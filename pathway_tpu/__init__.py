@@ -1,0 +1,117 @@
+"""pathway_tpu — a TPU-native stream-processing & live-RAG framework.
+
+A brand-new implementation of the capabilities of the reference Pathway
+framework (see SURVEY.md): Table/expression DSL over incremental diff-stream
+semantics, one code path for batch + streaming, connectors, temporal
+windows/joins, vector indexing and the LLM xpack — executed by a host-side
+microbatch scheduler dispatching batched columnar compute to JAX/XLA/Pallas
+on TPU, instead of a Rust timely/differential-dataflow engine.
+
+Public API mirrors the reference's `import pathway as pw` surface
+(reference: python/pathway/__init__.py:10-95).
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import dtype as _dt
+from pathway_tpu.internals import reducers_frontend as reducers
+from pathway_tpu.internals import universes  # noqa: F401
+from pathway_tpu.internals.dtype import DType
+from pathway_tpu.internals.error import global_error_log
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    apply,
+    apply_async,
+    apply_with_type,
+    cast,
+    coalesce,
+    declare_type,
+    fill_error,
+    if_else,
+    make_tuple,
+    require,
+    unwrap,
+)
+from pathway_tpu.internals.iterate import iterate
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.internals.run import run, run_all
+from pathway_tpu.internals.schema import (
+    ColumnDefinition,
+    Schema,
+    column_definition,
+    schema_builder,
+    schema_from_csv,
+    schema_from_dict,
+    schema_from_pandas,
+    schema_from_types,
+)
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.table_slice import TableSlice
+from pathway_tpu.internals.thisclass import left, right, this
+from pathway_tpu.internals.udfs import UDF, udf
+from pathway_tpu.internals.joins import JoinMode, JoinResult
+
+# type aliases (pw.DateTimeNaive etc. usable in schema annotations)
+DATE_TIME_NAIVE = _dt.DATE_TIME_NAIVE
+DATE_TIME_UTC = _dt.DATE_TIME_UTC
+DURATION = _dt.DURATION
+DateTimeNaive = "DateTimeNaive"
+DateTimeUtc = "DateTimeUtc"
+Duration = "Duration"
+PyObjectWrapper = object
+
+from pathway_tpu import debug  # noqa: E402
+from pathway_tpu import demo  # noqa: E402
+from pathway_tpu import io  # noqa: E402
+from pathway_tpu import persistence  # noqa: E402
+from pathway_tpu import stdlib  # noqa: E402
+from pathway_tpu.stdlib import graphs, indexing, ml, ordered, statistical, stateful, temporal, utils  # noqa: E402
+from pathway_tpu import xpacks  # noqa: E402
+from pathway_tpu.internals import udfs  # noqa: E402
+from pathway_tpu.internals.udfs import (  # noqa: E402
+    AsyncRetryStrategy,
+    CacheStrategy,
+    DefaultCache,
+    DiskCache,
+    ExponentialBackoffRetryStrategy,
+    FixedDelayRetryStrategy,
+    InMemoryCache,
+    NoRetryStrategy,
+    async_executor,
+    fully_async_executor,
+    sync_executor,
+)
+from pathway_tpu.internals.sql import sql  # noqa: E402
+from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
+from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
+from pathway_tpu.internals.config import set_license_key  # noqa: E402
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
+
+Date_time_naive = DateTimeNaive
+
+__version__ = "0.1.0"
+
+# groupby sugar namespaces
+groupby = None
+
+
+def assert_table_has_columns(table: Table, columns) -> None:
+    missing = set(columns) - set(table.column_names())
+    if missing:
+        raise AssertionError(f"table is missing columns: {missing}")
+
+
+__all__ = [
+    "Table", "Schema", "Json", "Pointer", "DType", "TableSlice",
+    "this", "left", "right",
+    "apply", "apply_async", "apply_with_type", "cast", "coalesce",
+    "declare_type", "fill_error", "if_else", "make_tuple", "require",
+    "unwrap", "iterate", "udf", "UDF", "sql", "load_yaml",
+    "run", "run_all", "debug", "demo", "io", "reducers", "persistence",
+    "column_definition", "schema_builder", "schema_from_csv",
+    "schema_from_dict", "schema_from_pandas", "schema_from_types",
+    "indexing", "ml", "temporal", "graphs", "stdlib", "xpacks",
+    "MonitoringLevel", "AsyncTransformer", "global_error_log",
+]

@@ -1,0 +1,239 @@
+"""pw.debug — static table construction + deterministic printing
+(reference: python/pathway/debug/__init__.py:48-489).
+
+`table_from_markdown` + `compute_and_print` are the backbone of the test
+harness (SURVEY §4: the markdown-table → captured-diff-stream pattern).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.keys import Pointer, hash_values
+from pathway_tpu.internals.runner import GraphRunner, run_tables
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+
+_SPECIAL = ("_time", "_diff", "__time__", "__diff__")
+
+
+def _parse_value(tok: str) -> Any:
+    tok = tok.strip()
+    if tok in ("", "None"):
+        return None
+    if tok == "True":
+        return True
+    if tok == "False":
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        pass
+    if len(tok) >= 2 and tok[0] in "\"'" and tok[-1] == tok[0]:
+        return tok[1:-1]
+    return tok
+
+
+def table_from_markdown(txt: str, *, id_from=None, unsafe_trusted_ids=False,
+                        schema: type[sch.Schema] | None = None,
+                        _stream: bool = False) -> Table:
+    lines = [l for l in txt.strip().splitlines()
+             if l.strip() and not set(l.strip()) <= {"-", "|", " ", "+"}]
+    header = [h.strip() for h in re.split(r"\s*\|\s*", lines[0].strip().strip("|"))
+              if h.strip()]
+    rows_raw = []
+    for line in lines[1:]:
+        toks = [t for t in re.split(r"\s*\|\s*", line.strip().strip("|"))]
+        rows_raw.append([_parse_value(t) for t in toks])
+
+    has_id = header and header[0] == "id"
+    col_names = [h for h in header if h not in _SPECIAL and h != "id"]
+    time_idx = next((i for i, h in enumerate(header) if h in ("_time", "__time__")), None)
+    diff_idx = next((i for i, h in enumerate(header) if h in ("_diff", "__diff__")), None)
+    name_idx = {h: i for i, h in enumerate(header)}
+
+    keys, rows, times, diffs = [], [], [], []
+    for rix, raw in enumerate(rows_raw):
+        if has_id:
+            keys.append(hash_values("md-id", raw[0]))
+        elif id_from:
+            keys.append(hash_values(*[raw[name_idx[c]] for c in id_from]))
+        elif diff_idx is not None:
+            # with retractions, identical rows must share a key so -1 cancels +1
+            keys.append(hash_values(
+                "md-val", *[raw[name_idx[c]] if name_idx[c] < len(raw) else None
+                            for c in col_names]))
+        else:
+            keys.append(hash_values("md-row", rix))
+        rows.append(tuple(raw[name_idx[c]] if name_idx[c] < len(raw) else None
+                          for c in col_names))
+        times.append(int(raw[time_idx]) if time_idx is not None else 0)
+        diffs.append(int(raw[diff_idx]) if diff_idx is not None else 1)
+
+    if schema is not None:
+        the_schema = schema
+        dtypes = [the_schema[c].dtype for c in col_names]
+        rows = [tuple(dt.coerce_value(v, d) for v, d in zip(r, dtypes))
+                for r in rows]
+    else:
+        cols = {}
+        for i, c in enumerate(col_names):
+            vals = [r[i] for r in rows]
+            cols[c] = sch.ColumnSchema(name=c, dtype=_infer_col_dtype(vals))
+        the_schema = sch.schema_from_columns(cols)
+        dtypes = [the_schema[c].dtype for c in col_names]
+        rows = [tuple(dt.coerce_value(v, d) for v, d in zip(r, dtypes))
+                for r in rows]
+
+    plan = Plan("static", keys=keys, rows=rows,
+                times=times if (time_idx is not None or _stream) else None,
+                diffs=diffs if diff_idx is not None else None)
+    return Table(plan, the_schema, Universe())
+
+
+def _infer_col_dtype(vals) -> dt.DType:
+    non_null = [v for v in vals if v is not None]
+    opt = len(non_null) < len(vals)
+    if not non_null:
+        return dt.ANY
+    types = {type(v) for v in non_null}
+    if types <= {bool}:
+        base = dt.BOOL
+    elif types <= {int}:
+        base = dt.INT
+    elif types <= {int, float}:
+        base = dt.FLOAT if float in types else dt.INT
+    elif types <= {str}:
+        base = dt.STR
+    else:
+        base = dt.ANY
+    return dt.Optional(base) if opt else base
+
+
+# alias used pervasively in reference tests
+parse_to_table = table_from_markdown
+
+
+def table_from_rows(schema: type[sch.Schema], rows: list[tuple],
+                    unsafe_trusted_ids: bool = False, is_stream: bool = False) -> Table:
+    """rows: tuples of column values, optionally + (time, diff) when is_stream."""
+    col_names = schema.column_names()
+    keys, data, times, diffs = [], [], [], []
+    for rix, row in enumerate(rows):
+        if is_stream:
+            *vals, t, d = row
+        else:
+            vals, t, d = list(row), 0, 1
+        keys.append(hash_values("row", rix, *[repr(v) for v in vals]))
+        data.append(tuple(vals))
+        times.append(int(t))
+        diffs.append(int(d))
+    plan = Plan("static", keys=keys, rows=data,
+                times=times if is_stream else None,
+                diffs=diffs if is_stream else None)
+    return Table(plan, schema, Universe())
+
+
+def table_from_pandas(df: pd.DataFrame, *, id_from=None,
+                      unsafe_trusted_ids: bool = False,
+                      schema: type[sch.Schema] | None = None) -> Table:
+    if schema is None:
+        schema = sch.schema_from_pandas(df, id_from=id_from)
+    col_names = schema.column_names()
+    keys, rows = [], []
+    for rix, (idx, row) in enumerate(df.iterrows()):
+        if id_from:
+            keys.append(hash_values(*[row[c] for c in id_from]))
+        else:
+            keys.append(hash_values("md-row", rix))
+        rows.append(tuple(dt.normalize_scalar(row[c]) if c in df.columns else None
+                          for c in col_names))
+    plan = Plan("static", keys=keys, rows=rows, times=None, diffs=None)
+    return Table(plan, schema, Universe())
+
+
+def table_to_pandas(table: Table, *, include_id: bool = True) -> pd.DataFrame:
+    [cap] = run_tables(table)
+    state = cap.snapshot()
+    names = table.column_names()
+    records = []
+    index = []
+    for key in sorted(state, key=int):
+        row = state[key]
+        index.append(key)
+        records.append(dict(zip(names, row)))
+    df = pd.DataFrame.from_records(records, columns=names)
+    if include_id:
+        df.index = index
+    return df
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, str):
+        return v
+    if isinstance(v, Pointer):
+        return str(v)
+    return repr(v)
+
+
+def compute_and_print(table: Table, *, include_id: bool = True,
+                      short_pointers: bool = True, n_rows: int | None = None,
+                      squash_updates: bool = True, terminate_on_error: bool = True,
+                      file=None) -> None:
+    [cap] = run_tables(table)
+    state = cap.snapshot()
+    names = table.column_names()
+    items = sorted(state.items(), key=lambda kv: _row_sort_key(kv[1], kv[0]))
+    if n_rows is not None:
+        items = items[:n_rows]
+    cols = (["id"] if include_id else []) + names
+    lines = [" | ".join(cols)]
+    for key, row in items:
+        cells = ([str(key)] if include_id else []) + [_fmt(v) for v in row]
+        lines.append(" | ".join(cells))
+    print("\n".join(lines), file=file)
+
+
+def _row_sort_key(row, key):
+    out = []
+    for v in row:
+        if isinstance(v, (bool, int, float)) and not isinstance(v, Pointer):
+            out.append((0, float(v), ""))
+        elif isinstance(v, str):
+            out.append((1, 0.0, v))
+        else:
+            out.append((2, 0.0, repr(v)))
+    out.append((3, float(int(key) % 10**9), ""))
+    return tuple(out)
+
+
+def compute_and_print_update_stream(table: Table, *, include_id: bool = True,
+                                    short_pointers: bool = True,
+                                    n_rows: int | None = None,
+                                    terminate_on_error: bool = True,
+                                    file=None) -> None:
+    [cap] = run_tables(table)
+    names = table.column_names()
+    events = cap.consolidated_events()
+    events.sort(key=lambda e: (e[2], _row_sort_key(e[1], e[0])))
+    if n_rows is not None:
+        events = events[:n_rows]
+    cols = (["id"] if include_id else []) + names + ["__time__", "__diff__"]
+    lines = [" | ".join(cols)]
+    for key, row, time, diff in events:
+        cells = ([str(key)] if include_id else []) + [_fmt(v) for v in row] + [
+            str(time), str(diff)]
+        lines.append(" | ".join(cells))
+    print("\n".join(lines), file=file)

@@ -1,0 +1,112 @@
+"""pw.demo — synthetic streams (reference: python/pathway/demo/__init__.py:28-258).
+
+Streams are generated as timed diff-feeds (speedrun semantics): each value
+arrives at its own logical timestamp, exercising the incremental path of
+every operator downstream, without wall-clock waits.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+from typing import Any, Callable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.keys import hash_values
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+
+
+def generate_custom_stream(value_generators: dict[str, Callable[[int], Any]],
+                           *, schema: type[sch.Schema] | None = None,
+                           nb_rows: int | None = 100,
+                           autocommit_duration_ms: int = 1000,
+                           input_rate: float = 1.0,
+                           persistent_id=None, name=None) -> Table:
+    n = nb_rows if nb_rows is not None else 100
+    names = list(value_generators.keys())
+    if schema is None:
+        schema = sch.schema_from_types(**{c: dt.ANY for c in names})
+    col_order = schema.column_names()
+    keys, rows, times = [], [], []
+    for i in range(n):
+        values = {c: value_generators[c](i) for c in names}
+        keys.append(hash_values("demo", i))
+        rows.append(tuple(values.get(c) for c in col_order))
+        times.append(i + 1)
+    plan = Plan("static", keys=keys, rows=rows, times=times, diffs=None)
+    return Table(plan, schema, Universe(), name=name or "demo_stream")
+
+
+def range_stream(*, nb_rows: int = 30, offset: int = 0,
+                 autocommit_duration_ms: int = 1000,
+                 input_rate: float = 1.0, name=None) -> Table:
+    return generate_custom_stream(
+        {"value": lambda i: i + offset},
+        schema=sch.schema_from_types(value=dt.INT),
+        nb_rows=nb_rows, name=name or "range_stream")
+
+
+def noisy_linear_stream(*, nb_rows: int = 10, input_rate: float = 1.0,
+                        name=None) -> Table:
+    import random
+
+    rng = random.Random(0)
+    return generate_custom_stream(
+        {"x": lambda i: float(i),
+         "y": lambda i: float(i) + rng.uniform(-1, 1)},
+        schema=sch.schema_from_types(x=dt.FLOAT, y=dt.FLOAT),
+        nb_rows=nb_rows, name=name or "noisy_linear")
+
+
+def replay_csv(path: str, *, schema: type[sch.Schema],
+               input_rate: float = 1.0, name=None) -> Table:
+    col_order = schema.column_names()
+    dtypes = schema._dtypes()
+    keys, rows, times = [], [], []
+    with open(path, newline="") as f:
+        for i, rec in enumerate(_csv.DictReader(f)):
+            vals = {c: _coerce(rec.get(c), dtypes[c]) for c in col_order}
+            keys.append(hash_values("replay", path, i))
+            rows.append(tuple(vals[c] for c in col_order))
+            times.append(i + 1)
+    plan = Plan("static", keys=keys, rows=rows, times=times, diffs=None)
+    return Table(plan, schema, Universe(), name=name or "replay_csv")
+
+
+def replay_csv_with_time(path: str, *, schema: type[sch.Schema],
+                         time_column: str, unit: str = "s",
+                         autocommit_ms: int = 100, speedup: float = 1.0,
+                         name=None) -> Table:
+    col_order = schema.column_names()
+    dtypes = schema._dtypes()
+    entries = []
+    with open(path, newline="") as f:
+        for i, rec in enumerate(_csv.DictReader(f)):
+            vals = {c: _coerce(rec.get(c), dtypes[c]) for c in col_order}
+            t = vals.get(time_column)
+            entries.append((t, i, vals))
+    entries.sort(key=lambda e: (e[0], e[1]))
+    keys, rows, times = [], [], []
+    for t, i, vals in entries:
+        keys.append(hash_values("replay", path, i))
+        rows.append(tuple(vals[c] for c in col_order))
+        times.append(int(t) if t is not None else i)
+    plan = Plan("static", keys=keys, rows=rows, times=times, diffs=None)
+    return Table(plan, schema, Universe(), name=name or "replay_csv_time")
+
+
+def _coerce(v, d):
+    if v is None:
+        return None
+    base = dt.unoptionalize(d)
+    try:
+        if base is dt.INT:
+            return int(v)
+        if base is dt.FLOAT:
+            return float(v)
+        if base is dt.BOOL:
+            return str(v).lower() in ("1", "true", "yes", "on")
+    except ValueError:
+        return None
+    return v

@@ -1,0 +1,144 @@
+"""Diff-delta representation: the engine's unit of data motion.
+
+Replaces differential-dataflow's ``Collection<(Key, Row)>`` updates
+(reference: src/engine/dataflow.rs:162-181). A *delta* is a consolidated
+multiset of ``(key, row, diff)`` changes at one logical timestamp. Tables are
+keyed — at most one live row per key — so arrangements are plain
+``dict[key -> row]`` and consolidation sums diffs per (key, row-fingerprint).
+
+Rows are Python tuples host-side; numeric columns are materialized to numpy
+on demand (``column_array``) for vectorized/XLA evaluation — the hot tensor
+path (embeddings, KNN) never round-trips through per-row objects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+from pathway_tpu.internals.keys import Pointer, hash_values
+
+Entry = tuple  # (Pointer, tuple_row, int_diff)
+
+
+def row_fingerprint(row: tuple) -> int:
+    """Equality-compatible digest of a row (handles ndarray cells)."""
+    try:
+        return hash(row)
+    except TypeError:
+        return int(hash_values(*row))
+
+
+class Delta:
+    """A consolidated batch of (key, row, diff) updates."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self, entries: list[Entry] | None = None):
+        self.entries: list[Entry] = entries if entries is not None else []
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def __bool__(self) -> bool:
+        return bool(self.entries)
+
+    def __iter__(self) -> Iterator[Entry]:
+        return iter(self.entries)
+
+    def append(self, key: Pointer, row: tuple, diff: int) -> None:
+        self.entries.append((key, row, diff))
+
+    def extend(self, entries: Iterable[Entry]) -> None:
+        self.entries.extend(entries)
+
+    def consolidate(self) -> "Delta":
+        if len(self.entries) <= 1:
+            return self
+        acc: dict[tuple[Pointer, int], list] = {}
+        for key, row, diff in self.entries:
+            k = (key, row_fingerprint(row))
+            slot = acc.get(k)
+            if slot is None:
+                acc[k] = [key, row, diff]
+            else:
+                slot[2] += diff
+        return Delta([(k, r, d) for k, r, d in acc.values() if d != 0])
+
+    def map(self, fn: Callable[[Pointer, tuple], tuple]) -> "Delta":
+        return Delta([(k, fn(k, r), d) for k, r, d in self.entries])
+
+    def negate(self) -> "Delta":
+        return Delta([(k, r, -d) for k, r, d in self.entries])
+
+    # ---- columnar views ---------------------------------------------------
+    def column_array(self, i: int, np_dtype=None) -> np.ndarray:
+        vals = [r[i] for _, r, _ in self.entries]
+        if np_dtype is not None and np_dtype != np.dtype(object):
+            return np.asarray(vals, dtype=np_dtype)
+        arr = np.empty(len(vals), dtype=object)
+        arr[:] = vals
+        return arr
+
+    def keys_list(self) -> list[Pointer]:
+        return [k for k, _, _ in self.entries]
+
+    def diffs_array(self) -> np.ndarray:
+        return np.asarray([d for _, _, d in self.entries], dtype=np.int64)
+
+    @staticmethod
+    def from_rows(keys: Iterable[Pointer], rows: Iterable[tuple],
+                  diff: int = 1) -> "Delta":
+        return Delta([(k, tuple(r), diff) for k, r in zip(keys, rows)])
+
+
+class Arrangement:
+    """Materialized current state of a keyed table: key -> row.
+
+    The host analogue of a DD arrangement/spine (reference arranges
+    collections for join/reduce sharing — src/engine/dataflow.rs). ``update``
+    applies a consolidated delta and returns the *effective* delta (what
+    actually changed), which downstream operators use for correct retraction.
+    """
+
+    __slots__ = ("rows",)
+
+    def __init__(self):
+        self.rows: dict[Pointer, tuple] = {}
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def get(self, key: Pointer):
+        return self.rows.get(key)
+
+    def __contains__(self, key: Pointer) -> bool:
+        return key in self.rows
+
+    def items(self):
+        return self.rows.items()
+
+    def update(self, delta: Delta) -> None:
+        for key, row, diff in delta.entries:
+            if diff > 0:
+                self.rows[key] = row
+            elif diff < 0:
+                cur = self.rows.get(key)
+                if cur is not None and row_fingerprint(cur) == row_fingerprint(row):
+                    del self.rows[key]
+
+    def as_delta(self, diff: int = 1) -> Delta:
+        return Delta([(k, r, diff) for k, r in self.rows.items()])
+
+
+def upsert_delta(arrangement: Arrangement, key: Pointer, new_row: tuple | None,
+                 out: Delta) -> None:
+    """Emit retraction of the current row (if any) + insertion of new_row."""
+    cur = arrangement.rows.get(key)
+    if cur is not None:
+        if new_row is not None and row_fingerprint(cur) == row_fingerprint(new_row):
+            return
+        out.append(key, cur, -1)
+    if new_row is not None:
+        out.append(key, new_row, 1)

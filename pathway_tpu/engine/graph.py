@@ -1,0 +1,259 @@
+"""Engine graph + microbatch scheduler.
+
+Replaces timely's worker loop / progress tracking (reference:
+run_with_new_dataflow_graph, src/engine/dataflow.rs:5430-5641). Scheduling
+model: logical timestamps are totally ordered u64s (reference
+src/engine/timestamp.rs:19); at each committed timestamp the scheduler
+pushes source deltas through the nodes in topological order — every operator
+sees its complete input delta for time t before producing output for t, which
+is exactly the consistency guarantee timely's frontiers provide, obtained
+here by construction of the microbatch loop.
+
+Iteration (pw.iterate) nests a sub-graph run to fixpoint per outer
+timestamp (reference: iterate, dataflow.rs:3668 — DD Variable with product
+timestamps; here: delta-driven rounds until the feedback delta is empty).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine.delta import Arrangement, Delta, row_fingerprint
+from pathway_tpu.engine.operators import Operator, SourceOperator
+
+
+class Node:
+    __slots__ = ("id", "op", "inputs", "name")
+
+    def __init__(self, id: int, op: Operator, inputs: list["Node"], name: str = ""):
+        self.id = id
+        self.op = op
+        self.inputs = inputs
+        self.name = name
+
+    def __repr__(self):
+        return f"<Node {self.id} {self.name or type(self.op).__name__}>"
+
+
+class EngineGraph:
+    def __init__(self):
+        self.nodes: list[Node] = []
+
+    def add_node(self, op: Operator, inputs: list[Node] | None = None,
+                 name: str = "") -> Node:
+        node = Node(len(self.nodes), op, list(inputs or []), name)
+        self.nodes.append(node)
+        return node
+
+    def add_source(self, name: str = "source") -> Node:
+        return self.add_node(SourceOperator(name), [], name)
+
+
+class CapturedStream:
+    """Output capture: the list of (key, row, time, diff) a table produced.
+
+    Mirrors the reference's capture_table_data (src/python_api.rs:3200) used
+    by the test harness's assert_table_equality / assert_stream_equality.
+    """
+
+    def __init__(self):
+        self.events: list[tuple] = []  # (key, row, time, diff)
+
+    def on_delta(self, time: int, delta: Delta) -> None:
+        for key, row, diff in delta.entries:
+            self.events.append((key, row, time, diff))
+
+    def snapshot(self) -> dict:
+        state: dict = {}
+        counts: dict = {}
+        for key, row, time, diff in self.events:
+            c = counts.get(key, 0) + diff
+            counts[key] = c
+            if c > 0:
+                state[key] = row
+            else:
+                state.pop(key, None)
+                counts.pop(key, None)
+        return state
+
+    def consolidated_events(self) -> list[tuple]:
+        acc: dict[tuple, int] = {}
+        order: dict[tuple, int] = {}
+        for i, (key, row, time, diff) in enumerate(self.events):
+            k = (key, row_fingerprint(row), time)
+            if k not in acc:
+                acc[k] = 0
+                order[k] = i
+            acc[k] += diff
+        out = []
+        for i, (key, row, time, diff) in enumerate(self.events):
+            k = (key, row_fingerprint(row), time)
+            if order.get(k) == i and acc[k] != 0:
+                out.append((key, row, time, acc[k]))
+        return out
+
+
+class Scheduler:
+    """Single-host microbatch driver for an EngineGraph."""
+
+    def __init__(self, graph: EngineGraph):
+        self.graph = graph
+        self._topo = self._topo_sort()
+        self.stats: dict[int, dict] = {
+            n.id: {"insertions": 0, "retractions": 0} for n in graph.nodes
+        }
+        self.on_step: Callable[[int], None] | None = None
+
+    def _topo_sort(self) -> list[Node]:
+        seen: dict[int, int] = {}
+        order: list[Node] = []
+
+        def visit(node: Node):
+            state = seen.get(node.id, 0)
+            if state == 2:
+                return
+            if state == 1:
+                raise ValueError("cycle in engine graph (use iterate)")
+            seen[node.id] = 1
+            for up in node.inputs:
+                visit(up)
+            seen[node.id] = 2
+            order.append(node)
+
+        for node in self.graph.nodes:
+            visit(node)
+        return order
+
+    def run_time(self, time: int) -> dict[int, Delta]:
+        """Process one committed timestamp: sources already hold pending data."""
+        outputs: dict[int, Delta] = {}
+        for node in self._topo:
+            in_deltas = [outputs.get(up.id, _EMPTY) for up in node.inputs]
+            delta = node.op.step(time, in_deltas)
+            extra = node.op.on_time_advance(time)
+            if extra:
+                delta = Delta(delta.entries + extra.entries).consolidate()
+            outputs[node.id] = delta
+            if delta:
+                st = self.stats[node.id]
+                for _, _, d in delta.entries:
+                    if d > 0:
+                        st["insertions"] += d
+                    else:
+                        st["retractions"] -= d
+        if self.on_step is not None:
+            self.on_step(time)
+        return outputs
+
+
+_EMPTY = Delta()
+
+
+class IterateOperator(Operator):
+    """Fixpoint iteration over a sub-graph.
+
+    ``builder(graph, iter_sources, extra_sources) -> (iter_out_nodes, result_nodes)``
+    builds the loop body. Per outer timestamp: feed full input state, run
+    delta-driven rounds (the body is incremental across rounds — shrinking
+    deltas near convergence, DD-style) until the feedback delta is empty or
+    ``limit`` rounds passed; then emit the diff of the converged result
+    against what was previously emitted.
+    """
+
+    def __init__(self, n_iterated: int, n_extra: int, builder, limit: int | None):
+        self.arity = n_iterated + n_extra
+        self.n_iterated = n_iterated
+        self.n_extra = n_extra
+        self.builder = builder
+        self.limit = limit
+        self.input_states = [Arrangement() for _ in range(self.arity)]
+        self.emitted: list[Arrangement] = []
+        self.n_results: int | None = None
+
+    def step(self, time, in_deltas):
+        if not any(in_deltas):
+            return Delta()
+        for st, d in zip(self.input_states, in_deltas):
+            st.update(d)
+
+        sub = EngineGraph()
+        iter_sources = [sub.add_source(f"iter_{i}") for i in range(self.n_iterated)]
+        extra_sources = [sub.add_source(f"extra_{i}") for i in range(self.n_extra)]
+        iter_out_nodes, result_nodes = self.builder(sub, iter_sources, extra_sources)
+        assert len(iter_out_nodes) == self.n_iterated
+        if self.n_results is None:
+            self.n_results = len(result_nodes)
+            self.emitted = [Arrangement() for _ in range(self.n_results)]
+
+        sched = Scheduler(sub)
+        var_states = [Arrangement() for _ in range(self.n_iterated)]
+        out_states = [Arrangement() for _ in range(self.n_iterated)]
+        result_states = [Arrangement() for _ in range(self.n_results)]
+
+        # round 0: feed full current input state
+        for i, src in enumerate(iter_sources):
+            full = self.input_states[i].as_delta()
+            src.op.push(full)
+            var_states[i].update(full)
+        for j, src in enumerate(extra_sources):
+            src.op.push(self.input_states[self.n_iterated + j].as_delta())
+
+        rounds = 0
+        while True:
+            outputs = sched.run_time(rounds)
+            for i, node in enumerate(iter_out_nodes):
+                out_states[i].update(outputs.get(node.id, _EMPTY))
+            for i, node in enumerate(result_nodes):
+                result_states[i].update(outputs.get(node.id, _EMPTY))
+            rounds += 1
+            if self.limit is not None and rounds >= self.limit:
+                break
+            # feedback delta = body output state - variable state
+            converged = True
+            for i in range(self.n_iterated):
+                fb = _state_diff(var_states[i], out_states[i])
+                if fb:
+                    converged = False
+                    iter_sources[i].op.push(fb)
+                    var_states[i].update(fb)
+            if converged:
+                break
+
+        out = Delta()
+        self._result_offsets = []
+        for i in range(self.n_results):
+            fb = _state_diff(self.emitted[i], result_states[i])
+            self._result_offsets.append((len(out.entries), len(fb.entries)))
+            # tag rows with result index so the demux downstream can split
+            for key, row, diff in fb.entries:
+                out.append(key, (i, row), diff)
+            self.emitted[i].update(fb)
+        return out
+
+
+class DemuxOperator(Operator):
+    """Select the i-th tagged sub-stream of an IterateOperator output."""
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        if not delta:
+            return Delta()
+        return Delta([
+            (k, row, d) for k, (i, row), d in delta.entries if i == self.index
+        ])
+
+
+def _state_diff(old: Arrangement, new: Arrangement) -> Delta:
+    out = Delta()
+    for key, row in old.items():
+        nrow = new.get(key)
+        if nrow is None or row_fingerprint(nrow) != row_fingerprint(row):
+            out.append(key, row, -1)
+    for key, row in new.items():
+        orow = old.get(key)
+        if orow is None or row_fingerprint(orow) != row_fingerprint(row):
+            out.append(key, row, 1)
+    return out

@@ -1,0 +1,101 @@
+"""As-of-now external index operator.
+
+Rebuild of the reference's UseExternalIndexAsOfNow
+(src/engine/dataflow/operators/external_index.rs +
+src/external_integration/mod.rs:40-48): the data stream's diffs maintain the
+index (add on +1, remove on -1); each *query insertion* is answered against
+the index state as of its arrival and the answer is never revised — the
+semantics behind DataIndex.query_as_of_now / live RAG serving.
+
+The index object itself is pluggable (protocol below); the TPU-resident
+brute-force KNN lives in pathway_tpu/ops/knn.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+from pathway_tpu.engine.delta import Delta
+from pathway_tpu.engine.operators import Operator
+from pathway_tpu.internals.keys import Pointer
+
+
+class ExternalIndex(Protocol):
+    def add(self, key: Pointer, vector: Any, filter_data: Any | None) -> None: ...
+
+    def remove(self, key: Pointer) -> None: ...
+
+    def search(self, queries: list[tuple[Pointer, Any, int | None, str | None]]
+               ) -> list[tuple]:
+        """Batched: [(qkey, query_vec, limit, filter)] ->
+        per query a tuple of (match_key, score) pairs."""
+        ...
+
+
+class ExternalIndexOperator(Operator):
+    arity = 2  # [data, queries]
+
+    def __init__(self, index, data_vec_pos: int, data_filter_pos: int | None,
+                 query_vec_pos: int, query_limit_pos: int | None,
+                 query_filter_pos: int | None, default_limit: int = 3):
+        self.index = index
+        self.data_vec_pos = data_vec_pos
+        self.data_filter_pos = data_filter_pos
+        self.query_vec_pos = query_vec_pos
+        self.query_limit_pos = query_limit_pos
+        self.query_filter_pos = query_filter_pos
+        self.default_limit = default_limit
+        self.answers: dict[Pointer, tuple] = {}
+
+    def step(self, time, in_deltas):
+        from pathway_tpu.internals.error import ERROR, global_error_log
+
+        data_delta, query_delta = in_deltas
+        # 1. maintain index from data diffs (before answering this batch's
+        #    queries — matches reference order: index updated, then searches)
+        for key, row, diff in data_delta.entries:
+            if diff > 0:
+                vec = row[self.data_vec_pos]
+                if vec is None or vec is ERROR:
+                    global_error_log().log(
+                        "external index: skipping row with error/None vector",
+                        operator="external_index")
+                    continue
+                filt = row[self.data_filter_pos] if self.data_filter_pos is not None else None
+                self.index.add(key, vec, filt)
+            else:
+                self.index.remove(key)
+        out = Delta()
+        # 2. answer query insertions (batched), retract answers on query removal
+        batch = []
+        for key, row, diff in query_delta.entries:
+            if diff > 0:
+                vec = row[self.query_vec_pos]
+                if vec is None or vec is ERROR:
+                    # poisoned query: empty reply, never crash the worker
+                    global_error_log().log(
+                        "external index: query with error/None vector",
+                        operator="external_index")
+                    self.answers[key] = ()
+                    out.append(key, ((),), 1)
+                    continue
+                limit = (row[self.query_limit_pos]
+                         if self.query_limit_pos is not None else self.default_limit)
+                if not isinstance(limit, int):
+                    limit = self.default_limit
+                filt = (row[self.query_filter_pos]
+                        if self.query_filter_pos is not None else None)
+                if filt is ERROR:
+                    filt = None
+                batch.append((key, vec, limit, filt))
+            else:
+                prev = self.answers.pop(key, None)
+                if prev is not None:
+                    out.append(key, (prev,), -1)
+        if batch:
+            replies = self.index.search(batch)
+            for (key, _, _, _), reply in zip(batch, replies):
+                reply = tuple(reply)
+                self.answers[key] = reply
+                out.append(key, (reply,), 1)
+        return out

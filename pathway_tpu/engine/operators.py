@@ -1,0 +1,520 @@
+"""Incremental operators over diff-deltas.
+
+Rebuild of the reference engine's operator set (``trait Graph``,
+src/engine/graph.rs:664-1007, implemented in src/engine/dataflow.rs). Each
+operator consumes consolidated input deltas for one timestamp and emits the
+exact output delta — the differential-dataflow contract — but scheduled by a
+host-side microbatch loop instead of timely progress tracking. Batched
+columnar callables (numpy/XLA) do the per-batch math; there is no per-row
+FFI in the hot path.
+
+Conventions:
+- every table is keyed: ≤1 live row per key,
+- ``step(time, in_deltas)`` is called once per node per timestamp,
+- map/filter callables receive ``(keys: list[Pointer], rows: list[tuple])``
+  and return batch results (lists / numpy arrays).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine.delta import (
+    Arrangement,
+    Delta,
+    row_fingerprint,
+    upsert_delta,
+)
+from pathway_tpu.engine.reducers import make_reducer_state
+from pathway_tpu.internals.keys import Pointer, hash_values
+
+
+class Operator:
+    arity = 1
+
+    def step(self, time: int, in_deltas: list[Delta]) -> Delta:
+        raise NotImplementedError
+
+    def on_time_advance(self, time: int) -> Delta:
+        """Called for every committed timestamp (even with no input) so
+        buffering operators (temporal behaviors) can release rows."""
+        return Delta()
+
+
+class SourceOperator(Operator):
+    """Fed externally by an input session; just passes its delta through."""
+
+    arity = 0
+
+    def __init__(self, name: str = "source"):
+        self.name = name
+        self.pending = Delta()
+
+    def push(self, delta: Delta) -> None:
+        self.pending.extend(delta.entries)
+
+    def step(self, time, in_deltas):
+        out = self.pending.consolidate()
+        self.pending = Delta()
+        return out
+
+
+class MapOperator(Operator):
+    """Row-wise (batched) projection: select / expression tables
+    (reference: expression_table, dataflow.rs:1258)."""
+
+    def __init__(self, fn: Callable[[list, list], list]):
+        self.fn = fn
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        if not delta:
+            return Delta()
+        keys = delta.keys_list()
+        rows = [r for _, r, _ in delta.entries]
+        new_rows = self.fn(keys, rows)
+        return Delta([
+            (k, tuple(nr), d)
+            for (k, _, d), nr in zip(delta.entries, new_rows)
+        ])
+
+
+class DeterministicMapOperator(MapOperator):
+    """Map that caches outputs per key so retractions replay identical values
+    even for non-deterministic fns (reference:
+    map_named_with_consistent_deletions, dataflow/operators.rs:308)."""
+
+    def __init__(self, fn):
+        super().__init__(fn)
+        self.cache: dict[tuple[Pointer, int], tuple] = {}
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        if not delta:
+            return Delta()
+        out = Delta()
+        to_eval = []
+        for key, row, diff in delta.entries:
+            ck = (key, row_fingerprint(row))
+            if diff < 0 and ck in self.cache:
+                out.append(key, self.cache.pop(ck), diff)
+            else:
+                to_eval.append((key, row, diff, ck))
+        if to_eval:
+            keys = [k for k, _, _, _ in to_eval]
+            rows = [r for _, r, _, _ in to_eval]
+            new_rows = self.fn(keys, rows)
+            for (key, _, diff, ck), nr in zip(to_eval, new_rows):
+                nr = tuple(nr)
+                if diff > 0:
+                    self.cache[ck] = nr
+                out.append(key, nr, diff)
+        return out
+
+
+class FilterOperator(Operator):
+    def __init__(self, pred: Callable[[list, list], Sequence[bool]]):
+        self.pred = pred
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        if not delta:
+            return Delta()
+        keys = delta.keys_list()
+        rows = [r for _, r, _ in delta.entries]
+        mask = self.pred(keys, rows)
+        return Delta([e for e, m in zip(delta.entries, mask) if m])
+
+
+class ReindexOperator(Operator):
+    """Re-key rows (with_id_from / reindex). New key computed from the row;
+    collisions on the new key are a user error (like reference)."""
+
+    def __init__(self, key_fn: Callable[[list, list], Sequence[Pointer]]):
+        self.key_fn = key_fn
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        if not delta:
+            return Delta()
+        keys = delta.keys_list()
+        rows = [r for _, r, _ in delta.entries]
+        new_keys = self.key_fn(keys, rows)
+        return Delta([
+            (nk, r, d) for (k, r, d), nk in zip(delta.entries, new_keys)
+        ]).consolidate()
+
+
+class FlattenOperator(Operator):
+    """One row -> many rows (Table.flatten). fn(key,row) yields (new_key,new_row)."""
+
+    def __init__(self, fn: Callable[[Pointer, tuple], list]):
+        self.fn = fn
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        out = Delta()
+        for key, row, diff in delta.entries:
+            for nk, nr in self.fn(key, row):
+                out.append(nk, tuple(nr), diff)
+        return out.consolidate()
+
+
+class BinaryKeyOperator(Operator):
+    """Generic key-aligned binary combiner.
+
+    Covers concat/update_rows/intersect/difference/restrict/having and
+    same-universe column zipping: maintains both input arrangements, and for
+    every affected key recomputes ``combine(left_row|None, right_row|None)``
+    before and after the delta, emitting the difference. This is the
+    host analogue of DD's arrange-both-sides + per-key recompute
+    (reference: concat/update_rows via engine union ops, dataflow.rs).
+    """
+
+    arity = 2
+
+    def __init__(self, combine: Callable[[tuple | None, tuple | None], tuple | None]):
+        self.combine = combine
+        self.left = Arrangement()
+        self.right = Arrangement()
+
+    def step(self, time, in_deltas):
+        dl, dr = in_deltas
+        if not dl and not dr:
+            return Delta()
+        affected: dict[Pointer, None] = {}
+        for k, _, _ in dl.entries:
+            affected[k] = None
+        for k, _, _ in dr.entries:
+            affected[k] = None
+        old_out: dict[Pointer, tuple | None] = {}
+        for k in affected:
+            old_out[k] = self.combine(self.left.get(k), self.right.get(k))
+        self.left.update(dl)
+        self.right.update(dr)
+        out = Delta()
+        for k in affected:
+            new = self.combine(self.left.get(k), self.right.get(k))
+            old = old_out[k]
+            if old is not None and (new is None or
+                                    row_fingerprint(old) != row_fingerprint(new)):
+                out.append(k, old, -1)
+            if new is not None and (old is None or
+                                    row_fingerprint(old) != row_fingerprint(new)):
+                out.append(k, new, 1)
+        return out
+
+
+class NAryConcatOperator(Operator):
+    """Disjoint-key union of N inputs (Table.concat). Raises on key overlap
+    unless ``update`` (last input wins — update_rows semantics)."""
+
+    def __init__(self, n: int, combine_rows: Callable[[list], tuple | None],
+                 update: bool = False):
+        self.arity = n
+        self.states = [Arrangement() for _ in range(n)]
+        self.combine_rows = combine_rows
+        self.update = update
+
+    def step(self, time, in_deltas):
+        if not any(in_deltas):
+            return Delta()
+        affected: dict[Pointer, None] = {}
+        for d in in_deltas:
+            for k, _, _ in d.entries:
+                affected[k] = None
+        old = {k: self._combined(k) for k in affected}
+        for st, d in zip(self.states, in_deltas):
+            st.update(d)
+        out = Delta()
+        for k in affected:
+            new = self._combined(k)
+            o = old[k]
+            if o is not None and (new is None or row_fingerprint(o) != row_fingerprint(new)):
+                out.append(k, o, -1)
+            if new is not None and (o is None or row_fingerprint(o) != row_fingerprint(new)):
+                out.append(k, new, 1)
+        return out
+
+    def _combined(self, key):
+        present = [st.get(key) for st in self.states]
+        live = [r for r in present if r is not None]
+        if not live:
+            return None
+        if len(live) > 1 and not self.update:
+            raise KeyError(
+                f"duplicate key {key!r} in concat of tables with overlapping "
+                "universes; use update_rows or concat_reindex"
+            )
+        return self.combine_rows(present)
+
+
+class GroupByOperator(Operator):
+    """groupby().reduce() (reference: group_by_table, dataflow.rs:2904).
+
+    ``group_fn(key,row) -> (group_key, group_vals)`` routes each input row to
+    a group; ``reducer_specs`` is a list of
+    ``(name, extract(key,row)->argtuple, kwargs)``. Emits per changed group a
+    retraction of the old reduced row and the new one.
+    """
+
+    def __init__(self, group_fn, reducer_specs):
+        self.group_fn = group_fn
+        self.reducer_specs = reducer_specs
+        self.group_states: dict[Pointer, list] = {}   # gkey -> [states...]
+        self.group_vals: dict[Pointer, tuple] = {}
+        self.group_counts: dict[Pointer, int] = {}    # membership multiset size
+        self.out = Arrangement()
+        self.seq = 0
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        if not delta:
+            return Delta()
+        touched: dict[Pointer, None] = {}
+        for key, row, diff in delta.entries:
+            gkey, gvals = self.group_fn(key, row)
+            states = self.group_states.get(gkey)
+            if states is None:
+                states = [make_reducer_state(name, **kw)
+                          for name, _, kw in self.reducer_specs]
+                self.group_states[gkey] = states
+                self.group_vals[gkey] = gvals
+                self.group_counts[gkey] = 0
+            self.group_counts[gkey] += diff
+            for st, (name, extract, _kw) in zip(states, self.reducer_specs):
+                args = extract(key, row)
+                if name in ("earliest", "latest"):
+                    if diff > 0:
+                        args = (*args, (time, self.seq))
+                        self.seq += 1
+                    else:
+                        args = (*args, None)
+                st.add(args, diff)
+            touched[gkey] = None
+        out = Delta()
+        for gkey in touched:
+            states = self.group_states[gkey]
+            if self.group_counts.get(gkey, 0) <= 0:
+                new_row = None
+                del self.group_states[gkey]
+                self.group_vals.pop(gkey, None)
+                self.group_counts.pop(gkey, None)
+            else:
+                gvals = self.group_vals[gkey]
+                new_row = (*gvals, *[st.emit() for st in states])
+            upsert_delta(self.out, gkey, new_row, out)
+        self.out.update(out)
+        return out
+
+
+class JoinOperator(Operator):
+    """Inner/left/right/outer join (reference: join_tables, dataflow.rs:2276).
+
+    ``lkey_fn/rkey_fn`` extract the join key from a row; output id =
+    hash(join-side ids) like the reference (result key sharded like the join
+    key, dataflow.rs:2371-2379); outer 'ears' appear when a side has no
+    match. For every affected join-key group the output set is recomputed
+    before/after and differenced — correct under arbitrary retraction.
+    """
+
+    arity = 2
+
+    def __init__(self, mode: str, lkey_fn, rkey_fn,
+                 out_fn: Callable[[Pointer | None, tuple | None, Pointer | None, tuple | None], tuple],
+                 out_key_fn=None, left_id_only: bool = False):
+        assert mode in ("inner", "left", "right", "outer")
+        self.mode = mode
+        self.lkey_fn = lkey_fn
+        self.rkey_fn = rkey_fn
+        self.out_fn = out_fn
+        self.out_key_fn = out_key_fn or self._default_out_key
+        self.left: dict[Any, dict[Pointer, tuple]] = {}
+        self.right: dict[Any, dict[Pointer, tuple]] = {}
+        self.left_id_only = left_id_only
+
+    @staticmethod
+    def _default_out_key(lkey, rkey, jk):
+        return hash_values(lkey, rkey)
+
+    def _group_out(self, jk) -> dict[Pointer, tuple]:
+        lg = self.left.get(jk) or {}
+        rg = self.right.get(jk) or {}
+        out: dict[Pointer, tuple] = {}
+        if lg and rg:
+            for lk, lrow in lg.items():
+                for rk, rrow in rg.items():
+                    out[self.out_key_fn(lk, rk, jk)] = self.out_fn(lk, lrow, rk, rrow)
+        if self.mode in ("left", "outer") and lg and not rg:
+            for lk, lrow in lg.items():
+                out[self.out_key_fn(lk, None, jk)] = self.out_fn(lk, lrow, None, None)
+        if self.mode in ("right", "outer") and rg and not lg:
+            for rk, rrow in rg.items():
+                out[self.out_key_fn(None, rk, jk)] = self.out_fn(None, None, rk, rrow)
+        return out
+
+    @staticmethod
+    def _apply(index, jk, key, row, diff):
+        grp = index.setdefault(jk, {})
+        if diff > 0:
+            grp[key] = row
+        else:
+            grp.pop(key, None)
+            if not grp:
+                index.pop(jk, None)
+
+    def step(self, time, in_deltas):
+        dl, dr = in_deltas
+        if not dl and not dr:
+            return Delta()
+        affected: dict[Any, None] = {}
+        l_entries = [(self.lkey_fn(k, r), k, r, d) for k, r, d in dl.entries]
+        r_entries = [(self.rkey_fn(k, r), k, r, d) for k, r, d in dr.entries]
+        for jk, _, _, _ in l_entries:
+            affected[jk] = None
+        for jk, _, _, _ in r_entries:
+            affected[jk] = None
+        affected.pop(None, None)  # null join keys never match
+        old = {jk: self._group_out(jk) for jk in affected}
+        for jk, k, r, d in l_entries:
+            if jk is not None:
+                self._apply(self.left, jk, k, r, d)
+        for jk, k, r, d in r_entries:
+            if jk is not None:
+                self._apply(self.right, jk, k, r, d)
+        out = Delta()
+        for jk in affected:
+            new = self._group_out(jk)
+            o = old[jk]
+            for okey, orow in o.items():
+                n = new.get(okey)
+                if n is None or row_fingerprint(n) != row_fingerprint(orow):
+                    out.append(okey, orow, -1)
+            for okey, nrow in new.items():
+                oo = o.get(okey)
+                if oo is None or row_fingerprint(oo) != row_fingerprint(nrow):
+                    out.append(okey, nrow, 1)
+        return out.consolidate()
+
+
+class DeduplicateOperator(Operator):
+    """pw.Table.deduplicate (reference: deduplicate, dataflow.rs:3013):
+    per instance keep one accepted value; ``acceptor(new, old) -> bool``
+    decides replacement. Append-only w.r.t. input deletions (ignored)."""
+
+    def __init__(self, instance_fn, value_fn, acceptor, full_row: bool = True):
+        self.instance_fn = instance_fn
+        self.value_fn = value_fn
+        self.acceptor = acceptor
+        self.state: dict[Any, tuple[Pointer, tuple]] = {}
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        out = Delta()
+        for key, row, diff in delta.entries:
+            if diff <= 0:
+                continue  # deduplicate consumes append-only streams
+            inst = self.instance_fn(key, row)
+            new_val = self.value_fn(key, row)
+            cur = self.state.get(inst)
+            if cur is None:
+                accept = True
+            else:
+                old_val = self.value_fn(cur[0], cur[1])
+                try:
+                    accept = bool(self.acceptor(new_val, old_val))
+                except Exception:
+                    accept = False
+            if accept:
+                gkey = hash_values("dedup", inst)
+                if cur is not None:
+                    out.append(gkey, cur[1], -1)
+                self.state[inst] = (key, row)
+                out.append(gkey, row, 1)
+        return out.consolidate()
+
+
+class OutputOperator(Operator):
+    """Terminal capture: invokes callback(time, delta); passes delta through."""
+
+    def __init__(self, callback: Callable[[int, Delta], None]):
+        self.callback = callback
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        if delta:
+            self.callback(time, delta)
+        return delta
+
+    def notify_time_end(self, time):
+        pass
+
+
+class StatefulArrangeOperator(Operator):
+    """Materializes its input (identity + arrangement), for ix/debug reads."""
+
+    def __init__(self):
+        self.state = Arrangement()
+
+    def step(self, time, in_deltas):
+        self.state.update(in_deltas[0])
+        return in_deltas[0]
+
+
+class SortOperator(Operator):
+    """prev/next pointers within (instance, sort-key) order
+    (reference: sort_table, dataflow.rs:1910; operators/prev_next.rs).
+
+    Round-1 implementation recomputes neighbours for the affected instance
+    on change — O(n log n) per touched instance, correct under retraction.
+    """
+
+    def __init__(self, key_fn, instance_fn):
+        self.key_fn = key_fn
+        self.instance_fn = instance_fn
+        self.instances: dict[Any, dict[Pointer, Any]] = {}
+        self.out = Arrangement()
+
+    def step(self, time, in_deltas):
+        delta = in_deltas[0]
+        if not delta:
+            return Delta()
+        touched: dict[Any, None] = {}
+        removed: list[Pointer] = []
+        for key, row, diff in delta.entries:
+            inst = self.instance_fn(key, row)
+            grp = self.instances.setdefault(inst, {})
+            if diff > 0:
+                grp[key] = self.key_fn(key, row)
+            else:
+                if key in grp:
+                    grp.pop(key)
+                    removed.append(key)
+            touched[inst] = None
+        out = Delta()
+        for key in removed:
+            # only retract if the key wasn't re-inserted (possibly under
+            # another instance) in this same delta
+            if not any(key in g for g in self.instances.values()):
+                upsert_delta(self.out, key, None, out)
+        for inst in touched:
+            grp = self.instances.get(inst, {})
+            order = sorted(grp.items(), key=lambda kv: (_sortable(kv[1]), int(kv[0])))
+            for i, (key, _sk) in enumerate(order):
+                prev_k = order[i - 1][0] if i > 0 else None
+                next_k = order[i + 1][0] if i + 1 < len(order) else None
+                upsert_delta(self.out, key, (prev_k, next_k), out)
+        self.out.update(out)
+        return out
+
+
+def _sortable(v):
+    if v is None:
+        return (0, 0)
+    if isinstance(v, (bool, int, float, np.integer, np.floating)):
+        return (1, float(v))
+    if isinstance(v, str):
+        return (2, v)
+    return (3, repr(v))

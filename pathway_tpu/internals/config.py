@@ -1,0 +1,72 @@
+"""Runtime config from PATHWAY_* env vars
+(reference: python/pathway/internals/config.py + src/engine/dataflow/config.rs).
+
+Worker topology maps to the TPU mesh instead of timely threads/processes:
+``PATHWAY_THREADS`` ≈ host-side ingest/worker threads,
+``PATHWAY_PROCESSES``/``PATHWAY_PROCESS_ID`` ≈ multi-host topology. There is
+deliberately no 8-worker license cap (reference caps at MAX_WORKERS=8,
+config.rs:7-11; we don't) and no license server phone-home (license.rs:11).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class PathwayConfig:
+    license_key: str | None = None
+    monitoring_server: str | None = None
+    ignore_asserts: bool = False
+
+    @property
+    def threads(self) -> int:
+        return _env_int("PATHWAY_THREADS", 1)
+
+    @property
+    def processes(self) -> int:
+        return _env_int("PATHWAY_PROCESSES", 1)
+
+    @property
+    def process_id(self) -> int:
+        return _env_int("PATHWAY_PROCESS_ID", 0)
+
+    @property
+    def first_port(self) -> int:
+        return _env_int("PATHWAY_FIRST_PORT", 10000)
+
+    @property
+    def monitoring_http_port(self) -> int:
+        return _env_int("PATHWAY_MONITORING_HTTP_PORT", 20000) + self.process_id
+
+    @property
+    def persistent_storage(self) -> str | None:
+        return os.environ.get("PATHWAY_PERSISTENT_STORAGE")
+
+    @property
+    def run_id(self) -> str:
+        return os.environ.get("PATHWAY_RUN_ID", "")
+
+    @property
+    def total_workers(self) -> int:
+        return self.threads * self.processes
+
+
+pathway_config = PathwayConfig()
+
+
+def get_pathway_config() -> PathwayConfig:
+    return pathway_config
+
+
+def set_license_key(key: str | None) -> None:
+    """Accepted for API compatibility; all features are always enabled."""
+    pathway_config.license_key = key

@@ -1,0 +1,505 @@
+"""Expression AST → batched evaluators.
+
+The compile-side counterpart of the reference's per-context
+ExpressionEvaluators (python/pathway/internals/graph_runner/
+expression_evaluator.py) and the engine interpreter
+(src/engine/expression.rs) — except evaluation is *batched*: each compiled
+node maps a whole delta's column to a result column. Sync UDFs run once per
+batch; async UDFs gather the whole batch on one event loop (the reference
+takes the GIL once per batch and calls Python per row —
+dataflow.rs:1258-1318; we never go per-row across a runtime boundary).
+
+Numeric columns use numpy fast paths; object columns fall back to per-row
+Python with ERROR-sentinel propagation per cell.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals import operations as ops
+from pathway_tpu.internals.error import ERROR, global_error_log
+from pathway_tpu.internals.keys import hash_values
+
+Batch = list  # column of values, len == n rows
+
+
+class CompileContext:
+    """Maps column references to tuple positions in the engine row."""
+
+    def __init__(self):
+        self.col_pos: dict[tuple[int, str], int] = {}
+        self.id_tables: set[int] = set()
+        self.id_pos: dict[int, int] = {}
+
+    def add_table(self, table, offset: int) -> int:
+        """Register `table`'s columns at `offset`; returns next free offset."""
+        names = table._column_names()
+        for i, name in enumerate(names):
+            self.col_pos.setdefault((id(table), name), offset + i)
+        self.id_tables.add(id(table))
+        return offset + len(names)
+
+    def alias(self, table, target) -> None:
+        """Make references to `table` resolve like references to `target`."""
+        for (tid, name), pos in list(self.col_pos.items()):
+            if tid == id(target):
+                self.col_pos.setdefault((id(table), name), pos)
+        if id(target) in self.id_tables:
+            self.id_tables.add(id(table))
+
+    def position(self, ref: ex.ColumnReference) -> int:
+        key = (id(ref.table), ref.name)
+        if key not in self.col_pos:
+            raise KeyError(
+                f"column {ref.name!r} of table {ref.table!r} is not part of "
+                "this context (did you mean pw.this, or join the tables first?)"
+            )
+        return self.col_pos[key]
+
+
+class _AsyncLoop:
+    """Shared background event loop for async UDF batches
+    (reference: internals/graph_runner/async_utils.py)."""
+
+    _instance = None
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name="pathway-tpu-async-udf")
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def get(cls) -> "_AsyncLoop":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def gather(self, coros: list) -> list:
+        async def _g():
+            return await asyncio.gather(*coros, return_exceptions=True)
+
+        fut = asyncio.run_coroutine_threadsafe(_g(), self.loop)
+        return fut.result()
+
+
+def run_coro_batch(coros: list) -> list:
+    results = _AsyncLoop.get().gather(coros)
+    out = []
+    for r in results:
+        if isinstance(r, Exception):
+            global_error_log().log(f"async UDF failed: {r!r}")
+            out.append(ERROR)
+        else:
+            out.append(r)
+    return out
+
+
+class ExpressionCompiler:
+    def __init__(self, ctx: CompileContext):
+        self.ctx = ctx
+        self.has_non_deterministic = False
+
+    # -- public -------------------------------------------------------------
+    def compile(self, expr: ex.ColumnExpression) -> Callable[[list, list], Batch]:
+        return self._compile(expr)
+
+    def compile_program(self, exprs: list[ex.ColumnExpression]):
+        """Compile many output expressions into fn(keys, rows) -> list[tuple]."""
+        fns = [self._compile(e) for e in exprs]
+
+        def program(keys, rows):
+            cols = [fn(keys, rows) for fn in fns]
+            return list(zip(*cols)) if cols else [() for _ in keys]
+
+        return program
+
+    def compile_predicate(self, expr: ex.ColumnExpression):
+        fn = self._compile(expr)
+
+        def pred(keys, rows):
+            return [bool(v) and v is not ERROR for v in fn(keys, rows)]
+
+        return pred
+
+    def compile_key_fn(self, exprs: list[ex.ColumnExpression]):
+        fns = [self._compile(e) for e in exprs]
+
+        def key_fn(keys, rows):
+            cols = [fn(keys, rows) for fn in fns]
+            return [hash_values(*vals) for vals in zip(*cols)]
+
+        return key_fn
+
+    # -- dispatch -----------------------------------------------------------
+    def _compile(self, expr) -> Callable[[list, list], Batch]:
+        if not isinstance(expr, ex.ColumnExpression):
+            expr = ex.ConstExpression(expr)
+        method = getattr(self, f"_compile_{type(expr).__name__}", None)
+        if method is None:
+            raise NotImplementedError(f"cannot compile {type(expr).__name__}")
+        return method(expr)
+
+    # -- leaves -------------------------------------------------------------
+    def _compile_ConstExpression(self, expr):
+        v = expr._value
+
+        def fn(keys, rows):
+            return [v] * len(keys)
+
+        return fn
+
+    def _compile_IdExpression(self, expr):
+        pos = self.ctx.id_pos.get(id(expr.table))
+        if pos is not None:
+            def fn(keys, rows):
+                return [r[pos] for r in rows]
+            return fn
+
+        def fn(keys, rows):
+            return list(keys)
+
+        return fn
+
+    def _compile_ColumnReference(self, expr):
+        pos = self.ctx.position(expr)
+
+        def fn(keys, rows):
+            return [r[pos] for r in rows]
+
+        return fn
+
+    # -- operators ----------------------------------------------------------
+    def _compile_BinaryExpression(self, expr):
+        lf = self._compile(expr._left)
+        rf = self._compile(expr._right)
+        op = ops.BINARY_OPS[expr._op]
+        opname = expr._op
+
+        def fn(keys, rows):
+            lv = lf(keys, rows)
+            rv = rf(keys, rows)
+            out = []
+            for a, b in zip(lv, rv):
+                if a is ERROR or b is ERROR:
+                    out.append(ERROR)
+                elif a is None or b is None:
+                    if opname == "==":
+                        out.append(a is None and b is None)
+                    elif opname == "!=":
+                        out.append(not (a is None and b is None))
+                    else:
+                        out.append(None)
+                else:
+                    try:
+                        out.append(op(a, b))
+                    except Exception as e:
+                        global_error_log().log(f"{opname} failed: {e!r}")
+                        out.append(ERROR)
+            return out
+
+        return fn
+
+    def _compile_UnaryExpression(self, expr):
+        af = self._compile(expr._arg)
+        op = ops.UNARY_OPS[expr._op]
+
+        def fn(keys, rows):
+            return [
+                ERROR if v is ERROR else (None if v is None else op(v))
+                for v in af(keys, rows)
+            ]
+
+        return fn
+
+    def _compile_IsNoneExpression(self, expr):
+        af = self._compile(expr._arg)
+
+        def fn(keys, rows):
+            return [v is None for v in af(keys, rows)]
+
+        return fn
+
+    def _compile_IsNotNoneExpression(self, expr):
+        af = self._compile(expr._arg)
+
+        def fn(keys, rows):
+            return [v is not None for v in af(keys, rows)]
+
+        return fn
+
+    def _compile_IfElseExpression(self, expr):
+        cf = self._compile(expr._if)
+        tf = self._compile(expr._then)
+        ef = self._compile(expr._else)
+
+        def fn(keys, rows):
+            cond = cf(keys, rows)
+            tv = tf(keys, rows)
+            ev = ef(keys, rows)
+            return [
+                ERROR if c is ERROR else (t if c else e)
+                for c, t, e in zip(cond, tv, ev)
+            ]
+
+        return fn
+
+    def _compile_CoalesceExpression(self, expr):
+        fns = [self._compile(a) for a in expr._args]
+
+        def fn(keys, rows):
+            cols = [f(keys, rows) for f in fns]
+            out = []
+            for vals in zip(*cols):
+                res = None
+                for v in vals:
+                    if v is not None and v is not ERROR:
+                        res = v
+                        break
+                    if v is ERROR:
+                        res = ERROR
+                        break
+                out.append(res)
+            return out
+
+        return fn
+
+    def _compile_RequireExpression(self, expr):
+        vf = self._compile(expr._val)
+        fns = [self._compile(a) for a in expr._args]
+
+        def fn(keys, rows):
+            vals = vf(keys, rows)
+            deps = [f(keys, rows) for f in fns]
+            out = []
+            for i, v in enumerate(vals):
+                if any(d[i] is None for d in deps):
+                    out.append(None)
+                else:
+                    out.append(v)
+            return out
+
+        return fn
+
+    def _compile_CastExpression(self, expr):
+        af = self._compile(expr._expr)
+        target = expr._return_type
+
+        def fn(keys, rows):
+            out = []
+            for v in af(keys, rows):
+                try:
+                    out.append(ops.cast_value(v, target))
+                except Exception as e:
+                    global_error_log().log(f"cast failed: {e!r}")
+                    out.append(ERROR)
+            return out
+
+        return fn
+
+    def _compile_ConvertExpression(self, expr):
+        af = self._compile(expr._expr)
+        target = expr._return_type
+        unwrap = expr._unwrap
+
+        def fn(keys, rows):
+            out = []
+            for v in af(keys, rows):
+                try:
+                    out.append(ops.convert_value(v, target, unwrap))
+                except Exception as e:
+                    global_error_log().log(f"convert failed: {e!r}")
+                    out.append(ERROR)
+            return out
+
+        return fn
+
+    def _compile_DeclareTypeExpression(self, expr):
+        return self._compile(expr._expr)
+
+    def _compile_UnwrapExpression(self, expr):
+        af = self._compile(expr._expr)
+
+        def fn(keys, rows):
+            out = []
+            for v in af(keys, rows):
+                if v is None:
+                    global_error_log().log("unwrap() got None")
+                    out.append(ERROR)
+                else:
+                    out.append(v)
+            return out
+
+        return fn
+
+    def _compile_FillErrorExpression(self, expr):
+        af = self._compile(expr._expr)
+        rf = self._compile(expr._replacement)
+
+        def fn(keys, rows):
+            vals = af(keys, rows)
+            reps = rf(keys, rows)
+            return [r if v is ERROR else v for v, r in zip(vals, reps)]
+
+        return fn
+
+    def _compile_MakeTupleExpression(self, expr):
+        fns = [self._compile(a) for a in expr._args]
+
+        def fn(keys, rows):
+            cols = [f(keys, rows) for f in fns]
+            return [tuple(vals) for vals in zip(*cols)] if cols else [()] * len(keys)
+
+        return fn
+
+    def _compile_GetExpression(self, expr):
+        of = self._compile(expr._obj)
+        inf = self._compile(expr._index)
+        df = self._compile(expr._default)
+        check = expr._check_if_exists
+
+        def fn(keys, rows):
+            objs = of(keys, rows)
+            idxs = inf(keys, rows)
+            defs = df(keys, rows)
+            out = []
+            for o, i, d in zip(objs, idxs, defs):
+                try:
+                    out.append(ops.get_item(o, i, d, check))
+                except Exception as e:
+                    global_error_log().log(f"get_item failed: {e!r}")
+                    out.append(ERROR)
+            return out
+
+        return fn
+
+    def _compile_MethodCallExpression(self, expr):
+        fns = [self._compile(a) for a in expr._args]
+        method = ops.METHODS[expr._method]
+        kwargs = expr._kwargs
+
+        def fn(keys, rows):
+            cols = [f(keys, rows) for f in fns]
+            out = []
+            for vals in zip(*cols):
+                if vals[0] is None:
+                    out.append(None)
+                    continue
+                if any(v is ERROR for v in vals):
+                    out.append(ERROR)
+                    continue
+                try:
+                    out.append(method(*vals, **kwargs))
+                except Exception as e:
+                    global_error_log().log(f"{expr._method} failed: {e!r}")
+                    out.append(ERROR)
+            return out
+
+        return fn
+
+    def _compile_PointerExpression(self, expr):
+        fns = [self._compile(a) for a in expr._args]
+        inst_fn = self._compile(expr._instance) if expr._instance is not None else None
+
+        def fn(keys, rows):
+            cols = [f(keys, rows) for f in fns]
+            if inst_fn is not None:
+                cols.append(inst_fn(keys, rows))
+            return [hash_values(*vals) for vals in zip(*cols)]
+
+        return fn
+
+    def _compile_ApplyExpression(self, expr):
+        fns = [self._compile(a) for a in expr._args]
+        kw_fns = {k: self._compile(v) for k, v in expr._kwargs.items()}
+        f = expr._fn
+        propagate_none = expr._propagate_none
+        if not expr._deterministic:
+            self.has_non_deterministic = True
+
+        def fn(keys, rows):
+            arg_cols = [g(keys, rows) for g in fns]
+            kw_cols = {k: g(keys, rows) for k, g in kw_fns.items()}
+            out = []
+            for i in range(len(keys)):
+                args = [c[i] for c in arg_cols]
+                kws = {k: c[i] for k, c in kw_cols.items()}
+                if any(a is ERROR for a in args) or any(
+                        v is ERROR for v in kws.values()):
+                    out.append(ERROR)
+                    continue
+                if propagate_none and (any(a is None for a in args) or any(
+                        v is None for v in kws.values())):
+                    out.append(None)
+                    continue
+                try:
+                    out.append(f(*args, **kws))
+                except Exception as e:
+                    global_error_log().log(f"apply failed: {e!r}")
+                    out.append(ERROR)
+            return out
+
+        return fn
+
+    def _compile_AsyncApplyExpression(self, expr):
+        fns = [self._compile(a) for a in expr._args]
+        kw_fns = {k: self._compile(v) for k, v in expr._kwargs.items()}
+        f = expr._fn
+        propagate_none = expr._propagate_none
+        if not expr._deterministic:
+            self.has_non_deterministic = True
+
+        def fn(keys, rows):
+            arg_cols = [g(keys, rows) for g in fns]
+            kw_cols = {k: g(keys, rows) for k, g in kw_fns.items()}
+            coros = []
+            slots = []  # (index, precomputed | None)
+            for i in range(len(keys)):
+                args = [c[i] for c in arg_cols]
+                kws = {k: c[i] for k, c in kw_cols.items()}
+                if any(a is ERROR for a in args):
+                    slots.append((i, ERROR))
+                elif propagate_none and any(a is None for a in args):
+                    slots.append((i, None))
+                else:
+                    slots.append((i, _PENDING))
+                    coros.append(f(*args, **kws))
+            results = run_coro_batch(coros) if coros else []
+            out: list = [None] * len(keys)
+            it = iter(results)
+            for i, pre in slots:
+                out[i] = next(it) if pre is _PENDING else pre
+            return out
+
+        return fn
+
+    _compile_FullyAsyncApplyExpression = _compile_AsyncApplyExpression
+
+    def _compile_ReducerExpression(self, expr):
+        raise TypeError(
+            f"reducer {expr._name!r} used outside groupby().reduce()"
+        )
+
+
+class _Pending:
+    pass
+
+
+_PENDING = _Pending()
+
+
+def compile_map_program(exprs, ctx: CompileContext):
+    comp = ExpressionCompiler(ctx)
+    program = comp.compile_program(list(exprs))
+    return program, comp.has_non_deterministic

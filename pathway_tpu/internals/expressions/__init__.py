@@ -1,0 +1,5 @@
+from pathway_tpu.internals.expressions.date_time import DateTimeNamespace
+from pathway_tpu.internals.expressions.numerical import NumericalNamespace
+from pathway_tpu.internals.expressions.string import StringNamespace
+
+__all__ = ["DateTimeNamespace", "NumericalNamespace", "StringNamespace"]

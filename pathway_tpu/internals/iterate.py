@@ -1,0 +1,91 @@
+"""pw.iterate — fixed-point iteration
+(reference: internals/parse_graph.py:153 add_iterate + dataflow.rs:3668)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+
+
+class IterateShared:
+    def __init__(self, input_tables, iterated_placeholders, extra_placeholders,
+                 body_outputs, result_tables, limit):
+        self.input_tables = input_tables
+        self.iterated_placeholders = iterated_placeholders
+        self.extra_placeholders = extra_placeholders
+        self.body_outputs = body_outputs
+        self.result_tables = result_tables
+        self.limit = limit
+
+
+class _IterateResultNamespace:
+    def __init__(self, mapping: dict):
+        self._mapping = mapping
+        for k, v in mapping.items():
+            setattr(self, k, v)
+
+    def __getitem__(self, k):
+        return self._mapping[k]
+
+    def __iter__(self):
+        return iter(self._mapping.values())
+
+    def keys(self):
+        return self._mapping.keys()
+
+
+def iterate(func: Callable, iteration_limit: int | None = None, **kwargs):
+    """Iterate `func` to fixpoint over the tables passed as kwargs.
+
+    Tables returned by `func` under the same name as an input are fed back;
+    other inputs are loop-invariant ("extra"). Returns the converged tables
+    (single Table if `func` returned one, else a namespace by name).
+    """
+    placeholders = {}
+    for name, t in kwargs.items():
+        if not isinstance(t, Table):
+            raise TypeError(f"iterate argument {name} must be a Table")
+        placeholders[name] = Table(
+            Plan("iter_placeholder", source_name=name), t.schema, Universe(),
+            name=f"iter_{name}")
+
+    result = func(**placeholders)
+
+    single = False
+    if isinstance(result, Table):
+        # convention: a single returned table iterates the first input
+        first = next(iter(kwargs))
+        result_map = {first: result}
+        single = True
+    elif isinstance(result, dict):
+        result_map = dict(result)
+    elif hasattr(result, "_asdict"):
+        result_map = dict(result._asdict())
+    elif isinstance(result, tuple):
+        result_map = {name: t for name, t in zip(kwargs, result)}
+    else:
+        raise TypeError("iterate body must return Table(s)")
+
+    iterated_names = [n for n in kwargs if n in result_map]
+    extra_names = [n for n in kwargs if n not in result_map]
+
+    shared = IterateShared(
+        input_tables=[kwargs[n] for n in iterated_names]
+        + [kwargs[n] for n in extra_names],
+        iterated_placeholders=[placeholders[n] for n in iterated_names],
+        extra_placeholders=[placeholders[n] for n in extra_names],
+        body_outputs=[result_map[n] for n in iterated_names],
+        result_tables=list(result_map.values()),
+        limit=iteration_limit,
+    )
+
+    outs = {}
+    for i, (name, body_table) in enumerate(result_map.items()):
+        plan = Plan("iterate_result", shared=shared, index=i)
+        outs[name] = Table(plan, body_table.schema, Universe(),
+                           name=f"iterated_{name}")
+    if single:
+        return next(iter(outs.values()))
+    return _IterateResultNamespace(outs)

@@ -1,0 +1,134 @@
+"""128-bit row keys (Pointers) and deterministic hashing.
+
+Rebuild of the reference's ``Key`` (src/engine/value.rs:41 — xxh3-derived
+u128 ids) and the ``pointer_from`` derivation. We use blake2b-128 over a
+canonical encoding: deterministic across processes/hosts, so key-based
+sharding over a TPU mesh is stable without coordination.
+
+Sharding mirrors src/engine/dataflow/shard.rs:6 — ``shard = key & MASK`` —
+except the mask is the mesh's data-axis size, not a licensed 8-worker cap
+(reference caps at MAX_WORKERS=8, src/engine/dataflow/config.rs:7; we don't).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import struct
+from typing import Any, Iterable
+
+import numpy as np
+
+_SALT = b"pathway-tpu-key-v1"
+
+
+class Pointer(int):
+    """An opaque 128-bit row id. Subclasses int for cheap hashing/dict keys."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return f"^{self:032X}"[:12] + "..."
+
+    def __str__(self) -> str:
+        return f"^{_b64ish(self)}"
+
+    @property
+    def lo(self) -> int:
+        return int(self) & 0xFFFFFFFFFFFFFFFF
+
+    @property
+    def hi(self) -> int:
+        return int(self) >> 64
+
+
+_ALPHABET = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+
+
+def _b64ish(v: int) -> str:
+    # short readable digest for debug printing (like reference's base32 keys)
+    out = []
+    v = int(v) & ((1 << 128) - 1)
+    for _ in range(14):
+        out.append(_ALPHABET[v % 36])
+        v //= 36
+    return "".join(reversed(out))
+
+
+def _encode_value(value: Any, out: list) -> None:
+    """Canonical byte encoding of an engine value for hashing."""
+    if value is None:
+        out.append(b"\x00")
+    elif value is True:
+        out.append(b"\x01\x01")
+    elif value is False:
+        out.append(b"\x01\x00")
+    elif isinstance(value, Pointer):
+        out.append(b"\x02" + int(value).to_bytes(16, "little"))
+    elif isinstance(value, (int, np.integer)):
+        out.append(b"\x03" + struct.pack("<q", int(value)))
+    elif isinstance(value, (float, np.floating)):
+        f = float(value)
+        if math.isfinite(f) and f == int(f) and abs(f) < 2**62:
+            # ints and equal floats hash identically (reference: HashInto for Value)
+            out.append(b"\x03" + struct.pack("<q", int(f)))
+        else:
+            out.append(b"\x04" + struct.pack("<d", f))
+    elif isinstance(value, str):
+        b = value.encode()
+        out.append(b"\x05" + struct.pack("<q", len(b)) + b)
+    elif isinstance(value, bytes):
+        out.append(b"\x06" + struct.pack("<q", len(value)) + value)
+    elif isinstance(value, tuple):
+        out.append(b"\x07" + struct.pack("<q", len(value)))
+        for v in value:
+            _encode_value(v, out)
+    elif isinstance(value, np.ndarray):
+        out.append(b"\x08" + str(value.dtype).encode() + struct.pack(
+            "<q", value.ndim) + value.shape.__repr__().encode() + value.tobytes())
+    else:
+        from pathway_tpu.internals.json import Json
+
+        if isinstance(value, Json):
+            b = value.dumps().encode()
+            out.append(b"\x09" + struct.pack("<q", len(b)) + b)
+        else:
+            b = repr(value).encode()
+            out.append(b"\x0a" + struct.pack("<q", len(b)) + b)
+
+
+def hash_values(*values: Any) -> Pointer:
+    """Deterministic 128-bit key from a tuple of values (ref_scalar analogue)."""
+    out: list = []
+    for v in values:
+        _encode_value(v, out)
+    digest = hashlib.blake2b(b"".join(out), digest_size=16, key=_SALT).digest()
+    return Pointer(int.from_bytes(digest, "little"))
+
+
+def ref_scalar(*args: Any, optional: bool = False) -> Pointer:
+    """Public ``pw.this.pointer_from`` scalar variant."""
+    return hash_values(*args)
+
+
+_SEQ_NAMESPACE = hash_values("pathway-tpu/sequential")
+
+
+def sequential_key(counter: int, salt: Any = 0) -> Pointer:
+    return hash_values(_SEQ_NAMESPACE, salt, counter)
+
+
+def shard_of(key: Pointer, n_shards: int) -> int:
+    return int(key) % n_shards
+
+
+def shard_array(keys: Iterable[Pointer], n_shards: int) -> np.ndarray:
+    return np.fromiter((int(k) % n_shards for k in keys), dtype=np.int64)
+
+
+def keys_to_u64(keys: Iterable[Pointer]) -> np.ndarray:
+    """Lossy 64-bit projection used for device-side routing tensors."""
+    return np.fromiter(
+        (int(k) & 0xFFFFFFFFFFFFFFFF for k in keys),
+        dtype=np.uint64,
+    )

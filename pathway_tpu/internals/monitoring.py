@@ -1,0 +1,73 @@
+"""Monitoring dashboard (reference: python/pathway/internals/monitoring.py —
+rich-based live operator stats table + MonitoringLevel)."""
+
+from __future__ import annotations
+
+import enum
+import sys
+import time
+
+
+class MonitoringLevel(enum.Enum):
+    AUTO = enum.auto()
+    AUTO_ALL = enum.auto()
+    NONE = enum.auto()
+    IN_OUT = enum.auto()
+    ALL = enum.auto()
+
+
+class StatsMonitor:
+    """Collects per-operator counters from the scheduler and renders a
+    terminal dashboard (rich if a tty, plain lines otherwise)."""
+
+    def __init__(self, level: MonitoringLevel = MonitoringLevel.NONE,
+                 refresh_seconds: float = 1.0):
+        self.level = level
+        self.refresh_seconds = refresh_seconds
+        self._last_render = 0.0
+        self._live = None
+        self._rows: list[tuple] = []
+
+    def enabled(self) -> bool:
+        if self.level == MonitoringLevel.NONE:
+            return False
+        if self.level in (MonitoringLevel.AUTO, MonitoringLevel.AUTO_ALL):
+            return sys.stderr.isatty()
+        return True
+
+    def update(self, scheduler, graph, now_time: int) -> None:
+        if not self.enabled():
+            return
+        now = time.monotonic()
+        if now - self._last_render < self.refresh_seconds:
+            return
+        self._last_render = now
+        self._rows = []
+        for node in graph.nodes:
+            st = scheduler.stats.get(node.id)
+            if not st:
+                continue
+            if self.level in (MonitoringLevel.IN_OUT, MonitoringLevel.AUTO):
+                if not (node.name.startswith(("source", "subscribe", "capture",
+                                              "output"))):
+                    continue
+            self._rows.append((node.name or str(node.id),
+                               st["insertions"], st["retractions"]))
+        self._render(now_time)
+
+    def _render(self, now_time: int) -> None:
+        try:
+            from rich.console import Console
+            from rich.table import Table as RichTable
+
+            console = Console(stderr=True)
+            table = RichTable(title=f"pathway-tpu @ t={now_time}")
+            table.add_column("operator")
+            table.add_column("insertions", justify="right")
+            table.add_column("retractions", justify="right")
+            for name, ins, rets in self._rows:
+                table.add_row(name, str(ins), str(rets))
+            console.print(table)
+        except Exception:
+            for name, ins, rets in self._rows:
+                print(f"[monitor] {name}: +{ins} -{rets}", file=sys.stderr)

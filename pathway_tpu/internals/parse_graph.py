@@ -1,0 +1,24 @@
+"""Global registry of outputs/sinks collected as user code runs
+(reference: python/pathway/internals/parse_graph.py — here the Table plans
+form the DAG themselves; the registry only tracks run-time bindings)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+class ParseGraph:
+    def __init__(self):
+        # each binder: fn(runner) -> None, attaches sinks/subscribers
+        self.output_binders: list[Callable] = []
+        self.has_streaming_sources = False
+
+    def add_output(self, binder: Callable) -> None:
+        self.output_binders.append(binder)
+
+    def clear(self) -> None:
+        self.output_binders.clear()
+        self.has_streaming_sources = False
+
+
+G = ParseGraph()

@@ -1,0 +1,95 @@
+"""`pw.reducers` namespace (reference: python/pathway/reducers →
+internals/custom_reducers.py + engine Reducer enum, src/engine/reduce.rs:22)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals import expression as ex
+
+
+def count(*args) -> ex.ReducerExpression:
+    return ex.ReducerExpression("count", *args)
+
+
+def sum(expr) -> ex.ReducerExpression:  # noqa: A001
+    return ex.ReducerExpression("sum", expr)
+
+
+def avg(expr) -> ex.ReducerExpression:
+    return ex.ReducerExpression("avg", expr)
+
+
+def min(expr) -> ex.ReducerExpression:  # noqa: A001
+    return ex.ReducerExpression("min", expr)
+
+
+def max(expr) -> ex.ReducerExpression:  # noqa: A001
+    return ex.ReducerExpression("max", expr)
+
+
+def argmin(expr) -> ex.ReducerExpression:
+    return ex.ReducerExpression("argmin", expr)
+
+
+def argmax(expr) -> ex.ReducerExpression:
+    return ex.ReducerExpression("argmax", expr)
+
+
+def unique(expr) -> ex.ReducerExpression:
+    return ex.ReducerExpression("unique", expr)
+
+
+def any(expr) -> ex.ReducerExpression:  # noqa: A001
+    return ex.ReducerExpression("any", expr)
+
+
+def sorted_tuple(expr, *, skip_nones: bool = False) -> ex.ReducerExpression:
+    return ex.ReducerExpression("sorted_tuple", expr, skip_nones=skip_nones)
+
+
+def tuple(expr, *, skip_nones: bool = False) -> ex.ReducerExpression:  # noqa: A001
+    return ex.ReducerExpression("tuple", expr, skip_nones=skip_nones)
+
+
+def ndarray(expr, *, skip_nones: bool = False) -> ex.ReducerExpression:
+    return ex.ReducerExpression("ndarray", expr, skip_nones=skip_nones)
+
+
+def earliest(expr) -> ex.ReducerExpression:
+    return ex.ReducerExpression("earliest", expr)
+
+
+def latest(expr) -> ex.ReducerExpression:
+    return ex.ReducerExpression("latest", expr)
+
+
+def stateful_single(combine_fn: Callable, *args) -> ex.ReducerExpression:
+    def combine(state, rows):
+        for row in rows:
+            state = combine_fn(state, *row)
+        return state
+
+    return ex.ReducerExpression("stateful", *args, fn=combine)
+
+
+def stateful_many(combine_fn: Callable, *args) -> ex.ReducerExpression:
+    return ex.ReducerExpression("stateful", *args, fn=combine_fn)
+
+
+def udf_reducer(reducer_cls):
+    """Decorator-compatible custom reducer hook (subset of reference API)."""
+
+    def make(*args):
+        acc = reducer_cls()
+
+        def combine(state, rows):
+            if state is None:
+                state = acc.initial_state() if hasattr(acc, "initial_state") else None
+            for row in rows:
+                state = acc.update(state, *row)
+            return state
+
+        return ex.ReducerExpression("stateful", *args, fn=combine)
+
+    return make

@@ -1,0 +1,41 @@
+"""pw.run — execute the collected pipeline
+(reference: python/pathway/internals/run.py:12-52)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.runner import GraphRunner
+
+
+def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = False,
+        default_logging: bool = True, persistence_config=None,
+        runtime_typechecking: bool | None = None, terminate_on_error: bool = True,
+        **kwargs) -> Any:
+    """Build the engine graph from all registered outputs and run it.
+
+    Static-only graphs run in batch mode to completion; graphs with streaming
+    sources enter the realtime microbatch loop (pathway_tpu/engine/streaming.py)
+    until all sources finish or the process is stopped.
+    """
+    runner = GraphRunner()
+    for binder in G.output_binders:
+        binder(runner)
+    if persistence_config is not None:
+        runner._persistence_config = persistence_config
+    if runner._stream_subjects:
+        from pathway_tpu.engine.streaming import StreamingRuntime
+
+        rt = StreamingRuntime(runner, monitoring_level=monitoring_level,
+                              with_http_server=with_http_server,
+                              persistence_config=persistence_config,
+                              terminate_on_error=terminate_on_error)
+        rt.run()
+    else:
+        runner.run_batch()
+    return runner
+
+
+def run_all(**kwargs):
+    return run(**kwargs)

@@ -1,0 +1,679 @@
+"""Lowering + execution: Table plans → engine graph → microbatch run.
+
+Rebuild of the reference's GraphRunner
+(python/pathway/internals/graph_runner/__init__.py:36 +
+expression_evaluator.py + operator_handler.py): walks the plan DAG reachable
+from requested outputs, compiles expressions against row layouts, builds
+engine operators, then drives the scheduler over logical times.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine import operators as eng
+from pathway_tpu.engine.delta import Delta
+from pathway_tpu.engine.graph import (
+    CapturedStream,
+    DemuxOperator,
+    EngineGraph,
+    IterateOperator,
+    Node,
+    Scheduler,
+)
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.expression_compiler import (
+    CompileContext,
+    ExpressionCompiler,
+    compile_map_program,
+)
+from pathway_tpu.internals.groupbys import split_reducers
+from pathway_tpu.internals.keys import Pointer, hash_values
+from pathway_tpu.internals.table import Plan, Table
+
+
+class _Proxy:
+    """Synthetic table for rewritten row spaces (groupby results etc.)."""
+
+    def __init__(self, names):
+        self._names = list(names)
+
+    def _column_names(self):
+        return self._names
+
+
+def _referenced_tables(exprs, base: Table) -> list[Table]:
+    """All concrete tables appearing in exprs, base first."""
+    seen: dict[int, Table] = {id(base): base}
+    order = [base]
+
+    def walk(e):
+        if isinstance(e, ex.ColumnReference) and isinstance(e.table, Table):
+            if id(e.table) not in seen:
+                seen[id(e.table)] = e.table
+                order.append(e.table)
+        for d in getattr(e, "_deps", ()):
+            walk(d)
+
+    for e in exprs:
+        walk(e)
+    return order
+
+
+class GraphRunner:
+    def __init__(self):
+        self.graph = EngineGraph()
+        self._memo: dict[int, Node] = {}
+        self._static_feeds: list[tuple[Node, list]] = []  # (node, [(time,key,row,diff)])
+        self._stream_subjects: list[tuple[Node, Any]] = []  # streaming sources
+        self._captures: dict[int, CapturedStream] = {}
+        self._monitoring = None
+
+    # ------------------------------------------------------------------
+    # public entry points
+    # ------------------------------------------------------------------
+    def capture(self, table: Table) -> CapturedStream:
+        node = self.lower(table)
+        cap = CapturedStream()
+        self.graph.add_node(eng.OutputOperator(cap.on_delta), [node], "capture")
+        self._captures[id(table)] = cap
+        return cap
+
+    def subscribe(self, table: Table, callback: Callable[[int, Delta], None],
+                  positions: bool = False) -> None:
+        node = self.lower(table)
+        self.graph.add_node(eng.OutputOperator(callback), [node], "subscribe")
+
+    def run_batch(self) -> None:
+        """Run all static feeds to completion (batch mode: one pass over the
+        totally-ordered times present in the inputs + a flush tick)."""
+        sched = Scheduler(self.graph)
+        times: set[int] = {0}
+        for node, feed in self._static_feeds:
+            for t, _, _, _ in feed:
+                times.add(t)
+        for t in sorted(times):
+            for node, feed in self._static_feeds:
+                batch = Delta([(k, r, d) for (ft, k, r, d) in feed if ft == t])
+                if batch:
+                    node.op.push(batch)
+            sched.run_time(t)
+        # flush tick for buffering/forgetting operators
+        sched.run_time(max(times) + 1)
+        self._scheduler = sched
+
+    # ------------------------------------------------------------------
+    # lowering
+    # ------------------------------------------------------------------
+    def lower(self, table: Table) -> Node:
+        key = id(table)
+        if key in self._memo:
+            return self._memo[key]
+        plan = table._plan
+        handler = getattr(self, f"_lower_{plan.kind}", None)
+        if handler is None:
+            raise NotImplementedError(f"no lowering for plan kind {plan.kind!r}")
+        node = handler(table, plan)
+        self._memo[key] = node
+        return node
+
+    # -- helpers ------------------------------------------------------------
+    def _row_space(self, base: Table, exprs: list) -> tuple[Node, CompileContext]:
+        """Node producing zipped rows of all tables referenced by exprs,
+        with a CompileContext mapping references to positions."""
+        tables = _referenced_tables(exprs, base)
+        ctx = CompileContext()
+        node = self.lower(tables[0])
+        offset = ctx.add_table(tables[0], 0)
+        for t in tables[1:]:
+            right = self.lower(t)
+            left_len = offset
+            right_len = len(t._column_names())
+
+            def combine(l, r, _ll=left_len, _rl=right_len):
+                if l is None or r is None:
+                    return None
+                return (*l, *r)
+
+            node = self.graph.add_node(
+                eng.BinaryKeyOperator(combine), [node, right], "zip"
+            )
+            offset = ctx.add_table(t, offset)
+        return node, ctx
+
+    # -- sources ------------------------------------------------------------
+    def _lower_static(self, table: Table, plan: Plan) -> Node:
+        node = self.graph.add_source(table._name)
+        keys = plan.params["keys"]
+        rows = plan.params["rows"]
+        times = plan.params.get("times") or [0] * len(keys)
+        diffs = plan.params.get("diffs") or [1] * len(keys)
+        feed = [
+            (t, k, tuple(r), d) for t, k, r, d in zip(times, keys, rows, diffs)
+        ]
+        self._static_feeds.append((node, feed))
+        return node
+
+    def _lower_input(self, table: Table, plan: Plan) -> Node:
+        node = self.graph.add_source(table._name)
+        self._stream_subjects.append((node, plan.params["datasource"]))
+        return node
+
+    def _lower_identity(self, table: Table, plan: Plan) -> Node:
+        return self.lower(plan.params["base"])
+
+    # -- row ops ------------------------------------------------------------
+    def _lower_map(self, table: Table, plan: Plan) -> Node:
+        base = plan.params["base"]
+        exprs = plan.params["exprs"]
+        node, ctx = self._row_space(base, exprs)
+        program, nondet = compile_map_program(exprs, ctx)
+        op = eng.DeterministicMapOperator(program) if nondet else eng.MapOperator(program)
+        return self.graph.add_node(op, [node], f"map:{table._name}")
+
+    def _lower_filter(self, table: Table, plan: Plan) -> Node:
+        base = plan.params["base"]
+        pred = plan.params["pred"]
+        node, ctx = self._row_space(base, [pred])
+        comp = ExpressionCompiler(ctx)
+        # keep base row shape: need projection back to base columns if zipped
+        pred_fn = comp.compile_predicate(pred)
+        n_base = len(base._column_names())
+
+        def keep_base(keys, rows):
+            return [r[:n_base] for r in rows]
+
+        filt = self.graph.add_node(eng.FilterOperator(pred_fn), [node], "filter")
+        if len(_referenced_tables([pred], base)) > 1:
+            return self.graph.add_node(eng.MapOperator(keep_base), [filt], "proj")
+        return filt
+
+    def _lower_reindex(self, table: Table, plan: Plan) -> Node:
+        base = plan.params["base"]
+        key_exprs = plan.params["key_exprs"]
+        node, ctx = self._row_space(base, key_exprs)
+        comp = ExpressionCompiler(ctx)
+        if plan.params.get("raw"):
+            vfn = comp.compile(key_exprs[0])
+
+            def key_fn(keys, rows):
+                out = []
+                for v in vfn(keys, rows):
+                    if not isinstance(v, Pointer):
+                        v = hash_values(v)
+                    out.append(v)
+                return out
+        else:
+            key_fn = comp.compile_key_fn(key_exprs)
+        n_base = len(base._column_names())
+        reindexed = self.graph.add_node(
+            eng.ReindexOperator(key_fn), [node], "reindex")
+        if len(_referenced_tables(key_exprs, base)) > 1:
+            return self.graph.add_node(
+                eng.MapOperator(lambda keys, rows: [r[:n_base] for r in rows]),
+                [reindexed], "proj")
+        return reindexed
+
+    # -- groupby ------------------------------------------------------------
+    def _lower_groupby(self, table: Table, plan: Plan) -> Node:
+        base: Table = plan.params["base"]
+        by = plan.params["by"]
+        instance = plan.params["instance"]
+        out_exprs = plan.params["out_exprs"]
+        by_id = plan.params.get("by_id", False)
+
+        gvals_exprs = list(by)
+        if instance is not None:
+            gvals_exprs.append(instance)
+
+        n_red_placeholder: list = []
+        proxy = _Proxy([])
+        rewritten, reducers = split_reducers(out_exprs, by, instance, proxy)
+        proxy._names = [f"__g{i}" for i in range(len(gvals_exprs))] + [
+            f"__r{j}" for j in range(len(reducers))
+        ]
+
+        # compile group-side fns over base rows
+        inner_exprs = list(gvals_exprs)
+        for r in reducers:
+            inner_exprs.extend(r._args)
+        node, ctx = self._row_space(base, inner_exprs)
+        comp = ExpressionCompiler(ctx)
+        gval_fns = [comp.compile(e) for e in gvals_exprs]
+        reducer_specs = []
+        for r in reducers:
+            arg_fns = [comp.compile(a) for a in r._args]
+            name = _engine_reducer_name(r)
+            kwargs = dict(r._kwargs)
+            fn = kwargs.pop("fn", None)
+            spec_kwargs = {}
+            if name in ("sorted_tuple", "tuple", "ndarray"):
+                spec_kwargs["skip_nones"] = kwargs.get("skip_nones", False)
+            if name == "stateful":
+                spec_kwargs["fn"] = fn
+            if name == "argmin":
+                def extract(key, row, _fns=arg_fns):
+                    vals = [f([key], [row])[0] for f in _fns]
+                    return (vals[0], key) if len(vals) == 1 else (vals[0], vals[1])
+                reducer_specs.append(("argmin", extract, spec_kwargs))
+                continue
+            if name == "argmax":
+                def extract(key, row, _fns=arg_fns):
+                    vals = [f([key], [row])[0] for f in _fns]
+                    return (vals[0], key) if len(vals) == 1 else (vals[0], vals[1])
+                reducer_specs.append(("argmax", extract, spec_kwargs))
+                continue
+            if name in ("tuple", "ndarray"):
+                def extract(key, row, _fns=arg_fns, _k=name):
+                    vals = [f([key], [row])[0] for f in _fns]
+                    return (vals[0], int(key))
+                reducer_specs.append((name, extract, spec_kwargs))
+                continue
+
+            def extract(key, row, _fns=arg_fns):
+                return tuple(f([key], [row])[0] for f in _fns)
+
+            reducer_specs.append((name, extract, spec_kwargs))
+
+        use_raw_key = bool(by_id)
+
+        def group_fn(key, row):
+            gvals = tuple(f([key], [row])[0] for f in gval_fns)
+            if use_raw_key:
+                gkey = gvals[0] if isinstance(gvals[0], Pointer) else hash_values(gvals[0])
+            else:
+                gkey = hash_values(*gvals)
+            return gkey, gvals
+
+        gnode = self.graph.add_node(
+            eng.GroupByOperator(group_fn, reducer_specs),
+            [node], f"groupby:{table._name}")
+
+        # post-map over (gvals, reduced) rows
+        post_ctx = CompileContext()
+        post_ctx.add_table(proxy, 0)
+        post_program, nondet = compile_map_program(rewritten, post_ctx)
+        op = eng.DeterministicMapOperator(post_program) if nondet else eng.MapOperator(post_program)
+        return self.graph.add_node(op, [gnode], f"reduce:{table._name}")
+
+    # -- joins --------------------------------------------------------------
+    def _lower_join_select(self, table: Table, plan: Plan) -> Node:
+        left: Table = plan.params["left"]
+        right: Table = plan.params["right"]
+        on = plan.params["on"]
+        mode = plan.params["mode"]
+        id_expr = plan.params.get("id_expr")
+        exprs = plan.params["exprs"]
+
+        lnode = self.lower(left)
+        rnode = self.lower(right)
+
+        lctx = CompileContext()
+        lctx.add_table(left, 0)
+        lcomp = ExpressionCompiler(lctx)
+        l_fns = [lcomp.compile(a) for a, _ in on]
+        rctx = CompileContext()
+        rctx.add_table(right, 0)
+        rcomp = ExpressionCompiler(rctx)
+        r_fns = [rcomp.compile(b) for _, b in on]
+
+        def lkey_fn(key, row):
+            vals = tuple(f([key], [row])[0] for f in l_fns)
+            if any(v is None for v in vals):
+                return None
+            return hash_values(*vals)
+
+        def rkey_fn(key, row):
+            vals = tuple(f([key], [row])[0] for f in r_fns)
+            if any(v is None for v in vals):
+                return None
+            return hash_values(*vals)
+
+        nl = len(left._column_names())
+        nr = len(right._column_names())
+
+        def out_fn(lk, lrow, rk, rrow):
+            lr = lrow if lrow is not None else (None,) * nl
+            rr = rrow if rrow is not None else (None,) * nr
+            return (*lr, *rr, lk, rk)
+
+        out_key_fn = None
+        if id_expr is not None and isinstance(id_expr, ex.IdExpression):
+            if id_expr.table is left:
+                out_key_fn = lambda lk, rk, jk: lk
+            elif id_expr.table is right:
+                out_key_fn = lambda lk, rk, jk: rk
+
+        jnode = self.graph.add_node(
+            eng.JoinOperator(mode, lkey_fn, rkey_fn, out_fn, out_key_fn),
+            [lnode, rnode], f"join:{mode}")
+
+        ctx = CompileContext()
+        off = ctx.add_table(left, 0)
+        off = ctx.add_table(right, off)
+        ctx.id_pos = {id(left): nl + nr, id(right): nl + nr + 1}
+        program, nondet = compile_map_program(exprs, ctx)
+        op = eng.DeterministicMapOperator(program) if nondet else eng.MapOperator(program)
+        return self.graph.add_node(op, [jnode], f"join_select:{table._name}")
+
+    # -- set ops ------------------------------------------------------------
+    def _project_to_names(self, t: Table, names: list[str]) -> Node:
+        node = self.lower(t)
+        own = t._column_names()
+        if own == names:
+            return node
+        pos = [own.index(n) for n in names]
+
+        def proj(keys, rows):
+            return [tuple(r[p] for p in pos) for r in rows]
+
+        return self.graph.add_node(eng.MapOperator(proj), [node], "proj")
+
+    def _lower_concat(self, table: Table, plan: Plan) -> Node:
+        tables = plan.params["tables"]
+        update = plan.params["update"]
+        names = table._column_names()
+        nodes = [self._project_to_names(t, names) for t in tables]
+
+        def combine_rows(present: list):
+            live = [r for r in present if r is not None]
+            return live[-1] if update else live[0]
+
+        return self.graph.add_node(
+            eng.NAryConcatOperator(len(nodes), combine_rows, update=update),
+            nodes, "concat")
+
+    def _lower_concat_reindex(self, table: Table, plan: Plan) -> Node:
+        tables = plan.params["tables"]
+        names = table._column_names()
+        nodes = []
+        for i, t in enumerate(tables):
+            n = self._project_to_names(t, names)
+            salt = i
+
+            def key_fn(keys, rows, _s=salt):
+                return [hash_values(k, _s) for k in keys]
+
+            nodes.append(self.graph.add_node(
+                eng.ReindexOperator(key_fn), [n], f"reindex{i}"))
+
+        def combine_rows(present):
+            return next(r for r in present if r is not None)
+
+        return self.graph.add_node(
+            eng.NAryConcatOperator(len(nodes), combine_rows, update=False),
+            nodes, "concat_reindex")
+
+    def _lower_update_cells(self, table: Table, plan: Plan) -> Node:
+        base: Table = plan.params["base"]
+        other: Table = plan.params["other"]
+        columns = plan.params["columns"]
+        lnode = self.lower(base)
+        rnode = self.lower(other)
+        base_names = base._column_names()
+        other_names = other._column_names()
+        repl = {base_names.index(c): other_names.index(c) for c in columns}
+
+        def combine(l, r):
+            if l is None:
+                return None
+            if r is None:
+                return l
+            return tuple(
+                r[repl[i]] if i in repl else v for i, v in enumerate(l)
+            )
+
+        return self.graph.add_node(
+            eng.BinaryKeyOperator(combine), [lnode, rnode], "update_cells")
+
+    def _lower_key_filter(self, table: Table, plan: Plan) -> Node:
+        base: Table = plan.params["base"]
+        other: Table = plan.params["other"]
+        mode = plan.params["mode"]
+        lnode = self.lower(base)
+        rnode = self.lower(other)
+        if mode in ("restrict", "intersect"):
+            combine = lambda l, r: l if (l is not None and r is not None) else None
+        elif mode == "difference":
+            combine = lambda l, r: l if (l is not None and r is None) else None
+        else:
+            raise ValueError(mode)
+        return self.graph.add_node(
+            eng.BinaryKeyOperator(combine), [lnode, rnode], mode)
+
+    def _lower_having(self, table: Table, plan: Plan) -> Node:
+        base: Table = plan.params["base"]
+        indexer = plan.params["indexer"]
+        idx_table: Table = plan.params["indexer_table"]
+        lnode = self.lower(base)
+        inode = self.lower(idx_table)
+        ctx = CompileContext()
+        ctx.add_table(idx_table, 0)
+        comp = ExpressionCompiler(ctx)
+        vfn = comp.compile(indexer)
+
+        def key_fn(keys, rows):
+            return [v if isinstance(v, Pointer) else hash_values(v)
+                    for v in vfn(keys, rows)]
+
+        keyed = self.graph.add_node(
+            eng.ReindexOperator(key_fn), [inode], "having_keys")
+        combine = lambda l, r: l if (l is not None and r is not None) else None
+        return self.graph.add_node(
+            eng.BinaryKeyOperator(combine), [lnode, keyed], "having")
+
+    # -- reshaping ----------------------------------------------------------
+    def _lower_flatten(self, table: Table, plan: Plan) -> Node:
+        base: Table = plan.params["base"]
+        col = plan.params["col_name"]
+        origin_id = plan.params.get("origin_id")
+        node = self.lower(base)
+        pos = base._column_names().index(col)
+
+        def fn(key, row):
+            val = row[pos]
+            if val is None:
+                return []
+            out = []
+            for i, elem in enumerate(val):
+                nk = hash_values(key, i)
+                nr = list(row)
+                nr[pos] = elem
+                if origin_id is not None:
+                    nr.append(key)
+                out.append((nk, tuple(nr)))
+            return out
+
+        return self.graph.add_node(eng.FlattenOperator(fn), [node], "flatten")
+
+    def _lower_sort(self, table: Table, plan: Plan) -> Node:
+        base: Table = plan.params["base"]
+        key_e = plan.params["key"]
+        inst_e = plan.params["instance"]
+        node, ctx = self._row_space(base, [key_e] + ([inst_e] if inst_e else []))
+        comp = ExpressionCompiler(ctx)
+        kfn = comp.compile(key_e)
+        ifn = comp.compile(inst_e) if inst_e is not None else None
+
+        def key_fn(key, row):
+            return kfn([key], [row])[0]
+
+        def instance_fn(key, row):
+            return ifn([key], [row])[0] if ifn is not None else None
+
+        return self.graph.add_node(
+            eng.SortOperator(key_fn, instance_fn), [node], "sort")
+
+    def _lower_dedupe(self, table: Table, plan: Plan) -> Node:
+        base: Table = plan.params["base"]
+        value_e = plan.params["value"]
+        inst_e = plan.params["instance"]
+        acceptor = plan.params["acceptor"]
+        node = self.lower(base)
+        ctx = CompileContext()
+        ctx.add_table(base, 0)
+        comp = ExpressionCompiler(ctx)
+        vfn = comp.compile(value_e) if value_e is not None else None
+        ifn = comp.compile(inst_e) if inst_e is not None else None
+
+        def value_fn(key, row):
+            return vfn([key], [row])[0] if vfn is not None else row
+
+        def instance_fn(key, row):
+            return ifn([key], [row])[0] if ifn is not None else 0
+
+        return self.graph.add_node(
+            eng.DeduplicateOperator(instance_fn, value_fn, acceptor),
+            [node], "deduplicate")
+
+    # -- pointer lookup ------------------------------------------------------
+    def _lower_ix(self, table: Table, plan: Plan) -> Node:
+        target: Table = plan.params["target"]
+        ctx_table: Table = plan.params["ctx"]
+        key_expr = plan.params["key_expr"]
+        optional = plan.params["optional"]
+
+        lnode, lctx = self._row_space(ctx_table, [key_expr])
+        comp = ExpressionCompiler(lctx)
+        kfn = comp.compile(key_expr)
+        rnode = self.lower(target)
+
+        def lkey_fn(key, row):
+            return kfn([key], [row])[0]
+
+        def rkey_fn(key, row):
+            return key
+
+        nt = len(target._column_names())
+
+        def out_fn(lk, lrow, rk, rrow):
+            return rrow if rrow is not None else (None,) * nt
+
+        mode = "left" if optional else "inner"
+        return self.graph.add_node(
+            eng.JoinOperator(mode, lkey_fn, rkey_fn, out_fn,
+                             out_key_fn=lambda lk, rk, jk: lk),
+            [lnode, rnode], "ix")
+
+    # -- temporal low-level --------------------------------------------------
+    def _lower_forget_immediately(self, table: Table, plan: Plan) -> Node:
+        from pathway_tpu.engine.temporal_ops import ForgetImmediatelyOperator
+
+        node = self.lower(plan.params["base"])
+        return self.graph.add_node(ForgetImmediatelyOperator(), [node], "forget_now")
+
+    def _lower_filter_out_forgetting(self, table: Table, plan: Plan) -> Node:
+        from pathway_tpu.engine.temporal_ops import FilterOutForgettingOperator
+
+        node = self.lower(plan.params["base"])
+        return self.graph.add_node(FilterOutForgettingOperator(), [node],
+                                   "filter_out_forgetting")
+
+    def _lower_buffer(self, table: Table, plan: Plan) -> Node:
+        return self._lower_time_column_op(table, plan, "buffer")
+
+    def _lower_forget(self, table: Table, plan: Plan) -> Node:
+        return self._lower_time_column_op(table, plan, "forget")
+
+    def _lower_freeze(self, table: Table, plan: Plan) -> Node:
+        return self._lower_time_column_op(table, plan, "freeze")
+
+    def _lower_time_column_op(self, table: Table, plan: Plan, kind: str) -> Node:
+        from pathway_tpu.engine import temporal_ops as tops
+
+        base: Table = plan.params["base"]
+        node, ctx = self._row_space(base, [plan.params["threshold"],
+                                           plan.params["time"]])
+        comp = ExpressionCompiler(ctx)
+        thr_fn = comp.compile(plan.params["threshold"])
+        time_fn = comp.compile(plan.params["time"])
+
+        def scalar(fn):
+            def g(key, row):
+                return fn([key], [row])[0]
+            return g
+
+        if kind == "buffer":
+            op = tops.BufferOperator(scalar(thr_fn), scalar(time_fn))
+        elif kind == "forget":
+            op = tops.ForgetOperator(scalar(thr_fn), scalar(time_fn),
+                                     plan.params.get("mark", False))
+        else:
+            op = tops.FreezeOperator(scalar(thr_fn), scalar(time_fn))
+        return self.graph.add_node(op, [node], kind)
+
+    # -- iterate -------------------------------------------------------------
+    def _lower_iterate_result(self, table: Table, plan: Plan) -> Node:
+        shared = plan.params["shared"]
+        index = plan.params["index"]
+        inode = self._lower_iterate_shared(shared)
+        return self.graph.add_node(DemuxOperator(index), [inode],
+                                   f"iterate_out{index}")
+
+    def _lower_iterate_shared(self, shared) -> Node:
+        key = ("iterate", id(shared))
+        if key in self._memo:
+            return self._memo[key]
+        outer_nodes = [self.lower(t) for t in shared.input_tables]
+
+        def builder(subgraph, iter_sources, extra_sources):
+            sub = GraphRunner()
+            sub.graph = subgraph
+            for placeholder, src in zip(shared.iterated_placeholders, iter_sources):
+                sub._memo[id(placeholder)] = src
+            for placeholder, src in zip(shared.extra_placeholders, extra_sources):
+                sub._memo[id(placeholder)] = src
+            iter_out_nodes = [sub.lower(t) for t in shared.body_outputs]
+            result_nodes = [sub.lower(t) for t in shared.result_tables]
+            return iter_out_nodes, result_nodes
+
+        op = IterateOperator(
+            n_iterated=len(shared.iterated_placeholders),
+            n_extra=len(shared.extra_placeholders),
+            builder=builder,
+            limit=shared.limit,
+        )
+        node = self.graph.add_node(op, outer_nodes, "iterate")
+        self._memo[key] = node
+        return node
+
+    # -- external index ------------------------------------------------------
+    def _lower_external_index(self, table: Table, plan: Plan) -> Node:
+        from pathway_tpu.engine.index_ops import ExternalIndexOperator
+
+        data: Table = plan.params["data"]
+        queries: Table = plan.params["queries"]
+        factory = plan.params["index_factory"]
+        dnode = self.lower(data)
+        qnode = self.lower(queries)
+
+        def colpos(t, col):
+            if col is None:
+                return None
+            name = col.name if isinstance(col, ex.ColumnReference) else col
+            return t._column_names().index(name)
+
+        op = ExternalIndexOperator(
+            index=factory.build(),
+            data_vec_pos=plan.params.get("data_vec_pos", 0),
+            data_filter_pos=colpos(data, plan.params.get("data_filter_col")),
+            query_vec_pos=plan.params.get("query_vec_pos", 0),
+            query_limit_pos=colpos(queries, plan.params.get("limit_col")),
+            query_filter_pos=colpos(queries, plan.params.get("query_filter_col")),
+        )
+        return self.graph.add_node(op, [dnode, qnode], "external_index")
+
+
+def _engine_reducer_name(r: ex.ReducerExpression) -> str:
+    return r._name
+
+
+# ---------------------------------------------------------------------------
+# convenience: run tables to captured streams (test harness backbone)
+# ---------------------------------------------------------------------------
+
+def run_tables(*tables: Table) -> list[CapturedStream]:
+    runner = GraphRunner()
+    caps = [runner.capture(t) for t in tables]
+    runner.run_batch()
+    return caps

@@ -1,0 +1,17 @@
+"""pw.sql — SQL subset compiled to Table ops
+(reference: python/pathway/internals/sql.py, 726 LoC, sqlglot-based).
+
+sqlglot is not available in this environment; a hand-rolled parser for the
+same subset (SELECT/WHERE/GROUP BY/HAVING/JOIN/UNION/INTERSECT/WITH) lives
+in internals/sql_parser.py.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.table import Table
+
+
+def sql(query: str, **tables: Table) -> Table:
+    from pathway_tpu.internals.sql_parser import compile_sql
+
+    return compile_sql(query, tables)

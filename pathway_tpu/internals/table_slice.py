@@ -1,0 +1,63 @@
+"""TableSlice (reference: python/pathway/internals/table_slice.py)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import expression as ex
+
+
+class TableSlice:
+    def __init__(self, table, mapping: dict):
+        self._table = table
+        self._mapping = dict(mapping)
+
+    def __iter__(self):
+        return iter(self._mapping.values())
+
+    def keys(self):
+        return list(self._mapping.keys())
+
+    def __getitem__(self, name):
+        if isinstance(name, ex.ColumnReference):
+            name = name.name
+        return self._mapping[name]
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._mapping:
+            return self._mapping[name]
+        raise AttributeError(name)
+
+    def without(self, *cols):
+        names = {c.name if isinstance(c, ex.ColumnReference) else c for c in cols}
+        return TableSlice(
+            self._table,
+            {k: v for k, v in self._mapping.items() if k not in names},
+        )
+
+    def rename(self, mapping: dict):
+        mapping = {
+            (k.name if isinstance(k, ex.ColumnReference) else k):
+            (v.name if isinstance(v, ex.ColumnReference) else v)
+            for k, v in mapping.items()
+        }
+        return TableSlice(
+            self._table,
+            {mapping.get(k, k): v for k, v in self._mapping.items()},
+        )
+
+    def with_prefix(self, prefix: str):
+        return self.rename({k: prefix + k for k in self._mapping})
+
+    def with_suffix(self, suffix: str):
+        return self.rename({k: k + suffix for k in self._mapping})
+
+    @property
+    def slice(self):
+        return self
+
+    def _to_column_mapping(self):
+        return dict(self._mapping)
+
+    def __repr__(self):
+        return f"<TableSlice {list(self._mapping.keys())}>"

@@ -1,0 +1,41 @@
+"""Universes — key-set identity of tables
+(reference: python/pathway/internals/universe.py + universe_solver.py).
+
+We track universe identity and explicit promises instead of running the
+reference's SAT solver; operations requiring same/sub-universes check
+identity or a recorded promise and otherwise defer to keyed engine ops,
+which are correct regardless (keys align or don't at runtime).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+_ids = itertools.count()
+
+
+class Universe:
+    __slots__ = ("id", "supersets")
+
+    def __init__(self):
+        self.id = next(_ids)
+        self.supersets: set[int] = {self.id}
+
+    def subuniverse(self) -> "Universe":
+        u = Universe()
+        u.supersets |= self.supersets
+        return u
+
+    def is_subset_of(self, other: "Universe") -> bool:
+        return other.id in self.supersets
+
+    def is_equal_to(self, other: "Universe") -> bool:
+        return self is other or (
+            self.is_subset_of(other) and other.is_subset_of(self)
+        )
+
+    def promise_is_subset_of(self, other: "Universe") -> None:
+        self.supersets |= other.supersets
+
+    def __repr__(self):
+        return f"<Universe {self.id}>"

@@ -1,0 +1,17 @@
+"""pw.universes — universe promises
+(reference: python/pathway/internals/universes.py)."""
+
+from __future__ import annotations
+
+
+def promise_are_pairwise_disjoint(*tables) -> None:
+    return None
+
+
+def promise_are_equal(*tables) -> None:
+    for t in tables[1:]:
+        tables[0].promise_universes_are_equal(t)
+
+
+def promise_is_subset_of(table, other) -> None:
+    table.promise_universe_is_subset_of(other)

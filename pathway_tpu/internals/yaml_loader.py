@@ -1,0 +1,107 @@
+"""Declarative YAML pipeline loader
+(reference: python/pathway/internals/yaml_loader.py — `$var` references,
+`!pw.` object tags, env interpolation)."""
+
+from __future__ import annotations
+
+import importlib
+import os
+import re
+from typing import Any, IO
+
+import yaml
+
+_ENV_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+class _PwTag:
+    def __init__(self, path: str, kwargs: dict):
+        self.path = path
+        self.kwargs = kwargs
+
+    def instantiate(self, variables: dict):
+        target = _resolve_symbol(self.path)
+        kwargs = {k: _materialize(v, variables) for k, v in self.kwargs.items()}
+        if callable(target):
+            return target(**kwargs) if kwargs else (
+                target() if _requires_call(target) else target)
+        return target
+
+
+def _requires_call(target) -> bool:
+    return isinstance(target, type)
+
+
+def _resolve_symbol(path: str):
+    """Resolve `pw.xpacks.llm.embedders.SentenceTransformerEmbedder`-style paths."""
+    parts = path.split(".")
+    if parts[0] in ("pw", "pathway"):
+        import pathway_tpu as root
+
+        obj: Any = root
+        parts = parts[1:]
+    else:
+        obj = importlib.import_module(parts[0])
+        parts = parts[1:]
+    for p in parts:
+        if hasattr(obj, p):
+            obj = getattr(obj, p)
+        else:
+            obj = importlib.import_module(f"{obj.__name__}.{p}")
+    return obj
+
+
+def _pw_constructor(loader, tag_suffix, node):
+    if isinstance(node, yaml.MappingNode):
+        kwargs = loader.construct_mapping(node, deep=True)
+    else:
+        kwargs = {}
+    return _PwTag(tag_suffix, kwargs)
+
+
+class _Loader(yaml.SafeLoader):
+    pass
+
+
+yaml.add_multi_constructor("!pw", lambda l, s, n: _pw_constructor(l, "pw" + s, n),
+                           Loader=_Loader)
+yaml.add_multi_constructor("!", lambda l, s, n: _pw_constructor(l, s, n),
+                           Loader=_Loader)
+
+
+def _interpolate_env(text: str) -> str:
+    return _ENV_RE.sub(lambda m: os.environ.get(m.group(1), m.group(0)), text)
+
+
+def _materialize(value: Any, variables: dict) -> Any:
+    if isinstance(value, _PwTag):
+        return value.instantiate(variables)
+    if isinstance(value, str) and value.startswith("$"):
+        name = value[1:]
+        if name in variables:
+            return _materialize(variables[name], variables)
+        return value
+    if isinstance(value, dict):
+        return {k: _materialize(v, variables) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_materialize(v, variables) for v in value]
+    return value
+
+
+def load_yaml(stream: str | IO) -> Any:
+    if hasattr(stream, "read"):
+        text = stream.read()
+    else:
+        text = stream
+    text = _interpolate_env(text)
+    raw = yaml.load(text, Loader=_Loader)
+    if not isinstance(raw, dict):
+        return raw
+    variables = {k: v for k, v in raw.items() if k.startswith("$")}
+    variables = {k[1:]: v for k, v in variables.items()}
+    out = {}
+    for k, v in raw.items():
+        if k.startswith("$"):
+            continue
+        out[k] = _materialize(v, variables)
+    return out

@@ -1,0 +1,28 @@
+"""pw.io — connectors (reference: python/pathway/io/__init__.py:33-60).
+
+Implemented natively: fs/csv/jsonlines/plaintext (file readers+writers),
+python (ConnectorSubject), http (rest_connector server + streaming client),
+subscribe, null, kafka (via kafka-python if importable, else clear error).
+Cloud connectors that need absent client libraries (s3, gdrive, …) raise at
+call-time with instructions, keeping API surface and signatures.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.io import csv, fs, jsonlines, null, python  # noqa: F401
+from pathway_tpu.io._subscribe import subscribe  # noqa: F401
+from pathway_tpu.io import http  # noqa: F401
+from pathway_tpu.io import kafka  # noqa: F401
+from pathway_tpu.io import airbyte, bigquery, debezium, deltalake, elasticsearch  # noqa: F401
+from pathway_tpu.io import gdrive, logstash, minio, mongodb, nats, postgres  # noqa: F401
+from pathway_tpu.io import plaintext, pubsub, pyfilesystem, redpanda, s3, s3_csv  # noqa: F401
+from pathway_tpu.io import slack, sqlite  # noqa: F401
+from pathway_tpu.io.python import ConnectorSubject  # noqa: F401
+
+__all__ = [
+    "csv", "fs", "jsonlines", "null", "python", "http", "kafka", "subscribe",
+    "ConnectorSubject", "airbyte", "bigquery", "debezium", "deltalake",
+    "elasticsearch", "gdrive", "logstash", "minio", "mongodb", "nats",
+    "plaintext", "postgres", "pubsub", "pyfilesystem", "redpanda", "s3",
+    "s3_csv", "slack", "sqlite",
+]

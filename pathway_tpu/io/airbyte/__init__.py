@@ -1,0 +1,5 @@
+"""pw.io.airbyte (reference: python/pathway/io/airbyte). Gated: needs airbyte-serverless."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("airbyte", "airbyte-serverless")

@@ -1,0 +1,5 @@
+"""pw.io.bigquery (reference: python/pathway/io/bigquery). Gated: needs google-cloud-bigquery."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("bigquery", "google-cloud-bigquery")

@@ -1,0 +1,5 @@
+"""pw.io.debezium (reference: python/pathway/io/debezium). Gated: needs a Kafka client (kafka-python)."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("debezium", "a Kafka client (kafka-python)")

@@ -1,0 +1,5 @@
+"""pw.io.deltalake (reference: python/pathway/io/deltalake). Gated: needs deltalake."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("deltalake", "deltalake")

@@ -1,0 +1,5 @@
+"""pw.io.elasticsearch (reference: python/pathway/io/elasticsearch). Gated: needs elasticsearch."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("elasticsearch", "elasticsearch")

@@ -1,0 +1,179 @@
+"""pw.io.fs — filesystem connector
+(reference: python/pathway/io/fs + src/connectors/data_storage.rs
+FilesystemReader:566, FileWriter:538). Formats: csv / json / plaintext /
+binary / plaintext_by_file. Static mode reads eagerly; streaming mode polls
+the directory for new/changed files."""
+
+from __future__ import annotations
+
+import csv as _csv
+import json as _json
+import os
+import time as _time
+from pathlib import Path
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import hash_values
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._datasource import DataSource, Session
+
+
+def _list_files(path: str) -> list[Path]:
+    p = Path(path)
+    if p.is_dir():
+        return sorted(f for f in p.rglob("*") if f.is_file())
+    if p.exists():
+        return [p]
+    import glob
+
+    return sorted(Path(f) for f in glob.glob(path))
+
+
+def _parse_file(fpath: Path, format: str, schema, with_metadata: bool):
+    """Yield value-dicts for one file."""
+    meta = None
+    if with_metadata:
+        st = fpath.stat()
+        meta = Json({
+            "path": str(fpath), "size": st.st_size,
+            "modified_at": int(st.st_mtime), "created_at": int(st.st_ctime),
+            "seen_at": int(_time.time()),
+        })
+    if format in ("plaintext", "plaintext_by_file", "binary"):
+        if format == "binary":
+            data = fpath.read_bytes()
+            rows = [{"data": data}]
+        elif format == "plaintext_by_file":
+            rows = [{"data": fpath.read_text()}]
+        else:
+            rows = [{"data": line} for line in fpath.read_text().splitlines()]
+    elif format == "csv":
+        with open(fpath, newline="") as f:
+            rows = list(_csv.DictReader(f))
+    elif format in ("json", "jsonlines"):
+        rows = []
+        for line in fpath.read_text().splitlines():
+            if line.strip():
+                rows.append(_json.loads(line))
+    else:
+        raise ValueError(f"unknown format {format!r}")
+    for r in rows:
+        if meta is not None:
+            r["_metadata"] = meta
+        yield r
+
+
+def _schema_for(format: str, schema, with_metadata: bool):
+    if schema is not None:
+        if with_metadata and "_metadata" not in schema.column_names():
+            schema = schema | sch.schema_from_types(_metadata=dt.JSON)
+        return schema
+    if format in ("plaintext", "plaintext_by_file"):
+        base = sch.schema_from_types(data=dt.STR)
+    elif format == "binary":
+        base = sch.schema_from_types(data=dt.BYTES)
+    else:
+        raise ValueError(f"schema required for format {format!r}")
+    if with_metadata:
+        base = base | sch.schema_from_types(_metadata=dt.JSON)
+    return base
+
+
+class FsSource(DataSource):
+    name = "fs"
+
+    def __init__(self, path: str, format: str, schema, mode: str,
+                 with_metadata: bool, refresh_interval_s: float = 0.5,
+                 autocommit_duration_ms=1500):
+        super().__init__(schema, autocommit_duration_ms)
+        self.path = path
+        self.format = format
+        self.mode = mode
+        self.with_metadata = with_metadata
+        self.refresh_interval_s = refresh_interval_s
+
+    def run(self, session: Session) -> None:
+        seen: dict[str, float] = {}
+        emitted: dict[str, list] = {}
+        seq = 0
+        while True:
+            for f in _list_files(self.path):
+                mtime = f.stat().st_mtime
+                fkey = str(f)
+                if fkey in seen and seen[fkey] == mtime:
+                    continue
+                if fkey in emitted:
+                    for key, row in emitted[fkey]:
+                        session.push(key, row, -1)
+                seen[fkey] = mtime
+                rows = []
+                for values in _parse_file(f, self.format, self.schema,
+                                          self.with_metadata):
+                    key, row = self.row_to_engine(values, seq)
+                    seq += 1
+                    session.push(key, row, 1)
+                    rows.append((key, row))
+                emitted[fkey] = rows
+            if self.mode != "streaming":
+                return
+            _time.sleep(self.refresh_interval_s)
+
+
+def read(path: str, *, format: str = "plaintext", schema=None,
+         mode: str = "streaming", csv_settings=None, json_field_paths=None,
+         with_metadata: bool = False, autocommit_duration_ms: int | None = 1500,
+         name: str | None = None, **kwargs) -> Table:
+    the_schema = _schema_for(format, schema, with_metadata)
+    if mode == "static":
+        keys, rows = [], []
+        seq = 0
+        src = FsSource(path, format, the_schema, mode, with_metadata)
+        for f in _list_files(path):
+            for values in _parse_file(f, format, the_schema, with_metadata):
+                key, row = src.row_to_engine(values, seq)
+                seq += 1
+                keys.append(key)
+                rows.append(row)
+        plan = Plan("static", keys=keys, rows=rows, times=None, diffs=None)
+        return Table(plan, the_schema, Universe(), name=name or "fs_static")
+    source = FsSource(path, format, the_schema, mode, with_metadata,
+                      autocommit_duration_ms=autocommit_duration_ms)
+    return Table(Plan("input", datasource=source), the_schema, Universe(),
+                 name=name or "fs_input")
+
+
+def write(table: Table, filename: str, *, format: str = "json", name=None,
+          **kwargs) -> None:
+    """Append diffs to a file as CSV or JSONLines with time/diff columns
+    (reference FileWriter output format)."""
+    names = table.column_names()
+    path = filename
+
+    def binder(runner):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        f = open(path, "w", newline="")
+        if format == "csv":
+            writer = _csv.writer(f)
+            writer.writerow(names + ["time", "diff"])
+
+            def callback(time, delta):
+                for key, row, diff in delta.entries:
+                    writer.writerow(list(row) + [time, diff])
+                f.flush()
+        else:
+            def callback(time, delta):
+                for key, row, diff in delta.entries:
+                    rec = dict(zip(names, row))
+                    rec["time"] = time
+                    rec["diff"] = diff
+                    f.write(_json.dumps(rec, default=str) + "\n")
+                f.flush()
+
+        runner.subscribe(table, callback)
+
+    G.add_output(binder)

@@ -1,0 +1,5 @@
+"""pw.io.gdrive (reference: python/pathway/io/gdrive). Gated: needs google-api-python-client."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("gdrive", "google-api-python-client")

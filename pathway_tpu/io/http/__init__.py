@@ -1,0 +1,308 @@
+"""pw.io.http — REST server connector + streaming HTTP client.
+
+Rebuild of the reference's rest_connector (python/pathway/io/http/_server.py:624
++ PathwayWebserver:329): each HTTP request becomes a row in a query table;
+`response_writer` resolves the awaiting request when the pipeline emits the
+row with the same key. This is the serving front door of the RAG stack
+(SURVEY §3.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+import threading
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import Pointer, hash_values
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._datasource import DataSource, Session
+
+
+class PathwayWebserver:
+    """Shared aiohttp server; multiple rest_connectors can register routes
+    (reference: _server.py:329 with OpenAPI docs at /_schema)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080,
+                 with_schema_endpoint: bool = True, with_cors: bool = False):
+        self.host = host
+        self.port = port
+        self._routes: dict[tuple[str, str], Any] = {}
+        self._openapi: dict = {"openapi": "3.0.3",
+                               "info": {"title": "pathway-tpu", "version": "1"},
+                               "paths": {}}
+        self._started = threading.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self.with_schema_endpoint = with_schema_endpoint
+
+    def register(self, route: str, methods: tuple[str, ...], handler,
+                 schema: type[sch.Schema] | None) -> None:
+        for m in methods:
+            self._routes[(m.upper(), route)] = handler
+        if schema is not None:
+            props = {
+                c.name: {"type": _openapi_type(c.dtype)}
+                for c in schema.columns().values()
+            }
+            self._openapi["paths"][route] = {
+                m.lower(): {
+                    "requestBody": {"content": {"application/json": {
+                        "schema": {"type": "object", "properties": props}}}},
+                    "responses": {"200": {"description": "ok"}},
+                } for m in methods
+            }
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        from aiohttp import web
+
+        async def dispatch(request):
+            handler = self._routes.get((request.method, request.path))
+            if handler is None:
+                if request.path == "/_schema" and self.with_schema_endpoint:
+                    return web.json_response(self._openapi)
+                return web.Response(status=404, text="no such route")
+            try:
+                if request.method in ("POST", "PUT", "PATCH"):
+                    try:
+                        payload = await request.json()
+                    except Exception:
+                        payload = {"query": await request.text()}
+                else:
+                    payload = dict(request.query)
+                result = await handler(payload)
+                if isinstance(result, (dict, list)):
+                    return web.json_response(result)
+                return web.Response(text=str(result))
+            except _BadRequest as e:
+                return web.Response(status=400, text=str(e))
+            except Exception as e:
+                return web.Response(status=500, text=repr(e))
+
+        async def main():
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", dispatch)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self._started.set()
+            while True:
+                await asyncio.sleep(3600)
+
+        def run_loop():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(main())
+            except Exception:
+                self._started.set()
+
+        self._thread = threading.Thread(target=run_loop, daemon=True,
+                                        name="pathway-tpu-webserver")
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+
+class _BadRequest(ValueError):
+    pass
+
+
+def _openapi_type(d) -> str:
+    from pathway_tpu.internals import dtype as dtm
+
+    base = dtm.unoptionalize(d)
+    if base is dtm.INT:
+        return "integer"
+    if base is dtm.FLOAT:
+        return "number"
+    if base is dtm.BOOL:
+        return "boolean"
+    return "string"
+
+
+class RestSource(DataSource):
+    name = "rest"
+
+    def __init__(self, webserver: PathwayWebserver, route: str,
+                 methods: tuple[str, ...], schema,
+                 delete_completed_queries: bool,
+                 autocommit_duration_ms=50, request_validator=None):
+        super().__init__(schema, autocommit_duration_ms)
+        self.webserver = webserver
+        self.route = route
+        self.methods = methods
+        self.delete_completed_queries = delete_completed_queries
+        self.request_validator = request_validator
+        self.pending: dict[Pointer, tuple[asyncio.AbstractEventLoop,
+                                          asyncio.Event, list]] = {}
+        self._session: Session | None = None
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def run(self, session: Session) -> None:
+        self._session = session
+
+        async def handler(payload: dict):
+            for col in self.schema.columns().values():
+                if col.name not in payload:
+                    if col.has_default_value:
+                        payload[col.name] = col.default_value
+                    else:
+                        raise _BadRequest(
+                            f"field {col.name!r} is required")
+            if self.request_validator is not None:
+                err = self.request_validator(payload)
+                if err:
+                    raise _BadRequest(str(err))
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            key, row = self.row_to_engine(payload, seq)
+            key = hash_values("rest", self._uid, seq)
+            loop = asyncio.get_event_loop()
+            event = asyncio.Event()
+            slot: list = [None]
+            self.pending[key] = (loop, event, slot)
+            session.push(key, row, 1)
+            await event.wait()
+            if self.delete_completed_queries:
+                session.push(key, row, -1)
+            return slot[0]
+
+        self.webserver.register(self.route, self.methods, handler, self.schema)
+        self.webserver.start()
+        # stay alive until runtime stops us (sources close when run() returns)
+        stop = threading.Event()
+        stop.wait()
+
+    def resolve(self, key: Pointer, value: Any) -> None:
+        entry = self.pending.pop(key, None)
+        if entry is None:
+            return
+        loop, event, slot = entry
+        slot[0] = value
+        loop.call_soon_threadsafe(event.set)
+
+
+def rest_connector(host: str | None = None, port: int | None = None, *,
+                   webserver: PathwayWebserver | None = None,
+                   route: str = "/", schema: type[sch.Schema] | None = None,
+                   methods: tuple[str, ...] = ("POST",),
+                   autocommit_duration_ms: int | None = 50,
+                   keep_queries: bool | None = None,
+                   delete_completed_queries: bool = False,
+                   request_validator=None,
+                   documentation=None) -> tuple[Table, Any]:
+    """Returns (query_table, response_writer)."""
+    if webserver is None:
+        webserver = PathwayWebserver(host or "0.0.0.0", port or 8080)
+    if schema is None:
+        schema = sch.schema_from_types(query=dt.ANY)
+    source = RestSource(webserver, route, methods, schema,
+                        delete_completed_queries,
+                        autocommit_duration_ms=autocommit_duration_ms,
+                        request_validator=request_validator)
+    table = Table(Plan("input", datasource=source), schema, Universe(),
+                  name=f"rest:{route}")
+
+    def response_writer(response_table: Table) -> None:
+        names = response_table.column_names()
+
+        def binder(runner):
+            def callback(time, delta):
+                for key, row, diff in delta.entries:
+                    if diff <= 0:
+                        continue
+                    if len(names) == 1:
+                        value = row[0]
+                    else:
+                        value = dict(zip(names, row))
+                    value = _jsonable(value)
+                    source.resolve(key, value)
+
+            runner.subscribe(response_table, callback)
+
+        G.add_output(binder)
+
+    return table, response_writer
+
+
+def _jsonable(value):
+    if isinstance(value, Json):
+        return value.value
+    if isinstance(value, dict):
+        return {k: _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, Pointer):
+        return str(value)
+    import numpy as np
+
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, (np.integer, np.floating)):
+        return value.item()
+    return value
+
+
+# -- streaming HTTP client (reference: io/http/_streaming.py) ----------------
+
+def read(url: str, *, schema=None, format: str = "json",
+         autocommit_duration_ms: int | None = 1500, name=None,
+         **kwargs) -> Table:
+    import urllib.request
+
+    from pathway_tpu.io._datasource import CallbackSource
+
+    if schema is None:
+        schema = sch.schema_from_types(data=dt.ANY)
+
+    def gen():
+        with urllib.request.urlopen(url) as resp:
+            for line in resp:
+                line = line.decode().strip()
+                if not line:
+                    continue
+                if format == "json":
+                    yield _json.loads(line)
+                else:
+                    yield {"data": line}
+
+    source = CallbackSource(gen, schema,
+                            autocommit_duration_ms=autocommit_duration_ms,
+                            name="http")
+    return Table(Plan("input", datasource=source), schema, Universe(),
+                 name=name or "http_input")
+
+
+def write(table: Table, url: str, *, method: str = "POST", format: str = "json",
+          name=None, **kwargs) -> None:
+    import urllib.request
+
+    names = table.column_names()
+
+    def binder(runner):
+        def callback(time, delta):
+            for key, row, diff in delta.entries:
+                rec = dict(zip(names, row))
+                rec.update({"time": time, "diff": diff})
+                req = urllib.request.Request(
+                    url, data=_json.dumps(_jsonable(rec)).encode(),
+                    method=method,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    urllib.request.urlopen(req, timeout=10)
+                except Exception:
+                    pass
+
+        runner.subscribe(table, callback)
+
+    G.add_output(binder)

@@ -1,0 +1,107 @@
+"""pw.io.kafka (reference: python/pathway/io/kafka + KafkaReader/Writer,
+src/connectors/data_storage.rs:720,2142).
+
+Activates when a Python Kafka client (`kafka-python` or `confluent_kafka`)
+is importable; otherwise raises at call time. Partition-parallel reads map
+to per-host sources in the multi-host topology (reference: each worker owns
+its partitions, connectors/mod.rs ReadersQueryPurpose).
+"""
+
+from __future__ import annotations
+
+import json as _json
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._datasource import DataSource, Session
+
+
+def _get_client():
+    try:
+        import kafka  # type: ignore
+
+        return "kafka-python"
+    except ImportError:
+        pass
+    try:
+        import confluent_kafka  # type: ignore
+
+        return "confluent"
+    except ImportError:
+        return None
+
+
+class KafkaSource(DataSource):
+    name = "kafka"
+
+    def __init__(self, rdkafka_settings: dict, topic: str, format: str,
+                 schema, autocommit_duration_ms=1500):
+        super().__init__(schema, autocommit_duration_ms)
+        self.settings = rdkafka_settings
+        self.topic = topic
+        self.format = format
+
+    def run(self, session: Session) -> None:
+        from kafka import KafkaConsumer  # type: ignore
+
+        consumer = KafkaConsumer(
+            self.topic,
+            bootstrap_servers=self.settings.get("bootstrap.servers"),
+            group_id=self.settings.get("group.id"),
+            auto_offset_reset=self.settings.get("auto.offset.reset", "earliest"),
+        )
+        seq = 0
+        for msg in consumer:
+            if self.format == "raw":
+                values = {"data": msg.value}
+            else:
+                values = _json.loads(msg.value)
+            key, row = self.row_to_engine(values, seq)
+            seq += 1
+            session.push(key, row, 1)
+
+
+def read(rdkafka_settings: dict, topic: str | None = None, *, schema=None,
+         format: str = "raw", autocommit_duration_ms: int | None = 1500,
+         name=None, **kwargs) -> Table:
+    if _get_client() is None:
+        raise ImportError(
+            "pw.io.kafka requires kafka-python or confluent_kafka; neither is "
+            "installed in this environment.")
+    if schema is None:
+        schema = sch.schema_from_types(data=dt.BYTES)
+    source = KafkaSource(rdkafka_settings, topic, format, schema,
+                         autocommit_duration_ms=autocommit_duration_ms)
+    return Table(Plan("input", datasource=source), schema, Universe(),
+                 name=name or "kafka_input")
+
+
+def write(table: Table, rdkafka_settings: dict, topic_name: str, *,
+          format: str = "json", name=None, **kwargs) -> None:
+    if _get_client() is None:
+        raise ImportError(
+            "pw.io.kafka requires kafka-python or confluent_kafka; neither is "
+            "installed in this environment.")
+    from kafka import KafkaProducer  # type: ignore
+
+    from pathway_tpu.internals.parse_graph import G
+
+    names = table.column_names()
+
+    def binder(runner):
+        producer = KafkaProducer(
+            bootstrap_servers=rdkafka_settings.get("bootstrap.servers"))
+
+        def callback(time, delta):
+            for key, row, diff in delta.entries:
+                rec = dict(zip(names, row))
+                rec["time"] = time
+                rec["diff"] = diff
+                producer.send(topic_name, _json.dumps(rec, default=str).encode())
+            producer.flush()
+
+        runner.subscribe(table, callback)
+
+    G.add_output(binder)

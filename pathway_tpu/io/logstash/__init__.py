@@ -1,0 +1,5 @@
+"""pw.io.logstash (reference: python/pathway/io/logstash). Gated: needs an HTTP sink endpoint."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("logstash", "an HTTP sink endpoint")

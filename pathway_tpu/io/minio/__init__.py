@@ -1,0 +1,5 @@
+"""pw.io.minio (reference: python/pathway/io/minio). Gated: needs boto3."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("minio", "boto3")

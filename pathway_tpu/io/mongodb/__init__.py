@@ -1,0 +1,5 @@
+"""pw.io.mongodb (reference: python/pathway/io/mongodb). Gated: needs pymongo."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("mongodb", "pymongo")

@@ -1,0 +1,5 @@
+"""pw.io.nats (reference: python/pathway/io/nats). Gated: needs nats-py."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("nats", "nats-py")

@@ -1,0 +1,13 @@
+"""pw.io.null (reference: NullWriter, src/connectors/data_storage.rs:2297)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def write(table: Table, *, name=None, **kwargs) -> None:
+    def binder(runner):
+        runner.subscribe(table, lambda time, delta: None)
+
+    G.add_output(binder)

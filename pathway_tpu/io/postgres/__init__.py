@@ -1,0 +1,5 @@
+"""pw.io.postgres (reference: python/pathway/io/postgres). Gated: needs psycopg2."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("postgres", "psycopg2")

@@ -1,0 +1,5 @@
+"""pw.io.pubsub (reference: python/pathway/io/pubsub). Gated: needs google-cloud-pubsub."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("pubsub", "google-cloud-pubsub")

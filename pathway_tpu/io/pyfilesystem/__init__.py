@@ -1,0 +1,5 @@
+"""pw.io.pyfilesystem (reference: python/pathway/io/pyfilesystem). Gated: needs fs."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("pyfilesystem", "fs")

@@ -1,0 +1,5 @@
+"""pw.io.redpanda (reference: python/pathway/io/redpanda). Gated: needs kafka-python."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("redpanda", "kafka-python")

@@ -1,0 +1,5 @@
+"""pw.io.s3 (reference: python/pathway/io/s3). Gated: needs boto3."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("s3", "boto3")

@@ -1,0 +1,5 @@
+"""pw.io.s3_csv (reference: python/pathway/io/s3_csv). Gated: needs boto3."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("s3_csv", "boto3")

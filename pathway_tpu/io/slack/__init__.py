@@ -1,0 +1,5 @@
+"""pw.io.slack (reference: python/pathway/io/slack). Gated: needs slack-sdk."""
+
+from pathway_tpu.io._gated import gated
+
+read, write = gated("slack", "slack-sdk")

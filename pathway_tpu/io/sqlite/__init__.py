@@ -1,0 +1,97 @@
+"""pw.io.sqlite (reference: SqliteReader,
+src/connectors/data_storage.rs:2483). Snapshot + rowid-polling CDC."""
+
+from __future__ import annotations
+
+import sqlite3
+import time as _time
+
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._datasource import DataSource, Session
+
+
+class SqliteSource(DataSource):
+    name = "sqlite"
+
+    def __init__(self, path: str, table_name: str, schema,
+                 mode: str = "streaming", poll_interval_s: float = 1.0,
+                 autocommit_duration_ms=1500):
+        super().__init__(schema, autocommit_duration_ms)
+        self.path = path
+        self.table_name = table_name
+        self.mode = mode
+        self.poll_interval_s = poll_interval_s
+
+    def run(self, session: Session) -> None:
+        names = self.schema.column_names()
+        cols = ", ".join(names)
+        emitted: dict[int, tuple] = {}
+        seq = 0
+        while True:
+            conn = sqlite3.connect(self.path)
+            try:
+                cur = conn.execute(
+                    f"SELECT rowid, {cols} FROM {self.table_name}")
+                current: dict[int, tuple] = {}
+                for rec in cur.fetchall():
+                    rowid, *vals = rec
+                    current[rowid] = tuple(vals)
+            finally:
+                conn.close()
+            for rowid, vals in current.items():
+                if emitted.get(rowid) != vals:
+                    values = dict(zip(names, vals))
+                    values["_rowid"] = rowid
+                    key, row = self.row_to_engine(values, rowid)
+                    if rowid in emitted:
+                        old = dict(zip(names, emitted[rowid]))
+                        old["_rowid"] = rowid
+                        okey, orow = self.row_to_engine(old, rowid)
+                        session.push(okey, orow, -1)
+                    session.push(key, row, 1)
+                    emitted[rowid] = vals
+            for rowid in list(emitted):
+                if rowid not in current:
+                    old = dict(zip(names, emitted.pop(rowid)))
+                    old["_rowid"] = rowid
+                    okey, orow = self.row_to_engine(old, rowid)
+                    session.push(okey, orow, -1)
+            if self.mode != "streaming":
+                return
+            _time.sleep(self.poll_interval_s)
+
+    def row_to_engine(self, values, seq):
+        from pathway_tpu.internals.keys import hash_values
+        from pathway_tpu.internals import dtype as dt
+
+        names = self.schema.column_names()
+        dtypes = self.schema._dtypes()
+        row = tuple(dt.coerce_value(values.get(n), dtypes[n]) for n in names)
+        key = hash_values("sqlite", self.table_name, values.get("_rowid", seq))
+        return key, row
+
+
+def read(path: str, table_name: str, schema: type[sch.Schema], *,
+         mode: str = "streaming", autocommit_duration_ms: int | None = 1500,
+         name=None, **kw) -> Table:
+    source = SqliteSource(path, table_name, schema, mode=mode,
+                          autocommit_duration_ms=autocommit_duration_ms)
+    if mode == "static":
+        # run eagerly into a static plan
+        rows_acc: list = []
+
+        class _Sess:
+            def push(self, key, row, diff):
+                rows_acc.append((key, row, diff))
+
+            closed = None
+
+        source.run(_Sess())  # type: ignore[arg-type]
+        keys = [k for k, r, d in rows_acc if d > 0]
+        rows = [r for k, r, d in rows_acc if d > 0]
+        return Table(Plan("static", keys=keys, rows=rows, times=None, diffs=None),
+                     schema, Universe(), name=name or "sqlite_static")
+    return Table(Plan("input", datasource=source), schema, Universe(),
+                 name=name or "sqlite_input")

@@ -1,0 +1,1 @@
+from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric  # noqa: F401

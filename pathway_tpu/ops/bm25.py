@@ -1,0 +1,118 @@
+"""Host-side incremental BM25 full-text index.
+
+Replaces the reference's TantivyIndex (src/external_integration/
+tantivy_integration.rs:16 — Rust tantivy crate). Okapi BM25 with an
+incremental inverted index; text scoring is pointer-chasing work that has no
+MXU shape, so it stays host-side (a C++ engine is the planned upgrade path,
+mirroring the reference's native choice).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from collections import defaultdict
+from typing import Any
+
+from pathway_tpu.internals.keys import Pointer
+
+_TOKEN_RE = re.compile(r"[A-Za-z0-9_]+")
+
+
+def tokenize(text: str) -> list[str]:
+    return [t.lower() for t in _TOKEN_RE.findall(text or "")]
+
+
+class BM25Index:
+    def __init__(self, *, k1: float = 1.2, b: float = 0.75,
+                 ram_budget: int | None = None, in_memory_index: bool = True):
+        self.k1 = k1
+        self.b = b
+        self._postings: dict[str, dict[Pointer, int]] = defaultdict(dict)
+        self._doc_len: dict[Pointer, int] = {}
+        self._doc_tokens: dict[Pointer, list[str]] = {}
+        self._filter_data: dict[Pointer, Any] = {}
+        self._total_len = 0
+        self._lock = threading.RLock()
+
+    def __len__(self) -> int:
+        return len(self._doc_len)
+
+    def add(self, key: Pointer, text: Any, filter_data: Any | None = None) -> None:
+        with self._lock:
+            if key in self._doc_len:
+                self.remove(key)
+            tokens = tokenize(text if isinstance(text, str) else str(text))
+            self._doc_tokens[key] = tokens
+            self._doc_len[key] = len(tokens)
+            self._total_len += len(tokens)
+            for tok in tokens:
+                self._postings[tok][key] = self._postings[tok].get(key, 0) + 1
+            if filter_data is not None:
+                self._filter_data[key] = filter_data
+
+    def remove(self, key: Pointer) -> None:
+        with self._lock:
+            tokens = self._doc_tokens.pop(key, None)
+            if tokens is None:
+                return
+            self._total_len -= self._doc_len.pop(key, 0)
+            self._filter_data.pop(key, None)
+            for tok in tokens:
+                posting = self._postings.get(tok)
+                if posting is None:
+                    continue
+                cnt = posting.get(key, 0) - 1
+                if cnt <= 0:
+                    posting.pop(key, None)
+                    if not posting:
+                        del self._postings[tok]
+                else:
+                    posting[key] = cnt
+
+    def _score_query(self, text: str, limit: int, filt) -> list[tuple]:
+        n_docs = len(self._doc_len)
+        if n_docs == 0:
+            return []
+        avg_len = self._total_len / n_docs if n_docs else 1.0
+        scores: dict[Pointer, float] = defaultdict(float)
+        for tok in tokenize(text):
+            posting = self._postings.get(tok)
+            if not posting:
+                continue
+            df = len(posting)
+            idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+            for key, tf in posting.items():
+                dl = self._doc_len[key]
+                denom = tf + self.k1 * (1 - self.b + self.b * dl / avg_len)
+                scores[key] += idf * (tf * (self.k1 + 1)) / denom
+        ranked = sorted(scores.items(), key=lambda kv: (-kv[1], int(kv[0])))
+        out = []
+        for key, score in ranked:
+            if filt is not None and not self._passes_filter(key, filt):
+                continue
+            out.append((key, score))
+            if len(out) >= limit:
+                break
+        return out
+
+    def _passes_filter(self, key, filt) -> bool:
+        data = self._filter_data.get(key)
+        if callable(filt):
+            try:
+                return bool(filt(data))
+            except Exception:
+                return False
+        from pathway_tpu.internals.jmespath_lite import evaluate_filter
+
+        return evaluate_filter(filt, data)
+
+    def search(self, queries: list[tuple]) -> list[tuple]:
+        with self._lock:
+            out = []
+            for qkey, text, limit, filt in queries:
+                out.append(tuple(self._score_query(
+                    text if isinstance(text, str) else str(text),
+                    int(limit or 3), filt)))
+            return out

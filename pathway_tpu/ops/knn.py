@@ -1,0 +1,239 @@
+"""TPU-resident brute-force KNN index.
+
+The TPU-native replacement for the reference's BruteForceKNNIndex
+(src/external_integration/brute_force_knn_integration.rs:22,187-229 —
+ndarray ``index_arr.dot(query_batch)`` + k-smallest on CPU): vectors live in
+an HBM-resident padded slab; queries are answered by one jitted
+matmul + top-k over the slab (MXU work), with host-side dirty-slot batching
+so incremental adds/removes coalesce into few device scatters.
+
+Distance metrics mirror the reference (L2sq / cosine). Sharded multi-chip
+variant (slab split over a mesh axis + per-shard top-k + merge) lives in
+pathway_tpu/parallel/sharded_knn.py.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals.keys import Pointer
+
+
+class KnnMetric(enum.Enum):
+    L2SQ = "l2sq"
+    COS = "cos"
+
+
+_MIN_CAPACITY = 1024
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+class BruteForceKnnIndex:
+    """Incremental exact KNN over a device-resident vector slab.
+
+    add/remove mutate a host mirror and enqueue dirty slots; search flushes
+    pending updates to the device (single scatter), then runs the jitted
+    scores+top-k kernel. Capacity doubles on overflow (reference: doubling
+    realloc, brute_force_knn_integration.rs).
+    """
+
+    def __init__(self, dimensions: int, *, reserved_space: int = 0,
+                 metric: KnnMetric | str = KnnMetric.L2SQ,
+                 dtype: str = "float32", device=None):
+        if isinstance(metric, str):
+            metric = KnnMetric(metric)
+        self.dim = int(dimensions)
+        self.metric = metric
+        self.capacity = max(_MIN_CAPACITY, _round_up(max(reserved_space, 1), 128))
+        self.dtype = dtype
+        self._lock = threading.RLock()
+
+        # host mirror
+        self._host_vectors = np.zeros((self.capacity, self.dim), dtype=np.float32)
+        self._host_valid = np.zeros((self.capacity,), dtype=bool)
+        self._key_to_slot: dict[Pointer, int] = {}
+        self._slot_to_key: dict[int, Pointer] = {}
+        self._filter_data: dict[Pointer, Any] = {}
+        self._free: list[int] = list(range(self.capacity - 1, -1, -1))
+        self._dirty: set[int] = set()
+
+        # device state (lazy)
+        self._dev_vectors = None
+        self._dev_valid = None
+        self._search_fn_cache: dict[tuple, Callable] = {}
+        self._device = device
+
+    # ------------------------------------------------------------------
+    # maintenance (called from the external-index operator on data diffs)
+    # ------------------------------------------------------------------
+    def add(self, key: Pointer, vector: Any, filter_data: Any | None = None) -> None:
+        with self._lock:
+            vec = np.asarray(vector, dtype=np.float32).reshape(-1)
+            if vec.shape[0] != self.dim:
+                raise ValueError(
+                    f"vector dim {vec.shape[0]} != index dim {self.dim}")
+            if key in self._key_to_slot:
+                slot = self._key_to_slot[key]
+            else:
+                if not self._free:
+                    self._grow()
+                slot = self._free.pop()
+                self._key_to_slot[key] = slot
+                self._slot_to_key[slot] = key
+            self._host_vectors[slot] = vec
+            self._host_valid[slot] = True
+            if filter_data is not None:
+                self._filter_data[key] = filter_data
+            self._dirty.add(slot)
+
+    def remove(self, key: Pointer) -> None:
+        with self._lock:
+            slot = self._key_to_slot.pop(key, None)
+            if slot is None:
+                return
+            del self._slot_to_key[slot]
+            self._filter_data.pop(key, None)
+            self._host_valid[slot] = False
+            self._free.append(slot)
+            self._dirty.add(slot)
+
+    def __len__(self) -> int:
+        return len(self._key_to_slot)
+
+    def _grow(self) -> None:
+        old_cap = self.capacity
+        self.capacity = old_cap * 2
+        new_vec = np.zeros((self.capacity, self.dim), dtype=np.float32)
+        new_vec[:old_cap] = self._host_vectors
+        self._host_vectors = new_vec
+        new_valid = np.zeros((self.capacity,), dtype=bool)
+        new_valid[:old_cap] = self._host_valid
+        self._host_valid = new_valid
+        self._free.extend(range(self.capacity - 1, old_cap - 1, -1))
+        self._dev_vectors = None  # force full re-upload at next search
+        self._dev_valid = None
+        self._search_fn_cache.clear()
+
+    # ------------------------------------------------------------------
+    # device sync + search
+    # ------------------------------------------------------------------
+    def _flush_to_device(self):
+        import jax
+        import jax.numpy as jnp
+
+        if self._dev_vectors is None:
+            self._dev_vectors = jnp.asarray(self._host_vectors)
+            self._dev_valid = jnp.asarray(self._host_valid)
+            self._dirty.clear()
+            return
+        if self._dirty:
+            idxs = np.fromiter(self._dirty, dtype=np.int32)
+            self._dirty.clear()
+            vals = jnp.asarray(self._host_vectors[idxs])
+            valid = jnp.asarray(self._host_valid[idxs])
+            self._dev_vectors = self._dev_vectors.at[idxs].set(vals)
+            self._dev_valid = self._dev_valid.at[idxs].set(valid)
+
+    def _get_search_fn(self, k: int):
+        key = (k, self.capacity, self.metric)
+        fn = self._search_fn_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        metric = self.metric
+
+        @jax.jit
+        def search(queries, vectors, valid):
+            # queries (B, D), vectors (N, D) — one MXU matmul over the slab
+            if metric == KnnMetric.COS:
+                qn = queries / (jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-12)
+                vn = vectors / (jnp.linalg.norm(vectors, axis=1, keepdims=True) + 1e-12)
+                scores = qn @ vn.T  # higher better
+            else:
+                # -||q - v||^2 = 2 q·v - ||v||^2 - ||q||^2 ; drop ||q||^2 (const per row)
+                dots = queries @ vectors.T
+                v_sq = jnp.sum(vectors * vectors, axis=1)
+                scores = 2.0 * dots - v_sq[None, :]
+            scores = jnp.where(valid[None, :], scores, -jnp.inf)
+            top_scores, top_idx = jax.lax.top_k(scores, k)
+            return top_scores, top_idx
+
+        self._search_fn_cache[key] = search
+        return search
+
+    def search(self, queries: list[tuple]) -> list[tuple]:
+        """Batched search: [(qkey, vector, limit, filter)] →
+        per query a tuple of (match_key, score) pairs, best first.
+        Scores follow the reference convention: L2sq distance (lower=better,
+        reported as distance) or cosine distance 1-cos_sim."""
+        if not queries:
+            return []
+        with self._lock:
+            if not self._key_to_slot:
+                return [() for _ in queries]
+            self._flush_to_device()
+            import jax.numpy as jnp
+
+            max_k = max(int(q[2] or 3) for q in queries)
+            # over-fetch when filters present so post-filtering still fills k
+            has_filter = any(q[3] is not None for q in queries)
+            fetch_k = min(self.capacity,
+                          max_k * 4 if has_filter else max_k)
+            fetch_k = max(fetch_k, 1)
+            qmat = jnp.asarray(
+                np.stack([np.asarray(q[1], dtype=np.float32).reshape(-1)
+                          for q in queries]))
+            search_fn = self._get_search_fn(fetch_k)
+            top_scores, top_idx = search_fn(qmat, self._dev_vectors,
+                                            self._dev_valid)
+            top_scores = np.asarray(top_scores)
+            top_idx = np.asarray(top_idx)
+
+            out = []
+            for qi, (qkey, qvec, limit, filt) in enumerate(queries):
+                limit = int(limit or 3)
+                matches = []
+                qnorm_sq = None
+                for rank in range(fetch_k):
+                    score = top_scores[qi, rank]
+                    if not math.isfinite(score):
+                        break
+                    slot = int(top_idx[qi, rank])
+                    key = self._slot_to_key.get(slot)
+                    if key is None:
+                        continue
+                    if filt is not None and not self._passes_filter(key, filt):
+                        continue
+                    if self.metric == KnnMetric.COS:
+                        dist = 1.0 - float(score)
+                    else:
+                        if qnorm_sq is None:
+                            q = np.asarray(qvec, dtype=np.float32).reshape(-1)
+                            qnorm_sq = float(q @ q)
+                        dist = max(0.0, qnorm_sq - float(score))
+                    matches.append((key, dist))
+                    if len(matches) >= limit:
+                        break
+                out.append(tuple(matches))
+            return out
+
+    def _passes_filter(self, key: Pointer, filt: Any) -> bool:
+        data = self._filter_data.get(key)
+        if callable(filt):
+            try:
+                return bool(filt(data))
+            except Exception:
+                return False
+        from pathway_tpu.internals.jmespath_lite import evaluate_filter
+
+        return evaluate_filter(filt, data)

@@ -1,0 +1,63 @@
+"""pw.persistence — checkpoint/resume configuration
+(reference: python/pathway/persistence/__init__.py:12,89 +
+src/persistence/). Engine-side implementation: engine/persistence.py."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class Backend:
+    kind = "mock"
+
+    def __init__(self, kind: str, path: str | None = None, **kwargs):
+        self.kind = kind
+        self.path = path
+        self.options = kwargs
+
+    @classmethod
+    def filesystem(cls, path: str) -> "Backend":
+        return cls("filesystem", path)
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+        # S3 client not available in-image; filesystem layout is identical —
+        # gate at runtime.
+        return cls("s3", root_path, bucket_settings=bucket_settings)
+
+    @classmethod
+    def azure(cls, root_path: str, account: Any = None, **kw) -> "Backend":
+        return cls("azure", root_path, account=account, **kw)
+
+    @classmethod
+    def mock(cls, events: Any = None) -> "Backend":
+        return cls("mock")
+
+
+class PersistenceMode(enum.Enum):
+    """reference: src/connectors/mod.rs:107 / engine.pyi:776-787."""
+
+    BATCH = "batch"
+    SPEEDRUN_REPLAY = "speedrun_replay"
+    REALTIME_REPLAY = "realtime_replay"
+    PERSISTING = "persisting"
+    SELECTIVE_PERSISTING = "selective_persisting"
+    UDF_CACHING = "udf_caching"
+
+
+@dataclass
+class Config:
+    backend: Backend | None = None
+    snapshot_interval_ms: int = 0
+    persistence_mode: PersistenceMode = PersistenceMode.PERSISTING
+    continue_after_replay: bool = True
+
+    @classmethod
+    def simple_config(cls, backend: Backend, **kwargs) -> "Config":
+        return cls(backend=backend, **kwargs)
+
+    def __post_init__(self):
+        if isinstance(self.persistence_mode, str):
+            self.persistence_mode = PersistenceMode(self.persistence_mode)

@@ -1,0 +1,11 @@
+from pathway_tpu.stdlib import (  # noqa: F401
+    graphs,
+    indexing,
+    ml,
+    ordered,
+    statistical,
+    stateful,
+    temporal,
+    utils,
+    viz,
+)

@@ -1,0 +1,95 @@
+"""pw.stdlib.graphs — graph algorithms on tables
+(reference: python/pathway/stdlib/graphs/: pagerank/impl.py:18,
+bellman_ford/impl.py:42, louvain_communities). All built on pw.iterate
+fixpoints, exactly as in the reference."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import pathway_tpu.internals.reducers_frontend as reducers
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.iterate import iterate
+from pathway_tpu.internals.table import Table
+
+
+@dataclass
+class Graph:
+    """V: vertices table; E: edges table with u, v pointer columns."""
+
+    V: Table
+    E: Table
+
+
+def pagerank(edges: Table, steps: int = 5, damping: float = 0.85) -> Table:
+    """Iterative pagerank over an edge table with `u`, `v` pointer columns.
+
+    Returns a table keyed by vertex with a `rank` int column (scaled by 1000,
+    matching the reference's integer ranks — pagerank/impl.py).
+    """
+    degrees = edges.groupby(edges.u).reduce(edges.u, degree=reducers.count())
+    vertices_u = edges.groupby(id=edges.u).reduce()
+    vertices_v = edges.groupby(id=edges.v).reduce()
+    vertices = vertices_u.update_rows(vertices_v)
+    ranks0 = vertices.select(rank=1000)
+
+    deg_by_u = degrees.with_id(degrees.u)
+
+    def one_step(ranks: Table, edges: Table, degrees: Table) -> Table:
+        edge_rank = edges.select(
+            target=edges.v,
+            flow=ranks.ix(edges.u, context=edges).rank
+            // degrees.ix(edges.u, context=edges).degree,
+        )
+        inflow = edge_rank.groupby(id=edge_rank.target).reduce(
+            flow=reducers.sum(edge_rank.flow))
+        base = ranks.select(rank=150)
+        damped = inflow.select(rank=inflow.flow * 850 // 1000)
+        new_ranks = base.update_cells(
+            base.select(rank=150 + damped.restrict(base).rank)
+            if False else damped.select(rank=150 + damped.rank)
+        ) if False else None
+        # rank' = 150 + 0.85 * inflow  (vertices with no inflow keep 150)
+        merged = ranks.select(rank=150).update_rows(
+            inflow.select(rank=150 + inflow.flow * 850 // 1000))
+        return merged.with_universe_of(ranks) if merged.is_subset_of(ranks) else merged
+
+    result = iterate(
+        lambda ranks, edges, degrees: one_step(ranks, edges, degrees),
+        iteration_limit=steps,
+        ranks=ranks0, edges=edges, degrees=deg_by_u,
+    )
+    return result
+
+
+def bellman_ford(vertices: Table, edges: Table) -> Table:
+    """Single-source shortest paths: `vertices` has `is_source: bool`;
+    `edges` has u, v, dist. Returns per-vertex `dist_from_source`
+    (reference: graphs/bellman_ford/impl.py:42)."""
+    INF = float("inf")
+    dists0 = vertices.select(
+        dist_from_source=ex.if_else(vertices.is_source, 0.0, INF))
+
+    def step(dists: Table, edges: Table) -> Table:
+        relaxed = edges.select(
+            target=edges.v,
+            dist=dists.ix(edges.u, context=edges).dist_from_source + edges.dist,
+        )
+        best = relaxed.groupby(id=relaxed.target).reduce(
+            dist=reducers.min(relaxed.dist))
+        merged = dists.update_cells(
+            best.select(dist_from_source=best.dist).with_universe_of(dists)
+            if False else best.select(dist_from_source=best.dist))
+        improved = dists.select(
+            dist_from_source=ex.if_else(
+                merged.dist_from_source < dists.dist_from_source,
+                merged.dist_from_source, dists.dist_from_source))
+        return improved
+
+    return iterate(lambda dists, edges: step(dists, edges),
+                   dists=dists0, edges=edges)
+
+
+def louvain_communities(vertices: Table, edges: Table, iterations: int = 5):
+    raise NotImplementedError("louvain arrives with the clustering stdlib pass")

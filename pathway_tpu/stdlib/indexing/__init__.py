@@ -1,0 +1,32 @@
+from pathway_tpu.stdlib.indexing.data_index import (  # noqa: F401
+    DataIndex,
+    InnerIndex,
+)
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (  # noqa: F401
+    BruteForceKnn,
+    BruteForceKnnFactory,
+    LshKnn,
+    USearchKnn,
+)
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25, TantivyBM25Factory  # noqa: F401
+from pathway_tpu.stdlib.indexing.vector_document_index import (  # noqa: F401
+    default_brute_force_knn_document_index,
+    default_lsh_knn_document_index,
+    default_usearch_knn_document_index,
+    default_vector_document_index,
+)
+from pathway_tpu.stdlib.indexing import retrievers  # noqa: F401
+from pathway_tpu.stdlib.indexing.sorting import (  # noqa: F401
+    binsearch_oracle,
+    filter_smallest_k,
+    prefix_sum_oracle,
+    retrieve_prev_next_values,
+)
+
+__all__ = [
+    "DataIndex", "InnerIndex", "BruteForceKnn", "BruteForceKnnFactory",
+    "LshKnn", "USearchKnn", "TantivyBM25", "TantivyBM25Factory",
+    "default_brute_force_knn_document_index", "default_lsh_knn_document_index",
+    "default_usearch_knn_document_index", "default_vector_document_index",
+    "retrievers", "retrieve_prev_next_values",
+]

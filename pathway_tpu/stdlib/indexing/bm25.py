@@ -1,0 +1,33 @@
+"""BM25 full-text inner index (reference: stdlib/indexing/bm25.py:38 —
+TantivyBM25 over the Rust tantivy engine; here over ops/bm25.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.ops.bm25 import BM25Index
+from pathway_tpu.stdlib.indexing.data_index import InnerIndex
+
+
+@dataclass
+class TantivyBM25Factory:
+    ram_budget: int = 50_000_000
+    in_memory_index: bool = True
+
+    def build(self) -> BM25Index:
+        return BM25Index(ram_budget=self.ram_budget,
+                         in_memory_index=self.in_memory_index)
+
+
+class TantivyBM25(InnerIndex):
+    def __init__(self, data_column: ex.ColumnReference,
+                 metadata_column: ex.ColumnExpression | None = None, *,
+                 ram_budget: int = 50_000_000, in_memory_index: bool = True):
+        super().__init__(data_column, metadata_column)
+        self.ram_budget = ram_budget
+        self.in_memory_index = in_memory_index
+
+    def factory(self) -> TantivyBM25Factory:
+        return TantivyBM25Factory(self.ram_budget, self.in_memory_index)

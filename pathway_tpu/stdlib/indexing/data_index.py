@@ -1,0 +1,153 @@
+"""DataIndex — the index-query API (north star of the indexing stdlib).
+
+Rebuild of reference stdlib/indexing/data_index.py:142,214: an InnerIndex
+wraps an engine external index (TPU brute-force KNN / BM25 / …);
+DataIndex.query_as_of_now answers a query stream against the live index and
+repacks matches into data columns (tuples when collapse_rows=True).
+"""
+
+from __future__ import annotations
+
+from abc import ABC
+from dataclasses import dataclass
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.table import Table
+
+
+class InnerIndex(ABC):
+    """Specifies which columns are indexed and how (reference :142)."""
+
+    def __init__(self, data_column: ex.ColumnReference,
+                 metadata_column: ex.ColumnExpression | None = None):
+        self.data_column = data_column
+        self.metadata_column = metadata_column
+
+    def factory(self):
+        raise NotImplementedError
+
+    @property
+    def query_embedder(self):
+        return None
+
+
+@dataclass
+class _PreparedQueryCols:
+    vec: ex.ColumnExpression
+    limit: ex.ColumnExpression | None
+    filter: ex.ColumnExpression | None
+
+
+class DataIndex:
+    def __init__(self, data_table: Table, inner_index: InnerIndex):
+        self.data_table = data_table
+        self.inner_index = inner_index
+
+    # ------------------------------------------------------------------
+    def query_as_of_now(self, query_column: ex.ColumnExpression, *,
+                        number_of_matches: ex.ColumnExpression | int = 3,
+                        collapse_rows: bool = True,
+                        metadata_filter: ex.ColumnExpression | None = None,
+                        globbing_metadata_filter=None) -> Table:
+        return self._query(query_column, number_of_matches, collapse_rows,
+                           metadata_filter, as_of_now=True)
+
+    def query(self, query_column: ex.ColumnExpression, *,
+              number_of_matches: ex.ColumnExpression | int = 3,
+              collapse_rows: bool = True,
+              metadata_filter: ex.ColumnExpression | None = None) -> Table:
+        # NOTE: full "revise results on data change" semantics land with the
+        # re-scoring operator; identical to query_as_of_now in batch mode.
+        return self._query(query_column, number_of_matches, collapse_rows,
+                           metadata_filter, as_of_now=False)
+
+    # ------------------------------------------------------------------
+    def _query(self, query_column, number_of_matches, collapse_rows,
+               metadata_filter, as_of_now: bool) -> Table:
+        query_table: Table = query_column.table
+        data = self.data_table
+        inner = self.inner_index
+
+        data_vec = inner.data_column
+        embedder = inner.query_embedder
+        data_prepared = data.select(
+            _pw_vec=data_vec,
+            _pw_meta=inner.metadata_column if inner.metadata_column is not None else None,
+        )
+
+        qvec = query_column
+        if embedder is not None:
+            qvec = embedder(query_column)
+        query_prepared = query_table.select(
+            _pw_q=qvec,
+            _pw_k=number_of_matches,
+            _pw_filter=metadata_filter,
+        )
+
+        reply = data_prepared._external_index_as_of_now(
+            query_prepared,
+            index_factory=inner.factory(),
+            query_responses_limit_column=query_prepared._pw_k,
+            query_filter_column=query_prepared._pw_filter,
+            index_filter_data_column=data_prepared._pw_meta,
+        )
+
+        # reply: key=query key, column _pw_index_reply = ((match_key, score),...)
+        def with_rank(r):
+            return tuple((k, s, i) for i, (k, s) in enumerate(r))
+
+        ranked = reply.select(
+            _pw_matches=ex.ApplyExpression(with_rank, None,
+                                           reply._pw_index_reply))
+        flat = ranked.flatten(ranked._pw_matches, origin_id="_pw_query_id")
+        flat = flat.select(
+            _pw_query_id=flat._pw_query_id,
+            _pw_match_id=flat._pw_matches[0],
+            _pw_score=flat._pw_matches[1],
+            _pw_rank=flat._pw_matches[2],
+        )
+        matched = data.ix(flat._pw_match_id, context=flat)
+
+        data_cols = {
+            name: matched[name] for name in data.column_names()
+        }
+        if not collapse_rows:
+            out = flat.select(
+                query_id=flat._pw_query_id,
+                _pw_index_reply_score=flat._pw_score,
+                _pw_index_reply_id=flat._pw_match_id,
+                **data_cols,
+            )
+            return out
+
+        # collapse into per-query tuples ordered by rank
+        import pathway_tpu.internals.reducers_frontend as reducers
+
+        per_match = flat.select(
+            flat._pw_query_id, flat._pw_rank, flat._pw_score,
+            flat._pw_match_id, **data_cols)
+        agg = {
+            "_pw_index_reply_score": reducers.sorted_tuple(
+                ex.MakeTupleExpression(per_match._pw_rank, per_match._pw_score)),
+            "_pw_index_reply_id": reducers.sorted_tuple(
+                ex.MakeTupleExpression(per_match._pw_rank, per_match._pw_match_id)),
+        }
+        for name in data.column_names():
+            agg[name] = reducers.sorted_tuple(
+                ex.MakeTupleExpression(per_match._pw_rank, per_match[name]))
+        grouped = per_match.groupby(id=per_match._pw_query_id).reduce(**agg)
+
+        def strip(t):
+            return tuple(v for _, v in t)
+
+        final_cols = {}
+        for name in list(agg.keys()):
+            final_cols[name] = ex.ApplyExpression(strip, None, grouped[name])
+        result = grouped.select(**final_cols)
+        # queries with zero matches: give empty tuples (left outer against queries)
+        padded = query_table.select(
+            **{name: () for name in final_cols}
+        ).update_cells(result.promise_universe_is_subset_of(query_table))
+        return padded

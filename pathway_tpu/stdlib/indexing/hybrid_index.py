@@ -1,0 +1,80 @@
+"""Hybrid retrieval: reciprocal-rank fusion of several indexes
+(reference: stdlib/indexing/hybrid_index.py — HybridIndex/HybridIndexFactory)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.table import Table
+
+
+class HybridDataIndex:
+    def __init__(self, data_table: Table, indexes: list, *, k: int = 60):
+        self.data_table = data_table
+        self.indexes = indexes
+        self.k = k
+
+    def query_as_of_now(self, query_column, *, number_of_matches=3,
+                        collapse_rows: bool = True, metadata_filter=None,
+                        **kw) -> Table:
+        results = [
+            idx.query_as_of_now(
+                query_column, number_of_matches=number_of_matches,
+                collapse_rows=True, metadata_filter=metadata_filter)
+            for idx in self.indexes
+        ]
+        k_rrf = self.k
+
+        id_cols = [r._pw_index_reply_id for r in results]
+
+        def fuse(*reply_id_tuples):
+            scores: dict = {}
+            for reply in reply_id_tuples:
+                for rank, key in enumerate(reply or ()):
+                    scores[key] = scores.get(key, 0.0) + 1.0 / (k_rrf + rank + 1)
+            ranked = sorted(scores.items(), key=lambda kv: -kv[1])
+            return tuple((key, score) for key, score in ranked)
+
+        base = results[0]
+        fused = base.select(
+            _pw_fused=ex.ApplyExpression(fuse, None, *id_cols))
+
+        data = self.data_table
+
+        def with_rank(r):
+            return tuple((key, s, i) for i, (key, s) in enumerate(r))
+
+        ranked_t = fused.select(
+            _pw_matches=ex.ApplyExpression(with_rank, None, fused._pw_fused))
+        flat = ranked_t.flatten(ranked_t._pw_matches, origin_id="_pw_query_id")
+        flat = flat.select(
+            _pw_query_id=flat._pw_query_id,
+            _pw_match_id=flat._pw_matches[0],
+            _pw_score=flat._pw_matches[1],
+            _pw_rank=flat._pw_matches[2],
+        )
+        matched = data.ix(flat._pw_match_id, context=flat)
+        import pathway_tpu.internals.reducers_frontend as reducers
+
+        per_match = flat.select(
+            flat._pw_query_id, flat._pw_rank, flat._pw_score, flat._pw_match_id,
+            **{n: matched[n] for n in data.column_names()})
+        agg = {
+            "_pw_index_reply_score": reducers.sorted_tuple(
+                ex.MakeTupleExpression(per_match._pw_rank, per_match._pw_score)),
+            "_pw_index_reply_id": reducers.sorted_tuple(
+                ex.MakeTupleExpression(per_match._pw_rank, per_match._pw_match_id)),
+        }
+        for n in data.column_names():
+            agg[n] = reducers.sorted_tuple(
+                ex.MakeTupleExpression(per_match._pw_rank, per_match[n]))
+        grouped = per_match.groupby(id=per_match._pw_query_id).reduce(**agg)
+
+        def strip(t):
+            return tuple(v for _, v in t)
+
+        out_cols = {n: ex.ApplyExpression(strip, None, grouped[n]) for n in agg}
+        result = grouped.select(**out_cols)
+        query_table = query_column.table
+        return query_table.select(
+            **{n: () for n in out_cols}
+        ).update_cells(result.promise_universe_is_subset_of(query_table))

@@ -1,0 +1,99 @@
+"""KNN inner indexes (reference: stdlib/indexing/nearest_neighbors.py —
+BruteForceKnn:141, USearchKnn:48, LshKnn:221).
+
+All variants run on the TPU brute-force slab (ops/knn.py): exact search at
+matmul speed supersedes the reference's approximate engines at these scales
+(USearch HNSW / LSH exist in the reference to avoid CPU O(N·d) scans; one
+MXU matmul over an HBM slab makes the exact scan the fast path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
+
+
+class BruteForceKnnMetricKind:
+    L2SQ = KnnMetric.L2SQ
+    COS = KnnMetric.COS
+
+
+@dataclass
+class BruteForceKnnFactory:
+    """Engine-side index factory (reference: ExternalIndexFactory,
+    src/external_integration/mod.rs:46 — one instance per worker)."""
+
+    dimensions: int | None = None
+    reserved_space: int = 1024
+    metric: KnnMetric = KnnMetric.L2SQ
+    embedder: Any = None
+
+    def build(self) -> BruteForceKnnIndex:
+        dim = self.dimensions
+        if dim is None:
+            dim = _probe_embedder_dimension(self.embedder)
+        return BruteForceKnnIndex(
+            dim, reserved_space=self.reserved_space, metric=self.metric)
+
+
+def _probe_embedder_dimension(embedder) -> int:
+    if embedder is None:
+        raise ValueError("dimensions required when no embedder is given")
+    from pathway_tpu.xpacks.llm._utils import get_embedding_dimension
+
+    return get_embedding_dimension(embedder)
+
+
+class BruteForceKnn(InnerIndex):
+    def __init__(self, data_column: ex.ColumnReference,
+                 metadata_column: ex.ColumnExpression | None = None, *,
+                 dimensions: int | None = None, reserved_space: int = 1024,
+                 metric: KnnMetric = KnnMetric.L2SQ, embedder: Any = None):
+        super().__init__(data_column, metadata_column)
+        self.dimensions = dimensions
+        self.reserved_space = reserved_space
+        self.metric = metric
+        self.embedder = embedder
+
+    def factory(self) -> BruteForceKnnFactory:
+        return BruteForceKnnFactory(
+            dimensions=self.dimensions, reserved_space=self.reserved_space,
+            metric=self.metric, embedder=self.embedder)
+
+    @property
+    def query_embedder(self):
+        return self.embedder
+
+
+class USearchKnn(BruteForceKnn):
+    """API-compatible with the reference's USearchKnn (HNSW); executes as the
+    exact TPU scan (recall = 1.0 by construction)."""
+
+    def __init__(self, data_column, metadata_column=None, *, dimensions=None,
+                 reserved_space: int = 1024, metric=KnnMetric.COS,
+                 connectivity: int = 0, expansion_add: int = 0,
+                 expansion_search: int = 0, embedder=None):
+        if isinstance(metric, str):
+            metric = {"cos": KnnMetric.COS, "l2sq": KnnMetric.L2SQ}.get(
+                metric.lower(), KnnMetric.COS)
+        super().__init__(data_column, metadata_column, dimensions=dimensions,
+                         reserved_space=reserved_space, metric=metric,
+                         embedder=embedder)
+
+
+class LshKnn(BruteForceKnn):
+    """API-compatible with the reference's LshKnn (random-projection LSH,
+    stdlib/ml/classifiers/_knn_lsh.py); executes as the exact TPU scan."""
+
+    def __init__(self, data_column, metadata_column=None, *, dimensions=None,
+                 n_or: int = 20, n_and: int = 10, bucket_length: float = 10.0,
+                 distance_type: str = "euclidean", reserved_space: int = 1024,
+                 embedder=None):
+        metric = KnnMetric.COS if distance_type == "cosine" else KnnMetric.L2SQ
+        super().__init__(data_column, metadata_column, dimensions=dimensions,
+                         reserved_space=reserved_space, metric=metric,
+                         embedder=embedder)

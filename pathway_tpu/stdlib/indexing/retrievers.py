@@ -1,0 +1,102 @@
+"""Retriever factory API used by the LLM xpack's vector store
+(reference: stdlib/indexing — factory classes consumed by
+VectorStoreServer(retriever_factory=...))."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from pathway_tpu.ops.knn import KnnMetric
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    LshKnn,
+    USearchKnn,
+)
+
+
+class AbstractRetrieverFactory:
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        raise NotImplementedError
+
+
+@dataclass
+class BruteForceKnnFactory(AbstractRetrieverFactory):
+    dimensions: int | None = None
+    reserved_space: int = 1024
+    metric: KnnMetric = KnnMetric.COS
+    embedder: Any = None
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        inner = BruteForceKnn(
+            data_column, metadata_column, dimensions=self.dimensions,
+            reserved_space=self.reserved_space, metric=self.metric,
+            embedder=self.embedder)
+        return DataIndex(data_table, inner)
+
+
+@dataclass
+class UsearchKnnFactory(AbstractRetrieverFactory):
+    dimensions: int | None = None
+    reserved_space: int = 1024
+    metric: KnnMetric = KnnMetric.COS
+    connectivity: int = 0
+    expansion_add: int = 0
+    expansion_search: int = 0
+    embedder: Any = None
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        inner = USearchKnn(
+            data_column, metadata_column, dimensions=self.dimensions,
+            reserved_space=self.reserved_space, metric=self.metric,
+            embedder=self.embedder)
+        return DataIndex(data_table, inner)
+
+
+@dataclass
+class LshKnnFactory(AbstractRetrieverFactory):
+    dimensions: int | None = None
+    n_or: int = 20
+    n_and: int = 10
+    bucket_length: float = 10.0
+    distance_type: str = "euclidean"
+    embedder: Any = None
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        inner = LshKnn(data_column, metadata_column, dimensions=self.dimensions,
+                       n_or=self.n_or, n_and=self.n_and,
+                       bucket_length=self.bucket_length,
+                       distance_type=self.distance_type, embedder=self.embedder)
+        return DataIndex(data_table, inner)
+
+
+@dataclass
+class TantivyBM25Factory(AbstractRetrieverFactory):
+    ram_budget: int = 50_000_000
+    in_memory_index: bool = True
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        inner = TantivyBM25(data_column, metadata_column,
+                            ram_budget=self.ram_budget,
+                            in_memory_index=self.in_memory_index)
+        return DataIndex(data_table, inner)
+
+
+@dataclass
+class HybridIndexFactory(AbstractRetrieverFactory):
+    """Reciprocal-rank-fusion over several retrievers
+    (reference: stdlib/indexing/hybrid_index.py)."""
+
+    retriever_factories: list
+    k: int = 60
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        from pathway_tpu.stdlib.indexing.hybrid_index import HybridDataIndex
+
+        indexes = [
+            f.build_index(data_column, data_table, metadata_column)
+            for f in self.retriever_factories
+        ]
+        return HybridDataIndex(data_table, indexes, k=self.k)

@@ -1,0 +1,43 @@
+"""Sorted-structure helpers (reference: stdlib/indexing/sorting.py, 230 LoC)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.table import Table
+
+
+def sort_from_index(table: Table, key, instance=None) -> Table:
+    return table.sort(key, instance=instance)
+
+
+def retrieve_prev_next_values(ordered_table: Table,
+                              value: ex.ColumnReference | None = None) -> Table:
+    """For a table with prev/next pointer columns (output of Table.sort) and
+    an optional value column: fetch the nearest non-None value looking
+    backward (prev_value) and forward (next_value)."""
+    if value is None:
+        prev_row = ordered_table.ix(ordered_table.prev, optional=True,
+                                    context=ordered_table)
+        next_row = ordered_table.ix(ordered_table.next, optional=True,
+                                    context=ordered_table)
+        return ordered_table.select(
+            prev_value=prev_row.prev, next_value=next_row.next)
+    table = value.table
+    prev_row = table.ix(ordered_table.prev, optional=True, context=ordered_table)
+    next_row = table.ix(ordered_table.next, optional=True, context=ordered_table)
+    return ordered_table.select(
+        prev_value=prev_row[value.name],
+        next_value=next_row[value.name],
+    )
+
+
+def binsearch_oracle(*args, **kwargs):
+    raise NotImplementedError("binsearch trees arrive with the sorting pass")
+
+
+def prefix_sum_oracle(*args, **kwargs):
+    raise NotImplementedError("prefix-sum oracle arrives with the sorting pass")
+
+
+def filter_smallest_k(column: ex.ColumnReference, instance, ks_table):
+    raise NotImplementedError("filter_smallest_k arrives with the sorting pass")

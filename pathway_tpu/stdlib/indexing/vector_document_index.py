@@ -1,0 +1,60 @@
+"""Sugar factories for document indexes
+(reference: stdlib/indexing/vector_document_index.py:34-210)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.table import Table
+from pathway_tpu.ops.knn import KnnMetric
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    LshKnn,
+    USearchKnn,
+)
+
+
+def default_vector_document_index(
+        data_column: ex.ColumnReference, data_table: Table, *,
+        embedder: Any = None, dimensions: int | None = None,
+        metadata_column: ex.ColumnExpression | None = None) -> DataIndex:
+    return default_brute_force_knn_document_index(
+        data_column, data_table, embedder=embedder, dimensions=dimensions,
+        metadata_column=metadata_column)
+
+
+def default_brute_force_knn_document_index(
+        data_column: ex.ColumnReference, data_table: Table, *,
+        embedder: Any = None, dimensions: int | None = None,
+        reserved_space: int = 1024, metric: KnnMetric = KnnMetric.COS,
+        metadata_column: ex.ColumnExpression | None = None) -> DataIndex:
+    inner = BruteForceKnn(
+        data_column, metadata_column, dimensions=dimensions,
+        reserved_space=reserved_space, metric=metric, embedder=embedder)
+    return DataIndex(data_table, inner)
+
+
+def default_usearch_knn_document_index(
+        data_column: ex.ColumnReference, data_table: Table, *,
+        embedder: Any = None, dimensions: int | None = None,
+        reserved_space: int = 1024, metric: KnnMetric = KnnMetric.COS,
+        connectivity: int = 0, expansion_add: int = 0,
+        expansion_search: int = 0,
+        metadata_column: ex.ColumnExpression | None = None) -> DataIndex:
+    inner = USearchKnn(
+        data_column, metadata_column, dimensions=dimensions,
+        reserved_space=reserved_space, metric=metric,
+        connectivity=connectivity, expansion_add=expansion_add,
+        expansion_search=expansion_search, embedder=embedder)
+    return DataIndex(data_table, inner)
+
+
+def default_lsh_knn_document_index(
+        data_column: ex.ColumnReference, data_table: Table, *,
+        embedder: Any = None, dimensions: int | None = None,
+        metadata_column: ex.ColumnExpression | None = None) -> DataIndex:
+    inner = LshKnn(data_column, metadata_column, dimensions=dimensions,
+                   embedder=embedder)
+    return DataIndex(data_table, inner)

@@ -1,0 +1,4 @@
+from pathway_tpu.stdlib.ml import classifiers, index  # noqa: F401
+from pathway_tpu.stdlib.ml.index import KNNIndex  # noqa: F401
+
+__all__ = ["KNNIndex", "classifiers", "index"]

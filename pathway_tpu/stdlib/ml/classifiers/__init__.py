@@ -1,0 +1,51 @@
+"""kNN classifiers (reference: stdlib/ml/classifiers/ — _knn_lsh.py, _lsh.py).
+
+The reference trains LSH projections and classifies via bucketed voting;
+here classification queries ride the exact TPU KNN index.
+"""
+
+from __future__ import annotations
+
+import pathway_tpu.internals.reducers_frontend as reducers
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+
+def knn_lsh_classifier_train(data: Table, L: int = 20, type: str = "euclidean",
+                             **lsh_params):
+    """Returns a classify(queries, k) function closed over the trained index
+    (reference: classifiers/_knn_lsh.py:135 knn_lsh_classifier_train)."""
+    n_dim = lsh_params.get("d") or lsh_params.get("n_dimensions")
+
+    index = KNNIndex(data.data, data, n_dimensions=n_dim,
+                     distance_type="cosine" if type == "cosine" else "euclidean")
+
+    def classify(queries: Table, k: int = 3) -> Table:
+        matched = index.get_nearest_items(queries.data, k=k)
+        labels = matched.select(predicted_label=ex.ApplyExpression(
+            _majority, None, matched.label))
+        return labels
+
+    return classify
+
+
+def _majority(labels):
+    if not labels:
+        return None
+    counts: dict = {}
+    for l in labels:
+        counts[l] = counts.get(l, 0) + 1
+    return max(counts.items(), key=lambda kv: (kv[1], str(kv[0])))[0]
+
+
+def knn_lsh_euclidean_classifier_train(data: Table, d: int, M: int, L: int, A: float):
+    return knn_lsh_classifier_train(data, L, "euclidean", d=d, M=M, A=A)
+
+
+def knn_lsh_generic_classifier_train(data: Table, lsh_projection, distance_function, L: int):
+    return knn_lsh_classifier_train(data, L)
+
+
+def knn_lsh_classify(classifier, queries: Table, k: int = 3) -> Table:
+    return classifier(queries, k)

@@ -1,0 +1,60 @@
+"""Classic KNNIndex API (reference: stdlib/ml/index.py:9-52).
+
+The reference backs this with LSH bucketing + a per-row numpy UDF
+(classifiers/_knn_lsh.py:135-290); here every variant runs on the exact
+TPU brute-force slab (ops/knn.py) — the per-row numpy UDF becomes one
+batched MXU dispatch, which is the whole point of the TPU build
+(SURVEY §2.3 'ml stdlib' note).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.table import Table
+from pathway_tpu.ops.knn import KnnMetric
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnn
+
+
+class KNNIndex:
+    def __init__(self, data_embedding: ex.ColumnReference, data: Table, *,
+                 n_dimensions: int, n_or: int = 20, n_and: int = 10,
+                 bucket_length: float = 10.0, distance_type: str = "euclidean",
+                 metadata: ex.ColumnExpression | None = None):
+        metric = KnnMetric.COS if distance_type == "cosine" else KnnMetric.L2SQ
+        inner = BruteForceKnn(
+            data_embedding, metadata, dimensions=n_dimensions,
+            metric=metric)
+        self._index = DataIndex(data, inner)
+        self._data = data
+
+    def get_nearest_items(self, query_embedding: ex.ColumnReference, k=3, *,
+                          collapse_rows: bool = True,
+                          with_distances: bool = False,
+                          metadata_filter: ex.ColumnExpression | None = None) -> Table:
+        result = self._index.query(
+            query_embedding, number_of_matches=k, collapse_rows=collapse_rows,
+            metadata_filter=metadata_filter)
+        return self._shape_result(result, collapse_rows, with_distances)
+
+    def get_nearest_items_asof_now(self, query_embedding: ex.ColumnReference,
+                                   k=3, *, collapse_rows: bool = True,
+                                   with_distances: bool = False,
+                                   metadata_filter=None) -> Table:
+        result = self._index.query_as_of_now(
+            query_embedding, number_of_matches=k, collapse_rows=collapse_rows,
+            metadata_filter=metadata_filter)
+        return self._shape_result(result, collapse_rows, with_distances)
+
+    def _shape_result(self, result: Table, collapse_rows: bool,
+                      with_distances: bool) -> Table:
+        names = [n for n in self._data.column_names()]
+        keep = list(names)
+        if with_distances:
+            rename = {"_pw_index_reply_score": "dist"}
+            return result.select(
+                dist=result._pw_index_reply_score,
+                **{n: result[n] for n in keep})
+        return result.select(**{n: result[n] for n in keep})

@@ -1,0 +1,719 @@
+"""Temporal stdlib: windows, behaviors, asof/interval/window joins.
+
+Rebuild of reference stdlib/temporal (5,536 LoC: _window.py:599-869 windows,
+interval_join.py, asof_join.py, _asof_now_join.py, temporal_behavior.py).
+Window assignment is a per-row flatten onto (start, end) window instances,
+then an ordinary incremental groupby — behaviors compile to the engine's
+buffer/forget/freeze watermark operators (engine/temporal_ops.py), exactly
+like the reference compiles them to time_column.rs operators.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.table import Table
+
+__all__ = [
+    "Window", "tumbling", "sliding", "session", "intervals_over",
+    "CommonBehavior", "common_behavior", "exactly_once_behavior",
+    "windowby", "asof_join", "asof_join_left", "asof_join_right",
+    "asof_join_outer", "asof_now_join", "asof_now_join_left",
+    "interval", "interval_join", "interval_join_left", "interval_join_right",
+    "interval_join_outer", "window_join", "Direction",
+]
+
+
+# ---------------------------------------------------------------------------
+# behaviors (reference: temporal_behavior.py:29-113)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CommonBehavior:
+    delay: Any = None
+    cutoff: Any = None
+    keep_results: bool = True
+
+
+def common_behavior(delay=None, cutoff=None, keep_results: bool = True) -> CommonBehavior:
+    return CommonBehavior(delay, cutoff, keep_results)
+
+
+@dataclass
+class ExactlyOnceBehavior:
+    shift: Any = None
+
+
+def exactly_once_behavior(shift=None) -> ExactlyOnceBehavior:
+    return ExactlyOnceBehavior(shift)
+
+
+# ---------------------------------------------------------------------------
+# window definitions (reference: _window.py)
+# ---------------------------------------------------------------------------
+
+class Window:
+    def assign(self, t) -> list[tuple]:
+        raise NotImplementedError
+
+
+@dataclass
+class TumblingWindow(Window):
+    duration: Any
+    origin: Any = None
+    offset: Any = None
+
+    def assign(self, t):
+        origin = self.origin if self.origin is not None else (
+            self.offset if self.offset is not None else _zero_like(t))
+        k = _floor_div(t - origin, self.duration)
+        start = origin + k * self.duration
+        return [(start, start + self.duration)]
+
+
+@dataclass
+class SlidingWindow(Window):
+    hop: Any
+    duration: Any
+    origin: Any = None
+    offset: Any = None
+
+    def assign(self, t):
+        origin = self.origin if self.origin is not None else (
+            self.offset if self.offset is not None else _zero_like(t))
+        out = []
+        # windows [start, start+duration) with start = origin + i*hop covering t
+        first = _floor_div(t - origin - self.duration, self.hop) + 1
+        i = first
+        while True:
+            start = origin + i * self.hop
+            if start > t:
+                break
+            if t < start + self.duration:
+                out.append((start, start + self.duration))
+            i += 1
+        return out
+
+
+@dataclass
+class SessionWindow(Window):
+    predicate: Any = None
+    max_gap: Any = None
+
+
+@dataclass
+class IntervalsOverWindow(Window):
+    at: Table
+    lower_bound: Any
+    upper_bound: Any
+    is_outer: bool = False
+
+
+def tumbling(duration, origin=None, offset=None) -> TumblingWindow:
+    return TumblingWindow(duration, origin, offset)
+
+
+def sliding(hop, duration=None, ratio: int | None = None, origin=None,
+            offset=None) -> SlidingWindow:
+    if duration is None and ratio is not None:
+        duration = hop * ratio
+    return SlidingWindow(hop, duration, origin, offset)
+
+
+def session(*, predicate=None, max_gap=None) -> SessionWindow:
+    if (predicate is None) == (max_gap is None):
+        raise ValueError("session() needs exactly one of predicate= / max_gap=")
+    return SessionWindow(predicate, max_gap)
+
+
+def intervals_over(*, at: Table, lower_bound, upper_bound,
+                   is_outer: bool = False) -> IntervalsOverWindow:
+    return IntervalsOverWindow(at, lower_bound, upper_bound, is_outer)
+
+
+def _zero_like(t):
+    import datetime
+
+    import pandas as pd
+
+    if isinstance(t, (pd.Timestamp, datetime.datetime)):
+        return pd.Timestamp(0)
+    return 0
+
+
+def _floor_div(a, b):
+    import pandas as pd
+
+    if isinstance(a, pd.Timedelta):
+        return int(a // b)
+    return a // b
+
+
+# ---------------------------------------------------------------------------
+# windowby (reference: _window.py windowby + WindowedTable)
+# ---------------------------------------------------------------------------
+
+class WindowedTable:
+    """Result of windowby: reduce() groups rows per (instance, window)."""
+
+    def __init__(self, windowed: Table, instance_used: bool):
+        self._windowed = windowed
+        self._instance_used = instance_used
+
+    def reduce(self, *args, **kwargs) -> Table:
+        t = self._windowed
+        by = [t["_pw_window"], t["_pw_window_start"], t["_pw_window_end"]]
+        if self._instance_used:
+            by.append(t["_pw_instance"])
+        grouped = t.groupby(*by)
+
+        def fix(e):
+            return thisclass.resolve_this({"this": t}, ex.wrap_arg(e))
+
+        new_args = [fix(a) for a in args]
+        new_kwargs = {k: fix(v) for k, v in kwargs.items()}
+        return grouped.reduce(*new_args, **new_kwargs)
+
+
+def windowby(table: Table, time_expr, *, window: Window, behavior=None,
+             instance=None, origin=None) -> WindowedTable:
+    time_e = table._resolve(ex.wrap_arg(time_expr))
+    instance_used = instance is not None
+    inst_e = table._resolve(ex.wrap_arg(instance)) if instance_used else None
+
+    if isinstance(window, SessionWindow):
+        windowed = _assign_session_windows(table, time_e, window, inst_e)
+    elif isinstance(window, IntervalsOverWindow):
+        windowed = _assign_intervals_over(table, time_e, window, inst_e)
+    else:
+        assign = window.assign
+
+        def windows_of(t):
+            if t is None:
+                return ()
+            return tuple(assign(t))
+
+        with_windows = table.with_columns(
+            _pw_windows=ex.ApplyExpression(windows_of, None, time_e),
+            _pw_time=time_e,
+            **({"_pw_instance": inst_e} if instance_used else {}),
+        )
+        flat = with_windows.flatten(with_windows._pw_windows)
+        windowed = flat.with_columns(
+            _pw_window_start=flat._pw_windows[0],
+            _pw_window_end=flat._pw_windows[1],
+            _pw_window=ex.MakeTupleExpression(
+                *( [flat._pw_instance] if instance_used else [] ),
+                flat._pw_windows[0], flat._pw_windows[1]),
+        ).without("_pw_windows")
+
+    if behavior is not None:
+        windowed = _apply_behavior(windowed, behavior)
+    return WindowedTable(windowed, instance_used)
+
+
+def _apply_behavior(windowed: Table, behavior) -> Table:
+    if isinstance(behavior, ExactlyOnceBehavior):
+        shift = behavior.shift
+        thr = windowed._pw_window_end if shift is None else (
+            windowed._pw_window_end + shift)
+        out = windowed._buffer(thr, windowed._pw_time)
+        out = out._forget(thr, out._pw_time, mark_forgetting_records=False)
+        return out._filter_out_results_of_forgetting()
+    if isinstance(behavior, CommonBehavior):
+        out = windowed
+        if behavior.delay is not None:
+            out = out._buffer(out._pw_window_start + behavior.delay, out._pw_time)
+        if behavior.cutoff is not None:
+            out = out._forget(out._pw_window_end + behavior.cutoff, out._pw_time)
+            if behavior.keep_results:
+                out = out._filter_out_results_of_forgetting()
+        return out
+    raise TypeError(f"unknown behavior {behavior!r}")
+
+
+def _assign_session_windows(table: Table, time_e, window: SessionWindow,
+                            inst_e) -> Table:
+    """Sessions via per-instance sorted sweep: collect (time,key) tuples per
+    instance, split where gap/predicate breaks, emit per-key window bounds."""
+    base = table.with_columns(
+        _pw_time=time_e,
+        _pw_instance=inst_e if inst_e is not None else 0,
+    )
+    pred = window.predicate
+    max_gap = window.max_gap
+
+    import pathway_tpu.internals.reducers_frontend as reducers
+
+    per_inst = base.groupby(base._pw_instance).reduce(
+        base._pw_instance,
+        _pw_items=reducers.sorted_tuple(
+            ex.MakeTupleExpression(base._pw_time, base.id)),
+    )
+
+    def sessions(items):
+        out = []
+        cur: list = []
+        last_t = None
+        for t, key in items:
+            if cur:
+                joined = (pred(last_t, t) if pred is not None
+                          else (t - last_t) <= max_gap)
+                if not joined:
+                    out.append(tuple(cur))
+                    cur = []
+            cur.append((t, key))
+            last_t = t
+        if cur:
+            out.append(tuple(cur))
+        result = []
+        for sess in out:
+            start = sess[0][0]
+            end = sess[-1][0]
+            for t, key in sess:
+                result.append((key, start, end))
+        return tuple(result)
+
+    assignments = per_inst.select(
+        per_inst._pw_instance,
+        _pw_assign=ex.ApplyExpression(sessions, None, per_inst._pw_items),
+    )
+    flat = assignments.flatten(assignments._pw_assign)
+    keyed = flat.select(
+        _pw_key=flat._pw_assign[0],
+        _pw_window_start=flat._pw_assign[1],
+        _pw_window_end=flat._pw_assign[2],
+        _pw_instance=flat._pw_instance,
+    ).with_id(thisclass.this._pw_key)
+    src = table.with_columns(_pw_time=time_e)
+    joined = keyed.with_universe_of(src)
+    out = src.with_columns(
+        _pw_window_start=joined._pw_window_start,
+        _pw_window_end=joined._pw_window_end,
+        _pw_instance=joined._pw_instance,
+    )
+    return out.with_columns(
+        _pw_window=ex.MakeTupleExpression(
+            out._pw_instance, out._pw_window_start, out._pw_window_end),
+    )
+
+
+def _assign_intervals_over(table: Table, time_e, window: IntervalsOverWindow,
+                           inst_e) -> Table:
+    """intervals_over: for each row of `at`, a window
+    [at+lower_bound, at+upper_bound] gathering source rows."""
+    at = window.at
+    at_col = at.column_names()[0]
+    lb, ub = window.lower_bound, window.upper_bound
+    src = table.with_columns(
+        _pw_time=time_e,
+        _pw_instance=inst_e if inst_e is not None else 0,
+    )
+
+    # cross join via instance bucket (intervals_over is generally small `at`)
+    at_t = at.select(_pw_at=at[at_col]).with_columns(_pw_join_key=0)
+    src_k = src.with_columns(_pw_join_key=0)
+    pairs = src_k.join(
+        at_t, src_k._pw_join_key == at_t._pw_join_key
+    ).select(
+        *[src_k[n] for n in table.column_names()],
+        _pw_time=src_k._pw_time,
+        _pw_instance=src_k._pw_instance,
+        _pw_at=at_t._pw_at,
+    )
+    inside = pairs.filter(
+        (pairs._pw_time >= pairs._pw_at + lb) & (pairs._pw_time <= pairs._pw_at + ub)
+    )
+    return inside.with_columns(
+        _pw_window_start=inside._pw_at + lb,
+        _pw_window_end=inside._pw_at + ub,
+        _pw_window=ex.MakeTupleExpression(
+            inside._pw_instance, inside._pw_at),
+    )
+
+
+# ---------------------------------------------------------------------------
+# asof_now_join (reference: _asof_now_join.py — query-against-live-state)
+# ---------------------------------------------------------------------------
+
+def asof_now_join(left: Table, right: Table, *on, how: str = "inner", id=None,
+                  left_instance=None, right_instance=None):
+    """Left side behaves as a one-shot query stream: each left row is joined
+    against the right state as of its arrival and never updated."""
+    if how not in ("inner", "left"):
+        raise ValueError("asof_now_join supports how='inner'|'left'")
+    forgetting = left._forget_immediately()
+    # column references on `left` must resolve against the forgetting table
+    fixed_on = []
+    for cond in on:
+        fixed_on.append(_replace_table(cond, left, forgetting))
+    jr = forgetting.join(right, *fixed_on, how=how,
+                         id=_replace_table(id, left, forgetting) if id is not None else None,
+                         left_instance=left_instance, right_instance=right_instance)
+    return _AsofNowJoinResult(jr, left, forgetting)
+
+
+class _AsofNowJoinResult:
+    def __init__(self, join_result, original_left, forgetting):
+        self._jr = join_result
+        self._orig = original_left
+        self._forgetting = forgetting
+
+    def select(self, *args, **kwargs) -> Table:
+        args = [_replace_table(a, self._orig, self._forgetting) for a in args]
+        kwargs = {k: _replace_table(v, self._orig, self._forgetting)
+                  for k, v in kwargs.items()}
+        result = self._jr.select(*args, **kwargs)
+        return result._filter_out_results_of_forgetting()
+
+
+def asof_now_join_left(left, right, *on, **kw):
+    return asof_now_join(left, right, *on, how="left", **kw)
+
+
+def _replace_table(expr, old: Table, new: Table):
+    from pathway_tpu.internals.expression_utils import map_expression
+
+    if expr is None or not isinstance(expr, ex.ColumnExpression):
+        return expr
+
+    def mapper(e):
+        if isinstance(e, ex.IdExpression) and e.table is old:
+            return ex.IdExpression(new)
+        if isinstance(e, ex.ColumnReference) and e.table is old:
+            return ex.ColumnReference(new, e.name)
+        return None
+
+    return map_expression(expr, mapper)
+
+
+# ---------------------------------------------------------------------------
+# asof_join (reference: asof_join.py, 1,110 LoC)
+# ---------------------------------------------------------------------------
+
+class Direction:
+    BACKWARD = "backward"
+    FORWARD = "forward"
+    NEAREST = "nearest"
+
+
+def asof_join(left: Table, right: Table, t_left, t_right, *on,
+              how: str = "inner", defaults: dict | None = None,
+              direction: str | None = None) -> "_AsofJoinResult":
+    return _AsofJoinResult(left, right,
+                           left._resolve(ex.wrap_arg(t_left)),
+                           thisclass.resolve_this({"this": right}, ex.wrap_arg(t_right)),
+                           list(on), how, defaults or {},
+                           direction or Direction.BACKWARD)
+
+
+def asof_join_left(left, right, t_left, t_right, *on, **kw):
+    kw["how"] = "left"
+    return asof_join(left, right, t_left, t_right, *on, **kw)
+
+
+def asof_join_right(left, right, t_left, t_right, *on, **kw):
+    kw["how"] = "right"
+    return asof_join(left, right, t_left, t_right, *on, **kw)
+
+
+def asof_join_outer(left, right, t_left, t_right, *on, **kw):
+    kw["how"] = "outer"
+    return asof_join(left, right, t_left, t_right, *on, **kw)
+
+
+class _AsofJoinResult:
+    """For each left row: the latest right row with t_right <= t_left
+    (direction backward; forward/nearest analogous), within the on-equality
+    groups. Implemented with the engine's join + argmax reducer + ix —
+    incremental end to end."""
+
+    def __init__(self, left, right, t_left, t_right, on, how, defaults, direction):
+        self._left = left
+        self._right = right
+        self._tl = t_left
+        self._tr = t_right
+        self._on = on
+        self._how = how
+        self._defaults = defaults
+        self._direction = direction
+
+    def select(self, *args, **kwargs) -> Table:
+        import pathway_tpu.internals.reducers_frontend as reducers
+
+        left, right = self._left, self._right
+        lt = left.with_columns(_pw_t=self._tl)
+        rt = right.with_columns(_pw_t=self._tr)
+        on = [_replace_table(_replace_table(c, left, lt), right, rt)
+              for c in self._on]
+        if not on:
+            lt = lt.with_columns(_pw_onk=0)
+            rt = rt.with_columns(_pw_onk=0)
+            on = [lt._pw_onk == rt._pw_onk]
+        pairs = lt.join(rt, *on).select(
+            _pw_lid=lt.id, _pw_rid=rt.id, _pw_lt=lt._pw_t, _pw_rt=rt._pw_t,
+        )
+        if self._direction == Direction.BACKWARD:
+            valid = pairs.filter(pairs._pw_rt <= pairs._pw_lt)
+            score = valid._pw_rt
+            pick = reducers.argmax(score)
+        elif self._direction == Direction.FORWARD:
+            valid = pairs.filter(pairs._pw_rt >= pairs._pw_lt)
+            pick = reducers.argmin(valid._pw_rt)
+        else:
+            valid = pairs.with_columns(
+                _pw_dist=ex.if_else(pairs._pw_rt >= pairs._pw_lt,
+                                    pairs._pw_rt - pairs._pw_lt,
+                                    pairs._pw_lt - pairs._pw_rt))
+            pick = reducers.argmin(valid._pw_dist)
+        best = valid.groupby(valid._pw_lid).reduce(
+            valid._pw_lid,
+            _pw_best=ex.ReducerExpression(
+                "argmin" if self._direction != Direction.BACKWARD else "argmax",
+                valid._pw_dist if self._direction == Direction.NEAREST
+                else valid._pw_rt,
+                valid._pw_rid),
+        ).with_id(thisclass.this._pw_lid)
+        matched = best.with_universe_of(left)
+        rmatch = right.ix(matched._pw_best, optional=(self._how in ("left", "outer")),
+                          context=matched)
+
+        # build output
+        out_kwargs: dict[str, ex.ColumnExpression] = {}
+        for arg in args:
+            if isinstance(arg, ex.ColumnReference):
+                out_kwargs[arg.name] = arg
+            elif isinstance(arg, thisclass.ThisRef):
+                for n in left.column_names():
+                    out_kwargs[n] = left[n]
+        out_kwargs.update(kwargs)
+
+        def fix(e):
+            e = thisclass.resolve_this(
+                {"left": left, "right": right, "this": left}, ex.wrap_arg(e))
+            return _replace_table(e, right, rmatch)
+
+        fixed = {k: fix(v) for k, v in out_kwargs.items()}
+        base = left if self._how in ("inner", "left") else left
+        result = base.select(**fixed)
+        if self._how == "inner":
+            result = result.restrict(best) if False else result.intersect(best)
+        return result
+
+
+# ---------------------------------------------------------------------------
+# interval_join (reference: interval_join.py, 1,619 LoC)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Interval:
+    lower_bound: Any
+    upper_bound: Any
+
+
+def interval(lower_bound, upper_bound) -> Interval:
+    return Interval(lower_bound, upper_bound)
+
+
+def interval_join(left: Table, right: Table, t_left, t_right, intrvl, *on,
+                  how: str = "inner", behavior=None):
+    """Pairs (l, r) with t_l + lb <= t_r <= t_l + ub.
+
+    Bucketed equi-join: left rows replicate into every bucket their interval
+    overlaps; right rows live in their own bucket; a pair matches only in
+    bucket_of(t_r), so each pair appears exactly once.
+    """
+    if isinstance(intrvl, tuple):
+        intrvl = Interval(*intrvl)
+    lb, ub = intrvl.lower_bound, intrvl.upper_bound
+    width = ub - lb
+    if width <= _zero_width(width):
+        width = _one_like(width)
+
+    tl_e = left._resolve(ex.wrap_arg(t_left))
+    tr_e = thisclass.resolve_this({"this": right}, ex.wrap_arg(t_right))
+
+    def left_buckets(t):
+        if t is None:
+            return ()
+        lo, hi = t + lb, t + ub
+        b0 = _floor_div(lo, width)
+        b1 = _floor_div(hi, width)
+        return tuple(range(int(b0), int(b1) + 1))
+
+    def right_bucket(t):
+        if t is None:
+            return None
+        return int(_floor_div(t, width))
+
+    lt = left.with_columns(
+        _pw_t=tl_e,
+        _pw_buckets=ex.ApplyExpression(left_buckets, None, tl_e))
+    lt_flat = lt.flatten(lt._pw_buckets)
+    rt = right.with_columns(
+        _pw_t=tr_e,
+        _pw_bucket=ex.ApplyExpression(right_bucket, None, tr_e))
+
+    conds = [lt_flat._pw_buckets == rt._pw_bucket]
+    for c in on:
+        conds.append(_replace_table(_replace_table(c, left, lt_flat), right, rt))
+    return _IntervalJoinResult(left, right, lt_flat, rt, conds, lb, ub, how,
+                               behavior)
+
+
+def _zero_width(w):
+    import pandas as pd
+
+    if isinstance(w, pd.Timedelta):
+        return pd.Timedelta(0)
+    return 0
+
+
+def _one_like(w):
+    import pandas as pd
+
+    if isinstance(w, pd.Timedelta):
+        return pd.Timedelta(1, "s")
+    return 1
+
+
+class _IntervalJoinResult:
+    def __init__(self, left, right, lt, rt, conds, lb, ub, how, behavior):
+        self._left = left
+        self._right = right
+        self._lt = lt
+        self._rt = rt
+        self._conds = conds
+        self._lb = lb
+        self._ub = ub
+        self._how = how
+
+    def select(self, *args, **kwargs) -> Table:
+        lt, rt = self._lt, self._rt
+        jr = lt.join(rt, *self._conds, how="inner")
+        matched = jr.select(
+            _pw_lid=lt.id, _pw_rid=rt.id, _pw_lt=lt._pw_t, _pw_rt=rt._pw_t)
+        good = matched.filter(
+            (matched._pw_rt >= matched._pw_lt + self._lb)
+            & (matched._pw_rt <= matched._pw_lt + self._ub))
+
+        lref = self._left
+        rref = self._right
+        lmatch = lref.ix(good._pw_lid, context=good)
+        rmatch = rref.ix(good._pw_rid, context=good)
+
+        out: dict[str, ex.ColumnExpression] = {}
+        for arg in args:
+            if isinstance(arg, ex.ColumnReference):
+                out[arg.name] = arg
+        out.update(kwargs)
+
+        def fix(e):
+            e = thisclass.resolve_this(
+                {"left": lref, "right": rref, "this": lref}, ex.wrap_arg(e))
+            e = _replace_table(e, lref, lmatch)
+            e = _replace_table(e, rref, rmatch)
+            return e
+
+        fixed = {k: fix(v) for k, v in out.items()}
+        result = good.select(**fixed)
+        if self._how in ("left", "outer"):
+            # add unmatched left rows with None right columns
+            matched_left = good.groupby(good._pw_lid).reduce(good._pw_lid)\
+                .with_id(thisclass.this._pw_lid)
+            unmatched = lref.difference(matched_left.with_universe_of(lref))
+            cols = {}
+            for name, e in out.items():
+                e2 = thisclass.resolve_this(
+                    {"left": lref, "right": rref, "this": lref}, ex.wrap_arg(e))
+                side = _side_of(e2, lref, rref)
+                if side == "left":
+                    cols[name] = _replace_table(e2, lref, unmatched)
+                else:
+                    cols[name] = None
+            pad = unmatched.select(**cols)
+            result = result.concat(pad)
+        return result
+
+
+def interval_join_left(left, right, t_left, t_right, intrvl, *on, **kw):
+    kw["how"] = "left"
+    return interval_join(left, right, t_left, t_right, intrvl, *on, **kw)
+
+
+def interval_join_right(left, right, t_left, t_right, intrvl, *on, **kw):
+    kw["how"] = "right"
+    return interval_join(left, right, t_left, t_right, intrvl, *on, **kw)
+
+
+def interval_join_outer(left, right, t_left, t_right, intrvl, *on, **kw):
+    kw["how"] = "outer"
+    return interval_join(left, right, t_left, t_right, intrvl, *on, **kw)
+
+
+def _side_of(e, left, right):
+    found = set()
+
+    def walk(x):
+        if isinstance(x, ex.ColumnReference):
+            if x.table is left:
+                found.add("left")
+            elif x.table is right:
+                found.add("right")
+        for d in getattr(x, "_deps", ()):
+            walk(d)
+
+    walk(e)
+    if found == {"left"}:
+        return "left"
+    if found == {"right"}:
+        return "right"
+    return "mixed"
+
+
+# ---------------------------------------------------------------------------
+# window_join (reference: window_join.py, 1,217 LoC)
+# ---------------------------------------------------------------------------
+
+def window_join(left: Table, right: Table, t_left, t_right, window: Window,
+                *on, how: str = "inner"):
+    """Join rows that fall into the same window."""
+    tl_e = left._resolve(ex.wrap_arg(t_left))
+    tr_e = thisclass.resolve_this({"this": right}, ex.wrap_arg(t_right))
+    assign = window.assign
+
+    def windows_of(t):
+        if t is None:
+            return ()
+        return tuple(assign(t))
+
+    lt = left.with_columns(_pw_w=ex.ApplyExpression(windows_of, None, tl_e))
+    ltf = lt.flatten(lt._pw_w)
+    rt = right.with_columns(_pw_w=ex.ApplyExpression(windows_of, None, tr_e))
+    rtf = rt.flatten(rt._pw_w)
+    conds = [ltf._pw_w == rtf._pw_w]
+    for c in on:
+        conds.append(_replace_table(_replace_table(c, left, ltf), right, rtf))
+    jr = ltf.join(rtf, *conds, how=how)
+
+    class _WJ:
+        def select(self_inner, *args, **kwargs):
+            def fix(e):
+                e = thisclass.resolve_this(
+                    {"left": left, "right": right, "this": left}, ex.wrap_arg(e))
+                e = _replace_table(e, left, ltf)
+                e = _replace_table(e, right, rtf)
+                return e
+
+            out = {}
+            for arg in args:
+                if isinstance(arg, ex.ColumnReference):
+                    out[arg.name] = arg
+            out.update(kwargs)
+            fixed = {k: fix(v) for k, v in out.items()}
+            return jr.select(**fixed)
+
+    return _WJ()

@@ -1,0 +1,5 @@
+from pathway_tpu.stdlib.utils import col  # noqa: F401
+from pathway_tpu.stdlib.utils import filtering  # noqa: F401
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: F401
+
+__all__ = ["col", "filtering", "AsyncTransformer"]

@@ -1,0 +1,47 @@
+"""pw.stdlib.utils.col (reference: python/pathway/stdlib/utils/col.py)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.table import Table
+
+
+def unpack_col(column: ex.ColumnReference, *unpacked_columns,
+               schema=None) -> Table:
+    """Expand a tuple column into many columns."""
+    table = column.table
+    if schema is not None:
+        names = schema.column_names()
+    else:
+        names = [c.name if isinstance(c, ex.ColumnReference) else str(c)
+                 for c in unpacked_columns]
+    return table.select(**{
+        n: ex.GetExpression(column, i, check_if_exists=False)
+        for i, n in enumerate(names)
+    })
+
+
+def flatten_column(column: ex.ColumnReference, origin_id: str | None = "origin_id"):
+    table = column.table
+    return table.flatten(column, origin_id=origin_id)
+
+
+def multiapply_all_rows(*cols, fun, result_col):
+    raise NotImplementedError
+
+
+def apply_all_rows(*cols, fun, result_col):
+    raise NotImplementedError
+
+
+def groupby_reduce_majority(column: ex.ColumnReference, value_column):
+    import pathway_tpu.internals.reducers_frontend as reducers
+
+    table = column.table
+    counted = table.groupby(column, value_column).reduce(
+        column, value_column, _pw_cnt=reducers.count())
+    return counted.groupby(counted[column.name]).reduce(
+        counted[column.name],
+        majority=reducers.argmax(counted._pw_cnt),
+    )

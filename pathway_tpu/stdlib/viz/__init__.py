@@ -1,0 +1,19 @@
+"""pw.stdlib.viz — live table visualization (reference: stdlib/viz/plotting.py,
+Bokeh/Panel). Headless environment: provides `table.show()`/`plot` as
+text-mode fallbacks."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.table import Table
+
+
+def show(table: Table, **kwargs) -> None:
+    from pathway_tpu.debug import compute_and_print
+
+    compute_and_print(table)
+
+
+def plot(table: Table, plotting_function=None, sorting_col=None):
+    raise NotImplementedError(
+        "interactive plotting requires bokeh/panel (not in this image)"
+    )

@@ -1,0 +1,5 @@
+"""pw.xpacks — extension packs (reference: python/pathway/xpacks/)."""
+
+from pathway_tpu.xpacks import llm  # noqa: F401
+
+__all__ = ["llm"]

@@ -1,0 +1,21 @@
+"""pw.xpacks.llm — LLM/RAG toolkit (reference: python/pathway/xpacks/llm/).
+
+Submodules import lazily so the heavyweight model stacks (torch/flax) load
+only when used.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+__all__ = [
+    "embedders", "llms", "parsers", "splitters", "rerankers",
+    "vector_store", "document_store", "question_answering", "servers",
+    "prompts", "_utils",
+]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(f"pathway_tpu.xpacks.llm.{name}")
+    raise AttributeError(name)

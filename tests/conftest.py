@@ -1,0 +1,10 @@
+"""Test env: force CPU backend with 8 virtual devices so multi-chip sharding
+tests run without TPU hardware (SURVEY §4: the stand-in for the reference's
+fork-based multi-process tests)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)

@@ -1,0 +1,180 @@
+"""Expression system (reference: engine Expression ops, engine.pyi:211-390)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, rows_of
+
+
+def test_if_else_coalesce_require():
+    t = T("""
+    a | b
+    1 |
+    2 | 5
+    """)
+    r = t.select(
+        c=pw.if_else(t.a > 1, t.a, 0),
+        d=pw.coalesce(t.b, t.a),
+        e=pw.require(t.a + 1, t.b),
+    )
+    assert sorted(rows_of(r), key=repr) == [(0, 1, None), (2, 5, 3)]
+
+
+def test_str_namespace():
+    t = T("""
+    s
+    'Hello World'
+    """)
+    r = t.select(
+        lo=t.s.str.lower(),
+        ln=t.s.str.len(),
+        sw=t.s.str.startswith("Hello"),
+        rep=t.s.str.replace("World", "TPU"),
+    )
+    assert rows_of(r) == [("hello world", 11, True, "Hello TPU")]
+
+
+def test_parse_numbers():
+    t = T("""
+    s
+    '42'
+    """)
+    r = t.select(i=t.s.str.parse_int(), f=t.s.str.parse_float())
+    assert rows_of(r) == [(42, 42.0)]
+
+
+def test_dt_namespace():
+    t = T("""
+    s
+    '2023-03-25 12:30:15'
+    """)
+    d = t.select(dt=t.s.dt.strptime("%Y-%m-%d %H:%M:%S"))
+    r = d.select(y=d.dt.dt.year(), m=d.dt.dt.month(), h=d.dt.dt.hour())
+    assert rows_of(r) == [(2023, 3, 12)]
+
+
+def test_duration_arithmetic():
+    t = T("""
+    a              | b
+    '2023-01-02'   | '2023-01-01'
+    """)
+    d = t.select(
+        x=t.a.dt.strptime("%Y-%m-%d"),
+        y=t.b.dt.strptime("%Y-%m-%d"),
+    )
+    r = d.select(days=(d.x - d.y).dt.days())
+    assert rows_of(r) == [(1,)]
+
+
+def test_apply_and_udf():
+    t = T("""
+    a
+    1
+    2
+    """)
+
+    @pw.udf
+    def double(x: int) -> int:
+        return x * 2
+
+    r = t.select(b=pw.apply(lambda x: x + 10, t.a), c=double(t.a))
+    assert sorted(rows_of(r)) == [(11, 2), (12, 4)]
+
+
+def test_async_udf():
+    t = T("""
+    a
+    1
+    2
+    """)
+
+    @pw.udf
+    async def slow_double(x: int) -> int:
+        await asyncio.sleep(0.001)
+        return x * 2
+
+    r = t.select(b=slow_double(t.a))
+    assert sorted(rows_of(r)) == [(2,), (4,)]
+
+
+def test_udf_cache_and_retries():
+    calls = []
+
+    @pw.udf(cache_strategy=pw.InMemoryCache(), deterministic=True)
+    def f(x: int) -> int:
+        calls.append(x)
+        return x + 1
+
+    t = T("""
+    a
+    5
+    5
+    """)
+    r = t.select(b=f(t.a))
+    assert rows_of(r) == [(6,), (6,)]
+    assert len(calls) == 1  # second call served from cache
+
+
+def test_error_and_fill_error():
+    t = T("""
+    a | b
+    1 | 0
+    4 | 2
+    """)
+    r = t.select(c=pw.fill_error(t.a // t.b, -1))
+    assert sorted(rows_of(r)) == [(-1,), (2,)]
+
+
+def test_make_tuple_and_get():
+    t = T("""
+    a | b
+    1 | 2
+    """)
+    r = t.select(t3=pw.make_tuple(t.a, t.b, t.a + t.b))
+    r2 = r.select(x=r.t3[2], y=r.t3.get(10, default=-1))
+    assert rows_of(r2) == [(3, -1)]
+
+
+def test_json():
+    t = T("""
+    a
+    1
+    """)
+    j = pw.Json({"x": {"y": [1, 2, 3]}})
+    r = t.select(v=pw.apply_with_type(lambda _: j["x"]["y"][1].as_int(), int, t.a))
+    assert rows_of(r) == [(2,)]
+
+
+def test_matmul_on_arrays():
+    t = T("""
+    a
+    1
+    """)
+    m = np.eye(2)
+    v = np.array([3.0, 4.0])
+    r = t.select(x=pw.apply_with_type(lambda _: float((m @ v)[1]), float, t.a))
+    assert rows_of(r) == [(4.0,)]
+
+
+def test_pointer_from_stable():
+    t = T("""
+    a
+    1
+    2
+    """)
+    r = t.select(p1=t.pointer_from(t.a), p2=pw.this.pointer_from(pw.this.a))
+    for p1, p2 in rows_of(r):
+        assert p1 == p2
+
+
+def test_ndarray_cells_roundtrip():
+    t = T("""
+    a
+    1
+    """)
+    r = t.select(v=pw.apply(lambda x: np.arange(3) * x, t.a))
+    r2 = r.select(s=pw.apply_with_type(lambda v: float(v.sum()), float, r.v))
+    assert rows_of(r2) == [(3.0,)]

@@ -1,0 +1,136 @@
+"""Reducers & groupby (reference: engine Reducer set, src/engine/reduce.rs:22)."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality_wo_index, rows_of
+
+
+def _t():
+    return T("""
+    g | x | y
+    a | 3 | 1.5
+    a | 1 | 2.5
+    b | 2 | 0.5
+    """)
+
+
+def test_basic_reducers():
+    t = _t()
+    r = t.groupby(t.g).reduce(
+        t.g,
+        s=pw.reducers.sum(t.x),
+        n=pw.reducers.count(),
+        mn=pw.reducers.min(t.x),
+        mx=pw.reducers.max(t.x),
+        av=pw.reducers.avg(t.y),
+    )
+    assert sorted(rows_of(r)) == [("a", 4, 2, 1, 3, 2.0), ("b", 2, 1, 2, 2, 0.5)]
+
+
+def test_argmin_argmax():
+    t = _t()
+    r = t.groupby(t.g).reduce(
+        t.g,
+        lo=pw.reducers.argmin(t.x),
+        hi=pw.reducers.argmax(t.x),
+    )
+    fetched_lo = t.ix(r.lo, context=r)
+    fetched_hi = t.ix(r.hi, context=r)
+    vals = r.select(r.g, lo_x=fetched_lo.x, hi_x=fetched_hi.x)
+    assert sorted(rows_of(vals)) == [("a", 1, 3), ("b", 2, 2)]
+
+
+def test_tuple_reducers():
+    t = _t()
+    r = t.groupby(t.g).reduce(
+        t.g,
+        st=pw.reducers.sorted_tuple(t.x),
+    )
+    assert sorted(rows_of(r)) == [("a", (1, 3)), ("b", (2,))]
+
+
+def test_unique_any():
+    t = T("""
+    g | c
+    a | 7
+    a | 7
+    b | 9
+    """)
+    r = t.groupby(t.g).reduce(t.g, u=pw.reducers.unique(t.c),
+                              an=pw.reducers.any(t.c))
+    assert sorted(rows_of(r)) == [("a", 7, 7), ("b", 9, 9)]
+
+
+def test_ndarray_reducer():
+    t = _t()
+    r = t.groupby(t.g).reduce(t.g, arr=pw.reducers.ndarray(t.x))
+    rows = dict(rows_of(r))
+    assert sorted(rows["a"].tolist()) == [1, 3]
+
+
+def test_earliest_latest():
+    t = T("""
+    g | x | _time
+    a | 1 | 2
+    a | 2 | 4
+    a | 3 | 6
+    """)
+    r = t.groupby(t.g).reduce(
+        t.g, e=pw.reducers.earliest(t.x), l=pw.reducers.latest(t.x))
+    assert rows_of(r) == [("a", 1, 3)]
+
+
+def test_stateful_single():
+    t = T("""
+    g | x
+    a | 1
+    a | 2
+    b | 5
+    """)
+
+    def acc(state, x):
+        return (state or 0) + x
+
+    r = t.groupby(t.g).reduce(t.g, s=pw.reducers.stateful_single(acc, t.x))
+    assert sorted(rows_of(r)) == [("a", 3), ("b", 5)]
+
+
+def test_compound_reduce_expression():
+    t = _t()
+    r = t.groupby(t.g).reduce(
+        t.g, z=pw.reducers.sum(t.x) * 10 + pw.reducers.count())
+    assert sorted(rows_of(r)) == [("a", 42), ("b", 21)]
+
+
+def test_incremental_retraction():
+    t = T("""
+    g | x | _time | _diff
+    a | 1 | 2     | 1
+    a | 2 | 4     | 1
+    a | 1 | 6     | -1
+    """)
+    r = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.x),
+                              mn=pw.reducers.min(t.x))
+    assert rows_of(r) == [("a", 2, 2)]
+
+
+def test_groupby_instance():
+    t = T("""
+    g | i | x
+    a | 0 | 1
+    a | 1 | 2
+    b | 0 | 5
+    """)
+    r = t.groupby(t.g, instance=t.i).reduce(t.g, s=pw.reducers.sum(t.x))
+    assert sorted(rows_of(r)) == [("a", 1), ("a", 2), ("b", 5)]
+
+
+def test_global_reduce_empty_groups_vanish():
+    t = T("""
+    g | x | _time | _diff
+    a | 1 | 2     | 1
+    a | 1 | 4     | -1
+    """)
+    r = t.groupby(t.g).reduce(t.g, n=pw.reducers.count())
+    assert rows_of(r) == []

@@ -1,0 +1,91 @@
+"""Joins (reference: join_tables, src/engine/dataflow.rs:2276)."""
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality_wo_index, rows_of
+
+
+def _sides():
+    t1 = T("""
+    k | v
+    1 | a
+    2 | b
+    3 | c
+    """)
+    t2 = T("""
+    k | w
+    2 | X
+    3 | Y
+    4 | Z
+    """)
+    return t1, t2
+
+
+def test_inner():
+    t1, t2 = _sides()
+    r = t1.join(t2, t1.k == t2.k).select(t1.v, t2.w)
+    assert sorted(rows_of(r)) == [("b", "X"), ("c", "Y")]
+
+
+def test_left():
+    t1, t2 = _sides()
+    r = t1.join_left(t2, t1.k == t2.k).select(t1.v, w=t2.w)
+    assert sorted(rows_of(r), key=repr) == [("a", None), ("b", "X"), ("c", "Y")]
+
+
+def test_right():
+    t1, t2 = _sides()
+    r = t1.join_right(t2, t1.k == t2.k).select(v=t1.v, w=t2.w)
+    assert sorted(rows_of(r), key=str) == [("b", "X"), ("c", "Y"), (None, "Z")]
+
+
+def test_outer():
+    t1, t2 = _sides()
+    r = t1.join_outer(t2, t1.k == t2.k).select(v=t1.v, w=t2.w)
+    assert len(rows_of(r)) == 4
+
+
+def test_left_right_this_syntax():
+    t1, t2 = _sides()
+    r = t1.join(t2, pw.left.k == pw.right.k).select(pw.left.v, pw.right.w)
+    assert sorted(rows_of(r)) == [("b", "X"), ("c", "Y")]
+
+
+def test_join_id_left():
+    t1, t2 = _sides()
+    r = t1.join(t2, t1.k == t2.k, id=t1.id).select(t1.v, t2.w)
+    # keeping left ids: can update_cells back onto t1's subuniverse
+    assert sorted(rows_of(r)) == [("b", "X"), ("c", "Y")]
+
+
+def test_multi_condition():
+    t1 = T("""
+    a | b | v
+    1 | 1 | p
+    1 | 2 | q
+    """)
+    t2 = T("""
+    a | b | w
+    1 | 2 | r
+    """)
+    r = t1.join(t2, t1.a == t2.a, t1.b == t2.b).select(t1.v, t2.w)
+    assert rows_of(r) == [("q", "r")]
+
+
+def test_join_expressions_in_select():
+    t1, t2 = _sides()
+    r = t1.join(t2, t1.k == t2.k).select(z=t1.k * 10 + t2.k)
+    assert sorted(rows_of(r)) == [(22,), (33,)]
+
+
+def test_incremental_join_retraction():
+    t1 = T("""
+    k | v | _time | _diff
+    1 | a | 2     | 1
+    1 | a | 6     | -1
+    """)
+    t2 = T("""
+    k | w | _time
+    1 | X | 4
+    """)
+    r = t1.join(t2, t1.k == t2.k).select(t1.v, t2.w)
+    assert rows_of(r) == []

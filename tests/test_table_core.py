@@ -1,0 +1,220 @@
+"""Core Table ops (reference test analogue: python/pathway/tests/test_common.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    rows_of,
+)
+
+
+def test_select_arithmetic():
+    t = T("""
+    a | b
+    1 | 2
+    3 | 4
+    """)
+    r = t.select(c=t.a + t.b, d=t.a * t.b, e=t.b / t.a, f=t.b % t.a)
+    assert rows_of(r) == [(3, 2, 2.0, 0), (7, 12, 4 / 3, 1)]
+
+
+def test_select_this():
+    t = T("""
+    a | b
+    1 | 2
+    """)
+    r = t.select(pw.this.a, c=pw.this.b + 1)
+    assert rows_of(r) == [(1, 3)]
+
+
+def test_with_columns():
+    t = T("""
+    a | b
+    1 | 2
+    """)
+    r = t.with_columns(c=t.a + t.b)
+    assert rows_of(r) == [(1, 2, 3)]
+
+
+def test_filter_keeps_keys():
+    t = T("""
+    a
+    1
+    2
+    3
+    """)
+    r = t.filter(t.a >= 2)
+    expected = T("""
+    a
+    2
+    3
+    """)
+    assert_table_equality_wo_index(r, expected)
+
+
+def test_rename_without():
+    t = T("""
+    a | b | c
+    1 | 2 | 3
+    """)
+    assert rows_of(t.without("b")) == [(1, 3)]
+    r = t.rename_by_dict({"a": "x"})
+    assert r.column_names() == ["x", "b", "c"]
+
+
+def test_cast_and_types():
+    t = T("""
+    a
+    1
+    2
+    """)
+    r = t.select(b=pw.cast(float, t.a))
+    assert rows_of(r) == [(1.0,), (2.0,)]
+
+
+def test_concat_reindex_and_update_rows():
+    t1 = T("""
+    a
+    1
+    """)
+    t2 = T("""
+    a
+    2
+    """)
+    c = t1.concat_reindex(t2)
+    assert sorted(rows_of(c)) == [(1,), (2,)]
+
+    u = T("""
+    id | a
+    1  | 10
+    2  | 20
+    """)
+    v = T("""
+    id | a
+    2  | 99
+    3  | 30
+    """)
+    merged = u.update_rows(v)
+    assert sorted(rows_of(merged)) == [(10,), (30,), (99,)]
+
+
+def test_update_cells():
+    u = T("""
+    id | a | b
+    1  | 1 | x
+    2  | 2 | y
+    """)
+    v = T("""
+    id | b
+    2  | z
+    """)
+    r = u.update_cells(v)
+    assert sorted(rows_of(r)) == [(1, "x"), (2, "z")]
+
+
+def test_difference_intersect():
+    t1 = T("""
+    id | a
+    1  | 1
+    2  | 2
+    3  | 3
+    """)
+    t2 = T("""
+    id | b
+    2  | 0
+    3  | 0
+    """)
+    assert rows_of(t1.difference(t2)) == [(1,)]
+    assert sorted(rows_of(t1.intersect(t2))) == [(2,), (3,)]
+
+
+def test_with_id_from():
+    t = T("""
+    a | b
+    1 | x
+    2 | y
+    """)
+    r = t.with_id_from(t.a)
+    r2 = t.with_id_from(t.a)
+    assert_table_equality(r, r2)
+
+
+def test_ix():
+    orders = T("""
+    id | item_id | qty
+    1  | 10      | 2
+    2  | 20      | 3
+    """)
+    items = T("""
+    iid | name
+    10  | apple
+    20  | pear
+    """)
+    # build pointer column on orders matching items' reindexed ids
+    orders2 = orders.select(ptr=orders.pointer_from(orders.item_id), qty=orders.qty)
+    items2 = items.with_id_from(items.iid)
+    fetched = items2.ix(orders2.ptr, context=orders2)
+    r = orders2.select(orders2.qty, name=fetched.name)
+    assert sorted(rows_of(r)) == [(2, "apple"), (3, "pear")]
+
+
+def test_flatten():
+    t = T("""
+    s
+    'a b'
+    'c'
+    """)
+    r = t.select(w=t.s.str.split(" ")).flatten(pw.this.w)
+    assert sorted(rows_of(r)) == [("a",), ("b",), ("c",)]
+
+
+def test_sort_prev_next():
+    t = T("""
+    a
+    3
+    1
+    2
+    """)
+    s = t.sort(t.a)
+    both_none = s.filter(s.prev.is_none() & s.next.is_none())
+    assert rows_of(both_none) == []
+    firsts = s.filter(s.prev.is_none())
+    r = t.restrict(firsts).select(t.a)
+    assert rows_of(r) == [(1,)]
+
+
+def test_deduplicate():
+    t = T("""
+    a | _time
+    1 | 2
+    2 | 4
+    5 | 6
+    3 | 8
+    """)
+    r = t.deduplicate(value=t.a, acceptor=lambda new, old: new > old)
+    assert rows_of(r) == [(5,)]
+
+
+def test_groupby_id():
+    t = T("""
+    a
+    1
+    2
+    """)
+    r = t.groupby(id=t.id).reduce(s=pw.reducers.sum(t.a))
+    assert_table_equality_wo_index(r, t.select(s=t.a))
+
+
+def test_split():
+    t = T("""
+    a
+    1
+    2
+    3
+    """)
+    pos, neg = t.split(t.a > 1)
+    assert sorted(rows_of(pos)) == [(2,), (3,)]
+    assert rows_of(neg) == [(1,)]

@@ -1,0 +1,65 @@
+"""Test harness (reference: python/pathway/tests/utils.py:412-520 —
+assert_table_equality & friends over captured diff streams)."""
+
+from __future__ import annotations
+
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.engine.delta import row_fingerprint
+from pathway_tpu.internals.runner import run_tables
+
+T = table_from_markdown
+
+
+def _snapshot(table):
+    [cap] = run_tables(table)
+    return cap.snapshot()
+
+
+def assert_table_equality(actual, expected):
+    """Same keys, same rows."""
+    a, e = run_tables(actual, expected)
+    sa, se = a.snapshot(), e.snapshot()
+    assert _normalize(sa) == _normalize(se), f"\nactual:   {sa}\nexpected: {se}"
+
+
+def assert_table_equality_wo_index(actual, expected):
+    """Same multiset of rows, ignoring keys."""
+    a, e = run_tables(actual, expected)
+    ra = sorted((row_fingerprint(r) for r in a.snapshot().values()))
+    re_ = sorted((row_fingerprint(r) for r in e.snapshot().values()))
+    assert ra == re_, (
+        f"\nactual rows:   {sorted(map(repr, a.snapshot().values()))}"
+        f"\nexpected rows: {sorted(map(repr, e.snapshot().values()))}"
+    )
+
+
+assert_table_equality_wo_types = assert_table_equality
+assert_table_equality_wo_index_types = assert_table_equality_wo_index
+
+
+def assert_stream_equality_wo_index(actual, expected):
+    """Same consolidated (row, time, diff) stream, ignoring keys."""
+    a, e = run_tables(actual, expected)
+    ka = sorted((row_fingerprint(r), t, d) for _, r, t, d in a.consolidated_events())
+    ke = sorted((row_fingerprint(r), t, d) for _, r, t, d in e.consolidated_events())
+    assert ka == ke, (
+        f"\nactual:   {sorted((r, t, d) for _, r, t, d in a.consolidated_events())}"
+        f"\nexpected: {sorted((r, t, d) for _, r, t, d in e.consolidated_events())}"
+    )
+
+
+def assert_stream_equality(actual, expected):
+    a, e = run_tables(actual, expected)
+    ka = sorted((k, row_fingerprint(r), t, d)
+                for k, r, t, d in a.consolidated_events())
+    ke = sorted((k, row_fingerprint(r), t, d)
+                for k, r, t, d in e.consolidated_events())
+    assert ka == ke
+
+
+def _normalize(snapshot):
+    return {k: row_fingerprint(r) for k, r in snapshot.items()}
+
+
+def rows_of(table) -> list[tuple]:
+    return sorted(_snapshot(table).values(), key=repr)
