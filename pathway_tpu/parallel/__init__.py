@@ -1,0 +1,48 @@
+"""pathway_tpu.parallel — device-mesh parallelism layer.
+
+The TPU-native replacement for the reference's distributed machinery
+(timely `communication` crate: external/timely-dataflow/communication/ —
+worker threads + TCP exchange channels; worker/cluster config
+src/engine/dataflow/config.rs:62-127). Instead of N identical workers
+exchanging rows over sockets, pathway_tpu scales by sharding device state
+(vector slabs, grouped aggregates) over a `jax.sharding.Mesh` and letting
+XLA insert ICI collectives (psum / all_gather / ppermute / all_to_all)
+inside jitted steps.
+
+Axis conventions (used across the framework):
+- ``data``  — batch / keyspace shards (the reference's worker shards,
+  src/engine/dataflow/shard.rs:6-20)
+- ``model`` — tensor-parallel shards of model weights (absent in the
+  reference — SURVEY §2.5 — but first-class here)
+A sequence axis for ring/Ulysses attention reuses ``data`` by default.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.parallel.mesh import (
+    MeshConfig,
+    current_mesh,
+    get_mesh,
+    make_mesh,
+    replicated,
+    shard_batch,
+    use_mesh,
+)
+from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex
+from pathway_tpu.parallel.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "get_mesh",
+    "use_mesh",
+    "current_mesh",
+    "shard_batch",
+    "replicated",
+    "ShardedKnnIndex",
+    "ring_attention",
+    "ulysses_attention",
+]

@@ -1,0 +1,107 @@
+"""Mesh construction + sharding helpers.
+
+Replaces the reference's worker/cluster configuration
+(src/engine/dataflow/config.rs:88-127: PATHWAY_THREADS × PATHWAY_PROCESSES →
+timely thread/TCP topology). Here the topology is a `jax.sharding.Mesh`
+over TPU chips: the ``data`` axis carries keyspace/batch shards (what the
+reference calls workers) and the ``model`` axis carries tensor-parallel
+weight shards. Env vars:
+
+- ``PATHWAY_DATA_PARALLEL``  — size of the data axis (default: all devices)
+- ``PATHWAY_MODEL_PARALLEL`` — size of the model axis (default 1)
+
+There is deliberately no 8-worker cap (the reference's free-tier
+MAX_WORKERS, config.rs:7, is a license artifact, not a design point).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from dataclasses import dataclass
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    data: int
+    model: int = 1
+
+    @staticmethod
+    def from_env(n_devices: int | None = None) -> "MeshConfig":
+        import jax
+
+        if n_devices is None:
+            n_devices = len(jax.devices())
+        model = int(os.environ.get("PATHWAY_MODEL_PARALLEL", "1"))
+        data_env = os.environ.get("PATHWAY_DATA_PARALLEL")
+        if data_env is not None:
+            data = int(data_env)
+        else:
+            data = max(1, n_devices // model)
+        return MeshConfig(data=data, model=model)
+
+
+def make_mesh(config: MeshConfig | None = None, *, devices=None):
+    """Build a 2-D (data, model) Mesh over the given (or all) devices."""
+    import jax
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig.from_env(len(devices))
+    n = config.data * config.model
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {config} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(config.data, config.model)
+    return jax.sharding.Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+_ACTIVE_MESH = None
+
+
+def get_mesh():
+    """The process-wide active mesh, creating a default one on first use."""
+    global _ACTIVE_MESH
+    if _ACTIVE_MESH is None:
+        _ACTIVE_MESH = make_mesh()
+    return _ACTIVE_MESH
+
+
+def current_mesh():
+    """The active mesh or None (never creates one)."""
+    return _ACTIVE_MESH
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    """Set the process-wide mesh for the duration of the block."""
+    global _ACTIVE_MESH
+    prev = _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_MESH = prev
+
+
+def shard_batch(mesh=None, *extra_axes):
+    """NamedSharding placing dim 0 on the data axis, rest replicated."""
+    import jax
+
+    if mesh is None:
+        mesh = get_mesh()
+    spec = jax.sharding.PartitionSpec(DATA_AXIS, *extra_axes)
+    return jax.sharding.NamedSharding(mesh, spec)
+
+
+def replicated(mesh=None):
+    import jax
+
+    if mesh is None:
+        mesh = get_mesh()
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())

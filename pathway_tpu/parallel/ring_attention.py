@@ -1,0 +1,162 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no attention kernels at all (SURVEY §2.5 — its "long
+context" is long *streams*); pathway_tpu makes long-sequence attention
+first-class for the embedder/LLM forward passes. Two standard schemes:
+
+- ``ring_attention``: K/V blocks rotate around the mesh axis via
+  ``lax.ppermute`` while each chip keeps its Q shard; softmax is
+  accumulated online (flash-attention style: running max + denominator),
+  so the full S×S score matrix never materialises and each step overlaps
+  one block matmul with one ICI hop.
+- ``ulysses_attention``: ``all_to_all`` re-shards from sequence-parallel
+  to head-parallel, runs exact local attention over full sequence per
+  head group, and re-shards back. Cheaper at moderate S, needs
+  heads % n_shards == 0.
+
+Both are pure-JAX over ``jax.shard_map`` — XLA lowers the collectives to
+ICI ops. Inputs are (batch, seq, heads, head_dim) with seq sharded over
+the mesh ``data`` axis (or any named axis passed in).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from pathway_tpu.parallel.mesh import DATA_AXIS
+
+
+def _online_block(q, k_blk, v_blk, m, l, o, mask=None):
+    """One flash-style accumulation step. q (B,Sq,H,D); k/v (B,Sk,H,D);
+    m,l (B,H,Sq); o (B,Sq,H,D)."""
+    import jax.numpy as jnp
+
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    # guard fully-masked rows (m_new == -inf) against NaNs
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * jnp.transpose(corr, (0, 2, 1))[..., None] + \
+        jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, *, mesh=None, axis: str = DATA_AXIS,
+                   causal: bool = False):
+    """Exact attention with sequence sharded over ``axis``.
+
+    q, k, v: (batch, seq, heads, head_dim), seq dim sharded. Returns the
+    attention output with the same sharding.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from pathway_tpu.parallel.mesh import get_mesh
+
+    if mesh is None:
+        mesh = get_mesh()
+    n = int(mesh.shape[axis])
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(q, k, v):
+        B, Sq, H, D = q.shape
+        my = jax.lax.axis_index(axis)
+        m0 = jnp.full((B, H, Sq), -jnp.inf, dtype=jnp.float32)
+        l0 = jnp.zeros((B, H, Sq), dtype=jnp.float32)
+        o0 = jnp.zeros(q.shape, dtype=jnp.float32)
+
+        q_pos = my * Sq + jnp.arange(Sq)
+
+        def body(t, carry):
+            k_blk, v_blk, m, l, o = carry
+            if causal:
+                src = (my - t) % n
+                k_pos = src * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
+                mask = q_pos[None, None, :, None] >= k_pos[None, None, None, :]
+            else:
+                mask = None
+            m, l, o = _online_block(q, k_blk, v_blk, m, l, o, mask)
+            k_blk = jax.lax.ppermute(k_blk, axis, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis, perm)
+            return k_blk, v_blk, m, l, o
+
+        k_blk, v_blk, m, l, o = jax.lax.fori_loop(
+            0, n, body, (k, v, m0, l0, o0))
+        denom = jnp.transpose(l, (0, 2, 1))[..., None]
+        return (o / jnp.maximum(denom, 1e-30)).astype(q.dtype)
+
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, *, mesh=None, axis: str = DATA_AXIS,
+                      causal: bool = False):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    Re-shards (B, S/n, H, D) → (B, S, H/n, D) with one all_to_all, runs
+    exact attention per head group over the full sequence, and re-shards
+    back. Requires heads % axis_size == 0.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from pathway_tpu.parallel.mesh import get_mesh
+
+    if mesh is None:
+        mesh = get_mesh()
+    n = int(mesh.shape[axis])
+    if q.shape[2] % n != 0:
+        raise ValueError(f"heads {q.shape[2]} not divisible by axis size {n}")
+
+    def local(q, k, v):
+        # (B, S/n, H, D) → (B, S, H/n, D): split heads, concat seq
+        def seq_to_head(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        def head_to_seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+        scale = qh.shape[-1] ** -0.5
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+        if causal:
+            S = qh.shape[1]
+            mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vh.dtype), vh)
+        return head_to_seq(out)
+
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal: bool = False):
+    """Unsharded exact attention for testing parity."""
+    import jax
+    import jax.numpy as jnp
+
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)

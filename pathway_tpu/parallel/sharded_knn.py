@@ -1,0 +1,273 @@
+"""Multi-chip sharded brute-force KNN.
+
+Pod-scale variant of ops/knn.py (reference: BruteForceKNNIndex,
+src/external_integration/brute_force_knn_integration.rs:22,187-229 — which
+is per-worker: each timely worker owns the rows routed to it by key shard).
+Here the vector slab is one logical array of shape
+``(n_shards, cap_per_shard, dim)`` sharded over the mesh ``data`` axis:
+each chip scores queries against its local shard (one MXU matmul), takes a
+local top-k, and the per-shard candidates are merged with a second top-k —
+the cross-chip traffic is only ``n_shards × B × k`` scores over ICI, never
+the slab itself. This is the distributed-KNN design for BASELINE.md
+config 5 (multi-worker KNN over a stream).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.ops.knn import KnnMetric, _round_up
+from pathway_tpu.parallel.mesh import DATA_AXIS, get_mesh
+
+
+class ShardedKnnIndex:
+    """Exact KNN over a mesh-sharded vector slab.
+
+    Slots form one logical space of size ``n_shards * cap_per_shard``;
+    slot ``s`` lives on shard ``s // cap_per_shard``. Adds are balanced by
+    always allocating from the emptiest shard (the reference balances by
+    key-hash routing, src/engine/dataflow/shard.rs:6-20; explicit balancing
+    avoids hash skew in the slab).
+    """
+
+    def __init__(self, dimensions: int, *, mesh=None,
+                 reserved_space: int = 0,
+                 metric: KnnMetric | str = KnnMetric.L2SQ):
+        if isinstance(metric, str):
+            metric = KnnMetric(metric)
+        self.dim = int(dimensions)
+        self.metric = metric
+        self._mesh = mesh if mesh is not None else get_mesh()
+        self.n_shards = int(self._mesh.shape[DATA_AXIS])
+        per = max(reserved_space // self.n_shards + 1, 1)
+        self.cap_per_shard = max(128, _round_up(per, 128))
+        self._lock = threading.RLock()
+
+        cap = self.total_capacity
+        self._host_vectors = np.zeros((cap, self.dim), dtype=np.float32)
+        self._host_valid = np.zeros((cap,), dtype=bool)
+        self._key_to_slot: dict[Pointer, int] = {}
+        self._slot_to_key: dict[int, Pointer] = {}
+        self._filter_data: dict[Pointer, Any] = {}
+        # per-shard LIFO free lists
+        self._free: list[list[int]] = [
+            list(range((s + 1) * self.cap_per_shard - 1,
+                       s * self.cap_per_shard - 1, -1))
+            for s in range(self.n_shards)
+        ]
+        self._dirty: set[int] = set()
+        self._dev_vectors = None
+        self._dev_valid = None
+        self._search_fn_cache: dict[tuple, Callable] = {}
+
+    @property
+    def total_capacity(self) -> int:
+        return self.n_shards * self.cap_per_shard
+
+    def __len__(self) -> int:
+        return len(self._key_to_slot)
+
+    # ------------------------------------------------------------------
+    def add(self, key: Pointer, vector: Any,
+            filter_data: Any | None = None) -> None:
+        with self._lock:
+            vec = np.asarray(vector, dtype=np.float32).reshape(-1)
+            if vec.shape[0] != self.dim:
+                raise ValueError(
+                    f"vector dim {vec.shape[0]} != index dim {self.dim}")
+            slot = self._key_to_slot.get(key)
+            if slot is None:
+                shard = max(range(self.n_shards),
+                            key=lambda s: len(self._free[s]))
+                if not self._free[shard]:
+                    self._grow()
+                    shard = max(range(self.n_shards),
+                                key=lambda s: len(self._free[s]))
+                slot = self._free[shard].pop()
+                self._key_to_slot[key] = slot
+                self._slot_to_key[slot] = key
+            self._host_vectors[slot] = vec
+            self._host_valid[slot] = True
+            if filter_data is not None:
+                self._filter_data[key] = filter_data
+            self._dirty.add(slot)
+
+    def remove(self, key: Pointer) -> None:
+        with self._lock:
+            slot = self._key_to_slot.pop(key, None)
+            if slot is None:
+                return
+            del self._slot_to_key[slot]
+            self._filter_data.pop(key, None)
+            self._host_valid[slot] = False
+            self._free[slot // self.cap_per_shard].append(slot)
+            self._dirty.add(slot)
+
+    def _grow(self) -> None:
+        """Double per-shard capacity; slot ids are remapped shard-locally."""
+        old_per = self.cap_per_shard
+        new_per = old_per * 2
+        cap = self.n_shards * new_per
+        new_vec = np.zeros((cap, self.dim), dtype=np.float32)
+        new_valid = np.zeros((cap,), dtype=bool)
+        remap: dict[int, int] = {}
+        for s in range(self.n_shards):
+            old_lo, new_lo = s * old_per, s * new_per
+            new_vec[new_lo:new_lo + old_per] = \
+                self._host_vectors[old_lo:old_lo + old_per]
+            new_valid[new_lo:new_lo + old_per] = \
+                self._host_valid[old_lo:old_lo + old_per]
+            for i in range(old_per):
+                remap[old_lo + i] = new_lo + i
+        self._host_vectors = new_vec
+        self._host_valid = new_valid
+        self._key_to_slot = {k: remap[v] for k, v in self._key_to_slot.items()}
+        self._slot_to_key = {remap[s]: k for s, k in self._slot_to_key.items()}
+        self._free = [
+            [remap[s] for s in shard_free] +
+            list(range((i + 1) * new_per - 1, i * new_per + old_per - 1, -1))
+            for i, shard_free in enumerate(self._free)
+        ]
+        self.cap_per_shard = new_per
+        self._dev_vectors = None
+        self._dev_valid = None
+        self._search_fn_cache.clear()
+        self._dirty.clear()
+
+    # ------------------------------------------------------------------
+    def _flush_to_device(self):
+        import jax
+        import jax.numpy as jnp
+
+        S, C, D = self.n_shards, self.cap_per_shard, self.dim
+        sharding = jax.sharding.NamedSharding(
+            self._mesh, jax.sharding.PartitionSpec(DATA_AXIS))
+        if self._dev_vectors is None:
+            self._dev_vectors = jax.device_put(
+                self._host_vectors.reshape(S, C, D), sharding)
+            self._dev_valid = jax.device_put(
+                self._host_valid.reshape(S, C), sharding)
+            self._dirty.clear()
+            return
+        if self._dirty:
+            idxs = np.fromiter(self._dirty, dtype=np.int32)
+            self._dirty.clear()
+            sh, sl = idxs // C, idxs % C
+            self._dev_vectors = self._dev_vectors.at[sh, sl].set(
+                jnp.asarray(self._host_vectors[idxs]))
+            self._dev_valid = self._dev_valid.at[sh, sl].set(
+                jnp.asarray(self._host_valid[idxs]))
+
+    def _get_search_fn(self, k: int):
+        cache_key = (k, self.cap_per_shard)
+        fn = self._search_fn_cache.get(cache_key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        metric = self.metric
+        C = self.cap_per_shard
+
+        def local_search(queries, vectors, valid):
+            # queries (B, D) replicated; vectors (1, C, D), valid (1, C) local
+            vecs = vectors[0]
+            if metric == KnnMetric.COS:
+                qn = queries / (jnp.linalg.norm(queries, axis=1,
+                                                keepdims=True) + 1e-12)
+                vn = vecs / (jnp.linalg.norm(vecs, axis=1,
+                                             keepdims=True) + 1e-12)
+                scores = qn @ vn.T
+            else:
+                dots = queries @ vecs.T
+                v_sq = jnp.sum(vecs * vecs, axis=1)
+                scores = 2.0 * dots - v_sq[None, :]
+            scores = jnp.where(valid[0][None, :], scores, -jnp.inf)
+            s, i = jax.lax.top_k(scores, min(k, C))  # (B, k) local
+            # globalize slot ids with this shard's offset
+            shard_id = jax.lax.axis_index(DATA_AXIS)
+            gi = i + shard_id * C
+            # gather candidates from every shard: (S, B, k) on each chip
+            all_s = jax.lax.all_gather(s, DATA_AXIS)
+            all_i = jax.lax.all_gather(gi, DATA_AXIS)
+            B = queries.shape[0]
+            cand_s = jnp.transpose(all_s, (1, 0, 2)).reshape(B, -1)
+            cand_i = jnp.transpose(all_i, (1, 0, 2)).reshape(B, -1)
+            ms, mpos = jax.lax.top_k(cand_s, min(k, cand_s.shape[1]))
+            mi = jnp.take_along_axis(cand_i, mpos, axis=1)
+            return ms, mi
+
+        shard_fn = jax.shard_map(
+            local_search, mesh=self._mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        fn = jax.jit(shard_fn)
+        self._search_fn_cache[cache_key] = fn
+        return fn
+
+    def search(self, queries: list[tuple]) -> list[tuple]:
+        """Same contract as ops.knn.BruteForceKnnIndex.search."""
+        if not queries:
+            return []
+        with self._lock:
+            if not self._key_to_slot:
+                return [() for _ in queries]
+            self._flush_to_device()
+
+            max_k = max(int(q[2] or 3) for q in queries)
+            has_filter = any(q[3] is not None for q in queries)
+            fetch_k = max(1, min(self.cap_per_shard,
+                                 max_k * 4 if has_filter else max_k))
+            qmat = np.stack([np.asarray(q[1], dtype=np.float32).reshape(-1)
+                             for q in queries])
+            search_fn = self._get_search_fn(fetch_k)
+            top_scores, top_idx = search_fn(qmat, self._dev_vectors,
+                                            self._dev_valid)
+            top_scores = np.asarray(top_scores)
+            top_idx = np.asarray(top_idx)
+
+            out = []
+            for qi, (qkey, qvec, limit, filt) in enumerate(queries):
+                limit = int(limit or 3)
+                matches = []
+                qnorm_sq = None
+                for rank in range(top_scores.shape[1]):
+                    score = top_scores[qi, rank]
+                    if not math.isfinite(score):
+                        break
+                    key = self._slot_to_key.get(int(top_idx[qi, rank]))
+                    if key is None:
+                        continue
+                    if filt is not None and not self._passes_filter(key, filt):
+                        continue
+                    if self.metric == KnnMetric.COS:
+                        dist = 1.0 - float(score)
+                    else:
+                        if qnorm_sq is None:
+                            q = np.asarray(qvec, dtype=np.float32).reshape(-1)
+                            qnorm_sq = float(q @ q)
+                        dist = max(0.0, qnorm_sq - float(score))
+                    matches.append((key, dist))
+                    if len(matches) >= limit:
+                        break
+                out.append(tuple(matches))
+            return out
+
+    def _passes_filter(self, key: Pointer, filt: Any) -> bool:
+        data = self._filter_data.get(key)
+        if callable(filt):
+            try:
+                return bool(filt(data))
+            except Exception:
+                return False
+        from pathway_tpu.internals.jmespath_lite import evaluate_filter
+
+        return evaluate_filter(filt, data)

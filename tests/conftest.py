@@ -1,10 +1,19 @@
 """Test env: force CPU backend with 8 virtual devices so multi-chip sharding
 tests run without TPU hardware (SURVEY §4: the stand-in for the reference's
-fork-based multi-process tests)."""
+fork-based multi-process tests).
+
+Note: the axon sitecustomize imports jax at interpreter startup (before this
+conftest), so env vars (JAX_PLATFORMS / XLA_FLAGS) are too late — but jax
+backends initialize lazily, so jax.config.update still wins as long as no
+devices were touched yet.
+"""
 
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,130 @@
+"""Multi-chip sharding tests on the 8-device virtual CPU mesh
+(SURVEY §4: stand-in for the reference's fork-based multi-process tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.parallel import (
+    MeshConfig,
+    ShardedKnnIndex,
+    make_mesh,
+    ring_attention,
+    ulysses_attention,
+    use_mesh,
+)
+from pathway_tpu.parallel.ring_attention import reference_attention
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(MeshConfig(data=8, model=1))
+
+
+@pytest.fixture(scope="module")
+def mesh42():
+    return make_mesh(MeshConfig(data=4, model=2))
+
+
+def test_mesh_shapes(mesh8, mesh42):
+    assert mesh8.shape["data"] == 8 and mesh8.shape["model"] == 1
+    assert mesh42.shape["data"] == 4 and mesh42.shape["model"] == 2
+
+
+def _brute_force_knn(vectors, keys, query, k):
+    d = ((vectors - query[None, :]) ** 2).sum(axis=1)
+    order = np.argsort(d, kind="stable")[:k]
+    return [(keys[i], float(d[i])) for i in order]
+
+
+def test_sharded_knn_matches_exact(mesh8):
+    rng = np.random.default_rng(0)
+    n, dim = 500, 16
+    vectors = rng.normal(size=(n, dim)).astype(np.float32)
+    keys = [Pointer(i) for i in range(n)]
+    with use_mesh(mesh8):
+        idx = ShardedKnnIndex(dim, mesh=mesh8, reserved_space=n)
+        for key, vec in zip(keys, vectors):
+            idx.add(key, vec)
+        q = rng.normal(size=(dim,)).astype(np.float32)
+        (result,) = idx.search([(Pointer(999), q, 5, None)])
+        expected = _brute_force_knn(vectors, keys, q, 5)
+        assert [k for k, _ in result] == [k for k, _ in expected]
+        for (_, got), (_, want) in zip(result, expected):
+            assert got == pytest.approx(want, rel=1e-4, abs=1e-4)
+
+
+def test_sharded_knn_remove_and_grow(mesh8):
+    rng = np.random.default_rng(1)
+    dim = 8
+    with use_mesh(mesh8):
+        idx = ShardedKnnIndex(dim, mesh=mesh8, reserved_space=8)
+        base_cap = idx.total_capacity
+        n = base_cap + 100  # force growth
+        vectors = rng.normal(size=(n, dim)).astype(np.float32)
+        for i in range(n):
+            idx.add(Pointer(i), vectors[i])
+        assert idx.total_capacity > base_cap
+        assert len(idx) == n
+        # remove half, searches must never return removed keys
+        for i in range(0, n, 2):
+            idx.remove(Pointer(i))
+        (res,) = idx.search([(Pointer(-1), vectors[3], 10, None)])
+        assert res, "expected matches"
+        for key, _ in res:
+            assert int(key) % 2 == 1
+        assert res[0][0] == Pointer(3)
+
+
+def test_sharded_knn_cosine_and_filter(mesh8):
+    dim = 4
+    with use_mesh(mesh8):
+        idx = ShardedKnnIndex(dim, mesh=mesh8, metric="cos")
+        idx.add(Pointer(1), [1, 0, 0, 0], {"path": "a.txt"})
+        idx.add(Pointer(2), [0.9, 0.1, 0, 0], {"path": "b.md"})
+        idx.add(Pointer(3), [0, 1, 0, 0], {"path": "c.md"})
+        (res,) = idx.search(
+            [(Pointer(0), [1, 0, 0, 0], 2,
+              lambda meta: meta["path"].endswith(".md"))])
+        assert [k for k, _ in res] == [Pointer(2), Pointer(3)]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(mesh8, causal):
+    rng = np.random.default_rng(2)
+    B, S, H, D = 2, 32, 4, 8  # S sharded 8-way → 4 per chip
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+    want = reference_attention(q, k, v, causal=causal)
+    got = ring_attention(q, k, v, mesh=mesh8, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(mesh8, causal):
+    rng = np.random.default_rng(3)
+    B, S, H, D = 2, 32, 8, 4  # heads divisible by 8
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+    want = reference_attention(q, k, v, causal=causal)
+    got = ulysses_attention(q, k, v, mesh=mesh8, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_on_submesh(mesh42):
+    rng = np.random.default_rng(4)
+    B, S, H, D = 1, 16, 2, 4
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+    want = reference_attention(q, k, v)
+    got = ring_attention(q, k, v, mesh=mesh42)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
