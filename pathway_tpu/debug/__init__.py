@@ -44,19 +44,28 @@ def _parse_value(tok: str) -> Any:
     return tok
 
 
+def _split_cells(line: str) -> list[str]:
+    """Split a markdown row into cells, preserving a leading empty cell
+    (the reference's implicit-id header format: ``  | owner | pet``)."""
+    cells = [c.strip() for c in line.strip().split("|")]
+    while cells and cells[-1] == "":  # trailing pipe
+        cells.pop()
+    return cells
+
+
 def table_from_markdown(txt: str, *, id_from=None, unsafe_trusted_ids=False,
                         schema: type[sch.Schema] | None = None,
                         _stream: bool = False) -> Table:
     lines = [l for l in txt.strip().splitlines()
              if l.strip() and not set(l.strip()) <= {"-", "|", " ", "+"}]
-    header = [h.strip() for h in re.split(r"\s*\|\s*", lines[0].strip().strip("|"))
-              if h.strip()]
+    header = _split_cells(lines[0])
+    has_id = bool(header) and header[0] in ("", "id")
+    if has_id:
+        header = ["id"] + header[1:]
     rows_raw = []
     for line in lines[1:]:
-        toks = [t for t in re.split(r"\s*\|\s*", line.strip().strip("|"))]
+        toks = _split_cells(line)
         rows_raw.append([_parse_value(t) for t in toks])
-
-    has_id = header and header[0] == "id"
     col_names = [h for h in header if h not in _SPECIAL and h != "id"]
     time_idx = next((i for i, h in enumerate(header) if h in ("_time", "__time__")), None)
     diff_idx = next((i for i, h in enumerate(header) if h in ("_diff", "__diff__")), None)

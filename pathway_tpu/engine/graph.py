@@ -124,8 +124,13 @@ class Scheduler:
             visit(node)
         return order
 
-    def run_time(self, time: int) -> dict[int, Delta]:
-        """Process one committed timestamp: sources already hold pending data."""
+    def run_time(self, time: int, flush: bool = False) -> dict[int, Delta]:
+        """Process one committed timestamp: sources already hold pending data.
+
+        ``flush=True`` marks the end-of-stream tick: operators holding rows
+        (temporal buffers) release them, and the releases propagate downstream
+        within the same tick.
+        """
         outputs: dict[int, Delta] = {}
         for node in self._topo:
             in_deltas = [outputs.get(up.id, _EMPTY) for up in node.inputs]
@@ -133,6 +138,10 @@ class Scheduler:
             extra = node.op.on_time_advance(time)
             if extra:
                 delta = Delta(delta.entries + extra.entries).consolidate()
+            if flush:
+                held = node.op.flush(time)
+                if held:
+                    delta = Delta(delta.entries + held.entries).consolidate()
             outputs[node.id] = delta
             if delta:
                 st = self.stats[node.id]

@@ -42,6 +42,12 @@ class Operator:
         buffering operators (temporal behaviors) can release rows."""
         return Delta()
 
+    def flush(self, time: int) -> Delta:
+        """End-of-stream: release anything still held (the reference flushes
+        buffers when the input frontier reaches +inf, operators/time_column.rs).
+        Only called once, at the final flush tick."""
+        return Delta()
+
 
 class SourceOperator(Operator):
     """Fed externally by an input session; just passes its delta through."""
@@ -425,7 +431,11 @@ class DeduplicateOperator(Operator):
                 old_val = self.value_fn(cur[0], cur[1])
                 try:
                     accept = bool(self.acceptor(new_val, old_val))
-                except Exception:
+                except Exception as e:
+                    from pathway_tpu.internals.error import global_error_log
+
+                    global_error_log().log(
+                        f"deduplicate acceptor raised: {e!r}", "deduplicate")
                     accept = False
             if accept:
                 gkey = hash_values("dedup", inst)

@@ -97,7 +97,8 @@ class StreamingRuntime:
                         if leftovers:
                             self.scheduler.run_time(time_counter)
                             time_counter += 1
-                    self.scheduler.run_time(time_counter)
+                    # all sources closed: end-of-stream flush tick
+                    self.scheduler.run_time(time_counter, flush=True)
                     if self.persistence is not None:
                         self.persistence.commit(time_counter)
                     break

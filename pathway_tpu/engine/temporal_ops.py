@@ -131,6 +131,9 @@ class BufferOperator(_WatermarkOp):
     def on_time_advance(self, time):
         return Delta()
 
+    def flush(self, time):
+        return self.flush_all()
+
 
 class ForgetOperator(_WatermarkOp):
     """Retract rows once the watermark passes their threshold (behavior

@@ -468,9 +468,11 @@ class ExpressionCompiler:
             for i in range(len(keys)):
                 args = [c[i] for c in arg_cols]
                 kws = {k: c[i] for k, c in kw_cols.items()}
-                if any(a is ERROR for a in args):
+                if any(a is ERROR for a in args) or any(
+                        v is ERROR for v in kws.values()):
                     slots.append((i, ERROR))
-                elif propagate_none and any(a is None for a in args):
+                elif propagate_none and (any(a is None for a in args) or any(
+                        v is None for v in kws.values())):
                     slots.append((i, None))
                 else:
                     slots.append((i, _PENDING))

@@ -98,8 +98,8 @@ class GraphRunner:
                 if batch:
                     node.op.push(batch)
             sched.run_time(t)
-        # flush tick for buffering/forgetting operators
-        sched.run_time(max(times) + 1)
+        # end-of-stream flush tick: temporal buffers release held rows
+        sched.run_time(max(times) + 1, flush=True)
         self._scheduler = sched
 
     # ------------------------------------------------------------------
@@ -317,16 +317,19 @@ class GraphRunner:
         rcomp = ExpressionCompiler(rctx)
         r_fns = [rcomp.compile(b) for _, b in on]
 
+        # SQL null semantics: a None join value matches nothing, but in
+        # left/right/outer mode the row must still appear as an unmatched
+        # "ear" — so map it to a per-row sentinel key that can't collide.
         def lkey_fn(key, row):
             vals = tuple(f([key], [row])[0] for f in l_fns)
             if any(v is None for v in vals):
-                return None
+                return ("__pw_null__", "l", key)
             return hash_values(*vals)
 
         def rkey_fn(key, row):
             vals = tuple(f([key], [row])[0] for f in r_fns)
             if any(v is None for v in vals):
-                return None
+                return ("__pw_null__", "r", key)
             return hash_values(*vals)
 
         nl = len(left._column_names())
@@ -539,7 +542,10 @@ class GraphRunner:
         rnode = self.lower(target)
 
         def lkey_fn(key, row):
-            return kfn([key], [row])[0]
+            k = kfn([key], [row])[0]
+            # None lookup key: matches nothing, but in optional mode the
+            # row must still surface with a None payload
+            return ("__pw_null__", "l", key) if k is None else k
 
         def rkey_fn(key, row):
             return key

@@ -442,8 +442,6 @@ class _AsofJoinResult:
         self._direction = direction
 
     def select(self, *args, **kwargs) -> Table:
-        import pathway_tpu.internals.reducers_frontend as reducers
-
         left, right = self._left, self._right
         lt = left.with_columns(_pw_t=self._tl)
         rt = right.with_columns(_pw_t=self._tr)
@@ -458,28 +456,32 @@ class _AsofJoinResult:
         )
         if self._direction == Direction.BACKWARD:
             valid = pairs.filter(pairs._pw_rt <= pairs._pw_lt)
-            score = valid._pw_rt
-            pick = reducers.argmax(score)
         elif self._direction == Direction.FORWARD:
             valid = pairs.filter(pairs._pw_rt >= pairs._pw_lt)
-            pick = reducers.argmin(valid._pw_rt)
         else:
             valid = pairs.with_columns(
                 _pw_dist=ex.if_else(pairs._pw_rt >= pairs._pw_lt,
                                     pairs._pw_rt - pairs._pw_lt,
                                     pairs._pw_lt - pairs._pw_rt))
-            pick = reducers.argmin(valid._pw_dist)
         best = valid.groupby(valid._pw_lid).reduce(
             valid._pw_lid,
             _pw_best=ex.ReducerExpression(
-                "argmin" if self._direction != Direction.BACKWARD else "argmax",
+                "argmax" if self._direction == Direction.BACKWARD else "argmin",
                 valid._pw_dist if self._direction == Direction.NEAREST
                 else valid._pw_rt,
                 valid._pw_rid),
         ).with_id(thisclass.this._pw_lid)
-        matched = best.with_universe_of(left)
-        rmatch = right.ix(matched._pw_best, optional=(self._how in ("left", "outer")),
-                          context=matched)
+
+        keep_unmatched_left = self._how in ("left", "outer")
+        if keep_unmatched_left:
+            # pad every left row so unmatched ones surface with a None match
+            matched = left.select(_pw_best=None).update_cells(
+                best.select(thisclass.this._pw_best)
+                    .promise_universe_is_subset_of(left))
+            rmatch = right.ix(matched._pw_best, optional=True, context=matched)
+        else:
+            matched = best.with_universe_of(left)
+            rmatch = right.ix(matched._pw_best, optional=False, context=matched)
 
         # build output
         out_kwargs: dict[str, ex.ColumnExpression] = {}
@@ -491,16 +493,35 @@ class _AsofJoinResult:
                     out_kwargs[n] = left[n]
         out_kwargs.update(kwargs)
 
-        def fix(e):
+        def fix(name, e):
             e = thisclass.resolve_this(
                 {"left": left, "right": right, "this": left}, ex.wrap_arg(e))
-            return _replace_table(e, right, rmatch)
+            e = _replace_table(e, right, rmatch)
+            if name in self._defaults:
+                e = ex.coalesce(e, self._defaults[name])
+            return e
 
-        fixed = {k: fix(v) for k, v in out_kwargs.items()}
-        base = left if self._how in ("inner", "left") else left
-        result = base.select(**fixed)
-        if self._how == "inner":
-            result = result.restrict(best) if False else result.intersect(best)
+        fixed = {k: fix(k, v) for k, v in out_kwargs.items()}
+        result = left.select(**fixed)
+        if not keep_unmatched_left:
+            # inner/right: only left rows that found a match
+            result = result.intersect(best)
+        if self._how in ("right", "outer"):
+            # right rows never chosen as a best match get padded in
+            matched_right = best.groupby(best._pw_best).reduce(best._pw_best)\
+                .with_id(thisclass.this._pw_best)
+            unmatched = right.difference(matched_right.with_universe_of(right))
+            cols = {}
+            for name, e in out_kwargs.items():
+                e2 = thisclass.resolve_this(
+                    {"left": left, "right": right, "this": left},
+                    ex.wrap_arg(e))
+                if _side_of(e2, left, right) == "right":
+                    cols[name] = _replace_table(e2, right, unmatched)
+                else:
+                    cols[name] = self._defaults.get(name)
+            # reindex: right-row keys may collide with left-result keys
+            result = result.concat_reindex(unmatched.select(**cols))
         return result
 
 
@@ -518,6 +539,25 @@ def interval(lower_bound, upper_bound) -> Interval:
     return Interval(lower_bound, upper_bound)
 
 
+def _as_num(x):
+    """Numeric view of a time-like value (pandas Timestamp/Timedelta →
+    integer nanoseconds) so bucket arithmetic is plain integer math —
+    Timestamp // Timedelta is not defined (fix for datetime time columns)."""
+    import datetime
+
+    import pandas as pd
+
+    if isinstance(x, pd.Timestamp):
+        return x.value
+    if isinstance(x, pd.Timedelta):
+        return x.value
+    if isinstance(x, datetime.datetime):
+        return pd.Timestamp(x).value
+    if isinstance(x, datetime.timedelta):
+        return pd.Timedelta(x).value
+    return x
+
+
 def interval_join(left: Table, right: Table, t_left, t_right, intrvl, *on,
                   how: str = "inner", behavior=None):
     """Pairs (l, r) with t_l + lb <= t_r <= t_l + ub.
@@ -529,33 +569,48 @@ def interval_join(left: Table, right: Table, t_left, t_right, intrvl, *on,
     if isinstance(intrvl, tuple):
         intrvl = Interval(*intrvl)
     lb, ub = intrvl.lower_bound, intrvl.upper_bound
-    width = ub - lb
-    if width <= _zero_width(width):
-        width = _one_like(width)
+    width = _as_num(ub) - _as_num(lb)
+    if width <= 0:
+        width = 1
 
     tl_e = left._resolve(ex.wrap_arg(t_left))
     tr_e = thisclass.resolve_this({"this": right}, ex.wrap_arg(t_right))
+    lb_n, ub_n = _as_num(lb), _as_num(ub)
 
     def left_buckets(t):
         if t is None:
             return ()
-        lo, hi = t + lb, t + ub
-        b0 = _floor_div(lo, width)
-        b1 = _floor_div(hi, width)
+        tn = _as_num(t)
+        b0 = (tn + lb_n) // width
+        b1 = (tn + ub_n) // width
         return tuple(range(int(b0), int(b1) + 1))
 
     def right_bucket(t):
         if t is None:
             return None
-        return int(_floor_div(t, width))
+        return int(_as_num(t) // width)
 
     lt = left.with_columns(
         _pw_t=tl_e,
         _pw_buckets=ex.ApplyExpression(left_buckets, None, tl_e))
-    lt_flat = lt.flatten(lt._pw_buckets)
+    # origin_id keeps the pre-flatten left row id so matches can be joined
+    # back to the original left table
+    lt_flat = lt.flatten(lt._pw_buckets, origin_id="_pw_lorig")
     rt = right.with_columns(
         _pw_t=tr_e,
         _pw_bucket=ex.ApplyExpression(right_bucket, None, tr_e))
+
+    if behavior is not None and isinstance(behavior, CommonBehavior):
+        if behavior.delay is not None:
+            lt_flat = lt_flat._buffer(
+                lt_flat._pw_t + behavior.delay, lt_flat._pw_t)
+            rt = rt._buffer(rt._pw_t + behavior.delay, rt._pw_t)
+        if behavior.cutoff is not None:
+            # a left row is dead once no admissible right time remains
+            # (t_r <= t_l + ub), and symmetrically for right rows
+            lt_flat = lt_flat._forget(
+                lt_flat._pw_t + ub + behavior.cutoff, lt_flat._pw_t)
+            rt = rt._forget(rt._pw_t - lb + behavior.cutoff, rt._pw_t)
 
     conds = [lt_flat._pw_buckets == rt._pw_bucket]
     for c in on:
@@ -590,12 +645,30 @@ class _IntervalJoinResult:
         self._lb = lb
         self._ub = ub
         self._how = how
+        self._behavior = behavior
+
+    def _pad_unmatched(self, out, side: str, unmatched: Table) -> Table:
+        """Rows of one side with no match: that side's columns, None other."""
+        lref, rref = self._left, self._right
+        cols = {}
+        for name, e in out.items():
+            e2 = thisclass.resolve_this(
+                {"left": lref, "right": rref, "this": lref}, ex.wrap_arg(e))
+            if _side_of(e2, lref, rref) == side:
+                cols[name] = _replace_table(
+                    e2, lref if side == "left" else rref, unmatched)
+            else:
+                cols[name] = None
+        return unmatched.select(**cols)
 
     def select(self, *args, **kwargs) -> Table:
         lt, rt = self._lt, self._rt
         jr = lt.join(rt, *self._conds, how="inner")
+        # _pw_lorig is the pre-flatten left id; rt is unflattened so rt.id
+        # is the original right id
         matched = jr.select(
-            _pw_lid=lt.id, _pw_rid=rt.id, _pw_lt=lt._pw_t, _pw_rt=rt._pw_t)
+            _pw_lid=lt._pw_lorig, _pw_rid=rt.id,
+            _pw_lt=lt._pw_t, _pw_rt=rt._pw_t)
         good = matched.filter(
             (matched._pw_rt >= matched._pw_lt + self._lb)
             & (matched._pw_rt <= matched._pw_lt + self._ub))
@@ -620,22 +693,25 @@ class _IntervalJoinResult:
 
         fixed = {k: fix(v) for k, v in out.items()}
         result = good.select(**fixed)
+        # pads are concat_reindex-ed: left/right row keys may collide with
+        # each other or with the pair keys (join output keys are synthetic
+        # in the reference too, dataflow.rs:2371-2379)
         if self._how in ("left", "outer"):
-            # add unmatched left rows with None right columns
             matched_left = good.groupby(good._pw_lid).reduce(good._pw_lid)\
                 .with_id(thisclass.this._pw_lid)
             unmatched = lref.difference(matched_left.with_universe_of(lref))
-            cols = {}
-            for name, e in out.items():
-                e2 = thisclass.resolve_this(
-                    {"left": lref, "right": rref, "this": lref}, ex.wrap_arg(e))
-                side = _side_of(e2, lref, rref)
-                if side == "left":
-                    cols[name] = _replace_table(e2, lref, unmatched)
-                else:
-                    cols[name] = None
-            pad = unmatched.select(**cols)
-            result = result.concat(pad)
+            result = result.concat_reindex(
+                self._pad_unmatched(out, "left", unmatched))
+        if self._how in ("right", "outer"):
+            matched_right = good.groupby(good._pw_rid).reduce(good._pw_rid)\
+                .with_id(thisclass.this._pw_rid)
+            unmatched = rref.difference(matched_right.with_universe_of(rref))
+            result = result.concat_reindex(
+                self._pad_unmatched(out, "right", unmatched))
+        if (isinstance(self._behavior, CommonBehavior)
+                and self._behavior.cutoff is not None
+                and self._behavior.keep_results):
+            result = result._filter_out_results_of_forgetting()
         return result
 
 

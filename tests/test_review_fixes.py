@@ -1,0 +1,389 @@
+"""Regression tests for the round-1 code-review findings (temporal joins,
+null join keys, markdown ids, buffer flush, async UDF kwargs, dedup errors)."""
+
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import expression as ex
+from tests.utils import T, rows_of
+
+
+def _rows(table):
+    """Run and return the live rows (ignoring ids), repr-sorted."""
+    return rows_of(table)
+
+
+def _expect(rows):
+    return sorted(rows, key=repr)
+
+
+# ---------------------------------------------------------------------------
+# 1. markdown implicit-id format
+# ---------------------------------------------------------------------------
+
+def test_markdown_leading_empty_id_cell():
+    t = pw.debug.table_from_markdown(
+        """
+          | owner | pet
+        1 | Alice | dog
+        2 | Bob   | cat
+        """
+    )
+    assert set(t.column_names()) == {"owner", "pet"}
+    assert _rows(t) == _expect([("Alice", "dog"), ("Bob", "cat")])
+
+
+def test_markdown_explicit_id_header_unchanged():
+    t = pw.debug.table_from_markdown(
+        """
+        id | v
+        1  | 10
+        2  | 20
+        """
+    )
+    assert t.column_names() == ["v"]
+    assert _rows(t) == _expect([(10,), (20,)])
+
+
+def test_markdown_same_id_same_key():
+    a = pw.debug.table_from_markdown("""
+          | v
+        7 | 1
+    """)
+    b = pw.debug.table_from_markdown("""
+          | w
+        7 | 2
+    """)
+    # same explicit id → same key → zip via with_universe_of works
+    joined = a.with_columns(w=b.with_universe_of(a).w)
+    assert _rows(joined) == _expect([(1, 2)])
+
+
+# ---------------------------------------------------------------------------
+# 2. interval_join
+# ---------------------------------------------------------------------------
+
+def test_interval_join_inner_matches():
+    left = T("""
+        a | t
+        1 | 0
+        2 | 10
+    """)
+    right = T("""
+        b | t
+        9 | 1
+        8 | 30
+    """)
+    res = pw.temporal.interval_join(
+        left, right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(a=left.a, b=right.b)
+    assert _rows(res) == _expect([(1, 9)])
+
+
+def test_interval_join_left_pads_unmatched():
+    left = T("""
+        a | t
+        1 | 0
+        2 | 100
+    """)
+    right = T("""
+        b | t
+        9 | 1
+    """)
+    res = pw.temporal.interval_join_left(
+        left, right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(a=left.a, b=right.b)
+    assert _rows(res) == _expect([(1, 9), (2, None)])
+
+
+def test_interval_join_right_pads_unmatched():
+    left = T("""
+        a | t
+        1 | 0
+    """)
+    right = T("""
+        b | t
+        9 | 1
+        8 | 50
+    """)
+    res = pw.temporal.interval_join_right(
+        left, right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(a=left.a, b=right.b)
+    assert _rows(res) == _expect([(1, 9), (None, 8)])
+
+
+def test_interval_join_outer():
+    left = T("""
+        a | t
+        1 | 0
+        2 | 100
+    """)
+    right = T("""
+        b | t
+        9 | 1
+        8 | 50
+    """)
+    res = pw.temporal.interval_join_outer(
+        left, right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(a=left.a, b=right.b)
+    assert _rows(res) == _expect([(1, 9), (2, None), (None, 8)])
+
+
+def test_interval_join_datetimes():
+    df_l = pd.DataFrame({"t": pd.to_datetime(["2024-01-01 00:00:00",
+                                              "2024-01-01 04:00:00"]),
+                         "a": [1, 2]})
+    df_r = pd.DataFrame({"t": pd.to_datetime(["2024-01-01 00:30:00"]),
+                         "b": [9]})
+    left = pw.debug.table_from_pandas(df_l)
+    right = pw.debug.table_from_pandas(df_r)
+    res = pw.temporal.interval_join(
+        left, right, left.t, right.t,
+        pw.temporal.interval(pd.Timedelta("-1h"), pd.Timedelta("1h")),
+    ).select(a=left.a, b=right.b)
+    assert _rows(res) == _expect([(1, 9)])
+
+
+# ---------------------------------------------------------------------------
+# 3. asof_join
+# ---------------------------------------------------------------------------
+
+def test_asof_join_inner_backward():
+    left = T("""
+        a | t
+        1 | 1
+        2 | 5
+    """)
+    right = T("""
+        b | t
+        7 | 3
+    """)
+    res = pw.temporal.asof_join(
+        left, right, left.t, right.t
+    ).select(a=left.a, b=right.b)
+    # t=1 has no right row <= 1 → dropped in inner mode
+    assert _rows(res) == _expect([(2, 7)])
+
+
+def test_asof_join_left_keeps_unmatched():
+    left = T("""
+        a | t
+        1 | 1
+        2 | 5
+    """)
+    right = T("""
+        b | t
+        7 | 3
+    """)
+    res = pw.temporal.asof_join_left(
+        left, right, left.t, right.t
+    ).select(a=left.a, b=right.b)
+    assert _rows(res) == _expect([(1, None), (2, 7)])
+
+
+def test_asof_join_left_defaults():
+    left = T("""
+        a | t
+        1 | 1
+    """)
+    right = T("""
+        b | t
+        7 | 3
+    """)
+    res = pw.temporal.asof_join_left(
+        left, right, left.t, right.t, defaults={"b": -1}
+    ).select(a=left.a, b=right.b)
+    assert _rows(res) == _expect([(1, -1)])
+
+
+def test_asof_join_right_pads_unchosen():
+    left = T("""
+        a | t
+        1 | 5
+    """)
+    right = T("""
+        b | t
+        7 | 3
+        8 | 4
+        9 | 50
+    """)
+    res = pw.temporal.asof_join_right(
+        left, right, left.t, right.t
+    ).select(a=left.a, b=right.b)
+    # best match for t=5 is b=8; b=7 and b=9 never chosen → padded
+    assert _rows(res) == _expect([(1, 8), (None, 7), (None, 9)])
+
+
+def test_asof_join_outer():
+    left = T("""
+        a | t
+        1 | 1
+        2 | 5
+    """)
+    right = T("""
+        b | t
+        7 | 3
+        9 | 50
+    """)
+    res = pw.temporal.asof_join_outer(
+        left, right, left.t, right.t
+    ).select(a=left.a, b=right.b)
+    assert _rows(res) == _expect([(1, None), (2, 7), (None, 9)])
+
+
+def test_asof_join_forward():
+    left = T("""
+        a | t
+        1 | 1
+    """)
+    right = T("""
+        b | t
+        7 | 3
+        8 | 10
+    """)
+    res = pw.temporal.asof_join(
+        left, right, left.t, right.t, direction="forward"
+    ).select(a=left.a, b=right.b)
+    assert _rows(res) == _expect([(1, 7)])
+
+
+# ---------------------------------------------------------------------------
+# 4. None join keys in left/outer joins
+# ---------------------------------------------------------------------------
+
+def test_left_join_keeps_none_key_rows():
+    left = T("""
+        k | v
+        1 | 10
+        None | 20
+    """)
+    right = T("""
+        k | w
+        1 | 100
+    """)
+    res = left.join(right, left.k == right.k, how="left").select(
+        v=left.v, w=right.w)
+    assert _rows(res) == _expect([(10, 100), (20, None)])
+
+
+def test_outer_join_none_keys_never_match_each_other():
+    left = T("""
+        k | v
+        None | 1
+    """)
+    right = T("""
+        k | w
+        None | 2
+    """)
+    res = left.join(right, left.k == right.k, how="outer").select(
+        v=left.v, w=right.w)
+    assert _rows(res) == _expect([(1, None), (None, 2)])
+
+
+def test_inner_join_drops_none_keys():
+    left = T("""
+        k | v
+        None | 1
+        2 | 3
+    """)
+    right = T("""
+        k | w
+        2 | 4
+    """)
+    res = left.join(right, left.k == right.k).select(v=left.v, w=right.w)
+    assert _rows(res) == _expect([(3, 4)])
+
+
+# ---------------------------------------------------------------------------
+# 5. buffer flush at end of stream
+# ---------------------------------------------------------------------------
+
+def test_windowby_delay_flushes_at_end():
+    t = T("""
+        v | t
+        1 | 0
+        2 | 4
+        3 | 10
+    """)
+    res = pw.temporal.windowby(
+        t, t.t, window=pw.temporal.tumbling(4),
+        behavior=pw.temporal.common_behavior(delay=5),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    # the [8,12) window's threshold (13) exceeds the final watermark (10) —
+    # it must still be emitted by the end-of-stream flush
+    assert _rows(res) == _expect([(0, 1), (4, 2), (8, 3)])
+
+
+# ---------------------------------------------------------------------------
+# 7. async UDF kwarg propagation
+# ---------------------------------------------------------------------------
+
+def test_async_udf_propagates_error_kwargs():
+    @pw.udf
+    async def combine(*, x: int) -> int:
+        assert not isinstance(x, object.__new__(type).__mro__[-1].__class__ )
+        return x + 1
+
+    t = T("""
+        a | b
+        1 | 0
+    """)
+    # a/b → error via division by zero, passed as KEYWORD arg
+    bad = t.select(e=ex.fill_error(t.a // t.b, -7))
+    assert _rows(bad) == _expect([(-7,)])
+
+    seen = []
+
+    @pw.udf
+    async def probe(*, x) -> int:
+        seen.append(x)
+        return 0
+
+    res = t.select(r=ex.fill_error(probe(x=t.a // t.b), -1))
+    assert _rows(res) == _expect([(-1,)])
+    assert seen == []  # coroutine never scheduled with the ERROR sentinel
+
+
+def test_async_udf_propagates_none_kwargs():
+    seen = []
+
+    @pw.udf(propagate_none=True)
+    async def probe(*, x) -> int:
+        seen.append(x)
+        return 1
+
+    t = T("""
+        a
+        None
+    """)
+    res = t.select(r=probe(x=t.a))
+    assert _rows(res) == _expect([(None,)])
+    assert seen == []
+
+
+# ---------------------------------------------------------------------------
+# 8. deduplicate acceptor errors are logged, not swallowed silently
+# ---------------------------------------------------------------------------
+
+def test_deduplicate_acceptor_exception_logged():
+    t = T("""
+        v
+        1
+        2
+    """)
+
+    def acceptor(new, old):
+        raise RuntimeError("boom")
+
+    res = t.deduplicate(value=t.v, acceptor=acceptor)
+    before = len(pw.global_error_log().entries)
+    rows = _rows(res)
+    assert rows == [(1,)] or rows == [(2,)]
+    after = len(pw.global_error_log().entries)
+    assert after > before
+    assert any("boom" in e["message"]
+               for e in pw.global_error_log().entries[before:])
