@@ -1,0 +1,78 @@
+"""Tokenizers for the flagship encoder.
+
+``HashTokenizer`` is a dependency-free deterministic tokenizer (word →
+stable hash mod vocab) for tests and benchmarks — the analogue of the
+reference test-suite's fake embedding models (xpacks/llm/tests/
+test_vector_store.py:107-121: real model swapped for a deterministic
+function). For real checkpoints, ``load_hf_tokenizer`` wraps a local
+HuggingFace tokenizer when `transformers` is importable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+import numpy as np
+
+_WORD_RE = re.compile(r"\w+|[^\w\s]")
+
+CLS_ID = 101
+SEP_ID = 102
+PAD_ID = 0
+_RESERVED = 1000  # ids below this are reserved for specials
+
+
+class HashTokenizer:
+    """Deterministic, vocabulary-free tokenizer: token ids are stable
+    across processes (md5-based, not Python ``hash``)."""
+
+    def __init__(self, vocab_size: int = 30522, max_len: int = 512,
+                 add_special_tokens: bool = True):
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+        self.add_special_tokens = add_special_tokens
+        self._cache: dict[str, int] = {}
+
+    def _word_id(self, word: str) -> int:
+        cached = self._cache.get(word)
+        if cached is not None:
+            return cached
+        h = hashlib.md5(word.lower().encode()).digest()
+        span = self.vocab_size - _RESERVED
+        wid = _RESERVED + int.from_bytes(h[:8], "little") % span
+        if len(self._cache) < 1 << 20:
+            self._cache[word] = wid
+        return wid
+
+    def encode(self, text: str, max_len: int | None = None) -> list[int]:
+        max_len = max_len or self.max_len
+        ids = [self._word_id(w) for w in _WORD_RE.findall(text)]
+        if self.add_special_tokens:
+            ids = [CLS_ID] + ids[: max_len - 2] + [SEP_ID]
+        else:
+            ids = ids[:max_len]
+        return ids
+
+    def batch(self, texts: list[str], max_len: int | None = None,
+              pad_to: int | None = None):
+        """→ (token_ids, attention_mask) int32/bool arrays, padded to the
+        longest sequence (or ``pad_to``) — static-shape friendly: callers
+        should bucket ``pad_to`` to a few sizes to bound recompilation."""
+        max_len = max_len or self.max_len
+        encoded = [self.encode(t, max_len) for t in texts]
+        width = pad_to or max(1, max(len(e) for e in encoded))
+        ids = np.full((len(texts), width), PAD_ID, dtype=np.int32)
+        mask = np.zeros((len(texts), width), dtype=bool)
+        for i, e in enumerate(encoded):
+            e = e[:width]
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = True
+        return ids, mask
+
+
+def load_hf_tokenizer(name_or_path: str):
+    """Local HuggingFace tokenizer (no network if the path is local)."""
+    from transformers import AutoTokenizer  # baked into the image
+
+    return AutoTokenizer.from_pretrained(name_or_path)

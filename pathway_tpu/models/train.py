@@ -1,0 +1,136 @@
+"""Contrastive training step for the flagship encoder.
+
+The reference never trains models (its embedders call external/torch models,
+xpacks/llm/embedders.py); pathway_tpu makes embedder fine-tuning a
+first-class TPU workload so a live RAG index can adapt to its corpus. The
+step is a standard bi-encoder InfoNCE (in-batch negatives, both
+directions), jit-compiled over the device mesh with:
+
+- **dp**: query/doc token batches sharded over the ``data`` axis;
+- **tp**: encoder weights sharded over the ``model`` axis
+  (models/encoder.py::param_pspecs);
+- **ep**: MoE experts sharded over ``model`` when config.num_experts > 0;
+- **sp**: long-sequence variants swap in ring attention
+  (parallel/ring_attention.py) via the ``attn_fn`` hook.
+
+XLA/GSPMD inserts the all-gathers/psums from the shardings; nothing here
+hand-schedules collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pathway_tpu.models.encoder import (
+    EncoderConfig,
+    encode,
+    init_params,
+    param_pspecs,
+)
+from pathway_tpu.parallel.mesh import DATA_AXIS
+
+
+def make_optimizer(learning_rate: float = 2e-5, weight_decay: float = 0.01):
+    return optax.adamw(learning_rate, weight_decay=weight_decay)
+
+
+def init_train_state(key, config: EncoderConfig, optimizer=None):
+    params = init_params(key, config)
+    optimizer = optimizer or make_optimizer()
+    opt_state = optimizer.init(params)
+    return {"params": params, "opt_state": opt_state,
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def train_state_pspecs(config: EncoderConfig, optimizer=None, key=None):
+    """PartitionSpec tree matching ``init_train_state`` output: optimizer
+    moments shard exactly like their parameters, scalars replicate."""
+    pspecs = param_pspecs(config)
+    optimizer = optimizer or make_optimizer()
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(lambda k: init_params(k, config), key)
+    opt_shape = jax.eval_shape(optimizer.init, shapes)
+    param_treedef = jax.tree.structure(shapes)
+
+    # optax adamw state = (ScaleByAdamState(count, mu, nu), wd, ...);
+    # mu/nu mirror the param tree → shard like params.
+    def rec(node):
+        try:
+            if jax.tree.structure(node) == param_treedef:
+                return pspecs
+        except Exception:
+            pass
+        if hasattr(node, "_fields"):  # NamedTuple (optax states)
+            return type(node)(*[rec(c) for c in node])
+        if isinstance(node, tuple):
+            return tuple(rec(c) for c in node)
+        if isinstance(node, list):
+            return [rec(c) for c in node]
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return P()
+
+    return {"params": pspecs, "opt_state": rec(opt_shape), "step": P()}
+
+
+def info_nce_loss(q_emb, d_emb, temperature: float = 0.05):
+    """Symmetric in-batch-negative InfoNCE; embeddings already normalized."""
+    logits = (q_emb @ d_emb.T) / temperature
+    labels = jnp.arange(logits.shape[0])
+    l_qd = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    l_dq = optax.softmax_cross_entropy_with_integer_labels(logits.T, labels)
+    return jnp.mean(l_qd + l_dq) * 0.5
+
+
+def contrastive_train_step(state, batch, *, config: EncoderConfig,
+                           optimizer=None, temperature: float = 0.05,
+                           attn_fn=None):
+    """One optimizer step. batch = {q_ids, q_mask, d_ids, d_mask} (B, S)."""
+    optimizer = optimizer or make_optimizer()
+
+    def loss_fn(params):
+        q = encode(params, batch["q_ids"], batch["q_mask"], config=config,
+                   attn_fn=attn_fn)
+        d = encode(params, batch["d_ids"], batch["d_mask"], config=config,
+                   attn_fn=attn_fn)
+        return info_nce_loss(q, d, temperature)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+    updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                        state["params"])
+    new_params = optax.apply_updates(state["params"], updates)
+    return {"params": new_params, "opt_state": new_opt,
+            "step": state["step"] + 1}, loss
+
+
+def make_sharded_train_step(mesh, config: EncoderConfig, optimizer=None,
+                            attn_fn=None):
+    """jit the train step with dp batch sharding + tp/ep state sharding.
+
+    Returns (step_fn, state_shardings, batch_sharding); place the initial
+    state with ``jax.device_put(state, state_shardings)`` before stepping.
+    """
+    optimizer = optimizer or make_optimizer()
+    state_specs = train_state_pspecs(config, optimizer)
+    state_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), state_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    batch_shardings = {k: batch_sharding
+                       for k in ("q_ids", "q_mask", "d_ids", "d_mask")}
+
+    step = functools.partial(contrastive_train_step, config=config,
+                             optimizer=optimizer, attn_fn=attn_fn)
+    fn = jax.jit(
+        step,
+        in_shardings=(state_shardings, batch_shardings),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    return fn, state_shardings, batch_sharding

@@ -73,6 +73,24 @@ class ShardedKnnIndex:
         return len(self._key_to_slot)
 
     # ------------------------------------------------------------------
+    def _alloc_slot(self, key: Pointer) -> int:
+        """Slot for ``key``, allocating from the emptiest shard (growing if
+        all shards are full). Lock held. Balances instead of key-hash routing
+        (reference routes by hash, src/engine/dataflow/shard.rs:6-20) to
+        avoid hash skew in the slab."""
+        slot = self._key_to_slot.get(key)
+        if slot is None:
+            shard = max(range(self.n_shards),
+                        key=lambda s: len(self._free[s]))
+            if not self._free[shard]:
+                self._grow()
+                shard = max(range(self.n_shards),
+                            key=lambda s: len(self._free[s]))
+            slot = self._free[shard].pop()
+            self._key_to_slot[key] = slot
+            self._slot_to_key[slot] = key
+        return slot
+
     def add(self, key: Pointer, vector: Any,
             filter_data: Any | None = None) -> None:
         with self._lock:
@@ -80,22 +98,42 @@ class ShardedKnnIndex:
             if vec.shape[0] != self.dim:
                 raise ValueError(
                     f"vector dim {vec.shape[0]} != index dim {self.dim}")
-            slot = self._key_to_slot.get(key)
-            if slot is None:
-                shard = max(range(self.n_shards),
-                            key=lambda s: len(self._free[s]))
-                if not self._free[shard]:
-                    self._grow()
-                    shard = max(range(self.n_shards),
-                                key=lambda s: len(self._free[s]))
-                slot = self._free[shard].pop()
-                self._key_to_slot[key] = slot
-                self._slot_to_key[slot] = key
+            slot = self._alloc_slot(key)
             self._host_vectors[slot] = vec
             self._host_valid[slot] = True
             if filter_data is not None:
                 self._filter_data[key] = filter_data
             self._dirty.add(slot)
+
+    def add_batch(self, keys: list[Pointer], vectors,
+                  filter_data: list[Any] | None = None) -> None:
+        """Vectorized add (same contract as ops.knn add_batch); rows go to
+        the emptiest shards. Capacity is ensured up front because _grow()
+        remaps slot ids — no grow may happen mid-allocation."""
+        if len(keys) == 0:
+            return
+        vecs = np.asarray(vectors, dtype=np.float32)
+        if vecs.ndim != 2 or vecs.shape[1] != self.dim:
+            raise ValueError(
+                f"expected ({len(keys)}, {self.dim}) vectors, got {vecs.shape}")
+        if vecs.shape[0] != len(keys):
+            raise ValueError(
+                f"{len(keys)} keys but {vecs.shape[0]} vectors")
+        if filter_data is not None and len(filter_data) != len(keys):
+            raise ValueError(
+                f"{len(keys)} keys but {len(filter_data)} filter_data entries")
+        with self._lock:
+            n_new = len({k for k in keys if k not in self._key_to_slot})
+            while sum(len(f) for f in self._free) < n_new:
+                self._grow()
+            slots = np.empty(len(keys), dtype=np.int64)
+            for i, key in enumerate(keys):
+                slots[i] = self._alloc_slot(key)
+                if filter_data is not None and filter_data[i] is not None:
+                    self._filter_data[key] = filter_data[i]
+            self._host_vectors[slots] = vecs
+            self._host_valid[slots] = True
+            self._dirty.update(slots.tolist())
 
     def remove(self, key: Pointer) -> None:
         with self._lock:

@@ -1,0 +1,168 @@
+"""Flagship encoder model + tokenizer + training step tests (CPU backend)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pathway_tpu.models.encoder import EncoderConfig, encode, init_params
+from pathway_tpu.models.tokenizer import HashTokenizer
+from pathway_tpu.models.train import (
+    contrastive_train_step,
+    init_train_state,
+    make_optimizer,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = EncoderConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), config)
+    return config, params
+
+
+def _batch(config, n=4, s=12, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, config.vocab_size, (n, s)).astype(np.int32)
+    mask = np.ones((n, s), dtype=bool)
+    return ids, mask
+
+
+def test_encode_shape_and_norm(tiny):
+    config, params = tiny
+    ids, mask = _batch(config)
+    out = encode(params, ids, mask, config=config)
+    assert out.shape == (4, config.hidden)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=1),
+                               1.0, atol=1e-3)
+
+
+def test_encode_deterministic(tiny):
+    config, params = tiny
+    ids, mask = _batch(config)
+    a = np.asarray(encode(params, ids, mask, config=config))
+    b = np.asarray(encode(params, ids, mask, config=config))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_encode_padding_invariance(tiny):
+    """Padding tokens must not change the (mean-pooled) embedding."""
+    config = EncoderConfig.tiny(pooling="mean")
+    params = init_params(jax.random.PRNGKey(0), config)
+    ids, mask = _batch(config, n=2, s=8)
+    padded_ids = np.concatenate([ids, np.zeros((2, 8), np.int32)], axis=1)
+    padded_mask = np.concatenate([mask, np.zeros((2, 8), bool)], axis=1)
+    a = np.asarray(encode(params, ids, mask, config=config))
+    b = np.asarray(encode(params, padded_ids, padded_mask, config=config))
+    np.testing.assert_allclose(a, b, atol=2e-2)
+
+
+def test_moe_encode_runs():
+    config = EncoderConfig.tiny(num_experts=4)
+    params = init_params(jax.random.PRNGKey(1), config)
+    ids, mask = _batch(config)
+    out = np.asarray(encode(params, ids, mask, config=config))
+    assert np.isfinite(out).all()
+
+
+def test_tokenizer_stable_and_padded():
+    tok = HashTokenizer(vocab_size=1024, max_len=16)
+    a = tok.encode("hello world")
+    b = tok.encode("hello world")
+    assert a == b
+    assert a[0] == 101 and a[-1] == 102
+    ids, mask = tok.batch(["one two three", "one"], pad_to=8)
+    assert ids.shape == (2, 8)
+    assert mask[0].sum() == 5 and mask[1].sum() == 3  # CLS + words + SEP
+    # same word → same id across instances (cache-independent)
+    tok2 = HashTokenizer(vocab_size=1024)
+    assert tok2.encode("hello world") == a
+
+
+def test_train_step_reduces_loss(tiny):
+    config, _ = tiny
+    opt = make_optimizer(learning_rate=1e-3)
+    state = init_train_state(jax.random.PRNGKey(0), config, opt)
+    rng = np.random.default_rng(0)
+    batch = {
+        "q_ids": rng.integers(0, config.vocab_size, (8, 10)).astype(np.int32),
+        "q_mask": np.ones((8, 10), bool),
+        "d_ids": rng.integers(0, config.vocab_size, (8, 10)).astype(np.int32),
+        "d_mask": np.ones((8, 10), bool),
+    }
+    step = jax.jit(lambda s, b: contrastive_train_step(
+        s, b, config=config, optimizer=opt))
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert int(state["step"]) == 5
+
+
+def test_knn_add_batch_matches_add():
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(40, 8)).astype(np.float32)
+    a = BruteForceKnnIndex(8, metric=KnnMetric.L2SQ)
+    b = BruteForceKnnIndex(8, metric=KnnMetric.L2SQ)
+    for i in range(40):
+        a.add(Pointer(i), vecs[i])
+    b.add_batch([Pointer(i) for i in range(40)], vecs)
+    q = [(Pointer(99), vecs[7], 5, None)]
+    assert a.search(q) == b.search(q)
+    # overwrite semantics: re-adding a key replaces its vector
+    b.add_batch([Pointer(7)], vecs[8:9])
+    res = b.search([(Pointer(99), vecs[8], 1, None)])
+    assert res[0][0][0] in (Pointer(7), Pointer(8))
+
+
+def test_sharded_knn_add_batch_grow_remap():
+    """Regression: a grow mid-batch remaps slots; every row must stay findable."""
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.parallel.mesh import MeshConfig, make_mesh
+    from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex
+
+    mesh = make_mesh(MeshConfig(data=2, model=1))
+    index = ShardedKnnIndex(4, mesh=mesh)  # cap 128/shard → 256 total
+    rng = np.random.default_rng(0)
+    n = 300  # forces a grow inside one add_batch
+    vecs = rng.normal(size=(n, 4)).astype(np.float32)
+    index.add_batch([Pointer(i) for i in range(n)], vecs)
+    assert len(index) == n
+    for probe in (0, 127, 128, 255, 256, 299):
+        res = index.search([(Pointer(10**6), vecs[probe], 1, None)])
+        assert res[0] and res[0][0][0] == Pointer(probe), (probe, res)
+
+
+def test_knn_add_batch_duplicates_and_filter():
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    index = BruteForceKnnIndex(4)
+    vecs = np.eye(4, dtype=np.float32)
+    # duplicate key in one batch: last write wins, no spurious grow
+    index.add_batch([Pointer(1), Pointer(1)], vecs[:2],
+                    filter_data=[{"tag": "a"}, {"tag": "b"}])
+    assert len(index) == 1 and index.capacity == 1024
+    res = index.search([(Pointer(9), vecs[1], 1, lambda d: d["tag"] == "b")])
+    assert res[0] and res[0][0][0] == Pointer(1)
+    index.add_batch([], np.zeros((0, 4), np.float32))  # no-op
+    with pytest.raises(ValueError):
+        index.add_batch([Pointer(2)], vecs[:2])
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 8
+
+
+def test_graft_entry_multichip():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
