@@ -365,7 +365,8 @@ class ApplyExpression(ColumnExpression):
 
     def __init__(self, fn: Callable, return_type: Any, *args,
                  propagate_none: bool = False, deterministic: bool = True,
-                 max_batch_size: int | None = None, **kwargs):
+                 max_batch_size: int | None = None,
+                 batch: bool = False, **kwargs):
         self._fn = fn
         self._return_type = dt.wrap(return_type)
         self._args = tuple(wrap_arg(a) for a in args)
@@ -373,6 +374,10 @@ class ApplyExpression(ColumnExpression):
         self._propagate_none = propagate_none
         self._deterministic = deterministic
         self._max_batch_size = max_batch_size
+        # batch=True → fn receives whole columns (lists) and returns a list:
+        # the columnar dispatch path for TPU/vectorized UDFs (SURVEY §7 —
+        # replaces the reference's per-row GIL calls, dataflow.rs:1300-1305)
+        self._batch = batch
 
     @property
     def _deps(self):

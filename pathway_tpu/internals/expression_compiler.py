@@ -427,6 +427,8 @@ class ExpressionCompiler:
         propagate_none = expr._propagate_none
         if not expr._deterministic:
             self.has_non_deterministic = True
+        if getattr(expr, "_batch", False):
+            return self._compile_batch_apply(expr, fns, kw_fns)
 
         def fn(keys, rows):
             arg_cols = [g(keys, rows) for g in fns]
@@ -448,6 +450,53 @@ class ExpressionCompiler:
                 except Exception as e:
                     global_error_log().log(f"apply failed: {e!r}")
                     out.append(ERROR)
+            return out
+
+        return fn
+
+    def _compile_batch_apply(self, expr, fns, kw_fns):
+        """Columnar UDF dispatch: ``fn`` gets whole columns (lists aligned by
+        row) and returns a list of results — one host→device round-trip per
+        engine batch instead of per row. Rows with ERROR/None args are masked
+        out before the call and spliced back after."""
+        f = expr._fn
+        propagate_none = expr._propagate_none
+        max_bs = expr._max_batch_size
+
+        def fn(keys, rows):
+            arg_cols = [g(keys, rows) for g in fns]
+            kw_cols = {k: g(keys, rows) for k, g in kw_fns.items()}
+            n = len(keys)
+            out: list = [None] * n
+            live: list[int] = []
+            for i in range(n):
+                args_i = [c[i] for c in arg_cols]
+                kws_i = [c[i] for c in kw_cols.values()]
+                if any(a is ERROR for a in args_i) or any(
+                        v is ERROR for v in kws_i):
+                    out[i] = ERROR
+                elif propagate_none and (any(a is None for a in args_i)
+                                         or any(v is None for v in kws_i)):
+                    out[i] = None
+                else:
+                    live.append(i)
+            step = max_bs or len(live) or 1
+            for lo in range(0, len(live), step):
+                idx = live[lo:lo + step]
+                args = [[c[i] for i in idx] for c in arg_cols]
+                kws = {k: [c[i] for i in idx] for k, c in kw_cols.items()}
+                try:
+                    results = f(*args, **kws)
+                    if len(results) != len(idx):
+                        raise ValueError(
+                            f"batch UDF returned {len(results)} results "
+                            f"for {len(idx)} rows")
+                    for i, r in zip(idx, results):
+                        out[i] = r
+                except Exception as e:
+                    global_error_log().log(f"batch apply failed: {e!r}")
+                    for i in idx:
+                        out[i] = ERROR
             return out
 
         return fn

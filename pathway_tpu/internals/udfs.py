@@ -73,6 +73,24 @@ class ExponentialBackoffRetryStrategy(FixedDelayRetryStrategy):
 # cache strategies (reference: udfs/caches.py)
 # ---------------------------------------------------------------------------
 
+# process-wide default cache used by UDFs constructed without an explicit
+# cache_strategy; activated by server run(with_cache=True, cache_backend=...)
+# (reference: run kwargs with_cache/cache_backend wiring UDF-caching
+# persistence mode, udfs/caches.py)
+_DEFAULT_CACHE: "CacheStrategy | None" = None
+
+
+def set_default_cache(strategy: "CacheStrategy | None") -> None:
+    """Set the cache strategy applied to UDFs that did not pick their own.
+    Applies to UDFs prepared after this call."""
+    global _DEFAULT_CACHE
+    _DEFAULT_CACHE = strategy
+
+
+def get_default_cache() -> "CacheStrategy | None":
+    return _DEFAULT_CACHE
+
+
 class CacheStrategy:
     def wrap_async(self, fn: Callable) -> Callable:
         raise NotImplementedError
@@ -299,13 +317,16 @@ class UDF:
     def __init__(self, *, return_type: Any = None, deterministic: bool = False,
                  propagate_none: bool = False, executor: Executor | None = None,
                  cache_strategy: CacheStrategy | None = None,
-                 max_batch_size: int | None = None):
+                 max_batch_size: int | None = None, batch: bool = False):
         self.return_type = return_type
         self.deterministic = deterministic
         self.propagate_none = propagate_none
         self.executor = executor or AutoExecutor()
         self.cache_strategy = cache_strategy
         self.max_batch_size = max_batch_size
+        # batch=True → __wrapped__ receives whole columns (lists) and
+        # returns a list (columnar TPU/vectorized dispatch; sync only)
+        self.batch = batch
         self._prepared: Callable | None = None
 
     # subclasses override
@@ -329,6 +350,8 @@ class UDF:
     def _prepare(self):
         if self._prepared is not None:
             return self._prepared, self._is_async
+        if self.cache_strategy is None:
+            self.cache_strategy = get_default_cache()
         fn = self.func
         is_coro = inspect.iscoroutinefunction(fn) or inspect.iscoroutinefunction(
             getattr(fn, "__wrapped__", None))
@@ -354,6 +377,19 @@ class UDF:
         self._prepared = fn
         return fn, self._is_async
 
+    def prepared_async(self) -> Callable:
+        """Async callable with this UDF's retry/timeout/capacity/cache
+        wrapping applied — for direct (non-column) invocation, e.g. the
+        adaptive RAG loop calling a chat model outside the engine."""
+        fn, is_async = self._prepare()
+        if is_async:
+            return fn
+
+        async def as_async(*args, **kwargs):
+            return fn(*args, **kwargs)
+
+        return as_async
+
     def __call__(self, *args, **kwargs) -> ex.ColumnExpression:
         fn, is_async = self._prepare()
         ret = self._infer_return_type(self.func)
@@ -362,11 +398,14 @@ class UDF:
             cls = ex.FullyAsyncApplyExpression
         elif is_async:
             cls = ex.AsyncApplyExpression
+        if self.batch and cls is not ex.ApplyExpression:
+            raise TypeError("batch=True UDFs must be sync")
         return cls(
             fn, ret, *args,
             propagate_none=self.propagate_none,
             deterministic=self.deterministic,
             max_batch_size=self.max_batch_size,
+            batch=self.batch,
             **kwargs,
         )
 
@@ -386,7 +425,7 @@ def udf(fun: Callable | None = None, /, *, return_type: Any = None,
         deterministic: bool = False, propagate_none: bool = False,
         executor: Executor | None = None,
         cache_strategy: CacheStrategy | None = None,
-        max_batch_size: int | None = None):
+        max_batch_size: int | None = None, batch: bool = False):
     """Decorator turning a Python function into a column UDF."""
 
     def wrapper(f):
@@ -394,6 +433,7 @@ def udf(fun: Callable | None = None, /, *, return_type: Any = None,
             f, return_type=return_type, deterministic=deterministic,
             propagate_none=propagate_none, executor=executor,
             cache_strategy=cache_strategy, max_batch_size=max_batch_size,
+            batch=batch,
         )
 
     if fun is not None:

@@ -44,6 +44,25 @@ class DataIndex:
     def __init__(self, data_table: Table, inner_index: InnerIndex):
         self.data_table = data_table
         self.inner_index = inner_index
+        self._data_prepared: Table | None = None
+
+    def _prepare_data(self) -> Table:
+        """Embed + project the corpus ONCE per DataIndex: every query stream
+        reuses the same plan node, so the encoder forward over the corpus
+        runs once even when several endpoints query the same index."""
+        if self._data_prepared is None:
+            inner = self.inner_index
+            data_vec = inner.data_column
+            if inner.query_embedder is not None:
+                # "embedder inside index" (reference vector_store.py:214-292):
+                # both the indexed column and the query column are embedded
+                data_vec = inner.query_embedder(data_vec)
+            self._data_prepared = self.data_table.select(
+                _pw_vec=data_vec,
+                _pw_meta=inner.metadata_column
+                if inner.metadata_column is not None else None,
+            )
+        return self._data_prepared
 
     # ------------------------------------------------------------------
     def query_as_of_now(self, query_column: ex.ColumnExpression, *,
@@ -70,12 +89,8 @@ class DataIndex:
         data = self.data_table
         inner = self.inner_index
 
-        data_vec = inner.data_column
         embedder = inner.query_embedder
-        data_prepared = data.select(
-            _pw_vec=data_vec,
-            _pw_meta=inner.metadata_column if inner.metadata_column is not None else None,
-        )
+        data_prepared = self._prepare_data()
 
         qvec = query_column
         if embedder is not None:

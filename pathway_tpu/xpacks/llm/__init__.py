@@ -10,7 +10,7 @@ import importlib
 
 __all__ = [
     "embedders", "llms", "parsers", "splitters", "rerankers",
-    "vector_store", "document_store", "question_answering", "servers",
+    "vector_store", "question_answering", "servers",
     "prompts", "_utils",
 ]
 

@@ -1,0 +1,176 @@
+"""Embedders — UDFs mapping text columns to embedding vectors.
+
+Same API family as the reference (xpacks/llm/embedders.py: OpenAIEmbedder:83,
+LiteLLMEmbedder:178, SentenceTransformerEmbedder:268, GeminiEmbedder:328;
+dimension probing via one call :63), plus the TPU-native flagship:
+``JaxEncoderEmbedder`` runs pathway_tpu/models/encoder.py under jit with
+**columnar batch dispatch** (UDF batch=True) — whole engine batches are
+tokenized and encoded in one device call, never per row.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals import udfs
+from pathway_tpu.xpacks.llm._utils import _import_or_raise
+
+
+class BaseEmbedder(udfs.UDF):
+    """Embedder base: callable on a column; knows its output dimension."""
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        """Probe the dimension with one call (reference embedders.py:63)."""
+        result = self.func(".", **kwargs)
+        if asyncio.iscoroutine(result):
+            result = asyncio.run(result)
+        arr = np.asarray(result)
+        if arr.ndim == 2:  # batch embedder probed with a single item
+            arr = arr[0]
+        return int(arr.shape[0])
+
+
+class JaxEncoderEmbedder(BaseEmbedder):
+    """TPU-native embedder over the flagship JAX encoder.
+
+    Tokenizes with models.tokenizer (HashTokenizer by default, or a local HF
+    tokenizer), bf16 forward under jit, sequence-length bucketing to bound
+    recompilation. This replaces the reference's torch
+    SentenceTransformerEmbedder as the local-model path.
+    """
+
+    _BUCKETS = (32, 64, 128, 256, 512)
+
+    def __init__(self, *, config=None, params=None, tokenizer=None,
+                 seed: int = 0, max_len: int = 512,
+                 call_kwargs: dict = {}, **kwargs):
+        kwargs.setdefault("batch", True)
+        kwargs.setdefault("deterministic", True)
+        super().__init__(**kwargs)
+        import jax
+
+        from pathway_tpu.models.encoder import EncoderConfig, encode, \
+            init_params
+        from pathway_tpu.models.tokenizer import HashTokenizer
+
+        self.config = config or EncoderConfig.bge_small()
+        self.params = params if params is not None else init_params(
+            jax.random.PRNGKey(seed), self.config)
+        self.tokenizer = tokenizer or HashTokenizer(
+            vocab_size=self.config.vocab_size, max_len=max_len)
+        self.max_len = min(max_len, self.config.max_len)
+        cfg = self.config
+        self._encode = jax.jit(
+            lambda p, ids, mask: encode(p, ids, mask, config=cfg))
+
+    def _bucket(self, n: int) -> int:
+        for b in self._BUCKETS:
+            if n <= b:
+                return min(b, self.max_len)
+        return self.max_len
+
+    def embed_batch(self, texts: list[str]) -> np.ndarray:
+        ids, mask = self.tokenizer.batch(
+            [t or "." for t in texts], max_len=self.max_len)
+        pad_to = self._bucket(ids.shape[1])
+        if ids.shape[1] < pad_to:
+            pad = pad_to - ids.shape[1]
+            ids = np.pad(ids, ((0, 0), (0, pad)))
+            mask = np.pad(mask, ((0, 0), (0, pad)))
+        else:
+            ids, mask = ids[:, :pad_to], mask[:, :pad_to]
+        return np.asarray(self._encode(self.params, ids, mask))
+
+    def __wrapped__(self, texts: list[str], **kwargs) -> list[np.ndarray]:
+        emb = self.embed_batch(list(texts))
+        return [emb[i] for i in range(emb.shape[0])]
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return int(self.config.hidden)
+
+
+class SentenceTransformerEmbedder(BaseEmbedder):
+    """Local sentence-transformers model (torch) — reference :268-326.
+    Prefer JaxEncoderEmbedder on TPU; this exists for checkpoint parity."""
+
+    def __init__(self, model: str, *, call_kwargs: dict = {},
+                 device: str = "cpu", **kwargs):
+        kwargs.setdefault("batch", True)
+        super().__init__(**kwargs)
+        st = _import_or_raise("sentence_transformers",
+                              "SentenceTransformerEmbedder")
+        self.model = st.SentenceTransformer(model, device=device)
+        self.kwargs = call_kwargs
+
+    def __wrapped__(self, texts: list[str], **kwargs) -> list[np.ndarray]:
+        out = self.model.encode(list(texts), **{**self.kwargs, **kwargs})
+        return [np.asarray(v) for v in out]
+
+
+class _RemoteEmbedder(BaseEmbedder):
+    """Shared shape of the network embedders: async UDF with retry/cache."""
+
+    def __init__(self, *, capacity: int | None = None,
+                 retry_strategy: udfs.AsyncRetryStrategy | None = None,
+                 cache_strategy: udfs.CacheStrategy | None = None,
+                 model: str | None = None, **call_kwargs):
+        executor = udfs.async_executor(capacity=capacity,
+                                       retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        call_kwargs["model"] = model
+        self.kwargs = {k: v for k, v in call_kwargs.items() if v is not None}
+
+
+class OpenAIEmbedder(_RemoteEmbedder):
+    """OpenAI /embeddings API (reference embedders.py:83)."""
+
+    def __init__(self, model: str | None = "text-embedding-3-small",
+                 api_key: str | None = None, base_url: str | None = None,
+                 **kwargs):
+        super().__init__(model=model, **kwargs)
+        self._client_kwargs = {"api_key": api_key, "base_url": base_url}
+        self._client = None
+
+    def _get_client(self):
+        if self._client is None:
+            openai = _import_or_raise("openai", "OpenAIEmbedder")
+            kw = {k: v for k, v in self._client_kwargs.items()
+                  if v is not None}
+            self._client = openai.AsyncOpenAI(**kw)
+        return self._client
+
+    async def __wrapped__(self, input: str, **kwargs) -> np.ndarray:
+        resp = await self._get_client().embeddings.create(
+            input=[input or "."], **{**self.kwargs, **kwargs})
+        return np.array(resp.data[0].embedding)
+
+
+class LiteLLMEmbedder(_RemoteEmbedder):
+    """Any provider through litellm (reference embedders.py:178)."""
+
+    async def __wrapped__(self, input: str, **kwargs) -> np.ndarray:
+        litellm = _import_or_raise("litellm", "LiteLLMEmbedder")
+        resp = await litellm.aembedding(
+            input=[input or "."], **{**self.kwargs, **kwargs})
+        return np.array(resp.data[0]["embedding"])
+
+
+class GeminiEmbedder(_RemoteEmbedder):
+    """Google Generative AI embeddings (reference embedders.py:328)."""
+
+    def __init__(self, model: str | None = "models/embedding-001",
+                 api_key: str | None = None, **kwargs):
+        super().__init__(model=model, **kwargs)
+        self._api_key = api_key
+
+    async def __wrapped__(self, input: str, **kwargs) -> np.ndarray:
+        genai = _import_or_raise("google.generativeai", "GeminiEmbedder")
+        if self._api_key:
+            genai.configure(api_key=self._api_key)
+        resp = await asyncio.to_thread(
+            genai.embed_content, content=input or ".",
+            **{**self.kwargs, **kwargs})
+        return np.array(resp["embedding"])

@@ -1,0 +1,97 @@
+"""Document parsers — UDFs mapping raw bytes to [(text, metadata)] chunks.
+
+Reference: xpacks/llm/parsers.py (ParseUtf8, ParseUnstructured,
+ParseOpenParse — PDF layout/tables/vision). ``ParseUtf8`` is native here;
+the heavyweight parsers import their libraries lazily and raise a clear
+error when absent (this image has no unstructured/openparse).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import udfs
+from pathway_tpu.xpacks.llm._utils import _import_or_raise
+
+
+def _as_text(contents: Any) -> str:
+    if isinstance(contents, bytes):
+        return contents.decode("utf-8", errors="replace")
+    return str(contents)
+
+
+class ParseUtf8(udfs.UDF):
+    """Decode raw bytes as UTF-8 → one chunk (reference ParseUtf8)."""
+
+    def __wrapped__(self, contents: Any, **kwargs) -> list[tuple[str, dict]]:
+        return [(_as_text(contents), {})]
+
+
+class ParseUnstructured(udfs.UDF):
+    """unstructured-io parser (reference ParseUnstructured): splits any
+    document type into elements; chunking modes single/elements/paged."""
+
+    def __init__(self, mode: str = "single", post_processors=None,
+                 **partition_kwargs):
+        super().__init__()
+        if mode not in ("single", "elements", "paged"):
+            raise ValueError(f"invalid mode {mode!r}")
+        self.mode = mode
+        self.post_processors = post_processors or []
+        self.partition_kwargs = partition_kwargs
+
+    def __wrapped__(self, contents: Any, **kwargs) -> list[tuple[str, dict]]:
+        partition = _import_or_raise(
+            "unstructured.partition.auto", "ParseUnstructured")
+        import io
+
+        raw = contents if isinstance(contents, bytes) \
+            else str(contents).encode()
+        elements = partition.partition(
+            file=io.BytesIO(raw), **{**self.partition_kwargs, **kwargs})
+        for proc in self.post_processors:
+            elements = [proc(e) for e in elements]
+        if self.mode == "single":
+            return [("\n\n".join(str(e) for e in elements), {})]
+        out = []
+        if self.mode == "paged":
+            pages: dict[int, list] = {}
+            for e in elements:
+                page = getattr(e.metadata, "page_number", 1) or 1
+                pages.setdefault(page, []).append(str(e))
+            for page, texts in sorted(pages.items()):
+                out.append(("\n\n".join(texts), {"page_number": page}))
+            return out
+        for e in elements:  # elements mode
+            meta = e.metadata.to_dict() if hasattr(e, "metadata") else {}
+            meta["category"] = type(e).__name__
+            out.append((str(e), meta))
+        return out
+
+
+class ParseOpenParse(udfs.UDF):
+    """openparse PDF layout parser (reference ParseOpenParse +
+    _openparse_utils.py): nodes with text/tables, optional vision LLM for
+    images. Requires the `openparse` package."""
+
+    def __init__(self, table_args: dict | None = None,
+                 parse_images: bool = False, llm=None, **kwargs):
+        super().__init__(**kwargs)
+        self.table_args = table_args
+        self.parse_images = parse_images
+        self.llm = llm
+
+    def __wrapped__(self, contents: Any, **kwargs) -> list[tuple[str, dict]]:
+        openparse = _import_or_raise("openparse", "ParseOpenParse")
+        import io
+        import tempfile
+
+        raw = contents if isinstance(contents, bytes) \
+            else str(contents).encode()
+        parser = openparse.DocumentParser(table_args=self.table_args)
+        with tempfile.NamedTemporaryFile(suffix=".pdf") as f:
+            f.write(raw)
+            f.flush()
+            doc = parser.parse(f.name)
+        return [(node.text, {"bbox": [list(b) for b in getattr(
+            node, "bbox", [])]}) for node in doc.nodes]
