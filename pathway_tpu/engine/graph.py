@@ -23,13 +23,14 @@ from pathway_tpu.engine.operators import Operator, SourceOperator
 
 
 class Node:
-    __slots__ = ("id", "op", "inputs", "name")
+    __slots__ = ("id", "op", "inputs", "name", "trace")
 
     def __init__(self, id: int, op: Operator, inputs: list["Node"], name: str = ""):
         self.id = id
         self.op = op
         self.inputs = inputs
         self.name = name
+        self.trace = None  # user-frame Trace set by the lowering
 
     def __repr__(self):
         return f"<Node {self.id} {self.name or type(self.op).__name__}>"
@@ -134,14 +135,24 @@ class Scheduler:
         outputs: dict[int, Delta] = {}
         for node in self._topo:
             in_deltas = [outputs.get(up.id, _EMPTY) for up in node.inputs]
-            delta = node.op.step(time, in_deltas)
-            extra = node.op.on_time_advance(time)
-            if extra:
-                delta = Delta(delta.entries + extra.entries).consolidate()
-            if flush:
-                held = node.op.flush(time)
-                if held:
-                    delta = Delta(delta.entries + held.entries).consolidate()
+            try:
+                delta = node.op.step(time, in_deltas)
+                extra = node.op.on_time_advance(time)
+                if extra:
+                    delta = Delta(delta.entries + extra.entries).consolidate()
+                if flush:
+                    held = node.op.flush(time)
+                    if held:
+                        delta = Delta(delta.entries + held.entries).consolidate()
+            except Exception as e:
+                from pathway_tpu.internals.trace import add_trace_note
+
+                # annotate rather than wrap: the original exception type must
+                # keep escaping pw.run() so user except-clauses still match
+                # (reference: trace.py add_pathway_trace_note)
+                add_trace_note(e, node.trace,
+                               node.name or type(node.op).__name__)
+                raise
             outputs[node.id] = delta
             if delta:
                 st = self.stats[node.id]

@@ -1,0 +1,113 @@
+"""Per-process HTTP monitoring endpoint.
+
+Rebuild of the reference's hyper-based server (src/engine/http_server.rs:77
+``start_http_server_thread`` + ``metrics_from_stats`` :25): serves
+``/status`` (JSON snapshot of runtime progress) and ``/metrics``
+(Prometheus/OpenMetrics text) on ``PATHWAY_MONITORING_HTTP_PORT +
+process_id`` (default base 20000, like the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def monitoring_port() -> int:
+    base = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000"))
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    return base + pid
+
+
+class MonitoringHttpServer:
+    def __init__(self, runtime, port: int | None = None):
+        self.runtime = runtime
+        self.port = port if port is not None else monitoring_port()
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- payloads ----------------------------------------------------------
+    def status_payload(self) -> dict:
+        sched = self.runtime.scheduler
+        graph = self.runtime.runner.graph
+        operators = []
+        for node in graph.nodes:
+            st = sched.stats.get(node.id, {})
+            operators.append({
+                "id": node.id,
+                "name": node.name or type(node.op).__name__,
+                "insertions": st.get("insertions", 0),
+                "retractions": st.get("retractions", 0),
+            })
+        return {
+            "process_id": int(os.environ.get("PATHWAY_PROCESS_ID", "0")),
+            "sources": len(self.runtime.sessions),
+            "operators": operators,
+        }
+
+    def metrics_payload(self) -> str:
+        # OpenMetrics text format, one family per counter kind
+        # (reference exposes input/output latency gauges + process metrics).
+        lines = [
+            "# TYPE pathway_tpu_insertions counter",
+            "# TYPE pathway_tpu_retractions counter",
+        ]
+        def esc(v: str) -> str:
+            # Prometheus exposition format label escaping
+            return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+                "\n", r"\n")
+
+        payload = self.status_payload()
+        for op in payload["operators"]:
+            labels = f'{{operator="{esc(op["name"])}",id="{op["id"]}"}}'
+            lines.append(f"pathway_tpu_insertions{labels} {op['insertions']}")
+            lines.append(f"pathway_tpu_retractions{labels} {op['retractions']}")
+        try:
+            import resource
+
+            rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            lines.append("# TYPE pathway_tpu_process_memory_max_bytes gauge")
+            lines.append(f"pathway_tpu_process_memory_max_bytes {rss_kb * 1024}")
+        except Exception:
+            pass
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    # -- server ------------------------------------------------------------
+    def start(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.rstrip("/") in ("", "/status"):
+                    body = json.dumps(server.status_payload()).encode()
+                    ctype = "application/json"
+                elif self.path.rstrip("/") == "/metrics":
+                    body = server.metrics_payload().encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="pathway-tpu-http")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -54,9 +54,12 @@ class StreamingRuntime:
         if self.persistence is not None:
             time_counter = self.persistence.restore_time() + 1
         for node, session, datasource in self.sessions:
+            live_session = session
             if self.persistence is not None:
-                self.persistence.attach_source(datasource, session)
-            self.threads.append(datasource.start(session))
+                # replay the durable prefix into `session`, then hand the
+                # reader a recording proxy that skips the replayed count
+                live_session = self.persistence.attach_source(datasource, session)
+            self.threads.append(datasource.start(live_session))
         if self.http_server is not None:
             self.http_server.start()
 
@@ -103,5 +106,7 @@ class StreamingRuntime:
                         self.persistence.commit(time_counter)
                     break
         finally:
+            if self.persistence is not None:
+                self.persistence.close()
             if self.http_server is not None:
                 self.http_server.stop()

@@ -114,6 +114,8 @@ class GraphRunner:
         if handler is None:
             raise NotImplementedError(f"no lowering for plan kind {plan.kind!r}")
         node = handler(table, plan)
+        if node.trace is None:
+            node.trace = getattr(plan, "trace", None)
         self._memo[key] = node
         return node
 

@@ -25,11 +25,14 @@ _table_ids = itertools.count()
 class Plan:
     """One logical operator producing a keyed table."""
 
-    __slots__ = ("kind", "params")
+    __slots__ = ("kind", "params", "trace")
 
     def __init__(self, kind: str, **params):
         self.kind = kind
         self.params = params
+        from pathway_tpu.internals.trace import trace_user_frame
+
+        self.trace = trace_user_frame()
 
     def __repr__(self):
         return f"<Plan {self.kind}>"

@@ -28,7 +28,11 @@ class Session:
         self._q: queue.Queue = queue.Queue()
         self.closed = threading.Event()
 
-    def push(self, key: Pointer, row: tuple, diff: int = 1) -> None:
+    def push(self, key: Pointer, row: tuple, diff: int = 1,
+             offset: Any = None) -> None:
+        # `offset` is the source's durable position for this entry; it is
+        # consumed by the persistence layer's RecordingSession proxy
+        # (engine/persistence.py) and ignored on the plain live path.
         self._q.put((key, row, diff))
 
     def drain(self) -> list[tuple]:

@@ -97,26 +97,90 @@ class FsSource(DataSource):
         self.with_metadata = with_metadata
         self.refresh_interval_s = refresh_interval_s
 
+    def seek(self, replayed: list) -> None:
+        """Persistence continuation (engine/persistence.py attach_source):
+        reconstruct per-file read state from the replayed snapshot entries
+        so run() neither re-emits durably-logged rows nor misses the tail
+        of a file whose rows were only partially committed before a crash.
+        Mirrors the reference's rewind-then-continue-from-offsets protocol
+        (src/connectors/mod.rs:215-368) with file-granular offsets."""
+        state: dict[str, dict] = {}
+        n_replayed_rows = 0
+        for key, row, diff, offset in replayed:
+            if diff < 0:
+                # retraction of an earlier emission: drop ONE instance of the
+                # key, from the originating file when the offset names it
+                if offset:
+                    targets = [state[offset[1]]] if offset[1] in state else []
+                else:
+                    targets = list(state.values())
+                for st in targets:
+                    for i in range(len(st["rows"]) - 1, -1, -1):
+                        if st["rows"][i][0] == key:
+                            del st["rows"][i]
+                            break
+                    else:
+                        continue
+                    break
+                continue
+            n_replayed_rows += 1
+            if not offset:
+                continue
+            kind, fkey, mtime, idx, is_last = offset
+            st = state.get(fkey)
+            if st is None or st["mtime"] != mtime:
+                st = state[fkey] = {"mtime": mtime, "rows": [], "last": False}
+            st["rows"].append((key, row))
+            st["last"] = bool(is_last)
+        # continue the key-seq counter past every replayed insertion so new
+        # rows never reuse a durably-logged key (keyless schemas hash seq)
+        self._resume_seq = n_replayed_rows
+        self._resume_seen = {}
+        self._resume_emitted = {}
+        self._resume_skip = {}
+        for fkey, st in state.items():
+            if not st["rows"]:
+                continue
+            self._resume_emitted[fkey] = list(st["rows"])
+            if st["last"]:
+                self._resume_seen[fkey] = st["mtime"]
+            else:
+                # logged rows are a prefix of the file at this mtime
+                self._resume_skip[fkey] = (st["mtime"], len(st["rows"]))
+
     def run(self, session: Session) -> None:
-        seen: dict[str, float] = {}
-        emitted: dict[str, list] = {}
-        seq = 0
+        seen: dict[str, float] = dict(getattr(self, "_resume_seen", {}))
+        emitted: dict[str, list] = dict(getattr(self, "_resume_emitted", {}))
+        resume_skip: dict[str, tuple] = dict(getattr(self, "_resume_skip", {}))
+        seq = getattr(self, "_resume_seq", 0)
         while True:
             for f in _list_files(self.path):
                 mtime = f.stat().st_mtime
                 fkey = str(f)
                 if fkey in seen and seen[fkey] == mtime:
                     continue
-                if fkey in emitted:
+                skip = 0
+                if fkey in resume_skip:
+                    r_mtime, r_count = resume_skip.pop(fkey)
+                    if r_mtime == mtime:
+                        # continue a partially-committed file from its prefix
+                        skip = r_count
+                if skip == 0 and fkey in emitted:
                     for key, row in emitted[fkey]:
-                        session.push(key, row, -1)
+                        session.push(key, row, -1, offset=("retract", fkey,
+                                                           mtime, 0, False))
                 seen[fkey] = mtime
-                rows = []
-                for values in _parse_file(f, self.format, self.schema,
-                                          self.with_metadata):
+                rows = list(emitted.get(fkey, [])) if skip else []
+                parsed = list(_parse_file(f, self.format, self.schema,
+                                          self.with_metadata))
+                for idx, values in enumerate(parsed):
+                    if idx < skip:
+                        continue
                     key, row = self.row_to_engine(values, seq)
                     seq += 1
-                    session.push(key, row, 1)
+                    is_last = idx == len(parsed) - 1
+                    session.push(key, row, 1,
+                                 offset=("row", fkey, mtime, idx, is_last))
                     rows.append((key, row))
                 emitted[fkey] = rows
             if self.mode != "streaming":
@@ -127,7 +191,8 @@ class FsSource(DataSource):
 def read(path: str, *, format: str = "plaintext", schema=None,
          mode: str = "streaming", csv_settings=None, json_field_paths=None,
          with_metadata: bool = False, autocommit_duration_ms: int | None = 1500,
-         name: str | None = None, **kwargs) -> Table:
+         name: str | None = None, persistent_id: str | None = None,
+         **kwargs) -> Table:
     the_schema = _schema_for(format, schema, with_metadata)
     if mode == "static":
         keys, rows = [], []
@@ -143,6 +208,7 @@ def read(path: str, *, format: str = "plaintext", schema=None,
         return Table(plan, the_schema, Universe(), name=name or "fs_static")
     source = FsSource(path, format, the_schema, mode, with_metadata,
                       autocommit_duration_ms=autocommit_duration_ms)
+    source.persistent_id = persistent_id or name
     return Table(Plan("input", datasource=source), the_schema, Universe(),
                  name=name or "fs_input")
 

@@ -88,10 +88,12 @@ class PythonSource(DataSource):
 
 def read(subject: ConnectorSubject, *, schema: type[sch.Schema] | None = None,
          format: str = "raw", autocommit_duration_ms: int | None = 1500,
-         name: str | None = None, **kwargs) -> Table:
+         name: str | None = None, persistent_id: str | None = None,
+         **kwargs) -> Table:
     if schema is None:
         schema = sch.schema_from_types(data=dt.ANY)
     source = PythonSource(subject, schema,
                           autocommit_duration_ms=autocommit_duration_ms)
+    source.persistent_id = persistent_id or name
     plan = Plan("input", datasource=source)
     return Table(plan, schema, Universe(), name=name or "python_input")

@@ -1,0 +1,75 @@
+"""HTTP monitoring endpoint + error-trace attribution
+(reference: src/engine/http_server.rs, internals/trace.py)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.http_server import MonitoringHttpServer
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+class _FakeNode:
+    def __init__(self, id, name):
+        self.id = id
+        self.name = name
+        self.op = object()
+
+
+class _FakeRuntime:
+    def __init__(self):
+        class Sched:
+            stats = {0: {"insertions": 7, "retractions": 2}}
+
+        class Graph:
+            nodes = [_FakeNode(0, "source:test")]
+
+        class Runner:
+            graph = Graph()
+
+        self.scheduler = Sched()
+        self.runner = Runner()
+        self.sessions = [1, 2]
+
+
+def test_http_status_and_metrics():
+    server = MonitoringHttpServer(_FakeRuntime(), port=0)  # ephemeral port
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        status = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert status["sources"] == 2
+        assert status["operators"][0]["insertions"] == 7
+        metrics = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert 'pathway_tpu_insertions{operator="source:test",id="0"} 7' in metrics
+        assert metrics.rstrip().endswith("# EOF")
+    finally:
+        server.stop()
+
+
+def test_engine_error_carries_user_trace():
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        0
+        """
+    )
+    bad = t.flatten(t.a)  # flattening an int column: TypeError in-operator
+    with pytest.raises(TypeError) as exc_info:  # original type preserved
+        pw.debug.compute_and_print(bad)
+    notes = "\n".join(getattr(exc_info.value, "__notes__", []))
+    assert "in operator" in notes
+    assert "test_monitoring_http.py" in notes
+    assert "flatten" in notes

@@ -1,0 +1,265 @@
+"""Checkpoint/resume (SURVEY §5 checkpoint; reference: src/persistence/ +
+integration_tests/wordcount kill-and-recover harness, test_recovery.py:25)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.persistence import SnapshotLog
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+# ---------------------------------------------------------------------------
+# snapshot log
+# ---------------------------------------------------------------------------
+
+def test_snapshot_log_roundtrip(tmp_path):
+    log = SnapshotLog(str(tmp_path / "s.snap"))
+    log.append(1, [("k1", ("a",), 1, None)])
+    log.append(2, [("k2", ("b",), 1, ("row", "f", 0.0, 0, True))])
+    log.close()
+    records = SnapshotLog(str(tmp_path / "s.snap")).read_all()
+    assert len(records) == 2
+    assert records[0] == (1, [("k1", ("a",), 1, None)])
+    assert records[1][1][0][3] == ("row", "f", 0.0, 0, True)
+
+
+def test_snapshot_log_truncated_tail(tmp_path):
+    path = str(tmp_path / "s.snap")
+    log = SnapshotLog(path)
+    log.append(1, [("k1", ("a",), 1, None)])
+    log.close()
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\x00\x00\x00\x00partial")  # crash mid-append
+    records = SnapshotLog(path).read_all()
+    assert len(records) == 1  # the torn record is dropped
+
+
+def test_snapshot_log_append_after_torn_tail(tmp_path):
+    """Appends after a torn record must stay readable (the torn bytes are
+    truncated first), or the log stops making durable progress forever."""
+    path = str(tmp_path / "s.snap")
+    log = SnapshotLog(path)
+    log.append(1, [("k1", ("a",), 1, None)])
+    log.close()
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\x00\x00\x00\x00partial")
+    log2 = SnapshotLog(path)
+    log2.append(2, [("k2", ("b",), 1, None)])
+    log2.close()
+    records = SnapshotLog(path).read_all()
+    assert [t for t, _ in records] == [1, 2]
+
+
+def test_duplicate_persistent_id_rejected(tmp_path):
+    from pathway_tpu.engine.persistence import PersistenceDriver
+    from pathway_tpu.io._datasource import CallbackSource, Session
+
+    cfg = pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(str(tmp_path / "p")))
+    driver = PersistenceDriver(cfg)
+    schema = pw.schema_from_types(x=int)
+    s1 = CallbackSource(lambda: iter(()), schema)
+    s1.persistent_id = "dup"
+    s2 = CallbackSource(lambda: iter(()), schema)
+    s2.persistent_id = "dup"
+    driver.attach_source(s1, Session())
+    with pytest.raises(ValueError, match="unique persistent_id"):
+        driver.attach_source(s2, Session())
+
+
+# ---------------------------------------------------------------------------
+# in-process resume: python source (skip-N protocol)
+# ---------------------------------------------------------------------------
+
+def _run_counts(words: list[str], backend) -> dict[str, int]:
+    """Stream `words`, persist via `backend`, return final word counts."""
+    G.clear()
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            for w in words:
+                self.next(word=w)
+
+    t = pw.io.python.read(
+        Subject(), schema=pw.schema_from_types(word=str),
+        autocommit_duration_ms=10, persistent_id="words")
+    counts = t.groupby(t.word).reduce(word=t.word, c=pw.reducers.count())
+    state: dict[str, int] = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            state[row["word"]] = row["c"]
+        elif state.get(row["word"]) == row["c"]:
+            del state[row["word"]]
+
+    pw.io.subscribe(counts, on_change)
+    pw.run(persistence_config=pw.persistence.Config.simple_config(backend))
+    return state
+
+
+def test_python_source_resume_mock_backend():
+    backend = pw.persistence.Backend.mock()
+    first = _run_counts(["a", "b", "a"], backend)
+    assert first == {"a": 2, "b": 1}
+    # restart: the source deterministically re-emits its prefix, plus new rows
+    second = _run_counts(["a", "b", "a", "c", "b"], backend)
+    assert second == {"a": 2, "b": 2, "c": 1}  # no double counting
+
+
+def test_python_source_resume_filesystem_backend(tmp_path):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    first = _run_counts(["x", "y"], backend)
+    assert first == {"x": 1, "y": 1}
+    assert os.path.exists(tmp_path / "pstate" / "streams" / "words.snap")
+    second = _run_counts(["x", "y", "x"], backend)
+    assert second == {"x": 2, "y": 1}
+
+
+# ---------------------------------------------------------------------------
+# in-process resume: fs source (seek protocol, file-granular offsets)
+# ---------------------------------------------------------------------------
+
+def _run_fs_counts(input_dir, backend) -> dict[str, int]:
+    G.clear()
+    t = pw.io.fs.read(str(input_dir), format="plaintext", mode="batch",
+                      autocommit_duration_ms=10, persistent_id="fsrc")
+    counts = t.groupby(t.data).reduce(w=t.data, c=pw.reducers.count())
+    state: dict[str, int] = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            state[row["w"]] = row["c"]
+        elif state.get(row["w"]) == row["c"]:
+            del state[row["w"]]
+
+    pw.io.subscribe(counts, on_change)
+    pw.run(persistence_config=pw.persistence.Config.simple_config(backend))
+    return state
+
+
+def test_fs_source_resume_new_files(tmp_path):
+    inp = tmp_path / "in"
+    inp.mkdir()
+    (inp / "a.txt").write_text("w1\nw2\n")
+    (inp / "b.txt").write_text("w1\n")
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    first = _run_fs_counts(inp, backend)
+    assert first == {"w1": 2, "w2": 1}
+    # restart with one new file: completed files must not re-emit
+    (inp / "c.txt").write_text("w2\nw3\n")
+    second = _run_fs_counts(inp, backend)
+    assert second == {"w1": 2, "w2": 2, "w3": 1}
+
+
+def test_fs_source_resume_changed_file(tmp_path):
+    inp = tmp_path / "in"
+    inp.mkdir()
+    (inp / "a.txt").write_text("old1\nold2\n")
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    first = _run_fs_counts(inp, backend)
+    assert first == {"old1": 1, "old2": 1}
+    # file rewritten between runs: replayed rows must be retracted
+    (inp / "a.txt").write_text("new1\n")
+    os.utime(inp / "a.txt", (time.time() + 5, time.time() + 5))
+    second = _run_fs_counts(inp, backend)
+    assert second == {"new1": 1}
+
+
+# ---------------------------------------------------------------------------
+# kill-and-recover wordcount (subprocess; tier-4 of SURVEY §4)
+# ---------------------------------------------------------------------------
+
+_WORDCOUNT = textwrap.dedent("""
+    import sys
+    import pathway_tpu as pw
+
+    inp, pdir, out = sys.argv[1], sys.argv[2], sys.argv[3]
+    t = pw.io.fs.read(inp, format="plaintext", mode="streaming",
+                      autocommit_duration_ms=40, persistent_id="words")
+    counts = t.groupby(t.data).reduce(word=t.data, c=pw.reducers.count())
+    pw.io.fs.write(counts, out, format="csv")
+    pw.run(persistence_config=pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(pdir)))
+""")
+
+
+def _read_counts(out_path) -> dict[str, int]:
+    import csv
+
+    state: dict[str, int] = {}
+    try:
+        with open(out_path, newline="") as f:
+            for row in csv.DictReader(f):
+                w, c, d = row["word"], int(row["c"]), int(row["diff"])
+                if d > 0:
+                    state[w] = c
+                elif state.get(w) == c:
+                    del state[w]
+    except (FileNotFoundError, KeyError, ValueError):
+        return {}
+    return state
+
+
+@pytest.mark.slow
+def test_wordcount_kill_and_recover(tmp_path):
+    inp = tmp_path / "in"
+    inp.mkdir()
+    pdir = str(tmp_path / "pstate")
+    out = str(tmp_path / "out.csv")
+    script = tmp_path / "wc.py"
+    script.write_text(_WORDCOUNT)
+
+    n_files, per_file = 6, 25
+    expected: dict[str, int] = {}
+    for i in range(3):  # first half of the input exists up-front
+        words = [f"w{j % 7}" for j in range(per_file)]
+        (inp / f"{i:03d}.txt").write_text("\n".join(words) + "\n")
+        for w in words:
+            expected[w] = expected.get(w, 0) + 1
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    proc = subprocess.Popen([sys.executable, str(script), str(inp), pdir, out],
+                            env=env, cwd="/root/repo")
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not _read_counts(out):
+            time.sleep(0.1)
+        assert _read_counts(out), "no output before kill"
+        proc.send_signal(signal.SIGKILL)  # crash mid-stream
+        proc.wait()
+
+        for i in range(3, n_files):  # rest of the input arrives after crash
+            words = [f"w{j % 5}" for j in range(per_file)]
+            (inp / f"{i:03d}.txt").write_text("\n".join(words) + "\n")
+            for w in words:
+                expected[w] = expected.get(w, 0) + 1
+
+        proc = subprocess.Popen(
+            [sys.executable, str(script), str(inp), pdir, out],
+            env=env, cwd="/root/repo")
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if _read_counts(out) == expected:
+                break
+            time.sleep(0.2)
+        assert _read_counts(out) == expected
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
