@@ -1,0 +1,160 @@
+// Native BM25 full-text index engine.
+//
+// TPU-native equivalent of the reference's TantivyIndex
+// (src/external_integration/tantivy_integration.rs:16 — the Rust tantivy
+// crate): text scoring is pointer-chasing with no MXU shape, so like the
+// reference it lives in native code on the host. C ABI consumed via ctypes
+// from pathway_tpu/native/__init__.py; doc ids are u64 handles mapped to
+// engine Pointers python-side (the reference's KeyToU64IdMapper,
+// external_integration/mod.rs:205).
+//
+// Build: g++ -O2 -shared -fPIC (driven by pathway_tpu/native/build.py).
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Posting {
+    // doc id -> term frequency
+    std::unordered_map<uint64_t, uint32_t> tf;
+};
+
+struct TextIndex {
+    double k1;
+    double b;
+    std::unordered_map<std::string, Posting> postings;
+    std::unordered_map<uint64_t, uint32_t> doc_len;
+    std::unordered_map<uint64_t, std::vector<std::string>> doc_tokens;
+    uint64_t total_len = 0;
+    std::mutex mu;
+};
+
+void tokenize(const char* text, std::vector<std::string>& out) {
+    out.clear();
+    if (text == nullptr) return;
+    std::string cur;
+    for (const char* p = text; *p; ++p) {
+        unsigned char c = static_cast<unsigned char>(*p);
+        if (std::isalnum(c) || c == '_') {
+            cur.push_back(static_cast<char>(std::tolower(c)));
+        } else if (!cur.empty()) {
+            out.push_back(cur);
+            cur.clear();
+        }
+    }
+    if (!cur.empty()) out.push_back(cur);
+}
+
+void remove_locked(TextIndex* idx, uint64_t id) {
+    auto it = idx->doc_tokens.find(id);
+    if (it == idx->doc_tokens.end()) return;
+    for (const std::string& tok : it->second) {
+        auto pit = idx->postings.find(tok);
+        if (pit == idx->postings.end()) continue;
+        auto fit = pit->second.tf.find(id);
+        if (fit != pit->second.tf.end()) {
+            if (fit->second <= 1) {
+                pit->second.tf.erase(fit);
+                if (pit->second.tf.empty()) idx->postings.erase(pit);
+            } else {
+                --fit->second;
+            }
+        }
+    }
+    idx->total_len -= idx->doc_len[id];
+    idx->doc_len.erase(id);
+    idx->doc_tokens.erase(it);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ti_new(double k1, double b) {
+    auto* idx = new TextIndex();
+    idx->k1 = k1;
+    idx->b = b;
+    return idx;
+}
+
+void ti_free(void* h) { delete static_cast<TextIndex*>(h); }
+
+void ti_add(void* h, uint64_t id, const char* text) {
+    auto* idx = static_cast<TextIndex*>(h);
+    std::lock_guard<std::mutex> lock(idx->mu);
+    remove_locked(idx, id);  // re-add semantics match ops/bm25.py add()
+    std::vector<std::string> tokens;
+    tokenize(text, tokens);
+    idx->doc_len[id] = static_cast<uint32_t>(tokens.size());
+    idx->total_len += tokens.size();
+    for (const std::string& tok : tokens) {
+        ++idx->postings[tok].tf[id];
+    }
+    idx->doc_tokens[id] = std::move(tokens);
+}
+
+void ti_remove(void* h, uint64_t id) {
+    auto* idx = static_cast<TextIndex*>(h);
+    std::lock_guard<std::mutex> lock(idx->mu);
+    remove_locked(idx, id);
+}
+
+uint64_t ti_len(void* h) {
+    auto* idx = static_cast<TextIndex*>(h);
+    std::lock_guard<std::mutex> lock(idx->mu);
+    return idx->doc_len.size();
+}
+
+// Okapi BM25 (same formula as ops/bm25.py _score_query; ties broken by
+// ascending doc id). Writes up to k (id, score) pairs; returns the count.
+int32_t ti_search(void* h, const char* query, int32_t k, uint64_t* out_ids,
+                  double* out_scores) {
+    auto* idx = static_cast<TextIndex*>(h);
+    std::lock_guard<std::mutex> lock(idx->mu);
+    const size_t n_docs = idx->doc_len.size();
+    if (n_docs == 0 || k <= 0) return 0;
+    const double avg_len =
+        static_cast<double>(idx->total_len) / static_cast<double>(n_docs);
+
+    std::vector<std::string> tokens;
+    tokenize(query, tokens);
+    std::unordered_map<uint64_t, double> scores;
+    for (const std::string& tok : tokens) {
+        auto pit = idx->postings.find(tok);
+        if (pit == idx->postings.end()) continue;
+        const double df = static_cast<double>(pit->second.tf.size());
+        const double idf =
+            std::log(1.0 + (static_cast<double>(n_docs) - df + 0.5) / (df + 0.5));
+        for (const auto& [id, tf] : pit->second.tf) {
+            const double dl = static_cast<double>(idx->doc_len[id]);
+            const double denom =
+                tf + idx->k1 * (1.0 - idx->b + idx->b * dl / avg_len);
+            scores[id] += idf * (tf * (idx->k1 + 1.0)) / denom;
+        }
+    }
+
+    std::vector<std::pair<uint64_t, double>> ranked(scores.begin(),
+                                                    scores.end());
+    const size_t want = std::min(static_cast<size_t>(k), ranked.size());
+    std::partial_sort(
+        ranked.begin(), ranked.begin() + want, ranked.end(),
+        [](const auto& a, const auto& b) {
+            if (a.second != b.second) return a.second > b.second;
+            return a.first < b.first;
+        });
+    for (size_t i = 0; i < want; ++i) {
+        out_ids[i] = ranked[i].first;
+        out_scores[i] = ranked[i].second;
+    }
+    return static_cast<int32_t>(want);
+}
+
+}  // extern "C"

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from pathway_tpu.internals import dtype as _dt
 from pathway_tpu.internals import reducers_frontend as reducers
+from pathway_tpu.internals.reducers_frontend import BaseCustomAccumulator  # noqa: F401
 from pathway_tpu.internals import universes  # noqa: F401
 from pathway_tpu.internals.dtype import DType
 from pathway_tpu.internals.error import global_error_log
@@ -106,7 +107,7 @@ def assert_table_has_columns(table: Table, columns) -> None:
 __all__ = [
     "Table", "Schema", "Json", "Pointer", "DType", "TableSlice",
     "this", "left", "right",
-    "apply", "apply_async", "apply_with_type", "cast", "coalesce",
+    "apply", "apply_async", "apply_with_type", "BaseCustomAccumulator", "cast", "coalesce",
     "declare_type", "fill_error", "if_else", "make_tuple", "require",
     "unwrap", "iterate", "udf", "UDF", "sql", "load_yaml",
     "run", "run_all", "debug", "demo", "io", "reducers", "persistence",

@@ -1,0 +1,5 @@
+"""``python -m pathway_tpu`` → the CLI (reference: `pathway` console script)."""
+
+from pathway_tpu.cli import main
+
+main()

@@ -1,0 +1,144 @@
+"""``pathway-tpu`` command line interface
+(reference: python/pathway/cli.py:53-280 — spawn / replay / spawn-from-env).
+
+``spawn -t T -n N program.py`` forks N processes of the user program with
+``PATHWAY_THREADS/PROCESSES/PROCESS_ID/FIRST_PORT/RUN_ID`` set — each
+process hosts its shard of the device mesh (the reference's timely cluster
+topology, re-aimed at multi-host TPU). ``replay`` re-runs a program against
+a recorded snapshot directory with batch/speedrun timing, optionally
+continuing live afterwards. Recording/replay wiring rides the persistence
+env vars consumed by ``pw.run`` (internals/run.py)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import uuid
+
+import click
+
+import pathway_tpu as pw
+
+
+def _plural(n: int, singular: str, plural: str) -> str:
+    return f"{n} {singular if n == 1 else plural}"
+
+
+def spawn_program(*, threads: int, processes: int, first_port: int,
+                  program: str, arguments: tuple[str, ...], env_base: dict):
+    click.echo(
+        f"Preparing {_plural(processes, 'process', 'processes')} "
+        f"({_plural(processes * threads, 'total worker', 'total workers')})",
+        err=True)
+    run_id = str(uuid.uuid4())
+    handles = []
+    try:
+        for process_id in range(processes):
+            env = dict(env_base)
+            env["PATHWAY_THREADS"] = str(threads)
+            env["PATHWAY_PROCESSES"] = str(processes)
+            env["PATHWAY_FIRST_PORT"] = str(first_port)
+            env["PATHWAY_PROCESS_ID"] = str(process_id)
+            env["PATHWAY_RUN_ID"] = run_id
+            handles.append(subprocess.Popen([program, *arguments], env=env))
+        for handle in handles:
+            handle.wait()
+    finally:
+        for handle in handles:
+            if handle.poll() is None:
+                handle.terminate()
+    sys.exit(max((h.returncode or 0) for h in handles))
+
+
+@click.group()
+@click.version_option(version=pw.__version__, prog_name="pathway-tpu")
+def cli() -> None:
+    pass
+
+
+_spawn_opts = [
+    click.option("-t", "--threads", metavar="N", type=int, default=1,
+                 help="number of threads per process"),
+    click.option("-n", "--processes", metavar="N", type=int, default=1,
+                 help="number of processes"),
+    click.option("--first-port", type=int, metavar="PORT", default=10000,
+                 help="first port to use for communication"),
+]
+
+
+def _apply(opts, f):
+    for opt in reversed(opts):
+        f = opt(f)
+    return f
+
+
+@cli.command(context_settings={"allow_interspersed_args": False,
+                               "show_default": True})
+@click.option("--record", is_flag=True,
+              help="record data from connectors while running")
+@click.option("--record-path", type=str, default="record",
+              help="directory in which recording is stored")
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+@click.pass_context
+def spawn(ctx, record, record_path, program, arguments,
+          threads=1, processes=1, first_port=10000):
+    env = os.environ.copy()
+    if record:
+        env["PATHWAY_REPLAY_STORAGE"] = record_path
+        env["PATHWAY_SNAPSHOT_ACCESS"] = "record"
+        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    spawn_program(threads=threads, processes=processes,
+                  first_port=first_port, program=program,
+                  arguments=arguments, env_base=env)
+
+
+spawn = _apply(_spawn_opts, spawn)
+
+
+@cli.command(context_settings={"allow_interspersed_args": False,
+                               "show_default": True})
+@click.option("--record-path", type=str, default="record",
+              help="directory in which recording is stored")
+@click.option("--mode",
+              type=click.Choice(["batch", "speedrun"], case_sensitive=False),
+              help="mode of replaying data")
+@click.option("--continue", "continue_after_replay", is_flag=True,
+              help="continue with realtime data after the recording replays")
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def replay(record_path, mode, continue_after_replay, program, arguments,
+           threads=1, processes=1, first_port=10000):
+    env = os.environ.copy()
+    env["PATHWAY_REPLAY_STORAGE"] = record_path
+    env["PATHWAY_SNAPSHOT_ACCESS"] = "replay"
+    if mode:
+        env["PATHWAY_PERSISTENCE_MODE"] = (
+            "batch" if mode.lower() == "batch" else "speedrun_replay")
+    if continue_after_replay:
+        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    spawn_program(threads=threads, processes=processes,
+                  first_port=first_port, program=program,
+                  arguments=arguments, env_base=env)
+
+
+replay = _apply(_spawn_opts, replay)
+
+
+@cli.command()
+def spawn_from_env():
+    """Run ``spawn`` with arguments taken from PATHWAY_SPAWN_ARGS
+    (reference cli.py:125 — the container entrypoint hook)."""
+    args = os.environ.get("PATHWAY_SPAWN_ARGS")
+    if args:
+        cli.main(args=["spawn", *args.split(" ")],
+                 prog_name="pathway-tpu", standalone_mode=True)
+
+
+def main() -> None:
+    cli.main(prog_name="pathway-tpu")
+
+
+if __name__ == "__main__":
+    main()

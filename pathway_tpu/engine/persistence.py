@@ -41,29 +41,12 @@ class SnapshotLog:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         self._f = None
 
-    def read_all(self) -> list[tuple[int, list]]:
-        records = []
+    def _scan(self) -> tuple[list[tuple[int, list]], int]:
+        """(intact records, byte offset of the end of the last intact one).
+        A torn tail record — crash mid-append — is excluded from both."""
+        records: list = []
         if not os.path.exists(self.path):
-            return records
-        with open(self.path, "rb") as f:
-            data = f.read()
-        pos = 0
-        while pos + _LEN.size <= len(data):
-            (length,) = _LEN.unpack_from(data, pos)
-            if pos + _LEN.size + length > len(data):
-                break  # truncated tail: crash mid-append; drop it
-            try:
-                rec = pickle.loads(data[pos + _LEN.size:pos + _LEN.size + length])
-            except Exception:
-                break
-            records.append(rec)
-            pos += _LEN.size + length
-        return records
-
-    def _valid_length(self) -> int:
-        """Byte offset of the end of the last intact record."""
-        if not os.path.exists(self.path):
-            return 0
+            return records, 0
         with open(self.path, "rb") as f:
             data = f.read()
         pos = 0
@@ -73,18 +56,21 @@ class SnapshotLog:
             if end > len(data):
                 break
             try:
-                pickle.loads(data[pos + _LEN.size:end])
+                rec = pickle.loads(data[pos + _LEN.size:end])
             except Exception:
                 break
+            records.append(rec)
             pos = end
-        return pos
+        return records, pos
+
+    def read_all(self) -> list[tuple[int, list]]:
+        return self._scan()[0]
 
     def append(self, time: int, entries: list) -> None:
         if self._f is None:
-            # a torn tail record (crash mid-append in an earlier run) must be
-            # truncated before appending, or every later record would sit
-            # behind unreadable bytes and be lost to read_all forever
-            valid = self._valid_length()
+            # truncate any torn tail record before appending, or every later
+            # record would sit behind unreadable bytes forever
+            _records, valid = self._scan()
             self._f = open(self.path, "ab")
             if self._f.tell() != valid:
                 self._f.truncate(valid)
@@ -155,8 +141,14 @@ class PersistenceDriver:
         backend = config.backend
         self.kind = backend.kind
         if self.kind in ("filesystem", "s3", "azure"):
-            # s3/azure clients are not in-image; their on-disk layout is
-            # identical, so treat root_path as a local staging directory.
+            if self.kind != "filesystem":
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s persistence backend: no cloud client in this build — "
+                    "writing snapshots to LOCAL path %r. State will not "
+                    "survive loss of this machine's disk.",
+                    self.kind, backend.path)
             self.root = backend.path
             os.makedirs(os.path.join(self.root, "streams"), exist_ok=True)
         elif self.kind == "mock":

@@ -217,7 +217,10 @@ def _sort_key(v):
         return (1, float(v))
     if isinstance(v, str):
         return (2, v)
-    return (3, repr(v))
+    if isinstance(v, (tuple, list)):
+        # element-wise, not repr: (10, k) must sort after (5, k)
+        return (3, tuple(_sort_key(x) for x in v))
+    return (4, repr(v))
 
 
 class _EarliestState(ReducerState):
@@ -268,12 +271,13 @@ class _StatefulState(ReducerState):
     (src/engine/reduce.rs Stateful{combine_fn}). Only supports additions;
     retraction raises like the reference does on append-only violation."""
 
-    __slots__ = ("fn", "state", "n")
+    __slots__ = ("fn", "state", "n", "emit_fn")
 
-    def __init__(self, fn: Callable):
+    def __init__(self, fn: Callable, emit: Callable | None = None):
         self.fn = fn
         self.state = None
         self.n = 0
+        self.emit_fn = emit
 
     def add(self, args, diff):
         if diff < 0:
@@ -284,6 +288,10 @@ class _StatefulState(ReducerState):
         self.state = self.fn(self.state, [args])
 
     def emit(self):
+        # emit_fn: custom-accumulator result extraction (compute_result in
+        # the reference's BaseCustomAccumulator protocol)
+        if self.emit_fn is not None:
+            return self.emit_fn(self.state)
         return self.state
 
     def is_empty(self):

@@ -53,13 +53,22 @@ class StreamingRuntime:
         time_counter = 1
         if self.persistence is not None:
             time_counter = self.persistence.restore_time() + 1
+        replay_only = (
+            self.persistence is not None
+            and not getattr(self.persistence.config, "continue_after_replay",
+                            True))
         for node, session, datasource in self.sessions:
             live_session = session
             if self.persistence is not None:
                 # replay the durable prefix into `session`, then hand the
                 # reader a recording proxy that skips the replayed count
                 live_session = self.persistence.attach_source(datasource, session)
-            self.threads.append(datasource.start(live_session))
+            if replay_only:
+                # pure replay (CLI `replay` without --continue): process the
+                # recorded prefix only — no live reader threads
+                session.close()
+            else:
+                self.threads.append(datasource.start(live_session))
         if self.http_server is not None:
             self.http_server.start()
 

@@ -66,7 +66,13 @@ def _encode_value(value: Any, out: list) -> None:
     elif isinstance(value, Pointer):
         out.append(b"\x02" + int(value).to_bytes(16, "little"))
     elif isinstance(value, (int, np.integer)):
-        out.append(b"\x03" + struct.pack("<q", int(value)))
+        v = int(value)
+        if -(2**63) <= v < 2**63:
+            out.append(b"\x03" + struct.pack("<q", v))
+        else:
+            # arbitrary-precision ints (e.g. raw 128-bit pointer values)
+            b = v.to_bytes((v.bit_length() + 8) // 8, "little", signed=True)
+            out.append(b"\x0b" + struct.pack("<q", len(b)) + b)
     elif isinstance(value, (float, np.floating)):
         f = float(value)
         if math.isfinite(f) and f == int(f) and abs(f) < 2**62:

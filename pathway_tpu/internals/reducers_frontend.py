@@ -77,8 +77,46 @@ def stateful_many(combine_fn: Callable, *args) -> ex.ReducerExpression:
     return ex.ReducerExpression("stateful", *args, fn=combine_fn)
 
 
+class BaseCustomAccumulator:
+    """Custom-reducer protocol (reference: pw.BaseCustomAccumulator):
+    ``from_row(row)`` builds a one-row accumulator, ``update(other)`` folds
+    another accumulator in, ``compute_result()`` extracts the emitted value.
+    Use with :func:`udf_reducer`."""
+
+    @classmethod
+    def from_row(cls, row):
+        raise NotImplementedError
+
+    def update(self, other) -> None:
+        raise NotImplementedError
+
+    def compute_result(self):
+        raise NotImplementedError
+
+
 def udf_reducer(reducer_cls):
-    """Decorator-compatible custom reducer hook (subset of reference API)."""
+    """Turn a BaseCustomAccumulator subclass (or a legacy
+    ``update(state, *row)`` class) into a reducer factory."""
+
+    if isinstance(reducer_cls, type) and issubclass(reducer_cls,
+                                                    BaseCustomAccumulator):
+        def make(*args):
+            def combine(state, rows):
+                for row in rows:
+                    acc = reducer_cls.from_row(list(row))
+                    if state is None:
+                        state = acc
+                    else:
+                        state.update(acc)
+                return state
+
+            def emit(state):
+                return state.compute_result()
+
+            return ex.ReducerExpression("stateful", *args, fn=combine,
+                                        emit=emit)
+
+        return make
 
     def make(*args):
         acc = reducer_cls()

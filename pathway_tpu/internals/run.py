@@ -22,6 +22,8 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
     runner = GraphRunner()
     for binder in G.output_binders:
         binder(runner)
+    if persistence_config is None:
+        persistence_config = _persistence_config_from_env()
     if persistence_config is not None:
         runner._persistence_config = persistence_config
     if runner._stream_subjects:
@@ -39,3 +41,27 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
 
 def run_all(**kwargs):
     return run(**kwargs)
+
+
+def _persistence_config_from_env():
+    """Record/replay wiring set by the CLI (cli.py spawn --record / replay):
+    PATHWAY_REPLAY_STORAGE + PATHWAY_SNAPSHOT_ACCESS + PATHWAY_PERSISTENCE_MODE
+    + PATHWAY_CONTINUE_AFTER_REPLAY (reference: cli.py:178-187, engine env)."""
+    import os
+
+    path = os.environ.get("PATHWAY_REPLAY_STORAGE") or os.environ.get(
+        "PATHWAY_PERSISTENT_STORAGE")
+    if not path:
+        return None
+    from pathway_tpu import persistence
+
+    mode = os.environ.get("PATHWAY_PERSISTENCE_MODE", "persisting")
+    cont = os.environ.get("PATHWAY_CONTINUE_AFTER_REPLAY", "")
+    access = os.environ.get("PATHWAY_SNAPSHOT_ACCESS", "")
+    continue_after_replay = cont.lower() in ("1", "true", "yes") or (
+        access == "record")
+    return persistence.Config(
+        backend=persistence.Backend.filesystem(path),
+        persistence_mode=mode,
+        continue_after_replay=continue_after_replay,
+    )

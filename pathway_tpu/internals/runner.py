@@ -253,6 +253,8 @@ class GraphRunner:
                 spec_kwargs["skip_nones"] = kwargs.get("skip_nones", False)
             if name == "stateful":
                 spec_kwargs["fn"] = fn
+                if kwargs.get("emit") is not None:
+                    spec_kwargs["emit"] = kwargs["emit"]
             if name == "argmin":
                 def extract(key, row, _fns=arg_fns):
                     vals = [f([key], [row])[0] for f in _fns]

@@ -1,0 +1,742 @@
+"""Hand-rolled SQL front-end for ``pw.sql``.
+
+The reference compiles a sqlglot AST to Table ops
+(python/pathway/internals/sql.py:63-726). sqlglot is not in this image, so
+this module provides its own tokenizer + recursive-descent parser for the
+same subset — SELECT / WHERE / GROUP BY / HAVING / JOIN (inner, left,
+right, outer, cross) / UNION [ALL] / INTERSECT / WITH / DISTINCT — and
+compiles it to the same Table-DSL calls the reference emits (select,
+filter, groupby+reduce, join, concat_reindex).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals import reducers_frontend as reducers
+from pathway_tpu.internals.table import Table
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    \s+
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<number>\d+\.\d*|\.\d+|\d+)
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9]*|"[^"]+")
+  | (?P<op><>|!=|<=|>=|\|\||[=<>+\-*/%(),.])
+    """,
+    re.VERBOSE,
+)
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "AS", "JOIN",
+    "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "ON", "AND", "OR",
+    "NOT", "NULL", "TRUE", "FALSE", "IN", "IS", "BETWEEN", "LIKE", "CASE",
+    "WHEN", "THEN", "ELSE", "END", "UNION", "ALL", "INTERSECT", "WITH",
+    "DISTINCT",
+}
+
+
+@dataclass
+class Token:
+    kind: str  # 'kw' | 'ident' | 'number' | 'string' | 'op' | 'eof'
+    value: str
+
+
+def tokenize(query: str) -> list[Token]:
+    tokens: list[Token] = []
+    pos = 0
+    while pos < len(query):
+        m = _TOKEN_RE.match(query, pos)
+        if m is None:
+            raise ValueError(f"SQL syntax error at: {query[pos:pos + 20]!r}")
+        pos = m.end()
+        if m.lastgroup is None:
+            continue
+        text = m.group(m.lastgroup)
+        if m.lastgroup == "string":
+            tokens.append(Token("string", text[1:-1].replace("''", "'")))
+        elif m.lastgroup == "number":
+            tokens.append(Token("number", text))
+        elif m.lastgroup == "ident":
+            if text.startswith('"'):
+                tokens.append(Token("ident", text[1:-1]))
+            elif text.upper() in _KEYWORDS:
+                tokens.append(Token("kw", text.upper()))
+            else:
+                tokens.append(Token("ident", text))
+        else:
+            tokens.append(Token("op", text))
+    tokens.append(Token("eof", ""))
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TableRef:
+    name: str | None = None
+    subquery: Any = None  # SelectStmt | compound tuple
+    alias: str | None = None
+
+
+@dataclass
+class JoinClause:
+    kind: str  # inner | left | right | outer | cross
+    table: TableRef = None
+    on: Any = None
+
+
+@dataclass
+class SelectStmt:
+    items: list = field(default_factory=list)  # (expr, alias|None) | ('*',)
+    from_table: TableRef | None = None
+    joins: list = field(default_factory=list)
+    where: Any = None
+    group_by: list = field(default_factory=list)
+    having: Any = None
+    distinct: bool = False
+
+
+_AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+
+
+class Parser:
+    def __init__(self, tokens: list[Token]):
+        self.tokens = tokens
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    def peek(self) -> Token:
+        return self.tokens[self.i]
+
+    def next(self) -> Token:
+        tok = self.tokens[self.i]
+        self.i += 1
+        return tok
+
+    def accept(self, kind: str, value: str | None = None) -> Token | None:
+        tok = self.peek()
+        if tok.kind == kind and (value is None or tok.value == value):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, value: str | None = None) -> Token:
+        tok = self.accept(kind, value)
+        if tok is None:
+            got = self.peek()
+            raise ValueError(
+                f"SQL parse error: expected {value or kind}, got "
+                f"{got.value or got.kind!r}")
+        return tok
+
+    # -- statement ---------------------------------------------------------
+    def parse(self):
+        ctes = {}
+        if self.accept("kw", "WITH"):
+            while True:
+                name = self.expect("ident").value
+                self.expect("kw", "AS")
+                self.expect("op", "(")
+                ctes[name] = self.parse_compound()
+                self.expect("op", ")")
+                if not self.accept("op", ","):
+                    break
+        stmt = self.parse_compound()
+        self.expect("eof")
+        return ctes, stmt
+
+    def parse_compound(self):
+        left = self.parse_select()
+        while True:
+            if self.accept("kw", "UNION"):
+                all_flag = self.accept("kw", "ALL") is not None
+                right = self.parse_select()
+                left = ("union", all_flag, left, right)
+            elif self.accept("kw", "INTERSECT"):
+                right = self.parse_select()
+                left = ("intersect", left, right)
+            else:
+                return left
+
+    def parse_select(self) -> SelectStmt:
+        self.expect("kw", "SELECT")
+        stmt = SelectStmt()
+        stmt.distinct = self.accept("kw", "DISTINCT") is not None
+        while True:
+            if self.accept("op", "*"):
+                stmt.items.append(("*",))
+            else:
+                expr = self.parse_expr()
+                alias = None
+                if self.accept("kw", "AS"):
+                    alias = self.expect("ident").value
+                elif self.peek().kind == "ident":
+                    alias = self.next().value
+                stmt.items.append((expr, alias))
+            if not self.accept("op", ","):
+                break
+        if self.accept("kw", "FROM"):
+            stmt.from_table = self.parse_table_ref()
+            while True:
+                kind = None
+                if self.accept("kw", "CROSS"):
+                    kind = "cross"
+                elif self.accept("kw", "INNER"):
+                    kind = "inner"
+                elif self.accept("kw", "LEFT"):
+                    self.accept("kw", "OUTER")
+                    kind = "left"
+                elif self.accept("kw", "RIGHT"):
+                    self.accept("kw", "OUTER")
+                    kind = "right"
+                elif self.accept("kw", "FULL"):
+                    self.accept("kw", "OUTER")
+                    kind = "outer"
+                elif self.peek().kind == "kw" and self.peek().value == "JOIN":
+                    kind = "inner"
+                if kind is None:
+                    break
+                self.expect("kw", "JOIN")
+                ref = self.parse_table_ref()
+                on = None
+                if kind != "cross":
+                    self.expect("kw", "ON")
+                    on = self.parse_expr()
+                stmt.joins.append(JoinClause(kind, ref, on))
+        if self.accept("kw", "WHERE"):
+            stmt.where = self.parse_expr()
+        if self.accept("kw", "GROUP"):
+            self.expect("kw", "BY")
+            while True:
+                stmt.group_by.append(self.parse_expr())
+                if not self.accept("op", ","):
+                    break
+        if self.accept("kw", "HAVING"):
+            stmt.having = self.parse_expr()
+        return stmt
+
+    def parse_table_ref(self) -> TableRef:
+        if self.accept("op", "("):
+            sub = self.parse_compound()
+            self.expect("op", ")")
+            ref = TableRef(subquery=sub)
+        else:
+            ref = TableRef(name=self.expect("ident").value)
+        if self.accept("kw", "AS"):
+            ref.alias = self.expect("ident").value
+        elif self.peek().kind == "ident":
+            ref.alias = self.next().value
+        return ref
+
+    # -- expressions (precedence climbing) ---------------------------------
+    def parse_expr(self):
+        return self.parse_or()
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.accept("kw", "OR"):
+            left = ("bin", "or", left, self.parse_and())
+        return left
+
+    def parse_and(self):
+        left = self.parse_not()
+        while self.accept("kw", "AND"):
+            left = ("bin", "and", left, self.parse_not())
+        return left
+
+    def parse_not(self):
+        if self.accept("kw", "NOT"):
+            return ("not", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self):
+        left = self.parse_addsub()
+        tok = self.peek()
+        if tok.kind == "op" and tok.value in ("=", "<>", "!=", "<", "<=",
+                                              ">", ">="):
+            op = self.next().value
+            op = {"=": "==", "<>": "!="}.get(op, op)
+            return ("bin", op, left, self.parse_addsub())
+        if tok.kind == "kw" and tok.value == "IS":
+            self.next()
+            neg = self.accept("kw", "NOT") is not None
+            self.expect("kw", "NULL")
+            return ("isnull", left, neg)
+        neg = False
+        if tok.kind == "kw" and tok.value == "NOT":
+            nxt = self.tokens[self.i + 1]
+            if nxt.kind == "kw" and nxt.value in ("IN", "BETWEEN", "LIKE"):
+                self.next()
+                neg = True
+                tok = self.peek()
+        if tok.kind == "kw" and tok.value == "IN":
+            self.next()
+            self.expect("op", "(")
+            vals = [self.parse_expr()]
+            while self.accept("op", ","):
+                vals.append(self.parse_expr())
+            self.expect("op", ")")
+            return ("in", left, vals, neg)
+        if tok.kind == "kw" and tok.value == "BETWEEN":
+            self.next()
+            lo = self.parse_addsub()
+            self.expect("kw", "AND")
+            hi = self.parse_addsub()
+            return ("between", left, lo, hi, neg)
+        if tok.kind == "kw" and tok.value == "LIKE":
+            self.next()
+            pattern = self.expect("string").value
+            return ("like", left, pattern, neg)
+        return left
+
+    def parse_addsub(self):
+        left = self.parse_muldiv()
+        while True:
+            tok = self.peek()
+            if tok.kind == "op" and tok.value in ("+", "-", "||"):
+                self.next()
+                left = ("bin", tok.value, left, self.parse_muldiv())
+            else:
+                return left
+
+    def parse_muldiv(self):
+        left = self.parse_unary()
+        while True:
+            tok = self.peek()
+            if tok.kind == "op" and tok.value in ("*", "/", "%"):
+                self.next()
+                left = ("bin", tok.value, left, self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self):
+        if self.accept("op", "-"):
+            return ("neg", self.parse_unary())
+        if self.accept("op", "+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self):
+        tok = self.next()
+        if tok.kind == "number":
+            return ("lit", float(tok.value) if "." in tok.value
+                    else int(tok.value))
+        if tok.kind == "string":
+            return ("lit", tok.value)
+        if tok.kind == "kw":
+            if tok.value == "NULL":
+                return ("lit", None)
+            if tok.value == "TRUE":
+                return ("lit", True)
+            if tok.value == "FALSE":
+                return ("lit", False)
+            if tok.value == "CASE":
+                whens = []
+                while self.accept("kw", "WHEN"):
+                    cond = self.parse_expr()
+                    self.expect("kw", "THEN")
+                    whens.append((cond, self.parse_expr()))
+                default = ("lit", None)
+                if self.accept("kw", "ELSE"):
+                    default = self.parse_expr()
+                self.expect("kw", "END")
+                return ("case", whens, default)
+            raise ValueError(f"SQL parse error: unexpected {tok.value}")
+        if tok.kind == "op" and tok.value == "(":
+            inner = self.parse_expr()
+            self.expect("op", ")")
+            return inner
+        if tok.kind == "ident":
+            # function call
+            if self.accept("op", "("):
+                name = tok.value.lower()
+                if self.accept("op", "*"):
+                    self.expect("op", ")")
+                    return ("func", name, [], True)
+                args = []
+                if not self.accept("op", ")"):
+                    args.append(self.parse_expr())
+                    while self.accept("op", ","):
+                        args.append(self.parse_expr())
+                    self.expect("op", ")")
+                return ("func", name, args, False)
+            # qualified column tab.col
+            if self.accept("op", "."):
+                col = self.expect("ident").value
+                return ("col", tok.value, col)
+            return ("col", None, tok.value)
+        raise ValueError(f"SQL parse error: unexpected {tok.value!r}")
+
+
+# ---------------------------------------------------------------------------
+# compiler: AST → Table ops
+# ---------------------------------------------------------------------------
+
+class Scope:
+    """alias → (Table column-name mapping into the current flat table)."""
+
+    def __init__(self):
+        self.entries: list[tuple[str | None, dict[str, str]]] = []
+        self.table: Table | None = None
+
+    def resolve(self, alias: str | None, name: str) -> ex.ColumnReference:
+        if alias is not None:
+            for a, cols in self.entries:
+                if a == alias:
+                    if name not in cols:
+                        raise KeyError(
+                            f"no column {name!r} in table {alias!r}")
+                    return self.table[cols[name]]
+            raise KeyError(f"unknown table alias {alias!r}")
+        hits = [cols[name] for _a, cols in self.entries if name in cols]
+        if not hits:
+            raise KeyError(f"unknown column {name!r}")
+        if len(set(hits)) > 1:
+            raise ValueError(f"ambiguous column {name!r}")
+        return self.table[hits[0]]
+
+    def all_columns(self) -> list[tuple[str, str]]:
+        """(output name, flat name) for SELECT *."""
+        out = []
+        seen = set()
+        for _a, cols in self.entries:
+            for name, flat in cols.items():
+                if name in seen:
+                    raise ValueError(
+                        f"SELECT * with duplicate column {name!r}; "
+                        "qualify the select list instead")
+                seen.add(name)
+                out.append((name, flat))
+        return out
+
+
+def _like_matcher(pattern: str):
+    regex = re.compile(
+        "^" + re.escape(pattern).replace("%", ".*").replace("_", ".") + "$",
+        re.DOTALL)
+
+    def match(value):
+        return value is not None and regex.match(str(value)) is not None
+
+    return match
+
+
+class Compiler:
+    def __init__(self, env: dict[str, Table]):
+        self.env = env
+
+    def lookup_table(self, name: str) -> Table:
+        if name in self.env:
+            return self.env[name]
+        for k, v in self.env.items():
+            if k.lower() == name.lower():
+                return v
+        raise KeyError(f"unknown table {name!r} in SQL query")
+
+    # -- expression --------------------------------------------------------
+    def expr(self, node, scope: Scope):
+        kind = node[0]
+        if kind == "lit":
+            return ex.wrap_arg(node[1])
+        if kind == "col":
+            return scope.resolve(node[1], node[2])
+        if kind == "bin":
+            op, l, r = node[1], self.expr(node[2], scope), self.expr(node[3], scope)
+            if op == "and":
+                return l & r
+            if op == "or":
+                return l | r
+            if op == "||":
+                return ex.apply(lambda a, b: (str(a) if a is not None else "")
+                                + (str(b) if b is not None else ""), l, r)
+            import operator as _op
+
+            table = {"+": _op.add, "-": _op.sub, "*": _op.mul,
+                     "/": _op.truediv, "%": _op.mod, "==": _op.eq,
+                     "!=": _op.ne, "<": _op.lt, "<=": _op.le, ">": _op.gt,
+                     ">=": _op.ge}
+            return table[op](l, r)
+        if kind == "not":
+            return ~self.expr(node[1], scope)
+        if kind == "neg":
+            return -self.expr(node[1], scope)
+        if kind == "isnull":
+            e = self.expr(node[1], scope)
+            res = ex.IsNoneExpression(e)
+            return ~res if node[2] else res
+        if kind == "in":
+            e = self.expr(node[1], scope)
+            folded = None
+            for v in node[2]:
+                term = e == self.expr(v, scope)
+                folded = term if folded is None else folded | term
+            return ~folded if node[3] else folded
+        if kind == "between":
+            e = self.expr(node[1], scope)
+            lo, hi = self.expr(node[2], scope), self.expr(node[3], scope)
+            res = (e >= lo) & (e <= hi)
+            return ~res if node[4] else res
+        if kind == "like":
+            e = self.expr(node[1], scope)
+            res = ex.apply(_like_matcher(node[2]), e)
+            return ~res if node[3] else res
+        if kind == "case":
+            whens, default = node[1], node[2]
+            out = self.expr(default, scope)
+            for cond, val in reversed(whens):
+                out = ex.if_else(self.expr(cond, scope),
+                                 self.expr(val, scope), out)
+            return out
+        if kind == "func":
+            return self.func(node, scope)
+        raise ValueError(f"cannot compile SQL expression {node!r}")
+
+    def func(self, node, scope: Scope):
+        name, args, star = node[1], node[2], node[3]
+        if name in _AGG_FUNCS:
+            if name == "count":
+                return reducers.count()
+            [arg] = args
+            return getattr(reducers, name)(self.expr(arg, scope))
+        compiled = [self.expr(a, scope) for a in args]
+        if name == "coalesce":
+            return ex.coalesce(*compiled)
+        if name == "nullif":
+            a, b = compiled
+            return ex.if_else(a == b, ex.wrap_arg(None), a)
+        simple = {
+            "abs": abs,
+            "lower": lambda s: s.lower() if s is not None else None,
+            "upper": lambda s: s.upper() if s is not None else None,
+            "length": lambda s: len(s) if s is not None else None,
+            "round": lambda x, *d: round(x, *[int(v) for v in d])
+            if x is not None else None,
+        }
+        if name in simple:
+            return ex.apply(simple[name], *compiled)
+        raise ValueError(f"unsupported SQL function {name!r}")
+
+    def _has_aggregate(self, node) -> bool:
+        if not isinstance(node, tuple):
+            return False
+        if node[0] == "func" and node[1] in _AGG_FUNCS:
+            return True
+        for child in node:
+            if isinstance(child, tuple) and self._has_aggregate(child):
+                return True
+            if isinstance(child, list) and any(
+                    self._has_aggregate(x) for x in child):
+                return True
+        return False
+
+    # -- FROM / JOIN -------------------------------------------------------
+    def table_for_ref(self, ref: TableRef) -> tuple[Table, str | None]:
+        if ref.subquery is not None:
+            return self.compile_compound(ref.subquery), ref.alias
+        t = self.lookup_table(ref.name)
+        return t, ref.alias or ref.name
+
+    def build_scope(self, stmt: SelectStmt) -> Scope:
+        scope = Scope()
+        base, alias = self.table_for_ref(stmt.from_table)
+        scope.table = base
+        scope.entries.append((alias, {c: c for c in base.column_names()}))
+
+        for join in stmt.joins:
+            right, ralias = self.table_for_ref(join.table)
+            flat_names = {c for _a, cols in scope.entries
+                          for c in cols.values()}
+            rmap = {}
+            for c in right.column_names():
+                flat = c if c not in flat_names else f"{ralias}__{c}"
+                i = 1
+                while flat in flat_names:
+                    flat = f"{ralias}__{c}_{i}"
+                    i += 1
+                rmap[c] = flat
+                flat_names.add(flat)
+
+            rscope = Scope()
+            rscope.table = right
+            rscope.entries.append((ralias, {c: c for c in right.column_names()}))
+
+            conds, post = self.split_on(join.on, scope, rscope)
+            how = join.kind
+            if join.kind == "cross":
+                # every row matches: constant join key on both sides
+                conds = [ex.wrap_arg(0) == ex.wrap_arg(0)]
+                how = "inner"
+            if post is not None and join.kind != "inner":
+                raise ValueError(
+                    "non-equality ON conditions are only supported for "
+                    "INNER JOIN")
+            jr = scope.table.join(right, *conds, how=how)
+            kwargs = {}
+            for _a, cols in scope.entries:
+                for name, flat in cols.items():
+                    kwargs[flat] = scope.table[flat]
+            for c, flat in rmap.items():
+                kwargs[flat] = right[c]
+            flat_table = jr.select(**kwargs)
+
+            new = Scope()
+            new.table = flat_table
+            new.entries = [(a, dict(cols)) for a, cols in scope.entries]
+            new.entries.append((ralias, rmap))
+            scope = new
+            if post is not None:
+                # re-resolve the residual condition against the flat table
+                scope.table = scope.table.filter(self.expr(post, scope))
+        return scope
+
+    def split_on(self, on, lscope: Scope, rscope: Scope):
+        """Split an ON conjunction into equality pairs usable as join
+        conditions (left_expr == right_expr) and a residual predicate."""
+        if on is None:
+            return [], None
+        conjuncts = []
+
+        def flatten(n):
+            if isinstance(n, tuple) and n[0] == "bin" and n[1] == "and":
+                flatten(n[2])
+                flatten(n[3])
+            else:
+                conjuncts.append(n)
+
+        flatten(on)
+        conds, residual = [], []
+        for c in conjuncts:
+            if isinstance(c, tuple) and c[0] == "bin" and c[1] == "==":
+                sides = []
+                ok = True
+                for sub in (c[2], c[3]):
+                    try:
+                        sides.append(self.expr(sub, lscope))
+                        side_of = "l"
+                    except (KeyError, ValueError):
+                        try:
+                            sides.append(self.expr(sub, rscope))
+                            side_of = "r"
+                        except (KeyError, ValueError):
+                            ok = False
+                            break
+                    sides[-1] = (side_of, sides[-1])
+                if ok and len(sides) == 2:
+                    tags = {sides[0][0], sides[1][0]}
+                    if tags == {"l", "r"}:
+                        l = next(e for t, e in sides if t == "l")
+                        r = next(e for t, e in sides if t == "r")
+                        conds.append(l == r)
+                        continue
+            residual.append(c)
+        post = None
+        for c in residual:
+            post = c if post is None else ("bin", "and", post, c)
+        return conds, post
+
+    # -- SELECT ------------------------------------------------------------
+    def output_name(self, item, i: int) -> str:
+        expr, alias = item
+        if alias:
+            return alias
+        if isinstance(expr, tuple) and expr[0] == "col":
+            return expr[2]
+        if isinstance(expr, tuple) and expr[0] == "func":
+            return expr[1]
+        return f"col_{i}"
+
+    def compile_select(self, stmt: SelectStmt) -> Table:
+        scope = self.build_scope(stmt) if stmt.from_table is not None else None
+        if scope is None:
+            raise ValueError("SELECT without FROM is not supported")
+        t = scope.table
+        if stmt.where is not None:
+            t = t.filter(self.expr(stmt.where, scope))
+            scope.table = t
+
+        has_agg = any(
+            item[0] != "*" and self._has_aggregate(item[0])
+            for item in stmt.items
+        ) or (stmt.having is not None and self._has_aggregate(stmt.having))
+
+        if stmt.group_by or has_agg:
+            out = {}
+            used = set()
+            for i, item in enumerate(stmt.items):
+                if item[0] == "*":
+                    raise ValueError("SELECT * cannot be mixed with GROUP BY")
+                name = self.output_name(item, i)
+                if name in used:
+                    name = f"{name}_{i}"
+                used.add(name)
+                out[name] = self.expr(item[0], scope)
+            by = [self.expr(g, scope) for g in stmt.group_by]
+            if stmt.having is not None:
+                out["__having__"] = self.expr(stmt.having, scope)
+            if by:
+                result = t.groupby(*by).reduce(**out)
+            else:
+                result = t.reduce(**out)
+            if stmt.having is not None:
+                result = result.filter(result["__having__"]).without(
+                    "__having__")
+        else:
+            out = {}
+            used = set()
+            for i, item in enumerate(stmt.items):
+                if item[0] == "*":
+                    for name, flat in scope.all_columns():
+                        out[name] = t[flat]
+                        used.add(name)
+                    continue
+                name = self.output_name(item, i)
+                if name in used:
+                    name = f"{name}_{i}"
+                used.add(name)
+                out[name] = self.expr(item[0], scope)
+            result = t.select(**out)
+
+        if stmt.distinct:
+            result = _distinct(result)
+        return result
+
+    def compile_compound(self, node) -> Table:
+        if isinstance(node, SelectStmt):
+            return self.compile_select(node)
+        if node[0] == "union":
+            _tag, all_flag, l, r = node
+            lt, rt = self.compile_compound(l), self.compile_compound(r)
+            combined = lt.concat_reindex(rt)
+            return combined if all_flag else _distinct(combined)
+        if node[0] == "intersect":
+            lt = _distinct(self.compile_compound(node[1]))
+            rt = _distinct(self.compile_compound(node[2]))
+            cols = lt.column_names()
+            rcols = rt.column_names()
+            conds = [lt[c] == rt[rc] for c, rc in zip(cols, rcols)]
+            return lt.join(rt, *conds, how="inner").select(
+                **{c: lt[c] for c in cols})
+        raise ValueError(f"unknown compound node {node[0]!r}")
+
+
+def _distinct(t: Table) -> Table:
+    cols = t.column_names()
+    return t.groupby(*[t[c] for c in cols]).reduce(**{c: t[c] for c in cols})
+
+
+def compile_sql(query: str, tables: dict[str, Table]) -> Table:
+    ctes, stmt = Parser(tokenize(query)).parse()
+    env = dict(tables)
+    compiler = Compiler(env)
+    for name, sub in ctes.items():
+        env[name] = compiler.compile_compound(sub)
+    return compiler.compile_compound(stmt)

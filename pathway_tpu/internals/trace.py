@@ -37,9 +37,10 @@ def trace_user_frame() -> Trace | None:
     import sys
 
     frame = sys._getframe(1)
+    pkg_prefix = _PKG_ROOT + os.sep
     while frame is not None:
         fname = os.path.abspath(frame.f_code.co_filename)
-        if not fname.startswith(_PKG_ROOT) and "<frozen" not in fname:
+        if not fname.startswith(pkg_prefix) and "<frozen" not in fname:
             line = linecache.getline(fname, frame.f_lineno).strip()
             return Trace(frame.f_code.co_filename, frame.f_lineno,
                          frame.f_code.co_name, line)
@@ -59,18 +60,3 @@ def add_trace_note(e: BaseException, trace: Trace | None,
         e.add_note(note)
 
 
-class EngineErrorWithTrace(Exception):
-    """An engine-side failure annotated with the user operator that caused it
-    (reference: internals/trace.py add_pathway_trace_note)."""
-
-    def __init__(self, cause: BaseException, trace: Trace | None,
-                 operator: str = ""):
-        self.cause = cause
-        self.trace = trace
-        self.operator = operator
-        msg = f"{type(cause).__name__}: {cause}"
-        if operator:
-            msg += f"\n  in operator {operator!r}"
-        if trace is not None:
-            msg += f"\noccurred here:\n{trace}"
-        super().__init__(msg)

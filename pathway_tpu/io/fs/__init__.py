@@ -171,16 +171,27 @@ class FsSource(DataSource):
                                                            mtime, 0, False))
                 seen[fkey] = mtime
                 rows = list(emitted.get(fkey, [])) if skip else []
-                parsed = list(_parse_file(f, self.format, self.schema,
-                                          self.with_metadata))
-                for idx, values in enumerate(parsed):
-                    if idx < skip:
-                        continue
-                    key, row = self.row_to_engine(values, seq)
+                # one-row lookahead keeps parsing streamed (no whole-file
+                # list) while still flagging the final row's offset is_last
+                parsed = _parse_file(f, self.format, self.schema,
+                                     self.with_metadata)
+                idx = -1
+                pending_values = None
+                for values in parsed:
+                    idx += 1
+                    if pending_values is not None:
+                        key, row = self.row_to_engine(pending_values, seq)
+                        seq += 1
+                        session.push(key, row, 1,
+                                     offset=("row", fkey, mtime, idx - 1,
+                                             False))
+                        rows.append((key, row))
+                    pending_values = values if idx >= skip else None
+                if pending_values is not None:
+                    key, row = self.row_to_engine(pending_values, seq)
                     seq += 1
-                    is_last = idx == len(parsed) - 1
                     session.push(key, row, 1,
-                                 offset=("row", fkey, mtime, idx, is_last))
+                                 offset=("row", fkey, mtime, idx, True))
                     rows.append((key, row))
                 emitted[fkey] = rows
             if self.mode != "streaming":

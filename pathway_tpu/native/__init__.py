@@ -1,0 +1,85 @@
+"""ctypes bindings to the framework's native C++ engines (native/*.cpp).
+
+Reference parity: the reference's non-matmul native components are Rust
+(tantivy text index, connectors); here they are C++ behind a C ABI. Each
+binding degrades gracefully — callers use ``native_available()`` /
+factories that fall back to the pure-Python engine when no toolchain is
+present."""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any
+
+from pathway_tpu.native.build import NativeBuildError, ensure_built
+
+_text_index_lib = None
+_text_index_err: Exception | None = None
+_load_lock = threading.Lock()
+
+
+def _load_text_index():
+    global _text_index_lib, _text_index_err
+    if _text_index_lib is not None or _text_index_err is not None:
+        return _text_index_lib
+    with _load_lock:
+        if _text_index_lib is not None or _text_index_err is not None:
+            return _text_index_lib
+        try:
+            lib = ctypes.CDLL(ensure_built("text_index"))
+        except Exception as e:  # missing toolchain, sandboxed fs, …
+            _text_index_err = e
+            return None
+        lib.ti_new.restype = ctypes.c_void_p
+        lib.ti_new.argtypes = [ctypes.c_double, ctypes.c_double]
+        lib.ti_free.argtypes = [ctypes.c_void_p]
+        lib.ti_add.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                               ctypes.c_char_p]
+        lib.ti_remove.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ti_len.restype = ctypes.c_uint64
+        lib.ti_len.argtypes = [ctypes.c_void_p]
+        lib.ti_search.restype = ctypes.c_int32
+        lib.ti_search.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_double)]
+        _text_index_lib = lib
+        return lib
+
+
+def text_index_available() -> bool:
+    return _load_text_index() is not None
+
+
+class NativeTextIndex:
+    """Thin RAII wrapper over the C++ BM25 engine (u64 doc ids)."""
+
+    def __init__(self, k1: float = 1.2, b: float = 0.75):
+        lib = _load_text_index()
+        if lib is None:
+            raise NativeBuildError(
+                f"native text index unavailable: {_text_index_err}")
+        self._lib = lib
+        self._h = lib.ti_new(k1, b)
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.ti_free(h)
+            self._h = None
+
+    def add(self, doc_id: int, text: str) -> None:
+        self._lib.ti_add(self._h, doc_id, text.encode())
+
+    def remove(self, doc_id: int) -> None:
+        self._lib.ti_remove(self._h, doc_id)
+
+    def __len__(self) -> int:
+        return int(self._lib.ti_len(self._h))
+
+    def search(self, query: str, k: int) -> list[tuple[int, float]]:
+        ids = (ctypes.c_uint64 * k)()
+        scores = (ctypes.c_double * k)()
+        n = self._lib.ti_search(self._h, query.encode(), k, ids, scores)
+        return [(int(ids[i]), float(scores[i])) for i in range(n)]

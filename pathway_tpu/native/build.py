@@ -1,0 +1,47 @@
+"""On-demand builder for the framework's native (C++) components.
+
+The reference ships its native engine pre-built as a Rust cdylib via
+maturin; this build compiles small C++ engines (native/*.cpp) with the
+system toolchain on first use and caches the .so by source hash, so a
+source edit transparently rebuilds. No pybind11 in-image — the ABI is
+plain C consumed through ctypes."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO_ROOT, "native")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_build")
+_LOCK = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def ensure_built(name: str) -> str:
+    """Compile native/<name>.cpp (if needed) and return the .so path."""
+    src = os.path.join(_SRC_DIR, f"{name}.cpp")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_BUILD_DIR, f"{name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    with _LOCK:
+        if os.path.exists(out):
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = out + f".tmp{os.getpid()}"
+        cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", src, "-o", tmp]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"native build failed for {name}:\n{proc.stderr}")
+        os.replace(tmp, out)  # atomic: concurrent processes race safely
+        return out

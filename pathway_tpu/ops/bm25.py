@@ -24,6 +24,18 @@ def tokenize(text: str) -> list[str]:
     return [t.lower() for t in _TOKEN_RE.findall(text or "")]
 
 
+def passes_filter(data: Any, filt: Any) -> bool:
+    """Shared metadata-filter evaluation (callable or JMESPath-lite expr)."""
+    if callable(filt):
+        try:
+            return bool(filt(data))
+        except Exception:
+            return False
+    from pathway_tpu.internals.jmespath_lite import evaluate_filter
+
+    return evaluate_filter(filt, data)
+
+
 class BM25Index:
     def __init__(self, *, k1: float = 1.2, b: float = 0.75,
                  ram_budget: int | None = None, in_memory_index: bool = True):
@@ -98,15 +110,7 @@ class BM25Index:
         return out
 
     def _passes_filter(self, key, filt) -> bool:
-        data = self._filter_data.get(key)
-        if callable(filt):
-            try:
-                return bool(filt(data))
-            except Exception:
-                return False
-        from pathway_tpu.internals.jmespath_lite import evaluate_filter
-
-        return evaluate_filter(filt, data)
+        return passes_filter(self._filter_data.get(key), filt)
 
     def search(self, queries: list[tuple]) -> list[tuple]:
         with self._lock:
@@ -116,3 +120,100 @@ class BM25Index:
                     text if isinstance(text, str) else str(text),
                     int(limit or 3), filt)))
             return out
+
+
+class NativeBM25Index:
+    """Same contract as :class:`BM25Index`, backed by the C++ engine
+    (native/text_index.cpp — the build's TantivyIndex equivalent). Pointer
+    keys are mapped to u64 doc ids here, exactly the reference's
+    KeyToU64IdMapper split (external_integration/mod.rs:205); metadata
+    filters are evaluated host-side over an over-fetched candidate list."""
+
+    def __init__(self, *, k1: float = 1.2, b: float = 0.75,
+                 ram_budget: int | None = None, in_memory_index: bool = True):
+        from pathway_tpu.native import NativeTextIndex
+
+        self._native = NativeTextIndex(k1=k1, b=b)
+        self._key_to_id: dict[Pointer, int] = {}
+        self._id_to_key: dict[int, Pointer] = {}
+        self._filter_data: dict[Pointer, Any] = {}
+        self._next_id = 0
+        self._lock = threading.RLock()
+
+    def __len__(self) -> int:
+        return len(self._native)
+
+    def add(self, key: Pointer, text: Any, filter_data: Any | None = None) -> None:
+        with self._lock:
+            doc_id = self._key_to_id.get(key)
+            if doc_id is None:
+                doc_id = self._next_id
+                self._next_id += 1
+                self._key_to_id[key] = doc_id
+                self._id_to_key[doc_id] = key
+            self._native.add(doc_id,
+                             text if isinstance(text, str) else str(text))
+            # re-add replaces metadata, including back to None (BM25Index
+            # contract: its add() goes through remove() first)
+            self._filter_data.pop(key, None)
+            if filter_data is not None:
+                self._filter_data[key] = filter_data
+
+    def remove(self, key: Pointer) -> None:
+        with self._lock:
+            doc_id = self._key_to_id.pop(key, None)
+            if doc_id is None:
+                return
+            self._id_to_key.pop(doc_id, None)
+            self._filter_data.pop(key, None)
+            self._native.remove(doc_id)
+
+    def _passes_filter(self, key, filt) -> bool:
+        return passes_filter(self._filter_data.get(key), filt)
+
+    def search(self, queries: list[tuple]) -> list[tuple]:
+        with self._lock:
+            out = []
+            n_docs = len(self._native)
+            for qkey, text, limit, filt in queries:
+                limit = int(limit or 3)
+                text_s = text if isinstance(text, str) else str(text)
+                matches: list = []
+                # escalating over-fetch: a selective filter must not reduce
+                # the result set below `limit` while matching docs remain
+                fetch = limit if filt is None else min(n_docs, limit * 4)
+                while n_docs:
+                    hits = self._native.search(text_s, max(fetch, 1))
+                    matches = []
+                    for doc_id, score in hits:
+                        key = self._id_to_key.get(doc_id)
+                        if key is None:
+                            continue
+                        if filt is not None and not self._passes_filter(key,
+                                                                        filt):
+                            continue
+                        matches.append((key, score))
+                        if len(matches) >= limit:
+                            break
+                    if (len(matches) >= limit or filt is None
+                            or fetch >= n_docs or len(hits) < fetch):
+                        break
+                    fetch = min(n_docs, fetch * 4)
+                out.append(tuple(matches))
+            return out
+
+
+def create_bm25_index(*, k1: float = 1.2, b: float = 0.75,
+                      ram_budget: int | None = None,
+                      in_memory_index: bool = True,
+                      prefer_native: bool = True):
+    """BM25 engine factory: the C++ engine when the toolchain can build it,
+    else the pure-Python index (identical scoring formula)."""
+    if prefer_native:
+        try:
+            return NativeBM25Index(k1=k1, b=b, ram_budget=ram_budget,
+                                   in_memory_index=in_memory_index)
+        except Exception:
+            pass
+    return BM25Index(k1=k1, b=b, ram_budget=ram_budget,
+                     in_memory_index=in_memory_index)

@@ -225,39 +225,53 @@ class BruteForceKnnIndex:
             qmat = jnp.asarray(
                 np.stack([np.asarray(q[1], dtype=np.float32).reshape(-1)
                           for q in queries]))
-            search_fn = self._get_search_fn(fetch_k)
-            top_scores, top_idx = search_fn(qmat, self._dev_vectors,
-                                            self._dev_valid)
-            top_scores = np.asarray(top_scores)
-            top_idx = np.asarray(top_idx)
 
-            out = []
-            for qi, (qkey, qvec, limit, filt) in enumerate(queries):
-                limit = int(limit or 3)
-                matches = []
-                qnorm_sq = None
-                for rank in range(fetch_k):
-                    score = top_scores[qi, rank]
-                    if not math.isfinite(score):
-                        break
-                    slot = int(top_idx[qi, rank])
-                    key = self._slot_to_key.get(slot)
-                    if key is None:
-                        continue
-                    if filt is not None and not self._passes_filter(key, filt):
-                        continue
-                    if self.metric == KnnMetric.COS:
-                        dist = 1.0 - float(score)
-                    else:
-                        if qnorm_sq is None:
-                            q = np.asarray(qvec, dtype=np.float32).reshape(-1)
-                            qnorm_sq = float(q @ q)
-                        dist = max(0.0, qnorm_sq - float(score))
-                    matches.append((key, dist))
-                    if len(matches) >= limit:
-                        break
-                out.append(tuple(matches))
-            return out
+            while True:
+                search_fn = self._get_search_fn(fetch_k)
+                top_scores_d, top_idx_d = search_fn(qmat, self._dev_vectors,
+                                                    self._dev_valid)
+                top_scores = np.asarray(top_scores_d)
+                top_idx = np.asarray(top_idx_d)
+
+                out = []
+                exhausted = True
+                for qi, (qkey, qvec, limit, filt) in enumerate(queries):
+                    limit = int(limit or 3)
+                    matches = []
+                    qnorm_sq = None
+                    ranks_seen = 0
+                    for rank in range(fetch_k):
+                        score = top_scores[qi, rank]
+                        if not math.isfinite(score):
+                            break
+                        ranks_seen += 1
+                        slot = int(top_idx[qi, rank])
+                        key = self._slot_to_key.get(slot)
+                        if key is None:
+                            continue
+                        if filt is not None and not self._passes_filter(key,
+                                                                        filt):
+                            continue
+                        if self.metric == KnnMetric.COS:
+                            dist = 1.0 - float(score)
+                        else:
+                            if qnorm_sq is None:
+                                q = np.asarray(qvec,
+                                               dtype=np.float32).reshape(-1)
+                                qnorm_sq = float(q @ q)
+                            dist = max(0.0, qnorm_sq - float(score))
+                        matches.append((key, dist))
+                        if len(matches) >= limit:
+                            break
+                    if (len(matches) < limit and ranks_seen == fetch_k
+                            and fetch_k < self.capacity):
+                        # a selective filter ate the whole candidate list and
+                        # more live slots remain: escalate the top-k fetch
+                        exhausted = False
+                    out.append(tuple(matches))
+                if exhausted or not has_filter:
+                    return out
+                fetch_k = min(self.capacity, fetch_k * 4)
 
     def _passes_filter(self, key: Pointer, filt: Any) -> bool:
         data = self._filter_data.get(key)

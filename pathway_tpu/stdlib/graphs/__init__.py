@@ -44,12 +44,6 @@ def pagerank(edges: Table, steps: int = 5, damping: float = 0.85) -> Table:
         )
         inflow = edge_rank.groupby(id=edge_rank.target).reduce(
             flow=reducers.sum(edge_rank.flow))
-        base = ranks.select(rank=150)
-        damped = inflow.select(rank=inflow.flow * 850 // 1000)
-        new_ranks = base.update_cells(
-            base.select(rank=150 + damped.restrict(base).rank)
-            if False else damped.select(rank=150 + damped.rank)
-        ) if False else None
         # rank' = 150 + 0.85 * inflow  (vertices with no inflow keep 150)
         merged = ranks.select(rank=150).update_rows(
             inflow.select(rank=150 + inflow.flow * 850 // 1000))
@@ -91,5 +85,139 @@ def bellman_ford(vertices: Table, edges: Table) -> Table:
                    dists=dists0, edges=edges)
 
 
-def louvain_communities(vertices: Table, edges: Table, iterations: int = 5):
-    raise NotImplementedError("louvain arrives with the clustering stdlib pass")
+def _broadcast_scalar(single_row: Table, target: Table, col: str):
+    """Join a one-row aggregate into every row of ``target`` via a constant
+    join key — the incremental broadcast (reference: the gradual_broadcast
+    operator, src/engine/dataflow/operators/gradual_broadcast.rs)."""
+    jr = target.join(single_row, ex.wrap_arg(0) == ex.wrap_arg(0),
+                     id=target.id)
+    return jr.select(**{c: target[c] for c in target.column_names()},
+                     **{col: single_row[col]})
+
+
+def _with_weights(edges: Table) -> Table:
+    if "weight" in edges.column_names():
+        return edges.select(u=edges.u, v=edges.v,
+                            weight=ex.cast(float, edges.weight))
+    return edges.select(u=edges.u, v=edges.v, weight=1.0)
+
+
+def louvain_communities(vertices: Table, edges: Table,
+                        iterations: int = 30) -> Table:
+    """Cluster assignment per vertex by greedy modularity maximization
+    (one Louvain level; reference: graphs/louvain_communities/impl.py:225).
+
+    Each round proposes, per vertex, the adjacent cluster maximizing the
+    Louvain gain w(v→C) − deg(v)·deg(C)/2m, then executes an INDEPENDENT
+    SET of moves — a move runs only if it holds the maximum per-round hash
+    priority in both its source and target clusters (the reference's
+    parallel-conflict resolution, impl.py _one_step:154) — so concurrent
+    swaps cannot oscillate. ``edges``: u, v pointer columns + optional
+    weight; undirected graphs must list both (u,v) and (v,u).
+
+    Returns a vertex-keyed table with cluster column ``c`` (a representative
+    vertex pointer)."""
+    from pathway_tpu.internals.keys import hash_values
+
+    wedges = _with_weights(edges)
+    degrees = wedges.groupby(id=wedges.u).reduce(
+        deg=reducers.sum(wedges.weight))
+    degrees = vertices.select(deg=0.0).update_rows(degrees)
+    total = wedges.reduce(m2=reducers.sum(wedges.weight))
+    clustering0 = vertices.select(c=vertices.id)
+    counter0 = total.select(n=0)
+
+    def body(clustering: Table, counter: Table, wedges: Table,
+             degrees: Table, m2tab: Table):
+        cv = clustering.ix(wedges.v, context=wedges).c
+        vc = wedges.select(u=wedges.u, c=cv, w=wedges.weight)
+        vc = vc.groupby(vc.u, vc.c).reduce(
+            u=vc.u, c=vc.c, w=reducers.sum(vc.w))
+
+        memb = clustering.select(c=clustering.c,
+                                 deg=degrees.restrict(clustering).deg)
+        cdeg = memb.groupby(memb.c).reduce(
+            c=memb.c, cdeg=reducers.sum(memb.deg))
+        cdeg_by_c = cdeg.with_id(cdeg.c)
+
+        vc = _broadcast_scalar(m2tab, vc, "m2")
+        scored = vc.select(
+            u=vc.u, c=vc.c,
+            gain=ex.apply(
+                lambda w, dv, dc, m2: w - dv * (dc or 0.0) / m2,
+                vc.w, degrees.ix(vc.u, context=vc).deg,
+                cdeg_by_c.ix(vc.c, context=vc, optional=True).cdeg,
+                vc.m2),
+        )
+        best = scored.groupby(id=scored.u).reduce(
+            choice=reducers.argmax(
+                ex.make_tuple(scored.gain, ex.apply(lambda p: -int(p),
+                                                    scored.c))))
+        picked = best.select(
+            vc_new=scored.ix(best.choice, context=best).c,
+            gain=scored.ix(best.choice, context=best).gain)
+        movers = picked.filter(
+            (picked.gain > 0.0)
+            & ex.apply(lambda new, cur: new != cur, picked.vc_new,
+                       clustering.restrict(picked).c))
+        movers = _broadcast_scalar(counter, movers, "n")
+        movers = movers.select(
+            vc_new=movers.vc_new,
+            uc=clustering.restrict(movers).c,
+            r=ex.apply(lambda key, n: int(hash_values(key, n)) & (
+                (1 << 62) - 1), movers.id, movers.n))
+
+        # independent set: a move must be its source AND target cluster's
+        # max-priority move this round
+        outp = movers.select(c=movers.uc, r=movers.r)
+        inp = movers.select(c=movers.vc_new, r=movers.r)
+        prios = outp.concat_reindex(inp)
+        maxp = prios.groupby(prios.c).reduce(c=prios.c,
+                                             mx=reducers.max(prios.r))
+        maxp_by_c = maxp.with_id(maxp.c)
+        accepted = movers.filter(
+            (movers.r == maxp_by_c.ix(movers.uc, context=movers).mx)
+            & (movers.r == maxp_by_c.ix(movers.vc_new, context=movers).mx))
+
+        new_c = clustering.update_cells(
+            accepted.select(c=accepted.vc_new)).with_universe_of(clustering)
+
+        # freeze the round counter once no vertex wants to move, so the
+        # fixpoint detector sees a fully-quiescent state
+        ntab = movers.reduce(cnt=reducers.count())
+        cj = counter.join_left(ntab, ex.wrap_arg(0) == ex.wrap_arg(0)).select(
+            n=counter.n + ex.if_else(ex.coalesce(ntab.cnt, 0) > 0, 1, 0))
+        new_counter = cj.with_universe_of(counter)
+        return {"clustering": new_c, "counter": new_counter}
+
+    result = iterate(
+        lambda clustering, counter, wedges, degrees, m2tab: body(
+            clustering, counter, wedges, degrees, m2tab),
+        iteration_limit=iterations,
+        clustering=clustering0, counter=counter0, wedges=wedges,
+        degrees=degrees, m2tab=total)
+    return result["clustering"]
+
+
+def exact_modularity(edges: Table, clustering: Table) -> Table:
+    """Q = Σ_C [ in(C)/2m − (deg(C)/2m)² ] over a directed-edge-doubled
+    graph (reference louvain impl.py exact_modularity:340). Returns a
+    single-row table with column ``modularity``."""
+    wedges = _with_weights(edges)
+    cu = clustering.ix(wedges.u, context=wedges).c
+    cv = clustering.ix(wedges.v, context=wedges).c
+    marked = wedges.select(cu=cu, cv=cv, w=wedges.weight)
+    m2 = marked.reduce(m2=reducers.sum(marked.w))
+    internal = marked.filter(
+        ex.apply(lambda a, b: a == b, marked.cu, marked.cv))
+    in_c = internal.groupby(internal.cu).reduce(
+        c=internal.cu, w_in=reducers.sum(internal.w))
+    deg_c = marked.groupby(marked.cu).reduce(
+        c=marked.cu, deg=reducers.sum(marked.w))
+    joined = deg_c.join_left(in_c, deg_c.c == in_c.c).select(
+        deg=deg_c.deg, w_in=ex.coalesce(in_c.w_in, 0.0))
+    joined = _broadcast_scalar(m2, joined, "m2")
+    per_cluster = joined.select(
+        q=ex.apply(lambda w_in, deg, m2v: w_in / m2v - (deg / m2v) ** 2,
+                   joined.w_in, joined.deg, joined.m2))
+    return per_cluster.reduce(modularity=reducers.sum(per_cluster.q))

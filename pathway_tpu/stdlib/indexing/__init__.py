@@ -17,10 +17,10 @@ from pathway_tpu.stdlib.indexing.vector_document_index import (  # noqa: F401
 )
 from pathway_tpu.stdlib.indexing import retrievers  # noqa: F401
 from pathway_tpu.stdlib.indexing.sorting import (  # noqa: F401
-    binsearch_oracle,
+    build_sorted_index,
     filter_smallest_k,
-    prefix_sum_oracle,
     retrieve_prev_next_values,
+    sort_from_index,
 )
 
 __all__ = [
@@ -28,5 +28,6 @@ __all__ = [
     "LshKnn", "USearchKnn", "TantivyBM25", "TantivyBM25Factory",
     "default_brute_force_knn_document_index", "default_lsh_knn_document_index",
     "default_usearch_knn_document_index", "default_vector_document_index",
-    "retrievers", "retrieve_prev_next_values",
+    "retrievers", "retrieve_prev_next_values", "build_sorted_index",
+    "sort_from_index", "filter_smallest_k",
 ]

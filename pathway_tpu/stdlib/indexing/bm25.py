@@ -7,7 +7,7 @@ from dataclasses import dataclass
 from typing import Any
 
 from pathway_tpu.internals import expression as ex
-from pathway_tpu.ops.bm25 import BM25Index
+from pathway_tpu.ops.bm25 import create_bm25_index
 from pathway_tpu.stdlib.indexing.data_index import InnerIndex
 
 
@@ -16,9 +16,10 @@ class TantivyBM25Factory:
     ram_budget: int = 50_000_000
     in_memory_index: bool = True
 
-    def build(self) -> BM25Index:
-        return BM25Index(ram_budget=self.ram_budget,
-                         in_memory_index=self.in_memory_index)
+    def build(self):
+        # C++ engine when buildable, Python engine otherwise (ops/bm25.py)
+        return create_bm25_index(ram_budget=self.ram_budget,
+                                 in_memory_index=self.in_memory_index)
 
 
 class TantivyBM25(InnerIndex):

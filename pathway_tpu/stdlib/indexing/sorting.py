@@ -1,43 +1,91 @@
-"""Sorted-structure helpers (reference: stdlib/indexing/sorting.py, 230 LoC)."""
+"""Sorted-structure helpers (reference: stdlib/indexing/sorting.py, 230 LoC —
+build_sorted_index:92, sort_from_index:137, retrieve_prev_next_values:195).
+
+The reference maintains a treap over keys (hash priorities) and derives
+prev/next pointers by tree walks inside pw.iterate. This build's engine has
+an incremental sorted-order operator (engine SortOperator, mirroring the
+reference's prev_next.rs pointer maintenance), so the index IS the sorted
+table — build_sorted_index returns the same {index, oracle} shape without
+the treap construction fixpoint."""
 
 from __future__ import annotations
 
 from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals import reducers_frontend as reducers
+from pathway_tpu.internals.iterate import iterate
 from pathway_tpu.internals.table import Table
 
 
-def sort_from_index(table: Table, key, instance=None) -> Table:
+def build_sorted_index(nodes: Table) -> dict:
+    """Sorted index over ``nodes`` (columns: key, instance) —
+    {index: table with prev/next pointers, oracle: per-instance root (the
+    minimum key, standing in for the treap root)}."""
+    index = nodes.sort(nodes.key, instance=nodes.instance)
+    oracle = nodes.groupby(nodes.instance).reduce(
+        instance=nodes.instance, root=reducers.argmin(nodes.key))
+    return dict(index=index, oracle=oracle)
+
+
+def sort_from_index(table: Table, key=None, instance=None) -> Table:
+    key = key if key is not None else table.key
     return table.sort(key, instance=instance)
+
+
+def _skip_nones(tab: Table) -> Table:
+    """One pointer-jump round: rows whose prev/next landed on a None value
+    look one hop further (reference _retrieving_prev_next_value:182)."""
+    prev_row = tab.ix(tab.prev_value, optional=True, context=tab)
+    next_row = tab.ix(tab.next_value, optional=True, context=tab)
+    return tab.select(
+        prev=tab.prev, next=tab.next, value=tab.value,
+        prev_value=ex.if_else(
+            tab.prev_value.is_none(), None,
+            ex.if_else(prev_row.value.is_none(), prev_row.prev,
+                       tab.prev_value)),
+        next_value=ex.if_else(
+            tab.next_value.is_none(), None,
+            ex.if_else(next_row.value.is_none(), next_row.next,
+                       tab.next_value)),
+    )
 
 
 def retrieve_prev_next_values(ordered_table: Table,
                               value: ex.ColumnReference | None = None) -> Table:
-    """For a table with prev/next pointer columns (output of Table.sort) and
-    an optional value column: fetch the nearest non-None value looking
-    backward (prev_value) and forward (next_value)."""
+    """For each row of a table with prev/next pointer columns: a pointer to
+    the nearest row (backward / forward in the order) whose value is not
+    None. Columns: prev_value, next_value (reference sorting.py:195)."""
     if value is None:
-        prev_row = ordered_table.ix(ordered_table.prev, optional=True,
-                                    context=ordered_table)
-        next_row = ordered_table.ix(ordered_table.next, optional=True,
-                                    context=ordered_table)
-        return ordered_table.select(
-            prev_value=prev_row.prev, next_value=next_row.next)
-    table = value.table
-    prev_row = table.ix(ordered_table.prev, optional=True, context=ordered_table)
-    next_row = table.ix(ordered_table.next, optional=True, context=ordered_table)
-    return ordered_table.select(
-        prev_value=prev_row[value.name],
-        next_value=next_row[value.name],
-    )
+        value_col = ordered_table.value
+    elif (isinstance(value, ex.ColumnReference)
+          and value.table is not ordered_table):
+        # sort() output carries only prev/next; pull the value column from
+        # its source table (same universe — sort preserves keys)
+        value_col = value.table.restrict(ordered_table)[value.name]
+    else:
+        value_col = ordered_table[value.name if isinstance(
+            value, ex.ColumnReference) else value]
+    tab = ordered_table.select(
+        prev=ordered_table.prev, next=ordered_table.next, value=value_col,
+        prev_value=ordered_table.prev, next_value=ordered_table.next)
+    result = iterate(lambda tab: _skip_nones(tab), tab=tab)
+    return result.select(prev_value=result.prev_value,
+                         next_value=result.next_value)
 
 
-def binsearch_oracle(*args, **kwargs):
-    raise NotImplementedError("binsearch trees arrive with the sorting pass")
-
-
-def prefix_sum_oracle(*args, **kwargs):
-    raise NotImplementedError("prefix-sum oracle arrives with the sorting pass")
-
-
-def filter_smallest_k(column: ex.ColumnReference, instance, ks_table):
-    raise NotImplementedError("filter_smallest_k arrives with the sorting pass")
+def filter_smallest_k(column: ex.ColumnReference, instance: ex.ColumnReference,
+                      ks_table: Table) -> Table:
+    """Keep, per instance, the k rows with the smallest ``column`` value
+    (k read from ks_table's ``k`` column, joined on ``instance``).
+    Ties broken by row key, so exactly k rows survive."""
+    t = column.table
+    ranked = t.groupby(instance).reduce(
+        inst=instance,
+        sorted=reducers.sorted_tuple(ex.make_tuple(column, t.id)))
+    ks_inst = (ks_table.instance if "instance" in ks_table.column_names()
+               else ks_table.id)
+    with_k = ranked.join(ks_table, ranked.inst == ks_inst).select(
+        sorted=ranked.sorted, k=ks_table.k)
+    keys = with_k.select(kk=ex.apply(
+        lambda s, k: tuple(p[1] for p in s[:int(k)]), with_k.sorted, with_k.k))
+    flat = keys.flatten(keys.kk)
+    return t.having(flat.kk)

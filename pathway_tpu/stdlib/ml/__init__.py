@@ -1,4 +1,16 @@
-from pathway_tpu.stdlib.ml import classifiers, index  # noqa: F401
+from pathway_tpu.stdlib.ml import classifiers, hmm, index, smart_table_ops, utils  # noqa: F401
 from pathway_tpu.stdlib.ml.index import KNNIndex  # noqa: F401
+from pathway_tpu.stdlib.ml.smart_table_ops import (  # noqa: F401
+    FuzzyJoinFeatureGeneration,
+    FuzzyJoinNormalization,
+    fuzzy_match,
+    fuzzy_match_tables,
+    fuzzy_self_match,
+    smart_fuzzy_match,
+)
 
-__all__ = ["KNNIndex", "classifiers", "index"]
+__all__ = [
+    "KNNIndex", "classifiers", "hmm", "index", "smart_table_ops", "utils",
+    "FuzzyJoinFeatureGeneration", "FuzzyJoinNormalization", "fuzzy_match",
+    "fuzzy_match_tables", "fuzzy_self_match", "smart_fuzzy_match",
+]

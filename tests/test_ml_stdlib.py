@@ -1,0 +1,158 @@
+"""ML stdlib: HMM decoding, fuzzy joins, custom accumulators
+(reference: stdlib/ml/hmm.py, stdlib/ml/smart_table_ops/_fuzzy_join.py)."""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, rows_of
+
+
+# ---------------------------------------------------------------------------
+# BaseCustomAccumulator protocol
+# ---------------------------------------------------------------------------
+
+def test_udf_reducer_custom_accumulator():
+    class SumSq(pw.BaseCustomAccumulator):
+        def __init__(self, v):
+            self.total = v * v
+
+        @classmethod
+        def from_row(cls, row):
+            [v] = row
+            return cls(v)
+
+        def update(self, other):
+            self.total += other.total
+
+        def compute_result(self):
+            return self.total
+
+    sumsq = pw.reducers.udf_reducer(SumSq)
+    t = T("""
+    g | x
+    a | 1
+    a | 2
+    b | 3
+    """)
+    r = t.groupby(t.g).reduce(g=t.g, s=sumsq(t.x))
+    assert sorted(rows_of(r)) == [("a", 5), ("b", 9)]
+
+
+# ---------------------------------------------------------------------------
+# HMM (the reference's manul example, same graph/numbers)
+# ---------------------------------------------------------------------------
+
+def _manul_graph():
+    import networkx as nx
+
+    def emis(observation, state):
+        table = {("HUNGRY", "GRUMPY"): 0.9, ("HUNGRY", "HAPPY"): 0.1,
+                 ("FULL", "GRUMPY"): 0.7, ("FULL", "HAPPY"): 0.3}
+        return np.log(table[(state, observation)])
+
+    g = nx.DiGraph()
+    g.add_node("HUNGRY", calc_emission_log_ppb=partial(emis, state="HUNGRY"))
+    g.add_node("FULL", calc_emission_log_ppb=partial(emis, state="FULL"))
+    g.add_edge("HUNGRY", "HUNGRY", log_transition_ppb=np.log(0.4))
+    g.add_edge("HUNGRY", "FULL", log_transition_ppb=np.log(0.6))
+    g.add_edge("FULL", "HUNGRY", log_transition_ppb=np.log(0.6))
+    g.add_edge("FULL", "FULL", log_transition_ppb=np.log(0.4))
+    g.graph["start_nodes"] = ["HUNGRY", "FULL"]
+    return g
+
+
+def test_hmm_viterbi_stream():
+    obs = T("""
+    observation | __time__
+    HAPPY       | 1
+    HAPPY       | 2
+    GRUMPY      | 3
+    GRUMPY      | 4
+    HAPPY       | 5
+    GRUMPY      | 6
+    """)
+    hmm_reducer = pw.reducers.udf_reducer(
+        pw.stdlib.ml.hmm.create_hmm_reducer(_manul_graph(),
+                                            num_results_kept=3))
+    decoded = obs.reduce(decoded_state=hmm_reducer(obs.observation))
+    # final state after all six observations (reference doctest's last row)
+    assert rows_of(decoded) == [(("HUNGRY", "FULL", "HUNGRY"),)]
+
+
+# ---------------------------------------------------------------------------
+# fuzzy joins
+# ---------------------------------------------------------------------------
+
+def test_fuzzy_match_columns():
+    left = T("""
+    name
+    Johnny Smith
+    Alice Cooper
+    Bob Marley
+    """)
+    right = T("""
+    name
+    smith john
+    cooper alice
+    marley bob
+    """)
+    res = pw.stdlib.ml.fuzzy_match(left.name, right.name)
+    got = rows_of(res.select(
+        l=pw.apply(lambda p: None, res.left), w=res.weight))
+    assert len(got) == 3  # every row found its mutual-best partner
+
+    # check an actual pairing via joined payloads
+    joined = res.join(left, res.left == left.id).select(
+        lname=left.name, right=res.right)
+    joined = joined.join(right, joined.right == right.id).select(
+        lname=joined.lname, rname=right.name)
+    pairs = dict(rows_of(joined))
+    assert pairs["Alice Cooper"] == "cooper alice"
+    assert pairs["Bob Marley"] == "marley bob"
+
+
+def test_fuzzy_match_tables_and_self_match():
+    t1 = T("""
+    a     | b
+    apple | pie
+    stock | market
+    """)
+    t2 = T("""
+    c
+    apple pie recipe
+    stock market crash
+    """)
+    res = pw.stdlib.ml.fuzzy_match_tables(t1, t2)
+    joined = res.join(t1, res.left == t1.id).select(a=t1.a, right=res.right)
+    joined = joined.join(t2, joined.right == t2.id).select(
+        a=joined.a, c=t2.c)
+    pairs = dict(rows_of(joined))
+    assert pairs == {"apple": "apple pie recipe",
+                     "stock": "stock market crash"}
+
+    t3 = T("""
+    v
+    hello world
+    hello world
+    something else
+    """)
+    selfm = pw.stdlib.ml.fuzzy_self_match(t3, t3.v)
+    got = rows_of(selfm)
+    assert len(got) == 1  # the two identical rows pair up once
+
+
+def test_classifier_accuracy():
+    predicted = T("""
+    predicted_label
+    cat
+    dog
+    cat
+    """)
+    exact = predicted.select(label=pw.apply(
+        lambda p: "cat", predicted.predicted_label))
+    acc = pw.stdlib.ml.utils.classifier_accuracy(predicted, exact)
+    got = dict((v, c) for c, v in rows_of(acc))
+    assert got == {True: 2, False: 1}

@@ -1,0 +1,111 @@
+"""Native C++ BM25 engine vs the Python engine
+(native/text_index.cpp; reference equivalent: TantivyIndex,
+src/external_integration/tantivy_integration.rs)."""
+
+import pytest
+
+from pathway_tpu.internals.keys import hash_values
+from pathway_tpu.ops.bm25 import BM25Index, NativeBM25Index, create_bm25_index
+
+DOCS = {
+    "d1": "systolic arrays multiply matrices in hardware",
+    "d2": "streaming dataflow engines process incremental updates",
+    "d3": "the tpu matrix unit is a systolic array",
+    "d4": "hash joins shuffle rows between workers",
+}
+
+
+def _build(cls):
+    idx = cls()
+    keys = {}
+    for name, text in DOCS.items():
+        keys[name] = hash_values(name)
+        idx.add(keys[name], text, filter_data={"name": name})
+    return idx, keys
+
+
+def test_native_builds_and_matches_python_ranking():
+    try:
+        native, nkeys = _build(NativeBM25Index)
+    except Exception as e:
+        pytest.skip(f"native toolchain unavailable: {e}")
+    python, pkeys = _build(BM25Index)
+    assert len(native) == len(python) == 4
+
+    for query in ("systolic array", "incremental updates", "rows workers",
+                  "nothing matches this zz"):
+        nres = native.search([(None, query, 4, None)])[0]
+        pres = python.search([(None, query, 4, None)])[0]
+        assert [k for k, _ in nres] == [k for k, _ in pres], query
+        for (nk, ns), (pk, ps) in zip(nres, pres):
+            assert abs(ns - ps) < 1e-9
+
+
+def test_native_remove_and_update():
+    try:
+        idx, keys = _build(NativeBM25Index)
+    except Exception as e:
+        pytest.skip(f"native toolchain unavailable: {e}")
+    idx.remove(keys["d3"])
+    assert len(idx) == 3
+    res = idx.search([(None, "systolic", 4, None)])[0]
+    assert [k for k, _ in res] == [keys["d1"]]
+    # re-add with different text replaces the old posting
+    idx.add(keys["d1"], "completely different words now")
+    res2 = idx.search([(None, "systolic", 4, None)])[0]
+    assert res2 == ()
+    res3 = idx.search([(None, "different words", 4, None)])[0]
+    assert [k for k, _ in res3] == [keys["d1"]]
+
+
+def test_native_filtering_overfetch():
+    try:
+        idx, keys = _build(NativeBM25Index)
+    except Exception as e:
+        pytest.skip(f"native toolchain unavailable: {e}")
+    res = idx.search([(None, "systolic arrays matrix", 1,
+                       lambda d: d and d["name"] == "d3")])[0]
+    assert [k for k, _ in res] == [keys["d3"]]
+
+
+def test_factory_prefers_native():
+    idx = create_bm25_index()
+    assert isinstance(idx, (NativeBM25Index, BM25Index))
+    # in this image the toolchain exists, so native must win
+    assert isinstance(idx, NativeBM25Index)
+
+
+def test_selective_filter_escalates_fetch():
+    """A filter passing only low-ranked docs must not shrink results
+    (parity with BM25Index — over-fetch escalates past limit*4)."""
+    try:
+        native = NativeBM25Index()
+    except Exception as e:
+        pytest.skip(f"native toolchain unavailable: {e}")
+    python = BM25Index()
+    keys = {}
+    for i in range(50):
+        k = hash_values(f"doc{i}")
+        keys[i] = k
+        # doc i repeats the query term i+1 times → rank increases with i
+        text = " ".join(["match"] * (i + 1))
+        fd = {"allowed": i < 5}  # only the 5 LOWEST-ranked docs pass
+        native.add(k, text, filter_data=fd)
+        python.add(k, text, filter_data=fd)
+    filt = lambda d: bool(d and d["allowed"])
+    nres = native.search([(None, "match", 3, filt)])[0]
+    pres = python.search([(None, "match", 3, filt)])[0]
+    assert len(nres) == len(pres) == 3
+    assert {k for k, _ in nres} == {k for k, _ in pres}
+
+
+def test_re_add_clears_stale_filter_data():
+    try:
+        native = NativeBM25Index()
+    except Exception as e:
+        pytest.skip(f"native toolchain unavailable: {e}")
+    k = hash_values("doc")
+    native.add(k, "hello world", filter_data={"ok": False})
+    native.add(k, "hello world")  # re-add without metadata
+    res = native.search([(None, "hello", 3, lambda d: d is None)])[0]
+    assert [key for key, _ in res] == [k]

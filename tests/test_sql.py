@@ -1,0 +1,196 @@
+"""pw.sql — SQL subset compiled to Table ops
+(reference: python/pathway/internals/sql.py; parser re-implemented in
+internals/sql_parser.py since sqlglot is not vendored)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, rows_of
+
+
+def _tab():
+    return T("""
+    name  | dept | salary
+    alice | eng  | 100
+    bob   | eng  | 80
+    carol | ops  | 60
+    dave  | ops  | 40
+    erin  | hr   | 90
+    """)
+
+
+def test_select_where():
+    t = _tab()
+    r = pw.sql("SELECT name, salary FROM tab WHERE salary > 70", tab=t)
+    assert sorted(rows_of(r)) == [("alice", 100), ("bob", 80), ("erin", 90)]
+
+
+def test_select_star_and_expressions():
+    t = _tab()
+    r = pw.sql("SELECT *, salary * 2 AS double FROM tab WHERE dept = 'hr'",
+               tab=t)
+    assert rows_of(r) == [("erin", "hr", 90, 180)]
+
+
+def test_arithmetic_and_case():
+    t = _tab()
+    r = pw.sql(
+        """
+        SELECT name,
+               CASE WHEN salary >= 90 THEN 'high'
+                    WHEN salary >= 60 THEN 'mid'
+                    ELSE 'low' END AS band
+        FROM tab
+        """,
+        tab=t)
+    assert sorted(rows_of(r)) == [
+        ("alice", "high"), ("bob", "mid"), ("carol", "mid"),
+        ("dave", "low"), ("erin", "high")]
+
+
+def test_group_by_having():
+    t = _tab()
+    r = pw.sql(
+        """
+        SELECT dept, SUM(salary) AS total, COUNT(*) AS n
+        FROM tab GROUP BY dept HAVING SUM(salary) > 80
+        """,
+        tab=t)
+    assert sorted(rows_of(r)) == [("eng", 180, 2), ("hr", 90, 1), ("ops", 100, 2)]
+    r2 = pw.sql("SELECT dept, AVG(salary) AS a FROM tab GROUP BY dept "
+                "HAVING COUNT(*) > 1", tab=t)
+    assert sorted(rows_of(r2)) == [("eng", 90.0), ("ops", 50.0)]
+
+
+def test_global_aggregate():
+    t = _tab()
+    r = pw.sql("SELECT COUNT(*) AS n, MIN(salary) AS lo, MAX(salary) AS hi "
+               "FROM tab", tab=t)
+    assert rows_of(r) == [(5, 40, 100)]
+
+
+def test_join_inner_and_left():
+    emp = _tab()
+    dept = T("""
+    dept | site
+    eng  | NYC
+    ops  | SF
+    """)
+    r = pw.sql(
+        "SELECT e.name, d.site FROM emp e JOIN dept d ON e.dept = d.dept "
+        "WHERE e.salary > 70", emp=emp, dept=dept)
+    assert sorted(rows_of(r)) == [("alice", "NYC"), ("bob", "NYC")]
+    r2 = pw.sql(
+        "SELECT e.name, d.site FROM emp e LEFT JOIN dept d ON e.dept = d.dept",
+        emp=emp, dept=dept)
+    assert sorted(rows_of(r2), key=repr) == sorted(
+        [("alice", "NYC"), ("bob", "NYC"), ("carol", "SF"), ("dave", "SF"),
+         ("erin", None)], key=repr)
+
+
+def test_join_three_way_and_residual_condition():
+    a = T("""
+    k | x
+    1 | 10
+    2 | 20
+    """)
+    b = T("""
+    k | y
+    1 | 1
+    2 | 2
+    """)
+    c = T("""
+    k | z
+    1 | 7
+    2 | 9
+    """)
+    r = pw.sql(
+        "SELECT a.x, b.y, c.z FROM a JOIN b ON a.k = b.k "
+        "JOIN c ON b.k = c.k AND c.z > 8", a=a, b=b, c=c)
+    assert rows_of(r) == [(20, 2, 9)]
+
+
+def test_union_and_intersect():
+    t1 = T("""
+    v
+    1
+    2
+    3
+    """)
+    t2 = T("""
+    v
+    2
+    3
+    4
+    """)
+    u = pw.sql("SELECT v FROM t1 UNION SELECT v FROM t2", t1=t1, t2=t2)
+    assert sorted(rows_of(u)) == [(1,), (2,), (3,), (4,)]
+    ua = pw.sql("SELECT v FROM t1 UNION ALL SELECT v FROM t2", t1=t1, t2=t2)
+    assert sorted(rows_of(ua)) == [(1,), (2,), (2,), (3,), (3,), (4,)]
+    i = pw.sql("SELECT v FROM t1 INTERSECT SELECT v FROM t2", t1=t1, t2=t2)
+    assert sorted(rows_of(i)) == [(2,), (3,)]
+
+
+def test_with_cte_and_subquery():
+    t = _tab()
+    r = pw.sql(
+        """
+        WITH rich AS (SELECT name, dept FROM tab WHERE salary >= 90)
+        SELECT dept, COUNT(*) AS n FROM rich GROUP BY dept
+        """,
+        tab=t)
+    assert sorted(rows_of(r)) == [("eng", 1), ("hr", 1)]
+    r2 = pw.sql(
+        "SELECT name FROM (SELECT name, salary FROM tab WHERE dept = 'eng') s "
+        "WHERE s.salary > 90", tab=t)
+    assert rows_of(r2) == [("alice",)]
+
+
+def test_predicates_in_between_like_null():
+    t = _tab()
+    r = pw.sql("SELECT name FROM tab WHERE dept IN ('eng', 'hr')", tab=t)
+    assert sorted(rows_of(r)) == [("alice",), ("bob",), ("erin",)]
+    r2 = pw.sql("SELECT name FROM tab WHERE salary BETWEEN 60 AND 90", tab=t)
+    assert sorted(rows_of(r2)) == [("bob",), ("carol",), ("erin",)]
+    r3 = pw.sql("SELECT name FROM tab WHERE name LIKE '%ar%'", tab=t)
+    assert sorted(rows_of(r3)) == [("carol",)]
+    r4 = pw.sql("SELECT name FROM tab WHERE name NOT LIKE 'a%' "
+                "AND salary NOT IN (40, 60)", tab=t)
+    assert sorted(rows_of(r4)) == [("bob",), ("erin",)]
+
+
+def test_functions_and_distinct():
+    t = _tab()
+    r = pw.sql("SELECT DISTINCT dept FROM tab", tab=t)
+    assert sorted(rows_of(r)) == [("eng",), ("hr",), ("ops",)]
+    r2 = pw.sql("SELECT UPPER(name) AS u FROM tab WHERE LENGTH(name) = 3",
+                tab=t)
+    assert rows_of(r2) == [("BOB",)]
+    r3 = pw.sql("SELECT name, COALESCE(NULLIF(dept, 'hr'), 'people') AS d "
+                "FROM tab WHERE salary = 90", tab=t)
+    assert rows_of(r3) == [("erin", "people")]
+
+
+def test_cross_join():
+    a = T("""
+    x
+    1
+    2
+    """)
+    b = T("""
+    y
+    10
+    20
+    """)
+    r = pw.sql("SELECT a.x, b.y FROM a CROSS JOIN b", a=a, b=b)
+    assert sorted(rows_of(r)) == [(1, 10), (1, 20), (2, 10), (2, 20)]
+
+
+def test_parse_errors():
+    t = _tab()
+    with pytest.raises(ValueError, match="SQL parse error"):
+        pw.sql("SELECT FROM tab", tab=t)
+    with pytest.raises(KeyError, match="unknown table"):
+        pw.sql("SELECT x FROM missing", tab=t)
+    with pytest.raises(ValueError, match="unsupported SQL function"):
+        pw.sql("SELECT FOO(name) FROM tab", tab=t)

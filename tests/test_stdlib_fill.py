@@ -1,0 +1,126 @@
+"""stdlib completion: sorting helpers, all-rows applies, louvain
+(reference: stdlib/indexing/sorting.py, stdlib/utils/col.py,
+stdlib/graphs/louvain_communities/impl.py)."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.graphs import exact_modularity, louvain_communities
+from pathway_tpu.stdlib.indexing import (
+    build_sorted_index,
+    filter_smallest_k,
+    retrieve_prev_next_values,
+)
+from pathway_tpu.stdlib.utils.col import apply_all_rows, multiapply_all_rows
+from tests.utils import T, rows_of
+
+
+def test_retrieve_prev_next_values_skips_nones():
+    t = T("""
+    k | value
+    1 | 10
+    2 |
+    3 |
+    4 | 40
+    5 |
+    """)
+    ordered = t.sort(t.k)
+    merged = ordered.select(prev=ordered.prev, next=ordered.next,
+                            value=t.restrict(ordered).value)
+    res = retrieve_prev_next_values(merged)
+    # map pointers back to the value they point at
+    vals = res.select(
+        pv=t.ix(res.prev_value, optional=True, context=res).value,
+        nv=t.ix(res.next_value, optional=True, context=res).value,
+    )
+    joined = vals.select(k=t.restrict(vals).k, pv=vals.pv, nv=vals.nv)
+    got = {k: (pv, nv) for k, pv, nv in rows_of(joined)}
+    assert got[1] == (None, 40)   # no earlier value; next non-None is 40
+    assert got[2] == (10, 40)
+    assert got[3] == (10, 40)
+    assert got[5] == (40, None)
+
+
+def test_build_sorted_index_shape():
+    t = T("""
+    key | instance
+    5   | 0
+    1   | 0
+    3   | 0
+    """)
+    idx = build_sorted_index(t)
+    assert set(idx.keys()) == {"index", "oracle"}
+    [(inst, root)] = rows_of(idx["oracle"])
+    assert inst == 0
+
+
+def test_filter_smallest_k():
+    t = T("""
+    v  | inst
+    10 | a
+    5  | a
+    7  | a
+    1  | b
+    2  | b
+    """)
+    ks = T("""
+    instance | k
+    a        | 2
+    b        | 1
+    """)
+    res = filter_smallest_k(t.v, t.inst, ks)
+    assert sorted(rows_of(res)) == [(1, "b"), (5, "a"), (7, "a")]
+
+
+def test_apply_all_rows():
+    t = T("""
+    a | b
+    1 | 10
+    2 | 20
+    3 | 30
+    """)
+    res = apply_all_rows(
+        t.a, t.b, fun=lambda ca, cb: [x + sum(ca) + sum(cb)
+                                      for x in ca],
+        result_col_name="res")
+    assert sorted(rows_of(res)) == [(67,), (68,), (69,)]
+    multi = multiapply_all_rows(
+        t.a, t.b,
+        fun=lambda ca, cb: ([x + 1 for x in ca], [y - 1 for y in cb]),
+        result_col_names=["a1", "b1"])
+    assert sorted(rows_of(multi)) == [(2, 9), (3, 19), (4, 29)]
+
+
+def test_louvain_two_cliques():
+    # two triangles connected by a single weak edge → two communities
+    edges_raw = T("""
+    su | sv
+    a  | b
+    b  | c
+    c  | a
+    d  | e
+    e  | f
+    f  | d
+    a  | d
+    """)
+    verts = T("""
+    name
+    a
+    b
+    c
+    d
+    e
+    f
+    """).with_id_from(pw.this.name)
+    fwd = edges_raw.select(u=verts.pointer_from(edges_raw.su),
+                           v=verts.pointer_from(edges_raw.sv))
+    bwd = edges_raw.select(u=verts.pointer_from(edges_raw.sv),
+                           v=verts.pointer_from(edges_raw.su))
+    edges = fwd.concat_reindex(bwd)
+    clusters = louvain_communities(verts, edges)
+    labeled = clusters.select(name=verts.restrict(clusters).name,
+                              c=pw.apply(int, clusters.c))
+    got = dict(rows_of(labeled))
+    assert got["a"] == got["b"] == got["c"]
+    assert got["d"] == got["e"] == got["f"]
+    assert got["a"] != got["d"]
+    [(q,)] = rows_of(exact_modularity(edges, clusters))
+    assert q > 0.3  # two-clique partition is strongly modular
