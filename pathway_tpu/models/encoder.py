@@ -170,20 +170,31 @@ def param_pspecs(config: EncoderConfig) -> dict:
 # forward
 # ---------------------------------------------------------------------------
 
-def _layer_norm(x, scale, bias, eps):
-    x = x.astype(jnp.float32)
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+def _layer_norm(x, scale, bias, eps, out_dtype=None):
+    """Stats in f32; the result returns to ``out_dtype`` (the residual
+    stream stays bf16 — at (B=1024, S=128, H=384) an f32 stream is 200 MB
+    touched by every block, and HBM bandwidth, not MXU, bounds the pass)."""
+    out_dtype = out_dtype or x.dtype
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    normed = (xf - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+    return normed.astype(out_dtype)
 
 
 def _dense_attention(q, k, v, mask):
-    """q,k,v: (B, S, H, D); mask: (B, S) validity. One fused softmax-attn."""
+    """q,k,v: (B, S, H, D); mask: (B, S) validity. Fused softmax-attention.
+
+    Scores stay in the compute dtype (bf16): the (B, H, S, S) tensor is the
+    pass's largest intermediate, and keeping it f32 doubles its HBM traffic
+    for <5e-5 cosine deviation. Max-subtraction runs the exp in f32."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    neg = jnp.finfo(jnp.float32).min
-    scores = jnp.where(mask[:, None, None, :], scores.astype(jnp.float32), neg)
-    probs = jax.nn.softmax(scores, axis=-1)
+    bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(scores.dtype)
+    scores = scores + bias
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp((scores - m).astype(jnp.float32)).astype(scores.dtype)
+    probs = p / jnp.sum(p, axis=-1, keepdims=True)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
@@ -198,17 +209,18 @@ def _attention_block(x, p, mask, config: EncoderConfig, attn_fn):
     out = attn_fn(q.reshape(shp), k.reshape(shp), v.reshape(shp), mask)
     out = out.reshape(B, S, H).astype(cd)
     out = out @ p["wo"].astype(cd) + p["bo"].astype(cd)
-    return _layer_norm(x + out.astype(jnp.float32),
-                       p["ln_scale"], p["ln_bias"], config.layer_norm_eps)
+    return _layer_norm(xc + out, p["ln_scale"], p["ln_bias"],
+                       config.layer_norm_eps, out_dtype=cd)
 
 
 def _mlp_block(x, p, config: EncoderConfig):
     cd = config.compute_dtype
-    h = x.astype(cd) @ p["w1"].astype(cd) + p["b1"].astype(cd)
-    h = jax.nn.gelu(h.astype(jnp.float32), approximate=False).astype(cd)
+    xc = x.astype(cd)
+    h = xc @ p["w1"].astype(cd) + p["b1"].astype(cd)
+    h = jax.nn.gelu(h, approximate=False)  # erf gelu (BERT), bf16 VPU
     out = h @ p["w2"].astype(cd) + p["b2"].astype(cd)
-    return _layer_norm(x + out.astype(jnp.float32),
-                       p["ln_scale"], p["ln_bias"], config.layer_norm_eps)
+    return _layer_norm(xc + out, p["ln_scale"], p["ln_bias"],
+                       config.layer_norm_eps, out_dtype=cd)
 
 
 def _moe_block(x, p, config: EncoderConfig):
@@ -230,9 +242,9 @@ def _moe_block(x, p, config: EncoderConfig):
     out = jnp.einsum("bsei,eih->bseh", h, p["w2"].astype(cd))
     out = out + p["b2"].astype(cd)[None, None]
     out = jnp.einsum("bseh,bse->bsh", out, onehot)
-    out = out.astype(jnp.float32) * gate_val[..., None]
-    return _layer_norm(x + out, p["ln_scale"], p["ln_bias"],
-                       config.layer_norm_eps)
+    out = (out.astype(jnp.float32) * gate_val[..., None]).astype(cd)
+    return _layer_norm(x.astype(cd) + out, p["ln_scale"], p["ln_bias"],
+                       config.layer_norm_eps, out_dtype=cd)
 
 
 def encode(params: dict, token_ids, attention_mask, *,
@@ -249,14 +261,25 @@ def encode(params: dict, token_ids, attention_mask, *,
         attn_fn = _dense_attention
     emb = params["embeddings"]
     B, S = token_ids.shape
+    cd = config.compute_dtype
     mask = attention_mask.astype(bool)
-    x = emb["token"][token_ids]
-    x = x + emb["position"][:S][None]
-    if token_type_ids is None:
-        x = x + emb["token_type"][0][None, None]
+    # Large batches: gather from a bf16 view of the table — the (V, H)
+    # random-access read is the pass's most HBM-expensive op, and the one-off
+    # f32→bf16 convert (~V*H*6 bytes) amortizes when the gather touches a
+    # comparable volume. Small (serving) batches: gather f32 rows directly,
+    # converting only what was read. B*S is static under jit, so this is a
+    # trace-time branch, not device control flow.
+    if B * S >= emb["token"].shape[0]:
+        x = emb["token"].astype(cd)[token_ids]
     else:
-        x = x + emb["token_type"][token_type_ids]
-    x = _layer_norm(x, emb["ln_scale"], emb["ln_bias"], config.layer_norm_eps)
+        x = emb["token"][token_ids].astype(cd)
+    x = x + emb["position"][:S][None].astype(cd)
+    if token_type_ids is None:
+        x = x + emb["token_type"][0][None, None].astype(cd)
+    else:
+        x = x + emb["token_type"][token_type_ids].astype(cd)
+    x = _layer_norm(x, emb["ln_scale"], emb["ln_bias"], config.layer_norm_eps,
+                    out_dtype=cd)
 
     for layer in params["layers"]:
         x = _attention_block(x, layer["attn"], mask, config, attn_fn)
@@ -266,10 +289,11 @@ def encode(params: dict, token_ids, attention_mask, *,
             x = _mlp_block(x, layer["mlp"], config)
 
     if config.pooling == "cls":
-        pooled = x[:, 0]
+        pooled = x[:, 0].astype(jnp.float32)
     else:  # mean over valid tokens
+        xf = x.astype(jnp.float32)
         m = mask.astype(jnp.float32)[..., None]
-        pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        pooled = jnp.sum(xf * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
     if config.normalize:
         pooled = pooled / jnp.maximum(
             jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
