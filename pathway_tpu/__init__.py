@@ -84,6 +84,20 @@ from pathway_tpu.internals.udfs import (  # noqa: E402
     fully_async_executor,
     sync_executor,
 )
+from pathway_tpu.internals.row_transformer import (  # noqa: E402
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
+from pathway_tpu.internals.interactive import (  # noqa: E402
+    enable_interactive_mode,
+    is_interactive_mode_enabled,
+)
 from pathway_tpu.internals.sql import sql  # noqa: E402
 from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
 from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
@@ -115,4 +129,49 @@ __all__ = [
     "schema_from_dict", "schema_from_pandas", "schema_from_types",
     "indexing", "ml", "temporal", "graphs", "stdlib", "xpacks",
     "MonitoringLevel", "AsyncTransformer", "global_error_log",
+    "transformer", "ClassArg", "input_attribute", "output_attribute",
+    "attribute", "method", "input_method", "pandas_transformer",
+    "table_transformer",
 ]
+
+
+def table_transformer(func=None, **_kwargs):
+    """Decorator marking a Table→Table function; schema compatibility of
+    annotated arguments is checked at call time (reference:
+    internals/common.py:533 — the full version also coerces subtypes)."""
+    import functools
+    import inspect
+    import typing
+
+    def wrap(f):
+        sig = inspect.signature(f)
+        hints_cache: list = []  # resolved lazily: schema classes may be
+        # defined after the decorated function under postponed annotations
+
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            if not hints_cache:
+                try:
+                    hints_cache.append(typing.get_type_hints(f)
+                                       if f.__annotations__ else {})
+                except NameError:
+                    hints_cache.append({})
+            hints = hints_cache[0]
+            bound = sig.bind(*args, **kwargs)
+            for name, value in bound.arguments.items():
+                expected = hints.get(name)
+                if (isinstance(value, Table) and isinstance(expected, type)
+                        and issubclass(expected, Schema)):
+                    missing = (set(expected.column_names())
+                               - set(value.column_names()))
+                    if missing:
+                        raise TypeError(
+                            f"{f.__name__}: argument {name!r} is missing "
+                            f"columns {sorted(missing)}")
+            return f(*args, **kwargs)
+
+        return inner
+
+    if func is not None:
+        return wrap(func)
+    return wrap

@@ -197,10 +197,10 @@ def _fmt(v) -> str:
     return repr(v)
 
 
-def compute_and_print(table: Table, *, include_id: bool = True,
-                      short_pointers: bool = True, n_rows: int | None = None,
-                      squash_updates: bool = True, terminate_on_error: bool = True,
-                      file=None) -> None:
+def table_to_markdown(table: Table, *, include_id: bool = True,
+                      n_rows: int | None = None) -> str:
+    """Bounded snapshot rendered as the markdown-ish table format
+    ``table_from_markdown`` parses (round-trippable)."""
     [cap] = run_tables(table)
     state = cap.snapshot()
     names = table.column_names()
@@ -212,7 +212,15 @@ def compute_and_print(table: Table, *, include_id: bool = True,
     for key, row in items:
         cells = ([str(key)] if include_id else []) + [_fmt(v) for v in row]
         lines.append(" | ".join(cells))
-    print("\n".join(lines), file=file)
+    return "\n".join(lines)
+
+
+def compute_and_print(table: Table, *, include_id: bool = True,
+                      short_pointers: bool = True, n_rows: int | None = None,
+                      squash_updates: bool = True, terminate_on_error: bool = True,
+                      file=None) -> None:
+    print(table_to_markdown(table, include_id=include_id, n_rows=n_rows),
+          file=file)
 
 
 def _row_sort_key(row, key):

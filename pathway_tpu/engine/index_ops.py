@@ -37,7 +37,8 @@ class ExternalIndexOperator(Operator):
 
     def __init__(self, index, data_vec_pos: int, data_filter_pos: int | None,
                  query_vec_pos: int, query_limit_pos: int | None,
-                 query_filter_pos: int | None, default_limit: int = 3):
+                 query_filter_pos: int | None, default_limit: int = 3,
+                 revise: bool = False):
         self.index = index
         self.data_vec_pos = data_vec_pos
         self.data_filter_pos = data_filter_pos
@@ -45,7 +46,12 @@ class ExternalIndexOperator(Operator):
         self.query_limit_pos = query_limit_pos
         self.query_filter_pos = query_filter_pos
         self.default_limit = default_limit
+        # revise=True → full `DataIndex.query` semantics: standing queries
+        # are re-answered whenever the indexed data changes (retract +
+        # re-emit on difference); False → as-of-now (answers frozen).
+        self.revise = revise
         self.answers: dict[Pointer, tuple] = {}
+        self.live_queries: dict[Pointer, tuple] = {}  # key → (vec, limit, filt)
 
     def step(self, time, in_deltas):
         from pathway_tpu.internals.error import ERROR, global_error_log
@@ -68,6 +74,7 @@ class ExternalIndexOperator(Operator):
                 add_vecs.clear()
                 add_filts.clear()
 
+        data_changed = bool(data_delta.entries)
         for key, row, diff in data_delta.entries:
             if diff > 0:
                 vec = row[self.data_vec_pos]
@@ -88,36 +95,71 @@ class ExternalIndexOperator(Operator):
                 self.index.remove(key)
         flush_adds()
         out = Delta()
-        # 2. answer query insertions (batched), retract answers on query removal
-        batch = []
+        # 2. answer query insertions (batched), retract answers on removal.
+        # Per-key NET view of the batch: an update can arrive as +1-then--1
+        # for the same key in either order; sequential processing would
+        # drop the standing query (or leak the old answer), so resolve each
+        # key once — last positive row wins, net<0 with no insert = removal.
+        per_key: dict[Pointer, list] = {}
+        key_order: list[Pointer] = []
         for key, row, diff in query_delta.entries:
+            if key not in per_key:
+                per_key[key] = [0, None]  # [net, last_positive_row]
+                key_order.append(key)
+            per_key[key][0] += diff
             if diff > 0:
-                vec = row[self.query_vec_pos]
-                if vec is None or vec is ERROR:
-                    # poisoned query: empty reply, never crash the worker
-                    global_error_log().log(
-                        "external index: query with error/None vector",
-                        operator="external_index")
-                    self.answers[key] = ()
-                    out.append(key, ((),), 1)
-                    continue
-                limit = (row[self.query_limit_pos]
-                         if self.query_limit_pos is not None else self.default_limit)
-                if not isinstance(limit, int):
-                    limit = self.default_limit
-                filt = (row[self.query_filter_pos]
-                        if self.query_filter_pos is not None else None)
-                if filt is ERROR:
-                    filt = None
-                batch.append((key, vec, limit, filt))
-            else:
-                prev = self.answers.pop(key, None)
-                if prev is not None:
-                    out.append(key, (prev,), -1)
+                per_key[key][1] = row
+
+        batch = []
+        for key in key_order:
+            net, row = per_key[key]
+            if row is None:
+                if net < 0:
+                    self.live_queries.pop(key, None)
+                    prev = self.answers.pop(key, None)
+                    if prev is not None:
+                        out.append(key, (prev,), -1)
+                continue
+            # (re)insertion or in-batch update: retract a superseded answer
+            prev = self.answers.pop(key, None)
+            if prev is not None:
+                out.append(key, (prev,), -1)
+            vec = row[self.query_vec_pos]
+            if vec is None or vec is ERROR:
+                # poisoned query: empty reply, never crash the worker
+                global_error_log().log(
+                    "external index: query with error/None vector",
+                    operator="external_index")
+                self.answers[key] = ()
+                out.append(key, ((),), 1)
+                continue
+            limit = (row[self.query_limit_pos]
+                     if self.query_limit_pos is not None else self.default_limit)
+            if not isinstance(limit, int):
+                limit = self.default_limit
+            filt = (row[self.query_filter_pos]
+                    if self.query_filter_pos is not None else None)
+            if filt is ERROR:
+                filt = None
+            batch.append((key, vec, limit, filt))
+            if self.revise:
+                self.live_queries[key] = (vec, limit, filt)
+        new_keys = {k for k, _, _, _ in batch}
+        if self.revise and data_changed and self.live_queries:
+            # re-answer every standing query against the updated index; only
+            # changed replies produce retract+re-emit diffs. One batched
+            # search — on the KNN index this is a single slab matmul.
+            batch = [(k, v, l, f) for k, (v, l, f)
+                     in self.live_queries.items()]
         if batch:
             replies = self.index.search(batch)
             for (key, _, _, _), reply in zip(batch, replies):
                 reply = tuple(reply)
+                prev = self.answers.get(key)
+                if key not in new_keys and prev == reply:
+                    continue
+                if prev is not None and key not in new_keys:
+                    out.append(key, (prev,), -1)
                 self.answers[key] = reply
                 out.append(key, (reply,), 1)
         return out

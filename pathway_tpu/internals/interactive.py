@@ -1,0 +1,70 @@
+"""Experimental interactive (REPL) mode
+(reference: internals/interactive.py:181-222 — a displayhook that renders
+live tables as strings, plus enable/is_enabled controllers).
+
+In this build, displaying a Table in interactive mode computes a bounded
+snapshot through the engine and prints it (the reference's LiveTable
+auto-refresh thread is tied to its monitoring stack; bounded preview is the
+capability REPL users rely on)."""
+
+from __future__ import annotations
+
+import sys
+import warnings
+from typing import Callable
+
+
+class DisplayAsStr:
+    """Marker: the interactive displayhook prints str(value) for these."""
+
+
+class InteractiveModeController:
+    _orig_displayhook: Callable[[object], None]
+
+    def __init__(self, _pathway_internal: bool = False) -> None:
+        assert _pathway_internal, (
+            "InteractiveModeController is an internal class")
+        self._orig_displayhook = sys.displayhook
+        sys.displayhook = self._displayhook
+
+    def _displayhook(self, value: object) -> None:
+        from pathway_tpu.internals.table import Table
+
+        if isinstance(value, DisplayAsStr):
+            import builtins
+
+            builtins._ = value
+            print(str(value))
+        elif isinstance(value, Table):
+            import builtins
+
+            builtins._ = value
+            try:
+                from pathway_tpu.debug import table_to_markdown
+
+                print(table_to_markdown(value))
+            except Exception as e:
+                print(f"<Table: preview unavailable: {e}>")
+        else:
+            self._orig_displayhook(value)
+
+    def close(self) -> None:
+        sys.displayhook = self._orig_displayhook
+
+
+def is_interactive_mode_enabled() -> bool:
+    from pathway_tpu.internals.parse_graph import G
+
+    return getattr(G, "interactive_mode_controller", None) is not None
+
+
+def enable_interactive_mode() -> InteractiveModeController:
+    warnings.warn("interactive mode is experimental", stacklevel=2)
+    from pathway_tpu.internals.parse_graph import G
+
+    controller = getattr(G, "interactive_mode_controller", None)
+    if controller is not None:
+        return controller
+    controller = InteractiveModeController(_pathway_internal=True)
+    G.interactive_mode_controller = controller
+    return controller

@@ -1,0 +1,240 @@
+"""Class (row) transformers — the ``@pw.transformer`` legacy API
+(reference: internals/row_transformer.py:294 + engine complex_columns;
+graph_runner/row_transformer_operator_handler.py).
+
+A transformer declares inner ``ClassArg`` classes, one per input table:
+``input_attribute()`` fields mirror input columns; ``@output_attribute``
+methods compute new columns and may chase pointers into any of the
+transformer's tables (``self.transformer.other[ptr].attr``), including
+references into *output* attributes of other rows.
+
+Execution model here: the transformer's tables are gathered whole (one
+batched dispatch — the engine's incremental whole-table fold, like
+apply_all_rows), attributes are evaluated lazily with memoization
+host-side, and results are re-keyed to the source rows. The reference
+evaluates the same dependency graph row-by-row inside the engine
+(complex_columns); capability and observable semantics match, granularity
+of incrementality is whole-table per changed input batch."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.keys import Pointer, hash_values
+from pathway_tpu.internals.table import Table
+
+
+class _InputAttribute:
+    """Descriptor: reads the row's input column through the evaluator."""
+
+    def __set_name__(self, owner, name):
+        self.name = name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return obj._ev.value(obj._class_name, obj.id, self.name)
+
+
+class _ComputedAttribute:
+    """Descriptor for @output_attribute / @attribute: accessing it yields
+    the computed (memoized) value, not the function."""
+
+    def __init__(self, fn: Callable, kind: str):
+        self.fn = fn
+        self._pw_kind = kind
+
+    def __set_name__(self, owner, name):
+        self.name = name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return obj._ev.value(obj._class_name, obj.id, self.name)
+
+
+def input_attribute(type: Any = float) -> Any:  # noqa: A002
+    return _InputAttribute()
+
+
+def output_attribute(fn: Callable) -> _ComputedAttribute:
+    return _ComputedAttribute(fn, "output")
+
+
+def attribute(fn: Callable) -> _ComputedAttribute:
+    """Computed helper attribute (not emitted as an output column)."""
+    return _ComputedAttribute(fn, "attribute")
+
+
+def method(fn: Callable) -> Callable:
+    fn._pw_kind = "method"
+    return fn
+
+
+def input_method(type: Any = float) -> Callable:  # noqa: A002
+    def deco(fn):
+        fn._pw_kind = "method"
+        return fn
+
+    return deco
+
+
+class ClassArg:
+    """Base class for transformer inner classes (reference ClassArg:148)."""
+
+    def __init__(self, evaluator: "_Evaluator", class_name: str, key: Pointer):
+        self._ev = evaluator
+        self._class_name = class_name
+        self.id = key
+
+    def pointer_from(self, *args, optional=False):
+        return hash_values(*args)
+
+    @property
+    def transformer(self):
+        return self._ev.namespace
+
+
+class _TableIndex:
+    def __init__(self, evaluator: "_Evaluator", class_name: str):
+        self._ev = evaluator
+        self._class_name = class_name
+
+    def __getitem__(self, key: Pointer) -> ClassArg:
+        return self._ev.proxy(self._class_name, key)
+
+
+class _ClassNamespace:
+    """``self.transformer.<table>[ptr]`` → row proxy of another table."""
+
+    def __init__(self, evaluator: "_Evaluator"):
+        self._ev = evaluator
+
+    def __getattr__(self, name: str) -> _TableIndex:
+        return _TableIndex(self._ev, name)
+
+
+class _Evaluator:
+    """Lazy, memoized evaluation of all attributes over materialized rows."""
+
+    def __init__(self, classes: dict, tables: dict):
+        # tables: class_name → {key → {col: value}}
+        self.classes = classes
+        self.tables = tables
+        self._memo: dict[tuple, Any] = {}
+        self._in_progress: set[tuple] = set()
+        self.namespace = _ClassNamespace(self)
+
+    def proxy(self, class_name: str, key: Pointer) -> ClassArg:
+        return self.classes[class_name](self, class_name, key)
+
+    def value(self, class_name: str, key, name: str):
+        row = self.tables[class_name].get(key)
+        if row is not None and name in row:
+            return row[name]
+        member = getattr(self.classes[class_name], name, None)
+        if isinstance(member, _ComputedAttribute):
+            memo_key = (class_name, key, name)
+            if memo_key in self._memo:
+                return self._memo[memo_key]
+            if memo_key in self._in_progress:
+                raise RecursionError(
+                    f"cyclic attribute dependency at {class_name}.{name}")
+            self._in_progress.add(memo_key)
+            try:
+                result = member.fn(self.proxy(class_name, key))
+            finally:
+                self._in_progress.discard(memo_key)
+            self._memo[memo_key] = result
+            return result
+        raise AttributeError(
+            f"transformer class {class_name!r}: row {key} has no "
+            f"attribute {name!r}")
+
+
+def _output_names(cls) -> list[str]:
+    return [n for n, m in vars(cls).items()
+            if isinstance(m, _ComputedAttribute) and m._pw_kind == "output"]
+
+
+def transformer(cls) -> "_TransformerFactory":
+    classes = {name: member for name, member in vars(cls).items()
+               if isinstance(member, type) and issubclass(member, ClassArg)}
+    return _TransformerFactory(cls.__name__, classes)
+
+
+class _TransformerFactory:
+    def __init__(self, name: str, classes: dict[str, type]):
+        self.name = name
+        self.classes = classes
+
+    def __call__(self, **tables: Table):
+        import pathway_tpu.internals.reducers_frontend as reducers
+
+        missing = set(self.classes) - set(tables)
+        if missing:
+            raise TypeError(f"transformer {self.name} missing tables: "
+                            f"{sorted(missing)}")
+
+        # gather every input table whole (one sorted_tuple fold per table)
+        order = list(self.classes)
+        col_names = {}
+        base = None
+        for idx, cname in enumerate(order):
+            t = tables[cname]
+            names = t.column_names()
+            col_names[cname] = names
+            p = t.select(row=ex.apply(
+                lambda rid, *vals: (int(rid), *vals), t.id,
+                *[t[n] for n in names]))
+            rt = p.reduce(rows=reducers.sorted_tuple(p.row))
+            if base is None:
+                base = rt.select(**{f"_pw_{idx}": rt.rows})
+            else:
+                jr = base.join(rt, ex.wrap_arg(0) == ex.wrap_arg(0),
+                               id=base.id)
+                base = jr.select(
+                    **{c: base[c] for c in base.column_names()},
+                    **{f"_pw_{idx}": rt.rows})
+
+        classes = self.classes
+        cols = col_names
+
+        def run_all(*packed_rows):
+            state = {}
+            for cname, rows in zip(order, packed_rows):
+                state[cname] = {
+                    Pointer(r[0]): dict(zip(cols[cname], r[1:]))
+                    for r in rows
+                }
+            ev = _Evaluator(classes, state)
+            out = []
+            for cname in order:
+                names = _output_names(classes[cname])
+                table_out = []
+                for key in state[cname]:
+                    vals = tuple(ev.value(cname, key, n) for n in names)
+                    table_out.append((int(key), *vals))
+                out.append(tuple(table_out))
+            return tuple(out)
+
+        results = base.select(out=ex.apply(
+            run_all, *[base[f"_pw_{i}"] for i in range(len(order))]))
+
+        class _Result:
+            pass
+
+        result = _Result()
+        for idx, cname in enumerate(order):
+            out_attrs = _output_names(classes[cname])
+            per_table = results.select(rows=ex.apply(
+                lambda o, _i=idx: o[_i], results.out))
+            flat = per_table.flatten(per_table.rows)
+            keyed = flat.select(
+                _pw_id=ex.apply(lambda r: Pointer(r[0]), flat.rows),
+                **{n: ex.apply(lambda r, _j=j: r[_j + 1], flat.rows)
+                   for j, n in enumerate(out_attrs)})
+            setattr(result, cname,
+                    keyed.with_id(keyed._pw_id).without("_pw_id"))
+        return result

@@ -670,6 +670,7 @@ class GraphRunner:
             query_vec_pos=plan.params.get("query_vec_pos", 0),
             query_limit_pos=colpos(queries, plan.params.get("limit_col")),
             query_filter_pos=colpos(queries, plan.params.get("query_filter_col")),
+            revise=plan.params.get("revise", False),
         )
         return self.graph.add_node(op, [dnode, qnode], "external_index")
 

@@ -475,13 +475,55 @@ class Table:
         return self.ix(expr, optional=optional, context=context)
 
     # ------------------------------------------------------------------
+    # visualization (reference: stdlib/viz/table_viz.py show:26, Table.plot)
+    # ------------------------------------------------------------------
+    def show(self, *, snapshot: bool = True, include_id: bool = True,
+             short_pointers: bool = True, sorters=None):
+        # short_pointers: our Pointer str() is already the short digest form
+        if sorters is not None:
+            raise NotImplementedError(
+                "show(sorters=...) needs the panel widget backend "
+                "(not in this build)")
+        from pathway_tpu.stdlib.viz import show as _show
+
+        return _show(self, snapshot=snapshot, include_id=include_id)
+
+    def plot(self, plotting_function=None, sorting_col=None):
+        from pathway_tpu.stdlib.viz import plot as _plot
+
+        return _plot(self, plotting_function, sorting_col)
+
+    def _repr_html_(self) -> str:
+        import html as _html
+
+        from pathway_tpu.internals.runner import run_tables
+
+        [cap] = run_tables(self)
+        names = self._column_names()
+        out = ["<table>", "<tr>"]
+        out.extend(f"<th>{_html.escape(str(c))}</th>"
+                   for c in ["id"] + names)
+        out.append("</tr>")
+        for key, row in sorted(cap.snapshot().items(),
+                               key=lambda kv: int(kv[0]))[:50]:
+            out.append("<tr>")
+            out.append(f"<td>{_html.escape(str(key))}</td>")
+            out.extend(
+                f"<td>{_html.escape('' if v is None else str(v))}</td>"
+                for v in row)
+            out.append("</tr>")
+        out.append("</table>")
+        return "".join(out)
+
+    # ------------------------------------------------------------------
     # iteration / indexes / io hooks (wired by other modules)
     # ------------------------------------------------------------------
     def _external_index_as_of_now(self, query_table, *, index_factory,
                                   query_responses_limit_column=None,
                                   query_filter_column=None,
                                   index_filter_data_column=None,
-                                  res_type=dt.ANY_TUPLE):
+                                  res_type=dt.ANY_TUPLE,
+                                  revise: bool = False):
         cols = {"_pw_index_reply": sch.ColumnSchema(name="_pw_index_reply",
                                                     dtype=res_type)}
         schema = sch.schema_from_columns(cols)
@@ -491,6 +533,7 @@ class Table:
             limit_col=query_responses_limit_column,
             query_filter_col=query_filter_column,
             data_filter_col=index_filter_data_column,
+            revise=revise,
         )
         return Table(plan, schema, query_table._universe.subuniverse())
 

@@ -77,8 +77,8 @@ class DataIndex:
               number_of_matches: ex.ColumnExpression | int = 3,
               collapse_rows: bool = True,
               metadata_filter: ex.ColumnExpression | None = None) -> Table:
-        # NOTE: full "revise results on data change" semantics land with the
-        # re-scoring operator; identical to query_as_of_now in batch mode.
+        # Full semantics: standing queries are re-answered whenever the
+        # indexed data changes (engine/index_ops.py revise=True path).
         return self._query(query_column, number_of_matches, collapse_rows,
                            metadata_filter, as_of_now=False)
 
@@ -107,6 +107,7 @@ class DataIndex:
             query_responses_limit_column=query_prepared._pw_k,
             query_filter_column=query_prepared._pw_filter,
             index_filter_data_column=data_prepared._pw_meta,
+            revise=not as_of_now,
         )
 
         # reply: key=query key, column _pw_index_reply = ((match_key, score),...)

@@ -21,9 +21,12 @@ __all__ = [
     "Window", "tumbling", "sliding", "session", "intervals_over",
     "CommonBehavior", "common_behavior", "exactly_once_behavior",
     "windowby", "asof_join", "asof_join_left", "asof_join_right",
-    "asof_join_outer", "asof_now_join", "asof_now_join_left",
-    "interval", "interval_join", "interval_join_left", "interval_join_right",
-    "interval_join_outer", "window_join", "Direction",
+    "asof_join_outer", "asof_now_join", "asof_now_join_inner",
+    "asof_now_join_left",
+    "interval", "interval_join", "interval_join_inner", "interval_join_left",
+    "interval_join_right", "interval_join_outer",
+    "window_join", "window_join_inner", "window_join_left",
+    "window_join_right", "window_join_outer", "Direction",
 ]
 
 
@@ -793,3 +796,34 @@ def window_join(left: Table, right: Table, t_left, t_right, window: Window,
             return jr.select(**fixed)
 
     return _WJ()
+
+
+# explicit-mode aliases (reference __init__.py exports the full matrix)
+def asof_now_join_inner(left, right, *on, **kw):
+    kw["how"] = "inner"
+    return asof_now_join(left, right, *on, **kw)
+
+
+def interval_join_inner(left, right, t_left, t_right, intrvl, *on, **kw):
+    kw["how"] = "inner"
+    return interval_join(left, right, t_left, t_right, intrvl, *on, **kw)
+
+
+def window_join_inner(left, right, t_left, t_right, window, *on, **kw):
+    kw["how"] = "inner"
+    return window_join(left, right, t_left, t_right, window, *on, **kw)
+
+
+def window_join_left(left, right, t_left, t_right, window, *on, **kw):
+    kw["how"] = "left"
+    return window_join(left, right, t_left, t_right, window, *on, **kw)
+
+
+def window_join_right(left, right, t_left, t_right, window, *on, **kw):
+    kw["how"] = "right"
+    return window_join(left, right, t_left, t_right, window, *on, **kw)
+
+
+def window_join_outer(left, right, t_left, t_right, window, *on, **kw):
+    kw["how"] = "outer"
+    return window_join(left, right, t_left, t_right, window, *on, **kw)

@@ -1,0 +1,96 @@
+"""pw.pandas_transformer — run a pandas function over whole tables
+(reference: stdlib/utils/pandas_transformer.py — tables gathered to
+DataFrames, the user function applied, the result re-keyed).
+
+One batched whole-table dispatch per input change (the reference gathers via
+sorted_tuple reducers identically); meant for infrequent small-table use."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import pathway_tpu.internals.reducers_frontend as reducers
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.keys import Pointer, hash_values
+from pathway_tpu.internals.table import Table
+
+
+def pandas_transformer(output_schema, output_universe: str | int | None = None):
+    """Decorator: the wrapped function receives pandas DataFrames (indexed by
+    row key as int) in place of Tables and must return a DataFrame; the
+    result becomes a Table with ``output_schema``. When ``output_universe``
+    names (or indexes) an input argument, the output keeps that table's
+    keys; otherwise rows are re-keyed from the DataFrame index."""
+
+    def wrapper(func: Callable) -> Callable:
+        import inspect
+
+        arg_names = list(inspect.signature(func).parameters)
+
+        def wrapped(*tables: Table) -> Table:
+            import pandas as pd
+
+            assert tables, "pandas_transformer needs at least one input table"
+            packed_cols = {}
+            metas = []
+            for idx, t in enumerate(tables):
+                names = t.column_names()
+                packed = t.select(row=ex.apply(
+                    lambda rid, *vals: (int(rid), *vals), t.id,
+                    *[t[n] for n in names]))
+                packed_cols[f"_pw_in_{idx}"] = packed.reduce(
+                    rows=reducers.sorted_tuple(packed.row))
+                metas.append(names)
+
+            base = None
+            for idx, rt in enumerate(packed_cols.values()):
+                if base is None:
+                    base = rt.select(_pw_in_0=rt.rows)
+                else:
+                    jr = base.join(rt, ex.wrap_arg(0) == ex.wrap_arg(0),
+                                   id=base.id)
+                    base = jr.select(
+                        **{c: base[c] for c in base.column_names()},
+                        **{f"_pw_in_{idx}": rt.rows})
+
+            def run(*packed_rows):
+                frames = []
+                for names, rows in zip(metas, packed_rows):
+                    ids = [r[0] for r in rows]
+                    data = {n: [r[i + 1] for r in rows]
+                            for i, n in enumerate(names)}
+                    frames.append(pd.DataFrame(data, index=ids))
+                result = func(*frames)
+                out_names = output_schema.column_names()
+                out_rows = []
+                for key_val, row in zip(result.index, result.itertuples(
+                        index=False)):
+                    out_rows.append((int(key_val), *row[:len(out_names)]))
+                return tuple(out_rows)
+
+            applied = base.select(out=ex.apply(
+                run, *[base[f"_pw_in_{i}"] for i in range(len(tables))]))
+            flat = applied.flatten(applied.out)
+            out_names = output_schema.column_names()
+
+            keyed = flat.select(
+                _pw_id=ex.apply(_result_key(output_universe, arg_names,
+                                            tables), flat.out),
+                **{n: ex.apply(lambda r, _i=i: r[_i + 1], flat.out)
+                   for i, n in enumerate(out_names)})
+            return keyed.with_id(keyed._pw_id).without("_pw_id")
+
+        return wrapped
+
+    return wrapper
+
+
+def _result_key(output_universe, arg_names, tables):
+    if output_universe is not None:
+        # keys come from an input table: the DataFrame index IS its row keys
+        def key_of(r):
+            return Pointer(r[0])
+    else:
+        def key_of(r):
+            return hash_values("pandas_transformer", r[0])
+    return key_of

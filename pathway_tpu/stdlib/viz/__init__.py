@@ -7,13 +7,35 @@ from __future__ import annotations
 from pathway_tpu.internals.table import Table
 
 
-def show(table: Table, **kwargs) -> None:
-    from pathway_tpu.debug import compute_and_print
+def show(table: Table, *, snapshot: bool = True,
+         include_id: bool = True) -> str:
+    """Text-mode table preview (bokeh/panel not in-image; the reference
+    returns a live pn.Column — here the bounded render as a string).
+    snapshot=False renders the change stream with time/diff columns."""
+    import io
 
-    compute_and_print(table)
+    from pathway_tpu.debug import (
+        compute_and_print_update_stream,
+        table_to_markdown,
+    )
+
+    if snapshot:
+        rendered = table_to_markdown(table, include_id=include_id)
+    else:
+        buf = io.StringIO()
+        compute_and_print_update_stream(table, include_id=include_id,
+                                        file=buf)
+        rendered = buf.getvalue().rstrip("\n")
+    print(rendered)
+    return rendered
 
 
 def plot(table: Table, plotting_function=None, sorting_col=None):
+    try:
+        import bokeh  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "interactive plotting requires bokeh/panel (not in this image)"
+        ) from e
     raise NotImplementedError(
-        "interactive plotting requires bokeh/panel (not in this image)"
-    )
+        "bokeh present but live plotting is not wired in this build yet")

@@ -1,0 +1,58 @@
+"""DataIndex.query revise-on-update semantics vs query_as_of_now
+(reference: stdlib/indexing/data_index.py — query revises, as_of_now
+freezes; engine/index_ops.py revise flag)."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing import TantivyBM25
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from tests.utils import T, rows_of
+
+
+def _setup():
+    docs = T("""
+    text            | __time__
+    alpha_one       | 2
+    beta_two        | 2
+    alpha_three     | 6
+    """).select(text=pw.apply(lambda s: s.replace("_", " "), pw.this.text))
+    queries = T("""
+    q     | k | __time__
+    alpha | 2 | 4
+    """).select(q=pw.this.q, k=pw.this.k)
+    return docs, queries
+
+
+def test_query_revises_when_data_changes():
+    docs, queries = _setup()
+    index = DataIndex(docs, TantivyBM25(docs.text))
+    res = index.query(queries.q, number_of_matches=queries.k)
+    out = res.select(hit=res.text)
+    # final state: the query (arrived t=4) sees the doc added at t=6 too
+    [(hits,)] = rows_of(out)
+    assert set(hits) == {"alpha one", "alpha three"}
+
+
+def test_query_as_of_now_freezes():
+    docs, queries = _setup()
+    index = DataIndex(docs, TantivyBM25(docs.text))
+    res = index.query_as_of_now(queries.q, number_of_matches=queries.k)
+    out = res.select(hit=res.text)
+    # answered at t=4: only the docs existing then; never revised at t=6
+    [(hits,)] = rows_of(out)
+    assert set(hits) == {"alpha one"}
+
+
+def test_query_revision_emits_retractions():
+    from pathway_tpu.internals.runner import run_tables
+
+    docs, queries = _setup()
+    index = DataIndex(docs, TantivyBM25(docs.text))
+    res = index.query(queries.q, number_of_matches=queries.k)
+    out = res.select(hit=res.text)
+    [cap] = run_tables(out)
+    events = cap.consolidated_events()
+    # at t=6 the old single-hit reply row set must be revised incrementally
+    times = sorted({t for _, _, t, _ in events})
+    assert 6 in times
+    retractions = [e for e in events if e[3] < 0]
+    assert retractions, "data change must retract superseded reply rows"

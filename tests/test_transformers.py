@@ -1,0 +1,168 @@
+"""Row transformers, pandas_transformer, table_transformer
+(reference: internals/row_transformer.py, stdlib/utils/pandas_transformer.py,
+internals/common.py table_transformer)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, rows_of
+
+
+def test_class_transformer_basic():
+    @pw.transformer
+    class doubler:
+        class table(pw.ClassArg):
+            value = pw.input_attribute()
+
+            @pw.output_attribute
+            def doubled(self):
+                return self.value * 2
+
+            @pw.output_attribute
+            def plus_one(self):
+                return self.doubled + 1  # depends on another output attr
+
+    t = T("""
+    value
+    3
+    5
+    """)
+    result = doubler(table=t).table
+    assert sorted(rows_of(result)) == [(6, 7), (10, 11)]
+    # output keyed like the input: joinable back
+    j = t.join(result, t.id == result.id).select(v=t.value, d=result.doubled)
+    assert sorted(rows_of(j)) == [(3, 6), (5, 10)]
+
+
+def test_class_transformer_pointer_chasing():
+    @pw.transformer
+    class chained:
+        class nodes(pw.ClassArg):
+            nxt = pw.input_attribute()
+            val = pw.input_attribute()
+
+            @pw.output_attribute
+            def chain_sum(self):
+                # sum of own value + next's value (pointer chase)
+                if self.nxt is None:
+                    return self.val
+                other = self.transformer.nodes[self.nxt]
+                return self.val + other.val
+
+    t = T("""
+    name | val
+    a    | 1
+    b    | 10
+    c    | 100
+    """).with_id_from(pw.this.name)
+    linked = t.select(
+        val=t.val,
+        nxt=pw.if_else(t.name == "c", None,
+                       t.pointer_from(pw.if_else(t.name == "a", "b", "c"))))
+    result = chained(nodes=linked).nodes
+    got = dict((v, s) for v, s in
+               rows_of(linked.join(result, linked.id == result.id).select(
+                   v=linked.val, s=result.chain_sum)))
+    assert got == {1: 11, 10: 110, 100: 100}
+
+
+def test_class_transformer_recursive_output_across_rows():
+    @pw.transformer
+    class cascade:
+        class items(pw.ClassArg):
+            nxt = pw.input_attribute()
+            val = pw.input_attribute()
+
+            @pw.output_attribute
+            def total(self):
+                # recursive: total = val + next.total
+                if self.nxt is None:
+                    return self.val
+                return self.val + self.transformer.items[self.nxt].total
+
+    t = T("""
+    name | val
+    a    | 1
+    b    | 2
+    c    | 4
+    """).with_id_from(pw.this.name)
+    linked = t.select(
+        val=t.val,
+        nxt=pw.if_else(t.name == "c", None,
+                       t.pointer_from(pw.if_else(t.name == "a", "b", "c"))))
+    result = cascade(items=linked).items
+    got = dict(rows_of(linked.join(result, linked.id == result.id).select(
+        v=linked.val, s=result.total)))
+    assert got == {1: 7, 2: 6, 4: 4}
+
+
+def test_pandas_transformer():
+    schema = pw.schema_from_types(scaled=float)
+
+    @pw.pandas_transformer(output_schema=schema, output_universe=0)
+    def scale(df):
+        return (df[["x"]] / df["x"].sum()).rename(columns={"x": "scaled"})
+
+    t = T("""
+    x
+    1
+    3
+    """)
+    result = scale(t)
+    assert sorted(rows_of(result)) == [(0.25,), (0.75,)]
+    # keys preserved (output_universe=first arg)
+    j = t.join(result, t.id == result.id).select(x=t.x, s=result.scaled)
+    assert sorted(rows_of(j)) == [(1, 0.25), (3, 0.75)]
+
+
+def test_table_transformer_checks_schema():
+    class NeedsX(pw.Schema):
+        x: int
+
+    @pw.table_transformer
+    def f(t: NeedsX):
+        return t
+
+    t_ok = T("""
+    x | y
+    1 | 2
+    """)
+    f(t_ok)  # superset allowed
+    t_bad = T("""
+    z
+    1
+    """)
+    with pytest.raises(TypeError, match="missing"):
+        f(t_bad)
+
+
+def test_show_and_repr_html_and_interactive():
+    t = T("""
+    a
+    1
+    2
+    """)
+    rendered = t.show()
+    assert "a" in rendered and "1" in rendered
+    html = t._repr_html_()
+    assert html.startswith("<table>") and "<td>2</td>" in html
+
+    import sys
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ctrl = pw.enable_interactive_mode()
+    try:
+        assert pw.is_interactive_mode_enabled()
+        import io
+        buf = io.StringIO()
+        stdout, sys.stdout = sys.stdout, buf
+        try:
+            sys.displayhook(t)
+        finally:
+            sys.stdout = stdout
+        assert "a" in buf.getvalue()
+    finally:
+        ctrl.close()
+        from pathway_tpu.internals.parse_graph import G
+        G.interactive_mode_controller = None
