@@ -27,28 +27,28 @@ def _plural(n: int, singular: str, plural: str) -> str:
 
 def spawn_program(*, threads: int, processes: int, first_port: int,
                   program: str, arguments: tuple[str, ...], env_base: dict):
+    # One host process drives the TPU; scaling is logical workers sharding
+    # the dataflow in-process (engine/graph.py Scheduler) and the device
+    # mesh — not OS processes. `-n N` therefore folds into N*T logical
+    # workers of a single process instead of forking N duplicate pipelines.
+    workers = processes * threads
     click.echo(
-        f"Preparing {_plural(processes, 'process', 'processes')} "
-        f"({_plural(processes * threads, 'total worker', 'total workers')})",
+        f"Preparing 1 process ({_plural(workers, 'total worker', 'total workers')})",
         err=True)
     run_id = str(uuid.uuid4())
-    handles = []
+    env = dict(env_base)
+    env["PATHWAY_THREADS"] = str(workers)
+    env["PATHWAY_PROCESSES"] = "1"
+    env["PATHWAY_FIRST_PORT"] = str(first_port)
+    env["PATHWAY_PROCESS_ID"] = "0"
+    env["PATHWAY_RUN_ID"] = run_id
+    handle = subprocess.Popen([program, *arguments], env=env)
     try:
-        for process_id in range(processes):
-            env = dict(env_base)
-            env["PATHWAY_THREADS"] = str(threads)
-            env["PATHWAY_PROCESSES"] = str(processes)
-            env["PATHWAY_FIRST_PORT"] = str(first_port)
-            env["PATHWAY_PROCESS_ID"] = str(process_id)
-            env["PATHWAY_RUN_ID"] = run_id
-            handles.append(subprocess.Popen([program, *arguments], env=env))
-        for handle in handles:
-            handle.wait()
+        handle.wait()
     finally:
-        for handle in handles:
-            if handle.poll() is None:
-                handle.terminate()
-    sys.exit(max((h.returncode or 0) for h in handles))
+        if handle.poll() is None:
+            handle.terminate()
+    sys.exit(handle.returncode or 0)
 
 
 @click.group()

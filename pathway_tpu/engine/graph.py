@@ -19,7 +19,8 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from pathway_tpu.engine.delta import Arrangement, Delta, row_fingerprint
-from pathway_tpu.engine.operators import Operator, SourceOperator
+from pathway_tpu.engine.operators import Exchange, Operator, SourceOperator
+from pathway_tpu.internals.keys import Pointer, hash_values
 
 
 class Node:
@@ -95,15 +96,60 @@ class CapturedStream:
 
 
 class Scheduler:
-    """Single-host microbatch driver for an EngineGraph."""
+    """Single-host microbatch driver for an EngineGraph.
 
-    def __init__(self, graph: EngineGraph):
+    With ``n_workers > 1`` the scheduler runs the dataflow *sharded*: every
+    node gets one operator replica per logical worker, rows are key-routed
+    between workers at each stateful operator's exchange boundary
+    (reference: timely worker threads + exchange pacts,
+    src/engine/dataflow/shard.rs — shard = key & mask), and sources are
+    partitioned by row key. Execution is bulk-synchronous per node per
+    timestamp, so the per-time consistency guarantee is unchanged.
+    """
+
+    def __init__(self, graph: EngineGraph, n_workers: int = 1):
         self.graph = graph
+        self.n_workers = max(1, int(n_workers))
         self._topo = self._topo_sort()
+        # worker replicas per node; replica 0 is always node.op itself.
+        # Gather nodes (unpartitionable state) keep a single replica that
+        # lives on worker 0.
+        self._replicas: dict[int, list[Operator]] = {}
+        self._gather: dict[int, bool] = {}
+        for node in graph.nodes:
+            specs = node.op.exchange_specs()
+            gather = any(s == Exchange.GATHER for s in specs)
+            self._gather[node.id] = gather
+            if self.n_workers == 1 or gather:
+                self._replicas[node.id] = [node.op]
+            else:
+                self._replicas[node.id] = node.op.replicate(self.n_workers)
         self.stats: dict[int, dict] = {
             n.id: {"insertions": 0, "retractions": 0} for n in graph.nodes
         }
         self.on_step: Callable[[int], None] | None = None
+
+    # -- sharding helpers ----------------------------------------------------
+    def _route(self, spec, key, row) -> int:
+        v = key if spec == Exchange.BY_KEY else spec(key, row)
+        if not isinstance(v, int):  # Pointer subclasses int
+            v = hash_values(v)
+        return int(v) % self.n_workers
+
+    def push_source(self, node: Node, delta: Delta) -> None:
+        """Feed a source node, partitioning rows across workers by key
+        (the in-process analogue of per-worker source reads,
+        reference src/connectors/mod.rs:400)."""
+        reps = self._replicas[node.id]
+        if len(reps) == 1:
+            reps[0].push(delta)
+            return
+        parts: list[list] = [[] for _ in reps]
+        for key, row, diff in delta.entries:
+            parts[int(key) % self.n_workers].append((key, row, diff))
+        for rep, part in zip(reps, parts):
+            if part:
+                rep.push(Delta(part))
 
     def _topo_sort(self) -> list[Node]:
         seen: dict[int, int] = {}
@@ -132,41 +178,135 @@ class Scheduler:
         (temporal buffers) release them, and the releases propagate downstream
         within the same tick.
         """
-        outputs: dict[int, Delta] = {}
-        for node in self._topo:
-            in_deltas = [outputs.get(up.id, _EMPTY) for up in node.inputs]
-            try:
-                delta = node.op.step(time, in_deltas)
-                extra = node.op.on_time_advance(time)
-                if extra:
-                    delta = Delta(delta.entries + extra.entries).consolidate()
-                if flush:
-                    held = node.op.flush(time)
-                    if held:
-                        delta = Delta(delta.entries + held.entries).consolidate()
-            except Exception as e:
-                from pathway_tpu.internals.trace import add_trace_note
+        if self.n_workers == 1:
+            outputs: dict[int, Delta] = {}
+            for node in self._topo:
+                in_deltas = [outputs.get(up.id, _EMPTY) for up in node.inputs]
+                delta = self._step_op(node, node.op, time, in_deltas, flush)
+                outputs[node.id] = delta
+                self._count(node.id, delta)
+            if self.on_step is not None:
+                self.on_step(time)
+            return outputs
+        return self._run_time_sharded(time, flush)
 
-                # annotate rather than wrap: the original exception type must
-                # keep escaping pw.run() so user except-clauses still match
-                # (reference: trace.py add_pathway_trace_note)
-                add_trace_note(e, node.trace,
-                               node.name or type(node.op).__name__)
-                raise
-            outputs[node.id] = delta
-            if delta:
-                st = self.stats[node.id]
-                for _, _, d in delta.entries:
-                    if d > 0:
-                        st["insertions"] += d
+    def _step_op(self, node: Node, op: Operator, time: int,
+                 in_deltas: list[Delta], flush: bool) -> Delta:
+        try:
+            delta = op.step(time, in_deltas)
+            extra = op.on_time_advance(time)
+            if extra:
+                delta = Delta(delta.entries + extra.entries).consolidate()
+            if flush:
+                held = op.flush(time)
+                if held:
+                    delta = Delta(delta.entries + held.entries).consolidate()
+        except Exception as e:
+            from pathway_tpu.internals.trace import add_trace_note
+
+            # annotate rather than wrap: the original exception type must
+            # keep escaping pw.run() so user except-clauses still match
+            # (reference: trace.py add_pathway_trace_note)
+            add_trace_note(e, node.trace,
+                           node.name or type(node.op).__name__)
+            raise
+        return delta
+
+    def _count(self, node_id: int, delta: Delta) -> None:
+        if delta:
+            st = self.stats[node_id]
+            for _, _, d in delta.entries:
+                if d > 0:
+                    st["insertions"] += d
+                else:
+                    st["retractions"] -= d
+
+    def _run_time_sharded(self, time: int, flush: bool) -> dict[int, Delta]:
+        n = self.n_workers
+        outputs: dict[int, list[Delta]] = {}  # node.id -> per-worker deltas
+        for node in self._topo:
+            reps = self._replicas[node.id]
+            if self._gather[node.id]:
+                # single owner on worker 0 consumes every worker's input
+                ins = []
+                for up in node.inputs:
+                    parts = outputs.get(up.id)
+                    merged = []
+                    for p in parts or ():
+                        merged.extend(p.entries)
+                    ins.append(Delta(merged).consolidate() if merged else _EMPTY)
+                delta = self._step_op(node, reps[0], time, ins, flush)
+                outs = [delta] + [_EMPTY] * (n - 1)
+            else:
+                specs = reps[0].exchange_specs()
+                per_worker: list[list[Delta]] = [
+                    [_EMPTY] * len(node.inputs) for _ in range(n)]
+                for j, up in enumerate(node.inputs):
+                    parts = outputs.get(up.id) or [_EMPTY] * n
+                    spec = specs[j]
+                    if spec is None:
+                        for w in range(n):
+                            per_worker[w][j] = parts[w]
                     else:
-                        st["retractions"] -= d
+                        routed: list[list] = [[] for _ in range(n)]
+                        for p in parts:
+                            for key, row, diff in p.entries:
+                                routed[self._route(spec, key, row)].append(
+                                    (key, row, diff))
+                        for w in range(n):
+                            if routed[w]:
+                                per_worker[w][j] = Delta(routed[w]).consolidate()
+                # temporal operators share one watermark across workers
+                # (global, like a timely frontier): advance it from every
+                # worker's input before any replica releases rows on it
+                if hasattr(reps[0], "_advance_watermark"):
+                    for w in range(n):
+                        for d in per_worker[w]:
+                            if d:
+                                reps[w]._advance_watermark(d)
+                outs = [
+                    self._step_op(node, reps[w], time, per_worker[w], flush)
+                    for w in range(n)
+                ]
+            outputs[node.id] = outs
+            for d in outs:
+                self._count(node.id, d)
         if self.on_step is not None:
             self.on_step(time)
-        return outputs
+        return _MergedOutputs(outputs)
 
 
 _EMPTY = Delta()
+
+
+class _MergedOutputs:
+    """Lazy node-output view over per-worker deltas: merging every node's
+    partitions each tick would be pure overhead (the streaming/batch drivers
+    ignore run_time's return value), so partitions are concatenated and
+    consolidated only for nodes a caller actually asks for — matching the
+    consolidated per-op deltas the n_workers=1 path returns."""
+
+    __slots__ = ("_per_worker",)
+
+    def __init__(self, per_worker: dict[int, list[Delta]]):
+        self._per_worker = per_worker
+
+    def get(self, node_id: int, default: Delta = _EMPTY) -> Delta:
+        outs = self._per_worker.get(node_id)
+        if outs is None:
+            return default
+        entries: list = []
+        for d in outs:
+            entries.extend(d.entries)
+        return Delta(entries).consolidate()
+
+    def __getitem__(self, node_id: int) -> Delta:
+        if node_id not in self._per_worker:
+            raise KeyError(node_id)
+        return self.get(node_id)
+
+    def __contains__(self, node_id: int) -> bool:
+        return node_id in self._per_worker
 
 
 class IterateOperator(Operator):
@@ -179,6 +319,12 @@ class IterateOperator(Operator):
     ``limit`` rounds passed; then emit the diff of the converged result
     against what was previously emitted.
     """
+
+    def exchange_specs(self):
+        # the fixpoint body may contain joins/groupbys over the whole
+        # collection: per-shard fixpoints would be wrong (e.g. pagerank on a
+        # subgraph), so iteration state is owned by one worker
+        return [Exchange.GATHER] * self.arity
 
     def __init__(self, n_iterated: int, n_extra: int, builder, limit: int | None):
         self.arity = n_iterated + n_extra

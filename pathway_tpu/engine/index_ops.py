@@ -16,7 +16,7 @@ from __future__ import annotations
 from typing import Any, Protocol
 
 from pathway_tpu.engine.delta import Delta
-from pathway_tpu.engine.operators import Operator
+from pathway_tpu.engine.operators import Exchange, Operator
 from pathway_tpu.internals.keys import Pointer
 
 
@@ -34,6 +34,12 @@ class ExternalIndex(Protocol):
 
 class ExternalIndexOperator(Operator):
     arity = 2  # [data, queries]
+
+    def exchange_specs(self):
+        # the TPU index is one device-resident slab: a single owner ingests
+        # all data and answers all queries (the mesh-sharded variant lives in
+        # parallel/sharded_knn.py and shards *inside* the index over ICI)
+        return [Exchange.GATHER, Exchange.GATHER]
 
     def __init__(self, index, data_vec_pos: int, data_filter_pos: int | None,
                  query_vec_pos: int, query_limit_pos: int | None,

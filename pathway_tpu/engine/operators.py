@@ -31,11 +31,45 @@ from pathway_tpu.engine.reducers import make_reducer_state
 from pathway_tpu.internals.keys import Pointer, hash_values
 
 
+class Exchange:
+    """Per-input exchange contracts for sharded execution (reference:
+    src/engine/dataflow/shard.rs — keys route to workers by hash; exchange
+    pacts on arrange/join/group inputs). A spec is one of:
+
+    - ``None``: no data movement — the input is processed on whichever
+      worker currently holds each row (stateless operators),
+    - ``Exchange.BY_KEY``: route each entry by its row key,
+    - ``Exchange.GATHER``: send everything to worker 0 (operators whose
+      state cannot be partitioned, e.g. fixpoint iteration),
+    - a callable ``(key, row) -> routing value``: route by the hash of the
+      returned value (join keys, group keys, instances).
+    """
+
+    BY_KEY = "by_key"
+    GATHER = "gather"
+
+
 class Operator:
     arity = 1
 
     def step(self, time: int, in_deltas: list[Delta]) -> Delta:
         raise NotImplementedError
+
+    def exchange_specs(self) -> list:
+        """One exchange spec per input (see Exchange). Default: stateless —
+        rows are processed wherever they already live."""
+        return [None] * self.arity
+
+    def replicate(self, n: int) -> list["Operator"]:
+        """Return n worker replicas of this operator, self as worker 0.
+
+        Must be called before any data has flowed (state empty), so a
+        deepcopy clones configuration (closures are shared by reference —
+        the copy module treats functions as atomic) with fresh state.
+        """
+        import copy
+
+        return [self] + [copy.deepcopy(self) for _ in range(n - 1)]
 
     def on_time_advance(self, time: int) -> Delta:
         """Called for every committed timestamp (even with no input) so
@@ -186,6 +220,9 @@ class BinaryKeyOperator(Operator):
         self.left = Arrangement()
         self.right = Arrangement()
 
+    def exchange_specs(self):
+        return [Exchange.BY_KEY, Exchange.BY_KEY]
+
     def step(self, time, in_deltas):
         dl, dr = in_deltas
         if not dl and not dr:
@@ -223,6 +260,9 @@ class NAryConcatOperator(Operator):
         self.states = [Arrangement() for _ in range(n)]
         self.combine_rows = combine_rows
         self.update = update
+
+    def exchange_specs(self):
+        return [Exchange.BY_KEY] * self.arity
 
     def step(self, time, in_deltas):
         if not any(in_deltas):
@@ -275,12 +315,23 @@ class GroupByOperator(Operator):
         self.out = Arrangement()
         self.seq = 0
 
+    def exchange_specs(self):
+        # route rows to the worker owning their group (reference: group_by
+        # exchanges by group key, dataflow.rs:2904)
+        return [lambda key, row: self.group_fn(key, row)[0]]
+
     def step(self, time, in_deltas):
         delta = in_deltas[0]
         if not delta:
             return Delta()
         touched: dict[Pointer, None] = {}
-        for key, row, diff in delta.entries:
+        # canonical per-tick order (key, then retractions-first, then row):
+        # order-sensitive reducers (earliest/latest stamps, stateful folds)
+        # must not depend on arrival order, which sharded exchange permutes —
+        # with a canonical order, n_workers ∈ {1, N} give identical results
+        for key, row, diff in sorted(
+                delta.entries,
+                key=lambda e: (int(e[0]), e[2], row_fingerprint(e[1]))):
             gkey, gvals = self.group_fn(key, row)
             states = self.group_states.get(gkey)
             if states is None:
@@ -340,6 +391,12 @@ class JoinOperator(Operator):
         self.left: dict[Any, dict[Pointer, tuple]] = {}
         self.right: dict[Any, dict[Pointer, tuple]] = {}
         self.left_id_only = left_id_only
+
+    def exchange_specs(self):
+        # both sides route by join key so each key group is wholly owned by
+        # one worker (reference: join exchanges, dataflow.rs:2276)
+        return [lambda k, r: self.lkey_fn(k, r),
+                lambda k, r: self.rkey_fn(k, r)]
 
     @staticmethod
     def _default_out_key(lkey, rkey, jk):
@@ -416,10 +473,20 @@ class DeduplicateOperator(Operator):
         self.acceptor = acceptor
         self.state: dict[Any, tuple[Pointer, tuple]] = {}
 
+    def exchange_specs(self):
+        # per-instance acceptance is order-sensitive: a single worker must
+        # own each instance (reference: deduplicate exchanges by instance)
+        return [lambda k, r: self.instance_fn(k, r)]
+
     def step(self, time, in_deltas):
         delta = in_deltas[0]
         out = Delta()
-        for key, row, diff in delta.entries:
+        # canonical per-tick order: acceptance is order-sensitive, and the
+        # sharded exchange permutes same-tick arrival order — sorting by key
+        # keeps results identical at any worker count (across ticks the
+        # stream order still governs, as before)
+        for key, row, diff in sorted(
+                delta.entries, key=lambda e: int(e[0])):
             if diff <= 0:
                 continue  # deduplicate consumes append-only streams
             inst = self.instance_fn(key, row)
@@ -452,6 +519,12 @@ class OutputOperator(Operator):
     def __init__(self, callback: Callable[[int, Delta], None]):
         self.callback = callback
 
+    def replicate(self, n):
+        # all workers funnel into the same sink: share the callback object
+        # (a deepcopy of a bound method would clone its receiver and the
+        # replica outputs would silently vanish into the copy)
+        return [self] + [OutputOperator(self.callback) for _ in range(n - 1)]
+
     def step(self, time, in_deltas):
         delta = in_deltas[0]
         if delta:
@@ -467,6 +540,9 @@ class StatefulArrangeOperator(Operator):
 
     def __init__(self):
         self.state = Arrangement()
+
+    def exchange_specs(self):
+        return [Exchange.BY_KEY]
 
     def step(self, time, in_deltas):
         self.state.update(in_deltas[0])
@@ -486,6 +562,11 @@ class SortOperator(Operator):
         self.instance_fn = instance_fn
         self.instances: dict[Any, dict[Pointer, Any]] = {}
         self.out = Arrangement()
+
+    def exchange_specs(self):
+        # prev/next neighbours are computed within an instance: one worker
+        # must own each instance (reference: operators/prev_next.rs)
+        return [lambda k, r: self.instance_fn(k, r)]
 
     def step(self, time, in_deltas):
         delta = in_deltas[0]

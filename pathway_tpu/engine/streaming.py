@@ -21,11 +21,15 @@ from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
 class StreamingRuntime:
     def __init__(self, runner, *, monitoring_level=None, with_http_server=False,
                  persistence_config=None, terminate_on_error=True,
-                 default_commit_ms: int = 100):
+                 default_commit_ms: int = 100, n_workers: int | None = None):
         from pathway_tpu.io._datasource import Session
 
+        if n_workers is None:
+            from pathway_tpu.internals.config import get_pathway_config
+
+            n_workers = get_pathway_config().threads
         self.runner = runner
-        self.scheduler = Scheduler(runner.graph)
+        self.scheduler = Scheduler(runner.graph, n_workers=n_workers)
         self.sessions = []
         self.threads = []
         self.default_commit_ms = default_commit_ms
@@ -86,7 +90,7 @@ class StreamingRuntime:
                     entries = session.drain()
                     if entries:
                         any_data = True
-                        node.op.push(Delta(entries))
+                        self.scheduler.push_source(node, Delta(entries))
                     if not session.closed.is_set():
                         all_closed = False
                 self.scheduler.run_time(time_counter)
@@ -105,7 +109,7 @@ class StreamingRuntime:
                             entries = session.drain()
                             if entries:
                                 leftovers = True
-                                node.op.push(Delta(entries))
+                                self.scheduler.push_source(node, Delta(entries))
                         if leftovers:
                             self.scheduler.run_time(time_counter)
                             time_counter += 1

@@ -16,7 +16,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from pathway_tpu.engine.delta import Delta, row_fingerprint
-from pathway_tpu.engine.operators import Operator
+from pathway_tpu.engine.operators import Exchange, Operator
 
 NEG_INF = float("-inf")
 
@@ -56,7 +56,27 @@ class _WatermarkOp(Operator):
     def __init__(self, threshold_fn: Callable, time_fn: Callable):
         self.threshold_fn = threshold_fn
         self.time_fn = time_fn
-        self.watermark: Any = NEG_INF
+        # boxed so sharded worker replicas share one global watermark, the
+        # way timely frontiers are global across workers (the scheduler
+        # advances every replica's watermark before stepping any of them)
+        self._wm_box: list = [NEG_INF]
+
+    @property
+    def watermark(self) -> Any:
+        return self._wm_box[0]
+
+    @watermark.setter
+    def watermark(self, v: Any) -> None:
+        self._wm_box[0] = v
+
+    def exchange_specs(self):
+        return [Exchange.BY_KEY]
+
+    def replicate(self, n):
+        reps = super().replicate(n)
+        for r in reps[1:]:
+            r._wm_box = self._wm_box
+        return reps
 
     def _advance_watermark(self, delta: Delta) -> None:
         for key, row, diff in delta.entries:

@@ -19,6 +19,18 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
     sources enter the realtime microbatch loop (pathway_tpu/engine/streaming.py)
     until all sources finish or the process is stopped.
     """
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    if cfg.processes > 1:
+        # never silently run N duplicate pipelines: multi-process topology
+        # needs cross-process exchange, which this engine scales over the
+        # device mesh instead (in-process logical workers shard the
+        # dataflow; see engine/graph.py Scheduler)
+        raise NotImplementedError(
+            f"PATHWAY_PROCESSES={cfg.processes}: multi-process dataflow "
+            "execution is not supported; use PATHWAY_THREADS=N for N "
+            "sharded in-process workers (cli spawn -n folds into this)")
     runner = GraphRunner()
     for binder in G.output_binders:
         binder(runner)

@@ -84,10 +84,14 @@ class GraphRunner:
         node = self.lower(table)
         self.graph.add_node(eng.OutputOperator(callback), [node], "subscribe")
 
-    def run_batch(self) -> None:
+    def run_batch(self, n_workers: int | None = None) -> None:
         """Run all static feeds to completion (batch mode: one pass over the
         totally-ordered times present in the inputs + a flush tick)."""
-        sched = Scheduler(self.graph)
+        if n_workers is None:
+            from pathway_tpu.internals.config import get_pathway_config
+
+            n_workers = get_pathway_config().threads
+        sched = Scheduler(self.graph, n_workers=n_workers)
         times: set[int] = {0}
         for node, feed in self._static_feeds:
             for t, _, _, _ in feed:
@@ -96,7 +100,7 @@ class GraphRunner:
             for node, feed in self._static_feeds:
                 batch = Delta([(k, r, d) for (ft, k, r, d) in feed if ft == t])
                 if batch:
-                    node.op.push(batch)
+                    sched.push_source(node, batch)
             sched.run_time(t)
         # end-of-stream flush tick: temporal buffers release held rows
         sched.run_time(max(times) + 1, flush=True)

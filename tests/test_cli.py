@@ -60,10 +60,11 @@ def test_spawn_multi_process_env(tmp_path):
                TEST_OUT=str(tmp_path / "out"))
     res = _run_cli("spawn", "-n", "2", sys.executable, str(prog), env=env)
     assert res.returncode == 0, res.stderr
-    assert "2 processes" in res.stderr
-    # each process ran the full program with its own PATHWAY_PROCESS_ID
+    # -n folds into sharded in-process workers of ONE process: exactly one
+    # pipeline runs (never N duplicate copies), results identical to -n 1
+    assert "1 process (2 total workers)" in res.stderr
     assert _counts(tmp_path / "out0") == {"x": 2, "y": 1}
-    assert _counts(tmp_path / "out1") == {"x": 2, "y": 1}
+    assert not (tmp_path / "out1").exists()
 
 
 def test_record_then_replay(tmp_path):
