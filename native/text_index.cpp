@@ -33,6 +33,10 @@ struct TextIndex {
     std::unordered_map<std::string, Posting> postings;
     std::unordered_map<uint64_t, uint32_t> doc_len;
     std::unordered_map<uint64_t, std::vector<std::string>> doc_tokens;
+    // doc id -> the engine's 128-bit Pointer key (hi, lo); equal-score
+    // results rank by this so the native and pure-Python engines agree
+    // (ops/bm25.py sorts ties by int(pointer) ascending)
+    std::unordered_map<uint64_t, std::pair<uint64_t, uint64_t>> doc_tie;
     uint64_t total_len = 0;
     std::mutex mu;
 };
@@ -71,6 +75,7 @@ void remove_locked(TextIndex* idx, uint64_t id) {
     }
     idx->total_len -= idx->doc_len[id];
     idx->doc_len.erase(id);
+    idx->doc_tie.erase(id);
     idx->doc_tokens.erase(it);
 }
 
@@ -87,7 +92,8 @@ void* ti_new(double k1, double b) {
 
 void ti_free(void* h) { delete static_cast<TextIndex*>(h); }
 
-void ti_add(void* h, uint64_t id, const char* text) {
+void ti_add(void* h, uint64_t id, uint64_t tie_hi, uint64_t tie_lo,
+            const char* text) {
     auto* idx = static_cast<TextIndex*>(h);
     std::lock_guard<std::mutex> lock(idx->mu);
     remove_locked(idx, id);  // re-add semantics match ops/bm25.py add()
@@ -95,6 +101,7 @@ void ti_add(void* h, uint64_t id, const char* text) {
     tokenize(text, tokens);
     idx->doc_len[id] = static_cast<uint32_t>(tokens.size());
     idx->total_len += tokens.size();
+    idx->doc_tie[id] = {tie_hi, tie_lo};
     for (const std::string& tok : tokens) {
         ++idx->postings[tok].tf[id];
     }
@@ -114,7 +121,9 @@ uint64_t ti_len(void* h) {
 }
 
 // Okapi BM25 (same formula as ops/bm25.py _score_query; ties broken by
-// ascending doc id). Writes up to k (id, score) pairs; returns the count.
+// ascending 128-bit Pointer key, matching the Python engine's
+// sort key (-score, int(pointer))). Writes up to k (id, score) pairs;
+// returns the count.
 int32_t ti_search(void* h, const char* query, int32_t k, uint64_t* out_ids,
                   double* out_scores) {
     auto* idx = static_cast<TextIndex*>(h);
@@ -146,8 +155,11 @@ int32_t ti_search(void* h, const char* query, int32_t k, uint64_t* out_ids,
     const size_t want = std::min(static_cast<size_t>(k), ranked.size());
     std::partial_sort(
         ranked.begin(), ranked.begin() + want, ranked.end(),
-        [](const auto& a, const auto& b) {
+        [idx](const auto& a, const auto& b) {
             if (a.second != b.second) return a.second > b.second;
+            const auto& ta = idx->doc_tie.at(a.first);
+            const auto& tb = idx->doc_tie.at(b.first);
+            if (ta != tb) return ta < tb;
             return a.first < b.first;
         });
     for (size_t i = 0; i < want; ++i) {

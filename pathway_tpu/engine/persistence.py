@@ -13,10 +13,15 @@ expressed as replay+skip so *any* deterministic reader gets exactly-once
 input without a per-reader seek API.
 
 The log is authoritative (no separate metadata file to keep consistent):
-records are length-prefixed pickles, fsynced per commit; a truncated tail
-record (crash mid-append) is detected and dropped on load. This mirrors the
+records are length-prefixed, CRC32-checksummed pickles decoded by a
+RESTRICTED unpickler (class whitelist below — a snapshot written by an
+attacker with access to shared storage must not execute code on resume),
+fsynced per commit; a truncated or corrupted tail record (crash
+mid-append, bit rot) is detected and dropped on load. This mirrors the
 reference's rule that only data finalized at the last *committed* frontier
-is recovered (state.rs:120-226).
+is recovered (state.rs:120-226) — the reference gets decode safety for
+free from serde/bincode's data-only model; the Python build has to
+enforce it explicitly.
 
 Backends: ``filesystem`` (a directory of per-source logs) and ``mock``
 (in-memory, state kept on the Backend object — the test double, like the
@@ -25,16 +30,61 @@ reference's mock metadata backend).
 
 from __future__ import annotations
 
+import io
 import os
 import pickle
 import struct
+import zlib
 from typing import Any
 
-_LEN = struct.Struct("<Q")
+_HDR = struct.Struct("<QI")  # payload length, CRC32(payload)
+_MAGIC = b"PWSNAP01"  # format marker; bump the digit on layout changes
+
+# Decode whitelist: data classes that legitimately appear inside logged
+# (time, [(key, row, diff, offset), ...]) records — engine Values
+# (internals/keys.Pointer, internals/json.Json, numpy arrays, datetimes)
+# and plain containers. Anything else (os.system, builtins.eval,
+# functools.partial, ...) is refused at load time.
+_SAFE_GLOBALS = {
+    ("builtins", n) for n in
+    ("list", "tuple", "dict", "set", "frozenset", "bytearray", "complex")
+} | {
+    ("pathway_tpu.internals.keys", "Pointer"),
+    ("pathway_tpu.internals.json", "Json"),
+    ("datetime", "datetime"), ("datetime", "date"), ("datetime", "time"),
+    ("datetime", "timedelta"), ("datetime", "timezone"),
+    # the build's canonical datetime/duration value types host-side are
+    # pandas Timestamp/Timedelta (internals/expressions/date_time.py)
+    ("pandas._libs.tslibs.timestamps", "_unpickle_timestamp"),
+    ("pandas._libs.tslibs.timestamps", "Timestamp"),
+    ("pandas._libs.tslibs.timedeltas", "_timedelta_unpickle"),
+    ("pandas._libs.tslibs.timedeltas", "Timedelta"),
+    ("numpy", "ndarray"), ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy._core.numeric", "_frombuffer"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _SAFE_GLOBALS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"snapshot log references forbidden global {module}.{name} — "
+            "refusing to decode (possible tampering)")
+
+
+def _safe_loads(payload: bytes):
+    return _RestrictedUnpickler(io.BytesIO(payload)).load()
 
 
 class SnapshotLog:
-    """Append-only framed-pickle log of (time, entries) records."""
+    """Append-only framed, checksummed, restricted-pickle log of
+    (time, entries) records."""
 
     def __init__(self, path: str):
         self.path = path
@@ -49,14 +99,27 @@ class SnapshotLog:
             return records, 0
         with open(self.path, "rb") as f:
             data = f.read()
-        pos = 0
-        while pos + _LEN.size <= len(data):
-            (length,) = _LEN.unpack_from(data, pos)
-            end = pos + _LEN.size + length
+        if not data:
+            return records, 0
+        if not data.startswith(_MAGIC):
+            # refuse to guess: silently reading an alien/older layout as
+            # empty would wipe it on the next append
+            raise ValueError(
+                f"{self.path}: not a {_MAGIC.decode()} snapshot log — "
+                "refusing to read or overwrite it")
+        pos = len(_MAGIC)
+        while pos + _HDR.size <= len(data):
+            length, crc = _HDR.unpack_from(data, pos)
+            end = pos + _HDR.size + length
             if end > len(data):
                 break
+            payload = data[pos + _HDR.size:end]
+            if zlib.crc32(payload) != crc:
+                break  # torn/corrupt tail — recover the prefix before it
             try:
-                rec = pickle.loads(data[pos + _LEN.size:end])
+                rec = _safe_loads(payload)
+            except pickle.UnpicklingError:
+                raise  # forbidden global = tampering, not a torn tail
             except Exception:
                 break
             records.append(rec)
@@ -75,8 +138,10 @@ class SnapshotLog:
             if self._f.tell() != valid:
                 self._f.truncate(valid)
                 self._f.seek(valid)
+            if valid == 0:
+                self._f.write(_MAGIC)
         payload = pickle.dumps((time, entries), protocol=pickle.HIGHEST_PROTOCOL)
-        self._f.write(_LEN.pack(len(payload)) + payload)
+        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)) + payload)
         self._f.flush()
         os.fsync(self._f.fileno())
 

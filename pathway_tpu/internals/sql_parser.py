@@ -676,7 +676,9 @@ class Compiler:
                     raise ValueError("SELECT * cannot be mixed with GROUP BY")
                 name = self.output_name(item, i)
                 if name in used:
-                    name = f"{name}_{i}"
+                    raise ValueError(
+                        f"duplicate output column {name!r} in SELECT — "
+                        "disambiguate with AS")
                 used.add(name)
                 out[name] = self.expr(item[0], scope)
             by = [self.expr(g, scope) for g in stmt.group_by]
@@ -695,12 +697,18 @@ class Compiler:
             for i, item in enumerate(stmt.items):
                 if item[0] == "*":
                     for name, flat in scope.all_columns():
+                        if name in used:
+                            raise ValueError(
+                                f"duplicate output column {name!r} in "
+                                "SELECT — disambiguate with AS")
                         out[name] = t[flat]
                         used.add(name)
                     continue
                 name = self.output_name(item, i)
                 if name in used:
-                    name = f"{name}_{i}"
+                    raise ValueError(
+                        f"duplicate output column {name!r} in SELECT — "
+                        "disambiguate with AS")
                 used.add(name)
                 out[name] = self.expr(item[0], scope)
             result = t.select(**out)

@@ -35,6 +35,7 @@ def _load_text_index():
         lib.ti_new.argtypes = [ctypes.c_double, ctypes.c_double]
         lib.ti_free.argtypes = [ctypes.c_void_p]
         lib.ti_add.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                               ctypes.c_uint64, ctypes.c_uint64,
                                ctypes.c_char_p]
         lib.ti_remove.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.ti_len.restype = ctypes.c_uint64
@@ -69,8 +70,11 @@ class NativeTextIndex:
             lib.ti_free(h)
             self._h = None
 
-    def add(self, doc_id: int, text: str) -> None:
-        self._lib.ti_add(self._h, doc_id, text.encode())
+    def add(self, doc_id: int, text: str,
+            tie_hi: int = 0, tie_lo: int = 0) -> None:
+        # (tie_hi, tie_lo) = the engine Pointer's 128 bits; equal-score
+        # hits rank by it so native and Python BM25 engines agree
+        self._lib.ti_add(self._h, doc_id, tie_hi, tie_lo, text.encode())
 
     def remove(self, doc_id: int) -> None:
         self._lib.ti_remove(self._h, doc_id)

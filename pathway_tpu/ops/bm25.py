@@ -151,8 +151,11 @@ class NativeBM25Index:
                 self._next_id += 1
                 self._key_to_id[key] = doc_id
                 self._id_to_key[doc_id] = key
+            kint = int(key)
             self._native.add(doc_id,
-                             text if isinstance(text, str) else str(text))
+                             text if isinstance(text, str) else str(text),
+                             tie_hi=(kint >> 64) & 0xFFFFFFFFFFFFFFFF,
+                             tie_lo=kint & 0xFFFFFFFFFFFFFFFF)
             # re-add replaces metadata, including back to None (BM25Index
             # contract: its add() goes through remove() first)
             self._filter_data.pop(key, None)

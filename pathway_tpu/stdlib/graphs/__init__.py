@@ -107,9 +107,15 @@ def louvain_communities(vertices: Table, edges: Table,
     """Cluster assignment per vertex by greedy modularity maximization
     (one Louvain level; reference: graphs/louvain_communities/impl.py:225).
 
-    Each round proposes, per vertex, the adjacent cluster maximizing the
-    Louvain gain w(v→C) − deg(v)·deg(C)/2m, then executes an INDEPENDENT
-    SET of moves — a move runs only if it holds the maximum per-round hash
+    Each round proposes, per vertex, the cluster maximizing the Louvain
+    gain 2·w(v→C) − deg(v)·(2·deg(C) + deg(v))/2m — where for the
+    vertex's CURRENT cluster deg(C) is corrected to deg(C) − deg(v),
+    since moving out removes v's own degree from the cluster (reference
+    impl.py louvain_gain:111-145: ``gain_for_staying`` passes
+    ``cluster_penalties … − vertex_degrees.ix(…).degree``). A zero-weight
+    placeholder candidate for the current cluster guarantees "stay" is
+    always scored (impl.py:92). It then executes an INDEPENDENT SET of
+    moves — a move runs only if it holds the maximum per-round hash
     priority in both its source and target clusters (the reference's
     parallel-conflict resolution, impl.py _one_step:154) — so concurrent
     swaps cannot oscillate. ``edges``: u, v pointer columns + optional
@@ -129,8 +135,17 @@ def louvain_communities(vertices: Table, edges: Table,
 
     def body(clustering: Table, counter: Table, wedges: Table,
              degrees: Table, m2tab: Table):
-        cv = clustering.ix(wedges.v, context=wedges).c
-        vc = wedges.select(u=wedges.u, c=cv, w=wedges.weight)
+        # candidate edges vertex→cluster; self-loops travel with the vertex,
+        # so they shift every candidate's score equally — drop them.  A
+        # zero-weight placeholder per vertex to its CURRENT cluster makes
+        # "stay" always a scored candidate (reference impl.py:92).
+        proper = wedges.filter(
+            ex.apply(lambda a, b: a != b, wedges.u, wedges.v))
+        cv = clustering.ix(proper.v, context=proper).c
+        vc0 = proper.select(u=proper.u, c=cv, w=proper.weight)
+        placeholder = clustering.select(u=clustering.id, c=clustering.c,
+                                        w=0.0)
+        vc = vc0.concat_reindex(placeholder)
         vc = vc.groupby(vc.u, vc.c).reduce(
             u=vc.u, c=vc.c, w=reducers.sum(vc.w))
 
@@ -141,25 +156,34 @@ def louvain_communities(vertices: Table, edges: Table,
         cdeg_by_c = cdeg.with_id(cdeg.c)
 
         vc = _broadcast_scalar(m2tab, vc, "m2")
+        cur_of_u = clustering.ix(vc.u, context=vc).c
+
+        def louvain_gain(w, dv, dc, m2, c, cur):
+            # reference impl.py:111-113; staying subtracts deg(v) from the
+            # cluster degree because leaving removes it (impl.py:138-139)
+            penalty = (dc or 0.0) - (dv if c == cur else 0.0)
+            return 2.0 * w - dv * (2.0 * penalty + dv) / m2
+
         scored = vc.select(
             u=vc.u, c=vc.c,
+            is_cur=ex.apply(lambda c, cur: int(c == cur), vc.c, cur_of_u),
             gain=ex.apply(
-                lambda w, dv, dc, m2: w - dv * (dc or 0.0) / m2,
+                louvain_gain,
                 vc.w, degrees.ix(vc.u, context=vc).deg,
                 cdeg_by_c.ix(vc.c, context=vc, optional=True).cdeg,
-                vc.m2),
+                vc.m2, vc.c, cur_of_u),
         )
+        # ties prefer staying put (is_cur), then lowest pointer — keeps
+        # rounds deterministic and oscillation-free
         best = scored.groupby(id=scored.u).reduce(
             choice=reducers.argmax(
-                ex.make_tuple(scored.gain, ex.apply(lambda p: -int(p),
-                                                    scored.c))))
+                ex.make_tuple(scored.gain, scored.is_cur,
+                              ex.apply(lambda p: -int(p), scored.c))))
         picked = best.select(
-            vc_new=scored.ix(best.choice, context=best).c,
-            gain=scored.ix(best.choice, context=best).gain)
+            vc_new=scored.ix(best.choice, context=best).c)
         movers = picked.filter(
-            (picked.gain > 0.0)
-            & ex.apply(lambda new, cur: new != cur, picked.vc_new,
-                       clustering.restrict(picked).c))
+            ex.apply(lambda new, cur: new != cur, picked.vc_new,
+                     clustering.restrict(picked).c))
         movers = _broadcast_scalar(counter, movers, "n")
         movers = movers.select(
             vc_new=movers.vc_new,

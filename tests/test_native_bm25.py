@@ -99,6 +99,30 @@ def test_selective_filter_escalates_fetch():
     assert {k for k, _ in nres} == {k for k, _ in pres}
 
 
+def test_tie_break_parity_with_python_engine():
+    """Equal-score hits must rank identically in both engines: by
+    ascending Pointer (the Python engine's (-score, int(key)) sort key),
+    NOT by native insertion-order doc id (the pre-fix divergence)."""
+    try:
+        native = NativeBM25Index()
+    except Exception as e:
+        pytest.skip(f"native toolchain unavailable: {e}")
+    python = BM25Index()
+    # identical text → identical scores for every doc; scrambled insertion
+    # order so insertion-order doc ids disagree with pointer order
+    names = [f"doc{i:02d}" for i in range(20)]
+    keys = {n: hash_values(n) for n in names}
+    scrambled = sorted(names, key=lambda n: hash_values(n, 7))
+    assert scrambled != sorted(names, key=lambda n: int(keys[n]))
+    for n in scrambled:
+        native.add(keys[n], "tied score text")
+        python.add(keys[n], "tied score text")
+    nres = native.search([(None, "tied text", 10, None)])[0]
+    pres = python.search([(None, "tied text", 10, None)])[0]
+    assert [k for k, _ in nres] == [k for k, _ in pres]
+    assert [k for k, _ in nres] == sorted(keys.values(), key=int)[:10]
+
+
 def test_re_add_clears_stale_filter_data():
     try:
         native = NativeBM25Index()

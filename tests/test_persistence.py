@@ -263,3 +263,96 @@ def test_wordcount_kill_and_recover(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+def test_snapshot_log_rejects_malicious_pickle(tmp_path):
+    """Regression: snapshot decode is restricted — a crafted record on
+    shared storage must raise, not execute code on resume."""
+    import pickle
+    import struct
+    import zlib
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("echo pwned > /tmp/pwned",))
+
+    payload = pickle.dumps((1, [Evil()]))
+    path = str(tmp_path / "s.snap")
+    with open(path, "wb") as f:
+        f.write(b"PWSNAP01")
+        f.write(struct.pack("<QI", len(payload), zlib.crc32(payload)))
+        f.write(payload)
+    with pytest.raises(Exception, match="forbidden global"):
+        SnapshotLog(path).read_all()
+
+
+def test_snapshot_log_refuses_alien_format(tmp_path):
+    """A file without the format magic must raise — NOT read as empty and
+    then get truncated away by the next append."""
+    path = str(tmp_path / "s.snap")
+    with open(path, "wb") as f:
+        f.write(b"some other tool's data that must survive")
+    with pytest.raises(ValueError, match="not a PWSNAP01"):
+        SnapshotLog(path).read_all()
+    with pytest.raises(ValueError, match="not a PWSNAP01"):
+        SnapshotLog(path).append(1, [("k", ("v",), 1, None)])
+    with open(path, "rb") as f:
+        assert f.read() == b"some other tool's data that must survive"
+
+
+def test_snapshot_log_roundtrips_pandas_datetimes(tmp_path):
+    """pd.Timestamp/Timedelta are the engine's host-side datetime values —
+    the restricted decoder must admit them or resume self-poisons."""
+    import pandas as pd
+
+    path = str(tmp_path / "s.snap")
+    log = SnapshotLog(path)
+    row = (pd.Timestamp("2026-07-29 12:00"),
+           pd.Timestamp("2026-07-29", tz="UTC"),
+           pd.Timedelta(seconds=5))
+    log.append(1, [("k", row, 1, None)])
+    log.close()
+    [(_, [(_, got, _, _)])] = SnapshotLog(path).read_all()
+    assert got == row
+
+
+def test_snapshot_log_crc_detects_corruption(tmp_path):
+    """A bit-flipped record (and everything after it) is dropped instead of
+    being decoded as garbage."""
+    path = str(tmp_path / "s.snap")
+    log = SnapshotLog(path)
+    log.append(1, [("k1", ("a",), 1, None)])
+    log.append(2, [("k2", ("b",), 1, None)])
+    log.close()
+    with open(path, "r+b") as f:
+        f.seek(-3, os.SEEK_END)  # flip a byte inside the last payload
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    records = SnapshotLog(path).read_all()
+    assert [t for t, _ in records] == [1]
+
+
+def test_snapshot_log_roundtrips_engine_value_types(tmp_path):
+    """The restricted decoder must still admit every legitimate engine
+    value class: Pointer, Json, numpy arrays, datetimes."""
+    import datetime
+
+    import numpy as np
+
+    from pathway_tpu.internals.json import Json
+    from pathway_tpu.internals.keys import hash_values
+
+    row = (hash_values("k"), Json({"a": [1, 2]}),
+           np.arange(3.0), datetime.datetime(2026, 7, 29, 12, 0),
+           datetime.timedelta(seconds=5), b"bytes", ("nested", 1.5))
+    path = str(tmp_path / "s.snap")
+    log = SnapshotLog(path)
+    log.append(7, [(row[0], row, 1, None)])
+    log.close()
+    [(t, [(k, got, diff, off)])] = SnapshotLog(path).read_all()
+    assert t == 7 and diff == 1 and k == row[0]
+    assert isinstance(got[0], type(row[0])) and got[0] == row[0]
+    assert got[1].value == {"a": [1, 2]}
+    assert np.array_equal(got[2], row[2])
+    assert got[3:] == row[3:]

@@ -194,3 +194,22 @@ def test_parse_errors():
         pw.sql("SELECT x FROM missing", tab=t)
     with pytest.raises(ValueError, match="unsupported SQL function"):
         pw.sql("SELECT FOO(name) FROM tab", tab=t)
+
+
+def test_duplicate_alias_is_an_error():
+    """Regression: duplicate SELECT output names used to be silently
+    renamed to name_<i>, changing the result schema without warning."""
+    t = _tab()
+    with pytest.raises(ValueError, match="duplicate output column 'name'"):
+        pw.sql("SELECT name, dept AS name FROM t", t=t)
+    with pytest.raises(ValueError, match="duplicate output column"):
+        pw.sql("SELECT sum(salary) AS s, count(*) AS s FROM t GROUP BY dept",
+               t=t)
+    # same column twice without aliases collides on the inferred name too
+    with pytest.raises(ValueError, match="duplicate output column 'name'"):
+        pw.sql("SELECT name, name FROM t", t=t)
+    # star-expansion colliding with an explicit alias — both orders
+    with pytest.raises(ValueError, match="duplicate output column 'name'"):
+        pw.sql("SELECT dept AS name, * FROM t", t=t)
+    with pytest.raises(ValueError, match="duplicate output column 'name'"):
+        pw.sql("SELECT *, dept AS name FROM t", t=t)

@@ -124,3 +124,69 @@ def test_louvain_two_cliques():
     assert got["a"] != got["d"]
     [(q,)] = rows_of(exact_modularity(edges, clusters))
     assert q > 0.3  # two-clique partition is strongly modular
+
+
+def _sym_edges(pairs):
+    """names -> (verts, edges) tables with both edge directions."""
+    names = sorted({n for p in pairs for n in p})
+    verts = T("name\n" + "\n".join(names)).with_id_from(pw.this.name)
+    raw = T("su | sv\n" + "\n".join(f"{a} | {b}" for a, b in pairs))
+    fwd = raw.select(u=verts.pointer_from(raw.su),
+                     v=verts.pointer_from(raw.sv))
+    bwd = raw.select(u=verts.pointer_from(raw.sv),
+                     v=verts.pointer_from(raw.su))
+    return names, verts, fwd.concat_reindex(bwd)
+
+
+def _modularity(pairs, labels):
+    """Exact Q over the directed-doubled graph, computed independently."""
+    dedges = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
+    m2 = len(dedges)
+    deg = {}
+    for a, _ in dedges:
+        deg[a] = deg.get(a, 0) + 1
+    q = 0.0
+    for c in set(labels.values()):
+        members = {n for n, l in labels.items() if l == c}
+        w_in = sum(1 for a, b in dedges if a in members and b in members)
+        dc = sum(deg.get(n, 0) for n in members)
+        q += w_in / m2 - (dc / m2) ** 2
+    return q
+
+
+def test_louvain_gain_is_locally_optimal():
+    # Regression for the deg(v) stay/move correction (reference
+    # louvain_communities/impl.py:111-145): the result must be a
+    # 1-move-local optimum of exact modularity — the uncorrected gain
+    # (w - deg(v)*deg(C)/2m, no stay candidate) accepts degrading moves.
+    pairs = [
+        # 4-clique A
+        ("a1", "a2"), ("a1", "a3"), ("a1", "a4"),
+        ("a2", "a3"), ("a2", "a4"), ("a3", "a4"),
+        # 4-clique B
+        ("b1", "b2"), ("b1", "b3"), ("b1", "b4"),
+        ("b2", "b3"), ("b2", "b4"), ("b3", "b4"),
+        # inter-clique noise + a bridge vertex leaning toward A
+        ("a1", "b1"), ("a2", "b2"),
+        ("g", "a3"), ("g", "a4"), ("g", "b3"),
+    ]
+    names, verts, edges = _sym_edges(pairs)
+    clusters = louvain_communities(verts, edges, iterations=40)
+    labeled = clusters.select(name=verts.restrict(clusters).name,
+                              c=pw.apply(int, clusters.c))
+    labels = dict(rows_of(labeled))
+    q = _modularity(pairs, labels)
+    [(q_engine,)] = rows_of(exact_modularity(edges, clusters))
+    assert abs(q - q_engine) < 1e-9
+    # no single-vertex move (to any adjacent cluster or a fresh singleton)
+    # may improve modularity
+    fresh = object()
+    for v in names:
+        for target in set(labels.values()) | {fresh}:
+            if target == labels[v]:
+                continue
+            moved = dict(labels)
+            moved[v] = target
+            assert _modularity(pairs, moved) <= q + 1e-9, (
+                f"moving {v} improves modularity: "
+                f"{_modularity(pairs, moved)} > {q}")
