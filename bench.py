@@ -1,19 +1,30 @@
-"""Headline benchmark: RAG embed+index throughput (docs/sec/chip).
+"""Headline benchmark: RAG embed+index throughput + p50 KNN latency @10M.
 
-Measures the north-star path from BASELINE.md: documents → tokenize →
-flagship encoder forward (BGE-small shape, bfloat16, jit) → KNN index add
-(HBM slab scatter). Baseline target: ≥50k docs/sec on v5e-8 ⇒ 6250
-docs/sec/chip. Prints ONE JSON line.
+Measures BOTH halves of the north-star metric from BASELINE.md:
+
+1. documents → tokenize → flagship encoder forward (BGE-small shape,
+   bfloat16, jit) → KNN index add (HBM slab scatter). Target: ≥50k
+   docs/sec on v5e-8 ⇒ 6250 docs/sec/chip.
+2. brute-force KNN query latency against a 10M x 384 bf16 slab resident
+   in one chip's HBM (7.7 GB; the search is HBM-bandwidth-bound, chunked
+   lax.scan kernel in ops/knn.py). Target: p50 < 20 ms.
+
+Prints ONE JSON line; the KNN figures ride along as knn_* fields.
+Override the slab size with BENCH_KNN_N (e.g. for CPU smoke runs).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
 BASELINE_DOCS_PER_SEC_PER_CHIP = 50_000 / 8
+KNN_TARGET_P50_MS = 20.0
+KNN_N = int(os.environ.get("BENCH_KNN_N", 10_000_000))
+KNN_DIM = 384
 # 2048 docs/dispatch: amortizes per-execute overhead (and the tunnel RPC in
 # the axon dev setup) — measured ~6% over 1024 at equal accuracy
 BATCH = 2048
@@ -32,13 +43,26 @@ def main() -> None:
     import jax
 
     from pathway_tpu.models.encoder import EncoderConfig, encode, init_params
-    from pathway_tpu.models.tokenizer import HashTokenizer
+    from pathway_tpu.models.hf_loader import find_local_checkpoint, load_model
+    from pathway_tpu.models.tokenizer import (WordPieceTokenizer,
+                                              make_synthetic_vocab)
     from pathway_tpu.internals.keys import Pointer
     from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
 
-    config = EncoderConfig.bge_small()
-    params = init_params(jax.random.PRNGKey(0), config)
-    tokenizer = HashTokenizer(vocab_size=config.vocab_size, max_len=SEQ)
+    # real BGE weights + vocab when the checkpoint is on disk; otherwise
+    # random weights at the exact BGE shape and a synthetic vocab — the
+    # tokenizer still runs the real WordPiece algorithm (native C++ batch
+    # kernel), so the host-side cost is representative either way
+    if find_local_checkpoint("BAAI/bge-small-en-v1.5"):
+        params, config, tokenizer = load_model("BAAI/bge-small-en-v1.5")
+        tokenizer.max_len = SEQ
+    else:
+        config = EncoderConfig.bge_small()
+        params = init_params(jax.random.PRNGKey(0), config)
+        tokenizer = WordPieceTokenizer(
+            make_synthetic_vocab([f"word{i}" for i in range(4096)],
+                                 vocab_size=config.vocab_size),
+            max_len=SEQ)
     index = BruteForceKnnIndex(config.hidden, reserved_space=1 << 17,
                                metric=KnnMetric.COS)
 
@@ -104,12 +128,174 @@ def main() -> None:
     sustained = batch_times[1:]  # drop the warmup-straddling first batch
     docs_per_sec = BATCH * len(sustained) / float(np.sum(sustained))
 
+    etl = bench_etl()
+    knn = bench_knn()
+
     print(json.dumps({
-        "metric": "RAG docs/sec/chip (embed+index)",
+        "metric": "RAG docs/sec/chip (embed+index); p50 KNN @10M",
         "value": round(docs_per_sec, 1),
         "unit": "docs/s",
         "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC_PER_CHIP, 3),
+        **etl,
+        **knn,
     }))
+
+
+def bench_etl(n_rows: int = 100_000) -> dict:
+    """Streaming ETL rows/sec: WordCount + dimension join over 50 ticks
+    (the reference's headline WordCount benchmark shape, README.md:244-250),
+    at n_workers ∈ {1, 8}.
+
+    Measured finding this round (recorded here so the numbers travel with
+    the bench): sharded execution is a correctness model — 8 in-process
+    workers add ~20-25% routing/merge overhead and thread-pool stepping is
+    SLOWER (GIL-bound pure-Python operators). The throughput path forward
+    is columnar operator state (numpy key arrays + searchsorted routing),
+    not threads.
+    """
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+
+    n_ticks, vocab = 50, 5000
+    rng = np.random.default_rng(0)
+    words = rng.integers(0, vocab, size=n_rows)
+    qtys = rng.integers(1, 10, size=n_rows)
+    ticks = np.sort(rng.integers(0, n_ticks, size=n_rows))
+
+    def run_once(n_workers: int) -> float:
+        G.clear()
+
+        class S(pw.Schema):
+            word: str
+            qty: int
+
+        class L(pw.Schema):
+            word: str
+            cat: str
+
+        events = table_from_rows(
+            S, [(f"w{words[i]}", int(qtys[i]), int(ticks[i]) * 2, 1)
+                for i in range(n_rows)], is_stream=True)
+        lex = table_from_rows(
+            L, [(f"w{i}", f"cat{i % 7}") for i in range(vocab)])
+        counts = events.groupby(events.word).reduce(
+            events.word, n=pw.reducers.count(),
+            total=pw.reducers.sum(events.qty))
+        joined = counts.join(lex, counts.word == lex.word).select(
+            counts.word, counts.n, counts.total, lex.cat)
+        runner = GraphRunner()
+        runner.capture(joined)
+        t0 = time.perf_counter()
+        runner.run_batch(n_workers=n_workers)
+        dt = time.perf_counter() - t0
+        G.clear()
+        return n_rows / dt
+
+    return {
+        "etl_rows_per_s_1w": round(run_once(1), 0),
+        "etl_rows_per_s_8w": round(run_once(8), 0),
+        "etl_n_rows": n_rows,
+        "etl_ticks": n_ticks,
+    }
+
+
+def _dispatch_floor_ms() -> float:
+    """Per-dispatch host↔device overhead (huge on a tunneled dev chip,
+    ~0.1 ms on production hardware) — measured so the reported e2e numbers
+    are interpretable."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def trivial(x):
+        return x + 1.0
+
+    x = jnp.zeros((8, 8), jnp.float32)
+    np.asarray(trivial(x))
+    lat = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(trivial(x))
+        lat.append((time.perf_counter() - t0) * 1e3)
+    return float(np.percentile(lat, 50))
+
+
+def bench_knn() -> dict:
+    """Query latency against the largest slab that fits one chip.
+
+    ``knn_p50_ms`` is DEVICE execution time per single-query search
+    (measured by index.latency_probe: many searches in one dispatch — the
+    number the <20 ms target is about). ``knn_e2e_*`` are end-to-end
+    through this environment's dispatch path, with the measured dispatch
+    floor reported next to them.
+    """
+    import ml_dtypes
+
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    n = KNN_N
+    while True:
+        try:
+            index = BruteForceKnnIndex(KNN_DIM, reserved_space=n,
+                                       metric=KnnMetric.COS,
+                                       dtype="bfloat16")
+            rng = np.random.default_rng(0)
+            ingest_start = time.perf_counter()
+            chunk = 1 << 19
+            # one bf16 pool reused for every chunk: value distribution is
+            # irrelevant for a latency bench, and host-side RNG + f32→bf16
+            # casting at 10M x 384 would dominate the bench's wall time
+            pool = (rng.random((chunk, KNN_DIM), dtype=np.float32) * 2.0
+                    - 1.0).astype(ml_dtypes.bfloat16)
+            for base in range(0, n, chunk):
+                m = min(chunk, n - base)
+                index.add_batch([Pointer(base + i) for i in range(m)],
+                                pool[:m])
+                # async per-chunk upload overlaps the next chunk's host work
+                index.flush_device()
+            queries = rng.random((64, KNN_DIM), dtype=np.float32) * 2.0 - 1.0
+
+            def run(batch, k=10):
+                qs = [(Pointer(10**9 + i), batch[i], k, None)
+                      for i in range(len(batch))]
+                return index.search(qs)
+
+            # first search uploads the slab + compiles the (1, N) kernel
+            res = run(queries[:1])
+            assert res[0] and len(res[0]) == 10
+            ingest_s = time.perf_counter() - ingest_start
+
+            dev_single = index.latency_probe(batch_size=1, k=10, reps=64)
+            dev_batch64 = index.latency_probe(batch_size=64, k=10, reps=16)
+            floor = _dispatch_floor_ms()
+            lat = []
+            for i in range(20):
+                t0 = time.perf_counter()
+                run(queries[i % 64:i % 64 + 1])
+                lat.append((time.perf_counter() - t0) * 1e3)
+            del index
+            return {
+                "knn_n_vectors": n,
+                "knn_dim": KNN_DIM,
+                "knn_dtype": "bfloat16",
+                "knn_p50_ms": round(dev_single, 2),
+                "knn_batch64_ms": round(dev_batch64, 2),
+                "knn_vs_target": round(KNN_TARGET_P50_MS / dev_single, 3),
+                "knn_e2e_p50_ms": round(float(np.percentile(lat, 50)), 2),
+                "knn_e2e_p99_ms": round(float(np.percentile(lat, 99)), 2),
+                "knn_dispatch_floor_ms": round(floor, 2),
+                "knn_ingest_s": round(ingest_s, 1),
+            }
+        except (RuntimeError, MemoryError) as e:
+            # HBM too small for this slab — release the failed attempt's
+            # device slab BEFORE retrying, then halve
+            index = None  # noqa: F841
+            if n <= 1 << 20:
+                return {"knn_error": str(e)[:200]}
+            n //= 2
 
 
 if __name__ == "__main__":

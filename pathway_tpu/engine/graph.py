@@ -107,9 +107,25 @@ class Scheduler:
     timestamp, so the per-time consistency guarantee is unchanged.
     """
 
-    def __init__(self, graph: EngineGraph, n_workers: int = 1):
+    def __init__(self, graph: EngineGraph, n_workers: int = 1,
+                 parallel_threads: bool | None = None):
         self.graph = graph
         self.n_workers = max(1, int(n_workers))
+        if parallel_threads is None:
+            import os
+
+            parallel_threads = os.environ.get(
+                "PATHWAY_WORKER_THREADS", "0") not in ("0", "", "false")
+        # step worker replicas on a thread pool. State is disjoint per
+        # replica so this is safe; it pays off only when operator work
+        # releases the GIL (numpy/XLA-heavy columnar evaluators) — for
+        # pure-Python row ops the GIL serializes it, which is why it is
+        # opt-in (measured in bench.py bench_etl).
+        self._pool = None
+        if parallel_threads and self.n_workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=self.n_workers)
         self._topo = self._topo_sort()
         # worker replicas per node; replica 0 is always node.op itself.
         # Gather nodes (unpartitionable state) keep a single replica that
@@ -247,8 +263,16 @@ class Scheduler:
                     if spec is None:
                         for w in range(n):
                             per_worker[w][j] = parts[w]
+                    elif spec == Exchange.BY_KEY:
+                        routed = [[] for _ in range(n)]
+                        for p in parts:
+                            for e in p.entries:  # inline: keys are ints
+                                routed[int(e[0]) % n].append(e)
+                        for w in range(n):
+                            if routed[w]:
+                                per_worker[w][j] = Delta(routed[w]).consolidate()
                     else:
-                        routed: list[list] = [[] for _ in range(n)]
+                        routed = [[] for _ in range(n)]
                         for p in parts:
                             for key, row, diff in p.entries:
                                 routed[self._route(spec, key, row)].append(
@@ -264,10 +288,17 @@ class Scheduler:
                         for d in per_worker[w]:
                             if d:
                                 reps[w]._advance_watermark(d)
-                outs = [
-                    self._step_op(node, reps[w], time, per_worker[w], flush)
-                    for w in range(n)
-                ]
+                if self._pool is not None:
+                    outs = list(self._pool.map(
+                        lambda w: self._step_op(node, reps[w], time,
+                                                per_worker[w], flush),
+                        range(n)))
+                else:
+                    outs = [
+                        self._step_op(node, reps[w], time, per_worker[w],
+                                      flush)
+                        for w in range(n)
+                    ]
             outputs[node.id] = outs
             for d in outs:
                 self._count(node.id, d)

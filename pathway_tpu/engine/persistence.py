@@ -101,6 +101,10 @@ class SnapshotLog:
             data = f.read()
         if not data:
             return records, 0
+        if len(data) < len(_MAGIC) and _MAGIC.startswith(data):
+            # crash during the very first append, mid-magic: an empty log
+            # with a torn tail, not an alien file
+            return records, 0
         if not data.startswith(_MAGIC):
             # refuse to guess: silently reading an alien/older layout as
             # empty would wipe it on the next append

@@ -1,0 +1,182 @@
+"""Load real HuggingFace BERT-family checkpoints (BGE/MiniLM/E5) into the
+pure-JAX encoder (pathway_tpu/models/encoder.py).
+
+The reference embeds real models through torch SentenceTransformer
+(python/pathway/xpacks/llm/embedders.py:268-326); here the checkpoint's
+weights are mapped directly into the encoder's pytree (torch Linear stores
+(out, in) — transposed into the encoder's input-dim-first layout) and the
+checkpoint's vocab.txt drives the WordPiece tokenizer
+(pathway_tpu/models/tokenizer.py), so the whole serving path is
+JAX + native code with no torch in the loop.
+
+Everything is offline: ``load_checkpoint`` takes a local directory;
+``find_local_checkpoint`` resolves a model name against the local HF cache
+only (no network).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+
+def find_local_checkpoint(model_name: str) -> str | None:
+    """Resolve a model name (e.g. 'BAAI/bge-small-en-v1.5') to a local HF
+    cache snapshot directory, or None. Never touches the network."""
+    if os.path.isdir(model_name):
+        return model_name
+    cache = os.environ.get(
+        "HF_HOME", os.path.expanduser("~/.cache/huggingface"))
+    repo_dir = os.path.join(
+        cache, "hub", "models--" + model_name.replace("/", "--"))
+    snapshots = os.path.join(repo_dir, "snapshots")
+    if not os.path.isdir(snapshots):
+        return None
+    candidates = sorted(
+        (os.path.join(snapshots, d) for d in os.listdir(snapshots)),
+        key=os.path.getmtime, reverse=True)
+    for c in candidates:
+        if os.path.exists(os.path.join(c, "config.json")):
+            return c
+    return None
+
+
+def _read_state_dict(path: str) -> dict[str, np.ndarray]:
+    st_path = os.path.join(path, "model.safetensors")
+    if os.path.exists(st_path):
+        from safetensors.numpy import load_file
+
+        return load_file(st_path)
+    bin_path = os.path.join(path, "pytorch_model.bin")
+    if os.path.exists(bin_path):
+        import torch  # cpu build baked into the image
+
+        sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+        return {k: v.numpy() for k, v in sd.items()}
+    raise FileNotFoundError(
+        f"no model.safetensors or pytorch_model.bin under {path}")
+
+
+def _strip_prefix(sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    # BertModel checkpoints may key as "bert.embeddings..." or
+    # "embeddings..." depending on how they were saved
+    if any(k.startswith("bert.") for k in sd):
+        return {k[len("bert."):]: v for k, v in sd.items()
+                if k.startswith("bert.")}
+    return sd
+
+
+def _detect_pooling(path: str) -> str:
+    """sentence-transformers keeps pooling in 1_Pooling/config.json; BGE
+    uses CLS. Fall back to 'cls'."""
+    pool_cfg = os.path.join(path, "1_Pooling", "config.json")
+    if os.path.exists(pool_cfg):
+        with open(pool_cfg) as f:
+            cfg = json.load(f)
+        if cfg.get("pooling_mode_mean_tokens"):
+            return "mean"
+        if cfg.get("pooling_mode_cls_token"):
+            return "cls"
+    return "cls"
+
+
+def load_checkpoint(path: str, *, compute_dtype: Any = None,
+                    pooling: str | None = None):
+    """Local checkpoint dir → (params, EncoderConfig, WordPieceTokenizer).
+
+    The params tree matches models/encoder.py::init_params exactly, so
+    ``encode(params, ids, mask, config=config)`` runs the real model.
+    """
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.models.tokenizer import WordPieceTokenizer
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    kw = {}
+    if compute_dtype is not None:
+        kw["compute_dtype"] = compute_dtype
+    config = EncoderConfig(
+        vocab_size=hf["vocab_size"],
+        hidden=hf["hidden_size"],
+        layers=hf["num_hidden_layers"],
+        heads=hf["num_attention_heads"],
+        intermediate=hf["intermediate_size"],
+        max_len=hf["max_position_embeddings"],
+        type_vocab_size=hf.get("type_vocab_size", 2),
+        layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+        pooling=pooling or _detect_pooling(path),
+        **kw)
+
+    sd = _strip_prefix(_read_state_dict(path))
+
+    def get(name: str) -> "jnp.ndarray":
+        arr = sd.get(name)
+        if arr is None:
+            raise KeyError(
+                f"checkpoint {path} is missing tensor {name!r} — not a "
+                "BERT-family encoder?")
+        return jnp.asarray(np.asarray(arr), dtype=jnp.float32)
+
+    def linear(prefix: str):
+        # torch Linear: weight (out, in) — encoder wants (in, out)
+        return get(prefix + ".weight").T, get(prefix + ".bias")
+
+    params: dict[str, Any] = {
+        "embeddings": {
+            "token": get("embeddings.word_embeddings.weight"),
+            "position": get("embeddings.position_embeddings.weight"),
+            "token_type": get("embeddings.token_type_embeddings.weight"),
+            "ln_scale": get("embeddings.LayerNorm.weight"),
+            "ln_bias": get("embeddings.LayerNorm.bias"),
+        },
+        "layers": [],
+    }
+    for i in range(config.layers):
+        pre = f"encoder.layer.{i}."
+        wq, bq = linear(pre + "attention.self.query")
+        wk, bk = linear(pre + "attention.self.key")
+        wv, bv = linear(pre + "attention.self.value")
+        wo, bo = linear(pre + "attention.output.dense")
+        w1, b1 = linear(pre + "intermediate.dense")
+        w2, b2 = linear(pre + "output.dense")
+        params["layers"].append({
+            "attn": {
+                "wq": wq, "bq": bq, "wk": wk, "bk": bk,
+                "wv": wv, "bv": bv, "wo": wo, "bo": bo,
+                "ln_scale": get(pre + "attention.output.LayerNorm.weight"),
+                "ln_bias": get(pre + "attention.output.LayerNorm.bias"),
+            },
+            "mlp": {
+                "w1": w1, "b1": b1, "w2": w2, "b2": b2,
+                "ln_scale": get(pre + "output.LayerNorm.weight"),
+                "ln_bias": get(pre + "output.LayerNorm.bias"),
+            },
+        })
+
+    vocab_path = os.path.join(path, "vocab.txt")
+    tokenizer = None
+    if os.path.exists(vocab_path):
+        do_lower = hf.get("do_lower_case", True)
+        tok_cfg = os.path.join(path, "tokenizer_config.json")
+        if os.path.exists(tok_cfg):
+            with open(tok_cfg) as f:
+                do_lower = json.load(f).get("do_lower_case", do_lower)
+        tokenizer = WordPieceTokenizer.from_vocab_file(
+            vocab_path, do_lower=do_lower, max_len=config.max_len)
+    return params, config, tokenizer
+
+
+def load_model(model_name: str = "BAAI/bge-small-en-v1.5", **kw):
+    """Name → local cache lookup → load_checkpoint. Raises with a clear
+    message when the checkpoint is not on disk (zero-egress builds)."""
+    path = find_local_checkpoint(model_name)
+    if path is None:
+        raise FileNotFoundError(
+            f"{model_name}: no local checkpoint (searched the HF cache); "
+            "download it on a connected machine or pass a directory path")
+    return load_checkpoint(path, **kw)

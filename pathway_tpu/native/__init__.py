@@ -52,6 +52,80 @@ def text_index_available() -> bool:
     return _load_text_index() is not None
 
 
+_wordpiece_lib = None
+_wordpiece_err: Exception | None = None
+
+
+def _load_wordpiece():
+    global _wordpiece_lib, _wordpiece_err
+    if _wordpiece_lib is not None or _wordpiece_err is not None:
+        return _wordpiece_lib
+    with _load_lock:
+        if _wordpiece_lib is not None or _wordpiece_err is not None:
+            return _wordpiece_lib
+        try:
+            lib = ctypes.CDLL(ensure_built("wordpiece"))
+        except Exception as e:
+            _wordpiece_err = e
+            return None
+        lib.wp_new.restype = ctypes.c_void_p
+        lib.wp_new.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                               ctypes.c_int32]
+        lib.wp_free.argtypes = [ctypes.c_void_p]
+        lib.wp_encode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        _wordpiece_lib = lib
+        return lib
+
+
+def wordpiece_available() -> bool:
+    return _load_wordpiece() is not None
+
+
+class NativeWordPiece:
+    """Batch WordPiece tokenizer over the C++ engine (native/wordpiece.cpp).
+    One C call per batch; ids match the pure-Python reference
+    implementation in pathway_tpu/models/tokenizer.py."""
+
+    def __init__(self, vocab: list[str], do_lower: bool = True):
+        lib = _load_wordpiece()
+        if lib is None:
+            raise NativeBuildError(
+                f"native wordpiece unavailable: {_wordpiece_err}")
+        self._lib = lib
+        blob = "\n".join(vocab).encode("utf-8")
+        self._h = lib.wp_new(blob, len(blob), 1 if do_lower else 0)
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.wp_free(h)
+            self._h = None
+
+    def encode_batch(self, texts: list[bytes], max_len: int, cls_id: int,
+                     sep_id: int, unk_id: int, pad_id: int):
+        import numpy as np
+
+        n = len(texts)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        for i, t in enumerate(texts):
+            offsets[i + 1] = offsets[i] + len(t)
+        blob = b"".join(texts)
+        out_ids = np.empty((n, max_len), dtype=np.int32)
+        out_lens = np.empty(n, dtype=np.int32)
+        self._lib.wp_encode_batch(
+            self._h, blob, offsets.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)), n, max_len,
+            cls_id, sep_id, unk_id, pad_id,
+            out_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out_ids, out_lens
+
+
 class NativeTextIndex:
     """Thin RAII wrapper over the C++ BM25 engine (u64 doc ids)."""
 

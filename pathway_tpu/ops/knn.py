@@ -10,6 +10,16 @@ so incremental adds/removes coalesce into few device scatters.
 Distance metrics mirror the reference (L2sq / cosine). Sharded multi-chip
 variant (slab split over a mesh axis + per-shard top-k + merge) lives in
 pathway_tpu/parallel/sharded_knn.py.
+
+Two scale features target the 10M-vector p50 budget (BASELINE.md):
+
+- ``dtype="bfloat16"`` halves slab bytes (10M x 384 = 7.7 GB, fits one
+  v5e) AND halves the HBM scan time — the search is bandwidth-bound, so
+  latency tracks slab bytes. Scores accumulate in f32 on the MXU
+  (``preferred_element_type``), so only storage is low-precision.
+- Above ``_CHUNK_ROWS`` slots the kernel switches to a ``lax.scan`` over
+  slab chunks with a per-chunk top-k and a final merge, bounding the
+  (B, N) score buffer at (B, chunk) regardless of slab size.
 """
 
 from __future__ import annotations
@@ -30,10 +40,23 @@ class KnnMetric(enum.Enum):
 
 
 _MIN_CAPACITY = 1024
+# slabs larger than this are scanned in chunks of this many rows
+_CHUNK_ROWS = 1 << 19
 
 
 def _round_up(n: int, mult: int) -> int:
     return ((n + mult - 1) // mult) * mult
+
+
+def _np_dtype(dtype: str):
+    if dtype == "float32":
+        return np.float32
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        return ml_dtypes.bfloat16
+    raise ValueError(f"unsupported knn dtype {dtype!r} "
+                     "(use 'float32' or 'bfloat16')")
 
 
 class BruteForceKnnIndex:
@@ -53,11 +76,16 @@ class BruteForceKnnIndex:
         self.dim = int(dimensions)
         self.metric = metric
         self.capacity = max(_MIN_CAPACITY, _round_up(max(reserved_space, 1), 128))
+        if self.capacity > _CHUNK_ROWS:
+            # the chunked kernel reshapes the slab to (C, chunk, D)
+            self.capacity = _round_up(self.capacity, _CHUNK_ROWS)
         self.dtype = dtype
+        self._np_dtype = _np_dtype(dtype)
         self._lock = threading.RLock()
 
         # host mirror
-        self._host_vectors = np.zeros((self.capacity, self.dim), dtype=np.float32)
+        self._host_vectors = np.zeros((self.capacity, self.dim),
+                                      dtype=self._np_dtype)
         self._host_valid = np.zeros((self.capacity,), dtype=bool)
         self._key_to_slot: dict[Pointer, int] = {}
         self._slot_to_key: dict[int, Pointer] = {}
@@ -87,7 +115,7 @@ class BruteForceKnnIndex:
 
     def add(self, key: Pointer, vector: Any, filter_data: Any | None = None) -> None:
         with self._lock:
-            vec = np.asarray(vector, dtype=np.float32).reshape(-1)
+            vec = np.asarray(vector, dtype=self._np_dtype).reshape(-1)
             if vec.shape[0] != self.dim:
                 raise ValueError(
                     f"vector dim {vec.shape[0]} != index dim {self.dim}")
@@ -103,7 +131,7 @@ class BruteForceKnnIndex:
         """Vectorized add: one slab write for a whole batch of rows."""
         if len(keys) == 0:
             return
-        vecs = np.asarray(vectors, dtype=np.float32)
+        vecs = np.asarray(vectors, dtype=self._np_dtype)
         if vecs.ndim != 2 or vecs.shape[1] != self.dim:
             raise ValueError(
                 f"expected ({len(keys)}, {self.dim}) vectors, got {vecs.shape}")
@@ -118,10 +146,21 @@ class BruteForceKnnIndex:
             while len(self._free) < n_new:
                 self._grow()
             slots = np.empty(len(keys), dtype=np.int64)
+            k2s = self._key_to_slot  # bulk ingest: locals beat attr lookups
+            s2k = self._slot_to_key
+            free = self._free
             for i, key in enumerate(keys):
-                slots[i] = self._alloc_slot(key)
-                if filter_data is not None and filter_data[i] is not None:
-                    self._filter_data[key] = filter_data[i]
+                slot = k2s.get(key)
+                if slot is None:
+                    slot = free.pop()
+                    k2s[key] = slot
+                    s2k[slot] = key
+                slots[i] = slot
+            if filter_data is not None:
+                fd = self._filter_data
+                for key, data in zip(keys, filter_data):
+                    if data is not None:
+                        fd[key] = data
             self._host_vectors[slots] = vecs
             self._host_valid[slots] = True
             self._dirty.update(slots.tolist())
@@ -143,16 +182,21 @@ class BruteForceKnnIndex:
     def _grow(self) -> None:
         old_cap = self.capacity
         self.capacity = old_cap * 2
-        new_vec = np.zeros((self.capacity, self.dim), dtype=np.float32)
+        if self.capacity > _CHUNK_ROWS:
+            self.capacity = _round_up(self.capacity, _CHUNK_ROWS)
+        new_vec = np.zeros((self.capacity, self.dim), dtype=self._np_dtype)
         new_vec[:old_cap] = self._host_vectors
         self._host_vectors = new_vec
         new_valid = np.zeros((self.capacity,), dtype=bool)
         new_valid[:old_cap] = self._host_valid
         self._host_valid = new_valid
         self._free.extend(range(self.capacity - 1, old_cap - 1, -1))
-        self._dev_vectors = None  # force full re-upload at next search
+        self._dev_vectors = None  # device slab is re-created at next search
         self._dev_valid = None
         self._search_fn_cache.clear()
+        # every occupied slot must re-ship: the next flush may take the
+        # zero-slab + scatter path, which uploads only dirty rows
+        self._dirty.update(self._slot_to_key.keys())
 
     # ------------------------------------------------------------------
     # device sync + search
@@ -162,10 +206,20 @@ class BruteForceKnnIndex:
         import jax.numpy as jnp
 
         if self._dev_vectors is None:
-            self._dev_vectors = jnp.asarray(self._host_vectors)
-            self._dev_valid = jnp.asarray(self._host_valid)
-            self._dirty.clear()
-            return
+            if len(self._dirty) * 2 < self.capacity:
+                # sparse occupancy: materialize a zero slab ON DEVICE (no
+                # host transfer) and fall through to the dirty scatter —
+                # incremental ingest then ships only written rows
+                slab_dtype = (jnp.bfloat16 if self.dtype == "bfloat16"
+                              else jnp.float32)
+                self._dev_vectors = jnp.zeros(
+                    (self.capacity, self.dim), dtype=slab_dtype)
+                self._dev_valid = jnp.zeros((self.capacity,), dtype=bool)
+            else:
+                self._dev_vectors = jnp.asarray(self._host_vectors)
+                self._dev_valid = jnp.asarray(self._host_valid)
+                self._dirty.clear()
+                return
         if self._dirty:
             idxs = np.fromiter(self._dirty, dtype=np.int32)
             self._dirty.clear()
@@ -173,6 +227,14 @@ class BruteForceKnnIndex:
             valid = jnp.asarray(self._host_valid[idxs])
             self._dev_vectors = self._dev_vectors.at[idxs].set(vals)
             self._dev_valid = self._dev_valid.at[idxs].set(valid)
+
+    def flush_device(self) -> None:
+        """Push pending host-mirror changes to the device now (async
+        dispatch). Bulk loaders call this per ingest chunk so transfers
+        overlap the next chunk's host-side work instead of serializing
+        into one giant blocking upload at first search."""
+        with self._lock:
+            self._flush_to_device()
 
     def _get_search_fn(self, k: int):
         key = (k, self.capacity, self.metric)
@@ -183,21 +245,68 @@ class BruteForceKnnIndex:
         import jax.numpy as jnp
 
         metric = self.metric
+        slab_dtype = jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+        capacity = self.capacity
+        chunked = capacity > _CHUNK_ROWS
+
+        def score_block(q, vectors, valid):
+            # q (B, D) slab dtype, vectors (N, D) slab dtype → (B, N) f32.
+            # MXU takes low-precision inputs but accumulates f32
+            # (preferred_element_type) so bf16 storage costs recall, not
+            # score arithmetic.
+            if metric == KnnMetric.COS:
+                vn_sq = jax.lax.dot_general(
+                    vectors, vectors,
+                    (((1,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
+                dots = jax.lax.dot_general(
+                    q, vectors, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                scores = dots * jax.lax.rsqrt(vn_sq + 1e-12)[None, :]
+            else:
+                # -||q - v||^2 = 2 q·v - ||v||^2 - ||q||^2 ; drop ||q||^2
+                # (constant per query row, does not change ranking)
+                dots = jax.lax.dot_general(
+                    q, vectors, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                v_sq = jax.lax.dot_general(
+                    vectors, vectors,
+                    (((1,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
+                scores = 2.0 * dots - v_sq[None, :]
+            return jnp.where(valid[None, :], scores, -jnp.inf)
 
         @jax.jit
         def search(queries, vectors, valid):
-            # queries (B, D), vectors (N, D) — one MXU matmul over the slab
+            # queries (B, D) f32, vectors (capacity, D) slab dtype
             if metric == KnnMetric.COS:
-                qn = queries / (jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-12)
-                vn = vectors / (jnp.linalg.norm(vectors, axis=1, keepdims=True) + 1e-12)
-                scores = qn @ vn.T  # higher better
-            else:
-                # -||q - v||^2 = 2 q·v - ||v||^2 - ||q||^2 ; drop ||q||^2 (const per row)
-                dots = queries @ vectors.T
-                v_sq = jnp.sum(vectors * vectors, axis=1)
-                scores = 2.0 * dots - v_sq[None, :]
-            scores = jnp.where(valid[None, :], scores, -jnp.inf)
-            top_scores, top_idx = jax.lax.top_k(scores, k)
+                queries = queries / (jnp.linalg.norm(
+                    queries, axis=1, keepdims=True) + 1e-12)
+            q = queries.astype(slab_dtype)
+            if not chunked:
+                top_scores, top_idx = jax.lax.top_k(
+                    score_block(q, vectors, valid), k)
+                return top_scores, top_idx
+            # scan slab chunks: peak scores buffer is (B, chunk) instead of
+            # (B, capacity) — 10M x 384 stays under one chip's HBM
+            n_chunks = capacity // _CHUNK_ROWS
+            vchunks = vectors.reshape(n_chunks, _CHUNK_ROWS, vectors.shape[1])
+            validc = valid.reshape(n_chunks, _CHUNK_ROWS)
+
+            def body(_, chunk):
+                vs, val = chunk
+                ts, ti = jax.lax.top_k(score_block(q, vs, val), k)
+                return None, (ts, ti)
+
+            _, (ts, ti) = jax.lax.scan(body, None, (vchunks, validc))
+            # ts/ti: (C, B, k); global slot = chunk_index * _CHUNK_ROWS + ti
+            offsets = (jnp.arange(n_chunks,
+                                  dtype=ti.dtype) * _CHUNK_ROWS)[:, None, None]
+            ti = ti + offsets
+            cand_s = jnp.moveaxis(ts, 0, 1).reshape(q.shape[0], -1)
+            cand_i = jnp.moveaxis(ti, 0, 1).reshape(q.shape[0], -1)
+            top_scores, pos = jax.lax.top_k(cand_s, k)
+            top_idx = jnp.take_along_axis(cand_i, pos, axis=1)
             return top_scores, top_idx
 
         self._search_fn_cache[key] = search
@@ -217,9 +326,12 @@ class BruteForceKnnIndex:
             import jax.numpy as jnp
 
             max_k = max(int(q[2] or 3) for q in queries)
-            # over-fetch when filters present so post-filtering still fills k
+            # over-fetch when filters present so post-filtering still fills
+            # k; the chunked kernel's per-chunk top-k bounds fetch at the
+            # chunk size
             has_filter = any(q[3] is not None for q in queries)
-            fetch_k = min(self.capacity,
+            fetch_cap = min(self.capacity, _CHUNK_ROWS)
+            fetch_k = min(fetch_cap,
                           max_k * 4 if has_filter else max_k)
             fetch_k = max(fetch_k, 1)
             qmat = jnp.asarray(
@@ -263,15 +375,86 @@ class BruteForceKnnIndex:
                         matches.append((key, dist))
                         if len(matches) >= limit:
                             break
-                    if (len(matches) < limit and ranks_seen == fetch_k
-                            and fetch_k < self.capacity):
-                        # a selective filter ate the whole candidate list and
-                        # more live slots remain: escalate the top-k fetch
+                    if len(matches) < limit and ranks_seen == fetch_k:
+                        # a selective filter ate the whole candidate list
+                        # and more live slots remain: escalate the fetch
                         exhausted = False
                     out.append(tuple(matches))
                 if exhausted or not has_filter:
                     return out
-                fetch_k = min(self.capacity, fetch_k * 4)
+                if fetch_k >= fetch_cap:
+                    # the chunked kernel caps per-chunk top-k at the chunk
+                    # size; a filter so selective that it eats that many
+                    # top candidates falls back to an exact host-side pass
+                    # over the mirror — completeness over speed in the
+                    # pathological case
+                    return [
+                        r if len(r) >= int(q[2] or 3) or q[3] is None
+                        else self._exhaustive_filtered_search(
+                            q[1], int(q[2] or 3), q[3])
+                        for q, r in zip(queries, out)
+                    ]
+                fetch_k = min(fetch_cap, fetch_k * 4)
+
+    def _exhaustive_filtered_search(self, qvec, limit: int, filt):
+        """Exact filtered top-k over the host mirror (lock held)."""
+        keys = [k for k in self._key_to_slot
+                if self._passes_filter(k, filt)]
+        if not keys:
+            return ()
+        slots = np.fromiter((self._key_to_slot[k] for k in keys),
+                            dtype=np.int64)
+        vecs = self._host_vectors[slots].astype(np.float32)
+        q = np.asarray(qvec, dtype=np.float32).reshape(-1)
+        if self.metric == KnnMetric.COS:
+            qn = q / (np.linalg.norm(q) + 1e-12)
+            vn = vecs / (np.linalg.norm(vecs, axis=1, keepdims=True) + 1e-12)
+            dists = 1.0 - vn @ qn
+        else:
+            dists = np.sum((vecs - q[None, :]) ** 2, axis=1)
+        order = np.argsort(dists, kind="stable")[:limit]
+        return tuple((keys[int(i)], float(dists[int(i)])) for i in order)
+
+    def latency_probe(self, *, batch_size: int = 1, k: int = 10,
+                      reps: int = 32, seed: int = 0) -> float:
+        """Device execution time per search batch, in ms.
+
+        Runs ``reps`` full searches inside ONE jitted ``fori_loop`` dispatch
+        (distinct resident queries each iteration, results folded into a
+        carry so nothing dead-code-eliminates) and divides the wall time.
+        This isolates the kernel from per-dispatch host/RPC overhead —
+        on production hardware dispatch adds ~0.1 ms, but on a tunneled dev
+        chip it can add tens of ms, which would swamp a <20 ms p50 target
+        (BASELINE.md) that is really about the kernel + HBM scan.
+        """
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            if not self._key_to_slot:
+                raise ValueError("empty index")
+            self._flush_to_device()
+            search_fn = self._get_search_fn(k)
+            rng = np.random.default_rng(seed)
+            qpool = jnp.asarray(rng.random(
+                (reps, batch_size, self.dim), dtype=np.float32) * 2.0 - 1.0)
+            vectors, valid = self._dev_vectors, self._dev_valid
+
+            @jax.jit
+            def probe(qpool, vectors, valid):
+                def body(i, acc):
+                    ts, ti = search_fn(qpool[i], vectors, valid)
+                    return acc + jnp.sum(ts) + jnp.sum(ti).astype(jnp.float32)
+
+                return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+            float(probe(qpool, vectors, valid))  # compile + warm
+            t0 = _time.perf_counter()
+            float(probe(qpool, vectors, valid))
+            total = _time.perf_counter() - t0
+            return total / reps * 1e3
 
     def _passes_filter(self, key: Pointer, filt: Any) -> bool:
         data = self._filter_data.get(key)

@@ -1,64 +1,211 @@
-"""pw.AsyncTransformer — fully-async table→table transformation
-(reference: python/pathway/stdlib/utils/async_transformer.py:61, 430 LoC).
+"""pw.AsyncTransformer — fully-async table→table transformation.
 
-Round-1 implementation runs the async `invoke` per input batch through the
-shared UDF event loop and emits results synchronously at the same engine
-time (the reference streams them back via an internal connector; the
-observable end state matches). Instance-consistency buffering arrives with
-the streaming runtime integration.
+Reference: python/pathway/stdlib/utils/async_transformer.py:61-490. The
+reference streams invoke() results back through an internal connector and
+buffers them per (instance, processing time) so an instance's rows land
+atomically (`_Instance.buffer`, `_maybe_produce_instance`,
+``_flush_buffer`` — impl:186-231), with four result views
+(output_table/finished/successful/failed) keyed by a ``_async_status``
+column.
+
+This engine is a per-timestamp BSP microbatch scheduler
+(engine/graph.py): every invoke() launched for a timestamp completes
+before the timestamp's outputs are emitted, so the reference's
+(instance, time) atomicity holds by construction and no background
+connector loop is needed. What remains semantic is captured here:
+
+- per-row SUCCESS/FAILURE status (invoke raising → FAILURE with null
+  outputs, not an engine error);
+- **instance consistency**: if any element of an instance failed, the
+  instance's successful rows are demoted to FAILURE with null outputs
+  (the reference's ``_Instance.correct`` flag, impl:205-226);
+- ``with_options(capacity, timeout, retry_strategy, cache_strategy)``
+  applied through the same wrapper stack as async UDFs
+  (internals/udfs.py::_wrap_async);
+- ``output_schema`` via subclass keyword, invoke()-signature validation
+  against the input schema (impl:349-368).
+
+PENDING rows are never observable: a BSP tick finishes its batch before
+emitting, so ``output_table`` equals ``finished``.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import functools
+import inspect
+from enum import Enum
+from typing import Any, ClassVar
 
 from pathway_tpu.internals import expression as ex
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.udfs import (CacheStrategy, Executor,
+                                        _wrap_async)
+
+
+class _AsyncStatus(Enum):
+    PENDING = "-PENDING-"
+    FAILURE = "-FAILURE-"
+    SUCCESS = "-SUCCESS-"
+
+
+_ASYNC_STATUS_COLUMN = "_async_status"
 
 
 class AsyncTransformer:
-    output_schema: type[sch.Schema]
+    output_schema: ClassVar[type[sch.Schema]]
 
-    def __init__(self, input_table: Table, *, instance=None, **kwargs):
+    def __init_subclass__(cls, /, output_schema: type[sch.Schema] | None = None,
+                          **kwargs):
+        super().__init_subclass__(**kwargs)
+        if output_schema is not None:
+            cls.output_schema = output_schema
+
+    def __init__(self, input_table: Table, *, instance=None,
+                 autocommit_duration_ms: int | None = 1500, **kwargs):
+        if not hasattr(self, "output_schema"):
+            raise TypeError(
+                "AsyncTransformer subclass must define output_schema (class "
+                "attribute or `class T(AsyncTransformer, output_schema=S)`)")
         self._input_table = input_table
         self._instance = instance
-        if not hasattr(self, "output_schema"):
-            raise TypeError("AsyncTransformer subclass must define output_schema")
+        self._autocommit_duration_ms = autocommit_duration_ms
+        self._executor_options: dict[str, Any] = {}
+        self._cache_strategy: CacheStrategy | None = None
+        self._check_signature(input_table)
 
+    def _check_signature(self, table: Table) -> None:
+        """invoke()'s parameters must match the input columns 1:1
+        (reference impl:349-368)."""
+        sig = inspect.signature(self.invoke)
+        try:
+            sig.bind(**{name: None for name in table.column_names()})
+        except TypeError as e:
+            msg = str(e)
+            if "unexpected keyword argument" in msg:
+                raise TypeError(
+                    f"Input table has a column not present on the argument "
+                    f"list of the invoke method: {msg}") from None
+            if "missing a required argument" in msg:
+                raise TypeError(
+                    f"invoke() declares an argument that is not a column of "
+                    f"the input table: {msg}") from None
+            raise
+
+    # -- user hooks ------------------------------------------------------
     async def invoke(self, *args, **kwargs) -> dict:
         raise NotImplementedError
 
     def open(self) -> None:
-        pass
+        """One-time setup before any invoke() runs."""
 
     def close(self) -> None:
-        pass
+        """Cleanup when the pipeline shuts down."""
 
-    @property
-    def successful(self) -> Table:
-        return self.result
+    def with_options(self, capacity: int | None = None,
+                     timeout: float | None = None,
+                     retry_strategy=None,
+                     cache_strategy: CacheStrategy | None = None,
+                     ) -> "AsyncTransformer":
+        self._executor_options = dict(capacity=capacity, timeout=timeout,
+                                      retry_strategy=retry_strategy)
+        self._cache_strategy = cache_strategy
+        return self
 
-    @property
-    def result(self) -> Table:
+    # -- result views ----------------------------------------------------
+    @functools.cached_property
+    def output_table(self) -> Table:
+        """All rows with their ``_async_status`` (SUCCESS or FAILURE —
+        PENDING cannot be observed under BSP execution)."""
         table = self._input_table
         names = table.column_names()
         out_names = self.output_schema.column_names()
         self.open()
 
-        async def call(*vals):
+        async def invoke_kw(*vals):
             res = await self.invoke(**dict(zip(names, vals)))
-            return tuple(res[n] for n in out_names)
+            if set(res.keys()) != set(out_names):
+                raise ValueError(
+                    "result of async function does not match output schema")
+            return res
 
-        packed = table.select(
-            _pw_res=ex.AsyncApplyExpression(call, None, *[table[n] for n in names])
+        # retry/timeout/capacity/cache wrap the raw invoke so a retry
+        # strategy actually sees the exception; the FAILURE catch sits
+        # outside the whole stack
+        inner = _wrap_async(invoke_kw, Executor(**self._executor_options),
+                            self._cache_strategy)
+
+        async def wrapped(*vals):
+            try:
+                res = await inner(*vals)
+                return (True,) + tuple(res[n] for n in out_names)
+            except Exception:
+                return (False,) + (None,) * len(out_names)
+
+        inst = self._instance if self._instance is not None else table.id
+        raw = table.select(
+            _pw_res=ex.AsyncApplyExpression(
+                wrapped, None, *[table[n] for n in names]),
+            _pw_instance=inst,
         )
-        return packed.select(**{
-            n: ex.GetExpression(packed._pw_res, i, check_if_exists=False)
-            for i, n in enumerate(out_names)
-        }).update_types(**{
-            n: self.output_schema[n].dtype for n in out_names
-        })
+        # instance consistency: any failed element demotes every row of
+        # the instance (the reference's _Instance.correct flag)
+        fails = raw.filter(
+            ex.apply(lambda r: not r[0], raw._pw_res))
+        fi = fails.groupby(fails._pw_instance).reduce(
+            inst=fails._pw_instance)
+        joined = raw.join_left(fi, raw._pw_instance == fi.inst,
+                               id=raw.id).select(
+            res=raw._pw_res,
+            bad=ex.apply(lambda r, i: (not r[0]) or i is not None,
+                         raw._pw_res, fi.inst),
+        )
 
-    def with_options(self, **kwargs) -> "AsyncTransformer":
-        return self
+        def pick(r, bad, _i=0):
+            return None if bad else r[1 + _i]
+
+        cols = {
+            n: ex.apply(functools.partial(pick, _i=i),
+                        joined.res, joined.bad)
+            for i, n in enumerate(out_names)
+        }
+        cols[_ASYNC_STATUS_COLUMN] = ex.apply(
+            lambda bad: (_AsyncStatus.FAILURE if bad
+                         else _AsyncStatus.SUCCESS).value,
+            joined.bad)
+        return joined.select(**cols)
+
+    @functools.cached_property
+    def finished(self) -> Table:
+        """Rows that finished execution, with their status column."""
+        t = self.output_table
+        return t.filter(
+            ex.apply(lambda s: s != _AsyncStatus.PENDING.value,
+                     t[_ASYNC_STATUS_COLUMN]))
+
+    @functools.cached_property
+    def successful(self) -> Table:
+        """Only rows whose whole instance executed successfully."""
+        t = self.output_table
+        ok = t.filter(
+            ex.apply(lambda s: s == _AsyncStatus.SUCCESS.value,
+                     t[_ASYNC_STATUS_COLUMN]))
+        out_names = self.output_schema.column_names()
+        return ok.select(**{n: ok[n] for n in out_names}).update_types(
+            **{n: self.output_schema[n].dtype for n in out_names})
+
+    @functools.cached_property
+    def failed(self) -> Table:
+        """Rows that failed — including successful rows demoted by an
+        instance-mate's failure (reference impl:448-457)."""
+        t = self.output_table
+        bad = t.filter(
+            ex.apply(lambda s: s == _AsyncStatus.FAILURE.value,
+                     t[_ASYNC_STATUS_COLUMN]))
+        out_names = self.output_schema.column_names()
+        return bad.select(**{n: bad[n] for n in out_names})
+
+    @property
+    def result(self) -> Table:
+        """Deprecated alias of ``successful``."""
+        return self.successful

@@ -1,0 +1,129 @@
+"""AsyncTransformer semantics (reference:
+python/pathway/stdlib/utils/async_transformer.py:61-490): status column,
+successful/failed/finished views, instance-consistency demotion,
+with_options, signature validation."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from tests.utils import T, rows_of
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+class OutSchema(pw.Schema):
+    ret: int
+
+
+def _input():
+    return T("""
+    value | group
+    1     | a
+    2     | a
+    3     | b
+    """)
+
+
+def test_successful_basic():
+    class Inc(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value, group) -> dict:
+            await asyncio.sleep(0.001)
+            return {"ret": value + 1}
+
+    res = Inc(input_table=_input()).successful
+    assert sorted(rows_of(res)) == [(2,), (3,), (4,)]
+
+
+def test_failure_rows_and_status_column():
+    class Flaky(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value, group) -> dict:
+            if value == 2:
+                raise RuntimeError("boom")
+            return {"ret": value * 10}
+
+    tr = Flaky(input_table=_input())
+    assert sorted(rows_of(tr.successful)) == [(10,), (30,)]
+    assert sorted(rows_of(tr.failed)) == [(None,)]
+    statuses = sorted(s for _, s in rows_of(tr.output_table))
+    assert statuses == ["-FAILURE-", "-SUCCESS-", "-SUCCESS-"]
+    # finished == output_table under BSP execution
+    assert sorted(rows_of(tr.finished), key=repr) == sorted(
+        rows_of(tr.output_table), key=repr)
+
+
+def test_instance_failure_demotes_group():
+    t = _input()
+
+    class Flaky(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value, group) -> dict:
+            if value == 1:
+                raise RuntimeError("boom")
+            return {"ret": value * 10}
+
+    tr = Flaky(input_table=t, instance=t.group)
+    # value=2 succeeded but shares instance 'a' with the failed value=1:
+    # demoted (reference _Instance.correct); only 'b' survives
+    assert sorted(rows_of(tr.successful)) == [(30,)]
+    assert sorted(rows_of(tr.failed)) == [(None,), (None,)]
+
+
+def test_wrong_result_keys_is_failure():
+    class Bad(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value, group) -> dict:
+            return {"wrong": 1}
+
+    tr = Bad(input_table=_input())
+    assert rows_of(tr.successful) == []
+    assert len(rows_of(tr.failed)) == 3
+
+
+def test_signature_validation():
+    class Inc(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value) -> dict:  # missing 'group'
+            return {"ret": value}
+
+    with pytest.raises(TypeError, match="not present"):
+        Inc(input_table=_input())
+
+    class Missing(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value, group, extra) -> dict:
+            return {"ret": value}
+
+    with pytest.raises(TypeError, match="not a column"):
+        Missing(input_table=_input())
+
+
+def test_with_options_retry():
+    attempts: dict[int, int] = {}
+
+    class FlakyOnce(pw.AsyncTransformer, output_schema=OutSchema):
+        async def invoke(self, value, group) -> dict:
+            attempts[value] = attempts.get(value, 0) + 1
+            if attempts[value] == 1:
+                raise RuntimeError("transient")
+            return {"ret": value}
+
+    tr = FlakyOnce(input_table=_input()).with_options(
+        retry_strategy=pw.udfs.FixedDelayRetryStrategy(
+            max_retries=3, delay_ms=1))
+    assert sorted(rows_of(tr.successful)) == [(1,), (2,), (3,)]
+    assert all(n >= 2 for n in attempts.values())
+
+
+def test_missing_output_schema_raises():
+    class NoSchema(pw.AsyncTransformer):
+        async def invoke(self, value, group) -> dict:
+            return {}
+
+    with pytest.raises(TypeError, match="output_schema"):
+        NoSchema(input_table=_input())

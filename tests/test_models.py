@@ -166,3 +166,111 @@ def test_graft_entry_multichip():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_knn_bf16_recall_parity_with_f32():
+    """bf16 slab (the 10M-fit dtype): top-10 must agree with f32 within
+    normal low-precision slack on well-separated random data."""
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    rng = np.random.default_rng(1)
+    n, d = 2048, 64
+    vecs = rng.normal(size=(n, d)).astype(np.float32)
+    queries = rng.normal(size=(8, d)).astype(np.float32)
+    for metric in (KnnMetric.L2SQ, KnnMetric.COS):
+        f32 = BruteForceKnnIndex(d, metric=metric, reserved_space=n)
+        b16 = BruteForceKnnIndex(d, metric=metric, reserved_space=n,
+                                 dtype="bfloat16")
+        keys = [Pointer(i) for i in range(n)]
+        f32.add_batch(keys, vecs)
+        b16.add_batch(keys, vecs)
+        q = [(Pointer(10_000 + i), queries[i], 10, None) for i in range(8)]
+        rf = f32.search(q)
+        rb = b16.search(q)
+        for got_f, got_b in zip(rf, rb):
+            exact = {k for k, _ in got_f}
+            approx = {k for k, _ in got_b}
+            recall = len(exact & approx) / len(exact)
+            assert recall >= 0.8, (metric, recall)
+        # top-1 must match exactly on this well-separated data
+        assert all(rb[i][0][0] == rf[i][0][0] for i in range(8))
+
+
+def test_knn_chunked_scan_matches_single_shot(monkeypatch):
+    """Force the chunked lax.scan path with a tiny chunk size: results
+    must be identical to the single-matmul path (it is exact, not
+    approximate)."""
+    import pathway_tpu.ops.knn as knn_mod
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    rng = np.random.default_rng(2)
+    n, d = 700, 16
+    vecs = rng.normal(size=(n, d)).astype(np.float32)
+    queries = rng.normal(size=(5, d)).astype(np.float32)
+
+    plain = BruteForceKnnIndex(d, metric=KnnMetric.L2SQ, reserved_space=1024)
+    monkeypatch.setattr(knn_mod, "_CHUNK_ROWS", 256)
+    chunked = BruteForceKnnIndex(d, metric=KnnMetric.L2SQ,
+                                 reserved_space=1024)
+    assert chunked.capacity % 256 == 0 and chunked.capacity > 256
+
+    keys = [Pointer(i) for i in range(n)]
+    plain.add_batch(keys, vecs)
+    chunked.add_batch(keys, vecs)
+    # remove some rows so validity masking crosses chunk boundaries
+    for i in range(0, n, 7):
+        plain.remove(Pointer(i))
+        chunked.remove(Pointer(i))
+    q = [(Pointer(10_000 + i), queries[i], 12, None) for i in range(5)]
+    res_p = plain.search(q)
+    res_c = chunked.search(q)
+    for a, b in zip(res_p, res_c):
+        assert [k for k, _ in a] == [k for k, _ in b]
+        assert np.allclose([s for _, s in a], [s for _, s in b],
+                           rtol=1e-4, atol=1e-4)
+
+
+def test_knn_grow_after_flush_keeps_old_rows():
+    """Regression: _grow() after a flush must re-ship every occupied slot —
+    the zero-slab+scatter flush path only uploads dirty rows."""
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    idx = BruteForceKnnIndex(8, metric=KnnMetric.L2SQ)  # capacity 1024
+    rng = np.random.default_rng(3)
+    vecs = rng.normal(size=(1024, 8)).astype(np.float32)
+    idx.add_batch([Pointer(i) for i in range(1024)], vecs)
+    res = idx.search([(Pointer(10**9), vecs[0], 1, None)])
+    assert res[0][0][0] == Pointer(0)  # flush happened
+    more = rng.normal(size=(10, 8)).astype(np.float32)
+    idx.add_batch([Pointer(2000 + i) for i in range(10)], more)  # grows
+    assert idx.capacity > 1024
+    res = idx.search([(Pointer(10**9), vecs[0], 1, None)])
+    assert res[0][0][0] == Pointer(0), "pre-grow row lost from device slab"
+    res2 = idx.search([(Pointer(10**9), more[3], 1, None)])
+    assert res2[0][0][0] == Pointer(2003)
+
+
+def test_knn_selective_filter_beyond_chunk_cap(monkeypatch):
+    """A filter rejecting every top candidate up to the chunk cap must
+    still return the matching rows (host-side exhaustive fallback)."""
+    import pathway_tpu.ops.knn as knn_mod
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    monkeypatch.setattr(knn_mod, "_CHUNK_ROWS", 128)
+    idx = BruteForceKnnIndex(4, metric=KnnMetric.L2SQ, reserved_space=1024)
+    rng = np.random.default_rng(4)
+    n = 700
+    vecs = rng.normal(size=(n, 4)).astype(np.float32)
+    # only the 3 FARTHEST rows from the query pass the filter
+    q = vecs[0] + 100.0
+    dists = np.sum((vecs - q) ** 2, axis=1)
+    allowed = set(np.argsort(dists)[-3:].tolist())
+    idx.add_batch([Pointer(i) for i in range(n)], vecs,
+                  filter_data=[{"ok": i in allowed} for i in range(n)])
+    res = idx.search([(Pointer(10**9), q, 3,
+                       lambda d: bool(d and d["ok"]))])[0]
+    assert {int(k) for k, _ in res} == allowed

@@ -1,0 +1,136 @@
+"""WordPiece tokenizer: pure-Python vs native C++ parity, and golden
+parity against the HF BertTokenizer algorithm (constructed offline from a
+local vocab file — no network). Replaces the reference's dependency on HF
+`tokenizers` inside SentenceTransformerEmbedder
+(python/pathway/xpacks/llm/embedders.py:268-326)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pathway_tpu.models.tokenizer import (WordPieceTokenizer,
+                                          make_synthetic_vocab)
+
+VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "quick", "brown", "fox", "jump", "##ed", "##s", "##ing",
+    "over", "lazy", "dog", "un", "##believ", "##able", "!", ",", ".",
+    "##anana", "b", "1", "##2", "##3", "好", "世", "界",
+    "a", "##b", "##c",
+]
+
+CASES = [
+    "The quick brown fox jumped over the lazy dog!",
+    "unbelievable, jumps jumping",
+    "banana b123 bb",
+    "hello 世界 好",  # hello is OOV → [UNK]; CJK chars split singly
+    "",
+    "   ",
+    "a,b.c",
+    "word-with-dashes and under_scores",
+    "x" * 150,  # over max word bytes → [UNK]
+    "MiXeD CaSe LOWERing",
+    "tabs\tand\nnewlines  multiple   spaces",
+    "trailing punctuation...",
+    "ab abc ba cab",  # exercises longest-match-first backtracking
+]
+
+
+def _tok(**kw):
+    return WordPieceTokenizer(VOCAB, **kw)
+
+
+def test_basic_encoding():
+    tok = _tok(prefer_native=False)
+    ids = tok.encode("The quick brown fox")
+    assert ids[0] == tok.cls_id and ids[-1] == tok.sep_id
+    inner = ids[1:-1]
+    assert inner == [tok.vocab["the"], tok.vocab["quick"],
+                     tok.vocab["brown"], tok.vocab["fox"]]
+    # longest-match-first: "jumped" → jump + ##ed
+    ids2 = tok.encode("jumped")[1:-1]
+    assert ids2 == [tok.vocab["jump"], tok.vocab["##ed"]]
+    # whole-word UNK when any piece fails
+    assert tok.encode("zzz")[1:-1] == [tok.unk_id]
+    # banana → b + ##anana
+    assert tok.encode("banana")[1:-1] == [tok.vocab["b"],
+                                          tok.vocab["##anana"]]
+
+
+def test_python_native_parity():
+    native = _tok(prefer_native=True)
+    if native._native is None:
+        pytest.skip("native toolchain unavailable")
+    python = _tok(prefer_native=False)
+    for case in CASES:
+        nids, nmask = native.batch([case], pad_to=64)
+        pids, pmask = python.batch([case], pad_to=64)
+        assert nids.tolist() == pids.tolist(), case
+        assert nmask.tolist() == pmask.tolist(), case
+    # one batched call over all cases must equal per-case calls
+    nids, _ = native.batch(CASES, pad_to=64)
+    pids, _ = python.batch(CASES, pad_to=64)
+    assert nids.tolist() == pids.tolist()
+
+
+def test_hf_bert_tokenizer_golden_parity():
+    """Both engines must reproduce HF BertTokenizer ids on a shared vocab
+    (accent stripping off — a documented simplification)."""
+    transformers = pytest.importorskip("transformers")
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        vocab_path = os.path.join(d, "vocab.txt")
+        with open(vocab_path, "w", encoding="utf-8") as f:
+            f.write("\n".join(VOCAB) + "\n")
+        hf = transformers.BertTokenizer(
+            vocab_file=vocab_path, do_lower_case=True, strip_accents=False,
+            tokenize_chinese_chars=True)
+        ours = WordPieceTokenizer.from_vocab_file(vocab_path)
+        python = WordPieceTokenizer.from_vocab_file(vocab_path,
+                                                    prefer_native=False)
+        for case in CASES:
+            want = hf(case, add_special_tokens=True,
+                      truncation=True, max_length=64)["input_ids"]
+            got_n = ours.encode(case, max_len=64) if ours._native is None \
+                else ours.batch([case], pad_to=64)[0][0]
+            got_p = python.encode(case, max_len=64)
+            if not isinstance(got_n, list):
+                got_n = [int(x) for x in got_n if x != ours.pad_id
+                         or want.count(ours.pad_id)]
+                got_n = got_n[: len(want)]
+            assert got_p == want, (case, got_p, want)
+            assert got_n == want, (case, got_n, want)
+
+
+def test_batch_padding_and_mask():
+    tok = _tok(prefer_native=False)
+    ids, mask = tok.batch(["the quick", "fox"], pad_to=8)
+    assert ids.shape == (2, 8) and mask.shape == (2, 8)
+    assert ids[0, 0] == tok.cls_id
+    assert mask[0].sum() == 4 and mask[1].sum() == 3  # CLS + words + SEP
+    assert (ids[~mask] == tok.pad_id).all()
+    # truncation to pad_to keeps the trailing SEP
+    long_ids, long_mask = tok.batch(["the quick brown fox " * 20], pad_to=8)
+    assert long_mask.all() and long_ids[0, -1] == tok.sep_id
+
+
+def test_vocab_file_roundtrip(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    tok = WordPieceTokenizer.from_vocab_file(str(p), prefer_native=False)
+    assert tok.vocab_size == len(VOCAB)
+    assert tok.cls_id == 2 and tok.pad_id == 0
+
+
+def test_synthetic_vocab_covers_corpus():
+    words = [f"word{i}" for i in range(500)]
+    vocab = make_synthetic_vocab(words, vocab_size=4096)
+    assert len(vocab) == 4096 and len(set(vocab)) == 4096
+    tok = WordPieceTokenizer(vocab, prefer_native=False)
+    ids = tok.encode("word1 word499")[1:-1]
+    assert tok.unk_id not in ids
+    # OOV words split into pieces rather than collapsing to UNK
+    ids2 = tok.encode("zq9k")[1:-1]
+    assert len(ids2) >= 1
