@@ -37,9 +37,29 @@ inline bool is_ascii_punct(unsigned char c) {
            (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
 }
 
+// HF BasicTokenizer classes: whitespace = " \t\n\r" + category Zs;
+// other control chars are DROPPED entirely (HF _clean_text)
 inline bool is_space(unsigned char c) {
-    return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
-           c == '\v';
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+inline bool is_ascii_control(unsigned char c) {
+    return (c < 0x20 && c != '\t' && c != '\n' && c != '\r') || c == 0x7F;
+}
+
+// complete Unicode Zs category (minus ASCII space, handled above)
+inline bool is_unicode_space(uint32_t cp) {
+    return cp == 0xA0 || cp == 0x1680 || (cp >= 0x2000 && cp <= 0x200A) ||
+           cp == 0x202F || cp == 0x205F || cp == 0x3000;
+}
+
+// practical C* set: C1 controls (incl. NEL 0x85), soft hyphen, zero-width
+// and directional format chars, BOM
+inline bool is_unicode_control(uint32_t cp) {
+    return (cp >= 0x80 && cp <= 0x9F) || cp == 0xAD ||
+           (cp >= 0x200B && cp <= 0x200F) ||
+           (cp >= 0x202A && cp <= 0x202E) ||
+           (cp >= 0x2060 && cp <= 0x2064) || cp == 0xFEFF;
 }
 
 // decode one UTF-8 codepoint; returns its byte length (0 on malformed)
@@ -110,9 +130,7 @@ void basic_tokenize(const std::string& lowered,
         if (is_cjk(cp)) {
             flush(i);
             out.emplace_back(i, static_cast<size_t>(len));
-        } else if (cp == 0xA0 || cp == 0x2028 || cp == 0x2029 ||
-                   cp == 0x1680 || cp == 0x205F || cp == 0x3000 ||
-                   (cp >= 0x2000 && cp <= 0x200A)) {  // unicode spaces
+        } else if (is_unicode_space(cp)) {
             flush(i);
         } else {
             if (word_start == std::string::npos) word_start = i;
@@ -209,12 +227,34 @@ void wp_encode_batch(void* h, const char* texts, const int64_t* offsets,
     for (int32_t t = 0; t < n_texts; ++t) {
         const char* s = texts + offsets[t];
         size_t n = static_cast<size_t>(offsets[t + 1] - offsets[t]);
-        lowered.assign(s, n);
-        if (v->lower)
-            for (char& c : lowered)
-                if (static_cast<unsigned char>(c) < 0x80)
-                    c = static_cast<char>(
-                        tolower(static_cast<unsigned char>(c)));
+        // cleaning pass (HF _clean_text): drop control/format chars so a
+        // word interrupted by one CONCATENATES; lowercase ASCII
+        lowered.clear();
+        lowered.reserve(n);
+        const auto* sb = reinterpret_cast<const unsigned char*>(s);
+        const auto* se = sb + n;
+        size_t j = 0;
+        while (j < n) {
+            unsigned char c = sb[j];
+            if (c < 0x80) {
+                if (!is_ascii_control(c)) {
+                    lowered.push_back(
+                        v->lower ? static_cast<char>(tolower(c))
+                                 : static_cast<char>(c));
+                }
+                ++j;
+                continue;
+            }
+            int len = utf8_len(sb + j, se);
+            if (len == 0) {
+                ++j;  // malformed byte: drop
+                continue;
+            }
+            if (!is_unicode_control(utf8_cp(sb + j, len))) {
+                lowered.append(s + j, static_cast<size_t>(len));
+            }
+            j += static_cast<size_t>(len);
+        }
         words.clear();
         basic_tokenize(lowered, words);
         ids.clear();

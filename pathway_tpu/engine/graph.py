@@ -126,6 +126,9 @@ class Scheduler:
             from concurrent.futures import ThreadPoolExecutor
 
             self._pool = ThreadPoolExecutor(max_workers=self.n_workers)
+        import threading
+
+        self._stats_lock = threading.Lock()
         self._topo = self._topo_sort()
         # worker replicas per node; replica 0 is always node.op itself.
         # Gather nodes (unpartitionable state) keep a single replica that
@@ -141,9 +144,17 @@ class Scheduler:
             else:
                 self._replicas[node.id] = node.op.replicate(self.n_workers)
         self.stats: dict[int, dict] = {
-            n.id: {"insertions": 0, "retractions": 0} for n in graph.nodes
+            n.id: {"insertions": 0, "retractions": 0,
+                   "latency_ms": 0.0, "total_ms": 0.0}
+            for n in graph.nodes
         }
         self.on_step: Callable[[int], None] | None = None
+
+    def close(self) -> None:
+        """Release the worker thread pool (idempotent)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
     # -- sharding helpers ----------------------------------------------------
     def _route(self, spec, key, row) -> int:
@@ -208,6 +219,9 @@ class Scheduler:
 
     def _step_op(self, node: Node, op: Operator, time: int,
                  in_deltas: list[Delta], flush: bool) -> Delta:
+        import time as _time
+
+        t0 = _time.perf_counter()
         try:
             delta = op.step(time, in_deltas)
             extra = op.on_time_advance(time)
@@ -226,6 +240,15 @@ class Scheduler:
             add_trace_note(e, node.trace,
                            node.name or type(node.op).__name__)
             raise
+        # per-operator step latency (reference: OperatorStats latency via
+        # Probers, src/engine/progress_reporter.rs:114 — feeds dashboard
+        # and /metrics). Under sharding, replicas accumulate into one node;
+        # the lock keeps += exact when replicas step on the thread pool.
+        ms = (_time.perf_counter() - t0) * 1e3
+        st = self.stats[node.id]
+        with self._stats_lock:
+            st["latency_ms"] = ms
+            st["total_ms"] += ms
         return delta
 
     def _count(self, node_id: int, delta: Delta) -> None:

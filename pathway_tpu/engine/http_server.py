@@ -40,6 +40,8 @@ class MonitoringHttpServer:
                 "name": node.name or type(node.op).__name__,
                 "insertions": st.get("insertions", 0),
                 "retractions": st.get("retractions", 0),
+                "latency_ms": round(st.get("latency_ms", 0.0), 3),
+                "total_ms": round(st.get("total_ms", 0.0), 3),
             })
         return {
             "process_id": int(os.environ.get("PATHWAY_PROCESS_ID", "0")),
@@ -53,6 +55,8 @@ class MonitoringHttpServer:
         lines = [
             "# TYPE pathway_tpu_insertions counter",
             "# TYPE pathway_tpu_retractions counter",
+            "# TYPE pathway_tpu_operator_latency_ms gauge",
+            "# TYPE pathway_tpu_operator_total_ms counter",
         ]
         def esc(v: str) -> str:
             # Prometheus exposition format label escaping
@@ -64,6 +68,10 @@ class MonitoringHttpServer:
             labels = f'{{operator="{esc(op["name"])}",id="{op["id"]}"}}'
             lines.append(f"pathway_tpu_insertions{labels} {op['insertions']}")
             lines.append(f"pathway_tpu_retractions{labels} {op['retractions']}")
+            lines.append(
+                f"pathway_tpu_operator_latency_ms{labels} {op['latency_ms']}")
+            lines.append(
+                f"pathway_tpu_operator_total_ms{labels} {op['total_ms']}")
         try:
             import resource
 

@@ -119,6 +119,8 @@ class StreamingRuntime:
                         self.persistence.commit(time_counter)
                     break
         finally:
+            self.monitor.close()
+            self.scheduler.close()
             if self.persistence is not None:
                 self.persistence.close()
             if self.http_server is not None:

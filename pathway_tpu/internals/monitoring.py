@@ -1,9 +1,19 @@
-"""Monitoring dashboard (reference: python/pathway/internals/monitoring.py —
-rich-based live operator stats table + MonitoringLevel)."""
+"""Live monitoring dashboard.
+
+Reference: python/pathway/internals/monitoring.py:56-226 — a rich-based
+Live terminal dashboard showing per-connector/operator rows (insertions,
+retractions, latency) above a rolling log panel, refreshed in place while
+the pipeline runs, gated by ``MonitoringLevel``. Latency comes from the
+scheduler's per-operator step probes (engine/graph.py Scheduler.stats,
+the analogue of OperatorStats fed by Probers,
+src/engine/progress_reporter.rs:114).
+"""
 
 from __future__ import annotations
 
+import collections
 import enum
+import logging
 import sys
 import time
 
@@ -16,9 +26,26 @@ class MonitoringLevel(enum.Enum):
     ALL = enum.auto()
 
 
+class _LogBuffer(logging.Handler):
+    """Captures recent log records for the dashboard's log panel
+    (reference keeps a rich log pane under the stats table)."""
+
+    def __init__(self, maxlen: int = 8):
+        super().__init__()
+        self.records: collections.deque[str] = collections.deque(
+            maxlen=maxlen)
+
+    def emit(self, record):
+        try:
+            self.records.append(self.format(record))
+        except Exception:
+            pass
+
+
 class StatsMonitor:
-    """Collects per-operator counters from the scheduler and renders a
-    terminal dashboard (rich if a tty, plain lines otherwise)."""
+    """Collects per-operator counters + latency from the scheduler and
+    renders a live terminal dashboard (rich Live on a tty, plain lines
+    otherwise)."""
 
     def __init__(self, level: MonitoringLevel = MonitoringLevel.NONE,
                  refresh_seconds: float = 1.0):
@@ -27,6 +54,11 @@ class StatsMonitor:
         self._last_render = 0.0
         self._live = None
         self._rows: list[tuple] = []
+        self._t0 = time.monotonic()
+        self._log = _LogBuffer()
+        self._log.setFormatter(logging.Formatter("%(levelname)s %(message)s"))
+        if self.enabled():
+            logging.getLogger().addHandler(self._log)
 
     def enabled(self) -> bool:
         if self.level == MonitoringLevel.NONE:
@@ -34,6 +66,9 @@ class StatsMonitor:
         if self.level in (MonitoringLevel.AUTO, MonitoringLevel.AUTO_ALL):
             return sys.stderr.isatty()
         return True
+
+    def _in_out_only(self) -> bool:
+        return self.level in (MonitoringLevel.IN_OUT, MonitoringLevel.AUTO)
 
     def update(self, scheduler, graph, now_time: int) -> None:
         if not self.enabled():
@@ -47,27 +82,59 @@ class StatsMonitor:
             st = scheduler.stats.get(node.id)
             if not st:
                 continue
-            if self.level in (MonitoringLevel.IN_OUT, MonitoringLevel.AUTO):
-                if not (node.name.startswith(("source", "subscribe", "capture",
-                                              "output"))):
-                    continue
+            if self._in_out_only() and not node.name.startswith(
+                    ("source", "subscribe", "capture", "output")):
+                continue
             self._rows.append((node.name or str(node.id),
-                               st["insertions"], st["retractions"]))
+                               st["insertions"], st["retractions"],
+                               st.get("latency_ms", 0.0),
+                               st.get("total_ms", 0.0)))
         self._render(now_time)
+
+    def _renderable(self, now_time: int):
+        from rich.console import Group
+        from rich.panel import Panel
+        from rich.table import Table as RichTable
+
+        elapsed = time.monotonic() - self._t0
+        table = RichTable(
+            title=f"pathway-tpu  t={now_time}  up {elapsed:5.1f}s")
+        table.add_column("operator")
+        table.add_column("insertions", justify="right")
+        table.add_column("retractions", justify="right")
+        table.add_column("latency ms", justify="right")
+        table.add_column("total ms", justify="right")
+        for name, ins, rets, lat, tot in self._rows:
+            table.add_row(name, str(ins), str(rets), f"{lat:.2f}",
+                          f"{tot:.0f}")
+        if self._log.records:
+            return Group(table, Panel("\n".join(self._log.records),
+                                      title="log", height=None))
+        return table
 
     def _render(self, now_time: int) -> None:
         try:
-            from rich.console import Console
-            from rich.table import Table as RichTable
+            if self._live is None:
+                from rich.console import Console
+                from rich.live import Live
 
-            console = Console(stderr=True)
-            table = RichTable(title=f"pathway-tpu @ t={now_time}")
-            table.add_column("operator")
-            table.add_column("insertions", justify="right")
-            table.add_column("retractions", justify="right")
-            for name, ins, rets in self._rows:
-                table.add_row(name, str(ins), str(rets))
-            console.print(table)
+                self._live = Live(self._renderable(now_time),
+                                  console=Console(stderr=True),
+                                  refresh_per_second=4, transient=False)
+                self._live.start()
+            else:
+                self._live.update(self._renderable(now_time))
         except Exception:
-            for name, ins, rets in self._rows:
-                print(f"[monitor] {name}: +{ins} -{rets}", file=sys.stderr)
+            for name, ins, rets, lat, tot in self._rows:
+                print(f"[monitor] {name}: +{ins} -{rets} {lat:.2f}ms",
+                      file=sys.stderr)
+
+    def close(self) -> None:
+        if self._live is not None:
+            try:
+                self._live.stop()
+            except Exception:
+                pass
+            self._live = None
+        if self.enabled():
+            logging.getLogger().removeHandler(self._log)

@@ -104,6 +104,7 @@ class GraphRunner:
             sched.run_time(t)
         # end-of-stream flush tick: temporal buffers release held rows
         sched.run_time(max(times) + 1, flush=True)
+        sched.close()  # batch run complete: release worker pool threads
         self._scheduler = sched
 
     # ------------------------------------------------------------------

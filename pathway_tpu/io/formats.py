@@ -1,0 +1,347 @@
+"""Dependency-free wire formats: DSV, Debezium CDC, psql statements.
+
+Rebuild of the reference's parser/formatter layer
+(src/connectors/data_format.rs — DsvParser:377, DsvFormatter:816,
+DebeziumMessageParser:931 with Postgres+MongoDB variants:926,
+PsqlUpdatesFormatter:1504, PsqlSnapshotFormatter:1563). These are pure
+parsing/formatting — no client libraries — so they work standalone
+(tested in tests/test_wire_formats.py), through ``pw.io.fs.read`` (DSV
+files, Debezium CDC replay files) and through the Kafka connector.
+
+Event model: parsers yield ``ParsedEvent`` records; ``insert``/``delete``
+carry full value rows (Postgres CDC has before/after images), ``upsert``
+carries the new row or None-as-delete (MongoDB CDC has no before image —
+reference session_type() Upsert, data_format.rs:1296-1305).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io as _io
+import json as _json
+from dataclasses import dataclass
+from typing import Any
+
+from pathway_tpu.internals.json import Json
+
+DEBEZIUM_EMPTY_KEY_PAYLOAD = '{"payload": {"before": {}, "after": {}}}'
+# reference: DebeziumMessageParser::standard_separator (8 spaces)
+DEBEZIUM_STANDARD_SEPARATOR = " " * 8
+
+
+@dataclass(frozen=True)
+class ParsedEvent:
+    kind: str                       # "insert" | "delete" | "upsert"
+    key: tuple | None               # primary-key values (None = derive)
+    values: dict[str, Any] | None   # None only for upsert-deletes
+
+
+class ParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# DSV (delimiter-separated values) — data_format.rs:377 (parser), 816
+# ---------------------------------------------------------------------------
+
+def _parse_typed(raw: str, dtype) -> Any:
+    """String field → engine value, mirroring parse_with_type
+    (data_format.rs:412): int/float/bool/json/str."""
+    from pathway_tpu.internals import dtype as dt
+
+    if dtype is None or dtype == dt.STR or dtype == dt.ANY:
+        return raw
+    if dtype == dt.INT:
+        return int(raw)
+    if dtype == dt.FLOAT:
+        return float(raw)
+    if dtype == dt.BOOL:
+        low = raw.strip().lower()
+        # advanced bool parsing (data_format.rs:403): accept common forms
+        if low in ("true", "t", "yes", "y", "on", "1"):
+            return True
+        if low in ("false", "f", "no", "n", "off", "0"):
+            return False
+        raise ParseError(f"cannot parse {raw!r} as bool")
+    if dtype == dt.JSON:
+        return Json.parse(raw)
+    if dtype == dt.BYTES:
+        return raw.encode()
+    return raw
+
+
+class DsvParser:
+    """Header-driven DSV with a configurable delimiter.
+
+    First line names the columns; subsequent lines become events. Typed via
+    an optional schema. ``value_columns`` restricts which columns land in
+    rows; ``key_columns`` extracts the primary key tuple."""
+
+    def __init__(self, *, separator: str = ",", schema=None,
+                 value_columns: list[str] | None = None,
+                 key_columns: list[str] | None = None):
+        if len(separator) != 1:
+            raise ParseError("DSV separator must be a single character")
+        self.separator = separator
+        self.schema = schema
+        self.value_columns = value_columns
+        self.key_columns = key_columns
+        self._header: list[str] | None = None
+
+    def _split(self, line: str) -> list[str]:
+        # csv module handles quoting/escaping for any single-char delimiter
+        return next(_csv.reader(_io.StringIO(line),
+                                delimiter=self.separator))
+
+    def parse_header(self, line: str) -> list[str]:
+        self._header = self._split(line.rstrip("\r\n"))
+        return self._header
+
+    def parse_line(self, line: str, kind: str = "insert") -> ParsedEvent:
+        if self._header is None:
+            raise ParseError("DSV header not parsed yet")
+        tokens = self._split(line.rstrip("\r\n"))
+        if len(tokens) != len(self._header):
+            raise ParseError(
+                f"DSV row has {len(tokens)} fields, header has "
+                f"{len(self._header)}")
+        raw = dict(zip(self._header, tokens))
+        cols = self.value_columns or self._header
+        dtypes = {}
+        if self.schema is not None:
+            dtypes = {n: self.schema[n].dtype
+                      for n in self.schema.column_names() if n in raw}
+        values = {}
+        for n in cols:
+            if n not in raw:
+                raise ParseError(f"DSV row is missing column {n!r}")
+            values[n] = _parse_typed(raw[n], dtypes.get(n))
+        key = None
+        if self.key_columns:
+            key = tuple(values.get(n, _parse_typed(raw[n], dtypes.get(n)))
+                        for n in self.key_columns)
+        return ParsedEvent(kind, key, values)
+
+    def parse_lines(self, text: str) -> list[ParsedEvent]:
+        out = []
+        for i, line in enumerate(text.splitlines()):
+            if not line.strip():
+                continue
+            if self._header is None:
+                self.parse_header(line)
+                continue
+            out.append(self.parse_line(line))
+        return out
+
+
+class DsvFormatter:
+    """Rows → DSV lines with trailing time/diff columns (reference
+    DsvFormatter appends time and diff, data_format.rs:830-860)."""
+
+    def __init__(self, value_columns: list[str], *, separator: str = ","):
+        self.value_columns = value_columns
+        self.separator = separator
+
+    def header(self) -> str:
+        return self._fmt(self.value_columns + ["time", "diff"])
+
+    def _fmt(self, fields: list) -> str:
+        buf = _io.StringIO()
+        _csv.writer(buf, delimiter=self.separator,
+                    lineterminator="").writerow(fields)
+        return buf.getvalue()
+
+    def format(self, values: dict[str, Any], time: int, diff: int) -> str:
+        return self._fmt(
+            [values[n] for n in self.value_columns] + [time, diff])
+
+
+# ---------------------------------------------------------------------------
+# Debezium CDC — data_format.rs:931-1330
+# ---------------------------------------------------------------------------
+
+def _values_by_names(obj: Any, names: list[str]) -> dict[str, Any]:
+    """Extract named fields from a decoded JSON object; nested values wrap
+    as Json (values_by_names_from_json analogue)."""
+    if not isinstance(obj, dict):
+        raise ParseError(f"expected JSON object, got {type(obj).__name__}")
+    out = {}
+    for n in names:
+        v = obj.get(n)
+        if isinstance(v, (dict, list)):
+            v = Json(v)
+        out[n] = v
+    return out
+
+
+class DebeziumMessageParser:
+    """Debezium CDC envelope → ParsedEvents.
+
+    ``db_type='postgres'``: before/after images → op 'r'/'c' = insert of
+    after; 'u' = delete(before) + insert(after); 'd' = delete(before).
+    ``db_type='mongodb'``: no before image and `after` is a serialized
+    JSON string → everything becomes upserts ('d' = upsert None)
+    (reference parse_read_or_create/_update/_delete,
+    data_format.rs:1165-1215 and session_type:1296-1305)."""
+
+    def __init__(self, value_field_names: list[str],
+                 key_field_names: list[str] | None = None, *,
+                 db_type: str = "postgres",
+                 separator: str = DEBEZIUM_STANDARD_SEPARATOR):
+        if db_type not in ("postgres", "mongodb"):
+            raise ParseError(f"unknown Debezium db_type {db_type!r}")
+        self.value_field_names = value_field_names
+        self.key_field_names = key_field_names
+        self.db_type = db_type
+        self.separator = separator
+
+    # -- low-level entry points -----------------------------------------
+    def parse_kv(self, key_bytes: bytes | str | None,
+                 value_bytes: bytes | str | None) -> list[ParsedEvent]:
+        if value_bytes is None:
+            raise ParseError("empty Kafka payload")
+        if key_bytes is None:
+            if self.key_field_names is not None:
+                raise ParseError("empty Kafka key with key fields declared")
+            key_bytes = DEBEZIUM_EMPTY_KEY_PAYLOAD
+        key_raw = (key_bytes.decode() if isinstance(key_bytes, bytes)
+                   else key_bytes)
+        val_raw = (value_bytes.decode() if isinstance(value_bytes, bytes)
+                   else value_bytes)
+        try:
+            value = _json.loads(val_raw)
+        except Exception:
+            raise ParseError(f"failed to parse JSON: {val_raw[:80]!r}")
+        if value is None:
+            return []  # Kafka compaction tombstone (data_format.rs:1262)
+        if not isinstance(value, dict):
+            raise ParseError("Debezium message root must be an object")
+        if "payload" not in value:
+            raise ParseError("no payload at the top level")
+        try:
+            key = _json.loads(key_raw)
+        except Exception:
+            raise ParseError(f"failed to parse JSON key: {key_raw[:80]!r}")
+        payload = value["payload"]
+        key_payload = key.get("payload") if isinstance(key, dict) else None
+        op = payload.get("op") if isinstance(payload, dict) else None
+        if not isinstance(op, str):
+            raise ParseError("operation field missing in payload")
+        if op in ("r", "c"):
+            return self._read_or_create(key_payload, payload)
+        if op == "u":
+            return self._update(key_payload, payload)
+        if op == "d":
+            return self._delete(key_payload, payload)
+        raise ParseError(f"unsupported Debezium operation {op!r}")
+
+    def parse_line(self, line: bytes | str) -> list[ParsedEvent]:
+        """Combined "<key><separator><value>" form (file replay / tests —
+        reference RawBytes branch, data_format.rs:1221-1236)."""
+        text = line.decode() if isinstance(line, bytes) else line
+        parts = text.strip().split(self.separator)
+        if len(parts) != 2:
+            raise ParseError(
+                f"expected key/value pair, got {len(parts)} tokens")
+        return self.parse_kv(parts[0], parts[1])
+
+    # -- op handlers -----------------------------------------------------
+    def _key_of(self, key_payload) -> tuple | None:
+        if self.key_field_names is None:
+            return None
+        if not isinstance(key_payload, dict) or any(
+                n not in key_payload for n in self.key_field_names):
+            # message key doesn't carry the declared fields (e.g. empty
+            # key payload): fall back to deriving the key from the value
+            # image downstream
+            return None
+        vals = _values_by_names(key_payload, self.key_field_names)
+        return tuple(vals[n] for n in self.key_field_names)
+
+    def _image(self, payload, field: str) -> dict[str, Any]:
+        img = payload.get(field)
+        if isinstance(img, str):  # MongoDB serializes the image as a string
+            try:
+                img = _json.loads(img)
+            except Exception:
+                raise ParseError(f"failed to parse JSON image: {img[:80]!r}")
+        return _values_by_names(img or {}, self.value_field_names)
+
+    def _read_or_create(self, key_payload, payload) -> list[ParsedEvent]:
+        key = self._key_of(key_payload)
+        vals = self._image(payload, "after")
+        if self.db_type == "postgres":
+            return [ParsedEvent("insert", key, vals)]
+        return [ParsedEvent("upsert", key, vals)]
+
+    def _update(self, key_payload, payload) -> list[ParsedEvent]:
+        key = self._key_of(key_payload)
+        if self.db_type == "postgres":
+            return [
+                ParsedEvent("delete", key, self._image(payload, "before")),
+                ParsedEvent("insert", key, self._image(payload, "after")),
+            ]
+        return [ParsedEvent("upsert", key, self._image(payload, "after"))]
+
+    def _delete(self, key_payload, payload) -> list[ParsedEvent]:
+        key = self._key_of(key_payload)
+        if self.db_type == "postgres":
+            return [
+                ParsedEvent("delete", key, self._image(payload, "before"))]
+        return [ParsedEvent("upsert", key, None)]
+
+
+# ---------------------------------------------------------------------------
+# psql formatters — data_format.rs:1504 (updates), 1563 (snapshot)
+# ---------------------------------------------------------------------------
+
+class PsqlUpdatesFormatter:
+    """Row diff → parameterized INSERT with time/diff columns (the sink
+    table is an append-only update log, reference PsqlUpdatesFormatter)."""
+
+    def __init__(self, table_name: str, value_columns: list[str]):
+        self.table_name = table_name
+        self.value_columns = value_columns
+
+    def format(self, values: dict[str, Any], time: int,
+               diff: int) -> tuple[str, list]:
+        placeholders = ",".join(
+            f"${i + 1}" for i in range(len(self.value_columns)))
+        sql = (
+            f"INSERT INTO {self.table_name} "
+            f"({','.join(self.value_columns)},time,diff) "
+            f"VALUES ({placeholders},{time},{diff})")
+        return sql, [values[n] for n in self.value_columns]
+
+
+class PsqlSnapshotFormatter:
+    """Row diff → upsert keeping only the freshest row version per key
+    (reference PsqlSnapshotFormatter: ON CONFLICT ... DO UPDATE guarded by
+    time/diff so stale replays cannot clobber newer state)."""
+
+    def __init__(self, table_name: str, key_columns: list[str],
+                 value_columns: list[str]):
+        self.table_name = table_name
+        self.key_columns = key_columns
+        self.value_columns = value_columns
+        for k in key_columns:
+            if k not in value_columns:
+                raise ParseError(
+                    f"snapshot key column {k!r} must be a value column")
+
+    def format(self, values: dict[str, Any], time: int,
+               diff: int) -> tuple[str, list]:
+        cols = self.value_columns
+        placeholders = ",".join(f"${i + 1}" for i in range(len(cols)))
+        update_pairs = ",".join(
+            f"{n}=${i + 1}" for i, n in enumerate(cols)
+            if n not in self.key_columns)
+        on_conflict = ",".join(self.key_columns)
+        t = self.table_name
+        sql = (
+            f"INSERT INTO {t} ({','.join(cols)},time,diff) "
+            f"VALUES ({placeholders},{time},{diff}) "
+            f"ON CONFLICT ({on_conflict}) DO UPDATE SET "
+            f"{update_pairs},time={time},diff={diff} "
+            f"WHERE {t}.time<{time} OR ({t}.time={time} AND {t}.diff=-1)")
+        return sql, [values[n] for n in cols]

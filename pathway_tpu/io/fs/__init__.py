@@ -34,7 +34,8 @@ def _list_files(path: str) -> list[Path]:
     return sorted(Path(f) for f in glob.glob(path))
 
 
-def _parse_file(fpath: Path, format: str, schema, with_metadata: bool):
+def _parse_file(fpath: Path, format: str, schema, with_metadata: bool,
+                dsv_separator: str = ","):
     """Yield value-dicts for one file."""
     meta = None
     if with_metadata:
@@ -55,6 +56,11 @@ def _parse_file(fpath: Path, format: str, schema, with_metadata: bool):
     elif format == "csv":
         with open(fpath, newline="") as f:
             rows = list(_csv.DictReader(f))
+    elif format == "dsv":
+        from pathway_tpu.io.formats import DsvParser
+
+        parser = DsvParser(separator=dsv_separator, schema=schema)
+        rows = [ev.values for ev in parser.parse_lines(fpath.read_text())]
     elif format in ("json", "jsonlines"):
         rows = []
         for line in fpath.read_text().splitlines():
@@ -89,13 +95,14 @@ class FsSource(DataSource):
 
     def __init__(self, path: str, format: str, schema, mode: str,
                  with_metadata: bool, refresh_interval_s: float = 0.5,
-                 autocommit_duration_ms=1500):
+                 autocommit_duration_ms=1500, dsv_separator: str = ","):
         super().__init__(schema, autocommit_duration_ms)
         self.path = path
         self.format = format
         self.mode = mode
         self.with_metadata = with_metadata
         self.refresh_interval_s = refresh_interval_s
+        self.dsv_separator = dsv_separator
 
     def seek(self, replayed: list) -> None:
         """Persistence continuation (engine/persistence.py attach_source):
@@ -174,7 +181,8 @@ class FsSource(DataSource):
                 # one-row lookahead keeps parsing streamed (no whole-file
                 # list) while still flagging the final row's offset is_last
                 parsed = _parse_file(f, self.format, self.schema,
-                                     self.with_metadata)
+                                     self.with_metadata,
+                                     self.dsv_separator)
                 idx = -1
                 pending_values = None
                 for values in parsed:
@@ -203,14 +211,16 @@ def read(path: str, *, format: str = "plaintext", schema=None,
          mode: str = "streaming", csv_settings=None, json_field_paths=None,
          with_metadata: bool = False, autocommit_duration_ms: int | None = 1500,
          name: str | None = None, persistent_id: str | None = None,
-         **kwargs) -> Table:
+         dsv_separator: str = ",", **kwargs) -> Table:
     the_schema = _schema_for(format, schema, with_metadata)
     if mode == "static":
         keys, rows = [], []
         seq = 0
-        src = FsSource(path, format, the_schema, mode, with_metadata)
+        src = FsSource(path, format, the_schema, mode, with_metadata,
+                       dsv_separator=dsv_separator)
         for f in _list_files(path):
-            for values in _parse_file(f, format, the_schema, with_metadata):
+            for values in _parse_file(f, format, the_schema, with_metadata,
+                                      dsv_separator):
                 key, row = src.row_to_engine(values, seq)
                 seq += 1
                 keys.append(key)
@@ -218,7 +228,8 @@ def read(path: str, *, format: str = "plaintext", schema=None,
         plan = Plan("static", keys=keys, rows=rows, times=None, diffs=None)
         return Table(plan, the_schema, Universe(), name=name or "fs_static")
     source = FsSource(path, format, the_schema, mode, with_metadata,
-                      autocommit_duration_ms=autocommit_duration_ms)
+                      autocommit_duration_ms=autocommit_duration_ms,
+                      dsv_separator=dsv_separator)
     source.persistent_id = persistent_id or name
     return Table(Plan("input", datasource=source), the_schema, Universe(),
                  name=name or "fs_input")

@@ -1,5 +1,76 @@
-"""pw.io.postgres (reference: python/pathway/io/postgres). Gated: needs psycopg2."""
+"""pw.io.postgres — PostgreSQL sink.
 
-from pathway_tpu.io._gated import gated
+Reference: python/pathway/io/postgres + PsqlWriter
+(src/connectors/data_storage.rs:1578) with the PsqlUpdates/PsqlSnapshot
+formatters (src/connectors/data_format.rs:1504,1563). Statement formatting
+is dependency-free (pathway_tpu/io/formats.py, tested without a server);
+executing them needs psycopg2 at call time.
 
-read, write = gated("postgres", "psycopg2")
+``output_table_type='stream_of_changes'`` appends every diff with
+time/diff columns; ``'snapshot'`` upserts the freshest row version per
+primary key, guarded against stale replays.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io.formats import (PsqlSnapshotFormatter,
+                                    PsqlUpdatesFormatter)
+
+
+def read(*args, **kwargs):
+    raise ImportError(
+        "pw.io.postgres.read: like the reference, Postgres input arrives "
+        "via CDC — use pw.io.debezium.read (data_storage.rs has a psql "
+        "writer but no reader)")
+
+
+def write(table: Table, postgres_settings: dict, table_name: str, *,
+          output_table_type: str = "stream_of_changes",
+          primary_key: list[str] | None = None,
+          max_batch_size: int | None = None, name: str | None = None,
+          init_mode: str = "default", **kwargs) -> None:
+    try:
+        import psycopg2  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "pw.io.postgres.write requires psycopg2 to execute statements "
+            "(the statement formatting itself is dependency-free, "
+            "pathway_tpu/io/formats.py)") from e
+
+    names = table.column_names()
+    if output_table_type == "snapshot":
+        if not primary_key:
+            raise ValueError("snapshot mode needs primary_key=[...]")
+        formatter: Any = PsqlSnapshotFormatter(table_name, primary_key,
+                                               names)
+    elif output_table_type == "stream_of_changes":
+        formatter = PsqlUpdatesFormatter(table_name, names)
+    else:
+        raise ValueError(
+            f"unknown output_table_type {output_table_type!r}")
+
+    def binder(runner):
+        conn = psycopg2.connect(**postgres_settings)
+        conn.autocommit = False
+
+        def callback(time, delta):
+            with conn.cursor() as cur:
+                for key, row, diff in delta.entries:
+                    sql, params = formatter.format(
+                        dict(zip(names, row)), time, diff)
+                    # $n placeholders → psycopg2 named params; named (not
+                    # positional %s) because the snapshot statement REUSES
+                    # placeholders in its DO UPDATE SET clause
+                    for i in range(len(params), 0, -1):
+                        sql = sql.replace(f"${i}", f"%(p{i})s")
+                    cur.execute(sql, {f"p{i + 1}": v
+                                      for i, v in enumerate(params)})
+            conn.commit()
+
+        runner.subscribe(table, callback)
+
+    G.add_output(binder)

@@ -149,6 +149,12 @@ class WordPieceTokenizer:
 
     # -- pure-Python reference implementation ---------------------------
     def _basic_tokenize(self, text: str) -> list[str]:
+        """HF BasicTokenizer character classes (tokenization_bert.py):
+        whitespace = " \\t\\n\\r" + category Zs; control chars (category
+        C*) are DROPPED, not treated as spaces; ASCII punctuation and CJK
+        codepoints split as their own tokens."""
+        import unicodedata
+
         if self.do_lower:
             text = "".join(
                 c.lower() if ord(c) < 128 else c for c in text)
@@ -162,9 +168,17 @@ class WordPieceTokenizer:
 
         for ch in text:
             cp = ord(ch)
-            if ch.isspace():
+            if ch in " \t\n\r":
                 flush()
-            elif ch in _PUNCT or _is_cjk(cp):
+                continue
+            if cp >= 0x80 or cp < 0x20 or cp == 0x7F:
+                cat = unicodedata.category(ch)
+                if cat == "Zs":
+                    flush()
+                    continue
+                if cat.startswith("C"):
+                    continue  # control/format chars vanish (HF clean_text)
+            if ch in _PUNCT or _is_cjk(cp):
                 flush()
                 out.append(ch)
             else:

@@ -91,7 +91,8 @@ class BruteForceKnnIndex:
         self._slot_to_key: dict[int, Pointer] = {}
         self._filter_data: dict[Pointer, Any] = {}
         self._free: list[int] = list(range(self.capacity - 1, -1, -1))
-        self._dirty: set[int] = set()
+        self._dirty: set[int] = set()    # host → device pending
+        self._stale: set[int] = set()    # device → host pending (add_batch_device)
 
         # device state (lazy)
         self._dev_vectors = None
@@ -125,6 +126,7 @@ class BruteForceKnnIndex:
             if filter_data is not None:
                 self._filter_data[key] = filter_data
             self._dirty.add(slot)
+            self._stale.discard(slot)  # host write wins
 
     def add_batch(self, keys: list[Pointer], vectors,
                   filter_data: list[Any] | None = None) -> None:
@@ -163,7 +165,119 @@ class BruteForceKnnIndex:
                         fd[key] = data
             self._host_vectors[slots] = vecs
             self._host_valid[slots] = True
-            self._dirty.update(slots.tolist())
+            slot_list = slots.tolist()
+            self._dirty.update(slot_list)
+            self._stale.difference_update(slot_list)  # host write wins
+
+    def add_batch_device(self, keys: list[Pointer], vectors) -> None:
+        """Device-to-device add: ``vectors`` is a jax (n, dim) array already
+        resident on the chip (e.g. fresh encoder output). The slab is
+        updated by an on-device scatter and the host mirror is marked stale
+        (synced lazily, only when a host-side read needs it) — embeddings
+        never round-trip through the host, which on a tunneled dev chip
+        saves ~1.5 KB/doc of download+upload on the hot ingest path."""
+        if len(keys) == 0:
+            return
+        import jax.numpy as jnp
+
+        if vectors.ndim != 2 or vectors.shape[1] != self.dim or \
+                vectors.shape[0] != len(keys):
+            raise ValueError(
+                f"expected ({len(keys)}, {self.dim}) device vectors, got "
+                f"{vectors.shape}")
+        with self._lock:
+            n_new = len({k for k in keys if k not in self._key_to_slot})
+            while len(self._free) < n_new:
+                self._grow()
+            slots = np.empty(len(keys), dtype=np.int32)
+            k2s, s2k, free = self._key_to_slot, self._slot_to_key, self._free
+            for i, key in enumerate(keys):
+                slot = k2s.get(key)
+                if slot is None:
+                    slot = free.pop()
+                    k2s[key] = slot
+                    s2k[slot] = key
+                slots[i] = slot
+            self._flush_to_device()  # establish the slab before scattering
+            slab_dtype = (jnp.bfloat16 if self.dtype == "bfloat16"
+                          else jnp.float32)
+            idxs = jnp.asarray(slots)
+            self._dev_vectors = self._dev_vectors.at[idxs].set(
+                vectors.astype(slab_dtype))
+            self._dev_valid = self._dev_valid.at[idxs].set(True)
+            self._host_valid[slots] = True
+            slot_list = slots.tolist()
+            self._stale.update(slot_list)
+            self._dirty.difference_update(slot_list)  # device write wins
+
+    def make_fused_ingest(self, producer: Callable):
+        """Fuse a producer (e.g. the encoder forward pass) with the slab
+        scatter into ONE jitted dispatch, donating the slab so XLA updates
+        it in place (no copy, no extra dispatch, nothing returns to the
+        host). This is the hot embed+index path: the reference runs
+        embedder UDF → index.add per row on the CPU
+        (xpacks/llm/embedders.py + brute_force_knn_integration.rs); here
+        the embedding tensor never leaves the chip.
+
+        ``producer(*args) -> (n, dim) array``. Returns
+        ``ingest(keys, *args)``. Capacity must not grow mid-stream —
+        reserve up front (ValueError otherwise, donation pins the shape).
+        """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        slab_dtype = (jnp.bfloat16 if self.dtype == "bfloat16"
+                      else jnp.float32)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(slab, valid, slots, *args):
+            out = producer(*args)
+            slab = slab.at[slots].set(out.astype(slab_dtype))
+            valid = valid.at[slots].set(True)
+            return slab, valid
+
+        def ingest(keys: list[Pointer], *args) -> None:
+            with self._lock:
+                n_new = len({k for k in keys
+                             if k not in self._key_to_slot})
+                if len(self._free) < n_new:
+                    raise ValueError(
+                        "fused ingest cannot grow the slab (donated shape "
+                        "is pinned) — reserve capacity up front")
+                self._flush_to_device()
+                slots = np.empty(len(keys), dtype=np.int32)
+                k2s, s2k, free = (self._key_to_slot, self._slot_to_key,
+                                  self._free)
+                for i, key in enumerate(keys):
+                    slot = k2s.get(key)
+                    if slot is None:
+                        slot = free.pop()
+                        k2s[key] = slot
+                        s2k[slot] = key
+                    slots[i] = slot
+                self._dev_vectors, self._dev_valid = step(
+                    self._dev_vectors, self._dev_valid,
+                    jnp.asarray(slots), *args)
+                self._host_valid[slots] = True
+                slot_list = slots.tolist()
+                self._stale.update(slot_list)
+                self._dirty.difference_update(slot_list)
+
+        return ingest
+
+    def _sync_mirror(self) -> None:
+        """Pull device-authoritative rows back into the host mirror (lock
+        held). Needed before _grow (the realloc copies the mirror) and
+        before host-side exact reads."""
+        if not self._stale or self._dev_vectors is None:
+            self._stale.clear()
+            return
+        idxs = np.fromiter(self._stale, dtype=np.int32)
+        self._stale.clear()
+        self._host_vectors[idxs] = np.asarray(
+            self._dev_vectors[idxs]).astype(self._np_dtype)
 
     def remove(self, key: Pointer) -> None:
         with self._lock:
@@ -175,11 +289,15 @@ class BruteForceKnnIndex:
             self._host_valid[slot] = False
             self._free.append(slot)
             self._dirty.add(slot)
+            self._stale.discard(slot)
 
     def __len__(self) -> int:
         return len(self._key_to_slot)
 
     def _grow(self) -> None:
+        # device-authoritative rows must land in the mirror before the
+        # realloc copies it (the old device slab is discarded below)
+        self._sync_mirror()
         old_cap = self.capacity
         self.capacity = old_cap * 2
         if self.capacity > _CHUNK_ROWS:
@@ -398,6 +516,7 @@ class BruteForceKnnIndex:
 
     def _exhaustive_filtered_search(self, qvec, limit: int, filt):
         """Exact filtered top-k over the host mirror (lock held)."""
+        self._sync_mirror()
         keys = [k for k in self._key_to_slot
                 if self._passes_filter(k, filt)]
         if not keys:

@@ -263,8 +263,9 @@ def _assign_session_windows(table: Table, time_e, window: SessionWindow,
         last_t = None
         for t, key in items:
             if cur:
+                # reference _window.py:80 — strict: b - a < max_gap
                 joined = (pred(last_t, t) if pred is not None
-                          else (t - last_t) <= max_gap)
+                          else (t - last_t) < max_gap)
                 if not joined:
                     out.append(tuple(cur))
                     cur = []
@@ -757,32 +758,128 @@ def _side_of(e, left, right):
 # window_join (reference: window_join.py, 1,217 LoC)
 # ---------------------------------------------------------------------------
 
+def _session_window_join(left: Table, right: Table, tl_e, tr_e,
+                         window: SessionWindow, on, how: str):
+    """Session windows have no per-element assignment: sessions are built
+    from the sorted UNION of both sides' times per join key, split where
+    max_gap/predicate breaks (reference _window_join.py:174-180 — "creates
+    sessions by concatenating records from both sides"), then each side
+    attaches its session bounds and the sides equi-join on
+    (join key, session). Same-time entries always share a session."""
+    import pathway_tpu.internals.reducers_frontend as reducers
+
+    lk, rk = [], []
+    for c in on:
+        if not (isinstance(c, ex.BinaryExpression) and c._op == "=="):
+            raise ValueError(
+                "session window_join supports equality conditions only")
+        a, b = c._left, c._right
+        if _side_of(a, left, right) == "left":
+            la, rb = a, b
+        else:
+            la, rb = b, a
+        lk.append(left._resolve(la))
+        rk.append(thisclass.resolve_this({"this": right}, rb))
+    lkey = ex.MakeTupleExpression(*lk) if lk else ex.wrap_arg(0)
+    rkey = ex.MakeTupleExpression(*rk) if rk else ex.wrap_arg(0)
+
+    ul = left.select(_pw_t=tl_e, _pw_k=lkey)
+    ur = right.select(_pw_t=tr_e, _pw_k=rkey)
+    u = ul.concat_reindex(ur)
+    u = u.filter(ex.apply(lambda t: t is not None, u._pw_t))
+    g = u.groupby(u._pw_k).reduce(
+        k=u._pw_k, ts=reducers.sorted_tuple(u._pw_t))
+    pred, max_gap = window.predicate, window.max_gap
+
+    def spans_of(ts):
+        spans: list = []
+        cur_start = None
+        prev = None
+        members: list = []
+        for t in ts:
+            if prev is not None and t != prev:
+                joined = (pred(prev, t) if pred is not None
+                          else (t - prev) < max_gap)
+                if not joined:
+                    spans.append((cur_start, prev, tuple(members)))
+                    members = []
+                    cur_start = None
+            if cur_start is None:
+                cur_start = t
+            if not members or members[-1] != t:
+                members.append(t)
+            prev = t
+        if cur_start is not None:
+            spans.append((cur_start, prev, tuple(members)))
+        out = []
+        for s, e, ms in spans:
+            for t in ms:
+                out.append((t, s, e))
+        return tuple(out)
+
+    m = g.select(k=g.k, _pw_sp=ex.ApplyExpression(spans_of, None, g.ts))
+    mf = m.flatten(m._pw_sp)
+    tmap = mf.select(k=mf.k, t=mf._pw_sp[0], s=mf._pw_sp[1],
+                     e=mf._pw_sp[2])
+
+    la = left.with_columns(_pw_k=lkey, _pw_t=tl_e)
+    ltf = la.join(tmap, la._pw_k == tmap.k, la._pw_t == tmap.t,
+                  id=la.id).select(
+        **{n: la[n] for n in left.column_names()},
+        _pw_k=la._pw_k, _pw_w=ex.MakeTupleExpression(tmap.s, tmap.e))
+    ra = right.with_columns(_pw_k=rkey, _pw_t=tr_e)
+    rtf = ra.join(tmap, ra._pw_k == tmap.k, ra._pw_t == tmap.t,
+                  id=ra.id).select(
+        **{n: ra[n] for n in right.column_names()},
+        _pw_k=ra._pw_k, _pw_w=ex.MakeTupleExpression(tmap.s, tmap.e))
+    jr = ltf.join(rtf, ltf._pw_k == rtf._pw_k, ltf._pw_w == rtf._pw_w,
+                  how=how)
+    return ltf, rtf, jr
+
+
 def window_join(left: Table, right: Table, t_left, t_right, window: Window,
                 *on, how: str = "inner"):
-    """Join rows that fall into the same window."""
+    """Join rows that fall into the same window
+    (reference: _window_join.py:156 — tumbling/sliding windows assign each
+    row to its windows and the sides equi-join on (window, on-conds);
+    session windows merge both sides' times into shared sessions)."""
     tl_e = left._resolve(ex.wrap_arg(t_left))
     tr_e = thisclass.resolve_this({"this": right}, ex.wrap_arg(t_right))
-    assign = window.assign
 
-    def windows_of(t):
-        if t is None:
-            return ()
-        return tuple(assign(t))
+    if isinstance(window, SessionWindow):
+        ltf, rtf, jr = _session_window_join(
+            left, right, tl_e, tr_e, window, on, how)
+    else:
+        assign = window.assign
 
-    lt = left.with_columns(_pw_w=ex.ApplyExpression(windows_of, None, tl_e))
-    ltf = lt.flatten(lt._pw_w)
-    rt = right.with_columns(_pw_w=ex.ApplyExpression(windows_of, None, tr_e))
-    rtf = rt.flatten(rt._pw_w)
-    conds = [ltf._pw_w == rtf._pw_w]
-    for c in on:
-        conds.append(_replace_table(_replace_table(c, left, ltf), right, rtf))
-    jr = ltf.join(rtf, *conds, how=how)
+        def windows_of(t):
+            if t is None:
+                return ()
+            return tuple(assign(t))
+
+        lt = left.with_columns(
+            _pw_w=ex.ApplyExpression(windows_of, None, tl_e))
+        ltf = lt.flatten(lt._pw_w)
+        rt = right.with_columns(
+            _pw_w=ex.ApplyExpression(windows_of, None, tr_e))
+        rtf = rt.flatten(rt._pw_w)
+        conds = [ltf._pw_w == rtf._pw_w]
+        for c in on:
+            conds.append(
+                _replace_table(_replace_table(c, left, ltf), right, rtf))
+        jr = ltf.join(rtf, *conds, how=how)
 
     class _WJ:
+        """Result proxy — like the reference's WindowJoinResult
+        (_window_join.py:24-155) it exposes ``select``, substituting
+        pw.left / pw.right / original-table references; the result of
+        ``select`` is an ordinary Table that composes with everything."""
+
         def select(self_inner, *args, **kwargs):
             def fix(e):
                 e = thisclass.resolve_this(
-                    {"left": left, "right": right, "this": left}, ex.wrap_arg(e))
+                    {"left": left, "right": right, "this": left},
+                    ex.wrap_arg(e))
                 e = _replace_table(e, left, ltf)
                 e = _replace_table(e, right, rtf)
                 return e

@@ -274,3 +274,40 @@ def test_knn_selective_filter_beyond_chunk_cap(monkeypatch):
     res = idx.search([(Pointer(10**9), q, 3,
                        lambda d: bool(d and d["ok"]))])[0]
     assert {int(k) for k, _ in res} == allowed
+
+
+def test_knn_add_batch_device_matches_host_path():
+    """Device-to-device adds must be search-equivalent to host adds, and
+    the lazy mirror must survive grow + host-side exact reads."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    rng = np.random.default_rng(5)
+    vecs = rng.normal(size=(300, 8)).astype(np.float32)
+    host = BruteForceKnnIndex(8, metric=KnnMetric.L2SQ)
+    dev = BruteForceKnnIndex(8, metric=KnnMetric.L2SQ)
+    keys = [Pointer(i) for i in range(300)]
+    host.add_batch(keys, vecs)
+    dev.add_batch_device(keys, jnp.asarray(vecs))
+    q = [(Pointer(900 + i), vecs[i * 7], 5, None) for i in range(4)]
+    assert host.search(q) == dev.search(q)
+    # grow after device adds: stale rows must be synced, not lost
+    more = rng.normal(size=(800, 8)).astype(np.float32)
+    dev.add_batch_device([Pointer(1000 + i) for i in range(800)],
+                         jnp.asarray(more))
+    assert dev.capacity > 1024
+    res = dev.search([(Pointer(999), vecs[3], 1, None)])
+    assert res[0][0][0] == Pointer(3)
+    res2 = dev.search([(Pointer(999), more[11], 1, None)])
+    assert res2[0][0][0] == Pointer(1011)
+    # host-side exact read (filtered fallback) sees device-written rows
+    dev2 = BruteForceKnnIndex(4, metric=KnnMetric.L2SQ)
+    eye = np.eye(4, dtype=np.float32)
+    dev2.add_batch_device([Pointer(i) for i in range(4)], jnp.asarray(eye))
+    for i in range(4):
+        dev2._filter_data[Pointer(i)] = {"ok": i == 2}
+    got = dev2._exhaustive_filtered_search(eye[2], 1,
+                                           lambda d: bool(d and d["ok"]))
+    assert got[0][0] == Pointer(2)

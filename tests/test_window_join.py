@@ -1,0 +1,201 @@
+"""window_join: tumbling/sliding/session × inner/left/right/outer, with
+retractions, verified against a brute-force model, at n_workers ∈ {1, 8}
+(reference: python/pathway/stdlib/temporal/_window_join.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.delta import row_fingerprint
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.runner import GraphRunner
+from tests.utils import T, rows_of
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+LEFT_MD = """
+k | t  | a  | _time | _diff
+x | 1  | 10 | 2     | 1
+x | 4  | 11 | 2     | 1
+y | 3  | 12 | 2     | 1
+x | 7  | 13 | 4     | 1
+x | 4  | 11 | 6     | -1
+z | 2  | 14 | 6     | 1
+"""
+
+RIGHT_MD = """
+k | t  | b  | _time | _diff
+x | 2  | 20 | 2     | 1
+x | 5  | 21 | 2     | 1
+y | 9  | 22 | 4     | 1
+x | 6  | 23 | 6     | 1
+w | 1  | 24 | 6     | 1
+"""
+
+# final states after the update stream above settles
+LEFT_ROWS = [("x", 1, 10), ("y", 3, 12), ("x", 7, 13), ("z", 2, 14)]
+RIGHT_ROWS = [("x", 2, 20), ("x", 5, 21), ("y", 9, 22), ("x", 6, 23),
+              ("w", 1, 24)]
+
+
+def _tumbling_wins(t, dur):
+    s = (t // dur) * dur
+    return [(s, s + dur)]
+
+
+def _sliding_wins(t, hop, dur):
+    out = []
+    i = (t - dur) // hop + 1
+    while True:
+        s = i * hop
+        if s > t:
+            break
+        if t < s + dur:
+            out.append((s, s + dur))
+        i += 1
+    return out
+
+
+def _session_spans(times, max_gap):
+    spans = {}
+    ts = sorted(set(times))
+    if not ts:
+        return spans
+    cur = [ts[0]]
+    for t in ts[1:]:
+        if t - cur[-1] < max_gap:
+            cur.append(t)
+        else:
+            for m in cur:
+                spans[m] = (cur[0], cur[-1])
+            cur = [t]
+    for m in cur:
+        spans[m] = (cur[0], cur[-1])
+    return spans
+
+
+def _model(how, wins_of=None, session_gap=None):
+    """Brute-force expected multiset of (a, b) pairs."""
+    out = []
+    if session_gap is not None:
+        keys = {k for k, _, _ in LEFT_ROWS} | {k for k, _, _ in RIGHT_ROWS}
+        for k in keys:
+            lts = [t for kk, t, _ in LEFT_ROWS if kk == k]
+            rts = [t for kk, t, _ in RIGHT_ROWS if kk == k]
+            spans = _session_spans(lts + rts, session_gap)
+            sess = sorted({spans[t] for t in lts + rts})
+            for sp in sess:
+                lg = [(a,) for kk, t, a in LEFT_ROWS
+                      if kk == k and spans[t] == sp]
+                rg = [(b,) for kk, t, b in RIGHT_ROWS
+                      if kk == k and spans[t] == sp]
+                out.extend(_join_groups(lg, rg, how))
+        return sorted(out, key=repr)
+    pairs = {}
+    for k, t, a in LEFT_ROWS:
+        for w in wins_of(t):
+            pairs.setdefault((k, w), [[], []])[0].append((a,))
+    for k, t, b in RIGHT_ROWS:
+        for w in wins_of(t):
+            pairs.setdefault((k, w), [[], []])[1].append((b,))
+    for lg, rg in pairs.values():
+        out.extend(_join_groups(lg, rg, how))
+    return sorted(out, key=repr)
+
+
+def _join_groups(lg, rg, how):
+    out = []
+    if lg and rg:
+        for (a,) in lg:
+            for (b,) in rg:
+                out.append((a, b))
+    if how in ("left", "outer") and lg and not rg:
+        out.extend((a, None) for (a,) in lg)
+    if how in ("right", "outer") and rg and not lg:
+        out.extend((None, b) for (b,) in rg)
+    return out
+
+
+def _run(window, how, n_workers):
+    G.clear()
+    left = T(LEFT_MD)
+    right = T(RIGHT_MD)
+    res = pw.temporal.window_join(
+        left, right, left.t, right.t, window, left.k == right.k,
+        how=how).select(a=pw.left.a, b=pw.right.b)
+    runner = GraphRunner()
+    cap = runner.capture(res)
+    runner.run_batch(n_workers=n_workers)
+    rows = sorted((tuple(r) for r in cap.snapshot().values()), key=repr)
+    stream = sorted((k, row_fingerprint(r), t, d)
+                    for k, r, t, d in cap.consolidated_events())
+    G.clear()
+    return rows, stream
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_tumbling_window_join(how):
+    rows, _ = _run(pw.temporal.tumbling(duration=3), how, 1)
+    assert rows == _model(how, wins_of=lambda t: _tumbling_wins(t, 3))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_sliding_window_join(how):
+    rows, _ = _run(pw.temporal.sliding(hop=2, duration=4), how, 1)
+    assert rows == _model(how, wins_of=lambda t: _sliding_wins(t, 2, 4))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_session_window_join(how):
+    rows, _ = _run(pw.temporal.session(max_gap=2), how, 1)
+    assert rows == _model(how, session_gap=2)
+
+
+@pytest.mark.parametrize("window", [
+    pw.temporal.tumbling(duration=3),
+    pw.temporal.sliding(hop=2, duration=4),
+    pw.temporal.session(max_gap=2),
+], ids=["tumbling", "sliding", "session"])
+@pytest.mark.parametrize("how", ["inner", "outer"])
+def test_window_join_sharded_identical(window, how):
+    """Full update stream (incl. retraction) must be byte-identical at
+    n_workers ∈ {1, 8}."""
+    rows1, stream1 = _run(window, how, 1)
+    rows8, stream8 = _run(window, how, 8)
+    assert rows1 == rows8
+    assert stream1 == stream8
+
+
+def test_session_join_predicate_mode():
+    rows, _ = _run(pw.temporal.session(
+        predicate=lambda a, b: b - a < 2), "inner", 1)
+    assert rows == _model("inner", session_gap=2)
+
+
+def test_window_join_result_composes():
+    """select() returns a plain Table that composes with filter/groupby."""
+    left = T(LEFT_MD)
+    right = T(RIGHT_MD)
+    res = pw.temporal.window_join(
+        left, right, left.t, right.t, pw.temporal.tumbling(duration=3),
+        left.k == right.k, how="inner").select(
+        k=pw.left.k, a=pw.left.a, b=pw.right.b)
+    agg = res.groupby(res.k).reduce(res.k, n=pw.reducers.count())
+    big = agg.filter(agg.n > 1)
+    runner = GraphRunner()
+    cap = runner.capture(big)
+    runner.run_batch()
+    got = dict((r[0], r[1]) for r in cap.snapshot().values())
+    model = {}
+    for a, b in _model("inner", wins_of=lambda t: _tumbling_wins(t, 3)):
+        k = next(kk for kk, _, aa in LEFT_ROWS if aa == a)
+        model[k] = model.get(k, 0) + 1
+    model = {k: v for k, v in model.items() if v > 1}
+    assert got == model

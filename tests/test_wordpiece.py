@@ -34,6 +34,9 @@ CASES = [
     "tabs\tand\nnewlines  multiple   spaces",
     "trailing punctuation...",
     "ab abc ba cab",  # exercises longest-match-first backtracking
+    "a b",       # narrow no-break space (French number grouping)
+    "a\x1cb\x1db\x1eb\x1fb",  # ASCII separators Python isspace() accepts
+    "a\x85b  c d　e",  # NEL + more unicode spaces
 ]
 
 
