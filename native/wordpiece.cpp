@@ -47,19 +47,26 @@ inline bool is_ascii_control(unsigned char c) {
     return (c < 0x20 && c != '\t' && c != '\n' && c != '\r') || c == 0x7F;
 }
 
-// complete Unicode Zs category (minus ASCII space, handled above)
+// Unicode Zs category (minus ASCII space) + Zl/Zp: HF's
+// whitespace_tokenize uses str.split(), which splits on the line and
+// paragraph separators too
 inline bool is_unicode_space(uint32_t cp) {
     return cp == 0xA0 || cp == 0x1680 || (cp >= 0x2000 && cp <= 0x200A) ||
-           cp == 0x202F || cp == 0x205F || cp == 0x3000;
+           cp == 0x202F || cp == 0x205F || cp == 0x3000 ||
+           cp == 0x2028 || cp == 0x2029;
 }
 
-// practical C* set: C1 controls (incl. NEL 0x85), soft hyphen, zero-width
-// and directional format chars, BOM
+// practical C* set: C1 controls (incl. NEL 0x85), soft hyphen, Mongolian
+// vowel separator, Arabic/Syriac format marks, zero-width and
+// directional/isolate format chars, word joiner, BOM
 inline bool is_unicode_control(uint32_t cp) {
     return (cp >= 0x80 && cp <= 0x9F) || cp == 0xAD ||
+           (cp >= 0x0600 && cp <= 0x0605) || cp == 0x061C ||
+           cp == 0x06DD || cp == 0x070F || cp == 0x08E2 || cp == 0x180E ||
            (cp >= 0x200B && cp <= 0x200F) ||
            (cp >= 0x202A && cp <= 0x202E) ||
-           (cp >= 0x2060 && cp <= 0x2064) || cp == 0xFEFF;
+           (cp >= 0x2060 && cp <= 0x2064) ||
+           (cp >= 0x2066 && cp <= 0x206F) || cp == 0xFEFF;
 }
 
 // decode one UTF-8 codepoint; returns its byte length (0 on malformed)

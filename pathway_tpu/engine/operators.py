@@ -609,3 +609,121 @@ def _sortable(v):
     if isinstance(v, str):
         return (2, v)
     return (3, repr(v))
+
+
+class GradualBroadcastOperator(Operator):
+    """Throttled broadcast of a changing (lower, value, upper) triplet
+    (reference: src/engine/dataflow/operators/gradual_broadcast.rs:1-490).
+
+    Every target row gets an ``apx_value`` column approximating the
+    broadcast value: keys below ``threshold = (value-lower)/(upper-lower)
+    x KEY_MAX`` see ``upper``, the rest see ``lower``. When the value
+    moves, only keys BETWEEN the old and new thresholds change — so a
+    jittering broadcast scalar retracts O(moved fraction) of rows instead
+    of all of them (apply_to_fragment from..to, gradual_broadcast.rs:
+    421-460). Input 0: target rows; input 1: the triplet table (last
+    insert wins, like the reference's broadcast stream).
+    """
+
+    arity = 2
+    _KEY_SPACE = 1 << 128
+    _MISSING = object()  # 'never emitted' sentinel (None is a legal apx)
+
+    def __init__(self):
+        self.rows: dict[Pointer, tuple] = {}
+        self._sorted_keys: list[int] = []  # int(key), ascending
+        self._by_int: dict[int, Pointer] = {}
+        self.triplet: tuple | None = None
+        self._threshold: int | None = None  # threshold of last emission
+        self.emitted_apx: dict[Pointer, Any] = {}
+
+    def exchange_specs(self):
+        # the triplet must be visible to every row's owner; with a single
+        # logical owner the state stays consistent (the reference
+        # broadcasts the triplet stream to all workers instead)
+        return [Exchange.GATHER, Exchange.GATHER]
+
+    def _threshold_of(self, triplet) -> int:
+        lower, value, upper = triplet
+        try:
+            span = upper - lower
+            frac = 1.0 if span == 0 else (value - lower) / span
+        except TypeError:
+            frac = 1.0
+        frac = min(1.0, max(0.0, float(frac)))
+        return int(frac * self._KEY_SPACE)
+
+    def _apx_of(self, key: Pointer) -> Any:
+        lower, _value, upper = self.triplet
+        return upper if int(key) < self._threshold else lower
+
+    def _emit_upsert(self, out: Delta, key: Pointer, row: tuple) -> None:
+        apx = self._apx_of(key)
+        old = self.emitted_apx.get(key, self._MISSING)
+        if old is self._MISSING:
+            out.append(key, (*row, apx), 1)
+            self.emitted_apx[key] = apx
+        elif row_fingerprint((old,)) != row_fingerprint((apx,)):
+            out.append(key, (*row, old), -1)
+            out.append(key, (*row, apx), 1)
+            self.emitted_apx[key] = apx
+
+    def step(self, time, in_deltas):
+        import bisect
+
+        d_rows, d_thr = in_deltas
+        out = Delta()
+        old_triplet = self.triplet
+        if d_thr:
+            for _k, row, diff in d_thr.entries:
+                if diff > 0:
+                    self.triplet = (row[0], row[1], row[2])
+        if d_rows:
+            for key, row, diff in d_rows.entries:
+                ik = int(key)
+                if diff > 0:
+                    if key not in self.rows:
+                        bisect.insort(self._sorted_keys, ik)
+                        self._by_int[ik] = key
+                    self.rows[key] = row
+                    if self.triplet is not None:
+                        if self._threshold is None:
+                            self._threshold = self._threshold_of(
+                                self.triplet)
+                        apx = self._apx_of(key)
+                        out.append(key, (*row, apx), 1)
+                        self.emitted_apx[key] = apx
+                else:
+                    if key in self.rows:
+                        idx = bisect.bisect_left(self._sorted_keys, ik)
+                        if (idx < len(self._sorted_keys)
+                                and self._sorted_keys[idx] == ik):
+                            self._sorted_keys.pop(idx)
+                        self._by_int.pop(ik, None)
+                    self.rows.pop(key, None)
+                    old = self.emitted_apx.pop(key, self._MISSING)
+                    if old is not self._MISSING:
+                        out.append(key, (*row, old), -1)
+        if d_thr and self.triplet is not None:
+            new_thr = self._threshold_of(self.triplet)
+            bounds_changed = (
+                old_triplet is None
+                or old_triplet[0] != self.triplet[0]
+                or old_triplet[2] != self.triplet[2])
+            old_thr = self._threshold
+            self._threshold = new_thr
+            if bounds_changed or old_thr is None:
+                # lower/upper changed: every emitted apx may be stale
+                for key, row in self.rows.items():
+                    self._emit_upsert(out, key, row)
+            elif new_thr != old_thr:
+                # only the key band between the thresholds flips
+                # (reference apply_to_fragment from..to,
+                # gradual_broadcast.rs:421-460)
+                lo, hi = min(old_thr, new_thr), max(old_thr, new_thr)
+                i = bisect.bisect_left(self._sorted_keys, lo)
+                j = bisect.bisect_left(self._sorted_keys, hi)
+                for ik in self._sorted_keys[i:j]:
+                    key = self._by_int[ik]
+                    self._emit_upsert(out, key, self.rows[key])
+        return out.consolidate()

@@ -12,7 +12,7 @@ from pathway_tpu.internals.runner import GraphRunner
 def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = False,
         default_logging: bool = True, persistence_config=None,
         runtime_typechecking: bool | None = None, terminate_on_error: bool = True,
-        **kwargs) -> Any:
+        telemetry_config=None, **kwargs) -> Any:
     """Build the engine graph from all registered outputs and run it.
 
     Static-only graphs run in batch mode to completion; graphs with streaming
@@ -31,23 +31,39 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
             f"PATHWAY_PROCESSES={cfg.processes}: multi-process dataflow "
             "execution is not supported; use PATHWAY_THREADS=N for N "
             "sharded in-process workers (cli spawn -n folds into this)")
+    from pathway_tpu.internals.telemetry import Config as TelemetryConfig
+    from pathway_tpu.internals.telemetry import Telemetry
+
+    if telemetry_config is None:
+        telemetry_config = TelemetryConfig.create()
+    telemetry = Telemetry(telemetry_config)
+
     runner = GraphRunner()
-    for binder in G.output_binders:
-        binder(runner)
+    with telemetry.span("pathway.graph.build"):
+        for binder in G.output_binders:
+            binder(runner)
     if persistence_config is None:
         persistence_config = _persistence_config_from_env()
     if persistence_config is not None:
         runner._persistence_config = persistence_config
-    if runner._stream_subjects:
-        from pathway_tpu.engine.streaming import StreamingRuntime
+    try:
+        with telemetry.span("pathway.run",
+                            run_id=telemetry_config.run_id or ""):
+            if runner._stream_subjects:
+                from pathway_tpu.engine.streaming import StreamingRuntime
 
-        rt = StreamingRuntime(runner, monitoring_level=monitoring_level,
-                              with_http_server=with_http_server,
-                              persistence_config=persistence_config,
-                              terminate_on_error=terminate_on_error)
-        rt.run()
-    else:
-        runner.run_batch()
+                rt = StreamingRuntime(
+                    runner, monitoring_level=monitoring_level,
+                    with_http_server=with_http_server,
+                    persistence_config=persistence_config,
+                    terminate_on_error=terminate_on_error)
+                telemetry.register_scheduler_gauges(rt.scheduler,
+                                                    runner.graph)
+                rt.run()
+            else:
+                runner.run_batch()
+    finally:
+        telemetry.shutdown()
     return runner
 
 

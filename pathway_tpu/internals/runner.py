@@ -166,6 +166,12 @@ class GraphRunner:
         self._stream_subjects.append((node, plan.params["datasource"]))
         return node
 
+    def _lower_gradual_broadcast(self, table: Table, plan: Plan) -> Node:
+        base = self.lower(plan.params["base"])
+        thr = self.lower(plan.params["thr"])
+        return self.graph.add_node(eng.GradualBroadcastOperator(),
+                                   [base, thr], "gradual_broadcast")
+
     def _lower_identity(self, table: Table, plan: Plan) -> Node:
         return self.lower(plan.params["base"])
 

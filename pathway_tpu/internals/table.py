@@ -372,6 +372,21 @@ class Table:
     # ------------------------------------------------------------------
     # set ops / combination
     # ------------------------------------------------------------------
+    def _gradual_broadcast(self, threshold_table: "Table", lower_column,
+                           value_column, upper_column) -> "Table":
+        """Add an ``apx_value`` column approximating a changing broadcast
+        scalar: keys below (value-lower)/(upper-lower) of the key space
+        read ``upper``, the rest ``lower`` — a moving value retracts only
+        the key range it crossed (reference: Table._gradual_broadcast,
+        internals/table.py:627 + operators/gradual_broadcast.rs)."""
+        from pathway_tpu.internals import dtype as dt
+
+        thr = threshold_table.select(_pw_l=lower_column, _pw_v=value_column,
+                                     _pw_u=upper_column)
+        plan = Plan("gradual_broadcast", base=self, thr=thr)
+        schema = self.schema | sch.schema_from_types(apx_value=dt.ANY)
+        return Table(plan, schema, self._universe)
+
     def concat(self, *others: "Table") -> "Table":
         tables = [self, *others]
         schema = _common_schema(tables)

@@ -1,0 +1,189 @@
+"""OpenTelemetry traces + metrics for pipeline runs.
+
+Reference: src/engine/telemetry.rs:196-366 (OTLP tracer/meter providers,
+periodic process metrics — memory, CPU — and operator latency gauges) plus
+the Python-side graph-build spans (internals/graph_runner/telemetry.py).
+
+This build instruments through the **OTel API** (in-image): spans and
+gauges are real instrumentation objects that become live the moment an
+OTel SDK is configured in the process (the standard API/SDK split). When
+``endpoint`` is passed and the SDK + OTLP exporter packages are
+importable, ``Config.create`` wires a full pipeline provider itself;
+otherwise instrumentation degrades to the API's no-op implementations —
+never an import error (the reference gates the same way on its
+license/monitoring-server config, telemetry.rs:196-264).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class Config:
+    """Telemetry configuration (reference: telemetry::Config::create)."""
+
+    telemetry_enabled: bool = False
+    endpoint: str | None = None
+    service_name: str = "pathway-tpu"
+    run_id: str | None = None
+
+    @classmethod
+    def create(cls, *, telemetry_enabled: bool = False,
+               endpoint: str | None = None,
+               service_name: str = "pathway-tpu",
+               run_id: str | None = None) -> "Config":
+        endpoint = endpoint or os.environ.get(
+            "PATHWAY_TELEMETRY_ENDPOINT") or None
+        if endpoint:
+            telemetry_enabled = True
+        return cls(telemetry_enabled=telemetry_enabled, endpoint=endpoint,
+                   service_name=service_name,
+                   run_id=run_id or os.environ.get("PATHWAY_RUN_ID"))
+
+
+class Telemetry:
+    """Tracer + meter bundle bound to one pipeline run."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self._provider = None
+        self._meter_provider = None
+        try:
+            from opentelemetry import metrics, trace
+        except ImportError:  # pragma: no cover - otel api is in-image
+            self.tracer = None
+            self.meter = None
+            return
+        if config.endpoint and self._try_setup_sdk(config):
+            # providers stay LOCAL to this run (never set as the process
+            # globals): a second pw.run() builds fresh ones, so per-run
+            # shutdown cannot orphan later runs on a dead global provider
+            self.tracer = self._provider.get_tracer(config.service_name)
+            self.meter = self._meter_provider.get_meter(config.service_name)
+        else:
+            self.tracer = trace.get_tracer(config.service_name)
+            self.meter = metrics.get_meter(config.service_name)
+        self._instruments: dict[str, Any] = {}
+
+    def _try_setup_sdk(self, config: Config) -> bool:
+        """Build OTLP providers when the SDK is importable (reference:
+        tracer/meter provider construction, telemetry.rs:85-130)."""
+        try:
+            from opentelemetry.exporter.otlp.proto.grpc.metric_exporter import (  # noqa: E501
+                OTLPMetricExporter)
+            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (  # noqa: E501
+                OTLPSpanExporter)
+            from opentelemetry.sdk.metrics import MeterProvider
+            from opentelemetry.sdk.metrics.export import (
+                PeriodicExportingMetricReader)
+            from opentelemetry.sdk.resources import Resource
+            from opentelemetry.sdk.trace import TracerProvider
+            from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+            resource = Resource.create({
+                "service.name": self.config.service_name,
+                "pathway.run_id": self.config.run_id or "",
+            })
+            provider = TracerProvider(resource=resource)
+            provider.add_span_processor(BatchSpanProcessor(
+                OTLPSpanExporter(endpoint=config.endpoint)))
+            self._provider = provider
+            reader = PeriodicExportingMetricReader(
+                OTLPMetricExporter(endpoint=config.endpoint))
+            self._meter_provider = MeterProvider(resource=resource,
+                                                 metric_readers=[reader])
+            return True
+        except ImportError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "telemetry endpoint %s configured but the OTel SDK/OTLP "
+                "exporter packages are not installed — instrumentation "
+                "stays no-op", config.endpoint)
+            return False
+
+    # -- spans -----------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        if self.tracer is None:
+            yield None
+            return
+        with self.tracer.start_as_current_span(name) as sp:
+            for k, v in attrs.items():
+                try:
+                    sp.set_attribute(k, v)
+                except Exception:
+                    pass
+            yield sp
+
+    # -- metrics ---------------------------------------------------------
+    def register_scheduler_gauges(self, scheduler, graph) -> None:
+        """Observable gauges over the scheduler's per-operator stats —
+        the analogue of the reference's input/output latency gauges
+        (telemetry.rs:312-366) plus process memory/CPU."""
+        if self.meter is None:
+            return
+
+        def observe_latency(options):
+            from opentelemetry.metrics import Observation
+
+            out = []
+            for node in graph.nodes:
+                st = scheduler.stats.get(node.id)
+                if st:
+                    out.append(Observation(
+                        st.get("latency_ms", 0.0),
+                        {"operator": node.name or str(node.id)}))
+            return out
+
+        def observe_counts(kind):
+            def observe(options):
+                from opentelemetry.metrics import Observation
+
+                return [
+                    Observation(scheduler.stats[n.id][kind],
+                                {"operator": n.name or str(n.id)})
+                    for n in graph.nodes if n.id in scheduler.stats
+                ]
+
+            return observe
+
+        def observe_memory(options):
+            from opentelemetry.metrics import Observation
+
+            import resource as _res
+
+            rss_kb = _res.getrusage(_res.RUSAGE_SELF).ru_maxrss
+            return [Observation(rss_kb * 1024)]
+
+        def observe_cpu(options):
+            from opentelemetry.metrics import Observation
+
+            return [Observation(time.process_time())]
+
+        m = self.meter
+        self._instruments["latency"] = m.create_observable_gauge(
+            "pathway.operator.latency_ms", callbacks=[observe_latency])
+        self._instruments["insertions"] = m.create_observable_counter(
+            "pathway.operator.insertions",
+            callbacks=[observe_counts("insertions")])
+        self._instruments["retractions"] = m.create_observable_counter(
+            "pathway.operator.retractions",
+            callbacks=[observe_counts("retractions")])
+        self._instruments["memory"] = m.create_observable_gauge(
+            "pathway.process.memory_bytes", callbacks=[observe_memory])
+        self._instruments["cpu"] = m.create_observable_gauge(
+            "pathway.process.cpu_seconds", callbacks=[observe_cpu])
+
+    def shutdown(self) -> None:
+        for p in (self._provider, self._meter_provider):
+            if p is not None:
+                try:
+                    p.shutdown()
+                except Exception:
+                    pass

@@ -118,8 +118,16 @@ class DsvParser:
             values[n] = _parse_typed(raw[n], dtypes.get(n))
         key = None
         if self.key_columns:
-            key = tuple(values.get(n, _parse_typed(raw[n], dtypes.get(n)))
-                        for n in self.key_columns)
+            key_vals = []
+            for n in self.key_columns:
+                if n in values:
+                    key_vals.append(values[n])
+                elif n in raw:
+                    key_vals.append(_parse_typed(raw[n], dtypes.get(n)))
+                else:
+                    raise ParseError(f"DSV key column {n!r} is not in "
+                                     "the header")
+            key = tuple(key_vals)
         return ParsedEvent(kind, key, values)
 
     def parse_lines(self, text: str) -> list[ParsedEvent]:

@@ -173,7 +173,9 @@ class WordPieceTokenizer:
                 continue
             if cp >= 0x80 or cp < 0x20 or cp == 0x7F:
                 cat = unicodedata.category(ch)
-                if cat == "Zs":
+                if cat in ("Zs", "Zl", "Zp"):
+                    # Zl/Zp: HF's whitespace_tokenize is str.split(),
+                    # which splits on line/paragraph separators too
                     flush()
                     continue
                 if cat.startswith("C"):

@@ -259,6 +259,27 @@ def test_wordcount_kill_and_recover(tmp_path):
                 break
             time.sleep(0.2)
         assert _read_counts(out) == expected
+
+        # SECOND kill/recover cycle (the reference harness kills several
+        # times, integration_tests/wordcount/test_recovery.py): crash the
+        # recovered process, add more input, recover again — exactly-once
+        # across repeated crashes
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        for i in range(n_files, n_files + 2):
+            words = [f"w{j % 3}" for j in range(per_file)]
+            (inp / f"{i:03d}.txt").write_text("\n".join(words) + "\n")
+            for w in words:
+                expected[w] = expected.get(w, 0) + 1
+        proc = subprocess.Popen(
+            [sys.executable, str(script), str(inp), pdir, out],
+            env=env, cwd="/root/repo")
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if _read_counts(out) == expected:
+                break
+            time.sleep(0.2)
+        assert _read_counts(out) == expected
     finally:
         if proc.poll() is None:
             proc.kill()

@@ -387,3 +387,68 @@ def test_deduplicate_acceptor_exception_logged():
     assert after > before
     assert any("boom" in e["message"]
                for e in pw.global_error_log().entries[before:])
+
+
+def test_gradual_broadcast_values_and_throttling():
+    """_gradual_broadcast (reference gradual_broadcast.rs): rows read
+    `upper` when key < (value-lower)/(upper-lower) of keyspace, else
+    `lower`; when the value moves, only keys between the old and new
+    thresholds re-emit."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals.runner import GraphRunner
+
+    class R(pw.Schema):
+        name: str
+
+    class T_(pw.Schema):
+        lo: float
+        val: float
+        hi: float
+
+    rows = table_from_rows(R, [(f"r{i}",) for i in range(40)])
+    # triplet stream: val starts at lo (nobody upgraded), then moves 40%
+    # of the way to hi at t=2, then to 50% at t=4 (a small nudge)
+    thr = table_from_rows(
+        T_, [(0.0, 0.0, 10.0, 0, 1),
+             (0.0, 0.0, 10.0, 2, -1), (0.0, 4.0, 10.0, 2, 1),
+             (0.0, 4.0, 10.0, 4, -1), (0.0, 5.0, 10.0, 4, 1)],
+        is_stream=True)
+    out = rows._gradual_broadcast(thr, thr.lo, thr.val, thr.hi)
+    runner = GraphRunner()
+    cap = runner.capture(out)
+    runner.run_batch()
+
+    state = cap.snapshot()
+    assert len(state) == 40
+    # final: keys in the lowest 50% of keyspace read hi, others lo
+    for key, row in state.items():
+        expected = 10.0 if int(key) < (1 << 127) else 0.0
+        assert row[-1] == expected, (key, row)
+    # throttling: the t=4 nudge (40% -> 50%) must re-emit only the keys
+    # inside the crossed 10% band, not all 40 rows
+    t4_retractions = [e for e in cap.events if e[2] == 4 and e[3] < 0]
+    frac = len(t4_retractions) / 40
+    assert 0 < len(t4_retractions) <= 8, len(t4_retractions)
+    # and at t=2 only ~40% flipped
+    t2 = [e for e in cap.events if e[2] == 2 and e[3] < 0]
+    assert 0 < len(t2) <= 24
+
+
+def test_gradual_broadcast_none_apx_still_retracts():
+    """A triplet containing None emits apx=None; deleting such a row must
+    still retract it (regression: None was conflated with 'never
+    emitted')."""
+    from pathway_tpu.engine.delta import Delta
+    from pathway_tpu.engine.operators import GradualBroadcastOperator
+    from pathway_tpu.internals.keys import hash_values
+
+    op = GradualBroadcastOperator()
+    k = hash_values("r")
+    out0 = op.step(0, [Delta([(k, ("x",), 1)]),
+                       Delta([(hash_values("t"), (None, None, None), 1)])])
+    assert [(key, row, d) for key, row, d in out0.entries] == [
+        (k, ("x", None), 1)]
+    out1 = op.step(1, [Delta([(k, ("x",), -1)]), Delta()])
+    assert [(key, row, d) for key, row, d in out1.entries] == [
+        (k, ("x", None), -1)]

@@ -1,0 +1,117 @@
+"""OTel telemetry (reference: src/engine/telemetry.rs:196-366 +
+graph_runner/telemetry.py spans): instrumentation flows through the OTel
+API — spans and observable gauges are exercised against an in-memory
+tracer/meter double, and pw.run stays correct with telemetry enabled and
+no SDK installed (no-op path)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.telemetry import Config, Telemetry
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_config_env_activation(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TELEMETRY_ENDPOINT", raising=False)
+    assert not Config.create().telemetry_enabled
+    monkeypatch.setenv("PATHWAY_TELEMETRY_ENDPOINT", "http://otlp:4317")
+    cfg = Config.create()
+    assert cfg.telemetry_enabled and cfg.endpoint == "http://otlp:4317"
+
+
+def test_spans_and_gauges_through_api_doubles(monkeypatch):
+    """Drive the instrumentation against recording tracer/meter doubles —
+    proves real attributes/observations flow through the OTel API."""
+    spans = []
+
+    class _Span:
+        def __init__(self, name):
+            self.name = name
+            self.attrs = {}
+
+        def set_attribute(self, k, v):
+            self.attrs[k] = v
+
+        def __enter__(self):
+            spans.append(self)
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    class _Tracer:
+        def start_as_current_span(self, name):
+            return _Span(name)
+
+    gauges = {}
+
+    class _Meter:
+        def create_observable_gauge(self, name, callbacks=None, **kw):
+            gauges[name] = callbacks
+            return name
+
+        def create_observable_counter(self, name, callbacks=None, **kw):
+            gauges[name] = callbacks
+            return name
+
+    tel = Telemetry(Config.create())
+    tel.tracer = _Tracer()
+    tel.meter = _Meter()
+    tel._instruments = {}
+
+    with tel.span("pathway.run", run_id="r1") as sp:
+        assert sp.name == "pathway.run" and sp.attrs["run_id"] == "r1"
+    assert [s.name for s in spans] == ["pathway.run"]
+
+    # wire gauges over a real scheduler after a real run
+    t = pw.debug.table_from_markdown("""
+    a | b
+    1 | 2
+    3 | 4
+    """)
+    agg = t.groupby(t.b).reduce(t.b, s=pw.reducers.sum(t.a))
+    from pathway_tpu.internals.runner import GraphRunner
+
+    runner = GraphRunner()
+    runner.capture(agg)
+    runner.run_batch()
+    tel.register_scheduler_gauges(runner._scheduler, runner.graph)
+    assert "pathway.operator.latency_ms" in gauges
+    obs = gauges["pathway.operator.insertions"][0](None)
+    assert sum(o.value for o in obs) > 0
+    mem = gauges["pathway.process.memory_bytes"][0](None)
+    assert mem[0].value > 1 << 20
+
+
+def test_run_with_telemetry_enabled_noop_sdk():
+    """pw.run(telemetry_config=...) with no SDK installed must work and
+    produce correct results (API no-op path)."""
+    t = pw.debug.table_from_markdown("""
+    x
+    1
+    2
+    """)
+    doubled = t.select(y=t.x * 2)
+    got = pw.debug.table_to_pandas(
+        doubled, include_id=False)["y"].tolist()
+    assert sorted(got) == [2, 4]
+    # and through pw.run with an output binder
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        pw.io.jsonlines.write(doubled, f"{d}/out.jsonl")
+        pw.run(telemetry_config=Config.create(telemetry_enabled=True))
+        import json
+
+        rows = [json.loads(line) for line in
+                open(f"{d}/out.jsonl").read().splitlines()]
+        assert sorted(r["y"] for r in rows) == [2, 4]

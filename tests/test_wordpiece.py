@@ -37,6 +37,9 @@ CASES = [
     "a b",       # narrow no-break space (French number grouping)
     "a\x1cb\x1db\x1eb\x1fb",  # ASCII separators Python isspace() accepts
     "a\x85b  c d　e",  # NEL + more unicode spaces
+    "fox\u2066over\u2069 dog",  # bidi isolates dropped, words fuse
+    "fox\u2028over\u2029dog",  # Zl/Zp split like str.split()
+    "a\u200bb \u00adc",  # zero-width space + soft hyphen dropped,
 ]
 
 
