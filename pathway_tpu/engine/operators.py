@@ -679,7 +679,12 @@ class GradualBroadcastOperator(Operator):
                 if diff > 0:
                     self.triplet = (row[0], row[1], row[2])
         if d_rows:
-            for key, row, diff in d_rows.entries:
+            # canonical order: retractions before insertions per key (same
+            # hazard GroupByOperator sorts for, operators.py:332 — an
+            # update pair may arrive insert-first after exchange merging)
+            for key, row, diff in sorted(
+                    d_rows.entries,
+                    key=lambda e: (int(e[0]), e[2], row_fingerprint(e[1]))):
                 ik = int(key)
                 if diff > 0:
                     if key not in self.rows:

@@ -125,16 +125,27 @@ class Telemetry:
     def register_scheduler_gauges(self, scheduler, graph) -> None:
         """Observable gauges over the scheduler's per-operator stats —
         the analogue of the reference's input/output latency gauges
-        (telemetry.rs:312-366) plus process memory/CPU."""
+        (telemetry.rs:312-366) plus process memory/CPU.
+
+        The OTel API has no instrument unregistration, so callbacks read
+        through ``self._gauge_state``, which ``shutdown()`` clears — after
+        the run they return nothing and hold no reference to the dead
+        scheduler/graph (relevant in global-SDK mode, where the meter
+        outlives the run)."""
         if self.meter is None:
             return
+        self._gauge_state = {"scheduler": scheduler, "graph": graph}
+        state = self._gauge_state
 
         def observe_latency(options):
             from opentelemetry.metrics import Observation
 
+            sched, g = state.get("scheduler"), state.get("graph")
+            if sched is None:
+                return []
             out = []
-            for node in graph.nodes:
-                st = scheduler.stats.get(node.id)
+            for node in g.nodes:
+                st = sched.stats.get(node.id)
                 if st:
                     out.append(Observation(
                         st.get("latency_ms", 0.0),
@@ -145,10 +156,13 @@ class Telemetry:
             def observe(options):
                 from opentelemetry.metrics import Observation
 
+                sched, g = state.get("scheduler"), state.get("graph")
+                if sched is None:
+                    return []
                 return [
-                    Observation(scheduler.stats[n.id][kind],
+                    Observation(sched.stats[n.id][kind],
                                 {"operator": n.name or str(n.id)})
-                    for n in graph.nodes if n.id in scheduler.stats
+                    for n in g.nodes if n.id in sched.stats
                 ]
 
             return observe
@@ -181,6 +195,8 @@ class Telemetry:
             "pathway.process.cpu_seconds", callbacks=[observe_cpu])
 
     def shutdown(self) -> None:
+        if getattr(self, "_gauge_state", None):
+            self._gauge_state.clear()  # disarm global-meter callbacks
         for p in (self._provider, self._meter_provider):
             if p is not None:
                 try:

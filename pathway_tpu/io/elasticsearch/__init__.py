@@ -1,5 +1,90 @@
-"""pw.io.elasticsearch (reference: python/pathway/io/elasticsearch). Gated: needs elasticsearch."""
+"""pw.io.elasticsearch — Elasticsearch sink (reference:
+python/pathway/io/elasticsearch + ElasticSearchWriter,
+src/connectors/data_storage.rs:2238). Documents are posted through the
+plain REST bulk API over requests (in-image) — no elasticsearch client
+package needed; auth via basic credentials or api key.
+"""
 
-from pathway_tpu.io._gated import gated
+from __future__ import annotations
 
-read, write = gated("elasticsearch", "elasticsearch")
+import json as _json
+from dataclasses import dataclass
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+@dataclass
+class ElasticSearchAuth:
+    kind: str = "none"
+    username: str | None = None
+    password: str | None = None
+    api_key: str | None = None
+
+    @classmethod
+    def basic(cls, username: str, password: str) -> "ElasticSearchAuth":
+        return cls("basic", username=username, password=password)
+
+    @classmethod
+    def apikey(cls, api_key: str) -> "ElasticSearchAuth":
+        return cls("apikey", api_key=api_key)
+
+    def headers(self) -> dict:
+        h = {"Content-Type": "application/x-ndjson"}
+        if self.kind == "apikey" and self.api_key:
+            h["Authorization"] = f"ApiKey {self.api_key}"
+        return h
+
+    def requests_auth(self):
+        if self.kind == "basic":
+            return (self.username, self.password)
+        return None
+
+
+def write(table: Table, host: str, auth: ElasticSearchAuth | None = None,
+          index_name: str = "pathway", *, max_batch_size: int | None = None,
+          name: str | None = None, **kwargs) -> None:
+    """Index the table's update stream: insertions index documents (with
+    time/diff fields), deletions index the retraction record — matching
+    the reference writer's append-only document stream."""
+    import requests
+
+    auth = auth or ElasticSearchAuth()
+    names = table.column_names()
+    url = host.rstrip("/") + "/_bulk"
+
+    def binder(runner):
+        session = requests.Session()
+
+        batch_docs = max_batch_size or 10_000  # bound each _bulk body
+
+        def callback(time, delta):
+            lines = []
+
+            def flush():
+                if not lines:
+                    return
+                resp = session.post(url, data="\n".join(lines) + "\n",
+                                    headers=auth.headers(),
+                                    auth=auth.requests_auth(), timeout=30)
+                resp.raise_for_status()
+                lines.clear()
+
+            for key, row, diff in delta.entries:
+                doc = dict(zip(names, row))
+                doc.update({"time": time, "diff": diff})
+                lines.append(_json.dumps({"index": {"_index": index_name}}))
+                lines.append(_json.dumps(doc, default=str))
+                if len(lines) >= 2 * batch_docs:
+                    flush()
+            flush()
+
+        runner.subscribe(table, callback)
+
+    G.add_output(binder)
+
+
+def read(*args, **kwargs):
+    raise NotImplementedError(
+        "pw.io.elasticsearch is sink-only, matching the reference "
+        "(ElasticSearchWriter exists; no reader in data_storage.rs)")

@@ -61,6 +61,10 @@ def _parse_file(fpath: Path, format: str, schema, with_metadata: bool,
 
         parser = DsvParser(separator=dsv_separator, schema=schema)
         rows = [ev.values for ev in parser.parse_lines(fpath.read_text())]
+    elif format == "parquet":
+        import pyarrow.parquet as pq
+
+        rows = pq.read_table(str(fpath)).to_pylist()
     elif format in ("json", "jsonlines"):
         rows = []
         for line in fpath.read_text().splitlines():
@@ -237,10 +241,35 @@ def read(path: str, *, format: str = "plaintext", schema=None,
 
 def write(table: Table, filename: str, *, format: str = "json", name=None,
           **kwargs) -> None:
-    """Append diffs to a file as CSV or JSONLines with time/diff columns
-    (reference FileWriter output format)."""
+    """Append diffs to a file as CSV / JSONLines / Parquet with time/diff
+    columns (reference FileWriter output format; parquet matching the
+    DeltaTableWriter's columnar sink, data_storage.rs:2687)."""
     names = table.column_names()
     path = filename
+
+    if format == "parquet":
+        def binder(runner):
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            batches: list[dict] = []
+
+            def callback(time, delta):
+                for key, row, diff in delta.entries:
+                    rec = dict(zip(names, row))
+                    rec["time"] = time
+                    rec["diff"] = diff
+                    batches.append(rec)
+                # parquet is not appendable: rewrite the file per commit
+                # (small sinks; larger ones want the delta-table layout)
+                pq.write_table(pa.Table.from_pylist(batches), path)
+
+            runner.subscribe(table, callback)
+
+        G.add_output(binder)
+        return
 
     def binder(runner):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

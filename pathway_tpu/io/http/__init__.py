@@ -284,10 +284,19 @@ def read(url: str, *, schema=None, format: str = "json",
 
 
 def write(table: Table, url: str, *, method: str = "POST", format: str = "json",
-          name=None, **kwargs) -> None:
+          name=None, n_retries: int = 0, retry_delay_s: float = 0.5,
+          request_timeout_ms: int | None = None, **kwargs) -> None:
+    """POST each diff as flat JSON with time/diff fields. Failures retry
+    ``n_retries`` times with exponential backoff (the reference's output
+    writer retry loop, src/retry.rs + OUTPUT_RETRIES, dataflow.rs:133)
+    and are LOGGED on final failure — never silently dropped."""
+    import logging
+    import time as _time
     import urllib.request
 
     names = table.column_names()
+    timeout = (request_timeout_ms / 1000.0) if request_timeout_ms else 10.0
+    log = logging.getLogger(__name__)
 
     def binder(runner):
         def callback(time, delta):
@@ -298,10 +307,17 @@ def write(table: Table, url: str, *, method: str = "POST", format: str = "json",
                     url, data=_json.dumps(_jsonable(rec)).encode(),
                     method=method,
                     headers={"Content-Type": "application/json"})
-                try:
-                    urllib.request.urlopen(req, timeout=10)
-                except Exception:
-                    pass
+                for attempt in range(n_retries + 1):
+                    try:
+                        urllib.request.urlopen(req, timeout=timeout)
+                        break
+                    except Exception as e:
+                        if attempt == n_retries:
+                            log.error(
+                                "http sink %s: delivery failed after %d "
+                                "attempt(s): %s", url, attempt + 1, e)
+                        else:
+                            _time.sleep(retry_delay_s * (2 ** attempt))
 
         runner.subscribe(table, callback)
 

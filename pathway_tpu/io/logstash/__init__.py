@@ -1,5 +1,25 @@
-"""pw.io.logstash (reference: python/pathway/io/logstash). Gated: needs an HTTP sink endpoint."""
+"""pw.io.logstash — Logstash HTTP-input sink (reference:
+python/pathway/io/logstash/__init__.py — a thin delegation to
+pw.io.http.write: flat JSON objects with time/diff fields)."""
 
-from pathway_tpu.io._gated import gated
+from __future__ import annotations
 
-read, write = gated("logstash", "an HTTP sink endpoint")
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io.http import write as _http_write
+
+
+def write(table: Table, endpoint: str, n_retries: int = 0,
+          retry_policy=None, connect_timeout_ms: int | None = None,
+          request_timeout_ms: int | None = None, **kwargs) -> None:
+    """Send the table's update stream to a Logstash HTTP input (retries
+    with backoff via the shared HTTP sink; connect_timeout folds into the
+    request timeout — urllib exposes a single deadline)."""
+    timeout = request_timeout_ms or connect_timeout_ms
+    _http_write(table, endpoint, method="POST", format="json",
+                n_retries=n_retries, request_timeout_ms=timeout, **kwargs)
+
+
+def read(*args, **kwargs):
+    raise NotImplementedError(
+        "pw.io.logstash is sink-only, matching the reference "
+        "(python/pathway/io/logstash has no reader)")

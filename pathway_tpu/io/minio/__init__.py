@@ -1,5 +1,43 @@
-"""pw.io.minio (reference: python/pathway/io/minio). Gated: needs boto3."""
+"""pw.io.minio — MinIO connector (reference: python/pathway/io/minio).
+MinIO speaks the S3 protocol with a custom endpoint: ``MinIOSettings``
+converts to ``AwsS3Settings`` and routes through pw.io.s3, exactly the
+reference's delegation."""
 
-from pathway_tpu.io._gated import gated
+from __future__ import annotations
 
-read, write = gated("minio", "boto3")
+from dataclasses import dataclass
+
+from pathway_tpu.io import s3 as _s3
+
+
+@dataclass
+class MinIOSettings:
+    endpoint: str
+    bucket_name: str
+    access_key: str
+    secret_access_key: str
+    with_path_style: bool = True
+    region: str | None = None
+
+    def create_aws_settings(self) -> "_s3.AwsS3Settings":
+        endpoint = self.endpoint
+        if endpoint and "://" not in endpoint:
+            endpoint = "https://" + endpoint
+        return _s3.AwsS3Settings(
+            bucket_name=self.bucket_name,
+            access_key=self.access_key,
+            secret_access_key=self.secret_access_key,
+            region=self.region,
+            endpoint=endpoint,
+            with_path_style=self.with_path_style,
+        )
+
+
+def read(path: str, minio_settings: MinIOSettings, **kwargs):
+    return _s3.read(path,
+                    aws_s3_settings=minio_settings.create_aws_settings(),
+                    **kwargs)
+
+
+def write(*args, **kwargs):
+    return _s3.write(*args, **kwargs)

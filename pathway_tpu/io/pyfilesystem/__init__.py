@@ -1,5 +1,181 @@
-"""pw.io.pyfilesystem (reference: python/pathway/io/pyfilesystem). Gated: needs fs."""
+"""pw.io.pyfilesystem — virtual-filesystem connector.
 
-from pathway_tpu.io._gated import gated
+Reference: python/pathway/io/pyfilesystem/__init__.py:142 — reads every
+file under a path of a PyFilesystem ``FS`` object as one binary ``data``
+row (+ optional ``_metadata``), polling for changes in streaming mode.
 
-read, write = gated("pyfilesystem", "fs")
+This build accepts EITHER a PyFilesystem ``FS`` (when the ``fs`` package
+is installed) or an **fsspec** filesystem / URL (fsspec ships in-image:
+``"file:///tmp/dir"``, ``"memory://"``, ``s3://...`` with s3fs, ...), so
+the connector is live without extra dependencies.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import hash_values
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._datasource import DataSource, Session
+
+
+class _FsspecAdapter:
+    """Uniform listing/reading over fsspec filesystems and URLs."""
+
+    def __init__(self, source: Any, path: str):
+        import fsspec
+
+        if isinstance(source, str):
+            self.fs, root = fsspec.core.url_to_fs(source)
+            self.root = root.rstrip("/")
+        else:
+            self.fs = source
+            self.root = path.rstrip("/")
+        if path and isinstance(source, str):
+            self.root = (self.root + "/" + path.strip("/")).rstrip("/")
+
+    def list_files(self) -> list[tuple[str, float, int]]:
+        """→ [(path, mtime, size)] sorted; best-effort mtime (some
+        filesystems, e.g. memory://, do not track it)."""
+        out = []
+        try:
+            entries = self.fs.find(self.root or "/", withdirs=False,
+                                   detail=True)
+        except FileNotFoundError:
+            return []
+        for p, info in sorted(entries.items()):
+            mtime = info.get("mtime") or info.get("LastModified") or 0
+            try:
+                mtime = float(
+                    mtime.timestamp() if hasattr(mtime, "timestamp")
+                    else mtime)
+            except Exception:
+                mtime = 0.0
+            out.append((p, mtime, int(info.get("size") or 0)))
+        return out
+
+    def read_bytes(self, path: str) -> bytes:
+        with self.fs.open(path, "rb") as f:
+            return f.read()
+
+
+class _PyFilesystemAdapter:
+    """Adapter for a PyFilesystem ``FS`` object (reference's native
+    source type) — used when the ``fs`` package is installed."""
+
+    def __init__(self, source: Any, path: str):
+        self.fs = source
+        self.root = "/" + path.strip("/") if path else "/"
+
+    def list_files(self) -> list[tuple[str, float, int]]:
+        out = []
+        for p in sorted(self.fs.walk.files(self.root)):
+            info = self.fs.getinfo(p, namespaces=["details"])
+            mtime = info.modified.timestamp() if info.modified else 0.0
+            out.append((p, mtime, info.size or 0))
+        return out
+
+    def read_bytes(self, path: str) -> bytes:
+        return self.fs.readbytes(path)
+
+
+def _adapter_for(source: Any, path: str):
+    try:
+        from fs.base import FS  # type: ignore
+
+        if isinstance(source, FS):
+            return _PyFilesystemAdapter(source, path)
+    except ImportError:
+        pass
+    return _FsspecAdapter(source, path)
+
+
+class PyFilesystemSource(DataSource):
+    name = "pyfilesystem"
+
+    def __init__(self, source: Any, path: str, schema, mode: str,
+                 with_metadata: bool, refresh_interval: float,
+                 autocommit_duration_ms=1500):
+        super().__init__(schema, autocommit_duration_ms)
+        self.adapter = _adapter_for(source, path)
+        self.mode = mode
+        self.with_metadata = with_metadata
+        self.refresh_interval = refresh_interval
+
+    def _row_of(self, path: str, mtime: float, size: int):
+        data = self.adapter.read_bytes(path)
+        values: dict[str, Any] = {"data": data}
+        if self.with_metadata:
+            values["_metadata"] = Json({
+                "path": path, "size": size, "modified_at": int(mtime),
+                "seen_at": int(_time.time()),
+            })
+        key = hash_values("pyfilesystem", path)
+        return key, values
+
+    def run(self, session: Session) -> None:
+        seen: dict[str, float] = {}
+        emitted: dict[str, tuple] = {}
+        while True:
+            for path, mtime, size in self.adapter.list_files():
+                if seen.get(path) == mtime and path in emitted:
+                    continue
+                key, values = self._row_of(path, mtime, size)
+                _, row = self.row_to_engine(values, 0)
+                if path in emitted:
+                    session.push(key, emitted[path], -1)
+                session.push(key, row, 1)
+                emitted[path] = row
+                seen[path] = mtime
+            if self.mode != "streaming":
+                return
+            _time.sleep(self.refresh_interval)
+
+
+def read(source: Any, *, path: str = "", refresh_interval: float = 30,
+         mode: str = "streaming", with_metadata: bool = False,
+         name: str | None = None, persistent_id: str | None = None,
+         autocommit_duration_ms: int | None = 1500) -> Table:
+    """Each file under ``path`` becomes one binary ``data`` row."""
+    schema = sch.schema_from_types(data=dt.BYTES)
+    if with_metadata:
+        schema = schema | sch.schema_from_types(_metadata=dt.JSON)
+    src = PyFilesystemSource(source, path, schema, mode, with_metadata,
+                             refresh_interval,
+                             autocommit_duration_ms=autocommit_duration_ms)
+    src.persistent_id = persistent_id or name
+    if mode == "static":
+        keys, rows = [], []
+
+        class _Collect:
+            closed = False
+
+            def push(self, key, row, diff=1, offset=None):
+                if diff > 0:
+                    keys.append(key)
+                    rows.append(row)
+                else:
+                    try:
+                        i = keys.index(key)
+                        keys.pop(i)
+                        rows.pop(i)
+                    except ValueError:
+                        pass
+
+        src.run(_Collect())
+        plan = Plan("static", keys=keys, rows=rows, times=None, diffs=None)
+        return Table(plan, schema, Universe(),
+                     name=name or "pyfilesystem_static")
+    return Table(Plan("input", datasource=src), schema, Universe(),
+                 name=name or "pyfilesystem")
+
+
+def write(*args, **kwargs):
+    raise NotImplementedError(
+        "pw.io.pyfilesystem is read-only, matching the reference "
+        "(python/pathway/io/pyfilesystem has no writer)")

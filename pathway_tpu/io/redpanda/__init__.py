@@ -1,5 +1,15 @@
-"""pw.io.redpanda (reference: python/pathway/io/redpanda). Gated: needs kafka-python."""
+"""pw.io.redpanda — Redpanda connector (reference:
+python/pathway/io/redpanda/__init__.py — Redpanda is Kafka-API-compatible,
+so read/write delegate to pw.io.kafka verbatim)."""
 
-from pathway_tpu.io._gated import gated
+from __future__ import annotations
 
-read, write = gated("redpanda", "kafka-python")
+from pathway_tpu.io import kafka as _kafka
+
+
+def read(rdkafka_settings: dict, topic=None, **kwargs):
+    return _kafka.read(rdkafka_settings, topic, **kwargs)
+
+
+def write(table, rdkafka_settings: dict, topic_name: str, **kwargs):
+    return _kafka.write(table, rdkafka_settings, topic_name, **kwargs)

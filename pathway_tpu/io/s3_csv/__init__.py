@@ -1,5 +1,46 @@
-"""pw.io.s3_csv (reference: python/pathway/io/s3_csv). Gated: needs boto3."""
+"""pw.io.s3_csv — CSV-over-S3 (reference: python/pathway/io/s3_csv +
+S3CsvReader, src/connectors/data_storage.rs:1973). Delegates to pw.io.s3
+for object access (fsspec; activates with s3fs) and parses rows with the
+shared DSV layer."""
 
-from pathway_tpu.io._gated import gated
+from __future__ import annotations
 
-read, write = gated("s3_csv", "boto3")
+from pathway_tpu.io import s3 as _s3
+
+
+def read(path: str, *, aws_s3_settings=None, schema=None,
+         mode: str = "streaming", csv_settings=None, **kwargs):
+    if schema is None:
+        raise ValueError(
+            "pw.io.s3_csv.read requires schema= (column names/types for "
+            "the CSV rows)")
+    raw = _s3.read(path, aws_s3_settings=aws_s3_settings, format="binary",
+                   mode=mode, **kwargs)
+    # parse each object's bytes into typed rows via the DSV layer
+    import pathway_tpu as pw
+    from pathway_tpu.io.formats import DsvParser
+
+    sep = ","
+    if csv_settings is not None:
+        sep = getattr(csv_settings, "delimiter", ",") or ","
+
+    names = schema.column_names() if schema is not None else None
+
+    def parse(blob: bytes) -> tuple:
+        parser = DsvParser(separator=sep, schema=schema,
+                           value_columns=names)
+        events = parser.parse_lines(blob.decode("utf-8", "replace"))
+        return tuple(tuple(ev.values[n] for n in (names or ev.values))
+                     for ev in events)
+
+    rows = raw.select(_pw_rows=pw.apply(parse, raw.data))
+    flat = rows.flatten(rows._pw_rows)
+    out_names = names or []
+    return flat.select(**{
+        n: pw.apply(lambda r, _i=i: r[_i], flat._pw_rows)
+        for i, n in enumerate(out_names)
+    })
+
+
+def write(*args, **kwargs):
+    return _s3.write(*args, **kwargs)

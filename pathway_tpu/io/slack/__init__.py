@@ -1,5 +1,40 @@
-"""pw.io.slack (reference: python/pathway/io/slack). Gated: needs slack-sdk."""
+"""pw.io.slack — Slack alert sink (reference:
+python/pathway/io/slack/__init__.py:11 send_alerts — each row of the
+column becomes one chat.postMessage call). Plain HTTPS via requests
+(in-image); no slack-sdk needed."""
 
-from pathway_tpu.io._gated import gated
+from __future__ import annotations
 
-read, write = gated("slack", "slack-sdk")
+from pathway_tpu.internals.expression import ColumnReference
+from pathway_tpu.io._subscribe import subscribe
+
+
+def send_alerts(alerts: ColumnReference, slack_channel_id: str,
+                slack_token: str) -> None:
+    """Send every row of ``alerts`` as a message to a Slack channel."""
+    import requests
+
+    table = alerts.table
+    col = alerts.name
+
+    def on_change(key, row, time, is_addition):
+        if not is_addition:
+            return
+        requests.post(
+            "https://slack.com/api/chat.postMessage",
+            headers={"Authorization": f"Bearer {slack_token}"},
+            json={"channel": slack_channel_id, "text": str(row[col])},
+        ).raise_for_status()
+
+    subscribe(table, on_change=on_change)
+
+
+# reference exposes only send_alerts; read/write aliases for discoverability
+def write(table, slack_channel_id: str, slack_token: str, *,
+          column: str = "message", **kwargs) -> None:
+    send_alerts(table[column], slack_channel_id, slack_token)
+
+
+def read(*args, **kwargs):
+    raise NotImplementedError(
+        "pw.io.slack is sink-only, matching the reference")
