@@ -149,6 +149,13 @@ def main() -> None:
     sustained = batch_times[1:]  # drop the warmup-straddling first batch
     docs_per_sec = BATCH * len(sustained) / float(np.sum(sustained))
 
+    # free the embed leg's device state (slab + donated buffers) before the
+    # 10M KNN leg claims most of HBM
+    del index, ingest
+    import gc
+
+    gc.collect()
+
     etl = {} if "etl" in SKIP else bench_etl()
     knn = {} if "knn" in SKIP else bench_knn()
 
@@ -252,10 +259,12 @@ def bench_knn() -> dict:
     through this environment's dispatch path, with the measured dispatch
     floor reported next to them.
     """
-    import ml_dtypes
 
     from pathway_tpu.internals.keys import Pointer
     from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    import jax
+    import jax.numpy as jnp
 
     n = KNN_N
     while True:
@@ -265,18 +274,19 @@ def bench_knn() -> dict:
                                        dtype="bfloat16")
             rng = np.random.default_rng(0)
             ingest_start = time.perf_counter()
-            chunk = 1 << 19
-            # one bf16 pool reused for every chunk: value distribution is
-            # irrelevant for a latency bench, and host-side RNG + f32→bf16
-            # casting at 10M x 384 would dominate the bench's wall time
-            pool = (rng.random((chunk, KNN_DIM), dtype=np.float32) * 2.0
-                    - 1.0).astype(ml_dtypes.bfloat16)
-            for base in range(0, n, chunk):
+            chunk = min(1 << 19, n)
+            # ingest through the DEVICE path (the production embed+index
+            # route: vectors are born on-chip): per-chunk on-device RNG +
+            # add_batch_device scatter — no 7.7 GB host→device transfer,
+            # which would dominate wall time through a dev tunnel
+            gen = jax.jit(
+                lambda key: jax.random.uniform(
+                    key, (chunk, KNN_DIM), jnp.bfloat16, -1.0, 1.0))
+            for ci, base in enumerate(range(0, n, chunk)):
                 m = min(chunk, n - base)
-                index.add_batch([Pointer(base + i) for i in range(m)],
-                                pool[:m])
-                # async per-chunk upload overlaps the next chunk's host work
-                index.flush_device()
+                vecs = gen(jax.random.PRNGKey(ci))
+                index.add_batch_device(
+                    [Pointer(base + i) for i in range(m)], vecs[:m])
             queries = rng.random((64, KNN_DIM), dtype=np.float32) * 2.0 - 1.0
 
             def run(batch, k=10):
@@ -311,9 +321,13 @@ def bench_knn() -> dict:
                 "knn_ingest_s": round(ingest_s, 1),
             }
         except (RuntimeError, MemoryError) as e:
-            # HBM too small for this slab — release the failed attempt's
-            # device slab BEFORE retrying, then halve
-            index = None  # noqa: F841
+            # HBM too small for this slab — release EVERYTHING the failed
+            # attempt pinned on device (slab, chunk buffer, jitted gen)
+            # before retrying, then halve
+            index = vecs = gen = None  # noqa: F841
+            import gc
+
+            gc.collect()
             if n <= 1 << 20:
                 return {"knn_error": str(e)[:200]}
             n //= 2

@@ -29,10 +29,15 @@ def default_brute_force_knn_document_index(
         data_column: ex.ColumnReference, data_table: Table, *,
         embedder: Any = None, dimensions: int | None = None,
         reserved_space: int = 1024, metric: KnnMetric = KnnMetric.COS,
-        metadata_column: ex.ColumnExpression | None = None) -> DataIndex:
+        metadata_column: ex.ColumnExpression | None = None,
+        mesh: Any = None, dtype: str = "float32") -> DataIndex:
+    """``mesh='auto'`` shards the slab over the device mesh's data axis
+    (ICI top-k merge) when more than one device is visible; ``dtype=
+    'bfloat16'`` halves slab bytes and scan time on one chip."""
     inner = BruteForceKnn(
         data_column, metadata_column, dimensions=dimensions,
-        reserved_space=reserved_space, metric=metric, embedder=embedder)
+        reserved_space=reserved_space, metric=metric, embedder=embedder,
+        mesh=mesh, dtype=dtype)
     return DataIndex(data_table, inner)
 
 

@@ -128,3 +128,62 @@ def test_ring_attention_on_submesh(mesh42):
     got = ring_attention(q, k, v, mesh=mesh42)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_document_index_mesh_sharded_end_to_end():
+    """default_brute_force_knn_document_index(mesh='auto') builds the
+    mesh-sharded index and serves correct as-of-now queries through the
+    engine (VERDICT weak #10: the index now scales over devices, the
+    TPU-native axis, instead of gathering everything onto one worker)."""
+    import numpy as np
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+    from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex
+    from pathway_tpu.stdlib.indexing import (
+        default_brute_force_knn_document_index)
+
+    G.clear()
+    try:
+        rng = np.random.default_rng(0)
+        vecs = rng.normal(size=(32, 8)).astype(np.float32)
+
+        class D(pw.Schema):
+            doc: str
+
+        docs = pw.debug.table_from_rows(D, [(f"d{i}",) for i in range(32)])
+        data = docs.select(
+            doc=docs.doc,
+            vec=pw.apply(lambda d: vecs[int(d[1:])], docs.doc))
+        index = default_brute_force_knn_document_index(
+            data.vec, data, dimensions=8, mesh="auto")
+        # the factory must have chosen the sharded index on the 8-device
+        # CPU test mesh
+        built = index.inner_index.factory().build()
+        assert isinstance(built, ShardedKnnIndex)
+        assert built.n_shards > 1
+
+        class Q(pw.Schema):
+            qvec: str
+
+        queries = pw.debug.table_from_rows(Q, [("7",), ("19",)])
+        qv = queries.select(
+            v=pw.apply(lambda i: vecs[int(i)], queries.qvec))
+        hits = index.query_as_of_now(qv.v, number_of_matches=1)
+        res = qv.select(
+            q=queries.restrict(qv).qvec,
+            hit=pw.apply(lambda t: t[0] if t else None,
+                         hits._pw_index_reply_id))
+        runner = GraphRunner()
+        cap = runner.capture(res)
+        data_cap = runner.capture(data)
+        runner.run_batch()
+        # the hit must be EXACTLY the matching corpus row's key: queries
+        # are vecs[7]/vecs[19], both present verbatim in the index —
+        # catches cross-shard slot-globalization bugs, not just liveness
+        doc_key = {row[0]: key for key, row in data_cap.snapshot().items()}
+        got = {row[0]: row[1] for row in cap.snapshot().values()}
+        assert got == {"7": doc_key["d7"], "19": doc_key["d19"]}
+    finally:
+        G.clear()
