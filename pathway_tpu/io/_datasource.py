@@ -88,6 +88,26 @@ class DataSource:
         return key, row
 
 
+class CollectSession:
+    """Session double folding pushed diffs into final state — shared by
+    connectors' static modes (debezium, deltalake, pyfilesystem)."""
+
+    closed = False
+
+    def __init__(self):
+        self.state: dict = {}
+        self.counts: dict = {}
+
+    def push(self, key, row, diff=1, offset=None):
+        c = self.counts.get(key, 0) + diff
+        self.counts[key] = c
+        if c > 0:
+            self.state[key] = row
+        else:
+            self.state.pop(key, None)
+            self.counts.pop(key, None)
+
+
 class CallbackSource(DataSource):
     """Wraps a generator function yielding dict rows."""
 

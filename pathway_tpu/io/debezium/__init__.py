@@ -115,23 +115,7 @@ class DebeziumFileSource(DataSource):
             _time.sleep(0.5)
 
 
-class _CollectSession:
-    """Minimal Session double: folds pushed diffs into final state."""
-
-    closed = False
-
-    def __init__(self):
-        self.state: dict = {}
-        self.counts: dict = {}
-
-    def push(self, key, row, diff=1, offset=None):
-        c = self.counts.get(key, 0) + diff
-        self.counts[key] = c
-        if c > 0:
-            self.state[key] = row
-        else:
-            self.state.pop(key, None)
-            self.counts.pop(key, None)
+from pathway_tpu.io._datasource import CollectSession as _CollectSession
 
 
 def read_from_file(path: str, *, schema, db_type: str = "postgres",

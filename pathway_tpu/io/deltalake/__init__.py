@@ -1,5 +1,235 @@
-"""pw.io.deltalake (reference: python/pathway/io/deltalake). Gated: needs deltalake."""
+"""pw.io.deltalake — Delta Lake table connector.
 
-from pathway_tpu.io._gated import gated
+Reference: python/pathway/io/deltalake + DeltaTableReader/Writer
+(src/connectors/data_storage.rs:2978,2687 — the delta-rs crate). The Delta
+transaction protocol is an ordered ``_delta_log/NNNNNNNNNNNNNNNNNNNN.json``
+of actions over parquet part files, so this build implements the subset the
+reference exercises **dependency-free** with pyarrow (in-image):
 
-read, write = gated("deltalake", "deltalake")
+- ``write``: per commit, a parquet part + a log entry with add actions
+  (protocol/metaData in version 0), rows carrying time/diff columns — the
+  reference's append-only change-stream layout;
+- ``read``: replays the log (add/remove file actions), reads live parts,
+  and in streaming mode polls for new versions — each new version's rows
+  stream incrementally.
+
+The ``deltalake`` package is NOT required; tables written here are readable
+by delta-rs and vice versa for this action subset.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+import time as _time
+import uuid
+from pathlib import Path
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._datasource import DataSource, Session
+
+_LOG_DIR = "_delta_log"
+
+
+def _log_path(root: str, version: int) -> str:
+    return os.path.join(root, _LOG_DIR, f"{version:020d}.json")
+
+
+def _list_versions(root: str) -> list[int]:
+    d = Path(root) / _LOG_DIR
+    if not d.is_dir():
+        return []
+    out = []
+    for f in d.iterdir():
+        if f.suffix == ".json" and f.stem.isdigit():
+            out.append(int(f.stem))
+    return sorted(out)
+
+
+def _arrow_schema_to_delta(schema) -> str:
+    """pyarrow schema → Delta schemaString (JSON struct type)."""
+    import pyarrow as pa
+
+    def field_type(t):
+        if pa.types.is_integer(t):
+            return "long"
+        if pa.types.is_floating(t):
+            return "double"
+        if pa.types.is_boolean(t):
+            return "boolean"
+        if pa.types.is_binary(t):
+            return "binary"
+        return "string"
+
+    fields = [{"name": f.name, "type": field_type(f.type),
+               "nullable": True, "metadata": {}} for f in schema]
+    return _json.dumps({"type": "struct", "fields": fields})
+
+
+def write(table: Table, uri: str, *, partition_columns=None,
+          min_commit_frequency: int | None = None,
+          name: str | None = None, **kwargs) -> None:
+    """Stream the table's diffs into a Delta table (time/diff columns
+    appended, reference DeltaTableWriter layout)."""
+    names = table.column_names()
+    root = uri
+
+    def binder(runner):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(os.path.join(root, _LOG_DIR), exist_ok=True)
+        state = {"version": (max(_list_versions(root), default=-1) + 1)}
+
+        def commit(actions: list[dict]) -> None:
+            # put-if-absent, as the Delta protocol requires: exclusive
+            # create; on collision with a concurrent writer, re-scan and
+            # take the next version number
+            while True:
+                path = _log_path(root, state["version"])
+                try:
+                    with open(path, "x") as f:
+                        for a in actions:
+                            f.write(_json.dumps(a) + "\n")
+                    break
+                except FileExistsError:
+                    state["version"] = max(_list_versions(root),
+                                           default=-1) + 1
+            state["version"] += 1
+
+        def callback(time, delta):
+            if not delta.entries:
+                return
+            rows = []
+            for key, row, diff in delta.entries:
+                rec = dict(zip(names, row))
+                rec.update({"time": time, "diff": diff})
+                rows.append(rec)
+            tbl = pa.Table.from_pylist(rows)
+            part = f"part-{state['version']:05d}-{uuid.uuid4().hex}.parquet"
+            pq.write_table(tbl, os.path.join(root, part))
+            actions = []
+            if state["version"] == 0:
+                actions.append({"protocol": {
+                    "minReaderVersion": 1, "minWriterVersion": 2}})
+                actions.append({"metaData": {
+                    "id": uuid.uuid4().hex,
+                    "format": {"provider": "parquet", "options": {}},
+                    "schemaString": _arrow_schema_to_delta(tbl.schema),
+                    "partitionColumns": partition_columns or [],
+                    "configuration": {},
+                    "createdTime": int(_time.time() * 1000)}})
+            actions.append({"commitInfo": {
+                "timestamp": int(_time.time() * 1000),
+                "operation": "WRITE"}})
+            actions.append({"add": {
+                "path": part,
+                "size": os.path.getsize(os.path.join(root, part)),
+                "partitionValues": {}, "dataChange": True,
+                "modificationTime": int(_time.time() * 1000)}})
+            commit(actions)
+
+        runner.subscribe(table, callback)
+
+    G.add_output(binder)
+
+
+class DeltaLakeSource(DataSource):
+    name = "deltalake"
+
+    def __init__(self, uri: str, schema, mode: str,
+                 autocommit_duration_ms=1500):
+        super().__init__(schema, autocommit_duration_ms)
+        self.uri = uri
+        self.mode = mode
+
+    def _actions_of_version(self, version: int) -> list[dict]:
+        with open(_log_path(self.uri, version)) as f:
+            return [_json.loads(line) for line in f if line.strip()]
+
+    def run(self, session: Session) -> None:
+        import pyarrow.parquet as pq
+
+        from pathway_tpu.internals.keys import hash_values
+
+        pkeys = self.schema.primary_key_columns()
+        names = self.schema.column_names()
+        seq = 0
+        done = -1
+        # keyless rows key as (content hash, occurrence index): duplicate
+        # rows stay distinct, a delete cancels exactly one occurrence
+        occ: dict = {}
+        # part path -> pushed (key, row, sign) so a 'remove' action
+        # (delta-rs DELETE/OPTIMIZE rewrites) retracts its rows exactly
+        emitted_by_part: dict[str, list] = {}
+
+        def key_of(values, sign: int):
+            nonlocal seq
+            key, row = self.row_to_engine(values, seq)
+            seq += 1
+            if pkeys:
+                return key, row
+            content = hash_values("delta",
+                                  *[values.get(n) for n in names])
+            n_seen = occ.get(content, 0)
+            if sign > 0:
+                occ[content] = n_seen + 1
+                return hash_values(content, n_seen), row
+            occ[content] = max(0, n_seen - 1)
+            return hash_values(content, max(0, n_seen - 1)), row
+
+        def apply_version(v: int) -> None:
+            for action in self._actions_of_version(v):
+                if "add" in action:
+                    part = action["add"]["path"]
+                    pushed = emitted_by_part.setdefault(part, [])
+                    table = pq.read_table(
+                        os.path.join(self.uri, part)).to_pylist()
+                    for values in table:
+                        diff = int(values.pop("diff", 1))
+                        values.pop("time", None)
+                        sign = 1 if diff >= 0 else -1
+                        key, row = key_of(values, sign)
+                        session.push(key, row, sign)
+                        pushed.append((key, row, sign))
+                elif "remove" in action:
+                    part = action["remove"]["path"]
+                    for key, row, sign in emitted_by_part.pop(part, ()):
+                        session.push(key, row, -sign)
+
+        while True:
+            available = set(_list_versions(self.uri))
+            # strictly in version order, no gaps (the protocol's total
+            # order): a late-landing lower version is never skipped
+            while done + 1 in available:
+                done += 1
+                apply_version(done)
+            if self.mode != "streaming":
+                return
+            _time.sleep(0.5)
+
+
+def read(uri: str, *, schema, mode: str = "streaming",
+         autocommit_duration_ms: int | None = 1500,
+         name: str | None = None, persistent_id: str | None = None,
+         **kwargs) -> Table:
+    """Replay + tail a Delta table's transaction log as a live table.
+    Rows written by ``pw.io.deltalake.write`` (or delta-rs with the same
+    layout) stream back with their diffs applied."""
+    from pathway_tpu.io._datasource import CollectSession
+
+    src = DeltaLakeSource(uri, schema, mode,
+                          autocommit_duration_ms=autocommit_duration_ms)
+    src.persistent_id = persistent_id or name
+    if mode == "static":
+        sess = CollectSession()
+        src.run(sess)
+        keys = list(sess.state.keys())
+        rows = [sess.state[k] for k in keys]
+        plan = Plan("static", keys=keys, rows=rows, times=None, diffs=None)
+        return Table(plan, schema, Universe(),
+                     name=name or "deltalake_static")
+    return Table(Plan("input", datasource=src), schema, Universe(),
+                 name=name or "deltalake")

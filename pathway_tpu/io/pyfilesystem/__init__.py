@@ -150,24 +150,12 @@ def read(source: Any, *, path: str = "", refresh_interval: float = 30,
                              autocommit_duration_ms=autocommit_duration_ms)
     src.persistent_id = persistent_id or name
     if mode == "static":
-        keys, rows = [], []
+        from pathway_tpu.io._datasource import CollectSession
 
-        class _Collect:
-            closed = False
-
-            def push(self, key, row, diff=1, offset=None):
-                if diff > 0:
-                    keys.append(key)
-                    rows.append(row)
-                else:
-                    try:
-                        i = keys.index(key)
-                        keys.pop(i)
-                        rows.pop(i)
-                    except ValueError:
-                        pass
-
-        src.run(_Collect())
+        sess = CollectSession()
+        src.run(sess)
+        keys = list(sess.state.keys())
+        rows = [sess.state[k] for k in keys]
         plan = Plan("static", keys=keys, rows=rows, times=None, diffs=None)
         return Table(plan, schema, Universe(),
                      name=name or "pyfilesystem_static")

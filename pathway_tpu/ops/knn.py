@@ -98,6 +98,7 @@ class BruteForceKnnIndex:
         self._dev_vectors = None
         self._dev_valid = None
         self._search_fn_cache: dict[tuple, Callable] = {}
+        self._scatter_fn = None
         self._device = device
 
     # ------------------------------------------------------------------
@@ -199,12 +200,8 @@ class BruteForceKnnIndex:
                     s2k[slot] = key
                 slots[i] = slot
             self._flush_to_device()  # establish the slab before scattering
-            slab_dtype = (jnp.bfloat16 if self.dtype == "bfloat16"
-                          else jnp.float32)
-            idxs = jnp.asarray(slots)
-            self._dev_vectors = self._dev_vectors.at[idxs].set(
-                vectors.astype(slab_dtype))
-            self._dev_valid = self._dev_valid.at[idxs].set(True)
+            self._scatter(jnp.asarray(slots), vectors,
+                          jnp.ones(len(keys), dtype=bool))
             self._host_valid[slots] = True
             slot_list = slots.tolist()
             self._stale.update(slot_list)
@@ -319,6 +316,28 @@ class BruteForceKnnIndex:
     # ------------------------------------------------------------------
     # device sync + search
     # ------------------------------------------------------------------
+    def _scatter(self, idxs, vals, valid_vals):
+        """Jitted, slab-DONATING scatter: without donation every
+        ``.at[].set`` materializes a second full slab (15.4 GB transient at
+        10M bf16 — an OOM and a full-HBM copy per call)."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        if self._scatter_fn is None:
+            slab_dtype = (jnp.bfloat16 if self.dtype == "bfloat16"
+                          else jnp.float32)
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def scatter(slab, valid, idxs, vals, valid_vals):
+                return (slab.at[idxs].set(vals.astype(slab_dtype)),
+                        valid.at[idxs].set(valid_vals))
+
+            self._scatter_fn = scatter
+        self._dev_vectors, self._dev_valid = self._scatter_fn(
+            self._dev_vectors, self._dev_valid, idxs, vals, valid_vals)
+
     def _flush_to_device(self):
         import jax
         import jax.numpy as jnp
@@ -341,10 +360,9 @@ class BruteForceKnnIndex:
         if self._dirty:
             idxs = np.fromiter(self._dirty, dtype=np.int32)
             self._dirty.clear()
-            vals = jnp.asarray(self._host_vectors[idxs])
-            valid = jnp.asarray(self._host_valid[idxs])
-            self._dev_vectors = self._dev_vectors.at[idxs].set(vals)
-            self._dev_valid = self._dev_valid.at[idxs].set(valid)
+            self._scatter(jnp.asarray(idxs),
+                          jnp.asarray(self._host_vectors[idxs]),
+                          jnp.asarray(self._host_valid[idxs]))
 
     def flush_device(self) -> None:
         """Push pending host-mirror changes to the device now (async

@@ -273,3 +273,133 @@ def test_gradual_broadcast_insert_before_retract_update():
                                        (tk, (0.0, 0.0, 10.0), 1)])])
     assert any(d > 0 and row == ("new", 0.0)
                for _, row, d in out2.entries)
+
+
+def test_deltalake_write_read_roundtrip(tmp_path):
+    """Dependency-free Delta protocol subset: parquet parts + ordered
+    _delta_log JSON (reference: DeltaTableReader/Writer via delta-rs)."""
+    import json as js
+
+    root = str(tmp_path / "dt")
+    t = pw.debug.table_from_markdown("""
+    name  | qty | _time | _diff
+    alice | 3   | 2     | 1
+    bob   | 5   | 2     | 1
+    alice | 3   | 4     | -1
+    carol | 7   | 4     | 1
+    """)
+    pw.io.deltalake.write(t, root)
+    pw.run()
+
+    # the log is real Delta protocol: version 0 carries protocol+metaData
+    log0 = (tmp_path / "dt" / "_delta_log" /
+            f"{0:020d}.json").read_text().splitlines()
+    actions = [js.loads(l) for l in log0]
+    assert any("protocol" in a for a in actions)
+    assert any("metaData" in a for a in actions)
+    assert any("add" in a for a in actions)
+
+    class S(pw.Schema):
+        name: str
+        qty: int
+
+    G.clear()
+    back = pw.io.deltalake.read(root, schema=S, mode="static")
+    got = sorted(rows_of(back))
+    # the retraction of alice applied during replay
+    assert got == [("bob", 5), ("carol", 7)]
+
+
+def test_deltalake_streaming_tails_new_versions(tmp_path):
+    import threading
+    import time
+
+    root = str(tmp_path / "dt")
+    # seed version 0 through the writer
+    t = pw.debug.table_from_markdown("name\nseed")
+    pw.io.deltalake.write(t, root)
+    pw.run()
+    G.clear()
+
+    class S(pw.Schema):
+        name: str
+
+    seen = []
+    live = pw.io.deltalake.read(root, schema=S, mode="streaming")
+    pw.io.subscribe(live, on_change=lambda key, row, time, is_addition:
+                    seen.append(row["name"]))
+
+    def feed():
+        time.sleep(1.2)
+        G2 = []
+        # write a NEW version with a fresh pipeline (append-only tail)
+        import pathway_tpu as pw2
+        from pathway_tpu.internals.parse_graph import G as PG
+
+        # separate graph context: build + run a second writer run
+        snapshot = list(PG.output_binders)
+        t2 = pw2.debug.table_from_markdown("name\nlive_row")
+        pw2.io.deltalake.write(t2, root)
+        new_binders = [b for b in PG.output_binders
+                       if b not in snapshot]
+        from pathway_tpu.internals.runner import GraphRunner
+
+        r = GraphRunner()
+        for b in new_binders:
+            b(r)
+        r.run_batch()
+
+    threading.Thread(target=feed, daemon=True).start()
+    threading.Thread(target=lambda: pw.run(), daemon=True).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and set(seen) != {"seed", "live_row"}:
+        time.sleep(0.1)
+    assert set(seen) == {"seed", "live_row"}
+
+
+def test_deltalake_remove_actions_and_duplicates(tmp_path):
+    """delta-rs interop semantics: 'remove' actions retract a part's rows;
+    duplicate keyless rows stay distinct occurrences."""
+    import json as js
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = tmp_path / "dt"
+    (root / "_delta_log").mkdir(parents=True)
+
+    def commit(version, actions):
+        p = root / "_delta_log" / f"{version:020d}.json"
+        p.write_text("\n".join(js.dumps(a) for a in actions) + "\n")
+
+    def part(name, rows):
+        pq.write_table(pa.Table.from_pylist(rows), str(root / name))
+
+    # v0: two identical keyless rows + one other
+    part("p0.parquet", [{"name": "dup", "qty": 1, "time": 0, "diff": 1},
+                        {"name": "dup", "qty": 1, "time": 0, "diff": 1},
+                        {"name": "solo", "qty": 2, "time": 0, "diff": 1}])
+    commit(0, [{"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+               {"add": {"path": "p0.parquet", "size": 1,
+                        "partitionValues": {}, "dataChange": True}}])
+    # v1: a compaction-style rewrite — remove p0, re-add survivors only
+    part("p1.parquet", [{"name": "dup", "qty": 1, "time": 1, "diff": 1}])
+    commit(1, [{"remove": {"path": "p0.parquet", "dataChange": True}},
+               {"add": {"path": "p1.parquet", "size": 1,
+                        "partitionValues": {}, "dataChange": True}}])
+
+    class S(pw.Schema):
+        name: str
+        qty: int
+
+    t = pw.io.deltalake.read(str(root), schema=S, mode="static")
+    got = sorted(rows_of(t))
+    # after the rewrite exactly ONE dup row survives, solo is gone
+    assert got == [("dup", 1)]
+
+    # duplicates before any remove: both occurrences visible
+    G.clear()
+    (root / "_delta_log" / f"{1:020d}.json").unlink()
+    t2 = pw.io.deltalake.read(str(root), schema=S, mode="static")
+    got2 = sorted(rows_of(t2))
+    assert got2 == [("dup", 1), ("dup", 1), ("solo", 2)]
