@@ -19,6 +19,9 @@ class ParseGraph:
     def clear(self) -> None:
         self.output_binders.clear()
         self.has_streaming_sources = False
+        from pathway_tpu.internals.universe_solver import GLOBAL_SOLVER
+
+        GLOBAL_SOLVER.reset()
 
 
 G = ParseGraph()

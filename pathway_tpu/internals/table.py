@@ -256,6 +256,7 @@ class Table:
         return t
 
     def promise_universes_are_disjoint(self, other: "Table") -> "Table":
+        self._universe.promise_is_disjoint_from(other._universe)
         return self
 
     def promise_universes_are_equal(self, other: "Table") -> "Table":
@@ -391,7 +392,10 @@ class Table:
         tables = [self, *others]
         schema = _common_schema(tables)
         plan = Plan("concat", tables=tables, update=False)
-        return Table(plan, schema, Universe())
+        out = Table(plan, schema, Universe())
+        for t in tables:  # union: every input is a subset of the result
+            t._universe.promise_is_subset_of(out._universe)
+        return out
 
     def concat_reindex(self, *others: "Table") -> "Table":
         tables = [self, *others]
@@ -402,7 +406,10 @@ class Table:
     def update_rows(self, other: "Table") -> "Table":
         schema = _common_schema([self, other], update=True)
         plan = Plan("concat", tables=[self, other], update=True)
-        return Table(plan, schema, Universe())
+        out = Table(plan, schema, Universe())
+        self._universe.promise_is_subset_of(out._universe)
+        other._universe.promise_is_subset_of(out._universe)
+        return out
 
     def update_cells(self, other: "Table") -> "Table":
         names = other.column_names()

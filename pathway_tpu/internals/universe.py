@@ -1,41 +1,48 @@
 """Universes — key-set identity of tables
 (reference: python/pathway/internals/universe.py + universe_solver.py).
 
-We track universe identity and explicit promises instead of running the
-reference's SAT solver; operations requiring same/sub-universes check
-identity or a recorded promise and otherwise defer to keyed engine ops,
-which are correct regardless (keys align or don't at runtime).
+Relations (subset / equality / disjointness) live in the process-wide
+``UniverseSolver`` (internals/universe_solver.py) and are decided by
+query-time transitive closure, so a promise recorded on a parent holds
+for subuniverses created before OR after the promise — the entailment
+behavior of the reference's SAT-based solver, without the python-sat
+dependency.
 """
 
 from __future__ import annotations
 
 import itertools
 
+from pathway_tpu.internals.universe_solver import GLOBAL_SOLVER
+
 _ids = itertools.count()
 
 
 class Universe:
-    __slots__ = ("id", "supersets")
+    __slots__ = ("id",)
 
     def __init__(self):
         self.id = next(_ids)
-        self.supersets: set[int] = {self.id}
 
     def subuniverse(self) -> "Universe":
         u = Universe()
-        u.supersets |= self.supersets
+        GLOBAL_SOLVER.add_subset(u.id, self.id)
         return u
 
     def is_subset_of(self, other: "Universe") -> bool:
-        return other.id in self.supersets
+        return GLOBAL_SOLVER.is_subset(self.id, other.id)
 
     def is_equal_to(self, other: "Universe") -> bool:
-        return self is other or (
-            self.is_subset_of(other) and other.is_subset_of(self)
-        )
+        return self is other or GLOBAL_SOLVER.are_equal(self.id, other.id)
+
+    def is_disjoint_from(self, other: "Universe") -> bool:
+        return GLOBAL_SOLVER.are_disjoint(self.id, other.id)
 
     def promise_is_subset_of(self, other: "Universe") -> None:
-        self.supersets |= other.supersets
+        GLOBAL_SOLVER.add_subset(self.id, other.id)
+
+    def promise_is_disjoint_from(self, other: "Universe") -> None:
+        GLOBAL_SOLVER.add_disjoint(self.id, other.id)
 
     def __repr__(self):
         return f"<Universe {self.id}>"

@@ -1,0 +1,82 @@
+"""Universe relation solver.
+
+Reference: python/pathway/internals/universe_solver.py — a python-sat
+constraint solver deciding subset/equality relations between universes
+(key sets), powering ``with_universe_of`` validation and same-universe
+operator checks. Only three kinds of facts are ever asserted (subset,
+equality = two subsets, disjointness) and only those are queried, so a
+relation graph with query-time transitive closure decides the asserted
+entailments without a SAT dependency:
+
+- subset: reachability in the directed superset graph;
+- equality: subset both ways;
+- disjointness: declared pairs, inherited downward (a ⊆ x, b ⊆ y,
+  x ⊥ y ⇒ a ⊥ b).
+
+One deliberate approximation vs the reference solver: union results
+(concat/update_rows) record only the LOWER bounds input ⊆ result — the
+upper bound "result ⊆ S whenever every input ⊆ S" is not derived, so
+such checks fall back to runtime-keyed behavior instead of static proof.
+
+Query-time closure also fixes the eager-snapshot design this replaces:
+a promise recorded on a parent universe now holds for subuniverses
+created EARLIER, matching the reference solver's behavior.
+"""
+
+from __future__ import annotations
+
+
+class UniverseSolver:
+    def __init__(self):
+        self._supersets: dict[int, set[int]] = {}
+        self._disjoint: set[frozenset] = set()
+
+    def reset(self) -> None:
+        """Drop all relations — called by ParseGraph.clear() so a
+        long-lived process (notebook, server) doesn't accumulate
+        relations for dead pipelines forever."""
+        self._supersets.clear()
+        self._disjoint.clear()
+
+    # -- facts ------------------------------------------------------------
+    def add_subset(self, sub_id: int, sup_id: int) -> None:
+        self._supersets.setdefault(sub_id, set()).add(sup_id)
+
+    def add_equal(self, a_id: int, b_id: int) -> None:
+        self.add_subset(a_id, b_id)
+        self.add_subset(b_id, a_id)
+
+    def add_disjoint(self, a_id: int, b_id: int) -> None:
+        self._disjoint.add(frozenset((a_id, b_id)))
+
+    # -- queries ----------------------------------------------------------
+    def _ancestors(self, uid: int) -> set[int]:
+        seen = {uid}
+        stack = [uid]
+        while stack:
+            for sup in self._supersets.get(stack.pop(), ()):
+                if sup not in seen:
+                    seen.add(sup)
+                    stack.append(sup)
+        return seen
+
+    def is_subset(self, sub_id: int, sup_id: int) -> bool:
+        return sup_id in self._ancestors(sub_id)
+
+    def are_equal(self, a_id: int, b_id: int) -> bool:
+        return a_id == b_id or (
+            self.is_subset(a_id, b_id) and self.is_subset(b_id, a_id))
+
+    def are_disjoint(self, a_id: int, b_id: int) -> bool:
+        if not self._disjoint:
+            return False
+        anc_a = self._ancestors(a_id)
+        anc_b = self._ancestors(b_id)
+        for pair in self._disjoint:
+            x, y = tuple(pair) if len(pair) == 2 else (next(iter(pair)),) * 2
+            if (x in anc_a and y in anc_b) or (y in anc_a and x in anc_b):
+                return True
+        return False
+
+
+GLOBAL_SOLVER = UniverseSolver()

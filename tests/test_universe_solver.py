@@ -1,0 +1,73 @@
+"""Universe solver (reference: internals/universe_solver.py — SAT-based;
+here a relation graph with query-time closure deciding the same subset/
+equality/disjointness entailments)."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.universe import Universe
+
+
+def setup_function(_):
+    G.clear()
+
+
+def teardown_function(_):
+    G.clear()
+
+
+def test_transitive_subset():
+    a = Universe()
+    b = a.subuniverse()
+    c = b.subuniverse()
+    assert c.is_subset_of(a)
+    assert not a.is_subset_of(c)
+
+
+def test_late_promise_propagates_to_existing_children():
+    """The regression the solver fixes: the old eager-snapshot design
+    copied supersets at subuniverse() time, so a promise recorded on the
+    parent AFTERWARD never reached existing children."""
+    parent = Universe()
+    child = parent.subuniverse()  # created BEFORE the promise
+    target = Universe()
+    parent.promise_is_subset_of(target)
+    assert parent.is_subset_of(target)
+    assert child.is_subset_of(target)  # entailed through the parent
+
+
+def test_equality_both_ways():
+    a, b = Universe(), Universe()
+    a.promise_is_subset_of(b)
+    assert not a.is_equal_to(b)
+    b.promise_is_subset_of(a)
+    assert a.is_equal_to(b) and b.is_equal_to(a)
+
+
+def test_disjointness_inherited_downward():
+    a, b = Universe(), Universe()
+    a.promise_is_disjoint_from(b)
+    sa, sb = a.subuniverse(), b.subuniverse()
+    assert sa.is_disjoint_from(sb)
+    assert sb.is_disjoint_from(sa)
+    assert not sa.is_disjoint_from(a.subuniverse())
+
+
+def test_table_operations_register_relations():
+    t = pw.debug.table_from_markdown("""
+    a
+    1
+    2
+    3
+    """)
+    f = t.filter(t.a > 1)
+    assert f._universe.is_subset_of(t._universe)
+    u = t.concat_reindex(t)  # fresh keys: no relation claimed
+    c = f.concat(t.filter(t.a <= 1))
+    # union result: both inputs are subsets of it, and it stays a
+    # subset-of-t entailment-free (c may equal t but is not proven to)
+    assert f._universe.is_subset_of(c._universe)
+    assert not c._universe.is_subset_of(t._universe)
+    d = t.promise_universes_are_disjoint(u)
+    assert t._universe.is_disjoint_from(u._universe)
