@@ -1,0 +1,58 @@
+"""Streaming ETL template (reference: the WordCount / Kafka-ETL templates,
+docs/2.developers/7.templates): tail a directory of JSONLines order events,
+join against a dimension file, aggregate revenue per category with a
+sliding window, and stream results to CSV — with live dashboard and
+Prometheus /metrics.
+
+Run:
+    python examples/streaming_etl.py ./orders ./categories.csv ./out.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import pathway_tpu as pw
+
+
+class Order(pw.Schema):
+    item: str
+    qty: int
+    price: float
+    ts: int
+
+
+class Category(pw.Schema):
+    item: str
+    category: str
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("orders_dir")
+    ap.add_argument("categories_csv")
+    ap.add_argument("out_csv")
+    args = ap.parse_args()
+
+    orders = pw.io.fs.read(args.orders_dir, format="json", schema=Order,
+                           mode="streaming")
+    cats = pw.io.fs.read(args.categories_csv, format="csv",
+                         schema=Category, mode="static")
+
+    enriched = orders.join(cats, orders.item == cats.item).select(
+        orders.item, orders.qty, orders.price, orders.ts, cats.category,
+        revenue=orders.qty * orders.price)
+    by_cat = enriched.windowby(
+        enriched.ts, window=pw.temporal.sliding(hop=60, duration=300),
+        instance=enriched.category).reduce(
+        category=pw.this._pw_instance,
+        window_start=pw.this._pw_window_start,
+        revenue=pw.reducers.sum(pw.this.revenue),
+        n_orders=pw.reducers.count())
+
+    pw.io.fs.write(by_cat, args.out_csv, format="csv")
+    pw.run(monitoring_level=pw.MonitoringLevel.ALL, with_http_server=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,25 @@ class StreamingRuntime:
         if self.http_server is not None:
             self.http_server.start()
 
+        # feed static tables at startup: dimension data (markdown tables,
+        # static csv) joined against live streams must be present from tick
+        # one. One tick per distinct logical time, like run_batch — a
+        # single collapsed batch would net out add/retract pairs that
+        # legitimately exist at different times (update streams).
+        static_times = sorted({t for _n, feed in self.runner._static_feeds
+                               for (t, _k, _r, _d) in feed})
+        for t in static_times:
+            any_batch = False
+            for node, feed in self.runner._static_feeds:
+                batch = Delta([(k, r, d) for (ft, k, r, d) in feed
+                               if ft == t])
+                if batch:
+                    self.scheduler.push_source(node, batch)
+                    any_batch = True
+            if any_batch:
+                self.scheduler.run_time(time_counter)
+                time_counter += 1
+
         commit_s = min(
             [s[2].autocommit_duration_ms or self.default_commit_ms
              for s in self.sessions] + [self.default_commit_ms]

@@ -19,10 +19,11 @@ _ids = itertools.count()
 
 
 class Universe:
-    __slots__ = ("id",)
+    __slots__ = ("id", "__weakref__")
 
     def __init__(self):
         self.id = next(_ids)
+        GLOBAL_SOLVER.register(self)
 
     def subuniverse(self) -> "Universe":
         u = Universe()

@@ -25,22 +25,63 @@ created EARLIER, matching the reference solver's behavior.
 
 from __future__ import annotations
 
+import weakref
+
+_PRUNE_EVERY = 4096  # fact insertions between garbage sweeps
+
 
 class UniverseSolver:
     def __init__(self):
         self._supersets: dict[int, set[int]] = {}
         self._disjoint: set[frozenset] = set()
+        # live Universe objects by id — dead ones get spliced out of the
+        # relation graph (reachability-preserving), so a long-lived
+        # process doesn't accumulate relations for dead pipelines forever
+        self._registry: "weakref.WeakValueDictionary[int, object]" = (
+            weakref.WeakValueDictionary())
+        self._adds_since_prune = 0
 
     def reset(self) -> None:
-        """Drop all relations — called by ParseGraph.clear() so a
-        long-lived process (notebook, server) doesn't accumulate
-        relations for dead pipelines forever."""
+        """Drop all relations — called by ParseGraph.clear()."""
         self._supersets.clear()
         self._disjoint.clear()
+        self._adds_since_prune = 0
+
+    def register(self, universe) -> None:
+        self._registry[universe.id] = universe
+
+    def _prune(self) -> None:
+        """Splice garbage-collected universes out of the graph while
+        preserving every entailment between LIVE universes: a dead node's
+        incoming edges are rewired to its outgoing set, and disjoint
+        pairs naming it are conservatively re-attributed to its
+        predecessors (a ⊆ x†, x† ⊥ y still implies a ⊥ y)."""
+        live = set(self._registry.keys())
+        dead = [uid for uid in list(self._supersets) if uid not in live]
+        for d in dead:
+            outs = self._supersets.pop(d, set())
+            outs.discard(d)
+            preds = [sub for sub, sups in self._supersets.items()
+                     if d in sups]
+            for sub in preds:
+                sups = self._supersets[sub]
+                sups.discard(d)
+                sups |= outs
+            if self._disjoint:
+                stale = [p for p in self._disjoint if d in p]
+                for pair in stale:
+                    self._disjoint.discard(pair)
+                    (other,) = tuple(pair - {d}) or (d,)
+                    for sub in preds:
+                        self._disjoint.add(frozenset((sub, other)))
+        self._adds_since_prune = 0
 
     # -- facts ------------------------------------------------------------
     def add_subset(self, sub_id: int, sup_id: int) -> None:
         self._supersets.setdefault(sub_id, set()).add(sup_id)
+        self._adds_since_prune += 1
+        if self._adds_since_prune >= _PRUNE_EVERY:
+            self._prune()
 
     def add_equal(self, a_id: int, b_id: int) -> None:
         self.add_subset(a_id, b_id)

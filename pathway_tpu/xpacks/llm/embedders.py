@@ -44,7 +44,8 @@ class JaxEncoderEmbedder(BaseEmbedder):
 
     _BUCKETS = (32, 64, 128, 256, 512)
 
-    def __init__(self, *, config=None, params=None, tokenizer=None,
+    def __init__(self, *, model: str | None = None, config=None,
+                 params=None, tokenizer=None,
                  seed: int = 0, max_len: int = 512,
                  call_kwargs: dict = {}, **kwargs):
         kwargs.setdefault("batch", True)
@@ -56,6 +57,13 @@ class JaxEncoderEmbedder(BaseEmbedder):
             init_params
         from pathway_tpu.models.tokenizer import HashTokenizer
 
+        if model is not None:
+            # name-based convenience, like the reference's
+            # SentenceTransformerEmbedder(model=...): loads the checkpoint
+            # (weights + config + WordPiece vocab) from the local HF cache
+            from pathway_tpu.models.hf_loader import load_model
+
+            params, config, tokenizer = load_model(model)
         self.config = config or EncoderConfig.bge_small()
         self.params = params if params is not None else init_params(
             jax.random.PRNGKey(seed), self.config)

@@ -87,22 +87,25 @@ class BaseRAGQuestionAnswerer:
         self.server = None
         self._pending_endpoints: list[tuple] = []
 
-    # -- schemas (reference :300-340) ---------------------------------
+    # -- schemas (reference :300-340 — optional fields carry defaults so
+    # a minimal POST body works, e.g. just {"prompt": ...}) --------------
     class AnswerQuerySchema(pw.Schema):
         prompt: str
-        filters: str | None
-        model: str | None
-        response_type: str  # "short" | "long"
+        filters: str | None = pw.column_definition(default_value=None)
+        model: str | None = pw.column_definition(default_value=None)
+        response_type: str = pw.column_definition(default_value="short")
 
     class SummarizeQuerySchema(pw.Schema):
         text_list: Any
-        model: str | None
+        model: str | None = pw.column_definition(default_value=None)
 
     class RetrieveQuerySchema(pw.Schema):
         query: str
-        k: int
-        metadata_filter: str | None
-        filepath_globpattern: str | None
+        k: int = pw.column_definition(default_value=3)
+        metadata_filter: str | None = pw.column_definition(
+            default_value=None)
+        filepath_globpattern: str | None = pw.column_definition(
+            default_value=None)
 
     class StatisticsQuerySchema(pw.Schema):
         pass

@@ -403,3 +403,45 @@ def test_deltalake_remove_actions_and_duplicates(tmp_path):
     t2 = pw.io.deltalake.read(str(root), schema=S, mode="static")
     got2 = sorted(rows_of(t2))
     assert got2 == [("dup", 1), ("dup", 1), ("solo", 2)]
+
+
+def test_streaming_join_against_static_dimension(tmp_path):
+    """Regression: streaming mode must feed static tables at startup — a
+    live stream joined with a static dimension table produced zero rows
+    (the batch path fed them, the streaming loop never did)."""
+    import threading
+    import time
+
+    d = tmp_path / "orders"
+    d.mkdir()
+    (d / "a.jsonl").write_text('{"item": "widget", "qty": 2}\n')
+
+    class Order(pw.Schema):
+        item: str
+        qty: int
+
+    class Cat(pw.Schema):
+        item: str
+        cat: str
+
+    orders = pw.io.fs.read(str(d), format="json", schema=Order,
+                           mode="streaming")
+    cats = pw.debug.table_from_rows(Cat, [("widget", "tools"),
+                                          ("gizmo", "toys")])
+    joined = orders.join(cats, orders.item == cats.item).select(
+        orders.item, orders.qty, cats.cat)
+    seen = []
+    pw.io.subscribe(joined, on_change=lambda key, row, time, is_addition:
+                    seen.append((row["item"], row["cat"], is_addition)))
+
+    def feed():
+        time.sleep(1.5)
+        (d / "b.jsonl").write_text('{"item": "gizmo", "qty": 1}\n')
+
+    threading.Thread(target=feed, daemon=True).start()
+    threading.Thread(target=lambda: pw.run(), daemon=True).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(seen) < 2:
+        time.sleep(0.1)
+    assert ("widget", "tools", True) in seen
+    assert ("gizmo", "toys", True) in seen

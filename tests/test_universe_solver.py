@@ -71,3 +71,55 @@ def test_table_operations_register_relations():
     assert not c._universe.is_subset_of(t._universe)
     d = t.promise_universes_are_disjoint(u)
     assert t._universe.is_disjoint_from(u._universe)
+
+
+def test_prune_preserves_live_entailments():
+    """Garbage-collected universes splice out of the relation graph while
+    subset AND disjointness entailments between live universes survive."""
+    import gc
+
+    from pathway_tpu.internals.universe_solver import GLOBAL_SOLVER
+
+    root = Universe()
+    mid = root.subuniverse()       # will die
+    leaf = mid.subuniverse()
+    other = Universe()
+    mid2 = other.subuniverse()     # will die, carries a disjoint pair
+    leaf2 = mid2.subuniverse()
+    mid.promise_is_disjoint_from(mid2)
+    assert leaf.is_subset_of(root)
+    assert leaf.is_disjoint_from(leaf2)
+
+    del mid, mid2
+    gc.collect()
+    GLOBAL_SOLVER._prune()
+
+    assert leaf.is_subset_of(root), "subset lost through dead intermediate"
+    assert leaf.is_disjoint_from(leaf2), \
+        "disjointness lost through dead intermediate"
+    dead_ids = set(GLOBAL_SOLVER._supersets) - set(
+        GLOBAL_SOLVER._registry.keys())
+    # no dead node keeps outgoing edges after the sweep
+    assert not dead_ids
+
+
+def test_prune_triggers_automatically():
+    from pathway_tpu.internals import universe_solver as us
+
+    GLOBAL = us.GLOBAL_SOLVER
+    GLOBAL.reset()
+    old = us._PRUNE_EVERY
+    us._PRUNE_EVERY = 64
+    try:
+        keep = Universe()
+        for _ in range(100):  # churn: dead chains force automatic sweeps
+            u = keep.subuniverse()
+            for _ in range(3):
+                u = u.subuniverse()
+        import gc
+
+        gc.collect()
+        keep.subuniverse()  # one more add past the threshold
+        assert len(GLOBAL._supersets) < 100
+    finally:
+        us._PRUNE_EVERY = old
