@@ -174,12 +174,12 @@ def bench_etl(n_rows: int = 100_000) -> dict:
     (the reference's headline WordCount benchmark shape, README.md:244-250),
     at n_workers ∈ {1, 8}.
 
-    Measured finding this round (recorded here so the numbers travel with
-    the bench): sharded execution is a correctness model — 8 in-process
-    workers add ~20-25% routing/merge overhead and thread-pool stepping is
-    SLOWER (GIL-bound pure-Python operators). The throughput path forward
-    is columnar operator state (numpy key arrays + searchsorted routing),
-    not threads.
+    Measured finding (updated): per-row compiled key paths (compile_row),
+    the bilinear join delta, hash memoization and exchange route caching
+    took 1w from ~15k to ~38k rows/s and shrank the 8-worker routing
+    overhead to ~20%. Thread-pool stepping remains SLOWER (GIL-bound
+    pure-Python operators) — real parallel speedup needs multi-process
+    workers (engine/multiproc.py path) or free-threaded builds.
     """
     import pathway_tpu as pw
     from pathway_tpu.debug import table_from_rows

@@ -56,6 +56,17 @@ class Delta:
     def consolidate(self) -> "Delta":
         if len(self.entries) <= 1:
             return self
+        # fast path: all keys distinct (map/source outputs over unique rows)
+        # — nothing can cancel, so skip the per-row fingerprinting
+        seen: set = set()
+        distinct = True
+        for key, _, diff in self.entries:
+            if key in seen or diff == 0:
+                distinct = False
+                break
+            seen.add(key)
+        if distinct:
+            return self
         acc: dict[tuple[Pointer, int], list] = {}
         for key, row, diff in self.entries:
             k = (key, row_fingerprint(row))

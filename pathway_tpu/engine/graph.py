@@ -129,6 +129,7 @@ class Scheduler:
         import threading
 
         self._stats_lock = threading.Lock()
+        self._route_cache: dict[tuple[int, int], dict] = {}
         self._topo = self._topo_sort()
         # worker replicas per node; replica 0 is always node.op itself.
         # Gather nodes (unpartitionable state) keep a single replica that
@@ -159,6 +160,9 @@ class Scheduler:
     # -- sharding helpers ----------------------------------------------------
     def _route(self, spec, key, row) -> int:
         v = key if spec == Exchange.BY_KEY else spec(key, row)
+        return self._route_value(v)
+
+    def _route_value(self, v) -> int:
         if not isinstance(v, int):  # Pointer subclasses int
             v = hash_values(v)
         return int(v) % self.n_workers
@@ -295,11 +299,35 @@ class Scheduler:
                             if routed[w]:
                                 per_worker[w][j] = Delta(routed[w]).consolidate()
                     else:
+                        # non-int route values (instance columns etc.) repeat
+                        # heavily tick after tick: memoize value -> worker per
+                        # edge. Ints (already-uniform Pointers) route directly
+                        # — % is cheaper than the cache probe — and tuples are
+                        # per-row null sentinels that would never hit.
+                        cache = self._route_cache.setdefault(
+                            (node.id, j), {})
                         routed = [[] for _ in range(n)]
                         for p in parts:
-                            for key, row, diff in p.entries:
-                                routed[self._route(spec, key, row)].append(
-                                    (key, row, diff))
+                            for e in p.entries:
+                                v = spec(e[0], e[1])
+                                if isinstance(v, int):
+                                    # Pointers and ints route by value like
+                                    # _route_value (shard = key mod n,
+                                    # shard.rs:6) — % beats a cache probe
+                                    w = int(v) % n
+                                elif isinstance(v, tuple):
+                                    w = self._route_value(v)
+                                else:
+                                    try:
+                                        w = cache.get(v)
+                                    except TypeError:  # unhashable
+                                        w = self._route_value(v)
+                                    else:
+                                        if w is None:
+                                            w = self._route_value(v)
+                                            if len(cache) < (1 << 20):
+                                                cache[v] = w
+                                routed[w].append(e)
                         for w in range(n):
                             if routed[w]:
                                 per_worker[w][j] = Delta(routed[w]).consolidate()

@@ -28,7 +28,7 @@ from pathway_tpu.engine.delta import (
     upsert_delta,
 )
 from pathway_tpu.engine.reducers import make_reducer_state
-from pathway_tpu.internals.keys import Pointer, hash_values
+from pathway_tpu.internals.keys import Pointer, hash_values, mix_pointers
 
 
 class Exchange:
@@ -314,6 +314,11 @@ class GroupByOperator(Operator):
         self.group_counts: dict[Pointer, int] = {}    # membership multiset size
         self.out = Arrangement()
         self.seq = 0
+        # all other reducers are commutative multisets/semigroups — the
+        # canonical sort below is pure overhead for them
+        self._order_sensitive = any(
+            name in ("earliest", "latest", "stateful")
+            for name, _, _ in reducer_specs)
 
     def exchange_specs(self):
         # route rows to the worker owning their group (reference: group_by
@@ -329,9 +334,13 @@ class GroupByOperator(Operator):
         # order-sensitive reducers (earliest/latest stamps, stateful folds)
         # must not depend on arrival order, which sharded exchange permutes —
         # with a canonical order, n_workers ∈ {1, N} give identical results
-        for key, row, diff in sorted(
+        if self._order_sensitive:
+            entries = sorted(
                 delta.entries,
-                key=lambda e: (int(e[0]), e[2], row_fingerprint(e[1]))):
+                key=lambda e: (int(e[0]), e[2], row_fingerprint(e[1])))
+        else:
+            entries = delta.entries
+        for key, row, diff in entries:
             gkey, gvals = self.group_fn(key, row)
             states = self.group_states.get(gkey)
             if states is None:
@@ -387,6 +396,11 @@ class JoinOperator(Operator):
         self.lkey_fn = lkey_fn
         self.rkey_fn = rkey_fn
         self.out_fn = out_fn
+        # default out key = mix(left id, right id): unique per pair, so the
+        # bilinear delta path applies. A custom out_key_fn (join id from one
+        # side) can collide across pairs — those joins keep the per-group
+        # recompute path whose dict semantics dedupe collisions.
+        self._bilinear = out_key_fn is None
         self.out_key_fn = out_key_fn or self._default_out_key
         self.left: dict[Any, dict[Pointer, tuple]] = {}
         self.right: dict[Any, dict[Pointer, tuple]] = {}
@@ -400,7 +414,7 @@ class JoinOperator(Operator):
 
     @staticmethod
     def _default_out_key(lkey, rkey, jk):
-        return hash_values(lkey, rkey)
+        return mix_pointers(lkey, rkey)
 
     def _group_out(self, jk) -> dict[Pointer, tuple]:
         lg = self.left.get(jk) or {}
@@ -432,9 +446,11 @@ class JoinOperator(Operator):
         dl, dr = in_deltas
         if not dl and not dr:
             return Delta()
-        affected: dict[Any, None] = {}
         l_entries = [(self.lkey_fn(k, r), k, r, d) for k, r, d in dl.entries]
         r_entries = [(self.rkey_fn(k, r), k, r, d) for k, r, d in dr.entries]
+        if self._bilinear:
+            return self._step_bilinear(l_entries, r_entries)
+        affected: dict[Any, None] = {}
         for jk, _, _, _ in l_entries:
             affected[jk] = None
         for jk, _, _, _ in r_entries:
@@ -459,6 +475,74 @@ class JoinOperator(Operator):
                 oo = o.get(okey)
                 if oo is None or row_fingerprint(oo) != row_fingerprint(nrow):
                     out.append(okey, nrow, 1)
+        return out.consolidate()
+
+    def _step_bilinear(self, l_entries, r_entries) -> Delta:
+        """Exact incremental join delta: ΔL⋈R_old + L_new⋈ΔR (+ ear
+        emptiness transitions for left/right/outer) — O(delta x matches)
+        instead of recomputing every affected group (the DD join_core
+        update rule the reference leans on, dataflow.rs:2276)."""
+        out = Delta()
+        okey = self.out_key_fn
+        ofn = self.out_fn
+        left_ear = self.mode in ("left", "outer")
+        right_ear = self.mode in ("right", "outer")
+        # ΔL against R_old
+        for jk, lk, lrow, d in l_entries:
+            if jk is None:
+                continue
+            rg = self.right.get(jk)
+            if rg:
+                for rk, rrow in rg.items():
+                    out.append(okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), d)
+            elif left_ear:
+                out.append(okey(lk, None, jk), ofn(lk, lrow, None, None), d)
+        # left-group emptiness transitions flip right-side ears (vs R_old)
+        if right_ear:
+            l_empty_old: dict[Any, bool] = {}
+            for jk, _, _, _ in l_entries:
+                if jk is not None and jk not in l_empty_old:
+                    l_empty_old[jk] = jk not in self.left
+        for jk, lk, lrow, d in l_entries:
+            if jk is not None:
+                self._apply(self.left, jk, lk, lrow, d)
+        if right_ear:
+            for jk, was_empty in l_empty_old.items():
+                if (jk not in self.left) != was_empty:
+                    rg = self.right.get(jk)
+                    if rg:
+                        sign = -1 if was_empty else 1
+                        for rk, rrow in rg.items():
+                            out.append(okey(None, rk, jk),
+                                       ofn(None, None, rk, rrow), sign)
+        # ΔR against L_new
+        if left_ear:
+            r_empty_old: dict[Any, bool] = {}
+            for jk, _, _, _ in r_entries:
+                if jk is not None and jk not in r_empty_old:
+                    r_empty_old[jk] = jk not in self.right
+        for jk, rk, rrow, d in r_entries:
+            if jk is None:
+                continue
+            lg = self.left.get(jk)
+            if lg:
+                for lk, lrow in lg.items():
+                    out.append(okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), d)
+            elif right_ear:
+                out.append(okey(None, rk, jk), ofn(None, None, rk, rrow), d)
+        for jk, rk, rrow, d in r_entries:
+            if jk is not None:
+                self._apply(self.right, jk, rk, rrow, d)
+        # right-group emptiness transitions flip left-side ears (vs L_new)
+        if left_ear:
+            for jk, was_empty in r_empty_old.items():
+                if (jk not in self.right) != was_empty:
+                    lg = self.left.get(jk)
+                    if lg:
+                        sign = -1 if was_empty else 1
+                        for lk, lrow in lg.items():
+                            out.append(okey(lk, None, jk),
+                                       ofn(lk, lrow, None, None), sign)
         return out.consolidate()
 
 

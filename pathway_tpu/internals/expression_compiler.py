@@ -142,6 +142,28 @@ class ExpressionCompiler:
 
         return key_fn
 
+    def compile_row(self, expr) -> Callable[[Any, tuple], Any]:
+        """Per-row evaluator ``fn(key, row) -> value``.
+
+        Plain column refs / id refs — the overwhelmingly common case for
+        group keys, join keys and reducer arguments — compile to a tuple
+        index instead of a batch-of-one trip through the columnar
+        machinery (the engine's exchange and state operators evaluate
+        these per row, so this is the dataflow hot path)."""
+        if not isinstance(expr, ex.ColumnExpression):
+            const = expr
+            return lambda key, row: const
+        if isinstance(expr, ex.IdExpression):  # subclasses ColumnReference
+            return lambda key, row: key
+        if isinstance(expr, ex.ColumnReference):
+            pos = self.ctx.position(expr)
+            return lambda key, row: row[pos]
+        if isinstance(expr, ex.ConstExpression):
+            const = expr._value
+            return lambda key, row: const
+        batch_fn = self._compile(expr)
+        return lambda key, row: batch_fn([key], [row])[0]
+
     # -- dispatch -----------------------------------------------------------
     def _compile(self, expr) -> Callable[[list, list], Batch]:
         if not isinstance(expr, ex.ColumnExpression):

@@ -93,14 +93,20 @@ class GraphRunner:
             n_workers = get_pathway_config().threads
         sched = Scheduler(self.graph, n_workers=n_workers)
         times: set[int] = {0}
+        # group each feed by time once — scanning the whole feed per tick is
+        # O(ticks x rows) and dominates wide streaming feeds
+        by_time: list[tuple[Any, dict[int, list]]] = []
         for node, feed in self._static_feeds:
-            for t, _, _, _ in feed:
+            groups: dict[int, list] = {}
+            for t, k, r, d in feed:
                 times.add(t)
+                groups.setdefault(t, []).append((k, r, d))
+            by_time.append((node, groups))
         for t in sorted(times):
-            for node, feed in self._static_feeds:
-                batch = Delta([(k, r, d) for (ft, k, r, d) in feed if ft == t])
+            for node, groups in by_time:
+                batch = groups.get(t)
                 if batch:
-                    sched.push_source(node, batch)
+                    sched.push_source(node, Delta(batch))
             sched.run_time(t)
         # end-of-stream flush tick: temporal buffers release held rows
         sched.run_time(max(times) + 1, flush=True)
@@ -252,10 +258,10 @@ class GraphRunner:
             inner_exprs.extend(r._args)
         node, ctx = self._row_space(base, inner_exprs)
         comp = ExpressionCompiler(ctx)
-        gval_fns = [comp.compile(e) for e in gvals_exprs]
+        gval_fns = [comp.compile_row(e) for e in gvals_exprs]
         reducer_specs = []
         for r in reducers:
-            arg_fns = [comp.compile(a) for a in r._args]
+            arg_fns = [comp.compile_row(a) for a in r._args]
             name = _engine_reducer_name(r)
             kwargs = dict(r._kwargs)
             fn = kwargs.pop("fn", None)
@@ -268,37 +274,45 @@ class GraphRunner:
                     spec_kwargs["emit"] = kwargs["emit"]
             if name == "argmin":
                 def extract(key, row, _fns=arg_fns):
-                    vals = [f([key], [row])[0] for f in _fns]
+                    vals = [f(key, row) for f in _fns]
                     return (vals[0], key) if len(vals) == 1 else (vals[0], vals[1])
                 reducer_specs.append(("argmin", extract, spec_kwargs))
                 continue
             if name == "argmax":
                 def extract(key, row, _fns=arg_fns):
-                    vals = [f([key], [row])[0] for f in _fns]
+                    vals = [f(key, row) for f in _fns]
                     return (vals[0], key) if len(vals) == 1 else (vals[0], vals[1])
                 reducer_specs.append(("argmax", extract, spec_kwargs))
                 continue
             if name in ("tuple", "ndarray"):
                 def extract(key, row, _fns=arg_fns, _k=name):
-                    vals = [f([key], [row])[0] for f in _fns]
-                    return (vals[0], int(key))
+                    return (_fns[0](key, row), int(key))
                 reducer_specs.append((name, extract, spec_kwargs))
                 continue
 
-            def extract(key, row, _fns=arg_fns):
-                return tuple(f([key], [row])[0] for f in _fns)
+            if len(arg_fns) == 1:
+                def extract(key, row, _fn=arg_fns[0]):
+                    return (_fn(key, row),)
+            else:
+                def extract(key, row, _fns=arg_fns):
+                    return tuple(f(key, row) for f in _fns)
 
             reducer_specs.append((name, extract, spec_kwargs))
 
         use_raw_key = bool(by_id)
 
-        def group_fn(key, row):
-            gvals = tuple(f([key], [row])[0] for f in gval_fns)
-            if use_raw_key:
-                gkey = gvals[0] if isinstance(gvals[0], Pointer) else hash_values(gvals[0])
-            else:
-                gkey = hash_values(*gvals)
-            return gkey, gvals
+        if len(gval_fns) == 1 and not use_raw_key:
+            def group_fn(key, row, _f=gval_fns[0]):
+                v = _f(key, row)
+                return hash_values(v), (v,)
+        else:
+            def group_fn(key, row):
+                gvals = tuple(f(key, row) for f in gval_fns)
+                if use_raw_key:
+                    gkey = gvals[0] if isinstance(gvals[0], Pointer) else hash_values(gvals[0])
+                else:
+                    gkey = hash_values(*gvals)
+                return gkey, gvals
 
         gnode = self.graph.add_node(
             eng.GroupByOperator(group_fn, reducer_specs),
@@ -326,26 +340,40 @@ class GraphRunner:
         lctx = CompileContext()
         lctx.add_table(left, 0)
         lcomp = ExpressionCompiler(lctx)
-        l_fns = [lcomp.compile(a) for a, _ in on]
+        l_fns = [lcomp.compile_row(a) for a, _ in on]
         rctx = CompileContext()
         rctx.add_table(right, 0)
         rcomp = ExpressionCompiler(rctx)
-        r_fns = [rcomp.compile(b) for _, b in on]
+        r_fns = [rcomp.compile_row(b) for _, b in on]
 
         # SQL null semantics: a None join value matches nothing, but in
         # left/right/outer mode the row must still appear as an unmatched
         # "ear" — so map it to a per-row sentinel key that can't collide.
-        def lkey_fn(key, row):
-            vals = tuple(f([key], [row])[0] for f in l_fns)
-            if any(v is None for v in vals):
-                return ("__pw_null__", "l", key)
-            return hash_values(*vals)
+        if len(l_fns) == 1:
+            def lkey_fn(key, row, _f=l_fns[0]):
+                v = _f(key, row)
+                if v is None:
+                    return ("__pw_null__", "l", key)
+                return hash_values(v)
+        else:
+            def lkey_fn(key, row):
+                vals = tuple(f(key, row) for f in l_fns)
+                if any(v is None for v in vals):
+                    return ("__pw_null__", "l", key)
+                return hash_values(*vals)
 
-        def rkey_fn(key, row):
-            vals = tuple(f([key], [row])[0] for f in r_fns)
-            if any(v is None for v in vals):
-                return ("__pw_null__", "r", key)
-            return hash_values(*vals)
+        if len(r_fns) == 1:
+            def rkey_fn(key, row, _f=r_fns[0]):
+                v = _f(key, row)
+                if v is None:
+                    return ("__pw_null__", "r", key)
+                return hash_values(v)
+        else:
+            def rkey_fn(key, row):
+                vals = tuple(f(key, row) for f in r_fns)
+                if any(v is None for v in vals):
+                    return ("__pw_null__", "r", key)
+                return hash_values(*vals)
 
         nl = len(left._column_names())
         nr = len(right._column_names())
@@ -510,14 +538,14 @@ class GraphRunner:
         inst_e = plan.params["instance"]
         node, ctx = self._row_space(base, [key_e] + ([inst_e] if inst_e else []))
         comp = ExpressionCompiler(ctx)
-        kfn = comp.compile(key_e)
-        ifn = comp.compile(inst_e) if inst_e is not None else None
+        kfn = comp.compile_row(key_e)
+        ifn = comp.compile_row(inst_e) if inst_e is not None else None
 
         def key_fn(key, row):
-            return kfn([key], [row])[0]
+            return kfn(key, row)
 
         def instance_fn(key, row):
-            return ifn([key], [row])[0] if ifn is not None else None
+            return ifn(key, row) if ifn is not None else None
 
         return self.graph.add_node(
             eng.SortOperator(key_fn, instance_fn), [node], "sort")
@@ -531,14 +559,14 @@ class GraphRunner:
         ctx = CompileContext()
         ctx.add_table(base, 0)
         comp = ExpressionCompiler(ctx)
-        vfn = comp.compile(value_e) if value_e is not None else None
-        ifn = comp.compile(inst_e) if inst_e is not None else None
+        vfn = comp.compile_row(value_e) if value_e is not None else None
+        ifn = comp.compile_row(inst_e) if inst_e is not None else None
 
         def value_fn(key, row):
-            return vfn([key], [row])[0] if vfn is not None else row
+            return vfn(key, row) if vfn is not None else row
 
         def instance_fn(key, row):
-            return ifn([key], [row])[0] if ifn is not None else 0
+            return ifn(key, row) if ifn is not None else 0
 
         return self.graph.add_node(
             eng.DeduplicateOperator(instance_fn, value_fn, acceptor),
@@ -553,11 +581,11 @@ class GraphRunner:
 
         lnode, lctx = self._row_space(ctx_table, [key_expr])
         comp = ExpressionCompiler(lctx)
-        kfn = comp.compile(key_expr)
+        kfn = comp.compile_row(key_expr)
         rnode = self.lower(target)
 
         def lkey_fn(key, row):
-            k = kfn([key], [row])[0]
+            k = kfn(key, row)
             # None lookup key: matches nothing, but in optional mode the
             # row must still surface with a None payload
             return ("__pw_null__", "l", key) if k is None else k
@@ -606,13 +634,11 @@ class GraphRunner:
         node, ctx = self._row_space(base, [plan.params["threshold"],
                                            plan.params["time"]])
         comp = ExpressionCompiler(ctx)
-        thr_fn = comp.compile(plan.params["threshold"])
-        time_fn = comp.compile(plan.params["time"])
+        thr_fn = comp.compile_row(plan.params["threshold"])
+        time_fn = comp.compile_row(plan.params["time"])
 
         def scalar(fn):
-            def g(key, row):
-                return fn([key], [row])[0]
-            return g
+            return fn
 
         if kind == "buffer":
             op = tops.BufferOperator(scalar(thr_fn), scalar(time_fn))
