@@ -27,28 +27,33 @@ def _plural(n: int, singular: str, plural: str) -> str:
 
 def spawn_program(*, threads: int, processes: int, first_port: int,
                   program: str, arguments: tuple[str, ...], env_base: dict):
-    # One host process drives the TPU; scaling is logical workers sharding
-    # the dataflow in-process (engine/graph.py Scheduler) and the device
-    # mesh — not OS processes. `-n N` therefore folds into N*T logical
-    # workers of a single process instead of forking N duplicate pipelines.
-    workers = processes * threads
+    """Fork N processes of the user program, each owning T logical workers
+    (reference: cli.py:53-110,166 — PATHWAY_THREADS/PROCESSES/PROCESS_ID/
+    FIRST_PORT envs; processes cluster over TCP at FIRST_PORT+i,
+    engine/multiproc.py)."""
     click.echo(
-        f"Preparing 1 process ({_plural(workers, 'total worker', 'total workers')})",
+        f"Preparing {_plural(processes, 'process', 'processes')} "
+        f"({_plural(processes * threads, 'total worker', 'total workers')})",
         err=True)
     run_id = str(uuid.uuid4())
-    env = dict(env_base)
-    env["PATHWAY_THREADS"] = str(workers)
-    env["PATHWAY_PROCESSES"] = "1"
-    env["PATHWAY_FIRST_PORT"] = str(first_port)
-    env["PATHWAY_PROCESS_ID"] = "0"
-    env["PATHWAY_RUN_ID"] = run_id
-    handle = subprocess.Popen([program, *arguments], env=env)
+    handles = []
+    for pid in range(processes):
+        env = dict(env_base)
+        env["PATHWAY_THREADS"] = str(threads)
+        env["PATHWAY_PROCESSES"] = str(processes)
+        env["PATHWAY_FIRST_PORT"] = str(first_port)
+        env["PATHWAY_PROCESS_ID"] = str(pid)
+        env["PATHWAY_RUN_ID"] = run_id
+        handles.append(subprocess.Popen([program, *arguments], env=env))
+    rc = 0
     try:
-        handle.wait()
+        for handle in handles:
+            rc = handle.wait() or rc
     finally:
-        if handle.poll() is None:
-            handle.terminate()
-    sys.exit(handle.returncode or 0)
+        for handle in handles:
+            if handle.poll() is None:
+                handle.terminate()
+    sys.exit(rc)
 
 
 @click.group()

@@ -108,9 +108,21 @@ class Scheduler:
     """
 
     def __init__(self, graph: EngineGraph, n_workers: int = 1,
-                 parallel_threads: bool | None = None):
+                 parallel_threads: bool | None = None, cluster=None):
         self.graph = graph
-        self.n_workers = max(1, int(n_workers))
+        self.cluster = cluster
+        if cluster is not None:
+            # SPMD multi-process: n_workers is per-process; the global
+            # worker space is P x T, owned in contiguous blocks
+            # (reference: config.rs:108-120 — threads x processes)
+            per_proc = max(1, int(n_workers))
+            self.n_workers = per_proc * cluster.n_processes
+            self.local_lo = cluster.process_id * per_proc
+            self.local_hi = self.local_lo + per_proc
+        else:
+            self.n_workers = max(1, int(n_workers))
+            self.local_lo = 0
+            self.local_hi = self.n_workers
         if parallel_threads is None:
             import os
 
@@ -121,29 +133,39 @@ class Scheduler:
         # releases the GIL (numpy/XLA-heavy columnar evaluators) — for
         # pure-Python row ops the GIL serializes it, which is why it is
         # opt-in (measured in bench.py bench_etl).
+        self._local_n = self.local_hi - self.local_lo
         self._pool = None
-        if parallel_threads and self.n_workers > 1:
+        if parallel_threads and self._local_n > 1:
             from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = ThreadPoolExecutor(max_workers=self.n_workers)
+            self._pool = ThreadPoolExecutor(max_workers=self._local_n)
         import threading
 
         self._stats_lock = threading.Lock()
         self._route_cache: dict[tuple[int, int], dict] = {}
         self._topo = self._topo_sort()
-        # worker replicas per node; replica 0 is always node.op itself.
-        # Gather nodes (unpartitionable state) keep a single replica that
-        # lives on worker 0.
+        # LOCAL worker replicas per node (index = worker - local_lo);
+        # replica 0 on process 0 is always node.op itself. Gather nodes
+        # (unpartitionable state) keep one replica owned by global worker 0
+        # — i.e. by process 0; other processes hold none.
         self._replicas: dict[int, list[Operator]] = {}
         self._gather: dict[int, bool] = {}
         for node in graph.nodes:
             specs = node.op.exchange_specs()
             gather = any(s == Exchange.GATHER for s in specs)
             self._gather[node.id] = gather
-            if self.n_workers == 1 or gather:
+            if gather:
+                self._replicas[node.id] = (
+                    [node.op] if self.local_lo == 0 else [])
+            elif self.n_workers == 1:
                 self._replicas[node.id] = [node.op]
-            else:
+            elif cluster is None:
                 self._replicas[node.id] = node.op.replicate(self.n_workers)
+            else:
+                # each process replicates only its own block; replica
+                # identity across processes is irrelevant (state disjoint)
+                self._replicas[node.id] = node.op.replicate(
+                    self._local_n)
         self.stats: dict[int, dict] = {
             n.id: {"insertions": 0, "retractions": 0,
                    "latency_ms": 0.0, "total_ms": 0.0}
@@ -170,17 +192,39 @@ class Scheduler:
     def push_source(self, node: Node, delta: Delta) -> None:
         """Feed a source node, partitioning rows across workers by key
         (the in-process analogue of per-worker source reads,
-        reference src/connectors/mod.rs:400)."""
+        reference src/connectors/mod.rs:400). Under a cluster, rows whose
+        worker lives on another process are DROPPED — SPMD sources feed
+        every process the identical stream and each keeps its shard;
+        non-replicated sources forward shares explicitly first
+        (partition_remote + the streaming tick exchange)."""
         reps = self._replicas[node.id]
-        if len(reps) == 1:
+        if self.cluster is None and len(reps) == 1:
             reps[0].push(delta)
             return
+        n, lo, hi = self.n_workers, self.local_lo, self.local_hi
         parts: list[list] = [[] for _ in reps]
         for key, row, diff in delta.entries:
-            parts[int(key) % self.n_workers].append((key, row, diff))
+            w = int(key) % n
+            if lo <= w < hi:
+                parts[w - lo].append((key, row, diff))
         for rep, part in zip(reps, parts):
             if part:
                 rep.push(Delta(part))
+
+    def partition_remote(self, delta: Delta) -> dict[int, list]:
+        """Split source entries by owning process (peer id -> entries) for
+        single-reader sources whose rows must reach every process
+        (reference: 'single reader forwards for non-partitioned sources',
+        src/connectors/mod.rs ReadersQueryPurpose)."""
+        if self.cluster is None:
+            return {}
+        per_proc = (self.local_hi - self.local_lo)
+        out: dict[int, list] = {}
+        for key, row, diff in delta.entries:
+            p = (int(key) % self.n_workers) // per_proc
+            if p != self.cluster.process_id:
+                out.setdefault(p, []).append((key, row, diff))
+        return out
 
     def _topo_sort(self) -> list[Node]:
         seen: dict[int, int] = {}
@@ -266,89 +310,111 @@ class Scheduler:
 
     def _run_time_sharded(self, time: int, flush: bool) -> dict[int, Delta]:
         n = self.n_workers
-        outputs: dict[int, list[Delta]] = {}  # node.id -> per-worker deltas
+        lo, hi, L = self.local_lo, self.local_hi, self._local_n
+        cl = self.cluster
+        per_proc = L  # contiguous worker blocks of equal size per process
+        outputs: dict[int, list[Delta]] = {}  # node.id -> per-LOCAL deltas
         for node in self._topo:
             reps = self._replicas[node.id]
             if self._gather[node.id]:
-                # single owner on worker 0 consumes every worker's input
-                ins = []
-                for up in node.inputs:
-                    parts = outputs.get(up.id)
-                    merged = []
-                    for p in parts or ():
-                        merged.extend(p.entries)
-                    ins.append(Delta(merged).consolidate() if merged else _EMPTY)
-                delta = self._step_op(node, reps[0], time, ins, flush)
-                outs = [delta] + [_EMPTY] * (n - 1)
+                outs = self._step_gather(node, reps, time, flush, outputs, L)
             else:
-                specs = reps[0].exchange_specs()
+                specs = (reps[0] if reps else node.op).exchange_specs()
                 per_worker: list[list[Delta]] = [
-                    [_EMPTY] * len(node.inputs) for _ in range(n)]
+                    [_EMPTY] * len(node.inputs) for _ in range(L)]
+                # remote shares: peer -> {input j -> {global worker -> entries}}
+                send: dict[int, dict] = {}
+                exchanged = False
                 for j, up in enumerate(node.inputs):
-                    parts = outputs.get(up.id) or [_EMPTY] * n
+                    parts = outputs.get(up.id) or [_EMPTY] * L
                     spec = specs[j]
                     if spec is None:
-                        for w in range(n):
+                        for w in range(L):
                             per_worker[w][j] = parts[w]
-                    elif spec == Exchange.BY_KEY:
-                        routed = [[] for _ in range(n)]
+                        continue
+                    exchanged = True
+                    routed = [[] for _ in range(L)]
+                    if spec == Exchange.BY_KEY:
                         for p in parts:
                             for e in p.entries:  # inline: keys are ints
-                                routed[int(e[0]) % n].append(e)
-                        for w in range(n):
-                            if routed[w]:
-                                per_worker[w][j] = Delta(routed[w]).consolidate()
+                                gw = int(e[0]) % n
+                                if lo <= gw < hi:
+                                    routed[gw - lo].append(e)
+                                else:
+                                    send.setdefault(gw // per_proc, {}) \
+                                        .setdefault(j, {}) \
+                                        .setdefault(gw, []).append(e)
                     else:
-                        # non-int route values (instance columns etc.) repeat
-                        # heavily tick after tick: memoize value -> worker per
-                        # edge. Ints (already-uniform Pointers) route directly
-                        # — % is cheaper than the cache probe — and tuples are
-                        # per-row null sentinels that would never hit.
+                        # non-int route values (instance columns etc.)
+                        # repeat heavily tick after tick: memoize value ->
+                        # worker per edge. Ints (already-uniform Pointers)
+                        # route directly — % is cheaper than the cache
+                        # probe — and tuples are per-row null sentinels
+                        # that would never hit.
                         cache = self._route_cache.setdefault(
                             (node.id, j), {})
-                        routed = [[] for _ in range(n)]
                         for p in parts:
                             for e in p.entries:
                                 v = spec(e[0], e[1])
                                 if isinstance(v, int):
-                                    # Pointers and ints route by value like
-                                    # _route_value (shard = key mod n,
-                                    # shard.rs:6) — % beats a cache probe
-                                    w = int(v) % n
+                                    gw = int(v) % n
                                 elif isinstance(v, tuple):
-                                    w = self._route_value(v)
+                                    gw = self._route_value(v)
                                 else:
                                     try:
-                                        w = cache.get(v)
+                                        gw = cache.get(v)
                                     except TypeError:  # unhashable
-                                        w = self._route_value(v)
+                                        gw = self._route_value(v)
                                     else:
-                                        if w is None:
-                                            w = self._route_value(v)
+                                        if gw is None:
+                                            gw = self._route_value(v)
                                             if len(cache) < (1 << 20):
-                                                cache[v] = w
-                                routed[w].append(e)
-                        for w in range(n):
-                            if routed[w]:
-                                per_worker[w][j] = Delta(routed[w]).consolidate()
+                                                cache[v] = gw
+                                if lo <= gw < hi:
+                                    routed[gw - lo].append(e)
+                                else:
+                                    send.setdefault(gw // per_proc, {}) \
+                                        .setdefault(j, {}) \
+                                        .setdefault(gw, []).append(e)
+                    self._merge_routed(per_worker, routed, j)
                 # temporal operators share one watermark across workers
                 # (global, like a timely frontier): advance it from every
-                # worker's input before any replica releases rows on it
-                if hasattr(reps[0], "_advance_watermark"):
-                    for w in range(n):
-                        for d in per_worker[w]:
-                            if d:
-                                reps[w]._advance_watermark(d)
+                # process's pre-routing input before any replica releases
+                # rows on it — the candidate scalar rides the exchange
+                wm_local = None
+                wm_node = reps and hasattr(reps[0], "_advance_watermark")
+                if wm_node:
+                    for j, up in enumerate(node.inputs):
+                        for p in outputs.get(up.id) or ():
+                            wm_local = _wm_max(
+                                wm_local, reps[0]._watermark_candidate(p))
+                if cl is not None and (exchanged or wm_node):
+                    msgs = {p: {"rows": send.get(p), "wm": wm_local}
+                            for p in cl.peers}
+                    recv = cl.exchange(("x", time, node.id), msgs)
+                    for payload in recv.values():
+                        if payload is None:
+                            continue
+                        rows = payload.get("rows")
+                        if rows:
+                            for j, by_worker in rows.items():
+                                routed = [[] for _ in range(L)]
+                                for gw, ents in by_worker.items():
+                                    routed[gw - lo].extend(ents)
+                                self._merge_routed(per_worker, routed, j)
+                        wm_local = _wm_max(wm_local, payload.get("wm"))
+                if wm_node and wm_local is not None:
+                    reps[0]._advance_watermark_value(wm_local)
                 if self._pool is not None:
                     outs = list(self._pool.map(
                         lambda w: self._step_op(node, reps[w], time,
                                                 per_worker[w], flush),
-                        range(n)))
+                        range(L)))
                 else:
                     outs = [
                         self._step_op(node, reps[w], time, per_worker[w],
                                       flush)
-                        for w in range(n)
+                        for w in range(L)
                     ]
             outputs[node.id] = outs
             for d in outs:
@@ -357,8 +423,64 @@ class Scheduler:
             self.on_step(time)
         return _MergedOutputs(outputs)
 
+    @staticmethod
+    def _merge_routed(per_worker, routed, j) -> None:
+        for w, ents in enumerate(routed):
+            if not ents:
+                continue
+            cur = per_worker[w][j]
+            if cur is _EMPTY:
+                per_worker[w][j] = Delta(ents).consolidate()
+            else:
+                per_worker[w][j] = Delta(
+                    cur.entries + ents).consolidate()
+
+    def _step_gather(self, node, reps, time, flush, outputs, L):
+        """Gather node: one owner replica on (global) worker 0. Under a
+        cluster every process ships its input entries to process 0 and the
+        others emit nothing (the output lives where the state lives)."""
+        ins_entries: list[list] = [[] for _ in node.inputs]
+        for j, up in enumerate(node.inputs):
+            for p in outputs.get(up.id) or ():
+                ins_entries[j].extend(p.entries)
+        cl = self.cluster
+        if cl is not None:
+            if cl.process_id == 0:
+                recv = cl.exchange(("g", time, node.id),
+                                   {p: None for p in cl.peers})
+                for payload in recv.values():
+                    if payload:
+                        for j, ents in payload.items():
+                            ins_entries[j].extend(ents)
+            else:
+                mine = {j: e for j, e in enumerate(ins_entries) if e}
+                cl.exchange(("g", time, node.id),
+                            {p: (mine if p == 0 else None)
+                             for p in cl.peers})
+                return [_EMPTY] * L
+        if not reps:
+            return [_EMPTY] * L
+        ins = [Delta(e).consolidate() if e else _EMPTY
+               for e in ins_entries]
+        delta = self._step_op(node, reps[0], time, ins, flush)
+        return [delta] + [_EMPTY] * (L - 1)
+
 
 _EMPTY = Delta()
+
+
+def _wm_max(a, b):
+    """Max of two watermark candidates, tolerant of None and incomparable
+    event-time types (the per-op _advance_watermark path swallows
+    TypeError the same way — temporal_ops._gt)."""
+    if b is None:
+        return a
+    if a is None:
+        return b
+    try:
+        return b if b > a else a
+    except TypeError:
+        return a
 
 
 class _MergedOutputs:

@@ -1,0 +1,172 @@
+"""Multi-process dataflow execution: the host-level cluster plane.
+
+Rebuild of the reference's timely TCP cluster
+(src/engine/dataflow/config.rs:62-120 — ``PATHWAY_PROCESSES`` processes x
+``PATHWAY_THREADS`` workers each, sockets at ``127.0.0.1:FIRST_PORT+i``;
+CLI ``pathway spawn -n`` forks the same program per process). Every process
+runs the IDENTICAL user program (SPMD), so all build the same engine graph
+with the same node ids; global logical workers ``[0, P*T)`` are owned in
+contiguous blocks of T per process, and rows cross processes only at
+operator exchange boundaries.
+
+Transport is ``multiprocessing.connection`` over loopback/LAN TCP — the
+host-side control+exchange plane (the reference's timely ``communication``
+crate). Device-side data parallelism rides the jax mesh/ICI instead
+(parallel/mesh.py); this plane moves host rows and progress barriers, which
+are control flow, not tensor math (SURVEY §5 distributed-communication
+mapping).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from multiprocessing.connection import Client, Connection, Listener
+from typing import Any
+
+
+class Cluster:
+    """Pairwise duplex connections between the P processes of one run.
+
+    Process ``i`` listens on ``first_port + i``; every ``j > i`` dials
+    ``i``. All exchanges are bulk-synchronous: ``exchange(tag, msgs)``
+    sends one message to every peer and returns one message from every
+    peer, so each call is also a barrier (timely's progress channels
+    collapse to this under whole-batch microbatch scheduling).
+    """
+
+    def __init__(self, n_processes: int, process_id: int, first_port: int,
+                 run_id: str = ""):
+        self.n_processes = int(n_processes)
+        self.process_id = int(process_id)
+        self.first_port = int(first_port)
+        self.authkey = f"pathway-tpu/{run_id or 'cluster'}".encode()
+        self.peers: dict[int, Connection] = {}
+        self._listener: Listener | None = None
+        self._seq = 0
+
+    # -- wiring --------------------------------------------------------------
+    def connect(self, timeout_s: float = 30.0) -> None:
+        me = self.process_id
+        host = os.environ.get("PATHWAY_CLUSTER_HOST", "127.0.0.1")
+        self._listener = Listener((host, self.first_port + me),
+                                  authkey=self.authkey)
+        accepted: dict[int, Connection] = {}
+
+        def accept_loop():
+            while len(accepted) < self.n_processes - 1 - me:
+                conn = self._listener.accept()
+                peer = conn.recv()
+                accepted[peer] = conn
+
+        acceptor = None
+        if me < self.n_processes - 1:
+            acceptor = threading.Thread(target=accept_loop, daemon=True)
+            acceptor.start()
+        # dial every lower-numbered process (it is listening)
+        for peer in range(me):
+            deadline = time.monotonic() + timeout_s
+            while True:
+                try:
+                    conn = Client((host, self.first_port + peer),
+                                  authkey=self.authkey)
+                    break
+                except (ConnectionError, OSError):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"process {me}: cannot reach peer {peer} at "
+                            f"{host}:{self.first_port + peer}")
+                    time.sleep(0.05)
+            conn.send(me)
+            self.peers[peer] = conn
+        if acceptor is not None:
+            acceptor.join(timeout=timeout_s)
+            if acceptor.is_alive():
+                raise TimeoutError(
+                    f"process {me}: peers did not all connect within "
+                    f"{timeout_s}s (expected {self.n_processes - 1 - me})")
+            self.peers.update(accepted)
+
+    def close(self) -> None:
+        for conn in self.peers.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self.peers.clear()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except Exception:
+                pass
+            self._listener = None
+
+    # -- bulk-synchronous messaging -----------------------------------------
+    def exchange(self, tag: Any, msgs: dict[int, Any]) -> dict[int, Any]:
+        """Send ``msgs[peer]`` to every peer, receive one message from each.
+
+        Both directions may carry bulk payloads: sends run on a helper
+        thread while this thread receives, so two processes exchanging
+        large batches cannot deadlock on full socket buffers.
+        """
+        if not self.peers:
+            return {}
+        err: list[BaseException] = []
+
+        def send_all():
+            try:
+                for peer, conn in self.peers.items():
+                    conn.send((tag, msgs.get(peer)))
+            except BaseException as e:  # surfaced after the joins
+                err.append(e)
+
+        sender = threading.Thread(target=send_all, daemon=True)
+        sender.start()
+        out: dict[int, Any] = {}
+        for peer, conn in self.peers.items():
+            rtag, payload = conn.recv()
+            if rtag != tag:
+                raise RuntimeError(
+                    f"cluster protocol skew: process {self.process_id} "
+                    f"expected {tag!r} from {peer}, got {rtag!r}")
+            out[peer] = payload
+        sender.join()
+        if err:
+            raise err[0]
+        return out
+
+    def broadcast(self, tag: Any, obj: Any) -> dict[int, Any]:
+        """Symmetric all-to-all of one value (used for tick sync)."""
+        return self.exchange(tag, {p: obj for p in self.peers})
+
+    def barrier(self, tag: Any) -> None:
+        self.broadcast(("barrier", tag), None)
+
+
+_CLUSTER: Cluster | None = None
+
+
+def get_cluster() -> Cluster | None:
+    """Process-wide cluster from PATHWAY_* env (None when single-process).
+    Connected lazily on first use; the CLI ``spawn -n N`` sets the env for
+    each forked process (cli.py)."""
+    global _CLUSTER
+    if _CLUSTER is not None:
+        return _CLUSTER
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    if cfg.processes <= 1:
+        return None
+    _CLUSTER = Cluster(cfg.processes, cfg.process_id, cfg.first_port,
+                       os.environ.get("PATHWAY_RUN_ID", ""))
+    _CLUSTER.connect()
+    return _CLUSTER
+
+
+def reset_cluster() -> None:
+    global _CLUSTER
+    if _CLUSTER is not None:
+        _CLUSTER.close()
+    _CLUSTER = None

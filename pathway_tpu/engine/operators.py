@@ -306,7 +306,8 @@ class GroupByOperator(Operator):
     retraction of the old reduced row and the new one.
     """
 
-    def __init__(self, group_fn, reducer_specs):
+    def __init__(self, group_fn, reducer_specs,
+                 force_order_sensitive: bool = False):
         self.group_fn = group_fn
         self.reducer_specs = reducer_specs
         self.group_states: dict[Pointer, list] = {}   # gkey -> [states...]
@@ -315,8 +316,10 @@ class GroupByOperator(Operator):
         self.out = Arrangement()
         self.seq = 0
         # all other reducers are commutative multisets/semigroups — the
-        # canonical sort below is pure overhead for them
-        self._order_sensitive = any(
+        # canonical sort below is pure overhead for them. The lowering
+        # forces the sort for float sums (addition not associative: the
+        # n_workers ∈ {1, N} identity contract needs a canonical order)
+        self._order_sensitive = force_order_sensitive or any(
             name in ("earliest", "latest", "stateful")
             for name, _, _ in reducer_specs)
 
@@ -477,45 +480,80 @@ class JoinOperator(Operator):
                     out.append(okey, nrow, 1)
         return out.consolidate()
 
+    def _emit_left(self, out, jk, lk, lrow, sign) -> None:
+        """Output delta for one left row vs the CURRENT right state."""
+        rg = self.right.get(jk)
+        if rg:
+            okey, ofn = self.out_key_fn, self.out_fn
+            for rk, rrow in rg.items():
+                out.append(okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), sign)
+        elif self.mode in ("left", "outer"):
+            out.append(self.out_key_fn(lk, None, jk),
+                       self.out_fn(lk, lrow, None, None), sign)
+
+    def _emit_right(self, out, jk, rk, rrow, sign) -> None:
+        lg = self.left.get(jk)
+        if lg:
+            okey, ofn = self.out_key_fn, self.out_fn
+            for lk, lrow in lg.items():
+                out.append(okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), sign)
+        elif self.mode in ("right", "outer"):
+            out.append(self.out_key_fn(None, rk, jk),
+                       self.out_fn(None, None, rk, rrow), sign)
+
     def _step_bilinear(self, l_entries, r_entries) -> Delta:
         """Exact incremental join delta: ΔL⋈R_old + L_new⋈ΔR (+ ear
         emptiness transitions for left/right/outer) — O(delta x matches)
         instead of recomputing every affected group (the DD join_core
-        update rule the reference leans on, dataflow.rs:2276)."""
+        update rule the reference leans on, dataflow.rs:2276).
+
+        State applies ENTRY BY ENTRY while the side's delta is processed,
+        matching the recompute path's dict semantics exactly: an insert
+        over a live row is an upsert (old outputs retracted first, no-op
+        if the row is unchanged) and a retraction of an absent row emits
+        nothing. Right state stays fixed during the ΔL pass (R_old) and
+        left state is complete during the ΔR pass (L_new) — the bilinear
+        split that makes the delta exact."""
         out = Delta()
-        okey = self.out_key_fn
-        ofn = self.out_fn
         left_ear = self.mode in ("left", "outer")
         right_ear = self.mode in ("right", "outer")
-        # ΔL against R_old
-        for jk, lk, lrow, d in l_entries:
-            if jk is None:
-                continue
-            rg = self.right.get(jk)
-            if rg:
-                for rk, rrow in rg.items():
-                    out.append(okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), d)
-            elif left_ear:
-                out.append(okey(lk, None, jk), ofn(lk, lrow, None, None), d)
-        # left-group emptiness transitions flip right-side ears (vs R_old)
+        fp = row_fingerprint
+        # left-group emptiness transitions flip right-side ears; snapshot
+        # before ΔL applies
         if right_ear:
             l_empty_old: dict[Any, bool] = {}
             for jk, _, _, _ in l_entries:
                 if jk is not None and jk not in l_empty_old:
                     l_empty_old[jk] = jk not in self.left
+        # ΔL against R_old, left state applied as we go
         for jk, lk, lrow, d in l_entries:
-            if jk is not None:
-                self._apply(self.left, jk, lk, lrow, d)
+            if jk is None:
+                continue
+            lg = self.left.get(jk)
+            cur = lg.get(lk) if lg else None
+            if d > 0:
+                if cur is not None:
+                    if fp(cur) == fp(lrow):
+                        continue  # duplicate upsert: outputs unchanged
+                    self._emit_left(out, jk, lk, cur, -1)
+                self._emit_left(out, jk, lk, lrow, 1)
+                self._apply(self.left, jk, lk, lrow, 1)
+            else:
+                if cur is None:
+                    continue  # retraction of an absent row: no-op
+                self._emit_left(out, jk, lk, cur, -1)
+                self._apply(self.left, jk, lk, lrow, -1)
         if right_ear:
             for jk, was_empty in l_empty_old.items():
                 if (jk not in self.left) != was_empty:
                     rg = self.right.get(jk)
                     if rg:
                         sign = -1 if was_empty else 1
+                        okey, ofn = self.out_key_fn, self.out_fn
                         for rk, rrow in rg.items():
                             out.append(okey(None, rk, jk),
                                        ofn(None, None, rk, rrow), sign)
-        # ΔR against L_new
+        # ΔR against L_new, right state applied as we go
         if left_ear:
             r_empty_old: dict[Any, bool] = {}
             for jk, _, _, _ in r_entries:
@@ -524,15 +562,20 @@ class JoinOperator(Operator):
         for jk, rk, rrow, d in r_entries:
             if jk is None:
                 continue
-            lg = self.left.get(jk)
-            if lg:
-                for lk, lrow in lg.items():
-                    out.append(okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), d)
-            elif right_ear:
-                out.append(okey(None, rk, jk), ofn(None, None, rk, rrow), d)
-        for jk, rk, rrow, d in r_entries:
-            if jk is not None:
-                self._apply(self.right, jk, rk, rrow, d)
+            rg = self.right.get(jk)
+            cur = rg.get(rk) if rg else None
+            if d > 0:
+                if cur is not None:
+                    if fp(cur) == fp(rrow):
+                        continue
+                    self._emit_right(out, jk, rk, cur, -1)
+                self._emit_right(out, jk, rk, rrow, 1)
+                self._apply(self.right, jk, rk, rrow, 1)
+            else:
+                if cur is None:
+                    continue
+                self._emit_right(out, jk, rk, cur, -1)
+                self._apply(self.right, jk, rk, rrow, -1)
         # right-group emptiness transitions flip left-side ears (vs L_new)
         if left_ear:
             for jk, was_empty in r_empty_old.items():
@@ -540,6 +583,7 @@ class JoinOperator(Operator):
                     lg = self.left.get(jk)
                     if lg:
                         sign = -1 if was_empty else 1
+                        okey, ofn = self.out_key_fn, self.out_fn
                         for lk, lrow in lg.items():
                             out.append(okey(lk, None, jk),
                                        ofn(lk, lrow, None, None), sign)

@@ -21,7 +21,8 @@ from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
 class StreamingRuntime:
     def __init__(self, runner, *, monitoring_level=None, with_http_server=False,
                  persistence_config=None, terminate_on_error=True,
-                 default_commit_ms: int = 100, n_workers: int | None = None):
+                 default_commit_ms: int = 100, n_workers: int | None = None,
+                 cluster=None):
         from pathway_tpu.io._datasource import Session
 
         if n_workers is None:
@@ -29,7 +30,9 @@ class StreamingRuntime:
 
             n_workers = get_pathway_config().threads
         self.runner = runner
-        self.scheduler = Scheduler(runner.graph, n_workers=n_workers)
+        self.cluster = cluster
+        self.scheduler = Scheduler(runner.graph, n_workers=n_workers,
+                                   cluster=cluster)
         self.sessions = []
         self.threads = []
         self.default_commit_ms = default_commit_ms
@@ -53,6 +56,48 @@ class StreamingRuntime:
     def stop(self) -> None:
         self._stop.set()
 
+    def _drain_and_forward(self):
+        """Drain local sessions; under a cluster split each source's rows
+        by owning process (single reader on process 0 forwards shards —
+        reference: 'single reader forwards for non-partitioned sources').
+        Returns (any_data, all_closed, pushes) where pushes maps
+        peer -> {source index -> entries}."""
+        any_data = False
+        all_closed = True
+        pushes: dict[int, dict[int, list]] = {}
+        for i, (node, session, datasource) in enumerate(self.sessions):
+            entries = session.drain()
+            if entries:
+                any_data = True
+                delta = Delta(entries)
+                if self.cluster is not None:
+                    for peer, ents in self.scheduler.partition_remote(
+                            delta).items():
+                        pushes.setdefault(peer, {})[i] = ents
+                self.scheduler.push_source(node, delta)
+            if not session.closed.is_set():
+                all_closed = False
+        return any_data, all_closed, pushes
+
+    def _tick_sync(self, tick, any_data, all_closed, pushes):
+        """Cluster barrier per commit tick: exchange forwarded source rows
+        and merge liveness so all processes tick (and stop) in lockstep."""
+        if self.cluster is None:
+            return any_data, all_closed
+        msgs = {p: {"rows": pushes.get(p), "any": any_data,
+                    "closed": all_closed} for p in self.cluster.peers}
+        recv = self.cluster.exchange(("tick", tick), msgs)
+        for payload in recv.values():
+            rows = payload.get("rows")
+            if rows:
+                for i, ents in rows.items():
+                    node = self.sessions[i][0]
+                    self.scheduler.push_source(node, Delta(ents))
+                    any_data = True
+            any_data = any_data or payload["any"]
+            all_closed = all_closed and payload["closed"]
+        return any_data, all_closed
+
     def run(self) -> None:
         time_counter = 1
         if self.persistence is not None:
@@ -61,15 +106,17 @@ class StreamingRuntime:
             self.persistence is not None
             and not getattr(self.persistence.config, "continue_after_replay",
                             True))
+        reader_here = self.cluster is None or self.cluster.process_id == 0
         for node, session, datasource in self.sessions:
             live_session = session
-            if self.persistence is not None:
+            if self.persistence is not None and reader_here:
                 # replay the durable prefix into `session`, then hand the
                 # reader a recording proxy that skips the replayed count
                 live_session = self.persistence.attach_source(datasource, session)
-            if replay_only:
-                # pure replay (CLI `replay` without --continue): process the
-                # recorded prefix only — no live reader threads
+            if replay_only or not reader_here:
+                # pure replay (CLI `replay` without --continue) or a
+                # non-reading cluster process: no live reader threads —
+                # process 0 forwards this process's shard every tick
                 session.close()
             else:
                 self.threads.append(datasource.start(live_session))
@@ -80,16 +127,15 @@ class StreamingRuntime:
         # static csv) joined against live streams must be present from tick
         # one. One tick per distinct logical time, like run_batch — a
         # single collapsed batch would net out add/retract pairs that
-        # legitimately exist at different times (update streams).
-        static_times = sorted({t for _n, feed in self.runner._static_feeds
-                               for (t, _k, _r, _d) in feed})
-        for t in static_times:
+        # legitimately exist at different times (update streams). Static
+        # feeds are SPMD-identical, so no cluster forwarding is needed.
+        static_by_time, static_times = self.runner.static_feeds_by_time()
+        for t in sorted(static_times):
             any_batch = False
-            for node, feed in self.runner._static_feeds:
-                batch = Delta([(k, r, d) for (ft, k, r, d) in feed
-                               if ft == t])
+            for node, groups in static_by_time:
+                batch = groups.get(t)
                 if batch:
-                    self.scheduler.push_source(node, batch)
+                    self.scheduler.push_source(node, Delta(batch))
                     any_batch = True
             if any_batch:
                 self.scheduler.run_time(time_counter)
@@ -103,15 +149,9 @@ class StreamingRuntime:
         try:
             while not self._stop.is_set():
                 _time.sleep(commit_s)
-                any_data = False
-                all_closed = True
-                for node, session, datasource in self.sessions:
-                    entries = session.drain()
-                    if entries:
-                        any_data = True
-                        self.scheduler.push_source(node, Delta(entries))
-                    if not session.closed.is_set():
-                        all_closed = False
+                any_data, all_closed, pushes = self._drain_and_forward()
+                any_data, all_closed = self._tick_sync(
+                    time_counter, any_data, all_closed, pushes)
                 self.scheduler.run_time(time_counter)
                 self.monitor.update(self.scheduler, self.runner.graph,
                                     time_counter)
@@ -123,12 +163,10 @@ class StreamingRuntime:
                     # and closing — loop until truly empty, then final tick
                     leftovers = True
                     while leftovers:
-                        leftovers = False
-                        for node, session, datasource in self.sessions:
-                            entries = session.drain()
-                            if entries:
-                                leftovers = True
-                                self.scheduler.push_source(node, Delta(entries))
+                        any_data, _closed, pushes = self._drain_and_forward()
+                        any_data, _closed = self._tick_sync(
+                            time_counter, any_data, True, pushes)
+                        leftovers = any_data
                         if leftovers:
                             self.scheduler.run_time(time_counter)
                             time_counter += 1

@@ -79,11 +79,23 @@ class _WatermarkOp(Operator):
         return reps
 
     def _advance_watermark(self, delta: Delta) -> None:
+        self._advance_watermark_value(self._watermark_candidate(delta))
+
+    def _watermark_candidate(self, delta: Delta) -> Any:
+        """Max event-time in a delta (pre-routing): the process-local
+        contribution to the global watermark. Picklable scalar so it can
+        ride the cluster exchange (engine/multiproc.py)."""
+        best = None
         for key, row, diff in delta.entries:
             if diff > 0:
                 t = self.time_fn(key, row)
-                if t is not None and _gt(t, self.watermark):
-                    self.watermark = t
+                if t is not None and (best is None or _gt(t, best)):
+                    best = t
+        return best
+
+    def _advance_watermark_value(self, v: Any) -> None:
+        if v is not None and _gt(v, self.watermark):
+            self.watermark = v
 
 
 def _gt(a, b):

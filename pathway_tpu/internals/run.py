@@ -22,15 +22,15 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
     from pathway_tpu.internals.config import get_pathway_config
 
     cfg = get_pathway_config()
+    cluster = None
     if cfg.processes > 1:
-        # never silently run N duplicate pipelines: multi-process topology
-        # needs cross-process exchange, which this engine scales over the
-        # device mesh instead (in-process logical workers shard the
-        # dataflow; see engine/graph.py Scheduler)
-        raise NotImplementedError(
-            f"PATHWAY_PROCESSES={cfg.processes}: multi-process dataflow "
-            "execution is not supported; use PATHWAY_THREADS=N for N "
-            "sharded in-process workers (cli spawn -n folds into this)")
+        # SPMD cluster: every process runs this same program and owns a
+        # contiguous block of PATHWAY_THREADS logical workers; rows cross
+        # processes at exchange boundaries over TCP (engine/multiproc.py;
+        # reference: timely cluster, config.rs:62-120, cli spawn -n)
+        from pathway_tpu.engine.multiproc import get_cluster
+
+        cluster = get_cluster()
     from pathway_tpu.internals.telemetry import Config as TelemetryConfig
     from pathway_tpu.internals.telemetry import Telemetry
 
@@ -56,12 +56,13 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
                     runner, monitoring_level=monitoring_level,
                     with_http_server=with_http_server,
                     persistence_config=persistence_config,
-                    terminate_on_error=terminate_on_error)
+                    terminate_on_error=terminate_on_error,
+                    cluster=cluster)
                 telemetry.register_scheduler_gauges(rt.scheduler,
                                                     runner.graph)
                 rt.run()
             else:
-                runner.run_batch()
+                runner.run_batch(cluster=cluster)
     finally:
         telemetry.shutdown()
     return runner

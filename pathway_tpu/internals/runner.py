@@ -84,24 +84,18 @@ class GraphRunner:
         node = self.lower(table)
         self.graph.add_node(eng.OutputOperator(callback), [node], "subscribe")
 
-    def run_batch(self, n_workers: int | None = None) -> None:
+    def run_batch(self, n_workers: int | None = None, cluster=None) -> None:
         """Run all static feeds to completion (batch mode: one pass over the
-        totally-ordered times present in the inputs + a flush tick)."""
+        totally-ordered times present in the inputs + a flush tick). Under
+        a cluster, static feeds are deterministic SPMD replicas: every
+        process holds the same feed and keeps its worker block's shard."""
         if n_workers is None:
             from pathway_tpu.internals.config import get_pathway_config
 
             n_workers = get_pathway_config().threads
-        sched = Scheduler(self.graph, n_workers=n_workers)
-        times: set[int] = {0}
-        # group each feed by time once — scanning the whole feed per tick is
-        # O(ticks x rows) and dominates wide streaming feeds
-        by_time: list[tuple[Any, dict[int, list]]] = []
-        for node, feed in self._static_feeds:
-            groups: dict[int, list] = {}
-            for t, k, r, d in feed:
-                times.add(t)
-                groups.setdefault(t, []).append((k, r, d))
-            by_time.append((node, groups))
+        sched = Scheduler(self.graph, n_workers=n_workers, cluster=cluster)
+        by_time, feed_times = self.static_feeds_by_time()
+        times = {0} | feed_times
         for t in sorted(times):
             for node, groups in by_time:
                 batch = groups.get(t)
@@ -112,6 +106,21 @@ class GraphRunner:
         sched.run_time(max(times) + 1, flush=True)
         sched.close()  # batch run complete: release worker pool threads
         self._scheduler = sched
+
+    def static_feeds_by_time(self):
+        """Group every static feed by logical time ONCE — rescanning the
+        whole feed per tick is O(ticks x rows) and dominates wide feeds.
+        Returns ([(node, {time: [(k, r, d)]})], set_of_times); shared by
+        run_batch and the streaming runtime's startup feed."""
+        by_time: list[tuple[Any, dict[int, list]]] = []
+        times: set[int] = set()
+        for node, feed in self._static_feeds:
+            groups: dict[int, list] = {}
+            for t, k, r, d in feed:
+                times.add(t)
+                groups.setdefault(t, []).append((k, r, d))
+            by_time.append((node, groups))
+        return by_time, times
 
     # ------------------------------------------------------------------
     # lowering
@@ -260,9 +269,19 @@ class GraphRunner:
         comp = ExpressionCompiler(ctx)
         gval_fns = [comp.compile_row(e) for e in gvals_exprs]
         reducer_specs = []
+        force_sort = False
         for r in reducers:
             arg_fns = [comp.compile_row(a) for a in r._args]
             name = _engine_reducer_name(r)
+            if name in ("sum", "float_sum", "avg", "array_sum") and r._args:
+                # float addition is not associative: keep the canonical
+                # per-tick sort unless the argument is provably integral
+                from pathway_tpu.internals import dtype as _dt
+                from pathway_tpu.internals.type_inference import infer_dtype
+
+                d = _dt.unoptionalize(infer_dtype(r._args[0]))
+                if d not in (_dt.INT, _dt.BOOL):
+                    force_sort = True
             kwargs = dict(r._kwargs)
             fn = kwargs.pop("fn", None)
             spec_kwargs = {}
@@ -315,7 +334,8 @@ class GraphRunner:
                 return gkey, gvals
 
         gnode = self.graph.add_node(
-            eng.GroupByOperator(group_fn, reducer_specs),
+            eng.GroupByOperator(group_fn, reducer_specs,
+                                force_order_sensitive=force_sort),
             [node], f"groupby:{table._name}")
 
         # post-map over (gvals, reduced) rows
@@ -637,16 +657,13 @@ class GraphRunner:
         thr_fn = comp.compile_row(plan.params["threshold"])
         time_fn = comp.compile_row(plan.params["time"])
 
-        def scalar(fn):
-            return fn
-
         if kind == "buffer":
-            op = tops.BufferOperator(scalar(thr_fn), scalar(time_fn))
+            op = tops.BufferOperator(thr_fn, time_fn)
         elif kind == "forget":
-            op = tops.ForgetOperator(scalar(thr_fn), scalar(time_fn),
+            op = tops.ForgetOperator(thr_fn, time_fn,
                                      plan.params.get("mark", False))
         else:
-            op = tops.FreezeOperator(scalar(thr_fn), scalar(time_fn))
+            op = tops.FreezeOperator(thr_fn, time_fn)
         return self.graph.add_node(op, [node], kind)
 
     # -- iterate -------------------------------------------------------------

@@ -60,11 +60,15 @@ def test_spawn_multi_process_env(tmp_path):
                TEST_OUT=str(tmp_path / "out"))
     res = _run_cli("spawn", "-n", "2", sys.executable, str(prog), env=env)
     assert res.returncode == 0, res.stderr
-    # -n folds into sharded in-process workers of ONE process: exactly one
-    # pipeline runs (never N duplicate copies), results identical to -n 1
-    assert "1 process (2 total workers)" in res.stderr
-    assert _counts(tmp_path / "out0") == {"x": 2, "y": 1}
-    assert not (tmp_path / "out1").exists()
+    # -n forks a true process cluster (TCP exchange, engine/multiproc.py):
+    # each process owns a worker block and writes ITS shard of the result;
+    # the union of the shards equals the single-process answer and the
+    # shards are disjoint (state actually partitioned across processes)
+    assert "2 processes (2 total workers)" in res.stderr
+    c0 = _counts(tmp_path / "out0")
+    c1 = _counts(tmp_path / "out1")
+    assert not (set(c0) & set(c1))
+    assert {**c0, **c1} == {"x": 2, "y": 1}
 
 
 def test_record_then_replay(tmp_path):

@@ -10,6 +10,8 @@ operator state)."""
 
 from __future__ import annotations
 
+import os
+
 import pytest
 
 import pathway_tpu as pw
@@ -232,12 +234,88 @@ def test_order_sensitive_ops_identical_across_workers():
         assert _stream(c1) == _stream(cN)
 
 
-def test_multi_process_refused_loudly(monkeypatch):
-    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
-    t = T("""
-    a
-    1
-    """)
-    pw.debug.compute_and_print  # noqa: B018 — imported surface exists
-    with pytest.raises(NotImplementedError, match="PATHWAY_PROCESSES"):
-        pw.run()
+_MP_PROGRAM = """
+import json
+import os
+import sys
+
+import pathway_tpu as pw
+
+class S(pw.Schema):
+    shop: str
+    item: str
+    qty: int
+
+class I(pw.Schema):
+    item: str
+    price: int
+
+from pathway_tpu.debug import table_from_rows
+from pathway_tpu.engine.multiproc import get_cluster
+from pathway_tpu.internals.runner import GraphRunner
+
+rows = []
+for i in range(60):
+    rows.append((f"s{i % 7}", f"i{i % 13}", i % 9, 2 * (i % 4), 1))
+    if i % 11 == 0 and i > 0:
+        rows.append(rows[i - 2][:3] + (2 * (i % 4) + 2, -1))
+sales = table_from_rows(S, rows, is_stream=True)
+info = table_from_rows(I, [(f"i{j}", 10 * (j + 1)) for j in range(13)])
+totals = sales.groupby(sales.item).reduce(
+    sales.item, qty=pw.reducers.sum(sales.qty), n=pw.reducers.count())
+joined = totals.join(info, totals.item == info.item).select(
+    totals.item, revenue=totals.qty * info.price)
+
+runner = GraphRunner()
+caps = [runner.capture(t) for t in (totals, joined)]
+runner.run_batch(cluster=get_cluster())
+out = [sorted((int(k), repr(r), t, d)
+              for k, r, t, d in c.consolidated_events()) for c in caps]
+with open(sys.argv[1], "w") as f:
+    json.dump(out, f)
+"""
+
+
+def test_multi_process_batch_matches_single(tmp_path):
+    """True multi-process execution (engine/multiproc.py): 2 OS processes
+    exchange over TCP; the union of their captured shards must equal the
+    single-process result, and the shards must be disjoint (state really
+    partitioned across processes)."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    prog = tmp_path / "mp_prog.py"
+    prog.write_text(_MP_PROGRAM)
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo",
+                    PATHWAY_RUN_ID="mp-test")
+
+    def run_procs(n: int, first_port: int) -> list[list]:
+        handles = []
+        for pid in range(n):
+            env = dict(base_env, PATHWAY_PROCESSES=str(n),
+                       PATHWAY_PROCESS_ID=str(pid),
+                       PATHWAY_THREADS="2",
+                       PATHWAY_FIRST_PORT=str(first_port))
+            handles.append(subprocess.Popen(
+                [_sys.executable, str(prog), str(tmp_path / f"out_{n}_{pid}")],
+                env=env, stderr=subprocess.PIPE, text=True))
+        outs = []
+        for h in handles:
+            _, err = h.communicate(timeout=120)
+            assert h.returncode == 0, err
+        for pid in range(n):
+            outs.append(json.loads(
+                (tmp_path / f"out_{n}_{pid}").read_text()))
+        return outs
+
+    [single] = run_procs(1, 19310)
+    shards = run_procs(2, 19320)
+    for cap_i in range(len(single)):
+        merged = sorted(tuple(e) for s in shards for e in s[cap_i])
+        expect = sorted(tuple(e) for e in single[cap_i])
+        assert merged == expect
+        keys0 = {e[0] for e in shards[0][cap_i]}
+        keys1 = {e[0] for e in shards[1][cap_i]}
+        assert not (keys0 & keys1)
+        assert keys0 and keys1
