@@ -154,6 +154,10 @@ class Scheduler:
             specs = node.op.exchange_specs()
             gather = any(s == Exchange.GATHER for s in specs)
             self._gather[node.id] = gather
+            if gather and isinstance(node.op, IterateOperator):
+                # the gathered fixpoint still shards its inner rounds
+                # across this process's workers
+                node.op.inner_workers = self._local_n
             if gather:
                 self._replicas[node.id] = (
                     [node.op] if self.local_lo == 0 else [])
@@ -325,6 +329,7 @@ class Scheduler:
                 # remote shares: peer -> {input j -> {global worker -> entries}}
                 send: dict[int, dict] = {}
                 exchanged = False
+                bcast: dict[int, list] = {}  # input j -> entries for peers
                 for j, up in enumerate(node.inputs):
                     parts = outputs.get(up.id) or [_EMPTY] * L
                     spec = specs[j]
@@ -333,6 +338,19 @@ class Scheduler:
                             per_worker[w][j] = parts[w]
                         continue
                     exchanged = True
+                    if spec == Exchange.BROADCAST:
+                        # every local worker sees the complete delta; under
+                        # a cluster the local share also goes to all peers
+                        ents: list = []
+                        for p in parts:
+                            ents.extend(p.entries)
+                        if cl is not None and ents:
+                            bcast[j] = ents
+                        if ents:
+                            merged = Delta(list(ents)).consolidate()
+                            for w in range(L):
+                                per_worker[w][j] = merged
+                        continue
                     routed = [[] for _ in range(L)]
                     if spec == Exchange.BY_KEY:
                         for p in parts:
@@ -389,7 +407,8 @@ class Scheduler:
                             wm_local = _wm_max(
                                 wm_local, reps[0]._watermark_candidate(p))
                 if cl is not None and (exchanged or wm_node):
-                    msgs = {p: {"rows": send.get(p), "wm": wm_local}
+                    msgs = {p: {"rows": send.get(p), "wm": wm_local,
+                                "bcast": bcast or None}
                             for p in cl.peers}
                     recv = cl.exchange(("x", time, node.id), msgs)
                     for payload in recv.values():
@@ -402,10 +421,19 @@ class Scheduler:
                                 for gw, ents in by_worker.items():
                                     routed[gw - lo].extend(ents)
                                 self._merge_routed(per_worker, routed, j)
+                        peer_bcast = payload.get("bcast")
+                        if peer_bcast:
+                            for j, ents in peer_bcast.items():
+                                for w in range(L):
+                                    cur = per_worker[w][j]
+                                    base = cur.entries if cur is not _EMPTY \
+                                        else []
+                                    per_worker[w][j] = Delta(
+                                        base + ents).consolidate()
                         wm_local = _wm_max(wm_local, payload.get("wm"))
                 if wm_node and wm_local is not None:
                     reps[0]._advance_watermark_value(wm_local)
-                if self._pool is not None:
+                if self._pool is not None and reps[0].parallel_safe:
                     outs = list(self._pool.map(
                         lambda w: self._step_op(node, reps[w], time,
                                                 per_worker[w], flush),
@@ -555,7 +583,11 @@ class IterateOperator(Operator):
             self.n_results = len(result_nodes)
             self.emitted = [Arrangement() for _ in range(self.n_results)]
 
-        sched = Scheduler(sub)
+        # the fixpoint state gathers to one owner, but the rounds INSIDE
+        # run sharded across that process's workers (joins/groupbys in the
+        # loop body exchange by key like any other pipeline) — the
+        # owning scheduler passes its worker count down via inner_workers
+        sched = Scheduler(sub, n_workers=getattr(self, "inner_workers", 1))
         var_states = [Arrangement() for _ in range(self.n_iterated)]
         out_states = [Arrangement() for _ in range(self.n_iterated)]
         result_states = [Arrangement() for _ in range(self.n_results)]
@@ -589,6 +621,7 @@ class IterateOperator(Operator):
             if converged:
                 break
 
+        sched.close()  # inner pool released every outer tick
         out = Delta()
         self._result_offsets = []
         for i in range(self.n_results):

@@ -34,12 +34,21 @@ class ExternalIndex(Protocol):
 
 class ExternalIndexOperator(Operator):
     arity = 2  # [data, queries]
+    # replicas share one index slab; replica 0 mutates it first, so the
+    # per-worker steps must stay sequential
+    parallel_safe = False
 
     def exchange_specs(self):
-        # the TPU index is one device-resident slab: a single owner ingests
-        # all data and answers all queries (the mesh-sharded variant lives in
-        # parallel/sharded_knn.py and shards *inside* the index over ICI)
-        return [Exchange.GATHER, Exchange.GATHER]
+        # Reference semantics (operators/external_index.rs:97): the DATA
+        # stream is broadcast so every worker can answer queries, and
+        # queries stay wherever they live (parallel answering). TPU-first
+        # twist: worker replicas within a process SHARE one device-resident
+        # slab (one HBM copy; replica 0 is the sole maintainer) instead of
+        # the reference's full per-worker index copies; across processes
+        # the broadcast does duplicate the index, exactly like the
+        # reference. The mesh-sharded variant (parallel/sharded_knn.py)
+        # additionally shards *inside* the index over ICI.
+        return [Exchange.BROADCAST, None]
 
     def __init__(self, index, data_vec_pos: int, data_filter_pos: int | None,
                  query_vec_pos: int, query_limit_pos: int | None,
@@ -58,6 +67,20 @@ class ExternalIndexOperator(Operator):
         self.revise = revise
         self.answers: dict[Pointer, tuple] = {}
         self.live_queries: dict[Pointer, tuple] = {}  # key → (vec, limit, filt)
+        # replica 0 maintains the shared index; other replicas only search
+        self._is_primary = True
+
+    def replicate(self, n: int):
+        import copy
+
+        reps = [self]
+        for _ in range(n - 1):
+            r = copy.copy(self)  # share the index object, not deepcopy it
+            r.answers = {}
+            r.live_queries = {}
+            r._is_primary = False
+            reps.append(r)
+        return reps
 
     def step(self, time, in_deltas):
         from pathway_tpu.internals.error import ERROR, global_error_log
@@ -81,6 +104,11 @@ class ExternalIndexOperator(Operator):
                 add_filts.clear()
 
         data_changed = bool(data_delta.entries)
+        if not self._is_primary:
+            # the broadcast hands every replica the data delta so revise
+            # mode can re-answer, but only the primary mutates the shared
+            # slab (one scatter per process, not one per worker)
+            data_delta = Delta()
         for key, row, diff in data_delta.entries:
             if diff > 0:
                 vec = row[self.data_vec_pos]

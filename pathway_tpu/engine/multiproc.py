@@ -136,12 +136,6 @@ class Cluster:
             raise err[0]
         return out
 
-    def broadcast(self, tag: Any, obj: Any) -> dict[int, Any]:
-        """Symmetric all-to-all of one value (used for tick sync)."""
-        return self.exchange(tag, {p: obj for p in self.peers})
-
-    def barrier(self, tag: Any) -> None:
-        self.broadcast(("barrier", tag), None)
 
 
 _CLUSTER: Cluster | None = None

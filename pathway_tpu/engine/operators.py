@@ -41,16 +41,25 @@ class Exchange:
     - ``Exchange.BY_KEY``: route each entry by its row key,
     - ``Exchange.GATHER``: send everything to worker 0 (operators whose
       state cannot be partitioned, e.g. fixpoint iteration),
+    - ``Exchange.BROADCAST``: every worker (and every process, under a
+      cluster) sees the complete input delta — the reference's
+      ``.broadcast()`` on the external-index data stream
+      (operators/external_index.rs:97) and gradual_broadcast's threshold
+      stream,
     - a callable ``(key, row) -> routing value``: route by the hash of the
       returned value (join keys, group keys, instances).
     """
 
     BY_KEY = "by_key"
     GATHER = "gather"
+    BROADCAST = "broadcast"
 
 
 class Operator:
     arity = 1
+    # False for ops whose replicas share mutable state (e.g. one device
+    # slab): their per-worker steps must not run on the thread pool
+    parallel_safe = True
 
     def step(self, time: int, in_deltas: list[Delta]) -> Delta:
         raise NotImplementedError
@@ -766,10 +775,11 @@ class GradualBroadcastOperator(Operator):
         self.emitted_apx: dict[Pointer, Any] = {}
 
     def exchange_specs(self):
-        # the triplet must be visible to every row's owner; with a single
-        # logical owner the state stays consistent (the reference
-        # broadcasts the triplet stream to all workers instead)
-        return [Exchange.GATHER, Exchange.GATHER]
+        # rows shard by key; the triplet stream is broadcast so every
+        # shard applies the same thresholds (reference: the broadcast
+        # stream in gradual_broadcast.rs) — per-key apx values are
+        # independent, so sharding is exact
+        return [Exchange.BY_KEY, Exchange.BROADCAST]
 
     def _threshold_of(self, triplet) -> int:
         lower, value, upper = triplet
@@ -803,7 +813,11 @@ class GradualBroadcastOperator(Operator):
         out = Delta()
         old_triplet = self.triplet
         if d_thr:
-            for _k, row, diff in d_thr.entries:
+            # canonical order: the broadcast merges parts in arbitrary
+            # order; "last insert wins" must not depend on worker count
+            for _k, row, diff in sorted(
+                    d_thr.entries,
+                    key=lambda e: (int(e[0]), e[2], row_fingerprint(e[1]))):
                 if diff > 0:
                     self.triplet = (row[0], row[1], row[2])
         if d_rows:

@@ -152,11 +152,17 @@ class StreamingRuntime:
                 any_data, all_closed, pushes = self._drain_and_forward()
                 any_data, all_closed = self._tick_sync(
                     time_counter, any_data, all_closed, pushes)
-                self.scheduler.run_time(time_counter)
-                self.monitor.update(self.scheduler, self.runner.graph,
-                                    time_counter)
-                if self.persistence is not None:
-                    self.persistence.commit(time_counter)
+                # under a cluster an idle tick would still pay one TCP
+                # round per exchanged node inside run_time; the merged
+                # any_data is identical on every process, so skipping is
+                # SPMD-consistent (single-process keeps ticking — empty
+                # ticks are near-free and drive as-of-now retractions)
+                if self.cluster is None or any_data:
+                    self.scheduler.run_time(time_counter)
+                    self.monitor.update(self.scheduler, self.runner.graph,
+                                        time_counter)
+                    if self.persistence is not None:
+                        self.persistence.commit(time_counter)
                 time_counter += 1
                 if all_closed and not any_data:
                     # re-drain: a source may have pushed between its drain()

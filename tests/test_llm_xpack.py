@@ -21,10 +21,15 @@ from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
 
 @pw.udf
 def fake_embedder(text: str) -> np.ndarray:
-    """Deterministic bag-of-words embedding (dimension 16)."""
+    """Deterministic bag-of-words embedding (dimension 16). Uses md5, not
+    hash(): str hashing is PYTHONHASHSEED-randomized and unlucky seeds
+    collide enough to flip nearest-neighbour ranks (seed 6 did)."""
+    import hashlib
+
     vec = np.zeros(16)
     for w in str(text).lower().split():
-        vec[hash(w) % 16] += 1.0
+        h = int(hashlib.md5(w.encode()).hexdigest(), 16)
+        vec[h % 16] += 1.0
     n = np.linalg.norm(vec)
     return vec / n if n else vec
 
