@@ -600,28 +600,29 @@ class IterateOperator(Operator):
         for j, src in enumerate(extra_sources):
             src.op.push(self.input_states[self.n_iterated + j].as_delta())
 
-        rounds = 0
-        while True:
-            outputs = sched.run_time(rounds)
-            for i, node in enumerate(iter_out_nodes):
-                out_states[i].update(outputs.get(node.id, _EMPTY))
-            for i, node in enumerate(result_nodes):
-                result_states[i].update(outputs.get(node.id, _EMPTY))
-            rounds += 1
-            if self.limit is not None and rounds >= self.limit:
-                break
-            # feedback delta = body output state - variable state
-            converged = True
-            for i in range(self.n_iterated):
-                fb = _state_diff(var_states[i], out_states[i])
-                if fb:
-                    converged = False
-                    iter_sources[i].op.push(fb)
-                    var_states[i].update(fb)
-            if converged:
-                break
-
-        sched.close()  # inner pool released every outer tick
+        try:
+            rounds = 0
+            while True:
+                outputs = sched.run_time(rounds)
+                for i, node in enumerate(iter_out_nodes):
+                    out_states[i].update(outputs.get(node.id, _EMPTY))
+                for i, node in enumerate(result_nodes):
+                    result_states[i].update(outputs.get(node.id, _EMPTY))
+                rounds += 1
+                if self.limit is not None and rounds >= self.limit:
+                    break
+                # feedback delta = body output state - variable state
+                converged = True
+                for i in range(self.n_iterated):
+                    fb = _state_diff(var_states[i], out_states[i])
+                    if fb:
+                        converged = False
+                        iter_sources[i].op.push(fb)
+                        var_states[i].update(fb)
+                if converged:
+                    break
+        finally:
+            sched.close()  # inner pool released even on a failing round
         out = Delta()
         self._result_offsets = []
         for i in range(self.n_results):

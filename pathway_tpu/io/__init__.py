@@ -1,10 +1,16 @@
 """pw.io — connectors (reference: python/pathway/io/__init__.py:33-60).
 
-Implemented natively: fs/csv/jsonlines/plaintext (file readers+writers),
-python (ConnectorSubject), http (rest_connector server + streaming client),
-subscribe, null, kafka (via kafka-python if importable, else clear error).
-Cloud connectors that need absent client libraries (s3, gdrive, …) raise at
-call-time with instructions, keeping API surface and signatures.
+Every connector is implemented against its actual protocol, with no
+optional client packages: fs/csv/jsonlines/plaintext/parquet file IO,
+python (ConnectorSubject), http (rest_connector server + streaming
+client), subscribe, null, kafka, sqlite, debezium CDC, deltalake, s3/
+minio/s3_csv (REST+SigV4), postgres (wire format), elasticsearch (bulk
+REST), logstash, slack, pyfilesystem, gdrive (Drive REST), airbyte
+(protocol host over docker/pypi/executable connectors), pubsub + bigquery
+(REST sinks), nats (wire protocol), mongodb (OP_MSG+BSON). Hosted-service
+AUTH that requires absent crypto (google service-account JWT signing) is
+gated at call time with instructions; the protocols themselves are always
+in-repo.
 """
 
 from __future__ import annotations

@@ -1,5 +1,306 @@
-"""pw.io.airbyte (reference: python/pathway/io/airbyte). Gated: needs airbyte-serverless."""
+"""pw.io.airbyte — run Airbyte connectors and stream their records
+(reference: python/pathway/io/airbyte/__init__.py:97 + the vendored
+airbyte_serverless runner, third_party/airbyte_serverless/sources.py).
 
-from pathway_tpu.io._gated import gated
+This is a from-scratch host for the Airbyte protocol
+(https://docs.airbyte.com/understanding-airbyte/airbyte-protocol): any
+connector — a docker image, a console tool from the ``airbyte-source-*``
+PyPI family installed into a throwaway venv, or an arbitrary executable —
+is spoken to over stdin/stdout JSON lines: ``discover --config`` yields the
+catalog, ``read --config --catalog --state`` yields RECORD/STATE messages.
+Incremental sync: the latest STATE is fed back on the next poll cycle, so
+each refresh emits only new records. No airbyte packages are needed; the
+``executable`` method has no dependencies at all.
 
-read, write = gated("airbyte", "airbyte-serverless")
+Returns a table with a single ``data`` Json column per record, exactly like
+the reference.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+import subprocess
+import tempfile
+import time as _time
+from typing import Any, Sequence
+
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.schema import schema_from_types
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._datasource import DataSource, Session
+
+INCREMENTAL_SYNC_MODE = "incremental"
+METHOD_PYPI = "pypi"
+METHOD_DOCKER = "docker"
+METHOD_EXECUTABLE = "executable"
+
+
+class AirbyteProtocolSource:
+    """Drives one connector process through the Airbyte common interface."""
+
+    def __init__(self, command: list[str], config: dict | None,
+                 streams: Sequence[str],
+                 env_vars: dict[str, str] | None = None,
+                 mount_dir: str | None = None):
+        self.command = list(command)
+        self.config = config or {}
+        self.streams = list(streams)
+        self.env_vars = dict(env_vars or {})
+        # docker needs the temp files visible inside the container
+        self.mount_dir = mount_dir
+        self._catalog: dict | None = None
+
+    # -- process plumbing ----------------------------------------------------
+    def _run(self, args: list[str], files: dict[str, Any]) -> list[dict]:
+        """Run ``command *args`` with JSON payloads written to temp files
+        referenced by name in args; parse stdout as Airbyte messages."""
+        env = dict(os.environ, **self.env_vars)
+        with tempfile.TemporaryDirectory(dir=self.mount_dir) as td:
+            final_args = []
+            for a in args:
+                if a in files:
+                    path = os.path.join(td, a)
+                    with open(path, "w") as f:
+                        _json.dump(files[a], f)
+                    final_args.append(path)
+                else:
+                    final_args.append(a)
+            proc = subprocess.run(
+                self.command + final_args, env=env,
+                capture_output=True, text=True, timeout=3600)
+        messages = []
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if not line or not line.startswith("{"):
+                continue
+            try:
+                messages.append(_json.loads(line))
+            except _json.JSONDecodeError:
+                continue
+        if proc.returncode != 0:
+            errors = [m for m in messages if m.get("type") == "TRACE"]
+            raise RuntimeError(
+                f"airbyte connector failed (rc={proc.returncode}): "
+                f"{errors[:1] or proc.stderr[-500:]}")
+        return messages
+
+    # -- protocol steps ------------------------------------------------------
+    def check(self) -> None:
+        for m in self._run(["check", "--config", "config.json"],
+                           {"config.json": self.config}):
+            if m.get("type") == "CONNECTION_STATUS":
+                status = m["connectionStatus"]
+                if status.get("status") != "SUCCEEDED":
+                    raise RuntimeError(
+                        f"airbyte check failed: {status.get('message')}")
+                return
+
+    def discover(self) -> dict:
+        for m in self._run(["discover", "--config", "config.json"],
+                           {"config.json": self.config}):
+            if m.get("type") == "CATALOG":
+                return m["catalog"]
+        raise RuntimeError("airbyte discover produced no catalog")
+
+    @property
+    def configured_catalog(self) -> dict:
+        if self._catalog is None:
+            catalog = self.discover()
+            by_name = {s["name"]: s for s in catalog.get("streams", [])}
+            wanted = self.streams or list(by_name)
+            streams = []
+            for name in wanted:
+                if name not in by_name:
+                    raise ValueError(
+                        f"stream {name!r} not found; connector offers "
+                        f"{sorted(by_name)}")
+                stream = by_name[name]
+                modes = stream.get("supported_sync_modes", ["full_refresh"])
+                sync_mode = (INCREMENTAL_SYNC_MODE
+                             if INCREMENTAL_SYNC_MODE in modes
+                             else "full_refresh")
+                streams.append({
+                    "stream": stream,
+                    "sync_mode": sync_mode,
+                    "destination_sync_mode": "append",
+                })
+            self._catalog = {"streams": streams}
+        return self._catalog
+
+    def extract(self, state) -> tuple[list[dict], Any]:
+        """One read pass: returns (records, new_state)."""
+        args = ["read", "--config", "config.json",
+                "--catalog", "catalog.json"]
+        files = {"config.json": self.config,
+                 "catalog.json": self.configured_catalog}
+        if state is not None:
+            args += ["--state", "state.json"]
+            files["state.json"] = state
+        records = []
+        stream_states: dict[str, dict] = {}
+        legacy_state = None
+        for m in self._run(args, files):
+            mtype = m.get("type")
+            if mtype == "RECORD":
+                records.append(m["record"])
+            elif mtype == "STATE":
+                s = m.get("state", {})
+                if s.get("type") == "STREAM":
+                    desc = s["stream"]["stream_descriptor"]
+                    stream_states[desc.get("name", "")] = s
+                elif "data" in s:
+                    legacy_state = s["data"]
+        if stream_states:
+            # modern per-stream states are passed back as a list
+            prev = {}
+            if isinstance(state, list):
+                for s in state:
+                    desc = s.get("stream", {}).get("stream_descriptor", {})
+                    prev[desc.get("name", "")] = s
+            prev.update(stream_states)
+            return records, list(prev.values())
+        if legacy_state is not None:
+            return records, legacy_state
+        return records, state
+
+
+def _docker_source(docker_image: str, config, streams, env_vars,
+                   mount_dir: str | None = None) -> AirbyteProtocolSource:
+    mount_dir = mount_dir or tempfile.gettempdir()
+    command = ["docker", "run", "--rm", "-i",
+               "-v", f"{mount_dir}:{mount_dir}"]
+    for k in (env_vars or {}):
+        command += ["-e", k]
+    command.append(docker_image)
+    return AirbyteProtocolSource(command, config, streams, env_vars,
+                                 mount_dir=mount_dir)
+
+
+def _venv_source(connector_name: str, config, streams,
+                 env_vars) -> AirbyteProtocolSource:
+    """pip-install ``airbyte-{connector}`` into a cached venv and run its
+    console tool (the reference's VenvAirbyteSource, sources.py). The venv
+    lives at a stable per-connector path and is reused across runs — a
+    connector venv is ~50-100 MB and a pip install per pipeline start
+    would accumulate both disk and latency."""
+    import venv
+
+    vdir = os.path.join(tempfile.gettempdir(),
+                        f"pw-airbyte-{connector_name}")
+    tool = os.path.join(vdir, "bin", connector_name)
+    if not os.path.exists(tool):
+        venv.create(vdir, with_pip=True)
+        pip = os.path.join(vdir, "bin", "pip")
+        package = f"airbyte-{connector_name}"
+        proc = subprocess.run([pip, "install", "--quiet", package],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"pip install {package} failed (no network, or the "
+                f"connector is not on PyPI — use the docker method): "
+                f"{proc.stderr[-300:]}")
+    return AirbyteProtocolSource([tool], config, streams, env_vars)
+
+
+class AirbyteSource(DataSource):
+    name = "airbyte"
+
+    def __init__(self, schema, protocol_source: AirbyteProtocolSource,
+                 mode: str, refresh_interval_ms: int,
+                 autocommit_duration_ms=1500):
+        super().__init__(schema, autocommit_duration_ms)
+        self.protocol_source = protocol_source
+        self.mode = mode
+        self.refresh_interval_s = refresh_interval_ms / 1000.0
+        self.state = None
+
+    def run(self, session: Session) -> None:
+        seq = 0
+        while True:
+            records, self.state = self.protocol_source.extract(self.state)
+            for record in records:
+                key, row = self.row_to_engine(
+                    {"data": Json(record.get("data", {}))}, seq)
+                seq += 1
+                session.push(key, row, 1)
+            if self.mode != "streaming":
+                return
+            _time.sleep(self.refresh_interval_s)
+
+
+def _load_config(config_file_path) -> dict:
+    import yaml
+
+    with open(config_file_path) as f:
+        text = f.read()
+    # airbyte-serverless configs use ${VAR} env interpolation
+    text = os.path.expandvars(text)
+    return yaml.safe_load(text)
+
+
+def read(config_file_path: os.PathLike | str,
+         streams: Sequence[str], *,
+         execution_type: str = "local",
+         mode: str = "streaming",
+         env_vars: dict[str, str] | None = None,
+         service_user_credentials_file: str | None = None,
+         gcp_region: str = "europe-west1",
+         gcp_job_name: str | None = None,
+         enforce_method: str | None = None,
+         refresh_interval_ms: int = 60000,
+         name: str | None = None,
+         persistent_id: str | None = None) -> Table:
+    """Stream records from an Airbyte connector (reference signature,
+    io/airbyte/__init__.py:97-109). The yaml config's ``source`` section
+    carries ``docker_image`` (docker method), or a connector whose
+    ``airbyte-source-*`` package installs from PyPI (pypi method), or an
+    ``executable`` command list speaking the Airbyte protocol directly
+    (dependency-free; used by the test-suite and custom connectors)."""
+    if execution_type != "local":
+        raise NotImplementedError(
+            "remote (Google Cloud) airbyte execution needs GCP access; "
+            "run the connector locally (docker/pypi/executable)")
+    conf = _load_config(config_file_path)
+    source_conf = conf.get("source") or {}
+    config = source_conf.get("config")
+    executable = source_conf.get("executable")
+    docker_image = source_conf.get("docker_image")
+
+    if executable is not None and enforce_method in (None, METHOD_EXECUTABLE):
+        cmd = executable if isinstance(executable, list) else [executable]
+        protocol = AirbyteProtocolSource(cmd, config, streams, env_vars)
+    elif docker_image is not None:
+        connector_name = docker_image.removeprefix("airbyte/").partition(
+            ":")[0]
+        if enforce_method == METHOD_PYPI:
+            protocol = _venv_source(connector_name, config, streams, env_vars)
+        else:
+            protocol = _docker_source(docker_image, config, streams, env_vars)
+    else:
+        raise ValueError(
+            "config must provide source.docker_image or source.executable")
+
+    schema = schema_from_types(data=Json)
+    if mode == "static":
+        records, _state = protocol.extract(None)
+        keys, rows = [], []
+        src = AirbyteSource(schema, protocol, mode, refresh_interval_ms)
+        for seq, record in enumerate(records):
+            key, row = src.row_to_engine(
+                {"data": Json(record.get("data", {}))}, seq)
+            keys.append(key)
+            rows.append(row)
+        return Table(Plan("static", keys=keys, rows=rows, times=None,
+                          diffs=None), schema, Universe(),
+                     name=name or "airbyte_static")
+    source = AirbyteSource(schema, protocol, mode, refresh_interval_ms)
+    source.persistent_id = persistent_id or name
+    return Table(Plan("input", datasource=source), schema, Universe(),
+                 name=name or "airbyte_input")
+
+
+def write(*args, **kwargs):
+    raise NotImplementedError(
+        "pw.io.airbyte is source-only, matching the reference")

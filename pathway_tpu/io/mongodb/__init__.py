@@ -1,5 +1,118 @@
-"""pw.io.mongodb (reference: python/pathway/io/mongodb). Gated: needs pymongo."""
+"""pw.io.mongodb — MongoDB sink over the raw wire protocol
+(reference: python/pathway/io/mongodb in newer releases — a writer that
+appends the change stream to a collection with ``time``/``diff`` fields).
 
-from pathway_tpu.io._gated import gated
+Implemented directly on the MongoDB wire protocol: OP_MSG (opcode 2013)
+frames carrying BSON ``insert`` commands (_bson.py is the in-repo codec) —
+no pymongo. Connection strings: ``mongodb://host:port`` (no auth/SRV;
+those need an external driver).
+"""
 
-read, write = gated("mongodb", "pymongo")
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from urllib.parse import urlparse
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io.mongodb import _bson
+
+_OP_MSG = 2013
+
+
+class _MongoConn:
+    def __init__(self, connection_string: str):
+        u = urlparse(connection_string)
+        if u.scheme not in ("mongodb", ""):
+            raise ValueError(
+                f"unsupported scheme {u.scheme!r} (mongodb+srv and auth "
+                "need a full driver)")
+        self.sock = socket.create_connection(
+            (u.hostname or "127.0.0.1", u.port or 27017), timeout=30)
+        self._request_id = 0
+
+    def command(self, doc: dict) -> dict:
+        """Send one OP_MSG command document, return the reply document."""
+        self._request_id += 1
+        body = struct.pack("<I", 0) + b"\x00" + _bson.encode(doc)
+        header = struct.pack("<iiii", 16 + len(body), self._request_id, 0,
+                             _OP_MSG)
+        self.sock.sendall(header + body)
+        raw = self._read_exact(16)
+        length, _rid, _resp_to, opcode = struct.unpack("<iiii", raw)
+        payload = self._read_exact(length - 16)
+        if opcode != _OP_MSG:
+            raise ConnectionError(f"unexpected reply opcode {opcode}")
+        # flagBits(4) + section kind byte(1) + BSON doc
+        if payload[4] != 0:
+            raise ConnectionError("unexpected OP_MSG section kind")
+        return _bson.decode(payload, 5)
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("MongoDB connection closed")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def write(table: Table, *, connection_string: str, database: str,
+          collection: str, max_batch_size: int | None = None,
+          name: str | None = None) -> None:
+    """Append the table's change stream to ``database.collection``; each
+    document carries the row columns plus ``time`` and ``diff``."""
+    names = table.column_names()
+    batch_limit = max_batch_size or 1000
+
+    def binder(runner):
+        state = {"conn": None}
+        lock = threading.Lock()
+
+        def conn() -> _MongoConn:
+            if state["conn"] is None:
+                state["conn"] = _MongoConn(connection_string)
+            return state["conn"]
+
+        def insert(docs):
+            reply = conn().command({
+                "insert": collection,
+                "$db": database,
+                "documents": docs,
+            })
+            # ok:1 still accompanies per-document failures (unique-index
+            # violations etc.) — those arrive in writeErrors
+            if reply.get("ok") not in (1, 1.0) or reply.get("writeErrors"):
+                raise RuntimeError(f"mongodb insert failed: {reply}")
+
+        def callback(time, delta):
+            with lock:
+                docs = []
+                for _key, row, diff in delta.entries:
+                    doc = dict(zip(names, row))
+                    doc.update({"time": time, "diff": diff})
+                    docs.append(doc)
+                    if len(docs) >= batch_limit:
+                        insert(docs)
+                        docs = []
+                if docs:
+                    insert(docs)
+
+        runner.subscribe(table, callback)
+
+    G.add_output(binder)
+
+
+def read(*args, **kwargs):
+    raise NotImplementedError(
+        "pw.io.mongodb is sink-only (matching the reference connector, "
+        "which wraps a MongoDB writer)")

@@ -1,0 +1,118 @@
+"""Minimal BSON encoder/decoder (subset sufficient for insert commands and
+their replies) — the wire format behind pw.io.mongodb.write, implemented
+from the spec (https://bsonspec.org/spec.html) with no pymongo.
+
+Supported types: double, string, document, array, binary, bool, datetime
+(UTC ms), null, int32, int64. Everything else encodes via ``str``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import struct
+from typing import Any
+
+_INT32_MIN, _INT32_MAX = -(2**31), 2**31 - 1
+_EPOCH = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+
+
+def encode(doc: dict) -> bytes:
+    out = bytearray()
+    for key, value in doc.items():
+        _encode_element(out, str(key), value)
+    return struct.pack("<i", len(out) + 5) + bytes(out) + b"\x00"
+
+
+def _encode_element(out: bytearray, key: str, value: Any) -> None:
+    name = key.encode() + b"\x00"
+    if value is None:
+        out += b"\x0a" + name
+    elif value is True or value is False:
+        out += b"\x08" + name + (b"\x01" if value else b"\x00")
+    elif isinstance(value, int):
+        if _INT32_MIN <= value <= _INT32_MAX:
+            out += b"\x10" + name + struct.pack("<i", value)
+        else:
+            out += b"\x12" + name + struct.pack("<q", int(value))
+    elif isinstance(value, float):
+        out += b"\x01" + name + struct.pack("<d", value)
+    elif isinstance(value, str):
+        b = value.encode()
+        out += b"\x02" + name + struct.pack("<i", len(b) + 1) + b + b"\x00"
+    elif isinstance(value, bytes):
+        out += b"\x05" + name + struct.pack("<i", len(value)) + b"\x00" + value
+    elif isinstance(value, dict):
+        out += b"\x03" + name + encode(value)
+    elif isinstance(value, (list, tuple)):
+        out += b"\x04" + name + encode(
+            {str(i): v for i, v in enumerate(value)})
+    elif isinstance(value, datetime.datetime):
+        if value.tzinfo is None:
+            value = value.replace(tzinfo=datetime.timezone.utc)
+        ms = int((value - _EPOCH).total_seconds() * 1000)
+        out += b"\x09" + name + struct.pack("<q", ms)
+    else:
+        _encode_element(out, key, str(value))
+
+
+def decode(data: bytes, offset: int = 0) -> dict:
+    doc, _ = _decode_doc(data, offset)
+    return doc
+
+
+def _decode_doc(data: bytes, offset: int) -> tuple[dict, int]:
+    (length,) = struct.unpack_from("<i", data, offset)
+    end = offset + length - 1  # position of the trailing \x00
+    pos = offset + 4
+    out: dict = {}
+    while pos < end:
+        etype = data[pos]
+        pos += 1
+        name_end = data.index(b"\x00", pos)
+        key = data[pos:name_end].decode()
+        pos = name_end + 1
+        if etype == 0x0A:
+            out[key] = None
+        elif etype == 0x08:
+            out[key] = data[pos] == 1
+            pos += 1
+        elif etype == 0x10:
+            (out[key],) = struct.unpack_from("<i", data, pos)
+            pos += 4
+        elif etype == 0x12:
+            (out[key],) = struct.unpack_from("<q", data, pos)
+            pos += 8
+        elif etype == 0x01:
+            (out[key],) = struct.unpack_from("<d", data, pos)
+            pos += 8
+        elif etype == 0x02:
+            (slen,) = struct.unpack_from("<i", data, pos)
+            out[key] = data[pos + 4:pos + 4 + slen - 1].decode()
+            pos += 4 + slen
+        elif etype == 0x05:
+            (blen,) = struct.unpack_from("<i", data, pos)
+            out[key] = data[pos + 5:pos + 5 + blen]
+            pos += 5 + blen
+        elif etype == 0x03:
+            out[key], pos = _decode_doc(data, pos)
+        elif etype == 0x04:
+            arr, pos = _decode_doc(data, pos)
+            out[key] = [arr[k] for k in sorted(arr, key=int)]
+        elif etype == 0x09:
+            (ms,) = struct.unpack_from("<q", data, pos)
+            out[key] = _EPOCH + datetime.timedelta(milliseconds=ms)
+            pos += 8
+        elif etype == 0x11:  # timestamp — in every replica-set reply
+            # (operationTime / $clusterTime); (increment, seconds) u32 pair
+            inc, secs = struct.unpack_from("<II", data, pos)
+            out[key] = (secs, inc)
+            pos += 8
+        elif etype == 0x07:  # ObjectId
+            out[key] = data[pos:pos + 12].hex()
+            pos += 12
+        elif etype == 0x13:  # decimal128 — surfaced as raw bytes
+            out[key] = data[pos:pos + 16]
+            pos += 16
+        else:
+            raise ValueError(f"unsupported BSON element type 0x{etype:02x}")
+    return out, end + 1

@@ -1,5 +1,204 @@
-"""pw.io.nats (reference: python/pathway/io/nats). Gated: needs nats-py."""
+"""pw.io.nats — NATS reader/writer over the plain NATS wire protocol
+(reference: python/pathway/io/nats in newer releases; protocol:
+https://docs.nats.io/reference/reference-protocols/nats-protocol).
 
-from pathway_tpu.io._gated import gated
+The protocol is line-oriented text over TCP (INFO/CONNECT/SUB/PUB/MSG/
+PING/PONG) — implemented directly on ``socket``, no nats-py client.
+"""
 
-read, write = gated("nats", "nats-py")
+from __future__ import annotations
+
+import json as _json
+import socket
+import threading
+from urllib.parse import urlparse
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._datasource import DataSource, Session
+
+
+def _parse_uri(uri: str) -> tuple[str, int]:
+    u = urlparse(uri if "://" in uri else f"nats://{uri}")
+    return u.hostname or "127.0.0.1", u.port or 4222
+
+
+class _NatsConn:
+    """Minimal protocol client: CONNECT, SUB, PUB, PING/PONG."""
+
+    def __init__(self, uri: str, timeout: float | None = None):
+        host, port = _parse_uri(uri)
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.sock.settimeout(timeout)
+        self.buf = b""
+        info = self._read_line()  # server greets with INFO {...}
+        if not info.startswith(b"INFO"):
+            raise ConnectionError(f"not a NATS server: {info[:80]!r}")
+        self._send(b'CONNECT {"verbose":false,"pedantic":false,'
+                   b'"name":"pathway-tpu"}\r\n')
+
+    def _send(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("NATS connection closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("NATS connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def publish(self, subject: str, payload: bytes,
+                headers: dict | None = None) -> None:
+        if headers:
+            hdr = b"NATS/1.0\r\n" + b"".join(
+                f"{k}: {v}\r\n".encode() for k, v in headers.items()
+            ) + b"\r\n"
+            self._send(f"HPUB {subject} {len(hdr)} "
+                       f"{len(hdr) + len(payload)}\r\n".encode()
+                       + hdr + payload + b"\r\n")
+        else:
+            self._send(f"PUB {subject} {len(payload)}\r\n".encode()
+                       + payload + b"\r\n")
+
+    def subscribe(self, subject: str, sid: int = 1) -> None:
+        self._send(f"SUB {subject} {sid}\r\n".encode())
+
+    def next_message(self) -> bytes | None:
+        """Blocks for the next MSG payload; answers PINGs in between."""
+        while True:
+            line = self._read_line()
+            if line.startswith(b"MSG"):
+                parts = line.split()  # MSG <subject> <sid> [reply] <bytes>
+                nbytes = int(parts[-1])
+                payload = self._read_exact(nbytes)
+                self._read_exact(2)  # trailing \r\n
+                return payload
+            if line.startswith(b"HMSG"):
+                parts = line.split()
+                hdr_len, total = int(parts[-2]), int(parts[-1])
+                blob = self._read_exact(total)
+                self._read_exact(2)
+                return blob[hdr_len:]
+            if line == b"PING":
+                self._send(b"PONG\r\n")
+            elif line.startswith(b"-ERR"):
+                raise ConnectionError(f"NATS error: {line.decode()}")
+            # +OK / PONG / INFO updates ignored
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class NatsSource(DataSource):
+    name = "nats"
+
+    def __init__(self, schema, uri: str, topic: str, format: str,
+                 autocommit_duration_ms=1500):
+        super().__init__(schema, autocommit_duration_ms)
+        self.uri = uri
+        self.topic = topic
+        self.format = format
+
+    def run(self, session: Session) -> None:
+        conn = _NatsConn(self.uri)
+        conn.subscribe(self.topic)
+        seq = 0
+        try:
+            while True:
+                payload = conn.next_message()
+                if payload is None:
+                    return
+                if self.format == "json":
+                    try:
+                        values = _json.loads(payload)
+                    except _json.JSONDecodeError:
+                        continue
+                    if not isinstance(values, dict):
+                        values = {"data": Json(values)}
+                elif self.format == "plaintext":
+                    values = {"data": payload.decode(errors="replace")}
+                else:  # raw
+                    values = {"data": payload}
+                key, row = self.row_to_engine(values, seq)
+                seq += 1
+                session.push(key, row, 1)
+        finally:
+            conn.close()
+
+
+def read(uri: str, topic: str, *, schema: type[sch.Schema] | None = None,
+         format: str = "json", autocommit_duration_ms: int | None = 1500,
+         name: str | None = None, persistent_id: str | None = None,
+         **kwargs) -> Table:
+    """Subscribe to a subject and stream its messages. ``format``:
+    "json" parses each message against ``schema``; "plaintext"/"raw"
+    produce a single `data` column."""
+    if schema is None:
+        if format == "plaintext":
+            schema = sch.schema_from_types(data=dt.STR)
+        elif format == "raw":
+            schema = sch.schema_from_types(data=dt.BYTES)
+        else:
+            schema = sch.schema_from_types(data=Json)
+    source = NatsSource(schema, uri, topic, format,
+                        autocommit_duration_ms=autocommit_duration_ms)
+    source.persistent_id = persistent_id or name
+    return Table(Plan("input", datasource=source), schema, Universe(),
+                 name=name or "nats_input")
+
+
+def write(table: Table, uri: str, topic: str, *, format: str = "json",
+          name: str | None = None, **kwargs) -> None:
+    """Publish the table's change stream to a subject. JSON messages carry
+    the row columns plus ``time``/``diff``; raw/plaintext tables must have
+    one column and get time/diff as NATS headers."""
+    names = table.column_names()
+    if format in ("raw", "plaintext") and len(names) != 1:
+        raise ValueError(f"format={format!r} needs a single-column table")
+
+    def binder(runner):
+        state = {"conn": None}
+        lock = threading.Lock()
+
+        def conn() -> _NatsConn:
+            if state["conn"] is None:
+                state["conn"] = _NatsConn(uri)
+            return state["conn"]
+
+        def callback(time, delta):
+            with lock:
+                c = conn()
+                for _key, row, diff in delta.entries:
+                    if format == "json":
+                        doc = dict(zip(names, row))
+                        doc.update({"time": time, "diff": diff})
+                        payload = _json.dumps(doc, default=str).encode()
+                        c.publish(topic, payload)
+                    else:
+                        v = row[0]
+                        payload = v if isinstance(v, bytes) else str(v).encode()
+                        c.publish(topic, payload,
+                                  headers={"pathway_time": time,
+                                           "pathway_diff": diff})
+
+        runner.subscribe(table, callback)
+
+    G.add_output(binder)

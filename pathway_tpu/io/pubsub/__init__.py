@@ -1,5 +1,115 @@
-"""pw.io.pubsub (reference: python/pathway/io/pubsub). Gated: needs google-cloud-pubsub."""
+"""pw.io.pubsub — Google Cloud Pub/Sub sink
+(reference: python/pathway/io/pubsub/__init__.py:49 — publishes one binary
+column per message with ``pathway_time``/``pathway_diff`` attributes).
 
-from pathway_tpu.io._gated import gated
+Two transports:
+- a ``publisher`` object duck-typing ``pubsub_v1.PublisherClient``
+  (``.topic_path(project, topic)`` + ``.publish(topic, data, **attrs)``
+  returning a future) — exactly the reference API, usable with the real
+  google client when installed;
+- the REST transport (no google packages): ``projects/{p}/topics/{t}:publish``
+  with base64 payloads, against ``endpoint`` or the standard
+  ``PUBSUB_EMULATOR_HOST`` env var. Auth via ``access_token`` when talking
+  to real GCP.
+"""
 
-read, write = gated("pubsub", "google-cloud-pubsub")
+from __future__ import annotations
+
+import base64
+import os
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def _rest_endpoint(endpoint: str | None) -> str:
+    if endpoint:
+        return endpoint.rstrip("/")
+    emulator = os.environ.get("PUBSUB_EMULATOR_HOST")
+    if emulator:
+        return f"http://{emulator}/v1"
+    return "https://pubsub.googleapis.com/v1"
+
+
+def write(table: Table, publisher=None, project_id: str | None = None,
+          topic_id: str | None = None, *, endpoint: str | None = None,
+          access_token: str | None = None, name: str | None = None) -> None:
+    """Publish the table's change stream to a topic. The table must have
+    exactly one binary column (reference contract); each change carries
+    ``pathway_time`` and ``pathway_diff`` attributes."""
+    names = table.column_names()
+    if len(names) != 1:
+        raise ValueError(
+            "pw.io.pubsub.write requires a table with a single (binary) "
+            f"column, got {names}")
+    [col] = names
+    if project_id is None or topic_id is None:
+        raise ValueError("project_id and topic_id are required")
+
+    def payload_bytes(v) -> bytes:
+        if isinstance(v, bytes):
+            return v
+        if isinstance(v, str):
+            return v.encode()
+        raise TypeError(
+            f"pubsub payload column {col!r} must be bytes/str, got "
+            f"{type(v).__name__}")
+
+    if publisher is not None:
+        topic_path = publisher.topic_path(project_id, topic_id)
+
+        def binder(runner):
+            futures = []
+
+            def callback(time, delta):
+                for _key, row, diff in delta.entries:
+                    futures.append(publisher.publish(
+                        topic_path, payload_bytes(row[0]),
+                        pathway_time=str(time), pathway_diff=str(diff)))
+                # resolve per tick like the reference's on_time_end flush
+                for f in futures:
+                    f.result()
+                futures.clear()
+
+            runner.subscribe(table, callback)
+
+        G.add_output(binder)
+        return
+
+    url = (f"{_rest_endpoint(endpoint)}/projects/{project_id}/topics/"
+           f"{topic_id}:publish")
+
+    def binder(runner):
+        import requests
+
+        session = requests.Session()
+        headers = {"Content-Type": "application/json"}
+        if access_token:
+            headers["Authorization"] = f"Bearer {access_token}"
+
+        def callback(time, delta):
+            messages = [
+                {
+                    "data": base64.b64encode(
+                        payload_bytes(row[0])).decode(),
+                    "attributes": {"pathway_time": str(time),
+                                   "pathway_diff": str(diff)},
+                }
+                for _key, row, diff in delta.entries
+            ]
+            # the publish API caps one request at 1000 messages / 10 MB
+            for i in range(0, len(messages), 500):
+                resp = session.post(
+                    url, json={"messages": messages[i:i + 500]},
+                    headers=headers, timeout=30)
+                resp.raise_for_status()
+
+        runner.subscribe(table, callback)
+
+    G.add_output(binder)
+
+
+def read(*args, **kwargs):
+    raise NotImplementedError(
+        "pw.io.pubsub is sink-only, matching the reference (write at "
+        "io/pubsub/__init__.py:49; no reader)")

@@ -1,0 +1,576 @@
+"""Network connectors against in-test fake servers: gdrive (Drive REST),
+pubsub (REST publish), bigquery (insertAll), airbyte (protocol subprocess),
+nats (wire protocol broker), mongodb (OP_MSG + BSON).
+
+No external services or client packages: every test spins up a local
+stand-in speaking the real protocol, which is exactly what the connectors
+implement (reference test strategy: fakes injected where real services
+would go, SURVEY §4)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _clear_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _start_http(handler_cls):
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+# ---------------------------------------------------------------------------
+# gdrive
+# ---------------------------------------------------------------------------
+
+
+class _FakeDrive(BaseHTTPRequestHandler):
+    files: dict = {}
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, payload, code=200):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.headers.get("Authorization") != "Bearer tok123":
+            return self._json({"error": "unauthorized"}, 401)
+        u = urlparse(self.path)
+        q = parse_qs(u.query)
+        if u.path == "/files":
+            match = q["q"][0].split("'")[1]
+            listing = [{k: v for k, v in meta.items() if k != "_content"}
+                       for meta in self.files.values()
+                       if match in meta.get("parents", [])]
+            return self._json({"files": listing})
+        fid = u.path.split("/files/")[1].split("/")[0]
+        meta = self.files.get(fid)
+        if meta is None:
+            return self._json({"error": "notFound"}, 404)
+        if q.get("alt") == ["media"]:
+            body = meta["_content"]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        return self._json({k: v for k, v in meta.items() if k != "_content"})
+
+
+def test_gdrive_static_and_filtering():
+    _FakeDrive.files = {
+        "root": {"id": "root", "name": "dir",
+                 "mimeType": "application/vnd.google-apps.folder"},
+        "f1": {"id": "f1", "name": "a.txt", "mimeType": "text/plain",
+               "parents": ["root"], "modifiedTime": "t1", "size": "5",
+               "_content": b"hello"},
+        "f2": {"id": "f2", "name": "b.pdf", "mimeType": "application/pdf",
+               "parents": ["root"], "modifiedTime": "t1", "size": "3",
+               "_content": b"pdf"},
+        "sub": {"id": "sub", "name": "nested",
+                "mimeType": "application/vnd.google-apps.folder",
+                "parents": ["root"]},
+        "f3": {"id": "f3", "name": "c.txt", "mimeType": "text/plain",
+               "parents": ["sub"], "modifiedTime": "t1", "size": "6",
+               "_content": b"nested"},
+    }
+    server, url = _start_http(_FakeDrive)
+    try:
+        t = pw.io.gdrive.read("root", mode="static", access_token="tok123",
+                              endpoint=url, with_metadata=True)
+        rows = pw.debug.table_to_pandas(t).to_dict("records")
+        contents = sorted(r["data"] for r in rows)
+        assert contents == [b"hello", b"nested", b"pdf"]
+        # glob filtering
+        G.clear()
+        t2 = pw.io.gdrive.read("root", mode="static", access_token="tok123",
+                               endpoint=url, file_name_pattern="*.txt")
+        rows2 = pw.debug.table_to_pandas(t2).to_dict("records")
+        assert sorted(r["data"] for r in rows2) == [b"hello", b"nested"]
+    finally:
+        server.shutdown()
+
+
+def test_gdrive_streaming_update_and_delete(tmp_path):
+    _FakeDrive.files = {
+        "root": {"id": "root", "name": "dir",
+                 "mimeType": "application/vnd.google-apps.folder"},
+        "f1": {"id": "f1", "name": "a.txt", "mimeType": "text/plain",
+               "parents": ["root"], "modifiedTime": "t1", "size": "2",
+               "_content": b"v1"},
+    }
+    server, url = _start_http(_FakeDrive)
+    try:
+        t = pw.io.gdrive.read("root", mode="streaming",
+                              access_token="tok123", endpoint=url,
+                              refresh_interval=0,
+                              autocommit_duration_ms=20)
+        seen = []
+        pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                        seen.append((row["data"], is_addition)))
+
+        def mutate():
+            time.sleep(0.4)
+            _FakeDrive.files["f1"] = dict(
+                _FakeDrive.files["f1"], modifiedTime="t2", _content=b"v2")
+            time.sleep(0.4)
+            del _FakeDrive.files["f1"]
+
+        threading.Thread(target=mutate, daemon=True).start()
+        threading.Thread(target=lambda: pw.run(), daemon=True).start()
+        want = {(b"v1", True), (b"v1", False), (b"v2", True), (b"v2", False)}
+        deadline = time.time() + 12
+        while time.time() < deadline and not want <= set(seen):
+            time.sleep(0.1)
+    finally:
+        server.shutdown()
+    assert want <= set(seen)
+
+
+# ---------------------------------------------------------------------------
+# pubsub
+# ---------------------------------------------------------------------------
+
+
+class _FakePubSub(BaseHTTPRequestHandler):
+    published: list = []
+
+    def log_message(self, *args):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers["Content-Length"])
+        payload = json.loads(self.rfile.read(n))
+        self.published.append((self.path, payload))
+        body = json.dumps({"messageIds": [
+            str(i) for i in range(len(payload["messages"]))]}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_pubsub_rest_write():
+    _FakePubSub.published = []
+    server, url = _start_http(_FakePubSub)
+    try:
+        t = pw.debug.table_from_markdown("""
+        data
+        alpha
+        beta
+        """)
+        pw.io.pubsub.write(t, project_id="proj", topic_id="top",
+                           endpoint=url)
+        pw.run()
+    finally:
+        server.shutdown()
+    [(path, payload)] = _FakePubSub.published
+    assert path == "/projects/proj/topics/top:publish"
+    import base64
+
+    datas = sorted(base64.b64decode(m["data"]).decode()
+                   for m in payload["messages"])
+    assert datas == ["alpha", "beta"]
+    attrs = payload["messages"][0]["attributes"]
+    assert attrs["pathway_diff"] == "1"
+
+
+def test_pubsub_duck_typed_publisher():
+    calls = []
+
+    class _Future:
+        def result(self):
+            return "id"
+
+    class _Publisher:
+        def topic_path(self, project, topic):
+            return f"projects/{project}/topics/{topic}"
+
+        def publish(self, topic_path, data, **attrs):
+            calls.append((topic_path, data, attrs))
+            return _Future()
+
+    t = pw.debug.table_from_markdown("""
+    data
+    xyz
+    """)
+    pw.io.pubsub.write(t, _Publisher(), "proj", "top")
+    pw.run()
+    [(path, data, attrs)] = calls
+    assert path == "projects/proj/topics/top"
+    assert data == b"xyz"
+    assert attrs["pathway_diff"] == "1"
+
+
+# ---------------------------------------------------------------------------
+# bigquery
+# ---------------------------------------------------------------------------
+
+
+class _FakeBigQuery(BaseHTTPRequestHandler):
+    inserted: list = []
+
+    def log_message(self, *args):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers["Content-Length"])
+        payload = json.loads(self.rfile.read(n))
+        self.inserted.append((self.path, payload))
+        body = json.dumps({"kind": "bigquery#tableDataInsertAllResponse"}
+                          ).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_bigquery_write():
+    _FakeBigQuery.inserted = []
+    server, url = _start_http(_FakeBigQuery)
+    try:
+        t = pw.debug.table_from_markdown("""
+        name  | qty
+        bolt  | 3
+        screw | 7
+        """)
+        pw.io.bigquery.write(t, "warehouse", "parts", project_id="proj",
+                             endpoint=url)
+        pw.run()
+    finally:
+        server.shutdown()
+    [(path, payload)] = _FakeBigQuery.inserted
+    assert path == "/projects/proj/datasets/warehouse/tables/parts/insertAll"
+    rows = sorted((r["json"]["name"], r["json"]["qty"], r["json"]["diff"])
+                  for r in payload["rows"])
+    assert rows == [("bolt", 3, 1), ("screw", 7, 1)]
+
+
+# ---------------------------------------------------------------------------
+# airbyte
+# ---------------------------------------------------------------------------
+
+_FAKE_CONNECTOR = r'''#!/usr/bin/env python3
+import json, sys
+
+def emit(m):
+    print(json.dumps(m), flush=True)
+
+args = sys.argv[1:]
+cmd = args[0]
+opts = dict(zip(args[1::2], args[2::2]))
+if cmd == "discover":
+    emit({"type": "CATALOG", "catalog": {"streams": [
+        {"name": "events", "json_schema": {},
+         "supported_sync_modes": ["full_refresh", "incremental"]}]}})
+elif cmd == "read":
+    state = {}
+    if "--state" in opts:
+        with open(opts["--state"]) as f:
+            raw = json.load(f)
+        if isinstance(raw, list) and raw:
+            state = raw[0]["stream"]["stream_state"]
+    start = state.get("cursor", 0)
+    for i in range(start, start + 3):
+        emit({"type": "RECORD", "record": {
+            "stream": "events", "emitted_at": 0,
+            "data": {"n": i}}})
+    emit({"type": "STATE", "state": {
+        "type": "STREAM",
+        "stream": {"stream_descriptor": {"name": "events"},
+                   "stream_state": {"cursor": start + 3}}}})
+'''
+
+
+def _write_fake_connector(tmp_path):
+    script = tmp_path / "connector.py"
+    script.write_text(_FAKE_CONNECTOR)
+    config = tmp_path / "airbyte.yaml"
+    import sys
+
+    config.write_text(json.dumps({
+        "source": {
+            "executable": [sys.executable, str(script)],
+            "config": {"seed": 1},
+        }
+    }))
+    return config
+
+
+def test_airbyte_static_read(tmp_path):
+    config = _write_fake_connector(tmp_path)
+    t = pw.io.airbyte.read(config, ["events"], mode="static")
+    rows = pw.debug.table_to_pandas(t).to_dict("records")
+    assert sorted(r["data"].value["n"] for r in rows) == [0, 1, 2]
+
+
+def test_airbyte_incremental_state(tmp_path):
+    """Two extract cycles: the STATE from cycle 1 must feed cycle 2, so
+    records continue from the cursor instead of repeating."""
+    from pathway_tpu.io.airbyte import AirbyteProtocolSource
+    import sys
+
+    script = tmp_path / "connector.py"
+    script.write_text(_FAKE_CONNECTOR)
+    src = AirbyteProtocolSource([sys.executable, str(script)],
+                                {"seed": 1}, ["events"])
+    records1, state1 = src.extract(None)
+    assert [r["data"]["n"] for r in records1] == [0, 1, 2]
+    records2, state2 = src.extract(state1)
+    assert [r["data"]["n"] for r in records2] == [3, 4, 5]
+    assert state2[0]["stream"]["stream_state"]["cursor"] == 6
+
+
+def test_airbyte_unknown_stream_rejected(tmp_path):
+    config = _write_fake_connector(tmp_path)
+    with pytest.raises(ValueError, match="not found"):
+        pw.io.airbyte.read(config, ["nope"], mode="static")
+
+
+# ---------------------------------------------------------------------------
+# nats
+# ---------------------------------------------------------------------------
+
+
+class _FakeNatsBroker:
+    """Speaks enough of the NATS protocol to route PUB -> SUB."""
+
+    def __init__(self):
+        self.server = socket.create_server(("127.0.0.1", 0))
+        self.port = self.server.getsockname()[1]
+        self.subs: list[tuple] = []  # (conn, subject, sid)
+        self.lock = threading.Lock()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self.server.accept()
+            except OSError:
+                return
+            conn.sendall(b'INFO {"server_name":"fake"}\r\n')
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        buf = b""
+        while True:
+            try:
+                chunk = conn.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while b"\r\n" in buf:
+                line, rest = buf.split(b"\r\n", 1)
+                parts = line.split()
+                if not parts:
+                    buf = rest
+                    continue
+                verb = parts[0].upper()
+                if verb == b"PUB":
+                    nbytes = int(parts[-1])
+                    if len(rest) < nbytes + 2:
+                        break  # wait for full payload
+                    payload, rest = rest[:nbytes], rest[nbytes + 2:]
+                    self._route(parts[1].decode(), payload)
+                elif verb == b"SUB":
+                    with self.lock:
+                        self.subs.append((conn, parts[1].decode(),
+                                          parts[2].decode()))
+                buf = rest
+                continue
+            else:
+                continue
+
+    def _route(self, subject, payload):
+        with self.lock:
+            for conn, sub, sid in self.subs:
+                if sub == subject:
+                    try:
+                        conn.sendall(
+                            f"MSG {subject} {sid} {len(payload)}\r\n"
+                            .encode() + payload + b"\r\n")
+                    except OSError:
+                        pass
+
+    def close(self):
+        self.server.close()
+
+
+def test_nats_reader_receives_published_messages():
+    broker = _FakeNatsBroker()
+    uri = f"nats://127.0.0.1:{broker.port}"
+    try:
+        class S(pw.Schema):
+            word: str
+
+        incoming = pw.io.nats.read(uri, "updates", schema=S, format="json",
+                                   autocommit_duration_ms=30)
+        got = []
+        pw.io.subscribe(incoming, on_change=lambda key, row, time,
+                        is_addition: got.append(row["word"]))
+        threading.Thread(target=lambda: pw.run(), daemon=True).start()
+        # NATS is fire-and-forget: wait for the reader's SUB to register
+        # before publishing, else messages are (correctly) dropped
+        deadline = time.time() + 5
+        while time.time() < deadline and not broker.subs:
+            time.sleep(0.05)
+        assert broker.subs, "reader never subscribed"
+        from pathway_tpu.io.nats import _NatsConn
+
+        conn = _NatsConn(uri)
+        conn.publish("updates", b'{"word": "ping"}')
+        conn.publish("updates", b'{"word": "pong"}')
+        deadline = time.time() + 10
+        while time.time() < deadline and len(got) < 2:
+            time.sleep(0.05)
+    finally:
+        broker.close()
+    assert sorted(got) == ["ping", "pong"]
+
+
+def test_nats_writer_publishes_change_stream():
+    broker = _FakeNatsBroker()
+    uri = f"nats://127.0.0.1:{broker.port}"
+    try:
+        # raw protocol subscriber listening on the broker
+        from pathway_tpu.io.nats import _NatsConn
+
+        sub = _NatsConn(uri)
+        sub.subscribe("updates")
+        deadline = time.time() + 5
+        while time.time() < deadline and not broker.subs:
+            time.sleep(0.05)
+
+        src = pw.debug.table_from_markdown("""
+        word
+        ping
+        pong
+        """)
+        pw.io.nats.write(src, uri, "updates", format="json")
+        pw.run()
+        msgs = []
+        sub.sock.settimeout(5)
+        for _ in range(2):
+            msgs.append(json.loads(sub.next_message()))
+    finally:
+        broker.close()
+    assert sorted(m["word"] for m in msgs) == ["ping", "pong"]
+    assert all(m["diff"] == 1 for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# mongodb
+# ---------------------------------------------------------------------------
+
+
+class _FakeMongo:
+    def __init__(self):
+        self.server = socket.create_server(("127.0.0.1", 0))
+        self.port = self.server.getsockname()[1]
+        self.commands: list[dict] = []
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        from pathway_tpu.io.mongodb import _bson
+
+        try:
+            conn, _ = self.server.accept()
+        except OSError:
+            return
+        while True:
+            try:
+                header = self._read_exact(conn, 16)
+            except (ConnectionError, OSError):
+                return
+            length, rid, _resp, opcode = struct.unpack("<iiii", header)
+            payload = self._read_exact(conn, length - 16)
+            doc = _bson.decode(payload, 5)
+            self.commands.append(doc)
+            reply = _bson.encode({"ok": 1.0, "n": len(
+                doc.get("documents", []))})
+            body = struct.pack("<I", 0) + b"\x00" + reply
+            conn.sendall(struct.pack("<iiii", 16 + len(body), 1, rid, 2013)
+                         + body)
+
+    @staticmethod
+    def _read_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def close(self):
+        self.server.close()
+
+
+def test_mongodb_write():
+    fake = _FakeMongo()
+    try:
+        t = pw.debug.table_from_markdown("""
+        item | qty
+        nut  | 5
+        bolt | 9
+        """)
+        pw.io.mongodb.write(
+            t, connection_string=f"mongodb://127.0.0.1:{fake.port}",
+            database="warehouse", collection="parts")
+        pw.run()
+        time.sleep(0.1)
+    finally:
+        fake.close()
+    [cmd] = fake.commands
+    assert cmd["insert"] == "parts" and cmd["$db"] == "warehouse"
+    docs = sorted((d["item"], d["qty"], d["diff"])
+                  for d in cmd["documents"])
+    assert docs == [("bolt", 9, 1), ("nut", 5, 1)]
+
+
+def test_bson_roundtrip():
+    import datetime
+
+    from pathway_tpu.io.mongodb import _bson
+
+    doc = {
+        "s": "text", "i": 42, "big": 1 << 40, "f": 3.5, "b": True,
+        "none": None, "blob": b"\x00\x01", "arr": [1, "two", None],
+        "nested": {"k": "v"},
+        "ts": datetime.datetime(2026, 7, 30, 12, 0,
+                                tzinfo=datetime.timezone.utc),
+    }
+    out = _bson.decode(_bson.encode(doc))
+    assert out["s"] == "text" and out["i"] == 42 and out["big"] == 1 << 40
+    assert out["f"] == 3.5 and out["b"] is True and out["none"] is None
+    assert out["blob"] == b"\x00\x01"
+    assert out["arr"] == [1, "two", None]
+    assert out["nested"] == {"k": "v"}
+    assert out["ts"].year == 2026
