@@ -1,0 +1,75 @@
+"""Per-partition offset antichains for partitioned sources.
+
+Rebuild of the reference's ``OffsetAntichain``
+(src/persistence/frontier.rs:12): per source, the frontier of durable
+progress is a map ``partition -> highest contiguous offset``. Partitioned
+readers (Kafka topic-partitions, sharded logs) label every pushed entry
+with ``offset=("part", partition, offset)``; the persistence layer folds
+those labels into an antichain, stores it with each commit, and on resume
+hands it to the source's ``seek_offsets(antichain)`` so the reader
+continues each partition exactly past its durable prefix — no prefix
+replay assumption, robust to cross-partition interleaving.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+
+class OffsetAntichain:
+    """partition -> max offset seen; the durable frontier of one source."""
+
+    __slots__ = ("offsets",)
+
+    def __init__(self, offsets: dict | None = None):
+        self.offsets: dict[Any, Any] = dict(offsets or {})
+
+    def advance(self, partition: Any, offset: Any) -> None:
+        cur = self.offsets.get(partition)
+        if cur is None or offset > cur:
+            self.offsets[partition] = offset
+
+    def advance_from_entry_offset(self, entry_offset: Any) -> bool:
+        """Fold one entry's offset label; returns whether it was
+        partition-shaped (("part", partition, offset))."""
+        if (isinstance(entry_offset, tuple) and len(entry_offset) == 3
+                and entry_offset[0] == "part"):
+            self.advance(entry_offset[1], entry_offset[2])
+            return True
+        return False
+
+    def merge(self, other: "OffsetAntichain") -> "OffsetAntichain":
+        """Frontier union — max per partition (reference: merging worker
+        frontiers on load, persistence/state.rs:120-226)."""
+        out = OffsetAntichain(self.offsets)
+        for p, o in other.offsets.items():
+            out.advance(p, o)
+        return out
+
+    def get(self, partition: Any, default: Any = None) -> Any:
+        return self.offsets.get(partition, default)
+
+    def is_past(self, partition: Any, offset: Any) -> bool:
+        """Is ``offset`` already covered by the durable frontier?"""
+        cur = self.offsets.get(partition)
+        return cur is not None and offset <= cur
+
+    def __bool__(self) -> bool:
+        return bool(self.offsets)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, OffsetAntichain) \
+            and self.offsets == other.offsets
+
+    def __repr__(self) -> str:
+        return f"OffsetAntichain({self.offsets!r})"
+
+    def to_dict(self) -> dict:
+        return dict(self.offsets)
+
+    @classmethod
+    def from_entries(cls, offsets: Iterable[Any]) -> "OffsetAntichain":
+        out = cls()
+        for off in offsets:
+            out.advance_from_entry_offset(off)
+        return out

@@ -308,7 +308,16 @@ class PersistenceDriver:
                 offset = entry[3] if len(entry) > 3 else None
                 session.push(key, row, diff)
                 replayed.append((key, row, diff, offset))
-        if hasattr(datasource, "seek"):
+        from pathway_tpu.engine.offsets import OffsetAntichain
+
+        antichain = OffsetAntichain.from_entries(
+            off for _k, _r, _d, off in replayed)
+        if antichain and hasattr(datasource, "seek_offsets"):
+            # partitioned source: continue each partition past its durable
+            # frontier (reference OffsetAntichain, persistence/frontier.rs)
+            datasource.seek_offsets(antichain)
+            skip = 0
+        elif hasattr(datasource, "seek"):
             datasource.seek(replayed)
             skip = 0
         else:

@@ -217,9 +217,26 @@ class AirbyteSource(DataSource):
         self.state = None
 
     def run(self, session: Session) -> None:
+        import logging
+
         seq = 0
+        backoff = 1.0
         while True:
-            records, self.state = self.protocol_source.extract(self.state)
+            try:
+                records, self.state = self.protocol_source.extract(
+                    self.state)
+                backoff = 1.0
+            except (RuntimeError, OSError, subprocess.SubprocessError) as e:
+                if self.mode != "streaming":
+                    raise
+                # one failed sync cycle must not end the stream: the state
+                # is unchanged, so the next cycle re-reads the same window
+                logging.getLogger(__name__).warning(
+                    "airbyte sync failed (%s); retrying in %.0fs", e,
+                    backoff)
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 300.0)
+                continue
             for record in records:
                 key, row = self.row_to_engine(
                     {"data": Json(record.get("data", {}))}, seq)
@@ -284,14 +301,13 @@ def read(config_file_path: os.PathLike | str,
 
     schema = schema_from_types(data=Json)
     if mode == "static":
-        records, _state = protocol.extract(None)
-        keys, rows = [], []
+        from pathway_tpu.io._datasource import CollectSession
+
         src = AirbyteSource(schema, protocol, mode, refresh_interval_ms)
-        for seq, record in enumerate(records):
-            key, row = src.row_to_engine(
-                {"data": Json(record.get("data", {}))}, seq)
-            keys.append(key)
-            rows.append(row)
+        sess = CollectSession()
+        src.run(sess)  # static: one extract pass, then returns
+        keys = list(sess.state)
+        rows = [sess.state[k] for k in keys]
         return Table(Plan("static", keys=keys, rows=rows, times=None,
                           diffs=None), schema, Universe(),
                      name=name or "airbyte_static")

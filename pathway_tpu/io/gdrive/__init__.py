@@ -162,37 +162,57 @@ class GDriveSource(DataSource):
         pats = [pat] if isinstance(pat, str) else list(pat)
         return any(fnmatch.fnmatch(meta.get("name", ""), p) for p in pats)
 
+    def _poll_once(self, http, session: Session, emitted: dict,
+                   seq: int) -> int:
+        listing = self._scan(http)
+        # removals first (reference: deletions produce retractions)
+        for fid in list(emitted):
+            if fid not in listing:
+                _mtime, key, row = emitted.pop(fid)
+                session.push(key, row, -1)
+        for fid, meta in listing.items():
+            mtime = meta.get("modifiedTime")
+            prev = emitted.get(fid)
+            if prev is not None and prev[0] == mtime:
+                continue
+            content = self._download(http, meta)
+            if content is None:
+                continue
+            values = {"data": content}
+            if self.with_metadata:
+                values["_metadata"] = Json(meta)
+            key, row = self.row_to_engine(values, seq)
+            seq += 1
+            if prev is not None:
+                session.push(prev[1], prev[2], -1)
+            session.push(key, row, 1)
+            emitted[fid] = (mtime, key, row)
+        return seq
+
     # -- polling loop --------------------------------------------------------
     def run(self, session: Session) -> None:
+        import logging
+
         import requests
 
         http = requests.Session()
         emitted: dict[str, tuple] = {}  # file id -> (mtime, key, row)
         seq = 0
+        backoff = 1.0
         while True:
-            listing = self._scan(http)
-            # removals first (reference: deletions produce retractions)
-            for fid in list(emitted):
-                if fid not in listing:
-                    _mtime, key, row = emitted.pop(fid)
-                    session.push(key, row, -1)
-            for fid, meta in listing.items():
-                mtime = meta.get("modifiedTime")
-                prev = emitted.get(fid)
-                if prev is not None and prev[0] == mtime:
-                    continue
-                content = self._download(http, meta)
-                if content is None:
-                    continue
-                values = {"data": content}
-                if self.with_metadata:
-                    values["_metadata"] = Json(meta)
-                key, row = self.row_to_engine(values, seq)
-                seq += 1
-                if prev is not None:
-                    session.push(prev[1], prev[2], -1)
-                session.push(key, row, 1)
-                emitted[fid] = (mtime, key, row)
+            try:
+                seq = self._poll_once(http, session, emitted, seq)
+                backoff = 1.0
+            except (requests.RequestException, OSError) as e:
+                if self.mode != "streaming":
+                    raise
+                # Drive returns 429/5xx routinely: a transient failure must
+                # not silently end the stream — retry with backoff
+                logging.getLogger(__name__).warning(
+                    "gdrive poll failed (%s); retrying in %.0fs", e, backoff)
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 60.0)
+                continue
             if self.mode != "streaming":
                 return
             _time.sleep(self.refresh_interval)
@@ -239,22 +259,12 @@ def read(object_id: str, *,
         autocommit_duration_ms=autocommit_duration_ms)
     source.persistent_id = persistent_id or name
     if mode == "static":
-        import requests
+        from pathway_tpu.io._datasource import CollectSession
 
-        http = requests.Session()
-        keys, rows = [], []
-        seq = 0
-        for meta in source._scan(http).values():
-            content = source._download(http, meta)
-            if content is None:
-                continue
-            values = {"data": content}
-            if with_metadata:
-                values["_metadata"] = Json(meta)
-            key, row = source.row_to_engine(values, seq)
-            seq += 1
-            keys.append(key)
-            rows.append(row)
+        sess = CollectSession()
+        source.run(sess)  # mode="static": one scan pass, then returns
+        keys = list(sess.state)
+        rows = [sess.state[k] for k in keys]
         return Table(Plan("static", keys=keys, rows=rows, times=None,
                           diffs=None), schema, Universe(),
                      name=name or "gdrive_static")

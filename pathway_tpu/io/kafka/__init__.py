@@ -42,9 +42,16 @@ class KafkaSource(DataSource):
         self.settings = rdkafka_settings
         self.topic = topic
         self.format = format
+        self._resume_antichain = None
+
+    def seek_offsets(self, antichain) -> None:
+        """Persistence resume: continue each topic-partition past its
+        durable frontier (reference OffsetAntichain seek,
+        connectors/mod.rs:215-368 + persistence/frontier.rs)."""
+        self._resume_antichain = antichain
 
     def run(self, session: Session) -> None:
-        from kafka import KafkaConsumer  # type: ignore
+        from kafka import KafkaConsumer, TopicPartition  # type: ignore
 
         consumer = KafkaConsumer(
             self.topic,
@@ -53,14 +60,48 @@ class KafkaSource(DataSource):
             auto_offset_reset=self.settings.get("auto.offset.reset", "earliest"),
         )
         seq = 0
-        for msg in consumer:
+
+        def emit(msg):
+            nonlocal seq
             if self.format == "raw":
                 values = {"data": msg.value}
             else:
                 values = _json.loads(msg.value)
             key, row = self.row_to_engine(values, seq)
             seq += 1
-            session.push(key, row, 1)
+            session.push(key, row, 1,
+                         offset=("part", msg.partition, msg.offset))
+
+        if self._resume_antichain:
+            ac = self._resume_antichain
+            # group assignment happens inside poll(); loop until assigned,
+            # and do NOT drop what those polls fetch — emit anything the
+            # frontier doesn't already cover (a poll can race the seek)
+            import time as _t
+
+            deadline = _t.monotonic() + 60
+            prefetched = []
+            while not consumer.assignment():
+                batches = consumer.poll(timeout_ms=200)
+                for msgs in batches.values():
+                    prefetched.extend(msgs)
+                if _t.monotonic() > deadline:
+                    raise TimeoutError(
+                        "kafka resume: no partition assignment within 60s")
+            for tp in consumer.assignment():
+                last = ac.get(tp.partition)
+                if last is not None:
+                    consumer.seek(TopicPartition(tp.topic, tp.partition),
+                                  int(last) + 1)
+            for msg in prefetched:
+                # seeked partitions re-read from frontier+1, so their
+                # prefetched messages would double-emit; only partitions
+                # OUTSIDE the frontier (newly added) keep theirs, since
+                # the consumer position has already advanced past them
+                if ac.get(msg.partition) is None:
+                    emit(msg)
+        for msg in consumer:
+            emit(msg)
 
 
 def read(rdkafka_settings: dict, topic: str | None = None, *, schema=None,

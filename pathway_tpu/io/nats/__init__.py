@@ -118,30 +118,47 @@ class NatsSource(DataSource):
         self.format = format
 
     def run(self, session: Session) -> None:
-        conn = _NatsConn(self.uri)
-        conn.subscribe(self.topic)
+        import logging
+        import time as _time
+
         seq = 0
-        try:
-            while True:
-                payload = conn.next_message()
-                if payload is None:
-                    return
-                if self.format == "json":
-                    try:
-                        values = _json.loads(payload)
-                    except _json.JSONDecodeError:
-                        continue
-                    if not isinstance(values, dict):
-                        values = {"data": Json(values)}
-                elif self.format == "plaintext":
-                    values = {"data": payload.decode(errors="replace")}
-                else:  # raw
-                    values = {"data": payload}
-                key, row = self.row_to_engine(values, seq)
-                seq += 1
-                session.push(key, row, 1)
-        finally:
-            conn.close()
+        backoff = 1.0
+        while True:
+            conn = None
+            try:
+                conn = _NatsConn(self.uri)
+                conn.subscribe(self.topic)
+                backoff = 1.0
+                while True:
+                    payload = conn.next_message()
+                    if payload is None:
+                        return
+                    if self.format == "json":
+                        try:
+                            values = _json.loads(payload)
+                        except _json.JSONDecodeError:
+                            continue
+                        if not isinstance(values, dict):
+                            values = {"data": Json(values)}
+                    elif self.format == "plaintext":
+                        values = {"data": payload.decode(errors="replace")}
+                    else:  # raw
+                        values = {"data": payload}
+                    key, row = self.row_to_engine(values, seq)
+                    seq += 1
+                    session.push(key, row, 1)
+            except (ConnectionError, OSError) as e:
+                # server restarts/drops must not end the stream: NATS
+                # clients reconnect and resubscribe (core NATS is
+                # fire-and-forget, so the gap is protocol-inherent)
+                logging.getLogger(__name__).warning(
+                    "nats connection lost (%s); reconnecting in %.0fs",
+                    e, backoff)
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+            finally:
+                if conn is not None:
+                    conn.close()
 
 
 def read(uri: str, topic: str, *, schema: type[sch.Schema] | None = None,

@@ -1,0 +1,267 @@
+"""Dependency-free document text extraction: PDF, DOCX, PPTX, HTML.
+
+Backs ParseUnstructured when the `unstructured` package is absent
+(reference: xpacks/llm/parsers.py ParseUnstructured — there the heavy
+lifting is the unstructured-io library; here the common formats are parsed
+directly: PDF content streams are tokenized after FlateDecode, OOXML is
+zip+XML via the stdlib, HTML via html.parser).
+
+PDF scope: simple-font text operators (Tj/TJ/'/") in FlateDecode or plain
+streams — covers machine-generated text PDFs; CID-keyed/Type0 subset fonts
+need a full CMap implementation and come out garbled (the reference's
+answer there is also an external library).
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import zipfile
+import zlib
+from html.parser import HTMLParser
+from xml.etree import ElementTree
+
+
+# ---------------------------------------------------------------------------
+# format sniffing
+# ---------------------------------------------------------------------------
+
+def detect_format(raw: bytes) -> str:
+    if raw[:5] == b"%PDF-":
+        return "pdf"
+    if raw[:2] == b"PK":
+        try:
+            with zipfile.ZipFile(io.BytesIO(raw)) as z:
+                names = set(z.namelist())
+        except zipfile.BadZipFile:
+            return "binary"
+        if "word/document.xml" in names:
+            return "docx"
+        if any(n.startswith("ppt/slides/") for n in names):
+            return "pptx"
+        if any(n.startswith("xl/") for n in names):
+            return "xlsx"
+        return "zip"
+    head = raw[:1024].lstrip().lower()
+    if head.startswith(b"<!doctype html") or head.startswith(b"<html") \
+            or b"<body" in head:
+        return "html"
+    return "text"
+
+
+# ---------------------------------------------------------------------------
+# PDF
+# ---------------------------------------------------------------------------
+
+_STREAM_RE = re.compile(rb"<<(.*?)>>\s*stream\r?\n", re.DOTALL)
+_STRING_TOKEN = re.compile(
+    rb"\((?:\\.|[^\\()])*\)"      # (literal string) with escapes
+    rb"|<[0-9A-Fa-f\s]*>"          # <hex string>
+    rb"|\[|\]"
+    rb"|[A-Za-z'\"*]+"             # operators
+    rb"|[-+.0-9]+"                 # numbers
+)
+_ESCAPES = {
+    ord("n"): "\n", ord("r"): "\r", ord("t"): "\t", ord("b"): "\b",
+    ord("f"): "\f", ord("("): "(", ord(")"): ")", ord("\\"): "\\",
+}
+
+
+def _decode_pdf_string(tok: bytes) -> str:
+    if tok.startswith(b"<"):
+        hexstr = re.sub(rb"\s", b"", tok[1:-1])
+        if len(hexstr) % 2:
+            hexstr += b"0"
+        try:
+            return bytes.fromhex(hexstr.decode()).decode(
+                "latin-1", errors="replace")
+        except ValueError:
+            return ""
+    body = tok[1:-1]
+    out = []
+    i = 0
+    while i < len(body):
+        c = body[i]
+        if c == 0x5C and i + 1 < len(body):  # backslash
+            nxt = body[i + 1]
+            if nxt in _ESCAPES:
+                out.append(_ESCAPES[nxt])
+                i += 2
+                continue
+            if 0x30 <= nxt <= 0x37:  # octal \ddd
+                j = i + 1
+                digits = b""
+                while j < len(body) and len(digits) < 3 \
+                        and 0x30 <= body[j] <= 0x37:
+                    digits += bytes([body[j]])
+                    j += 1
+                out.append(chr(int(digits, 8)))
+                i = j
+                continue
+            i += 1
+            continue
+        out.append(chr(c))
+        i += 1
+    return "".join(out)
+
+
+def _extract_content_text(content: bytes) -> str:
+    """Tokenize one content stream, keeping text-showing operators."""
+    lines: list[str] = []
+    current: list[str] = []
+    pending: list[str] = []  # strings seen since the last operator
+    for m in _STRING_TOKEN.finditer(content):
+        tok = m.group(0)
+        c = tok[:1]
+        if c == b"(" or c == b"<":
+            pending.append(_decode_pdf_string(tok))
+        elif c.isalpha() or tok in (b"'", b'"'):
+            op = tok
+            if op in (b"Tj", b"TJ"):
+                current.extend(pending)
+            elif op in (b"'", b'"'):
+                # move-to-next-line + show
+                if current:
+                    lines.append("".join(current))
+                    current = []
+                current.extend(pending)
+            elif op in (b"Td", b"TD", b"T*"):
+                if current:
+                    lines.append("".join(current))
+                    current = []
+            elif op == b"ET":
+                if current:
+                    lines.append("".join(current))
+                    current = []
+            pending = []
+        elif tok in (b"[", b"]"):
+            continue
+        # numbers: ignored (kerning/positions)
+    if current:
+        lines.append("".join(current))
+    return "\n".join(line for line in lines if line.strip())
+
+
+def extract_pdf(raw: bytes) -> list[str]:
+    """Text of each content stream (≈ page) in document order."""
+    pages: list[str] = []
+    pos = 0
+    while True:
+        m = _STREAM_RE.search(raw, pos)
+        if m is None:
+            break
+        start = m.end()
+        end = raw.find(b"endstream", start)
+        if end < 0:
+            break
+        data = raw[start:end].rstrip(b"\r\n")
+        header = m.group(1)
+        if b"FlateDecode" in header:
+            try:
+                data = zlib.decompress(data)
+            except zlib.error:
+                pos = end + 9
+                continue
+        if b"BT" in data:
+            text = _extract_content_text(data)
+            if text:
+                pages.append(text)
+        pos = end + 9
+    return pages
+
+
+# ---------------------------------------------------------------------------
+# OOXML (docx / pptx) + HTML
+# ---------------------------------------------------------------------------
+
+_W_NS = "{http://schemas.openxmlformats.org/wordprocessingml/2006/main}"
+_A_NS = "{http://schemas.openxmlformats.org/drawingml/2006/main}"
+
+
+def extract_docx(raw: bytes) -> list[str]:
+    """Paragraph texts from word/document.xml."""
+    with zipfile.ZipFile(io.BytesIO(raw)) as z:
+        tree = ElementTree.fromstring(z.read("word/document.xml"))
+    out = []
+    for para in tree.iter(f"{_W_NS}p"):
+        text = "".join(t.text or "" for t in para.iter(f"{_W_NS}t"))
+        if text.strip():
+            out.append(text)
+    return out
+
+
+def extract_pptx(raw: bytes) -> list[str]:
+    """One text blob per slide, in slide order."""
+    with zipfile.ZipFile(io.BytesIO(raw)) as z:
+        slides = sorted(
+            (n for n in z.namelist()
+             if re.fullmatch(r"ppt/slides/slide\d+\.xml", n)),
+            key=lambda n: int(re.search(r"\d+", n).group()))
+        out = []
+        for name in slides:
+            tree = ElementTree.fromstring(z.read(name))
+            texts = [t.text or "" for t in tree.iter(f"{_A_NS}t")]
+            blob = "\n".join(t for t in texts if t.strip())
+            if blob:
+                out.append(blob)
+    return out
+
+
+class _TextHTMLParser(HTMLParser):
+    _SKIP = {"script", "style", "head", "noscript", "template"}
+    _BREAKS = {"p", "div", "br", "li", "tr", "h1", "h2", "h3", "h4", "h5",
+               "h6", "section", "article", "table"}
+
+    def __init__(self):
+        super().__init__(convert_charrefs=True)
+        self.parts: list[str] = []
+        self._skip_depth = 0
+
+    def handle_starttag(self, tag, attrs):
+        if tag in self._SKIP:
+            self._skip_depth += 1
+        elif tag in self._BREAKS:
+            self.parts.append("\n")
+
+    def handle_endtag(self, tag):
+        if tag in self._SKIP and self._skip_depth:
+            self._skip_depth -= 1
+        elif tag in self._BREAKS:
+            self.parts.append("\n")
+
+    def handle_data(self, data):
+        if not self._skip_depth and data.strip():
+            self.parts.append(data)
+
+
+def extract_html(raw: bytes) -> list[str]:
+    parser = _TextHTMLParser()
+    parser.feed(raw.decode("utf-8", errors="replace"))
+    text = "".join(parser.parts)
+    return [line.strip() for line in text.splitlines() if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# unified entry
+# ---------------------------------------------------------------------------
+
+def extract_elements(raw: bytes) -> list[tuple[str, dict]]:
+    """[(text, metadata)] for any supported format — the shape
+    ParseUnstructured's elements mode returns."""
+    fmt = detect_format(raw)
+    if fmt == "pdf":
+        return [(text, {"page_number": i + 1, "category": "Page",
+                        "filetype": "pdf"})
+                for i, text in enumerate(extract_pdf(raw))]
+    if fmt == "docx":
+        return [(text, {"category": "Paragraph", "filetype": "docx"})
+                for text in extract_docx(raw)]
+    if fmt == "pptx":
+        return [(text, {"page_number": i + 1, "category": "Slide",
+                        "filetype": "pptx"})
+                for i, text in enumerate(extract_pptx(raw))]
+    if fmt == "html":
+        return [(text, {"category": "Text", "filetype": "html"})
+                for text in extract_html(raw)]
+    return [(raw.decode("utf-8", errors="replace"),
+             {"category": "Text", "filetype": "text"})]

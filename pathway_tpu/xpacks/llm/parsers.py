@@ -1,9 +1,12 @@
 """Document parsers — UDFs mapping raw bytes to [(text, metadata)] chunks.
 
 Reference: xpacks/llm/parsers.py (ParseUtf8, ParseUnstructured,
-ParseOpenParse — PDF layout/tables/vision). ``ParseUtf8`` is native here;
-the heavyweight parsers import their libraries lazily and raise a clear
-error when absent (this image has no unstructured/openparse).
+ParseOpenParse — PDF layout/tables/vision). ``ParseUtf8`` is native;
+``ParseUnstructured`` uses the unstructured-io library when installed and
+otherwise falls back to the in-repo extractors (_doc_extract.py: PDF
+content-stream tokenizing, DOCX/PPTX zip+XML, HTML) — so the common
+document formats parse with zero optional dependencies. ``ParseOpenParse``
+similarly falls back to per-page PDF extraction when openparse is absent.
 """
 
 from __future__ import annotations
@@ -11,7 +14,6 @@ from __future__ import annotations
 from typing import Any
 
 from pathway_tpu.internals import udfs
-from pathway_tpu.xpacks.llm._utils import _import_or_raise
 
 
 def _as_text(contents: Any) -> str:
@@ -41,12 +43,14 @@ class ParseUnstructured(udfs.UDF):
         self.partition_kwargs = partition_kwargs
 
     def __wrapped__(self, contents: Any, **kwargs) -> list[tuple[str, dict]]:
-        partition = _import_or_raise(
-            "unstructured.partition.auto", "ParseUnstructured")
-        import io
-
         raw = contents if isinstance(contents, bytes) \
             else str(contents).encode()
+        try:
+            from unstructured.partition import auto as partition
+        except ImportError:
+            return self._fallback(raw)
+        import io
+
         elements = partition.partition(
             file=io.BytesIO(raw), **{**self.partition_kwargs, **kwargs})
         for proc in self.post_processors:
@@ -68,6 +72,26 @@ class ParseUnstructured(udfs.UDF):
             out.append((str(e), meta))
         return out
 
+    def _fallback(self, raw: bytes) -> list[tuple[str, dict]]:
+        from pathway_tpu.xpacks.llm._doc_extract import extract_elements
+
+        elements = extract_elements(raw)
+        # post_processors written for unstructured Elements receive plain
+        # text here (no Element objects exist without the library) —
+        # str -> str processors like clean_extra_whitespace work unchanged
+        for proc in self.post_processors:
+            elements = [(proc(text), meta) for text, meta in elements]
+        if self.mode == "single":
+            return [("\n\n".join(text for text, _m in elements), {})]
+        if self.mode == "paged":
+            pages: dict[int, list] = {}
+            for text, meta in elements:
+                page = meta.get("page_number", 1)
+                pages.setdefault(page, []).append(text)
+            return [("\n\n".join(texts), {"page_number": page})
+                    for page, texts in sorted(pages.items())]
+        return elements
+
 
 class ParseOpenParse(udfs.UDF):
     """openparse PDF layout parser (reference ParseOpenParse +
@@ -82,12 +106,18 @@ class ParseOpenParse(udfs.UDF):
         self.llm = llm
 
     def __wrapped__(self, contents: Any, **kwargs) -> list[tuple[str, dict]]:
-        openparse = _import_or_raise("openparse", "ParseOpenParse")
-        import io
-        import tempfile
-
         raw = contents if isinstance(contents, bytes) \
             else str(contents).encode()
+        try:
+            import openparse
+        except ImportError:
+            # layout/tables need openparse; plain text still extracts
+            from pathway_tpu.xpacks.llm._doc_extract import extract_pdf
+
+            return [(text, {"page_number": i + 1})
+                    for i, text in enumerate(extract_pdf(raw))]
+        import tempfile
+
         parser = openparse.DocumentParser(table_args=self.table_args)
         with tempfile.NamedTemporaryFile(suffix=".pdf") as f:
             f.write(raw)

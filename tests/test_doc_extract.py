@@ -1,0 +1,137 @@
+"""Dependency-free document extraction (xpacks/llm/_doc_extract.py):
+PDF content streams, DOCX/PPTX OOXML, HTML — the fallback engine behind
+ParseUnstructured/ParseOpenParse (reference parses these via the
+unstructured/openparse libraries, xpacks/llm/parsers.py)."""
+
+from __future__ import annotations
+
+import io
+import zipfile
+import zlib
+
+from pathway_tpu.xpacks.llm._doc_extract import (
+    detect_format,
+    extract_docx,
+    extract_elements,
+    extract_html,
+    extract_pdf,
+    extract_pptx,
+)
+from pathway_tpu.xpacks.llm.parsers import ParseOpenParse, ParseUnstructured
+
+
+def make_pdf(pages: list[list[str]], compress=True) -> bytes:
+    """Tiny but structurally real PDF: one content stream per page."""
+    out = io.BytesIO()
+    out.write(b"%PDF-1.4\n")
+    for lines in pages:
+        ops = [b"BT", b"/F1 12 Tf"]
+        for line in lines:
+            esc = line.replace("\\", r"\\").replace("(", r"\(") \
+                      .replace(")", r"\)")
+            ops.append(f"({esc}) Tj".encode())
+            ops.append(b"0 -14 Td")
+        ops.append(b"ET")
+        content = b"\n".join(ops)
+        if compress:
+            content = zlib.compress(content)
+            hdr = b"<< /Length %d /Filter /FlateDecode >>" % len(content)
+        else:
+            hdr = b"<< /Length %d >>" % len(content)
+        out.write(b"1 0 obj\n" + hdr + b"\nstream\n" + content +
+                  b"\nendstream\nendobj\n")
+    out.write(b"%%EOF\n")
+    return out.getvalue()
+
+
+def make_docx(paragraphs: list[str]) -> bytes:
+    ns = "http://schemas.openxmlformats.org/wordprocessingml/2006/main"
+    body = "".join(
+        f'<w:p><w:r><w:t>{p}</w:t></w:r></w:p>' for p in paragraphs)
+    doc = (f'<?xml version="1.0"?><w:document xmlns:w="{ns}">'
+           f'<w:body>{body}</w:body></w:document>')
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("[Content_Types].xml", "<Types/>")
+        z.writestr("word/document.xml", doc)
+    return buf.getvalue()
+
+
+def make_pptx(slides: list[list[str]]) -> bytes:
+    ns = "http://schemas.openxmlformats.org/drawingml/2006/main"
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("[Content_Types].xml", "<Types/>")
+        for i, texts in enumerate(slides, 1):
+            runs = "".join(f"<a:t>{t}</a:t>" for t in texts)
+            z.writestr(f"ppt/slides/slide{i}.xml",
+                       f'<?xml version="1.0"?><p:sld '
+                       f'xmlns:a="{ns}" xmlns:p="x">{runs}</p:sld>')
+    return buf.getvalue()
+
+
+def test_detect_format():
+    assert detect_format(make_pdf([["x"]])) == "pdf"
+    assert detect_format(make_docx(["x"])) == "docx"
+    assert detect_format(make_pptx([["x"]])) == "pptx"
+    assert detect_format(b"<html><body>hi</body></html>") == "html"
+    assert detect_format(b"plain words") == "text"
+
+
+def test_pdf_flate_and_plain():
+    for compress in (True, False):
+        raw = make_pdf([["Hello TPU world", "second line"],
+                        ["page two here"]], compress=compress)
+        pages = extract_pdf(raw)
+        assert len(pages) == 2
+        assert "Hello TPU world" in pages[0]
+        assert "second line" in pages[0]
+        assert "page two here" in pages[1]
+
+
+def test_pdf_escapes_and_hex_and_tj_array():
+    content = (b"BT (paren \\( inside\\)) Tj 0 -14 Td "
+               b"<48656C6C6F> Tj T* "
+               b"[(kerned ) -120 (array)] TJ ET")
+    raw = (b"%PDF-1.4\n1 0 obj\n<< /Length " + str(len(content)).encode()
+           + b" >>\nstream\n" + content + b"\nendstream\nendobj\n%%EOF")
+    [page] = extract_pdf(raw)
+    assert "paren ( inside)" in page
+    assert "Hello" in page
+    assert "kerned array" in page
+
+
+def test_docx_pptx_html():
+    assert extract_docx(make_docx(["alpha beta", "gamma"])) == \
+        ["alpha beta", "gamma"]
+    slides = extract_pptx(make_pptx([["title", "bullet"], ["closing"]]))
+    assert slides == ["title\nbullet", "closing"]
+    html = (b"<html><head><style>p{}</style><script>var x;</script></head>"
+            b"<body><h1>Title</h1><p>one</p><p>two &amp; three</p></body>"
+            b"</html>")
+    lines = extract_html(html)
+    assert lines == ["Title", "one", "two & three"]
+    assert all("var x" not in line for line in lines)
+
+
+def test_parse_unstructured_fallback_modes():
+    pdf = make_pdf([["page one text"], ["page two text"]])
+    single = ParseUnstructured(mode="single").__wrapped__(pdf)
+    assert len(single) == 1 and "page one text" in single[0][0]
+    paged = ParseUnstructured(mode="paged").__wrapped__(pdf)
+    assert [m["page_number"] for _t, m in paged] == [1, 2]
+    elements = ParseUnstructured(mode="elements").__wrapped__(
+        make_docx(["first", "second"]))
+    assert [t for t, _m in elements] == ["first", "second"]
+    assert elements[0][1]["filetype"] == "docx"
+
+
+def test_parse_openparse_fallback():
+    pdf = make_pdf([["content here"]])
+    nodes = ParseOpenParse().__wrapped__(pdf)
+    assert nodes and "content here" in nodes[0][0]
+
+
+def test_extract_elements_plain_text():
+    [(text, meta)] = extract_elements("just text".encode())
+    assert text == "just text" and meta["filetype"] == "text"
