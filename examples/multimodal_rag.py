@@ -1,0 +1,75 @@
+"""Multimodal RAG serving template (BASELINE config 4: CLIP image+text
+embeddings into one live index; reference counterpart: the multimodal
+gpt-4o template built on SlidesVectorStoreServer,
+xpacks/llm/vector_store.py:571).
+
+Watches a directory of images, embeds them with the in-repo CLIP dual
+encoder (models/clip.py), and serves cross-modal retrieval over REST:
+text queries are embedded by the TEXT tower into the same space the
+images live in, so `/v1/retrieve` returns the matching image files.
+
+Run:
+    python examples/multimodal_rag.py ./images --port 8080
+then:
+    curl -X POST localhost:8080/v1/retrieve \
+         -d '{"query": "a red square", "k": 2}'
+
+With random weights retrieval is structural only; pass --params to load
+trained CLIP weights (np.savez of the param tree) for meaningful ranking.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.models.clip import ClipConfig
+from pathway_tpu.stdlib.indexing import default_brute_force_knn_document_index
+from pathway_tpu.io.http import PathwayWebserver, rest_connector
+from pathway_tpu.xpacks.llm.embedders import ClipEmbedder
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("images", help="directory of image files (png/jpg)")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny CLIP config (tests/offline smoke)")
+    args = ap.parse_args()
+
+    config = ClipConfig.tiny() if args.tiny else ClipConfig()
+    emb = ClipEmbedder(config=config)
+    image_udf = emb.image()
+
+    images = pw.io.fs.read(args.images, format="binary", mode="streaming",
+                           with_metadata=True)
+    images = images.select(
+        path=pw.apply(lambda m: m.value.get("path") if m else None,
+                      images._metadata),
+        vec=image_udf(images.data),
+    )
+    index = default_brute_force_knn_document_index(
+        images.vec, images, dimensions=config.embed_dim)
+
+    class QuerySchema(pw.Schema):
+        query: str
+        k: int = 2
+
+    ws = PathwayWebserver(host=args.host, port=args.port)
+    queries, writer = rest_connector(
+        webserver=ws, route="/v1/retrieve", schema=QuerySchema,
+        delete_completed_queries=True)
+    qv = queries.select(queries.k, vec=emb(queries.query))
+    hits = index.query_as_of_now(qv.vec, number_of_matches=qv.k)
+    results = qv.select(
+        result=pw.apply(lambda paths: list(paths or ()),
+                        hits.restrict(qv).path))
+    writer(results)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+
+if __name__ == "__main__":
+    main()

@@ -79,6 +79,7 @@ class GDriveSource(DataSource):
         self.with_metadata = with_metadata
         self.object_size_limit = object_size_limit
         self.file_name_pattern = file_name_pattern
+        self._seq = 0  # instance state: partial progress survives retries
 
     # -- REST calls ----------------------------------------------------------
     def _headers(self) -> dict:
@@ -162,8 +163,7 @@ class GDriveSource(DataSource):
         pats = [pat] if isinstance(pat, str) else list(pat)
         return any(fnmatch.fnmatch(meta.get("name", ""), p) for p in pats)
 
-    def _poll_once(self, http, session: Session, emitted: dict,
-                   seq: int) -> int:
+    def _poll_once(self, http, session: Session, emitted: dict) -> None:
         listing = self._scan(http)
         # removals first (reference: deletions produce retractions)
         for fid in list(emitted):
@@ -181,13 +181,12 @@ class GDriveSource(DataSource):
             values = {"data": content}
             if self.with_metadata:
                 values["_metadata"] = Json(meta)
-            key, row = self.row_to_engine(values, seq)
-            seq += 1
+            key, row = self.row_to_engine(values, self._seq)
+            self._seq += 1
             if prev is not None:
                 session.push(prev[1], prev[2], -1)
             session.push(key, row, 1)
             emitted[fid] = (mtime, key, row)
-        return seq
 
     # -- polling loop --------------------------------------------------------
     def run(self, session: Session) -> None:
@@ -197,11 +196,10 @@ class GDriveSource(DataSource):
 
         http = requests.Session()
         emitted: dict[str, tuple] = {}  # file id -> (mtime, key, row)
-        seq = 0
         backoff = 1.0
         while True:
             try:
-                seq = self._poll_once(http, session, emitted, seq)
+                self._poll_once(http, session, emitted)
                 backoff = 1.0
             except (requests.RequestException, OSError) as e:
                 if self.mode != "streaming":

@@ -77,17 +77,22 @@ class KafkaSource(DataSource):
             # group assignment happens inside poll(); loop until assigned,
             # and do NOT drop what those polls fetch — emit anything the
             # frontier doesn't already cover (a poll can race the seek)
+            import logging
             import time as _t
 
-            deadline = _t.monotonic() + 60
+            warn_at = _t.monotonic() + 60
             prefetched = []
             while not consumer.assignment():
                 batches = consumer.poll(timeout_ms=200)
                 for msgs in batches.values():
                     prefetched.extend(msgs)
-                if _t.monotonic() > deadline:
-                    raise TimeoutError(
-                        "kafka resume: no partition assignment within 60s")
+                if _t.monotonic() > warn_at:
+                    # slow rebalance/broker outage: keep waiting (a fresh
+                    # start would block in the iterator the same way)
+                    logging.getLogger(__name__).warning(
+                        "kafka resume: still waiting for partition "
+                        "assignment")
+                    warn_at = _t.monotonic() + 60
             for tp in consumer.assignment():
                 last = ac.get(tp.partition)
                 if last is not None:

@@ -9,6 +9,13 @@ and optional ring/Ulysses attention for long sequences
 (pathway_tpu/parallel/ring_attention.py).
 """
 
+from pathway_tpu.models.clip import (
+    ClipConfig,
+    clip_train_step,
+    encode_image,
+    encode_text,
+    init_clip_params,
+)
 from pathway_tpu.models.encoder import (
     EncoderConfig,
     encode,
@@ -23,8 +30,13 @@ from pathway_tpu.models.train import (
 )
 
 __all__ = [
+    "ClipConfig",
     "EncoderConfig",
+    "clip_train_step",
     "encode",
+    "encode_image",
+    "encode_text",
+    "init_clip_params",
     "init_params",
     "param_pspecs",
     "HashTokenizer",

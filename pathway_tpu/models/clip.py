@@ -1,0 +1,193 @@
+"""CLIP-style dual encoder (image + text) for multimodal RAG.
+
+The reference's multimodal template embeds images with API vision models
+(BASELINE config 4: "Multimodal RAG (CLIP image+text embeddings)"); this
+is the TPU-native counterpart: a ViT image tower and the in-repo text
+encoder projected into one shared embedding space, trained contrastively
+(InfoNCE both directions, the CLIP objective). All matmuls bfloat16 on the
+MXU; patchify is a single reshape+matmul (no conv needed for square
+non-overlapping patches); towers are jittable and mesh-shardable like the
+flagship encoder (models/encoder.py param_pspecs applies to the text
+tower; the vision tower shares the same layer structure).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models.encoder import (
+    EncoderConfig,
+    _attention_block,
+    _dense_attention,
+    _dense_init,
+    _layer_norm,
+    _mlp_block,
+    encode,
+    init_params,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    vision_hidden: int = 192
+    vision_layers: int = 6
+    vision_heads: int = 6
+    vision_intermediate: int = 768
+    embed_dim: int = 128
+    text: EncoderConfig = dataclasses.field(
+        default_factory=lambda: EncoderConfig(pooling="cls",
+                                              normalize=False))
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def vision_encoder_config(self) -> EncoderConfig:
+        """The vision tower reuses the text encoder's block functions via
+        an EncoderConfig carrying its dimensions."""
+        return EncoderConfig(
+            hidden=self.vision_hidden, heads=self.vision_heads,
+            intermediate=self.vision_intermediate,
+            layers=self.vision_layers, pooling="cls", normalize=False,
+            compute_dtype=self.compute_dtype)
+
+    @staticmethod
+    def tiny(**kw) -> "ClipConfig":
+        base = dict(image_size=16, patch_size=4, vision_hidden=32,
+                    vision_layers=2, vision_heads=4,
+                    vision_intermediate=64, embed_dim=16,
+                    text=EncoderConfig.tiny(pooling="cls", normalize=False))
+        base.update(kw)
+        return ClipConfig(**base)
+
+
+def init_clip_params(key, config: ClipConfig) -> dict:
+    kv, kt, kp, kq, kr, kc = jax.random.split(key, 6)
+    Hv = config.vision_hidden
+    P = config.patch_size
+    vis_cfg = config.vision_encoder_config
+    vision = init_params(kv, dataclasses.replace(
+        vis_cfg, vocab_size=1, max_len=config.n_patches + 1))
+    # vision embeddings are patches, not tokens: replace the lookup tables
+    vision["embeddings"] = {
+        "patch_w": _dense_init(kp, (P * P * config.channels, Hv)),
+        "patch_b": jnp.zeros((Hv,), jnp.float32),
+        "cls": _dense_init(kc, (Hv,)),
+        "position": _dense_init(kq, (config.n_patches + 1, Hv)),
+        "ln_scale": jnp.ones((Hv,), jnp.float32),
+        "ln_bias": jnp.zeros((Hv,), jnp.float32),
+    }
+    return {
+        "vision": vision,
+        "text": init_params(kt, config.text),
+        "vision_proj": _dense_init(kr, (Hv, config.embed_dim)),
+        "text_proj": _dense_init(
+            jax.random.fold_in(kr, 1), (config.text.hidden,
+                                        config.embed_dim)),
+        "logit_scale": jnp.asarray(jnp.log(1.0 / 0.07), jnp.float32),
+    }
+
+
+def _patchify(pixels, config: ClipConfig):
+    """(B, H, W, C) -> (B, n_patches, P*P*C): a reshape/transpose — the
+    patch projection is then one MXU matmul."""
+    B = pixels.shape[0]
+    P = config.patch_size
+    n = config.image_size // P
+    x = pixels.reshape(B, n, P, n, P, config.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, n * n, P * P * config.channels)
+
+
+def encode_image(params: dict, pixels, *, config: ClipConfig):
+    """(B, H, W, C) float in [0, 1] -> (B, embed_dim) L2-normalized."""
+    vis = params["vision"]
+    emb = vis["embeddings"]
+    cd = config.compute_dtype
+    cfg = config.vision_encoder_config
+    x = _patchify(pixels.astype(cd), config)
+    x = x @ emb["patch_w"].astype(cd) + emb["patch_b"].astype(cd)
+    cls = jnp.broadcast_to(emb["cls"].astype(cd)[None, None],
+                           (x.shape[0], 1, x.shape[-1]))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + emb["position"][None].astype(cd)
+    x = _layer_norm(x, emb["ln_scale"], emb["ln_bias"],
+                    cfg.layer_norm_eps, out_dtype=cd)
+    mask = jnp.ones(x.shape[:2], bool)
+    for layer in vis["layers"]:
+        x = _attention_block(x, layer["attn"], mask, cfg, _dense_attention)
+        x = _mlp_block(x, layer["mlp"], cfg)
+    # mean over PATCH tokens (CLS excluded): at init a CLS readout is
+    # dominated by its own residual stream and carries ~1e-3 of the input
+    # signal, which stalls small-scale contrastive training; patch-mean is
+    # directly input-dependent from step 0 and trains reliably
+    pooled = jnp.mean(x[:, 1:].astype(jnp.float32), axis=1)
+    out = pooled @ params["vision_proj"]
+    return out / jnp.maximum(jnp.linalg.norm(out, axis=-1, keepdims=True),
+                             1e-12)
+
+
+def encode_text(params: dict, token_ids, attention_mask, *,
+                config: ClipConfig):
+    """(B, S) tokens -> (B, embed_dim) L2-normalized."""
+    pooled = encode(params["text"], token_ids, attention_mask,
+                    config=config.text)
+    out = pooled @ params["text_proj"]
+    return out / jnp.maximum(jnp.linalg.norm(out, axis=-1, keepdims=True),
+                             1e-12)
+
+
+def clip_loss(params: dict, batch: dict, *, config: ClipConfig):
+    """Symmetric InfoNCE over in-batch negatives (the CLIP objective)."""
+    img = encode_image(params, batch["pixels"], config=config)
+    txt = encode_text(params, batch["ids"], batch["mask"], config=config)
+    scale = jnp.exp(jnp.clip(params["logit_scale"], -5.0, jnp.log(100.0)))
+    logits = (img @ txt.T) * scale
+    labels = jnp.arange(logits.shape[0])
+    li = -jnp.mean(jax.nn.log_softmax(logits, axis=1)[labels, labels])
+    lt = -jnp.mean(jax.nn.log_softmax(logits, axis=0)[labels, labels])
+    return (li + lt) / 2
+
+
+def make_clip_optimizer(lr: float = 1e-3):
+    import optax
+
+    return optax.adam(lr)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "optimizer"))
+def clip_train_step(params, opt_state, batch, *, config: ClipConfig,
+                    optimizer):
+    """One Adam step (templates/tests; production training composes
+    models/train.py's mesh-sharded state instead)."""
+    loss, grads = jax.value_and_grad(
+        lambda p: clip_loss(p, batch, config=config))(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    import optax
+
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def load_image(raw: bytes, *, config: ClipConfig):
+    """Decode+resize image bytes to the model's (H, W, C) float array.
+    PIL decodes (in-image); callers may also pass ndarrays directly to
+    encode_image and skip this."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(raw)).convert("RGB").resize(
+        (config.image_size, config.image_size))
+    return np.asarray(img, dtype=np.float32) / 255.0

@@ -263,5 +263,12 @@ def extract_elements(raw: bytes) -> list[tuple[str, dict]]:
     if fmt == "html":
         return [(text, {"category": "Text", "filetype": "html"})
                 for text in extract_html(raw)]
+    if fmt in ("xlsx", "zip", "binary"):
+        # decoding known-binary formats as UTF-8 would index mojibake as
+        # if it were text — fail loudly like the pre-fallback behavior
+        raise ValueError(
+            f"unsupported document format {fmt!r}: the dependency-free "
+            "extractors cover pdf/docx/pptx/html/plain text; install "
+            "`unstructured` for other formats")
     return [(raw.decode("utf-8", errors="replace"),
              {"category": "Text", "filetype": "text"})]

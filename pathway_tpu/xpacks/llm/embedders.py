@@ -182,3 +182,89 @@ class GeminiEmbedder(_RemoteEmbedder):
             genai.embed_content, content=input or ".",
             **{**self.kwargs, **kwargs})
         return np.array(resp["embedding"])
+
+
+class ClipEmbedder(BaseEmbedder):
+    """Multimodal embedder over the in-repo CLIP dual encoder
+    (models/clip.py) — the TPU-native counterpart of the reference's
+    multimodal template (BASELINE config 4: CLIP image+text into one
+    index). ``__call__`` embeds text columns; ``image()`` embeds binary
+    image columns into the SAME space, so one KNN index serves cross-modal
+    retrieval."""
+
+    def __init__(self, *, config=None, params=None, tokenizer=None,
+                 seed: int = 0, **kwargs):
+        kwargs.setdefault("batch", True)
+        kwargs.setdefault("deterministic", True)
+        super().__init__(**kwargs)
+        import jax
+
+        from pathway_tpu.models import clip as _clip
+        from pathway_tpu.models.tokenizer import HashTokenizer
+
+        self.config = config or _clip.ClipConfig()
+        self.params = params if params is not None else \
+            _clip.init_clip_params(jax.random.PRNGKey(seed), self.config)
+        self.tokenizer = tokenizer or HashTokenizer(
+            vocab_size=self.config.text.vocab_size,
+            max_len=self.config.text.max_len)
+        cfg = self.config
+        self._encode_text = jax.jit(
+            lambda p, ids, mask: _clip.encode_text(p, ids, mask,
+                                                   config=cfg))
+        self._encode_image = jax.jit(
+            lambda p, px: _clip.encode_image(p, px, config=cfg))
+        self._clip = _clip
+
+    _BUCKETS = JaxEncoderEmbedder._BUCKETS
+
+    def embed_text_batch(self, texts: list[str]) -> np.ndarray:
+        max_len = self.config.text.max_len
+        ids, mask = self.tokenizer.batch(
+            [t or "." for t in texts], max_len=max_len)
+        # bucket-pad like JaxEncoderEmbedder: varying batch widths would
+        # otherwise recompile the jitted text tower per new width
+        pad_to = max_len
+        for b in self._BUCKETS:
+            if ids.shape[1] <= b:
+                pad_to = min(b, max_len)
+                break
+        if ids.shape[1] < pad_to:
+            pad = pad_to - ids.shape[1]
+            ids = np.pad(ids, ((0, 0), (0, pad)))
+            mask = np.pad(mask, ((0, 0), (0, pad)))
+        else:
+            ids, mask = ids[:, :pad_to], mask[:, :pad_to]
+        return np.asarray(self._encode_text(self.params, ids, mask))
+
+    def embed_image_batch(self, images: list) -> np.ndarray:
+        px = np.stack([
+            self._clip.load_image(im, config=self.config)
+            if isinstance(im, bytes) else np.asarray(im, np.float32)
+            for im in images
+        ])
+        return np.asarray(self._encode_image(self.params, px))
+
+    def __wrapped__(self, texts: list[str], **kwargs) -> list[np.ndarray]:
+        emb = self.embed_text_batch(list(texts))
+        return [emb[i] for i in range(emb.shape[0])]
+
+    def image(self):
+        """A UDF embedding image bytes/arrays into the shared space."""
+        outer = self
+
+        class _ImageUDF(BaseEmbedder):
+            def __init__(self):
+                super().__init__(batch=True, deterministic=True)
+
+            def __wrapped__(self, images: list, **kwargs):
+                emb = outer.embed_image_batch(list(images))
+                return [emb[i] for i in range(emb.shape[0])]
+
+            def get_embedding_dimension(self, **kwargs) -> int:
+                return int(outer.config.embed_dim)
+
+        return _ImageUDF()
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return int(self.config.embed_dim)
