@@ -85,6 +85,10 @@ class _PyFilesystemAdapter:
 
 
 def _adapter_for(source: Any, path: str):
+    # pre-built adapter (duck-typed): pw.io.s3 passes its native SigV4
+    # client wrapped in an adapter, no fsspec involved
+    if hasattr(source, "list_files") and hasattr(source, "read_bytes"):
+        return source
     try:
         from fs.base import FS  # type: ignore
 

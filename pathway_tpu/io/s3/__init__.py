@@ -2,10 +2,10 @@
 
 Reference: python/pathway/io/s3 (S3Scanner/S3GenericReader,
 src/connectors/data_storage.rs:1769,2315) with ``AwsS3Settings`` carrying
-bucket/credentials/endpoint. This build reads objects through **fsspec**
-(in-image); the s3 protocol itself activates when ``s3fs`` is installed —
-the settings/plumbing are real either way, and MinIO/DigitalOcean/Wasabi
-route here with custom endpoints exactly like the reference.
+bucket/credentials/endpoint. Objects are listed/fetched through the
+in-repo SigV4 REST client (_client.py) — no boto/s3fs packages;
+MinIO/DigitalOcean/Wasabi route here with custom endpoints exactly like
+the reference.
 """
 
 from __future__ import annotations
@@ -21,7 +21,7 @@ class AwsS3Settings:
     secret_access_key: str | None = None
     region: str | None = None
     endpoint: str | None = None
-    with_path_style: bool = False
+    with_path_style: bool | None = None  # None = auto (custom endpoint -> path style)
     session_token: str | None = None
 
     def storage_options(self) -> dict[str, Any]:
@@ -42,16 +42,52 @@ class AwsS3Settings:
         return opts
 
 
-def _open_fs(aws_s3_settings: AwsS3Settings):
-    try:
-        import fsspec
+class S3Adapter:
+    """list/read adapter over the native SigV4 client (io/s3/_client.py),
+    duck-typed into the pyfilesystem polling source — no fsspec/s3fs."""
 
-        return fsspec.filesystem("s3",
-                                 **aws_s3_settings.storage_options())
-    except (ImportError, ValueError) as e:
-        raise ImportError(
-            "pw.io.s3 needs the s3 fsspec protocol (install s3fs); the "
-            "connector plumbing is wired and activates with it") from e
+    def __init__(self, settings: AwsS3Settings, bucket: str, prefix: str):
+        from pathway_tpu.io.s3._client import client_from_settings
+
+        self.client = client_from_settings(settings, bucket=bucket)
+        self.prefix = prefix.strip("/")
+
+    def _listing(self):
+        """Directory semantics: 'data' must not match 'database/...' —
+        list under 'data/' and fall back to the exact object 'data'."""
+        if not self.prefix:
+            yield from self.client.list_objects("")
+            return
+        n = 0
+        for obj in self.client.list_objects(self.prefix + "/"):
+            n += 1
+            yield obj
+        if n == 0:
+            for obj in self.client.list_objects(self.prefix):
+                if obj["key"] == self.prefix:
+                    yield obj
+
+    def list_files(self) -> list[tuple[str, float, int]]:
+        import email.utils
+
+        out = []
+        for obj in self._listing():
+            lm = obj.get("last_modified") or ""
+            try:  # ISO 8601 (S3) or RFC 2822
+                import datetime as _dt
+
+                mtime = _dt.datetime.fromisoformat(
+                    lm.replace("Z", "+00:00")).timestamp()
+            except ValueError:
+                try:
+                    mtime = email.utils.parsedate_to_datetime(lm).timestamp()
+                except Exception:
+                    mtime = 0.0
+            out.append((obj["key"], mtime, obj["size"]))
+        return sorted(out)
+
+    def read_bytes(self, path: str) -> bytes:
+        return self.client.get_object(path)
 
 
 def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
@@ -60,21 +96,26 @@ def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
          persistent_id: str | None = None,
          autocommit_duration_ms: int | None = 1500, **kwargs):
     """Read objects under ``s3://bucket/path``. ``format='binary'``
-    yields one row per object; csv/jsonlines/plaintext parse contents
-    (downloaded through fsspec, parsed by the shared format layer)."""
+    yields one row per object, polled for changes in streaming mode
+    (native SigV4 REST client — no boto/s3fs; reference S3Scanner,
+    data_storage.rs:1769)."""
     from pathway_tpu.io import pyfilesystem as _pfs
+    from pathway_tpu.io.s3._client import split_bucket_prefix
 
     settings = aws_s3_settings or AwsS3Settings()
-    fs = _open_fs(settings)
-    full = path if "://" not in path else path.split("://", 1)[1]
-    bucket = settings.bucket_name
-    if bucket and full != bucket and not full.startswith(bucket + "/"):
-        full = f"{bucket}/{full}"
+    bucket, prefix = split_bucket_prefix(path, settings.bucket_name)
+    adapter = S3Adapter(settings, bucket, prefix)
     if format == "binary":
-        return _pfs.read(fs, path=full, mode=mode,
-                         with_metadata=with_metadata, name=name,
-                         persistent_id=persistent_id,
-                         autocommit_duration_ms=autocommit_duration_ms)
+        # persistent_id stays explicit: a shared default would collide in
+        # attach_source when two unnamed s3 sources persist
+        table = _pfs.read(adapter, mode=mode,
+                          with_metadata=with_metadata,
+                          name=name,
+                          persistent_id=persistent_id,
+                          autocommit_duration_ms=autocommit_duration_ms)
+        if name is None:
+            table._name = "s3_input"
+        return table
     raise NotImplementedError(
         f"pw.io.s3.read format={format!r}: only 'binary' is wired through "
         "the object-store path; parse csv/jsonlines downstream with the "
@@ -82,5 +123,6 @@ def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
 
 
 def write(*args, **kwargs):
-    raise ImportError(
-        "pw.io.s3.write requires an S3 client (s3fs) in this environment")
+    raise NotImplementedError(
+        "pw.io.s3 is read-only, matching the reference (S3 readers exist "
+        "in data_storage.rs; deltalake/persistence handle S3 writes)")

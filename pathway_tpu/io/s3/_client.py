@@ -1,0 +1,191 @@
+"""Dependency-free S3 REST client with AWS Signature Version 4.
+
+Replaces the reference's rust-s3 crate usage (S3Scanner,
+src/connectors/data_storage.rs:1769) without any boto/s3fs packages: the
+protocol is plain HTTPS + HMAC-SHA256 request signing
+(https://docs.aws.amazon.com/AmazonS3/latest/API/sig-v4-authenticating-requests.html).
+Works against AWS, MinIO and any S3-compatible endpoint (path-style for
+custom endpoints); tested against an in-process fake that verifies the
+signature chain.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import xml.etree.ElementTree as ET
+from typing import Iterator
+from urllib.parse import quote
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def _uri_encode(s: str, *, slash_ok: bool = False) -> str:
+    return quote(s, safe="/-_.~" if slash_ok else "-_.~")
+
+
+class S3Client:
+    """Minimal object operations: get/put/delete/list (ListObjectsV2)."""
+
+    def __init__(self, *, bucket: str, access_key: str | None = None,
+                 secret_key: str | None = None, region: str | None = None,
+                 endpoint: str | None = None, session_token: str | None = None,
+                 path_style: bool | None = None):
+        import os
+
+        self.bucket = bucket
+        # standard AWS environment credential chain when not passed
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID")
+        self.secret_key = secret_key or os.environ.get(
+            "AWS_SECRET_ACCESS_KEY")
+        self.session_token = session_token or os.environ.get(
+            "AWS_SESSION_TOKEN")
+        self.region = region or os.environ.get("AWS_REGION") or "us-east-1"
+        if endpoint:
+            self.endpoint = endpoint.rstrip("/")
+            self.path_style = True if path_style is None else path_style
+        else:
+            self.endpoint = f"https://s3.{self.region}.amazonaws.com"
+            self.path_style = False if path_style is None else path_style
+        import requests
+
+        self._http = requests.Session()
+
+    # -- signing ------------------------------------------------------------
+    def _host(self) -> str:
+        from urllib.parse import urlparse
+
+        netloc = urlparse(self.endpoint).netloc
+        if not self.path_style:
+            return f"{self.bucket}.{netloc}"
+        return netloc
+
+    def _url(self, key: str, query: dict | None = None) -> tuple[str, str, str]:
+        """(full url, canonical uri, canonical query)."""
+        from urllib.parse import urlparse
+
+        parsed = urlparse(self.endpoint)
+        if self.path_style:
+            uri = f"/{self.bucket}/{_uri_encode(key, slash_ok=True)}" if key \
+                else f"/{self.bucket}"
+        else:
+            uri = f"/{_uri_encode(key, slash_ok=True)}" if key else "/"
+        cq = "&".join(
+            f"{_uri_encode(k)}={_uri_encode(str(v))}"
+            for k, v in sorted((query or {}).items()))
+        host = self._host()
+        url = f"{parsed.scheme}://{host}{uri}" + (f"?{cq}" if cq else "")
+        return url, uri, cq
+
+    def _request(self, method: str, key: str = "", *, query: dict | None = None,
+                 body: bytes = b"", ok=(200,), stream: bool = False):
+        url, uri, cq = self._url(key, query)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        payload_hash = _sha256(body)
+        headers = {
+            "host": self._host(),
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date,
+        }
+        if self.session_token:
+            headers["x-amz-security-token"] = self.session_token
+        if self.access_key and self.secret_key:
+            signed = ";".join(sorted(headers))
+            canonical = "\n".join([
+                method, uri, cq,
+                "".join(f"{h}:{headers[h]}\n" for h in sorted(headers)),
+                signed, payload_hash,
+            ])
+            scope = f"{datestamp}/{self.region}/s3/aws4_request"
+            to_sign = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                                 _sha256(canonical.encode())])
+            k = _hmac(b"AWS4" + self.secret_key.encode(), datestamp)
+            k = _hmac(k, self.region)
+            k = _hmac(k, "s3")
+            k = _hmac(k, "aws4_request")
+            signature = hmac.new(k, to_sign.encode(),
+                                 hashlib.sha256).hexdigest()
+            headers["Authorization"] = (
+                f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+                f"SignedHeaders={signed}, Signature={signature}")
+        resp = self._http.request(method, url, headers=headers, data=body,
+                                  timeout=60, stream=stream)
+        if resp.status_code not in ok:
+            raise RuntimeError(
+                f"S3 {method} {key!r}: HTTP {resp.status_code} "
+                f"{resp.text[:300]}")
+        return resp
+
+    # -- object ops ---------------------------------------------------------
+    def get_object(self, key: str) -> bytes:
+        return self._request("GET", key).content
+
+    def get_object_or_none(self, key: str) -> bytes | None:
+        resp = self._request("GET", key, ok=(200, 404))
+        return None if resp.status_code == 404 else resp.content
+
+    def put_object(self, key: str, body: bytes) -> None:
+        self._request("PUT", key, body=body)
+
+    def delete_object(self, key: str) -> None:
+        self._request("DELETE", key, ok=(200, 204))
+
+    def list_objects(self, prefix: str = "") -> Iterator[dict]:
+        """Yields {key, size, last_modified} via ListObjectsV2 paging."""
+        token = None
+        while True:
+            query = {"list-type": "2", "prefix": prefix}
+            if token:
+                query["continuation-token"] = token
+            resp = self._request("GET", "", query=query)
+            ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+            tree = ET.fromstring(resp.content)
+            for item in tree.iter(f"{ns}Contents"):
+                yield {
+                    "key": item.findtext(f"{ns}Key"),
+                    "size": int(item.findtext(f"{ns}Size") or 0),
+                    "last_modified": item.findtext(f"{ns}LastModified"),
+                }
+            if tree.findtext(f"{ns}IsTruncated") != "true":
+                return
+            token = tree.findtext(f"{ns}NextContinuationToken")
+
+
+def client_from_settings(settings, bucket: str | None = None) -> S3Client:
+    """Build from pw.io.s3.AwsS3Settings (duck-typed). with_path_style is
+    tri-state: None lets the client choose (path-style for custom
+    endpoints, virtual-hosted for AWS); an explicit bool wins."""
+    return S3Client(
+        bucket=bucket or settings.bucket_name,
+        access_key=settings.access_key,
+        secret_key=settings.secret_access_key,
+        region=settings.region,
+        endpoint=settings.endpoint,
+        session_token=settings.session_token,
+        path_style=settings.with_path_style,
+    )
+
+
+def split_bucket_prefix(path: str, bucket_name: str | None = None
+                        ) -> tuple[str, str]:
+    """('s3://bucket/prefix' | 'bucket/prefix' | 'prefix'+bucket_name)
+    -> (bucket, prefix). One parser shared by the connector and the
+    persistence backend."""
+    if path.startswith("s3://"):
+        path = path[5:]
+    if bucket_name:
+        prefix = path
+        if path == bucket_name or path.startswith(bucket_name + "/"):
+            prefix = path[len(bucket_name):].lstrip("/")
+        return bucket_name, prefix
+    bucket, _, prefix = path.partition("/")
+    return bucket, prefix

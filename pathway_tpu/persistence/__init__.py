@@ -23,8 +23,11 @@ class Backend:
 
     @classmethod
     def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
-        # S3 client not available in-image; filesystem layout is identical —
-        # gate at runtime.
+        """Snapshots as objects on S3-compatible storage through the
+        native SigV4 client (io/s3/_client.py) — ``bucket_settings`` is a
+        pw.io.s3.AwsS3Settings (endpoint/credentials); ``root_path`` is
+        ``s3://bucket/prefix`` (reference: Backend.s3,
+        persistence/__init__.py:49 + S3 metadata backend)."""
         return cls("s3", root_path, bucket_settings=bucket_settings)
 
     @classmethod

@@ -1,0 +1,237 @@
+"""Native SigV4 S3 client + connector + persistence backend against an
+in-test S3-compatible server that VERIFIES the signature chain
+(reference: rust-s3-backed S3Scanner data_storage.rs:1769 and the S3
+persistence backends; here the protocol is implemented directly)."""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.s3 import AwsS3Settings
+from pathway_tpu.io.s3._client import S3Client
+
+ACCESS, SECRET, REGION = "AKTEST", "sekrit", "eu-test-1"
+
+
+@pytest.fixture(autouse=True)
+def _clear_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+class _FakeS3(BaseHTTPRequestHandler):
+    objects: dict = {}  # (bucket, key) -> bytes
+    verify_auth = True
+
+    def log_message(self, *args):
+        pass
+
+    # -- SigV4 verification (the server-side half of the handshake) -------
+    def _check_sig(self) -> bool:
+        if not self.verify_auth:
+            return True
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256"):
+            return False
+        fields = dict(p.strip().split("=", 1)
+                      for p in auth.split(" ", 1)[1].split(","))
+        signed = fields["SignedHeaders"].split(";")
+        u = urlparse(self.path)
+        cq = "&".join(sorted(u.query.split("&"))) if u.query else ""
+        canonical = "\n".join([
+            self.command, u.path, cq,
+            "".join(f"{h}:{self.headers[h]}\n" for h in signed),
+            fields["SignedHeaders"],
+            self.headers["x-amz-content-sha256"],
+        ])
+        datestamp, region, service, _ = fields["Credential"].split(
+            "/", 4)[1:]
+        scope = f"{datestamp}/{region}/{service}/aws4_request"
+        to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", self.headers["x-amz-date"], scope,
+            hashlib.sha256(canonical.encode()).hexdigest()])
+        k = hmac.new(b"AWS4" + SECRET.encode(), datestamp.encode(),
+                     hashlib.sha256).digest()
+        for part in (region, service, "aws4_request"):
+            k = hmac.new(k, part.encode(), hashlib.sha256).digest()
+        want = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        return hmac.compare_digest(want, fields["Signature"])
+
+    def _split(self):
+        u = urlparse(self.path)
+        parts = unquote(u.path).lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        return bucket, key, parse_qs(u.query)
+
+    def _reply(self, code, body=b"", ctype="application/xml"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        if not self._check_sig():
+            return self._reply(403)
+        bucket, key, _ = self._split()
+        n = int(self.headers.get("Content-Length", 0))
+        self.objects[(bucket, key)] = self.rfile.read(n)
+        self._reply(200)
+
+    def do_GET(self):
+        if not self._check_sig():
+            return self._reply(403)
+        bucket, key, q = self._split()
+        if "list-type" in q:
+            prefix = q.get("prefix", [""])[0]
+            items = sorted(k for (b, k) in self.objects
+                           if b == bucket and k.startswith(prefix))
+            xml = ['<?xml version="1.0"?><ListBucketResult '
+                   'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">']
+            for k in items:
+                xml.append(
+                    f"<Contents><Key>{k}</Key>"
+                    f"<Size>{len(self.objects[(bucket, k)])}</Size>"
+                    f"<LastModified>2026-07-30T00:00:00Z</LastModified>"
+                    f"</Contents>")
+            xml.append("<IsTruncated>false</IsTruncated></ListBucketResult>")
+            return self._reply(200, "".join(xml).encode())
+        data = self.objects.get((bucket, key))
+        if data is None:
+            return self._reply(404)
+        self._reply(200, data, ctype="application/octet-stream")
+
+    def do_DELETE(self):
+        if not self._check_sig():
+            return self._reply(403)
+        bucket, key, _ = self._split()
+        self.objects.pop((bucket, key), None)
+        self._reply(204)
+
+
+@pytest.fixture()
+def fake_s3():
+    _FakeS3.objects = {}
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def _client(endpoint, bucket="pail"):
+    return S3Client(bucket=bucket, access_key=ACCESS, secret_key=SECRET,
+                    region=REGION, endpoint=endpoint)
+
+
+def test_client_roundtrip_signed(fake_s3):
+    c = _client(fake_s3)
+    c.put_object("a/x.txt", b"hello")
+    c.put_object("a/y.txt", b"world")
+    c.put_object("b/z.txt", b"other")
+    assert c.get_object("a/x.txt") == b"hello"
+    assert c.get_object_or_none("missing") is None
+    listed = [o["key"] for o in c.list_objects("a/")]
+    assert listed == ["a/x.txt", "a/y.txt"]
+    c.delete_object("a/x.txt")
+    assert c.get_object_or_none("a/x.txt") is None
+
+
+def test_client_bad_secret_rejected(fake_s3):
+    c = S3Client(bucket="pail", access_key=ACCESS, secret_key="wrong",
+                 region=REGION, endpoint=fake_s3)
+    with pytest.raises(RuntimeError, match="403"):
+        c.put_object("k", b"v")
+
+
+def test_s3_connector_static_read(fake_s3):
+    c = _client(fake_s3)
+    c.put_object("docs/one.txt", b"first doc")
+    c.put_object("docs/two.txt", b"second doc")
+    c.put_object("other/three.txt", b"outside prefix")
+    settings = AwsS3Settings(bucket_name="pail", access_key=ACCESS,
+                             secret_access_key=SECRET, region=REGION,
+                             endpoint=fake_s3)
+    t = pw.io.s3.read("pail/docs", aws_s3_settings=settings, mode="static")
+    rows = sorted(r[0] for r in
+                  pw.debug.table_to_pandas(t).itertuples(index=False))
+    assert rows == [b"first doc", b"second doc"]
+
+
+def test_s3_persistence_backend_resume(fake_s3):
+    """Commit a prefix to S3 objects, 'restart', and verify the durable
+    records replay — the Backend.s3 path writes real objects now."""
+    from pathway_tpu.engine.persistence import PersistenceDriver
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.io._datasource import Session
+    from pathway_tpu.io.python import ConnectorSubject, PythonSource
+
+    settings = AwsS3Settings(bucket_name="pail", access_key=ACCESS,
+                             secret_access_key=SECRET, region=REGION,
+                             endpoint=fake_s3)
+    cfg = pw.persistence.Config(
+        backend=pw.persistence.Backend.s3("s3://pail/snapshots",
+                                          bucket_settings=settings))
+    schema = sch.schema_from_types(data=str)
+
+    class _Subject(ConnectorSubject):
+        def run(self):
+            pass
+
+    src = PythonSource(_Subject(), schema)
+    src.persistent_id = "events"
+    driver = PersistenceDriver(cfg)
+    live = Session()
+    rec = driver.attach_source(src, live)
+    k, r = src.row_to_engine({"data": "alpha"}, 0)
+    rec.push(k, r, 1)
+    driver.commit(1)
+    k, r = src.row_to_engine({"data": "beta"}, 1)
+    rec.push(k, r, 1)
+    driver.commit(2)
+    driver.close()
+
+    # the commits are visible as objects
+    keys = [o["key"] for o in _client(fake_s3).list_objects("snapshots/")]
+    assert keys == ["snapshots/streams/events/0000000000000000",
+                    "snapshots/streams/events/0000000000000001"]
+
+    # restart: replay the durable prefix
+    src2 = PythonSource(_Subject(), schema)
+    src2.persistent_id = "events"
+    driver2 = PersistenceDriver(cfg)
+    live2 = Session()
+    driver2.attach_source(src2, live2)
+    replayed = sorted(row[1][0] for row in live2.drain())
+    assert replayed == ["alpha", "beta"]
+    assert driver2.restore_time() == 2
+    driver2.close()
+
+
+def test_s3_log_skips_torn_upload(fake_s3):
+    from pathway_tpu.engine.persistence import S3SnapshotLog
+
+    c = _client(fake_s3)
+    log = S3SnapshotLog(c, "snap", "src")
+    log.append(1, [("k", ("a",), 1, None)])
+    log.append(2, [("k2", ("b",), 1, None)])
+    # simulate an interrupted upload: truncated body
+    body = c.get_object("snap/streams/src/0000000000000001")
+    c.put_object("snap/streams/src/0000000000000001", body[:-3])
+    records = S3SnapshotLog(c, "snap", "src").read_all()
+    assert [t for t, _e in records] == [1]
+    # appends continue past the corrupt object's sequence number
+    log2 = S3SnapshotLog(c, "snap", "src")
+    log2.append(3, [("k3", ("c",), 1, None)])
+    assert [t for t, _e in S3SnapshotLog(c, "snap", "src").read_all()] \
+        == [1, 3]
