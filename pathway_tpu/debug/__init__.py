@@ -254,3 +254,40 @@ def compute_and_print_update_stream(table: Table, *, include_id: bool = True,
             str(time), str(diff)]
         lines.append(" | ".join(cells))
     print("\n".join(lines), file=file)
+
+
+class StreamGenerator:
+    """Programmatic multi-batch stream builder for tests
+    (reference: debug/__init__.py StreamGenerator — batches become
+    consecutive engine timestamps; the by-workers variant merges worker
+    shards, since sharding here is by key, not by emitting worker)."""
+
+    def __init__(self):
+        self._count = 0
+
+    def _next_name(self) -> str:
+        self._count += 1
+        return f"stream_generator_{self._count}"
+
+    def table_from_list_of_batches(self, batches: list[list[dict]],
+                                   schema: type[sch.Schema]) -> Table:
+        """Each inner list lands at one (increasing) logical time."""
+        names = schema.column_names()
+        rows = []
+        for t, batch in enumerate(batches):
+            for values in batch:
+                rows.append(tuple(values[n] for n in names) + (t + 1, 1))
+        table = table_from_rows(schema, rows, is_stream=True)
+        table._name = self._next_name()
+        return table
+
+    def table_from_list_of_batches_by_workers(
+            self, batches: list[dict[int, list[dict]]],
+            schema: type[sch.Schema]) -> Table:
+        merged = [[values for shard in batch.values() for values in shard]
+                  for batch in batches]
+        return self.table_from_list_of_batches(merged, schema)
+
+    def table_from_markdown(self, table: str) -> Table:
+        """Markdown with a ``_time`` (and optional ``_diff``) column."""
+        return table_from_markdown(table)

@@ -171,36 +171,49 @@ class S3SnapshotLog:
         self._seq: int | None = None
 
     def read_all(self) -> list[tuple[int, list]]:
+        """Contiguous durable prefix, stopping at the first gap or corrupt
+        object — exactly SnapshotLog._scan's torn-tail rule. Skipping a
+        hole would desynchronize the replay+skip resume protocol (the
+        skip counter assumes the replayed records are a PREFIX of what
+        the reader re-emits)."""
         records: list = []
-        self._seq = 0
-        for obj in self.client.list_objects(self.prefix + "/"):
-            data = self.client.get_object(obj["key"])
-            if not data.startswith(_MAGIC):
+        expect = 0
+        for obj in sorted(self.client.list_objects(self.prefix + "/"),
+                          key=lambda o: o["key"]):
+            try:
+                seq = int(obj["key"].rsplit("/", 1)[-1])
+            except ValueError:
                 continue  # foreign object under the prefix
-            if len(data) < len(_MAGIC) + _HDR.size:
-                continue
+            if seq != expect:
+                break  # gap: a later commit without its predecessor
+            data = self.client.get_object(obj["key"])
+            if not data.startswith(_MAGIC) \
+                    or len(data) < len(_MAGIC) + _HDR.size:
+                break
             length, crc = _HDR.unpack_from(data, len(_MAGIC))
             payload = data[len(_MAGIC) + _HDR.size:
                            len(_MAGIC) + _HDR.size + length]
             if len(payload) != length or zlib.crc32(payload) != crc:
-                continue  # interrupted upload
+                break  # interrupted upload: prefix ends here
             records.append(_safe_loads(payload))
-            try:
-                self._seq = max(self._seq,
-                                int(obj["key"].rsplit("/", 1)[-1]) + 1)
-            except ValueError:
-                pass
+            expect += 1
+        self._seq = expect  # next append overwrites a torn slot
         return records
 
     def _next_seq(self) -> int:
         """Key listing only — no GETs/unpickling just to number an append
-        (the records themselves are read once by the driver's cache)."""
-        seq = 0
+        (the records themselves are read once by the driver's cache).
+        Appends after the CONTIGUOUS prefix: a torn/corrupt object's slot
+        gets overwritten, matching read_all's prefix rule."""
+        keys = set()
         for obj in self.client.list_objects(self.prefix + "/"):
             try:
-                seq = max(seq, int(obj["key"].rsplit("/", 1)[-1]) + 1)
+                keys.add(int(obj["key"].rsplit("/", 1)[-1]))
             except ValueError:
                 pass
+        seq = 0
+        while seq in keys:
+            seq += 1
         return seq
 
     def append(self, time: int, entries: list) -> None:

@@ -11,7 +11,6 @@ the reference.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any
 
 
 @dataclass
@@ -23,23 +22,6 @@ class AwsS3Settings:
     endpoint: str | None = None
     with_path_style: bool | None = None  # None = auto (custom endpoint -> path style)
     session_token: str | None = None
-
-    def storage_options(self) -> dict[str, Any]:
-        opts: dict[str, Any] = {}
-        if self.access_key:
-            opts["key"] = self.access_key
-        if self.secret_access_key:
-            opts["secret"] = self.secret_access_key
-        if self.session_token:
-            opts["token"] = self.session_token
-        client_kwargs: dict[str, Any] = {}
-        if self.endpoint:
-            client_kwargs["endpoint_url"] = self.endpoint
-        if self.region:
-            client_kwargs["region_name"] = self.region
-        if client_kwargs:
-            opts["client_kwargs"] = client_kwargs
-        return opts
 
 
 class S3Adapter:
@@ -91,10 +73,11 @@ class S3Adapter:
 
 
 def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
-         format: str = "binary", schema=None, mode: str = "streaming",
+         format: str = "binary", mode: str = "streaming",
          with_metadata: bool = False, name: str | None = None,
          persistent_id: str | None = None,
-         autocommit_duration_ms: int | None = 1500, **kwargs):
+         refresh_interval: float = 30,
+         autocommit_duration_ms: int | None = 1500):
     """Read objects under ``s3://bucket/path``. ``format='binary'``
     yields one row per object, polled for changes in streaming mode
     (native SigV4 REST client — no boto/s3fs; reference S3Scanner,
@@ -112,6 +95,7 @@ def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
                           with_metadata=with_metadata,
                           name=name,
                           persistent_id=persistent_id,
+                          refresh_interval=refresh_interval,
                           autocommit_duration_ms=autocommit_duration_ms)
         if name is None:
             table._name = "s3_input"

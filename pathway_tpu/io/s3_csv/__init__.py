@@ -1,7 +1,7 @@
 """pw.io.s3_csv — CSV-over-S3 (reference: python/pathway/io/s3_csv +
 S3CsvReader, src/connectors/data_storage.rs:1973). Delegates to pw.io.s3
-for object access (fsspec; activates with s3fs) and parses rows with the
-shared DSV layer."""
+for object access (native SigV4 client) and parses rows with the shared
+DSV layer."""
 
 from __future__ import annotations
 

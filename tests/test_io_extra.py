@@ -103,9 +103,8 @@ def test_s3_settings_and_native_client():
     s = pw.io.s3.AwsS3Settings(
         bucket_name="b", access_key="ak", secret_access_key="sk",
         endpoint="https://minio.local:9000", region="us-east-1")
-    opts = s.storage_options()
-    assert opts["key"] == "ak" and opts["secret"] == "sk"
-    assert opts["client_kwargs"]["endpoint_url"] == "https://minio.local:9000"
+    assert s.access_key == "ak" and s.secret_access_key == "sk"
+    assert s.endpoint == "https://minio.local:9000"
     m = pw.io.minio.MinIOSettings(
         endpoint="minio.local:9000", bucket_name="b", access_key="ak",
         secret_access_key="sk")
