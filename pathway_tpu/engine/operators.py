@@ -105,6 +105,10 @@ class SourceOperator(Operator):
         self.pending.extend(delta.entries)
 
     def step(self, time, in_deltas):
+        # consolidation here is load-bearing: a same-batch net-zero
+        # (key,row) pair must cancel BEFORE operators/sinks see it —
+        # order-sensitive reducers would otherwise record deleted values,
+        # float sums drift, and sinks emit phantom insert/delete events
         out = self.pending.consolidate()
         self.pending = Delta()
         return out

@@ -134,3 +134,32 @@ def test_global_reduce_empty_groups_vanish():
     """)
     r = t.groupby(t.g).reduce(t.g, n=pw.reducers.count())
     assert rows_of(r) == []
+
+
+def test_same_tick_net_zero_pair_invisible_to_order_sensitive_reducers():
+    """A same-batch insert+delete of the same row must cancel BEFORE
+    operators see it: earliest/latest would otherwise permanently record
+    the deleted value (their canonical sort processes retractions first,
+    so the uncancelled insert lands with no matching retraction), sinks
+    would emit phantom events, and float sums would drift."""
+    t = T("""
+    g | v | _time | _diff
+    a | 1 | 2     | 1
+    a | 9 | 4     | 1
+    a | 9 | 4     | -1
+    """)
+    r = t.groupby(t.g).reduce(
+        t.g, last=pw.reducers.latest(t.v), s=pw.reducers.sum(t.v))
+    assert sorted(rows_of(r)) == [("a", 1, 1)]
+
+    # and the sink never observes the phantom value
+    t2 = T("""
+    g | v | _time | _diff
+    a | 1 | 2     | 1
+    a | 9 | 4     | 1
+    a | 9 | 4     | -1
+    """)
+    from pathway_tpu.internals.runner import run_tables
+
+    [cap] = run_tables(t2)
+    assert all(row[1] != 9 for _k, row, _t, _d in cap.events)
