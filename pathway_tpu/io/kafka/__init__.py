@@ -1,10 +1,13 @@
 """pw.io.kafka (reference: python/pathway/io/kafka + KafkaReader/Writer,
 src/connectors/data_storage.rs:720,2142).
 
-Activates when a Python Kafka client (`kafka-python` or `confluent_kafka`)
-is importable; otherwise raises at call time. Partition-parallel reads map
-to per-host sources in the multi-host topology (reference: each worker owns
-its partitions, connectors/mod.rs ReadersQueryPurpose).
+Uses `kafka-python` when importable (consumer-group path); otherwise the
+IN-REPO wire-protocol client (_protocol.py: Metadata/ListOffsets/Fetch/
+Produce with RecordBatch v2 + CRC32C) with manual partition assignment —
+no client packages at all. Partition-parallel reads map to per-host
+sources in the multi-host topology (reference: each worker owns its
+partitions, connectors/mod.rs ReadersQueryPurpose); per-partition progress
+rides the engine's offset antichains, which also makes resume exact.
 """
 
 from __future__ import annotations
@@ -51,6 +54,9 @@ class KafkaSource(DataSource):
         self._resume_antichain = antichain
 
     def run(self, session: Session) -> None:
+        if _get_client() != "kafka-python":
+            # confluent_kafka alone cannot drive the kafka-python path
+            return self._run_native(session)
         from kafka import KafkaConsumer, TopicPartition  # type: ignore
 
         consumer = KafkaConsumer(
@@ -108,14 +114,95 @@ class KafkaSource(DataSource):
         for msg in consumer:
             emit(msg)
 
+    def _run_native(self, session: Session) -> None:
+        """Wire-protocol reader: manual partition assignment, offsets from
+        earliest (or the resume antichain), poll loop per partition."""
+        import logging
+        import time as _t
+
+        from pathway_tpu.io.kafka._protocol import (KafkaClient,
+                                                     KafkaProtocolError)
+
+        bootstrap = self.settings.get("bootstrap.servers", "127.0.0.1:9092")
+        bootstrap = bootstrap.split(",")[0]
+        reset = self.settings.get("auto.offset.reset", "earliest")
+        seq = 0
+
+        def emit(partition, offset, value):
+            nonlocal seq
+            if value is None:
+                return
+            if self.format == "raw":
+                values = {"data": value}
+            else:
+                values = _json.loads(value)
+            key, row = self.row_to_engine(values, seq)
+            seq += 1
+            session.push(key, row, 1, offset=("part", partition, offset))
+
+        backoff = 1.0
+        client = None
+        positions: dict[int, int] = {}
+        while True:
+            try:
+                if client is None:
+                    client = KafkaClient(bootstrap)
+                    parts = client.metadata(self.topic)
+                    for pid in parts:
+                        if pid in positions:
+                            continue
+                        last = (self._resume_antichain.get(pid)
+                                if self._resume_antichain else None)
+                        if last is not None:
+                            positions[pid] = int(last) + 1
+                        else:
+                            positions[pid] = client.list_offsets(
+                                self.topic, pid,
+                                -2 if reset == "earliest" else -1)
+                any_data = False
+                # one fetch covers every partition: per-partition polling
+                # would pay the broker's max_wait serially per idle one
+                by_part = client.fetch_many(self.topic, dict(positions))
+                for pid, records in by_part.items():
+                    for offset, _key, value in records:
+                        emit(pid, offset, value)
+                        positions[pid] = offset + 1
+                        any_data = True
+                backoff = 1.0
+                if not any_data:
+                    _t.sleep(0.05)
+            except KafkaProtocolError as e:
+                if e.code == 1:
+                    # OFFSET_OUT_OF_RANGE (retention passed the frontier):
+                    # honor auto.offset.reset instead of retrying forever
+                    logging.getLogger(__name__).warning(
+                        "kafka offset out of range; re-resolving via "
+                        "auto.offset.reset=%s", reset)
+                    positions.clear()
+                    continue
+                logging.getLogger(__name__).warning(
+                    "kafka protocol error (%s); reconnecting in %.0fs",
+                    e, backoff)
+                if client is not None:
+                    client.close()
+                    client = None
+                positions.clear()  # re-resolve from metadata on reconnect
+                _t.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+            except (ConnectionError, OSError, RuntimeError) as e:
+                logging.getLogger(__name__).warning(
+                    "kafka native reader error (%s); reconnecting in %.0fs",
+                    e, backoff)
+                if client is not None:
+                    client.close()
+                    client = None
+                _t.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+
 
 def read(rdkafka_settings: dict, topic: str | None = None, *, schema=None,
          format: str = "raw", autocommit_duration_ms: int | None = 1500,
          name=None, **kwargs) -> Table:
-    if _get_client() is None:
-        raise ImportError(
-            "pw.io.kafka requires kafka-python or confluent_kafka; neither is "
-            "installed in this environment.")
     if schema is None:
         schema = sch.schema_from_types(data=dt.BYTES)
     source = KafkaSource(rdkafka_settings, topic, format, schema,
@@ -126,28 +213,64 @@ def read(rdkafka_settings: dict, topic: str | None = None, *, schema=None,
 
 def write(table: Table, rdkafka_settings: dict, topic_name: str, *,
           format: str = "json", name=None, **kwargs) -> None:
-    if _get_client() is None:
-        raise ImportError(
-            "pw.io.kafka requires kafka-python or confluent_kafka; neither is "
-            "installed in this environment.")
-    from kafka import KafkaProducer  # type: ignore
-
     from pathway_tpu.internals.parse_graph import G
 
     names = table.column_names()
+    bootstrap = rdkafka_settings.get("bootstrap.servers", "127.0.0.1:9092")
 
-    def binder(runner):
-        producer = KafkaProducer(
-            bootstrap_servers=rdkafka_settings.get("bootstrap.servers"))
+    def encode_rows(time, delta):
+        out = []
+        for _key, row, diff in delta.entries:
+            rec = dict(zip(names, row))
+            rec["time"] = time
+            rec["diff"] = diff
+            out.append(_json.dumps(rec, default=str).encode())
+        return out
 
-        def callback(time, delta):
-            for key, row, diff in delta.entries:
-                rec = dict(zip(names, row))
-                rec["time"] = time
-                rec["diff"] = diff
-                producer.send(topic_name, _json.dumps(rec, default=str).encode())
-            producer.flush()
+    if _get_client() == "kafka-python":
+        def binder(runner):
+            from kafka import KafkaProducer  # type: ignore
 
-        runner.subscribe(table, callback)
+            producer = KafkaProducer(bootstrap_servers=bootstrap)
+
+            def callback(time, delta):
+                for payload in encode_rows(time, delta):
+                    producer.send(topic_name, payload)
+                producer.flush()
+
+            runner.subscribe(table, callback)
+    else:
+        def binder(runner):
+            from pathway_tpu.io.kafka._protocol import KafkaClient
+
+            state = {"client": None, "next_part": 0, "parts": None}
+
+            def send(payloads):
+                if state["client"] is None:
+                    state["client"] = KafkaClient(bootstrap.split(",")[0])
+                    state["parts"] = sorted(
+                        state["client"].metadata(topic_name)) or [0]
+                # round-robin partitions per tick, like a keyless producer
+                parts = state["parts"]
+                pid = parts[state["next_part"] % len(parts)]
+                state["next_part"] += 1
+                state["client"].produce(
+                    topic_name, pid, [(None, v) for v in payloads])
+
+            def callback(time, delta):
+                payloads = encode_rows(time, delta)
+                if not payloads:
+                    return
+                try:
+                    send(payloads)
+                except (ConnectionError, OSError, RuntimeError):
+                    # broker blip: drop the dead socket and retry once so
+                    # a restart doesn't poison every later tick
+                    if state["client"] is not None:
+                        state["client"].close()
+                        state["client"] = None
+                    send(payloads)
+
+            runner.subscribe(table, callback)
 
     G.add_output(binder)
