@@ -3,7 +3,7 @@
 Every connector is implemented against its actual protocol, with no
 optional client packages: fs/csv/jsonlines/plaintext/parquet file IO,
 python (ConnectorSubject), http (rest_connector server + streaming
-client), subscribe, null, kafka, sqlite, debezium CDC, deltalake, s3/
+client), subscribe, null, kafka (native wire protocol; kafka-python optional), sqlite, debezium CDC, deltalake, s3/
 minio/s3_csv (REST+SigV4), postgres (wire format), elasticsearch (bulk
 REST), logstash, slack, pyfilesystem, gdrive (Drive REST), airbyte
 (protocol host over docker/pypi/executable connectors), pubsub + bigquery

@@ -123,19 +123,28 @@ class KafkaSource(DataSource):
         from pathway_tpu.io.kafka._protocol import (KafkaClient,
                                                      KafkaProtocolError)
 
-        bootstrap = self.settings.get("bootstrap.servers", "127.0.0.1:9092")
-        bootstrap = bootstrap.split(",")[0]
+        hosts = [h.strip() for h in self.settings.get(
+            "bootstrap.servers", "127.0.0.1:9092").split(",") if h.strip()]
+        host_idx = 0
         reset = self.settings.get("auto.offset.reset", "earliest")
         seq = 0
 
         def emit(partition, offset, value):
             nonlocal seq
             if value is None:
-                return
+                return  # tombstone / control-batch sentinel
             if self.format == "raw":
                 values = {"data": value}
             else:
-                values = _json.loads(value)
+                try:
+                    values = _json.loads(value)
+                except (ValueError, UnicodeDecodeError):
+                    # a malformed message must not kill the reader; the
+                    # offset still advances so it is consumed exactly once
+                    logging.getLogger(__name__).warning(
+                        "kafka: skipping non-JSON message at %s[%s]",
+                        partition, offset)
+                    return
             key, row = self.row_to_engine(values, seq)
             seq += 1
             session.push(key, row, 1, offset=("part", partition, offset))
@@ -146,19 +155,23 @@ class KafkaSource(DataSource):
         while True:
             try:
                 if client is None:
-                    client = KafkaClient(bootstrap)
-                    parts = client.metadata(self.topic)
-                    for pid in parts:
-                        if pid in positions:
-                            continue
-                        last = (self._resume_antichain.get(pid)
-                                if self._resume_antichain else None)
-                        if last is not None:
-                            positions[pid] = int(last) + 1
-                        else:
-                            positions[pid] = client.list_offsets(
-                                self.topic, pid,
-                                -2 if reset == "earliest" else -1)
+                    # rotate bootstrap hosts across reconnects (failover)
+                    client = KafkaClient(hosts[host_idx % len(hosts)])
+                    host_idx += 1
+                    parts = sorted(client.metadata(self.topic))
+                # (re)resolve any partition without a position — new
+                # partitions, or after an out-of-range reset
+                for pid in parts:
+                    if pid in positions:
+                        continue
+                    last = (self._resume_antichain.get(pid)
+                            if self._resume_antichain else None)
+                    if last is not None:
+                        positions[pid] = int(last) + 1
+                    else:
+                        positions[pid] = client.list_offsets(
+                            self.topic, pid,
+                            -2 if reset == "earliest" else -1)
                 any_data = False
                 # one fetch covers every partition: per-partition polling
                 # would pay the broker's max_wait serially per idle one
@@ -174,19 +187,23 @@ class KafkaSource(DataSource):
             except KafkaProtocolError as e:
                 if e.code == 1:
                     # OFFSET_OUT_OF_RANGE (retention passed the frontier):
-                    # honor auto.offset.reset instead of retrying forever
+                    # honor auto.offset.reset instead of retrying forever.
+                    # The stale resume frontier must not be re-applied.
                     logging.getLogger(__name__).warning(
                         "kafka offset out of range; re-resolving via "
                         "auto.offset.reset=%s", reset)
+                    self._resume_antichain = None
                     positions.clear()
                     continue
+                # other broker errors (leader moved, topic recreated):
+                # reconnect and refresh metadata, but KEEP consumed
+                # positions — clearing them would re-emit the whole topic
                 logging.getLogger(__name__).warning(
                     "kafka protocol error (%s); reconnecting in %.0fs",
                     e, backoff)
                 if client is not None:
                     client.close()
                     client = None
-                positions.clear()  # re-resolve from metadata on reconnect
                 _t.sleep(backoff)
                 backoff = min(backoff * 2, 30.0)
             except (ConnectionError, OSError, RuntimeError) as e:
@@ -245,9 +262,12 @@ def write(table: Table, rdkafka_settings: dict, topic_name: str, *,
 
             state = {"client": None, "next_part": 0, "parts": None}
 
+            hosts = [h.strip() for h in bootstrap.split(",") if h.strip()]
+
             def send(payloads):
                 if state["client"] is None:
-                    state["client"] = KafkaClient(bootstrap.split(",")[0])
+                    state["client"] = KafkaClient(
+                        hosts[state["next_part"] % len(hosts)])
                     state["parts"] = sorted(
                         state["client"].metadata(topic_name)) or [0]
                 # round-robin partitions per tick, like a keyless producer

@@ -222,6 +222,14 @@ def parse_record_batches(data: bytes) -> Iterator[tuple[int, bytes | None,
                 "need kafka-python")
         r.uint32()                       # crc (trusted: TCP + broker)
         attrs = r.int16()
+        if attrs & 0x20:
+            # control batch (transaction markers): nothing to emit, but the
+            # caller must still advance PAST it or it refetches forever —
+            # yield one (offset, None, None) sentinel at the batch's end
+            lod = r.int32()              # lastOffsetDelta
+            yield base_offset + lod, None, None
+            pos = end
+            continue
         if attrs & 0x07:
             # silent skipping would stall a reader at this offset forever
             raise KafkaProtocolError(

@@ -3,3 +3,5 @@
 from pathway_tpu.xpacks import llm  # noqa: F401
 
 __all__ = ["llm"]
+
+from pathway_tpu.xpacks import connectors  # noqa: F401

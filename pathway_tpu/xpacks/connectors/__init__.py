@@ -1,0 +1,4 @@
+"""pw.xpacks.connectors — enterprise connectors (reference:
+python/pathway/xpacks/connectors)."""
+
+from pathway_tpu.xpacks.connectors import sharepoint  # noqa: F401

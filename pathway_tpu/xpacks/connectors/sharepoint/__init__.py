@@ -1,0 +1,235 @@
+"""pw.xpacks.connectors.sharepoint — SharePoint document reader
+(reference: python/pathway/xpacks/connectors/sharepoint/__init__.py:249 —
+a polling ConnectorSubject listing a folder over the SharePoint REST API;
+there it is entitlement-gated and driven through the office365 package).
+
+Here the REST protocol is spoken directly (``/_api/web/GetFolderByServer
+RelativeUrl(...)``): folder listing, recursive descent, ``$value``
+downloads, modified-time change detection with retractions. No license
+gate. Authentication is pluggable like pw.io.gdrive: pass ``access_token``
+or ``token_provider`` (an Azure AD bearer token for the site); the
+reference's certificate flow (tenant/client_id/cert_path/thumbprint)
+requires RSA signing and is gated on `msal` being installed.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.table import Plan, Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._datasource import CollectSession, DataSource, Session
+
+
+def _token_provider_from_cert(url, tenant, client_id, cert_path, thumbprint):
+    try:
+        import msal  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "certificate authentication needs msal (RSA-signed client "
+            "assertions); pass access_token= or token_provider= instead — "
+            "the SharePoint REST protocol itself runs without it") from e
+    from urllib.parse import urlparse
+
+    host = urlparse(url).netloc
+    app = msal.ConfidentialClientApplication(
+        client_id,
+        authority=f"https://login.microsoftonline.com/{tenant}",
+        client_credential={
+            "private_key": open(cert_path).read(),
+            "thumbprint": thumbprint,
+        })
+
+    def provider():
+        result = app.acquire_token_for_client(
+            scopes=[f"https://{host.split('/')[0]}/.default"])
+        if "access_token" not in result:
+            raise RuntimeError(f"sharepoint auth failed: {result}")
+        return result["access_token"]
+
+    return provider
+
+
+class SharePointSource(DataSource):
+    name = "sharepoint"
+
+    def __init__(self, schema, *, url: str, root_path: str, token_provider,
+                 mode: str, recursive: bool, object_size_limit: int | None,
+                 with_metadata: bool, refresh_interval: int,
+                 autocommit_duration_ms=1500):
+        super().__init__(schema, autocommit_duration_ms)
+        self.url = url.rstrip("/")
+        self.root_path = root_path
+        self.token_provider = token_provider
+        self.mode = mode
+        self.recursive = recursive
+        self.object_size_limit = object_size_limit
+        self.with_metadata = with_metadata
+        self.refresh_interval = refresh_interval
+        self._seq = 0
+
+    def _headers(self) -> dict:
+        tok = self.token_provider()
+        h = {"Accept": "application/json;odata=verbose"}
+        if tok:
+            h["Authorization"] = f"Bearer {tok}"
+        return h
+
+    def _get(self, http, api_path: str, **kw):
+        resp = http.get(f"{self.url}/_api/web/{api_path}",
+                        headers=self._headers(), timeout=60, **kw)
+        resp.raise_for_status()
+        return resp
+
+    def _list_folder(self, http, folder: str) -> tuple[list[dict], list[str]]:
+        """(files, subfolder server-relative urls)."""
+        enc = folder.replace("'", "''")
+        files = self._get(
+            http, f"GetFolderByServerRelativeUrl('{enc}')/Files"
+        ).json()["d"]["results"]
+        subfolders = []
+        if self.recursive:
+            for f in self._get(
+                    http, f"GetFolderByServerRelativeUrl('{enc}')/Folders"
+            ).json()["d"]["results"]:
+                name = f.get("Name", "")
+                if not name.startswith("Forms"):
+                    subfolders.append(f["ServerRelativeUrl"])
+        return files, subfolders
+
+    def _scan(self, http) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        stack = [self.root_path]
+        seen = set()
+        while stack:
+            folder = stack.pop()
+            if folder in seen:
+                continue
+            seen.add(folder)
+            files, subfolders = self._list_folder(http, folder)
+            stack.extend(subfolders)
+            for f in files:
+                size = int(f.get("Length") or 0)
+                if self.object_size_limit is not None \
+                        and size > self.object_size_limit:
+                    continue
+                out[f["ServerRelativeUrl"]] = f
+        return out
+
+    def _download(self, http, server_relative_url: str) -> bytes:
+        enc = server_relative_url.replace("'", "''")
+        return self._get(
+            http, f"GetFileByServerRelativeUrl('{enc}')/$value").content
+
+    def _meta(self, f: dict) -> Json:
+        return Json({
+            "path": f.get("ServerRelativeUrl"),
+            "name": f.get("Name"),
+            "size": int(f.get("Length") or 0),
+            "created_at": f.get("TimeCreated"),
+            "modified_at": f.get("TimeLastModified"),
+        })
+
+    def _poll_once(self, http, session, emitted: dict) -> None:
+        listing = self._scan(http)
+        for path in list(emitted):
+            if path not in listing:
+                _mt, key, row = emitted.pop(path)
+                session.push(key, row, -1)
+        for path, f in listing.items():
+            mtime = f.get("TimeLastModified")
+            prev = emitted.get(path)
+            if prev is not None and prev[0] == mtime:
+                continue
+            content = self._download(http, path)
+            values = {"data": content}
+            if self.with_metadata:
+                values["_metadata"] = self._meta(f)
+            key, row = self.row_to_engine(values, self._seq)
+            self._seq += 1
+            if prev is not None:
+                session.push(prev[1], prev[2], -1)
+            session.push(key, row, 1)
+            emitted[path] = (mtime, key, row)
+
+    def run(self, session: Session) -> None:
+        import logging
+
+        import requests
+
+        http = requests.Session()
+        emitted: dict[str, tuple] = {}
+        backoff = 1.0
+        while True:
+            try:
+                self._poll_once(http, session, emitted)
+                backoff = 1.0
+            except (requests.RequestException, OSError) as e:
+                if self.mode != "streaming":
+                    raise
+                logging.getLogger(__name__).warning(
+                    "sharepoint poll failed (%s); retrying in %.0fs",
+                    e, backoff)
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 60.0)
+                continue
+            if self.mode != "streaming":
+                return
+            _time.sleep(self.refresh_interval)
+
+
+def read(url: str, *,
+         tenant: str | None = None,
+         client_id: str | None = None,
+         cert_path: str | None = None,
+         thumbprint: str | None = None,
+         root_path: str,
+         mode: str = "streaming",
+         recursive: bool = True,
+         object_size_limit: int | None = None,
+         with_metadata: bool = False,
+         refresh_interval: int = 30,
+         access_token: str | None = None,
+         token_provider=None,
+         name: str | None = None,
+         persistent_id: str | None = None,
+         autocommit_duration_ms: int | None = 1500) -> Table:
+    """Read a SharePoint directory (recursively) or file as binary `data`
+    rows (reference signature, sharepoint/__init__.py:249-262, plus the
+    pluggable-auth extension)."""
+    if mode not in ("streaming", "static"):
+        raise ValueError(f"Unrecognized connector mode: {mode}")
+    if token_provider is None:
+        if access_token is not None:
+            token_provider = lambda: access_token  # noqa: E731
+        elif cert_path is not None:
+            token_provider = _token_provider_from_cert(
+                url, tenant, client_id, cert_path, thumbprint)
+        else:
+            raise ValueError(
+                "pass access_token/token_provider, or the certificate "
+                "flow's tenant/client_id/cert_path/thumbprint")
+    if with_metadata:
+        schema = sch.schema_from_types(data=dt.BYTES, _metadata=Json)
+    else:
+        schema = sch.schema_from_types(data=dt.BYTES)
+    source = SharePointSource(
+        schema, url=url, root_path=root_path, token_provider=token_provider,
+        mode=mode, recursive=recursive,
+        object_size_limit=object_size_limit, with_metadata=with_metadata,
+        refresh_interval=refresh_interval,
+        autocommit_duration_ms=autocommit_duration_ms)
+    source.persistent_id = persistent_id or name
+    if mode == "static":
+        sess = CollectSession()
+        source.run(sess)
+        keys = list(sess.state)
+        rows = [sess.state[k] for k in keys]
+        return Table(Plan("static", keys=keys, rows=rows, times=None,
+                          diffs=None), schema, Universe(),
+                     name=name or "sharepoint_static")
+    return Table(Plan("input", datasource=source), schema, Universe(),
+                 name=name or "sharepoint_input")

@@ -1,0 +1,134 @@
+"""SharePoint xpack connector against an in-test REST API double
+(reference: xpacks/connectors/sharepoint — entitlement-gated office365
+client there; the REST protocol itself here)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote, urlparse
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.xpacks.connectors import sharepoint
+
+
+@pytest.fixture(autouse=True)
+def _clear():
+    G.clear()
+    yield
+    G.clear()
+
+
+class _FakeSharePoint(BaseHTTPRequestHandler):
+    # folder url -> {"files": {name: (bytes, mtime)}, "folders": [urls]}
+    tree: dict = {}
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, payload, code=200):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.headers.get("Authorization") != "Bearer sp-tok":
+            return self._json({"error": "unauthorized"}, 401)
+        path = unquote(urlparse(self.path).path)
+        if "GetFolderByServerRelativeUrl('" in path:
+            folder = path.split("('", 1)[1].split("')", 1)[0]
+            node = self.tree.get(folder)
+            if node is None:
+                return self._json({"error": "notFound"}, 404)
+            if path.endswith("/Files"):
+                results = [
+                    {"Name": n, "ServerRelativeUrl": f"{folder}/{n}",
+                     "Length": str(len(data)), "TimeCreated": "t0",
+                     "TimeLastModified": mtime}
+                    for n, (data, mtime) in node["files"].items()]
+                return self._json({"d": {"results": results}})
+            if path.endswith("/Folders"):
+                results = [{"Name": f.rsplit("/", 1)[-1],
+                            "ServerRelativeUrl": f}
+                           for f in node["folders"]]
+                return self._json({"d": {"results": results}})
+        if "GetFileByServerRelativeUrl('" in path and path.endswith("$value"):
+            furl = path.split("('", 1)[1].split("')", 1)[0]
+            folder, _, name = furl.rpartition("/")
+            node = self.tree.get(folder)
+            if node is None or name not in node["files"]:
+                return self._json({"error": "notFound"}, 404)
+            data = node["files"][name][0]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        self._json({"error": "bad request"}, 400)
+
+
+@pytest.fixture()
+def fake_sp():
+    _FakeSharePoint.tree = {
+        "/sites/MySite/Docs": {
+            "files": {"a.txt": (b"alpha", "m1"),
+                      "big.bin": (b"x" * 100, "m1")},
+            "folders": ["/sites/MySite/Docs/Sub"],
+        },
+        "/sites/MySite/Docs/Sub": {
+            "files": {"b.txt": (b"beta", "m1")},
+            "folders": [],
+        },
+    }
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeSharePoint)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}/sites/MySite"
+    server.shutdown()
+
+
+def test_sharepoint_static_recursive_and_size_limit(fake_sp):
+    t = sharepoint.read(fake_sp, root_path="/sites/MySite/Docs",
+                        mode="static", access_token="sp-tok",
+                        with_metadata=True, object_size_limit=50)
+    rows = pw.debug.table_to_pandas(t).to_dict("records")
+    assert sorted(r["data"] for r in rows) == [b"alpha", b"beta"]
+    metas = {r["_metadata"].value["name"] for r in rows}
+    assert metas == {"a.txt", "b.txt"}  # big.bin filtered by size
+
+
+def test_sharepoint_streaming_update(fake_sp):
+    t = sharepoint.read(fake_sp, root_path="/sites/MySite/Docs",
+                        mode="streaming", access_token="sp-tok",
+                        refresh_interval=0, autocommit_duration_ms=20)
+    seen = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition:
+                    seen.append((row["data"], is_addition)))
+
+    def mutate():
+        time.sleep(0.4)
+        _FakeSharePoint.tree["/sites/MySite/Docs"]["files"]["a.txt"] = \
+            (b"alpha-v2", "m2")
+
+    threading.Thread(target=mutate, daemon=True).start()
+    threading.Thread(target=lambda: pw.run(), daemon=True).start()
+    want = {(b"alpha", True), (b"alpha", False), (b"alpha-v2", True)}
+    deadline = time.time() + 12
+    while time.time() < deadline and not want <= set(seen):
+        time.sleep(0.1)
+    assert want <= set(seen)
+
+
+def test_sharepoint_cert_flow_gated():
+    with pytest.raises((ImportError, ValueError, OSError),
+                       match="msal|access_token|nonexistent"):
+        sharepoint.read("https://x.sharepoint.com/sites/S",
+                        tenant="t", client_id="c",
+                        cert_path="/nonexistent.pem", thumbprint="tp",
+                        root_path="/sites/S/Docs", mode="static")
