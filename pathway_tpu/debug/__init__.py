@@ -2,7 +2,19 @@
 (reference: python/pathway/debug/__init__.py:48-489).
 
 `table_from_markdown` + `compute_and_print` are the backbone of the test
-harness (SURVEY §4: the markdown-table → captured-diff-stream pattern).
+harness (SURVEY §4: the markdown-table → captured-diff-stream pattern):
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown(\'\'\'
+... city   | temp
+... Lagos  | 33
+... Oslo   | 4
+... \'\'\')
+>>> pw.debug.compute_and_print(
+...     t.select(t.city, f=t.temp * 9 // 5 + 32), include_id=False)
+city | f
+Lagos | 91
+Oslo | 39
 """
 
 from __future__ import annotations

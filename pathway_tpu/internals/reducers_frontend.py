@@ -1,5 +1,22 @@
 """`pw.reducers` namespace (reference: python/pathway/reducers →
-internals/custom_reducers.py + engine Reducer enum, src/engine/reduce.rs:22)."""
+internals/custom_reducers.py + engine Reducer enum, src/engine/reduce.rs:22).
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown(\'\'\'
+... shop | qty
+... a    | 3
+... a    | 5
+... b    | 2
+... \'\'\')
+>>> pw.debug.compute_and_print(
+...     t.groupby(t.shop).reduce(
+...         t.shop, n=pw.reducers.count(), total=pw.reducers.sum(t.qty),
+...         top=pw.reducers.max(t.qty)),
+...     include_id=False)
+shop | n | total | top
+a | 2 | 8 | 5
+b | 1 | 2 | 2
+"""
 
 from __future__ import annotations
 

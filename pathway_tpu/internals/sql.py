@@ -12,6 +12,22 @@ from pathway_tpu.internals.table import Table
 
 
 def sql(query: str, **tables: Table) -> Table:
+    """Compile a SQL query over named tables.
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown(\'\'\'
+    ... item | price
+    ... pen  | 4
+    ... ink  | 9
+    ... pad  | 2
+    ... \'\'\')
+    >>> r = pw.sql("SELECT item, price * 2 AS double FROM t WHERE price > 3",
+    ...            t=t)
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    item | double
+    ink | 18
+    pen | 8
+    """
     from pathway_tpu.internals.sql_parser import compile_sql
 
     return compile_sql(query, tables)

@@ -151,6 +151,20 @@ class Table:
     # projection & mutation
     # ------------------------------------------------------------------
     def select(self, *args, **kwargs) -> "Table":
+        """Project/compute columns (reference: Table.select, table.py).
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown(\'\'\'
+        ... name  | qty
+        ... bolt  | 3
+        ... screw | 5
+        ... \'\'\')
+        >>> pw.debug.compute_and_print(
+        ...     t.select(t.name, double=t.qty * 2), include_id=False)
+        name | double
+        bolt | 6
+        screw | 10
+        """
         exprs = self._select_args_to_exprs(args, kwargs)
         schema = self._result_schema(exprs)
         plan = Plan("map", base=self, exprs=list(exprs.values()),
@@ -216,6 +230,20 @@ class Table:
     # filtering / universe ops
     # ------------------------------------------------------------------
     def filter(self, filter_expression) -> "Table":
+        """Keep rows where the predicate holds.
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown(\'\'\'
+        ... name  | qty
+        ... bolt  | 3
+        ... screw | 5
+        ... nut   | 9
+        ... \'\'\')
+        >>> pw.debug.compute_and_print(t.filter(t.qty > 4), include_id=False)
+        name | qty
+        nut | 9
+        screw | 5
+        """
         pred = self._resolve(ex.wrap_arg(filter_expression))
         plan = Plan("filter", base=self, pred=pred)
         return Table(plan, self._schema, self._universe.subuniverse())

@@ -1,0 +1,50 @@
+"""Runnable >>> examples on user-facing APIs (reference test strategy:
+doctests run in CI, compute_and_print determinism makes them assertions —
+SURVEY §4)."""
+
+from __future__ import annotations
+
+import doctest
+
+import pytest
+
+import pathway_tpu  # noqa: F401
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _clear():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _run(module) -> None:
+    results = doctest.testmod(module, verbose=False,
+                              optionflags=doctest.NORMALIZE_WHITESPACE)
+    assert results.failed == 0, f"{results.failed} doctest failures"
+    assert results.attempted > 0, "no doctests found"
+
+
+def test_table_doctests():
+    from pathway_tpu.internals import table
+
+    _run(table)
+
+
+def test_debug_doctests():
+    from pathway_tpu import debug
+
+    _run(debug)
+
+
+def test_reducers_doctests():
+    from pathway_tpu.internals import reducers_frontend
+
+    _run(reducers_frontend)
+
+
+def test_sql_doctests():
+    from pathway_tpu.internals import sql
+
+    _run(sql)
