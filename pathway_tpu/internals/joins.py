@@ -3,6 +3,24 @@
 ``t1.join(t2, t1.a == t2.b, how="left").select(...)`` — JoinResult carries
 both sides + the on-condition; select/reduce lower to the engine
 JoinOperator (result id = hash of side ids, reference dataflow.rs:2371).
+
+>>> import pathway_tpu as pw
+>>> l = pw.debug.table_from_markdown('''
+... k | v
+... a | 1
+... b | 2
+... ''')
+>>> r = pw.debug.table_from_markdown('''
+... k | w
+... a | 10
+... c | 30
+... ''')
+>>> pw.debug.compute_and_print(
+...     l.join_left(r, l.k == r.k).select(l.k, l.v, r.w),
+...     include_id=False)
+k | v | w
+a | 1 | 10
+b | 2 |
 """
 
 from __future__ import annotations

@@ -338,6 +338,21 @@ class Table:
 
     def deduplicate(self, *, value=None, instance=None, acceptor=None, name=None,
                     persistent_id=None) -> "Table":
+        """Keep one accepted value per instance; by default a new distinct
+        value replaces the old one (reference: pw.Table.deduplicate).
+
+        >>> import pathway_tpu as pw
+        >>> s = pw.debug.table_from_markdown('''
+        ... sensor | reading | _time
+        ... a      | 5       | 2
+        ... a      | 5       | 4
+        ... a      | 8       | 6
+        ... ''')
+        >>> pw.debug.compute_and_print(s.deduplicate(value=s.reading),
+        ...                            include_id=False)
+        sensor | reading
+        a | 8
+        """
         value_e = self._resolve(ex.wrap_arg(value)) if value is not None else None
         inst_e = self._resolve(ex.wrap_arg(instance)) if instance is not None else None
         if acceptor is None:

@@ -183,6 +183,24 @@ class WindowedTable:
 
 def windowby(table: Table, time_expr, *, window: Window, behavior=None,
              instance=None, origin=None) -> WindowedTable:
+    """Assign rows to time windows, then reduce per window.
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... at | v
+    ... 1  | 10
+    ... 3  | 20
+    ... 6  | 30
+    ... ''')
+    >>> win = pw.temporal.windowby(t, t.at, window=pw.temporal.tumbling(5))
+    >>> pw.debug.compute_and_print(
+    ...     win.reduce(start=pw.this._pw_window_start,
+    ...                s=pw.reducers.sum(pw.this.v)),
+    ...     include_id=False)
+    start | s
+    0 | 30
+    5 | 30
+    """
     time_e = table._resolve(ex.wrap_arg(time_expr))
     instance_used = instance is not None
     inst_e = table._resolve(ex.wrap_arg(instance)) if instance_used else None

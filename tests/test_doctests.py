@@ -48,3 +48,15 @@ def test_sql_doctests():
     from pathway_tpu.internals import sql
 
     _run(sql)
+
+
+def test_joins_doctests():
+    from pathway_tpu.internals import joins
+
+    _run(joins)
+
+
+def test_temporal_doctests():
+    from pathway_tpu.stdlib import temporal
+
+    _run(temporal)
