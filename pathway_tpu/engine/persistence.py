@@ -300,15 +300,13 @@ class PersistenceDriver:
             else:
                 self._s3 = S3Client(bucket=bucket)  # env credential chain
             self.root = prefix
-        elif self.kind in ("filesystem", "azure"):
-            if self.kind != "filesystem":
-                import logging
+        elif self.kind == "azure":
+            # Azure Blob via the in-repo SharedKey/SAS client; blob surface
+            # duck-types S3Client so the object-per-commit log is shared
+            from pathway_tpu.io.azure_blob import client_from_backend
 
-                logging.getLogger(__name__).warning(
-                    "%s persistence backend: no cloud client in this build — "
-                    "writing snapshots to LOCAL path %r. State will not "
-                    "survive loss of this machine's disk.",
-                    self.kind, backend.path)
+            self._s3, self.root = client_from_backend(backend)
+        elif self.kind == "filesystem":
             self.root = backend.path
             os.makedirs(os.path.join(self.root, "streams"), exist_ok=True)
         elif self.kind == "mock":

@@ -1,0 +1,187 @@
+"""Dependency-free Azure Blob Storage client (SharedKey / SAS auth).
+
+Backs ``pw.persistence.Backend.azure`` the way io/s3/_client.py backs the
+S3 backend: plain HTTPS + the Storage SharedKey signature
+(https://learn.microsoft.com/rest/api/storageservices/authorize-with-shared-key)
+or a SAS token appended to the query string. The object surface duck-types
+S3Client (get/put/delete/list with {key,size,last_modified} dicts), so the
+object-per-commit snapshot log (engine/persistence.py S3SnapshotLog) works
+against either store unchanged.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import xml.etree.ElementTree as ET
+from typing import Iterator
+from urllib.parse import quote, urlparse
+
+_API_VERSION = "2021-08-06"
+
+
+class AzureBlobClient:
+    def __init__(self, *, account: str, container: str,
+                 account_key: str | None = None,
+                 sas_token: str | None = None,
+                 endpoint: str | None = None):
+        self.account = account
+        self.container = container
+        self.account_key = account_key
+        self.sas_token = (sas_token or "").lstrip("?") or None
+        if endpoint:
+            # azurite-style endpoints carry the account in the URL path
+            # (http://host:port/devstoreaccount1); keep that path segment
+            # for both the request URL and the canonical resource
+            parsed = urlparse(endpoint.rstrip("/"))
+            self._base = f"{parsed.scheme}://{parsed.netloc}"
+            self._path_prefix = parsed.path  # "" or "/devstoreaccount1"
+        else:
+            self._base = f"https://{account}.blob.core.windows.net"
+            self._path_prefix = ""
+        self.endpoint = self._base + self._path_prefix
+        self.base_url = self.endpoint
+        import requests
+
+        self._http = requests.Session()
+
+    # -- auth ----------------------------------------------------------------
+    def _sign(self, method: str, path: str, query: dict, headers: dict) -> None:
+        if self.account_key is None:
+            return
+        canon_headers = "".join(
+            f"{k}:{headers[k]}\n"
+            for k in sorted(h for h in headers if h.startswith("x-ms-")))
+        canon_resource = f"/{self.account}{self._path_prefix}{path}"
+        for k in sorted(query):
+            canon_resource += f"\n{k}:{query[k]}"
+        length = headers.get("Content-Length", "")
+        if length == "0":
+            length = ""  # 2015-02-21+ rule: empty when zero
+        string_to_sign = "\n".join([
+            method,
+            "",              # Content-Encoding
+            "",              # Content-Language
+            length,          # Content-Length
+            "",              # Content-MD5
+            headers.get("Content-Type", ""),
+            "",              # Date (x-ms-date used instead)
+            "",              # If-Modified-Since
+            "",              # If-Match
+            "",              # If-None-Match
+            "",              # If-Unmodified-Since
+            "",              # Range
+        ]) + "\n" + canon_headers + canon_resource
+        key = base64.b64decode(self.account_key)
+        sig = base64.b64encode(hmac.new(
+            key, string_to_sign.encode(), hashlib.sha256).digest()).decode()
+        headers["Authorization"] = f"SharedKey {self.account}:{sig}"
+
+    def _request(self, method: str, blob: str = "", *,
+                 query: dict | None = None, body: bytes = b"",
+                 extra_headers: dict | None = None, ok=(200, 201, 202)):
+        query = dict(query or {})
+        path = f"/{self.container}"
+        if blob:
+            path += f"/{quote(blob, safe='/-_.~')}"
+        import email.utils
+
+        headers = {
+            # locale-independent RFC 1123 (strftime %a/%b break under a
+            # non-English LC_TIME and Azure rejects the request)
+            "x-ms-date": email.utils.formatdate(usegmt=True),
+            "x-ms-version": _API_VERSION,
+        }
+        if body or method == "PUT":
+            headers["Content-Length"] = str(len(body))
+        headers.update(extra_headers or {})
+        self._sign(method, path, query, headers)
+        qs = "&".join(f"{k}={quote(str(v), safe='')}"
+                      for k, v in sorted(query.items()))
+        if self.sas_token:
+            qs = f"{qs}&{self.sas_token}" if qs else self.sas_token
+        url = f"{self.base_url}{path}" + (f"?{qs}" if qs else "")
+        resp = self._http.request(method, url, headers=headers, data=body,
+                                  timeout=60)
+        if resp.status_code not in ok:
+            raise RuntimeError(
+                f"azure {method} {blob!r}: HTTP {resp.status_code} "
+                f"{resp.text[:300]}")
+        return resp
+
+    # -- object ops (S3Client-compatible surface) ----------------------------
+    def get_object(self, key: str) -> bytes:
+        return self._request("GET", key).content
+
+    def get_object_or_none(self, key: str) -> bytes | None:
+        resp = self._request("GET", key, ok=(200, 404))
+        return None if resp.status_code == 404 else resp.content
+
+    def put_object(self, key: str, body: bytes) -> None:
+        self._request("PUT", key, body=body,
+                      extra_headers={"x-ms-blob-type": "BlockBlob"})
+
+    def delete_object(self, key: str) -> None:
+        self._request("DELETE", key, ok=(200, 202, 204))
+
+    def list_objects(self, prefix: str = "") -> Iterator[dict]:
+        marker = None
+        while True:
+            query = {"restype": "container", "comp": "list"}
+            if prefix:  # an empty prefix param signs/parses ambiguously
+                query["prefix"] = prefix
+            if marker:
+                query["marker"] = marker
+            resp = self._request("GET", "", query=query)
+            tree = ET.fromstring(resp.content)
+            for blob in tree.iter("Blob"):
+                props = blob.find("Properties")
+                yield {
+                    "key": blob.findtext("Name"),
+                    "size": int(props.findtext("Content-Length") or 0)
+                    if props is not None else 0,
+                    "last_modified": props.findtext("Last-Modified")
+                    if props is not None else None,
+                }
+            marker = tree.findtext("NextMarker")
+            if not marker:
+                return
+
+
+def client_from_backend(backend) -> tuple["AzureBlobClient", str]:
+    """Build from pw.persistence.Backend.azure(root_path, account=...).
+
+    ``root_path``: ``az://container/prefix`` (or ``container/prefix``);
+    ``account`` carries account name + account_key/sas_token/endpoint —
+    a dict or any object with those attributes."""
+    path = (backend.path or "")
+    abfss_host = None
+    for scheme in ("az://", "azure://", "abfss://"):
+        if path.startswith(scheme):
+            path = path[len(scheme):]
+            break
+    container, _, prefix = path.partition("/")
+    if "@" in container:
+        # abfss form: container@account.dfs.core.windows.net — the dfs
+        # host maps onto the blob endpoint of the same account
+        container, _, host = container.partition("@")
+        abfss_host = host.replace(".dfs.", ".blob.")
+    acct = backend.options.get("account")
+    get = (acct.get if isinstance(acct, dict)
+           else lambda k, d=None: getattr(acct, k, d))
+    if acct is None:
+        raise ValueError(
+            "Backend.azure needs account=dict(account=..., account_key=... "
+            "or sas_token=..., endpoint=... for azurite)")
+    account = get("account") or (abfss_host.split(".", 1)[0]
+                                 if abfss_host else "devstoreaccount1")
+    endpoint = get("endpoint") or (f"https://{abfss_host}"
+                                   if abfss_host else None)
+    return AzureBlobClient(
+        account=account,
+        container=container,
+        account_key=get("account_key"),
+        sas_token=get("sas_token"),
+        endpoint=endpoint,
+    ), prefix

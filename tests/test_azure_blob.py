@@ -1,0 +1,212 @@
+"""Azure Blob client + persistence backend against an in-test server that
+VERIFIES the SharedKey signature (the Azure counterpart of
+tests/test_s3.py — one object-per-commit snapshot log serves both)."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.azure_blob import AzureBlobClient
+
+ACCOUNT = "teststore"
+KEY = base64.b64encode(b"super secret account key 123456").decode()
+
+
+@pytest.fixture(autouse=True)
+def _clear():
+    G.clear()
+    yield
+    G.clear()
+
+
+class _FakeAzure(BaseHTTPRequestHandler):
+    blobs: dict = {}  # (container, name) -> bytes
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def _verify(self) -> bool:
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith(f"SharedKey {ACCOUNT}:"):
+            return False
+        got_sig = auth.split(":", 1)[1]
+        u = urlparse(self.path)
+        xms = sorted((k.lower(), v) for k, v in self.headers.items()
+                     if k.lower().startswith("x-ms-"))
+        canon_headers = "".join(f"{k}:{v}\n" for k, v in xms)
+        canon_resource = f"/{ACCOUNT}{unquote(u.path)}"
+        q = parse_qs(u.query)
+        for k in sorted(q):
+            canon_resource += f"\n{k}:{q[k][0]}"
+        length = self.headers.get("Content-Length", "")
+        if length == "0":
+            length = ""
+        string_to_sign = "\n".join([
+            self.command, "", "", length, "",
+            self.headers.get("Content-Type", ""),
+            "", "", "", "", "", "",
+        ]) + "\n" + canon_headers + canon_resource
+        want = base64.b64encode(hmac.new(
+            base64.b64decode(KEY), string_to_sign.encode(),
+            hashlib.sha256).digest()).decode()
+        return hmac.compare_digest(want, got_sig)
+
+    def _reply(self, code, body=b""):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _split(self):
+        u = urlparse(self.path)
+        parts = unquote(u.path).lstrip("/").split("/", 1)
+        return parts[0], parts[1] if len(parts) > 1 else "", parse_qs(u.query)
+
+    def do_PUT(self):
+        if not self._verify():
+            return self._reply(403)
+        container, name, _ = self._split()
+        n = int(self.headers.get("Content-Length", 0))
+        self.blobs[(container, name)] = self.rfile.read(n)
+        self._reply(201)
+
+    def do_GET(self):
+        if not self._verify():
+            return self._reply(403)
+        container, name, q = self._split()
+        if q.get("comp") == ["list"]:
+            prefix = q.get("prefix", [""])[0]
+            names = sorted(n for (c, n) in self.blobs
+                           if c == container and n.startswith(prefix))
+            xml = ["<?xml version='1.0'?><EnumerationResults><Blobs>"]
+            for n in names:
+                xml.append(
+                    f"<Blob><Name>{n}</Name><Properties>"
+                    f"<Content-Length>{len(self.blobs[(container, n)])}"
+                    f"</Content-Length></Properties></Blob>")
+            xml.append("</Blobs><NextMarker/></EnumerationResults>")
+            return self._reply(200, "".join(xml).encode())
+        data = self.blobs.get((container, name))
+        if data is None:
+            return self._reply(404)
+        self._reply(200, data)
+
+    def do_DELETE(self):
+        if not self._verify():
+            return self._reply(403)
+        container, name, _ = self._split()
+        self.blobs.pop((container, name), None)
+        self._reply(202)
+
+
+@pytest.fixture()
+def fake_azure():
+    _FakeAzure.blobs = {}
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeAzure)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def _client(endpoint):
+    return AzureBlobClient(account=ACCOUNT, container="snaps",
+                           account_key=KEY, endpoint=endpoint)
+
+
+def test_blob_roundtrip_signed(fake_azure):
+    c = _client(fake_azure)
+    c.put_object("a/x", b"hello")
+    c.put_object("a/y", b"world")
+    c.put_object("b/z", b"other")
+    assert c.get_object("a/x") == b"hello"
+    assert c.get_object_or_none("missing") is None
+    assert [o["key"] for o in c.list_objects("a/")] == ["a/x", "a/y"]
+    c.delete_object("a/x")
+    assert c.get_object_or_none("a/x") is None
+
+
+def test_blob_bad_key_rejected(fake_azure):
+    bad = AzureBlobClient(
+        account=ACCOUNT, container="snaps",
+        account_key=base64.b64encode(b"wrong key").decode(),
+        endpoint=fake_azure)
+    with pytest.raises(RuntimeError, match="403"):
+        bad.put_object("k", b"v")
+
+
+def test_azure_persistence_backend_resume(fake_azure):
+    from pathway_tpu.engine.persistence import PersistenceDriver
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.io._datasource import Session
+    from pathway_tpu.io.python import ConnectorSubject, PythonSource
+
+    cfg = pw.persistence.Config(
+        backend=pw.persistence.Backend.azure(
+            "az://snaps/checkpoints",
+            account=dict(account=ACCOUNT, account_key=KEY,
+                         endpoint=fake_azure)))
+    schema = sch.schema_from_types(data=str)
+
+    class _Subject(ConnectorSubject):
+        def run(self):
+            pass
+
+    src = PythonSource(_Subject(), schema)
+    src.persistent_id = "events"
+    driver = PersistenceDriver(cfg)
+    live = Session()
+    rec = driver.attach_source(src, live)
+    k, r = src.row_to_engine({"data": "alpha"}, 0)
+    rec.push(k, r, 1)
+    driver.commit(1)
+    driver.close()
+
+    keys = [o["key"] for o in _client(fake_azure).list_objects("")]
+    assert keys == ["checkpoints/streams/events/0000000000000000"]
+
+    src2 = PythonSource(_Subject(), schema)
+    src2.persistent_id = "events"
+    driver2 = PersistenceDriver(cfg)
+    live2 = Session()
+    driver2.attach_source(src2, live2)
+    assert [row[1][0] for row in live2.drain()] == ["alpha"]
+    assert driver2.restore_time() == 1
+    driver2.close()
+
+
+def test_abfss_path_parsing():
+    from pathway_tpu.io.azure_blob import client_from_backend
+
+    backend = pw.persistence.Backend.azure(
+        "abfss://snaps@myacct.dfs.core.windows.net/checkpoints",
+        account=dict(account_key=KEY))
+    client, prefix = client_from_backend(backend)
+    assert client.container == "snaps"
+    assert client.account == "myacct"
+    assert client.base_url == "https://myacct.blob.core.windows.net"
+    assert prefix == "checkpoints"
+
+
+def test_azurite_path_style_signing(fake_azure):
+    """Azurite carries the account in the URL path; the canonical resource
+    must include it once from the endpoint and once as the account."""
+    # the fake serves /{container}/... at the root, so emulate azurite by
+    # checking only the signing shape here: base/path split is correct
+    c = AzureBlobClient(account="devstoreaccount1", container="snaps",
+                        account_key=KEY,
+                        endpoint="http://127.0.0.1:10000/devstoreaccount1")
+    assert c.base_url == "http://127.0.0.1:10000/devstoreaccount1"
+    assert c._path_prefix == "/devstoreaccount1"
+    headers: dict = {}
+    c._sign("GET", "/snaps/blob", {}, headers)
+    assert headers["Authorization"].startswith("SharedKey devstoreaccount1:")
