@@ -106,7 +106,7 @@ from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa:
 
 Date_time_naive = DateTimeNaive
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 # groupby sugar namespaces
 groupby = None

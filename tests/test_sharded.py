@@ -414,3 +414,64 @@ def test_iterate_inner_rounds_sharded():
     """), steps=15))
     runner1.run_batch(n_workers=1)
     assert _snap(cap) == _snap(cap1)
+
+
+_MP_DYING = """
+import os
+import sys
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_rows
+from pathway_tpu.engine.multiproc import get_cluster
+from pathway_tpu.internals.runner import GraphRunner
+
+class S(pw.Schema):
+    k: str
+    x: int
+
+rows = [(f"k{i}", i, 2 * (1 + i // 10), 1) for i in range(100)]
+t = table_from_rows(S, rows, is_stream=True)
+g = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.x))
+runner = GraphRunner()
+runner.capture(g)
+if os.environ["PATHWAY_PROCESS_ID"] == "1" and "--die" in sys.argv:
+    # simulate a crash after connecting but before finishing the run
+    cl = get_cluster()
+    time.sleep(0.3)
+    os._exit(17)
+runner.run_batch(cluster=get_cluster())
+print("survived", flush=True)
+"""
+
+
+def test_cluster_peer_death_detected(tmp_path):
+    """Failure detection (SURVEY §5): when one process of a cluster dies
+    mid-run, its peers must FAIL (EOFError at the next exchange) rather
+    than hang — the analogue of the reference's cross-worker panic
+    propagation (dataflow.rs:5459-5601)."""
+    import subprocess
+    import sys as _sys
+
+    prog = tmp_path / "dying.py"
+    prog.write_text(_MP_DYING)
+    env_base = dict(os.environ, JAX_PLATFORMS="cpu",
+                    PYTHONPATH="/root/repo", PATHWAY_RUN_ID="mp-die")
+    handles = []
+    for pid in range(2):
+        env = dict(env_base, PATHWAY_PROCESSES="2",
+                   PATHWAY_PROCESS_ID=str(pid), PATHWAY_THREADS="1",
+                   PATHWAY_FIRST_PORT="19710")
+        args = [_sys.executable, str(prog)]
+        if pid == 1:
+            args.append("--die")
+        handles.append(subprocess.Popen(args, env=env,
+                                        stdout=subprocess.PIPE,
+                                        stderr=subprocess.PIPE, text=True))
+    out0, err0 = handles[0].communicate(timeout=60)
+    out1, _err1 = handles[1].communicate(timeout=60)
+    assert handles[1].returncode == 17          # the simulated crash
+    assert handles[0].returncode != 0, out0     # peer fails, not hangs
+    assert "survived" not in out0
+    assert ("EOFError" in err0 or "Connection" in err0
+            or "BrokenPipe" in err0 or "closed" in err0), err0[-500:]
