@@ -1,0 +1,147 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution over a
+mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.5: ABSENT — its
+dataflow stages are operators, not weight partitions); this is part of the
+TPU-first training story alongside dp/tp/ep (models/train.py) and
+sequence-parallel ring attention (parallel/ring_attention.py).
+
+Design: the transformer's L homogeneous blocks are stacked on a leading
+layer axis and sharded over the ``pipe`` mesh axis, so each device holds
+L/S consecutive blocks. Microbatches flow through the classic GPipe
+schedule inside ONE jitted shard_map: at step t every stage applies its
+blocks to its current activation, then `lax.ppermute` rotates activations
+to the next stage over ICI. Stage 0 injects microbatch t while t < M; the
+last stage collects output t-(S-1). Total steps M + S - 1; bubble fraction
+(S-1)/(M+S-1) — amortized by more microbatches, exactly the standard
+schedule. Static shapes throughout; the step loop is a `lax.scan`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(layer_params: list) -> dict:
+    """[per-layer pytree] -> one pytree with a leading layer axis, ready to
+    shard over the pipe axis."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *layer_params)
+
+
+def make_pipeline_fn(mesh, block_fn: Callable, *, axis: str = PIPE_AXIS,
+                     extra_spec=P()):
+    """Build ``run(stacked_params, microbatches, *extra) -> outputs``.
+
+    - ``stacked_params``: pytree with leading layer axis (length L,
+      divisible by the pipe-axis size); sharded over ``axis``.
+    - ``microbatches``: (M, mb, ...) activations, replicated.
+    - ``block_fn(layer_params, x, extra) -> x``: one transformer block.
+    - ``extra``: ONE replicated side input shared by every microbatch
+      (e.g. an attention mask; per-microbatch side inputs belong inside
+      ``microbatches`` itself).
+
+    Output (M, mb, ...) is replicated (psum-broadcast from the last
+    stage). Parity with sequential layer application is exact.
+    """
+    n_stages = int(mesh.shape[axis])
+
+    def stage_apply(local_params, x, extra):
+        # apply this stage's L/S blocks in order (scan over the local
+        # layer axis keeps one compiled block body)
+        def body(h, layer):
+            return block_fn(layer, h, extra), None
+
+        out, _ = lax.scan(body, x, local_params)
+        return out
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(axis), P(), extra_spec),
+        out_specs=P(),
+        check_vma=False)
+    def run(stacked, microbatches, extra):
+        stage = lax.axis_index(axis)
+        m = microbatches.shape[0]
+        state = jnp.zeros_like(microbatches[0])
+        outputs = jnp.zeros_like(microbatches)
+
+        def step(carry, t):
+            state, outputs = carry
+            inject = microbatches[jnp.clip(t, 0, m - 1)]
+            x = jnp.where(stage == 0, inject, state)
+            out = stage_apply(stacked, x, extra)
+            oi = t - (n_stages - 1)
+            collect = (stage == n_stages - 1) & (oi >= 0)
+            outputs = jnp.where(
+                collect,
+                outputs.at[jnp.clip(oi, 0, m - 1)].set(out),
+                outputs)
+            state = lax.ppermute(
+                out, axis,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(
+            step, (state, outputs), jnp.arange(m + n_stages - 1))
+        # results live on the last stage only; broadcast for replicated out
+        return lax.psum(
+            jnp.where(stage == n_stages - 1, outputs,
+                      jnp.zeros_like(outputs)), axis)
+
+    def wrapper(stacked_params, microbatches, *extra):
+        if len(extra) > 1:
+            raise TypeError(
+                "make_pipeline_fn supports ONE replicated side input; pack "
+                f"extras into a single pytree (got {len(extra)})")
+        packed = extra[0] if extra else jnp.zeros((), jnp.float32)
+        return run(stacked_params, microbatches, packed)
+
+    return wrapper
+
+
+def sequential_encoder_blocks(layers, x, mask, config):
+    """Reference computation the pipeline must match: the encoder's blocks
+    applied in order (shared by tests and the driver dryrun)."""
+    from pathway_tpu.models.encoder import (_attention_block,
+                                            _dense_attention, _mlp_block)
+
+    x = x.astype(config.compute_dtype)
+    for layer in layers:
+        x = _attention_block(x, layer["attn"], mask, config,
+                             _dense_attention)
+        x = _mlp_block(x, layer["mlp"], config)
+    return x
+
+
+def pipeline_encoder_blocks(mesh, config, *, axis: str = PIPE_AXIS):
+    """Pipeline runner for the flagship encoder's transformer blocks
+    (models/encoder.py): ``run(stacked_layer_params, x_microbatches, mask)``
+    where x is the post-embedding hidden state. Embeddings and pooling stay
+    replicated outside the pipeline (they are a tiny fraction of the
+    FLOPs; the blocks are where pipelining pays)."""
+    from pathway_tpu.models.encoder import (_attention_block,
+                                            _dense_attention, _mlp_block)
+
+    def block_fn(layer, x, mask):
+        x = _attention_block(x, layer["attn"], mask, config,
+                             _dense_attention)
+        return _mlp_block(x, layer["mlp"], config)
+
+    run = make_pipeline_fn(mesh, block_fn, axis=axis)
+
+    def wrapped(stacked_params, microbatches, mask):
+        # blocks compute (and emit) compute_dtype; the scan carry must be
+        # dtype-stable, so activations enter the pipeline already cast
+        x = microbatches.astype(config.compute_dtype)
+        return run(stacked_params, x, mask)
+
+    return wrapped

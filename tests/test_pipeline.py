@@ -1,0 +1,57 @@
+"""Pipeline parallelism (parallel/pipeline.py): GPipe schedule over a
+``pipe`` mesh axis must reproduce sequential layer application exactly."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.models.encoder import EncoderConfig, init_params
+from pathway_tpu.parallel.pipeline import (pipeline_encoder_blocks,
+                                           sequential_encoder_blocks,
+                                           stack_stage_params)
+
+
+def _pipe_mesh(n: int):
+    devices = jax.devices()[:n]
+    if len(devices) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    return jax.sharding.Mesh(np.asarray(devices), ("pipe",))
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 4), (4, 7)])
+def test_pipeline_matches_sequential(n_stages, n_micro):
+    config = EncoderConfig.tiny(layers=4, heads=4)
+    params = init_params(jax.random.PRNGKey(0), config)
+    mesh = _pipe_mesh(n_stages)
+    run = pipeline_encoder_blocks(mesh, config)
+    stacked = stack_stage_params(params["layers"])
+
+    mb, seq, hidden = 2, 8, config.hidden
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n_micro, mb, seq, hidden)),
+                    jnp.float32)
+    mask = jnp.ones((mb, seq), bool)
+
+    got = run(stacked, x, mask)
+    assert got.shape == x.shape
+    want = jnp.stack([
+        sequential_encoder_blocks(params["layers"], x[i], mask, config)
+        for i in range(n_micro)
+    ])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_pipeline_requires_even_layer_split():
+    config = EncoderConfig.tiny(layers=3, heads=4)
+    params = init_params(jax.random.PRNGKey(0), config)
+    mesh = _pipe_mesh(2)
+    run = pipeline_encoder_blocks(mesh, config)
+    stacked = stack_stage_params(params["layers"])
+    x = jnp.zeros((2, 1, 4, config.hidden), jnp.float32)
+    mask = jnp.ones((1, 4), bool)
+    with pytest.raises(Exception):
+        run(stacked, x, mask)
