@@ -46,3 +46,5 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
 ]
+
+from pathway_tpu.parallel import pipeline  # noqa: F401
