@@ -61,7 +61,6 @@ DURATION = _dt.DURATION
 DateTimeNaive = "DateTimeNaive"
 DateTimeUtc = "DateTimeUtc"
 Duration = "Duration"
-PyObjectWrapper = object
 
 from pathway_tpu import debug  # noqa: E402
 from pathway_tpu import demo  # noqa: E402
@@ -103,6 +102,59 @@ from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
 from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
 from pathway_tpu.internals.config import set_license_key  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
+from pathway_tpu.internals.compat import (  # noqa: E402
+    Joinable,
+    PyObjectWrapper,
+    TableLike,
+    Type,
+    assert_table_has_schema,
+    iterate_universe,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+    local_error_log,
+    set_monitoring_config,
+    wrap_py_object,
+)
+from pathway_tpu.internals.groupbys import GroupedTable  # noqa: E402
+from pathway_tpu.internals.joins import JoinResult  # noqa: E402
+from pathway_tpu.internals import udfs as asynchronous  # noqa: E402
+from pathway_tpu.persistence import PersistenceMode  # noqa: E402
+from pathway_tpu.stdlib import viz  # noqa: E402
+from pathway_tpu.stdlib import temporal as window  # noqa: E402
+from pathway_tpu.internals.interactive import LiveTable  # noqa: E402
+
+# result-object aliases (reference exports the classes for typing; the
+# concrete result machinery is shared here)
+OuterJoinResult = JoinResult
+GroupedJoinResult = JoinResult
+IntervalJoinResult = JoinResult
+AsofJoinResult = JoinResult
+WindowJoinResult = JoinResult
+UDFSync = UDF
+UDFAsync = UDF
+
+
+def udf_async(fun=None, *, capacity=None, timeout=None, retry_strategy=None,
+              cache_strategy=None, **kwargs):
+    """Deprecated alias of ``pw.udf`` for async callables; the reference's
+    capacity/timeout/retry_strategy kwargs map onto an async executor
+    (internals/udfs.py async_executor)."""
+    from pathway_tpu.internals.udfs import async_executor
+
+    if capacity is not None or timeout is not None             or retry_strategy is not None:
+        kwargs.setdefault("executor", async_executor(
+            capacity=capacity, timeout=timeout,
+            retry_strategy=retry_strategy))
+    if cache_strategy is not None:
+        kwargs.setdefault("cache_strategy", cache_strategy)
+    return udf(fun, **kwargs) if fun is not None else udf(**kwargs)
+
+
+from pathway_tpu.internals.schema import SchemaProperties  # noqa: E402
+
 
 Date_time_naive = DateTimeNaive
 
@@ -132,6 +184,15 @@ __all__ = [
     "transformer", "ClassArg", "input_attribute", "output_attribute",
     "attribute", "method", "input_method", "pandas_transformer",
     "table_transformer",
+    # reference top-level parity (internals/compat.py + aliases)
+    "PyObjectWrapper", "wrap_py_object", "assert_table_has_schema",
+    "iterate_universe", "join", "join_inner", "join_left", "join_right",
+    "join_outer", "local_error_log", "set_monitoring_config",
+    "GroupedTable", "JoinResult", "TableLike", "Joinable",
+    "OuterJoinResult", "GroupedJoinResult", "IntervalJoinResult",
+    "AsofJoinResult", "WindowJoinResult", "UDFSync", "UDFAsync",
+    "udf_async", "asynchronous", "PersistenceMode", "viz", "window",
+    "Type", "LiveTable", "SchemaProperties",
 ]
 
 

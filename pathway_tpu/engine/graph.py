@@ -24,7 +24,7 @@ from pathway_tpu.internals.keys import Pointer, hash_values
 
 
 class Node:
-    __slots__ = ("id", "op", "inputs", "name", "trace")
+    __slots__ = ("id", "op", "inputs", "name", "trace", "error_log")
 
     def __init__(self, id: int, op: Operator, inputs: list["Node"], name: str = ""):
         self.id = id
@@ -32,6 +32,7 @@ class Node:
         self.inputs = inputs
         self.name = name
         self.trace = None  # user-frame Trace set by the lowering
+        self.error_log = None  # scoped log set by the lowering
 
     def __repr__(self):
         return f"<Node {self.id} {self.name or type(self.op).__name__}>"
@@ -273,7 +274,10 @@ class Scheduler:
                  in_deltas: list[Delta], flush: bool) -> Delta:
         import time as _time
 
+        from pathway_tpu.internals.error import set_active_step_log
+
         t0 = _time.perf_counter()
+        set_active_step_log(node.error_log)
         try:
             delta = op.step(time, in_deltas)
             extra = op.on_time_advance(time)
@@ -292,6 +296,8 @@ class Scheduler:
             add_trace_note(e, node.trace,
                            node.name or type(node.op).__name__)
             raise
+        finally:
+            set_active_step_log(None)
         # per-operator step latency (reference: OperatorStats latency via
         # Probers, src/engine/progress_reporter.rs:114 — feeds dashboard
         # and /metrics). Under sharding, replicas accumulate into one node;

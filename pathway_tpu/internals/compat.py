@@ -1,0 +1,198 @@
+"""API-compatibility surface: names the reference exports at top level
+whose machinery lives elsewhere in this build (reference:
+python/pathway/__init__.py __all__ — aliases, assertion helpers, the
+py-object wrapper, free-function join forms).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+
+
+# -- py-object wrapper (reference: internals/api.py wrap_py_object) ---------
+
+@dataclass(frozen=True)
+class PyObjectWrapper:
+    """Opaque wrapper carrying an arbitrary Python object through the
+    engine (reference: api.PyObjectWrapper — there it crosses the Rust
+    boundary; here values are host-native, so the wrapper is the
+    API-stable envelope + serializer hook)."""
+
+    value: Any
+    _serializer: Any = field(default=None, compare=False, repr=False)
+
+    def dumps(self) -> bytes:
+        if self._serializer is not None:
+            return self._serializer.dumps(self.value)
+        return pickle.dumps(self.value)
+
+
+def wrap_py_object(object: Any, *, serializer=None) -> PyObjectWrapper:
+    return PyObjectWrapper(object, serializer)
+
+
+# -- iterate_universe marker (reference: internals/operator.py:309) ---------
+
+@dataclass(frozen=True)
+class iterate_universe:  # noqa: N801 — reference-parity name
+    """Marks an iterate() input whose UNIVERSE (key set) iterates while
+    its column values come along for the ride."""
+
+    table: Table
+
+
+# -- schema assertion (reference: internals/common.py:474) ------------------
+
+def assert_table_has_schema(table: Table, schema: type[sch.Schema], *,
+                            allow_superset: bool = True,
+                            ignore_primary_keys: bool = True,
+                            allow_subtype: bool = True) -> None:
+    """Assert the table's schema is equivalent to ``schema``."""
+    tcols = dict(table.schema._dtypes())
+    scols = dict(schema._dtypes())
+    if not allow_superset and set(tcols) - set(scols):
+        raise AssertionError(
+            f"table has extra columns {sorted(set(tcols) - set(scols))}")
+    missing = set(scols) - set(tcols)
+    if missing:
+        raise AssertionError(f"table lacks columns {sorted(missing)}")
+    for name, want in scols.items():
+        got = tcols[name]
+        if got == want or want is dt.ANY:
+            continue
+        if allow_subtype and dt.unoptionalize(got) == dt.unoptionalize(want):
+            continue
+        raise AssertionError(
+            f"column {name!r}: table has {got}, schema wants {want}")
+    if not ignore_primary_keys:
+        if list(table.schema.primary_key_columns() or []) != \
+                list(schema.primary_key_columns() or []):
+            raise AssertionError("primary keys differ")
+
+
+# -- error logs (reference: internals/errors.py local_error_log) ------------
+
+@contextlib.contextmanager
+def local_error_log():
+    """Scope-local error log: operators BUILT inside the ``with`` block
+    report their errors here — including errors raised later, at run
+    time, while those operators step (Plan stamps the scope's log; the
+    scheduler activates it around each stamped node's step — the
+    reference's per-scope error-log tables, graph.rs error_log APIs)."""
+    from pathway_tpu.internals import error as err
+
+    local = err.ErrorLog()
+    err.push_construction_log(local)
+    try:
+        yield local
+    finally:
+        err.pop_construction_log()
+
+
+# -- monitoring config (reference: internals/config.py:144) -----------------
+
+_monitoring_endpoint: dict = {"server_endpoint": None}
+
+
+def set_monitoring_config(*, server_endpoint: str | None) -> None:
+    """Point OpenTelemetry exports at an OTLP endpoint
+    (internals/telemetry.py reads this when building its config)."""
+    _monitoring_endpoint["server_endpoint"] = server_endpoint
+
+
+def get_monitoring_endpoint() -> str | None:
+    return _monitoring_endpoint["server_endpoint"]
+
+
+# -- engine type facade (reference: api.PathwayType re-exported as Type) ----
+
+class Type:
+    """Static engine types, reference ``pw.Type`` (engine.pyi PathwayType):
+    ``pw.Type.STRING`` etc., plus the composite constructors."""
+
+    ANY = dt.ANY
+    STRING = dt.STR
+    INT = dt.INT
+    FLOAT = dt.FLOAT
+    BOOL = dt.BOOL
+    POINTER = dt.POINTER
+    BYTES = dt.BYTES
+    DATE_TIME_NAIVE = dt.DATE_TIME_NAIVE
+    DATE_TIME_UTC = dt.DATE_TIME_UTC
+    DURATION = dt.DURATION
+    JSON = dt.JSON
+    ARRAY = dt.ANY_ARRAY
+    INT_ARRAY = dt.INT_ARRAY
+    FLOAT_ARRAY = getattr(dt, "FLOAT_ARRAY", dt.ANY_ARRAY)
+    PY_OBJECT_WRAPPER = dt.ANY
+
+    @staticmethod
+    def optional(arg):
+        return dt.Optional(arg)
+
+    @staticmethod
+    def tuple(*args):
+        return dt.Tuple(args) if hasattr(dt, "Tuple") else dt.ANY
+
+    @staticmethod
+    def list(arg):
+        return getattr(dt, "List", lambda a: dt.ANY)(arg)
+
+    @staticmethod
+    def array(n_dim=None, wrapped=None):
+        return dt.ANY_ARRAY
+
+
+# -- joinable/table-like bases (reference: Joinable ⊃ Table, JoinResult) ----
+
+import abc  # noqa: E402
+
+
+class TableLike(abc.ABC):
+    """Things carrying a universe (reference internals/table_like.py)."""
+
+
+class Joinable(TableLike):
+    """Things a join can take as a side (reference internals/joins.py)."""
+
+
+def _register_bases() -> None:
+    from pathway_tpu.internals.groupbys import GroupedTable
+    from pathway_tpu.internals.joins import JoinResult
+
+    for cls in (Table, JoinResult):
+        Joinable.register(cls)
+    for cls in (Table, JoinResult, GroupedTable):
+        TableLike.register(cls)
+
+
+_register_bases()
+
+
+# -- free-function join forms (reference exports join/join_inner/...) -------
+
+def join(left: Table, right: Table, *on, how: str = "inner", **kwargs):
+    return left.join(right, *on, how=how, **kwargs)
+
+
+def join_inner(left: Table, right: Table, *on, **kwargs):
+    return left.join(right, *on, how="inner", **kwargs)
+
+
+def join_left(left: Table, right: Table, *on, **kwargs):
+    return left.join(right, *on, how="left", **kwargs)
+
+
+def join_right(left: Table, right: Table, *on, **kwargs):
+    return left.join(right, *on, how="right", **kwargs)
+
+
+def join_outer(left: Table, right: Table, *on, **kwargs):
+    return left.join(right, *on, how="outer", **kwargs)

@@ -56,6 +56,33 @@ class ErrorLog:
 
 _global_log = ErrorLog()
 
+# scoped logs: Plans built inside a `with local_error_log()` block carry
+# the scope's log; the scheduler activates it around each node's step so
+# RUNTIME errors from those operators land in the scoped log too
+# (reference: per-scope error-log tables, graph.rs error_log APIs)
+_construction_scope = threading.local()
+_active_step = threading.local()
+
+
+def current_construction_log():
+    return getattr(_construction_scope, "log", None)
+
+
+def push_construction_log(log) -> None:
+    _construction_scope.log = log
+
+
+def pop_construction_log() -> None:
+    _construction_scope.log = None
+
+
+def set_active_step_log(log) -> None:
+    _active_step.log = log
+
 
 def global_error_log() -> ErrorLog:
-    return _global_log
+    """The log errors go to RIGHT NOW: the stepping node's scoped log when
+    one is active, else the run-global log (the reference's
+    global_error_log vs local error-log tables)."""
+    active = getattr(_active_step, "log", None)
+    return active if active is not None else _global_log

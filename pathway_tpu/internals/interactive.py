@@ -68,3 +68,25 @@ def enable_interactive_mode() -> InteractiveModeController:
     controller = InteractiveModeController(_pathway_internal=True)
     G.interactive_mode_controller = controller
     return controller
+
+
+class LiveTable:
+    """Interactive-mode live view of a table (reference:
+    internals/interactive.py LiveTable — a REPL-refreshed snapshot).
+    Construct via ``enable_interactive_mode()`` + ``LiveTable.create``."""
+
+    def __init__(self, table, controller=None):
+        self.table = table
+        self.controller = controller
+
+    @classmethod
+    def create(cls, table, controller=None):
+        return cls(table, controller)
+
+    def snapshot(self):
+        from pathway_tpu.debug import table_to_pandas
+
+        return table_to_pandas(self.table)
+
+    def _repr_html_(self):  # notebook display hook
+        return self.snapshot().to_html()

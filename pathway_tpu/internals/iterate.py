@@ -44,7 +44,12 @@ def iterate(func: Callable, iteration_limit: int | None = None, **kwargs):
     (single Table if `func` returned one, else a namespace by name).
     """
     placeholders = {}
-    for name, t in kwargs.items():
+    for name, t in list(kwargs.items()):
+        # pw.iterate_universe(t) marks a universe-iterated input; the
+        # fixpoint semantics here iterate whole tables, which subsumes it
+        if type(t).__name__ == "iterate_universe" and hasattr(t, "table"):
+            t = t.table
+            kwargs[name] = t
         if not isinstance(t, Table):
             raise TypeError(f"iterate argument {name} must be a Table")
         placeholders[name] = Table(

@@ -136,6 +136,8 @@ class GraphRunner:
         node = handler(table, plan)
         if node.trace is None:
             node.trace = getattr(plan, "trace", None)
+        if getattr(node, "error_log", None) is None:
+            node.error_log = getattr(plan, "error_log", None)
         self._memo[key] = node
         return node
 

@@ -178,8 +178,22 @@ class SchemaMetaclass(type):
         return None
 
 
+class SchemaProperties:
+    """Schema-wide properties (reference: internals/schema.py
+    SchemaProperties — ``append_only`` marks every column append-only)."""
+
+    def __init__(self, append_only: bool | None = None):
+        self.append_only = append_only
+
+
 class Schema(metaclass=SchemaMetaclass):
     """Base class for user schemas."""
+
+    __properties__: "SchemaProperties | None" = None
+
+    @classmethod
+    def properties(cls) -> "SchemaProperties | None":
+        return cls.__properties__
 
 
 def schema_from_columns(columns: dict[str, ColumnSchema], name: str = "Schema"):
@@ -213,6 +227,7 @@ def schema_from_dict(columns: dict, name: str = "Schema") -> type[Schema]:
 
 def schema_builder(columns: dict[str, ColumnDefinition], *,
                    name: str = "Schema", properties=None) -> type[Schema]:
+    schema_append_only = bool(getattr(properties, "append_only", False))
     cols = {}
     for cname, definition in columns.items():
         cols[cname] = ColumnSchema(
@@ -220,9 +235,12 @@ def schema_builder(columns: dict[str, ColumnDefinition], *,
             dtype=definition.dtype or dt.ANY,
             primary_key=definition.primary_key,
             default_value=definition.default_value,
-            append_only=bool(definition.append_only or False),
+            append_only=bool(definition.append_only
+                             or schema_append_only),
         )
-    return schema_from_columns(cols, name=name)
+    out = schema_from_columns(cols, name=name)
+    out.__properties__ = properties
+    return out
 
 
 def schema_from_pandas(df, *, id_from=None, name: str = "Schema",

@@ -25,14 +25,17 @@ _table_ids = itertools.count()
 class Plan:
     """One logical operator producing a keyed table."""
 
-    __slots__ = ("kind", "params", "trace")
+    __slots__ = ("kind", "params", "trace", "error_log")
 
     def __init__(self, kind: str, **params):
         self.kind = kind
         self.params = params
+        from pathway_tpu.internals.error import current_construction_log
         from pathway_tpu.internals.trace import trace_user_frame
 
         self.trace = trace_user_frame()
+        # operators built inside `with pw.local_error_log()` report there
+        self.error_log = current_construction_log()
 
     def __repr__(self):
         return f"<Plan {self.kind}>"
@@ -170,6 +173,13 @@ class Table:
         plan = Plan("map", base=self, exprs=list(exprs.values()),
                     names=list(exprs.keys()))
         return Table(plan, schema, self._universe)
+
+    def live(self):
+        """Interactive-mode live view (reference: table.py Table.live +
+        internals/interactive.py LiveTable)."""
+        from pathway_tpu.internals.interactive import LiveTable
+
+        return LiveTable.create(self)
 
     def with_columns(self, *args, **kwargs) -> "Table":
         new = self._select_args_to_exprs(args, kwargs)
