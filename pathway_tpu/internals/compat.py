@@ -139,7 +139,7 @@ class Type:
 
     @staticmethod
     def tuple(*args):
-        return dt.Tuple(args) if hasattr(dt, "Tuple") else dt.ANY
+        return dt.Tuple(*args)
 
     @staticmethod
     def list(arg):

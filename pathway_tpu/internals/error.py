@@ -65,15 +65,20 @@ _active_step = threading.local()
 
 
 def current_construction_log():
-    return getattr(_construction_scope, "log", None)
+    stack = getattr(_construction_scope, "stack", None)
+    return stack[-1] if stack else None
 
 
 def push_construction_log(log) -> None:
-    _construction_scope.log = log
+    if not hasattr(_construction_scope, "stack"):
+        _construction_scope.stack = []
+    _construction_scope.stack.append(log)
 
 
 def pop_construction_log() -> None:
-    _construction_scope.log = None
+    stack = getattr(_construction_scope, "stack", None)
+    if stack:
+        stack.pop()
 
 
 def set_active_step_log(log) -> None:

@@ -47,7 +47,9 @@ def iterate(func: Callable, iteration_limit: int | None = None, **kwargs):
     for name, t in list(kwargs.items()):
         # pw.iterate_universe(t) marks a universe-iterated input; the
         # fixpoint semantics here iterate whole tables, which subsumes it
-        if type(t).__name__ == "iterate_universe" and hasattr(t, "table"):
+        from pathway_tpu.internals.compat import iterate_universe
+
+        if isinstance(t, iterate_universe):
             t = t.table
             kwargs[name] = t
         if not isinstance(t, Table):

@@ -148,3 +148,14 @@ def udf_reducer(reducer_cls):
         return ex.ReducerExpression("stateful", *args, fn=combine)
 
     return make
+
+
+def int_sum(expr) -> ex.ReducerExpression:
+    """Deprecated alias of ``sum`` (reference reducers.int_sum)."""
+    return ex.ReducerExpression("sum", expr)
+
+
+def npsum(expr) -> ex.ReducerExpression:
+    """Deprecated alias of ``ndarray`` element-wise sum
+    (reference reducers.npsum → array_sum)."""
+    return ex.ReducerExpression("array_sum", expr)

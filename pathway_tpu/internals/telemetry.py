@@ -37,6 +37,12 @@ class Config:
                endpoint: str | None = None,
                service_name: str = "pathway-tpu",
                run_id: str | None = None) -> "Config":
+        if endpoint is None:
+            from pathway_tpu.internals.compat import (
+                get_monitoring_endpoint,
+            )
+
+            endpoint = get_monitoring_endpoint()
         endpoint = endpoint or os.environ.get(
             "PATHWAY_TELEMETRY_ENDPOINT") or None
         if endpoint:

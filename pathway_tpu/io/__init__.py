@@ -32,3 +32,25 @@ __all__ = [
     "plaintext", "postgres", "pubsub", "pyfilesystem", "redpanda", "s3",
     "s3_csv", "slack", "sqlite",
 ]
+
+
+from dataclasses import dataclass as _dataclass
+from typing import Any as _Any
+from typing import Callable as _Callable
+
+
+@_dataclass
+class CsvParserSettings:
+    """CSV parser options (reference: io/_utils.py CsvParserSettings)."""
+
+    delimiter: str = ","
+    quote: str = '"'
+    escape: str | None = None
+    enable_double_quote_escapes: bool = True
+    enable_quoting: bool = True
+    comment_character: str | None = None
+
+
+# callback signatures for pw.io.subscribe (reference: io/_subscribe.py)
+OnChangeCallback = _Callable[..., _Any]
+OnFinishCallback = _Callable[[], _Any]

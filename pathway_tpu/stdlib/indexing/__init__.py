@@ -9,13 +9,15 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (  # noqa: F401
     USearchKnn,
 )
 from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25, TantivyBM25Factory  # noqa: F401
-from pathway_tpu.stdlib.indexing.vector_document_index import (  # noqa: F401
+from pathway_tpu.stdlib.indexing.vector_document_index import (
+    default_full_text_document_index,  # noqa: F401
     default_brute_force_knn_document_index,
     default_lsh_knn_document_index,
     default_usearch_knn_document_index,
     default_vector_document_index,
 )
 from pathway_tpu.stdlib.indexing import retrievers  # noqa: F401
+from pathway_tpu.stdlib.indexing.sorting import SortedIndex  # noqa: F401
 from pathway_tpu.stdlib.indexing.sorting import (  # noqa: F401
     build_sorted_index,
     filter_smallest_k,

@@ -89,3 +89,10 @@ def filter_smallest_k(column: ex.ColumnReference, instance: ex.ColumnReference,
         lambda s, k: tuple(p[1] for p in s[:int(k)]), with_k.sorted, with_k.k))
     flat = keys.flatten(keys.kk)
     return t.having(flat.kk)
+
+
+
+class SortedIndex(dict):
+    """Typed mapping {index, oracle} of the binary-search tree tables
+    (reference: stdlib/indexing/sorting.py:85 — a TypedDict; runtime dict
+    here, keys "index" and "oracle")."""

@@ -63,3 +63,14 @@ def default_lsh_knn_document_index(
     inner = LshKnn(data_column, metadata_column, dimensions=dimensions,
                    embedder=embedder)
     return DataIndex(data_table, inner)
+
+
+def default_full_text_document_index(
+        data_column, data_table, *, embedder=None,
+        metadata_column=None) -> DataIndex:
+    """Full-text (BM25) document index with default parameters
+    (reference: stdlib/indexing/full_text_document_index.py:8)."""
+    from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25
+
+    inner = TantivyBM25(data_column, metadata_column=metadata_column)
+    return DataIndex(data_table, inner)

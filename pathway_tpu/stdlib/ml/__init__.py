@@ -14,3 +14,5 @@ __all__ = [
     "FuzzyJoinFeatureGeneration", "FuzzyJoinNormalization", "fuzzy_match",
     "fuzzy_match_tables", "fuzzy_self_match", "smart_fuzzy_match",
 ]
+
+from pathway_tpu.stdlib.ml import datasets  # noqa: F401

@@ -1,0 +1,6 @@
+"""pw.ml.datasets — dataset fetch helpers
+(reference: stdlib/ml/datasets — sklearn-backed loaders)."""
+
+from pathway_tpu.stdlib.ml.datasets import classification  # noqa: F401
+
+__all__ = ["classification"]

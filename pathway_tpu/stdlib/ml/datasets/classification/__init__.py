@@ -1,0 +1,39 @@
+"""Classification dataset loaders (reference:
+stdlib/ml/datasets/classification — fetches MNIST via sklearn's openml
+mirror). Gated on scikit-learn + network; the split logic is in-repo."""
+
+from __future__ import annotations
+
+
+def load_mnist_sample(sample_size: int = 70000):
+    """(train_table, test_table, train_labels, test_labels) of an MNIST
+    sample (reference signature). Requires scikit-learn and network."""
+    try:
+        from sklearn.datasets import fetch_openml  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "load_mnist_sample needs scikit-learn (fetch_openml); the "
+            "dataset split logic is in-repo — install sklearn to fetch"
+        ) from e
+    import numpy as np
+    import pandas as pd
+
+    from pathway_tpu.debug import table_from_pandas
+
+    X, y = fetch_openml("mnist_784", version=1, return_X_y=True,
+                        as_frame=False)
+    X = X / 255.0
+    train_size = int(sample_size * 6 / 7)
+    test_size = sample_size // 7
+    X_train, y_train = X[:60000][:train_size], y[:60000][:train_size]
+    X_test, y_test = X[60000:70000][:test_size], y[60000:70000][:test_size]
+
+    def to_table(arr):
+        return table_from_pandas(pd.DataFrame(
+            {"data": [np.asarray(row) for row in arr.tolist()]}))
+
+    def labels(arr):
+        return table_from_pandas(pd.DataFrame({"label": list(arr)}))
+
+    return to_table(X_train), to_table(X_test), labels(y_train), \
+        labels(y_test)

@@ -942,3 +942,14 @@ def window_join_right(left, right, t_left, t_right, window, *on, **kw):
 def window_join_outer(left, right, t_left, t_right, window, *on, **kw):
     kw["how"] = "outer"
     return window_join(left, right, t_left, t_right, window, *on, **kw)
+
+
+
+# public result-class names for typing parity (reference exports these;
+# the concrete proxies are the underscore classes above)
+AsofJoinResult = _AsofJoinResult
+AsofNowJoinResult = _AsofNowJoinResult
+IntervalJoinResult = _IntervalJoinResult
+from pathway_tpu.internals.joins import JoinResult as _JoinResult  # noqa: E402
+
+WindowJoinResult = _JoinResult

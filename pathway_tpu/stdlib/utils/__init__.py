@@ -1,3 +1,4 @@
+from pathway_tpu.stdlib.utils import bucketing  # noqa: F401
 from pathway_tpu.stdlib.utils import col  # noqa: F401
 from pathway_tpu.stdlib.utils import filtering  # noqa: F401
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: F401
