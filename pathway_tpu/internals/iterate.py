@@ -43,12 +43,12 @@ def iterate(func: Callable, iteration_limit: int | None = None, **kwargs):
     other inputs are loop-invariant ("extra"). Returns the converged tables
     (single Table if `func` returned one, else a namespace by name).
     """
+    from pathway_tpu.internals.compat import iterate_universe
+
     placeholders = {}
     for name, t in list(kwargs.items()):
         # pw.iterate_universe(t) marks a universe-iterated input; the
         # fixpoint semantics here iterate whole tables, which subsumes it
-        from pathway_tpu.internals.compat import iterate_universe
-
         if isinstance(t, iterate_universe):
             t = t.table
             kwargs[name] = t

@@ -218,6 +218,13 @@ class GraphRunner:
             return self.graph.add_node(eng.MapOperator(keep_base), [filt], "proj")
         return filt
 
+    def _lower_filter_raw(self, table: Table, plan: Plan) -> Node:
+        """Filter with a prebuilt batch predicate fn(keys, rows) -> [bool]
+        (Table.remove_errors)."""
+        base = self.lower(plan.params["base"])
+        return self.graph.add_node(
+            eng.FilterOperator(plan.params["pred_fn"]), [base], "filter_raw")
+
     def _lower_reindex(self, table: Table, plan: Plan) -> Node:
         base = plan.params["base"]
         key_exprs = plan.params["key_exprs"]

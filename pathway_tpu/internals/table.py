@@ -174,6 +174,46 @@ class Table:
                     names=list(exprs.keys()))
         return Table(plan, schema, self._universe)
 
+    def debug(self, name: str) -> "Table":
+        """Print this table's final state during pw.run (reference:
+        table.py Table.debug → DebugOperator)."""
+        from pathway_tpu.internals.parse_graph import G
+
+        def binder(runner):
+            def callback(time, delta):
+                for key, row, diff in delta.entries:
+                    print(f"[debug {name}] t={time} diff={diff} "
+                          f"{dict(zip(self.column_names(), row))}")
+
+            runner.subscribe(self, callback)
+
+        G.add_output(binder)
+        return self
+
+    def eval_type(self, expression):
+        """dtype of an expression evaluated in this table's row context
+        (reference: table.py:2510)."""
+        from pathway_tpu.internals.type_inference import infer_dtype
+
+        return infer_dtype(self._resolve(ex.wrap_arg(expression)))
+
+    def remove_errors(self) -> "Table":
+        """Filter out rows containing ERROR values (reference:
+        table.py:2452)."""
+        from pathway_tpu.internals.error import is_error
+
+        def no_errors(keys, rows):
+            return [not any(is_error(v) for v in r) for r in rows]
+
+        plan = Plan("filter_raw", base=self, pred_fn=no_errors)
+        return Table(plan, self.schema, self._universe.subuniverse())
+
+    def update_id_type(self, id_type) -> "Table":
+        """Re-declare the id column's pointer type (metadata only here:
+        ids are untyped 128-bit pointers engine-side — reference
+        table.py:1993 narrows the schema's id type)."""
+        return self
+
     def live(self):
         """Interactive-mode live view (reference: table.py Table.live +
         internals/interactive.py LiveTable)."""

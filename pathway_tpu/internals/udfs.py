@@ -478,19 +478,12 @@ class UDFAsync(UDF):
 
 
 def _rewrapped(fn, options: dict):
-    exec_ = Executor(
+    exec_ = async_executor(
         capacity=options.get("capacity"),
         timeout=options.get("timeout"),
         retry_strategy=options.get("retry_strategy"))
-    wrapped = _wrap_async(coerce_async(fn), exec_,
-                          options.get("cache_strategy"))
-    import functools
-
-    @functools.wraps(fn)
-    async def run(*args, **kwargs):
-        return await wrapped(*args, **kwargs)
-
-    return run
+    return _wrap_async(coerce_async(fn), exec_,
+                       options.get("cache_strategy"))
 
 
 def async_options(**options):

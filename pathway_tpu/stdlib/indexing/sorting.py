@@ -16,14 +16,14 @@ from pathway_tpu.internals.iterate import iterate
 from pathway_tpu.internals.table import Table
 
 
-def build_sorted_index(nodes: Table) -> dict:
+def build_sorted_index(nodes: Table) -> "SortedIndex":
     """Sorted index over ``nodes`` (columns: key, instance) —
     {index: table with prev/next pointers, oracle: per-instance root (the
     minimum key, standing in for the treap root)}."""
     index = nodes.sort(nodes.key, instance=nodes.instance)
     oracle = nodes.groupby(nodes.instance).reduce(
         instance=nodes.instance, root=reducers.argmin(nodes.key))
-    return dict(index=index, oracle=oracle)
+    return SortedIndex(index=index, oracle=oracle)
 
 
 def sort_from_index(table: Table, key=None, instance=None) -> Table:

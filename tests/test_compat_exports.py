@@ -246,3 +246,21 @@ def test_async_options_and_with_helpers_execute():
     wrapped = with_retry_strategy(plain, FixedDelayRetryStrategy(
         max_retries=1, delay_ms=1))
     assert asyncio.run(wrapped(3)) == 6
+
+
+def test_remove_errors_and_eval_type(capsys):
+    t = T("""
+    a | b
+    3 | 3
+    4 | 0
+    5 | 5
+    """)
+    safe = t.select(t.a, ratio=t.a // t.b).remove_errors()
+    got = sorted(rows_of(safe))
+    assert got == [(3, 1), (5, 1)]  # the 4//0 row dropped
+    assert "int" in str(t.eval_type(t.a + t.b))
+    assert t.update_id_type(pw.Pointer) is t
+    t.debug("probe")
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    out = capsys.readouterr().out
+    assert "[debug probe]" in out
