@@ -303,3 +303,31 @@ class StreamGenerator:
     def table_from_markdown(self, table: str) -> Table:
         """Markdown with a ``_time`` (and optional ``_diff``) column."""
         return table_from_markdown(table)
+
+
+def table_to_dicts(table: Table):
+    """(keys, {column -> {key -> value}}) of the table's final state
+    (reference: debug/__init__.py:61)."""
+    [cap] = run_tables(table)
+    state = cap.snapshot()
+    keys = list(state.keys())
+    names = table.column_names()
+    columns = {
+        name: {key: state[key][i] for key in keys}
+        for i, name in enumerate(names)
+    }
+    return keys, columns
+
+
+def table_from_parquet(path, id_from=None, unsafe_trusted_ids=False) -> Table:
+    """Parquet file → table via pandas (reference: debug/__init__.py:457)."""
+    df = pd.read_parquet(path)
+    return table_from_pandas(df, id_from=id_from,
+                             unsafe_trusted_ids=unsafe_trusted_ids)
+
+
+def table_to_parquet(table: Table, filename):
+    """Table's final state → Parquet via pandas
+    (reference: debug/__init__.py:474)."""
+    df = table_to_pandas(table, include_id=False)
+    return df.to_parquet(filename)

@@ -193,8 +193,6 @@ class Table:
     def eval_type(self, expression):
         """dtype of an expression evaluated in this table's row context
         (reference: table.py:2510)."""
-        from pathway_tpu.internals.type_inference import infer_dtype
-
         return infer_dtype(self._resolve(ex.wrap_arg(expression)))
 
     def remove_errors(self) -> "Table":

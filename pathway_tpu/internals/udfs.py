@@ -446,6 +446,9 @@ def coerce_async(fn: Callable) -> Callable:
     if inspect.iscoroutinefunction(fn):
         return fn
 
+    import functools
+
+    @functools.wraps(fn)  # keep name/doc/annotations for type inference
     async def as_async(*args, **kwargs):
         return fn(*args, **kwargs)
 

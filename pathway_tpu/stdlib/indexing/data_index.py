@@ -41,9 +41,14 @@ class _PreparedQueryCols:
 
 
 class DataIndex:
-    def __init__(self, data_table: Table, inner_index: InnerIndex):
+    def __init__(self, data_table: Table, inner_index: InnerIndex,
+                 embedder=None):
         self.data_table = data_table
         self.inner_index = inner_index
+        # optional query embedder OVERRIDE (reference: DataIndex(...,
+        # embedder=...) — applied to the query column; vector indexes
+        # usually carry their own via inner.query_embedder instead)
+        self.embedder = embedder
         self._data_prepared: Table | None = None
 
     def _prepare_data(self) -> Table:
@@ -89,7 +94,7 @@ class DataIndex:
         data = self.data_table
         inner = self.inner_index
 
-        embedder = inner.query_embedder
+        embedder = self.embedder or inner.query_embedder
         data_prepared = self._prepare_data()
 
         qvec = query_column

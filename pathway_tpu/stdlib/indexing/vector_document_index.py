@@ -72,12 +72,7 @@ def default_full_text_document_index(
     (reference: stdlib/indexing/full_text_document_index.py:8)."""
     from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25
 
-    if embedder is not None:
-        # BM25 scores raw text — silently ignoring the embedder would
-        # diverge from what the caller asked for
-        raise NotImplementedError(
-            "the BM25 full-text index does not embed queries; use a KNN "
-            "document index (default_brute_force_knn_document_index) or "
-            "the hybrid index for embedder-based retrieval")
     inner = TantivyBM25(data_column, metadata_column=metadata_column)
-    return DataIndex(data_table, inner)
+    # the reference forwards embedder to DataIndex, which applies it to
+    # the QUERY column (full_text_document_index.py:27)
+    return DataIndex(data_table, inner, embedder=embedder)

@@ -264,3 +264,19 @@ def test_remove_errors_and_eval_type(capsys):
     pw.run(monitoring_level=pw.MonitoringLevel.NONE)
     out = capsys.readouterr().out
     assert "[debug probe]" in out
+
+
+def test_debug_parquet_and_dicts(tmp_path):
+    t = T("""
+    name | qty
+    bolt | 3
+    nut  | 5
+    """)
+    keys, cols = pw.debug.table_to_dicts(t)
+    assert sorted(cols["qty"].values()) == [3, 5]
+    assert set(cols) == {"name", "qty"}
+    path = tmp_path / "t.parquet"
+    pw.debug.table_to_parquet(t, path)
+    G.clear()
+    back = pw.debug.table_from_parquet(path)
+    assert sorted(rows_of(back)) == [("bolt", 3), ("nut", 5)]
