@@ -232,3 +232,18 @@ def test_kafka_connector_end_to_end_native():
         assert sorted(got) == ["mesh", "slab", "tpu"]
     finally:
         broker.close()
+
+
+def test_varint_zigzag_edges():
+    """Zigzag varints must roundtrip at the edges the record framing
+    depends on (negative lengths = null key/value markers)."""
+    for v in (0, -1, 1, -64, 63, 64, -65, 300, -300, 2**31 - 1,
+              -(2**31), 2**40, -(2**40)):
+        r = kp.Reader(kp.enc_varint(v))
+        assert r.varint() == v, v
+
+
+def test_record_batch_empty_and_single():
+    assert list(kp.parse_record_batches(b"")) == []
+    blob = kp.encode_record_batch([(None, None)])
+    assert list(kp.parse_record_batches(blob)) == [(0, None, None)]

@@ -67,6 +67,16 @@ def _build(rng: random.Random):
         mx=pw.reducers.max(t.y),
     )
     outs.append(g)
+    if rng.random() < 0.4:
+        win = pw.temporal.windowby(
+            t, t.y, window=pw.temporal.tumbling(rng.choice([3, 5, 8])),
+            instance=t.tag,
+        ).reduce(
+            tag=pw.this._pw_instance,
+            start=pw.this._pw_window_start,
+            s=pw.reducers.sum(pw.this.y),
+        )
+        outs.append(win)
     mode = rng.choice(["inner", "left", "outer"])
     joined = {
         "inner": t.join, "left": t.join_left, "outer": t.join_outer,
