@@ -1,0 +1,58 @@
+"""bench.py must stay runnable — the driver executes it on real hardware
+at round end; a silent import/shape regression there would void the
+round's measurements. CPU-sized smoke of each leg's machinery."""
+
+from __future__ import annotations
+
+import sys
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _clear():
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_bench_imports_and_docs():
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    docs = bench.make_docs(64)
+    assert len(docs) == 64 and all(isinstance(d, str) for d in docs)
+
+
+def test_bench_etl_leg_small():
+    import bench
+
+    out = bench.bench_etl(4000)
+    assert out["etl_rows_per_s_1w"] > 0
+    assert out["etl_rows_per_s_8w"] > 0
+    assert out["etl_n_cores"] >= 1
+
+
+def test_bench_tokenizer_and_encoder_shapes():
+    """The embed leg's host-side pieces: WordPiece batch + bucketing pack
+    produce shapes the jitted encoder accepts."""
+    import numpy as np
+
+    import bench
+    from pathway_tpu.models.tokenizer import (WordPieceTokenizer,
+                                              make_synthetic_vocab)
+
+    tok = WordPieceTokenizer(
+        make_synthetic_vocab([f"word{i}" for i in range(512)],
+                             vocab_size=30522), max_len=bench.SEQ)
+    docs = bench.make_docs(8)
+    ids, mask = tok.batch(docs, pad_to=bench.SEQ)
+    assert ids.shape == (8, bench.SEQ) and mask.shape == ids.shape
+    lens = mask.sum(axis=1)
+    assert (lens > 0).all()
+    # pack() logic: int16 ids + bucket width multiple of 16
+    width = min(bench.SEQ, max(16, int(-(-int(lens.max()) // 16) * 16)))
+    assert width % 16 == 0 and ids[:, :width].astype(np.int16).dtype == \
+        np.int16
