@@ -121,6 +121,28 @@ def tumbling(duration, origin=None, offset=None) -> TumblingWindow:
 
 def sliding(hop, duration=None, ratio: int | None = None, origin=None,
             offset=None) -> SlidingWindow:
+    """Sliding window of ``duration`` every ``hop``.
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... at | v
+    ... 1  | 10
+    ... 3  | 20
+    ... 7  | 30
+    ... ''')
+    >>> win = pw.temporal.windowby(
+    ...     t, t.at, window=pw.temporal.sliding(hop=2, duration=4))
+    >>> pw.debug.compute_and_print(
+    ...     win.reduce(start=pw.this._pw_window_start,
+    ...                s=pw.reducers.sum(pw.this.v)),
+    ...     include_id=False)
+    start | s
+    -2 | 10
+    0 | 30
+    2 | 20
+    4 | 30
+    6 | 30
+    """
     if duration is None and ratio is not None:
         duration = hop * ratio
     return SlidingWindow(hop, duration, origin, offset)
