@@ -28,7 +28,8 @@ from pathway_tpu.engine.delta import (
     upsert_delta,
 )
 from pathway_tpu.engine.reducers import make_reducer_state
-from pathway_tpu.internals.keys import Pointer, hash_values, mix_pointers
+from pathway_tpu.internals.keys import (Pointer, canonical_shard_value,
+                                        hash_values, mix_pointers)
 
 
 class Exchange:
@@ -128,8 +129,10 @@ class MapOperator(Operator):
         keys = delta.keys_list()
         rows = [r for _, r, _ in delta.entries]
         new_rows = self.fn(keys, rows)
+        # contract: fn returns one TUPLE per row (compile_program and the
+        # lowering's projections all do) — re-tupling was pure overhead
         return Delta([
-            (k, tuple(nr), d)
+            (k, nr, d)
             for (k, _, d), nr in zip(delta.entries, new_rows)
         ])
 
@@ -392,6 +395,220 @@ class GroupByOperator(Operator):
         return out
 
 
+def _rows_equal(a, b) -> bool:
+    """Value equality of two rows; fingerprint fallback for rows whose
+    cells don't support plain == (ndarrays)."""
+    try:
+        return bool(a == b)
+    except Exception:
+        return row_fingerprint(a) == row_fingerprint(b)
+
+
+class ColumnarGroupByOperator(Operator):
+    """Columnar groupby for dictionary-encodable group keys with
+    semigroup-sum reducers (count / integral sum / integral avg).
+
+    The row path (GroupByOperator) pays per-row Python: a 128-bit hash per
+    row for the group key plus a dict probe and a state-object method call
+    per reducer. Here a tick's delta is processed as arrays: group values
+    are interned to dense int codes (one dict probe per row, no hashing —
+    the group key is hashed ONCE per distinct group ever seen), reducer
+    state lives in numpy int64 arrays indexed by code (``np.add.at``
+    scatter), and only the touched groups pay per-group Python at emit.
+    Exact-retraction semantics are unchanged: all state updates are
+    additive, so arbitrary insert/retract orders give identical state.
+
+    Chosen by the lowering only when every reducer is in the columnar set,
+    no reducer is order-sensitive, and the group values come from plain
+    columns of hashable scalar dtype (internals/runner.py
+    ``_columnar_groupby_spec``); everything else keeps GroupByOperator.
+    Reference analogue: group_by_table (src/engine/dataflow.rs:2904).
+    """
+
+    _GROW = 1024
+    _INT_GUARD = 1 << 62  # |sum| beyond this migrates to exact python ints
+
+    def __init__(self, gval_pos: list, reducer_cols: list):
+        # gval_pos: row positions of the group-value columns
+        # reducer_cols: [("count", None) | ("sum", pos) | ("avg", pos)]
+        self.gval_pos = list(gval_pos)
+        self.reducer_cols = list(reducer_cols)
+        # (slot, code) -> exact python-int total for groups whose sums
+        # left the int64 guard range (row-path _SumState is bigint-exact)
+        self._big: dict = {}
+        self._intern: dict = {}          # typed gval -> dense code
+        self._by_gkey: dict = {}         # hashed gkey -> code (alias dedup)
+        self._gvals: list[tuple] = []    # code -> group values
+        self._gkeys: list[Pointer] = []  # code -> output key (hashed once)
+        self._last: list = []            # code -> last emitted row | None
+        self._cnt = np.zeros(0, np.int64)
+        self._sums = [np.zeros(0, np.int64)
+                      for kind, _ in reducer_cols if kind != "count"]
+        # reducer index -> slot in self._sums (arrays are reallocated on
+        # growth, so emit indexes by slot, never by captured reference)
+        self._sum_slot = {}
+        for i, (kind, _) in enumerate(reducer_cols):
+            if kind != "count":
+                self._sum_slot[i] = len(self._sum_slot)
+
+    def exchange_specs(self):
+        # route by the CANONICAL group value: the scheduler's route cache
+        # memoizes value -> worker (a dict probe instead of a hash per
+        # row), and canonicalization guarantees hash-equal values (1 vs
+        # 1.0 vs np.int64(1) — which _add_group aliases into one group)
+        # land on the same worker. Tuples route through hash_values, whose
+        # encoding collapses the same equivalences element-wise.
+        if len(self.gval_pos) == 1:
+            p = self.gval_pos[0]
+            return [lambda key, row: canonical_shard_value(row[p])]
+        ps = self.gval_pos
+        return [lambda key, row: tuple(row[p] for p in ps)]
+
+    def _add_group(self, tkey, gvals: tuple) -> int:
+        # alias via the hashed key: distinct typed representations of
+        # hash-equal values (1 vs 1.0, np.int64(5) vs 5) must share a
+        # group, exactly as the row path's hash_values keying does
+        gkey = hash_values(*gvals)
+        code = self._by_gkey.get(gkey)
+        if code is not None:
+            self._intern[tkey] = code
+            return code
+        code = len(self._gvals)
+        self._intern[tkey] = code
+        self._by_gkey[gkey] = code
+        self._gvals.append(gvals)
+        self._gkeys.append(gkey)
+        self._last.append(None)
+        if code >= self._cnt.shape[0]:
+            self._cnt = np.concatenate(
+                [self._cnt, np.zeros(self._GROW, np.int64)])
+            self._sums = [np.concatenate([s, np.zeros(self._GROW, np.int64)])
+                          for s in self._sums]
+        return code
+
+    def _codes(self, entries) -> np.ndarray:
+        intern = self._intern
+        get = intern.get
+        add = self._add_group
+        codes = np.empty(len(entries), np.int64)
+        if len(self.gval_pos) == 1:
+            p = self.gval_pos[0]
+            for i, (_k, row, _d) in enumerate(entries):
+                v = row[p]
+                # typed key: bool-vs-int dict equality (True == 1) must not
+                # merge groups the hash path keeps distinct
+                tk = (v.__class__, v)
+                c = get(tk)
+                codes[i] = add(tk, (v,)) if c is None else c
+        else:
+            ps = self.gval_pos
+            for i, (_k, row, _d) in enumerate(entries):
+                gvals = tuple(row[p] for p in ps)
+                tk = (tuple(v.__class__ for v in gvals), gvals)
+                c = get(tk)
+                codes[i] = add(tk, gvals) if c is None else c
+        return codes
+
+    def step(self, time, in_deltas):
+        entries = in_deltas[0].entries
+        if not entries:
+            return Delta()
+        n = len(entries)
+        codes = self._codes(entries)
+        diffs = np.fromiter((e[2] for e in entries), np.int64, n)
+        np.add.at(self._cnt, codes, diffs)
+        touched = np.unique(codes)
+        guard = self._INT_GUARD
+        for i, slot in self._sum_slot.items():
+            pos = self.reducer_cols[i][1]
+            arr = self._sums[slot]
+            vals = [e[1][pos] for e in entries]
+            try:
+                col = np.asarray(vals, np.int64)
+                # bound the whole tick's contribution so the int64 scatter
+                # cannot wrap before the migration check runs
+                fast = bool(np.abs(col).max(initial=0) < guard // (n + 1))
+            except (TypeError, ValueError, OverflowError):
+                fast = False  # None / non-int / giant cells
+            if fast:
+                np.add.at(arr, codes, col * diffs)
+                if self._big:
+                    # groups already migrated to exact python ints track
+                    # their tick contribution here (their arr slot is dead)
+                    big = self._big
+                    for j, c in enumerate(codes.tolist()):
+                        bk = (slot, c)
+                        cur = big.get(bk)
+                        if cur is not None:
+                            big[bk] = cur + int(col[j]) * int(diffs[j])
+                # inputs bounded by the guard and prior totals inside it,
+                # so no wrap happened yet; migrate any group that just
+                # left the guard range to exact python-int accumulation
+                mx = self._sums[slot][touched]
+                if np.abs(mx).max(initial=0) >= guard:
+                    for c in touched[np.abs(mx) >= guard].tolist():
+                        self._big.setdefault((slot, c), int(arr[c]))
+            else:
+                # exact slow path (mirrors _SumState: bigint, None adds
+                # nothing); groups cross into _big when they outgrow int64
+                big = self._big
+                for c, v, d in zip(codes.tolist(), vals, diffs.tolist()):
+                    if v is None:
+                        continue
+                    bk = (slot, c)
+                    cur = big.get(bk)
+                    if cur is not None:
+                        big[bk] = cur + d * int(v)
+                        continue
+                    total = int(arr[c]) + d * int(v)
+                    if -guard < total < guard:
+                        arr[c] = total
+                    else:
+                        big[bk] = total
+        # emit: gather touched-group state as C-batched lists, then one
+        # Python pass over touched groups only
+        tl = touched.tolist()
+        cnts = self._cnt[touched].tolist()
+        plan = [(kind, self._sums[self._sum_slot[i]][touched].tolist()
+                 if kind != "count" else None)
+                for i, (kind, _pos) in enumerate(self.reducer_cols)]
+        big = self._big
+        if big:
+            for i, (kind, _pos) in enumerate(self.reducer_cols):
+                if kind == "count":
+                    continue
+                slot = self._sum_slot[i]
+                col = plan[i][1]
+                for idx, c in enumerate(tl):
+                    exact = big.get((slot, c))
+                    if exact is not None:
+                        col[idx] = exact
+        out = Delta()
+        append = out.entries.append
+        last = self._last
+        gkeys = self._gkeys
+        gvals = self._gvals
+        for idx, code in enumerate(tl):
+            c = cnts[idx]
+            if c <= 0:
+                new = None
+            else:
+                red = [c if kind == "count"
+                       else (col[idx] / c if kind == "avg" else col[idx])
+                       for kind, col in plan]
+                new = (*gvals[code], *red)
+            old = last[code]
+            if old == new:
+                continue
+            gkey = gkeys[code]
+            if old is not None:
+                append((gkey, old, -1))
+            if new is not None:
+                append((gkey, new, 1))
+            last[code] = new
+        return out
+
+
 class JoinOperator(Operator):
     """Inner/left/right/outer join (reference: join_tables, dataflow.rs:2276).
 
@@ -417,6 +634,9 @@ class JoinOperator(Operator):
         # side) can collide across pairs — those joins keep the per-group
         # recompute path whose dict semantics dedupe collisions.
         self._bilinear = out_key_fn is None
+        # live (lk, rk) pairs recur every tick in dimension joins: a dict
+        # probe beats re-mixing 128-bit ints per emitted row
+        self._mix_cache: dict = {}
         self.out_key_fn = out_key_fn or self._default_out_key
         self.left: dict[Any, dict[Pointer, tuple]] = {}
         self.right: dict[Any, dict[Pointer, tuple]] = {}
@@ -428,9 +648,14 @@ class JoinOperator(Operator):
         return [lambda k, r: self.lkey_fn(k, r),
                 lambda k, r: self.rkey_fn(k, r)]
 
-    @staticmethod
-    def _default_out_key(lkey, rkey, jk):
-        return mix_pointers(lkey, rkey)
+    def _default_out_key(self, lkey, rkey, jk):
+        ck = (lkey, rkey)
+        p = self._mix_cache.get(ck)
+        if p is None:
+            p = mix_pointers(lkey, rkey)
+            if len(self._mix_cache) < (1 << 20):
+                self._mix_cache[ck] = p
+        return p
 
     def _group_out(self, jk) -> dict[Pointer, tuple]:
         lg = self.left.get(jk) or {}
@@ -497,9 +722,10 @@ class JoinOperator(Operator):
         """Output delta for one left row vs the CURRENT right state."""
         rg = self.right.get(jk)
         if rg:
+            append = out.entries.append
             okey, ofn = self.out_key_fn, self.out_fn
             for rk, rrow in rg.items():
-                out.append(okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), sign)
+                append((okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), sign))
         elif self.mode in ("left", "outer"):
             out.append(self.out_key_fn(lk, None, jk),
                        self.out_fn(lk, lrow, None, None), sign)
@@ -507,9 +733,10 @@ class JoinOperator(Operator):
     def _emit_right(self, out, jk, rk, rrow, sign) -> None:
         lg = self.left.get(jk)
         if lg:
+            append = out.entries.append
             okey, ofn = self.out_key_fn, self.out_fn
             for lk, lrow in lg.items():
-                out.append(okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), sign)
+                append((okey(lk, rk, jk), ofn(lk, lrow, rk, rrow), sign))
         elif self.mode in ("right", "outer"):
             out.append(self.out_key_fn(None, rk, jk),
                        self.out_fn(None, None, rk, rrow), sign)
@@ -527,6 +754,8 @@ class JoinOperator(Operator):
         nothing. Right state stays fixed during the ΔL pass (R_old) and
         left state is complete during the ΔR pass (L_new) — the bilinear
         split that makes the delta exact."""
+        if self.mode == "inner":
+            return self._step_bilinear_inner(l_entries, r_entries)
         out = Delta()
         left_ear = self.mode in ("left", "outer")
         right_ear = self.mode in ("right", "outer")
@@ -600,7 +829,110 @@ class JoinOperator(Operator):
                         for lk, lrow in lg.items():
                             out.append(okey(lk, None, jk),
                                        ofn(lk, lrow, None, None), sign)
-        return out.consolidate()
+        # NOT consolidated: emissions are exact multiset deltas already
+        # (upserts skip unchanged rows; out keys are unique per pair), and
+        # fingerprinting a dimension join's whole churn every tick was the
+        # single largest cost in bench_etl. Exchange merges and captures
+        # consolidate where it matters.
+        return out
+
+    def _one_side_inner(self, entries, my_index, other_index, flip):
+        """One bilinear pass of the inner-mode fast path. Adjacent
+        retract+insert of the same (jk, row-key) — the exact shape a
+        groupby's churn arrives in — fuse into one upsert: one state scan
+        and one output key per matched pair instead of two."""
+        out_entries: list = []
+        append = out_entries.append
+        eq = _rows_equal
+        okey, ofn = self.out_key_fn, self.out_fn
+        i, n = 0, len(entries)
+        while i < n:
+            jk, k, row, d = entries[i]
+            i += 1
+            if jk is None:
+                continue
+            grp = my_index.get(jk)
+            cur = grp.get(k) if grp else None
+            if d > 0:
+                if cur is not None:
+                    if eq(cur, row):
+                        continue  # duplicate upsert: outputs unchanged
+                    og = other_index.get(jk)
+                    if og:
+                        for ok_, orow in og.items():
+                            if flip:
+                                key = okey(ok_, k, jk)
+                                append((key, ofn(ok_, orow, k, cur), -1))
+                                append((key, ofn(ok_, orow, k, row), 1))
+                            else:
+                                key = okey(k, ok_, jk)
+                                append((key, ofn(k, cur, ok_, orow), -1))
+                                append((key, ofn(k, row, ok_, orow), 1))
+                    grp[k] = row
+                else:
+                    og = other_index.get(jk)
+                    if og:
+                        if flip:
+                            for ok_, orow in og.items():
+                                append((okey(ok_, k, jk),
+                                        ofn(ok_, orow, k, row), 1))
+                        else:
+                            for ok_, orow in og.items():
+                                append((okey(k, ok_, jk),
+                                        ofn(k, row, ok_, orow), 1))
+                    self._apply(my_index, jk, k, row, 1)
+            else:
+                if cur is None:
+                    continue  # retraction of an absent row: no-op
+                nxt = None
+                if i < n:
+                    jk2, k2, row2, d2 = entries[i]
+                    if d2 > 0 and k2 == k and jk2 == jk:
+                        nxt = row2
+                        i += 1
+                if nxt is not None:
+                    if eq(cur, nxt):
+                        continue  # value unchanged: no outputs, no state
+                    og = other_index.get(jk)
+                    if og:
+                        for ok_, orow in og.items():
+                            if flip:
+                                key = okey(ok_, k, jk)
+                                append((key, ofn(ok_, orow, k, cur), -1))
+                                append((key, ofn(ok_, orow, k, nxt), 1))
+                            else:
+                                key = okey(k, ok_, jk)
+                                append((key, ofn(k, cur, ok_, orow), -1))
+                                append((key, ofn(k, nxt, ok_, orow), 1))
+                    grp[k] = nxt
+                else:
+                    og = other_index.get(jk)
+                    if og:
+                        if flip:
+                            for ok_, orow in og.items():
+                                append((okey(ok_, k, jk),
+                                        ofn(ok_, orow, k, cur), -1))
+                        else:
+                            for ok_, orow in og.items():
+                                append((okey(k, ok_, jk),
+                                        ofn(k, cur, ok_, orow), -1))
+                    self._apply(my_index, jk, k, row, -1)
+        return out_entries
+
+    def _step_bilinear_inner(self, l_entries, r_entries) -> Delta:
+        """Inner-mode bilinear delta: same exact-update rule as the generic
+        path (ΔL vs R_old, then ΔR vs L_new) without ear bookkeeping, with
+        upsert-pair fusion (see _one_side_inner)."""
+        out = Delta()
+        if l_entries:
+            out.entries.extend(
+                self._one_side_inner(l_entries, self.left, self.right,
+                                     flip=False))
+        if r_entries:
+            out.entries.extend(
+                self._one_side_inner(r_entries, self.right, self.left,
+                                     flip=True))
+        return out
 
 
 class DeduplicateOperator(Operator):

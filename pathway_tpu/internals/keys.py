@@ -168,6 +168,43 @@ def sequential_key(counter: int, salt: Any = 0) -> Pointer:
     return hash_values(_SEQ_NAMESPACE, salt, counter)
 
 
+_INT_RANGE = 1 << 62
+
+
+def canonical_shard_value(v: Any):
+    """Canonical raw form of a value used as a route/state key.
+
+    ``hash_values`` deliberately encodes equal ints and floats (and their
+    numpy scalar forms) identically, so raw-value keying must collapse the
+    same equivalence classes: integral floats and numpy scalars map to the
+    python int/float, NaN (!= itself, so useless as a dict key) maps to
+    its hash, and anything exotic maps to its hash. Bools stay raw — they
+    equal their int twins as dict keys, which is safe for ROUTE caching
+    (consistent, merely co-locates two groups) but NOT for join-state
+    keying; join key functions hash bools before calling this."""
+    if v is None:
+        return v
+    cls = v.__class__
+    if cls is str or cls is int or cls is Pointer or cls is bool:
+        return v
+    if cls is float:
+        if v != v:
+            return hash_values(v)
+        if v.is_integer() and -_INT_RANGE < v < _INT_RANGE:
+            return int(v)
+        return v
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        f = float(v)
+        if f != f:
+            return hash_values(f)
+        if f.is_integer() and -_INT_RANGE < f < _INT_RANGE:
+            return int(f)
+        return f
+    return hash_values(v)
+
+
 def shard_of(key: Pointer, n_shards: int) -> int:
     return int(key) % n_shards
 

@@ -28,7 +28,8 @@ from pathway_tpu.internals.expression_compiler import (
     compile_map_program,
 )
 from pathway_tpu.internals.groupbys import split_reducers
-from pathway_tpu.internals.keys import Pointer, hash_values
+from pathway_tpu.internals.keys import (Pointer, canonical_shard_value,
+                                        hash_values)
 from pathway_tpu.internals.table import Plan, Table
 
 
@@ -64,7 +65,9 @@ class GraphRunner:
     def __init__(self):
         self.graph = EngineGraph()
         self._memo: dict[int, Node] = {}
-        self._static_feeds: list[tuple[Node, list]] = []  # (node, [(time,key,row,diff)])
+        # (node, {time: [(key, row, diff)]}) — pre-grouped at lowering so
+        # run startup does not rescan whole feeds row by row
+        self._static_feeds: list[tuple[Node, dict]] = []
         self._stream_subjects: list[tuple[Node, Any]] = []  # streaming sources
         self._captures: dict[int, CapturedStream] = {}
         self._monitoring = None
@@ -108,19 +111,13 @@ class GraphRunner:
         self._scheduler = sched
 
     def static_feeds_by_time(self):
-        """Group every static feed by logical time ONCE — rescanning the
-        whole feed per tick is O(ticks x rows) and dominates wide feeds.
+        """Feeds are stored pre-grouped by logical time (see _lower_static).
         Returns ([(node, {time: [(k, r, d)]})], set_of_times); shared by
         run_batch and the streaming runtime's startup feed."""
-        by_time: list[tuple[Any, dict[int, list]]] = []
         times: set[int] = set()
-        for node, feed in self._static_feeds:
-            groups: dict[int, list] = {}
-            for t, k, r, d in feed:
-                times.add(t)
-                groups.setdefault(t, []).append((k, r, d))
-            by_time.append((node, groups))
-        return by_time, times
+        for _node, groups in self._static_feeds:
+            times.update(groups)
+        return self._static_feeds, times
 
     # ------------------------------------------------------------------
     # lowering
@@ -170,12 +167,19 @@ class GraphRunner:
         node = self.graph.add_source(table._name)
         keys = plan.params["keys"]
         rows = plan.params["rows"]
-        times = plan.params.get("times") or [0] * len(keys)
+        times = plan.params.get("times")
         diffs = plan.params.get("diffs") or [1] * len(keys)
-        feed = [
-            (t, k, tuple(r), d) for t, k, r, d in zip(times, keys, rows, diffs)
-        ]
-        self._static_feeds.append((node, feed))
+        groups: dict[int, list] = {}
+        if times is None:
+            groups[0] = [(k, tuple(r), d)
+                         for k, r, d in zip(keys, rows, diffs)]
+        else:
+            for t, k, r, d in zip(times, keys, rows, diffs):
+                g = groups.get(t)
+                if g is None:
+                    g = groups[t] = []
+                g.append((k, tuple(r), d))
+        self._static_feeds.append((node, groups))
         return node
 
     def _lower_input(self, table: Table, plan: Plan) -> Node:
@@ -329,25 +333,40 @@ class GraphRunner:
 
         use_raw_key = bool(by_id)
 
-        if len(gval_fns) == 1 and not use_raw_key:
-            def group_fn(key, row, _f=gval_fns[0]):
-                v = _f(key, row)
-                return hash_values(v), (v,)
+        columnar = None
+        if not force_sort and not use_raw_key:
+            columnar = _columnar_groupby_spec(gvals_exprs, reducers, ctx)
+        if columnar is not None:
+            gnode = self.graph.add_node(
+                eng.ColumnarGroupByOperator(*columnar),
+                [node], f"groupby:{table._name}")
         else:
-            def group_fn(key, row):
-                gvals = tuple(f(key, row) for f in gval_fns)
-                if use_raw_key:
-                    gkey = gvals[0] if isinstance(gvals[0], Pointer) else hash_values(gvals[0])
-                else:
-                    gkey = hash_values(*gvals)
-                return gkey, gvals
+            if len(gval_fns) == 1 and not use_raw_key:
+                def group_fn(key, row, _f=gval_fns[0]):
+                    v = _f(key, row)
+                    return hash_values(v), (v,)
+            else:
+                def group_fn(key, row):
+                    gvals = tuple(f(key, row) for f in gval_fns)
+                    if use_raw_key:
+                        gkey = gvals[0] if isinstance(gvals[0], Pointer) else hash_values(gvals[0])
+                    else:
+                        gkey = hash_values(*gvals)
+                    return gkey, gvals
 
-        gnode = self.graph.add_node(
-            eng.GroupByOperator(group_fn, reducer_specs,
-                                force_order_sensitive=force_sort),
-            [node], f"groupby:{table._name}")
+            gnode = self.graph.add_node(
+                eng.GroupByOperator(group_fn, reducer_specs,
+                                    force_order_sensitive=force_sort),
+                [node], f"groupby:{table._name}")
 
-        # post-map over (gvals, reduced) rows
+        # post-map over (gvals, reduced) rows; elided when it is the
+        # identity projection (reduce() listing group cols then reducers in
+        # storage order — the common case)
+        if (len(rewritten) == len(proxy._names) and all(
+                type(e) is ex.ColumnReference and e.table is proxy
+                and e.name == proxy._names[i]
+                for i, e in enumerate(rewritten))):
+            return gnode
         post_ctx = CompileContext()
         post_ctx.add_table(proxy, 0)
         post_program, nondet = compile_map_program(rewritten, post_ctx)
@@ -378,12 +397,32 @@ class GraphRunner:
         # SQL null semantics: a None join value matches nothing, but in
         # left/right/outer mode the row must still appear as an unmatched
         # "ear" — so map it to a per-row sentinel key that can't collide.
+        # Hashable scalars are used RAW as the join-group key (dict keys in
+        # the join state; the scheduler's route cache memoizes value →
+        # worker) — hashing per row bought nothing. Bools still hash:
+        # True == 1 as a dict key, but hash_values keeps them distinct,
+        # and both sides must agree on the keying.
+        def _jkey(v, side, key):
+            if v is None:
+                return ("__pw_null__", side, key)
+            cls = v.__class__
+            if cls is str or cls is Pointer:
+                return v
+            if cls is int:  # not bool: its class is bool
+                return v
+            if cls is bool:
+                # True == 1 as a dict key but hash_values keeps bools
+                # distinct from ints — a raw bool would falsely match an
+                # int join key from the other side
+                return hash_values(v)
+            # floats / np scalars canonicalize so equal ints and floats
+            # (1 vs 1.0, np.int64(1) vs 1) join exactly as the hash
+            # encoding says they do; NaN and exotica fall back to hashing
+            return canonical_shard_value(v)
+
         if len(l_fns) == 1:
             def lkey_fn(key, row, _f=l_fns[0]):
-                v = _f(key, row)
-                if v is None:
-                    return ("__pw_null__", "l", key)
-                return hash_values(v)
+                return _jkey(_f(key, row), "l", key)
         else:
             def lkey_fn(key, row):
                 vals = tuple(f(key, row) for f in l_fns)
@@ -393,10 +432,7 @@ class GraphRunner:
 
         if len(r_fns) == 1:
             def rkey_fn(key, row, _f=r_fns[0]):
-                v = _f(key, row)
-                if v is None:
-                    return ("__pw_null__", "r", key)
-                return hash_values(v)
+                return _jkey(_f(key, row), "r", key)
         else:
             def rkey_fn(key, row):
                 vals = tuple(f(key, row) for f in r_fns)
@@ -407,11 +443,6 @@ class GraphRunner:
         nl = len(left._column_names())
         nr = len(right._column_names())
 
-        def out_fn(lk, lrow, rk, rrow):
-            lr = lrow if lrow is not None else (None,) * nl
-            rr = rrow if rrow is not None else (None,) * nr
-            return (*lr, *rr, lk, rk)
-
         out_key_fn = None
         if id_expr is not None and isinstance(id_expr, ex.IdExpression):
             if id_expr.table is left:
@@ -419,14 +450,31 @@ class GraphRunner:
             elif id_expr.table is right:
                 out_key_fn = lambda lk, rk, jk: rk
 
-        jnode = self.graph.add_node(
-            eng.JoinOperator(mode, lkey_fn, rkey_fn, out_fn, out_key_fn),
-            [lnode, rnode], f"join:{mode}")
-
         ctx = CompileContext()
         off = ctx.add_table(left, 0)
         off = ctx.add_table(right, off)
         ctx.id_pos = {id(left): nl + nr, id(right): nl + nr + 1}
+
+        # When every selected expression is a plain column/id reference the
+        # join emits the projected row DIRECTLY (code-generated picker) and
+        # the whole select map node disappears — one tuple per output row
+        # instead of three (wide row, column batch, zipped row).
+        direct = _direct_join_projection(exprs, ctx, nl, nr, mode)
+        if direct is not None:
+            jnode = self.graph.add_node(
+                eng.JoinOperator(mode, lkey_fn, rkey_fn, direct, out_key_fn),
+                [lnode, rnode], f"join_select:{table._name}")
+            return jnode
+
+        def out_fn(lk, lrow, rk, rrow):
+            lr = lrow if lrow is not None else (None,) * nl
+            rr = rrow if rrow is not None else (None,) * nr
+            return (*lr, *rr, lk, rk)
+
+        jnode = self.graph.add_node(
+            eng.JoinOperator(mode, lkey_fn, rkey_fn, out_fn, out_key_fn),
+            [lnode, rnode], f"join:{mode}")
+
         program, nondet = compile_map_program(exprs, ctx)
         op = eng.DeterministicMapOperator(program) if nondet else eng.MapOperator(program)
         return self.graph.add_node(op, [jnode], f"join_select:{table._name}")
@@ -740,6 +788,90 @@ class GraphRunner:
 
 def _engine_reducer_name(r: ex.ReducerExpression) -> str:
     return r._name
+
+
+def _direct_join_projection(exprs, ctx, nl: int, nr: int, mode: str):
+    """Code-generated ``out_fn(lk, lrow, rk, rrow) -> projected row`` when
+    every select expression is a plain column/id reference; None otherwise.
+    Replaces out_fn + select-map with a single tuple build per output row."""
+    items = []
+    for e in exprs:
+        if isinstance(e, ex.IdExpression):
+            pos = ctx.id_pos.get(id(e.table))
+            if pos == nl + nr:
+                items.append("lk")
+            elif pos == nl + nr + 1:
+                items.append("rk")
+            else:
+                return None
+        elif type(e) is ex.ColumnReference:
+            try:
+                p = ctx.position(e)
+            except KeyError:
+                return None
+            items.append(f"lrow[{p}]" if p < nl else f"rrow[{p - nl}]")
+        else:
+            return None
+    body = f"({', '.join(items)},)" if items else "()"
+    if mode == "inner":  # both rows always present
+        return eval(f"lambda lk, lrow, rk, rrow: {body}")  # noqa: S307
+    return eval(  # noqa: S307 — outer modes: absent side reads as None
+        f"lambda lk, lrow, rk, rrow, _ln=(None,) * {nl}, _rn=(None,) * {nr}: "
+        f"(lambda lrow, rrow: {body})("
+        "lrow if lrow is not None else _ln, "
+        "rrow if rrow is not None else _rn)")
+
+
+_COLUMNAR_GVAL_DTYPES = None  # populated lazily (dtype import cycle)
+
+
+def _columnar_groupby_spec(gvals_exprs, reducers, ctx):
+    """Positions for ColumnarGroupByOperator, or None if ineligible.
+
+    Eligible: every group value is a plain column of hashable scalar dtype
+    and every reducer is count / integral sum / integral avg. The hash
+    semantics are preserved exactly — the operator aliases typed intern
+    keys through ``hash_values`` on first sight of each distinct value."""
+    global _COLUMNAR_GVAL_DTYPES
+    from pathway_tpu.internals import dtype as _dt
+    from pathway_tpu.internals.type_inference import infer_dtype
+
+    if _COLUMNAR_GVAL_DTYPES is None:
+        _COLUMNAR_GVAL_DTYPES = (
+            _dt.INT, _dt.BOOL, _dt.STR, _dt.FLOAT, _dt.POINTER,
+        )
+    gval_pos = []
+    for e in gvals_exprs:
+        if isinstance(e, ex.IdExpression) or type(e) is not ex.ColumnReference:
+            return None
+        try:
+            d = _dt.unoptionalize(infer_dtype(e))
+        except Exception:
+            return None
+        if d not in _COLUMNAR_GVAL_DTYPES:
+            return None
+        gval_pos.append(ctx.position(e))
+    reducer_cols = []
+    for r in reducers:
+        name = _engine_reducer_name(r)
+        if name == "count" and not r._args:
+            reducer_cols.append(("count", None))
+            continue
+        if name in ("sum", "int_sum", "avg") and len(r._args) == 1:
+            a = r._args[0]
+            if type(a) is not ex.ColumnReference:
+                return None
+            try:
+                d = _dt.unoptionalize(infer_dtype(a))
+            except Exception:
+                return None
+            if d not in (_dt.INT, _dt.BOOL):
+                return None
+            reducer_cols.append(
+                ("avg" if name == "avg" else "sum", ctx.position(a)))
+            continue
+        return None
+    return gval_pos, reducer_cols
 
 
 # ---------------------------------------------------------------------------

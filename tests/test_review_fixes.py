@@ -452,3 +452,67 @@ def test_gradual_broadcast_none_apx_still_retracts():
     out1 = op.step(1, [Delta([(k, ("x",), -1)]), Delta()])
     assert [(key, row, d) for key, row, d in out1.entries] == [
         (k, ("x", None), -1)]
+
+
+# ---------------------------------------------------------------------------
+# round-4 findings: columnar ETL fast paths must keep hash-equivalence
+# semantics (equal ints/floats join and group together, at any worker count)
+# ---------------------------------------------------------------------------
+
+def test_join_int_column_to_float_column_matches():
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, x=str), [(1, "l1"), (3, "l3")])
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(b=float, y=str), [(1.0, "r1"), (2.5, "r2")])
+    j = left.join(right, left.a == right.b).select(left.x, right.y)
+    assert _rows(j) == _expect([("l1", "r1")])
+
+
+def test_groupby_mixed_int_float_values_one_group_any_worker_count():
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+
+    def run(n_workers):
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=float, v=int),
+            [(1, 10), (1.0, 20), (2.5, 5)])
+        g = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+        runner = GraphRunner()
+        cap = runner.capture(g)
+        runner.run_batch(n_workers=n_workers)
+        out = sorted((float(r[0]), r[1]) for r in cap.snapshot().values())
+        G.clear()
+        return out
+
+    assert run(1) == [(1.0, 30), (2.5, 5)]
+    assert run(8) == run(1)
+
+
+def test_columnar_sum_exact_beyond_int64():
+    big = 2**63 - 1
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int),
+        [("a", big), ("a", 5), ("b", 1)])
+    g = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    assert _rows(g) == _expect([("a", big + 5), ("b", 1)])
+
+
+def test_columnar_sum_exact_beyond_int64_streaming_retraction():
+    big = 2**62  # crosses the guard via accumulation, then retracts back
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, v=int),
+        [("a", big - 1, 0, 1), ("a", big - 1, 2, 1), ("a", 7, 4, 1),
+         ("a", big - 1, 6, -1)],
+        is_stream=True)
+    g = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    assert _rows(g) == _expect([("a", big - 1 + 7)])
+
+
+def test_bool_join_key_does_not_match_int():
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(a=bool, x=str), [(True, "lt")])
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(b=int, y=str), [(1, "r1")])
+    j = left.join(right, left.a == right.b).select(left.x, right.y)
+    assert _rows(j) == []

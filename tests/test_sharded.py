@@ -16,7 +16,8 @@ import pytest
 
 import pathway_tpu as pw
 from pathway_tpu.engine.delta import row_fingerprint
-from pathway_tpu.engine.operators import GroupByOperator, JoinOperator
+from pathway_tpu.engine.operators import (ColumnarGroupByOperator,
+                                          JoinOperator)
 from pathway_tpu.internals.runner import GraphRunner
 from tests.utils import T
 
@@ -99,11 +100,16 @@ def test_work_is_actually_partitioned():
                 return sched._replicas[node.id]
         raise AssertionError(f"no {op_type.__name__} node")
 
-    greps = replicas_of(GroupByOperator)
+    greps = replicas_of(ColumnarGroupByOperator)
     assert len(greps) == N_WORKERS
-    occupied = [rep for rep in greps if rep.group_states]
+
+    def live_groups(rep):
+        return [gk for gk, code in rep._by_gkey.items()
+                if rep._cnt[code] > 0]
+
+    occupied = [rep for rep in greps if live_groups(rep)]
     assert len(occupied) >= 2, "groupby state not partitioned"
-    all_groups = [g for rep in greps for g in rep.group_states]
+    all_groups = [g for rep in greps for g in live_groups(rep)]
     assert len(all_groups) == len(set(all_groups)) == 16, "shards overlap"
 
     jreps = replicas_of(JoinOperator)
