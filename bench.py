@@ -341,17 +341,20 @@ def bench_etl(n_rows: int = 100_000) -> dict:
     (the reference's headline WordCount benchmark shape, README.md:244-250),
     at n_workers ∈ {1, 8}.
 
-    Measured finding (updated): per-row compiled key paths (compile_row),
-    the bilinear join delta, hash memoization and exchange route caching
-    took 1w from ~15k to ~38k rows/s and shrank the 8-worker routing
-    overhead to ~20%. True multi-process execution (engine/multiproc.py,
-    TCP exchange, PATHWAY_PROCESSES xT) is implemented and
-    correctness-tested (tests/test_sharded.py, tests/test_cli.py), but
-    wall-clock scaling is unobservable in this environment: the container
-    exposes ONE CPU core (etl_n_cores below), so P processes timeshare it
-    and pickle exchange adds ~20-25% on trivial rows. On multi-core hosts
-    the UDF-heavy path parallelizes (stateless maps ship zero bytes
-    cross-process; only group/join exchanges pay pickling).
+    Measured finding (updated r4): the columnar stateful path took 1w from
+    ~38k to ~190k rows/s on this box — dictionary-encoded group keys +
+    int64 array reducer state (ColumnarGroupByOperator), raw-value join
+    keys, and native (C, Python-C-API) passes for the join bilinear update
+    and the groupby gather/emit loops (native/fastjoin.cpp,
+    native/fastgroup.cpp). True multi-process execution
+    (engine/multiproc.py, TCP exchange, PATHWAY_PROCESSES xT) is
+    implemented and correctness-tested (tests/test_sharded.py,
+    tests/test_cli.py), but wall-clock scaling is unobservable in this
+    environment: the container exposes ONE CPU core (etl_n_cores below),
+    so P processes timeshare it and pickle exchange adds ~20-25% on
+    trivial rows. On multi-core hosts the UDF-heavy path parallelizes
+    (stateless maps ship zero bytes cross-process; only group/join
+    exchanges pay pickling).
     """
     import pathway_tpu as pw
     from pathway_tpu.debug import table_from_rows

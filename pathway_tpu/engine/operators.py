@@ -395,6 +395,47 @@ class GroupByOperator(Operator):
         return out
 
 
+_FASTJOIN = False  # False = not probed, None = unavailable, module = loaded
+_FASTGROUP = False
+
+
+def _get_fastjoin():
+    """Native inner-join pass (native/fastjoin.cpp), built on first use;
+    None when the toolchain is unavailable (pure-Python fallback)."""
+    global _FASTJOIN
+    if _FASTJOIN is False:
+        try:
+            from pathway_tpu.native.build import load_extension
+
+            _FASTJOIN = load_extension("fastjoin")
+        except Exception as e:
+            import logging
+
+            logging.getLogger("pathway_tpu").warning(
+                "native join fast path unavailable (%s); using the "
+                "pure-Python engine loops", e)
+            _FASTJOIN = None
+    return _FASTJOIN
+
+
+def _get_fastgroup():
+    """Native groupby gather/emit passes (native/fastgroup.cpp)."""
+    global _FASTGROUP
+    if _FASTGROUP is False:
+        try:
+            from pathway_tpu.native.build import load_extension
+
+            _FASTGROUP = load_extension("fastgroup")
+        except Exception as e:
+            import logging
+
+            logging.getLogger("pathway_tpu").warning(
+                "native groupby fast path unavailable (%s); using the "
+                "pure-Python engine loops", e)
+            _FASTGROUP = None
+    return _FASTGROUP
+
+
 def _rows_equal(a, b) -> bool:
     """Value equality of two rows; fingerprint fallback for rows whose
     cells don't support plain == (ndarrays)."""
@@ -450,6 +491,14 @@ class ColumnarGroupByOperator(Operator):
         for i, (kind, _) in enumerate(reducer_cols):
             if kind != "count":
                 self._sum_slot[i] = len(self._sum_slot)
+        # native-pass parameter tables (see native/fastgroup.cpp)
+        self._gp = tuple(self.gval_pos)
+        self._val_pos = tuple(
+            reducer_cols[i][1]
+            for i in sorted(self._sum_slot, key=self._sum_slot.get))
+        self._kinds = tuple(
+            0 if kind == "count" else (2 if kind == "avg" else 1)
+            for kind, _ in reducer_cols)
 
     def exchange_specs(self):
         # route by the CANONICAL group value: the scheduler's route cache
@@ -514,15 +563,25 @@ class ColumnarGroupByOperator(Operator):
         if not entries:
             return Delta()
         n = len(entries)
-        codes = self._codes(entries)
-        diffs = np.fromiter((e[2] for e in entries), np.int64, n)
+        fg = _get_fastgroup()
+        cols = None
+        if fg is not None:
+            codes_l, diffs_l, cols = fg.gather(
+                entries, self._intern, self._add_group, self._gp,
+                self._val_pos)
+            codes = np.asarray(codes_l, np.int64)
+            diffs = np.asarray(diffs_l, np.int64)
+        else:
+            codes = self._codes(entries)
+            diffs = np.fromiter((e[2] for e in entries), np.int64, n)
         np.add.at(self._cnt, codes, diffs)
         touched = np.unique(codes)
         guard = self._INT_GUARD
         for i, slot in self._sum_slot.items():
             pos = self.reducer_cols[i][1]
             arr = self._sums[slot]
-            vals = [e[1][pos] for e in entries]
+            vals = cols[slot] if cols is not None else \
+                [e[1][pos] for e in entries]
             try:
                 col = np.asarray(vals, np.int64)
                 # bound the whole tick's contribution so the int64 scatter
@@ -566,23 +625,28 @@ class ColumnarGroupByOperator(Operator):
                     else:
                         big[bk] = total
         # emit: gather touched-group state as C-batched lists, then one
-        # Python pass over touched groups only
+        # pass over touched groups only (native when available)
         tl = touched.tolist()
         cnts = self._cnt[touched].tolist()
-        plan = [(kind, self._sums[self._sum_slot[i]][touched].tolist()
-                 if kind != "count" else None)
-                for i, (kind, _pos) in enumerate(self.reducer_cols)]
+        pcols = [self._sums[self._sum_slot[i]][touched].tolist()
+                 if kind != "count" else []
+                 for i, (kind, _pos) in enumerate(self.reducer_cols)]
         big = self._big
         if big:
             for i, (kind, _pos) in enumerate(self.reducer_cols):
                 if kind == "count":
                     continue
                 slot = self._sum_slot[i]
-                col = plan[i][1]
+                col = pcols[i]
                 for idx, c in enumerate(tl):
                     exact = big.get((slot, c))
                     if exact is not None:
                         col[idx] = exact
+        if fg is not None:
+            out = Delta()
+            out.entries = fg.emit(tl, cnts, self._kinds, pcols,
+                                  self._gvals, self._gkeys, self._last)
+            return out
         out = Delta()
         append = out.entries.append
         last = self._last
@@ -594,8 +658,9 @@ class ColumnarGroupByOperator(Operator):
                 new = None
             else:
                 red = [c if kind == "count"
-                       else (col[idx] / c if kind == "avg" else col[idx])
-                       for kind, col in plan]
+                       else (pcols[i][idx] / c if kind == "avg"
+                             else pcols[i][idx])
+                       for i, (kind, _p) in enumerate(self.reducer_cols)]
                 new = (*gvals[code], *red)
             old = last[code]
             if old == new:
@@ -623,12 +688,24 @@ class JoinOperator(Operator):
 
     def __init__(self, mode: str, lkey_fn, rkey_fn,
                  out_fn: Callable[[Pointer | None, tuple | None, Pointer | None, tuple | None], tuple],
-                 out_key_fn=None, left_id_only: bool = False):
+                 out_key_fn=None, left_id_only: bool = False,
+                 out_spec: tuple | None = None,
+                 lkey_pos: int | None = None, lkey_fb=None,
+                 rkey_pos: int | None = None, rkey_fb=None):
         assert mode in ("inner", "left", "right", "outer")
         self.mode = mode
         self.lkey_fn = lkey_fn
         self.rkey_fn = rkey_fn
         self.out_fn = out_fn
+        # C-friendly projection spec ((side, pos), ...) mirroring out_fn;
+        # side 0 = left row, 1 = right row, 2 = key (pos 0 lk / 1 rk)
+        self.out_spec = out_spec
+        # plain-column join keys: the native pass extracts row[pos] inline
+        # (fb(v, key) reproduces the lowering's _jkey for non-str/int cells)
+        self.lkey_pos = lkey_pos
+        self.lkey_fb = lkey_fb
+        self.rkey_pos = rkey_pos
+        self.rkey_fb = rkey_fb
         # default out key = mix(left id, right id): unique per pair, so the
         # bilinear delta path applies. A custom out_key_fn (join id from one
         # side) can collide across pairs — those joins keep the per-group
@@ -687,6 +764,10 @@ class JoinOperator(Operator):
         dl, dr = in_deltas
         if not dl and not dr:
             return Delta()
+        if self._bilinear and self.mode == "inner":
+            fj = _get_fastjoin()
+            if fj is not None:
+                return self._step_inner_native(fj, dl, dr)
         l_entries = [(self.lkey_fn(k, r), k, r, d) for k, r, d in dl.entries]
         r_entries = [(self.rkey_fn(k, r), k, r, d) for k, r, d in dr.entries]
         if self._bilinear:
@@ -919,10 +1000,46 @@ class JoinOperator(Operator):
                     self._apply(my_index, jk, k, row, -1)
         return out_entries
 
+    def _step_inner_native(self, fj, dl: Delta, dr: Delta) -> Delta:
+        """Inner bilinear delta via the native pass (native/fastjoin.cpp).
+        Raw delta entries go straight in when the join key is a plain
+        column (lkey_pos); otherwise the pre-keyed 4-tuple list is built
+        here and the C side skips extraction."""
+        spec = self.out_spec
+        ofn = self.out_fn if spec is None else None
+        out = Delta()
+        ext = out.entries.extend
+        if dl.entries:
+            if self.lkey_pos is not None:
+                ext(fj.one_side_inner(
+                    dl.entries, self.left, self.right, self._mix_cache,
+                    mix_pointers, Pointer, ofn, spec, False,
+                    self.lkey_pos, self.lkey_fb))
+            else:
+                les = [(self.lkey_fn(k, r), k, r, d)
+                       for k, r, d in dl.entries]
+                ext(fj.one_side_inner(
+                    les, self.left, self.right, self._mix_cache,
+                    mix_pointers, Pointer, ofn, spec, False, -1, None))
+        if dr.entries:
+            if self.rkey_pos is not None:
+                ext(fj.one_side_inner(
+                    dr.entries, self.right, self.left, self._mix_cache,
+                    mix_pointers, Pointer, ofn, spec, True,
+                    self.rkey_pos, self.rkey_fb))
+            else:
+                res = [(self.rkey_fn(k, r), k, r, d)
+                       for k, r, d in dr.entries]
+                ext(fj.one_side_inner(
+                    res, self.right, self.left, self._mix_cache,
+                    mix_pointers, Pointer, ofn, spec, True, -1, None))
+        return out
+
     def _step_bilinear_inner(self, l_entries, r_entries) -> Delta:
         """Inner-mode bilinear delta: same exact-update rule as the generic
         path (ΔL vs R_old, then ΔR vs L_new) without ear bookkeeping, with
-        upsert-pair fusion (see _one_side_inner)."""
+        upsert-pair fusion (see _one_side_inner). Pure-Python fallback for
+        environments without the native pass."""
         out = Delta()
         if l_entries:
             out.entries.extend(

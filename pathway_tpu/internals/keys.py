@@ -202,6 +202,15 @@ def canonical_shard_value(v: Any):
         if f.is_integer() and -_INT_RANGE < f < _INT_RANGE:
             return int(f)
         return f
+    # subclasses of the raw-pass classes (np.str_, IntEnum, Pointer
+    # subtypes) canonicalize to the base so they key identically to their
+    # plain twins — hash_values encodes them identically too
+    if isinstance(v, Pointer):
+        return v
+    if isinstance(v, str):
+        return str(v)
+    if isinstance(v, int):  # bool was exact-checked above; can't subclass
+        return int(v)
     return hash_values(v)
 
 

@@ -440,6 +440,28 @@ class GraphRunner:
                     return ("__pw_null__", "r", key)
                 return hash_values(*vals)
 
+        # plain-column join keys: give the native pass the position so it
+        # extracts + canonicalizes inline (fallback reproduces _jkey)
+        lkey_pos = rkey_pos = None
+        if len(on) == 1 and type(on[0][0]) is ex.ColumnReference:
+            try:
+                lkey_pos = lctx.position(on[0][0])
+            except KeyError:
+                lkey_pos = None
+        if len(on) == 1 and type(on[0][1]) is ex.ColumnReference:
+            try:
+                rkey_pos = rctx.position(on[0][1])
+            except KeyError:
+                rkey_pos = None
+        key_kw = dict(
+            lkey_pos=lkey_pos,
+            lkey_fb=(lambda v, key: _jkey(v, "l", key))
+            if lkey_pos is not None else None,
+            rkey_pos=rkey_pos,
+            rkey_fb=(lambda v, key: _jkey(v, "r", key))
+            if rkey_pos is not None else None,
+        )
+
         nl = len(left._column_names())
         nr = len(right._column_names())
 
@@ -461,8 +483,10 @@ class GraphRunner:
         # instead of three (wide row, column batch, zipped row).
         direct = _direct_join_projection(exprs, ctx, nl, nr, mode)
         if direct is not None:
+            direct_fn, cspec = direct
             jnode = self.graph.add_node(
-                eng.JoinOperator(mode, lkey_fn, rkey_fn, direct, out_key_fn),
+                eng.JoinOperator(mode, lkey_fn, rkey_fn, direct_fn,
+                                 out_key_fn, out_spec=cspec, **key_kw),
                 [lnode, rnode], f"join_select:{table._name}")
             return jnode
 
@@ -472,7 +496,8 @@ class GraphRunner:
             return (*lr, *rr, lk, rk)
 
         jnode = self.graph.add_node(
-            eng.JoinOperator(mode, lkey_fn, rkey_fn, out_fn, out_key_fn),
+            eng.JoinOperator(mode, lkey_fn, rkey_fn, out_fn, out_key_fn,
+                             **key_kw),
             [lnode, rnode], f"join:{mode}")
 
         program, nondet = compile_map_program(exprs, ctx)
@@ -791,17 +816,23 @@ def _engine_reducer_name(r: ex.ReducerExpression) -> str:
 
 
 def _direct_join_projection(exprs, ctx, nl: int, nr: int, mode: str):
-    """Code-generated ``out_fn(lk, lrow, rk, rrow) -> projected row`` when
-    every select expression is a plain column/id reference; None otherwise.
-    Replaces out_fn + select-map with a single tuple build per output row."""
+    """``(out_fn, c_spec)`` when every select expression is a plain
+    column/id reference; None otherwise. out_fn is a code-generated
+    ``(lk, lrow, rk, rrow) -> projected row``; c_spec is the equivalent
+    ((side, pos), ...) table for the native join pass (side 0 = left row,
+    1 = right row, 2 = key with pos 0 lk / 1 rk). Replaces out_fn +
+    select-map with a single tuple build per output row."""
     items = []
+    cspec = []
     for e in exprs:
         if isinstance(e, ex.IdExpression):
             pos = ctx.id_pos.get(id(e.table))
             if pos == nl + nr:
                 items.append("lk")
+                cspec.append((2, 0))
             elif pos == nl + nr + 1:
                 items.append("rk")
+                cspec.append((2, 1))
             else:
                 return None
         elif type(e) is ex.ColumnReference:
@@ -809,17 +840,24 @@ def _direct_join_projection(exprs, ctx, nl: int, nr: int, mode: str):
                 p = ctx.position(e)
             except KeyError:
                 return None
-            items.append(f"lrow[{p}]" if p < nl else f"rrow[{p - nl}]")
+            if p < nl:
+                items.append(f"lrow[{p}]")
+                cspec.append((0, p))
+            else:
+                items.append(f"rrow[{p - nl}]")
+                cspec.append((1, p - nl))
         else:
             return None
     body = f"({', '.join(items)},)" if items else "()"
     if mode == "inner":  # both rows always present
-        return eval(f"lambda lk, lrow, rk, rrow: {body}")  # noqa: S307
-    return eval(  # noqa: S307 — outer modes: absent side reads as None
+        fn = eval(f"lambda lk, lrow, rk, rrow: {body}")  # noqa: S307
+        return fn, tuple(cspec)
+    fn = eval(  # noqa: S307 — outer modes: absent side reads as None
         f"lambda lk, lrow, rk, rrow, _ln=(None,) * {nl}, _rn=(None,) * {nr}: "
         f"(lambda lrow, rrow: {body})("
         "lrow if lrow is not None else _ln, "
         "rrow if rrow is not None else _rn)")
+    return fn, tuple(cspec)
 
 
 _COLUMNAR_GVAL_DTYPES = None  # populated lazily (dtype import cycle)

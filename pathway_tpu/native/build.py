@@ -25,11 +25,21 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def ensure_built(name: str) -> str:
-    """Compile native/<name>.cpp (if needed) and return the .so path."""
+def ensure_built(name: str, python_api: bool = False) -> str:
+    """Compile native/<name>.cpp (if needed) and return the .so path.
+
+    ``python_api=True`` builds a CPython extension module (Python.h ABI,
+    loadable with importlib's ExtensionFileLoader) instead of a plain-C
+    ctypes library; the source must define ``PyInit_<name>``."""
     src = os.path.join(_SRC_DIR, f"{name}.cpp")
     with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    if python_api:
+        # ABI-tagged: a CPython extension built under one interpreter
+        # version must not be dlopen'd by another
+        import sys
+
+        digest = f"{digest}-{sys.implementation.cache_tag}"
     out = os.path.join(_BUILD_DIR, f"{name}-{digest}.so")
     if os.path.exists(out):
         return out
@@ -38,10 +48,28 @@ def ensure_built(name: str) -> str:
             return out
         os.makedirs(_BUILD_DIR, exist_ok=True)
         tmp = out + f".tmp{os.getpid()}"
-        cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", src, "-o", tmp]
+        cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC"]
+        if python_api:
+            import sysconfig
+
+            cmd.append(f"-I{sysconfig.get_paths()['include']}")
+        cmd += [src, "-o", tmp]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise NativeBuildError(
                 f"native build failed for {name}:\n{proc.stderr}")
         os.replace(tmp, out)  # atomic: concurrent processes race safely
         return out
+
+
+def load_extension(name: str):
+    """Build + import a CPython extension module from native/<name>.cpp."""
+    import importlib.machinery
+    import importlib.util
+
+    path = ensure_built(name, python_api=True)
+    loader = importlib.machinery.ExtensionFileLoader(name, path)
+    spec = importlib.util.spec_from_file_location(name, path, loader=loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
