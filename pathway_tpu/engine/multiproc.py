@@ -123,8 +123,22 @@ class Cluster:
 
         sender = threading.Thread(target=send_all, daemon=True)
         sender.start()
+        # bounded recv: a hung peer (or accidentally non-SPMD user code
+        # whose exchange schedule diverged) must surface as a diagnostic,
+        # not an eternal deadlock — only a cleanly-dead peer raises EOFError
+        # on its own
+        timeout_s = float(os.environ.get(
+            "PATHWAY_CLUSTER_RECV_TIMEOUT", 300.0))
         out: dict[int, Any] = {}
         for peer, conn in self.peers.items():
+            if not conn.poll(timeout_s):
+                raise TimeoutError(
+                    f"cluster peer {peer} unresponsive at exchange "
+                    f"{tag!r} (process {self.process_id} waited "
+                    f"{timeout_s:.0f}s; peer hung, or the programs "
+                    "diverged — graph construction must be deterministic "
+                    "across processes). Tune with "
+                    "PATHWAY_CLUSTER_RECV_TIMEOUT.")
             rtag, payload = conn.recv()
             if rtag != tag:
                 raise RuntimeError(

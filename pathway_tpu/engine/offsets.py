@@ -46,6 +46,11 @@ class OffsetAntichain:
             out.advance(p, o)
         return out
 
+    def pop(self, partition: Any, default: Any = None) -> Any:
+        """Drop one partition from the frontier (offset-out-of-range
+        recovery re-resolves just that partition via auto.offset.reset)."""
+        return self.offsets.pop(partition, default)
+
     def get(self, partition: Any, default: Any = None) -> Any:
         return self.offsets.get(partition, default)
 

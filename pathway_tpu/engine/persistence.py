@@ -259,6 +259,14 @@ class _RecordingSession:
         self._skip = skip
         self.pending: list = []  # (key, row, diff, offset)
         self.closed = inner.closed
+        self.stopping = inner.stopping
+
+    @property
+    def stop_requested(self) -> bool:
+        return self.stopping.is_set()
+
+    def sleep(self, seconds: float) -> bool:
+        return self._inner.sleep(seconds)
 
     def push(self, key, row, diff: int = 1, offset=None) -> None:
         if self._skip > 0:

@@ -13,9 +13,25 @@ from __future__ import annotations
 import threading
 import time as _time
 
+import weakref
+
 from pathway_tpu.engine.delta import Delta
 from pathway_tpu.engine.graph import Scheduler
 from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
+
+# live runtimes (weak: a runtime dies with its last strong ref). Lets
+# embedding code — and the test harness — stop pw.run() loops started on
+# background threads: stop_all() requests stop and joins reader threads.
+_ACTIVE_RUNTIMES: "weakref.WeakSet[StreamingRuntime]" = weakref.WeakSet()
+
+
+def stop_all(join_timeout: float = 5.0) -> None:
+    """Request stop on every live StreamingRuntime and join their reader
+    threads. Safe to call from any thread; idempotent."""
+    for rt in list(_ACTIVE_RUNTIMES):
+        rt.stop()
+    for rt in list(_ACTIVE_RUNTIMES):
+        rt.join_readers(join_timeout)
 
 
 class StreamingRuntime:
@@ -55,6 +71,15 @@ class StreamingRuntime:
 
     def stop(self) -> None:
         self._stop.set()
+        for _node, session, _ds in self.sessions:
+            session.stopping.set()
+
+    def join_readers(self, timeout: float = 5.0) -> None:
+        """Join connector threads after stop(); they observe the session's
+        stop event between polls (Session.sleep / stop_requested)."""
+        deadline = _time.monotonic() + timeout
+        for t in self.threads:
+            t.join(max(0.0, deadline - _time.monotonic()))
 
     def _drain_and_forward(self):
         """Drain local sessions; under a cluster split each source's rows
@@ -99,6 +124,7 @@ class StreamingRuntime:
         return any_data, all_closed
 
     def run(self) -> None:
+        _ACTIVE_RUNTIMES.add(self)
         time_counter = 1
         if self.persistence is not None:
             time_counter = self.persistence.restore_time() + 1
@@ -182,6 +208,13 @@ class StreamingRuntime:
                         self.persistence.commit(time_counter)
                     break
         finally:
+            # teardown: stop reader threads FIRST so nothing pushes into a
+            # closed pipeline, then join them (a reader that ignores the
+            # stop event is a bug the thread-leak test fixture catches)
+            for _node, session, _ds in self.sessions:
+                session.stopping.set()
+            self.join_readers()
+            _ACTIVE_RUNTIMES.discard(self)
             self.monitor.close()
             self.scheduler.close()
             if self.persistence is not None:

@@ -27,6 +27,20 @@ class Session:
     def __init__(self):
         self._q: queue.Queue = queue.Queue()
         self.closed = threading.Event()
+        # set by the runtime at teardown; polling sources observe it via
+        # stop_requested / sleep() so reader threads actually terminate
+        # (reference: connector threads exit when the main loop drops the
+        # channel, src/connectors/mod.rs)
+        self.stopping = threading.Event()
+
+    @property
+    def stop_requested(self) -> bool:
+        return self.stopping.is_set()
+
+    def sleep(self, seconds: float) -> bool:
+        """Pause between polls, waking immediately on a stop request.
+        Returns True to keep running, False when the source must exit."""
+        return not self.stopping.wait(seconds)
 
     def push(self, key: Pointer, row: tuple, diff: int = 1,
              offset: Any = None) -> None:
@@ -93,10 +107,17 @@ class CollectSession:
     connectors' static modes (debezium, deltalake, pyfilesystem)."""
 
     closed = False
+    stop_requested = False
 
     def __init__(self):
         self.state: dict = {}
         self.counts: dict = {}
+
+    def sleep(self, seconds: float) -> bool:
+        import time
+
+        time.sleep(seconds)
+        return True
 
     def push(self, key, row, diff=1, offset=None):
         c = self.counts.get(key, 0) + diff

@@ -221,7 +221,7 @@ class AirbyteSource(DataSource):
 
         seq = 0
         backoff = 1.0
-        while True:
+        while not session.stop_requested:
             try:
                 records, self.state = self.protocol_source.extract(
                     self.state)
@@ -234,7 +234,8 @@ class AirbyteSource(DataSource):
                 logging.getLogger(__name__).warning(
                     "airbyte sync failed (%s); retrying in %.0fs", e,
                     backoff)
-                _time.sleep(backoff)
+                if not session.sleep(backoff):
+                    return
                 backoff = min(backoff * 2, 300.0)
                 continue
             for record in records:
@@ -244,7 +245,8 @@ class AirbyteSource(DataSource):
                 session.push(key, row, 1)
             if self.mode != "streaming":
                 return
-            _time.sleep(self.refresh_interval_s)
+            if not session.sleep(self.refresh_interval_s):
+                return
 
 
 def _load_config(config_file_path) -> dict:

@@ -94,7 +94,7 @@ class DebeziumFileSource(DataSource):
             db_type=self.db_type, separator=self.separator)
         offset = 0          # byte offset: only the appended tail is read
         remainder = ""      # partial last line awaiting its newline
-        while True:
+        while not session.stop_requested:
             p = Path(self.path)
             if p.exists():
                 with open(p, encoding="utf-8") as f:
@@ -112,7 +112,8 @@ class DebeziumFileSource(DataSource):
                         pump.push(session, ev)
             if self.mode != "streaming":
                 return
-            _time.sleep(0.5)
+            if not session.sleep(0.5):
+                return
 
 
 from pathway_tpu.io._datasource import CollectSession as _CollectSession

@@ -199,7 +199,7 @@ class DeltaLakeSource(DataSource):
                     for key, row, sign in emitted_by_part.pop(part, ()):
                         session.push(key, row, -sign)
 
-        while True:
+        while not session.stop_requested:
             available = set(_list_versions(self.uri))
             # strictly in version order, no gaps (the protocol's total
             # order): a late-landing lower version is never skipped
@@ -208,7 +208,8 @@ class DeltaLakeSource(DataSource):
                 apply_version(done)
             if self.mode != "streaming":
                 return
-            _time.sleep(0.5)
+            if not session.sleep(0.5):
+                return
 
 
 def read(uri: str, *, schema, mode: str = "streaming",

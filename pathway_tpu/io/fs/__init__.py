@@ -164,7 +164,7 @@ class FsSource(DataSource):
         emitted: dict[str, list] = dict(getattr(self, "_resume_emitted", {}))
         resume_skip: dict[str, tuple] = dict(getattr(self, "_resume_skip", {}))
         seq = getattr(self, "_resume_seq", 0)
-        while True:
+        while not session.stop_requested:
             for f in _list_files(self.path):
                 mtime = f.stat().st_mtime
                 fkey = str(f)
@@ -208,7 +208,8 @@ class FsSource(DataSource):
                 emitted[fkey] = rows
             if self.mode != "streaming":
                 return
-            _time.sleep(self.refresh_interval_s)
+            if not session.sleep(self.refresh_interval_s):
+                return
 
 
 def read(path: str, *, format: str = "plaintext", schema=None,

@@ -197,7 +197,7 @@ class GDriveSource(DataSource):
         http = requests.Session()
         emitted: dict[str, tuple] = {}  # file id -> (mtime, key, row)
         backoff = 1.0
-        while True:
+        while not session.stop_requested:
             try:
                 self._poll_once(http, session, emitted)
                 backoff = 1.0
@@ -208,12 +208,14 @@ class GDriveSource(DataSource):
                 # not silently end the stream — retry with backoff
                 logging.getLogger(__name__).warning(
                     "gdrive poll failed (%s); retrying in %.0fs", e, backoff)
-                _time.sleep(backoff)
+                if not session.sleep(backoff):
+                    return
                 backoff = min(backoff * 2, 60.0)
                 continue
             if self.mode != "streaming":
                 return
-            _time.sleep(self.refresh_interval)
+            if not session.sleep(self.refresh_interval):
+                return
 
 
 def read(object_id: str, *,

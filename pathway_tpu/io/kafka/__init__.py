@@ -70,7 +70,9 @@ class KafkaSource(DataSource):
         def emit(msg):
             nonlocal seq
             if self.format == "raw":
-                values = {"data": msg.value}
+                values = {"data": msg.value}  # tombstones emit data=None
+            elif msg.value is None:
+                return  # json-format tombstone: nothing to parse
             else:
                 values = _json.loads(msg.value)
             key, row = self.row_to_engine(values, seq)
@@ -89,6 +91,9 @@ class KafkaSource(DataSource):
             warn_at = _t.monotonic() + 60
             prefetched = []
             while not consumer.assignment():
+                if session.stop_requested:
+                    consumer.close()
+                    return
                 batches = consumer.poll(timeout_ms=200)
                 for msgs in batches.values():
                     prefetched.extend(msgs)
@@ -111,8 +116,13 @@ class KafkaSource(DataSource):
                 # the consumer position has already advanced past them
                 if ac.get(msg.partition) is None:
                     emit(msg)
-        for msg in consumer:
-            emit(msg)
+        # poll (not the blocking iterator) so the stop event is observed
+        while not session.stop_requested:
+            batches = consumer.poll(timeout_ms=500)
+            for msgs in batches.values():
+                for msg in msgs:
+                    emit(msg)
+        consumer.close()
 
     def _run_native(self, session: Session) -> None:
         """Wire-protocol reader: manual partition assignment, offsets from
@@ -129,12 +139,18 @@ class KafkaSource(DataSource):
         reset = self.settings.get("auto.offset.reset", "earliest")
         seq = 0
 
+        from pathway_tpu.io.kafka._protocol import CONTROL
+
         def emit(partition, offset, value):
             nonlocal seq
-            if value is None:
-                return  # tombstone / control-batch sentinel
+            if value is CONTROL:
+                return  # transaction marker: advance the offset, emit nothing
             if self.format == "raw":
+                # tombstone (value None) emits data=None — identical to the
+                # kafka-python reader path
                 values = {"data": value}
+            elif value is None:
+                return  # json-format tombstone: nothing to parse
             else:
                 try:
                     values = _json.loads(value)
@@ -152,7 +168,7 @@ class KafkaSource(DataSource):
         backoff = 1.0
         client = None
         positions: dict[int, int] = {}
-        while True:
+        while not session.stop_requested:
             try:
                 if client is None:
                     # rotate bootstrap hosts across reconnects (failover)
@@ -182,18 +198,26 @@ class KafkaSource(DataSource):
                         positions[pid] = offset + 1
                         any_data = True
                 backoff = 1.0
-                if not any_data:
-                    _t.sleep(0.05)
+                if not any_data and not session.sleep(0.05):
+                    return
             except KafkaProtocolError as e:
                 if e.code == 1:
                     # OFFSET_OUT_OF_RANGE (retention passed the frontier):
-                    # honor auto.offset.reset instead of retrying forever.
-                    # The stale resume frontier must not be re-applied.
+                    # honor auto.offset.reset instead of retrying forever —
+                    # for the FAILING partition only. Clearing every
+                    # position would re-fetch healthy partitions (duplicate
+                    # rows under earliest, silent skips under latest).
                     logging.getLogger(__name__).warning(
-                        "kafka offset out of range; re-resolving via "
-                        "auto.offset.reset=%s", reset)
-                    self._resume_antichain = None
-                    positions.clear()
+                        "kafka offset out of range on partition %s; "
+                        "re-resolving it via auto.offset.reset=%s",
+                        e.partition, reset)
+                    if e.partition is not None:
+                        if self._resume_antichain:
+                            self._resume_antichain.pop(e.partition, None)
+                        positions.pop(e.partition, None)
+                    else:  # unknown partition: previous (full) behavior
+                        self._resume_antichain = None
+                        positions.clear()
                     continue
                 # other broker errors (leader moved, topic recreated):
                 # reconnect and refresh metadata, but KEEP consumed
@@ -204,7 +228,8 @@ class KafkaSource(DataSource):
                 if client is not None:
                     client.close()
                     client = None
-                _t.sleep(backoff)
+                if not session.sleep(backoff):
+                    return
                 backoff = min(backoff * 2, 30.0)
             except (ConnectionError, OSError, RuntimeError) as e:
                 logging.getLogger(__name__).warning(
@@ -213,7 +238,8 @@ class KafkaSource(DataSource):
                 if client is not None:
                     client.close()
                     client = None
-                _t.sleep(backoff)
+                if not session.sleep(backoff):
+                    return
                 backoff = min(backoff * 2, 30.0)
 
 

@@ -20,11 +20,16 @@ from typing import Any, Iterator
 
 
 class KafkaProtocolError(RuntimeError):
-    """Broker-reported error code (OFFSET_OUT_OF_RANGE=1, NOT_LEADER=6...)."""
+    """Broker-reported error code (OFFSET_OUT_OF_RANGE=1, NOT_LEADER=6...).
 
-    def __init__(self, code: int, context: str):
+    ``partition`` carries the failing partition id when the error came from
+    a per-partition response (fetch), so callers can recover just that
+    partition instead of resetting every healthy one."""
+
+    def __init__(self, code: int, context: str, partition: int | None = None):
         super().__init__(f"kafka error {code} ({context})")
         self.code = code
+        self.partition = partition
 
 # -- primitives -------------------------------------------------------------
 
@@ -200,10 +205,18 @@ def encode_record_batch(records: list[tuple[bytes | None, bytes | None]],
     return enc_int64(base_offset) + enc_int32(len(inner)) + inner
 
 
-def parse_record_batches(data: bytes) -> Iterator[tuple[int, bytes | None,
-                                                        bytes | None]]:
+# distinct sentinel for control batches (transaction markers): a legit
+# tombstone record also has key=None value=None, so (offset, None, None)
+# was ambiguous — readers dropped real tombstones on the native path while
+# the kafka-python path emitted them
+CONTROL = object()
+
+
+def parse_record_batches(data: bytes) -> Iterator[tuple[int, object,
+                                                        object]]:
     """Yield (offset, key, value) from a concatenation of RecordBatch v2
-    blobs (a Fetch response's record set). Truncated tails are skipped —
+    blobs (a Fetch response's record set); control batches yield one
+    ``(offset, CONTROL, CONTROL)`` marker. Truncated tails are skipped —
     brokers may return partial batches at the end of a fetch."""
     pos = 0
     n = len(data)
@@ -225,9 +238,9 @@ def parse_record_batches(data: bytes) -> Iterator[tuple[int, bytes | None,
         if attrs & 0x20:
             # control batch (transaction markers): nothing to emit, but the
             # caller must still advance PAST it or it refetches forever —
-            # yield one (offset, None, None) sentinel at the batch's end
+            # yield one CONTROL marker at the batch's end
             lod = r.int32()              # lastOffsetDelta
-            yield base_offset + lod, None, None
+            yield base_offset + lod, CONTROL, CONTROL
             pos = end
             continue
         if attrs & 0x07:
@@ -426,7 +439,8 @@ class KafkaClient:
                                 if o >= base]
         if errors:
             pid, err = next(iter(errors.items()))
-            raise KafkaProtocolError(err, f"fetch partition {pid}")
+            raise KafkaProtocolError(err, f"fetch partition {pid}",
+                                     partition=pid)
         return out
 
     def produce(self, topic: str, partition: int,

@@ -27,13 +27,23 @@ def _parse_uri(uri: str) -> tuple[str, int]:
     return u.hostname or "127.0.0.1", u.port or 4222
 
 
-class _NatsConn:
-    """Minimal protocol client: CONNECT, SUB, PUB, PING/PONG."""
+class _NatsStopped(Exception):
+    """Raised out of a blocked read when the runtime requested stop."""
 
-    def __init__(self, uri: str, timeout: float | None = None):
+
+class _NatsConn:
+    """Minimal protocol client: CONNECT, SUB, PUB, PING/PONG.
+
+    ``stop_event`` + a recv timeout make blocked reads interruptible
+    WITHOUT losing parse state: the timeout is handled inside _recv (the
+    buffered partial frame stays intact), never surfaced mid-message."""
+
+    def __init__(self, uri: str, timeout: float | None = None,
+                 stop_event=None):
         host, port = _parse_uri(uri)
         self.sock = socket.create_connection((host, port), timeout=30)
         self.sock.settimeout(timeout)
+        self.stop_event = stop_event
         self.buf = b""
         info = self._read_line()  # server greets with INFO {...}
         if not info.startswith(b"INFO"):
@@ -44,21 +54,27 @@ class _NatsConn:
     def _send(self, data: bytes) -> None:
         self.sock.sendall(data)
 
-    def _read_line(self) -> bytes:
-        while b"\r\n" not in self.buf:
-            chunk = self.sock.recv(65536)
+    def _recv(self) -> bytes:
+        while True:
+            try:
+                chunk = self.sock.recv(65536)
+            except TimeoutError:
+                if self.stop_event is not None and self.stop_event.is_set():
+                    raise _NatsStopped() from None
+                continue  # idle wait; buffered state untouched
             if not chunk:
                 raise ConnectionError("NATS connection closed")
-            self.buf += chunk
+            return chunk
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            self.buf += self._recv()
         line, self.buf = self.buf.split(b"\r\n", 1)
         return line
 
     def _read_exact(self, n: int) -> bytes:
         while len(self.buf) < n:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("NATS connection closed")
-            self.buf += chunk
+            self.buf += self._recv()
         out, self.buf = self.buf[:n], self.buf[n:]
         return out
 
@@ -123,14 +139,20 @@ class NatsSource(DataSource):
 
         seq = 0
         backoff = 1.0
-        while True:
+        while not session.stop_requested:
             conn = None
             try:
-                conn = _NatsConn(self.uri)
+                # 1s recv granularity + the session stop event: blocked
+                # reads wake to stop without losing mid-message state
+                conn = _NatsConn(self.uri, timeout=1.0,
+                                 stop_event=session.stopping)
                 conn.subscribe(self.topic)
                 backoff = 1.0
-                while True:
-                    payload = conn.next_message()
+                while not session.stop_requested:
+                    try:
+                        payload = conn.next_message()
+                    except _NatsStopped:
+                        return
                     if payload is None:
                         return
                     if self.format == "json":
@@ -147,6 +169,8 @@ class NatsSource(DataSource):
                     key, row = self.row_to_engine(values, seq)
                     seq += 1
                     session.push(key, row, 1)
+            except _NatsStopped:
+                return  # stop requested while connecting/handshaking
             except (ConnectionError, OSError) as e:
                 # server restarts/drops must not end the stream: NATS
                 # clients reconnect and resubscribe (core NATS is
@@ -154,7 +178,8 @@ class NatsSource(DataSource):
                 logging.getLogger(__name__).warning(
                     "nats connection lost (%s); reconnecting in %.0fs",
                     e, backoff)
-                _time.sleep(backoff)
+                if not session.sleep(backoff):
+                    return
                 backoff = min(backoff * 2, 30.0)
             finally:
                 if conn is not None:

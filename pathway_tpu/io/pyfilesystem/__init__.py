@@ -125,7 +125,7 @@ class PyFilesystemSource(DataSource):
     def run(self, session: Session) -> None:
         seen: dict[str, float] = {}
         emitted: dict[str, tuple] = {}
-        while True:
+        while not session.stop_requested:
             for path, mtime, size in self.adapter.list_files():
                 if seen.get(path) == mtime and path in emitted:
                     continue
@@ -138,7 +138,8 @@ class PyFilesystemSource(DataSource):
                 seen[path] = mtime
             if self.mode != "streaming":
                 return
-            _time.sleep(self.refresh_interval)
+            if not session.sleep(self.refresh_interval):
+                return
 
 
 def read(source: Any, *, path: str = "", refresh_interval: float = 30,

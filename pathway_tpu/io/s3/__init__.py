@@ -73,15 +73,28 @@ class S3Adapter:
 
 
 def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
-         format: str = "binary", mode: str = "streaming",
+         format: str = "binary", schema=None, mode: str = "streaming",
          with_metadata: bool = False, name: str | None = None,
          persistent_id: str | None = None,
          refresh_interval: float = 30,
-         autocommit_duration_ms: int | None = 1500):
+         autocommit_duration_ms: int | None = 1500,
+         **kwargs):
     """Read objects under ``s3://bucket/path``. ``format='binary'``
     yields one row per object, polled for changes in streaming mode
     (native SigV4 REST client — no boto/s3fs; reference S3Scanner,
-    data_storage.rs:1769)."""
+    data_storage.rs:1769). ``schema`` and the reference's extra kwargs
+    (csv_settings, downloader_threads_count, ...) are accepted for
+    signature compatibility; binary mode ignores them. Unknown keywords
+    still raise, so typos of real parameters are not swallowed."""
+    _REF_KWARGS = {"csv_settings", "json_field_paths", "path_filter",
+                   "downloader_threads_count", "debug_data",
+                   "value_columns", "id_columns", "types", "default_values",
+                   "kwargs"}
+    unknown = set(kwargs) - _REF_KWARGS
+    if unknown:
+        raise TypeError(
+            f"pw.io.s3.read() got unexpected keyword arguments "
+            f"{sorted(unknown)}")
     from pathway_tpu.io import pyfilesystem as _pfs
     from pathway_tpu.io.s3._client import split_bucket_prefix
 

@@ -29,7 +29,7 @@ class SqliteSource(DataSource):
         cols = ", ".join(names)
         emitted: dict[int, tuple] = {}
         seq = 0
-        while True:
+        while not session.stop_requested:
             conn = sqlite3.connect(self.path)
             try:
                 cur = conn.execute(
@@ -60,7 +60,8 @@ class SqliteSource(DataSource):
                     session.push(okey, orow, -1)
             if self.mode != "streaming":
                 return
-            _time.sleep(self.poll_interval_s)
+            if not session.sleep(self.poll_interval_s):
+                return
 
     def row_to_engine(self, values, seq):
         from pathway_tpu.internals.keys import hash_values

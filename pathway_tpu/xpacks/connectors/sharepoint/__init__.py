@@ -163,7 +163,7 @@ class SharePointSource(DataSource):
         http = requests.Session()
         emitted: dict[str, tuple] = {}
         backoff = 1.0
-        while True:
+        while not session.stop_requested:
             try:
                 self._poll_once(http, session, emitted)
                 backoff = 1.0
@@ -173,12 +173,14 @@ class SharePointSource(DataSource):
                 logging.getLogger(__name__).warning(
                     "sharepoint poll failed (%s); retrying in %.0fs",
                     e, backoff)
-                _time.sleep(backoff)
+                if not session.sleep(backoff):
+                    return
                 backoff = min(backoff * 2, 60.0)
                 continue
             if self.mode != "streaming":
                 return
-            _time.sleep(self.refresh_interval)
+            if not session.sleep(self.refresh_interval):
+                return
 
 
 def read(url: str, *,

@@ -32,6 +32,7 @@ class FakeBroker:
     def __init__(self, topics: dict[str, int]):
         # topic -> [partition logs]; log = list[(key, value)]
         self.logs = {t: [[] for _ in range(n)] for t, n in topics.items()}
+        self.force_error = None  # (partition, code): next fetch fails there
         self.server = socket.create_server(("127.0.0.1", 0))
         self.port = self.server.getsockname()[1]
         threading.Thread(target=self._accept, daemon=True).start()
@@ -129,11 +130,14 @@ class FakeBroker:
                    + kp.enc_int32(1) + kp.enc_string(topic)
                    + kp.enc_int32(len(wanted)))
             for pid, offset in wanted:
+                err = 0
+                if self.force_error and self.force_error[0] == pid:
+                    err = self.force_error[1]
                 log = self.logs[topic][pid]
                 chunk = log[offset:offset + 100]
                 records = kp.encode_record_batch(chunk, base_offset=offset) \
-                    if chunk else b""
-                out += (kp.enc_int32(pid) + kp.enc_int16(0)
+                    if chunk and not err else b""
+                out += (kp.enc_int32(pid) + kp.enc_int16(err)
                         + kp.enc_int64(len(log)) + kp.enc_int64(len(log))
                         + kp.enc_int32(0)  # aborted txns
                         + kp.enc_bytes(records))
@@ -247,3 +251,35 @@ def test_record_batch_empty_and_single():
     assert list(kp.parse_record_batches(b"")) == []
     blob = kp.encode_record_batch([(None, None)])
     assert list(kp.parse_record_batches(blob)) == [(0, None, None)]
+
+
+def test_control_batch_marker_distinct_from_tombstone():
+    """A control batch's sentinel must NOT look like a (None, None)
+    tombstone record — tombstones are real data (advisor r3 finding)."""
+    blob = kp.encode_record_batch([(b"k", None)], base_offset=3)
+    out = list(kp.parse_record_batches(blob))
+    assert out == [(3, b"k", None)]  # tombstone: value None, not CONTROL
+    assert all(v is not kp.CONTROL for _o, _k, v in out)
+
+
+def test_offset_out_of_range_carries_partition():
+    """fetch_many surfaces WHICH partition failed so the reader resets only
+    that one (advisor r3 finding: a full reset re-emits healthy
+    partitions under earliest / silently skips under latest)."""
+    broker = FakeBroker({"t": 2})
+    try:
+        c = kp.KafkaClient(f"127.0.0.1:{broker.port}")
+        c.produce("t", 0, [(None, b"a")])
+        c.produce("t", 1, [(None, b"b")])
+        broker.force_error = (1, 1)  # partition 1 -> OFFSET_OUT_OF_RANGE
+        with pytest.raises(kp.KafkaProtocolError) as exc:
+            c.fetch_many("t", {0: 0, 1: 5})
+        assert exc.value.code == 1 and exc.value.partition == 1
+        # healthy partition still fetches once the error clears
+        broker.force_error = None
+        got = c.fetch_many("t", {0: 0, 1: 0})
+        assert [v for _o, _k, v in got[0]] == [b"a"]
+        assert [v for _o, _k, v in got[1]] == [b"b"]
+        c.close()
+    finally:
+        broker.close()
