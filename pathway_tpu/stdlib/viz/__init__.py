@@ -30,12 +30,133 @@ def show(table: Table, *, snapshot: bool = True,
     return rendered
 
 
+def _has_streaming_input(table: Table) -> bool:
+    """Walk the plan graph: any ``input`` (connector-fed) plan means the
+    table only materializes under pw.run() — the plot must live-update.
+    Expressions are walked too (cross-table ix references can be the only
+    edge to a streaming table)."""
+    from pathway_tpu.internals import expression as ex
+
+    seen: set[int] = set()
+
+    def expr_tables(e):
+        if isinstance(e, ex.ColumnReference):
+            yield e.table
+        if isinstance(e, ex.ColumnExpression):
+            for child in e._deps():
+                yield from expr_tables(child)
+
+    def walk(t) -> bool:
+        if id(t) in seen:
+            return False
+        seen.add(id(t))
+        plan = t._plan
+        if plan.kind == "input":
+            return True
+        for v in plan.params.values():
+            for cand in (v if isinstance(v, (list, tuple)) else (v,)):
+                if isinstance(cand, Table) and walk(cand):
+                    return True
+                if isinstance(cand, ex.ColumnExpression):
+                    for et in expr_tables(cand):
+                        if isinstance(et, Table) and walk(et):
+                            return True
+        return False
+
+    return walk(table)
+
+
 def plot(table: Table, plotting_function=None, sorting_col=None):
+    """Live Bokeh plot of a table (reference: stdlib/viz/plotting.py).
+
+    ``plotting_function(source: ColumnDataSource) -> figure`` builds the
+    plot; the source's columns carry the table's columns. Static tables
+    render immediately; tables with streaming inputs update the
+    ColumnDataSource after every closed timestamp once ``pw.run()`` is
+    live. Returns a ``panel.Column`` when panel is importable, else the
+    bare Bokeh figure."""
     try:
-        import bokeh  # noqa: F401
+        from bokeh.models import ColumnDataSource
     except ImportError as e:
         raise NotImplementedError(
-            "interactive plotting requires bokeh/panel (not in this image)"
-        ) from e
-    raise NotImplementedError(
-        "bokeh present but live plotting is not wired in this build yet")
+            "interactive plotting requires bokeh (pip install bokeh; "
+            "optionally panel for dashboard output)") from e
+
+    col_names = table.column_names()
+    source = ColumnDataSource(data={c: [] for c in col_names})
+
+    if plotting_function is None:
+        def plotting_function(src, _cols=col_names):
+            from bokeh.plotting import figure
+
+            fig = figure(height=400, width=600)
+            if len(_cols) >= 2:
+                fig.scatter(_cols[0], _cols[1], source=src)
+            return fig
+
+    fig = plotting_function(source)
+
+    streaming = _has_streaming_input(table)
+    try:
+        import panel as pn
+
+        mode = "Streaming mode" if streaming else "Static preview"
+        viz = pn.Column(pn.Row(mode), fig)
+    except ImportError:
+        viz = fig
+
+    def render_state(state: dict) -> dict:
+        rows = list(state.items())
+        if sorting_col is not None:
+            pos = col_names.index(sorting_col)
+            rows.sort(key=lambda kv: _sort_key_viz(kv[1][pos]))
+        else:
+            rows.sort(key=lambda kv: int(kv[0]))
+        return {name: [r[i] for _k, r in rows]
+                for i, name in enumerate(col_names)}
+
+    if not streaming:
+        from pathway_tpu.internals.runner import run_tables
+
+        [cap] = run_tables(table)
+        state = cap.snapshot()
+        if state:
+            source.stream(render_state(state), rollover=len(state))
+        return viz
+
+    # streaming: integrate the change stream; after each closed timestamp
+    # replace the source contents (rollover = live row count)
+    import pathway_tpu as pw
+
+    state: dict = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            state[key] = tuple(row[c] for c in col_names)
+        else:
+            state.pop(key, None)
+
+    def push():
+        if state:
+            source.stream(render_state(state), rollover=len(state))
+        else:
+            # rollover=0 trims nothing in bokeh: clear by assignment
+            source.data = {c: [] for c in col_names}
+
+    def on_time_end(time):
+        doc = getattr(fig, "document", None)
+        if doc is not None and getattr(doc, "session_context", None):
+            doc.add_next_tick_callback(push)  # bokeh server: take the lock
+        else:
+            push()
+
+    pw.io.subscribe(table, on_change=on_change, on_time_end=on_time_end)
+    return viz
+
+
+def _sort_key_viz(v):
+    if v is None:
+        return (0, 0)
+    if isinstance(v, (bool, int, float)):
+        return (1, float(v))
+    return (2, str(v))

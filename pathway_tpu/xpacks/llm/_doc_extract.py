@@ -125,7 +125,7 @@ def _extract_content_text(content: bytes) -> str:
                     lines.append("".join(current))
                     current = []
                 current.extend(pending)
-            elif op in (b"Td", b"TD", b"T*"):
+            elif op in (b"Td", b"TD", b"T*", b"Tm"):
                 if current:
                     lines.append("".join(current))
                     current = []
@@ -142,32 +142,164 @@ def _extract_content_text(content: bytes) -> str:
     return "\n".join(line for line in lines if line.strip())
 
 
-def extract_pdf(raw: bytes) -> list[str]:
-    """Text of each content stream (≈ page) in document order."""
-    pages: list[str] = []
+def _iter_content_streams(raw: bytes):
+    """Decompressed content streams containing text blocks, in document
+    order — the ONE stream walk both text and table extraction share."""
     pos = 0
     while True:
         m = _STREAM_RE.search(raw, pos)
         if m is None:
-            break
+            return
         start = m.end()
         end = raw.find(b"endstream", start)
         if end < 0:
-            break
+            return
         data = raw[start:end].rstrip(b"\r\n")
-        header = m.group(1)
-        if b"FlateDecode" in header:
+        if b"FlateDecode" in m.group(1):
             try:
                 data = zlib.decompress(data)
             except zlib.error:
                 pos = end + 9
                 continue
         if b"BT" in data:
-            text = _extract_content_text(data)
-            if text:
-                pages.append(text)
+            yield data
         pos = end + 9
+
+
+def extract_pdf(raw: bytes) -> list[str]:
+    """Text of each content stream (≈ page) in document order."""
+    pages: list[str] = []
+    for data in _iter_content_streams(raw):
+        text = _extract_content_text(data)
+        if text:
+            pages.append(text)
     return pages
+
+
+# ---------------------------------------------------------------------------
+# PDF tables (positional layout analysis)
+# ---------------------------------------------------------------------------
+
+def _positioned_items(content: bytes) -> list[tuple[float, float, str]]:
+    """(x, y, text) for every text-showing op, tracking the text-positioning
+    operators (Tm/Td/TD/T*) — the coordinates machine-generated tables are
+    laid out with. Rotation/scaling are ignored (tables are axis-aligned)."""
+    items: list[tuple[float, float, str]] = []
+    lx = ly = 0.0   # current line origin
+    leading = 12.0  # TL; TD sets it to -ty
+    operands: list[float] = []
+    pending: list[str] = []
+    for m in _STRING_TOKEN.finditer(content):
+        tok = m.group(0)
+        c = tok[:1]
+        if c == b"(" or c == b"<":
+            pending.append(_decode_pdf_string(tok))
+            continue
+        if tok in (b"[", b"]"):
+            continue
+        if not (c.isalpha() or tok in (b"'", b'"')):
+            try:
+                operands.append(float(tok))
+            except ValueError:
+                pass
+            continue
+        op = tok
+        if op == b"Tm" and len(operands) >= 6:
+            lx, ly = operands[-2], operands[-1]
+        elif op in (b"Td", b"TD") and len(operands) >= 2:
+            lx += operands[-2]
+            ly += operands[-1]
+            if op == b"TD":
+                leading = -operands[-1] or leading
+        elif op == b"TL" and operands:
+            leading = operands[-1]
+        elif op == b"T*":
+            ly -= leading
+        elif op in (b"'", b'"'):
+            ly -= leading
+            if pending:
+                items.append((lx, ly, "".join(pending)))
+        elif op in (b"Tj", b"TJ"):
+            if pending:
+                items.append((lx, ly, "".join(pending)))
+        elif op == b"BT":
+            lx = ly = 0.0
+        operands = []
+        pending = []
+    return items
+
+
+def _detect_tables(items: list[tuple[float, float, str]],
+                   y_tol: float = 3.0, x_tol: float = 6.0
+                   ) -> list[list[list[str]]]:
+    """Tables from positioned text: cluster items into visual rows by y,
+    take runs of >= 2 consecutive rows with >= 2 cells each, and assign
+    cells to columns clustered over the run's x starts."""
+    if not items:
+        return []
+    # visual rows: same-y items, top to bottom
+    rows: list[tuple[float, list[tuple[float, str]]]] = []
+    for x, y, text in sorted(items, key=lambda it: (-it[1], it[0])):
+        if not text.strip():
+            continue
+        if rows and abs(rows[-1][0] - y) <= y_tol:
+            rows[-1][1].append((x, text))
+        else:
+            rows.append((y, [(x, text)]))
+    tables: list[list[list[str]]] = []
+    run: list[list[tuple[float, str]]] = []
+
+    def flush_run():
+        if len(run) < 2:
+            return
+        # columns: cluster x starts across the run
+        xs = sorted({x for cells in run for x, _ in cells})
+        cols: list[float] = []
+        for x in xs:
+            if not cols or x - cols[-1] > x_tol:
+                cols.append(x)
+        if len(cols) < 2:
+            return
+        out_rows = []
+        for cells in run:
+            out = [""] * len(cols)
+            for x, text in sorted(cells):
+                ci = min(range(len(cols)), key=lambda i: abs(cols[i] - x))
+                out[ci] = (out[ci] + " " + text).strip()
+            out_rows.append(out)
+        tables.append(out_rows)
+
+    for _y, cells in rows:
+        if len(cells) >= 2:
+            run.append(cells)
+        else:
+            flush_run()
+            run = []
+    flush_run()
+    return tables
+
+
+def extract_pdf_tables(raw: bytes) -> list[dict]:
+    """[{page, rows}] — structured cell rows for every table-shaped layout
+    region (reference scope: openparse's table extraction,
+    xpacks/llm/_openparse_utils.py). Pages are numbered exactly like
+    extract_pdf numbers them: streams yielding no text don't count."""
+    out: list[dict] = []
+    page = 0
+    for data in _iter_content_streams(raw):
+        if not _extract_content_text(data):
+            continue
+        page += 1
+        for rows in _detect_tables(_positioned_items(data)):
+            out.append({"page": page, "rows": rows})
+    return out
+
+
+def _rows_to_markdown(rows: list[list[str]]) -> str:
+    lines = [" | ".join(r) for r in rows]
+    if len(lines) > 1:
+        lines.insert(1, " | ".join("---" for _ in rows[0]))
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------
@@ -178,16 +310,46 @@ _W_NS = "{http://schemas.openxmlformats.org/wordprocessingml/2006/main}"
 _A_NS = "{http://schemas.openxmlformats.org/drawingml/2006/main}"
 
 
-def extract_docx(raw: bytes) -> list[str]:
-    """Paragraph texts from word/document.xml."""
+def extract_docx(raw: bytes, skip_table_paragraphs: bool = False
+                 ) -> list[str]:
+    """Paragraph texts from word/document.xml. With
+    ``skip_table_paragraphs`` the paragraphs living inside w:tbl cells are
+    left to extract_docx_tables — element extraction must not index the
+    same cell text twice."""
     with zipfile.ZipFile(io.BytesIO(raw)) as z:
         tree = ElementTree.fromstring(z.read("word/document.xml"))
+    in_table: set[int] = set()
+    if skip_table_paragraphs:
+        for tbl in tree.iter(f"{_W_NS}tbl"):
+            for p in tbl.iter(f"{_W_NS}p"):
+                in_table.add(id(p))
     out = []
     for para in tree.iter(f"{_W_NS}p"):
+        if id(para) in in_table:
+            continue
         text = "".join(t.text or "" for t in para.iter(f"{_W_NS}t"))
         if text.strip():
             out.append(text)
     return out
+
+
+def extract_docx_tables(raw: bytes) -> list[list[list[str]]]:
+    """Structured cell rows for every w:tbl in the document."""
+    with zipfile.ZipFile(io.BytesIO(raw)) as z:
+        tree = ElementTree.fromstring(z.read("word/document.xml"))
+    tables = []
+    for tbl in tree.iter(f"{_W_NS}tbl"):
+        rows = []
+        for tr in tbl.iter(f"{_W_NS}tr"):
+            cells = []
+            for tc in tr.iter(f"{_W_NS}tc"):
+                cells.append("".join(
+                    t.text or "" for t in tc.iter(f"{_W_NS}t")).strip())
+            if cells:
+                rows.append(cells)
+        if rows:
+            tables.append(rows)
+    return tables
 
 
 def extract_pptx(raw: bytes) -> list[str]:
@@ -250,12 +412,35 @@ def extract_elements(raw: bytes) -> list[tuple[str, dict]]:
     ParseUnstructured's elements mode returns."""
     fmt = detect_format(raw)
     if fmt == "pdf":
-        return [(text, {"page_number": i + 1, "category": "Page",
-                        "filetype": "pdf"})
-                for i, text in enumerate(extract_pdf(raw))]
+        # one walk: page text and tables together, with table cell lines
+        # removed from the page body so cell text is indexed exactly once
+        out: list[tuple[str, dict]] = []
+        page = 0
+        for data in _iter_content_streams(raw):
+            text = _extract_content_text(data)
+            if not text:
+                continue
+            page += 1
+            tables = _detect_tables(_positioned_items(data))
+            cells = {c for rows in tables for row in rows for c in row}
+            body = "\n".join(line for line in text.splitlines()
+                             if line.strip() not in cells)
+            if body.strip():
+                out.append((body, {"page_number": page, "category": "Page",
+                                   "filetype": "pdf"}))
+            for rows in tables:
+                out.append((_rows_to_markdown(rows),
+                            {"page_number": page, "category": "Table",
+                             "filetype": "pdf", "rows": rows}))
+        return out
     if fmt == "docx":
-        return [(text, {"category": "Paragraph", "filetype": "docx"})
-                for text in extract_docx(raw)]
+        out = [(text, {"category": "Paragraph", "filetype": "docx"})
+               for text in extract_docx(raw, skip_table_paragraphs=True)]
+        for rows in extract_docx_tables(raw):
+            out.append((_rows_to_markdown(rows),
+                        {"category": "Table", "filetype": "docx",
+                         "rows": rows}))
+        return out
     if fmt == "pptx":
         return [(text, {"page_number": i + 1, "category": "Slide",
                         "filetype": "pptx"})

@@ -51,9 +51,16 @@ def prompt_qa(context_docs, query: str,
 
 def prompt_qa_geometric_rag(context_docs, query: str,
                             information_not_found_response: str = NO_INFO_ANSWER,
-                            additional_rules: str = "") -> str:
+                            additional_rules: str = "",
+                            strict_prompt: bool = False) -> str:
     """Strict variant used by the adaptive strategy: the model must not
-    guess, so escalation on the sentinel is sound."""
+    guess, so escalation on the sentinel is sound. ``strict_prompt``
+    tightens the output contract further for small open-source models
+    (reference: prompts.prompt_qa_geometric_rag's strict mode)."""
+    if strict_prompt:
+        additional_rules += (
+            " Respond with the answer text alone — no preamble, no "
+            "explanation, no quotation marks around the whole answer.")
     return (
         "Use ONLY the documents below to answer. Do not use prior "
         "knowledge. If the answer is not contained in the documents, reply "

@@ -38,7 +38,8 @@ async def answer_with_geometric_rag_strategy(
         answer = prompts.NO_INFO_ANSWER
         for _ in range(max_iterations):
             context = docs[:n]
-            prompt = prompts.prompt_qa_geometric_rag(context, question)
+            prompt = prompts.prompt_qa_geometric_rag(
+                context, question, strict_prompt=strict_prompt)
             result = await chat([{"role": "user", "content": prompt}])
             if result and prompts.NO_INFO_ANSWER.lower() not in \
                     str(result).lower():
@@ -51,19 +52,48 @@ async def answer_with_geometric_rag_strategy(
     return answers
 
 
-async def answer_with_geometric_rag_strategy_from_index(
-        questions: list[str], index, documents_column_name: str,
+def answer_with_geometric_rag_strategy_from_index(
+        questions, index, documents_column,
         llm_chat_model: llms.BaseChat, n_starting_documents: int,
-        factor: int, max_iterations: int, **kwargs) -> list[str]:
-    """Retrieval + escalation in one call (reference :153). Retrieves the
-    maximum doc count once, then escalates locally."""
-    max_docs = n_starting_documents * factor ** (max_iterations - 1)
-    # index here is a DataIndex; retrieval happens through the table API in
-    # streaming mode — this helper serves the direct/batch use
-    raise NotImplementedError(
-        "use BaseRAGQuestionAnswerer/AdaptiveRAGQuestionAnswerer for "
-        "pipeline integration; direct from-index calls need a materialized "
-        "retriever")
+        factor: int, max_iterations: int,
+        metadata_filter=None, strict_prompt: bool = False):
+    """Retrieval + escalation in one expression (reference :153).
+
+    ``questions`` is a column of question strings; the index is queried
+    ONCE for the maximum document count the escalation could need
+    (n_starting_documents * factor^(max_iterations-1)), and the geometric
+    loop then runs locally over that retrieved list — each extra iteration
+    costs an LLM call but no retrieval. Returns an answer column; a
+    question with no answer yields None."""
+    from pathway_tpu.internals import expression as ex
+
+    max_documents = n_starting_documents * factor ** (max_iterations - 1)
+    if isinstance(documents_column, ex.ColumnReference):
+        documents_column_name = documents_column.name
+    else:
+        documents_column_name = documents_column
+
+    retrieved = index.query_as_of_now(
+        questions, number_of_matches=max_documents, collapse_rows=True,
+        metadata_filter=metadata_filter)
+    docs = retrieved.select(
+        _pw_documents=pw.coalesce(pw.this[documents_column_name], ()))
+
+    @pw.udf
+    async def escalate(question, documents) -> str | None:
+        doc_list = [str(d) for d in (documents or ())]
+        answers = await answer_with_geometric_rag_strategy(
+            [str(question)], [doc_list], llm_chat_model,
+            n_starting_documents, factor, max_iterations,
+            strict_prompt=strict_prompt)
+        answer = answers[0]
+        return None if answer == prompts.NO_INFO_ANSWER else answer
+
+    question_view = questions.table.ix(pw.this.id, context=docs)
+    result = docs.select(
+        answer=escalate(getattr(question_view, questions.name),
+                        pw.this._pw_documents))
+    return result.answer
 
 
 class BaseRAGQuestionAnswerer:

@@ -135,3 +135,93 @@ def test_parse_openparse_fallback():
 def test_extract_elements_plain_text():
     [(text, meta)] = extract_elements("just text".encode())
     assert text == "just text" and meta["filetype"] == "text"
+
+
+def make_table_pdf() -> bytes:
+    """A page laying out a 3x3 grid with absolute Tm positions (the shape
+    machine-generated table PDFs use) plus a loose paragraph line."""
+    cells = [
+        ("name", 72, 700), ("qty", 200, 700), ("price", 320, 700),
+        ("bolt", 72, 684), ("4", 200, 684), ("0.10", 320, 684),
+        ("nut", 72, 668), ("12", 200, 668), ("0.05", 320, 668),
+    ]
+    ops = [b"BT", b"/F1 10 Tf"]
+    for text, x, y in cells:
+        ops.append(f"1 0 0 1 {x} {y} Tm ({text}) Tj".encode())
+    ops.append(b"1 0 0 1 72 600 Tm (Totals are indicative only.) Tj")
+    ops.append(b"ET")
+    content = b"\n".join(ops)
+    hdr = b"<< /Length %d >>" % len(content)
+    return (b"%PDF-1.4\n1 0 obj\n" + hdr + b"\nstream\n" + content
+            + b"\nendstream\nendobj\n%%EOF\n")
+
+
+def test_pdf_table_extraction_structured_rows():
+    from pathway_tpu.xpacks.llm import _doc_extract as de
+
+    tables = de.extract_pdf_tables(make_table_pdf())
+    assert len(tables) == 1
+    assert tables[0]["page"] == 1
+    assert tables[0]["rows"] == [
+        ["name", "qty", "price"],
+        ["bolt", "4", "0.10"],
+        ["nut", "12", "0.05"],
+    ]
+    # the loose paragraph line must NOT be swallowed into the table
+    flat = [c for row in tables[0]["rows"] for c in row]
+    assert "Totals are indicative only." not in flat
+
+
+def test_pdf_table_flows_through_extract_elements():
+    from pathway_tpu.xpacks.llm import _doc_extract as de
+
+    elements = de.extract_elements(make_table_pdf())
+    tables = [(t, m) for t, m in elements if m.get("category") == "Table"]
+    assert len(tables) == 1
+    text, meta = tables[0]
+    assert meta["rows"][1] == ["bolt", "4", "0.10"]
+    assert "name | qty | price" in text  # markdown rendering for RAG
+
+
+def make_table_docx() -> bytes:
+    ns = "http://schemas.openxmlformats.org/wordprocessingml/2006/main"
+    tbl = (
+        "<w:tbl>"
+        "<w:tr><w:tc><w:p><w:r><w:t>h1</w:t></w:r></w:p></w:tc>"
+        "<w:tc><w:p><w:r><w:t>h2</w:t></w:r></w:p></w:tc></w:tr>"
+        "<w:tr><w:tc><w:p><w:r><w:t>a</w:t></w:r></w:p></w:tc>"
+        "<w:tc><w:p><w:r><w:t>b</w:t></w:r></w:p></w:tc></w:tr>"
+        "</w:tbl>")
+    doc = (f'<?xml version="1.0"?><w:document xmlns:w="{ns}">'
+           f'<w:body><w:p><w:r><w:t>intro</w:t></w:r></w:p>{tbl}'
+           f'</w:body></w:document>')
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("[Content_Types].xml", "<Types/>")
+        z.writestr("word/document.xml", doc)
+    return buf.getvalue()
+
+
+def test_docx_table_extraction():
+    from pathway_tpu.xpacks.llm import _doc_extract as de
+
+    assert de.extract_docx_tables(make_table_docx()) == [
+        [["h1", "h2"], ["a", "b"]]]
+    elements = de.extract_elements(make_table_docx())
+    cats = [m.get("category") for _t, m in elements]
+    assert "Table" in cats and "Paragraph" in cats
+
+
+def test_table_cells_indexed_exactly_once():
+    """Cell text must appear in the Table element only — not duplicated in
+    the Page/Paragraph body (double-indexing skews retrieval)."""
+    from pathway_tpu.xpacks.llm import _doc_extract as de
+
+    for raw in (make_table_pdf(), make_table_docx()):
+        elements = de.extract_elements(raw)
+        body_text = "\n".join(
+            t for t, m in elements if m.get("category") != "Table")
+        for cell in ("bolt", "h1"):
+            if any(cell in t for t, m in elements
+                   if m.get("category") == "Table"):
+                assert cell not in body_text, (cell, body_text)

@@ -302,3 +302,39 @@ def test_jax_embedder_in_pipeline():
     matches = rows[0]["result"].value
     assert len(matches) == 1
     assert "systolic" in matches[0]["text"]
+
+
+def test_geometric_rag_from_index_escalates():
+    """The direct path retrieves max docs ONCE and escalates locally
+    (reference question_answering.py:153): the fake chat needs 2 docs, so
+    calls go 1 -> 2 with a single retrieval behind them."""
+    from pathway_tpu.xpacks.llm.question_answering import (
+        answer_with_geometric_rag_strategy_from_index)
+
+    store = VectorStoreServer(_docs_table(), embedder=fake_embedder)
+    chat = FakeChat(min_docs=2)
+    queries = table_from_rows(
+        sch.schema_from_types(prompt=str), [("quick brown fox",)])
+    answer = answer_with_geometric_rag_strategy_from_index(
+        queries.prompt, store.index, "text", chat,
+        n_starting_documents=1, factor=2, max_iterations=3)
+    rows = _result_rows(answer.table)
+    assert rows[0]["answer"] == "answer from 2 docs"
+    assert chat.calls == [1, 2]
+
+
+def test_geometric_rag_from_index_returns_none_when_unanswerable():
+    from pathway_tpu.xpacks.llm.question_answering import (
+        answer_with_geometric_rag_strategy_from_index)
+
+    store = VectorStoreServer(_docs_table(), embedder=fake_embedder)
+    chat = FakeChat(min_docs=100)  # never satisfied
+    queries = table_from_rows(
+        sch.schema_from_types(prompt=str), [("quick brown fox",)])
+    answer = answer_with_geometric_rag_strategy_from_index(
+        queries.prompt, store.index, "text", chat,
+        n_starting_documents=2, factor=2, max_iterations=2)
+    rows = _result_rows(answer.table)
+    assert rows[0]["answer"] is None
+    # escalation 2 -> 4, capped by the 3 retrievable docs
+    assert chat.calls == [2, 3]

@@ -1,0 +1,110 @@
+"""stdlib.viz.plot — live Bokeh plotting (reference stdlib/viz/plotting.py).
+
+Bokeh is not in this image, so the tests install a minimal stub that
+mimics the ColumnDataSource.stream(rollover=...) contract and assert the
+plot path drives it: immediately for static tables, after every closed
+timestamp for streaming ones."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+class FakeColumnDataSource:
+    def __init__(self, data=None):
+        self.data = dict(data or {})
+        self.streams = []  # (data, rollover)
+
+    def stream(self, new_data, rollover=None):
+        # real bokeh semantics: append, then trim to the LAST `rollover`
+        # items (rollover=0 trims nothing — which is why the viz path must
+        # clear by assignment, not by streaming an empty update)
+        self.streams.append((dict(new_data), rollover))
+        for k, v in new_data.items():
+            self.data.setdefault(k, []).extend(v)
+        if rollover:
+            for k in self.data:
+                self.data[k] = self.data[k][-rollover:]
+
+
+class FakeFigure:
+    document = None
+
+    def scatter(self, *a, **kw):
+        pass
+
+
+@pytest.fixture()
+def bokeh_stub(monkeypatch):
+    bokeh = types.ModuleType("bokeh")
+    models = types.ModuleType("bokeh.models")
+    plotting = types.ModuleType("bokeh.plotting")
+    models.ColumnDataSource = FakeColumnDataSource
+    plotting.figure = lambda **kw: FakeFigure()
+    bokeh.models = models
+    bokeh.plotting = plotting
+    monkeypatch.setitem(sys.modules, "bokeh", bokeh)
+    monkeypatch.setitem(sys.modules, "bokeh.models", models)
+    monkeypatch.setitem(sys.modules, "bokeh.plotting", plotting)
+    yield
+    G.clear()
+
+
+def test_plot_static_table_fills_source_immediately(bokeh_stub):
+    G.clear()
+    t = pw.debug.table_from_markdown("""
+    x | y
+    1 | 10
+    3 | 30
+    2 | 20
+    """)
+    captured = {}
+
+    def plotting_function(source):
+        captured["source"] = source
+        return FakeFigure()
+
+    t.plot(plotting_function, sorting_col="x")
+    src = captured["source"]
+    assert src.data == {"x": [1, 2, 3], "y": [10, 20, 30]}
+    assert src.streams[-1][1] == 3  # rollover == live row count
+
+
+def test_plot_streaming_table_updates_source_per_tick(bokeh_stub):
+    G.clear()
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(x=1, y=10)
+            time.sleep(0.3)
+            self.next(x=2, y=20)
+            time.sleep(2.0)
+
+    t = pw.io.python.read(
+        Subject(), schema=pw.schema_from_types(x=int, y=int),
+        autocommit_duration_ms=30)
+    captured = {}
+
+    def plotting_function(source):
+        captured["source"] = source
+        return FakeFigure()
+
+    t.plot(plotting_function, sorting_col="x")
+    threading.Thread(target=lambda: pw.run(), daemon=True).start()
+    src = captured["source"]
+    deadline = time.time() + 10
+    while time.time() < deadline and src.data.get("x") != [1, 2]:
+        time.sleep(0.05)
+    assert src.data == {"x": [1, 2], "y": [10, 20]}
+    assert len(src.streams) >= 2  # one update per closed timestamp
+    from pathway_tpu.engine import streaming
+
+    streaming.stop_all()
