@@ -1,0 +1,482 @@
+// HNSW approximate-nearest-neighbour index (plain-C ABI for ctypes).
+//
+// The tpu-native counterpart of the reference's USearch integration
+// (src/external_integration/usearch_integration.rs:20 — USearchKNN over
+// HNSW): add/remove/search with l2sq / cosine / inner-product metrics,
+// plus byte-buffer save/load for persistence. Algorithm per Malkov &
+// Yashunin (2016): multi-layer skip-list-like graph, greedy descent from
+// the top layer, best-first beam (ef) at the target layer, closest-M
+// neighbour selection with reverse-link pruning. Removals are soft
+// (tombstones filtered from results, still traversable as routing nodes —
+// the usearch approach).
+//
+// Built on demand by pathway_tpu/native/build.py; consumed by
+// pathway_tpu/ops/hnsw.py through ctypes.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Metric { L2SQ = 0, COS = 1, IP = 2 };
+
+struct Hnsw {
+  int dim;
+  int metric;
+  int M;               // neighbours per node per layer (2M at layer 0)
+  int ef_construction;
+  double mult;         // level multiplier 1/ln(M)
+  std::mt19937_64 rng;
+
+  std::vector<float> vecs;            // slot-major storage
+  std::vector<float> norms;           // per-slot L2 norm (cos metric)
+  std::vector<uint64_t> ids;          // slot -> external id
+  std::vector<uint8_t> deleted;       // soft-delete tombstones
+  std::vector<int> levels;            // slot -> top layer
+  // links[slot] = concatenated fixed-size neighbour blocks per layer:
+  // layer l block at offset l*(cap_l) entries; -1 padding
+  std::vector<std::vector<int32_t>> links;
+  std::unordered_map<uint64_t, int> by_id;
+  int entry = -1;
+  int max_level = -1;
+  int64_t live = 0;
+
+  int cap(int layer) const { return layer == 0 ? 2 * M : M; }
+
+  float dist(const float* a, float na, const float* b, float nb) const {
+    float acc = 0.f;
+    if (metric == L2SQ) {
+      for (int i = 0; i < dim; i++) {
+        float d = a[i] - b[i];
+        acc += d * d;
+      }
+      return acc;
+    }
+    for (int i = 0; i < dim; i++) acc += a[i] * b[i];
+    if (metric == IP) return 1.f - acc;
+    float denom = na * nb;
+    return denom > 0.f ? 1.f - acc / denom : 1.f;
+  }
+
+  const float* vec(int s) const { return vecs.data() + (size_t)s * dim; }
+
+  float dist_to(const float* q, float qn, int s) const {
+    return dist(q, qn, vec(s), norms[s]);
+  }
+
+  // best-first beam search on one layer; returns (dist, slot) max-heap
+  // trimmed to ef
+  void search_layer(const float* q, float qn, int ep, int layer, int ef,
+                    std::vector<std::pair<float, int>>& out,
+                    std::vector<uint32_t>& visited,
+                    uint32_t stamp) const {
+    std::priority_queue<std::pair<float, int>> best;        // worst on top
+    std::priority_queue<std::pair<float, int>,
+                        std::vector<std::pair<float, int>>,
+                        std::greater<>> cand;               // closest on top
+    float d0 = dist_to(q, qn, ep);
+    best.emplace(d0, ep);
+    cand.emplace(d0, ep);
+    visited[ep] = stamp;
+    while (!cand.empty()) {
+      auto [dc, c] = cand.top();
+      if (dc > best.top().first && (int)best.size() >= ef) break;
+      cand.pop();
+      const int32_t* nb = links[c].data() + (size_t)layer_off(c, layer);
+      int n = cap(layer);
+      for (int i = 0; i < n; i++) {
+        int v = nb[i];
+        if (v < 0) break;
+        if (visited[v] == stamp) continue;
+        visited[v] = stamp;
+        float d = dist_to(q, qn, v);
+        if ((int)best.size() < ef || d < best.top().first) {
+          best.emplace(d, v);
+          cand.emplace(d, v);
+          if ((int)best.size() > ef) best.pop();
+        }
+      }
+    }
+    out.clear();
+    out.reserve(best.size());
+    while (!best.empty()) {
+      out.push_back(best.top());
+      best.pop();
+    }
+    std::reverse(out.begin(), out.end());  // closest first
+  }
+
+  size_t layer_off(int slot, int layer) const {
+    // layer 0 block is 2M wide; layers >= 1 are M wide
+    return layer == 0 ? 0 : (size_t)(2 * M + (layer - 1) * M);
+  }
+
+  // heuristic neighbour selection (paper Algorithm 4): a candidate joins
+  // only if it is closer to the base point than to every already-selected
+  // neighbour — this keeps long-range links that make the graph navigable
+  // (plain closest-M clusters and costs ~15pp of recall on hard data)
+  void select_heuristic(const std::vector<std::pair<float, int>>& cands,
+                        int m, std::vector<int>& out) const {
+    out.clear();
+    for (auto& [d, c] : cands) {
+      if ((int)out.size() >= m) break;
+      bool ok = true;
+      const float* cv = vec(c);
+      float cn = norms[c];
+      for (int s : out) {
+        if (dist(cv, cn, vec(s), norms[s]) < d) {
+          ok = false;
+          break;
+        }
+      }
+      if (ok) out.push_back(c);
+    }
+    // backfill with closest remaining so degree stays near m
+    if ((int)out.size() < m) {
+      for (auto& [d, c] : cands) {
+        if ((int)out.size() >= m) break;
+        if (std::find(out.begin(), out.end(), c) == out.end())
+          out.push_back(c);
+      }
+    }
+  }
+
+  void connect(int slot, int layer,
+               const std::vector<std::pair<float, int>>& cands) {
+    int m = cap(layer);
+    std::vector<std::pair<float, int>> pool;
+    pool.reserve(cands.size());
+    for (auto& pr : cands)
+      if (pr.second != slot) pool.push_back(pr);
+    std::vector<int> sel;
+    select_heuristic(pool, m, sel);
+    int32_t* nb = links[slot].data() + layer_off(slot, layer);
+    int n = (int)sel.size();
+    for (int i = 0; i < n; i++) nb[i] = sel[i];
+    for (int i = n; i < m; i++) nb[i] = -1;
+    // reverse links; prune overfull neighbours with the same heuristic
+    for (int i = 0; i < n; i++) {
+      int c = sel[i];
+      int32_t* cb = links[c].data() + layer_off(c, layer);
+      int cn = 0;
+      while (cn < m && cb[cn] >= 0) cn++;
+      if (cn < m) {
+        cb[cn] = slot;
+        continue;
+      }
+      std::vector<std::pair<float, int>> rp;
+      rp.reserve(cn + 1);
+      const float* cv = vec(c);
+      float cnorm = norms[c];
+      for (int j = 0; j < cn; j++)
+        rp.emplace_back(dist(cv, cnorm, vec(cb[j]), norms[cb[j]]), cb[j]);
+      rp.emplace_back(dist(cv, cnorm, vec(slot), norms[slot]), slot);
+      std::sort(rp.begin(), rp.end());
+      std::vector<int> rsel;
+      select_heuristic(rp, m, rsel);
+      int rn = (int)rsel.size();
+      for (int j = 0; j < rn; j++) cb[j] = rsel[j];
+      for (int j = rn; j < m; j++) cb[j] = -1;
+    }
+  }
+
+  std::vector<uint32_t> visited_;
+  uint32_t stamp_ = 0;
+
+  int add(uint64_t id, const float* v) {
+    auto it = by_id.find(id);
+    int slot;
+    if (it != by_id.end()) {
+      int old = it->second;
+      if (!deleted[old] &&
+          std::memcmp(vec(old), v, sizeof(float) * dim) == 0)
+        return 0;  // identical upsert: nothing to do
+      // the graph was linked for the OLD vector — relinking in place is
+      // not possible without a rebuild, so tombstone the old node and
+      // insert a freshly-linked one (streaming re-embeds must not erode
+      // recall; slots are append-only like usearch's soft deletes)
+      if (!deleted[old]) {
+        deleted[old] = 1;
+        live--;
+      }
+      by_id.erase(it);
+    }
+    slot = (int)ids.size();
+    ids.push_back(id);
+    deleted.push_back(0);
+    vecs.insert(vecs.end(), v, v + dim);
+    norms.push_back(l2(v));
+    std::exponential_distribution<double> ed(1.0);
+    int level = (int)(ed(rng) * mult);
+    levels.push_back(level);
+    links.emplace_back((size_t)(2 * M + (size_t)std::max(level, 0) * M),
+                       -1);
+    by_id.emplace(id, slot);
+    visited_.push_back(0);
+    live++;
+
+    if (entry < 0) {
+      entry = slot;
+      max_level = level;
+      return 0;
+    }
+    const float* q = v;
+    float qn = norms[slot];
+    int ep = entry;
+    // greedy descent through layers above the node's level
+    for (int l = max_level; l > level; l--) {
+      bool moved = true;
+      float de = dist_to(q, qn, ep);
+      while (moved) {
+        moved = false;
+        const int32_t* nb = links[ep].data() + layer_off(ep, l);
+        int n = cap(l);
+        for (int i = 0; i < n; i++) {
+          int u = nb[i];
+          if (u < 0) break;
+          float d = dist_to(q, qn, u);
+          if (d < de) {
+            de = d;
+            ep = u;
+            moved = true;
+          }
+        }
+      }
+    }
+    std::vector<std::pair<float, int>> cands;
+    for (int l = std::min(level, max_level); l >= 0; l--) {
+      if (++stamp_ == 0) {
+        std::fill(visited_.begin(), visited_.end(), 0);
+        stamp_ = 1;
+      }
+      search_layer(q, qn, ep, l, ef_construction, cands, visited_, stamp_);
+      connect(slot, l, cands);
+      if (!cands.empty()) ep = cands.front().second;
+    }
+    if (level > max_level) {
+      max_level = level;
+      entry = slot;
+    }
+    return 0;
+  }
+
+  float l2(const float* v) const {
+    float acc = 0.f;
+    for (int i = 0; i < dim; i++) acc += v[i] * v[i];
+    return std::sqrt(acc);
+  }
+
+  int remove(uint64_t id) {
+    auto it = by_id.find(id);
+    if (it == by_id.end() || deleted[it->second]) return -1;
+    deleted[it->second] = 1;
+    live--;
+    return 0;
+  }
+
+  int search(const float* q, int k, int ef, uint64_t* out_ids,
+             float* out_d) {
+    if (entry < 0 || live == 0) return 0;
+    float qn = l2(q);
+    int ep = entry;
+    for (int l = max_level; l > 0; l--) {
+      bool moved = true;
+      float de = dist_to(q, qn, ep);
+      while (moved) {
+        moved = false;
+        const int32_t* nb = links[ep].data() + layer_off(ep, l);
+        int n = cap(l);
+        for (int i = 0; i < n; i++) {
+          int u = nb[i];
+          if (u < 0) break;
+          float d = dist_to(q, qn, u);
+          if (d < de) {
+            de = d;
+            ep = u;
+            moved = true;
+          }
+        }
+      }
+    }
+    if (++stamp_ == 0) {
+      std::fill(visited_.begin(), visited_.end(), 0);
+      stamp_ = 1;
+    }
+    std::vector<std::pair<float, int>> cands;
+    search_layer(q, qn, ep, 0, std::max(ef, k), cands, visited_, stamp_);
+    int n = 0;
+    for (auto& [d, s] : cands) {
+      if (deleted[s]) continue;
+      out_ids[n] = ids[s];
+      out_d[n] = d;
+      if (++n >= k) break;
+    }
+    return n;
+  }
+};
+
+template <class T>
+static void put(std::vector<char>& b, const T& v) {
+  const char* p = reinterpret_cast<const char*>(&v);
+  b.insert(b.end(), p, p + sizeof(T));
+}
+
+template <class T>
+static T take(const char*& p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  p += sizeof(T);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* hnsw_create(int dim, int metric, int M, int ef_construction,
+                  unsigned long long seed) {
+  auto* h = new Hnsw();
+  h->dim = dim;
+  h->metric = metric;
+  h->M = M > 1 ? M : 16;
+  h->ef_construction = ef_construction > 0 ? ef_construction : 128;
+  h->mult = 1.0 / std::log((double)h->M);
+  h->rng.seed(seed ? seed : 0x9E3779B97F4A7C15ULL);
+  return h;
+}
+
+void hnsw_free(void* h) { delete static_cast<Hnsw*>(h); }
+
+int hnsw_add(void* h, unsigned long long id, const float* vec) {
+  return static_cast<Hnsw*>(h)->add(id, vec);
+}
+
+int hnsw_remove(void* h, unsigned long long id) {
+  return static_cast<Hnsw*>(h)->remove(id);
+}
+
+int hnsw_search(void* h, const float* q, int k, int ef,
+                unsigned long long* out_ids, float* out_d) {
+  return static_cast<Hnsw*>(h)->search(
+      q, k, ef, reinterpret_cast<uint64_t*>(out_ids), out_d);
+}
+
+long long hnsw_size(void* h) { return static_cast<Hnsw*>(h)->live; }
+
+// ---- persistence: versioned flat byte buffer ------------------------------
+
+long long hnsw_save_size(void* hp) {
+  auto* h = static_cast<Hnsw*>(hp);
+  size_t n = h->ids.size();
+  size_t links_bytes = 0;
+  for (auto& l : h->links) links_bytes += 8 + l.size() * 4;
+  return (long long)(64 + n * (8 + 1 + 4 + 4) +
+                     h->vecs.size() * 4 + links_bytes);
+}
+
+long long hnsw_save(void* hp, char* out, long long cap_bytes) {
+  auto* h = static_cast<Hnsw*>(hp);
+  std::vector<char> b;
+  b.reserve((size_t)cap_bytes);
+  put<uint32_t>(b, 0x484E5357u);  // 'HNSW'
+  put<uint32_t>(b, 1u);           // version
+  put<int32_t>(b, h->dim);
+  put<int32_t>(b, h->metric);
+  put<int32_t>(b, h->M);
+  put<int32_t>(b, h->ef_construction);
+  put<int32_t>(b, h->entry);
+  put<int32_t>(b, h->max_level);
+  put<int64_t>(b, h->live);
+  uint64_t n = h->ids.size();
+  put<uint64_t>(b, n);
+  for (uint64_t i = 0; i < n; i++) {
+    put<uint64_t>(b, h->ids[i]);
+    put<uint8_t>(b, h->deleted[i]);
+    put<int32_t>(b, h->levels[i]);
+    put<float>(b, h->norms[i]);
+  }
+  const char* vp = reinterpret_cast<const char*>(h->vecs.data());
+  b.insert(b.end(), vp, vp + h->vecs.size() * 4);
+  for (auto& l : h->links) {
+    put<uint64_t>(b, (uint64_t)l.size());
+    const char* lp = reinterpret_cast<const char*>(l.data());
+    b.insert(b.end(), lp, lp + l.size() * 4);
+  }
+  if ((long long)b.size() > cap_bytes) return -1;
+  std::memcpy(out, b.data(), b.size());
+  return (long long)b.size();  // exact size — callers must not keep slack
+}
+
+void* hnsw_load(const char* p, long long len) {
+  // every read is bounds-checked against `remaining` (never by pointer
+  // arithmetic that could overflow): a truncated/corrupt blob must come
+  // back nullptr, not an out-of-bounds read
+  const char* end = p + len;
+  auto remaining = [&]() -> uint64_t { return (uint64_t)(end - p); };
+  if (len < 48 || take<uint32_t>(p) != 0x484E5357u) return nullptr;
+  if (take<uint32_t>(p) != 1u) return nullptr;
+  int dim = take<int32_t>(p);
+  int metric = take<int32_t>(p);
+  int M = take<int32_t>(p);
+  int efc = take<int32_t>(p);
+  if (dim <= 0 || dim > (1 << 20) || M <= 0 || M > (1 << 16))
+    return nullptr;
+  auto* h = static_cast<Hnsw*>(hnsw_create(dim, metric, M, efc, 1));
+  h->entry = take<int32_t>(p);
+  h->max_level = take<int32_t>(p);
+  h->live = take<int64_t>(p);
+  uint64_t n = take<uint64_t>(p);
+  const uint64_t kRec = 8 + 1 + 4 + 4;
+  if (n > remaining() / kRec) {  // metadata section must fit
+    delete h;
+    return nullptr;
+  }
+  h->ids.resize(n);
+  h->deleted.resize(n);
+  h->levels.resize(n);
+  h->norms.resize(n);
+  h->visited_.assign(n, 0);
+  for (uint64_t i = 0; i < n; i++) {
+    h->ids[i] = take<uint64_t>(p);
+    h->deleted[i] = take<uint8_t>(p);
+    h->levels[i] = take<int32_t>(p);
+    h->norms[i] = take<float>(p);
+    h->by_id.emplace(h->ids[i], (int)i);
+  }
+  uint64_t vbytes = n * (uint64_t)dim * 4;
+  if (n != 0 && vbytes / n != (uint64_t)dim * 4) {  // multiply overflow
+    delete h;
+    return nullptr;
+  }
+  if (vbytes > remaining()) {
+    delete h;
+    return nullptr;
+  }
+  h->vecs.resize((size_t)n * dim);
+  std::memcpy(h->vecs.data(), p, vbytes);
+  p += vbytes;
+  h->links.resize(n);
+  for (uint64_t i = 0; i < n; i++) {
+    if (remaining() < 8) {
+      delete h;
+      return nullptr;
+    }
+    uint64_t ln = take<uint64_t>(p);
+    if (ln > remaining() / 4) {
+      delete h;
+      return nullptr;
+    }
+    h->links[i].resize(ln);
+    std::memcpy(h->links[i].data(), p, ln * 4);
+    p += ln * 4;
+  }
+  return h;
+}
+
+}  // extern "C"

@@ -1,0 +1,244 @@
+"""HNSW approximate KNN index over the native engine (native/hnsw_index.cpp).
+
+The reference integrates USearch's HNSW for sublinear CPU search
+(src/external_integration/usearch_integration.rs:20). Here the same role is
+filled by an in-repo C++ HNSW consumed through ctypes: sublinear
+add/remove/search for corpora that outgrow one chip's HBM slab or for
+CPU-only deployments, with byte-exact save/load for persistence.
+Implements the engine external-index protocol (engine/index_ops.py):
+add / add_batch / remove / search / __len__.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.ops.knn import KnnMetric
+
+_METRIC_CODE = {KnnMetric.L2SQ: 0, KnnMetric.COS: 1}
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            from pathway_tpu.native.build import ensure_built
+
+            lib = ctypes.CDLL(ensure_built("hnsw_index"))
+            lib.hnsw_create.restype = ctypes.c_void_p
+            lib.hnsw_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                        ctypes.c_int, ctypes.c_int,
+                                        ctypes.c_uint64]
+            lib.hnsw_free.argtypes = [ctypes.c_void_p]
+            lib.hnsw_add.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                     ctypes.POINTER(ctypes.c_float)]
+            lib.hnsw_remove.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.hnsw_search.restype = ctypes.c_int
+            lib.hnsw_search.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_float)]
+            lib.hnsw_size.restype = ctypes.c_longlong
+            lib.hnsw_size.argtypes = [ctypes.c_void_p]
+            lib.hnsw_save_size.restype = ctypes.c_longlong
+            lib.hnsw_save_size.argtypes = [ctypes.c_void_p]
+            lib.hnsw_save.restype = ctypes.c_longlong
+            lib.hnsw_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_longlong]
+            lib.hnsw_load.restype = ctypes.c_void_p
+            lib.hnsw_load.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+            _LIB = lib
+        return _LIB
+
+
+class HnswIndex:
+    """HNSW index with the engine external-index protocol.
+
+    ``connectivity`` / ``expansion_add`` / ``expansion_search`` follow the
+    usearch parameter names the reference exposes. The 64-bit external id
+    is the Pointer's low word; the full 128-bit Pointer is kept host-side
+    (collisions on the low word are astronomically unlikely and detected
+    at add time)."""
+
+    def __init__(self, dimensions: int, *,
+                 metric: KnnMetric = KnnMetric.COS,
+                 connectivity: int = 16,
+                 expansion_add: int = 128,
+                 expansion_search: int = 192,
+                 seed: int = 7):
+        if metric not in _METRIC_CODE:
+            raise ValueError(f"unsupported HNSW metric: {metric}")
+        self.dimensions = int(dimensions)
+        self.metric = metric
+        self.connectivity = int(connectivity) or 16
+        self.expansion_add = int(expansion_add) or 128
+        self.expansion_search = int(expansion_search) or 192
+        self._seed = seed
+        self._lock = threading.RLock()
+        self._h = _lib().hnsw_create(
+            self.dimensions, _METRIC_CODE[metric], self.connectivity,
+            self.expansion_add, seed)
+        self._keys: dict[int, Pointer] = {}     # low64 -> full pointer
+        self._filters: dict[Pointer, Any] = {}
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h and _LIB is not None:
+            _LIB.hnsw_free(h)
+            self._h = None
+
+    # -- engine protocol -----------------------------------------------------
+    def __len__(self) -> int:
+        return int(_lib().hnsw_size(self._h))
+
+    def add(self, key: Pointer, vector: Any,
+            filter_data: Any | None = None) -> None:
+        with self._lock:
+            low = key.lo if isinstance(key, Pointer) else \
+                int(key) & 0xFFFFFFFFFFFFFFFF
+            cur = self._keys.get(low)
+            if cur is not None and cur != key:
+                raise ValueError(
+                    f"HNSW 64-bit id collision between {cur!r} and {key!r}")
+            v = np.ascontiguousarray(
+                np.asarray(vector, dtype=np.float32).reshape(-1))
+            if v.shape[0] != self.dimensions:
+                raise ValueError(
+                    f"vector has dim {v.shape[0]}, index dim "
+                    f"{self.dimensions}")
+            _lib().hnsw_add(
+                self._h, low, v.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)))
+            self._keys[low] = key
+            if filter_data is not None:
+                self._filters[key] = filter_data
+            else:
+                self._filters.pop(key, None)
+
+    def add_batch(self, keys, vectors, filter_datas=None) -> None:
+        filter_datas = filter_datas or [None] * len(keys)
+        for key, vec, filt in zip(keys, vectors, filter_datas):
+            self.add(key, vec, filt)
+
+    def remove(self, key: Pointer) -> None:
+        with self._lock:
+            low = key.lo if isinstance(key, Pointer) else \
+                int(key) & 0xFFFFFFFFFFFFFFFF
+            _lib().hnsw_remove(self._h, low)
+            self._filters.pop(key, None)
+
+    def _passes_filter(self, key: Pointer, filt) -> bool:
+        data = self._filters.get(key)
+        if callable(filt):
+            try:
+                return bool(filt(data))
+            except Exception:
+                return False
+        from pathway_tpu.internals.jmespath_lite import evaluate_filter
+
+        return evaluate_filter(filt, data)
+
+    def search(self, queries: list[tuple]) -> list[tuple]:
+        """[(qkey, vector, limit, filter)] -> per query ((key, dist), ...)
+        best first; distances follow the engine convention (l2sq, or
+        cosine distance 1-cos)."""
+        if not queries:
+            return []
+        lib = _lib()
+        out = []
+        with self._lock:
+            n_live = len(self)
+            for _qkey, qvec, limit, filt in queries:
+                k = int(limit or 3)
+                if n_live == 0:
+                    out.append(())
+                    continue
+                q = np.ascontiguousarray(
+                    np.asarray(qvec, dtype=np.float32).reshape(-1))
+                ef = max(self.expansion_search, k * 2)
+                fetch = k if filt is None else min(n_live, k * 4)
+                matches: list[tuple] = []
+                while True:
+                    cap = max(fetch, 1)
+                    ids = np.empty(cap, np.uint64)
+                    dists = np.empty(cap, np.float32)
+                    got = lib.hnsw_search(
+                        self._h, q.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_float)),
+                        cap, max(ef, cap),
+                        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                        dists.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_float)))
+                    matches = []
+                    for i in range(got):
+                        key = self._keys.get(int(ids[i]))
+                        if key is None:
+                            continue
+                        if filt is not None and not self._passes_filter(
+                                key, filt):
+                            continue
+                        matches.append((key, float(dists[i])))
+                        if len(matches) >= k:
+                            break
+                    if len(matches) >= k or filt is None or fetch >= n_live:
+                        break
+                    fetch = min(n_live, fetch * 4)  # selective filter
+                out.append(tuple(matches))
+        return out
+
+    # -- persistence ---------------------------------------------------------
+    def save_bytes(self) -> bytes:
+        with self._lock:
+            lib = _lib()
+            size = int(lib.hnsw_save_size(self._h))
+            buf = ctypes.create_string_buffer(size)
+            written = int(lib.hnsw_save(self._h, buf, size))
+            if written < 0:
+                raise RuntimeError("hnsw save failed")
+            import pickle
+
+            side = pickle.dumps((self._keys, self._filters,
+                                 self.dimensions, self.metric.name,
+                                 self.connectivity, self.expansion_add,
+                                 self.expansion_search))
+            return (len(side).to_bytes(8, "little") + side
+                    + buf.raw[:written])
+
+    @classmethod
+    def load_bytes(cls, blob: bytes) -> "HnswIndex":
+        import pickle
+
+        try:
+            side_len = int.from_bytes(blob[:8], "little")
+            if side_len <= 0 or 8 + side_len > len(blob):
+                raise ValueError("side channel extends past the blob")
+            (keys, filters, dim, metric_name, conn, efa, efs) = pickle.loads(
+                blob[8:8 + side_len])
+        except Exception as e:
+            raise RuntimeError(f"hnsw load failed: corrupt blob ({e})") \
+                from e
+        graph = blob[8 + side_len:]
+        self = cls.__new__(cls)
+        self.dimensions = dim
+        self.metric = KnnMetric[metric_name]
+        self.connectivity = conn
+        self.expansion_add = efa
+        self.expansion_search = efs
+        self._seed = 7
+        self._lock = threading.RLock()
+        h = _lib().hnsw_load(graph, len(graph))
+        if not h:
+            raise RuntimeError("hnsw load failed: corrupt buffer")
+        self._h = h
+        self._keys = keys
+        self._filters = filters
+        return self

@@ -103,9 +103,41 @@ class BruteForceKnn(InnerIndex):
         return self.embedder
 
 
+@dataclass
+class UsearchEngineIndexFactory:
+    """Engine-side factory building the native HNSW
+    (native/hnsw_index.cpp; reference: usearch_integration.rs:20
+    USearchKNNIndexFactory). Sublinear search for corpora beyond one
+    chip's HBM or CPU-only deployments; the TPU slab (BruteForceKnn)
+    remains the exact fast path at in-HBM scales. (Named distinctly from
+    retrievers.UsearchKnnFactory, the user-facing retriever factory.)"""
+
+    dimensions: int | None = None
+    reserved_space: int = 1024
+    metric: KnnMetric = KnnMetric.COS
+    connectivity: int = 16
+    expansion_add: int = 128
+    expansion_search: int = 192
+    embedder: Any = None
+
+    def build(self):
+        from pathway_tpu.ops.hnsw import HnswIndex
+
+        dim = self.dimensions
+        if dim is None:
+            dim = _probe_embedder_dimension(self.embedder)
+        return HnswIndex(
+            dim, metric=self.metric,
+            connectivity=self.connectivity or 16,
+            expansion_add=self.expansion_add or 128,
+            expansion_search=self.expansion_search or 192)
+
+
 class USearchKnn(BruteForceKnn):
-    """API-compatible with the reference's USearchKnn (HNSW); executes as the
-    exact TPU scan (recall = 1.0 by construction)."""
+    """The reference's USearchKnn: a REAL HNSW index (native C++ engine,
+    native/hnsw_index.cpp) — approximate, sublinear search with the
+    usearch parameter surface (connectivity / expansion_add /
+    expansion_search)."""
 
     def __init__(self, data_column, metadata_column=None, *, dimensions=None,
                  reserved_space: int = 1024, metric=KnnMetric.COS,
@@ -117,6 +149,16 @@ class USearchKnn(BruteForceKnn):
         super().__init__(data_column, metadata_column, dimensions=dimensions,
                          reserved_space=reserved_space, metric=metric,
                          embedder=embedder)
+        self.connectivity = connectivity
+        self.expansion_add = expansion_add
+        self.expansion_search = expansion_search
+
+    def factory(self) -> UsearchEngineIndexFactory:
+        return UsearchEngineIndexFactory(
+            dimensions=self.dimensions, reserved_space=self.reserved_space,
+            metric=self.metric, connectivity=self.connectivity,
+            expansion_add=self.expansion_add,
+            expansion_search=self.expansion_search, embedder=self.embedder)
 
 
 class LshKnn(BruteForceKnn):

@@ -51,6 +51,9 @@ class UsearchKnnFactory(AbstractRetrieverFactory):
         inner = USearchKnn(
             data_column, metadata_column, dimensions=self.dimensions,
             reserved_space=self.reserved_space, metric=self.metric,
+            connectivity=self.connectivity,
+            expansion_add=self.expansion_add,
+            expansion_search=self.expansion_search,
             embedder=self.embedder)
         return DataIndex(data_table, inner)
 

@@ -1,0 +1,159 @@
+"""Native HNSW index (native/hnsw_index.cpp via ops/hnsw.py) — the real
+USearchKnn backend (reference: usearch_integration.rs:20).
+
+Pins: recall@10 >= 0.95 vs the exact scan, add/remove/upsert semantics,
+metadata filters, save/load byte-buffer persistence, and the DataIndex
+pipeline wiring."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.ops.hnsw import HnswIndex
+from pathway_tpu.ops.knn import KnnMetric
+
+N, D = 8000, 32
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(N, D)).astype(np.float32)
+    index = HnswIndex(D, metric=KnnMetric.COS)
+    for i in range(N):
+        index.add(Pointer(i), data[i])
+    return data, index
+
+
+def test_recall_at_10_vs_exact(corpus):
+    data, index = corpus
+    rng = np.random.default_rng(11)
+    queries = rng.normal(size=(50, D)).astype(np.float32)
+    norms = np.linalg.norm(data, axis=1)
+    res = index.search(
+        [(Pointer(10**6 + i), queries[i], 10, None) for i in range(50)])
+    hits = 0
+    for i in range(50):
+        sims = data @ queries[i] / (norms * np.linalg.norm(queries[i]))
+        exact = set(np.argsort(-sims)[:10].tolist())
+        hits += len({int(k) for k, _d in res[i]} & exact)
+    recall = hits / 500
+    assert recall >= 0.95, f"recall@10 = {recall}"
+
+
+def test_distances_match_cosine_convention(corpus):
+    data, index = corpus
+    [matches] = index.search([(Pointer(10**6), data[5], 1, None)])
+    key, dist = matches[0]
+    assert key == Pointer(5) and dist < 1e-5  # self-match, 1 - cos = 0
+
+
+def test_remove_and_upsert():
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(200, 16)).astype(np.float32)
+    idx = HnswIndex(16, metric=KnnMetric.L2SQ)
+    for i in range(200):
+        idx.add(Pointer(i), data[i])
+    assert len(idx) == 200
+    idx.remove(Pointer(7))
+    assert len(idx) == 199
+    [m] = idx.search([(Pointer(999), data[7], 5, None)])
+    assert Pointer(7) not in {k for k, _ in m}
+    # upsert resurrects with the new vector
+    idx.add(Pointer(7), data[8])
+    [m2] = idx.search([(Pointer(999), data[8], 2, None)])
+    assert {k for k, _ in m2} >= {Pointer(7), Pointer(8)}
+
+
+def test_metadata_filter_escalates():
+    rng = np.random.default_rng(2)
+    data = rng.normal(size=(300, 16)).astype(np.float32)
+    idx = HnswIndex(16, metric=KnnMetric.COS)
+    for i in range(300):
+        idx.add(Pointer(i), data[i],
+                filter_data={"path": f"/{'even' if i % 2 == 0 else 'odd'}"})
+    [m] = idx.search([
+        (Pointer(999), data[0], 8, lambda d: d["path"] == "/odd")])
+    assert len(m) == 8
+    assert all(int(k) % 2 == 1 for k, _ in m)
+
+
+def test_save_load_roundtrip(corpus):
+    data, index = corpus
+    blob = index.save_bytes()
+    restored = HnswIndex.load_bytes(blob)
+    assert len(restored) == len(index)
+    q = data[17]
+    [a] = index.search([(Pointer(999), q, 10, None)])
+    [b] = restored.search([(Pointer(999), q, 10, None)])
+    assert [int(k) for k, _ in a] == [int(k) for k, _ in b]
+
+
+def test_usearch_knn_pipeline_uses_hnsw():
+    """USearchKnn in a DataIndex pipeline is served by the native HNSW."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.ops.hnsw import HnswIndex as _H
+    from pathway_tpu.stdlib.indexing import DataIndex
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import USearchKnn
+
+    G.clear()
+    rng = np.random.default_rng(5)
+    vecs = rng.normal(size=(30, 8)).astype(np.float32)
+    docs = table_from_rows(
+        sch.schema_from_types(vec=np.ndarray, label=str),
+        [(vecs[i], f"doc{i}") for i in range(30)])
+    inner = USearchKnn(docs.vec, dimensions=8, metric="cos")
+    assert isinstance(inner.factory().build(), _H)
+    index = DataIndex(docs, inner)
+    queries = table_from_rows(
+        sch.schema_from_types(qvec=np.ndarray), [(vecs[3],)])
+    res = index.query(queries.qvec, number_of_matches=1,
+                      collapse_rows=False).select(label=pw.this.label)
+    from pathway_tpu.internals.runner import run_tables
+
+    [cap] = run_tables(res)
+    labels = [r[0] for r in cap.snapshot().values()]
+    assert labels == ["doc3"]
+    G.clear()
+
+
+def test_recall_survives_full_reembed_cycle():
+    """Streaming updates (remove + re-add with a NEW vector, the engine's
+    normal diff flow) must not erode recall: upserts relink the graph
+    rather than patching vectors in place."""
+    rng = np.random.default_rng(9)
+    n, d = 3000, 24
+    data = rng.normal(size=(n, d)).astype(np.float32)
+    idx = HnswIndex(d, metric=KnnMetric.COS)
+    for i in range(n):
+        idx.add(Pointer(i), data[i])
+    # re-embed every row (new random vectors), via remove+add
+    data2 = rng.normal(size=(n, d)).astype(np.float32)
+    for i in range(n):
+        idx.remove(Pointer(i))
+        idx.add(Pointer(i), data2[i])
+    assert len(idx) == n
+    queries = rng.normal(size=(30, d)).astype(np.float32)
+    norms = np.linalg.norm(data2, axis=1)
+    res = idx.search(
+        [(Pointer(10**6 + i), queries[i], 10, None) for i in range(30)])
+    hits = 0
+    for i in range(30):
+        sims = data2 @ queries[i] / (norms * np.linalg.norm(queries[i]))
+        exact = set(np.argsort(-sims)[:10].tolist())
+        hits += len({int(k) for k, _d in res[i]} & exact)
+    recall = hits / 300
+    assert recall >= 0.95, f"post-reembed recall@10 = {recall}"
+
+
+def test_load_rejects_truncated_blob(corpus):
+    _data, index = corpus
+    blob = index.save_bytes()
+    for cut in (len(blob) // 2, len(blob) - 5, 60):
+        with pytest.raises(RuntimeError):
+            HnswIndex.load_bytes(blob[:cut])
