@@ -476,6 +476,37 @@ void* hnsw_load(const char* p, long long len) {
     std::memcpy(h->links[i].data(), p, ln * 4);
     p += ln * 4;
   }
+  // structural validation: every field the search path dereferences must
+  // be in range — a tampered blob that passed the size checks must still
+  // come back nullptr, never an out-of-bounds access at query time
+  {
+    const int64_t ni = (int64_t)n;
+    bool ok = h->live >= 0 && h->live <= ni &&
+              h->entry >= -1 && h->entry < ni &&
+              (n == 0 ? h->entry == -1 : h->entry >= 0);
+    if (ok && n > 0) {
+      ok = h->max_level == h->levels[h->entry];
+      for (uint64_t i = 0; ok && i < n; i++) {
+        int lvl = h->levels[i];
+        if (lvl < 0 || lvl > 64 ||
+            h->links[i].size() !=
+                (size_t)(2 * h->M + (size_t)lvl * h->M)) {
+          ok = false;
+          break;
+        }
+        for (int32_t v : h->links[i]) {
+          if (v < -1 || v >= ni) {
+            ok = false;
+            break;
+          }
+        }
+      }
+    }
+    if (!ok) {
+      delete h;
+      return nullptr;
+    }
+  }
   return h;
 }
 

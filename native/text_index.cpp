@@ -15,6 +15,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <iterator>
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -30,6 +31,8 @@ struct Posting {
 struct TextIndex {
     double k1;
     double b;
+    bool lowercase = true;
+    bool stem = false;
     std::unordered_map<std::string, Posting> postings;
     std::unordered_map<uint64_t, uint32_t> doc_len;
     std::unordered_map<uint64_t, std::vector<std::string>> doc_tokens;
@@ -41,20 +44,119 @@ struct TextIndex {
     std::mutex mu;
 };
 
-void tokenize(const char* text, std::vector<std::string>& out) {
+bool has_vowel(const std::string& s, size_t end) {
+    for (size_t i = 0; i < end && i < s.size(); ++i) {
+        char c = s[i];
+        if (c == 'a' || c == 'e' || c == 'i' || c == 'o' || c == 'u')
+            return true;
+    }
+    return false;
+}
+
+bool ends_with(const std::string& s, const char* suf) {
+    size_t n = std::strlen(suf);
+    return s.size() >= n && s.compare(s.size() - n, n, suf) == 0;
+}
+
+// Light Porter stemmer (steps 1a-1c): plural/participle suffix stripping —
+// runs/running/ran't... -> run, matching tantivy's en_stem behaviour on
+// the common inflections (the full Porter pipeline is not reproduced)
+void stem_token(std::string& t) {
+    if (t.size() < 3) return;
+    // 1a: plurals
+    if (ends_with(t, "sses")) t.resize(t.size() - 2);
+    else if (ends_with(t, "ies")) t.resize(t.size() - 2);
+    else if (!ends_with(t, "ss") && !ends_with(t, "us") &&
+             t.back() == 's' && t.size() > 3)
+        t.pop_back();
+    // 1b: -ed / -ing (only when the remaining stem has a vowel); then the
+    // Porter cleanup: at/bl/iz stems regain their 'e' (rotating->rotate),
+    // else doubled consonants (not l/s/z) lose one (hopping->hop)
+    bool stripped = false;
+    if (ends_with(t, "ing") && t.size() > 5 && has_vowel(t, t.size() - 3)) {
+        t.resize(t.size() - 3);
+        stripped = true;
+    } else if (ends_with(t, "ed") && t.size() > 4 &&
+               has_vowel(t, t.size() - 2)) {
+        t.resize(t.size() - 2);
+        stripped = true;
+    }
+    if (stripped) {
+        if (ends_with(t, "at") || ends_with(t, "bl") || ends_with(t, "iz")) {
+            t.push_back('e');
+        } else if (t.size() >= 2 && t[t.size() - 1] == t[t.size() - 2] &&
+                   t.back() != 'l' && t.back() != 's' && t.back() != 'z') {
+            t.pop_back();
+        }
+    }
+    // 1c: terminal y -> i after a vowel-bearing stem
+    if (t.size() > 2 && t.back() == 'y' && has_vowel(t, t.size() - 1))
+        t.back() = 'i';
+}
+
+void tokenize(const TextIndex* idx, const char* text,
+              std::vector<std::string>& out) {
     out.clear();
     if (text == nullptr) return;
     std::string cur;
     for (const char* p = text; *p; ++p) {
         unsigned char c = static_cast<unsigned char>(*p);
         if (std::isalnum(c) || c == '_') {
-            cur.push_back(static_cast<char>(std::tolower(c)));
+            cur.push_back(idx->lowercase
+                              ? static_cast<char>(std::tolower(c))
+                              : static_cast<char>(c));
         } else if (!cur.empty()) {
+            if (idx->stem) stem_token(cur);
             out.push_back(cur);
             cur.clear();
         }
     }
-    if (!cur.empty()) out.push_back(cur);
+    if (!cur.empty()) {
+        if (idx->stem) stem_token(cur);
+        out.push_back(cur);
+    }
+}
+
+// query = loose terms + "quoted phrases"; phrase tokens also score, but a
+// doc must contain every phrase as adjacent tokens to qualify (the
+// tantivy PhraseQuery behaviour, tantivy_integration.rs scope)
+void parse_query(const TextIndex* idx, const char* q,
+                 std::vector<std::string>& terms,
+                 std::vector<std::vector<std::string>>& phrases) {
+    terms.clear();
+    phrases.clear();
+    std::string s(q ? q : "");
+    std::vector<std::string> part;
+    size_t pos = 0;
+    bool in_quote = false;
+    std::string segment;
+    auto flush = [&](bool quoted) {
+        tokenize(idx, segment.c_str(), part);
+        if (quoted && part.size() > 1) phrases.push_back(part);
+        for (auto& t : part) terms.push_back(t);
+        segment.clear();
+    };
+    for (; pos < s.size(); ++pos) {
+        if (s[pos] == '"') {
+            flush(in_quote);
+            in_quote = !in_quote;
+        } else {
+            segment.push_back(s[pos]);
+        }
+    }
+    flush(in_quote);
+}
+
+bool contains_phrase(const std::vector<std::string>& toks,
+                     const std::vector<std::string>& phrase) {
+    if (phrase.empty()) return true;
+    if (toks.size() < phrase.size()) return false;
+    for (size_t i = 0; i + phrase.size() <= toks.size(); ++i) {
+        size_t j = 0;
+        while (j < phrase.size() && toks[i + j] == phrase[j]) ++j;
+        if (j == phrase.size()) return true;
+    }
+    return false;
 }
 
 void remove_locked(TextIndex* idx, uint64_t id) {
@@ -83,10 +185,12 @@ void remove_locked(TextIndex* idx, uint64_t id) {
 
 extern "C" {
 
-void* ti_new(double k1, double b) {
+void* ti_new(double k1, double b, int32_t lowercase, int32_t stem) {
     auto* idx = new TextIndex();
     idx->k1 = k1;
     idx->b = b;
+    idx->lowercase = lowercase != 0;
+    idx->stem = stem != 0;
     return idx;
 }
 
@@ -98,7 +202,7 @@ void ti_add(void* h, uint64_t id, uint64_t tie_hi, uint64_t tie_lo,
     std::lock_guard<std::mutex> lock(idx->mu);
     remove_locked(idx, id);  // re-add semantics match ops/bm25.py add()
     std::vector<std::string> tokens;
-    tokenize(text, tokens);
+    tokenize(idx, text, tokens);
     idx->doc_len[id] = static_cast<uint32_t>(tokens.size());
     idx->total_len += tokens.size();
     idx->doc_tie[id] = {tie_hi, tie_lo};
@@ -134,7 +238,8 @@ int32_t ti_search(void* h, const char* query, int32_t k, uint64_t* out_ids,
         static_cast<double>(idx->total_len) / static_cast<double>(n_docs);
 
     std::vector<std::string> tokens;
-    tokenize(query, tokens);
+    std::vector<std::vector<std::string>> phrases;
+    parse_query(idx, query, tokens, phrases);
     std::unordered_map<uint64_t, double> scores;
     for (const std::string& tok : tokens) {
         auto pit = idx->postings.find(tok);
@@ -147,6 +252,20 @@ int32_t ti_search(void* h, const char* query, int32_t k, uint64_t* out_ids,
             const double denom =
                 tf + idx->k1 * (1.0 - idx->b + idx->b * dl / avg_len);
             scores[id] += idf * (tf * (idx->k1 + 1.0)) / denom;
+        }
+    }
+
+    if (!phrases.empty()) {
+        for (auto it = scores.begin(); it != scores.end();) {
+            const auto& toks = idx->doc_tokens[it->first];
+            bool ok = true;
+            for (const auto& ph : phrases) {
+                if (!contains_phrase(toks, ph)) {
+                    ok = false;
+                    break;
+                }
+            }
+            it = ok ? std::next(it) : scores.erase(it);
         }
     }
 
@@ -167,6 +286,103 @@ int32_t ti_search(void* h, const char* query, int32_t k, uint64_t* out_ids,
         out_scores[i] = ranked[i].second;
     }
     return static_cast<int32_t>(want);
+}
+
+// ---- persistence: versioned flat byte buffer (doc token streams; the
+// postings rebuild on load) ------------------------------------------------
+
+int64_t ti_save_size(void* h) {
+    auto* idx = static_cast<TextIndex*>(h);
+    std::lock_guard<std::mutex> lock(idx->mu);
+    int64_t total = 64;
+    for (const auto& [id, toks] : idx->doc_tokens) {
+        total += 8 + 16 + 8;  // id + tie + token count
+        for (const auto& t : toks) total += 4 + (int64_t)t.size();
+    }
+    return total;
+}
+
+int64_t ti_save(void* h, char* out, int64_t cap) {
+    auto* idx = static_cast<TextIndex*>(h);
+    std::lock_guard<std::mutex> lock(idx->mu);
+    std::vector<char> b;
+    b.reserve((size_t)cap);
+    auto put = [&](const void* p, size_t n) {
+        const char* c = static_cast<const char*>(p);
+        b.insert(b.end(), c, c + n);
+    };
+    uint32_t magic = 0x424D4958u, ver = 1;  // 'BMIX'
+    put(&magic, 4);
+    put(&ver, 4);
+    put(&idx->k1, 8);
+    put(&idx->b, 8);
+    uint8_t lc = idx->lowercase, st = idx->stem;
+    put(&lc, 1);
+    put(&st, 1);
+    uint64_t n = idx->doc_tokens.size();
+    put(&n, 8);
+    for (const auto& [id, toks] : idx->doc_tokens) {
+        put(&id, 8);
+        const auto& tie = idx->doc_tie.at(id);
+        put(&tie.first, 8);
+        put(&tie.second, 8);
+        uint64_t nt = toks.size();
+        put(&nt, 8);
+        for (const auto& t : toks) {
+            uint32_t len = (uint32_t)t.size();
+            put(&len, 4);
+            put(t.data(), t.size());
+        }
+    }
+    if ((int64_t)b.size() > cap) return -1;
+    std::memcpy(out, b.data(), b.size());
+    return (int64_t)b.size();
+}
+
+void* ti_load(const char* p, int64_t len) {
+    const char* end = p + len;
+    auto remaining = [&]() -> uint64_t { return (uint64_t)(end - p); };
+    auto take = [&](void* dst, size_t n) -> bool {
+        if (remaining() < n) return false;
+        std::memcpy(dst, p, n);
+        p += n;
+        return true;
+    };
+    uint32_t magic = 0, ver = 0;
+    double k1 = 0, bparam = 0;
+    uint8_t lc = 1, st = 0;
+    uint64_t n = 0;
+    if (!take(&magic, 4) || magic != 0x424D4958u) return nullptr;
+    if (!take(&ver, 4) || ver != 1) return nullptr;
+    if (!take(&k1, 8) || !take(&bparam, 8) || !take(&lc, 1) ||
+        !take(&st, 1) || !take(&n, 8))
+        return nullptr;
+    auto* idx = static_cast<TextIndex*>(ti_new(k1, bparam, lc, st));
+    for (uint64_t i = 0; i < n; i++) {
+        uint64_t id = 0, hi = 0, lo = 0, nt = 0;
+        if (!take(&id, 8) || !take(&hi, 8) || !take(&lo, 8) ||
+            !take(&nt, 8) || nt > remaining() / 4) {
+            ti_free(idx);
+            return nullptr;
+        }
+        std::vector<std::string> toks;
+        toks.reserve(nt);
+        for (uint64_t j = 0; j < nt; j++) {
+            uint32_t tl = 0;
+            if (!take(&tl, 4) || tl > remaining()) {
+                ti_free(idx);
+                return nullptr;
+            }
+            toks.emplace_back(p, tl);
+            p += tl;
+        }
+        idx->doc_len[id] = (uint32_t)toks.size();
+        idx->total_len += toks.size();
+        idx->doc_tie[id] = {hi, lo};
+        for (const std::string& t : toks) ++idx->postings[t].tf[id];
+        idx->doc_tokens[id] = std::move(toks);
+    }
+    return idx;
 }
 
 }  // extern "C"

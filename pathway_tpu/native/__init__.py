@@ -32,7 +32,8 @@ def _load_text_index():
             _text_index_err = e
             return None
         lib.ti_new.restype = ctypes.c_void_p
-        lib.ti_new.argtypes = [ctypes.c_double, ctypes.c_double]
+        lib.ti_new.argtypes = [ctypes.c_double, ctypes.c_double,
+                               ctypes.c_int32, ctypes.c_int32]
         lib.ti_free.argtypes = [ctypes.c_void_p]
         lib.ti_add.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                ctypes.c_uint64, ctypes.c_uint64,
@@ -44,6 +45,13 @@ def _load_text_index():
         lib.ti_search.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_double)]
+        lib.ti_save_size.restype = ctypes.c_int64
+        lib.ti_save_size.argtypes = [ctypes.c_void_p]
+        lib.ti_save.restype = ctypes.c_int64
+        lib.ti_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int64]
+        lib.ti_load.restype = ctypes.c_void_p
+        lib.ti_load.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         _text_index_lib = lib
         return lib
 
@@ -127,15 +135,22 @@ class NativeWordPiece:
 
 
 class NativeTextIndex:
-    """Thin RAII wrapper over the C++ BM25 engine (u64 doc ids)."""
+    """Thin RAII wrapper over the C++ BM25 engine (u64 doc ids).
 
-    def __init__(self, k1: float = 1.2, b: float = 0.75):
+    ``lowercase`` / ``stem`` configure the tokenizer pipeline (the
+    reference's tantivy tokenizer options: raw vs lowercased vs en_stem);
+    ``save_bytes``/``load_bytes`` round-trip the index for on-disk
+    persistence."""
+
+    def __init__(self, k1: float = 1.2, b: float = 0.75, *,
+                 lowercase: bool = True, stem: bool = False):
         lib = _load_text_index()
         if lib is None:
             raise NativeBuildError(
                 f"native text index unavailable: {_text_index_err}")
         self._lib = lib
-        self._h = lib.ti_new(k1, b)
+        self._h = lib.ti_new(k1, b, 1 if lowercase else 0,
+                             1 if stem else 0)
 
     def __del__(self):
         lib = getattr(self, "_lib", None)
@@ -161,3 +176,25 @@ class NativeTextIndex:
         scores = (ctypes.c_double * k)()
         n = self._lib.ti_search(self._h, query.encode(), k, ids, scores)
         return [(int(ids[i]), float(scores[i])) for i in range(n)]
+
+    def save_bytes(self) -> bytes:
+        size = int(self._lib.ti_save_size(self._h))
+        buf = ctypes.create_string_buffer(size)
+        written = int(self._lib.ti_save(self._h, buf, size))
+        if written < 0:
+            raise RuntimeError("text index save failed")
+        return buf.raw[:written]
+
+    @classmethod
+    def load_bytes(cls, blob: bytes) -> "NativeTextIndex":
+        lib = _load_text_index()
+        if lib is None:
+            raise NativeBuildError(
+                f"native text index unavailable: {_text_index_err}")
+        h = lib.ti_load(blob, len(blob))
+        if not h:
+            raise RuntimeError("text index load failed: corrupt buffer")
+        self = cls.__new__(cls)
+        self._lib = lib
+        self._h = h
+        return self

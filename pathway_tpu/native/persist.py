@@ -1,0 +1,66 @@
+"""Shared framing for native-index persistence blobs.
+
+Layout: 8-byte little-endian side-channel length, JSON side channel,
+native graph bytes. JSON — not pickle — on purpose: index files are
+treated as hostile/corruptible by the native loaders (bounds-checked,
+magic-versioned), and the Python side channel must hold the same line —
+loading a tampered file must never execute code. Pointer keys are
+serialized as decimal strings (128-bit ints exceed JSON number precision).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from pathway_tpu.internals.keys import Pointer
+
+
+def encode_pointer_map(d: dict) -> dict:
+    """{Pointer-or-int key: value} -> {str(int(key)): value}."""
+    return {str(int(k)): v for k, v in d.items()}
+
+
+def decode_pointer_map(d: dict) -> dict:
+    """{str: value} -> {Pointer(int(str)): value}."""
+    return {Pointer(int(k)): v for k, v in d.items()}
+
+
+def decode_int_map(d: dict, *, pointer_values: bool = False) -> dict:
+    """{str: value} -> {int(str): value}, optionally Pointer-izing values."""
+    return {int(k): Pointer(int(v)) if pointer_values else v
+            for k, v in d.items()}
+
+
+def pack(side: dict, graph: bytes) -> bytes:
+    """Frame a JSON-serializable side channel with the native graph bytes.
+    Raises TypeError for non-JSON-serializable metadata (filter payloads
+    must be plain data — the same restriction jmespath filtering implies)."""
+    blob = json.dumps(side, separators=(",", ":")).encode("utf-8")
+    return len(blob).to_bytes(8, "little") + blob + graph
+
+
+def unpack(blob: bytes, what: str) -> tuple[dict, bytes]:
+    """Inverse of pack(); raises RuntimeError on any corruption."""
+    try:
+        side_len = int.from_bytes(blob[:8], "little")
+        if side_len <= 0 or 8 + side_len > len(blob):
+            raise ValueError("side channel extends past the blob")
+        side = json.loads(blob[8:8 + side_len].decode("utf-8"))
+        if not isinstance(side, dict):
+            raise ValueError("side channel is not an object")
+    except Exception as e:
+        raise RuntimeError(f"{what} load failed: corrupt blob ({e})") from e
+    return side, blob[8 + side_len:]
+
+
+def jsonable_filters(filters: dict, what: str) -> dict:
+    """Validate + encode a {Pointer: filter_data} map for the side channel."""
+    enc = encode_pointer_map(filters)
+    try:
+        json.dumps(enc)
+    except TypeError as e:
+        raise TypeError(
+            f"{what}: filter metadata must be JSON-serializable to "
+            f"persist the index ({e})") from e
+    return enc

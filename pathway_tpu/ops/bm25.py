@@ -18,10 +18,70 @@ from typing import Any
 from pathway_tpu.internals.keys import Pointer
 
 _TOKEN_RE = re.compile(r"[A-Za-z0-9_]+")
+_VOWELS = set("aeiou")
 
 
-def tokenize(text: str) -> list[str]:
-    return [t.lower() for t in _TOKEN_RE.findall(text or "")]
+def _has_vowel(s: str) -> bool:
+    return any(c in _VOWELS for c in s)
+
+
+def light_stem(t: str) -> str:
+    """Light Porter stemmer (steps 1a-1c) — byte-identical to the C++
+    engine's stem_token (native/text_index.cpp) so both engines tokenize
+    the same."""
+    if len(t) < 3:
+        return t
+    if t.endswith("sses"):
+        t = t[:-2]
+    elif t.endswith("ies"):
+        t = t[:-2]
+    elif t.endswith("s") and not t.endswith(("ss", "us")) and len(t) > 3:
+        t = t[:-1]
+    stripped = False
+    if t.endswith("ing") and len(t) > 5 and _has_vowel(t[:-3]):
+        t = t[:-3]
+        stripped = True
+    elif t.endswith("ed") and len(t) > 4 and _has_vowel(t[:-2]):
+        t = t[:-2]
+        stripped = True
+    if stripped:
+        if t.endswith(("at", "bl", "iz")):
+            t += "e"  # rotating -> rotate
+        elif len(t) >= 2 and t[-1] == t[-2] and t[-1] not in "lsz":
+            t = t[:-1]  # hopping -> hop
+    if len(t) > 2 and t.endswith("y") and _has_vowel(t[:-1]):
+        t = t[:-1] + "i"
+    return t
+
+
+def tokenize(text: str, *, lowercase: bool = True,
+             stem: bool = False) -> list[str]:
+    toks = _TOKEN_RE.findall(text or "")
+    if lowercase:
+        toks = [t.lower() for t in toks]
+    if stem:
+        toks = [light_stem(t) for t in toks]
+    return toks
+
+
+def parse_query(text: str, *, lowercase: bool = True, stem: bool = False
+                ) -> tuple[list[str], list[list[str]]]:
+    """(terms, phrases): quoted segments become adjacency-required phrases
+    (tantivy PhraseQuery scope); all tokens — quoted or not — score."""
+    terms: list[str] = []
+    phrases: list[list[str]] = []
+    for i, segment in enumerate((text or "").split('"')):
+        part = tokenize(segment, lowercase=lowercase, stem=stem)
+        if i % 2 == 1 and len(part) > 1:
+            phrases.append(part)
+        terms.extend(part)
+    return terms, phrases
+
+
+def contains_phrase(tokens: list[str], phrase: list[str]) -> bool:
+    n = len(phrase)
+    return any(tokens[i:i + n] == phrase
+               for i in range(len(tokens) - n + 1))
 
 
 def passes_filter(data: Any, filt: Any) -> bool:
@@ -38,15 +98,21 @@ def passes_filter(data: Any, filt: Any) -> bool:
 
 class BM25Index:
     def __init__(self, *, k1: float = 1.2, b: float = 0.75,
-                 ram_budget: int | None = None, in_memory_index: bool = True):
+                 ram_budget: int | None = None, in_memory_index: bool = True,
+                 lowercase: bool = True, stemming: bool = False):
         self.k1 = k1
         self.b = b
+        self.lowercase = lowercase
+        self.stemming = stemming
         self._postings: dict[str, dict[Pointer, int]] = defaultdict(dict)
         self._doc_len: dict[Pointer, int] = {}
         self._doc_tokens: dict[Pointer, list[str]] = {}
         self._filter_data: dict[Pointer, Any] = {}
         self._total_len = 0
         self._lock = threading.RLock()
+
+    def _tokenize(self, text: str) -> list[str]:
+        return tokenize(text, lowercase=self.lowercase, stem=self.stemming)
 
     def __len__(self) -> int:
         return len(self._doc_len)
@@ -55,7 +121,8 @@ class BM25Index:
         with self._lock:
             if key in self._doc_len:
                 self.remove(key)
-            tokens = tokenize(text if isinstance(text, str) else str(text))
+            tokens = self._tokenize(
+                text if isinstance(text, str) else str(text))
             self._doc_tokens[key] = tokens
             self._doc_len[key] = len(tokens)
             self._total_len += len(tokens)
@@ -88,8 +155,10 @@ class BM25Index:
         if n_docs == 0:
             return []
         avg_len = self._total_len / n_docs if n_docs else 1.0
+        terms, phrases = parse_query(text, lowercase=self.lowercase,
+                                     stem=self.stemming)
         scores: dict[Pointer, float] = defaultdict(float)
-        for tok in tokenize(text):
+        for tok in terms:
             posting = self._postings.get(tok)
             if not posting:
                 continue
@@ -99,6 +168,12 @@ class BM25Index:
                 dl = self._doc_len[key]
                 denom = tf + self.k1 * (1 - self.b + self.b * dl / avg_len)
                 scores[key] += idf * (tf * (self.k1 + 1)) / denom
+        if phrases:
+            scores = {
+                key: s for key, s in scores.items()
+                if all(contains_phrase(self._doc_tokens[key], ph)
+                       for ph in phrases)
+            }
         ranked = sorted(scores.items(), key=lambda kv: (-kv[1], int(kv[0])))
         out = []
         for key, score in ranked:
@@ -130,10 +205,12 @@ class NativeBM25Index:
     filters are evaluated host-side over an over-fetched candidate list."""
 
     def __init__(self, *, k1: float = 1.2, b: float = 0.75,
-                 ram_budget: int | None = None, in_memory_index: bool = True):
+                 ram_budget: int | None = None, in_memory_index: bool = True,
+                 lowercase: bool = True, stemming: bool = False):
         from pathway_tpu.native import NativeTextIndex
 
-        self._native = NativeTextIndex(k1=k1, b=b)
+        self._native = NativeTextIndex(k1=k1, b=b, lowercase=lowercase,
+                                       stem=stemming)
         self._key_to_id: dict[Pointer, int] = {}
         self._id_to_key: dict[int, Pointer] = {}
         self._filter_data: dict[Pointer, Any] = {}
@@ -206,17 +283,58 @@ class NativeBM25Index:
             return out
 
 
+    # -- persistence (on-disk index; reference: tantivy's directory).
+    # JSON side channel, never pickle: index files are untrusted input.
+    def save_bytes(self) -> bytes:
+        from pathway_tpu.native import persist
+
+        with self._lock:
+            side = {
+                "key_to_id": persist.encode_pointer_map(self._key_to_id),
+                "filters": persist.jsonable_filters(self._filter_data,
+                                                    "bm25"),
+                "next_id": self._next_id,
+            }
+            return persist.pack(side, self._native.save_bytes())
+
+    @classmethod
+    def load_bytes(cls, blob: bytes) -> "NativeBM25Index":
+        from pathway_tpu.native import NativeTextIndex, persist
+
+        side, graph = persist.unpack(blob, "bm25")
+        try:
+            key_to_id = persist.decode_pointer_map(side["key_to_id"])
+            key_to_id = {k: int(v) for k, v in key_to_id.items()}
+            filter_data = persist.decode_pointer_map(
+                side.get("filters", {}))
+            next_id = int(side["next_id"])
+        except Exception as e:
+            raise RuntimeError(
+                f"bm25 load failed: corrupt blob ({e})") from e
+        self = cls.__new__(cls)
+        self._native = NativeTextIndex.load_bytes(graph)
+        self._key_to_id = key_to_id
+        self._id_to_key = {v: k for k, v in key_to_id.items()}
+        self._filter_data = filter_data
+        self._next_id = next_id
+        self._lock = threading.RLock()
+        return self
+
+
 def create_bm25_index(*, k1: float = 1.2, b: float = 0.75,
                       ram_budget: int | None = None,
                       in_memory_index: bool = True,
+                      lowercase: bool = True, stemming: bool = False,
                       prefer_native: bool = True):
     """BM25 engine factory: the C++ engine when the toolchain can build it,
-    else the pure-Python index (identical scoring formula)."""
+    else the pure-Python index (identical scoring formula and tokenizer)."""
     if prefer_native:
         try:
             return NativeBM25Index(k1=k1, b=b, ram_budget=ram_budget,
-                                   in_memory_index=in_memory_index)
+                                   in_memory_index=in_memory_index,
+                                   lowercase=lowercase, stemming=stemming)
         except Exception:
             pass
     return BM25Index(k1=k1, b=b, ram_budget=ram_budget,
-                     in_memory_index=in_memory_index)
+                     in_memory_index=in_memory_index,
+                     lowercase=lowercase, stemming=stemming)

@@ -195,8 +195,11 @@ class HnswIndex:
                 out.append(tuple(matches))
         return out
 
-    # -- persistence ---------------------------------------------------------
+    # -- persistence (JSON side channel + validated native graph;
+    # NEVER pickle — index files are untrusted input) ------------------------
     def save_bytes(self) -> bytes:
+        from pathway_tpu.native import persist
+
         with self._lock:
             lib = _lib()
             size = int(lib.hnsw_save_size(self._h))
@@ -204,35 +207,35 @@ class HnswIndex:
             written = int(lib.hnsw_save(self._h, buf, size))
             if written < 0:
                 raise RuntimeError("hnsw save failed")
-            import pickle
-
-            side = pickle.dumps((self._keys, self._filters,
-                                 self.dimensions, self.metric.name,
-                                 self.connectivity, self.expansion_add,
-                                 self.expansion_search))
-            return (len(side).to_bytes(8, "little") + side
-                    + buf.raw[:written])
+            side = {
+                "keys": {str(low): str(int(ptr))
+                         for low, ptr in self._keys.items()},
+                "filters": persist.jsonable_filters(self._filters, "hnsw"),
+                "dim": self.dimensions,
+                "metric": self.metric.name,
+                "connectivity": self.connectivity,
+                "expansion_add": self.expansion_add,
+                "expansion_search": self.expansion_search,
+            }
+            return persist.pack(side, buf.raw[:written])
 
     @classmethod
     def load_bytes(cls, blob: bytes) -> "HnswIndex":
-        import pickle
+        from pathway_tpu.native import persist
 
+        side, graph = persist.unpack(blob, "hnsw")
         try:
-            side_len = int.from_bytes(blob[:8], "little")
-            if side_len <= 0 or 8 + side_len > len(blob):
-                raise ValueError("side channel extends past the blob")
-            (keys, filters, dim, metric_name, conn, efa, efs) = pickle.loads(
-                blob[8:8 + side_len])
+            keys = persist.decode_int_map(side["keys"], pointer_values=True)
+            filters = persist.decode_pointer_map(side.get("filters", {}))
+            self = cls.__new__(cls)
+            self.dimensions = int(side["dim"])
+            self.metric = KnnMetric[side["metric"]]
+            self.connectivity = int(side["connectivity"])
+            self.expansion_add = int(side["expansion_add"])
+            self.expansion_search = int(side["expansion_search"])
         except Exception as e:
             raise RuntimeError(f"hnsw load failed: corrupt blob ({e})") \
                 from e
-        graph = blob[8 + side_len:]
-        self = cls.__new__(cls)
-        self.dimensions = dim
-        self.metric = KnnMetric[metric_name]
-        self.connectivity = conn
-        self.expansion_add = efa
-        self.expansion_search = efs
         self._seed = 7
         self._lock = threading.RLock()
         h = _lib().hnsw_load(graph, len(graph))

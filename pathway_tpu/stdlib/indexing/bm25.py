@@ -15,20 +15,33 @@ from pathway_tpu.stdlib.indexing.data_index import InnerIndex
 class TantivyBM25Factory:
     ram_budget: int = 50_000_000
     in_memory_index: bool = True
+    lowercase: bool = True
+    stemming: bool = False
 
     def build(self):
         # C++ engine when buildable, Python engine otherwise (ops/bm25.py)
         return create_bm25_index(ram_budget=self.ram_budget,
-                                 in_memory_index=self.in_memory_index)
+                                 in_memory_index=self.in_memory_index,
+                                 lowercase=self.lowercase,
+                                 stemming=self.stemming)
 
 
 class TantivyBM25(InnerIndex):
+    """Full-text BM25 index. Queries support quoted "phrase" segments
+    (adjacency-required, tantivy PhraseQuery scope); the tokenizer is
+    configurable (``lowercase``, ``stemming`` — tantivy's raw / simple /
+    en_stem pipeline options)."""
+
     def __init__(self, data_column: ex.ColumnReference,
                  metadata_column: ex.ColumnExpression | None = None, *,
-                 ram_budget: int = 50_000_000, in_memory_index: bool = True):
+                 ram_budget: int = 50_000_000, in_memory_index: bool = True,
+                 lowercase: bool = True, stemming: bool = False):
         super().__init__(data_column, metadata_column)
         self.ram_budget = ram_budget
         self.in_memory_index = in_memory_index
+        self.lowercase = lowercase
+        self.stemming = stemming
 
     def factory(self) -> TantivyBM25Factory:
-        return TantivyBM25Factory(self.ram_budget, self.in_memory_index)
+        return TantivyBM25Factory(self.ram_budget, self.in_memory_index,
+                                  self.lowercase, self.stemming)

@@ -157,3 +157,30 @@ def test_load_rejects_truncated_blob(corpus):
     for cut in (len(blob) // 2, len(blob) - 5, 60):
         with pytest.raises(RuntimeError):
             HnswIndex.load_bytes(blob[:cut])
+
+
+def test_load_rejects_tampered_graph_fields(corpus):
+    """Bit-flipped graph fields (entry, link targets) must be rejected at
+    load, not crash at search (structural validation in hnsw_load)."""
+    _data, index = corpus
+    blob = bytearray(index.save_bytes())
+    side_len = int.from_bytes(blob[:8], "little")
+    graph_off = 8 + side_len
+    # entry field lives at graph offset 24 (magic, ver, dim, metric, M, efc)
+    tampered = bytearray(blob)
+    tampered[graph_off + 24:graph_off + 28] = (2**31 - 1).to_bytes(
+        4, "little", signed=False)
+    with pytest.raises(RuntimeError):
+        HnswIndex.load_bytes(bytes(tampered))
+
+
+def test_persisted_blob_contains_no_pickle(corpus):
+    """Index files are untrusted input: the side channel is JSON, never
+    pickle (loading must not be able to execute code)."""
+    _data, index = corpus
+    blob = index.save_bytes()
+    side_len = int.from_bytes(blob[:8], "little")
+    import json
+
+    side = json.loads(blob[8:8 + side_len])
+    assert set(side) >= {"keys", "dim", "metric"}

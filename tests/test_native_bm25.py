@@ -133,3 +133,95 @@ def test_re_add_clears_stale_filter_data():
     native.add(k, "hello world")  # re-add without metadata
     res = native.search([(None, "hello", 3, lambda d: d is None)])[0]
     assert [key for key, _ in res] == [k]
+
+
+PHRASE_DOCS = [
+    ("ring attention rotates key value blocks", 1),
+    ("attention is all you need said the ring", 2),
+    ("value networks rotate around the ring topology", 3),
+]
+
+
+def _build_pair(**kw):
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.bm25 import BM25Index, NativeBM25Index
+
+    nat, py = NativeBM25Index(**kw), BM25Index(**kw)
+    for text, i in PHRASE_DOCS:
+        nat.add(Pointer(i), text)
+        py.add(Pointer(i), text)
+    return nat, py
+
+
+def test_phrase_query_requires_adjacency_both_engines():
+    from pathway_tpu.internals.keys import Pointer
+
+    nat, py = _build_pair()
+    for idx in (nat, py):
+        # loose terms: every doc containing any term matches
+        [loose] = idx.search([(Pointer(9), "ring attention", 10, None)])
+        assert len(loose) == 3
+        # quoted phrase: only the doc with the ADJACENT pair survives
+        [phrase] = idx.search([(Pointer(9), '"ring attention"', 10, None)])
+        assert [int(k) for k, _s in phrase] == [1]
+        # phrase plus extra loose term still phrase-filters
+        [mixed] = idx.search(
+            [(Pointer(9), 'value "ring attention"', 10, None)])
+        assert [int(k) for k, _s in mixed] == [1]
+
+
+def test_stemming_toggle_both_engines():
+    from pathway_tpu.internals.keys import Pointer
+
+    # stemming on: 'rotates'/'rotate' collapse, so both docs match 'rotating'
+    nat, py = _build_pair(stemming=True)
+    for idx in (nat, py):
+        [m] = idx.search([(Pointer(9), "rotating", 10, None)])
+        assert {int(k) for k, _s in m} == {1, 3}
+    # stemming off (default): no match for the unseen inflection
+    nat2, py2 = _build_pair()
+    for idx in (nat2, py2):
+        [m] = idx.search([(Pointer(9), "rotating", 10, None)])
+        assert m == ()
+
+
+def test_native_persistence_survives_kill_and_restore(tmp_path):
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.bm25 import NativeBM25Index
+
+    idx = NativeBM25Index(stemming=True)
+    for text, i in PHRASE_DOCS:
+        idx.add(Pointer(i), text, filter_data={"n": i})
+    [before] = idx.search([(Pointer(9), '"ring attention"', 10, None)])
+    path = tmp_path / "bm25.idx"
+    path.write_bytes(idx.save_bytes())
+    del idx  # 'kill'
+
+    restored = NativeBM25Index.load_bytes(path.read_bytes())
+    assert len(restored) == 3
+    [after] = restored.search([(Pointer(9), '"ring attention"', 10, None)])
+    assert [(int(k), round(s, 9)) for k, s in after] == \
+        [(int(k), round(s, 9)) for k, s in before]
+    # filters survive too
+    [filt] = restored.search(
+        [(Pointer(9), "ring", 10, lambda d: d and d["n"] == 3)])
+    assert [int(k) for k, _s in filt] == [3]
+    # incremental adds continue after restore
+    restored.add(Pointer(7), "a brand new ring attention article")
+    [again] = restored.search([(Pointer(9), '"ring attention"', 10, None)])
+    assert {int(k) for k, _s in again} == {1, 7}
+
+
+def test_truncated_bm25_blob_rejected():
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.bm25 import NativeBM25Index
+
+    idx = NativeBM25Index()
+    for text, i in PHRASE_DOCS:
+        idx.add(Pointer(i), text)
+    blob = idx.save_bytes()
+    import pytest as _pytest
+
+    for cut in (len(blob) - 3, len(blob) // 2, 10):
+        with _pytest.raises(RuntimeError):
+            NativeBM25Index.load_bytes(blob[:cut])
