@@ -367,7 +367,34 @@ def bench_etl(n_rows: int = 100_000) -> dict:
     qtys = rng.integers(1, 10, size=n_rows)
     ticks = np.sort(rng.integers(0, n_ticks, size=n_rows))
 
-    def run_once(n_workers: int) -> float:
+    def bench_exchange() -> dict:
+        """Serialization microbench of the multiprocess exchange plane
+        (engine/multiproc.py): bytes/row and enc+dec cost of the packed
+        payload format actually sent between cluster processes."""
+        import pickle as _p
+
+        from pathway_tpu.engine.multiproc import (_pack_payload,
+                                                  _unpack_payload)
+        from pathway_tpu.internals.keys import hash_values
+
+        n = min(20_000, n_rows)
+        ents = [(hash_values("row", i), (f"w{words[i]}", int(qtys[i])), 1)
+                for i in range(n)]
+        payload = {"rows": {0: {0: ents}}, "wm": None, "bcast": None}
+        t0 = time.perf_counter()
+        blob = _p.dumps(("x", _pack_payload(payload)),
+                        protocol=_p.HIGHEST_PROTOCOL)
+        enc_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _unpack_payload(_p.loads(blob)[1])
+        dec_s = time.perf_counter() - t0
+        return {
+            "exchange_bytes_per_row": round(len(blob) / n, 1),
+            "exchange_encdec_us_per_row": round(
+                (enc_s + dec_s) / n * 1e6, 3),
+        }
+
+    def run_once(n_workers: int) -> tuple[float, int]:
         G.clear()
 
         class S(pw.Schema):
@@ -390,18 +417,26 @@ def bench_etl(n_rows: int = 100_000) -> dict:
             counts.word, counts.n, counts.total, lex.cat)
         runner = GraphRunner()
         runner.capture(joined)
+        exchanged = sum(
+            1 for node in runner.graph.nodes
+            if any(s is not None for s in node.op.exchange_specs()))
         t0 = time.perf_counter()
         runner.run_batch(n_workers=n_workers)
         dt = time.perf_counter() - t0
         G.clear()
-        return n_rows / dt
+        return n_rows / dt, exchanged
 
+    r1, exchanged_nodes = run_once(1)
+    r8, _ = run_once(8)
     return {
-        "etl_rows_per_s_1w": round(run_once(1), 0),
-        "etl_rows_per_s_8w": round(run_once(8), 0),
+        "etl_rows_per_s_1w": round(r1, 0),
+        "etl_rows_per_s_8w": round(r8, 0),
         "etl_n_rows": n_rows,
         "etl_ticks": n_ticks,
         "etl_n_cores": os.cpu_count(),
+        # cluster barrier count per tick = exchanged nodes (BSP rounds)
+        "etl_exchange_rounds_per_tick": exchanged_nodes,
+        **bench_exchange(),
     }
 
 

@@ -481,3 +481,23 @@ def test_cluster_peer_death_detected(tmp_path):
     assert "survived" not in out0
     assert ("EOFError" in err0 or "Connection" in err0
             or "BrokenPipe" in err0 or "closed" in err0), err0[-500:]
+
+
+def test_exchange_payload_pack_roundtrip():
+    """The packed exchange wire format must be lossless, including nested
+    rows/bcast shapes and Pointer-keyed entries (engine/multiproc.py)."""
+    from pathway_tpu.engine.multiproc import _pack_payload, _unpack_payload
+    from pathway_tpu.internals.keys import Pointer, hash_values
+
+    ents = [(hash_values("a", i), (f"w{i}", i, None), 1 - 2 * (i % 2))
+            for i in range(50)]
+    payload = {"rows": {1: {3: ents}}, "wm": 7,
+               "bcast": {0: ents[:3]}, "any": True}
+    packed = _pack_payload(payload)
+    assert packed["rows"][1][3][0] == "__pw_ents__"
+    out = _unpack_payload(packed)
+    assert out == payload
+    assert all(isinstance(e[0], Pointer) for e in out["rows"][1][3])
+    # non-entry lists and scalars pass through untouched
+    assert _unpack_payload(_pack_payload({"xs": [1, 2], "s": "x"})) == \
+        {"xs": [1, 2], "s": "x"}
