@@ -57,14 +57,32 @@ class CapturedStream:
 
     Mirrors the reference's capture_table_data (src/python_api.rs:3200) used
     by the test harness's assert_table_equality / assert_stream_equality.
+    Capture is chunk-buffered: on_delta stores (time, entries) references
+    (deltas are never mutated after emission) and the flat event list
+    materializes on first read — the dataflow's hot loop must not pay for
+    the harness's bookkeeping.
     """
 
     def __init__(self):
-        self.events: list[tuple] = []  # (key, row, time, diff)
+        self._chunks: list[tuple[int, list]] = []
+        self._events: list[tuple] = []  # flattened (key, row, time, diff)
+
+    @property
+    def events(self) -> list[tuple]:
+        if self._chunks:
+            # atomically detach before flattening: a concurrent on_delta
+            # (pool-thread replicas share this capture) must land in the
+            # fresh list, not be cleared unflattened
+            chunks, self._chunks = self._chunks, []
+            for time, entries in chunks:
+                self._events.extend(
+                    [(key, row, time, diff)
+                     for key, row, diff in entries])
+        return self._events
 
     def on_delta(self, time: int, delta: Delta) -> None:
-        self.events.extend(
-            [(key, row, time, diff) for key, row, diff in delta.entries])
+        if delta.entries:
+            self._chunks.append((time, delta.entries))
 
     def snapshot(self) -> dict:
         state: dict = {}
