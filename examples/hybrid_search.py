@@ -1,0 +1,84 @@
+"""Hybrid search serving template: BM25 full-text (phrase queries,
+stemming) fused with HNSW vector retrieval by reciprocal-rank fusion
+(reference: stdlib/indexing/hybrid_index.py HybridIndex + the tantivy and
+usearch integrations).
+
+Run:
+    python examples/hybrid_search.py ./docs --port 8080
+then:
+    curl -X POST localhost:8080/search -d '{"query": "ring attention"}'
+    curl -X POST localhost:8080/search -d '{"query": "\\"ring attention\\""}'
+
+Quoted segments are phrase queries (adjacency-required on the BM25 leg);
+the vector leg uses the native HNSW engine (approximate, sublinear). Both
+legs update live as files appear in the watched directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing import DataIndex, TantivyBM25
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridDataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import USearchKnn
+
+DIM = 64
+
+
+@pw.udf(deterministic=True)
+def embed(text: str) -> np.ndarray:
+    """Deterministic hash embedder so the template runs anywhere; swap for
+    JaxEncoderEmbedder(model="BAAI/bge-small-en-v1.5") with the checkpoint."""
+    v = np.zeros(DIM)
+    for tok in str(text).lower().split():
+        h = int(hashlib.md5(tok.encode()).hexdigest(), 16)
+        v[h % DIM] += 1.0
+    n = np.linalg.norm(v)
+    return v / n if n else v
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("docs", help="directory of text files to watch")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--k", type=int, default=3)
+    args = ap.parse_args()
+
+    docs = pw.io.fs.read(args.docs, format="plaintext_by_file",
+                         mode="streaming", with_metadata=True)
+    docs = docs.select(text=pw.this.data)
+
+    # both legs consume the same raw text column: the BM25 leg tokenizes
+    # it (phrases included) and the vector leg embeds it index-side
+    # (embedder= makes DataIndex embed corpus AND query columns itself)
+    text_index = DataIndex(
+        docs, TantivyBM25(docs.text, stemming=True))
+    vector_index = DataIndex(
+        docs, USearchKnn(docs.text, dimensions=DIM, metric="cos",
+                         embedder=embed))
+
+    class QuerySchema(pw.Schema):
+        query: str
+
+    ws = pw.io.http.PathwayWebserver(host="0.0.0.0", port=args.port)
+    queries, writer = pw.io.http.rest_connector(
+        webserver=ws, route="/search", schema=QuerySchema,
+        delete_completed_queries=True)
+
+    fused = HybridDataIndex(docs, [text_index, vector_index])
+    res = fused.query_as_of_now(queries.query,
+                                number_of_matches=args.k)
+    out = res.select(result=pw.apply(
+        lambda ts: list(ts or ()), pw.this.text))
+    writer(out)
+    print(f"hybrid search at http://0.0.0.0:{args.port}/search "
+          f"(BM25 phrase+stem ⊕ HNSW, RRF)")
+    pw.run()
+
+
+if __name__ == "__main__":
+    main()

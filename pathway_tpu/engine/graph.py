@@ -64,25 +64,29 @@ class CapturedStream:
     """
 
     def __init__(self):
+        import threading
+
         self._chunks: list[tuple[int, list]] = []
         self._events: list[tuple] = []  # flattened (key, row, time, diff)
+        # guards the chunk buffer: pool-thread replicas share this capture,
+        # and an unsynchronized detach could orphan a concurrent append
+        # (one lock operation per TICK, not per row — off the hot path)
+        self._lock = threading.Lock()
 
     @property
     def events(self) -> list[tuple]:
-        if self._chunks:
-            # atomically detach before flattening: a concurrent on_delta
-            # (pool-thread replicas share this capture) must land in the
-            # fresh list, not be cleared unflattened
+        with self._lock:
             chunks, self._chunks = self._chunks, []
-            for time, entries in chunks:
-                self._events.extend(
-                    [(key, row, time, diff)
-                     for key, row, diff in entries])
+        for time, entries in chunks:
+            self._events.extend(
+                [(key, row, time, diff)
+                 for key, row, diff in entries])
         return self._events
 
     def on_delta(self, time: int, delta: Delta) -> None:
         if delta.entries:
-            self._chunks.append((time, delta.entries))
+            with self._lock:
+                self._chunks.append((time, delta.entries))
 
     def snapshot(self) -> dict:
         state: dict = {}
