@@ -354,7 +354,9 @@ class Scheduler:
             if self._gather[node.id]:
                 outs = self._step_gather(node, reps, time, flush, outputs, L)
             else:
-                specs = (reps[0] if reps else node.op).exchange_specs()
+                op0 = reps[0] if reps else node.op
+                specs = op0.exchange_specs()
+                consolidate = op0.consolidate_inputs
                 per_worker: list[list[Delta]] = [
                     [_EMPTY] * len(node.inputs) for _ in range(L)]
                 # remote shares: peer -> {input j -> {global worker -> entries}}
@@ -378,7 +380,9 @@ class Scheduler:
                         if cl is not None and ents:
                             bcast[j] = ents
                         if ents:
-                            merged = Delta(list(ents)).consolidate()
+                            merged = Delta(list(ents))
+                            if consolidate:
+                                merged = merged.consolidate()
                             for w in range(L):
                                 per_worker[w][j] = merged
                         continue
@@ -425,7 +429,7 @@ class Scheduler:
                                     send.setdefault(gw // per_proc, {}) \
                                         .setdefault(j, {}) \
                                         .setdefault(gw, []).append(e)
-                    self._merge_routed(per_worker, routed, j)
+                    self._merge_routed(per_worker, routed, j, consolidate)
                 # temporal operators share one watermark across workers
                 # (global, like a timely frontier): advance it from every
                 # process's pre-routing input before any replica releases
@@ -451,7 +455,8 @@ class Scheduler:
                                 routed = [[] for _ in range(L)]
                                 for gw, ents in by_worker.items():
                                     routed[gw - lo].extend(ents)
-                                self._merge_routed(per_worker, routed, j)
+                                self._merge_routed(per_worker, routed, j,
+                                                   consolidate)
                         peer_bcast = payload.get("bcast")
                         if peer_bcast:
                             for j, ents in peer_bcast.items():
@@ -459,8 +464,9 @@ class Scheduler:
                                     cur = per_worker[w][j]
                                     base = cur.entries if cur is not _EMPTY \
                                         else []
-                                    per_worker[w][j] = Delta(
-                                        base + ents).consolidate()
+                                    merged = Delta(base + ents)
+                                    per_worker[w][j] = merged.consolidate() \
+                                        if consolidate else merged
                         wm_local = _wm_max(wm_local, payload.get("wm"))
                 if wm_node and wm_local is not None:
                     reps[0]._advance_watermark_value(wm_local)
@@ -483,16 +489,15 @@ class Scheduler:
         return _MergedOutputs(outputs)
 
     @staticmethod
-    def _merge_routed(per_worker, routed, j) -> None:
+    def _merge_routed(per_worker, routed, j, consolidate: bool = True) -> None:
         for w, ents in enumerate(routed):
             if not ents:
                 continue
             cur = per_worker[w][j]
-            if cur is _EMPTY:
-                per_worker[w][j] = Delta(ents).consolidate()
-            else:
-                per_worker[w][j] = Delta(
-                    cur.entries + ents).consolidate()
+            merged = Delta(ents) if cur is _EMPTY else Delta(
+                cur.entries + ents)
+            per_worker[w][j] = merged.consolidate() if consolidate \
+                else merged
 
     def _step_gather(self, node, reps, time, flush, outputs, L):
         """Gather node: one owner replica on (global) worker 0. Under a

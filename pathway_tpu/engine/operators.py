@@ -61,6 +61,13 @@ class Operator:
     # False for ops whose replicas share mutable state (e.g. one device
     # slab): their per-worker steps must not run on the thread pool
     parallel_safe = True
+    # Consulted only for EXCHANGED inputs (the sharded merge points in
+    # graph.py; spec-None inputs always pass through unmerged): False for
+    # ops whose step() is exact on unconsolidated input — purely additive
+    # state, or exact handling of same-tick insert/retract pairs. Ops
+    # whose outputs feed sinks unfused (net-zero pairs would surface as
+    # phantom events) keep the default.
+    consolidate_inputs = True
 
     def step(self, time: int, in_deltas: list[Delta]) -> Delta:
         raise NotImplementedError
@@ -468,6 +475,7 @@ class ColumnarGroupByOperator(Operator):
 
     _GROW = 1024
     _INT_GUARD = 1 << 62  # |sum| beyond this migrates to exact python ints
+    consolidate_inputs = False  # purely additive array state
 
     def __init__(self, gval_pos: list, reducer_cols: list):
         # gval_pos: row positions of the group-value columns
@@ -677,6 +685,10 @@ class ColumnarGroupByOperator(Operator):
 class JoinOperator(Operator):
     """Inner/left/right/outer join (reference: join_tables, dataflow.rs:2276).
 
+    Exact on unconsolidated input: upserts and absent-row retractions are
+    handled entry by entry, and a same-tick net-zero pair emits output
+    pairs that cancel downstream.
+
     ``lkey_fn/rkey_fn`` extract the join key from a row; output id =
     hash(join-side ids) like the reference (result key sharded like the join
     key, dataflow.rs:2371-2379); outer 'ears' appear when a side has no
@@ -711,6 +723,10 @@ class JoinOperator(Operator):
         # side) can collide across pairs — those joins keep the per-group
         # recompute path whose dict semantics dedupe collisions.
         self._bilinear = out_key_fn is None
+        # only the inner bilinear fast path fuses same-tick retract+insert
+        # pairs; other modes would forward an uncanceled net-zero pair to
+        # sinks as phantom delete+insert events, so they keep consolidation
+        self.consolidate_inputs = not (self._bilinear and mode == "inner")
         # live (lk, rk) pairs recur every tick in dimension joins: a dict
         # probe beats re-mixing 128-bit ints per emitted row
         self._mix_cache: dict = {}

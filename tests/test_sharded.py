@@ -501,3 +501,25 @@ def test_exchange_payload_pack_roundtrip():
     # non-entry lists and scalars pass through untouched
     assert _unpack_payload(_pack_payload({"xs": [1, 2], "s": "x"})) == \
         {"xs": [1, 2], "s": "x"}
+
+
+def test_no_phantom_events_for_netzero_pairs_sharded():
+    """A projection-collapsed net-zero pair must not surface phantom
+    delete+insert events from a sharded join to subscribers."""
+    t = T("""
+    k | keep | drop | _time | _diff
+    a | 1    | 10   | 2     | 1
+    a | 1    | 10   | 4     | -1
+    a | 1    | 11   | 4     | 1
+    """)
+    proj = t.select(t.k, t.keep)  # drops the changed column -> net-zero
+    lex = T("""
+    k | cat
+    a | x
+    """)
+    for mode in ("join", "join_left", "join_outer"):
+        j = getattr(proj, mode)(lex, proj.k == lex.k).select(
+            proj.keep, lex.cat)
+        caps1, _ = _run_n([j], 1)
+        capsN, _ = _run_n([j], N_WORKERS)
+        assert _stream(caps1[0]) == _stream(capsN[0]), mode
