@@ -70,60 +70,84 @@ class EncoderConfig:
 # init
 # ---------------------------------------------------------------------------
 
-def _dense_init(key, shape, scale=0.02):
+DENSE_INIT_SCALE = 0.02
+
+
+def _dense_init(key, shape, scale=DENSE_INIT_SCALE):
     return (jax.random.normal(key, shape, dtype=jnp.float32) * scale)
 
 
-def init_params(key, config: EncoderConfig) -> dict:
-    keys = iter(jax.random.split(key, 16 + config.layers * 16))
+def _build_params(config: EncoderConfig, dense, zeros, ones) -> dict:
+    """Parameter tree structure, parametric over the array factory — the
+    ONE place the encoder's shapes live (jax and host inits share it)."""
     H, I_, V = config.hidden, config.intermediate, config.vocab_size
     params: dict[str, Any] = {
         "embeddings": {
-            "token": _dense_init(next(keys), (V, H)),
-            "position": _dense_init(next(keys), (config.max_len, H)),
-            "token_type": _dense_init(next(keys), (config.type_vocab_size, H)),
-            "ln_scale": jnp.ones((H,), jnp.float32),
-            "ln_bias": jnp.zeros((H,), jnp.float32),
+            "token": dense((V, H)),
+            "position": dense((config.max_len, H)),
+            "token_type": dense((config.type_vocab_size, H)),
+            "ln_scale": ones((H,)),
+            "ln_bias": zeros((H,)),
         },
         "layers": [],
     }
     for _ in range(config.layers):
         layer = {
             "attn": {
-                "wq": _dense_init(next(keys), (H, H)),
-                "bq": jnp.zeros((H,), jnp.float32),
-                "wk": _dense_init(next(keys), (H, H)),
-                "bk": jnp.zeros((H,), jnp.float32),
-                "wv": _dense_init(next(keys), (H, H)),
-                "bv": jnp.zeros((H,), jnp.float32),
-                "wo": _dense_init(next(keys), (H, H)),
-                "bo": jnp.zeros((H,), jnp.float32),
-                "ln_scale": jnp.ones((H,), jnp.float32),
-                "ln_bias": jnp.zeros((H,), jnp.float32),
+                "wq": dense((H, H)), "bq": zeros((H,)),
+                "wk": dense((H, H)), "bk": zeros((H,)),
+                "wv": dense((H, H)), "bv": zeros((H,)),
+                "wo": dense((H, H)), "bo": zeros((H,)),
+                "ln_scale": ones((H,)),
+                "ln_bias": zeros((H,)),
             },
         }
         if config.num_experts > 0:
             E = config.num_experts
             layer["moe"] = {
-                "router": _dense_init(next(keys), (H, E)),
-                "w1": _dense_init(next(keys), (E, H, I_)),
-                "b1": jnp.zeros((E, I_), jnp.float32),
-                "w2": _dense_init(next(keys), (E, I_, H)),
-                "b2": jnp.zeros((E, H), jnp.float32),
-                "ln_scale": jnp.ones((H,), jnp.float32),
-                "ln_bias": jnp.zeros((H,), jnp.float32),
+                "router": dense((H, E)),
+                "w1": dense((E, H, I_)),
+                "b1": zeros((E, I_)),
+                "w2": dense((E, I_, H)),
+                "b2": zeros((E, H)),
+                "ln_scale": ones((H,)),
+                "ln_bias": zeros((H,)),
             }
         else:
             layer["mlp"] = {
-                "w1": _dense_init(next(keys), (H, I_)),
-                "b1": jnp.zeros((I_,), jnp.float32),
-                "w2": _dense_init(next(keys), (I_, H)),
-                "b2": jnp.zeros((H,), jnp.float32),
-                "ln_scale": jnp.ones((H,), jnp.float32),
-                "ln_bias": jnp.zeros((H,), jnp.float32),
+                "w1": dense((H, I_)),
+                "b1": zeros((I_,)),
+                "w2": dense((I_, H)),
+                "b2": zeros((H,)),
+                "ln_scale": ones((H,)),
+                "ln_bias": zeros((H,)),
             }
         params["layers"].append(layer)
     return params
+
+
+def init_params(key, config: EncoderConfig) -> dict:
+    keys = iter(jax.random.split(key, 16 + config.layers * 16))
+    return _build_params(
+        config,
+        dense=lambda shape: _dense_init(next(keys), shape),
+        zeros=lambda shape: jnp.zeros(shape, jnp.float32),
+        ones=lambda shape: jnp.ones(shape, jnp.float32))
+
+
+def init_params_host(seed: int, config: EncoderConfig) -> dict:
+    """init_params twin on numpy: same tree/shapes, host arrays, ZERO jax
+    backend touch — for driver entry points that must stay hang-proof when
+    the device tunnel is unhealthy (the caller's jit moves the arrays)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return _build_params(
+        config,
+        dense=lambda shape: (rng.normal(size=shape)
+                             * DENSE_INIT_SCALE).astype(np.float32),
+        zeros=lambda shape: np.zeros(shape, np.float32),
+        ones=lambda shape: np.ones(shape, np.float32))
 
 
 def param_pspecs(config: EncoderConfig) -> dict:
