@@ -1,0 +1,8 @@
+"""Alias module (reference: pathway/asynchronous.py — a top-level import shim):
+``import pathway_tpu.asynchronous`` resolves to the implementing module."""
+
+import sys
+
+from pathway_tpu.internals import udfs as _impl
+
+sys.modules[__name__] = _impl

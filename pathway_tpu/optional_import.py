@@ -1,0 +1,13 @@
+"""Optional-dependency import guard (reference: pathway/optional_import.py
+— same contract, pointing at this package's extras)."""
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def optional_imports(extra: str):
+    try:
+        yield
+    except ImportError as e:
+        raise ImportError(
+            f"{e}. Consider installing 'pathway-tpu[{extra}]'") from e

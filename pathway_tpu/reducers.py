@@ -1,0 +1,8 @@
+"""Alias module (reference: pathway/reducers.py — a top-level import shim):
+``import pathway_tpu.reducers`` resolves to the implementing module."""
+
+import sys
+
+from pathway_tpu.internals import reducers_frontend as _impl
+
+sys.modules[__name__] = _impl

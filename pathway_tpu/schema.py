@@ -1,0 +1,13 @@
+"""Top-level schema helpers (reference: pathway/schema.py)."""
+
+from pathway_tpu.internals.schema import (  # noqa: F401
+    ColumnDefinition,
+    Schema,
+    SchemaProperties,
+    column_definition,
+    schema_builder,
+    schema_from_csv,
+    schema_from_dict,
+    schema_from_pandas,
+    schema_from_types,
+)

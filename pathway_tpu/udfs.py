@@ -1,0 +1,8 @@
+"""Alias module (reference: pathway/udfs.py — a top-level import shim):
+``import pathway_tpu.udfs`` resolves to the implementing module."""
+
+import sys
+
+from pathway_tpu.internals import udfs as _impl
+
+sys.modules[__name__] = _impl

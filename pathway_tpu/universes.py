@@ -1,0 +1,8 @@
+"""Alias module (reference: pathway/universes.py — a top-level import shim):
+``import pathway_tpu.universes`` resolves to the implementing module."""
+
+import sys
+
+from pathway_tpu.internals import universes as _impl
+
+sys.modules[__name__] = _impl

@@ -11,7 +11,7 @@ import importlib
 __all__ = [
     "embedders", "llms", "parsers", "splitters", "rerankers",
     "vector_store", "question_answering", "servers",
-    "prompts", "_utils",
+    "prompts", "constants", "_typing", "_utils",
 ]
 
 

@@ -1,0 +1,14 @@
+"""Type aliases for the llm xpack (reference: xpacks/llm/_typing.py)."""
+
+from typing import Callable, Iterable, TypeAlias, Union
+
+import pathway_tpu as pw
+
+Doc: TypeAlias = dict[str, str | dict]
+
+DocTransformerCallable: TypeAlias = Union[
+    Callable[[Iterable[Doc]], Iterable[Doc]],
+    Callable[[Iterable[Doc], float], Iterable[Doc]],
+]
+
+DocTransformer: TypeAlias = Union[pw.udfs.UDF, DocTransformerCallable]

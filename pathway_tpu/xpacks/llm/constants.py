@@ -1,0 +1,3 @@
+"""Shared defaults (reference: xpacks/llm/constants.py)."""
+
+DEFAULT_VISION_MODEL = "gpt-4o"

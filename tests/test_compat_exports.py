@@ -280,3 +280,32 @@ def test_debug_parquet_and_dicts(tmp_path):
     G.clear()
     back = pw.debug.table_from_parquet(path)
     assert sorted(rows_of(back)) == [("bolt", 3), ("nut", 5)]
+
+
+def test_top_level_shim_modules_importable():
+    """Reference users write ``import pathway.udfs`` / ``from
+    pathway.schema import ...`` — the same module paths must resolve here
+    (reference top-level shims: udfs.py, reducers.py, asynchronous.py,
+    universes.py, schema.py, optional_import.py)."""
+    import importlib
+    import sys
+
+    import pathway_tpu as pw
+
+    for name in ("udfs", "reducers", "asynchronous", "universes"):
+        mod = importlib.import_module(f"pathway_tpu.{name}")
+        assert mod is getattr(pw, name)  # no default: attr must exist
+        assert mod is sys.modules[f"pathway_tpu.{name}"]
+    from pathway_tpu.xpacks import llm
+
+    assert llm.constants.DEFAULT_VISION_MODEL
+    from pathway_tpu.optional_import import optional_imports
+    from pathway_tpu.schema import Schema, schema_from_types
+
+    assert schema_from_types(x=int).column_names() == ["x"]
+    import pytest as _pytest
+
+    with _pytest.raises(ImportError, match="pathway-tpu"):
+        with optional_imports("xpack-llm"):
+            raise ImportError("no such module")
+    assert Schema is not None
