@@ -877,16 +877,27 @@ def _columnar_groupby_spec(gvals_exprs, reducers, ctx):
     if _COLUMNAR_GVAL_DTYPES is None:
         _COLUMNAR_GVAL_DTYPES = (
             _dt.INT, _dt.BOOL, _dt.STR, _dt.FLOAT, _dt.POINTER,
+            _dt.DATE_TIME_NAIVE, _dt.DATE_TIME_UTC, _dt.DURATION,
         )
+
+    def hashable_dtype(d) -> bool:
+        d = _dt.unoptionalize(d)
+        if d in _COLUMNAR_GVAL_DTYPES:
+            return True
+        # concrete scalar tuples (window keys: (instance, start, end))
+        # intern fine — tuple hashing over hashable members
+        if isinstance(d, _dt.Tuple):
+            return all(hashable_dtype(el) for el in d.args)
+        return False
+
     gval_pos = []
     for e in gvals_exprs:
         if isinstance(e, ex.IdExpression) or type(e) is not ex.ColumnReference:
             return None
         try:
-            d = _dt.unoptionalize(infer_dtype(e))
+            if not hashable_dtype(infer_dtype(e)):
+                return None
         except Exception:
-            return None
-        if d not in _COLUMNAR_GVAL_DTYPES:
             return None
         gval_pos.append(ctx.position(e))
     reducer_cols = []

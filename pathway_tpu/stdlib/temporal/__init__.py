@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Optional
 
+from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import expression as ex
 from pathway_tpu.internals import thisclass
 from pathway_tpu.internals.table import Table
@@ -203,6 +204,22 @@ class WindowedTable:
         return grouped.reduce(*new_args, **new_kwargs)
 
 
+def _tumbling_fast_path_ok(window: "TumblingWindow", time_e) -> bool:
+    """The arithmetic fast path needs numeric, non-optional event times
+    (a None time must DROP the row — the generic flatten path's ()
+    semantics); datetime times keep the generic path (their zero origin
+    is value-dependent)."""
+    from pathway_tpu.internals.type_inference import infer_dtype
+
+    try:
+        d = infer_dtype(time_e)
+    except Exception:
+        return False
+    if d != dt.unoptionalize(d):  # optional: None handling differs
+        return False
+    return dt.unoptionalize(d) in (dt.INT, dt.FLOAT)
+
+
 def windowby(table: Table, time_expr, *, window: Window, behavior=None,
              instance=None, origin=None) -> WindowedTable:
     """Assign rows to time windows, then reduce per window.
@@ -231,6 +248,24 @@ def windowby(table: Table, time_expr, *, window: Window, behavior=None,
         windowed = _assign_session_windows(table, time_e, window, inst_e)
     elif isinstance(window, IntervalsOverWindow):
         windowed = _assign_intervals_over(table, time_e, window, inst_e)
+    elif (isinstance(window, TumblingWindow)
+          and _tumbling_fast_path_ok(window, time_e)):
+        # exactly one window per row: no flatten, no per-row python — the
+        # assignment is plain column arithmetic (start = origin +
+        # ((t - origin) // d) * d, same semantics as TumblingWindow.assign)
+        origin = window.origin if window.origin is not None else (
+            window.offset if window.offset is not None else 0)
+        d = window.duration
+        start_e = origin + ((time_e - origin) // d) * d
+        end_e = start_e + d
+        windowed = table.with_columns(
+            _pw_time=time_e,
+            _pw_window_start=start_e,
+            _pw_window_end=end_e,
+            _pw_window=ex.MakeTupleExpression(
+                *( [inst_e] if instance_used else [] ), start_e, end_e),
+            **({"_pw_instance": inst_e} if instance_used else {}),
+        )
     else:
         assign = window.assign
 
@@ -245,12 +280,20 @@ def windowby(table: Table, time_expr, *, window: Window, behavior=None,
             **({"_pw_instance": inst_e} if instance_used else {}),
         )
         flat = with_windows.flatten(with_windows._pw_windows)
+        # start/end carry the time expression's dtype (the tuple-returning
+        # assign fn erases it to ANY): concrete dtypes here let the
+        # columnar groupby fast path serve window reduces
+        from pathway_tpu.internals.type_inference import infer_dtype
+
+        time_dt = dt.unoptionalize(infer_dtype(time_e))
+        start_e = ex.declare_type(time_dt, flat._pw_windows[0])
+        end_e = ex.declare_type(time_dt, flat._pw_windows[1])
         windowed = flat.with_columns(
-            _pw_window_start=flat._pw_windows[0],
-            _pw_window_end=flat._pw_windows[1],
+            _pw_window_start=start_e,
+            _pw_window_end=end_e,
             _pw_window=ex.MakeTupleExpression(
                 *( [flat._pw_instance] if instance_used else [] ),
-                flat._pw_windows[0], flat._pw_windows[1]),
+                start_e, end_e),
         ).without("_pw_windows")
 
     if behavior is not None:

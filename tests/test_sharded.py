@@ -523,3 +523,53 @@ def test_no_phantom_events_for_netzero_pairs_sharded():
         caps1, _ = _run_n([j], 1)
         capsN, _ = _run_n([j], N_WORKERS)
         assert _stream(caps1[0]) == _stream(capsN[0]), mode
+
+
+def test_tumbling_fast_path_matches_generic_assignment():
+    """The arithmetic tumbling fast path must emit exactly what the
+    generic flatten path does — pinned by comparing against
+    sliding(hop=duration), which is semantically identical tumbling but
+    takes the generic path (incl. retractions and negative times)."""
+    t = T("""
+    sensor | v | at  | _time | _diff
+    a      | 1 | -7  | 2     | 1
+    b      | 2 | 0   | 2     | 1
+    a      | 3 | 4   | 4     | 1
+    b      | 4 | 5   | 4     | 1
+    a      | 3 | 4   | 6     | -1
+    a      | 5 | 13  | 6     | 1
+    """)
+
+    def agg(win):
+        return pw.temporal.windowby(
+            t, t.at, window=win, instance=t.sensor,
+        ).reduce(
+            sensor=pw.this._pw_instance,
+            start=pw.this._pw_window_start,
+            end=pw.this._pw_window_end,
+            s=pw.reducers.sum(pw.this.v),
+        )
+
+    for kw in ({}, {"offset": 3}, {"origin": -2}):
+        fast = agg(pw.temporal.tumbling(4, **kw))
+        generic = agg(pw.temporal.sliding(hop=4, duration=4, **kw))
+        for n in (1, N_WORKERS):  # tuple-keyed sharding included
+            caps, _ = _run_n([fast, generic], n)
+            assert _stream(caps[0]) == _stream(caps[1]), (kw, n)
+            assert _snap(caps[0]) == _snap(caps[1]), (kw, n)
+
+
+def test_tumbling_fast_path_float_times():
+    t = T("""
+    v | at
+    1 | 0.5
+    2 | 3.9
+    3 | 4.1
+    """)
+    win = pw.temporal.windowby(
+        t, t.at + 0.0, window=pw.temporal.tumbling(2.0),
+    ).reduce(start=pw.this._pw_window_start,
+             s=pw.reducers.count())
+    caps, _ = _run_n([win], 1)
+    got = sorted(r for r in caps[0].snapshot().values())
+    assert got == [(0.0, 1), (2.0, 1), (4.0, 1)]
