@@ -29,7 +29,7 @@ from pathway_tpu.internals.expression_compiler import (
 )
 from pathway_tpu.internals.groupbys import split_reducers
 from pathway_tpu.internals.keys import (Pointer, canonical_shard_value,
-                                        hash_values)
+                                        hash_values, mix_pointers)
 from pathway_tpu.internals.table import Plan, Table
 
 
@@ -624,7 +624,11 @@ class GraphRunner:
                 return []
             out = []
             for i, elem in enumerate(val):
-                nk = hash_values(key, i)
+                # mix-derived child keys: parent keys are already uniform
+                # 128-bit digests, so the multiply-xor mix preserves
+                # uniformity at a fraction of a fresh blake2b per row
+                # (same rationale as join output keys, keys.py:147)
+                nk = mix_pointers(key, i)
                 nr = list(row)
                 nr[pos] = elem
                 if origin_id is not None:
