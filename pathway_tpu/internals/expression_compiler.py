@@ -203,15 +203,47 @@ class ExpressionCompiler:
         return fn
 
     # -- operators ----------------------------------------------------------
+
+    # vectorizable ops over non-optional numeric columns — elementwise
+    # array callables (BINARY_OPS' == / != are whole-array scalar equality
+    # for ndarrays, so they get explicit elementwise forms here).
+    # Division-family ops and exponent are excluded (zero divisors raise
+    # in python but produce inf/nan in numpy); int overflow guards below
+    # keep python's bigint semantics.
+    _NUMERIC_FAST_OPS = {
+        "+": np.add, "-": np.subtract, "*": np.multiply,
+        "<": np.less, "<=": np.less_equal,
+        ">": np.greater, ">=": np.greater_equal,
+        "==": np.equal, "!=": np.not_equal,
+    }
+    _INT_SAFE = 1 << 62
+    _FLOAT_EXACT = float(1 << 53)  # beyond this, int->float64 rounds
+
+    def _numeric_fast_eligible(self, expr) -> bool:
+        from pathway_tpu.internals.type_inference import infer_dtype
+
+        if expr._op not in self._NUMERIC_FAST_OPS:
+            return False
+        try:
+            ld = infer_dtype(expr._left)
+            rd = infer_dtype(expr._right)
+        except Exception:
+            return False
+        for d in (ld, rd):
+            if d != dt.unoptionalize(d):  # optional: None semantics
+                return False
+            if dt.unoptionalize(d) not in (dt.INT, dt.FLOAT):
+                return False
+        return True
+
     def _compile_BinaryExpression(self, expr):
         lf = self._compile(expr._left)
         rf = self._compile(expr._right)
         op = ops.BINARY_OPS[expr._op]
         opname = expr._op
+        fast = self._numeric_fast_eligible(expr)
 
-        def fn(keys, rows):
-            lv = lf(keys, rows)
-            rv = rf(keys, rows)
+        def slow(lv, rv):
             out = []
             for a, b in zip(lv, rv):
                 if a is ERROR or b is ERROR:
@@ -230,6 +262,55 @@ class ExpressionCompiler:
                         global_error_log().log(f"{opname} failed: {e!r}")
                         out.append(ERROR)
             return out
+
+        if not fast:
+            def fn(keys, rows):
+                return slow(lf(keys, rows), rf(keys, rows))
+
+            return fn
+
+        int_safe = self._INT_SAFE
+        float_exact = self._FLOAT_EXACT
+        arith = opname in ("+", "-", "*")
+        np_op = self._NUMERIC_FAST_OPS[opname]
+
+        def magnitude(a) -> float:
+            # NOT np.abs().max(): abs(INT64_MIN) wraps negative and would
+            # slip past the guard
+            return max(abs(float(a.max(initial=0))),
+                       abs(float(a.min(initial=0))))
+
+        def fn(keys, rows):
+            lv = lf(keys, rows)
+            rv = rf(keys, rows)
+            if len(lv) < 8:  # array setup dominates tiny batches
+                return slow(lv, rv)
+            try:
+                la = np.asarray(lv)
+                ra = np.asarray(rv)
+            except Exception:
+                return slow(lv, rv)
+            lk, rk = la.dtype.kind, ra.dtype.kind
+            if lk not in "if" or rk not in "if":
+                return slow(lv, rv)  # ERROR/None/bool cells present
+            if lk == "i" and rk == "i":
+                if arith:
+                    # keep python's arbitrary-precision ints:
+                    # near-overflow magnitudes fall back (int64 wraps)
+                    amax, bmax = magnitude(la), magnitude(ra)
+                    if opname == "*":
+                        if amax * bmax >= float(1 << 62):
+                            return slow(lv, rv)
+                    elif amax >= int_safe or bmax >= int_safe:
+                        return slow(lv, rv)
+            elif lk != rk:
+                # int-vs-float: numpy casts the int side to float64 first,
+                # while python compares/combines exactly — ints beyond
+                # 2^53 would round, so fall back
+                ints = la if lk == "i" else ra
+                if magnitude(ints) >= float_exact:
+                    return slow(lv, rv)
+            return np_op(la, ra).tolist()
 
         return fn
 

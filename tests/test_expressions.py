@@ -178,3 +178,52 @@ def test_ndarray_cells_roundtrip():
     r = t.select(v=pw.apply(lambda x: np.arange(3) * x, t.a))
     r2 = r.select(s=pw.apply_with_type(lambda v: float(v.sum()), float, r.v))
     assert rows_of(r2) == [(3.0,)]
+
+
+def test_numeric_fast_path_keeps_python_semantics():
+    """The vectorized numeric BinaryExpression path must be bit-compatible
+    with per-row python evaluation: bigint precision, mixed int/float,
+    comparisons, and ERROR cells falling back."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import schema as sch
+    from tests.utils import rows_of
+
+    big = (1 << 62) + 7
+    t = table_from_rows(
+        sch.schema_from_types(a=int, b=int, f=float),
+        [(big, big, 0.5), (3, 4, 1.5), (-5, 2, -2.0)] + [
+            (i, i + 1, float(i)) for i in range(100, 120)])
+    out = t.select(
+        s=t.a + t.b, p=t.a * t.b, lt=t.a < t.b, mixed=t.a + t.f)
+    rows = dict()
+    for s, p, lt, mixed in rows_of(out):
+        rows[s] = (p, lt, mixed)
+    # bigint addition/multiplication stayed exact (no int64 wrap)
+    assert rows[2 * big] == (big * big, False, big + 0.5)
+    assert rows[7] == (12, True, 3 + 1.5)
+
+
+def test_numeric_fast_path_edge_semantics():
+    """Edges the vectorized path must fall back on: elementwise ==/!=,
+    INT64_MIN magnitudes, and >2^53 ints compared against floats."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import schema as sch
+    from tests.utils import rows_of
+
+    int64_min = -(1 << 63)
+    huge = (1 << 53) + 1
+    t = table_from_rows(
+        sch.schema_from_types(a=int, b=int, f=float),
+        [(int64_min, 2, 1.0), (huge, huge, float(1 << 53))] + [
+            (i, i, float(i)) for i in range(100, 110)])
+    out = t.select(
+        eq=t.a == t.b, ne=t.a != t.b, d=t.a - t.b, gt=t.a > t.f)
+    got = sorted(rows_of(out))
+    # INT64_MIN subtraction stays exact python arithmetic
+    assert (False, True, int64_min - 2, False) in got
+    # 2^53+1 > 2^53 float: exact int/float comparison (numpy would round)
+    assert (True, False, 0, True) in got
+    # elementwise equality over the plain range rows
+    assert got.count((True, False, 0, False)) == 10
