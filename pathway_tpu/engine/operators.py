@@ -479,7 +479,7 @@ class ColumnarGroupByOperator(Operator):
 
     def __init__(self, gval_pos: list, reducer_cols: list):
         # gval_pos: row positions of the group-value columns
-        # reducer_cols: [("count", None) | ("sum", pos) | ("avg", pos)]
+        # reducer_cols: [("count", None) | ("sum"|"avg"|"min"|"max", pos)]
         self.gval_pos = list(gval_pos)
         self.reducer_cols = list(reducer_cols)
         # (slot, code) -> exact python-int total for groups whose sums
@@ -491,19 +491,27 @@ class ColumnarGroupByOperator(Operator):
         self._gkeys: list[Pointer] = []  # code -> output key (hashed once)
         self._last: list = []            # code -> last emitted row | None
         self._cnt = np.zeros(0, np.int64)
-        self._sums = [np.zeros(0, np.int64)
-                      for kind, _ in reducer_cols if kind != "count"]
-        # reducer index -> slot in self._sums (arrays are reallocated on
-        # growth, so emit indexes by slot, never by captured reference)
-        self._sum_slot = {}
+        # value-bearing reducers share one extraction slot order (the C
+        # gather returns one column per _val_pos entry); sums/avgs
+        # additionally own an int64 state array, min/max a per-group
+        # value-count multiset (exact under retraction)
+        self._val_slot: dict[int, int] = {}
+        self._sum_slot: dict[int, int] = {}
+        self._mm: dict[int, dict] = {}   # reducer idx -> {code: {val: n}}
         for i, (kind, _) in enumerate(reducer_cols):
-            if kind != "count":
+            if kind == "count":
+                continue
+            self._val_slot[i] = len(self._val_slot)
+            if kind in ("sum", "avg"):
                 self._sum_slot[i] = len(self._sum_slot)
+            else:  # min / max
+                self._mm[i] = {}
+        self._sums = [np.zeros(0, np.int64) for _ in self._sum_slot]
         # native-pass parameter tables (see native/fastgroup.cpp)
         self._gp = tuple(self.gval_pos)
         self._val_pos = tuple(
             reducer_cols[i][1]
-            for i in sorted(self._sum_slot, key=self._sum_slot.get))
+            for i in sorted(self._val_slot, key=self._val_slot.get))
         self._kinds = tuple(
             0 if kind == "count" else (2 if kind == "avg" else 1)
             for kind, _ in reducer_cols)
@@ -585,10 +593,24 @@ class ColumnarGroupByOperator(Operator):
         np.add.at(self._cnt, codes, diffs)
         touched = np.unique(codes)
         guard = self._INT_GUARD
+        # min/max multisets: one dict update per entry (exact retraction)
+        for i, groups in self._mm.items():
+            pos = self.reducer_cols[i][1]
+            vals = cols[self._val_slot[i]] if cols is not None else \
+                [e[1][pos] for e in entries]
+            for c, v, d in zip(codes.tolist(), vals, diffs.tolist()):
+                g = groups.get(c)
+                if g is None:
+                    g = groups[c] = {}
+                nc = g.get(v, 0) + d
+                if nc == 0:
+                    del g[v]
+                else:
+                    g[v] = nc
         for i, slot in self._sum_slot.items():
             pos = self.reducer_cols[i][1]
             arr = self._sums[slot]
-            vals = cols[slot] if cols is not None else \
+            vals = cols[self._val_slot[i]] if cols is not None else \
                 [e[1][pos] for e in entries]
             try:
                 col = np.asarray(vals, np.int64)
@@ -636,13 +658,32 @@ class ColumnarGroupByOperator(Operator):
         # pass over touched groups only (native when available)
         tl = touched.tolist()
         cnts = self._cnt[touched].tolist()
-        pcols = [self._sums[self._sum_slot[i]][touched].tolist()
-                 if kind != "count" else []
-                 for i, (kind, _pos) in enumerate(self.reducer_cols)]
+        pcols = []
+        for i, (kind, _pos) in enumerate(self.reducer_cols):
+            if kind == "count":
+                pcols.append([])
+            elif kind in ("min", "max"):
+                groups = self._mm[i]
+                agg = min if kind == "min" else max
+
+                def mm_of(c, _g=groups, _agg=agg):
+                    g = _g.get(c)
+                    if not g:
+                        return None
+                    # net-negative counts (a retraction seen ahead of its
+                    # insertion) are excluded, matching the row path's
+                    # _MultisetState.iter_args max(c, 0) semantics
+                    live = [v for v, cnt in g.items() if cnt > 0]
+                    return _agg(live) if live else None
+
+                pcols.append([mm_of(c) for c in tl])
+            else:
+                pcols.append(
+                    self._sums[self._sum_slot[i]][touched].tolist())
         big = self._big
         if big:
             for i, (kind, _pos) in enumerate(self.reducer_cols):
-                if kind == "count":
+                if kind not in ("sum", "avg"):
                     continue
                 slot = self._sum_slot[i]
                 col = pcols[i]

@@ -923,6 +923,19 @@ def _columnar_groupby_spec(gvals_exprs, reducers, ctx):
             reducer_cols.append(
                 ("avg" if name == "avg" else "sum", ctx.position(a)))
             continue
+        if name in ("min", "max") and len(r._args) == 1:
+            # multiset side-state in the columnar operator: exact under
+            # retraction, values must be hashable scalars
+            a = r._args[0]
+            if type(a) is not ex.ColumnReference:
+                return None
+            try:
+                if not hashable_dtype(infer_dtype(a)):
+                    return None
+            except Exception:
+                return None
+            reducer_cols.append((name, ctx.position(a)))
+            continue
         return None
     return gval_pos, reducer_cols
 

@@ -163,3 +163,55 @@ def test_same_tick_net_zero_pair_invisible_to_order_sensitive_reducers():
 
     [cap] = run_tables(t2)
     assert all(row[1] != 9 for _k, row, _t, _d in cap.events)
+
+
+def test_columnar_minmax_reducers_exact_under_retraction():
+    """min/max ride the columnar operator as multiset side-state and stay
+    exact through retractions, matching the row path."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.operators import ColumnarGroupByOperator
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+
+    G.clear()
+    rows = [
+        ("a", 5, 0, 1), ("a", 2, 0, 1), ("a", 9, 2, 1),
+        ("b", 7, 2, 1), ("a", 2, 4, -1), ("a", 9, 4, -1),
+    ]
+    t = table_from_rows(
+        sch.schema_from_types(k=str, v=int), rows, is_stream=True)
+    g = t.groupby(t.k).reduce(
+        t.k, lo=pw.reducers.min(t.v), hi=pw.reducers.max(t.v),
+        s=pw.reducers.sum(t.v))
+    runner = GraphRunner()
+    cap = runner.capture(g)
+    assert any(isinstance(n.op, ColumnarGroupByOperator)
+               for n in runner.graph.nodes)
+    runner.run_batch(n_workers=1)
+    snap = sorted(cap.snapshot().values())
+    # after retracting 2 and 9, group a holds only 5
+    assert snap == [("a", 5, 5, 5), ("b", 7, 7, 7)]
+    G.clear()
+
+
+def test_columnar_minmax_ignores_net_negative_counts():
+    """A retraction arriving ahead of its insertion must not surface its
+    value in min/max (row-path _MultisetState parity)."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+
+    G.clear()
+    rows = [("a", 5, 0, 1), ("a", 7, 0, 1), ("a", 2, 0, -1)]
+    t = table_from_rows(
+        sch.schema_from_types(k=str, v=int), rows, is_stream=True)
+    g = t.groupby(t.k).reduce(t.k, lo=pw.reducers.min(t.v))
+    runner = GraphRunner()
+    cap = runner.capture(g)
+    runner.run_batch(n_workers=1)
+    assert sorted(cap.snapshot().values()) == [("a", 5)]
+    G.clear()
