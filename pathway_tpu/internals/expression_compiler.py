@@ -219,6 +219,26 @@ class ExpressionCompiler:
     _INT_SAFE = 1 << 62
     _FLOAT_EXACT = float(1 << 53)  # beyond this, int->float64 rounds
 
+    @staticmethod
+    def _numeric_column(vals, pure_float: bool):
+        """np array for a fast path, or None to fall back. ``pure_float``
+        rejects float-kind arrays built from mixed runtime values: a
+        statically-FLOAT column may hold python ints (types_lca widening),
+        and coercing them would round >2^53 magnitudes and change per-row
+        result types where the op preserves them (negation, if_else
+        selection, exact int-vs-float comparison)."""
+        try:
+            a = np.asarray(vals)
+        except Exception:
+            return None
+        k = a.dtype.kind
+        if k not in "if":
+            return None  # ERROR/None/bool/bigint cells present
+        if k == "f" and pure_float and not all(
+                type(v) is float for v in vals):
+            return None
+        return a
+
     def _numeric_fast_eligible(self, expr) -> bool:
         from pathway_tpu.internals.type_inference import infer_dtype
 
@@ -285,14 +305,13 @@ class ExpressionCompiler:
             rv = rf(keys, rows)
             if len(lv) < 8:  # array setup dominates tiny batches
                 return slow(lv, rv)
-            try:
-                la = np.asarray(lv)
-                ra = np.asarray(rv)
-            except Exception:
+            # comparisons are exact between int and float in python but
+            # not after a float64 coercion, so they need pure columns
+            la = self._numeric_column(lv, pure_float=not arith)
+            ra = self._numeric_column(rv, pure_float=not arith)
+            if la is None or ra is None:
                 return slow(lv, rv)
             lk, rk = la.dtype.kind, ra.dtype.kind
-            if lk not in "if" or rk not in "if":
-                return slow(lv, rv)  # ERROR/None/bool cells present
             if lk == "i" and rk == "i":
                 if arith:
                     # keep python's arbitrary-precision ints:
@@ -317,12 +336,42 @@ class ExpressionCompiler:
     def _compile_UnaryExpression(self, expr):
         af = self._compile(expr._arg)
         op = ops.UNARY_OPS[expr._op]
+        fast_neg = False
+        if expr._op == "-":
+            from pathway_tpu.internals.type_inference import infer_dtype
 
-        def fn(keys, rows):
+            try:
+                d = infer_dtype(expr._arg)
+                fast_neg = (d == dt.unoptionalize(d)
+                            and dt.unoptionalize(d) in (dt.INT, dt.FLOAT))
+            except Exception:
+                fast_neg = False
+
+        def slow(vals):
             return [
                 ERROR if v is ERROR else (None if v is None else op(v))
-                for v in af(keys, rows)
+                for v in vals
             ]
+
+        if not fast_neg:
+            def fn(keys, rows):
+                return slow(af(keys, rows))
+
+            return fn
+
+        numcol = self._numeric_column
+
+        def fn(keys, rows):
+            vals = af(keys, rows)
+            if len(vals) < 8:
+                return slow(vals)
+            a = numcol(vals, pure_float=True)  # negation preserves types
+            if a is None:
+                return slow(vals)
+            if a.dtype.kind == "i" and a.size and \
+                    float(a.min(initial=0)) <= float(-(1 << 63)):
+                return slow(vals)  # -INT64_MIN overflows int64
+            return np.negative(a).tolist()
 
         return fn
 
@@ -346,15 +395,47 @@ class ExpressionCompiler:
         cf = self._compile(expr._if)
         tf = self._compile(expr._then)
         ef = self._compile(expr._else)
+        fast = False
+        try:
+            from pathway_tpu.internals.type_inference import infer_dtype
+
+            td = infer_dtype(expr._then)
+            ed = infer_dtype(expr._else)
+            fast = (td == ed  # same static kind or the per-row types mix
+                    and all(
+                        d == dt.unoptionalize(d)
+                        and dt.unoptionalize(d) in (dt.INT, dt.FLOAT)
+                        for d in (td, ed)))
+        except Exception:
+            fast = False
+
+        def slow(cond, tv, ev):
+            return [
+                ERROR if c is ERROR else (t if c else e)
+                for c, t, e in zip(cond, tv, ev)
+            ]
+
+        numcol = self._numeric_column
 
         def fn(keys, rows):
             cond = cf(keys, rows)
             tv = tf(keys, rows)
             ev = ef(keys, rows)
-            return [
-                ERROR if c is ERROR else (t if c else e)
-                for c, t, e in zip(cond, tv, ev)
-            ]
+            if not fast or len(cond) < 8:
+                return slow(cond, tv, ev)
+            try:
+                ca = np.asarray(cond)
+            except Exception:
+                return slow(cond, tv, ev)
+            if ca.dtype.kind != "b":  # ERROR cells in the condition
+                return slow(cond, tv, ev)
+            # selection preserves each value's own type, so both branches
+            # must be pure columns of the SAME kind
+            ta = numcol(tv, pure_float=True)
+            ea = numcol(ev, pure_float=True)
+            if ta is None or ea is None or ta.dtype.kind != ea.dtype.kind:
+                return slow(cond, tv, ev)
+            return np.where(ca, ta, ea).tolist()
 
         return fn
 

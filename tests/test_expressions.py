@@ -227,3 +227,60 @@ def test_numeric_fast_path_edge_semantics():
     assert (True, False, 0, True) in got
     # elementwise equality over the plain range rows
     assert got.count((True, False, 0, False)) == 10
+
+
+def test_ifelse_and_negation_fast_paths():
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import schema as sch
+    from tests.utils import rows_of
+
+    int64_min = -(1 << 63)
+    t = table_from_rows(
+        sch.schema_from_types(a=int, b=int),
+        [(int64_min, 1), (5, 2)] + [(i, i % 3) for i in range(100, 110)])
+    out = t.select(
+        neg=-t.a,                       # INT64_MIN negation stays exact
+        pick=pw.if_else(t.a > t.b, t.a, t.b),
+        # mixed int/float branches keep per-row types (fallback path)
+        mixed=pw.if_else(t.a > t.b, t.a, t.b * 0.5),
+    )
+    got = {r[0]: r for r in rows_of(out)}
+    assert got[-int64_min][0] == -int64_min          # python bigint
+    assert got[-5] == (-5, 5, 5)
+    assert got[-100] == (-100, 100, 100)
+    assert isinstance(got[-5][2], int)               # per-row type kept
+    weird = table_from_rows(
+        sch.schema_from_types(a=int, b=int),
+        [(1, 3)] + [(i, 1) for i in range(10)])
+    m = weird.select(v=pw.if_else(weird.a > weird.b, weird.a, weird.b * 0.5))
+    vals = [r[0] for r in rows_of(m)]
+    assert 0.5 in vals and isinstance(sorted(vals)[-1], (int, float))
+
+
+def test_fast_paths_reject_lca_widened_float_columns_with_runtime_ints():
+    """A statically-FLOAT column can hold python ints (types_lca); the
+    vectorized paths must fall back so >2^53 ints stay exact and keep
+    their per-row types (review r4 finding)."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import schema as sch
+    from tests.utils import rows_of
+
+    huge = (1 << 53) + 1
+    # mixed: FLOAT-typed column whose values are python ints and floats
+    t = table_from_rows(
+        sch.schema_from_types(c=bool, x=float, y=float),
+        [(True, huge, 0.5), (False, 3, 2.5)] + [
+            (bool(i % 2), float(i), float(i)) for i in range(100, 110)])
+    out = t.select(
+        n=-t.x,
+        sel=pw.if_else(t.c, t.x, t.y),
+        cmp=t.x > t.y,
+    )
+    got = {r[0]: r for r in rows_of(out)}
+    # huge int stays an exact int through negation and selection
+    assert got[-huge] == (-huge, huge, True)
+    assert isinstance(got[-huge][1], int)
+    assert got[-3] == (-3, 2.5, True)
+    assert isinstance(got[-3][0], int)
