@@ -49,8 +49,10 @@ static PyObject *gather(PyObject * /*self*/, PyObject *args) {
     Py_INCREF(d);
     PyList_SET_ITEM(diffs, i, d);
     for (Py_ssize_t j = 0; j < nv; j++) {
-      PyObject *v =
-          PyTuple_GET_ITEM(row, PyLong_AsSsize_t(PyTuple_GET_ITEM(val_pos, j)));
+      Py_ssize_t vp = PyLong_AsSsize_t(PyTuple_GET_ITEM(val_pos, j));
+      // vp == -1 extracts the ROW KEY (argmin/argmax payload default)
+      PyObject *v = vp < 0 ? PyTuple_GET_ITEM(e, 0)
+                           : PyTuple_GET_ITEM(row, vp);
       Py_INCREF(v);
       PyList_SET_ITEM(PyList_GET_ITEM(cols, j), i, v);
     }

@@ -27,7 +27,7 @@ from pathway_tpu.engine.delta import (
     row_fingerprint,
     upsert_delta,
 )
-from pathway_tpu.engine.reducers import make_reducer_state
+from pathway_tpu.engine.reducers import _orderable, make_reducer_state
 from pathway_tpu.internals.keys import (Pointer, canonical_shard_value,
                                         hash_values, mix_pointers)
 
@@ -492,16 +492,28 @@ class ColumnarGroupByOperator(Operator):
         self._last: list = []            # code -> last emitted row | None
         self._cnt = np.zeros(0, np.int64)
         # value-bearing reducers share one extraction slot order (the C
-        # gather returns one column per _val_pos entry); sums/avgs
-        # additionally own an int64 state array, min/max a per-group
-        # value-count multiset (exact under retraction)
-        self._val_slot: dict[int, int] = {}
+        # gather returns one column per _val_pos entry; -1 extracts the
+        # row key); sums/avgs additionally own an int64 state array,
+        # min/max/argmin/argmax a per-group value-count multiset (exact
+        # under retraction)
+        self._val_slot: dict[int, int] = {}   # reducer -> cmp/value slot
+        self._arg_slot: dict[int, int] = {}   # argminmax -> payload slot
         self._sum_slot: dict[int, int] = {}
         self._mm: dict[int, dict] = {}   # reducer idx -> {code: {val: n}}
-        for i, (kind, _) in enumerate(reducer_cols):
+        val_pos: list[int] = []
+        for i, (kind, pos) in enumerate(reducer_cols):
             if kind == "count":
                 continue
-            self._val_slot[i] = len(self._val_slot)
+            if kind in ("argmin", "argmax"):
+                cpos, ppos = pos
+                self._val_slot[i] = len(val_pos)
+                val_pos.append(cpos)
+                self._arg_slot[i] = len(val_pos)
+                val_pos.append(ppos)
+                self._mm[i] = {}
+                continue
+            self._val_slot[i] = len(val_pos)
+            val_pos.append(pos)
             if kind in ("sum", "avg"):
                 self._sum_slot[i] = len(self._sum_slot)
             else:  # min / max
@@ -509,9 +521,7 @@ class ColumnarGroupByOperator(Operator):
         self._sums = [np.zeros(0, np.int64) for _ in self._sum_slot]
         # native-pass parameter tables (see native/fastgroup.cpp)
         self._gp = tuple(self.gval_pos)
-        self._val_pos = tuple(
-            reducer_cols[i][1]
-            for i in sorted(self._val_slot, key=self._val_slot.get))
+        self._val_pos = tuple(val_pos)
         self._kinds = tuple(
             0 if kind == "count" else (2 if kind == "avg" else 1)
             for kind, _ in reducer_cols)
@@ -593,11 +603,23 @@ class ColumnarGroupByOperator(Operator):
         np.add.at(self._cnt, codes, diffs)
         touched = np.unique(codes)
         guard = self._INT_GUARD
-        # min/max multisets: one dict update per entry (exact retraction)
+        # min/max/argmin/argmax multisets: one dict update per entry
+        # (exact retraction)
         for i, groups in self._mm.items():
-            pos = self.reducer_cols[i][1]
-            vals = cols[self._val_slot[i]] if cols is not None else \
-                [e[1][pos] for e in entries]
+            kind, pos = self.reducer_cols[i]
+            if kind in ("argmin", "argmax"):
+                cpos, ppos = pos
+                if cols is not None:
+                    cvals = cols[self._val_slot[i]]
+                    pvals = cols[self._arg_slot[i]]
+                else:
+                    cvals = [e[1][cpos] for e in entries]
+                    pvals = [e[0] if ppos < 0 else e[1][ppos]
+                             for e in entries]
+                vals = list(zip(cvals, pvals))
+            else:
+                vals = cols[self._val_slot[i]] if cols is not None else \
+                    [e[1][pos] for e in entries]
             for c, v, d in zip(codes.tolist(), vals, diffs.tolist()):
                 g = groups.get(c)
                 if g is None:
@@ -677,6 +699,23 @@ class ColumnarGroupByOperator(Operator):
                     return _agg(live) if live else None
 
                 pcols.append([mm_of(c) for c in tl])
+            elif kind in ("argmin", "argmax"):
+                groups = self._mm[i]
+                agg = min if kind == "argmin" else max
+
+                def am_of(c, _g=groups, _agg=agg):
+                    g = _g.get(c)
+                    if not g:
+                        return None
+                    # ties break by orderable payload, exactly the row
+                    # path's _ArgMin/_ArgMaxState key functions
+                    best = _agg(
+                        ((cv, _orderable(pv), pv)
+                         for (cv, pv), cnt in g.items() if cnt > 0),
+                        default=None)
+                    return best[2] if best is not None else None
+
+                pcols.append([am_of(c) for c in tl])
             else:
                 pcols.append(
                     self._sums[self._sum_slot[i]][touched].tolist())

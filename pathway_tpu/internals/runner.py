@@ -936,6 +936,23 @@ def _columnar_groupby_spec(gvals_exprs, reducers, ctx):
                 return None
             reducer_cols.append((name, ctx.position(a)))
             continue
+        if name in ("argmin", "argmax") and len(r._args) in (1, 2):
+            # (cmp, payload) multiset; payload defaults to the row key
+            # (runner's argmin extract semantics, position -1)
+            positions = []
+            for a in r._args:
+                if type(a) is not ex.ColumnReference:
+                    return None
+                try:
+                    if not hashable_dtype(infer_dtype(a)):
+                        return None
+                except Exception:
+                    return None
+                positions.append(ctx.position(a))
+            if len(positions) == 1:
+                positions.append(-1)  # payload = row key
+            reducer_cols.append((name, tuple(positions)))
+            continue
         return None
     return gval_pos, reducer_cols
 

@@ -215,3 +215,56 @@ def test_columnar_minmax_ignores_net_negative_counts():
     runner.run_batch(n_workers=1)
     assert sorted(cap.snapshot().values()) == [("a", 5)]
     G.clear()
+
+
+def test_columnar_argminmax_matches_row_path():
+    """argmin/argmax ride the columnar operator; results (incl. key
+    payloads, tiebreaks, retractions) must equal the row path."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.operators import (ColumnarGroupByOperator,
+                                              GroupByOperator)
+    from pathway_tpu.internals import runner as _runner
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+
+    rows = [
+        ("a", 5, "x", 0, 1), ("a", 9, "y", 0, 1), ("a", 9, "z", 2, 1),
+        ("b", 1, "q", 2, 1), ("a", 9, "z", 4, -1),
+    ]
+
+    def run(force_row_path):
+        G.clear()
+        t = table_from_rows(
+            sch.schema_from_types(k=str, v=int, tag=str), rows,
+            is_stream=True)
+        from pathway_tpu.internals import expression as ex
+
+        g = t.groupby(t.k).reduce(
+            t.k,
+            best_tag=ex.ReducerExpression("argmax", t.v, t.tag),
+            lo_key=pw.reducers.argmin(t.v),
+        )
+        runner = GraphRunner()
+        cap = runner.capture(g)
+        kinds = {type(n.op) for n in runner.graph.nodes}
+        if force_row_path:
+            assert GroupByOperator in kinds
+        else:
+            assert ColumnarGroupByOperator in kinds
+        runner.run_batch(n_workers=1)
+        out = sorted(cap.snapshot().values())
+        G.clear()
+        return out
+
+    columnar = run(False)
+    orig = _runner._columnar_groupby_spec
+    _runner._columnar_groupby_spec = lambda *a, **k: None
+    try:
+        row = run(True)
+    finally:
+        _runner._columnar_groupby_spec = orig
+    assert columnar == row
+    # argmax of a: after retracting (9, z), tie between remaining 9=y
+    assert columnar[0][1] == "y"
