@@ -427,6 +427,24 @@ def coerce_value(value: Any, dtype: DType) -> Any:
         return float(value)
     if target is INT and isinstance(value, (float, np.floating)) and float(value).is_integer():
         return int(value)
+    if isinstance(value, str) and target in (INT, FLOAT, BOOL):
+        # textual connectors (csv/dsv) deliver strings; parse per schema
+        # (best-effort: unparseable text passes through unchanged). The
+        # bool vocabulary matches the DSV parser's (io/formats.py
+        # _parse_typed, data_format.rs:403) so csv and dsv agree.
+        try:
+            if target is INT:
+                return int(value)
+            if target is FLOAT:
+                return float(value)
+            low = value.strip().lower()
+            if low in ("true", "t", "yes", "y", "on", "1"):
+                return True
+            if low in ("false", "f", "no", "n", "off", "0"):
+                return False
+            return value
+        except ValueError:
+            return value
     if target is STR and not isinstance(value, str):
         return str(value)
     if target is BOOL and not isinstance(value, bool):

@@ -70,6 +70,30 @@ def _parse_typed(raw: str, dtype) -> Any:
     return raw
 
 
+def parse_payload(data: bytes, format: str, schema=None,
+                  dsv_separator: str = ",") -> list[dict]:
+    """Value-dicts from one object/file payload, per connector format —
+    shared by the fs reader and object stores (reference: S3 readers parse
+    csv/json/plaintext server-side objects the same way,
+    data_storage.rs)."""
+    if format == "binary":
+        return [{"data": data}]
+    text = data.decode("utf-8", errors="replace")
+    if format == "plaintext_by_file":
+        return [{"data": text}]
+    if format == "plaintext":
+        return [{"data": line} for line in text.splitlines()]
+    if format == "csv":
+        return list(_csv.DictReader(_io.StringIO(text)))
+    if format == "dsv":
+        parser = DsvParser(separator=dsv_separator, schema=schema)
+        return [ev.values for ev in parser.parse_lines(text)]
+    if format in ("json", "jsonlines"):
+        return [_json.loads(line) for line in text.splitlines()
+                if line.strip()]
+    raise ValueError(f"unknown format {format!r}")
+
+
 class DsvParser:
     """Header-driven DSV with a configurable delimiter.
 

@@ -45,33 +45,16 @@ def _parse_file(fpath: Path, format: str, schema, with_metadata: bool,
             "modified_at": int(st.st_mtime), "created_at": int(st.st_ctime),
             "seen_at": int(_time.time()),
         })
-    if format in ("plaintext", "plaintext_by_file", "binary"):
-        if format == "binary":
-            data = fpath.read_bytes()
-            rows = [{"data": data}]
-        elif format == "plaintext_by_file":
-            rows = [{"data": fpath.read_text()}]
-        else:
-            rows = [{"data": line} for line in fpath.read_text().splitlines()]
-    elif format == "csv":
-        with open(fpath, newline="") as f:
-            rows = list(_csv.DictReader(f))
-    elif format == "dsv":
-        from pathway_tpu.io.formats import DsvParser
-
-        parser = DsvParser(separator=dsv_separator, schema=schema)
-        rows = [ev.values for ev in parser.parse_lines(fpath.read_text())]
-    elif format == "parquet":
+    if format == "parquet":
         import pyarrow.parquet as pq
 
         rows = pq.read_table(str(fpath)).to_pylist()
-    elif format in ("json", "jsonlines"):
-        rows = []
-        for line in fpath.read_text().splitlines():
-            if line.strip():
-                rows.append(_json.loads(line))
     else:
-        raise ValueError(f"unknown format {format!r}")
+        # one format dispatcher for files and object stores alike
+        from pathway_tpu.io.formats import parse_payload
+
+        rows = parse_payload(fpath.read_bytes(), format, schema,
+                             dsv_separator=dsv_separator)
     for r in rows:
         if meta is not None:
             r["_metadata"] = meta

@@ -72,6 +72,68 @@ class S3Adapter:
         return self.client.get_object(path)
 
 
+from pathway_tpu.io._datasource import DataSource as _DataSource
+
+
+class S3FormatSource(_DataSource):
+    """Polling reader parsing object payloads through the format layer
+    (io/formats.parse_payload): csv/dsv/json/jsonlines/plaintext rows out
+    of listed objects, re-emitted on object change (reference:
+    S3GenericReader, data_storage.rs:2315)."""
+
+    name = "s3"
+
+    def __init__(self, adapter: "S3Adapter", format: str, schema, mode: str,
+                 with_metadata: bool, refresh_interval: float,
+                 dsv_separator: str = ",",
+                 autocommit_duration_ms: int | None = 1500):
+        super().__init__(schema, autocommit_duration_ms)
+        self.adapter = adapter
+        self.format = format
+        self.mode = mode
+        self.with_metadata = with_metadata
+        self.refresh_interval = refresh_interval
+        self.dsv_separator = dsv_separator
+
+    def run(self, session) -> None:
+        from pathway_tpu.internals.json import Json
+        from pathway_tpu.io.formats import parse_payload
+
+        seen: dict[str, tuple] = {}
+        emitted: dict[str, list] = {}
+        seq = 0
+        while not session.stop_requested:
+            for key_path, mtime, size in self.adapter.list_files():
+                # (mtime, size) signature: object-store timestamps have
+                # 1s granularity, so a same-second overwrite must still
+                # be picked up when the payload length moved
+                if seen.get(key_path) == (mtime, size):
+                    continue
+                raw = self.adapter.read_bytes(key_path)
+                values_list = parse_payload(
+                    raw, self.format, self.schema,
+                    dsv_separator=self.dsv_separator)
+                if self.with_metadata:
+                    meta = Json({"path": key_path, "size": size,
+                                 "modified_at": int(mtime)})
+                    for v in values_list:
+                        v["_metadata"] = meta
+                for k, row in emitted.pop(key_path, ()):  # re-emit changed
+                    session.push(k, row, -1)
+                rows = []
+                for values in values_list:
+                    k, row = self.row_to_engine(values, seq)
+                    seq += 1
+                    session.push(k, row, 1)
+                    rows.append((k, row))
+                emitted[key_path] = rows
+                seen[key_path] = (mtime, size)
+            if self.mode != "streaming":
+                return
+            if not session.sleep(self.refresh_interval):
+                return
+
+
 def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
          format: str = "binary", schema=None, mode: str = "streaming",
          with_metadata: bool = False, name: str | None = None,
@@ -113,10 +175,44 @@ def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
         if name is None:
             table._name = "s3_input"
         return table
-    raise NotImplementedError(
-        f"pw.io.s3.read format={format!r}: only 'binary' is wired through "
-        "the object-store path; parse csv/jsonlines downstream with the "
-        "format layer (pathway_tpu/io/formats.py)")
+    if format not in ("csv", "dsv", "json", "jsonlines", "plaintext",
+                      "plaintext_by_file"):
+        raise ValueError(f"pw.io.s3.read: unknown format {format!r}")
+    from pathway_tpu.internals import dtype as _dt
+    from pathway_tpu.internals import schema as _sch
+    from pathway_tpu.internals.table import Plan, Table
+    from pathway_tpu.internals.universe import Universe
+
+    if schema is None:
+        if format in ("plaintext", "plaintext_by_file"):
+            schema = _sch.schema_from_types(data=_dt.STR)
+        else:
+            raise ValueError(
+                f"pw.io.s3.read format={format!r} requires a schema")
+    if with_metadata and "_metadata" not in schema.column_names():
+        schema = schema | _sch.schema_from_types(_metadata=_dt.JSON)
+    cs = kwargs.get("csv_settings")
+    separator = ","
+    if cs is not None:
+        separator = (getattr(cs, "delimiter", None)
+                     or (cs.get("delimiter") if isinstance(cs, dict)
+                         else None) or ",")
+    source = S3FormatSource(
+        adapter, format, schema, mode, with_metadata, refresh_interval,
+        dsv_separator=separator,
+        autocommit_duration_ms=autocommit_duration_ms)
+    source.persistent_id = persistent_id or name
+    if mode == "static":
+        from pathway_tpu.io._datasource import CollectSession
+
+        sess = CollectSession()
+        source.run(sess)
+        keys = list(sess.state)
+        rows = [sess.state[k] for k in keys]
+        plan = Plan("static", keys=keys, rows=rows, times=None, diffs=None)
+        return Table(plan, schema, Universe(), name=name or "s3_static")
+    return Table(Plan("input", datasource=source), schema, Universe(),
+                 name=name or "s3_input")
 
 
 def write(*args, **kwargs):
