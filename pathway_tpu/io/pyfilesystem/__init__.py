@@ -123,11 +123,14 @@ class PyFilesystemSource(DataSource):
         return key, values
 
     def run(self, session: Session) -> None:
-        seen: dict[str, float] = {}
+        # (mtime, size) change signature: object-store timestamps have 1s
+        # granularity, so a same-second overwrite must still be noticed
+        # when the payload length moved
+        seen: dict[str, tuple] = {}
         emitted: dict[str, tuple] = {}
         while not session.stop_requested:
             for path, mtime, size in self.adapter.list_files():
-                if seen.get(path) == mtime and path in emitted:
+                if seen.get(path) == (mtime, size) and path in emitted:
                     continue
                 key, values = self._row_of(path, mtime, size)
                 _, row = self.row_to_engine(values, 0)
@@ -135,7 +138,7 @@ class PyFilesystemSource(DataSource):
                     session.push(key, emitted[path], -1)
                 session.push(key, row, 1)
                 emitted[path] = row
-                seen[path] = mtime
+                seen[path] = (mtime, size)
             if self.mode != "streaming":
                 return
             if not session.sleep(self.refresh_interval):
