@@ -150,13 +150,15 @@ class GDriveSource(DataSource):
                     out[f["id"]] = f
         return out
 
+    def _exceeds_size_limit(self, meta: dict) -> bool:
+        if self.object_size_limit is None:
+            return False
+        try:
+            return int(meta.get("size", 0)) > self.object_size_limit
+        except (TypeError, ValueError):
+            return False
+
     def _accepts(self, meta: dict) -> bool:
-        if self.object_size_limit is not None:
-            try:
-                if int(meta.get("size", 0)) > self.object_size_limit:
-                    return False
-            except (TypeError, ValueError):
-                pass
         pat = self.file_name_pattern
         if pat is None:
             return True
@@ -175,12 +177,21 @@ class GDriveSource(DataSource):
             prev = emitted.get(fid)
             if prev is not None and prev[0] == mtime:
                 continue
-            content = self._download(http, meta)
-            if content is None:
-                continue
+            if self._exceeds_size_limit(meta):
+                # reference semantics: oversized objects surface as empty
+                # rows whose metadata carries the size_limit_exceeded
+                # status instead of silently disappearing
+                content = b""
+            else:
+                content = self._download(http, meta)
+                if content is None:
+                    continue
             values = {"data": content}
             if self.with_metadata:
-                values["_metadata"] = Json(meta)
+                enriched = extend_metadata(dict(meta))
+                if self._exceeds_size_limit(meta):
+                    enriched["status"] = STATUS_SIZE_LIMIT_EXCEEDED
+                values["_metadata"] = Json(enriched)
             key, row = self.row_to_engine(values, self._seq)
             self._seq += 1
             if prev is not None:
@@ -275,3 +286,34 @@ def read(object_id: str, *,
 def write(*args, **kwargs):
     raise NotImplementedError(
         "pw.io.gdrive is read-only, matching the reference")
+
+
+# -- metadata enrichment helpers (reference: io/gdrive/__init__.py:44-70,
+# applied to raw Drive file metadata dicts) ---------------------------------
+
+STATUS_DOWNLOADED = "downloaded"
+STATUS_SIZE_LIMIT_EXCEEDED = "size_limit_exceeded"
+
+
+def add_seen_at(metadata: dict) -> dict:
+    metadata["seen_at"] = int(_time.time())
+    return metadata
+
+
+def add_url(metadata: dict) -> dict:
+    metadata["url"] = f"https://drive.google.com/file/d/{metadata['id']}/"
+    return metadata
+
+
+def add_path(metadata: dict) -> dict:
+    metadata["path"] = metadata["name"]
+    return metadata
+
+
+def add_status(metadata: dict) -> dict:
+    metadata["status"] = STATUS_DOWNLOADED
+    return metadata
+
+
+def extend_metadata(metadata: dict) -> dict:
+    return add_status(add_seen_at(add_path(add_url(metadata))))

@@ -59,11 +59,24 @@ class KafkaSource(DataSource):
             return self._run_native(session)
         from kafka import KafkaConsumer, TopicPartition  # type: ignore
 
+        extra = {}
+        if self.settings.get("security.protocol"):
+            # rdkafka-style names -> kafka-python kwargs (SASL/SSL paths
+            # like Upstash; the in-repo wire client is plaintext-only)
+            extra["security_protocol"] = \
+                self.settings["security.protocol"].upper()
+        if self.settings.get("sasl.mechanism"):
+            extra["sasl_mechanism"] = self.settings["sasl.mechanism"]
+        if self.settings.get("sasl.username") is not None:
+            extra["sasl_plain_username"] = self.settings["sasl.username"]
+        if self.settings.get("sasl.password") is not None:
+            extra["sasl_plain_password"] = self.settings["sasl.password"]
         consumer = KafkaConsumer(
             self.topic,
             bootstrap_servers=self.settings.get("bootstrap.servers"),
             group_id=self.settings.get("group.id"),
             auto_offset_reset=self.settings.get("auto.offset.reset", "earliest"),
+            **extra,
         )
         seq = 0
 
@@ -320,3 +333,65 @@ def write(table: Table, rdkafka_settings: dict, topic_name: str, *,
             runner.subscribe(table, callback)
 
     G.add_output(binder)
+
+
+def check_raw_and_plaintext_only_kwargs(f):
+    """Decorator rejecting key/value/headers kwargs outside raw/plaintext
+    formats (reference: io/kafka/__init__.py:499)."""
+    import functools
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        if kwargs.get("format") not in ("raw", "plaintext"):
+            for param in ("key", "value", "headers"):
+                if kwargs.get(param) is not None:
+                    raise ValueError(
+                        f"Unsupported argument for "
+                        f"{kwargs.get('format')} format: {param}")
+        return f(*args, **kwargs)
+
+    return wrapper
+
+
+def simple_read(server: str, topic: str, *, read_only_new: bool = False,
+                schema=None, format: str = "raw",
+                autocommit_duration_ms: int | None = 1500,
+                **kwargs) -> Table:
+    """One-server convenience reader (reference: io/kafka/__init__.py:291):
+    anonymous consumer, offset reset per ``read_only_new``."""
+    settings = {
+        "bootstrap.servers": server,
+        "group.id": None,  # anonymous: no consumer-group coordination
+        "session.timeout.ms": "6000",
+        "enable.auto.commit": "false",
+        "auto.offset.reset": "latest" if read_only_new else "earliest",
+    }
+    return read(settings, topic, schema=schema, format=format,
+                autocommit_duration_ms=autocommit_duration_ms, **kwargs)
+
+
+write = check_raw_and_plaintext_only_kwargs(write)  # reference guard
+
+
+def read_from_upstash(endpoint: str, username: str, password: str,
+                      topic: str, *, read_only_new: bool = False,
+                      schema=None, format: str = "raw",
+                      autocommit_duration_ms: int | None = 1500,
+                      **kwargs) -> Table:
+    """Upstash-hosted Kafka (reference: io/kafka/__init__.py:388):
+    SASL-SCRAM over SSL settings filled in. The in-repo wire-protocol
+    client speaks plaintext only, so this path requires kafka-python for
+    the authenticated connection."""
+    settings = {
+        "bootstrap.servers": endpoint,
+        "group.id": username,
+        "session.timeout.ms": "6000",
+        "sasl.username": username,
+        "sasl.password": password,
+        "sasl.mechanism": "SCRAM-SHA-256",
+        "security.protocol": "sasl_ssl",
+        "enable.auto.commit": "false",
+        "auto.offset.reset": "latest" if read_only_new else "earliest",
+    }
+    return read(settings, topic, schema=schema, format=format,
+                autocommit_duration_ms=autocommit_duration_ms, **kwargs)

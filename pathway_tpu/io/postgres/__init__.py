@@ -74,3 +74,17 @@ def write(table: Table, postgres_settings: dict, table_name: str, *,
         runner.subscribe(table, callback)
 
     G.add_output(binder)
+
+
+def write_snapshot(table: Table, postgres_settings: dict, table_name: str,
+                   primary_key: list[str], *,
+                   max_batch_size: int | None = None,
+                   name: str | None = None,
+                   init_mode: str = "default", **kwargs) -> None:
+    """Maintain a Postgres table as the CURRENT SNAPSHOT of ``table``
+    (upserts keyed by ``primary_key``; reference:
+    io/postgres/__init__.py write_snapshot)."""
+    return write(table, postgres_settings, table_name,
+                 output_table_type="snapshot", primary_key=primary_key,
+                 max_batch_size=max_batch_size, name=name,
+                 init_mode=init_mode, **kwargs)

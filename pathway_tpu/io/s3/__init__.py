@@ -219,3 +219,54 @@ def write(*args, **kwargs):
     raise NotImplementedError(
         "pw.io.s3 is read-only, matching the reference (S3 readers exist "
         "in data_storage.rs; deltalake/persistence handle S3 writes)")
+
+
+@dataclass
+class DigitalOceanS3Settings:
+    """DigitalOcean Spaces connection settings (reference:
+    io/s3/__init__.py:22). Spaces speak the S3 protocol at
+    ``https://<region>.digitaloceanspaces.com``."""
+
+    bucket_name: str | None = None
+    access_key: str | None = None
+    secret_access_key: str | None = None
+    region: str | None = None
+
+    def _as_aws(self) -> AwsS3Settings:
+        return AwsS3Settings(
+            bucket_name=self.bucket_name, access_key=self.access_key,
+            secret_access_key=self.secret_access_key, region=self.region,
+            endpoint=f"https://{self.region}.digitaloceanspaces.com")
+
+
+@dataclass
+class WasabiS3Settings:
+    """Wasabi connection settings (reference: io/s3/__init__.py:57);
+    S3-compatible at ``https://s3.<region>.wasabisys.com``."""
+
+    bucket_name: str | None = None
+    access_key: str | None = None
+    secret_access_key: str | None = None
+    region: str | None = None
+
+    def _as_aws(self) -> AwsS3Settings:
+        return AwsS3Settings(
+            bucket_name=self.bucket_name, access_key=self.access_key,
+            secret_access_key=self.secret_access_key, region=self.region,
+            endpoint=f"https://s3.{self.region}.wasabisys.com")
+
+
+def read_from_digital_ocean(path: str,
+                            do_s3_settings: DigitalOceanS3Settings,
+                            format: str, **kwargs):
+    """S3 read against DigitalOcean Spaces (reference:
+    io/s3/__init__.py:290)."""
+    return read(path, aws_s3_settings=do_s3_settings._as_aws(),
+                format=format, **kwargs)
+
+
+def read_from_wasabi(path: str, wasabi_s3_settings: WasabiS3Settings,
+                     format: str, **kwargs):
+    """S3 read against Wasabi (reference: io/s3/__init__.py:407)."""
+    return read(path, aws_s3_settings=wasabi_s3_settings._as_aws(),
+                format=format, **kwargs)

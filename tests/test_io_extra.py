@@ -445,3 +445,55 @@ def test_streaming_join_against_static_dimension(tmp_path):
         time.sleep(0.1)
     assert ("widget", "tools", True) in seen
     assert ("gizmo", "toys", True) in seen
+
+
+def test_reference_convenience_wrappers():
+    """Thin reference-surface wrappers: kafka simple_read/upstash settings,
+    s3 DigitalOcean/Wasabi endpoints, postgres write_snapshot alias,
+    gdrive metadata enrichment."""
+    import pathway_tpu as pw
+
+    # kafka: settings construction (no broker needed — inspect the source)
+    t = pw.io.kafka.simple_read("srv:9092", "top", read_only_new=True)
+    src = t._plan.params["datasource"]
+    assert src.settings["bootstrap.servers"] == "srv:9092"
+    assert src.settings["auto.offset.reset"] == "latest"
+    t2 = pw.io.kafka.read_from_upstash("up:9092", "user", "pw", "top")
+    s2 = t2._plan.params["datasource"].settings
+    assert s2["security.protocol"] == "sasl_ssl"
+    assert s2["sasl.mechanism"] == "SCRAM-SHA-256"
+
+    @pw.io.kafka.check_raw_and_plaintext_only_kwargs
+    def fake_write(table, **kwargs):
+        return "ok"
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="key"):
+        fake_write(None, format="json", key="k")
+    assert fake_write(None, format="raw", key="k") == "ok"
+
+    # s3 settings map to the provider endpoints
+    do = pw.io.s3.DigitalOceanS3Settings(
+        bucket_name="b", access_key="a", secret_access_key="s",
+        region="ams3")
+    assert do._as_aws().endpoint == "https://ams3.digitaloceanspaces.com"
+    wa = pw.io.s3.WasabiS3Settings(
+        bucket_name="b", access_key="a", secret_access_key="s",
+        region="us-west-1")
+    assert wa._as_aws().endpoint == "https://s3.us-west-1.wasabisys.com"
+
+    # gdrive metadata enrichment
+    meta = pw.io.gdrive.extend_metadata({"id": "f1", "name": "doc.txt"})
+    assert meta["url"].endswith("/f1/")
+    assert meta["path"] == "doc.txt"
+    assert meta["status"] == pw.io.gdrive.STATUS_DOWNLOADED
+    assert isinstance(meta["seen_at"], int)
+
+    # postgres write_snapshot delegates to write(output_table_type=snapshot)
+    try:
+        import psycopg2  # noqa: F401
+    except ImportError:
+        with _pytest.raises(ImportError, match="psycopg2"):
+            pw.io.postgres.write_snapshot(
+                pw.debug.table_from_markdown("a\n1"), {}, "t", ["a"])
