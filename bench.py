@@ -59,10 +59,21 @@ def _encoder_flops_per_token(config) -> float:
     return float(L * per_layer + attn)
 
 
+_LEG_FNS = {
+    "embed": lambda: bench_embed(),
+    "framework": lambda: bench_embed_framework(),
+    "knn": lambda: bench_knn(),
+}
+
+
 def _run_device_legs_child() -> None:
-    """Child-process entry: backend init + embed + knn legs. Prints a JSON
-    snapshot line after EVERY leg (the parent takes the last parseable
-    line), so a hang mid-knn can't discard a completed embed measurement."""
+    """Child-process entry: backend init + the legs named in
+    ``_BENCH_DEVICE_LEGS``. Prints a JSON snapshot line after EVERY leg
+    (the parent takes the last parseable line), so a hang mid-leg can't
+    discard an earlier completed measurement."""
+    legs = [leg for leg in
+            os.environ.get("_BENCH_DEVICE_LEGS", "").split(",")
+            if leg and leg not in SKIP]
     result: dict = {}
     try:
         import jax
@@ -75,74 +86,80 @@ def _run_device_legs_child() -> None:
                       f"{str(e)[:300]}"}), flush=True)
         return
     print(json.dumps(result), flush=True)
-    if "embed" not in SKIP:
+    for leg in legs:
         try:
-            result.update(bench_embed())
+            result.update(_LEG_FNS[leg]())
         except Exception as e:  # noqa: BLE001
-            result["embed_error"] = f"{type(e).__name__}: {str(e)[:300]}"
-        print(json.dumps(result), flush=True)
-    if "knn" not in SKIP:
-        try:
-            result.update(bench_knn())
-        except Exception as e:  # noqa: BLE001
-            result["knn_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+            result[f"{leg}_error"] = f"{type(e).__name__}: {str(e)[:300]}"
         print(json.dumps(result), flush=True)
 
 
-def _run_device_legs() -> dict:
-    """Run the device-dependent legs in a killable subprocess.
-
-    The first device touch on a tunneled dev chip can fail
-    (``Unable to initialize backend 'axon'``) or block forever inside
-    PJRT client setup, where neither SIGALRM nor Python-level retry can
-    reach it — round 3's artifact died both ways. A subprocess with a
-    hard timeout turns every failure mode into a JSON ``error`` field.
-    """
+def _probe_backend() -> str | None:
+    """Return None when the device backend answers, else an error string.
+    Spaced retries: a tunnel that's unhealthy at one instant often
+    recovers within minutes — round 4 lost its whole TPU record to a
+    single unhealthy window."""
     import subprocess
     import sys
 
-    # Fast probe first: a hung tunnel should cost minutes, not the full
-    # device budget. Bounded retries — transient init failures recover.
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 240.0))
+    tries = int(os.environ.get("BENCH_PROBE_TRIES", 4))
+    base = (10.0, 45.0)
+    delays = tuple(base[i] if i < len(base) else 90.0
+                   for i in range(max(0, tries - 1)))
     probe_err = None
-    for attempt in range(3):
+    for attempt in range(len(delays) + 1):
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; print(len(jax.devices()))"],
                 capture_output=True, text=True, timeout=probe_timeout)
             if probe.returncode == 0:
-                probe_err = None
-                break
+                return None
             tail = probe.stderr.strip().splitlines()
             probe_err = f"backend probe rc={probe.returncode}: " \
                         + " | ".join(tail[-2:])
         except subprocess.TimeoutExpired:
             probe_err = (f"backend probe hung past {probe_timeout:.0f}s "
                          "(device tunnel unhealthy)")
-        if attempt < 2:
-            time.sleep(10.0)
-    if probe_err is not None:
-        return {"error": probe_err[:400]}
+        if attempt < len(delays):
+            time.sleep(delays[attempt])
+    return probe_err[:400]
+
+
+def _run_leg_group(legs: list[str], timeout_s: float) -> dict:
+    """Run one group of device legs in a killable subprocess.
+
+    The first device touch on a tunneled dev chip can fail
+    (``Unable to initialize backend 'axon'``) or block forever inside
+    PJRT client setup, where neither SIGALRM nor Python-level retry can
+    reach it — round 3's artifact died both ways. A subprocess with a
+    hard timeout turns every failure mode into a JSON ``error`` field,
+    and separate groups (embed vs knn) mean a hang in one cannot void
+    the other's measurement.
+    """
+    import subprocess
+    import sys
 
     last_err = "device legs never ran"
     for attempt in range(DEVICE_TRIES):
-        env = dict(os.environ, _BENCH_DEVICE_CHILD="1")
+        env = dict(os.environ, _BENCH_DEVICE_CHILD="1",
+                   _BENCH_DEVICE_LEGS=",".join(legs))
         try:
             proc = subprocess.run(
                 [sys.executable, "-u", os.path.abspath(__file__)],
                 env=env, capture_output=True, text=True,
-                timeout=DEVICE_TIMEOUT_S)
+                timeout=timeout_s)
         except subprocess.TimeoutExpired as e:
             # salvage the last snapshot line — completed legs survive a
             # hang in a later leg
             salvaged = _last_json_line(e.stdout)
             if salvaged is not None:
                 salvaged["device_hang_error"] = (
-                    f"device legs exceeded {DEVICE_TIMEOUT_S:.0f}s; "
+                    f"legs {legs} exceeded {timeout_s:.0f}s; "
                     "kept legs completed before the hang")
                 return salvaged
-            last_err = (f"device legs exceeded {DEVICE_TIMEOUT_S:.0f}s "
+            last_err = (f"legs {legs} exceeded {timeout_s:.0f}s "
                         "(backend hang?)")
             continue
         out = _last_json_line(proc.stdout)
@@ -155,6 +172,26 @@ def _run_device_legs() -> dict:
         last_err = (f"device-leg subprocess rc={proc.returncode}: "
                     + " | ".join(tail[-3:]))[:400]
     return {"error": last_err}
+
+
+def _run_device_legs() -> dict:
+    """Probe, then run embed(+framework) and knn as separately salvageable
+    subprocess groups."""
+    probe_err = _probe_backend()
+    if probe_err is not None:
+        return {"error": probe_err}
+    groups = [g for g in
+              ([leg for leg in ("embed", "framework") if leg not in SKIP],
+               [leg for leg in ("knn",) if leg not in SKIP]) if g]
+    result: dict = {}
+    for group in groups:
+        out = _run_leg_group(group, DEVICE_TIMEOUT_S)
+        for k, v in out.items():
+            if k in ("error", "device_hang_error"):
+                result[f"{'_'.join(group)}_{k}"] = v
+            else:
+                result[k] = v
+    return result
 
 
 def _last_json_line(stdout) -> dict | None:
@@ -183,15 +220,17 @@ def main() -> None:
     result: dict = {}
     errors: dict = {}
 
-    if not ({"embed", "knn"} <= SKIP):
-        dev = _run_device_legs()
-        for k, v in dev.items():
-            (errors if k.endswith("error") else result)[k] = v
+    # CPU legs first: they always produce numbers, and the minutes they
+    # take give a flaky device tunnel time to recover before the probe
     if "etl" not in SKIP:
         try:
             result.update(bench_etl())
         except Exception as e:  # noqa: BLE001
             errors["etl_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    if not ({"embed", "framework", "knn"} <= SKIP):
+        dev = _run_device_legs()
+        for k, v in dev.items():
+            (errors if k.endswith("error") else result)[k] = v
 
     # value/vs_baseline are null — not a real-looking 0.0 — when the
     # embed leg never produced a measurement
@@ -336,6 +375,110 @@ def bench_embed() -> dict:
     }
 
 
+def bench_embed_framework(n_docs: int | None = None) -> dict:
+    """BASELINE config 2 measured through the ACTUAL framework: a docs
+    Table streamed tick-by-tick through VectorStoreServer's graph
+    (parse UDF → flatten → split UDF → flatten → JaxEncoderEmbedder
+    batch-UDF → engine external index add) under GraphRunner, with one
+    retrieval query answered against the built index.
+
+    Reference counterpart: xpacks/llm/vector_store.py:214-292
+    (sources→parse→split→embed→index). ``framework_docs_per_s`` vs the
+    raw-kernel ``docs_per_s`` is the engine overhead this round is
+    shrinking; both ride the same encoder shape + WordPiece tokenizer.
+    """
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.json import Json
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+    from pathway_tpu.stdlib.indexing import (
+        default_brute_force_knn_document_index,
+    )
+    from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    if n_docs is None:
+        n_docs = int(os.environ.get("BENCH_FRAMEWORK_DOCS", BATCH * 8))
+    n_ticks = max(1, n_docs // BATCH)
+
+    emb = _make_framework_embedder(JaxEncoderEmbedder)
+
+    G.clear()
+    schema = sch.schema_from_types(data=str, _metadata=pw.Json)
+    docs_rows = [(doc, Json({"path": f"/d{i}.txt"}),
+                  (i * n_ticks) // n_docs * 2, 1)
+                 for i, doc in enumerate(make_docs(n_docs))]
+    docs = table_from_rows(schema, docs_rows, is_stream=True)
+
+    store = VectorStoreServer(
+        docs, embedder=emb,
+        index_builder=lambda chunks: default_brute_force_knn_document_index(
+            chunks.text, chunks, embedder=emb,
+            dimensions=emb.get_embedding_dimension(),
+            reserved_space=n_docs + 64, dtype="bfloat16"))
+    qschema = sch.schema_from_types(
+        query=str, k=int, metadata_filter=type(None),
+        filepath_globpattern=type(None))
+    queries = table_from_rows(
+        qschema, [("word1 word2 word3", 3, None, None)])
+    res = store.retrieve_query(queries)
+    runner = GraphRunner()
+    cap = runner.capture(res)
+
+    # pre-compile the encoder at the exact (BATCH, bucket) shape the timed
+    # run will use, so the measurement is throughput, not XLA compile time
+    # (the raw leg equally excludes its warmup dispatches)
+    warm = make_docs(BATCH, seed=1)
+    emb.embed_batch(warm)
+    emb.embed_batch(warm)
+    emb.embed_batch(["word1 word2 word3"])  # the (1, bucket) query shape
+
+    t0 = time.perf_counter()
+    runner.run_batch(n_workers=1)
+    dt = time.perf_counter() - t0
+    G.clear()
+
+    final = [row for _, row, _, diff in cap.events if diff > 0]
+    assert final, "framework retrieval produced no output rows"
+    reply = final[-1][0]
+    matches = reply.value if hasattr(reply, "value") else reply
+    assert matches, f"framework retrieval produced no matches: {reply!r}"
+    return {
+        "framework_docs_per_s": round(n_docs / dt, 1),
+        "framework_n_docs": n_docs,
+        "framework_ticks": n_ticks,
+    }
+
+
+def _make_framework_embedder(cls):
+    """JaxEncoderEmbedder at the flagship shape: real BGE checkpoint when
+    on disk, otherwise random weights at the exact BGE shape with the real
+    WordPiece algorithm over a synthetic vocab (same policy as
+    bench_embed). max_batch_size pins the per-dispatch shape so one
+    compile serves the whole run."""
+    import jax
+
+    from pathway_tpu.models.encoder import EncoderConfig, init_params
+    from pathway_tpu.models.hf_loader import find_local_checkpoint
+    from pathway_tpu.models.tokenizer import (WordPieceTokenizer,
+                                              make_synthetic_vocab)
+
+    if find_local_checkpoint("BAAI/bge-small-en-v1.5"):
+        return cls(model="BAAI/bge-small-en-v1.5", max_len=SEQ,
+                   max_batch_size=BATCH)
+    config = EncoderConfig.bge_small()
+    return cls(
+        config=config,
+        params=init_params(jax.random.PRNGKey(0), config),
+        tokenizer=WordPieceTokenizer(
+            make_synthetic_vocab([f"word{i}" for i in range(4096)],
+                                 vocab_size=config.vocab_size),
+            max_len=SEQ),
+        max_len=SEQ, max_batch_size=BATCH)
+
+
 def bench_etl(n_rows: int = 100_000) -> dict:
     """Streaming ETL rows/sec: WordCount + dimension join over 50 ticks
     (the reference's headline WordCount benchmark shape, README.md:244-250),
@@ -454,19 +597,32 @@ def bench_etl(n_rows: int = 100_000) -> dict:
         G.clear()
         return n_rows / dt
 
+    cores = os.cpu_count() or 1
     r1, exchanged_nodes = run_once(1)
     r8, _ = run_once(8)
-    return {
+    # honest scaling presentation: an 8-worker figure on fewer than 8
+    # cores measures timesharing, not scaling — label it so (round-4
+    # reviewer note), and report a per-core figure from a fit run
+    fit_workers = min(8, cores)
+    out = {
         "etl_rows_per_s_1w": round(r1, 0),
         "etl_rows_per_s_8w": round(r8, 0),
+        "etl_8w_oversubscribed": cores < 8,
         "etl_windowed_rows_per_s": round(run_windowed(), 0),
         "etl_n_rows": n_rows,
         "etl_ticks": n_ticks,
-        "etl_n_cores": os.cpu_count(),
+        "etl_n_cores": cores,
         # cluster barrier count per tick = exchanged nodes (BSP rounds)
         "etl_exchange_rounds_per_tick": exchanged_nodes,
         **bench_exchange(),
     }
+    if fit_workers > 1:
+        rN, _ = run_once(fit_workers) if fit_workers != 8 else (r8, 0)
+        out[f"etl_rows_per_s_{fit_workers}w"] = round(rN, 0)
+        out["etl_rows_per_s_per_core"] = round(rN / fit_workers, 0)
+    else:
+        out["etl_rows_per_s_per_core"] = round(r1, 0)
+    return out
 
 
 def _dispatch_floor_ms() -> float:

@@ -320,6 +320,64 @@ class NAryConcatOperator(Operator):
         return self.combine_rows(present)
 
 
+_ARRAY_SUM_DEVICE_MIN: int | None = None
+# ticks smaller than this skip the device pre-pass outright (not worth
+# the per-entry extract scan); tests lower it to exercise sharded runs
+_ARRAY_SUM_MIN_ROWS = 64
+
+
+def _array_sum_device_min() -> int:
+    """Element-count threshold above which a tick's array_sum rows route
+    through the XLA segment-sum kernel instead of per-row numpy adds
+    (PATHWAY_ARRAY_SUM_DEVICE_MIN; 0 disables the device path)."""
+    global _ARRAY_SUM_DEVICE_MIN
+    if _ARRAY_SUM_DEVICE_MIN is None:
+        import os
+
+        _ARRAY_SUM_DEVICE_MIN = int(os.environ.get(
+            "PATHWAY_ARRAY_SUM_DEVICE_MIN", 1 << 20))
+    return _ARRAY_SUM_DEVICE_MIN
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+_SEGSUM_FN = None
+
+
+def _device_segsum_fn():
+    """Jitted sequential segment-sum: input (G, M, D) of diff-weighted
+    rows (row m of group g, zero-padded past the group's length), output
+    (G, D) per-group totals.
+
+    The reduction is a ``lax.scan`` over the M axis — per group, rows
+    accumulate one at a time IN ORDER, exactly like the per-row numpy
+    path (``total = total + diff * v``). Zero padding is exact under IEEE
+    addition, so the result is BITWISE-identical to the sequential host
+    loop — the device path does not weaken the n_workers ∈ {1, N}
+    byte-identity contract the lowering's canonical sort establishes.
+    (A plain one-hot matmul or ``segment_sum`` would be faster but
+    reassociates the adds, making results depend on batch shape.)
+    """
+    global _SEGSUM_FN
+    if _SEGSUM_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def segsum(padded, init):
+            def body(acc, rows):
+                return acc + rows, None
+
+            acc, _ = jax.lax.scan(body, init,
+                                  jnp.moveaxis(padded, 1, 0))
+            return acc
+
+        _SEGSUM_FN = segsum
+    return _SEGSUM_FN
+
+
 class GroupByOperator(Operator):
     """groupby().reduce() (reference: group_by_table, dataflow.rs:2904).
 
@@ -345,6 +403,97 @@ class GroupByOperator(Operator):
         self._order_sensitive = force_order_sensitive or any(
             name in ("earliest", "latest", "stateful")
             for name, _, _ in reducer_specs)
+        self._array_sum_idx = [i for i, (name, _, _)
+                               in enumerate(reducer_specs)
+                               if name == "array_sum"]
+
+    def _device_array_sums(self, entries, routed):
+        """Per-tick batched array_sum: one XLA dispatch per reducer for
+        the whole tick instead of one numpy add per row (the reference
+        keeps ndarray values on the CPU engine, src/engine/reduce.rs
+        ArraySum; a TPU-first engine routes embedding-sized columns
+        through the device). Returns {reducer_idx: {gkey: (total, count)}}
+        for the reducers it handled; unhandled ones (mixed shapes,
+        non-f32 dtypes, too small to pay for a dispatch) fall back to the
+        per-row path."""
+        threshold = _array_sum_device_min()
+        if threshold <= 0:
+            return {}
+        handled: dict[int, dict] = {}
+        for idx in self._array_sum_idx:
+            extract = self.reducer_specs[idx][1]
+            # probe the first row before scanning the whole tick: the
+            # element count is already decidable from one row's shape
+            first = np.asarray(extract(*entries[0][:2])[0])
+            shape = first.shape
+            d = int(np.prod(shape)) if shape else 1
+            if first.dtype != np.float32 or len(entries) * d < threshold:
+                continue
+            arrs = [first]
+            ok = True
+            for key, row, _diff in entries[1:]:
+                a = np.asarray(extract(key, row)[0])
+                if a.dtype != np.float32 or a.shape != shape:
+                    ok = False
+                    break
+                arrs.append(a)
+            if not ok:
+                continue
+            try:
+                import jax.numpy as jnp
+            except Exception:  # pragma: no cover - jax always present
+                return {}
+            # rows per group, in entry order (canonically sorted by the
+            # caller when float — accumulation order is part of the
+            # byte-identity contract)
+            group_rows: dict[Pointer, list[int]] = {}
+            counts: dict[Pointer, int] = {}
+            for i, (key, row, diff) in enumerate(entries):
+                gkey = routed[i][0]
+                group_rows.setdefault(gkey, []).append(i)
+                counts[gkey] = counts.get(gkey, 0) + diff
+            gkeys = list(group_rows)
+            # a prior running total that is not float32 (e.g. float64 rows
+            # accumulated by earlier small ticks) must keep its dtype —
+            # fall back to the per-row path for this reducer
+            priors = {}
+            ok = True
+            for gkey in gkeys:
+                states = self.group_states.get(gkey)
+                prior = states[idx].total if states is not None else None
+                if prior is not None:
+                    prior = np.asarray(prior)
+                    if prior.dtype != np.float32 or prior.shape != shape:
+                        ok = False
+                        break
+                priors[gkey] = prior
+            if not ok:
+                continue
+            m_b = _next_pow2(max(len(v) for v in group_rows.values()))
+            g_b = _next_pow2(len(gkeys))
+            # pad with -0.0, the exact IEEE additive identity
+            # (x + -0.0 == x bitwise for every x INCLUDING -0.0, whereas
+            # x + 0.0 flips a -0.0 total to +0.0) — padding and seeding
+            # must not perturb the byte-identity contract
+            padded = np.full((g_b, m_b, d), -0.0, dtype=np.float32)
+            # seed the scan with each group's RUNNING total: the kernel
+            # then continues the exact sequential accumulation
+            # ((T + v_a) + v_b), not T + (v_a + v_b) — reassociating
+            # across the tick boundary would drift from the numpy path
+            init = np.full((g_b, d), -0.0, dtype=np.float32)
+            for g, gkey in enumerate(gkeys):
+                if priors[gkey] is not None:
+                    init[g] = priors[gkey].reshape(-1)
+                for p, i in enumerate(group_rows[gkey]):
+                    diff = entries[i][2]
+                    row_vec = arrs[i].reshape(-1)
+                    padded[g, p] = row_vec if diff == 1 else diff * row_vec
+            totals = np.asarray(_device_segsum_fn()(
+                jnp.asarray(padded), jnp.asarray(init)))
+            handled[idx] = {
+                gkey: (totals[g].reshape(shape), counts[gkey])
+                for g, gkey in enumerate(gkeys)}
+        return handled
 
     def exchange_specs(self):
         # route rows to the worker owning their group (reference: group_by
@@ -366,8 +515,14 @@ class GroupByOperator(Operator):
                 key=lambda e: (int(e[0]), e[2], row_fingerprint(e[1])))
         else:
             entries = delta.entries
-        for key, row, diff in entries:
-            gkey, gvals = self.group_fn(key, row)
+        routed = None
+        device_sums: dict[int, dict] = {}
+        if self._array_sum_idx and len(entries) >= _ARRAY_SUM_MIN_ROWS:
+            routed = [self.group_fn(key, row) for key, row, _ in entries]
+            device_sums = self._device_array_sums(entries, routed)
+        for i, (key, row, diff) in enumerate(entries):
+            gkey, gvals = routed[i] if routed is not None \
+                else self.group_fn(key, row)
             states = self.group_states.get(gkey)
             if states is None:
                 states = [make_reducer_state(name, **kw)
@@ -376,7 +531,10 @@ class GroupByOperator(Operator):
                 self.group_vals[gkey] = gvals
                 self.group_counts[gkey] = 0
             self.group_counts[gkey] += diff
-            for st, (name, extract, _kw) in zip(states, self.reducer_specs):
+            for ri, (st, (name, extract, _kw)) in enumerate(
+                    zip(states, self.reducer_specs)):
+                if ri in device_sums:
+                    continue  # whole tick pre-summed on device below
                 args = extract(key, row)
                 if name in ("earliest", "latest"):
                     if diff > 0:
@@ -386,6 +544,9 @@ class GroupByOperator(Operator):
                         args = (*args, None)
                 st.add(args, diff)
             touched[gkey] = None
+        for ri, per_group in device_sums.items():
+            for gkey, (total, count) in per_group.items():
+                self.group_states[gkey][ri].set_total(total, count)
         out = Delta()
         for gkey in touched:
             states = self.group_states[gkey]

@@ -83,6 +83,14 @@ class _ArraySumState(ReducerState):
         else:
             self.total = self.total + diff * v
 
+    def set_total(self, total, count: int) -> None:
+        """Batched tick update from the device segment-sum kernel
+        (operators.py ``_device_array_sums``): ``total`` is the NEW
+        running total (the kernel was seeded with the prior one), so it
+        replaces rather than adds."""
+        self.n += count
+        self.total = total
+
     def emit(self):
         return self.total
 

@@ -25,6 +25,7 @@ Two scale features target the 10M-vector p50 budget (BASELINE.md):
 from __future__ import annotations
 
 import enum
+import functools
 import math
 import threading
 from typing import Any, Callable
@@ -57,6 +58,99 @@ def _np_dtype(dtype: str):
         return ml_dtypes.bfloat16
     raise ValueError(f"unsupported knn dtype {dtype!r} "
                      "(use 'float32' or 'bfloat16')")
+
+
+@functools.lru_cache(maxsize=None)
+def _shared_search_fn(k: int, metric: KnnMetric):
+    """Module-level jitted search kernel, shared by ALL index instances.
+
+    jax.jit caches compiled executables per Python function object —
+    per-instance closures would recompile an identical kernel for every
+    fresh index (every new pipeline, every test). Capacity, slab dtype
+    and chunking are derived from the operand shapes at trace time, so
+    one function serves every slab; only (k, metric) must be static.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def score_block(q, vectors, valid):
+        # q (B, D) slab dtype, vectors (N, D) slab dtype → (B, N) f32.
+        # MXU takes low-precision inputs but accumulates f32
+        # (preferred_element_type) so bf16 storage costs recall, not
+        # score arithmetic.
+        if metric == KnnMetric.COS:
+            vn_sq = jax.lax.dot_general(
+                vectors, vectors,
+                (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            dots = jax.lax.dot_general(
+                q, vectors, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            scores = dots * jax.lax.rsqrt(vn_sq + 1e-12)[None, :]
+        else:
+            # -||q - v||^2 = 2 q·v - ||v||^2 - ||q||^2 ; drop ||q||^2
+            # (constant per query row, does not change ranking)
+            dots = jax.lax.dot_general(
+                q, vectors, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            v_sq = jax.lax.dot_general(
+                vectors, vectors,
+                (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            scores = 2.0 * dots - v_sq[None, :]
+        return jnp.where(valid[None, :], scores, -jnp.inf)
+
+    @jax.jit
+    def search(queries, vectors, valid):
+        # queries (B, D) f32, vectors (capacity, D) slab dtype
+        capacity = vectors.shape[0]
+        if metric == KnnMetric.COS:
+            queries = queries / (jnp.linalg.norm(
+                queries, axis=1, keepdims=True) + 1e-12)
+        q = queries.astype(vectors.dtype)
+        if capacity <= _CHUNK_ROWS:
+            top_scores, top_idx = jax.lax.top_k(
+                score_block(q, vectors, valid), k)
+            return top_scores, top_idx
+        # scan slab chunks: peak scores buffer is (B, chunk) instead of
+        # (B, capacity) — 10M x 384 stays under one chip's HBM
+        n_chunks = capacity // _CHUNK_ROWS
+        vchunks = vectors.reshape(n_chunks, _CHUNK_ROWS, vectors.shape[1])
+        validc = valid.reshape(n_chunks, _CHUNK_ROWS)
+
+        def body(_, chunk):
+            vs, val = chunk
+            ts, ti = jax.lax.top_k(score_block(q, vs, val), k)
+            return None, (ts, ti)
+
+        _, (ts, ti) = jax.lax.scan(body, None, (vchunks, validc))
+        # ts/ti: (C, B, k); global slot = chunk_index * _CHUNK_ROWS + ti
+        offsets = (jnp.arange(n_chunks,
+                              dtype=ti.dtype) * _CHUNK_ROWS)[:, None, None]
+        ti = ti + offsets
+        cand_s = jnp.moveaxis(ts, 0, 1).reshape(q.shape[0], -1)
+        cand_i = jnp.moveaxis(ti, 0, 1).reshape(q.shape[0], -1)
+        top_scores, pos = jax.lax.top_k(cand_s, k)
+        top_idx = jnp.take_along_axis(cand_i, pos, axis=1)
+        return top_scores, top_idx
+
+    return search
+
+
+@functools.lru_cache(maxsize=None)
+def _shared_scatter_fn():
+    """Module-level jitted slab-DONATING scatter (see _shared_search_fn
+    for why module-level): without donation every ``.at[].set``
+    materializes a second full slab (15.4 GB transient at 10M bf16 — an
+    OOM and a full-HBM copy per call)."""
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def scatter(slab, valid, idxs, vals, valid_vals):
+        return (slab.at[idxs].set(vals.astype(slab.dtype)),
+                valid.at[idxs].set(valid_vals))
+
+    return scatter
 
 
 class BruteForceKnnIndex:
@@ -97,8 +191,6 @@ class BruteForceKnnIndex:
         # device state (lazy)
         self._dev_vectors = None
         self._dev_valid = None
-        self._search_fn_cache: dict[tuple, Callable] = {}
-        self._scatter_fn = None
         self._device = device
 
     # ------------------------------------------------------------------
@@ -308,7 +400,6 @@ class BruteForceKnnIndex:
         self._free.extend(range(self.capacity - 1, old_cap - 1, -1))
         self._dev_vectors = None  # device slab is re-created at next search
         self._dev_valid = None
-        self._search_fn_cache.clear()
         # every occupied slot must re-ship: the next flush may take the
         # zero-slab + scatter path, which uploads only dirty rows
         self._dirty.update(self._slot_to_key.keys())
@@ -317,25 +408,8 @@ class BruteForceKnnIndex:
     # device sync + search
     # ------------------------------------------------------------------
     def _scatter(self, idxs, vals, valid_vals):
-        """Jitted, slab-DONATING scatter: without donation every
-        ``.at[].set`` materializes a second full slab (15.4 GB transient at
-        10M bf16 — an OOM and a full-HBM copy per call)."""
-        import functools
-
-        import jax
-        import jax.numpy as jnp
-
-        if self._scatter_fn is None:
-            slab_dtype = (jnp.bfloat16 if self.dtype == "bfloat16"
-                          else jnp.float32)
-
-            @functools.partial(jax.jit, donate_argnums=(0, 1))
-            def scatter(slab, valid, idxs, vals, valid_vals):
-                return (slab.at[idxs].set(vals.astype(slab_dtype)),
-                        valid.at[idxs].set(valid_vals))
-
-            self._scatter_fn = scatter
-        self._dev_vectors, self._dev_valid = self._scatter_fn(
+        """Slab-donating scatter through the shared jitted kernel."""
+        self._dev_vectors, self._dev_valid = _shared_scatter_fn()(
             self._dev_vectors, self._dev_valid, idxs, vals, valid_vals)
 
     def _flush_to_device(self):
@@ -373,80 +447,7 @@ class BruteForceKnnIndex:
             self._flush_to_device()
 
     def _get_search_fn(self, k: int):
-        key = (k, self.capacity, self.metric)
-        fn = self._search_fn_cache.get(key)
-        if fn is not None:
-            return fn
-        import jax
-        import jax.numpy as jnp
-
-        metric = self.metric
-        slab_dtype = jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
-        capacity = self.capacity
-        chunked = capacity > _CHUNK_ROWS
-
-        def score_block(q, vectors, valid):
-            # q (B, D) slab dtype, vectors (N, D) slab dtype → (B, N) f32.
-            # MXU takes low-precision inputs but accumulates f32
-            # (preferred_element_type) so bf16 storage costs recall, not
-            # score arithmetic.
-            if metric == KnnMetric.COS:
-                vn_sq = jax.lax.dot_general(
-                    vectors, vectors,
-                    (((1,), (1,)), ((0,), (0,))),
-                    preferred_element_type=jnp.float32)
-                dots = jax.lax.dot_general(
-                    q, vectors, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                scores = dots * jax.lax.rsqrt(vn_sq + 1e-12)[None, :]
-            else:
-                # -||q - v||^2 = 2 q·v - ||v||^2 - ||q||^2 ; drop ||q||^2
-                # (constant per query row, does not change ranking)
-                dots = jax.lax.dot_general(
-                    q, vectors, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                v_sq = jax.lax.dot_general(
-                    vectors, vectors,
-                    (((1,), (1,)), ((0,), (0,))),
-                    preferred_element_type=jnp.float32)
-                scores = 2.0 * dots - v_sq[None, :]
-            return jnp.where(valid[None, :], scores, -jnp.inf)
-
-        @jax.jit
-        def search(queries, vectors, valid):
-            # queries (B, D) f32, vectors (capacity, D) slab dtype
-            if metric == KnnMetric.COS:
-                queries = queries / (jnp.linalg.norm(
-                    queries, axis=1, keepdims=True) + 1e-12)
-            q = queries.astype(slab_dtype)
-            if not chunked:
-                top_scores, top_idx = jax.lax.top_k(
-                    score_block(q, vectors, valid), k)
-                return top_scores, top_idx
-            # scan slab chunks: peak scores buffer is (B, chunk) instead of
-            # (B, capacity) — 10M x 384 stays under one chip's HBM
-            n_chunks = capacity // _CHUNK_ROWS
-            vchunks = vectors.reshape(n_chunks, _CHUNK_ROWS, vectors.shape[1])
-            validc = valid.reshape(n_chunks, _CHUNK_ROWS)
-
-            def body(_, chunk):
-                vs, val = chunk
-                ts, ti = jax.lax.top_k(score_block(q, vs, val), k)
-                return None, (ts, ti)
-
-            _, (ts, ti) = jax.lax.scan(body, None, (vchunks, validc))
-            # ts/ti: (C, B, k); global slot = chunk_index * _CHUNK_ROWS + ti
-            offsets = (jnp.arange(n_chunks,
-                                  dtype=ti.dtype) * _CHUNK_ROWS)[:, None, None]
-            ti = ti + offsets
-            cand_s = jnp.moveaxis(ts, 0, 1).reshape(q.shape[0], -1)
-            cand_i = jnp.moveaxis(ti, 0, 1).reshape(q.shape[0], -1)
-            top_scores, pos = jax.lax.top_k(cand_s, k)
-            top_idx = jnp.take_along_axis(cand_i, pos, axis=1)
-            return top_scores, top_idx
-
-        self._search_fn_cache[key] = search
-        return search
+        return _shared_search_fn(k, self.metric)
 
     def search(self, queries: list[tuple]) -> list[tuple]:
         """Batched search: [(qkey, vector, limit, filter)] →

@@ -268,3 +268,48 @@ def test_columnar_argminmax_matches_row_path():
     assert columnar == row
     # argmax of a: after retracting (9, z), tie between remaining 9=y
     assert columnar[0][1] == "y"
+
+
+def test_array_sum_device_path_bitwise_matches_numpy(monkeypatch):
+    """Big float32 ndarray columns reduce through the XLA segment-sum
+    (operators._device_array_sums); the device result must be BITWISE
+    equal to the per-row numpy path — the scan kernel accumulates each
+    group's rows sequentially in the same canonical order, so no float
+    tolerance is needed (and the n_workers byte-identity contract
+    holds)."""
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine import operators as eng_ops
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+
+    rng = np.random.default_rng(7)
+    vecs = rng.standard_normal((300, 6)).astype(np.float32)
+    rows = [(f"g{i % 7}", vecs[i], (i % 3) * 2, 1) for i in range(300)]
+
+    def run(device_min, n_workers=1):
+        monkeypatch.setattr(eng_ops, "_ARRAY_SUM_DEVICE_MIN", device_min)
+        # sharded workers see ~300/(3 ticks × n_workers) entries per tick;
+        # drop the row gate so the 4-worker leg really drives the device
+        # path instead of vacuously passing through the numpy loop
+        monkeypatch.setattr(eng_ops, "_ARRAY_SUM_MIN_ROWS", 1)
+        G.clear()
+        t = table_from_rows(
+            sch.schema_from_types(g=str, v=np.ndarray), rows,
+            is_stream=True)
+        r = t.groupby(t.g).reduce(t.g, s=pw.reducers.npsum(t.v))
+        runner = GraphRunner()
+        cap = runner.capture(r)
+        runner.run_batch(n_workers=n_workers)
+        out = {row[0]: row[1] for row in cap.snapshot().values()}
+        G.clear()
+        return out
+
+    numpy_out = run(0)             # device path disabled
+    device_out = run(1)            # every tick routes through XLA
+    device_sharded = run(1, n_workers=4)
+    assert set(numpy_out) == set(device_out) == set(device_sharded)
+    for g in numpy_out:
+        assert numpy_out[g].dtype == device_out[g].dtype == np.float32
+        assert np.array_equal(numpy_out[g], device_out[g]), g
+        assert np.array_equal(numpy_out[g], device_sharded[g]), g
