@@ -12,12 +12,13 @@ from typing import Any, Iterator
 
 
 class Json:
-    __slots__ = ("_value",)
+    __slots__ = ("_value", "_dumps_cache")
 
     def __init__(self, value: Any = None):
         if isinstance(value, Json):
             value = value._value
         self._value = value
+        self._dumps_cache: str | None = None
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -29,7 +30,13 @@ class Json:
         return self._value
 
     def dumps(self) -> str:
-        return _json.dumps(self._value, sort_keys=True, default=_default)
+        # cached: key derivation / fingerprinting serializes the same Json
+        # cell at every exchange and groupby it flows through (the wrapper
+        # is immutable by contract)
+        if self._dumps_cache is None:
+            self._dumps_cache = _json.dumps(
+                self._value, sort_keys=True, default=_default)
+        return self._dumps_cache
 
     # -- access ------------------------------------------------------------
     def __getitem__(self, item) -> "Json":

@@ -45,12 +45,16 @@ def max(expr) -> ex.ReducerExpression:  # noqa: A001
     return ex.ReducerExpression("max", expr)
 
 
-def argmin(expr) -> ex.ReducerExpression:
-    return ex.ReducerExpression("argmin", expr)
+def argmin(expr, *payload) -> ex.ReducerExpression:
+    """One arg: key of the row holding the min. Two args: the second
+    expression's value from that row (engine argmin payload form)."""
+    return ex.ReducerExpression("argmin", expr, *payload)
 
 
-def argmax(expr) -> ex.ReducerExpression:
-    return ex.ReducerExpression("argmax", expr)
+def argmax(expr, *payload) -> ex.ReducerExpression:
+    """One arg: key of the row holding the max. Two args: the second
+    expression's value from that row (engine argmax payload form)."""
+    return ex.ReducerExpression("argmax", expr, *payload)
 
 
 def unique(expr) -> ex.ReducerExpression:

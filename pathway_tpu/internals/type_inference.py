@@ -23,8 +23,9 @@ _REDUCER_TYPES = {
     "avg": lambda args: dt.FLOAT,
     "min": lambda args: args[0] if args else dt.ANY,
     "max": lambda args: args[0] if args else dt.ANY,
-    "argmin": lambda args: dt.POINTER,
-    "argmax": lambda args: dt.POINTER,
+    # one arg: the best row's KEY; two args: the payload expression's value
+    "argmin": lambda args: args[1] if len(args) > 1 else dt.POINTER,
+    "argmax": lambda args: args[1] if len(args) > 1 else dt.POINTER,
     "unique": lambda args: args[0] if args else dt.ANY,
     "any": lambda args: args[0] if args else dt.ANY,
     "sorted_tuple": lambda args: dt.List(args[0]) if args else dt.ANY_TUPLE,

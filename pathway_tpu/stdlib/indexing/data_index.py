@@ -32,6 +32,14 @@ class InnerIndex(ABC):
     def query_embedder(self):
         return None
 
+    @property
+    def embeds_internally(self) -> bool:
+        """True when the engine-side index takes raw text and embeds it on
+        device itself (ops/knn.py DeviceEmbeddingKnnIndex) — the planner
+        then feeds text straight through instead of building UDF embedding
+        columns for data and queries."""
+        return False
+
 
 @dataclass
 class _PreparedQueryCols:
@@ -51,6 +59,14 @@ class DataIndex:
         self.embedder = embedder
         self._data_prepared: Table | None = None
 
+    def _embeds_internally(self) -> bool:
+        """The engine index embeds text on-device itself — unless a
+        DataIndex-level query-embedder override is present, which the
+        internal path could not honor (it would silently embed queries
+        with the DOCUMENT embedder); the override forces the classic
+        UDF-column path for both sides."""
+        return self.inner_index.embeds_internally and self.embedder is None
+
     def _prepare_data(self) -> Table:
         """Embed + project the corpus ONCE per DataIndex: every query stream
         reuses the same plan node, so the encoder forward over the corpus
@@ -58,7 +74,8 @@ class DataIndex:
         if self._data_prepared is None:
             inner = self.inner_index
             data_vec = inner.data_column
-            if inner.query_embedder is not None:
+            if inner.query_embedder is not None and \
+                    not self._embeds_internally():
                 # "embedder inside index" (reference vector_store.py:214-292):
                 # both the indexed column and the query column are embedded
                 data_vec = inner.query_embedder(data_vec)
@@ -98,7 +115,7 @@ class DataIndex:
         data_prepared = self._prepare_data()
 
         qvec = query_column
-        if embedder is not None:
+        if embedder is not None and not self._embeds_internally():
             qvec = embedder(query_column)
         query_prepared = query_table.select(
             _pw_q=qvec,
@@ -106,9 +123,14 @@ class DataIndex:
             _pw_filter=metadata_filter,
         )
 
+        factory = inner.factory()
+        if inner.embeds_internally and not self._embeds_internally():
+            # query-embedder override in play: the engine must take
+            # vectors, not text (see _embeds_internally)
+            factory.fuse = False
         reply = data_prepared._external_index_as_of_now(
             query_prepared,
-            index_factory=inner.factory(),
+            index_factory=factory,
             query_responses_limit_column=query_prepared._pw_k,
             query_filter_column=query_prepared._pw_filter,
             index_filter_data_column=data_prepared._pw_meta,

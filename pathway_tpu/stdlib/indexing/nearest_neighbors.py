@@ -40,6 +40,10 @@ class BruteForceKnnFactory:
     embedder: Any = None
     mesh: Any = None
     dtype: str = "float32"
+    # False forces the vector-input engine index even for device-capable
+    # embedders (set by DataIndex when a query-embedder override is in
+    # play — the fused text path could not honor it)
+    fuse: bool = True
 
     def build(self):
         dim = self.dimensions
@@ -65,9 +69,21 @@ class BruteForceKnnFactory:
             return ShardedKnnIndex(dim, mesh=mesh,
                                    reserved_space=self.reserved_space,
                                    metric=self.metric)
-        return BruteForceKnnIndex(
+        inner = BruteForceKnnIndex(
             dim, reserved_space=self.reserved_space, metric=self.metric,
             dtype=self.dtype)
+        # device-capable embedder: the engine index takes raw text and
+        # embeds on-chip; embeddings never round-trip the host. The gate
+        # must mirror BruteForceKnn.embeds_internally exactly — that
+        # property decides whether the DataIndex feeds text or vectors
+        # (self.mesh, not the resolved mesh: 'auto' may resolve to None
+        # here while the planner already chose the vector column)
+        if self.fuse and self.mesh is None and hasattr(
+                self.embedder, "encode_batch_device"):
+            from pathway_tpu.ops.knn import DeviceEmbeddingKnnIndex
+
+            return DeviceEmbeddingKnnIndex(self.embedder, inner)
+        return inner
 
 
 def _probe_embedder_dimension(embedder) -> int:
@@ -101,6 +117,14 @@ class BruteForceKnn(InnerIndex):
     @property
     def query_embedder(self):
         return self.embedder
+
+    @property
+    def embeds_internally(self) -> bool:
+        """True when the engine index embeds raw text on device itself
+        (DeviceEmbeddingKnnIndex) — the DataIndex then skips the UDF
+        embedding column entirely for both data and queries."""
+        return self.mesh is None and hasattr(self.embedder,
+                                             "encode_batch_device")
 
 
 @dataclass
@@ -159,6 +183,12 @@ class USearchKnn(BruteForceKnn):
             metric=self.metric, connectivity=self.connectivity,
             expansion_add=self.expansion_add,
             expansion_search=self.expansion_search, embedder=self.embedder)
+
+    @property
+    def embeds_internally(self) -> bool:
+        # the native HNSW is a host-side index: it needs real vectors in
+        # its add path, so the UDF embedding column stays
+        return False
 
 
 class LshKnn(BruteForceKnn):

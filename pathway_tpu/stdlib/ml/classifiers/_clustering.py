@@ -1,0 +1,113 @@
+"""LSH pre-clustering (reference: stdlib/ml/classifiers/_clustering_via_lsh.py).
+
+The reference aggregates LSH-bucket representatives and runs sklearn
+KMeans over them; here the k-means itself is a jitted weighted Lloyd
+iteration on the device (MXU distance matmuls) — no sklearn dependency,
+deterministic under a seed, and the FLOP-heavy part (N×K distance
+matrix) rides the hardware the rest of the framework runs on.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.ml.classifiers._lsh import lsh
+from pathway_tpu.stdlib.utils.col import (
+    apply_all_rows,
+    groupby_reduce_majority,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _kmeans_fn(k: int, iters: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(points, weights, init_idx):
+        # points (n, d) f32, weights (n,), init_idx (k,) int32
+        centers = points[init_idx]
+
+        def body(centers, _):
+            # (n, k) squared distances via one matmul + norms
+            d2 = (jnp.sum(points**2, axis=1, keepdims=True)
+                  - 2.0 * points @ centers.T
+                  + jnp.sum(centers**2, axis=1)[None, :])
+            assign = jnp.argmin(d2, axis=1)
+            onehot = jax.nn.one_hot(assign, k, dtype=points.dtype)
+            wsum = (onehot * weights[:, None]).T @ points
+            wtot = onehot.T @ weights
+            new_centers = jnp.where(
+                wtot[:, None] > 0, wsum / jnp.maximum(wtot, 1e-9)[:, None],
+                centers)
+            return new_centers, None
+
+        centers, _ = jax.lax.scan(body, centers, None, length=iters)
+        d2 = (jnp.sum(points**2, axis=1, keepdims=True)
+              - 2.0 * points @ centers.T
+              + jnp.sum(centers**2, axis=1)[None, :])
+        return jnp.argmin(d2, axis=1)
+
+    return run
+
+
+def kmeans_labels(points, weights, k: int, iters: int = 25,
+                  seed: int = 0) -> list[int]:
+    """Weighted k-means labels for ``points`` (device Lloyd iterations)."""
+    pts = np.asarray([np.asarray(p, dtype=np.float32).reshape(-1)
+                      for p in points], dtype=np.float32)
+    w = np.asarray(weights, dtype=np.float32)
+    n = pts.shape[0]
+    k_eff = min(k, n)
+    rng = np.random.default_rng(seed)
+    # weight-proportional init without replacement (k-means++-lite)
+    p = w / w.sum() if w.sum() > 0 else None
+    init = rng.choice(n, size=k_eff, replace=False, p=p).astype(np.int32)
+    labels = np.asarray(_kmeans_fn(k_eff, iters)(pts, w, init))
+    return [int(v) for v in labels]
+
+
+def clustering_via_lsh(data: Table, bucketer, k: int) -> Table:
+    """Cluster ``data.data`` vectors into ``k`` groups via LSH-bucket
+    representatives + device k-means + per-point majority vote across
+    bands (reference _clustering_via_lsh.py:30 clustering_via_lsh; unlike
+    the reference, ``k`` is honored — the reference hardcodes 3).
+
+    Returns a table keyed like ``data`` with a ``label`` column.
+    """
+    import pathway_tpu.internals.reducers_frontend as reducers
+
+    flat = lsh(data, bucketer, origin_id="data_id", include_data=True)
+
+    summed = flat.groupby(flat.bucketing, flat.band).reduce(
+        flat.bucketing, flat.band,
+        sum=reducers.npsum(flat.data),
+        count=reducers.count(),
+    )
+    reps = summed.select(
+        summed.bucketing, summed.band,
+        data=ex.ApplyExpression(
+            lambda s, c: np.asarray(s) / c, None, summed.sum, summed.count),
+        weight=summed.count,
+    )
+
+    labels = apply_all_rows(
+        reps.data, reps.weight,
+        fun=lambda datas, weights: kmeans_labels(datas, weights, k),
+        result_col_name="label")
+    labeled = reps.select(reps.bucketing, reps.band,
+                          label=labels.ix(reps.id, context=reps).label)
+
+    votes = flat.join(
+        labeled,
+        flat.bucketing == labeled.bucketing,
+        flat.band == labeled.band,
+    ).select(flat.data_id, labeled.label)
+
+    result = groupby_reduce_majority(votes.data_id, votes.label)
+    keyed = result.with_id(result.data_id)
+    return keyed.select(label=keyed.majority)

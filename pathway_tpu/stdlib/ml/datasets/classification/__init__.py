@@ -1,32 +1,27 @@
 """Classification dataset loaders (reference:
-stdlib/ml/datasets/classification — fetches MNIST via sklearn's openml
-mirror). Gated on scikit-learn + network; the split logic is in-repo."""
+stdlib/ml/datasets/classification/__init__.py — fetch + split into
+(train, test, train_labels, test_labels) Tables keyed so labels align
+with their data rows).
+
+``load_mnist_sample`` mirrors the reference exactly (openml fetch —
+needs network); ``load_digits_sample`` serves the same shape from
+scikit-learn's BUNDLED digits set, so classifier examples and tests run
+offline.
+"""
 
 from __future__ import annotations
 
+import numpy as np
 
-def load_mnist_sample(sample_size: int = 70000):
-    """(train_table, test_table, train_labels, test_labels) of an MNIST
-    sample (reference signature). Requires scikit-learn and network."""
-    try:
-        from sklearn.datasets import fetch_openml  # type: ignore
-    except ImportError as e:
-        raise ImportError(
-            "load_mnist_sample needs scikit-learn (fetch_openml); the "
-            "dataset split logic is in-repo — install sklearn to fetch"
-        ) from e
-    import numpy as np
+
+def _split_tables(X, y, train_size: int, test_size: int):
+    """(train, test, train_labels, test_labels) Tables; the label tables
+    share keys with their data tables (same row order + same table
+    builder), so ``data_table + label_table`` style composition and
+    ``.ix`` lookups line up."""
     import pandas as pd
 
     from pathway_tpu.debug import table_from_pandas
-
-    X, y = fetch_openml("mnist_784", version=1, return_X_y=True,
-                        as_frame=False)
-    X = X / 255.0
-    train_size = int(sample_size * 6 / 7)
-    test_size = sample_size // 7
-    X_train, y_train = X[:60000][:train_size], y[:60000][:train_size]
-    X_test, y_test = X[60000:70000][:test_size], y[60000:70000][:test_size]
 
     def to_table(arr):
         return table_from_pandas(pd.DataFrame(
@@ -35,5 +30,56 @@ def load_mnist_sample(sample_size: int = 70000):
     def labels(arr):
         return table_from_pandas(pd.DataFrame({"label": list(arr)}))
 
-    return to_table(X_train), to_table(X_test), labels(y_train), \
-        labels(y_test)
+    X_train, y_train = X[:train_size], y[:train_size]
+    X_test, y_test = X[train_size:train_size + test_size], \
+        y[train_size:train_size + test_size]
+    return (to_table(X_train), to_table(X_test),
+            labels(y_train), labels(y_test))
+
+
+def load_mnist_sample(sample_size: int = 70000):
+    """(train_table, test_table, train_labels, test_labels) of an MNIST
+    sample (reference signature). Requires scikit-learn and network
+    access (openml mirror)."""
+    try:
+        from sklearn.datasets import fetch_openml  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "load_mnist_sample needs scikit-learn (fetch_openml)") from e
+
+    X, y = fetch_openml("mnist_784", version=1, return_X_y=True,
+                        as_frame=False)
+    X = X / 255.0
+    train_size = int(sample_size * 6 / 7)
+    test_size = sample_size // 7
+    # the reference's fixed 60k/10k MNIST split
+    X = np.concatenate([X[:60000][:train_size], X[60000:70000][:test_size]])
+    y = np.concatenate([y[:60000][:train_size], y[60000:70000][:test_size]])
+    return _split_tables(X, y, train_size, test_size)
+
+
+def load_digits_sample(sample_size: int = 1797, *, shuffle_seed: int = 0):
+    """Same output shape as :func:`load_mnist_sample`, from sklearn's
+    BUNDLED 8x8 digits set (1,797 samples, no network) — the offline
+    dataset for classifier examples and tests.
+
+    >>> train, test, train_labels, test_labels = load_digits_sample(200)
+    >>> train.column_names(), train_labels.column_names()
+    (['data'], ['label'])
+    """
+    try:
+        from sklearn.datasets import load_digits  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "load_digits_sample needs scikit-learn") from e
+
+    X, y = load_digits(return_X_y=True)
+    X = X / 16.0
+    rng = np.random.default_rng(shuffle_seed)
+    order = rng.permutation(len(X))[:sample_size]
+    X, y = X[order], y[order].astype(str)
+    train_size = int(len(X) * 6 / 7)
+    return _split_tables(X, y, train_size, len(X) - train_size)
+
+
+__all__ = ["load_mnist_sample", "load_digits_sample"]

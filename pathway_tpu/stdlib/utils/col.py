@@ -69,12 +69,17 @@ def apply_all_rows(*cols: ex.ColumnReference, fun, result_col_name) -> Table:
 
 
 def groupby_reduce_majority(column: ex.ColumnReference, value_column):
+    """Per ``column`` group, the most frequent ``value_column`` value
+    (reference: stdlib/utils/col.py groupby_reduce_majority)."""
     import pathway_tpu.internals.reducers_frontend as reducers
 
     table = column.table
     counted = table.groupby(column, value_column).reduce(
         column, value_column, _pw_cnt=reducers.count())
+    val_name = value_column.name if isinstance(
+        value_column, ex.ColumnReference) else str(value_column)
     return counted.groupby(counted[column.name]).reduce(
         counted[column.name],
-        majority=reducers.argmax(counted._pw_cnt),
+        # two-arg argmax: payload is the VALUE with the top count
+        majority=reducers.argmax(counted._pw_cnt, counted[val_name]),
     )

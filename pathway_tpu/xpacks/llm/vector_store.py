@@ -120,6 +120,29 @@ class VectorStoreServer:
         if "_metadata" not in docs.column_names():
             docs = docs.with_columns(_metadata=Json({}))
 
+        if self.parser is None and self.splitter is None and \
+                not self.doc_post_processors:
+            # identity pipeline (pre-chunked text, the default config):
+            # parse and split are 1:1 passthroughs, so the parse→flatten→
+            # split→flatten→project chain collapses to one projection —
+            # no per-doc Json packing, no flatten key derivation. When the
+            # column is already str even the decode apply disappears.
+            from pathway_tpu.internals import dtype as _dt
+
+            # exactly STR: an Optional[str] column must keep the apply
+            # (str(None) == "None" is what the parser path indexes; a raw
+            # None text row would be dropped by the index operator)
+            data_dtype = docs.schema._dtypes().get("data")
+            if data_dtype == _dt.STR:
+                text_expr = pw.this.data
+            else:
+                text_expr = pw.apply_with_type(
+                    lambda data: data.decode("utf-8", "replace")
+                    if isinstance(data, bytes) else str(data),
+                    str, pw.this.data)
+            chunks = docs.select(text=text_expr, metadata=pw.this._metadata)
+            return self._finish_graph(docs, chunks)
+
         parser = _unwrap_udf(self.parser) if self.parser is not None \
             else lambda data: [(data.decode("utf-8", "replace")
                                 if isinstance(data, bytes) else str(data), {})]
@@ -172,7 +195,9 @@ class VectorStoreServer:
             metadata=pw.apply_with_type(
                 lambda j: Json(j.value["metadata"]), Json, pw.this.chunks),
         )
+        return self._finish_graph(docs, chunks)
 
+    def _finish_graph(self, docs, chunks) -> dict:
         if self.index_builder is not None:
             index = self.index_builder(chunks)
         elif self.index_factory is not None:

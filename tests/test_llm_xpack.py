@@ -338,3 +338,42 @@ def test_geometric_rag_from_index_returns_none_when_unanswerable():
     assert rows[0]["answer"] is None
     # escalation 2 -> 4, capped by the 3 retrievable docs
     assert chat.calls == [2, 3]
+
+
+def test_fused_device_embedding_index_path():
+    """A device-capable embedder (encode_batch_device) makes the engine
+    index take raw text: no UDF embedding column, embeddings born on
+    device (ops/knn.py DeviceEmbeddingKnnIndex). Retrieval, metadata
+    filters, and incremental updates must behave exactly like the
+    UDF-embedded path."""
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.ops.knn import DeviceEmbeddingKnnIndex
+    from pathway_tpu.stdlib.indexing import (
+        default_brute_force_knn_document_index,
+    )
+    from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
+
+    emb = JaxEncoderEmbedder(config=EncoderConfig.tiny())
+    docs = _docs_table()
+    index = default_brute_force_knn_document_index(
+        docs.data, docs, embedder=emb, dimensions=64,
+        metadata_column=docs._metadata)
+    assert index.inner_index.embeds_internally
+    built = index.inner_index.factory().build()
+    assert isinstance(built, DeviceEmbeddingKnnIndex)
+
+    queries = table_from_rows(
+        sch.schema_from_types(q=str), [("systolic arrays multiply",)])
+    res = index.query_as_of_now(queries.q, number_of_matches=1,
+                                collapse_rows=False)
+    rows = _result_rows(res.select(data=res.data))
+    assert len(rows) == 1 and "systolic" in rows[0]["data"]
+
+    # same query against the classic UDF-embedded path must agree
+    res2 = index.query_as_of_now(queries.q, number_of_matches=3,
+                                 collapse_rows=False,
+                                 metadata_filter="modified_at > `150`")
+    rows2 = _result_rows(res2.select(data=res2.data))
+    # the filter drops /a.txt (modified_at 100); both survivors return
+    assert len(rows2) == 2
+    assert not any("quick brown fox" in r["data"] for r in rows2)

@@ -187,3 +187,127 @@ def test_document_index_mesh_sharded_end_to_end():
         assert got == {"7": doc_key["d7"], "19": doc_key["d19"]}
     finally:
         G.clear()
+
+
+# ---------------------------------------------------------------------------
+# cluster-level kill-and-recover (reference:
+# integration_tests/wordcount/test_recovery.py:25 — real processes killed
+# mid-stream, restart must produce exact final counts from persistence)
+# ---------------------------------------------------------------------------
+
+_CLUSTER_WORDCOUNT = __import__("textwrap").dedent("""
+    import os
+    import pathway_tpu as pw
+
+    inp, pdir = os.environ["TEST_IN"], os.environ["TEST_PDIR"]
+    out = os.environ["TEST_OUT"] + os.environ.get("PATHWAY_PROCESS_ID", "?")
+    t = pw.io.fs.read(inp, format="plaintext", mode="streaming",
+                      autocommit_duration_ms=40, persistent_id="words")
+    counts = t.groupby(t.data).reduce(word=t.data, c=pw.reducers.count())
+    pw.io.fs.write(counts, out, format="csv")
+    pw.run(persistence_config=pw.persistence.Config.simple_config(
+        pw.persistence.Backend.filesystem(pdir)))
+""")
+
+
+def _shard_counts(out_base) -> dict[str, int]:
+    import csv
+
+    state: dict[str, int] = {}
+    for pid in range(2):
+        try:
+            with open(f"{out_base}{pid}", newline="") as f:
+                for row in csv.DictReader(f):
+                    w, c, d = row["word"], int(row["c"]), int(row["diff"])
+                    if d > 0:
+                        state[w] = c
+                    elif state.get(w) == c:
+                        del state[w]
+        except (FileNotFoundError, KeyError, ValueError):
+            continue
+    return state
+
+
+def _child_pids(pid: int) -> list[int]:
+    import glob
+
+    out = []
+    for path in glob.glob(f"/proc/{pid}/task/*/children"):
+        try:
+            with open(path) as f:
+                out.extend(int(p) for p in f.read().split())
+        except OSError:
+            continue
+    return out
+
+
+@pytest.mark.slow
+def test_cluster_kill_one_process_and_recover(tmp_path):
+    """Spawn a REAL 2-process cluster (cli spawn -n 2, TCP exchange),
+    SIGKILL one worker process mid-stream, verify the peer detects the
+    death and the cluster exits, then restart the cluster on the same
+    persistence dir and assert exact final counts — exactly-once across
+    a process crash at cluster level."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    inp = tmp_path / "in"
+    inp.mkdir()
+    script = tmp_path / "wc.py"
+    script.write_text(_CLUSTER_WORDCOUNT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo",
+               TEST_IN=str(inp), TEST_PDIR=str(tmp_path / "pstate"),
+               TEST_OUT=str(tmp_path / "out"),
+               PATHWAY_FIRST_PORT=str(21700 + os.getpid() % 500))
+
+    expected: dict[str, int] = {}
+
+    def add_file(i: int, mod: int):
+        words = [f"w{j % mod}" for j in range(25)]
+        (inp / f"{i:03d}.txt").write_text("\n".join(words) + "\n")
+        for w in words:
+            expected[w] = expected.get(w, 0) + 1
+
+    for i in range(3):
+        add_file(i, 7)
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-m", "pathway_tpu", "spawn", "-n", "2",
+             sys.executable, str(script)],
+            env=env, cwd="/root/repo", start_new_session=True)
+
+    proc = spawn()
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline and not _shard_counts(
+                str(tmp_path / "out")):
+            time.sleep(0.1)
+        assert _shard_counts(str(tmp_path / "out")), "no output before kill"
+
+        workers = _child_pids(proc.pid)
+        assert len(workers) == 2, f"expected 2 worker processes: {workers}"
+        os.kill(workers[1], signal.SIGKILL)  # crash ONE process mid-stream
+
+        # failure detection: the surviving peer must notice the death and
+        # the whole cluster must come down (spawn reaps + terminates)
+        assert proc.wait(timeout=90) is not None
+
+        for i in range(3, 6):  # more input arrives while the cluster is down
+            add_file(i, 5)
+
+        proc = spawn()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if _shard_counts(str(tmp_path / "out")) == expected:
+                break
+            time.sleep(0.2)
+        assert _shard_counts(str(tmp_path / "out")) == expected
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass

@@ -1,0 +1,109 @@
+"""Update-stream consistency via the DiffEntry harness
+(tests/utils.py — reference: python/pathway/tests/utils.py:97-225
+DiffEntry + assert_key_entries_in_stream_consistent/assert_stream_equal).
+
+These pin the SHAPE of intermediate emission, not just final state:
+which (key, row) pairs appear, with which polarity, in which per-key
+order — the contract behaviors/buffers/asof_now are about.
+"""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_rows
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.keys import hash_values
+from tests.utils import (
+    DiffEntry,
+    assert_key_entries_in_stream_consistent,
+    assert_stream_equal,
+)
+
+
+def test_streaming_wordcount_exact_update_stream():
+    """groupby counts over a 3-tick stream: the per-key stream must be
+    exactly +1, -1+2, -2+3 for the repeated word and +1 for the rest."""
+    schema = sch.schema_from_types(word=str)
+    rows = [("a", 0, 1), ("b", 0, 1), ("a", 2, 1), ("a", 4, 1)]
+    t = table_from_rows(schema, rows, is_stream=True)
+    counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+
+    def e(word, order, insertion, c):
+        return DiffEntry(hash_values(word), order, insertion,
+                         {"word": word, "c": c})
+
+    expected = [
+        e("a", 0, True, 1),
+        e("a", 1, False, 1), e("a", 2, True, 2),
+        e("a", 3, False, 2), e("a", 4, True, 3),
+        e("b", 0, True, 1),
+    ]
+    assert_stream_equal(expected, counts)
+
+
+def test_windowby_delay_behavior_stream_consistent():
+    """Tumbling window with delay: emission may buffer, but whatever
+    surfaces per window must be a subsequence of the expected revision
+    chain ending at the final sums (temporal-behavior site for the
+    DiffEntry harness)."""
+    schema = sch.schema_from_types(sensor=str, v=int, at=int)
+    rows = [
+        ("s1", 1, 0, 2, 1), ("s1", 2, 1, 2, 1),   # window [0,4): 1+2
+        ("s1", 4, 5, 4, 1),                        # window [4,8): 4
+        ("s1", 8, 2, 6, 1),                        # late row into [0,4)
+    ]
+    t = table_from_rows(schema, rows, is_stream=True)
+    win = pw.temporal.windowby(
+        t, t.at, window=pw.temporal.tumbling(4), instance=t.sensor,
+        behavior=pw.temporal.common_behavior(delay=2),
+    ).reduce(
+        sensor=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+
+    def window_key(sensor, start, end):
+        # WindowedTable.reduce groups by (window, start, end, instance)
+        # with window = (instance, start, end)
+        return hash_values((sensor, start, end), start, end, sensor)
+
+    def e(sensor, start, end, order, insertion, s):
+        return DiffEntry(window_key(sensor, start, end), order, insertion,
+                         {"sensor": sensor, "start": start, "s": s})
+
+    expected = [
+        # [0,4): may surface 3 (before the late row) then revise to 11
+        e("s1", 0, 4, 0, True, 3),
+        e("s1", 0, 4, 1, False, 3), e("s1", 0, 4, 2, True, 11),
+        # [4,8): single emission of 4
+        e("s1", 4, 8, 0, True, 4),
+    ]
+    assert_key_entries_in_stream_consistent(expected, win)
+
+
+def test_asof_now_join_stream_consistent():
+    """asof_now: each query joins the dimension state AS OF its arrival
+    and is never revised — the per-query stream must be exactly one
+    insertion carrying the state visible at that tick."""
+    dim_schema = sch.schema_from_types(k=str, label=str)
+    dims = table_from_rows(
+        dim_schema, [("x", "old", 0, 1), ("x", "old", 2, -1),
+                     ("x", "new", 2, 1)], is_stream=True)
+    q_schema = sch.schema_from_types(k=str, qid=int)
+    queries = table_from_rows(
+        q_schema, [("x", 1, 1, 1), ("x", 2, 3, 1)], is_stream=True)
+    queries = queries.with_id_from(queries.qid)
+
+    joined = pw.temporal.asof_now_join(
+        queries, dims, queries.k == dims.k, id=queries.id,
+    ).select(qid=queries.qid, label=dims.label)
+
+    def e(qid, order, insertion, label):
+        return DiffEntry(hash_values(qid), order, insertion,
+                         {"qid": qid, "label": label})
+
+    expected = [
+        e(1, 0, True, "old"),   # query at t=1 sees the original label
+        e(2, 0, True, "new"),   # query at t=3 sees the replacement
+    ]
+    assert_stream_equal(expected, joined)

@@ -218,3 +218,27 @@ def test_split():
     pos, neg = t.split(t.a > 1)
     assert sorted(rows_of(pos)) == [(2,), (3,)]
     assert rows_of(neg) == [(1,)]
+
+
+def test_typed_equality_catches_dtype_drift():
+    """assert_table_equality compares column dtypes: an int column that
+    drifted to float must FAIL typed equality while still passing the
+    _wo_types variant (reference: typed vs _wo_types assert split)."""
+    import pytest
+
+    from tests.utils import (assert_table_equality,
+                             assert_table_equality_wo_index,
+                             assert_table_equality_wo_index_types)
+
+    ints = T("""
+    a
+    1
+    2
+    """)
+    floats = ints.select(a=pw.cast(float, pw.this.a))
+    with pytest.raises(AssertionError, match="dtypes"):
+        assert_table_equality(floats, ints)
+    with pytest.raises(AssertionError, match="dtypes"):
+        assert_table_equality_wo_index(floats, ints)
+    # same values modulo type: the permissive variant accepts int 1 vs 1.0
+    assert_table_equality_wo_index_types(ints, ints)

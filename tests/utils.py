@@ -3,8 +3,11 @@ assert_table_equality & friends over captured diff streams)."""
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
+
 from pathway_tpu.debug import table_from_markdown
 from pathway_tpu.engine.delta import row_fingerprint
+from pathway_tpu.internals.keys import Pointer
 from pathway_tpu.internals.runner import run_tables
 
 T = table_from_markdown
@@ -15,15 +18,37 @@ def _snapshot(table):
     return cap.snapshot()
 
 
+def _assert_same_dtypes(actual, expected):
+    """Column dtype comparison (reference: assert_table_equality checks
+    types, the _wo_types variants don't — tests/utils.py:412). Catches
+    silent dtype drift (int column widened to float) that row-value
+    equality alone cannot see."""
+    da = {n: repr(d) for n, d in actual.schema._dtypes().items()}
+    de = {n: repr(d) for n, d in expected.schema._dtypes().items()}
+    assert da == de, f"\nactual dtypes:   {da}\nexpected dtypes: {de}"
+
+
 def assert_table_equality(actual, expected):
-    """Same keys, same rows."""
+    """Same keys, same rows, same column dtypes."""
+    _assert_same_dtypes(actual, expected)
+    assert_table_equality_wo_types(actual, expected)
+
+
+def assert_table_equality_wo_types(actual, expected):
+    """Same keys, same rows (dtypes NOT compared)."""
     a, e = run_tables(actual, expected)
     sa, se = a.snapshot(), e.snapshot()
     assert _normalize(sa) == _normalize(se), f"\nactual:   {sa}\nexpected: {se}"
 
 
 def assert_table_equality_wo_index(actual, expected):
-    """Same multiset of rows, ignoring keys."""
+    """Same multiset of rows and same dtypes, ignoring keys."""
+    _assert_same_dtypes(actual, expected)
+    assert_table_equality_wo_index_types(actual, expected)
+
+
+def assert_table_equality_wo_index_types(actual, expected):
+    """Same multiset of rows, ignoring keys (dtypes NOT compared)."""
     a, e = run_tables(actual, expected)
     ra = sorted((row_fingerprint(r) for r in a.snapshot().values()))
     re_ = sorted((row_fingerprint(r) for r in e.snapshot().values()))
@@ -31,10 +56,6 @@ def assert_table_equality_wo_index(actual, expected):
         f"\nactual rows:   {sorted(map(repr, a.snapshot().values()))}"
         f"\nexpected rows: {sorted(map(repr, e.snapshot().values()))}"
     )
-
-
-assert_table_equality_wo_types = assert_table_equality
-assert_table_equality_wo_index_types = assert_table_equality_wo_index
 
 
 def assert_stream_equality_wo_index(actual, expected):
@@ -63,3 +84,90 @@ def _normalize(snapshot):
 
 def rows_of(table) -> list[tuple]:
     return sorted(_snapshot(table).values(), key=repr)
+
+
+@dataclass(order=True)
+class DiffEntry:
+    """One expected (key, order, insertion, row) event of an update
+    stream (reference: tests/utils.py:97 DiffEntry). ``order`` ranks the
+    expected events per key — engine times need not match it, only the
+    per-key ordering."""
+
+    key: Pointer
+    order: int
+    insertion: bool
+    row: dict = field(compare=False)
+
+    @staticmethod
+    def create(pk_values: dict, order: int, insertion: bool,
+               row: dict, instance=None) -> "DiffEntry":
+        return DiffEntry(
+            DiffEntry.create_id_from(pk_values, instance=instance),
+            order, insertion, row)
+
+    @staticmethod
+    def create_id_from(pk_values: dict, instance=None) -> Pointer:
+        from pathway_tpu.internals.keys import (hash_values,
+                                                hash_values_with_instance)
+
+        vals = list(pk_values.values())
+        if instance is None:
+            return hash_values(*vals)
+        return hash_values_with_instance(*vals, instance=instance)
+
+    def final_cleanup_entry(self) -> "DiffEntry":
+        return DiffEntry(self.key, self.order + 1, False, self.row)
+
+
+def assert_key_entries_in_stream_consistent(expected: list[DiffEntry],
+                                            table) -> None:
+    """For every key: the table's update stream must be a SUBSEQUENCE of
+    the expected per-key (order, insertion) sequence, ending on the same
+    final entry (reference: tests/utils.py:210). Use for temporal
+    behaviors where intermediate flushes may or may not surface."""
+    import collections
+
+    names = table.column_names()
+    [cap] = run_tables(table)
+    state: dict[Pointer, collections.deque] = collections.defaultdict(
+        collections.deque)
+    for entry in sorted(expected):
+        state[entry.key].append(entry)
+    for key, row, time, diff in cap.events:
+        row_dict = dict(zip(names, row))
+        q = state.get(key)
+        assert q, (f"unexpected entry key={key!r} row={row_dict!r} "
+                   f"diff={diff} (no expected entries left)")
+        while True:
+            entry = q.popleft()
+            if (diff > 0, row_dict) == (entry.insertion, entry.row):
+                if not q:
+                    state.pop(key)
+                break
+            assert q, (f"entry key={key!r} row={row_dict!r} diff={diff} "
+                       f"matches nothing expected for this key")
+    assert not state, f"expected entries never observed: {dict(state)!r}"
+
+
+def assert_stream_equal(expected: list[DiffEntry], table) -> None:
+    """Exact per-key stream equality: every expected entry must appear,
+    in order, with nothing skipped (reference: tests/utils.py:189)."""
+    import collections
+
+    names = table.column_names()
+    [cap] = run_tables(table)
+    state: dict[Pointer, collections.deque] = collections.defaultdict(
+        collections.deque)
+    for entry in sorted(expected):
+        state[entry.key].append(entry)
+    for key, row, time, diff in cap.events:
+        row_dict = dict(zip(names, row))
+        q = state.get(key)
+        assert q, f"unexpected entry key={key!r} row={row_dict!r}"
+        entry = q.popleft()
+        assert (diff > 0, row_dict) == (entry.insertion, entry.row), (
+            f"got key={key!r} row={row_dict!r} diff={diff}, expected "
+            f"insertion={entry.insertion} row={entry.row!r}")
+        if not q:
+            state.pop(key)
+    assert not state, f"expected entries never observed: {dict(state)!r}"
