@@ -49,13 +49,13 @@ DEVICE_TIMEOUT_S = float(os.environ.get("BENCH_DEVICE_TIMEOUT", 2400.0))
 DEVICE_TRIES = int(os.environ.get("BENCH_DEVICE_TRIES", 2))
 
 
-def _encoder_flops_per_token(config) -> float:
+def _encoder_flops_per_token(config, seq: int = SEQ) -> float:
     """Forward FLOPs/token for the encoder: 2*(non-embedding params) for
     the matmuls + the attention-score/value term (4*S*h per token per
-    layer, S the padded sequence)."""
+    layer, S the PADDED width actually dispatched)."""
     h, f, L = config.hidden, config.intermediate, config.layers
     per_layer = 2 * (4 * h * h + 2 * h * f)  # qkv+out proj, ffn up+down
-    attn = L * 4 * SEQ * h  # scores + weighted values, both 2*S*h
+    attn = L * 4 * seq * h  # scores + weighted values, both 2*S*h
     return float(L * per_layer + attn)
 
 
@@ -332,12 +332,15 @@ def bench_embed() -> dict:
     start = time.perf_counter()
     batch_times = []
     batch_tokens = []
+    batch_flops = []
     last_t = start
     ids16, lens = pack(*tokenizer.batch(docs[:BATCH], pad_to=SEQ))
     while True:
         ingest([Pointer(key_base + i) for i in range(BATCH)],
                params, ids16, lens)  # async: one fused dispatch
         batch_tokens.append(ids16.shape[0] * ids16.shape[1])
+        batch_flops.append(batch_tokens[-1] * _encoder_flops_per_token(
+            config, seq=ids16.shape[1]))
         next_docs = docs[((n_batches + 1) % 4) * BATCH:][:BATCH]
         ids16, lens = pack(*tokenizer.batch(next_docs, pad_to=SEQ))
         now = time.perf_counter()
@@ -357,8 +360,11 @@ def bench_embed() -> dict:
     sustained = batch_times[1:]  # drop the warmup-straddling first batch
     docs_per_sec = BATCH * len(sustained) / float(np.sum(sustained))
     tokens_per_sec = float(np.sum(batch_tokens[1:]) / np.sum(sustained))
-    mfu = tokens_per_sec * _encoder_flops_per_token(config) \
+    # MFU from per-batch flops at the ACTUAL padded width (not SEQ):
+    # sustained MFU counts host stalls against the device
+    mfu = float(np.sum(batch_flops[1:]) / np.sum(sustained)) \
         / (PEAK_TFLOPS * 1e12)
+    mfu_dev = _device_only_mfu(params, config)
 
     # free the embed leg's device state (slab + donated buffers) before the
     # 10M KNN leg claims most of HBM
@@ -371,8 +377,44 @@ def bench_embed() -> dict:
         "docs_per_s": docs_per_sec,
         "tokens_per_s": round(tokens_per_sec, 0),
         "mfu_est": round(mfu, 3),
+        "mfu_device_only": round(mfu_dev, 3),
         "mfu_peak_tflops": PEAK_TFLOPS,
     }
+
+
+def _device_only_mfu(params, config, B: int = 2048, W: int = 128,
+                     reps: int = 8) -> float:
+    """Encoder MFU with NO host in the loop (reps forwards inside one
+    jitted fori_loop): the program's device ceiling, reported next to
+    sustained MFU so host-stall time is attributable. Measured r5 on
+    v5e: ~0.29 at (2048, 128) — flat in batch size, XLA dense attention
+    beating the Pallas kernel at S=128 (see ops/attention.py) — i.e. the
+    sustained number is near the program's ceiling, and further MFU comes
+    from model-shape changes, not host work."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.encoder import encode
+
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, config.vocab_size, (B, W)).astype(np.int32))
+    lens = jnp.full((B,), W - 5, jnp.int32)
+
+    @jax.jit
+    def loop(params, ids, lens):
+        def body(i, acc):
+            mask = jnp.arange(ids.shape[1])[None, :] < lens[:, None]
+            out = encode(params, ids + i, mask, config=config)
+            return acc + jnp.sum(out).astype(jnp.float32)
+
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+    float(loop(params, ids, lens))  # compile + warm
+    t0 = time.perf_counter()
+    float(loop(params, ids, lens))
+    dt = time.perf_counter() - t0
+    return reps * B * W * _encoder_flops_per_token(config, seq=W) \
+        / dt / (PEAK_TFLOPS * 1e12)
 
 
 def bench_embed_framework(n_docs: int | None = None) -> dict:

@@ -403,9 +403,12 @@ class GroupByOperator(Operator):
         self._order_sensitive = force_order_sensitive or any(
             name in ("earliest", "latest", "stateful")
             for name, _, _ in reducer_specs)
+        # "sum" included: an ndarray-typed column summed via the plain
+        # sum() reducer hits the same device path (the first-row probe
+        # rejects scalar sums cheaply)
         self._array_sum_idx = [i for i, (name, _, _)
                                in enumerate(reducer_specs)
-                               if name == "array_sum"]
+                               if name in ("array_sum", "sum")]
 
     def _device_array_sums(self, entries, routed):
         """Per-tick batched array_sum: one XLA dispatch per reducer for
@@ -421,7 +424,7 @@ class GroupByOperator(Operator):
             return {}
         handled: dict[int, dict] = {}
         for idx in self._array_sum_idx:
-            extract = self.reducer_specs[idx][1]
+            name, extract, _kw = self.reducer_specs[idx]
             # probe the first row before scanning the whole tick: the
             # element count is already decidable from one row's shape
             first = np.asarray(extract(*entries[0][:2])[0])
@@ -479,8 +482,13 @@ class GroupByOperator(Operator):
             # seed the scan with each group's RUNNING total: the kernel
             # then continues the exact sequential accumulation
             # ((T + v_a) + v_b), not T + (v_a + v_b) — reassociating
-            # across the tick boundary would drift from the numpy path
-            init = np.full((g_b, d), -0.0, dtype=np.float32)
+            # across the tick boundary would drift from the numpy path.
+            # Fresh-group seed mirrors each state's numpy start exactly:
+            # _ArraySumState begins at diff*v (no addition — seed -0.0,
+            # the identity), _SumState begins at int 0 + diff*v (seed
+            # +0.0, so a -0.0 first value flips to +0.0 as numpy does)
+            fresh_zero = np.float32(-0.0 if name == "array_sum" else 0.0)
+            init = np.full((g_b, d), fresh_zero, dtype=np.float32)
             for g, gkey in enumerate(gkeys):
                 if priors[gkey] is not None:
                     init[g] = priors[gkey].reshape(-1)

@@ -61,6 +61,12 @@ class _SumState(ReducerState):
         if v is not None:
             self.total = self.total + diff * v
 
+    def set_total(self, total, count: int) -> None:
+        """Device segment-sum tick update (see _ArraySumState.set_total):
+        ``total`` already continues this state's prior running total."""
+        self.n += count
+        self.total = total
+
     def emit(self):
         return self.total
 

@@ -15,6 +15,8 @@ Two execution paths, both honoring the reference API:
 
 from __future__ import annotations
 
+from typing import Literal
+
 import numpy as np
 
 import pathway_tpu.internals.reducers_frontend as reducers
@@ -168,10 +170,14 @@ def knn_lsh_classify(classifier, queries: Table, k: int = 3) -> Table:
     return classifier(queries, k)
 
 
+# reference export aliases (classifiers/__init__.py:13,16; _knn_lsh.py:43)
+knn_lsh_train = knn_lsh_classifier_train
+DistanceTypes = Literal["euclidean", "cosine"]
+
 __all__ = [
     "clustering_via_lsh", "kmeans_labels", "lsh",
     "generate_cosine_lsh_bucketer", "generate_euclidean_lsh_bucketer",
-    "knn_lsh_classifier_train", "knn_lsh_classify",
+    "knn_lsh_classifier_train", "knn_lsh_train", "knn_lsh_classify",
     "knn_lsh_euclidean_classifier_train",
-    "knn_lsh_generic_classifier_train",
+    "knn_lsh_generic_classifier_train", "DistanceTypes",
 ]

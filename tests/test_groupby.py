@@ -285,6 +285,11 @@ def test_array_sum_device_path_bitwise_matches_numpy(monkeypatch):
 
     rng = np.random.default_rng(7)
     vecs = rng.standard_normal((300, 6)).astype(np.float32)
+    # one group of pure -0.0 rows: the device seed must reproduce each
+    # state's numpy start exactly (npsum keeps -0.0, sum's int-0 start
+    # flips it to +0.0) — np.array_equal can't see the sign, so signbits
+    # are compared below
+    vecs[::7] = -0.0
     rows = [(f"g{i % 7}", vecs[i], (i % 3) * 2, 1) for i in range(300)]
 
     def run(device_min, n_workers=1):
@@ -297,11 +302,13 @@ def test_array_sum_device_path_bitwise_matches_numpy(monkeypatch):
         t = table_from_rows(
             sch.schema_from_types(g=str, v=np.ndarray), rows,
             is_stream=True)
-        r = t.groupby(t.g).reduce(t.g, s=pw.reducers.npsum(t.v))
+        # npsum (array_sum) AND plain sum() both ride the device path
+        r = t.groupby(t.g).reduce(t.g, s=pw.reducers.npsum(t.v),
+                                  s2=pw.reducers.sum(t.v))
         runner = GraphRunner()
         cap = runner.capture(r)
         runner.run_batch(n_workers=n_workers)
-        out = {row[0]: row[1] for row in cap.snapshot().values()}
+        out = {row[0]: (row[1], row[2]) for row in cap.snapshot().values()}
         G.clear()
         return out
 
@@ -309,7 +316,14 @@ def test_array_sum_device_path_bitwise_matches_numpy(monkeypatch):
     device_out = run(1)            # every tick routes through XLA
     device_sharded = run(1, n_workers=4)
     assert set(numpy_out) == set(device_out) == set(device_sharded)
+
+    def bitwise_equal(a, b):
+        return a.dtype == b.dtype and a.tobytes() == b.tobytes()
+
     for g in numpy_out:
-        assert numpy_out[g].dtype == device_out[g].dtype == np.float32
-        assert np.array_equal(numpy_out[g], device_out[g]), g
-        assert np.array_equal(numpy_out[g], device_sharded[g]), g
+        for col in (0, 1):  # npsum and plain sum
+            assert numpy_out[g][col].dtype == np.float32
+            assert bitwise_equal(numpy_out[g][col], device_out[g][col]), \
+                (g, col, numpy_out[g][col], device_out[g][col])
+            assert bitwise_equal(numpy_out[g][col],
+                                 device_sharded[g][col]), (g, col)

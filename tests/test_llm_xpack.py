@@ -377,3 +377,37 @@ def test_fused_device_embedding_index_path():
     # the filter drops /a.txt (modified_at 100); both survivors return
     assert len(rows2) == 2
     assert not any("quick brown fox" in r["data"] for r in rows2)
+
+
+def test_fused_index_handles_document_update_and_delete():
+    """Retraction + re-add of a doc through the fused device-embedding
+    index: a query after the update must see only the NEW text, and a
+    deleted doc must stop matching."""
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.stdlib.indexing import (
+        default_brute_force_knn_document_index,
+    )
+    from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
+
+    emb = JaxEncoderEmbedder(config=EncoderConfig.tiny())
+    schema = sch.schema_from_types(doc_id=int, data=str)
+    docs = table_from_rows(
+        schema,
+        [(1, "systolic arrays multiply matrices", 0, 1),
+         (2, "ring attention rotates blocks", 0, 1),
+         (1, "systolic arrays multiply matrices", 2, -1),  # doc replaced
+         (1, "pallas kernels tile vmem", 2, 1)],
+        is_stream=True)
+    docs = docs.with_id_from(docs.doc_id)
+    index = default_brute_force_knn_document_index(
+        docs.data, docs, embedder=emb, dimensions=64)
+    queries = table_from_rows(
+        sch.schema_from_types(q=str), [("systolic arrays", 4, 1)],
+        is_stream=True)
+    res = index.query_as_of_now(queries.q, number_of_matches=2,
+                                collapse_rows=False)
+    rows = _result_rows(res.select(data=res.data))
+    texts = {r["data"] for r in rows}
+    assert "systolic arrays multiply matrices" not in texts
+    assert texts <= {"ring attention rotates blocks",
+                     "pallas kernels tile vmem"} and texts

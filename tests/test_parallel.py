@@ -282,10 +282,10 @@ def test_cluster_kill_one_process_and_recover(tmp_path):
 
     proc = spawn()
     try:
-        deadline = time.time() + 90
-        while time.time() < deadline and not _shard_counts(
-                str(tmp_path / "out")):
-            time.sleep(0.1)
+        from tests.utils import wait_result_with_checker
+
+        wait_result_with_checker(
+            lambda: _shard_counts(str(tmp_path / "out")), 90)
         assert _shard_counts(str(tmp_path / "out")), "no output before kill"
 
         workers = _child_pids(proc.pid)
@@ -300,11 +300,9 @@ def test_cluster_kill_one_process_and_recover(tmp_path):
             add_file(i, 5)
 
         proc = spawn()
-        deadline = time.time() + 120
-        while time.time() < deadline:
-            if _shard_counts(str(tmp_path / "out")) == expected:
-                break
-            time.sleep(0.2)
+        wait_result_with_checker(
+            lambda: _shard_counts(str(tmp_path / "out")) == expected, 120,
+            step=0.2)
         assert _shard_counts(str(tmp_path / "out")) == expected
     finally:
         try:

@@ -12,6 +12,8 @@ import time
 
 import pytest
 
+from tests.utils import wait_result_with_checker
+
 import pathway_tpu as pw
 from pathway_tpu.engine.persistence import SnapshotLog
 from pathway_tpu.internals.parse_graph import G
@@ -237,9 +239,7 @@ def test_wordcount_kill_and_recover(tmp_path):
     proc = subprocess.Popen([sys.executable, str(script), str(inp), pdir, out],
                             env=env, cwd="/root/repo")
     try:
-        deadline = time.time() + 60
-        while time.time() < deadline and not _read_counts(out):
-            time.sleep(0.1)
+        wait_result_with_checker(lambda: _read_counts(out), 60)
         assert _read_counts(out), "no output before kill"
         proc.send_signal(signal.SIGKILL)  # crash mid-stream
         proc.wait()
@@ -253,11 +253,8 @@ def test_wordcount_kill_and_recover(tmp_path):
         proc = subprocess.Popen(
             [sys.executable, str(script), str(inp), pdir, out],
             env=env, cwd="/root/repo")
-        deadline = time.time() + 90
-        while time.time() < deadline:
-            if _read_counts(out) == expected:
-                break
-            time.sleep(0.2)
+        wait_result_with_checker(
+            lambda: _read_counts(out) == expected, 90, step=0.2)
         assert _read_counts(out) == expected
 
         # SECOND kill/recover cycle (the reference harness kills several
@@ -274,11 +271,8 @@ def test_wordcount_kill_and_recover(tmp_path):
         proc = subprocess.Popen(
             [sys.executable, str(script), str(inp), pdir, out],
             env=env, cwd="/root/repo")
-        deadline = time.time() + 90
-        while time.time() < deadline:
-            if _read_counts(out) == expected:
-                break
-            time.sleep(0.2)
+        wait_result_with_checker(
+            lambda: _read_counts(out) == expected, 90, step=0.2)
         assert _read_counts(out) == expected
     finally:
         if proc.poll() is None:

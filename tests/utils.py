@@ -107,13 +107,14 @@ class DiffEntry:
 
     @staticmethod
     def create_id_from(pk_values: dict, instance=None) -> Pointer:
-        from pathway_tpu.internals.keys import (hash_values,
-                                                hash_values_with_instance)
+        from pathway_tpu.internals.keys import hash_values
 
         vals = list(pk_values.values())
         if instance is None:
             return hash_values(*vals)
-        return hash_values_with_instance(*vals, instance=instance)
+        # instance-grouped outputs append the instance LAST to the key
+        # hash (expression_compiler group-key compilation)
+        return hash_values(*vals, instance)
 
     def final_cleanup_entry(self) -> "DiffEntry":
         return DiffEntry(self.key, self.order + 1, False, self.row)
@@ -171,3 +172,38 @@ def assert_stream_equal(expected: list[DiffEntry], table) -> None:
         if not q:
             state.pop(key)
     assert not state, f"expected entries never observed: {dict(state)!r}"
+
+
+class CsvLinesNumberChecker:
+    """Polling predicate: the CSV at ``path`` has ``n_lines`` data rows
+    (reference: tests/utils.py CsvLinesNumberChecker — used to await
+    streaming output files)."""
+
+    def __init__(self, path, n_lines: int):
+        self.path = path
+        self.n_lines = n_lines
+
+    def __call__(self) -> bool:
+        import csv
+
+        try:
+            with open(self.path, newline="") as f:
+                rows = sum(1 for _ in csv.reader(f)) - 1  # minus header
+        except FileNotFoundError:
+            return False
+        return rows >= self.n_lines
+
+
+def wait_result_with_checker(checker, timeout: float, *,
+                             step: float = 0.1) -> bool:
+    """Poll ``checker()`` until truthy or ``timeout`` seconds elapse
+    (reference: tests/utils.py wait_result_with_checker, minus the
+    process management — spawn-based tests manage their own processes)."""
+    import time as _time
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        if checker():
+            return True
+        _time.sleep(step)
+    return bool(checker())
