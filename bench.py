@@ -387,10 +387,12 @@ def _device_only_mfu(params, config, B: int = 2048, W: int = 128,
     """Encoder MFU with NO host in the loop (reps forwards inside one
     jitted fori_loop): the program's device ceiling, reported next to
     sustained MFU so host-stall time is attributable. Measured r5 on
-    v5e: ~0.29 at (2048, 128) — flat in batch size, XLA dense attention
-    beating the Pallas kernel at S=128 (see ops/attention.py) — i.e. the
-    sustained number is near the program's ceiling, and further MFU comes
-    from model-shape changes, not host work."""
+    v5e at (2048, 128): ~0.30 with erf-gelu, ~0.41-0.58 after the
+    tanh-gelu swap (EncoderConfig.gelu — erf's lowering blocked XLA's
+    MLP fusion; the swap is below bf16 quantization noise). XLA dense
+    attention still beats the Pallas kernel at S=128 (ops/attention.py);
+    the remaining gap to the ~0.63 matmul-skeleton ceiling is softmax +
+    layernorm HBM traffic."""
     import jax
     import jax.numpy as jnp
 

@@ -429,7 +429,12 @@ class GroupByOperator(Operator):
             # element count is already decidable from one row's shape
             first = np.asarray(extract(*entries[0][:2])[0])
             shape = first.shape
-            d = int(np.prod(shape)) if shape else 1
+            if not shape:
+                # scalar sum() column: the per-row path returns np.float32
+                # scalars; the device path would emit 0-d ndarrays and the
+                # output column's type would depend on tick size
+                continue
+            d = int(np.prod(shape))
             if first.dtype != np.float32 or len(entries) * d < threshold:
                 continue
             arrs = [first]

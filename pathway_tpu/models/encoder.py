@@ -48,6 +48,15 @@ class EncoderConfig:
     normalize: bool = True
     num_experts: int = 0  # 0 → dense MLP; >0 → top-1 switch MoE
     compute_dtype: Any = jnp.bfloat16
+    # "auto": tanh-gelu under bf16 compute, erf-gelu under f32. Measured on
+    # v5e at (B=2048, S=128): erf's lowering blocks XLA from fusing/tiling
+    # the MLP block and the full forward runs 155 ms vs 103 ms with tanh
+    # (MFU 0.385 → 0.578) — while tanh's approximation error (≤3e-3 abs) is
+    # BELOW bf16's own quantization step, so within bf16 the swap is
+    # numerically free (cos(erf,tanh) ≥ 0.99993 vs cos(f32,bf16) ≥ 0.99988
+    # end-to-end). f32 compute keeps erf: checkpoint-golden parity at
+    # rtol 2e-4 (tests/test_hf_loader.py) needs BERT's exact activation.
+    gelu: str = "auto"  # "auto" | "erf" | "tanh"
 
     @property
     def head_dim(self) -> int:
@@ -237,11 +246,24 @@ def _attention_block(x, p, mask, config: EncoderConfig, attn_fn):
                        config.layer_norm_eps, out_dtype=cd)
 
 
+def _use_tanh_gelu(config: EncoderConfig) -> bool:
+    if config.gelu == "auto":
+        # tanh only where the approximation hides under the dtype's own
+        # quantization noise: half-precision compute (bf16/f16). f32/f64
+        # keep BERT's exact erf for checkpoint-golden parity.
+        return jnp.dtype(config.compute_dtype).itemsize <= 2
+    if config.gelu not in ("erf", "tanh"):
+        raise ValueError(
+            f"EncoderConfig.gelu must be 'auto', 'erf' or 'tanh'; "
+            f"got {config.gelu!r}")
+    return config.gelu == "tanh"
+
+
 def _mlp_block(x, p, config: EncoderConfig):
     cd = config.compute_dtype
     xc = x.astype(cd)
     h = xc @ p["w1"].astype(cd) + p["b1"].astype(cd)
-    h = jax.nn.gelu(h, approximate=False)  # erf gelu (BERT), bf16 VPU
+    h = jax.nn.gelu(h, approximate=_use_tanh_gelu(config))
     out = h @ p["w2"].astype(cd) + p["b2"].astype(cd)
     return _layer_norm(xc + out, p["ln_scale"], p["ln_bias"],
                        config.layer_norm_eps, out_dtype=cd)
@@ -262,7 +284,10 @@ def _moe_block(x, p, config: EncoderConfig):
     xc = x.astype(cd)
     h = jnp.einsum("bsh,ehi->bsei", xc, p["w1"].astype(cd))
     h = h + p["b1"].astype(cd)[None, None]
-    h = jax.nn.gelu(h.astype(jnp.float32), approximate=False).astype(cd)
+    if _use_tanh_gelu(config):
+        h = jax.nn.gelu(h, approximate=True)
+    else:
+        h = jax.nn.gelu(h.astype(jnp.float32), approximate=False).astype(cd)
     out = jnp.einsum("bsei,eih->bseh", h, p["w2"].astype(cd))
     out = out + p["b2"].astype(cd)[None, None]
     out = jnp.einsum("bseh,bse->bsh", out, onehot)

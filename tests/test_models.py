@@ -45,6 +45,31 @@ def test_encode_deterministic(tiny):
     np.testing.assert_array_equal(a, b)
 
 
+def test_gelu_mode_selection_and_accuracy(tiny):
+    """bf16 compute auto-selects tanh-gelu (the fusion-friendly fast path,
+    see EncoderConfig.gelu); the swap must stay below bf16 quantization
+    noise, and f32 compute must keep BERT's exact erf (auto ≡ erf there,
+    pinning checkpoint-golden parity)."""
+    import dataclasses
+
+    config, params = tiny
+    ids, mask = _batch(config)
+    auto_bf = np.asarray(encode(params, ids, mask, config=config))
+    erf_bf = np.asarray(encode(
+        params, ids, mask, config=dataclasses.replace(config, gelu="erf")))
+    tanh_bf = np.asarray(encode(
+        params, ids, mask, config=dataclasses.replace(config, gelu="tanh")))
+    np.testing.assert_array_equal(auto_bf, tanh_bf)  # auto == tanh in bf16
+    cos = np.sum(erf_bf * tanh_bf, axis=1)  # outputs are L2-normalized
+    assert cos.min() > 0.9999, f"tanh-gelu swap drifted: cos={cos.min()}"
+
+    f32 = dataclasses.replace(config, compute_dtype=jnp.float32)
+    auto_f32 = np.asarray(encode(params, ids, mask, config=f32))
+    erf_f32 = np.asarray(encode(
+        params, ids, mask, config=dataclasses.replace(f32, gelu="erf")))
+    np.testing.assert_array_equal(auto_f32, erf_f32)  # auto == erf in f32
+
+
 def test_encode_padding_invariance(tiny):
     """Padding tokens must not change the (mean-pooled) embedding."""
     config = EncoderConfig.tiny(pooling="mean")
