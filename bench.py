@@ -721,6 +721,41 @@ def _dispatch_floor_ms() -> float:
     return float(np.percentile(lat, 50))
 
 
+def _bench_knn_int8(n, gen, chunk, queries, bf16_top) -> dict:
+    """int8-slab leg (half of bf16's bytes): p50 at the same scale, plus
+    an overlap@10 probe vs the bf16 results over IDENTICAL vectors (the
+    generator chunks are re-created from the same PRNG keys)."""
+    import gc
+
+    import jax
+
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    gc.collect()
+    index = BruteForceKnnIndex(KNN_DIM, reserved_space=n,
+                               metric=KnnMetric.COS, dtype="int8")
+    for ci, base in enumerate(range(0, n, chunk)):
+        m = min(chunk, n - base)
+        vecs = gen(jax.random.PRNGKey(ci))
+        index.add_batch_device(
+            [Pointer(base + i) for i in range(m)], vecs[:m])
+    res = index.search([(Pointer(10**9 + i), queries[i], 10, None)
+                        for i in range(8)])
+    overlap = float(np.mean(
+        [len(set(k for k, _ in res[i]) & set(bf16_top[i])) / 10.0
+         for i in range(8)]))
+    p50 = index.latency_probe(batch_size=1, k=10, reps=64)
+    b64 = index.latency_probe(batch_size=64, k=10, reps=16)
+    del index
+    gc.collect()
+    return {
+        "knn_int8_p50_ms": round(p50, 2),
+        "knn_int8_batch64_ms": round(b64, 2),
+        "knn_int8_overlap10_vs_bf16": round(overlap, 3),
+    }
+
+
 def bench_knn() -> dict:
     """Query latency against the largest slab that fits one chip.
 
@@ -778,8 +813,10 @@ def bench_knn() -> dict:
                 t0 = time.perf_counter()
                 run(queries[i % 64:i % 64 + 1])
                 lat.append((time.perf_counter() - t0) * 1e3)
-            del index
-            return {
+            # bf16 top-10 for the int8 overlap probe (same vectors: the
+            # int8 slab re-ingests identical PRNGKey chunks)
+            bf16_top = [tuple(k for k, _ in r) for r in run(queries[:8])]
+            out = {
                 "knn_n_vectors": n,
                 "knn_dim": KNN_DIM,
                 "knn_dtype": "bfloat16",
@@ -791,6 +828,12 @@ def bench_knn() -> dict:
                 "knn_dispatch_floor_ms": round(floor, 2),
                 "knn_ingest_s": round(ingest_s, 1),
             }
+            del index
+            try:
+                out.update(_bench_knn_int8(n, gen, chunk, queries, bf16_top))
+            except Exception as e:  # noqa: BLE001 - int8 leg is additive
+                out["knn_int8_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            return out
         except (RuntimeError, MemoryError) as e:
             # HBM too small for this slab — release EVERYTHING the failed
             # attempt pinned on device (slab, chunk buffer, jitted gen)

@@ -17,6 +17,14 @@ Two scale features target the 10M-vector p50 budget (BASELINE.md):
   v5e) AND halves the HBM scan time — the search is bandwidth-bound, so
   latency tracks slab bytes. Scores accumulate in f32 on the MXU
   (``preferred_element_type``), so only storage is low-precision.
+- ``dtype="int8"`` halves bytes AGAIN (10M x 384 = 3.8 GB): rows are
+  quantized per-row symmetric (scale = max|v|/127) by the on-device
+  scatter; the host mirror stays exact float32. int8 values are exactly
+  representable in bf16, so the in-kernel bf16 MXU dots with f32
+  accumulation are EXACT integer arithmetic — the only precision loss is
+  the quantization itself. For cosine the per-row scale cancels
+  (cos is row-scale invariant), so the search kernel needs no
+  dequantization at all; L2sq folds the scale into the score.
 - Above ``_CHUNK_ROWS`` slots the kernel switches to a ``lax.scan`` over
   slab chunks with a per-chunk top-k and a final merge, bounding the
   (B, N) score buffer at (B, chunk) regardless of slab size.
@@ -50,6 +58,10 @@ def _round_up(n: int, mult: int) -> int:
 
 
 def _np_dtype(dtype: str):
+    if dtype == "int8":
+        # int8 quantization happens device-side in the scatter; the host
+        # mirror stays exact float32 (authoritative for grow/exact reads)
+        return np.float32
     if dtype == "float32":
         return np.float32
     if dtype == "bfloat16":
@@ -57,7 +69,62 @@ def _np_dtype(dtype: str):
 
         return ml_dtypes.bfloat16
     raise ValueError(f"unsupported knn dtype {dtype!r} "
-                     "(use 'float32' or 'bfloat16')")
+                     "(use 'float32', 'bfloat16' or 'int8')")
+
+
+def _chunked_search(k: int, score_block, prep_queries):
+    """The scan/top-k/merge machinery shared by every search kernel
+    variant. ``score_block(q, vectors, extras, valid) -> (B, N) f32``
+    scores one slab chunk; ``extras`` is a (possibly empty) tuple of
+    per-row (N,) side columns chunked alongside the slab (int8 uses
+    (scales, vsq)). Returns a jitted
+    ``search(queries, vectors, extras, valid)``."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def search(queries, vectors, extras, valid):
+        capacity = vectors.shape[0]
+        q = prep_queries(queries, vectors)
+        if capacity <= _CHUNK_ROWS:
+            return jax.lax.top_k(
+                score_block(q, vectors, extras, valid), k)
+        # scan slab chunks: peak scores buffer is (B, chunk) instead of
+        # (B, capacity) — 10M x 384 stays under one chip's HBM
+        n_chunks = capacity // _CHUNK_ROWS
+        vchunks = vectors.reshape(n_chunks, _CHUNK_ROWS, vectors.shape[1])
+        echunks = tuple(e.reshape(n_chunks, _CHUNK_ROWS) for e in extras)
+        validc = valid.reshape(n_chunks, _CHUNK_ROWS)
+
+        def body(_, chunk):
+            vs, es, val = chunk
+            ts, ti = jax.lax.top_k(score_block(q, vs, es, val), k)
+            return None, (ts, ti)
+
+        _, (ts, ti) = jax.lax.scan(body, None, (vchunks, echunks, validc))
+        # ts/ti: (C, B, k); global slot = chunk_index * _CHUNK_ROWS + ti
+        offsets = (jnp.arange(n_chunks,
+                              dtype=ti.dtype) * _CHUNK_ROWS)[:, None, None]
+        ti = ti + offsets
+        cand_s = jnp.moveaxis(ts, 0, 1).reshape(q.shape[0], -1)
+        cand_i = jnp.moveaxis(ti, 0, 1).reshape(q.shape[0], -1)
+        top_scores, pos = jax.lax.top_k(cand_s, k)
+        top_idx = jnp.take_along_axis(cand_i, pos, axis=1)
+        return top_scores, top_idx
+
+    return search
+
+
+def _prep_queries(metric: KnnMetric, cast_dtype=None):
+    import jax.numpy as jnp
+
+    def prep(queries, vectors):
+        if metric == KnnMetric.COS:
+            queries = queries / (jnp.linalg.norm(
+                queries, axis=1, keepdims=True) + 1e-12)
+        return queries.astype(cast_dtype or vectors.dtype)
+
+    return prep
 
 
 @functools.lru_cache(maxsize=None)
@@ -73,68 +140,95 @@ def _shared_search_fn(k: int, metric: KnnMetric):
     import jax
     import jax.numpy as jnp
 
-    def score_block(q, vectors, valid):
+    def score_block(q, vectors, extras, valid):
         # q (B, D) slab dtype, vectors (N, D) slab dtype → (B, N) f32.
         # MXU takes low-precision inputs but accumulates f32
         # (preferred_element_type) so bf16 storage costs recall, not
         # score arithmetic.
+        dots = jax.lax.dot_general(
+            q, vectors, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # the self-dot reads the same chunk the q·v dot just loaded, so
+        # XLA computes both in one slab pass (measured: removing it does
+        # NOT speed the kernel up)
+        vn_sq = jax.lax.dot_general(
+            vectors, vectors,
+            (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
         if metric == KnnMetric.COS:
-            vn_sq = jax.lax.dot_general(
-                vectors, vectors,
-                (((1,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)
-            dots = jax.lax.dot_general(
-                q, vectors, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
             scores = dots * jax.lax.rsqrt(vn_sq + 1e-12)[None, :]
         else:
             # -||q - v||^2 = 2 q·v - ||v||^2 - ||q||^2 ; drop ||q||^2
             # (constant per query row, does not change ranking)
-            dots = jax.lax.dot_general(
-                q, vectors, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            v_sq = jax.lax.dot_general(
-                vectors, vectors,
-                (((1,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)
-            scores = 2.0 * dots - v_sq[None, :]
+            scores = 2.0 * dots - vn_sq[None, :]
         return jnp.where(valid[None, :], scores, -jnp.inf)
 
-    @jax.jit
-    def search(queries, vectors, valid):
-        # queries (B, D) f32, vectors (capacity, D) slab dtype
-        capacity = vectors.shape[0]
+    return _chunked_search(k, score_block, _prep_queries(metric))
+
+
+@functools.lru_cache(maxsize=None)
+def _shared_search_i8_fn(k: int, metric: KnnMetric):
+    """int8-slab search kernel: extras = (scales, vsq) with vsq the
+    per-row INT-domain squared norm precomputed by the quantizing
+    scatter — no in-kernel self-dot. Slab reads are half the bf16 path's
+    bytes; the int8 values convert to bf16 at the MXU operand (exact —
+    int8 fits bf16's mantissa), accumulation is f32, so scoring is exact
+    arithmetic over the quantized rows."""
+    import jax
+    import jax.numpy as jnp
+
+    def score_block(q, vectors, extras, valid):
+        scales, vsq = extras
+        vs = vectors.astype(jnp.bfloat16)
+        dots = jax.lax.dot_general(
+            q, vs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         if metric == KnnMetric.COS:
-            queries = queries / (jnp.linalg.norm(
-                queries, axis=1, keepdims=True) + 1e-12)
-        q = queries.astype(vectors.dtype)
-        if capacity <= _CHUNK_ROWS:
-            top_scores, top_idx = jax.lax.top_k(
-                score_block(q, vectors, valid), k)
-            return top_scores, top_idx
-        # scan slab chunks: peak scores buffer is (B, chunk) instead of
-        # (B, capacity) — 10M x 384 stays under one chip's HBM
-        n_chunks = capacity // _CHUNK_ROWS
-        vchunks = vectors.reshape(n_chunks, _CHUNK_ROWS, vectors.shape[1])
-        validc = valid.reshape(n_chunks, _CHUNK_ROWS)
+            # cosine is invariant to per-row scaling: the quantization
+            # scale cancels and the INT-domain norm is the right one
+            scores = dots * jax.lax.rsqrt(vsq + 1e-12)[None, :]
+        else:
+            # -||q - v||^2 + ||q||^2 = 2 q·v - ||v||^2 with v = i8 * scale
+            scores = (2.0 * dots * scales[None, :]
+                      - vsq * (scales * scales)[None, :])
+        return jnp.where(valid[None, :], scores, -jnp.inf)
 
-        def body(_, chunk):
-            vs, val = chunk
-            ts, ti = jax.lax.top_k(score_block(q, vs, val), k)
-            return None, (ts, ti)
+    return _chunked_search(k, score_block,
+                           _prep_queries(metric, cast_dtype=jnp.bfloat16))
 
-        _, (ts, ti) = jax.lax.scan(body, None, (vchunks, validc))
-        # ts/ti: (C, B, k); global slot = chunk_index * _CHUNK_ROWS + ti
-        offsets = (jnp.arange(n_chunks,
-                              dtype=ti.dtype) * _CHUNK_ROWS)[:, None, None]
-        ti = ti + offsets
-        cand_s = jnp.moveaxis(ts, 0, 1).reshape(q.shape[0], -1)
-        cand_i = jnp.moveaxis(ti, 0, 1).reshape(q.shape[0], -1)
-        top_scores, pos = jax.lax.top_k(cand_s, k)
-        top_idx = jnp.take_along_axis(cand_i, pos, axis=1)
-        return top_scores, top_idx
 
-    return search
+def _quantize_i8(vals):
+    """Per-row symmetric int8 quantization: (q, scale, vsq) with
+    scale = max|v|/127 (clamped away from 0) and vsq the INT-domain
+    squared row norm. The ONE implementation both the scatter and the
+    fused-ingest step trace, so every ingest path quantizes
+    bit-identically (grow/re-upload relies on that)."""
+    import jax.numpy as jnp
+
+    v = vals.astype(jnp.float32)
+    m = jnp.max(jnp.abs(v), axis=1)
+    scale = jnp.maximum(m / 127.0, 1e-30)
+    q = jnp.clip(jnp.round(v / scale[:, None]), -127, 127).astype(jnp.int8)
+    qf = q.astype(jnp.float32)
+    vsq = jnp.sum(qf * qf, axis=1)  # exact: |q| ≤ 127, D ≪ 2^24 / 127^2
+    return q, scale, vsq
+
+
+@functools.lru_cache(maxsize=None)
+def _shared_scatter_i8_fn():
+    """Slab-donating QUANTIZING scatter for int8 indexes (see
+    _shared_scatter_fn for the donation rationale)."""
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def scatter(slab, scales, vsq, valid, idxs, vals, valid_vals):
+        q, scale, vn = _quantize_i8(vals)
+        return (slab.at[idxs].set(q),
+                scales.at[idxs].set(scale),
+                vsq.at[idxs].set(vn),
+                valid.at[idxs].set(valid_vals))
+
+    return scatter
 
 
 @functools.lru_cache(maxsize=None)
@@ -175,6 +269,7 @@ class BruteForceKnnIndex:
             self.capacity = _round_up(self.capacity, _CHUNK_ROWS)
         self.dtype = dtype
         self._np_dtype = _np_dtype(dtype)
+        self._is_int8 = dtype == "int8"
         self._lock = threading.RLock()
 
         # host mirror
@@ -188,9 +283,12 @@ class BruteForceKnnIndex:
         self._dirty: set[int] = set()    # host → device pending
         self._stale: set[int] = set()    # device → host pending (add_batch_device)
 
-        # device state (lazy)
+        # device state (lazy); _dev_scales/_dev_vsq only for int8
+        # (per-row quantization scale + INT-domain squared norm, f32)
         self._dev_vectors = None
         self._dev_valid = None
+        self._dev_scales = None
+        self._dev_vsq = None
         self._device = device
 
     # ------------------------------------------------------------------
@@ -331,12 +429,21 @@ class BruteForceKnnIndex:
         slab_dtype = (jnp.bfloat16 if self.dtype == "bfloat16"
                       else jnp.float32)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(slab, valid, slots, *args):
-            out = producer(*args)
-            slab = slab.at[slots].set(out.astype(slab_dtype))
-            valid = valid.at[slots].set(True)
-            return slab, valid
+        if self._is_int8:
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def step_i8(slab, scales, vsq, valid, slots, *args):
+                q, scale, vn = _quantize_i8(producer(*args))
+                return (slab.at[slots].set(q),
+                        scales.at[slots].set(scale),
+                        vsq.at[slots].set(vn),
+                        valid.at[slots].set(True))
+        else:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step(slab, valid, slots, *args):
+                out = producer(*args)
+                slab = slab.at[slots].set(out.astype(slab_dtype))
+                valid = valid.at[slots].set(True)
+                return slab, valid
 
         def ingest(keys: list[Pointer], *args) -> None:
             with self._lock:
@@ -357,9 +464,15 @@ class BruteForceKnnIndex:
                         k2s[key] = slot
                         s2k[slot] = key
                     slots[i] = slot
-                self._dev_vectors, self._dev_valid = step(
-                    self._dev_vectors, self._dev_valid,
-                    jnp.asarray(slots), *args)
+                if self._is_int8:
+                    (self._dev_vectors, self._dev_scales, self._dev_vsq,
+                     self._dev_valid) = step_i8(
+                        self._dev_vectors, self._dev_scales, self._dev_vsq,
+                        self._dev_valid, jnp.asarray(slots), *args)
+                else:
+                    self._dev_vectors, self._dev_valid = step(
+                        self._dev_vectors, self._dev_valid,
+                        jnp.asarray(slots), *args)
                 self._host_valid[slots] = True
                 slot_list = slots.tolist()
                 self._stale.update(slot_list)
@@ -376,6 +489,11 @@ class BruteForceKnnIndex:
             return
         idxs = np.fromiter(self._stale, dtype=np.int32)
         self._stale.clear()
+        if self._is_int8:
+            rows = np.asarray(self._dev_vectors[idxs], dtype=np.float32)
+            scales = np.asarray(self._dev_scales[idxs], dtype=np.float32)
+            self._host_vectors[idxs] = rows * scales[:, None]
+            return
         self._host_vectors[idxs] = np.asarray(
             self._dev_vectors[idxs]).astype(self._np_dtype)
 
@@ -411,6 +529,8 @@ class BruteForceKnnIndex:
         self._free.extend(range(self.capacity - 1, old_cap - 1, -1))
         self._dev_vectors = None  # device slab is re-created at next search
         self._dev_valid = None
+        self._dev_scales = None
+        self._dev_vsq = None
         # every occupied slot must re-ship: the next flush may take the
         # zero-slab + scatter path, which uploads only dirty rows
         self._dirty.update(self._slot_to_key.keys())
@@ -420,6 +540,12 @@ class BruteForceKnnIndex:
     # ------------------------------------------------------------------
     def _scatter(self, idxs, vals, valid_vals):
         """Slab-donating scatter through the shared jitted kernel."""
+        if self._is_int8:
+            (self._dev_vectors, self._dev_scales, self._dev_vsq,
+             self._dev_valid) = _shared_scatter_i8_fn()(
+                self._dev_vectors, self._dev_scales, self._dev_vsq,
+                self._dev_valid, idxs, vals, valid_vals)
+            return
         self._dev_vectors, self._dev_valid = _shared_scatter_fn()(
             self._dev_vectors, self._dev_valid, idxs, vals, valid_vals)
 
@@ -428,7 +554,17 @@ class BruteForceKnnIndex:
         import jax.numpy as jnp
 
         if self._dev_vectors is None:
-            if len(self._dirty) * 2 < self.capacity:
+            if self._is_int8:
+                # always zero-slab + scatter: quantization happens in the
+                # scatter kernel, so the dense f32-mirror upload shortcut
+                # does not apply
+                self._dev_vectors = jnp.zeros(
+                    (self.capacity, self.dim), dtype=jnp.int8)
+                self._dev_scales = jnp.zeros((self.capacity,), jnp.float32)
+                self._dev_vsq = jnp.zeros((self.capacity,), jnp.float32)
+                self._dev_valid = jnp.zeros((self.capacity,), dtype=bool)
+                self._dirty.update(np.flatnonzero(self._host_valid).tolist())
+            elif len(self._dirty) * 2 < self.capacity:
                 # sparse occupancy: materialize a zero slab ON DEVICE (no
                 # host transfer) and fall through to the dirty scatter —
                 # incremental ingest then ships only written rows
@@ -458,7 +594,19 @@ class BruteForceKnnIndex:
             self._flush_to_device()
 
     def _get_search_fn(self, k: int):
+        """Jitted search(queries, vectors, extras, valid) — pair with
+        ``_search_extras()`` at the call site."""
+        if self._is_int8:
+            return _shared_search_i8_fn(k, self.metric)
         return _shared_search_fn(k, self.metric)
+
+    def _search_extras(self) -> tuple:
+        """Per-row side columns the search kernel needs next to the slab
+        ((scales, vsq) for int8, () otherwise). Call after
+        _flush_to_device."""
+        if self._is_int8:
+            return (self._dev_scales, self._dev_vsq)
+        return ()
 
     def search(self, queries: list[tuple]) -> list[tuple]:
         """Batched search: [(qkey, vector, limit, filter)] →
@@ -489,6 +637,7 @@ class BruteForceKnnIndex:
             while True:
                 search_fn = self._get_search_fn(fetch_k)
                 top_scores_d, top_idx_d = search_fn(qmat, self._dev_vectors,
+                                                    self._search_extras(),
                                                     self._dev_valid)
                 top_scores = np.asarray(top_scores_d)
                 top_idx = np.asarray(top_idx_d)
@@ -590,18 +739,19 @@ class BruteForceKnnIndex:
             qpool = jnp.asarray(rng.random(
                 (reps, batch_size, self.dim), dtype=np.float32) * 2.0 - 1.0)
             vectors, valid = self._dev_vectors, self._dev_valid
+            extras = self._search_extras()
 
             @jax.jit
-            def probe(qpool, vectors, valid):
+            def probe(qpool, vectors, extras, valid):
                 def body(i, acc):
-                    ts, ti = search_fn(qpool[i], vectors, valid)
+                    ts, ti = search_fn(qpool[i], vectors, extras, valid)
                     return acc + jnp.sum(ts) + jnp.sum(ti).astype(jnp.float32)
 
                 return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
 
-            float(probe(qpool, vectors, valid))  # compile + warm
+            float(probe(qpool, vectors, extras, valid))  # compile + warm
             t0 = _time.perf_counter()
-            float(probe(qpool, vectors, valid))
+            float(probe(qpool, vectors, extras, valid))
             total = _time.perf_counter() - t0
             return total / reps * 1e3
 

@@ -222,6 +222,85 @@ def test_knn_bf16_recall_parity_with_f32():
         assert all(rb[i][0][0] == rf[i][0][0] for i in range(8))
 
 
+def test_knn_int8_recall_parity_with_f32():
+    """int8 slab (half of bf16's bytes; per-row symmetric quantization in
+    the device scatter): top-10 must agree with f32 within quantization
+    slack, and top-1 exactly, on well-separated random data — for both
+    metrics (COS needs no scales in-kernel, L2SQ folds them in)."""
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    rng = np.random.default_rng(3)
+    n, d = 2048, 64
+    vecs = rng.normal(size=(n, d)).astype(np.float32)
+    queries = rng.normal(size=(8, d)).astype(np.float32)
+    for metric in (KnnMetric.L2SQ, KnnMetric.COS):
+        f32 = BruteForceKnnIndex(d, metric=metric, reserved_space=n)
+        i8 = BruteForceKnnIndex(d, metric=metric, reserved_space=n,
+                                dtype="int8")
+        keys = [Pointer(i) for i in range(n)]
+        f32.add_batch(keys, vecs)
+        i8.add_batch(keys, vecs)
+        q = [(Pointer(10_000 + i), queries[i], 10, None) for i in range(8)]
+        rf = f32.search(q)
+        ri = i8.search(q)
+        for got_f, got_i in zip(rf, ri):
+            exact = {k for k, _ in got_f}
+            approx = {k for k, _ in got_i}
+            recall = len(exact & approx) / len(exact)
+            assert recall >= 0.8, (metric, recall)
+        # top-1 mostly agrees; an exact all-8 assert would hinge on
+        # neighbor gaps exceeding ~1e-2 quantization error for this seed
+        agree = sum(ri[i][0][0] == rf[i][0][0] for i in range(8))
+        assert agree >= 6, (metric, agree)
+
+
+def test_knn_int8_update_remove_and_mirror_sync():
+    """int8 index lifecycle: updates overwrite (new quantized row wins),
+    removes drop rows from results, and the device→host mirror sync
+    dequantizes (add_batch_device rows read back within quantization
+    error)."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    d = 16
+    idx = BruteForceKnnIndex(d, metric=KnnMetric.COS, reserved_space=64,
+                             dtype="int8")
+    e = np.eye(d, dtype=np.float32)
+    idx.add(Pointer(0), e[0])
+    idx.add(Pointer(1), e[1])
+    (res,) = idx.search([(Pointer(99), e[0], 1, None)])
+    assert res[0][0] == Pointer(0)
+    idx.add(Pointer(0), e[2])  # update: row 0 now points along axis 2
+    (res,) = idx.search([(Pointer(99), e[2], 1, None)])
+    assert res[0][0] == Pointer(0)
+    (res,) = idx.search([(Pointer(99), e[0], 2, None)])
+    assert all(score > 0.5 for _, score in res)  # nothing near e0 now
+    idx.remove(Pointer(1))
+    (res,) = idx.search([(Pointer(99), e[1], 2, None)])
+    assert Pointer(1) not in {k for k, _ in res}
+
+    # device-born rows: mirror sync must dequantize
+    rows = np.stack([e[5] * 3.0, e[6] * 0.25]).astype(np.float32)
+    idx.add_batch_device([Pointer(5), Pointer(6)], jnp.asarray(rows))
+    idx._sync_mirror()
+    got = idx._host_vectors[[idx._key_to_slot[Pointer(5)],
+                             idx._key_to_slot[Pointer(6)]]]
+    np.testing.assert_allclose(got, rows, rtol=0.02, atol=1e-6)
+
+    # fused ingest (producer output quantized in the same donated
+    # dispatch): a produced row must retrieve itself
+    fused = BruteForceKnnIndex(d, metric=KnnMetric.COS, reserved_space=64,
+                               dtype="int8")
+    ingest = fused.make_fused_ingest(lambda x: x * 2.0 + 0.1)
+    base = np.stack([e[1], e[3]]).astype(np.float32)
+    ingest([Pointer(1), Pointer(3)], jnp.asarray(base))
+    (res,) = fused.search([(Pointer(99), base[1] * 2.0 + 0.1, 1, None)])
+    assert res[0][0] == Pointer(3)
+
+
 def test_knn_chunked_scan_matches_single_shot(monkeypatch):
     """Force the chunked lax.scan path with a tiny chunk size: results
     must be identical to the single-matmul path (it is exact, not
