@@ -32,7 +32,9 @@ class BruteForceKnnFactory:
     index (parallel/sharded_knn.py — slab split over ICI, per-shard top-k
     merge), the TPU-native counterpart of the reference's per-worker index
     instances. ``dtype='bfloat16'`` halves slab bytes AND scan time
-    (10M x 384 fits one chip)."""
+    (10M x 384 fits one chip); ``dtype='int8'`` halves them again
+    (per-row symmetric quantization on device, host mirror exact f32 —
+    see ops/knn.py)."""
 
     dimensions: int | None = None
     reserved_space: int = 1024

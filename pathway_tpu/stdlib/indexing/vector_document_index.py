@@ -33,7 +33,9 @@ def default_brute_force_knn_document_index(
         mesh: Any = None, dtype: str = "float32") -> DataIndex:
     """``mesh='auto'`` shards the slab over the device mesh's data axis
     (ICI top-k merge) when more than one device is visible; ``dtype=
-    'bfloat16'`` halves slab bytes and scan time on one chip."""
+    'bfloat16'`` halves slab bytes and scan time on one chip, and
+    ``dtype='int8'`` halves them again (quantized on device, host mirror
+    exact f32)."""
     inner = BruteForceKnn(
         data_column, metadata_column, dimensions=dimensions,
         reserved_space=reserved_space, metric=metric, embedder=embedder,
