@@ -214,6 +214,19 @@ def _quantize_i8(vals):
     return q, scale, vsq
 
 
+def _quantize_i8_np(vals: np.ndarray):
+    """numpy twin of _quantize_i8 (same formula term by term) for indexes
+    whose quantization runs host-side before a sharded device_put
+    (parallel/sharded_knn.py)."""
+    v = vals.astype(np.float32)
+    m = np.max(np.abs(v), axis=1)
+    scale = np.maximum(m / 127.0, 1e-30).astype(np.float32)
+    q = np.clip(np.round(v / scale[:, None]), -127, 127).astype(np.int8)
+    qf = q.astype(np.float32)
+    vsq = np.sum(qf * qf, axis=1)
+    return q, scale, vsq
+
+
 @functools.lru_cache(maxsize=None)
 def _shared_scatter_i8_fn():
     """Slab-donating QUANTIZING scatter for int8 indexes (see

@@ -21,7 +21,7 @@ from typing import Any, Callable
 import numpy as np
 
 from pathway_tpu.internals.keys import Pointer
-from pathway_tpu.ops.knn import KnnMetric, _round_up
+from pathway_tpu.ops.knn import KnnMetric, _quantize_i8_np, _round_up
 from pathway_tpu.parallel.mesh import DATA_AXIS, get_mesh
 
 
@@ -37,11 +37,20 @@ class ShardedKnnIndex:
 
     def __init__(self, dimensions: int, *, mesh=None,
                  reserved_space: int = 0,
-                 metric: KnnMetric | str = KnnMetric.L2SQ):
+                 metric: KnnMetric | str = KnnMetric.L2SQ,
+                 dtype: str = "float32"):
         if isinstance(metric, str):
             metric = KnnMetric(metric)
+        if dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(f"unsupported sharded knn dtype {dtype!r} "
+                             "(use 'float32', 'bfloat16' or 'int8')")
         self.dim = int(dimensions)
         self.metric = metric
+        # per-shard slab storage: bf16 halves slab bytes/scan time per
+        # chip, int8 halves them again (host-side per-row quantization at
+        # flush, same scheme as ops/knn.py _quantize_i8; the host mirror
+        # stays exact f32)
+        self.dtype = dtype
         self._mesh = mesh if mesh is not None else get_mesh()
         self.n_shards = int(self._mesh.shape[DATA_AXIS])
         per = max(reserved_space // self.n_shards + 1, 1)
@@ -63,6 +72,8 @@ class ShardedKnnIndex:
         self._dirty: set[int] = set()
         self._dev_vectors = None
         self._dev_valid = None
+        self._dev_scales = None  # int8 only: per-row scale + INT-domain
+        self._dev_vsq = None     # squared norm, both (S, C) f32
         self._search_fn_cache: dict[tuple, Callable] = {}
 
     @property
@@ -174,6 +185,8 @@ class ShardedKnnIndex:
         self.cap_per_shard = new_per
         self._dev_vectors = None
         self._dev_valid = None
+        self._dev_scales = None
+        self._dev_vsq = None
         self._search_fn_cache.clear()
         self._dirty.clear()
 
@@ -185,9 +198,30 @@ class ShardedKnnIndex:
         S, C, D = self.n_shards, self.cap_per_shard, self.dim
         sharding = jax.sharding.NamedSharding(
             self._mesh, jax.sharding.PartitionSpec(DATA_AXIS))
+
+        def slab_rows(rows):
+            if self.dtype == "bfloat16":
+                return rows.astype(jnp.bfloat16) if hasattr(rows, "astype") \
+                    else rows
+            return rows
+
         if self._dev_vectors is None:
-            self._dev_vectors = jax.device_put(
-                self._host_vectors.reshape(S, C, D), sharding)
+            if self.dtype == "int8":
+                q, scale, vsq = _quantize_i8_np(self._host_vectors)
+                self._dev_vectors = jax.device_put(
+                    q.reshape(S, C, D), sharding)
+                self._dev_scales = jax.device_put(
+                    scale.reshape(S, C), sharding)
+                self._dev_vsq = jax.device_put(
+                    vsq.reshape(S, C), sharding)
+            else:
+                host = self._host_vectors
+                if self.dtype == "bfloat16":
+                    import ml_dtypes
+
+                    host = host.astype(ml_dtypes.bfloat16)
+                self._dev_vectors = jax.device_put(
+                    host.reshape(S, C, D), sharding)
             self._dev_valid = jax.device_put(
                 self._host_valid.reshape(S, C), sharding)
             self._dirty.clear()
@@ -196,13 +230,22 @@ class ShardedKnnIndex:
             idxs = np.fromiter(self._dirty, dtype=np.int32)
             self._dirty.clear()
             sh, sl = idxs // C, idxs % C
-            self._dev_vectors = self._dev_vectors.at[sh, sl].set(
-                jnp.asarray(self._host_vectors[idxs]))
+            if self.dtype == "int8":
+                q, scale, vsq = _quantize_i8_np(self._host_vectors[idxs])
+                self._dev_vectors = self._dev_vectors.at[sh, sl].set(
+                    jnp.asarray(q))
+                self._dev_scales = self._dev_scales.at[sh, sl].set(
+                    jnp.asarray(scale))
+                self._dev_vsq = self._dev_vsq.at[sh, sl].set(
+                    jnp.asarray(vsq))
+            else:
+                self._dev_vectors = self._dev_vectors.at[sh, sl].set(
+                    slab_rows(jnp.asarray(self._host_vectors[idxs])))
             self._dev_valid = self._dev_valid.at[sh, sl].set(
                 jnp.asarray(self._host_valid[idxs]))
 
     def _get_search_fn(self, k: int):
-        cache_key = (k, self.cap_per_shard)
+        cache_key = (k, self.cap_per_shard, self.dtype)
         fn = self._search_fn_cache.get(cache_key)
         if fn is not None:
             return fn
@@ -212,19 +255,41 @@ class ShardedKnnIndex:
 
         metric = self.metric
         C = self.cap_per_shard
+        int8 = self.dtype == "int8"
 
-        def local_search(queries, vectors, valid):
-            # queries (B, D) replicated; vectors (1, C, D), valid (1, C) local
+        def local_search(queries, vectors, valid, *extras):
+            # queries (B, D) replicated; vectors (1, C, D), valid (1, C)
+            # local; extras = (scales, vsq) per-shard for int8
             vecs = vectors[0]
-            if metric == KnnMetric.COS:
+            if int8:
+                scales, vsq = extras[0][0], extras[1][0]
+                vs = vecs.astype(jnp.bfloat16)
+                if metric == KnnMetric.COS:
+                    qn = queries / (jnp.linalg.norm(
+                        queries, axis=1, keepdims=True) + 1e-12)
+                    dots = jax.lax.dot_general(
+                        qn.astype(jnp.bfloat16), vs,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    # per-row scale cancels for cosine (see ops/knn.py)
+                    scores = dots * jax.lax.rsqrt(vsq + 1e-12)[None, :]
+                else:
+                    dots = jax.lax.dot_general(
+                        queries.astype(jnp.bfloat16), vs,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    scores = (2.0 * dots * scales[None, :]
+                              - vsq * (scales * scales)[None, :])
+            elif metric == KnnMetric.COS:
                 qn = queries / (jnp.linalg.norm(queries, axis=1,
                                                 keepdims=True) + 1e-12)
-                vn = vecs / (jnp.linalg.norm(vecs, axis=1,
-                                             keepdims=True) + 1e-12)
+                vn = vecs / (jnp.linalg.norm(
+                    vecs.astype(jnp.float32), axis=1, keepdims=True) + 1e-12)
                 scores = qn @ vn.T
             else:
                 dots = queries @ vecs.T
-                v_sq = jnp.sum(vecs * vecs, axis=1)
+                vf = vecs.astype(jnp.float32)
+                v_sq = jnp.sum(vf * vf, axis=1)
                 scores = 2.0 * dots - v_sq[None, :]
             scores = jnp.where(valid[0][None, :], scores, -jnp.inf)
             s, i = jax.lax.top_k(scores, min(k, C))  # (B, k) local
@@ -241,9 +306,12 @@ class ShardedKnnIndex:
             mi = jnp.take_along_axis(cand_i, mpos, axis=1)
             return ms, mi
 
+        in_specs = (P(), P(DATA_AXIS), P(DATA_AXIS))
+        if int8:
+            in_specs = in_specs + (P(DATA_AXIS), P(DATA_AXIS))
         shard_fn = jax.shard_map(
             local_search, mesh=self._mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=in_specs,
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -267,8 +335,10 @@ class ShardedKnnIndex:
             qmat = np.stack([np.asarray(q[1], dtype=np.float32).reshape(-1)
                              for q in queries])
             search_fn = self._get_search_fn(fetch_k)
+            extras = ((self._dev_scales, self._dev_vsq)
+                      if self.dtype == "int8" else ())
             top_scores, top_idx = search_fn(qmat, self._dev_vectors,
-                                            self._dev_valid)
+                                            self._dev_valid, *extras)
             top_scores = np.asarray(top_scores)
             top_idx = np.asarray(top_idx)
 

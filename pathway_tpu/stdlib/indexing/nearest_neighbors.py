@@ -61,16 +61,9 @@ class BruteForceKnnFactory:
         if mesh is not None:
             from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex
 
-            if self.dtype != "float32":
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "mesh-sharded KNN slab currently stores float32 — "
-                    "dtype=%r is ignored (per-shard bf16 slabs are the "
-                    "single-chip BruteForceKnnIndex's feature)", self.dtype)
             return ShardedKnnIndex(dim, mesh=mesh,
                                    reserved_space=self.reserved_space,
-                                   metric=self.metric)
+                                   metric=self.metric, dtype=self.dtype)
         inner = BruteForceKnnIndex(
             dim, reserved_space=self.reserved_space, metric=self.metric,
             dtype=self.dtype)

@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.ops.knn import KnnMetric
 from pathway_tpu.parallel import (
     MeshConfig,
     ShardedKnnIndex,
@@ -55,6 +56,39 @@ def test_sharded_knn_matches_exact(mesh8):
         assert [k for k, _ in result] == [k for k, _ in expected]
         for (_, got), (_, want) in zip(result, expected):
             assert got == pytest.approx(want, rel=1e-4, abs=1e-4)
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+def test_sharded_knn_low_precision_slabs(mesh8, dtype):
+    """Per-shard bf16/int8 slabs: top-k over the mesh must agree with the
+    f32 sharded index within low-precision slack (top-1 exactly on this
+    well-separated data), for both metrics — incl. after updates (dirty
+    rows re-quantize on flush) and grow."""
+    rng = np.random.default_rng(7)
+    n, dim = 400, 16
+    vectors = rng.normal(size=(n, dim)).astype(np.float32)
+    keys = [Pointer(i) for i in range(n)]
+    queries = rng.normal(size=(6, dim)).astype(np.float32)
+    for metric in (KnnMetric.L2SQ, KnnMetric.COS):
+        with use_mesh(mesh8):
+            ref = ShardedKnnIndex(dim, mesh=mesh8, reserved_space=n,
+                                  metric=metric)
+            low = ShardedKnnIndex(dim, mesh=mesh8, reserved_space=n,
+                                  metric=metric, dtype=dtype)
+            ref.add_batch(keys, vectors)
+            low.add_batch(keys, vectors)
+            q = [(Pointer(10_000 + i), queries[i], 10, None)
+                 for i in range(6)]
+            rf, rl = ref.search(q), low.search(q)
+            for got_f, got_l in zip(rf, rl):
+                overlap = len({k for k, _ in got_f} & {k for k, _ in got_l})
+                assert overlap >= 8, (metric, dtype, overlap)
+                assert got_l[0][0] == got_f[0][0]
+            # update + re-search: the dirty row re-quantizes on flush
+            low.add(keys[0], vectors[1])
+            ref.add(keys[0], vectors[1])
+            (r2,) = low.search([(Pointer(11_000), vectors[1], 2, None)])
+            assert {k for k, _ in r2} == {keys[0], keys[1]}
 
 
 def test_sharded_knn_remove_and_grow(mesh8):
