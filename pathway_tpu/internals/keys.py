@@ -133,6 +133,19 @@ def hash_values(*values: Any) -> Pointer:
     return result
 
 
+def hash_values_uncached(*values: Any) -> Pointer:
+    """hash_values minus the memo cache, for callers whose keys are
+    unique by construction (e.g. per-row source ids that embed a row
+    index): the cache tuple build + miss + insert is pure overhead there
+    and evicts genuinely-repeating dataflow keys. Identical bytes →
+    identical Pointer as hash_values."""
+    out: list = []
+    for v in values:
+        _encode_value(v, out)
+    digest = hashlib.blake2b(b"".join(out), digest_size=16, key=_SALT).digest()
+    return Pointer(int.from_bytes(digest, "little"))
+
+
 def ref_scalar(*args: Any, optional: bool = False) -> Pointer:
     """Public ``pw.this.pointer_from`` scalar variant."""
     return hash_values(*args)
