@@ -56,7 +56,88 @@ def _b64ish(v: int) -> str:
 
 
 def _encode_value(value: Any, out: list) -> None:
-    """Canonical byte encoding of an engine value for hashing."""
+    """Canonical byte encoding of an engine value for hashing.
+
+    Hot path: one exact-type dict dispatch (``_ENCODERS``) instead of an
+    isinstance chain — this runs once per value per key derivation
+    (~millions of calls per 100k-row tick). Subclasses and numpy scalar
+    types miss the dict and take the full chain below, which stays the
+    single source of encoding truth for them."""
+    enc = _ENCODERS.get(type(value))
+    if enc is not None:
+        enc(value, out)
+        return
+    _encode_value_slow(value, out)
+
+
+def _enc_none(value, out):
+    out.append(b"\x00")
+
+
+def _enc_bool(value, out):
+    out.append(b"\x01\x01" if value else b"\x01\x00")
+
+
+def _enc_pointer(value, out):
+    out.append(b"\x02" + int(value).to_bytes(16, "little"))
+
+
+def _enc_int(value, out):
+    v = int(value)
+    if -(2**63) <= v < 2**63:
+        out.append(b"\x03" + struct.pack("<q", v))
+    else:
+        # arbitrary-precision ints (e.g. raw 128-bit pointer values)
+        b = v.to_bytes((v.bit_length() + 8) // 8, "little", signed=True)
+        out.append(b"\x0b" + struct.pack("<q", len(b)) + b)
+
+
+def _enc_float(value, out):
+    f = float(value)
+    if math.isfinite(f) and f == int(f) and abs(f) < 2**62:
+        # ints and equal floats hash identically (reference: HashInto for Value)
+        out.append(b"\x03" + struct.pack("<q", int(f)))
+    else:
+        out.append(b"\x04" + struct.pack("<d", f))
+
+
+def _enc_str(value, out):
+    b = value.encode()
+    out.append(b"\x05" + struct.pack("<q", len(b)) + b)
+
+
+def _enc_bytes(value, out):
+    out.append(b"\x06" + struct.pack("<q", len(value)) + value)
+
+
+def _enc_tuple(value, out):
+    out.append(b"\x07" + struct.pack("<q", len(value)))
+    for v in value:
+        _encode_value(v, out)
+
+
+def _enc_ndarray(value, out):
+    out.append(b"\x08" + str(value.dtype).encode() + struct.pack(
+        "<q", value.ndim) + value.shape.__repr__().encode() + value.tobytes())
+
+
+_ENCODERS = {
+    type(None): _enc_none,
+    bool: _enc_bool,
+    Pointer: _enc_pointer,
+    int: _enc_int,
+    float: _enc_float,
+    str: _enc_str,
+    bytes: _enc_bytes,
+    tuple: _enc_tuple,
+    np.ndarray: _enc_ndarray,
+}
+
+
+def _encode_value_slow(value: Any, out: list) -> None:
+    """Full chain for types outside _ENCODERS (numpy scalars, subclasses,
+    Json, arbitrary objects). MUST encode identically to the fast
+    encoders for any value both can see."""
     if value is None:
         out.append(b"\x00")
     elif value is True:
@@ -64,34 +145,19 @@ def _encode_value(value: Any, out: list) -> None:
     elif value is False:
         out.append(b"\x01\x00")
     elif isinstance(value, Pointer):
-        out.append(b"\x02" + int(value).to_bytes(16, "little"))
+        _enc_pointer(value, out)
     elif isinstance(value, (int, np.integer)):
-        v = int(value)
-        if -(2**63) <= v < 2**63:
-            out.append(b"\x03" + struct.pack("<q", v))
-        else:
-            # arbitrary-precision ints (e.g. raw 128-bit pointer values)
-            b = v.to_bytes((v.bit_length() + 8) // 8, "little", signed=True)
-            out.append(b"\x0b" + struct.pack("<q", len(b)) + b)
+        _enc_int(value, out)
     elif isinstance(value, (float, np.floating)):
-        f = float(value)
-        if math.isfinite(f) and f == int(f) and abs(f) < 2**62:
-            # ints and equal floats hash identically (reference: HashInto for Value)
-            out.append(b"\x03" + struct.pack("<q", int(f)))
-        else:
-            out.append(b"\x04" + struct.pack("<d", f))
+        _enc_float(value, out)
     elif isinstance(value, str):
-        b = value.encode()
-        out.append(b"\x05" + struct.pack("<q", len(b)) + b)
+        _enc_str(value, out)
     elif isinstance(value, bytes):
-        out.append(b"\x06" + struct.pack("<q", len(value)) + value)
+        _enc_bytes(value, out)
     elif isinstance(value, tuple):
-        out.append(b"\x07" + struct.pack("<q", len(value)))
-        for v in value:
-            _encode_value(v, out)
+        _enc_tuple(value, out)
     elif isinstance(value, np.ndarray):
-        out.append(b"\x08" + str(value.dtype).encode() + struct.pack(
-            "<q", value.ndim) + value.shape.__repr__().encode() + value.tobytes())
+        _enc_ndarray(value, out)
     else:
         from pathway_tpu.internals.json import Json
 
