@@ -77,7 +77,9 @@ def main() -> None:
     holder: dict = {}
     embedder = make_embedder(holder)
     # mesh='auto': >1 device on the data axis -> slab sharded over ICI
-    # with per-shard top-k merge; 1 device -> plain HBM slab
+    # with per-shard top-k merge; 1 device -> plain HBM slab. bf16 halves
+    # per-chip slab bytes/scan time; dtype="int8" halves them again
+    # (~30M vectors/chip at 384 dims)
     index = default_brute_force_knn_document_index(
         docs.data, docs, dimensions=holder["dim"], embedder=embedder,
         mesh="auto", dtype="bfloat16")
