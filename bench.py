@@ -413,11 +413,19 @@ def _device_only_mfu(params, config, B: int = 2048, W: int = 128,
         return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
 
     float(loop(params, ids, lens))  # compile + warm
-    t0 = time.perf_counter()
-    float(loop(params, ids, lens))
-    dt = time.perf_counter() - t0
+    # best of 3: this reports the program's device CEILING, and transient
+    # chip contention can only subtract from it (observed 0.41-0.58
+    # spread on the shared dev chip for identical code)
+    dt = min(_timed(lambda: float(loop(params, ids, lens)))
+             for _ in range(3))
     return reps * B * W * _encoder_flops_per_token(config, seq=W) \
         / dt / (PEAK_TFLOPS * 1e12)
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
 
 
 def bench_embed_framework(n_docs: int | None = None) -> dict:
