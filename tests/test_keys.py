@@ -1,0 +1,50 @@
+"""Key derivation invariants (internals/keys.py).
+
+The encoding is the cross-process sharding contract (blake2b-128 over a
+canonical value encoding, reference: src/engine/value.rs HashInto) — the
+fast exact-type dispatch, the slow isinstance chain, and the uncached
+variant must all produce identical bytes for any value they share.
+"""
+
+import numpy as np
+
+from pathway_tpu.internals.keys import (Pointer, _encode_value,
+                                        _encode_value_slow, hash_values,
+                                        hash_values_uncached)
+
+
+CASES = [
+    None, True, False, 0, 1, -5, 2**62, 2**70, -(2**70),
+    1.5, 3.0, -0.0, float("nan"), float("inf"), float("-inf"),
+    "", "abc", "naïve", b"", b"xy",
+    (), (1, "a", (2.0, None)), Pointer(123), Pointer((1 << 127) + 5),
+    np.int64(7), np.int32(-3), np.float32(2.5), np.float64(4.0),
+    np.arange(6).reshape(2, 3), np.zeros(0, np.float32),
+]
+
+
+def test_fast_and_slow_encoders_agree():
+    for v in CASES:
+        fast: list = []
+        slow: list = []
+        _encode_value(v, fast)
+        _encode_value_slow(v, slow)
+        assert b"".join(fast) == b"".join(slow), v
+
+
+def test_uncached_matches_cached():
+    for v in CASES:
+        assert hash_values_uncached("row", 3, v) == hash_values("row", 3, v)
+
+
+def test_int_float_equal_values_share_keys():
+    # reference HashInto: 3 and 3.0 hash identically; bools do NOT
+    assert hash_values(3) == hash_values(3.0)
+    assert hash_values(np.int64(3)) == hash_values(3)
+    assert hash_values(True) != hash_values(1)
+    assert hash_values(False) != hash_values(0)
+
+
+def test_tuple_encoding_is_not_concatenation():
+    # (("a",), "b") must differ from (("a", "b"),): lengths are framed
+    assert hash_values(("a",), "b") != hash_values(("a", "b"))
