@@ -45,8 +45,16 @@ def make_docs(n: int, seed: int = 0) -> list[str]:
 # override with BENCH_PEAK_TFLOPS for other chips)
 PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", 197.0))
 # Wall-clock budget for the device-leg subprocess (embed + 10M-slab knn)
-DEVICE_TIMEOUT_S = float(os.environ.get("BENCH_DEVICE_TIMEOUT", 2400.0))
+# per-group wall-clock budget, TOTAL across its retries (healthy runs:
+# embed+framework ≈ 6 min, knn incl. int8 ≈ 15 min — well inside)
+DEVICE_TIMEOUT_S = float(os.environ.get("BENCH_DEVICE_TIMEOUT", 1800.0))
 DEVICE_TRIES = int(os.environ.get("BENCH_DEVICE_TRIES", 2))
+# hard wall-clock budget for the WHOLE device phase (probe + all groups):
+# without it the worst case was probe 17 min + 4 x 40 min group tries
+# ≈ 3 h, and an outer driver timeout killing the bench mid-hang lost the
+# round-5 rehearsal's entire output. 3000 s leaves the knn group ≥ 20 min
+# even when the embed group burns its full budget on a half-wedged tunnel.
+DEVICE_DEADLINE_S = float(os.environ.get("BENCH_DEVICE_DEADLINE", 3000.0))
 
 
 def _encoder_flops_per_token(config, seq: int = SEQ) -> float:
@@ -142,14 +150,18 @@ def _run_leg_group(legs: list[str], timeout_s: float) -> dict:
     import sys
 
     last_err = "device legs never ran"
+    group_deadline = time.monotonic() + timeout_s  # total across tries
     for attempt in range(DEVICE_TRIES):
+        try_budget = group_deadline - time.monotonic()
+        if try_budget < 60.0:
+            break
         env = dict(os.environ, _BENCH_DEVICE_CHILD="1",
                    _BENCH_DEVICE_LEGS=",".join(legs))
         try:
             proc = subprocess.run(
                 [sys.executable, "-u", os.path.abspath(__file__)],
                 env=env, capture_output=True, text=True,
-                timeout=timeout_s)
+                timeout=try_budget)
         except subprocess.TimeoutExpired as e:
             # salvage the last snapshot line — completed legs survive a
             # hang in a later leg
@@ -176,7 +188,8 @@ def _run_leg_group(legs: list[str], timeout_s: float) -> dict:
 
 def _run_device_legs() -> dict:
     """Probe, then run embed(+framework) and knn as separately salvageable
-    subprocess groups."""
+    subprocess groups, all under one DEVICE_DEADLINE_S wall-clock budget."""
+    deadline = time.monotonic() + DEVICE_DEADLINE_S
     probe_err = _probe_backend()
     if probe_err is not None:
         return {"error": probe_err}
@@ -185,7 +198,13 @@ def _run_device_legs() -> dict:
                [leg for leg in ("knn",) if leg not in SKIP]) if g]
     result: dict = {}
     for group in groups:
-        out = _run_leg_group(group, DEVICE_TIMEOUT_S)
+        remaining = deadline - time.monotonic()
+        if remaining < 60.0:
+            result[f"{'_'.join(group)}_error"] = (
+                f"device deadline ({DEVICE_DEADLINE_S:.0f}s) exhausted "
+                "before this group ran")
+            continue
+        out = _run_leg_group(group, min(DEVICE_TIMEOUT_S, remaining))
         for k, v in out.items():
             if k in ("error", "device_hang_error"):
                 result[f"{'_'.join(group)}_{k}"] = v
@@ -227,23 +246,50 @@ def main() -> None:
             result.update(bench_etl())
         except Exception as e:  # noqa: BLE001
             errors["etl_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
+    def emit(extra_error: str | None = None) -> None:
+        # value/vs_baseline are null — not a real-looking 0.0 — when the
+        # embed leg never produced a measurement
+        docs_per_sec = result.get("docs_per_s")
+        err = dict(errors)
+        if extra_error:
+            err["bench_error"] = extra_error
+        print(json.dumps({
+            "metric": "RAG docs/sec/chip (embed+index); p50 KNN @10M",
+            "value": None if docs_per_sec is None else round(docs_per_sec, 1),
+            "unit": "docs/s",
+            "vs_baseline": None if docs_per_sec is None else round(
+                docs_per_sec / BASELINE_DOCS_PER_SEC_PER_CHIP, 3),
+            **{k: v for k, v in result.items() if k != "docs_per_s"},
+            **err,
+        }), flush=True)
+
+    # the CPU legs' numbers must survive ANYTHING the device phase does:
+    # emit a snapshot now (the capture takes the LAST parseable line), and
+    # emit again from a SIGTERM handler — a half-wedged tunnel can pass
+    # the probe then hang a dispatch for hours, and an outer driver
+    # timeout that SIGKILLs after SIGTERM must still find a JSON line
+    # (round-5 rehearsal lost a whole run's output exactly this way)
+    emit("device legs still pending" if not (
+        {"embed", "framework", "knn"} <= SKIP) else None)
+
+    import signal
+
+    def on_term(signum, frame):  # noqa: ARG001
+        emit(f"terminated by signal {signum} during device legs")
+        raise SystemExit(1)
+
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except (ValueError, OSError):
+        pass  # non-main thread / exotic platform: snapshot above suffices
+
     if not ({"embed", "framework", "knn"} <= SKIP):
         dev = _run_device_legs()
         for k, v in dev.items():
             (errors if k.endswith("error") else result)[k] = v
 
-    # value/vs_baseline are null — not a real-looking 0.0 — when the
-    # embed leg never produced a measurement
-    docs_per_sec = result.get("docs_per_s")
-    print(json.dumps({
-        "metric": "RAG docs/sec/chip (embed+index); p50 KNN @10M",
-        "value": None if docs_per_sec is None else round(docs_per_sec, 1),
-        "unit": "docs/s",
-        "vs_baseline": None if docs_per_sec is None else round(
-            docs_per_sec / BASELINE_DOCS_PER_SEC_PER_CHIP, 3),
-        **{k: v for k, v in result.items() if k != "docs_per_s"},
-        **errors,
-    }))
+    emit()
 
 
 def bench_embed() -> dict:
