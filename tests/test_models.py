@@ -301,6 +301,27 @@ def test_knn_int8_update_remove_and_mirror_sync():
     assert res[0][0] == Pointer(3)
 
 
+def test_knn_int8_grow_requantizes_from_mirror():
+    """Host-path growth past reserved capacity: the f32 mirror is
+    authoritative, the device slab (incl. scales/vsq) is rebuilt by
+    re-quantization, and search still finds exact self-neighbors."""
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+
+    rng = np.random.default_rng(11)
+    d = 8
+    idx = BruteForceKnnIndex(d, metric=KnnMetric.COS, reserved_space=32,
+                             dtype="int8")
+    base_cap = idx.capacity
+    n = base_cap + 500  # force at least one doubling
+    vecs = rng.normal(size=(n, d)).astype(np.float32)
+    idx.add_batch([Pointer(i) for i in range(n)], vecs)
+    assert idx.capacity > base_cap
+    for probe_i in (0, base_cap, n - 1):  # rows from before AND after grow
+        (res,) = idx.search([(Pointer(10**6), vecs[probe_i], 1, None)])
+        assert res[0][0] == Pointer(probe_i), probe_i
+
+
 def test_knn_chunked_scan_matches_single_shot(monkeypatch):
     """Force the chunked lax.scan path with a tiny chunk size: results
     must be identical to the single-matmul path (it is exact, not
