@@ -215,6 +215,11 @@ class ExpressionCompiler:
         "<": np.less, "<=": np.less_equal,
         ">": np.greater, ">=": np.greater_equal,
         "==": np.equal, "!=": np.not_equal,
+        # division family: numpy matches python elementwise (floor toward
+        # -inf, % sign follows divisor, / correctly rounded) EXCEPT for a
+        # zero divisor (python raises → per-cell ERROR; numpy warns and
+        # emits 0/inf/nan) — any zero in the divisor column falls back
+        "//": np.floor_divide, "%": np.mod, "/": np.true_divide,
     }
     _INT_SAFE = 1 << 62
     _FLOAT_EXACT = float(1 << 53)  # beyond this, int->float64 rounds
@@ -291,7 +296,8 @@ class ExpressionCompiler:
 
         int_safe = self._INT_SAFE
         float_exact = self._FLOAT_EXACT
-        arith = opname in ("+", "-", "*")
+        arith = opname in ("+", "-", "*", "//", "%", "/")
+        divlike = opname in ("//", "%", "/")
         np_op = self._NUMERIC_FAST_OPS[opname]
 
         def magnitude(a) -> float:
@@ -311,6 +317,9 @@ class ExpressionCompiler:
             ra = self._numeric_column(rv, pure_float=not arith)
             if la is None or ra is None:
                 return slow(lv, rv)
+            if divlike and bool((ra == 0).any()):
+                # python raises (→ per-cell ERROR) where numpy warns
+                return slow(lv, rv)
             lk, rk = la.dtype.kind, ra.dtype.kind
             if lk == "i" and rk == "i":
                 if arith:
@@ -319,6 +328,12 @@ class ExpressionCompiler:
                     amax, bmax = magnitude(la), magnitude(ra)
                     if opname == "*":
                         if amax * bmax >= float(1 << 62):
+                            return slow(lv, rv)
+                    elif opname == "/":
+                        # int/int → float: numpy converts operands to
+                        # float64 FIRST, python divides exact ints — they
+                        # differ beyond 2^53
+                        if amax >= float_exact or bmax >= float_exact:
                             return slow(lv, rv)
                     elif amax >= int_safe or bmax >= int_safe:
                         return slow(lv, rv)

@@ -229,6 +229,42 @@ def test_numeric_fast_path_edge_semantics():
     assert got.count((True, False, 0, False)) == 10
 
 
+def test_division_family_fast_path_semantics():
+    """//, % and / ride the vectorized path with python semantics intact:
+    floor toward -inf, % sign follows the divisor, int/int division is
+    exact, and any zero divisor falls back to per-row evaluation (ERROR
+    cells, not numpy's warn-and-0/inf)."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import schema as sch
+    from tests.utils import rows_of
+
+    t = table_from_rows(
+        sch.schema_from_types(a=int, b=int, f=float),
+        [(-7, 2, 2.5), (7, -2, -2.5), ((1 << 53) + 1, 3, 0.5)] + [
+            (i * 37, i % 9 + 1, float(i) + 0.5) for i in range(100)])
+    out = t.select(
+        a=t.a, fd=t.a // t.b, md=t.a % t.b, td=t.a / t.b, ffd=t.a // t.f)
+    got = {r[0]: tuple(r[1:]) for r in rows_of(out)}
+    assert got[-7] == (-4, 1, -3.5, -7 // 2.5)   # floor toward -inf
+    assert got[7] == (-4, -1, -3.5, 7 // -2.5)   # % follows divisor
+    big = (1 << 53) + 1
+    assert got[big] == (big // 3, big % 3, big / 3, big // 0.5)
+    for i in range(100):
+        a, b, f = i * 37, i % 9 + 1, float(i) + 0.5
+        assert got[a] == (a // b, a % b, a / b, a // f)
+
+    # zero divisor: per-row fallback turns the bad cells into ERROR while
+    # the good cells still compute
+    tz = table_from_rows(
+        sch.schema_from_types(a=int, b=int),
+        [(10, 2)] * 20 + [(10, 0)])
+    outz = tz.select(d=tz.a // tz.b)
+    vals = [r[0] for r in rows_of(outz)]
+    assert vals.count(5) == 20
+    assert len(vals) == 21  # the zero-divisor row became an ERROR cell
+
+
 def test_ifelse_and_negation_fast_paths():
     import pathway_tpu as pw
     from pathway_tpu.debug import table_from_rows
