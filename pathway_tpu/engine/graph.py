@@ -334,14 +334,19 @@ class Scheduler:
     def _count(self, node_id: int, delta: Delta) -> None:
         if delta:
             st = self.stats[node_id]
-            ds = [e[2] for e in delta.entries]
-            total = sum(ds)
-            if min(ds) >= 0:  # all-insert deltas are the overwhelming case
-                st["insertions"] += total
-            else:
-                neg = sum(d for d in ds if d < 0)
-                st["insertions"] += total - neg
-                st["retractions"] -= neg
+            ins = rets = 0
+            # single pass, no intermediate list: this runs per node per
+            # tick and the retraction branch is COMMON (incremental
+            # groupby emits retract+insert pairs), so the old
+            # sum + min + conditional-genexpr shape walked the entries
+            # up to three times
+            for _, _, d in delta.entries:
+                if d >= 0:
+                    ins += d
+                else:
+                    rets -= d
+            st["insertions"] += ins
+            st["retractions"] += rets
 
     def _run_time_sharded(self, time: int, flush: bool) -> dict[int, Delta]:
         n = self.n_workers
