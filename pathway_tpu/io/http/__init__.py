@@ -33,6 +33,7 @@ class PathwayWebserver:
         self.host = host
         self.port = port
         self._routes: dict[tuple[str, str], Any] = {}
+        self._formats: dict[str, str] = {}  # route -> "custom" | "raw"
         self._openapi: dict = {"openapi": "3.0.3",
                                "info": {"title": "pathway-tpu", "version": "1"},
                                "paths": {}}
@@ -42,9 +43,11 @@ class PathwayWebserver:
         self.with_schema_endpoint = with_schema_endpoint
 
     def register(self, route: str, methods: tuple[str, ...], handler,
-                 schema: type[sch.Schema] | None) -> None:
+                 schema: type[sch.Schema] | None,
+                 format: str = "custom") -> None:
         for m in methods:
             self._routes[(m.upper(), route)] = handler
+        self._formats[route] = format
         if schema is not None:
             props = {
                 c.name: {"type": _openapi_type(c.dtype)}
@@ -70,11 +73,23 @@ class PathwayWebserver:
                     return web.json_response(self._openapi)
                 return web.Response(status=404, text="no such route")
             try:
-                if request.method in ("POST", "PUT", "PATCH"):
+                fmt = self._formats.get(request.path, "custom")
+                if fmt == "raw" and request.method in ("POST", "PUT",
+                                                       "PATCH"):
+                    # raw format: the whole body IS the query value
+                    # (reference: _server.py:527 QUERY_SCHEMA_COLUMN)
+                    payload = {"query": await request.text()}
+                elif request.method in ("POST", "PUT", "PATCH"):
                     try:
                         payload = await request.json()
+                        if not isinstance(payload, dict):
+                            payload = {}
                     except Exception:
-                        payload = {"query": await request.text()}
+                        # reference custom-format semantics: unparseable
+                        # body -> {}, missing required fields then 400
+                        payload = {}
+                    for param, value in request.query.items():
+                        payload.setdefault(param, value)
                 else:
                     payload = dict(request.query)
                 result = await handler(payload)
@@ -134,11 +149,13 @@ class RestSource(DataSource):
     def __init__(self, webserver: PathwayWebserver, route: str,
                  methods: tuple[str, ...], schema,
                  delete_completed_queries: bool,
-                 autocommit_duration_ms=50, request_validator=None):
+                 autocommit_duration_ms=50, request_validator=None,
+                 format: str = "custom"):
         super().__init__(schema, autocommit_duration_ms)
         self.webserver = webserver
         self.route = route
         self.methods = methods
+        self.format = format
         self.delete_completed_queries = delete_completed_queries
         self.request_validator = request_validator
         self.pending: dict[Pointer, tuple[asyncio.AbstractEventLoop,
@@ -177,7 +194,8 @@ class RestSource(DataSource):
                 session.push(key, row, -1)
             return slot[0]
 
-        self.webserver.register(self.route, self.methods, handler, self.schema)
+        self.webserver.register(self.route, self.methods, handler,
+                                self.schema, format=self.format)
         self.webserver.start()
         # stay alive until runtime stops us (sources close when run() returns)
         stop = threading.Event()
@@ -200,16 +218,28 @@ def rest_connector(host: str | None = None, port: int | None = None, *,
                    keep_queries: bool | None = None,
                    delete_completed_queries: bool = False,
                    request_validator=None,
+                   format: str = "custom",
                    documentation=None) -> tuple[Table, Any]:
-    """Returns (query_table, response_writer)."""
+    """Returns (query_table, response_writer). ``format="custom"``
+    (default) parses the JSON body and merges URL query params, 400-ing
+    on missing required fields; ``format="raw"`` takes the whole request
+    body as the ``query`` column (reference: _server.py:50,525-535)."""
+    if format not in ("custom", "raw"):
+        raise ValueError(f"unknown endpoint input format: {format!r} "
+                         "(use 'custom' or 'raw')")
     if webserver is None:
         webserver = PathwayWebserver(host or "0.0.0.0", port or 8080)
     if schema is None:
         schema = sch.schema_from_types(query=dt.ANY)
+    if format == "raw" and "query" not in schema.column_names():
+        raise ValueError(
+            "'raw' endpoint input format requires a 'query' column "
+            "in the schema")
     source = RestSource(webserver, route, methods, schema,
                         delete_completed_queries,
                         autocommit_duration_ms=autocommit_duration_ms,
-                        request_validator=request_validator)
+                        request_validator=request_validator,
+                        format=format)
     table = Table(Plan("input", datasource=source), schema, Universe(),
                   name=f"rest:{route}")
 
