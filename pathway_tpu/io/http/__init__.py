@@ -70,7 +70,25 @@ class PathwayWebserver:
             handler = self._routes.get((request.method, request.path))
             if handler is None:
                 if request.path == "/_schema" and self.with_schema_endpoint:
-                    return web.json_response(self._openapi)
+                    # reference serves yaml by default with ?format=json
+                    # (_server.py:427-445)
+                    fmt = request.query.get("format", "yaml")
+                    if fmt == "json":
+                        return web.json_response(self._openapi)
+                    if fmt != "yaml":
+                        return web.Response(
+                            status=400,
+                            text=f"Unknown format: {fmt!r}. Supported "
+                                 "formats: 'json', 'yaml'")
+                    try:
+                        import yaml as _yaml
+
+                        text = _yaml.safe_dump(self._openapi,
+                                               sort_keys=False)
+                    except ImportError:
+                        return web.json_response(self._openapi)
+                    return web.Response(status=200, text=text,
+                                        content_type="text/x-yaml")
                 return web.Response(status=404, text="no such route")
             try:
                 fmt = self._formats.get(request.path, "custom")

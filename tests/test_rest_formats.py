@@ -59,6 +59,23 @@ def test_raw_format_takes_body_as_query(server):
     assert json.loads(body)["got"] == {"query": "plain text question"}
 
 
+def test_schema_endpoint_yaml_default_and_json(server):
+    import urllib.request
+
+    with urllib.request.urlopen(server + "/_schema", timeout=10) as r:
+        assert r.headers.get_content_type() == "text/x-yaml"
+        assert "openapi" in r.read().decode()
+    with urllib.request.urlopen(server + "/_schema?format=json",
+                                timeout=10) as r:
+        assert json.loads(r.read())["openapi"]
+    req = urllib.request.Request(server + "/_schema?format=xml")
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
 def test_rest_connector_validates_format_and_raw_schema():
     import pathway_tpu.internals.schema as sch
     from pathway_tpu.internals.parse_graph import G
