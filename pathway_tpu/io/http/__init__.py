@@ -41,6 +41,10 @@ class PathwayWebserver:
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self.with_schema_endpoint = with_schema_endpoint
+        # allow cross-origin requests (reference: aiohttp_cors with
+        # allow-all defaults, _server.py:361-371; implemented here as
+        # plain headers + OPTIONS preflight, no extra dependency)
+        self.with_cors = with_cors
 
     def register(self, route: str, methods: tuple[str, ...], handler,
                  schema: type[sch.Schema] | None,
@@ -66,7 +70,21 @@ class PathwayWebserver:
             return
         from aiohttp import web
 
+        _CORS = {
+            "Access-Control-Allow-Origin": "*",
+            "Access-Control-Allow-Methods": "*",
+            "Access-Control-Allow-Headers": "*",
+        }
+
         async def dispatch(request):
+            if self.with_cors and request.method == "OPTIONS":
+                return web.Response(status=204, headers=_CORS)
+            resp = await _dispatch_inner(request)
+            if self.with_cors:
+                resp.headers.update(_CORS)
+            return resp
+
+        async def _dispatch_inner(request):
             handler = self._routes.get((request.method, request.path))
             if handler is None:
                 if request.path == "/_schema" and self.with_schema_endpoint:

@@ -76,6 +76,28 @@ def test_schema_endpoint_yaml_default_and_json(server):
         assert e.code == 400
 
 
+def test_cors_headers_and_preflight():
+    import urllib.request
+
+    ws = PathwayWebserver(host="127.0.0.1", port=18594, with_cors=True)
+
+    async def echo(payload):
+        return {"ok": True}
+
+    ws.register("/c", ("POST",), echo, None)
+    ws.start()
+    base = "http://127.0.0.1:18594"
+    req = urllib.request.Request(base + "/c", method="OPTIONS")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+    code_body = _post(base + "/c", b"{}")
+    assert code_body[0] == 200
+    req = urllib.request.Request(base + "/c", data=b"{}", method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+
+
 def test_rest_connector_validates_format_and_raw_schema():
     import pathway_tpu.internals.schema as sch
     from pathway_tpu.internals.parse_graph import G
