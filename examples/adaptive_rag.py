@@ -29,8 +29,8 @@ from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
 from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
 
 
-def make_embedder():
-    if find_local_checkpoint("BAAI/bge-small-en-v1.5"):
+def make_embedder(force_hash: bool = False):
+    if not force_hash and find_local_checkpoint("BAAI/bge-small-en-v1.5"):
         from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
 
         return JaxEncoderEmbedder(model="BAAI/bge-small-en-v1.5")
@@ -74,23 +74,35 @@ class EchoChat(pw.udfs.UDF):
         return f"[context] {max(docs, key=len)[:200]}"
 
 
+def build(docs_dir: str, *, port: int = 8080,
+          force_hash_embedder: bool = False):
+    """Construct the adaptive-RAG serving graph; returns the answerer
+    (its graph is fully built — only run_server() executes anything)."""
+    docs = pw.io.fs.read(docs_dir, format="plaintext_by_file",
+                         mode="streaming", with_metadata=True)
+    store = VectorStoreServer(
+        docs, embedder=make_embedder(force_hash=force_hash_embedder),
+        splitter=TokenCountSplitter(max_tokens=120))
+    answerer = AdaptiveRAGQuestionAnswerer(
+        llm=EchoChat(), indexer=store, n_starting_documents=2, factor=2,
+        max_iterations=3)
+    answerer.build_server(host="0.0.0.0", port=port)
+    return answerer
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("docs_dir")
     ap.add_argument("--port", type=int, default=8080)
     args = ap.parse_args()
 
-    docs = pw.io.fs.read(args.docs_dir, format="plaintext_by_file",
-                         mode="streaming", with_metadata=True)
-    store = VectorStoreServer(
-        docs, embedder=make_embedder(),
-        splitter=TokenCountSplitter(max_tokens=120))
-    answerer = AdaptiveRAGQuestionAnswerer(
-        llm=EchoChat(), indexer=store, n_starting_documents=2, factor=2,
-        max_iterations=3)
-    answerer.build_server(host="0.0.0.0", port=args.port)
+    answerer = build(args.docs_dir, port=args.port)
     answerer.run_server()
 
 
 if __name__ == "__main__":
     main()
+elif __name__ == "__pathway_check__":
+    # graph-only import by `python -m pathway_tpu check`; the hash
+    # embedder keeps collection model-free even when checkpoints exist
+    build("./docs", force_hash_embedder=True)

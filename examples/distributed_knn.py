@@ -32,8 +32,8 @@ from pathway_tpu.stdlib.indexing import default_brute_force_knn_document_index
 from pathway_tpu.io.http import PathwayWebserver, rest_connector
 
 
-def make_embedder(dim_holder: dict):
-    if find_local_checkpoint("BAAI/bge-small-en-v1.5"):
+def make_embedder(dim_holder: dict, force_hash: bool = False):
+    if not force_hash and find_local_checkpoint("BAAI/bge-small-en-v1.5"):
         from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
 
         emb = JaxEncoderEmbedder(model="BAAI/bge-small-en-v1.5")
@@ -54,28 +54,20 @@ def make_embedder(dim_holder: dict):
     return hash_embed
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("docs", nargs="?", help="directory standing in for the "
-                    "stream when --kafka is not given")
-    ap.add_argument("--kafka", help="bootstrap servers, e.g. localhost:9092")
-    ap.add_argument("--topic", default="docs")
-    ap.add_argument("--port", type=int, default=8080)
-    ap.add_argument("--host", default="127.0.0.1")
-    args = ap.parse_args()
-
-    if args.kafka:
+def build(*, docs_dir: str | None = None, kafka: str | None = None,
+          topic: str = "docs", host: str = "127.0.0.1", port: int = 8080,
+          force_hash_embedder: bool = False) -> None:
+    """Construct the sharded-KNN serving graph (no execution)."""
+    if kafka:
         docs = pw.io.kafka.read(
-            {"bootstrap.servers": args.kafka, "group.id": "pw-knn"},
-            topic=args.topic, format="plaintext")
-    elif args.docs:
-        docs = pw.io.fs.read(args.docs, format="plaintext_by_file",
-                             mode="streaming")
+            {"bootstrap.servers": kafka, "group.id": "pw-knn"},
+            topic=topic, format="plaintext")
     else:
-        ap.error("pass a docs directory or --kafka")
+        docs = pw.io.fs.read(docs_dir, format="plaintext_by_file",
+                             mode="streaming")
 
     holder: dict = {}
-    embedder = make_embedder(holder)
+    embedder = make_embedder(holder, force_hash=force_hash_embedder)
     # mesh='auto': >1 device on the data axis -> slab sharded over ICI
     # with per-shard top-k merge; 1 device -> plain HBM slab. bf16 halves
     # per-chip slab bytes/scan time; dtype="int8" halves them again
@@ -88,7 +80,7 @@ def main() -> None:
         query: str
         k: int = 3
 
-    ws = PathwayWebserver(host=args.host, port=args.port)
+    ws = PathwayWebserver(host=host, port=port)
     queries, writer = rest_connector(
         webserver=ws, route="/v1/retrieve", schema=QuerySchema,
         delete_completed_queries=True)
@@ -97,8 +89,28 @@ def main() -> None:
         result=pw.apply(lambda t: list(t or ()),
                         hits.restrict(queries).data))
     writer(results)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("docs", nargs="?", help="directory standing in for the "
+                    "stream when --kafka is not given")
+    ap.add_argument("--kafka", help="bootstrap servers, e.g. localhost:9092")
+    ap.add_argument("--topic", default="docs")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args()
+
+    if not args.kafka and not args.docs:
+        ap.error("pass a docs directory or --kafka")
+    build(docs_dir=args.docs, kafka=args.kafka, topic=args.topic,
+          host=args.host, port=args.port)
     pw.run(monitoring_level=pw.MonitoringLevel.NONE)
 
 
 if __name__ == "__main__":
     main()
+elif __name__ == "__pathway_check__":
+    # graph-only import by `python -m pathway_tpu check`; the hash
+    # embedder keeps collection model-free even when checkpoints exist
+    build(docs_dir="./docs", force_hash_embedder=True)

@@ -41,14 +41,9 @@ def embed(text: str) -> np.ndarray:
     return v / n if n else v
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("docs", help="directory of text files to watch")
-    ap.add_argument("--port", type=int, default=8080)
-    ap.add_argument("--k", type=int, default=3)
-    args = ap.parse_args()
-
-    docs = pw.io.fs.read(args.docs, format="plaintext_by_file",
+def build(docs_dir: str, port: int, k: int) -> None:
+    """Construct the hybrid-search graph (no execution)."""
+    docs = pw.io.fs.read(docs_dir, format="plaintext_by_file",
                          mode="streaming", with_metadata=True)
     docs = docs.select(text=pw.this.data)
 
@@ -64,17 +59,26 @@ def main() -> None:
     class QuerySchema(pw.Schema):
         query: str
 
-    ws = pw.io.http.PathwayWebserver(host="0.0.0.0", port=args.port)
+    ws = pw.io.http.PathwayWebserver(host="0.0.0.0", port=port)
     queries, writer = pw.io.http.rest_connector(
         webserver=ws, route="/search", schema=QuerySchema,
         delete_completed_queries=True)
 
     fused = HybridDataIndex(docs, [text_index, vector_index])
-    res = fused.query_as_of_now(queries.query,
-                                number_of_matches=args.k)
+    res = fused.query_as_of_now(queries.query, number_of_matches=k)
     out = res.select(result=pw.apply(
         lambda ts: list(ts or ()), pw.this.text))
     writer(out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("docs", help="directory of text files to watch")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--k", type=int, default=3)
+    args = ap.parse_args()
+
+    build(args.docs, args.port, args.k)
     print(f"hybrid search at http://0.0.0.0:{args.port}/search "
           f"(BM25 phrase+stem ⊕ HNSW, RRF)")
     pw.run()
@@ -82,3 +86,6 @@ def main() -> None:
 
 if __name__ == "__main__":
     main()
+elif __name__ == "__pathway_check__":
+    # graph-only import by `python -m pathway_tpu check`
+    build("./docs", port=8080, k=3)

@@ -31,20 +31,14 @@ from pathway_tpu.io.http import PathwayWebserver, rest_connector
 from pathway_tpu.xpacks.llm.embedders import ClipEmbedder
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("images", help="directory of image files (png/jpg)")
-    ap.add_argument("--port", type=int, default=8080)
-    ap.add_argument("--host", default="127.0.0.1")
-    ap.add_argument("--tiny", action="store_true",
-                    help="tiny CLIP config (tests/offline smoke)")
-    args = ap.parse_args()
-
-    config = ClipConfig.tiny() if args.tiny else ClipConfig()
+def build(images_dir: str, *, host: str = "127.0.0.1", port: int = 8080,
+          tiny: bool = False) -> None:
+    """Construct the cross-modal retrieval graph (no execution)."""
+    config = ClipConfig.tiny() if tiny else ClipConfig()
     emb = ClipEmbedder(config=config)
     image_udf = emb.image()
 
-    images = pw.io.fs.read(args.images, format="binary", mode="streaming",
+    images = pw.io.fs.read(images_dir, format="binary", mode="streaming",
                            with_metadata=True)
     images = images.select(
         path=pw.apply(lambda m: m.value.get("path") if m else None,
@@ -58,7 +52,7 @@ def main() -> None:
         query: str
         k: int = 2
 
-    ws = PathwayWebserver(host=args.host, port=args.port)
+    ws = PathwayWebserver(host=host, port=port)
     queries, writer = rest_connector(
         webserver=ws, route="/v1/retrieve", schema=QuerySchema,
         delete_completed_queries=True)
@@ -68,8 +62,24 @@ def main() -> None:
         result=pw.apply(lambda paths: list(paths or ()),
                         hits.restrict(qv).path))
     writer(results)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("images", help="directory of image files (png/jpg)")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny CLIP config (tests/offline smoke)")
+    args = ap.parse_args()
+
+    build(args.images, host=args.host, port=args.port, tiny=args.tiny)
     pw.run(monitoring_level=pw.MonitoringLevel.NONE)
 
 
 if __name__ == "__main__":
     main()
+elif __name__ == "__pathway_check__":
+    # graph-only import by `python -m pathway_tpu check`; tiny CLIP keeps
+    # param init to a few ms
+    build("./images", tiny=True)

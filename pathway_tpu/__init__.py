@@ -38,6 +38,12 @@ from pathway_tpu.internals.iterate import iterate
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.keys import Pointer
 from pathway_tpu.internals.run import run, run_all
+from pathway_tpu.internals.static_check import (
+    Diagnostic,
+    Severity,
+    StaticCheckError,
+    static_check,
+)
 from pathway_tpu.internals.schema import (
     ColumnDefinition,
     Schema,
@@ -177,6 +183,7 @@ __all__ = [
     "declare_type", "fill_error", "if_else", "make_tuple", "require",
     "unwrap", "iterate", "udf", "UDF", "sql", "load_yaml",
     "run", "run_all", "debug", "demo", "io", "reducers", "persistence",
+    "static_check", "Diagnostic", "Severity", "StaticCheckError",
     "column_definition", "schema_builder", "schema_from_csv",
     "schema_from_dict", "schema_from_pandas", "schema_from_types",
     "indexing", "ml", "temporal", "graphs", "stdlib", "xpacks",

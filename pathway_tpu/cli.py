@@ -132,6 +132,159 @@ replay = _apply(_spawn_opts, replay)
 
 
 @cli.command()
+@click.option("--strict", is_flag=True,
+              help="treat warnings as errors (info stays informational)")
+@click.option("--require-pipeline", is_flag=True,
+              help="fail scripts that build no tables and register no "
+                   "sinks (catches graphs hidden behind __main__ guards)")
+@click.argument("paths", nargs=-1, required=True)
+def check(paths, strict, require_pipeline):
+    """Statically analyze pipeline scripts without running them.
+
+    Imports each script (or every ``*.py`` under a directory) with
+    ``pw.run`` disabled, collects the Table plan DAG it builds, and runs
+    the static analyzer (internals/static_check/) over it. Scripts are
+    imported with ``__name__ == "__pathway_check__"``, so pipelines built
+    only under ``if __name__ == "__main__":`` are skipped (reported as
+    "no pipeline collected"; an error under ``--require-pipeline``) — add
+    an ``if __name__ == "__pathway_check__":`` branch building the graph
+    with placeholder inputs to have it checked. Exits nonzero on any
+    error-severity diagnostic."""
+    import pathlib
+
+    from pathway_tpu.internals.static_check import Severity
+
+    scripts: list[pathlib.Path] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            # directory mode only gates pipeline entry points: helper
+            # modules (_*.py, __init__.py) and hidden dirs (.venv, .git)
+            # are skipped — pass a file path explicitly to force a check
+            scripts.extend(
+                f for f in sorted(path.rglob("*.py"))
+                if not f.name.startswith("_")
+                and not any(part.startswith(".")
+                            for part in f.relative_to(path).parts))
+        elif path.suffix == ".py":
+            scripts.append(path)
+        else:
+            raise click.UsageError(f"not a python script or directory: {p}")
+    if not scripts:
+        raise click.UsageError("no python scripts found under given paths")
+
+    n_errors = 0
+    for script in scripts:
+        diagnostics, collected = _collect_and_check(script)
+        bad = [d for d in diagnostics
+               if d.severity is Severity.ERROR
+               or (strict and d.severity is Severity.WARNING)]
+        if not collected and require_pipeline and not bad:
+            n_errors += 1
+            click.echo(f"[FAIL] {script} — no pipeline collected "
+                       "(--require-pipeline)", err=True)
+        elif not collected and not bad:
+            click.echo(f"[ok] {script} — no pipeline collected", err=True)
+        else:
+            n_errors += len(bad)
+            status = "FAIL" if bad else "ok"
+            click.echo(f"[{status}] {script} — "
+                       f"{len(diagnostics)} diagnostic(s)", err=True)
+        for d in diagnostics:
+            click.echo(str(d))
+    if n_errors:
+        click.echo(f"static check failed: {n_errors} blocking "
+                   f"diagnostic(s)", err=True)
+        sys.exit(1)
+
+
+def _collect_and_check(script):
+    """Import one script in collect-only mode and analyze its graph.
+
+    Returns ``(diagnostics, collected)`` where ``collected`` is False when
+    the script built no tables and registered no sinks — indistinguishable
+    from "clean" otherwise, which would make directory gates vacuous."""
+    import runpy
+
+    from pathway_tpu.internals import run as _run_module
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.static_check import Diagnostic, analyze
+
+    def _collect_only(**kwargs):
+        return None
+
+    def _register_as_sink(table, **kwargs):
+        # debug prints count as the pipeline's intended outputs, but must
+        # not execute the engine during a static check
+        G.add_output(lambda runner: None, table=table, sink="debug")
+
+    patched = [(pw, "run", _collect_only), (pw, "run_all", _collect_only),
+               (_run_module, "run", _collect_only),
+               (_run_module, "run_all", _collect_only),
+               (pw.debug, "compute_and_print", _register_as_sink),
+               (pw.debug, "compute_and_print_update_stream",
+                _register_as_sink)]
+    saved = [getattr(mod, name) for mod, name, _ in patched]
+
+    # the graph registry holds Tables only weakly; pin every table the
+    # script constructs so the DAG survives until analyze() even if the
+    # module globals are gone (e.g. the script calls sys.exit(0))
+    keep_alive: list = []
+    _real_register = G.register_table
+
+    def _register_pinned(table):
+        keep_alive.append(table)
+        _real_register(table)
+
+    G.clear()
+    script_dir = os.path.dirname(os.path.abspath(str(script)))
+    sys.path.insert(0, script_dir)
+    G.register_table = _register_pinned
+    # scripts in one directory may share helper modules with import-time
+    # side effects; drop helpers this script imports afterwards so every
+    # script's collection runs against a cold import cache
+    modules_before = set(sys.modules)
+
+    def _is_local_helper(name: str) -> bool:
+        f = getattr(sys.modules.get(name), "__file__", None)
+        return bool(f) and os.path.abspath(f).startswith(
+            script_dir + os.sep)
+    try:
+        for mod, name, stub in patched:
+            setattr(mod, name, stub)
+        try:
+            runpy.run_path(str(script), run_name="__pathway_check__")
+        except KeyboardInterrupt:
+            raise  # Ctrl-C must abort the whole check, not log a PWT000
+        except SystemExit as e:
+            if e.code not in (None, 0):
+                return [Diagnostic(
+                    code="PWT000",
+                    message="script exited with status "
+                            f"{e.code} during collection")], True
+            # clean exit: analyze what was collected
+        except BaseException as e:  # noqa: BLE001 — report, do not crash
+            return [Diagnostic(
+                code="PWT000",
+                message=f"script failed during collection: {e!r}")], True
+        collected = bool(G.tables() or G.outputs)
+        diagnostics = analyze(graph=G)
+        return diagnostics, collected
+    finally:
+        for (mod, name, _), fn in zip(patched, saved):
+            setattr(mod, name, fn)
+        del G.register_table  # drop the instance shadow of the class method
+        sys.path.remove(script_dir)
+        for name in set(sys.modules) - modules_before:
+            # framework/third-party modules stay cached: re-executing them
+            # repeats registration side effects (and C extensions such as
+            # jaxlib do not survive partial re-import at all)
+            if _is_local_helper(name):
+                del sys.modules[name]
+        G.clear()
+
+
+@cli.command()
 def spawn_from_env():
     """Run ``spawn`` with arguments taken from PATHWAY_SPAWN_ARGS
     (reference cli.py:125 — the container entrypoint hook)."""

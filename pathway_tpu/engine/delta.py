@@ -13,7 +13,7 @@ path (embeddings, KNN) never round-trips through per-row objects.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Iterator
+from typing import Callable, Iterable, Iterator
 
 import numpy as np
 

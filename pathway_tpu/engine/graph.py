@@ -16,7 +16,7 @@ timestamps; here: delta-driven rounds until the feedback delta is empty).
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable
 
 from pathway_tpu.engine.delta import Arrangement, Delta, row_fingerprint
 from pathway_tpu.engine.operators import Exchange, Operator, SourceOperator

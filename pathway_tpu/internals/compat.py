@@ -9,7 +9,7 @@ from __future__ import annotations
 import contextlib
 import pickle
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as sch

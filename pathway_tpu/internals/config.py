@@ -11,7 +11,7 @@ config.rs:7-11; we don't) and no license server phone-home (license.rs:11).
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 def _env_int(name: str, default: int) -> int:

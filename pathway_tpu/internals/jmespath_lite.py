@@ -10,7 +10,6 @@ dot paths, (back)quoted/number/string literals, ==/!=/<=/>=/</>,
 
 from __future__ import annotations
 
-import fnmatch
 import re
 from typing import Any
 

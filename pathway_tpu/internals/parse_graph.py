@@ -1,24 +1,61 @@
 """Global registry of outputs/sinks collected as user code runs
 (reference: python/pathway/internals/parse_graph.py — here the Table plans
-form the DAG themselves; the registry only tracks run-time bindings)."""
+form the DAG themselves; the registry only tracks run-time bindings).
+
+Each output is recorded as an :class:`OutputBinding` carrying not just the
+binder closure (consumed by ``pw.run``) but also the bound table and sink
+metadata, so the static analyzer (internals/static_check/) can reason about
+which tables reach a sink and whether the sink's declared format can carry
+the table's schema — without executing anything. A weak registry of every
+constructed Table powers the dead-dataflow check.
+"""
 
 from __future__ import annotations
 
+import weakref
+from dataclasses import dataclass
 from typing import Any, Callable
+
+
+@dataclass
+class OutputBinding:
+    """One registered sink: binder fn(runner) plus static metadata."""
+
+    binder: Callable
+    table: Any = None  # the Table routed to this sink (None: opaque binder)
+    sink: str = "sink"  # connector name, e.g. "fs", "postgres", "subscribe"
+    format: str | None = None  # sink serialization format when declared
 
 
 class ParseGraph:
     def __init__(self):
-        # each binder: fn(runner) -> None, attaches sinks/subscribers
-        self.output_binders: list[Callable] = []
+        # each binding's binder: fn(runner) -> None, attaches sinks/subscribers
+        self.outputs: list[OutputBinding] = []
         self.has_streaming_sources = False
+        # every Table constructed since the last clear(), weakly held —
+        # the static analyzer's universe for dead-dataflow detection
+        self._tables: "weakref.WeakSet[Any]" = weakref.WeakSet()
 
-    def add_output(self, binder: Callable) -> None:
-        self.output_binders.append(binder)
+    @property
+    def output_binders(self) -> list[Callable]:
+        return [o.binder for o in self.outputs]
+
+    def add_output(self, binder: Callable, *, table: Any = None,
+                   sink: str = "sink", format: str | None = None) -> None:
+        self.outputs.append(
+            OutputBinding(binder, table=table, sink=sink, format=format))
+
+    def register_table(self, table: Any) -> None:
+        self._tables.add(table)
+
+    def tables(self) -> list[Any]:
+        """Live tables constructed since the last clear()."""
+        return list(self._tables)
 
     def clear(self) -> None:
-        self.output_binders.clear()
+        self.outputs.clear()
         self.has_streaming_sources = False
+        self._tables = weakref.WeakSet()
         from pathway_tpu.internals.universe_solver import GLOBAL_SOLVER
 
         GLOBAL_SOLVER.reset()

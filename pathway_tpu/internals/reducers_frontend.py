@@ -20,7 +20,7 @@ b | 1 | 2 | 2
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable
 
 from pathway_tpu.internals import expression as ex
 

@@ -12,14 +12,25 @@ from pathway_tpu.internals.runner import GraphRunner
 def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = False,
         default_logging: bool = True, persistence_config=None,
         runtime_typechecking: bool | None = None, terminate_on_error: bool = True,
-        telemetry_config=None, **kwargs) -> Any:
+        telemetry_config=None, static_check: str | None = None,
+        **kwargs) -> Any:
     """Build the engine graph from all registered outputs and run it.
 
     Static-only graphs run in batch mode to completion; graphs with streaming
     sources enter the realtime microbatch loop (pathway_tpu/engine/streaming.py)
     until all sources finish or the process is stopped.
+
+    ``static_check`` runs the pre-execution analyzer
+    (internals/static_check/) over the collected plan DAG first:
+    ``"warn"`` logs every diagnostic, ``"error"`` additionally raises
+    :class:`StaticCheckError` on error-severity findings, ``"off"`` (the
+    default, also settable via ``PATHWAY_STATIC_CHECK``) skips analysis.
     """
     from pathway_tpu.internals.config import get_pathway_config
+
+    if persistence_config is None:
+        persistence_config = _persistence_config_from_env()
+    _run_static_check(static_check, persistence_config)
 
     cfg = get_pathway_config()
     cluster = None
@@ -42,8 +53,6 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
     with telemetry.span("pathway.graph.build"):
         for binder in G.output_binders:
             binder(runner)
-    if persistence_config is None:
-        persistence_config = _persistence_config_from_env()
     if persistence_config is not None:
         runner._persistence_config = persistence_config
     try:
@@ -70,6 +79,38 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
 
 def run_all(**kwargs):
     return run(**kwargs)
+
+
+def _run_static_check(mode: str | None, persistence_config) -> None:
+    """Opt-in pre-execution analysis gate for pw.run."""
+    import os
+
+    if mode is None:
+        mode = os.environ.get("PATHWAY_STATIC_CHECK", "off")
+    if mode in ("off", "", None):
+        return
+    if mode not in ("warn", "error"):
+        raise ValueError(
+            f"static_check must be 'off', 'warn' or 'error', got {mode!r}")
+    import logging
+
+    from pathway_tpu.internals.static_check import (Severity, StaticCheckError,
+                                                    analyze)
+
+    diagnostics = analyze(graph=G, persisted=persistence_config is not None)
+    if not diagnostics:
+        return
+    log = logging.getLogger("pathway_tpu.static_check")
+    levels = {Severity.ERROR: logging.ERROR,
+              Severity.WARNING: logging.WARNING,
+              Severity.INFO: logging.INFO}
+    # errors first, and each finding at its own severity so log-level
+    # filters and warning-based alerting see what the analyzer meant
+    for d in sorted(diagnostics, key=lambda d: levels[d.severity],
+                    reverse=True):
+        log.log(levels[d.severity], "%s", d)
+    if mode == "error" and any(d.is_error for d in diagnostics):
+        raise StaticCheckError(diagnostics)
 
 
 def _persistence_config_from_env():

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import csv as _csv
 import typing
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Mapping
 
 from pathway_tpu.internals import dtype as dt

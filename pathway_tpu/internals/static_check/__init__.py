@@ -1,0 +1,58 @@
+"""Pre-execution diagnostics over the Table plan DAG.
+
+``pw.static_check(*tables)`` analyzes the lazily-built pipeline — plans,
+expression trees, and the ParseGraph output registry — and returns a list
+of :class:`Diagnostic` findings (codes ``PWT001``–``PWT011``, severities
+error/warning/info) *before* the engine ever steps. The same analyzer backs
+``pw.run(static_check="warn"|"error")`` and the
+``python -m pathway_tpu check`` CLI.
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown('''
+... a | b
+... 1 | x
+... ''')
+>>> diags = pw.static_check(t.select(bad=t.a + t.b))
+>>> [d.code for d in diags]
+['PWT001']
+>>> print(str(diags[0]).splitlines()[0])  # doctest: +ELLIPSIS
+PWT001 error ...: operator '+' is not defined between int and str
+>>> pw.static_check(t.select(ok=t.a * 2))
+[]
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.static_check.analyzer import Analyzer, analyze
+from pathway_tpu.internals.static_check.diagnostics import (
+    CODES,
+    Diagnostic,
+    Severity,
+    StaticCheckError,
+    render,
+)
+
+__all__ = [
+    "Analyzer", "CODES", "Diagnostic", "Severity", "StaticCheckError",
+    "analyze", "render", "static_check",
+]
+
+
+def static_check(*tables, persistence: bool | None = None,
+                 graph=None) -> list[Diagnostic]:
+    """Statically validate the pipeline and return its diagnostics.
+
+    With explicit ``tables``, those tables count as intended outputs (their
+    whole upstream DAG is analyzed); with no arguments the globally
+    registered sinks' upstream DAGs are analyzed — the same view
+    ``pw.run(static_check=...)`` takes. Constructed tables outside every
+    output's upstream closure never execute, so they are only flagged as
+    dead dataflow (PWT004), not analyzed for errors. ``persistence`` arms the
+    persisted-pipeline checks (PWT006); when ``None`` it is auto-detected
+    from the persistence environment variables the CLI sets.
+    """
+    if persistence is None:
+        from pathway_tpu.internals.run import _persistence_config_from_env
+
+        persistence = _persistence_config_from_env() is not None
+    return analyze(tables, graph=graph, persisted=bool(persistence))

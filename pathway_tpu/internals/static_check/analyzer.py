@@ -1,0 +1,507 @@
+"""Static analysis over the lazily-built Table plan DAG.
+
+The analyzer walks plans / expression trees / the ParseGraph output registry
+*before* the engine runs and reports :class:`Diagnostic` findings — dtype
+mismatches that would fail (or silently mis-compute) at `pw.run` time, dead
+subgraphs, streaming pipelines with no sink, sink formats that cannot carry
+the bound table's schema, and universe relations the runtime solver would
+reject. Everything here is metadata-only: no datasource is started and no
+expression is evaluated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals.static_check.diagnostics import Diagnostic, Severity
+from pathway_tpu.internals.type_inference import infer_dtype
+
+_ARITH = {"+", "-", "*", "/", "//", "%", "**"}
+_ORDER_CMP = {"<", "<=", ">", ">="}
+_EQ_CMP = {"==", "!="}
+_BOOL_OPS = {"&", "|", "^"}
+_NUMERIC = (dt.INT, dt.FLOAT)
+_DATETIMES = (dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC)
+_SCALARS = (dt.INT, dt.FLOAT, dt.BOOL, dt.STR)
+
+# plan params that hold bulk row data, not graph structure — skipped by the
+# generic walker so analyzing a large static table stays O(plan), not O(rows)
+_BULK_PARAMS = {"keys", "rows", "times", "diffs"}
+
+
+def _is_unknown(d: dt.DType) -> bool:
+    """Dtypes the analyzer never judges: inference gave up or the value is
+    dynamically typed by design."""
+    return d in (dt.ANY, dt.NONE, dt.ERROR, dt.JSON) or isinstance(
+        d, (dt.Callable_, dt.Future))
+
+
+class _Node:
+    __slots__ = ("table", "parents", "consumers", "exprs")
+
+    def __init__(self, table):
+        self.table = table
+        self.parents: list = []      # upstream Tables
+        self.consumers: list = []    # downstream Tables
+        self.exprs: list = []        # expressions carried by this plan
+
+
+class Analyzer:
+    def __init__(self, *, graph=None, persisted: bool = False):
+        if graph is None:
+            from pathway_tpu.internals.parse_graph import G as graph
+        self.graph = graph
+        self.persisted = persisted
+        self.diagnostics: list[Diagnostic] = []
+        self._nodes: dict[int, _Node] = {}
+        self._seen_exprs: set[tuple[str, int]] = set()
+
+    # ------------------------------------------------------------------
+    # graph collection
+    # ------------------------------------------------------------------
+    def _collect(self, value: Any, tables: list, exprs: list) -> None:
+        from pathway_tpu.internals.table import Table
+
+        if isinstance(value, Table):
+            tables.append(value)
+        elif isinstance(value, ex.ColumnExpression):
+            exprs.append(value)
+            for e in ex.walk(value):
+                t = getattr(e, "_table", None) or getattr(e, "table", None)
+                if isinstance(t, Table):
+                    tables.append(t)
+        elif isinstance(value, (list, tuple, set, frozenset)):
+            for v in value:
+                self._collect(v, tables, exprs)
+        elif isinstance(value, dict):
+            for v in value.values():
+                self._collect(v, tables, exprs)
+
+    def _node(self, table) -> _Node:
+        node = self._nodes.get(id(table))
+        if node is not None:
+            return node
+        # iterative walk — a deep linear pipeline (thousands of chained
+        # selects) must not blow the interpreter recursion limit
+        stack = [table]
+        edges: list = []  # (parent, child) pairs discovered in this walk
+        while stack:
+            t = stack.pop()
+            if id(t) in self._nodes:
+                continue
+            n = self._nodes[id(t)] = _Node(t)
+            tables: list = []
+            exprs: list = []
+            for name, value in t._plan.params.items():
+                if name in _BULK_PARAMS:
+                    continue
+                self._collect(value, tables, exprs)
+            n.exprs = exprs
+            seen_parent: set[int] = set()
+            for parent in tables:
+                if parent is t or id(parent) in seen_parent:
+                    continue
+                seen_parent.add(id(parent))
+                n.parents.append(parent)
+                edges.append((parent, t))
+                stack.append(parent)
+        for parent, child in edges:
+            self._nodes[id(parent)].consumers.append(child)
+        return self._nodes[id(table)]
+
+    def _closure(self, roots: Iterable) -> set[int]:
+        out: set[int] = set()
+        stack = list(roots)
+        while stack:
+            t = stack.pop()
+            if id(t) in out:
+                continue
+            out.add(id(t))
+            stack.extend(self._node(t).parents)
+        return out
+
+    # ------------------------------------------------------------------
+    # entry point
+    # ------------------------------------------------------------------
+    def run(self, tables: Iterable = ()) -> list[Diagnostic]:
+        explicit = list(tables)
+        bound = [o.table for o in self.graph.outputs if o.table is not None]
+        roots = explicit + bound
+        registered = self.graph.tables()
+
+        reachable = self._closure(roots)
+        # build nodes for everything we know about so consumer edges exist
+        for t in registered:
+            self._node(t)
+
+        # expression/plan checks run on the code that would actually execute:
+        # the roots' upstream closure. Tables outside it never run, so their
+        # defects are not errors — they get the PWT004 dead-dataflow warning
+        # instead. With no roots at all there is no reachability notion and
+        # everything is checked.
+        check_all = not roots
+        for node in list(self._nodes.values()):
+            if not check_all and id(node.table) not in reachable:
+                continue
+            self._check_plan(node)
+            for e in node.exprs:
+                self._check_expr_tree(node, e)
+
+        self._check_dead_dataflow(roots, registered, reachable)
+        self._check_streaming_sources(roots, reachable)
+        self._check_sinks()
+        return self.diagnostics
+
+    # ------------------------------------------------------------------
+    # reporting helpers
+    # ------------------------------------------------------------------
+    def _report(self, code: str, message: str, node: _Node | None = None,
+                severity: Severity | None = None, related=(),
+                expr=None) -> None:
+        if expr is not None:
+            key = (code, id(expr))
+            if key in self._seen_exprs:
+                return
+            self._seen_exprs.add(key)
+        trace = None
+        name = None
+        if node is not None:
+            trace = node.table._plan.trace
+            name = node.table._name
+        self.diagnostics.append(Diagnostic(
+            code=code, message=message, severity=severity, trace=trace,
+            table=name, related=tuple(related)))
+
+    # ------------------------------------------------------------------
+    # expression-level checks: PWT001 / PWT002 / PWT006 / PWT008 / PWT010
+    # ------------------------------------------------------------------
+    def _check_expr_tree(self, node: _Node, root: ex.ColumnExpression) -> None:
+        for e in ex.walk(root):
+            if isinstance(e, ex.BinaryExpression):
+                self._check_binary(node, e)
+            elif isinstance(e, (ex.CastExpression, ex.ConvertExpression)):
+                self._check_cast(node, e)
+            elif isinstance(e, ex.GetExpression) and e._check_if_exists:
+                self._check_get_default(node, e)
+            if isinstance(e, ex.ApplyExpression):
+                self._check_udf(node, e)
+
+    def _check_binary(self, node: _Node, e: ex.BinaryExpression) -> None:
+        lt = dt.unoptionalize(infer_dtype(e._left))
+        rt = dt.unoptionalize(infer_dtype(e._right))
+        if _is_unknown(lt) or _is_unknown(rt):
+            return
+        op = e._op
+        if op in _ARITH and not _arith_ok(op, lt, rt):
+            self._report(
+                "PWT001",
+                f"operator {op!r} is not defined between {lt!r} and {rt!r}",
+                node, expr=e)
+        elif op in _ORDER_CMP and not _comparable(lt, rt):
+            self._report(
+                "PWT001",
+                f"ordering comparison {op!r} between incomparable dtypes "
+                f"{lt!r} and {rt!r}",
+                node, expr=e)
+        elif op in _EQ_CMP and not _comparable(lt, rt):
+            self._report(
+                "PWT001",
+                f"{op!r} between unrelated dtypes {lt!r} and {rt!r} is "
+                f"constant {op == '!='!r}",
+                node, severity=Severity.WARNING, expr=e)
+        elif op in _BOOL_OPS and not _boolean_ok(lt, rt):
+            self._report(
+                "PWT001",
+                f"boolean operator {op!r} requires bool/int operands, got "
+                f"{lt!r} and {rt!r}",
+                node, expr=e)
+        elif op == "@" and not (isinstance(lt, dt.Array)
+                                and isinstance(rt, dt.Array)):
+            self._report(
+                "PWT001",
+                f"matmul '@' requires array operands, got {lt!r} and {rt!r}",
+                node, expr=e)
+
+    def _check_cast(self, node: _Node, e) -> None:
+        src_full = infer_dtype(e._expr)
+        src = dt.unoptionalize(src_full)
+        tgt = dt.unoptionalize(e._return_type)
+        if src_full == e._return_type and not _is_unknown(src):
+            self._report(
+                "PWT010",
+                f"cast to {e._return_type!r} is redundant: the expression "
+                f"already has that dtype",
+                node, expr=e)
+            return
+        if _is_unknown(src) or _is_unknown(tgt):
+            return
+        if isinstance(e, ex.ConvertExpression) and src is dt.JSON:
+            return  # JSON unpacking is exactly what convert is for
+        if not _castable(src, tgt):
+            kind = ("convert" if isinstance(e, ex.ConvertExpression)
+                    else "cast")
+            self._report(
+                "PWT002",
+                f"cannot {kind} {src!r} to {tgt!r}: no runtime conversion "
+                f"exists between these dtypes",
+                node, expr=e)
+
+    def _check_get_default(self, node: _Node, e: ex.GetExpression) -> None:
+        obj_t = dt.unoptionalize(infer_dtype(e._obj))
+        if isinstance(obj_t, dt.Tuple):
+            elem = dt.types_lca_many(*obj_t.args) if obj_t.args else dt.ANY
+            if isinstance(e._index, ex.ConstExpression):
+                i = e._index._value
+                if isinstance(i, int) and -len(obj_t.args) <= i < len(obj_t.args):
+                    elem = obj_t.args[i]
+        elif isinstance(obj_t, dt.List):
+            elem = obj_t.wrapped
+        else:
+            return
+        default_t = infer_dtype(e._default)
+        if _is_unknown(elem) or _is_unknown(default_t):
+            return
+        if not dt.dtype_issubclass(default_t, elem):
+            widened = dt.types_lca(elem, default_t)
+            self._report(
+                "PWT008",
+                f"get() default of dtype {default_t!r} widens the element "
+                f"dtype {elem!r} to {widened!r} silently",
+                node, expr=e)
+
+    def _check_udf(self, node: _Node, e: ex.ApplyExpression) -> None:
+        if not self.persisted:
+            return
+        is_async = isinstance(e, ex.AsyncApplyExpression)
+        if e._deterministic and not is_async:
+            return
+        fn_name = getattr(e._fn, "__name__", repr(e._fn))
+        kind = "async" if is_async else "non-deterministic"
+        self._report(
+            "PWT006",
+            f"{kind} UDF {fn_name!r} feeds a persisted pipeline: replayed "
+            f"runs may diverge from the recorded snapshot (mark the UDF "
+            f"deterministic=True if it is)",
+            node, expr=e)
+
+    # ------------------------------------------------------------------
+    # plan-level checks: PWT003 / PWT007 / PWT011
+    # ------------------------------------------------------------------
+    def _check_plan(self, node: _Node) -> None:
+        plan = node.table._plan
+        if plan.kind == "join_select":
+            for a, b in plan.params.get("on", ()):
+                la = dt.unoptionalize(infer_dtype(a))
+                rb = dt.unoptionalize(infer_dtype(b))
+                if _is_unknown(la) or _is_unknown(rb):
+                    continue
+                if dt.types_lca(la, rb) is dt.ANY:
+                    self._report(
+                        "PWT003",
+                        f"join keys have incompatible dtypes: left is "
+                        f"{la!r}, right is {rb!r} — no value can match",
+                        node)
+        elif plan.kind == "groupby":
+            keys = list(plan.params.get("by") or [])
+            inst = plan.params.get("instance")
+            if inst is not None:
+                keys.append(inst)
+            for k in keys:
+                kt = dt.unoptionalize(infer_dtype(k))
+                if isinstance(kt, dt.Array) or kt in (dt.ERROR,) or isinstance(
+                        kt, dt.Callable_):
+                    self._report(
+                        "PWT003",
+                        f"groupby key has dtype {kt!r}, which cannot be used "
+                        f"as a grouping key",
+                        node)
+        elif plan.kind == "ix":
+            key_t = infer_dtype(plan.params["key_expr"])
+            base_t = dt.unoptionalize(key_t)
+            if _is_unknown(base_t):
+                return
+            if not isinstance(base_t, dt.Pointer):
+                self._report(
+                    "PWT011",
+                    f"ix key expression has dtype {key_t!r}; pointer lookup "
+                    f"requires a Pointer (use pointer_from to derive one)",
+                    node)
+        elif plan.kind == "update_cells":
+            self._check_universe_relation(
+                node, plan.params["other"], node.table._plan.params["base"],
+                op="update_cells", need="other ⊆ base")
+        elif plan.kind == "key_filter" and plan.params.get("mode") == "restrict":
+            self._check_universe_relation(
+                node, plan.params["other"], plan.params["base"],
+                op="restrict", need="other ⊆ base")
+        elif plan.kind == "identity" and plan.params.get("universe_from") is not None:
+            self._check_universe_relation(
+                node, plan.params["base"], plan.params["universe_from"],
+                op="with_universe_of", need="same key set", equal=True)
+
+    def _check_universe_relation(self, node: _Node, sub, sup, *, op: str,
+                                 need: str, equal: bool = False) -> None:
+        u_sub, u_sup = sub._universe, sup._universe
+        related = tuple(t for t in (sub._plan.trace, sup._plan.trace)
+                        if t is not None)
+        if u_sub.is_disjoint_from(u_sup):
+            self._report(
+                "PWT007",
+                f"{op}: universes of {sub._name!r} and {sup._name!r} are "
+                f"declared disjoint — the runtime solver rejects this "
+                f"({need} required)",
+                node, related=related)
+            return
+        proven = (u_sub.is_equal_to(u_sup) if equal
+                  else u_sub.is_subset_of(u_sup))
+        if not proven:
+            self._report(
+                "PWT007",
+                f"{op}: cannot statically prove {need} for {sub._name!r} vs "
+                f"{sup._name!r}; add promise_universe_is_subset_of / "
+                f"promise_universes_are_equal if this holds by construction",
+                node, severity=Severity.INFO, related=related)
+
+    # ------------------------------------------------------------------
+    # graph-level checks: PWT004 / PWT005 / PWT009
+    # ------------------------------------------------------------------
+    def _check_dead_dataflow(self, roots, registered, reachable) -> None:
+        if not roots:
+            return
+        root_ids = {id(t) for t in roots}
+        for t in registered:
+            if id(t) in reachable or id(t) in root_ids:
+                continue
+            node = self._nodes[id(t)]
+            if node.consumers:
+                continue  # only report the tip of a dead chain
+            self._report(
+                "PWT004",
+                f"table {t._name!r} (and its upstream-only subgraph) is "
+                f"computed but never reaches a sink",
+                node)
+
+    def _check_streaming_sources(self, roots, reachable) -> None:
+        for node in list(self._nodes.values()):
+            if node.table._plan.kind != "input":
+                continue
+            source = node.table._plan.params.get("datasource")
+            if getattr(source, "mode", "streaming") == "static":
+                # a static read terminates on its own; if it feeds nothing,
+                # the dead-dataflow check (PWT004) already reports it
+                continue
+            if not roots:
+                self._report(
+                    "PWT005",
+                    f"streaming source {node.table._name!r} has no output "
+                    f"binder: pw.run would consume it forever while "
+                    f"producing nothing",
+                    node)
+            elif id(node.table) not in reachable:
+                self._report(
+                    "PWT005",
+                    f"streaming source {node.table._name!r} never reaches "
+                    f"a sink",
+                    node)
+
+    def _check_sinks(self) -> None:
+        for binding in self.graph.outputs:
+            if binding.table is None or binding.format is None:
+                continue
+            table = binding.table
+            node = self._nodes.get(id(table))
+            for name in table.column_names():
+                col_t = dt.unoptionalize(table._schema[name].dtype)
+                bad = _format_incompatibility(binding.format, col_t)
+                if bad:
+                    self._report(
+                        "PWT009",
+                        f"sink {binding.sink!r} (format={binding.format!r}) "
+                        f"cannot faithfully serialize column {name!r} of "
+                        f"dtype {col_t!r}: {bad}",
+                        node)
+
+
+# ---------------------------------------------------------------------------
+# dtype compatibility tables
+# ---------------------------------------------------------------------------
+
+def _arith_ok(op: str, l: dt.DType, r: dt.DType) -> bool:
+    if l in _NUMERIC and r in _NUMERIC:
+        return True
+    if isinstance(l, dt.Array) or isinstance(r, dt.Array):
+        return True  # broadcasting elementwise arithmetic
+    if op == "+":
+        if l is dt.STR and r is dt.STR:
+            return True
+        if isinstance(l, (dt.Tuple, dt.List)) and isinstance(
+                r, (dt.Tuple, dt.List)):
+            return True
+    if op == "*" and {l, r} == {dt.STR, dt.INT}:
+        return True
+    # datetime algebra
+    if op == "-" and l in _DATETIMES and r is l:
+        return True
+    if op in ("+", "-") and l in _DATETIMES and r is dt.DURATION:
+        return True
+    if op == "+" and l is dt.DURATION and r in _DATETIMES:
+        return True
+    if l is dt.DURATION and r is dt.DURATION and op in ("+", "-", "/", "%"):
+        return True
+    if l is dt.DURATION and r in _NUMERIC and op in ("*", "/", "//"):
+        return True
+    if l in _NUMERIC and r is dt.DURATION and op == "*":
+        return True
+    return False
+
+
+def _comparable(l: dt.DType, r: dt.DType) -> bool:
+    if l in _NUMERIC and r in _NUMERIC:
+        return True
+    return dt.types_lca(l, r) is not dt.ANY
+
+
+def _boolean_ok(l: dt.DType, r: dt.DType) -> bool:
+    return l in (dt.BOOL, dt.INT) and r in (dt.BOOL, dt.INT)
+
+
+def _castable(src: dt.DType, tgt: dt.DType) -> bool:
+    if dt.dtype_issubclass(src, tgt) or dt.dtype_issubclass(tgt, src):
+        return True
+    if src in _SCALARS and tgt in _SCALARS:
+        return True
+    if isinstance(src, dt.Array) and isinstance(tgt, dt.Array):
+        return True
+    if {src, tgt} == {dt.BYTES, dt.STR}:
+        return True
+    if src in (*_DATETIMES, dt.DURATION) and tgt in (dt.STR, dt.INT, dt.FLOAT):
+        return True
+    if tgt is dt.STR:
+        return True  # everything renders to a string
+    return False
+
+
+def _format_incompatibility(format: str | None, col_t: dt.DType) -> str | None:
+    """Reason a column dtype cannot ride the sink format, or None if fine."""
+    if format in ("csv", "dsv", "sql"):
+        if isinstance(col_t, (dt.Array, dt.Tuple, dt.List)) or col_t in (
+                dt.ANY_ARRAY,):
+            return "flat text formats have no array/tuple encoding"
+        if col_t is dt.BYTES:
+            return "raw bytes are not representable in a text row format"
+        if isinstance(col_t, dt.Callable_):
+            return "callables cannot be serialized"
+    elif format == "json":
+        if col_t is dt.BYTES:
+            return "JSON has no bytes type (encode to str first)"
+        if isinstance(col_t, dt.Callable_):
+            return "callables cannot be serialized"
+    return None
+
+
+def analyze(tables: Iterable = (), *, graph=None,
+            persisted: bool = False) -> list[Diagnostic]:
+    """Run every static check; see :class:`Analyzer`."""
+    return Analyzer(graph=graph, persisted=persisted).run(tables)

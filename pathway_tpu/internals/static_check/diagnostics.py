@@ -1,0 +1,105 @@
+"""Diagnostic objects for the static pipeline analyzer.
+
+Each finding is a :class:`Diagnostic` with a stable code (``PWT001``…),
+a severity, a human message, and — whenever the offending operator captured
+one — the user stack frame from the plan's build-time trace
+(internals/trace.py), so a diagnostic points at the user's line, exactly
+like runtime operator errors do.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from pathway_tpu.internals.trace import Trace
+
+
+class Severity(enum.Enum):
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    def __str__(self) -> str:  # "error" in rendered diagnostics
+        return self.value
+
+
+#: code -> (default severity, one-line summary). The single source of truth
+#: for what the analyzer can emit; README's "Static checks" section mirrors it.
+CODES: dict[str, tuple[Severity, str]] = {
+    "PWT000": (Severity.ERROR,
+               "pipeline script failed to import / collect"),
+    "PWT001": (Severity.ERROR,
+               "binary operation on incompatible column dtypes"),
+    "PWT002": (Severity.ERROR,
+               "cast/convert between incompatible dtypes"),
+    "PWT003": (Severity.ERROR,
+               "join/groupby key columns have incompatible dtypes"),
+    "PWT004": (Severity.WARNING,
+               "dead dataflow: table computed but never reaches a sink"),
+    "PWT005": (Severity.WARNING,
+               "streaming source never reaches an output binder"),
+    "PWT006": (Severity.WARNING,
+               "non-deterministic or async UDF feeds a persisted pipeline"),
+    "PWT007": (Severity.ERROR,
+               "universe mismatch the solver would reject at runtime"),
+    "PWT008": (Severity.WARNING,
+               "get()/ix default dtype silently widens the column"),
+    "PWT009": (Severity.WARNING,
+               "sink schema incompatible with the connector's format"),
+    "PWT010": (Severity.INFO,
+               "redundant cast: expression already has the target dtype"),
+    "PWT011": (Severity.ERROR,
+               "ix key expression is not a pointer type"),
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One static-analysis finding."""
+
+    code: str
+    message: str
+    severity: Severity | None = None
+    trace: Trace | None = None
+    table: str | None = None
+    # secondary provenance (e.g. the other table of a universe mismatch)
+    related: tuple[Trace, ...] = field(default=())
+
+    def __post_init__(self):
+        if self.code not in CODES:
+            raise ValueError(f"unknown diagnostic code {self.code!r}")
+        if self.severity is None:
+            object.__setattr__(self, "severity", CODES[self.code][0])
+
+    @property
+    def is_error(self) -> bool:
+        return self.severity is Severity.ERROR
+
+    def __str__(self) -> str:
+        where = f" [{self.table}]" if self.table else ""
+        out = f"{self.code} {self.severity}{where}: {self.message}"
+        if self.trace is not None:
+            out += f"\n{self.trace}"
+        for t in self.related:
+            out += f"\n  related:\n{t}"
+        return out
+
+
+class StaticCheckError(RuntimeError):
+    """Raised by ``pw.run(static_check='error')`` when the analyzer finds
+    error-severity diagnostics. Carries the full diagnostic list."""
+
+    def __init__(self, diagnostics: list[Diagnostic]):
+        self.diagnostics = diagnostics
+        errors = [d for d in diagnostics if d.is_error]
+        lines = "\n\n".join(str(d) for d in errors)
+        super().__init__(
+            f"static check failed with {len(errors)} error(s):\n{lines}")
+
+
+def render(diagnostics: list[Diagnostic]) -> str:
+    """Multi-line human rendering, errors first."""
+    order = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
+    ranked = sorted(diagnostics, key=lambda d: order[d.severity])
+    return "\n\n".join(str(d) for d in ranked)

@@ -10,7 +10,7 @@ computes until pw.run / pw.debug.compute_and_print.
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Iterable
+from typing import Any
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import expression as ex
@@ -49,6 +49,9 @@ class Table:
         self._universe = universe or Universe()
         self._name = name or f"table_{next(_table_ids)}"
         self._id_dtype = dt.POINTER
+        from pathway_tpu.internals.parse_graph import G
+
+        G.register_table(self)
 
     # ------------------------------------------------------------------
     # metadata
@@ -187,7 +190,7 @@ class Table:
 
             runner.subscribe(self, callback)
 
-        G.add_output(binder)
+        G.add_output(binder, table=self, sink="debug")
         return self
 
     def eval_type(self, expression):
@@ -328,7 +331,10 @@ class Table:
         return Table(Plan("identity", base=self), self._schema, self._universe)
 
     def with_universe_of(self, other: "Table") -> "Table":
-        t = Table(Plan("identity", base=self), self._schema, other._universe)
+        # universe_from lets the static analyzer (PWT007) tell this apart
+        # from copy()/update_types() identity plans
+        t = Table(Plan("identity", base=self, universe_from=other),
+                  self._schema, other._universe)
         return t
 
     def promise_universes_are_disjoint(self, other: "Table") -> "Table":
@@ -414,7 +420,7 @@ class Table:
     # ------------------------------------------------------------------
     def join(self, other: "Table", *on, id=None, how="inner", left_instance=None,
              right_instance=None):
-        from pathway_tpu.internals.joins import JoinResult, JoinMode
+        from pathway_tpu.internals.joins import JoinResult
 
         mode = how if isinstance(how, str) else how.value
         return JoinResult.create(self, other, on, mode, id,

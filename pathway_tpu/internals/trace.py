@@ -57,6 +57,13 @@ def add_trace_note(e: BaseException, trace: Trace | None,
     if trace is not None:
         note += f"\noccurred here:\n{trace}"
     if note not in getattr(e, "__notes__", ()):
-        e.add_note(note)
+        if hasattr(e, "add_note"):
+            e.add_note(note)
+        else:  # Python < 3.11: emulate PEP 678 storage
+            notes = getattr(e, "__notes__", None)
+            if notes is None:
+                notes = []
+                e.__notes__ = notes
+            notes.append(note)
 
 

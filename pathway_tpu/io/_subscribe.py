@@ -4,7 +4,6 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
-from pathway_tpu.internals.keys import Pointer
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 
@@ -32,7 +31,7 @@ def subscribe(table: Table,
             runner._on_end_callbacks = getattr(runner, "_on_end_callbacks", [])
             runner._on_end_callbacks.append(on_end)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="subscribe")
 
 
 def internal_subscribe(table: Table, on_delta: Callable[[int, Any], None]) -> None:
@@ -41,4 +40,4 @@ def internal_subscribe(table: Table, on_delta: Callable[[int, Any], None]) -> No
     def binder(runner):
         runner.subscribe(table, on_delta)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="subscribe")

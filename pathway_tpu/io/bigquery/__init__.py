@@ -124,7 +124,7 @@ def write(table: Table, dataset_name: str, table_name: str,
 
         runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="bigquery", format="json")
 
 
 def read(*args, **kwargs):

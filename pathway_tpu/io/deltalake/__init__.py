@@ -133,7 +133,7 @@ def write(table: Table, uri: str, *, partition_columns=None,
 
         runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="deltalake", format="parquet")
 
 
 class DeltaLakeSource(DataSource):

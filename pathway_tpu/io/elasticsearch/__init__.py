@@ -81,7 +81,7 @@ def write(table: Table, host: str, auth: ElasticSearchAuth | None = None,
 
         runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="elasticsearch", format="json")
 
 
 def read(*args, **kwargs):

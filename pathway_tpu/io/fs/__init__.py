@@ -252,7 +252,7 @@ def write(table: Table, filename: str, *, format: str = "json", name=None,
 
             runner.subscribe(table, callback)
 
-        G.add_output(binder)
+        G.add_output(binder, table=table, sink="fs", format="parquet")
         return
 
     def binder(runner):
@@ -277,4 +277,4 @@ def write(table: Table, filename: str, *, format: str = "json", name=None,
 
         runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="fs", format=format)

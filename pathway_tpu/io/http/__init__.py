@@ -33,7 +33,9 @@ class PathwayWebserver:
         self.host = host
         self.port = port
         self._routes: dict[tuple[str, str], Any] = {}
-        self._formats: dict[str, str] = {}  # route -> "custom" | "raw"
+        # (method, route) -> "custom" | "raw"; keyed per method so two
+        # connectors sharing a route cannot clobber each other's format
+        self._formats: dict[tuple[str, str], str] = {}
         self._openapi: dict = {"openapi": "3.0.3",
                                "info": {"title": "pathway-tpu", "version": "1"},
                                "paths": {}}
@@ -49,9 +51,16 @@ class PathwayWebserver:
     def register(self, route: str, methods: tuple[str, ...], handler,
                  schema: type[sch.Schema] | None,
                  format: str = "custom") -> None:
-        for m in methods:
-            self._routes[(m.upper(), route)] = handler
-        self._formats[route] = format
+        keys = [(m.upper(), route) for m in methods]
+        for key in keys:  # validate every method before mutating any
+            if self._formats.get(key, format) != format:
+                raise ValueError(
+                    f"route {key[0]} {route} is already registered with "
+                    f"input format {self._formats[key]!r}; refusing to "
+                    f"re-register it as {format!r}")
+        for key in keys:
+            self._routes[key] = handler
+            self._formats[key] = format
         if schema is not None:
             props = {
                 c.name: {"type": _openapi_type(c.dtype)}
@@ -109,11 +118,12 @@ class PathwayWebserver:
                                         content_type="text/x-yaml")
                 return web.Response(status=404, text="no such route")
             try:
-                fmt = self._formats.get(request.path, "custom")
-                if fmt == "raw" and request.method in ("POST", "PUT",
-                                                       "PATCH"):
-                    # raw format: the whole body IS the query value
-                    # (reference: _server.py:527 QUERY_SCHEMA_COLUMN)
+                fmt = self._formats.get((request.method, request.path),
+                                        "custom")
+                if fmt == "raw":
+                    # raw format: the whole body IS the query value, for
+                    # every method — a bodyless GET yields {'query': ''}
+                    # (reference: _server.py:526-527 QUERY_SCHEMA_COLUMN)
                     payload = {"query": await request.text()}
                 elif request.method in ("POST", "PUT", "PATCH"):
                     try:
@@ -254,12 +264,17 @@ def rest_connector(host: str | None = None, port: int | None = None, *,
                    keep_queries: bool | None = None,
                    delete_completed_queries: bool = False,
                    request_validator=None,
-                   format: str = "custom",
+                   format: str | None = None,
                    documentation=None) -> tuple[Table, Any]:
     """Returns (query_table, response_writer). ``format="custom"``
-    (default) parses the JSON body and merges URL query params, 400-ing
-    on missing required fields; ``format="raw"`` takes the whole request
-    body as the ``query`` column (reference: _server.py:50,525-535)."""
+    parses the JSON body and merges URL query params, 400-ing on missing
+    required fields; ``format="raw"`` takes the whole request body as the
+    ``query`` column. With no explicit format, a schemaless endpoint
+    infers ``raw`` (a plain-text POST yields ``{'query': body}``) and a
+    schema-ful one infers ``custom``
+    (reference: _server.py:50,525-535,733-736)."""
+    if format is None:
+        format = "raw" if schema is None else "custom"
     if format not in ("custom", "raw"):
         raise ValueError(f"unknown endpoint input format: {format!r} "
                          "(use 'custom' or 'raw')")
@@ -296,7 +311,8 @@ def rest_connector(host: str | None = None, port: int | None = None, *,
 
             runner.subscribe(response_table, callback)
 
-        G.add_output(binder)
+        G.add_output(binder, table=response_table, sink="http.response",
+                     format="json")
 
     return table, response_writer
 
@@ -387,4 +403,4 @@ def write(table: Table, url: str, *, method: str = "POST", format: str = "json",
 
         runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="http", format="json")

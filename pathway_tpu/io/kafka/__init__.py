@@ -332,7 +332,7 @@ def write(table: Table, rdkafka_settings: dict, topic_name: str, *,
 
             runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="kafka", format=format)
 
 
 def check_raw_and_plaintext_only_kwargs(f):

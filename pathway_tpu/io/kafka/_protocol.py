@@ -16,7 +16,7 @@ from __future__ import annotations
 import socket
 import struct
 import time as _time
-from typing import Any, Iterator
+from typing import Iterator
 
 
 class KafkaProtocolError(RuntimeError):

@@ -109,7 +109,7 @@ def write(table: Table, *, connection_string: str, database: str,
 
         runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="mongodb", format="bson")
 
 
 def read(*args, **kwargs):

@@ -243,4 +243,4 @@ def write(table: Table, uri: str, topic: str, *, format: str = "json",
 
         runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="nats", format="json")

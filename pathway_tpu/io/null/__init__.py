@@ -10,4 +10,4 @@ def write(table: Table, *, name=None, **kwargs) -> None:
     def binder(runner):
         runner.subscribe(table, lambda time, delta: None)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="null")

@@ -73,7 +73,7 @@ def write(table: Table, postgres_settings: dict, table_name: str, *,
 
         runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="postgres", format="sql")
 
 
 def write_snapshot(table: Table, postgres_settings: dict, table_name: str,

@@ -73,7 +73,7 @@ def write(table: Table, publisher=None, project_id: str | None = None,
 
             runner.subscribe(table, callback)
 
-        G.add_output(binder)
+        G.add_output(binder, table=table, sink="pubsub", format="binary")
         return
 
     url = (f"{_rest_endpoint(endpoint)}/projects/{project_id}/topics/"
@@ -106,7 +106,7 @@ def write(table: Table, publisher=None, project_id: str | None = None,
 
         runner.subscribe(table, callback)
 
-    G.add_output(binder)
+    G.add_output(binder, table=table, sink="pubsub", format="binary")
 
 
 def read(*args, **kwargs):

@@ -11,7 +11,6 @@ serialized as decimal strings (128-bit ints exceed JSON number precision).
 from __future__ import annotations
 
 import json
-from typing import Any
 
 from pathway_tpu.internals.keys import Pointer
 

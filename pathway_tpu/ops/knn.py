@@ -209,8 +209,11 @@ def _quantize_i8(vals):
     m = jnp.max(jnp.abs(v), axis=1)
     scale = jnp.maximum(m / 127.0, 1e-30)
     q = jnp.clip(jnp.round(v / scale[:, None]), -127, 127).astype(jnp.int8)
-    qf = q.astype(jnp.float32)
-    vsq = jnp.sum(qf * qf, axis=1)  # exact: |q| ≤ 127, D ≪ 2^24 / 127^2
+    # accumulate in int32 so vsq is exact for any dim up to 2^31 / 127^2
+    # (~133k); a float32 accumulator starts rounding partial sums past
+    # dim ~1040. The final float32 value rounds at most once.
+    qi = q.astype(jnp.int32)
+    vsq = jnp.sum(qi * qi, axis=1).astype(jnp.float32)
     return q, scale, vsq
 
 
@@ -222,8 +225,9 @@ def _quantize_i8_np(vals: np.ndarray):
     m = np.max(np.abs(v), axis=1)
     scale = np.maximum(m / 127.0, 1e-30).astype(np.float32)
     q = np.clip(np.round(v / scale[:, None]), -127, 127).astype(np.int8)
-    qf = q.astype(np.float32)
-    vsq = np.sum(qf * qf, axis=1)
+    # int accumulation, same exactness rationale as _quantize_i8
+    qi = q.astype(np.int64)
+    vsq = np.sum(qi * qi, axis=1).astype(np.float32)
     return q, scale, vsq
 
 

@@ -24,6 +24,22 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions: newer jax exposes it at the
+    top level with ``check_vma``; older releases only have
+    ``jax.experimental.shard_map.shard_map`` with the same flag spelled
+    ``check_rep``."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 @dataclass(frozen=True)
 class MeshConfig:
     data: int

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from pathway_tpu.parallel.mesh import shard_map as _shard_map
+
 PIPE_AXIS = "pipe"
 
 
@@ -64,7 +66,7 @@ def make_pipeline_fn(mesh, block_fn: Callable, *, axis: str = PIPE_AXIS,
         return out
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        _shard_map, mesh=mesh,
         in_specs=(P(axis), P(), extra_spec),
         out_specs=P(),
         check_vma=False)

@@ -21,9 +21,9 @@ the mesh ``data`` axis (or any named axis passed in).
 
 from __future__ import annotations
 
-import functools
 
 from pathway_tpu.parallel.mesh import DATA_AXIS
+from pathway_tpu.parallel.mesh import shard_map as _shard_map
 
 
 def _online_block(q, k_blk, v_blk, m, l, o, mask=None):
@@ -95,8 +95,8 @@ def ring_attention(q, k, v, *, mesh=None, axis: str = DATA_AXIS,
         return (o / jnp.maximum(denom, 1e-30)).astype(q.dtype)
 
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = _shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 
@@ -142,8 +142,8 @@ def ulysses_attention(q, k, v, *, mesh=None, axis: str = DATA_AXIS,
         return head_to_seq(out)
 
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = _shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 

@@ -23,6 +23,7 @@ import numpy as np
 from pathway_tpu.internals.keys import Pointer
 from pathway_tpu.ops.knn import KnnMetric, _quantize_i8_np, _round_up
 from pathway_tpu.parallel.mesh import DATA_AXIS, get_mesh
+from pathway_tpu.parallel.mesh import shard_map as _shard_map
 
 
 class ShardedKnnIndex:
@@ -309,7 +310,7 @@ class ShardedKnnIndex:
         in_specs = (P(), P(DATA_AXIS), P(DATA_AXIS))
         if int8:
             in_specs = in_specs + (P(DATA_AXIS), P(DATA_AXIS))
-        shard_fn = jax.shard_map(
+        shard_fn = _shard_map(
             local_search, mesh=self._mesh,
             in_specs=in_specs,
             out_specs=(P(), P()),

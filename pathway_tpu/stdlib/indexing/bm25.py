@@ -4,7 +4,6 @@ TantivyBM25 over the Rust tantivy engine; here over ops/bm25.py)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any
 
 from pathway_tpu.internals import expression as ex
 from pathway_tpu.ops.bm25 import create_bm25_index

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 from abc import ABC
 from dataclasses import dataclass
-from typing import Any
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import expression as ex

@@ -9,8 +9,8 @@ MXU matmul over an HBM slab makes the exact scan the fast path).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Callable
+from dataclasses import dataclass
+from typing import Any
 
 from pathway_tpu.internals import expression as ex
 from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric

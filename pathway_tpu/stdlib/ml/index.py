@@ -9,7 +9,6 @@ batched MXU dispatch, which is the whole point of the TPU build
 
 from __future__ import annotations
 
-from typing import Any
 
 from pathway_tpu.internals import expression as ex
 from pathway_tpu.internals.table import Table

@@ -4,7 +4,6 @@
 from __future__ import annotations
 
 import pathway_tpu.internals.reducers_frontend as reducers
-from pathway_tpu.internals import thisclass
 from pathway_tpu.internals.table import Table
 
 

@@ -7,7 +7,7 @@ sorted_tuple reducers identically); meant for infrequent small-table use."""
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable
 
 import pathway_tpu.internals.reducers_frontend as reducers
 from pathway_tpu.internals import expression as ex

@@ -11,7 +11,7 @@ tokenized and encoded in one device call, never per row.
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Callable
+from typing import Any
 
 import numpy as np
 

@@ -18,7 +18,6 @@ import pathway_tpu as pw
 from pathway_tpu.internals import udfs
 from pathway_tpu.internals.json import Json
 from pathway_tpu.xpacks.llm import llms, prompts
-from pathway_tpu.xpacks.llm._utils import _unwrap_udf
 from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
 
 

@@ -10,7 +10,6 @@ cosine on device-normalized embeddings).
 from __future__ import annotations
 
 import re
-from typing import Any
 
 import numpy as np
 

@@ -12,7 +12,6 @@ import re
 import unicodedata
 
 from pathway_tpu.internals import udfs
-from pathway_tpu.internals.json import Json
 
 
 def null_splitter(txt: str) -> list[tuple[str, dict]]:

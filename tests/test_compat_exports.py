@@ -4,6 +4,8 @@ behave (internals/compat.py)."""
 
 from __future__ import annotations
 
+import os
+
 import pytest
 
 import pathway_tpu as pw
@@ -20,6 +22,12 @@ def _clear():
 
 _REFERENCE_ALL = None
 
+# parity tests compare against the reference checkout; skip cleanly in
+# containers that ship only this repo
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir("/root/reference/python/pathway"),
+    reason="reference checkout /root/reference not present")
+
 
 def _reference_names():
     global _REFERENCE_ALL
@@ -33,6 +41,7 @@ def _reference_names():
     return _REFERENCE_ALL
 
 
+@needs_reference
 def test_every_reference_export_resolves():
     missing = [n for n in _reference_names() if not hasattr(pw, n)]
     assert missing == [], missing
@@ -189,6 +198,7 @@ def test_table_live_view():
     assert list(snap["v"]) == [7]
 
 
+@needs_reference
 def test_submodule_export_parity():
     """Key submodule surfaces resolve every reference __all__ name."""
     import re

@@ -436,3 +436,25 @@ def test_knn_add_batch_device_matches_host_path():
     got = dev2._exhaustive_filtered_search(eye[2], 1,
                                            lambda d: bool(d and d["ok"]))
     assert got[0][0] == Pointer(2)
+
+
+@pytest.mark.parametrize("dim", [2048, 4096])
+def test_quantize_i8_vsq_exact_past_dim_1040(dim):
+    """vsq must equal the int-domain squared norm (rounded to float32 at
+    most once) well past dim ~1040, where a sequential float32 accumulator
+    starts rounding partial sums. dim 4096 breaks even numpy's pairwise
+    float32 summation, so this pins int accumulation on every backend."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops.knn import _quantize_i8, _quantize_i8_np
+
+    rng = np.random.default_rng(7)
+    # adversarial magnitudes: every |q| near 127 maximizes the partial sums
+    vecs = rng.uniform(0.9, 1.0, size=(16, dim)).astype(np.float32)
+    vecs *= rng.choice([-1.0, 1.0], size=vecs.shape).astype(np.float32)
+
+    for q, _, vsq in (_quantize_i8_np(vecs),
+                      tuple(np.asarray(x) for x in
+                            _quantize_i8(jnp.asarray(vecs)))):
+        exact = np.sum(q.astype(np.int64) ** 2, axis=1)
+        np.testing.assert_array_equal(vsq, exact.astype(np.float32))

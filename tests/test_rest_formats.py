@@ -34,7 +34,7 @@ def server():
         return {"got": payload}
 
     ws.register("/custom", ("POST",), echo, None, format="custom")
-    ws.register("/raw", ("POST",), echo, None, format="raw")
+    ws.register("/raw", ("POST", "GET"), echo, None, format="raw")
     ws.start()
     return "http://127.0.0.1:18591"
 
@@ -57,6 +57,64 @@ def test_raw_format_takes_body_as_query(server):
     code, body = _post(server + "/raw", b"plain text question")
     assert code == 200
     assert json.loads(body)["got"] == {"query": "plain text question"}
+
+
+def test_raw_format_applies_to_every_method(server):
+    # GET has no body: raw semantics still hold and yield {'query': ''},
+    # not the query-param dict custom would build
+    with urllib.request.urlopen(server + "/raw?ignored=1", timeout=10) as r:
+        assert r.status == 200
+        assert json.loads(r.read())["got"] == {"query": ""}
+
+
+def test_formats_are_keyed_per_method(server):
+    # the same route can serve raw POSTs and a custom GET side by side
+    ws = PathwayWebserver(host="127.0.0.1", port=18595)
+
+    async def echo(payload):
+        return {"got": payload}
+
+    ws.register("/mixed", ("POST",), echo, None, format="raw")
+    ws.register("/mixed", ("GET",), echo, None, format="custom")
+    ws.start()
+    base = "http://127.0.0.1:18595"
+    code, body = _post(base + "/mixed", b"plain text")
+    assert (code, json.loads(body)["got"]) == (200, {"query": "plain text"})
+    with urllib.request.urlopen(base + "/mixed?q=1", timeout=10) as r:
+        assert json.loads(r.read())["got"] == {"q": "1"}
+
+
+def test_conflicting_format_reregistration_is_rejected():
+    ws = PathwayWebserver(host="127.0.0.1", port=18596)
+
+    async def echo(payload):
+        return {"got": payload}
+
+    ws.register("/r", ("POST",), echo, None, format="raw")
+    with pytest.raises(ValueError, match="already registered"):
+        ws.register("/r", ("POST",), echo, None, format="custom")
+    # same-format re-registration stays allowed (handler swap)
+    ws.register("/r", ("POST",), echo, None, format="raw")
+
+
+def test_rejected_reregistration_is_atomic():
+    ws = PathwayWebserver(host="127.0.0.1", port=18597)
+
+    async def h1(payload):
+        return {"h": 1}
+
+    async def h2(payload):
+        return {"h": 2}
+
+    ws.register("/r", ("POST",), h1, None, format="raw")
+    # GET would be new, POST conflicts: the whole call must be a no-op,
+    # not leave GET /r registered with the new handler/format
+    with pytest.raises(ValueError, match="already registered"):
+        ws.register("/r", ("GET", "POST"), h2, None, format="custom")
+    assert ("GET", "/r") not in ws._routes
+    assert ("GET", "/r") not in ws._formats
+    assert ws._routes[("POST", "/r")] is h1
+    assert ws._formats[("POST", "/r")] == "raw"
 
 
 def test_schema_endpoint_yaml_default_and_json(server):
@@ -96,6 +154,25 @@ def test_cors_headers_and_preflight():
     req = urllib.request.Request(base + "/c", data=b"{}", method="POST")
     with urllib.request.urlopen(req, timeout=10) as r:
         assert r.headers["Access-Control-Allow-Origin"] == "*"
+
+
+def test_rest_connector_infers_format_from_schema():
+    import pathway_tpu.internals.schema as sch
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    # schemaless endpoint: raw inferred, so a plain-text POST becomes
+    # {'query': body} (reference _server.py:733-736)
+    ws = PathwayWebserver(host="127.0.0.1", port=18597)
+    table, _ = rest_connector(webserver=ws, route="/infer")
+    assert table._plan.params["datasource"].format == "raw"
+    assert table.column_names() == ["query"]
+    # schema-ful endpoint: custom inferred
+    ws2 = PathwayWebserver(host="127.0.0.1", port=18598)
+    table2, _ = rest_connector(webserver=ws2, route="/infer",
+                               schema=sch.schema_from_types(question=str))
+    assert table2._plan.params["datasource"].format == "custom"
+    G.clear()
 
 
 def test_rest_connector_validates_format_and_raw_schema():

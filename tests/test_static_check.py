@@ -1,0 +1,563 @@
+"""Static pipeline analyzer (internals/static_check/): one true-positive
+and one true-negative per diagnostic code, plus the three front doors —
+``pw.static_check``, ``pw.run(static_check=...)`` and
+``python -m pathway_tpu check``."""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.internals.dtype as dt
+import pathway_tpu.internals.schema as sch
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.static_check import (CODES, Diagnostic, Severity,
+                                                StaticCheckError, render)
+from tests.utils import T
+
+
+@pytest.fixture(autouse=True)
+def _clear():
+    G.clear()
+    yield
+    G.clear()
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+def _ab_table():
+    return T("""
+    a | b
+    1 | x
+    """)
+
+
+# ---------------------------------------------------------------------------
+# PWT001 — binary operation on incompatible dtypes
+# ---------------------------------------------------------------------------
+
+def test_pwt001_int_plus_str_is_error():
+    t = _ab_table()
+    diags = pw.static_check(t.select(bad=t.a + t.b))
+    assert codes(diags) == ["PWT001"]
+    assert diags[0].is_error
+    # the diagnostic points at the user's select line, not framework code
+    assert diags[0].trace is not None
+    assert diags[0].trace.file_name.endswith("test_static_check.py")
+
+
+def test_pwt001_ordering_incomparable_dtypes():
+    t = _ab_table()
+    assert codes(pw.static_check(t.select(bad=t.a < t.b))) == ["PWT001"]
+
+
+def test_pwt001_negative_valid_arithmetic():
+    t = _ab_table()
+    out = t.select(c=t.a * 2, d=t.b + t.b, e=t.a <= t.a)
+    assert pw.static_check(out) == []
+
+
+# ---------------------------------------------------------------------------
+# PWT002 — impossible cast/convert
+# ---------------------------------------------------------------------------
+
+def test_pwt002_int_to_duration_cast_is_error():
+    t = _ab_table()
+    diags = pw.static_check(t.select(c=pw.cast(dt.DURATION, t.a)))
+    assert codes(diags) == ["PWT002"]
+
+
+def test_pwt002_negative_int_to_float_cast():
+    t = _ab_table()
+    assert pw.static_check(t.select(c=pw.cast(float, t.a))) == []
+
+
+# ---------------------------------------------------------------------------
+# PWT003 — join/groupby keys with incompatible dtypes
+# ---------------------------------------------------------------------------
+
+def test_pwt003_join_on_int_vs_str_key():
+    left = T("""
+    k | v
+    1 | 2
+    """)
+    right = T("""
+    k | w
+    a | b
+    """)
+    joined = left.join(right, left.k == right.k).select(left.v, right.w)
+    diags = pw.static_check(joined)
+    assert "PWT003" in codes(diags)
+
+
+def test_pwt003_negative_matching_key_dtypes():
+    left = T("""
+    k | v
+    1 | 2
+    """)
+    right = T("""
+    k | w
+    1 | 3
+    """)
+    joined = left.join(right, left.k == right.k).select(left.v, right.w)
+    assert pw.static_check(joined) == []
+
+
+# ---------------------------------------------------------------------------
+# PWT004 — dead dataflow
+# ---------------------------------------------------------------------------
+
+def test_pwt004_unreached_table_is_reported():
+    t = _ab_table()
+    live = t.select(c=t.a * 2)
+    # computed, never consumed; the local ref keeps it alive in the weak
+    # registry, exactly like a forgotten module-level table in a script
+    dead = t.select(d=t.a + 1)  # noqa: F841
+    diags = pw.static_check(live)
+    assert codes(diags) == ["PWT004"]
+    assert diags[0].severity is Severity.WARNING
+
+
+def test_pwt004_negative_everything_reaches_the_sink():
+    t = _ab_table()
+    live = t.select(c=t.a * 2)
+    assert pw.static_check(live) == []
+
+
+def test_unreachable_table_errors_downgrade_to_dead_dataflow():
+    # a defective table outside the outputs' upstream closure never runs:
+    # it must warn as dead dataflow, not block a valid pipeline with errors
+    t = _ab_table()
+    live = t.select(c=t.a * 2)
+    scratch = t.select(bad=t.a + t.b)  # noqa: F841 — int+str, kept alive
+    diags = pw.static_check(live)
+    assert codes(diags) == ["PWT004"]
+    assert all(not d.is_error for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# PWT005 — streaming source never reaches a sink
+# ---------------------------------------------------------------------------
+
+def _streaming_source(tmp_dir):
+    return pw.io.fs.read(tmp_dir, format="json", mode="streaming",
+                         schema=sch.schema_from_types(a=int))
+
+
+def test_pwt005_streaming_source_without_output_binder(tmp_path):
+    source = _streaming_source(str(tmp_path))  # noqa: F841 — keep alive
+    diags = pw.static_check()
+    assert codes(diags) == ["PWT005"]
+
+
+def test_pwt005_negative_subscribed_source(tmp_path):
+    t = _streaming_source(str(tmp_path))
+    pw.io.subscribe(t, lambda *a, **k: None)
+    assert pw.static_check() == []
+
+
+def test_pwt005_negative_static_mode_source(tmp_path):
+    # a static read terminates on its own — no "runs forever" diagnostic
+    source = pw.io.fs.read(  # noqa: F841 — keep alive
+        str(tmp_path), format="json", mode="static",
+        schema=sch.schema_from_types(a=int))
+    assert codes(pw.static_check()) == []
+
+
+# ---------------------------------------------------------------------------
+# PWT006 — non-deterministic / async UDF in a persisted pipeline
+# ---------------------------------------------------------------------------
+
+def test_pwt006_nondeterministic_udf_with_persistence():
+    t = _ab_table()
+    inc = pw.udf(lambda x: x + 1)  # deterministic defaults to False
+    out = t.select(c=inc(t.a))
+    diags = pw.static_check(out, persistence=True)
+    assert codes(diags) == ["PWT006"]
+
+
+def test_pwt006_negative_deterministic_udf_or_no_persistence():
+    t = _ab_table()
+    inc_det = pw.udf(lambda x: x + 1, deterministic=True)
+    assert pw.static_check(t.select(c=inc_det(t.a)), persistence=True) == []
+    G.clear()
+    t = _ab_table()
+    inc = pw.udf(lambda x: x + 1)
+    assert pw.static_check(t.select(c=inc(t.a)), persistence=False) == []
+
+
+# ---------------------------------------------------------------------------
+# PWT007 — universe mismatch the solver would reject
+# ---------------------------------------------------------------------------
+
+def test_pwt007_update_cells_on_disjoint_universes():
+    a = T("""
+    x
+    1
+    """)
+    b = T("""
+    x
+    2
+    """)
+    disjoint = a.promise_universes_are_disjoint(b)
+    diags = pw.static_check(disjoint.update_cells(b))
+    assert "PWT007" in codes(diags)
+    pwt007 = [d for d in diags if d.code == "PWT007"]
+    assert pwt007[0].is_error
+
+
+def test_pwt007_unproven_subset_is_info_not_error():
+    a = T("""
+    x
+    1
+    """)
+    b = T("""
+    x
+    2
+    """)
+    diags = pw.static_check(a.update_cells(b))
+    assert codes(diags) == ["PWT007"]
+    assert diags[0].severity is Severity.INFO
+
+
+def test_pwt007_negative_proven_equal_universes():
+    t = _ab_table()
+    reshaped = t.select(c=t.a).with_universe_of(t)
+    assert pw.static_check(reshaped) == []
+
+
+# ---------------------------------------------------------------------------
+# PWT008 — get() default silently widens the element dtype
+# ---------------------------------------------------------------------------
+
+def test_pwt008_str_default_on_int_tuple():
+    t = _ab_table()
+    tup = t.select(tu=pw.make_tuple(t.a, t.a))
+    got = tup.select(g=tup.tu.get(0, default="missing"))
+    diags = pw.static_check(got)
+    assert codes(diags) == ["PWT008"]
+
+
+def test_pwt008_negative_default_matches_element_dtype():
+    t = _ab_table()
+    tup = t.select(tu=pw.make_tuple(t.a, t.a))
+    got = tup.select(g=tup.tu.get(0, default=7))
+    assert pw.static_check(got) == []
+
+
+# ---------------------------------------------------------------------------
+# PWT009 — sink format cannot carry the bound table's schema
+# ---------------------------------------------------------------------------
+
+def test_pwt009_tuple_column_into_csv_sink(tmp_path):
+    t = _ab_table()
+    tup = t.select(tu=pw.make_tuple(t.a, t.a))
+    pw.io.fs.write(tup, str(tmp_path / "out.csv"), format="csv")
+    diags = pw.static_check()
+    assert codes(diags) == ["PWT009"]
+
+
+def test_pwt009_negative_scalar_columns_into_csv(tmp_path):
+    t = _ab_table()
+    pw.io.fs.write(t.select(c=t.a * 2), str(tmp_path / "out.csv"),
+                   format="csv")
+    assert pw.static_check() == []
+
+
+# ---------------------------------------------------------------------------
+# PWT010 — redundant cast
+# ---------------------------------------------------------------------------
+
+def test_pwt010_cast_to_same_dtype_is_info():
+    t = _ab_table()
+    diags = pw.static_check(t.select(c=pw.cast(int, t.a)))
+    assert codes(diags) == ["PWT010"]
+    assert diags[0].severity is Severity.INFO
+
+
+def test_pwt010_negative_widening_cast():
+    t = _ab_table()
+    assert pw.static_check(t.select(c=pw.cast(float, t.a))) == []
+
+
+# ---------------------------------------------------------------------------
+# PWT011 — ix key is not a pointer
+# ---------------------------------------------------------------------------
+
+def test_pwt011_ix_with_int_key():
+    t = _ab_table()
+    diags = pw.static_check(t.ix(t.a))
+    assert codes(diags) == ["PWT011"]
+
+
+def test_pwt011_negative_ix_with_id_pointer():
+    t = _ab_table()
+    assert pw.static_check(t.ix(t.id)) == []
+
+
+# ---------------------------------------------------------------------------
+# diagnostics plumbing
+# ---------------------------------------------------------------------------
+
+def test_every_code_has_registered_severity_and_summary():
+    assert set(CODES) >= {f"PWT{i:03d}" for i in range(12)}
+    for code, (severity, summary) in CODES.items():
+        assert isinstance(severity, Severity)
+        assert summary
+
+
+def test_unknown_code_is_rejected():
+    with pytest.raises(ValueError, match="unknown diagnostic code"):
+        Diagnostic(code="PWT999", message="nope")
+
+
+def test_deep_linear_pipeline_does_not_hit_recursion_limit():
+    # the analyzer's DAG walk must be iterative: thousands of chained
+    # selects are a legal pipeline, not a stack overflow
+    t = T("""
+    a
+    1
+    """)
+    for _ in range(1200):
+        t = t.select(a=pw.this.a)
+    assert pw.static_check(t) == []
+
+
+def test_render_orders_errors_first():
+    out = render([
+        Diagnostic(code="PWT010", message="an info"),
+        Diagnostic(code="PWT001", message="an error"),
+        Diagnostic(code="PWT004", message="a warning"),
+    ])
+    assert out.index("PWT001") < out.index("PWT004") < out.index("PWT010")
+
+
+# ---------------------------------------------------------------------------
+# pw.run(static_check=...) gate
+# ---------------------------------------------------------------------------
+
+def test_run_static_check_error_raises_before_execution():
+    t = _ab_table()
+    bad = t.select(c=t.a + t.b)
+    pw.io.subscribe(bad, lambda *a, **k: None)
+    with pytest.raises(StaticCheckError) as exc_info:
+        pw.run(static_check="error")
+    assert any(d.code == "PWT001" for d in exc_info.value.diagnostics)
+
+
+def test_run_static_check_warn_logs_and_still_runs(caplog):
+    t = _ab_table()
+    seen = []
+    pw.io.subscribe(t.select(c=t.a * 2), lambda *a, **k: seen.append(a))
+    dead = t.select(dead=t.a + 1)  # noqa: F841 — keep alive
+    with caplog.at_level("WARNING", logger="pathway_tpu.static_check"):
+        pw.run(static_check="warn")
+    assert any("PWT004" in r.message for r in caplog.records)
+    assert seen  # the pipeline still executed
+
+
+def test_run_static_check_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="static_check must be"):
+        pw.run(static_check="loudly")
+
+
+def test_run_static_check_info_diagnostics_log_at_info(caplog):
+    # a redundant cast is informational — it must not surface as a
+    # WARNING record that log-level alerting would page on
+    t = _ab_table()
+    seen = []
+    pw.io.subscribe(t.select(c=pw.cast(int, t.a)),
+                    lambda *a, **k: seen.append(a))
+    with caplog.at_level("INFO", logger="pathway_tpu.static_check"):
+        pw.run(static_check="warn")
+    recs = [r for r in caplog.records if "PWT010" in r.message]
+    assert recs, caplog.records
+    assert all(r.levelno == logging.INFO for r in recs)
+    assert seen  # the pipeline still executed
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m pathway_tpu check
+# ---------------------------------------------------------------------------
+
+def _run_check(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", "check", *args],
+        capture_output=True, text=True, env=env, timeout=300)
+
+
+def test_cli_check_exits_nonzero_on_seeded_dtype_error(tmp_path):
+    script = tmp_path / "bad_pipeline.py"
+    script.write_text(textwrap.dedent("""
+        import pathway_tpu as pw
+        t = pw.debug.table_from_markdown('''
+        a | b
+        1 | x
+        ''')
+        out = t.select(c=t.a + t.b)
+        pw.debug.compute_and_print(out)
+    """))
+    proc = _run_check(str(script))
+    assert proc.returncode == 1, proc.stderr
+    assert "PWT001" in proc.stdout
+    # the seeded pipeline must not have actually executed
+    assert "Error" not in proc.stdout.splitlines()[0]
+
+
+def test_cli_check_reports_import_failure_as_pwt000(tmp_path):
+    script = tmp_path / "broken.py"
+    script.write_text("raise RuntimeError('boom at import time')\n")
+    proc = _run_check(str(script))
+    assert proc.returncode == 1
+    assert "PWT000" in proc.stdout
+
+
+def test_cli_check_passes_on_clean_script(tmp_path):
+    script = tmp_path / "clean_pipeline.py"
+    script.write_text(textwrap.dedent("""
+        import pathway_tpu as pw
+        t = pw.debug.table_from_markdown('''
+        a
+        1
+        ''')
+        pw.debug.compute_and_print(t.select(c=t.a * 2))
+    """))
+    proc = _run_check(str(script))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+GUARDED = """
+import pathway_tpu as pw
+
+def main():
+    t = pw.debug.table_from_markdown('''
+    a
+    1
+    ''')
+    pw.debug.compute_and_print(t.select(c=t.a * 2))
+
+if __name__ == "__main__":
+    main()
+"""
+
+
+def test_cli_check_reports_empty_collection_distinctly(tmp_path):
+    # a graph hidden behind __main__ must not read as "clean": without
+    # --require-pipeline it passes but says so; with the flag it fails
+    script = tmp_path / "guarded.py"
+    script.write_text(GUARDED)
+    proc = _run_check(str(script))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no pipeline collected" in proc.stderr
+    proc = _run_check("--require-pipeline", str(script))
+    assert proc.returncode == 1
+    assert "no pipeline collected" in proc.stderr
+
+
+def test_cli_check_pathway_check_hook_is_analyzed(tmp_path):
+    # the __pathway_check__ convention (used by examples/) feeds the
+    # analyzer a real graph — including its errors
+    script = tmp_path / "hooked.py"
+    script.write_text(GUARDED + """
+elif __name__ == "__pathway_check__":
+    t = pw.debug.table_from_markdown('''
+    a | b
+    1 | x
+    ''')
+    pw.debug.compute_and_print(t.select(c=t.a + t.b))
+""")
+    proc = _run_check("--require-pipeline", str(script))
+    assert proc.returncode == 1
+    assert "PWT001" in proc.stdout
+
+
+def test_cli_check_nonzero_system_exit_is_pwt000(tmp_path):
+    script = tmp_path / "exits.py"
+    script.write_text("import sys\nsys.exit(3)\n")
+    proc = _run_check(str(script))
+    assert proc.returncode == 1
+    assert "PWT000" in proc.stdout and "status 3" in proc.stdout
+
+
+def test_cli_check_clean_system_exit_is_ok(tmp_path):
+    script = tmp_path / "clean_exit.py"
+    script.write_text(textwrap.dedent("""
+        import sys
+        import pathway_tpu as pw
+        t = pw.debug.table_from_markdown('''
+        a
+        1
+        ''')
+        pw.debug.compute_and_print(t.select(c=t.a * 2))
+        sys.exit(0)
+    """))
+    proc = _run_check("--require-pipeline", str(script))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_check_clean_exit_still_analyzes_unbound_tables(tmp_path):
+    # sys.exit(0) drops the module globals; the registry holds tables only
+    # weakly, so without pinning the seeded error would vanish un-reported
+    script = tmp_path / "exit_with_bad_table.py"
+    script.write_text(textwrap.dedent("""
+        import sys
+        import pathway_tpu as pw
+        t = pw.debug.table_from_markdown('''
+        a | b
+        1 | x
+        ''')
+        bad = t.select(c=t.a + t.b)
+        sys.exit(0)
+    """))
+    proc = _run_check(str(script))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "PWT001" in proc.stdout
+
+
+def test_cli_check_directory_skips_helper_modules(tmp_path):
+    # only pipeline entry points gate a directory: _*.py and __init__.py
+    # must be neither imported nor failed under --require-pipeline
+    (tmp_path / "pipeline.py").write_text(textwrap.dedent("""
+        import pathway_tpu as pw
+        t = pw.debug.table_from_markdown('''
+        a
+        1
+        ''')
+        pw.debug.compute_and_print(t.select(c=t.a * 2))
+    """))
+    (tmp_path / "_helpers.py").write_text("CONSTANT = 1\n")
+    (tmp_path / "__init__.py").write_text("")
+    (tmp_path / ".hidden").mkdir()
+    (tmp_path / ".hidden" / "junk.py").write_text("raise RuntimeError\n")
+    proc = _run_check("--require-pipeline", str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "_helpers" not in proc.stderr and "junk" not in proc.stderr
+
+
+def test_cli_check_scripts_share_helper_with_cold_import_cache(tmp_path):
+    # two scripts importing the same graph-building helper must each
+    # collect it: the import cache is reset between scripts, otherwise
+    # the second one would see a cached (already-executed) module and
+    # fail the gate with "no pipeline collected"
+    (tmp_path / "_shared.py").write_text(textwrap.dedent("""
+        import pathway_tpu as pw
+        t = pw.debug.table_from_markdown('''
+        a
+        1
+        ''')
+        pw.debug.compute_and_print(t.select(c=t.a * 2))
+    """))
+    for name in ("first.py", "second.py"):
+        (tmp_path / name).write_text("import _shared\n")
+    proc = _run_check("--require-pipeline", str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
