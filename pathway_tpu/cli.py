@@ -137,8 +137,16 @@ replay = _apply(_spawn_opts, replay)
 @click.option("--require-pipeline", is_flag=True,
               help="fail scripts that build no tables and register no "
                    "sinks (catches graphs hidden behind __main__ guards)")
+@click.option("--tpu-mesh", "tpu_mesh", metavar="DATAxMODEL", default=None,
+              help="analyze against a hypothetical device topology "
+                   "(e.g. 4x2) — arms the PWT1xx sharding/placement "
+                   "checks without owning the hardware")
+@click.option("--json", "as_json", is_flag=True,
+              help="emit machine-readable diagnostics (code, severity, "
+                   "file, line, message) on stdout for CI annotation; "
+                   "exit-code semantics unchanged")
 @click.argument("paths", nargs=-1, required=True)
-def check(paths, strict, require_pipeline):
+def check(paths, strict, require_pipeline, tpu_mesh, as_json):
     """Statically analyze pipeline scripts without running them.
 
     Imports each script (or every ``*.py`` under a directory) with
@@ -150,9 +158,18 @@ def check(paths, strict, require_pipeline):
     an ``if __name__ == "__pathway_check__":`` branch building the graph
     with placeholder inputs to have it checked. Exits nonzero on any
     error-severity diagnostic."""
+    import json as _json
     import pathlib
 
-    from pathway_tpu.internals.static_check import Severity
+    from pathway_tpu.internals.static_check import (Severity,
+                                                    parse_mesh_spec)
+
+    mesh = None
+    if tpu_mesh is not None:
+        try:
+            mesh = parse_mesh_spec(tpu_mesh)
+        except ValueError as e:
+            raise click.UsageError(str(e))
 
     scripts: list[pathlib.Path] = []
     for p in paths:
@@ -174,8 +191,9 @@ def check(paths, strict, require_pipeline):
         raise click.UsageError("no python scripts found under given paths")
 
     n_errors = 0
+    json_out: list[dict] = []
     for script in scripts:
-        diagnostics, collected = _collect_and_check(script)
+        diagnostics, collected = _collect_and_check(script, mesh=mesh)
         bad = [d for d in diagnostics
                if d.severity is Severity.ERROR
                or (strict and d.severity is Severity.WARNING)]
@@ -191,14 +209,19 @@ def check(paths, strict, require_pipeline):
             click.echo(f"[{status}] {script} — "
                        f"{len(diagnostics)} diagnostic(s)", err=True)
         for d in diagnostics:
-            click.echo(str(d))
+            if as_json:
+                json_out.append({"script": str(script), **d.to_dict()})
+            else:
+                click.echo(str(d))
+    if as_json:
+        click.echo(_json.dumps(json_out, indent=2))
     if n_errors:
         click.echo(f"static check failed: {n_errors} blocking "
                    f"diagnostic(s)", err=True)
         sys.exit(1)
 
 
-def _collect_and_check(script):
+def _collect_and_check(script, mesh=None):
     """Import one script in collect-only mode and analyze its graph.
 
     Returns ``(diagnostics, collected)`` where ``collected`` is False when
@@ -268,7 +291,7 @@ def _collect_and_check(script):
                 code="PWT000",
                 message=f"script failed during collection: {e!r}")], True
         collected = bool(G.tables() or G.outputs)
-        diagnostics = analyze(graph=G)
+        diagnostics = analyze(graph=G, mesh=mesh)
         return diagnostics, collected
     finally:
         for (mod, name, _), fn in zip(patched, saved):

@@ -69,6 +69,30 @@ class ExternalIndexOperator(Operator):
         self.live_queries: dict[Pointer, tuple] = {}  # key → (vec, limit, filt)
         # replica 0 maintains the shared index; other replicas only search
         self._is_primary = True
+        self._warn_mesh_placement(index)
+
+    @staticmethod
+    def _warn_mesh_placement(index) -> None:
+        """Runtime counterpart of the static PWT104 check: an index slab
+        pinned to a mesh other than the process-wide active one makes every
+        query batch cross topologies."""
+        slab_mesh = getattr(index, "_mesh", None)
+        if slab_mesh is None:
+            return
+        from pathway_tpu.parallel.mesh import current_mesh
+
+        active = current_mesh()
+        if active is None or active is slab_mesh:
+            return
+        if dict(active.shape) != dict(slab_mesh.shape):
+            import logging
+
+            logging.getLogger("pathway_tpu.shard_check").warning(
+                "[PWT104] external index slab lives on a %s mesh while the "
+                "active mesh is %s — every query batch pays a "
+                "cross-topology transfer; build the index with mesh='auto' "
+                "or the active mesh",
+                dict(slab_mesh.shape), dict(active.shape))
 
     def replicate(self, n: int):
         import copy

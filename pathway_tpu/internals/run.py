@@ -25,6 +25,11 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
     ``"warn"`` logs every diagnostic, ``"error"`` additionally raises
     :class:`StaticCheckError` on error-severity findings, ``"off"`` (the
     default, also settable via ``PATHWAY_STATIC_CHECK``) skips analysis.
+    ``PATHWAY_STATIC_CHECK_MESH`` (e.g. ``"4x2"``) arms the mesh-dependent
+    sharding checks (PWT1xx) against that topology; the UDF-traceability
+    classifications the analyzer records on apply expressions
+    (``_shard_class``) are the hook for auto-jitting traceable UDFs here
+    later.
     """
     from pathway_tpu.internals.config import get_pathway_config
 
@@ -97,7 +102,9 @@ def _run_static_check(mode: str | None, persistence_config) -> None:
     from pathway_tpu.internals.static_check import (Severity, StaticCheckError,
                                                     analyze)
 
-    diagnostics = analyze(graph=G, persisted=persistence_config is not None)
+    diagnostics = analyze(
+        graph=G, persisted=persistence_config is not None,
+        mesh=os.environ.get("PATHWAY_STATIC_CHECK_MESH") or None)
     if not diagnostics:
         return
     log = logging.getLogger("pathway_tpu.static_check")

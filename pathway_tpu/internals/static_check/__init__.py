@@ -2,10 +2,12 @@
 
 ``pw.static_check(*tables)`` analyzes the lazily-built pipeline — plans,
 expression trees, and the ParseGraph output registry — and returns a list
-of :class:`Diagnostic` findings (codes ``PWT001``–``PWT011``, severities
+of :class:`Diagnostic` findings (codes ``PWT001``–``PWT011`` for the
+logical plan, ``PWT101``–``PWT110`` for sharding/placement, severities
 error/warning/info) *before* the engine ever steps. The same analyzer backs
 ``pw.run(static_check="warn"|"error")`` and the
-``python -m pathway_tpu check`` CLI.
+``python -m pathway_tpu check`` CLI (``--tpu-mesh 4x2`` analyzes against a
+hypothetical topology, ``--json`` emits machine-readable diagnostics).
 
 >>> import pathway_tpu as pw
 >>> t = pw.debug.table_from_markdown('''
@@ -31,15 +33,22 @@ from pathway_tpu.internals.static_check.diagnostics import (
     StaticCheckError,
     render,
 )
+from pathway_tpu.internals.static_check.shard_check import (
+    MeshSpec,
+    UdfClassification,
+    classify_udf,
+    parse_mesh_spec,
+)
 
 __all__ = [
-    "Analyzer", "CODES", "Diagnostic", "Severity", "StaticCheckError",
-    "analyze", "render", "static_check",
+    "Analyzer", "CODES", "Diagnostic", "MeshSpec", "Severity",
+    "StaticCheckError", "UdfClassification", "analyze", "classify_udf",
+    "parse_mesh_spec", "render", "static_check",
 ]
 
 
 def static_check(*tables, persistence: bool | None = None,
-                 graph=None) -> list[Diagnostic]:
+                 graph=None, mesh=None) -> list[Diagnostic]:
     """Statically validate the pipeline and return its diagnostics.
 
     With explicit ``tables``, those tables count as intended outputs (their
@@ -50,9 +59,22 @@ def static_check(*tables, persistence: bool | None = None,
     dead dataflow (PWT004), not analyzed for errors. ``persistence`` arms the
     persisted-pipeline checks (PWT006); when ``None`` it is auto-detected
     from the persistence environment variables the CLI sets.
+
+    ``mesh`` arms the mesh-dependent sharding/placement checks (PWT1xx,
+    static_check/shard_check.py) against a real or hypothetical topology:
+    a string ``"4x2"`` (data×model), a :class:`MeshSpec`, a
+    ``parallel.mesh.MeshConfig`` or a ``jax.sharding.Mesh``. When ``None``
+    the ``PATHWAY_STATIC_CHECK_MESH`` env var is consulted; without either,
+    only the mesh-independent PWT1xx checks (UDF traceability, sync
+    points, fused-slab hazards) run.
     """
+    import os
+
     if persistence is None:
         from pathway_tpu.internals.run import _persistence_config_from_env
 
         persistence = _persistence_config_from_env() is not None
-    return analyze(tables, graph=graph, persisted=bool(persistence))
+    if mesh is None:
+        mesh = os.environ.get("PATHWAY_STATIC_CHECK_MESH") or None
+    return analyze(tables, graph=graph, persisted=bool(persistence),
+                   mesh=mesh)

@@ -49,12 +49,29 @@ class _Node:
 
 
 class Analyzer:
-    def __init__(self, *, graph=None, persisted: bool = False):
+    def __init__(self, *, graph=None, persisted: bool = False, mesh=None):
         if graph is None:
             from pathway_tpu.internals.parse_graph import G as graph
+        from pathway_tpu.internals.static_check.shard_check import \
+            parse_mesh_spec
+
         self.graph = graph
         self.persisted = persisted
+        # topology under analysis for the PWT1xx sharding family; None
+        # skips the mesh-dependent checks (UDF/placement checks still run).
+        # A malformed spec (e.g. a typo'd PATHWAY_STATIC_CHECK_MESH) must
+        # surface as a diagnostic, not crash a warn-mode run
+        self.mesh_error: str | None = None
+        try:
+            self.mesh = parse_mesh_spec(mesh)
+        except ValueError as e:
+            self.mesh = None
+            self.mesh_error = str(e)
         self.diagnostics: list[Diagnostic] = []
+        # fn name -> UdfClassification, filled by the shard checker; the
+        # same classification is stamped on each ApplyExpression
+        # (expr._shard_class) so run.py can auto-jit the traceable class
+        self.udf_classifications: dict = {}
         self._nodes: dict[int, _Node] = {}
         self._seen_exprs: set[tuple[str, int]] = set()
 
@@ -78,6 +95,16 @@ class Analyzer:
         elif isinstance(value, dict):
             for v in value.values():
                 self._collect(v, tables, exprs)
+        else:
+            from pathway_tpu.internals.iterate import IterateShared
+
+            if isinstance(value, IterateShared):
+                # walk into the iterate body exactly once: the body tables
+                # are shared by every iterate_result plan, and node identity
+                # (plus per-expression dedup) keeps diagnostics from
+                # repeating across the loop's outputs
+                self._collect(value.input_tables, tables, exprs)
+                self._collect(value.result_tables, tables, exprs)
 
     def _node(self, table) -> _Node:
         node = self._nodes.get(id(table))
@@ -152,6 +179,13 @@ class Analyzer:
         self._check_dead_dataflow(roots, registered, reachable)
         self._check_streaming_sources(roots, reachable)
         self._check_sinks()
+
+        # second diagnostic family: sharding/placement (PWT1xx) over the
+        # same node map and reporting machinery
+        from pathway_tpu.internals.static_check.shard_check import \
+            ShardChecker
+
+        ShardChecker(self).run(None if check_all else reachable)
         return self.diagnostics
 
     # ------------------------------------------------------------------
@@ -501,7 +535,9 @@ def _format_incompatibility(format: str | None, col_t: dt.DType) -> str | None:
     return None
 
 
-def analyze(tables: Iterable = (), *, graph=None,
-            persisted: bool = False) -> list[Diagnostic]:
-    """Run every static check; see :class:`Analyzer`."""
-    return Analyzer(graph=graph, persisted=persisted).run(tables)
+def analyze(tables: Iterable = (), *, graph=None, persisted: bool = False,
+            mesh=None) -> list[Diagnostic]:
+    """Run every static check; see :class:`Analyzer`. ``mesh`` arms the
+    mesh-dependent sharding checks against a real or hypothetical
+    topology (``"4x2"``, a MeshSpec/MeshConfig, or a jax Mesh)."""
+    return Analyzer(graph=graph, persisted=persisted, mesh=mesh).run(tables)

@@ -51,6 +51,34 @@ CODES: dict[str, tuple[Severity, str]] = {
                "redundant cast: expression already has the target dtype"),
     "PWT011": (Severity.ERROR,
                "ix key expression is not a pointer type"),
+    # -- PWT1xx: sharding / placement (static_check/shard_check.py) --------
+    "PWT101": (Severity.ERROR,
+               "mesh axis sizes do not fit the device count"),
+    "PWT102": (Severity.ERROR,
+               "sharded leading dimension not divisible by the mesh axis "
+               "(silent replication/padding)"),
+    "PWT103": (Severity.ERROR,
+               "shard_map in/out specs inconsistent with operand rank or "
+               "mesh axes"),
+    "PWT104": (Severity.WARNING,
+               "operands placed on different meshes: every batch pays an "
+               "implicit cross-topology gather"),
+    "PWT105": (Severity.WARNING,
+               "host-device sync point inside a per-batch path"),
+    "PWT106": (Severity.ERROR,
+               "head-parallel attention: heads not divisible by the axis "
+               "size"),
+    "PWT107": (Severity.INFO,
+               "model axis configured but nothing in the pipeline is "
+               "model-parallel (silent weight replication)"),
+    "PWT108": (Severity.WARNING,
+               "fused donated ingest slab has no reserved capacity: first "
+               "growth silently drops the fused path"),
+    "PWT109": (Severity.WARNING,
+               "host-only UDF on a streaming hot path"),
+    "PWT110": (Severity.INFO,
+               "jit-traceable UDF dispatched row-by-row on the host "
+               "(auto-jit / batch=True candidate)"),
 }
 
 
@@ -75,6 +103,17 @@ class Diagnostic:
     @property
     def is_error(self) -> bool:
         return self.severity is Severity.ERROR
+
+    def to_dict(self) -> dict:
+        """Flat machine-readable form (CLI ``--json`` / CI annotations)."""
+        return {
+            "code": self.code,
+            "severity": str(self.severity),
+            "message": self.message,
+            "table": self.table,
+            "file": self.trace.file_name if self.trace else None,
+            "line": self.trace.line_number if self.trace else None,
+        }
 
     def __str__(self) -> str:
         where = f" [{self.table}]" if self.table else ""

@@ -57,6 +57,18 @@ def _round_up(n: int, mult: int) -> int:
     return ((n + mult - 1) // mult) * mult
 
 
+def planned_capacity(reserved_space: int) -> int:
+    """Slab capacity the index constructor will actually allocate for a
+    reservation — minimum floor, 128-lane rounding, chunk alignment. Shared
+    by ``BruteForceKnnIndex.__init__`` and the static shard checker
+    (PWT108), which uses it to explain what an unreserved fused slab pins."""
+    cap = max(_MIN_CAPACITY, _round_up(max(reserved_space, 1), 128))
+    if cap > _CHUNK_ROWS:
+        # the chunked kernel reshapes the slab to (C, chunk, D)
+        cap = _round_up(cap, _CHUNK_ROWS)
+    return cap
+
+
 def _np_dtype(dtype: str):
     if dtype == "int8":
         # int8 quantization happens device-side in the scatter; the host
@@ -280,10 +292,7 @@ class BruteForceKnnIndex:
             metric = KnnMetric(metric)
         self.dim = int(dimensions)
         self.metric = metric
-        self.capacity = max(_MIN_CAPACITY, _round_up(max(reserved_space, 1), 128))
-        if self.capacity > _CHUNK_ROWS:
-            # the chunked kernel reshapes the slab to (C, chunk, D)
-            self.capacity = _round_up(self.capacity, _CHUNK_ROWS)
+        self.capacity = planned_capacity(reserved_space)
         self.dtype = dtype
         self._np_dtype = _np_dtype(dtype)
         self._is_int8 = dtype == "int8"

@@ -24,6 +24,39 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def validate_shard_specs(mesh, in_specs, out_specs) -> None:
+    """Raise a clear ValueError when a PartitionSpec names an axis the mesh
+    does not have — otherwise the typo surfaces as an opaque error deep in
+    jax's shard_map lowering. (The static counterpart — rank consistency
+    against plan-propagated operand shapes — is PWT103 in
+    internals/static_check/shard_check.py.)"""
+    axes = set(getattr(mesh, "axis_names", ()))
+    if not axes:
+        return
+
+    def walk(spec):
+        if spec is None:
+            return
+        # PartitionSpec may or may not subclass tuple depending on the jax
+        # version, so detect it by mro name before treating tuples as
+        # containers of further specs
+        if any(c.__name__ == "PartitionSpec" for c in type(spec).__mro__):
+            for entry in spec:  # iterates the per-dim entries
+                names = entry if isinstance(entry, tuple) else (entry,)
+                for a in names:
+                    if a is not None and a not in axes:
+                        raise ValueError(
+                            f"shard_map spec names axis {a!r} but the mesh "
+                            f"only has axes {sorted(axes)} (PWT103)")
+            return
+        if isinstance(spec, (list, tuple)):
+            for s in spec:
+                walk(s)
+
+    walk(in_specs)
+    walk(out_specs)
+
+
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     """``jax.shard_map`` across jax versions: newer jax exposes it at the
     top level with ``check_vma``; older releases only have
@@ -31,6 +64,7 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     ``check_rep``."""
     import jax
 
+    validate_shard_specs(mesh, in_specs, out_specs)
     if hasattr(jax, "shard_map"):
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=check_vma)
@@ -45,19 +79,57 @@ class MeshConfig:
     data: int
     model: int = 1
 
+    def validate(self, n_devices: int) -> list[str]:
+        """Reasons this topology cannot tile ``n_devices`` chips (empty =
+        fine). Shared by :meth:`from_env` and the static shard checker
+        (PWT101) so eager and pre-execution validation agree."""
+        problems = []
+        if self.data < 1 or self.model < 1:
+            problems.append(
+                f"axis sizes must be positive, got data={self.data}, "
+                f"model={self.model}")
+            return problems
+        n = self.data * self.model
+        if n > n_devices:
+            problems.append(
+                f"mesh {self.data}x{self.model} needs {n} devices but "
+                f"only {n_devices} are available")
+        elif n_devices % n != 0:
+            problems.append(
+                f"mesh {self.data}x{self.model} covers {n} of {n_devices} "
+                f"devices and {n} does not divide {n_devices} — "
+                f"{n_devices - n} chips would sit idle")
+        return problems
+
     @staticmethod
     def from_env(n_devices: int | None = None) -> "MeshConfig":
         import jax
 
         if n_devices is None:
             n_devices = len(jax.devices())
-        model = int(os.environ.get("PATHWAY_MODEL_PARALLEL", "1"))
+        model_env = os.environ.get("PATHWAY_MODEL_PARALLEL")
         data_env = os.environ.get("PATHWAY_DATA_PARALLEL")
-        if data_env is not None:
-            data = int(data_env)
-        else:
-            data = max(1, n_devices // model)
-        return MeshConfig(data=data, model=model)
+        try:
+            model = int(model_env) if model_env is not None else 1
+            data = (int(data_env) if data_env is not None
+                    else max(1, n_devices // model))
+        except ValueError:
+            raise ValueError(
+                f"PATHWAY_DATA_PARALLEL={data_env!r} / "
+                f"PATHWAY_MODEL_PARALLEL={model_env!r} must be positive "
+                f"integers") from None
+        config = MeshConfig(data=data, model=model)
+        # validate eagerly: letting jax discover the mismatch later fails
+        # deep in mesh construction with an opaque reshape error that
+        # never names the env vars that caused it
+        problems = config.validate(n_devices)
+        if problems:
+            raise ValueError(
+                f"invalid mesh topology from environment "
+                f"(PATHWAY_DATA_PARALLEL={data_env!r}, "
+                f"PATHWAY_MODEL_PARALLEL={model_env!r}, {n_devices} "
+                f"devices visible): " + "; ".join(problems))
+        return config
 
 
 def make_mesh(config: MeshConfig | None = None, *, devices=None):

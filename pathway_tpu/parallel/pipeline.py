@@ -104,6 +104,15 @@ def make_pipeline_fn(mesh, block_fn: Callable, *, axis: str = PIPE_AXIS,
             raise TypeError(
                 "make_pipeline_fn supports ONE replicated side input; pack "
                 f"extras into a single pytree (got {len(extra)})")
+        leaves = jax.tree_util.tree_leaves(stacked_params)
+        if leaves and leaves[0].shape[0] % n_stages != 0:
+            # the leading layer axis shards over the pipe axis; a
+            # non-divisible stack would silently mis-shard or fail deep in
+            # shard_map — same condition the static checker flags (PWT102)
+            raise ValueError(
+                f"pipeline: {leaves[0].shape[0]} stacked layers are not "
+                f"divisible by the {n_stages}-stage pipe axis (PWT102) — "
+                f"pad the layer stack or change the stage count")
         packed = extra[0] if extra else jnp.zeros((), jnp.float32)
         return run(stacked_params, microbatches, packed)
 

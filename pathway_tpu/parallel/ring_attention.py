@@ -65,6 +65,13 @@ def ring_attention(q, k, v, *, mesh=None, axis: str = DATA_AXIS,
     if mesh is None:
         mesh = get_mesh()
     n = int(mesh.shape[axis])
+    if q.shape[1] % n != 0:
+        # shard_map would reject this with an opaque sharding error; the
+        # static checker flags the same condition pre-execution (PWT102)
+        raise ValueError(
+            f"ring attention: sequence length {q.shape[1]} is not "
+            f"divisible by the {axis!r} axis size {n} (PWT102) — pad the "
+            f"sequence or shrink the axis")
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def local(q, k, v):
@@ -118,7 +125,14 @@ def ulysses_attention(q, k, v, *, mesh=None, axis: str = DATA_AXIS,
         mesh = get_mesh()
     n = int(mesh.shape[axis])
     if q.shape[2] % n != 0:
-        raise ValueError(f"heads {q.shape[2]} not divisible by axis size {n}")
+        raise ValueError(
+            f"ulysses attention: {q.shape[2]} heads not divisible by the "
+            f"{axis!r} axis size {n} (PWT106) — pad heads to a multiple "
+            f"of {n} or use ring attention")
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"ulysses attention: sequence length {q.shape[1]} is not "
+            f"divisible by the {axis!r} axis size {n} (PWT102)")
 
     def local(q, k, v):
         # (B, S/n, H, D) → (B, S, H/n, D): split heads, concat seq

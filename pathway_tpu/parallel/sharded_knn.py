@@ -26,6 +26,39 @@ from pathway_tpu.parallel.mesh import DATA_AXIS, get_mesh
 from pathway_tpu.parallel.mesh import shard_map as _shard_map
 
 
+def slab_cap_per_shard(n_shards: int, reserved_space: int) -> int:
+    """Per-shard slab capacity for a reservation of ``reserved_space`` rows.
+
+    The ONE place the slab layout is decided: the index constructor sizes
+    its storage with it and the static shard checker
+    (internals/static_check/shard_check.py, PWT102) predicts padding/skew
+    from it — the two can never disagree about what a reservation costs.
+    """
+    per = max(reserved_space // n_shards + 1, 1)
+    return max(128, _round_up(per, 128))
+
+
+def search_operand_layout(dtype: str) -> tuple[tuple[tuple, int], ...]:
+    """``((sharded_axes, rank), ...)`` per search-kernel operand, in call
+    order: queries, vectors, valid (+ scales, vsq for int8). ``sharded_axes``
+    is a tuple of mesh axis names, one per leading operand dim (empty =
+    replicated) — the symbolic twin of the ``in_specs`` handed to
+    ``shard_map``. Shared by ``_get_search_fn`` and the static shard checker
+    (PWT103), so the spec/rank contract is asserted against the layout the
+    kernel actually uses."""
+    base = (
+        ((), 2),            # queries (B, D): replicated
+        ((DATA_AXIS,), 3),  # vectors (S, C, D): slab dim over the data axis
+        ((DATA_AXIS,), 2),  # valid (S, C)
+    )
+    if dtype == "int8":
+        base = base + (
+            ((DATA_AXIS,), 2),  # scales (S, C)
+            ((DATA_AXIS,), 2),  # vsq (S, C)
+        )
+    return base
+
+
 class ShardedKnnIndex:
     """Exact KNN over a mesh-sharded vector slab.
 
@@ -54,8 +87,7 @@ class ShardedKnnIndex:
         self.dtype = dtype
         self._mesh = mesh if mesh is not None else get_mesh()
         self.n_shards = int(self._mesh.shape[DATA_AXIS])
-        per = max(reserved_space // self.n_shards + 1, 1)
-        self.cap_per_shard = max(128, _round_up(per, 128))
+        self.cap_per_shard = slab_cap_per_shard(self.n_shards, reserved_space)
         self._lock = threading.RLock()
 
         cap = self.total_capacity
@@ -307,9 +339,8 @@ class ShardedKnnIndex:
             mi = jnp.take_along_axis(cand_i, mpos, axis=1)
             return ms, mi
 
-        in_specs = (P(), P(DATA_AXIS), P(DATA_AXIS))
-        if int8:
-            in_specs = in_specs + (P(DATA_AXIS), P(DATA_AXIS))
+        in_specs = tuple(P(*axes)
+                         for axes, _rank in search_operand_layout(self.dtype))
         shard_fn = _shard_map(
             local_search, mesh=self._mesh,
             in_specs=in_specs,

@@ -1,0 +1,24 @@
+"""Deliberately misconfigured sharded-KNN pipeline — the CI canary proving
+the PWT1xx gate bites.
+
+``python -m pathway_tpu check --tpu-mesh 8x1 tests/shard_check_negative_example.py``
+must exit nonzero: the slab reservation (1001 rows) does not tile the
+8-way data axis (PWT102). Without ``--tpu-mesh`` the slab stays unsharded
+and the script is clean — the misconfiguration is topology-relative.
+"""
+
+import numpy as np
+
+import pathway_tpu as pw
+import pathway_tpu.internals.schema as sch
+from pathway_tpu.stdlib.indexing import default_brute_force_knn_document_index
+
+docs = pw.io.fs.read("./docs", format="json", mode="streaming",
+                     schema=sch.schema_from_types(doc=str))
+data = docs.select(vec=pw.apply_with_type(
+    lambda d: np.zeros(16, dtype=np.float32), np.ndarray, docs.doc))
+# seeded misconfiguration: 1001 is not divisible by the 8-way data axis
+index = default_brute_force_knn_document_index(
+    data.vec, data, dimensions=16, reserved_space=1001, mesh="auto")
+hits = index.query_as_of_now(data.vec, number_of_matches=1)
+pw.io.subscribe(hits, lambda *a, **k: None)

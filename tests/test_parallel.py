@@ -35,6 +35,62 @@ def test_mesh_shapes(mesh8, mesh42):
     assert mesh42.shape["data"] == 4 and mesh42.shape["model"] == 2
 
 
+# ---------------------------------------------------------------------------
+# shard_map version shim: BOTH branches must keep working so a jax upgrade
+# cannot silently break the fallback (new jax: top-level jax.shard_map with
+# check_vma; old jax: jax.experimental.shard_map with check_rep)
+# ---------------------------------------------------------------------------
+
+def test_shard_map_shim_new_api_branch(monkeypatch, mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    import pathway_tpu.parallel.mesh as mesh_mod
+
+    seen = {}
+
+    def fake_shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+        seen["kwargs"] = kwargs
+        seen["mesh"] = mesh
+        return f
+
+    monkeypatch.setattr(jax, "shard_map", fake_shard_map, raising=False)
+    marker = lambda x: x  # noqa: E731
+    out = mesh_mod.shard_map(marker, mesh=mesh8, in_specs=(P("data"),),
+                             out_specs=P("data"), check_vma=False)
+    assert out is marker
+    assert seen["kwargs"] == {"check_vma": False}
+    assert seen["mesh"] is mesh8
+
+
+def test_shard_map_shim_fallback_branch(monkeypatch, mesh8):
+    import sys
+    import types
+
+    from jax.sharding import PartitionSpec as P
+
+    import pathway_tpu.parallel.mesh as mesh_mod
+
+    seen = {}
+
+    def fake_shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+        seen["kwargs"] = kwargs
+        return f
+
+    # force hasattr(jax, "shard_map") False so the shim takes the legacy
+    # path, and resolve jax.experimental.shard_map to a recorder module
+    # regardless of what the installed jax ships
+    monkeypatch.delattr(jax, "shard_map", raising=False)
+    stub = types.ModuleType("jax.experimental.shard_map")
+    stub.shard_map = fake_shard_map
+    monkeypatch.setitem(sys.modules, "jax.experimental.shard_map", stub)
+    marker = lambda x: x  # noqa: E731
+    out = mesh_mod.shard_map(marker, mesh=mesh8, in_specs=(P("data"),),
+                             out_specs=P("data"), check_vma=True)
+    assert out is marker
+    # the flag must arrive under its legacy spelling
+    assert seen["kwargs"] == {"check_rep": True}
+
+
 def _brute_force_knn(vectors, keys, query, k):
     d = ((vectors - query[None, :]) ** 2).sum(axis=1)
     order = np.argsort(d, kind="stable")[:k]
