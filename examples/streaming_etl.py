@@ -1,11 +1,13 @@
 """Streaming ETL template (reference: the WordCount / Kafka-ETL templates,
 docs/2.developers/7.templates): tail a directory of JSONLines order events,
 join against a dimension file, aggregate revenue per category with a
-sliding window, and stream results to CSV — with live dashboard and
-Prometheus /metrics.
+sliding window, and stream results to CSV — with live dashboard,
+Prometheus /metrics + /healthz, and supervised connectors (retry with
+capped-jittered backoff; degrade instead of crash unless --strict).
 
 Run:
-    python examples/streaming_etl.py ./orders ./categories.csv ./out.csv
+    python examples/streaming_etl.py ./orders ./categories.csv ./out.csv \
+        [--max-retries 5] [--strict]
 """
 
 from __future__ import annotations
@@ -27,10 +29,20 @@ class Category(pw.Schema):
     category: str
 
 
-def build(orders_dir: str, categories_csv: str, out_csv: str) -> None:
+def build(orders_dir: str, categories_csv: str, out_csv: str,
+          max_retries: int = 5) -> None:
     """Construct the ETL graph (no execution — `pw.run` happens in main)."""
+    # a flaky order feed is retried with capped, jittered backoff before
+    # the failure escalates (README "Fault tolerance")
+    orders_policy = pw.ConnectorPolicy(
+        max_retries=max_retries,
+        retry_strategy=pw.ExponentialBackoffRetryStrategy(
+            initial_delay_ms=500, backoff_factor=2.0, max_delay_ms=15_000,
+            jitter=True),
+        connect_timeout=60.0)
     orders = pw.io.fs.read(orders_dir, format="json", schema=Order,
-                           mode="streaming")
+                           mode="streaming",
+                           connector_policy=orders_policy)
     cats = pw.io.fs.read(categories_csv, format="csv",
                          schema=Category, mode="static")
 
@@ -53,10 +65,21 @@ def main():
     ap.add_argument("orders_dir")
     ap.add_argument("categories_csv")
     ap.add_argument("out_csv")
+    ap.add_argument("--max-retries", type=int, default=5,
+                    help="order-feed restarts before escalation")
+    ap.add_argument("--strict", action="store_true",
+                    help="terminate (and re-raise) when a connector's "
+                         "retries are exhausted instead of serving "
+                         "degraded")
     args = ap.parse_args()
 
-    build(args.orders_dir, args.categories_csv, args.out_csv)
-    pw.run(monitoring_level=pw.MonitoringLevel.ALL, with_http_server=True)
+    build(args.orders_dir, args.categories_csv, args.out_csv,
+          max_retries=args.max_retries)
+    # non-strict mode keeps serving on a permanently-failed feed; the
+    # degradation is visible on /healthz (503) and in /metrics
+    pw.run(monitoring_level=pw.MonitoringLevel.ALL, with_http_server=True,
+           terminate_on_error=args.strict,
+           watchdog=pw.WatchdogConfig(tick_deadline_s=30.0))
 
 
 if __name__ == "__main__":

@@ -106,6 +106,11 @@ from pathway_tpu.internals.interactive import (  # noqa: E402
 from pathway_tpu.internals.sql import sql  # noqa: E402
 from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
 from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
+from pathway_tpu.engine.supervisor import (  # noqa: E402
+    ConnectorPolicy,
+    ConnectorStalledError,
+    WatchdogConfig,
+)
 from pathway_tpu.internals.config import set_license_key  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.internals.compat import (  # noqa: E402

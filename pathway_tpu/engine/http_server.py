@@ -49,6 +49,36 @@ class MonitoringHttpServer:
             "operators": operators,
         }
 
+    def healthz_payload(self) -> tuple[bool, dict]:
+        """(healthy, body) for ``/healthz``: 200 while every supervised
+        source is live and the commit loop ticks; 503 with a body naming
+        failed/stalled sources and retry counts once degraded (contract in
+        README "Fault tolerance")."""
+        sup = getattr(self.runtime, "supervisor", None)
+        failed: list[dict] = []
+        stalled: list[str] = []
+        retries: dict[str, int] = {}
+        commit_stalled = False
+        healthy = True
+        if sup is not None:
+            healthy = sup.healthy()  # the supervisor owns the predicate
+            commit_stalled = sup.commit_stalled
+            for s in sup.summary():
+                retries[s["source"]] = s["restarts"]
+                if s["state"] == "failed":
+                    failed.append({"source": s["source"],
+                                   "error": s["error"],
+                                   "restarts": s["restarts"]})
+                if s["stalled"]:
+                    stalled.append(s["source"])
+        return healthy, {
+            "status": "healthy" if healthy else "degraded",
+            "failed_sources": failed,
+            "stalled_sources": stalled,
+            "commit_loop_stalled": commit_stalled,
+            "connector_retries": retries,
+        }
+
     def metrics_payload(self) -> str:
         # OpenMetrics text format, one family per counter kind
         # (reference exposes input/output latency gauges + process metrics).
@@ -72,6 +102,20 @@ class MonitoringHttpServer:
                 f"pathway_tpu_operator_latency_ms{labels} {op['latency_ms']}")
             lines.append(
                 f"pathway_tpu_operator_total_ms{labels} {op['total_ms']}")
+        sup = getattr(self.runtime, "supervisor", None)
+        if sup is not None and sup.entries:
+            # connector supervision counters (engine/supervisor.py):
+            # restarts performed and a failed flag per source — the alerting
+            # surface for degraded-but-serving pipelines
+            lines.append("# TYPE pathway_tpu_connector_restarts counter")
+            lines.append("# TYPE pathway_tpu_connector_failed gauge")
+            for s in sup.summary():
+                labels = f'{{source="{esc(s["source"])}"}}'
+                lines.append(
+                    f"pathway_tpu_connector_restarts{labels} {s['restarts']}")
+                failed = 1 if s["state"] == "failed" else 0
+                lines.append(
+                    f"pathway_tpu_connector_failed{labels} {failed}")
         try:
             import resource
 
@@ -89,17 +133,23 @@ class MonitoringHttpServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
+                code = 200
                 if self.path.rstrip("/") in ("", "/status"):
                     body = json.dumps(server.status_payload()).encode()
                     ctype = "application/json"
                 elif self.path.rstrip("/") == "/metrics":
                     body = server.metrics_payload().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.rstrip("/") == "/healthz":
+                    healthy, payload = server.healthz_payload()
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                    code = 200 if healthy else 503
                 else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

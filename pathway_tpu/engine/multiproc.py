@@ -19,6 +19,7 @@ mapping).
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import threading
@@ -27,6 +28,9 @@ from multiprocessing.connection import Client, Connection, Listener
 from typing import Any
 
 from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.testing import faults
+
+logger = logging.getLogger(__name__)
 
 _ENTS = "__pw_ents__"
 
@@ -134,17 +138,23 @@ class Cluster:
             self.peers.update(accepted)
 
     def close(self) -> None:
-        for conn in self.peers.values():
+        # teardown failures are logged (debug, with the peer id), never
+        # swallowed silently — a wedged close is how a half-dead cluster
+        # teardown stays diagnosable
+        for peer, conn in self.peers.items():
             try:
                 conn.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug(
+                    "process %d: closing connection to peer %d failed: %s",
+                    self.process_id, peer, e)
         self.peers.clear()
         if self._listener is not None:
             try:
                 self._listener.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("process %d: closing listener failed: %s",
+                             self.process_id, e)
             self._listener = None
 
     # -- bulk-synchronous messaging -----------------------------------------
@@ -157,6 +167,10 @@ class Cluster:
         """
         if not self.peers:
             return {}
+        # fault point: a test arms a Delay here to simulate a peer holding
+        # up a tick exchange (the commit-loop stall the watchdog reports)
+        faults.hit("cluster.exchange.delay", tag=tag,
+                   process_id=self.process_id)
         err: list[BaseException] = []
         st = self.stats
         st["rounds"] += 1

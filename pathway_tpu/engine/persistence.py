@@ -37,6 +37,8 @@ import struct
 import zlib
 from typing import Any
 
+from pathway_tpu.testing import faults
+
 _HDR = struct.Struct("<QI")  # payload length, CRC32(payload)
 _MAGIC = b"PWSNAP01"  # format marker; bump the digit on layout changes
 
@@ -145,8 +147,14 @@ class SnapshotLog:
             if valid == 0:
                 self._f.write(_MAGIC)
         payload = pickle.dumps((time, entries), protocol=pickle.HIGHEST_PROTOCOL)
-        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)) + payload)
+        faults.hit("persistence.append", path=self.path, time=time)
+        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+        # fault point between header and payload: an armed action aborts
+        # here leaving exactly the torn-tail record _scan must drop
+        faults.hit("persistence.append.torn", path=self.path, time=time)
+        self._f.write(payload)
         self._f.flush()
+        faults.hit("persistence.fsync", path=self.path, time=time)
         os.fsync(self._f.fileno())
 
     def close(self) -> None:

@@ -27,7 +27,11 @@ _ACTIVE_RUNTIMES: "weakref.WeakSet[StreamingRuntime]" = weakref.WeakSet()
 
 def stop_all(join_timeout: float = 5.0) -> None:
     """Request stop on every live StreamingRuntime and join their reader
-    threads. Safe to call from any thread; idempotent."""
+    threads; also stops static-mode connectors sleeping between polls
+    (CollectSession). Safe to call from any thread; idempotent."""
+    from pathway_tpu.io._datasource import stop_collect_sessions
+
+    stop_collect_sessions()
     for rt in list(_ACTIVE_RUNTIMES):
         rt.stop()
     for rt in list(_ACTIVE_RUNTIMES):
@@ -38,7 +42,8 @@ class StreamingRuntime:
     def __init__(self, runner, *, monitoring_level=None, with_http_server=False,
                  persistence_config=None, terminate_on_error=True,
                  default_commit_ms: int = 100, n_workers: int | None = None,
-                 cluster=None):
+                 cluster=None, connector_policy=None, watchdog=None):
+        from pathway_tpu.engine.supervisor import ConnectorSupervisor
         from pathway_tpu.io._datasource import Session
 
         if n_workers is None:
@@ -50,10 +55,21 @@ class StreamingRuntime:
         self.scheduler = Scheduler(runner.graph, n_workers=n_workers,
                                    cluster=cluster)
         self.sessions = []
-        self.threads = []
         self.default_commit_ms = default_commit_ms
         self._stop = threading.Event()
         self.monitor = StatsMonitor(monitoring_level or MonitoringLevel.NONE)
+        # supervision: reader threads are owned by the supervisor, which
+        # restarts crashed readers per policy and escalates per
+        # terminate_on_error (engine/supervisor.py)
+        self.supervisor = ConnectorSupervisor(
+            terminate_on_error=terminate_on_error,
+            default_policy=connector_policy)
+        self.monitor.set_supervisor(self.supervisor)
+        self.watchdog_config = watchdog
+        self.watchdog = None
+        # stamped by the commit loop each iteration; the watchdog measures
+        # tick progress against this
+        self.last_tick_at = _time.monotonic()
         self.persistence = None
         if persistence_config is not None and persistence_config.backend is not None:
             from pathway_tpu.engine.persistence import PersistenceDriver
@@ -71,6 +87,7 @@ class StreamingRuntime:
 
     def stop(self) -> None:
         self._stop.set()
+        self.supervisor.request_stop()
         for _node, session, _ds in self.sessions:
             session.stopping.set()
 
@@ -78,7 +95,7 @@ class StreamingRuntime:
         """Join connector threads after stop(); they observe the session's
         stop event between polls (Session.sleep / stop_requested)."""
         deadline = _time.monotonic() + timeout
-        for t in self.threads:
+        for t in self.supervisor.all_threads():
             t.join(max(0.0, deadline - _time.monotonic()))
 
     def _drain_and_forward(self):
@@ -145,7 +162,9 @@ class StreamingRuntime:
                 # process 0 forwards this process's shard every tick
                 session.close()
             else:
-                self.threads.append(datasource.start(live_session))
+                self.supervisor.add_source(node, datasource, session,
+                                           live_session)
+        self.supervisor.start_all()
         if self.http_server is not None:
             self.http_server.start()
 
@@ -172,9 +191,33 @@ class StreamingRuntime:
              for s in self.sessions] + [self.default_commit_ms]
         ) / 1000.0
 
+        from pathway_tpu.engine.supervisor import Watchdog
+
+        self.watchdog = Watchdog(self, self.supervisor, self.watchdog_config)
+        self.watchdog.start()
         try:
             while not self._stop.is_set():
                 _time.sleep(commit_s)
+                self.last_tick_at = _time.monotonic()
+                # supervision tick: observe crashed/stalled readers, fire
+                # scheduled backoff restarts, escalate exhausted retries
+                if self.supervisor.poll() is not None:
+                    if self.cluster is None:
+                        break
+                    # under a cluster, breaking out here would strand the
+                    # peers mid-exchange (they block in Cluster.exchange
+                    # until the recv timeout, then misreport a hung peer).
+                    # Instead stop the local readers, close every local
+                    # session with the error, and fall through: the normal
+                    # tick merge sees all_closed on every process and the
+                    # whole cluster leaves through the same lockstep
+                    # end-of-stream path; the fatal re-raise below still
+                    # fires on this process after teardown.
+                    self.supervisor.request_stop()
+                    for _node, session, _ds in self.sessions:
+                        session.stopping.set()
+                        session.close(reason="error",
+                                      error=self.supervisor.fatal_error)
                 any_data, all_closed, pushes = self._drain_and_forward()
                 any_data, all_closed = self._tick_sync(
                     time_counter, any_data, all_closed, pushes)
@@ -185,6 +228,11 @@ class StreamingRuntime:
                 # ticks are near-free and drive as-of-now retractions)
                 if self.cluster is None or any_data:
                     self.scheduler.run_time(time_counter)
+                    # stamp after the step too: a long (healthy) batch
+                    # counts as progress the moment it completes, so only
+                    # a single step exceeding the deadline can ever be
+                    # reported as a stall
+                    self.last_tick_at = _time.monotonic()
                     self.monitor.update(self.scheduler, self.runner.graph,
                                         time_counter)
                     if self.persistence is not None:
@@ -211,6 +259,8 @@ class StreamingRuntime:
             # teardown: stop reader threads FIRST so nothing pushes into a
             # closed pipeline, then join them (a reader that ignores the
             # stop event is a bug the thread-leak test fixture catches)
+            self.watchdog.stop()
+            self.supervisor.request_stop()
             for _node, session, _ds in self.sessions:
                 session.stopping.set()
             self.join_readers()
@@ -221,3 +271,9 @@ class StreamingRuntime:
                 self.persistence.close()
             if self.http_server is not None:
                 self.http_server.stop()
+        fatal = self.supervisor.fatal_error
+        if fatal is not None:
+            # escalation under terminate_on_error=True: surface the
+            # connector's own exception (its reader-thread traceback is
+            # attached) from pw.run, after a full clean teardown
+            raise fatal

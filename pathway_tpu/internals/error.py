@@ -41,17 +41,29 @@ def is_error(value) -> bool:
 
 
 class ErrorLog:
-    """Collects (message, operator_name) error rows for the run."""
+    """Collects (message, operator_name) error rows for the run.
+
+    ``kind`` partitions the log: ``"runtime"`` for poisoned-cell operator
+    errors, ``"connector"`` for supervised-source failures escalated by the
+    streaming runtime with ``terminate_on_error=False`` — the channel that
+    keeps a dropped source visible after the run reports completion."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.entries: list[dict] = []
 
-    def log(self, message: str, operator: str = "", trace=None) -> None:
+    def log(self, message: str, operator: str = "", trace=None,
+            kind: str = "runtime") -> None:
         with self._lock:
             self.entries.append(
-                {"message": message, "operator": operator, "trace": trace}
+                {"message": message, "operator": operator, "trace": trace,
+                 "kind": kind}
             )
+
+    def connector_failures(self) -> list[dict]:
+        """Entries logged by the connector supervisor (failed sources)."""
+        with self._lock:
+            return [e for e in self.entries if e["kind"] == "connector"]
 
 
 _global_log = ErrorLog()

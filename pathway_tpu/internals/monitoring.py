@@ -55,10 +55,16 @@ class StatsMonitor:
         self._live = None
         self._rows: list[tuple] = []
         self._t0 = time.monotonic()
+        # connector supervision state (engine/supervisor.py) rendered as a
+        # second panel: per-source lifecycle, restart counts, last error
+        self.supervisor = None
         self._log = _LogBuffer()
         self._log.setFormatter(logging.Formatter("%(levelname)s %(message)s"))
         if self.enabled():
             logging.getLogger().addHandler(self._log)
+
+    def set_supervisor(self, supervisor) -> None:
+        self.supervisor = supervisor
 
     def enabled(self) -> bool:
         if self.level == MonitoringLevel.NONE:
@@ -107,10 +113,31 @@ class StatsMonitor:
         for name, ins, rets, lat, tot in self._rows:
             table.add_row(name, str(ins), str(rets), f"{lat:.2f}",
                           f"{tot:.0f}")
+        parts = [table]
+        sup_lines = self._supervisor_lines()
+        if sup_lines:
+            parts.append(Panel("\n".join(sup_lines), title="connectors",
+                               height=None))
         if self._log.records:
-            return Group(table, Panel("\n".join(self._log.records),
-                                      title="log", height=None))
-        return table
+            parts.append(Panel("\n".join(self._log.records), title="log",
+                               height=None))
+        return parts[0] if len(parts) == 1 else Group(*parts)
+
+    def _supervisor_lines(self) -> list[str]:
+        if self.supervisor is None:
+            return []
+        lines = []
+        for s in self.supervisor.summary():
+            line = (f"{s['source']}: {s['state']}  rows={s['forwarded']}  "
+                    f"restarts={s['restarts']}")
+            if s["stalled"]:
+                line += "  STALLED"
+            if s["error"]:
+                line += f"  last_error={s['error']}"
+            lines.append(line)
+        if self.supervisor.commit_stalled:
+            lines.append("COMMIT LOOP STALLED (watchdog)")
+        return lines
 
     def _render(self, now_time: int) -> None:
         try:
@@ -128,6 +155,8 @@ class StatsMonitor:
             for name, ins, rets, lat, tot in self._rows:
                 print(f"[monitor] {name}: +{ins} -{rets} {lat:.2f}ms",
                       file=sys.stderr)
+            for line in self._supervisor_lines():
+                print(f"[monitor] {line}", file=sys.stderr)
 
     def close(self) -> None:
         if self._live is not None:

@@ -13,12 +13,21 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
         default_logging: bool = True, persistence_config=None,
         runtime_typechecking: bool | None = None, terminate_on_error: bool = True,
         telemetry_config=None, static_check: str | None = None,
+        connector_policy=None, watchdog=None,
         **kwargs) -> Any:
     """Build the engine graph from all registered outputs and run it.
 
     Static-only graphs run in batch mode to completion; graphs with streaming
     sources enter the realtime microbatch loop (pathway_tpu/engine/streaming.py)
     until all sources finish or the process is stopped.
+
+    ``connector_policy`` is the default :class:`pw.ConnectorPolicy`
+    (retry/backoff/escalation) applied to streaming sources that did not
+    pick their own; ``watchdog`` a :class:`pw.WatchdogConfig` tuning stall
+    detection (engine/supervisor.py). With ``terminate_on_error=True`` a
+    connector whose retries are exhausted stops the runtime and its
+    exception re-raises from here; with ``False`` the failure lands in the
+    global error log and the rest of the pipeline keeps serving.
 
     ``static_check`` runs the pre-execution analyzer
     (internals/static_check/) over the collected plan DAG first:
@@ -35,7 +44,8 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
 
     if persistence_config is None:
         persistence_config = _persistence_config_from_env()
-    _run_static_check(static_check, persistence_config)
+    _run_static_check(static_check, persistence_config, terminate_on_error,
+                      connector_policy)
 
     cfg = get_pathway_config()
     cluster = None
@@ -71,6 +81,7 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
                     with_http_server=with_http_server,
                     persistence_config=persistence_config,
                     terminate_on_error=terminate_on_error,
+                    connector_policy=connector_policy, watchdog=watchdog,
                     cluster=cluster)
                 telemetry.register_scheduler_gauges(rt.scheduler,
                                                     runner.graph)
@@ -86,7 +97,9 @@ def run_all(**kwargs):
     return run(**kwargs)
 
 
-def _run_static_check(mode: str | None, persistence_config) -> None:
+def _run_static_check(mode: str | None, persistence_config,
+                      terminate_on_error: bool | None = None,
+                      connector_policy=None) -> None:
     """Opt-in pre-execution analysis gate for pw.run."""
     import os
 
@@ -104,7 +117,9 @@ def _run_static_check(mode: str | None, persistence_config) -> None:
 
     diagnostics = analyze(
         graph=G, persisted=persistence_config is not None,
-        mesh=os.environ.get("PATHWAY_STATIC_CHECK_MESH") or None)
+        mesh=os.environ.get("PATHWAY_STATIC_CHECK_MESH") or None,
+        terminate_on_error=terminate_on_error,
+        connector_policy=connector_policy)
     if not diagnostics:
         return
     log = logging.getLogger("pathway_tpu.static_check")

@@ -48,7 +48,9 @@ __all__ = [
 
 
 def static_check(*tables, persistence: bool | None = None,
-                 graph=None, mesh=None) -> list[Diagnostic]:
+                 graph=None, mesh=None,
+                 terminate_on_error: bool | None = None,
+                 connector_policy=None) -> list[Diagnostic]:
     """Statically validate the pipeline and return its diagnostics.
 
     With explicit ``tables``, those tables count as intended outputs (their
@@ -77,4 +79,5 @@ def static_check(*tables, persistence: bool | None = None,
     if mesh is None:
         mesh = os.environ.get("PATHWAY_STATIC_CHECK_MESH") or None
     return analyze(tables, graph=graph, persisted=bool(persistence),
-                   mesh=mesh)
+                   mesh=mesh, terminate_on_error=terminate_on_error,
+                   connector_policy=connector_policy)

@@ -49,7 +49,9 @@ class _Node:
 
 
 class Analyzer:
-    def __init__(self, *, graph=None, persisted: bool = False, mesh=None):
+    def __init__(self, *, graph=None, persisted: bool = False, mesh=None,
+                 terminate_on_error: bool | None = None,
+                 connector_policy=None):
         if graph is None:
             from pathway_tpu.internals.parse_graph import G as graph
         from pathway_tpu.internals.static_check.shard_check import \
@@ -57,6 +59,12 @@ class Analyzer:
 
         self.graph = graph
         self.persisted = persisted
+        # the run's escalation mode, when known (pw.run passes its
+        # terminate_on_error; the CLI does not know it → None disarms the
+        # failure-policy check PWT012 rather than guessing), and the
+        # run-wide default ConnectorPolicy applied to sources without one
+        self.terminate_on_error = terminate_on_error
+        self.connector_policy = connector_policy
         # topology under analysis for the PWT1xx sharding family; None
         # skips the mesh-dependent checks (UDF/placement checks still run).
         # A malformed spec (e.g. a typo'd PATHWAY_STATIC_CHECK_MESH) must
@@ -426,6 +434,7 @@ class Analyzer:
                 # a static read terminates on its own; if it feeds nothing,
                 # the dead-dataflow check (PWT004) already reports it
                 continue
+            self._check_failure_policy(node, source)
             if not roots:
                 self._report(
                     "PWT005",
@@ -439,6 +448,27 @@ class Analyzer:
                     f"streaming source {node.table._name!r} never reaches "
                     f"a sink",
                     node)
+
+    def _check_failure_policy(self, node: _Node, source) -> None:
+        """PWT012: no retries AND no escalation — the one policy square
+        where a reader crash neither restarts nor stops the run, so the
+        source silently drops out while the pipeline reports progress."""
+        if self.terminate_on_error is not False:
+            return  # escalation (or an unknown run mode) covers the crash
+        # the effective policy mirrors the supervisor's resolution: the
+        # source's own, else the run-wide default; the supervisor's
+        # built-in default retries, so no-policy-anywhere is safe
+        policy = getattr(source, "connector_policy", None) \
+            or self.connector_policy
+        if policy is None or getattr(policy, "max_retries", None) != 0:
+            return
+        self._report(
+            "PWT012",
+            f"streaming source {node.table._name!r} has max_retries=0 and "
+            f"the run uses terminate_on_error=False: a reader crash would "
+            f"neither restart nor stop the run — the source is silently "
+            f"dropped (give it retries, or let the failure terminate)",
+            node)
 
     def _check_sinks(self) -> None:
         for binding in self.graph.outputs:
@@ -536,8 +566,15 @@ def _format_incompatibility(format: str | None, col_t: dt.DType) -> str | None:
 
 
 def analyze(tables: Iterable = (), *, graph=None, persisted: bool = False,
-            mesh=None) -> list[Diagnostic]:
+            mesh=None, terminate_on_error: bool | None = None,
+            connector_policy=None) -> list[Diagnostic]:
     """Run every static check; see :class:`Analyzer`. ``mesh`` arms the
     mesh-dependent sharding checks against a real or hypothetical
-    topology (``"4x2"``, a MeshSpec/MeshConfig, or a jax Mesh)."""
-    return Analyzer(graph=graph, persisted=persisted, mesh=mesh).run(tables)
+    topology (``"4x2"``, a MeshSpec/MeshConfig, or a jax Mesh);
+    ``terminate_on_error`` (the run's escalation mode, when known) arms
+    the connector failure-policy check (PWT012), with
+    ``connector_policy`` as the run-wide default for sources that set
+    none of their own."""
+    return Analyzer(graph=graph, persisted=persisted, mesh=mesh,
+                    terminate_on_error=terminate_on_error,
+                    connector_policy=connector_policy).run(tables)

@@ -51,6 +51,10 @@ CODES: dict[str, tuple[Severity, str]] = {
                "redundant cast: expression already has the target dtype"),
     "PWT011": (Severity.ERROR,
                "ix key expression is not a pointer type"),
+    "PWT012": (Severity.WARNING,
+               "streaming source with max_retries=0 under "
+               "terminate_on_error=False: a crash silently drops the "
+               "source"),
     # -- PWT1xx: sharding / placement (static_check/shard_check.py) --------
     "PWT101": (Severity.ERROR,
                "mesh axis sizes do not fit the device count"),

@@ -23,50 +23,17 @@ from pathway_tpu.internals import expression as ex
 
 
 # ---------------------------------------------------------------------------
-# retry strategies (reference: udfs/retries.py)
+# retry strategies — one shared implementation with connector supervision
+# (internals/retries.py; reference: udfs/retries.py). Re-exported here so
+# ``pw.udfs.FixedDelayRetryStrategy`` et al. keep their historical home.
 # ---------------------------------------------------------------------------
 
-class AsyncRetryStrategy:
-    async def invoke(self, fn: Callable, /, *args, **kwargs):
-        raise NotImplementedError
-
-
-class NoRetryStrategy(AsyncRetryStrategy):
-    async def invoke(self, fn, /, *args, **kwargs):
-        return await fn(*args, **kwargs)
-
-
-class FixedDelayRetryStrategy(AsyncRetryStrategy):
-    def __init__(self, max_retries: int = 3, delay_ms: int = 1000):
-        self.max_retries = max_retries
-        self.delay_ms = delay_ms
-
-    def _next_delay(self, delay: float) -> float:
-        return delay
-
-    async def invoke(self, fn, /, *args, **kwargs):
-        delay = self.delay_ms / 1000
-        for attempt in range(self.max_retries + 1):
-            try:
-                return await fn(*args, **kwargs)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                if attempt == self.max_retries:
-                    raise
-                await asyncio.sleep(delay)
-                delay = self._next_delay(delay)
-        raise RuntimeError("unreachable")
-
-
-class ExponentialBackoffRetryStrategy(FixedDelayRetryStrategy):
-    def __init__(self, max_retries: int = 3, initial_delay_ms: int = 1000,
-                 backoff_factor: float = 2.0):
-        super().__init__(max_retries, initial_delay_ms)
-        self.backoff_factor = backoff_factor
-
-    def _next_delay(self, delay: float) -> float:
-        return delay * self.backoff_factor
+from pathway_tpu.internals.retries import (  # noqa: F401
+    AsyncRetryStrategy,
+    ExponentialBackoffRetryStrategy,
+    FixedDelayRetryStrategy,
+    NoRetryStrategy,
+)
 
 
 # ---------------------------------------------------------------------------

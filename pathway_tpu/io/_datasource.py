@@ -12,6 +12,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import weakref
 from typing import Any, Callable
 
 from pathway_tpu.internals import dtype as dt
@@ -27,6 +28,12 @@ class Session:
     def __init__(self):
         self._q: queue.Queue = queue.Queue()
         self.closed = threading.Event()
+        # terminal state: a session closed by a crashing reader is NOT
+        # end-of-stream (reference: the main loop observes connector thread
+        # death, src/connectors/mod.rs) — the supervisor inspects the reason
+        # to decide between finishing, restarting, and escalating
+        self.closed_reason: str | None = None  # "eos" | "error"
+        self.error: BaseException | None = None
         # set by the runtime at teardown; polling sources observe it via
         # stop_requested / sleep() so reader threads actually terminate
         # (reference: connector threads exit when the main loop drops the
@@ -57,7 +64,11 @@ class Session:
             except queue.Empty:
                 return out
 
-    def close(self) -> None:
+    def close(self, reason: str = "eos",
+              error: BaseException | None = None) -> None:
+        if not self.closed.is_set():  # first close wins
+            self.closed_reason = reason
+            self.error = error
         self.closed.set()
 
 
@@ -65,6 +76,16 @@ class DataSource:
     """Base class: subclasses implement run(session) on a worker thread."""
 
     name = "datasource"
+    # restart/escalation policy (engine/supervisor.py ConnectorPolicy);
+    # None means the runtime's default policy applies
+    connector_policy = None
+    # restart semantics for the supervisor's in-process restarts: False
+    # (default) = a restarted run() re-emits the stream from the start, so
+    # the supervisor skips the already-delivered prefix; True = run()
+    # resumes from externally-tracked offsets (e.g. a Kafka consumer
+    # group), so nothing already delivered is re-emitted and nothing may
+    # be skipped
+    restart_resumes = False
 
     def __init__(self, schema: type[sch.Schema],
                  autocommit_duration_ms: int | None = 1500):
@@ -74,10 +95,15 @@ class DataSource:
 
     def start(self, session: Session) -> threading.Thread:
         def runner():
+            # capture the exception instead of swallowing it: a crashed
+            # reader closing its session as if end-of-stream would let the
+            # runtime flush, checkpoint, and report success on partial data
             try:
                 self.run(session)
-            finally:
-                session.close()
+            except BaseException as e:
+                session.close(reason="error", error=e)
+            else:
+                session.close(reason="eos")
 
         t = threading.Thread(target=runner, daemon=True,
                              name=f"pathway-tpu-src-{self.name}-{self._uid}")
@@ -102,22 +128,52 @@ class DataSource:
         return key, row
 
 
+def apply_connector_policy(source: DataSource, kwargs: dict,
+                           policy=None) -> DataSource:
+    """Attach the ``connector_policy=`` kwarg every connector ``read()``
+    documents (README "Fault tolerance") to its DataSource. Central so a
+    policy passed to a connector whose signature absorbs it into
+    ``**kwargs`` is honored, never silently swallowed."""
+    if policy is None:
+        policy = kwargs.pop("connector_policy", None)
+    if policy is not None:
+        source.connector_policy = policy
+    return source
+
+
+# live CollectSessions (weak: dies with the read that created it) —
+# engine.streaming.stop_all() stops these too, so a static-mode connector
+# sleeping between polls cannot outlive a process-level teardown
+_LIVE_COLLECT_SESSIONS: "weakref.WeakSet[CollectSession]" = weakref.WeakSet()
+
+
+def stop_collect_sessions() -> None:
+    """Request stop on every live CollectSession (teardown path, called
+    from engine.streaming.stop_all)."""
+    for cs in list(_LIVE_COLLECT_SESSIONS):
+        cs.stopping.set()
+
+
 class CollectSession:
     """Session double folding pushed diffs into final state — shared by
     connectors' static modes (debezium, deltalake, pyfilesystem)."""
 
     closed = False
-    stop_requested = False
 
     def __init__(self):
         self.state: dict = {}
         self.counts: dict = {}
+        # honored by sleep()/stop_requested so a static-mode connector
+        # polling through this double cannot outlive teardown
+        self.stopping = threading.Event()
+        _LIVE_COLLECT_SESSIONS.add(self)
+
+    @property
+    def stop_requested(self) -> bool:
+        return self.stopping.is_set()
 
     def sleep(self, seconds: float) -> bool:
-        import time
-
-        time.sleep(seconds)
-        return True
+        return not self.stopping.wait(seconds)
 
     def push(self, key, row, diff=1, offset=None):
         c = self.counts.get(key, 0) + diff

@@ -29,7 +29,8 @@ from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.schema import schema_from_types
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                         apply_connector_policy)
 
 INCREMENTAL_SYNC_MODE = "incremental"
 METHOD_PYPI = "pypi"
@@ -270,7 +271,8 @@ def read(config_file_path: os.PathLike | str,
          enforce_method: str | None = None,
          refresh_interval_ms: int = 60000,
          name: str | None = None,
-         persistent_id: str | None = None) -> Table:
+         persistent_id: str | None = None,
+         connector_policy=None) -> Table:
     """Stream records from an Airbyte connector (reference signature,
     io/airbyte/__init__.py:97-109). The yaml config's ``source`` section
     carries ``docker_image`` (docker method), or a connector whose
@@ -315,6 +317,7 @@ def read(config_file_path: os.PathLike | str,
                      name=name or "airbyte_static")
     source = AirbyteSource(schema, protocol, mode, refresh_interval_ms)
     source.persistent_id = persistent_id or name
+    apply_connector_policy(source, {}, policy=connector_policy)
     return Table(Plan("input", datasource=source), schema, Universe(),
                  name=name or "airbyte_input")
 

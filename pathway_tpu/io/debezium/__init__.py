@@ -24,7 +24,8 @@ from pathlib import Path
 from pathway_tpu.internals.keys import hash_values
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                         apply_connector_policy)
 from pathway_tpu.io.formats import (DEBEZIUM_STANDARD_SEPARATOR,
                                     DebeziumMessageParser, ParsedEvent,
                                     ParseError)
@@ -124,12 +125,14 @@ def read_from_file(path: str, *, schema, db_type: str = "postgres",
                    mode: str = "streaming",
                    autocommit_duration_ms: int | None = 1500,
                    name: str | None = None,
-                   persistent_id: str | None = None) -> Table:
+                   persistent_id: str | None = None,
+                   connector_policy=None) -> Table:
     """Replay a file of Debezium messages (one "<key><sep><value>" line per
     event) as a live CDC table (static mode folds the whole log eagerly)."""
     source = DebeziumFileSource(path, schema, db_type, separator, mode,
                                 autocommit_duration_ms=autocommit_duration_ms)
     source.persistent_id = persistent_id or name
+    apply_connector_policy(source, {}, policy=connector_policy)
     if mode == "static":
         sess = _CollectSession()
         source.run(sess)
@@ -151,6 +154,9 @@ class DebeziumKafkaSource(DataSource):
         self.settings = settings
         self.topic = topic
         self.db_type = db_type
+        # consumer-group offsets make a restarted consumer resume, not
+        # re-emit (see KafkaSource.restart_resumes)
+        self.restart_resumes = bool(settings.get("group.id"))
 
     def run(self, session: Session) -> None:
         from kafka import KafkaConsumer  # type: ignore
@@ -190,6 +196,7 @@ def read(rdkafka_settings: dict, topic_name: str, *, schema,
                                  db_type,
                                  autocommit_duration_ms=autocommit_duration_ms)
     source.persistent_id = persistent_id or name
+    apply_connector_policy(source, kwargs)
     return Table(Plan("input", datasource=source), schema, Universe(),
                  name=name or "debezium")
 

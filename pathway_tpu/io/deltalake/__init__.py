@@ -28,7 +28,8 @@ from pathlib import Path
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                         apply_connector_policy)
 
 _LOG_DIR = "_delta_log"
 
@@ -224,6 +225,7 @@ def read(uri: str, *, schema, mode: str = "streaming",
     src = DeltaLakeSource(uri, schema, mode,
                           autocommit_duration_ms=autocommit_duration_ms)
     src.persistent_id = persistent_id or name
+    apply_connector_policy(src, kwargs)
     if mode == "static":
         sess = CollectSession()
         src.run(sess)

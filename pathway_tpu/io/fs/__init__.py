@@ -20,7 +20,8 @@ from pathway_tpu.internals.keys import hash_values
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                        apply_connector_policy)
 
 
 def _list_files(path: str) -> list[Path]:
@@ -199,7 +200,7 @@ def read(path: str, *, format: str = "plaintext", schema=None,
          mode: str = "streaming", csv_settings=None, json_field_paths=None,
          with_metadata: bool = False, autocommit_duration_ms: int | None = 1500,
          name: str | None = None, persistent_id: str | None = None,
-         dsv_separator: str = ",", **kwargs) -> Table:
+         dsv_separator: str = ",", connector_policy=None, **kwargs) -> Table:
     the_schema = _schema_for(format, schema, with_metadata)
     if mode == "static":
         keys, rows = [], []
@@ -219,6 +220,7 @@ def read(path: str, *, format: str = "plaintext", schema=None,
                       autocommit_duration_ms=autocommit_duration_ms,
                       dsv_separator=dsv_separator)
     source.persistent_id = persistent_id or name
+    apply_connector_policy(source, {}, policy=connector_policy)
     return Table(Plan("input", datasource=source), the_schema, Universe(),
                  name=name or "fs_input")
 

@@ -22,7 +22,8 @@ from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                         apply_connector_policy)
 
 _FOLDER_MIME = "application/vnd.google-apps.folder"
 # Google-native docs have no binary content; export like the reference does
@@ -241,7 +242,8 @@ def read(object_id: str, *,
          endpoint: str = "https://www.googleapis.com/drive/v3",
          autocommit_duration_ms: int | None = 1500,
          name: str | None = None,
-         persistent_id: str | None = None) -> Table:
+         persistent_id: str | None = None,
+         connector_policy=None) -> Table:
     """Read a Drive file or directory (recursively) as a binary `data`
     column, re-polled every ``refresh_interval`` seconds in streaming mode
     (reference signature: io/gdrive/__init__.py:336-345)."""
@@ -269,6 +271,7 @@ def read(object_id: str, *,
         file_name_pattern=file_name_pattern,
         autocommit_duration_ms=autocommit_duration_ms)
     source.persistent_id = persistent_id or name
+    apply_connector_policy(source, {}, policy=connector_policy)
     if mode == "static":
         from pathway_tpu.io._datasource import CollectSession
 

@@ -21,7 +21,8 @@ from pathway_tpu.internals.keys import Pointer, hash_values
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                         apply_connector_policy)
 
 
 class PathwayWebserver:
@@ -361,6 +362,7 @@ def read(url: str, *, schema=None, format: str = "json",
     source = CallbackSource(gen, schema,
                             autocommit_duration_ms=autocommit_duration_ms,
                             name="http")
+    apply_connector_policy(source, kwargs)
     return Table(Plan("input", datasource=source), schema, Universe(),
                  name=name or "http_input")
 

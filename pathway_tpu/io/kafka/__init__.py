@@ -18,7 +18,8 @@ from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                         apply_connector_policy)
 
 
 def _get_client():
@@ -46,6 +47,10 @@ class KafkaSource(DataSource):
         self.topic = topic
         self.format = format
         self._resume_antichain = None
+        # with a consumer group the broker tracks our offsets: a restarted
+        # consumer resumes where the group left off instead of re-emitting
+        # — the supervisor must not prefix-skip fresh rows
+        self.restart_resumes = bool(rdkafka_settings.get("group.id"))
 
     def seek_offsets(self, antichain) -> None:
         """Persistence resume: continue each topic-partition past its
@@ -263,6 +268,7 @@ def read(rdkafka_settings: dict, topic: str | None = None, *, schema=None,
         schema = sch.schema_from_types(data=dt.BYTES)
     source = KafkaSource(rdkafka_settings, topic, format, schema,
                          autocommit_duration_ms=autocommit_duration_ms)
+    apply_connector_policy(source, kwargs)
     return Table(Plan("input", datasource=source), schema, Universe(),
                  name=name or "kafka_input")
 

@@ -19,7 +19,8 @@ from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                         apply_connector_policy)
 
 
 def _parse_uri(uri: str) -> tuple[str, int]:
@@ -203,6 +204,7 @@ def read(uri: str, topic: str, *, schema: type[sch.Schema] | None = None,
     source = NatsSource(schema, uri, topic, format,
                         autocommit_duration_ms=autocommit_duration_ms)
     source.persistent_id = persistent_id or name
+    apply_connector_policy(source, kwargs)
     return Table(Plan("input", datasource=source), schema, Universe(),
                  name=name or "nats_input")
 

@@ -21,7 +21,8 @@ from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.keys import hash_values
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                         apply_connector_policy)
 
 
 class _FsspecAdapter:
@@ -148,7 +149,8 @@ class PyFilesystemSource(DataSource):
 def read(source: Any, *, path: str = "", refresh_interval: float = 30,
          mode: str = "streaming", with_metadata: bool = False,
          name: str | None = None, persistent_id: str | None = None,
-         autocommit_duration_ms: int | None = 1500) -> Table:
+         autocommit_duration_ms: int | None = 1500,
+         connector_policy=None) -> Table:
     """Each file under ``path`` becomes one binary ``data`` row."""
     schema = sch.schema_from_types(data=dt.BYTES)
     if with_metadata:
@@ -157,6 +159,7 @@ def read(source: Any, *, path: str = "", refresh_interval: float = 30,
                              refresh_interval,
                              autocommit_duration_ms=autocommit_duration_ms)
     src.persistent_id = persistent_id or name
+    apply_connector_policy(src, {}, policy=connector_policy)
     if mode == "static":
         from pathway_tpu.io._datasource import CollectSession
 

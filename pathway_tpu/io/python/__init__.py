@@ -12,7 +12,8 @@ from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.keys import hash_values
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                        apply_connector_policy)
 
 
 class ConnectorSubject:
@@ -89,11 +90,13 @@ class PythonSource(DataSource):
 def read(subject: ConnectorSubject, *, schema: type[sch.Schema] | None = None,
          format: str = "raw", autocommit_duration_ms: int | None = 1500,
          name: str | None = None, persistent_id: str | None = None,
-         **kwargs) -> Table:
+         connector_policy=None, **kwargs) -> Table:
     if schema is None:
         schema = sch.schema_from_types(data=dt.ANY)
     source = PythonSource(subject, schema,
                           autocommit_duration_ms=autocommit_duration_ms)
     source.persistent_id = persistent_id or name
+    # per-source supervision override (engine/supervisor.py ConnectorPolicy)
+    apply_connector_policy(source, {}, policy=connector_policy)
     plan = Plan("input", datasource=source)
     return Table(plan, schema, Universe(), name=name or "python_input")

@@ -73,6 +73,7 @@ class S3Adapter:
 
 
 from pathway_tpu.io._datasource import DataSource as _DataSource
+from pathway_tpu.io._datasource import apply_connector_policy
 
 
 class S3FormatSource(_DataSource):
@@ -151,7 +152,7 @@ def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
     _REF_KWARGS = {"csv_settings", "json_field_paths", "path_filter",
                    "downloader_threads_count", "debug_data",
                    "value_columns", "id_columns", "types", "default_values",
-                   "kwargs"}
+                   "kwargs", "connector_policy"}
     unknown = set(kwargs) - _REF_KWARGS
     if unknown:
         raise TypeError(
@@ -171,7 +172,8 @@ def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
                           name=name,
                           persistent_id=persistent_id,
                           refresh_interval=refresh_interval,
-                          autocommit_duration_ms=autocommit_duration_ms)
+                          autocommit_duration_ms=autocommit_duration_ms,
+                          connector_policy=kwargs.get("connector_policy"))
         if name is None:
             table._name = "s3_input"
         return table
@@ -202,6 +204,7 @@ def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
         dsv_separator=separator,
         autocommit_duration_ms=autocommit_duration_ms)
     source.persistent_id = persistent_id or name
+    apply_connector_policy(source, kwargs)
     if mode == "static":
         from pathway_tpu.io._datasource import CollectSession
 

@@ -9,7 +9,8 @@ import time as _time
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import DataSource, Session
+from pathway_tpu.io._datasource import (DataSource, Session,
+                                         apply_connector_policy)
 
 
 class SqliteSource(DataSource):
@@ -79,6 +80,7 @@ def read(path: str, table_name: str, schema: type[sch.Schema], *,
          name=None, **kw) -> Table:
     source = SqliteSource(path, table_name, schema, mode=mode,
                           autocommit_duration_ms=autocommit_duration_ms)
+    apply_connector_policy(source, kw)
     if mode == "static":
         # run eagerly into a static plan
         rows_acc: list = []

@@ -1,0 +1,4 @@
+"""Testing utilities shipped with the package: the deterministic
+fault-injection harness (``pathway_tpu.testing.faults``) used by the
+fault-tolerance suite and available to downstream users hardening their
+own pipelines."""

@@ -1,0 +1,191 @@
+"""Deterministic fault injection for the streaming runtime.
+
+Two complementary mechanisms (reference: the wordcount kill-and-recover
+harness, integration_tests/wordcount/test_recovery.py, generalized into
+named failpoints like the reference engine's test-only error hooks):
+
+1. **Fault points** — named hooks compiled into runtime hot spots
+   (``faults.hit("persistence.fsync")`` in engine/persistence.py,
+   ``faults.hit("cluster.exchange.delay")`` in engine/multiproc.py).
+   Unarmed they are a dict lookup against an empty registry; a test arms
+   them with an action (:class:`FailNTimes`, :class:`Delay`) to inject a
+   failure at an exact, reproducible moment: an fsync that dies
+   mid-commit, a torn append, a peer that delays a tick exchange.
+
+2. **Faulty sources** — ``ConnectorSubject`` doubles with scripted crash
+   schedules (:func:`flaky_subject` raises after the Nth entry on the
+   first K attempts; :func:`hanging_subject` stops producing while
+   claiming liveness) driving the supervisor's restart/escalation/watchdog
+   paths end to end.
+
+Always ``reset()`` (or use the ``arm`` context manager) after a test —
+armed points are process-global.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Iterable
+
+
+class InjectedFault(RuntimeError):
+    """The exception raised by armed fault points and scripted sources —
+    a distinct type so tests can assert the *injected* failure surfaced,
+    not an incidental one."""
+
+
+# ---------------------------------------------------------------------------
+# fault points
+# ---------------------------------------------------------------------------
+
+_registry: dict[str, Callable] = {}
+_lock = threading.Lock()
+
+
+def hit(point: str, **ctx) -> None:
+    """Runtime-side hook: no-op unless a test armed ``point``."""
+    action = _registry.get(point)
+    if action is not None:
+        action(point, ctx)
+
+
+def arm_point(point: str, action: Callable) -> None:
+    """Arm ``point`` with ``action(point, ctx)`` — raises to inject a
+    failure, sleeps to inject a delay, or anything else."""
+    with _lock:
+        _registry[point] = action
+
+
+def disarm(point: str) -> None:
+    with _lock:
+        _registry.pop(point, None)
+
+
+def reset() -> None:
+    """Disarm every fault point (call from test teardown)."""
+    with _lock:
+        _registry.clear()
+
+
+@contextlib.contextmanager
+def arm(point: str, action: Callable):
+    """``with faults.arm("persistence.fsync", faults.FailNTimes(1)): ...``"""
+    arm_point(point, action)
+    try:
+        yield action
+    finally:
+        disarm(point)
+
+
+class FailNTimes:
+    """Raise on the first ``n`` hits, then pass (a transient failure)."""
+
+    def __init__(self, n: int = 1, exc: type[Exception] = InjectedFault):
+        self.n = n
+        self.exc = exc
+        self.hits = 0
+
+    def __call__(self, point: str, ctx: dict) -> None:
+        self.hits += 1
+        if self.hits <= self.n:
+            raise self.exc(f"injected fault at {point!r} (hit {self.hits})")
+
+
+class FailOnHit:
+    """Raise on exactly the ``k``-th hit (1-based), pass otherwise."""
+
+    def __init__(self, k: int, exc: type[Exception] = InjectedFault):
+        self.k = k
+        self.exc = exc
+        self.hits = 0
+
+    def __call__(self, point: str, ctx: dict) -> None:
+        self.hits += 1
+        if self.hits == self.k:
+            raise self.exc(f"injected fault at {point!r} (hit {self.hits})")
+
+
+class Delay:
+    """Sleep ``seconds`` on each of the first ``times`` hits (None = every
+    hit) — e.g. a cluster peer delaying a tick exchange."""
+
+    def __init__(self, seconds: float, times: int | None = None):
+        self.seconds = seconds
+        self.times = times
+        self.hits = 0
+
+    def __call__(self, point: str, ctx: dict) -> None:
+        self.hits += 1
+        if self.times is None or self.hits <= self.times:
+            time.sleep(self.seconds)
+
+
+# ---------------------------------------------------------------------------
+# scripted faulty sources (pw.io.python ConnectorSubject doubles)
+# ---------------------------------------------------------------------------
+
+def flaky_subject(rows: Iterable[dict], *, fail_after: int,
+                  fail_attempts: int = 1, delay_s: float = 0.0):
+    """A ``ConnectorSubject`` that re-emits ``rows`` from the start on each
+    (re)start attempt and, on the first ``fail_attempts`` attempts, raises
+    :class:`InjectedFault` after emitting ``fail_after`` rows. Attempt
+    ``fail_attempts`` (0-based) onward emits everything and finishes —
+    "reader raises after N entries / raises on the Kth restart" in one
+    deterministic schedule. ``fail_attempts=-1`` fails on every attempt
+    (retries can never succeed). ``delay_s`` paces emission so commit
+    ticks land between rows (exercising mid-stream checkpoints)."""
+    from pathway_tpu.io.python import ConnectorSubject
+
+    rows = list(rows)
+
+    class _Flaky(ConnectorSubject):
+        attempts = 0  # completed start attempts so far
+
+        def run(self) -> None:
+            attempt = type(self).attempts
+            type(self).attempts = attempt + 1
+            failing = fail_attempts < 0 or attempt < fail_attempts
+            for i, values in enumerate(rows):
+                if failing and i == fail_after:
+                    raise InjectedFault(
+                        f"reader crash after {fail_after} entries "
+                        f"(attempt {attempt})")
+                if delay_s:
+                    time.sleep(delay_s)
+                self.next(**values)
+            if failing and fail_after >= len(rows):
+                raise InjectedFault(
+                    f"reader crash at end of stream (attempt {attempt})")
+
+    return _Flaky()
+
+
+def hanging_subject(rows: Iterable[dict], *, hang_attempts: int = -1):
+    """A ``ConnectorSubject`` that emits ``rows`` and then hangs — thread
+    alive, session open, no pushes and no ``sleep()`` heartbeat — until
+    the runtime requests stop. The watchdog's hung-reader case. With
+    ``hang_attempts >= 0``, attempts past that count finish cleanly
+    instead (proving watchdog-triggered restart heals the pipeline)."""
+    from pathway_tpu.io.python import ConnectorSubject
+
+    rows = list(rows)
+
+    class _Hanging(ConnectorSubject):
+        attempts = 0
+
+        def run(self) -> None:
+            attempt = type(self).attempts
+            type(self).attempts = attempt + 1
+            for values in rows:
+                self.next(**values)
+            if 0 <= hang_attempts <= attempt:
+                return  # healed: finish as end-of-stream
+            # hang while claiming liveness: plain sleep, never the
+            # session's heartbeating sleep(); still honors stop so the
+            # abandoned thread exits instead of leaking
+            while not self._session.stop_requested:
+                time.sleep(0.01)
+
+    return _Hanging()

@@ -21,7 +21,8 @@ from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
-from pathway_tpu.io._datasource import CollectSession, DataSource, Session
+from pathway_tpu.io._datasource import (CollectSession, DataSource,
+                                         Session, apply_connector_policy)
 
 
 def _token_provider_from_cert(url, tenant, client_id, cert_path, thumbprint):
@@ -198,7 +199,8 @@ def read(url: str, *,
          token_provider=None,
          name: str | None = None,
          persistent_id: str | None = None,
-         autocommit_duration_ms: int | None = 1500) -> Table:
+         autocommit_duration_ms: int | None = 1500,
+         connector_policy=None) -> Table:
     """Read a SharePoint directory (recursively) or file as binary `data`
     rows (reference signature, sharepoint/__init__.py:249-262, plus the
     pluggable-auth extension)."""
@@ -225,6 +227,7 @@ def read(url: str, *,
         refresh_interval=refresh_interval,
         autocommit_duration_ms=autocommit_duration_ms)
     source.persistent_id = persistent_id or name
+    apply_connector_policy(source, {}, policy=connector_policy)
     if mode == "static":
         sess = CollectSession()
         source.run(sess)

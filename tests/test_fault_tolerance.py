@@ -1,0 +1,460 @@
+"""Supervised streaming runtime under injected faults
+(engine/supervisor.py + pathway_tpu/testing/faults.py; reference: the
+per-connector input threads whose death the main loop observes,
+src/connectors/mod.rs, and the wordcount kill-and-recover harness).
+
+Proves the acceptance contract of the supervision layer:
+- a reader that crashes mid-stream is restarted with backoff and, under
+  persistence, the final output is byte-identical to the no-fault run
+  (exactly-once across in-process restarts AND process re-runs);
+- with retries exhausted, ``terminate_on_error=True`` makes ``pw.run``
+  re-raise the connector's own exception (reader-thread traceback
+  attached) while ``terminate_on_error=False`` keeps the remaining
+  sources serving with the failure visible in the ErrorLog, ``/healthz``
+  (503) and ``/metrics``;
+- the watchdog fires on a reader that stops producing while claiming
+  liveness, and its escalation heals the pipeline when retries allow.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.retries import FixedDelayRetryStrategy
+from pathway_tpu.testing import faults
+from pathway_tpu.testing.faults import (InjectedFault, flaky_subject,
+                                        hanging_subject)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    G.clear()
+    faults.reset()
+    yield
+    G.clear()
+    faults.reset()
+
+
+def _rows(words):
+    return [{"word": w} for w in words]
+
+
+def _fast_policy(max_retries=3):
+    return pw.ConnectorPolicy(
+        max_retries=max_retries,
+        retry_strategy=FixedDelayRetryStrategy(delay_ms=20))
+
+
+def _run_counts(subject, *, backend=None, policy=None, persistent_id="words",
+                **run_kwargs) -> dict:
+    """Stream word rows from ``subject``, return final word counts."""
+    G.clear()
+    t = pw.io.python.read(
+        subject, schema=pw.schema_from_types(word=str),
+        autocommit_duration_ms=10, persistent_id=persistent_id,
+        connector_policy=policy)
+    counts = t.groupby(t.word).reduce(word=t.word, c=pw.reducers.count())
+    state: dict[str, int] = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            state[row["word"]] = row["c"]
+        elif state.get(row["word"]) == row["c"]:
+            del state[row["word"]]
+
+    pw.io.subscribe(counts, on_change)
+    cfg = None
+    if backend is not None:
+        cfg = pw.persistence.Config.simple_config(backend)
+    pw.run(persistence_config=cfg, **run_kwargs)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# crash → backoff restart → exactly-once
+# ---------------------------------------------------------------------------
+
+WORDS = ["a", "b", "a", "c", "b", "a"]
+
+
+def test_crash_restart_exactly_once_without_persistence():
+    """In-process restart must not double-deliver: the supervisor skips
+    the prefix the crashed attempt already pushed."""
+    baseline = _run_counts(flaky_subject(_rows(WORDS), fail_after=0,
+                                         fail_attempts=0))
+    assert baseline == {"a": 3, "b": 2, "c": 1}
+    subject = flaky_subject(_rows(WORDS), fail_after=3, fail_attempts=1)
+    state = _run_counts(subject, policy=_fast_policy())
+    assert state == baseline
+    assert type(subject).attempts == 2  # initial run + one restart
+
+
+def test_crash_restart_exactly_once_with_persistence_byte_identical():
+    """Two consecutive crashes, restarts under backoff, persistence
+    recording throughout: the serialized final output must be
+    byte-identical to the no-fault run's."""
+    baseline = _run_counts(flaky_subject(_rows(WORDS), fail_after=0,
+                                         fail_attempts=0))
+    backend = pw.persistence.Backend.mock()
+    subject = flaky_subject(_rows(WORDS), fail_after=3, fail_attempts=2)
+    state = _run_counts(subject, backend=backend, policy=_fast_policy())
+    assert type(subject).attempts == 3
+    as_bytes = json.dumps(sorted(state.items())).encode()
+    assert as_bytes == json.dumps(sorted(baseline.items())).encode()
+    # the durable log replays to the same state on a fresh process-run
+    replay = _run_counts(flaky_subject(_rows(WORDS), fail_after=0,
+                                       fail_attempts=0), backend=backend)
+    assert replay == baseline
+
+
+def test_double_crash_process_restarts_replay_exactly_once():
+    """Two consecutive process crashes (terminate_on_error=True raises,
+    simulating the kill), each at a different stream position, then a
+    clean run: replay+skip must hold across crash-of-a-recovery."""
+    backend = pw.persistence.Backend.mock()
+    words = ["a", "b", "a", "c"]
+    for fail_after in (2, 3):  # second crash strictly later in the stream
+        subject = flaky_subject(_rows(words), fail_after=fail_after,
+                                fail_attempts=-1, delay_s=0.03)
+        with pytest.raises(InjectedFault):
+            _run_counts(subject, backend=backend,
+                        policy=pw.ConnectorPolicy(max_retries=0),
+                        terminate_on_error=True)
+    state = _run_counts(flaky_subject(_rows(words), fail_after=0,
+                                      fail_attempts=0), backend=backend)
+    assert state == {"a": 2, "b": 1, "c": 1}
+
+
+# ---------------------------------------------------------------------------
+# retries exhausted → escalation per terminate_on_error
+# ---------------------------------------------------------------------------
+
+def test_terminate_on_error_true_reraises_connector_exception():
+    subject = flaky_subject(_rows(WORDS), fail_after=2, fail_attempts=-1)
+    with pytest.raises(InjectedFault) as exc_info:
+        _run_counts(subject, policy=_fast_policy(max_retries=1),
+                    terminate_on_error=True)
+    assert type(subject).attempts == 2  # initial + the single allowed retry
+    # the reader thread's traceback rides along to pw.run's caller
+    frames = traceback.extract_tb(exc_info.value.__traceback__)
+    assert any("faults.py" in f.filename for f in frames)
+
+
+def test_terminate_on_error_false_keeps_serving_and_logs():
+    G.clear()
+    schema = pw.schema_from_types(word=str)
+    bad = pw.io.python.read(
+        flaky_subject(_rows(["x", "x"]), fail_after=1, fail_attempts=-1),
+        schema=schema, autocommit_duration_ms=10, persistent_id="bad",
+        connector_policy=_fast_policy(max_retries=1))
+    good = pw.io.python.read(
+        flaky_subject(_rows(["g", "g", "g"]), fail_after=0, fail_attempts=0),
+        schema=schema, autocommit_duration_ms=10, persistent_id="good")
+    good_state: dict[str, int] = {}
+    bad_state: dict[str, int] = {}
+
+    def updater(state):
+        def on_change(key, row, time, is_addition):
+            if is_addition:
+                state[row["word"]] = row["c"]
+        return on_change
+
+    pw.io.subscribe(bad.groupby(bad.word).reduce(
+        word=bad.word, c=pw.reducers.count()), updater(bad_state))
+    pw.io.subscribe(good.groupby(good.word).reduce(
+        word=good.word, c=pw.reducers.count()), updater(good_state))
+    n_before = len(pw.global_error_log().connector_failures())
+    pw.run(terminate_on_error=False)  # completes despite the dead source
+    # the healthy source served to completion
+    assert good_state == {"g": 3}
+    # the failure is visible, never laundered into a clean shutdown
+    failures = pw.global_error_log().connector_failures()[n_before:]
+    assert any("'bad'" in f["message"] for f in failures)
+    assert all(f["kind"] == "connector" for f in failures)
+
+
+def _build_streaming_runtime(**kw):
+    from pathway_tpu.engine.streaming import StreamingRuntime
+    from pathway_tpu.internals.runner import GraphRunner
+
+    runner = GraphRunner()
+    for binder in G.output_binders:
+        binder(runner)
+    return StreamingRuntime(runner, **kw)
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_healthz_503_and_metrics_for_failed_source(monkeypatch):
+    """Degraded-but-serving runtime: /healthz flips to 503 naming the
+    failed source and its retry count; /metrics carries the connector
+    counters."""
+    monkeypatch.setenv("PATHWAY_MONITORING_HTTP_PORT", "0")  # ephemeral
+    G.clear()
+    schema = pw.schema_from_types(word=str)
+    bad = pw.io.python.read(
+        flaky_subject(_rows(["x"]), fail_after=0, fail_attempts=-1),
+        schema=schema, autocommit_duration_ms=10, persistent_id="bad",
+        connector_policy=pw.ConnectorPolicy(
+            max_retries=1, retry_strategy=FixedDelayRetryStrategy(
+                delay_ms=10)))
+    keeper = pw.io.python.read(
+        hanging_subject(_rows(["k"])), schema=schema,
+        autocommit_duration_ms=10, persistent_id="keeper")
+    pw.io.subscribe(bad, lambda *a, **k: None)
+    pw.io.subscribe(keeper, lambda *a, **k: None)
+    rt = _build_streaming_runtime(with_http_server=True,
+                                  terminate_on_error=False)
+    th = threading.Thread(target=rt.run, daemon=True)
+    th.start()
+    try:
+        deadline = time.monotonic() + 15
+        code, body = None, ""
+        while time.monotonic() < deadline:
+            if rt.http_server._httpd is not None:
+                base = f"http://127.0.0.1:{rt.http_server.port}"
+                code, body = _get(base + "/healthz")
+                if code == 503:
+                    break
+            time.sleep(0.05)
+        assert code == 503, f"healthz never degraded: {code} {body}"
+        payload = json.loads(body)
+        assert payload["status"] == "degraded"
+        assert [f["source"] for f in payload["failed_sources"]] == ["bad"]
+        assert payload["failed_sources"][0]["restarts"] == 1
+        assert payload["connector_retries"]["bad"] == 1
+        code, metrics = _get(base + "/metrics")
+        assert code == 200
+        assert 'pathway_tpu_connector_failed{source="bad"} 1' in metrics
+        assert 'pathway_tpu_connector_restarts{source="bad"} 1' in metrics
+        assert 'pathway_tpu_connector_failed{source="keeper"} 0' in metrics
+    finally:
+        rt.stop()
+        th.join(10)
+    assert not th.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# watchdog: hung readers and connect timeouts
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_on_hung_reader_and_escalates():
+    subject = hanging_subject(_rows(["a", "b"]))  # hangs on every attempt
+    with pytest.raises(pw.ConnectorStalledError, match="claiming liveness"):
+        _run_counts(
+            subject, policy=pw.ConnectorPolicy(max_retries=0),
+            terminate_on_error=True,
+            watchdog=pw.WatchdogConfig(reader_stall_timeout_s=0.3,
+                                       tick_deadline_s=None,
+                                       poll_interval_s=0.05))
+
+
+def test_watchdog_triggered_restart_heals_pipeline():
+    """First attempt hangs mid-stream; the watchdog abandons it and the
+    supervisor's restart finishes the stream — exactly once."""
+    subject = hanging_subject(_rows(WORDS), hang_attempts=1)
+    state = _run_counts(
+        subject, policy=_fast_policy(max_retries=2),
+        watchdog=pw.WatchdogConfig(reader_stall_timeout_s=0.25,
+                                   tick_deadline_s=None,
+                                   poll_interval_s=0.05))
+    assert state == {"a": 3, "b": 2, "c": 1}
+    assert type(subject).attempts == 2
+
+
+def test_connect_timeout_counts_as_failed_attempt():
+    """A reader silent from birth (no push, no heartbeat, no close) is
+    abandoned after connect_timeout and restarted."""
+
+    class _SilentFirst(pw.io.python.ConnectorSubject):
+        attempts = 0
+
+        def run(self):
+            attempt = type(self).attempts
+            type(self).attempts = attempt + 1
+            if attempt == 0:
+                while not self._session.stop_requested:
+                    time.sleep(0.01)
+                return
+            for values in _rows(["a", "b"]):
+                self.next(**values)
+
+    subject = _SilentFirst()
+    state = _run_counts(
+        subject,
+        policy=pw.ConnectorPolicy(
+            max_retries=1,
+            retry_strategy=FixedDelayRetryStrategy(delay_ms=10),
+            connect_timeout=0.3))
+    assert state == {"a": 1, "b": 1}
+    assert type(subject).attempts == 2
+
+
+# ---------------------------------------------------------------------------
+# fault-point machinery
+# ---------------------------------------------------------------------------
+
+def test_fault_points_unarmed_are_noops():
+    faults.hit("nonexistent.point")  # must not raise
+
+
+def test_fail_n_times_then_passes():
+    action = faults.FailNTimes(2)
+    with faults.arm("p", action):
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                faults.hit("p")
+        faults.hit("p")  # third hit passes
+    faults.hit("p")  # disarmed
+
+
+def test_fail_on_exact_hit():
+    with faults.arm("p", faults.FailOnHit(3)):
+        faults.hit("p")
+        faults.hit("p")
+        with pytest.raises(InjectedFault):
+            faults.hit("p")
+        faults.hit("p")
+
+
+def test_delay_action_delays():
+    with faults.arm("cluster.exchange.delay", faults.Delay(0.15, times=1)):
+        t0 = time.monotonic()
+        faults.hit("cluster.exchange.delay")
+        assert time.monotonic() - t0 >= 0.15
+        t0 = time.monotonic()
+        faults.hit("cluster.exchange.delay")  # only the first hit delays
+        assert time.monotonic() - t0 < 0.1
+
+
+def test_resuming_source_restarts_without_prefix_skip():
+    """A source that resumes from externally-tracked offsets (e.g. a
+    Kafka consumer group) re-emits NOTHING on restart — prefix-skip would
+    silently drop fresh rows. restart_resumes=True must disable it."""
+    from pathway_tpu.io._datasource import DataSource
+
+    class _Resuming(DataSource):
+        name = "resuming"
+        restart_resumes = True
+        attempts = 0
+
+        def run(self, session):
+            attempt = type(self).attempts
+            type(self).attempts = attempt + 1
+            words = ["a", "b", "a", "c"]
+            if attempt == 0:
+                for i, w in enumerate(words[:2]):
+                    session.push(*self.row_to_engine({"word": w}, i))
+                raise InjectedFault("crash after committing offsets")
+            # resumed: only the rows past the crash point, like a consumer
+            # group continuing from its committed offset
+            for i, w in enumerate(words[2:], start=2):
+                session.push(*self.row_to_engine({"word": w}, i))
+
+    from pathway_tpu.internals.table import Plan, Table
+    from pathway_tpu.internals.universe import Universe
+
+    G.clear()
+    schema = pw.schema_from_types(word=str)
+    source = _Resuming(schema, autocommit_duration_ms=10)
+    source.persistent_id = "resuming"
+    source.connector_policy = _fast_policy()
+    t = Table(Plan("input", datasource=source), schema, Universe(),
+              name="resuming")
+    counts = t.groupby(t.word).reduce(word=t.word, c=pw.reducers.count())
+    state: dict[str, int] = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            state[row["word"]] = row["c"]
+        elif state.get(row["word"]) == row["c"]:
+            del state[row["word"]]
+
+    pw.io.subscribe(counts, on_change)
+    pw.run()
+    assert _Resuming.attempts == 2
+    assert state == {"a": 2, "b": 1, "c": 1}  # nothing dropped, no dupes
+
+
+def test_kafka_group_id_marks_source_resuming():
+    t = pw.io.kafka.read({"bootstrap.servers": "x", "group.id": "g"},
+                         "topic")
+    assert t._plan.params["datasource"].restart_resumes
+    t2 = pw.io.kafka.read({"bootstrap.servers": "x"}, "topic")
+    assert not t2._plan.params["datasource"].restart_resumes
+
+
+def test_stop_all_stops_collect_sessions():
+    """Process-level teardown (streaming.stop_all) must reach static-mode
+    connectors sleeping through a CollectSession."""
+    from pathway_tpu.engine import streaming
+    from pathway_tpu.io._datasource import CollectSession
+
+    cs = CollectSession()
+    assert cs.sleep(0.01) is True
+    streaming.stop_all()
+    assert cs.stop_requested
+    assert cs.sleep(30.0) is False  # returns immediately
+
+
+def test_detached_attempt_records_no_liveness():
+    """An abandoned zombie attempt must not heartbeat through the shared
+    entry — it would mask a hung replacement attempt from the watchdog
+    and falsify the connect-timeout baseline."""
+    from types import SimpleNamespace
+
+    from pathway_tpu.engine.supervisor import (ConnectorSupervisor,
+                                               _SupervisedSession)
+    from pathway_tpu.io._datasource import Session
+
+    sup = ConnectorSupervisor()
+    ds = SimpleNamespace(name="fake", _uid=0, connector_policy=None,
+                         persistent_id="fake")
+    session = Session()
+    entry = sup.add_source(None, ds, session, session)
+    proxy = _SupervisedSession(entry, session, 0)
+    entry.last_activity = sentinel = -1.0
+    proxy.detached = True
+    proxy.push("k", ("r",), 1)
+    proxy.sleep(0)
+    assert entry.last_activity == sentinel  # no touch once detached
+    assert entry.forwarded == 0
+    assert session.drain() == []  # and nothing delivered
+
+
+def test_session_records_close_reason():
+    from pathway_tpu.io._datasource import Session
+
+    s = Session()
+    boom = ValueError("x")
+    s.close(reason="error", error=boom)
+    s.close()  # later clean close must not launder the error
+    assert s.closed_reason == "error"
+    assert s.error is boom
+
+
+def test_collect_session_sleep_honors_stop():
+    from pathway_tpu.io._datasource import CollectSession
+
+    cs = CollectSession()
+    assert cs.sleep(0.01) is True  # no stop requested: keep running
+    cs.stopping.set()
+    t0 = time.monotonic()
+    assert cs.sleep(30.0) is False  # returns immediately, signalling exit
+    assert time.monotonic() - t0 < 1.0
+    assert cs.stop_requested

@@ -1,0 +1,114 @@
+"""Shared retry strategies (internals/retries.py): the one delay-schedule
+implementation behind async UDF retries (internals/udfs.py) and connector
+supervision (engine/supervisor.py)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from pathway_tpu.internals.retries import (ExponentialBackoffRetryStrategy,
+                                           FixedDelayRetryStrategy,
+                                           NoRetryStrategy)
+
+
+def _seq(strategy, n):
+    return [strategy.delay_for_attempt(i) for i in range(n)]
+
+
+def test_fixed_delay_sequence_is_constant():
+    s = FixedDelayRetryStrategy(max_retries=5, delay_ms=250)
+    assert _seq(s, 4) == [0.25, 0.25, 0.25, 0.25]
+
+
+def test_exponential_sequence_without_jitter():
+    s = ExponentialBackoffRetryStrategy(initial_delay_ms=100,
+                                        backoff_factor=2.0)
+    assert _seq(s, 4) == [0.1, 0.2, 0.4, 0.8]
+
+
+def test_exponential_max_delay_caps_the_schedule():
+    s = ExponentialBackoffRetryStrategy(initial_delay_ms=100,
+                                        backoff_factor=10.0,
+                                        max_delay_ms=500)
+    assert _seq(s, 4) == [0.1, 0.5, 0.5, 0.5]
+
+
+def test_exponential_full_jitter_is_seeded_and_bounded():
+    mk = lambda: ExponentialBackoffRetryStrategy(  # noqa: E731
+        initial_delay_ms=100, backoff_factor=2.0, max_delay_ms=300,
+        jitter=True, seed=7)
+    a, b = _seq(mk(), 6), _seq(mk(), 6)
+    assert a == b  # same seed → identical schedule (deterministic tests)
+    # full jitter: uniform over [0, capped_delay]
+    caps = [0.1, 0.2, 0.3, 0.3, 0.3, 0.3]
+    assert all(0.0 <= d <= cap for d, cap in zip(a, caps))
+    # a different seed draws a different schedule
+    other = ExponentialBackoffRetryStrategy(
+        initial_delay_ms=100, backoff_factor=2.0, max_delay_ms=300,
+        jitter=True, seed=8)
+    assert _seq(other, 6) != a
+
+
+def test_async_invoke_retries_then_succeeds(monkeypatch):
+    sleeps: list[float] = []
+
+    async def fake_sleep(d):
+        sleeps.append(d)
+
+    monkeypatch.setattr(asyncio, "sleep", fake_sleep)
+    attempts = []
+
+    async def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    s = ExponentialBackoffRetryStrategy(max_retries=3, initial_delay_ms=100,
+                                        backoff_factor=2.0)
+    assert asyncio.run(s.invoke(flaky)) == "ok"
+    assert len(attempts) == 3
+    assert sleeps == [0.1, 0.2]  # invoke sleeps the declared schedule
+
+
+def test_async_invoke_exhausts_and_reraises(monkeypatch):
+    async def fake_sleep(d):
+        pass
+
+    monkeypatch.setattr(asyncio, "sleep", fake_sleep)
+    attempts = []
+
+    async def always_fails():
+        attempts.append(1)
+        raise ValueError("permanent")
+
+    s = FixedDelayRetryStrategy(max_retries=2, delay_ms=1)
+    with pytest.raises(ValueError, match="permanent"):
+        asyncio.run(s.invoke(always_fails))
+    assert len(attempts) == 3  # initial + 2 retries
+
+
+def test_no_retry_strategy_has_no_schedule():
+    with pytest.raises(RuntimeError):
+        NoRetryStrategy().delay_for_attempt(0)
+
+
+def test_udfs_module_reexports_shared_implementation():
+    """The historical import home keeps working and IS the shared class —
+    one schedule for UDF retries and connector restarts."""
+    from pathway_tpu.internals import retries, udfs
+
+    assert udfs.ExponentialBackoffRetryStrategy \
+        is retries.ExponentialBackoffRetryStrategy
+    assert udfs.FixedDelayRetryStrategy is retries.FixedDelayRetryStrategy
+    assert udfs.NoRetryStrategy is retries.NoRetryStrategy
+    assert udfs.AsyncRetryStrategy is retries.AsyncRetryStrategy
+
+
+def test_connector_policy_normalizes_no_retry():
+    import pathway_tpu as pw
+
+    p = pw.ConnectorPolicy(max_retries=5, retry_strategy=NoRetryStrategy())
+    assert p.max_retries == 0

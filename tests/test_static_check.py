@@ -305,11 +305,80 @@ def test_pwt011_negative_ix_with_id_pointer():
 
 
 # ---------------------------------------------------------------------------
+# PWT012 — no retries AND no escalation: a crash silently drops the source
+# ---------------------------------------------------------------------------
+
+def _no_retry_source(tmp_dir):
+    t = pw.io.fs.read(tmp_dir, format="json", mode="streaming",
+                      schema=sch.schema_from_types(a=int),
+                      connector_policy=pw.ConnectorPolicy(max_retries=0))
+    pw.io.subscribe(t, lambda *a, **k: None)
+    return t
+
+
+def test_pwt012_no_retries_without_escalation_warns(tmp_path):
+    _no_retry_source(str(tmp_path))
+    diags = pw.static_check(terminate_on_error=False)
+    assert codes(diags) == ["PWT012"]
+    assert not diags[0].is_error
+
+
+def test_pwt012_negative_terminate_on_error_true(tmp_path):
+    # escalation covers the crash: pw.run would re-raise it
+    _no_retry_source(str(tmp_path))
+    assert pw.static_check(terminate_on_error=True) == []
+
+
+def test_pwt012_negative_retries_available(tmp_path):
+    t = pw.io.fs.read(str(tmp_path), format="json", mode="streaming",
+                      schema=sch.schema_from_types(a=int),
+                      connector_policy=pw.ConnectorPolicy(max_retries=3))
+    pw.io.subscribe(t, lambda *a, **k: None)
+    assert pw.static_check(terminate_on_error=False) == []
+
+
+def test_pwt012_run_wide_default_policy(tmp_path):
+    # the hazard also arises from pw.run(connector_policy=...) applying a
+    # zero-retry default to sources that set no policy of their own
+    t = pw.io.fs.read(str(tmp_path), format="json", mode="streaming",
+                      schema=sch.schema_from_types(a=int))
+    pw.io.subscribe(t, lambda *a, **k: None)
+    diags = pw.static_check(
+        terminate_on_error=False,
+        connector_policy=pw.ConnectorPolicy(max_retries=0))
+    assert codes(diags) == ["PWT012"]
+    # a per-source policy with retries overrides the risky default
+    G.clear()
+    t2 = pw.io.fs.read(str(tmp_path), format="json", mode="streaming",
+                       schema=sch.schema_from_types(a=int),
+                       connector_policy=pw.ConnectorPolicy(max_retries=2))
+    pw.io.subscribe(t2, lambda *a, **k: None)
+    assert pw.static_check(
+        terminate_on_error=False,
+        connector_policy=pw.ConnectorPolicy(max_retries=0)) == []
+
+
+def test_pwt012_negative_unknown_run_mode(tmp_path):
+    # the CLI path does not know terminate_on_error — no guessing
+    _no_retry_source(str(tmp_path))
+    assert pw.static_check() == []
+
+
+def test_pwt012_surfaces_through_pw_run(tmp_path, caplog):
+    _no_retry_source(str(tmp_path))
+    from pathway_tpu.internals.run import _run_static_check
+
+    with caplog.at_level(logging.WARNING, "pathway_tpu.static_check"):
+        _run_static_check("warn", None, False)
+    assert any("PWT012" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
 # diagnostics plumbing
 # ---------------------------------------------------------------------------
 
 def test_every_code_has_registered_severity_and_summary():
-    assert set(CODES) >= {f"PWT{i:03d}" for i in range(12)}
+    assert set(CODES) >= {f"PWT{i:03d}" for i in range(13)}
     for code, (severity, summary) in CODES.items():
         assert isinstance(severity, Severity)
         assert summary

@@ -1,0 +1,48 @@
+"""Seeded hung-reader canary: proves the watchdog gate bites.
+
+A reader that claims liveness while producing nothing must trip the
+watchdog, exhaust its (zero) retry budget, and terminate the run with
+``ConnectorStalledError`` — within the deadline. Exits 0 iff exactly that
+happened; any other outcome (run completes, wrong exception, hang past
+the outer timeout) exits nonzero, failing the CI step.
+
+Run: ``python tests/watchdog_canary.py`` (same pattern as the PR 2
+shard-check canary: the gate is only trusted because a seeded failure is
+proven to trip it).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import pathway_tpu as pw
+from pathway_tpu.testing.faults import hanging_subject
+
+
+def main() -> int:
+    subject = hanging_subject([{"word": "w"}], hang_attempts=-1)
+    t = pw.io.python.read(
+        subject, schema=pw.schema_from_types(word=str),
+        autocommit_duration_ms=10, persistent_id="canary",
+        connector_policy=pw.ConnectorPolicy(max_retries=0))
+    pw.io.subscribe(t, lambda *a, **k: None)
+    try:
+        pw.run(
+            terminate_on_error=True,
+            watchdog=pw.WatchdogConfig(reader_stall_timeout_s=0.5,
+                                       tick_deadline_s=None,
+                                       poll_interval_s=0.05))
+    except pw.ConnectorStalledError as e:
+        print(f"OK: watchdog fired and escalated: {e}")
+        return 0
+    except Exception as e:  # wrong failure mode: the gate is broken
+        print(f"FAIL: expected ConnectorStalledError, got "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print("FAIL: run completed without the watchdog firing",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
