@@ -1,9 +1,15 @@
 """Streaming ETL template (reference: the WordCount / Kafka-ETL templates,
 docs/2.developers/7.templates): tail a directory of JSONLines order events,
-join against a dimension file, aggregate revenue per category with a
+join against a dimension file, score each order with a traceable
+(device-dispatched) batch UDF, aggregate revenue per category with a
 sliding window, and stream results to CSV — with live dashboard,
 Prometheus /metrics + /healthz, and supervised connectors (retry with
 capped-jittered backoff; degrade instead of crash unless --strict).
+
+The scoring UDF is ``batch=True, device=True``: whole engine batches
+dispatch as one XLA call, and with ``PATHWAY_DEVICE_INFLIGHT >= 2`` (the
+default) the scheduler overlaps each tick's device leg with the next
+tick's host-side parsing/joining (README "Pipelined execution").
 
 Run:
     python examples/streaming_etl.py ./orders ./categories.csv ./out.csv \
@@ -14,7 +20,20 @@ from __future__ import annotations
 
 import argparse
 
+import jax.numpy as jnp
+import numpy as np
+
 import pathway_tpu as pw
+
+
+@pw.udf(batch=True, device=True, deterministic=True, return_type=float)
+def demand_score(qty: list[int], price: list[float]) -> list[float]:
+    """Columnar demand score — one traceable XLA dispatch per engine
+    batch (log1p(qty) * sqrt(price)); rides the pipelined device leg."""
+    q = jnp.asarray(np.asarray(qty, np.float32))
+    p = jnp.asarray(np.asarray(price, np.float32))
+    s = jnp.log1p(q) * jnp.sqrt(p)
+    return [float(v) for v in np.asarray(s)]
 
 
 class Order(pw.Schema):
@@ -49,13 +68,18 @@ def build(orders_dir: str, categories_csv: str, out_csv: str,
     enriched = orders.join(cats, orders.item == cats.item).select(
         orders.item, orders.qty, orders.price, orders.ts, cats.category,
         revenue=orders.qty * orders.price)
+    enriched = enriched.select(
+        *[enriched[c] for c in ("item", "qty", "price", "ts", "category",
+                                "revenue")],
+        score=demand_score(enriched.qty, enriched.price))
     by_cat = enriched.windowby(
         enriched.ts, window=pw.temporal.sliding(hop=60, duration=300),
         instance=enriched.category).reduce(
         category=pw.this._pw_instance,
         window_start=pw.this._pw_window_start,
         revenue=pw.reducers.sum(pw.this.revenue),
-        n_orders=pw.reducers.count())
+        n_orders=pw.reducers.count(),
+        peak_demand=pw.reducers.max(pw.this.score))
 
     pw.io.fs.write(by_cat, out_csv, format="csv")
 

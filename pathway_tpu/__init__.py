@@ -112,6 +112,7 @@ from pathway_tpu.engine.supervisor import (  # noqa: E402
     WatchdogConfig,
 )
 from pathway_tpu.internals.config import set_license_key  # noqa: E402
+from pathway_tpu.warmup import enable_compilation_cache, warmup  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.internals.compat import (  # noqa: E402
     Joinable,

@@ -1,0 +1,198 @@
+"""Asynchronous device bridge: overlap host and device work across ticks.
+
+The scheduler is bulk-synchronous per tick: with one thread, tokenization /
+routing / pure-Python operators for tick t+1 cannot start until tick t's
+encoder forward, slab scatter and top-k materialization have retired — the
+TPU idles during host work and the host idles during device work (the
+``framework_docs_per_s`` vs raw-kernel ``docs_per_s`` gap in bench.py).
+WindVE (arxiv 2504.14941) shows a queue between the CPU stage and the
+accelerator stage roughly doubles embedding throughput at equal hardware;
+this module is that queue for the microbatch engine.
+
+Model: each tick's *device leg* — the downstream closure of every
+device-bound operator, stepped in topological order — is submitted as one
+FIFO job ("leg") to a single worker thread. The host thread immediately
+proceeds to the next tick's host-side work. Because legs are executed
+strictly in tick order by one worker, every operator still observes its
+ticks in order and per-tick consistency is unchanged; the overlap is purely
+between tick t's device leg and tick t+1..t+K's host legs.
+
+Guarantees:
+
+- **Bounded in-flight window**: at most ``max_inflight`` legs (queued +
+  running) exist at any moment; ``submit`` blocks (backpressure) when the
+  window is full, so a slow device cannot be out-run by the host.
+- **Hard barrier**: ``barrier()`` returns only when every submitted leg has
+  resolved. Callers place it before anything that externalizes state —
+  persistence checkpoints, end-of-stream flush, reading a tick's outputs.
+- **Error propagation**: a leg that raises poisons the bridge; the pending
+  queue is dropped (later ticks must not run on top of a failed one) and
+  the *original* exception re-raises on the host thread at the next
+  ``submit``/``barrier``, so user ``except`` clauses still match exactly as
+  they do in synchronous mode.
+
+The window is configured with ``PATHWAY_DEVICE_INFLIGHT`` (default 2 —
+double buffering; ``1`` disables pipelining entirely).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from collections import deque
+from typing import Callable
+
+
+def device_inflight_from_env() -> int:
+    """The configured in-flight window (>=1); 1 means synchronous."""
+    raw = os.environ.get("PATHWAY_DEVICE_INFLIGHT", "2")
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return 2
+
+
+class DeviceBridge:
+    """FIFO dispatch queue for per-tick device legs (see module doc)."""
+
+    def __init__(self, max_inflight: int = 2, name: str = "device-bridge"):
+        self.max_inflight = max(1, int(max_inflight))
+        self.name = name
+        self._cv = threading.Condition()
+        self._queue: deque = deque()  # (tick, fn, submitted_at)
+        self._running = False
+        self._error: BaseException | None = None
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        self._waiters = 0  # host threads blocked in submit/barrier
+        # -- instrumentation (read via stats(); exported on /metrics) ------
+        self.legs_dispatched = 0
+        self.legs_resolved = 0
+        # legs that finished with no host thread waiting on the bridge at
+        # any point of their execution: fully overlapped with host work
+        self.legs_overlapped = 0
+        self.queue_wait_ms = 0.0  # submit -> start, summed
+        self.exec_ms = 0.0        # start -> finish, summed
+        self.max_depth = 0
+
+    # ------------------------------------------------------------------
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._queue) + (1 if self._running else 0)
+
+    def submit(self, tick: int, fn: Callable[[], None]) -> None:
+        """Enqueue one tick's device leg; blocks while the window is full.
+
+        Raises the stored leg exception, if any — the host thread is the
+        one that must observe device failures.
+        """
+        with self._cv:
+            self._raise_if_error()
+            if self._closed:
+                raise RuntimeError("device bridge is closed")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._work, daemon=True, name=self.name)
+                self._thread.start()
+            while (len(self._queue) + (1 if self._running else 0)
+                   >= self.max_inflight):
+                self._waiters += 1
+                try:
+                    self._cv.wait()
+                finally:
+                    self._waiters -= 1
+                self._raise_if_error()
+            self._queue.append((tick, fn, _time.perf_counter()))
+            self.legs_dispatched += 1
+            depth = len(self._queue) + (1 if self._running else 0)
+            if depth > self.max_depth:
+                self.max_depth = depth
+            self._cv.notify_all()
+
+    def barrier(self) -> None:
+        """Block until every submitted leg has resolved; re-raise a leg
+        failure. This is the hard consistency point before commits,
+        flushes and output reads."""
+        with self._cv:
+            while (self._queue or self._running) and self._error is None:
+                self._waiters += 1
+                try:
+                    self._cv.wait()
+                finally:
+                    self._waiters -= 1
+            self._raise_if_error()
+
+    def close(self, join_timeout: float = 10.0) -> None:
+        """Drain remaining legs and stop the worker. Leg errors are NOT
+        raised here (close runs in ``finally`` paths; errors surface via
+        submit/barrier) — but they stay stored for a later barrier."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(join_timeout)
+
+    def error(self) -> BaseException | None:
+        """The stored leg failure, if any (without raising). Lets teardown
+        paths that must not raise mid-cleanup (Scheduler.close → drain)
+        still surface the failure afterwards."""
+        with self._cv:
+            return self._error
+
+    def stats(self) -> dict:
+        with self._cv:
+            resolved = self.legs_resolved
+            return {
+                "max_inflight": self.max_inflight,
+                "depth": len(self._queue) + (1 if self._running else 0),
+                "legs_dispatched": self.legs_dispatched,
+                "legs_resolved": resolved,
+                "legs_overlapped": self.legs_overlapped,
+                "overlap_ratio": (self.legs_overlapped / resolved
+                                  if resolved else 0.0),
+                "queue_wait_ms": round(self.queue_wait_ms, 3),
+                "exec_ms": round(self.exec_ms, 3),
+                "max_depth": self.max_depth,
+            }
+
+    # ------------------------------------------------------------------
+    def _raise_if_error(self) -> None:
+        if self._error is not None:
+            raise self._error
+
+    def _work(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue:  # closed and drained
+                    self._running = False
+                    self._cv.notify_all()
+                    return
+                tick, fn, submitted_at = self._queue.popleft()
+                self._running = True
+                # a host thread already blocked on us? then this leg is
+                # (at least partially) serialized with host work
+                waited_at_start = self._waiters > 0
+            started = _time.perf_counter()
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — must cross threads
+                with self._cv:
+                    self._error = e
+                    self._running = False
+                    # later ticks must not execute on top of a failed one
+                    self._queue.clear()
+                    self._cv.notify_all()
+                continue  # keep serving barrier wake-ups until close
+            finished = _time.perf_counter()
+            with self._cv:
+                self.queue_wait_ms += (started - submitted_at) * 1e3
+                self.exec_ms += (finished - started) * 1e3
+                self.legs_resolved += 1
+                if not waited_at_start and self._waiters == 0:
+                    self.legs_overlapped += 1
+                self._running = False
+                self._cv.notify_all()

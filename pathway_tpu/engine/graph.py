@@ -131,7 +131,8 @@ class Scheduler:
     """
 
     def __init__(self, graph: EngineGraph, n_workers: int = 1,
-                 parallel_threads: bool | None = None, cluster=None):
+                 parallel_threads: bool | None = None, cluster=None,
+                 device_inflight: int | None = None):
         self.graph = graph
         self.cluster = cluster
         if cluster is not None:
@@ -165,7 +166,19 @@ class Scheduler:
         import threading
 
         self._stats_lock = threading.Lock()
+        # value -> worker memo per exchanged edge; bounded so
+        # high-cardinality instance columns (user ids, session keys) do not
+        # leak over a long streaming run: at the cap the edge's memo is
+        # reset wholesale — O(1), and the hot values re-memoize immediately
         self._route_cache: dict[tuple[int, int], dict] = {}
+        import os as _os
+
+        try:
+            self._route_cache_max = max(
+                1024, int(_os.environ.get("PATHWAY_ROUTE_CACHE_MAX",
+                                          str(1 << 16))))
+        except ValueError:
+            self._route_cache_max = 1 << 16
         self._topo = self._topo_sort()
         # LOCAL worker replicas per node (index = worker - local_lo);
         # replica 0 on process 0 is always node.op itself. Gather nodes
@@ -199,9 +212,78 @@ class Scheduler:
             for n in graph.nodes
         }
         self.on_step: Callable[[int], None] | None = None
+        # -- pipelined device legs (engine/device_bridge.py) ---------------
+        # Device-bound operators (TPU-resident index add/search, traceable
+        # batch UDFs like the JAX encoder embedder) and their downstream
+        # closure form the per-tick "device leg"; with an in-flight window
+        # >= 2 the leg runs on the bridge worker while the host thread
+        # starts the next tick's host-side work. Single-worker,
+        # single-process only: sharded/cluster execution keeps the
+        # bulk-synchronous path (its exchanges are the consistency points).
+        from pathway_tpu.engine.device_bridge import (DeviceBridge,
+                                                      device_inflight_from_env)
+
+        if device_inflight is None:
+            device_inflight = device_inflight_from_env()
+        self.device_inflight = max(1, int(device_inflight))
+        self._bridge = None
+        self._deferred_ids: frozenset[int] = frozenset()
+        if (self.device_inflight >= 2 and self.n_workers == 1
+                and cluster is None):
+            device_nodes = [n.id for n in graph.nodes
+                            if getattr(n.op, "device_bound", False)]
+            if device_nodes:
+                self._deferred_ids = self._downstream_closure(device_nodes)
+                self._bridge = DeviceBridge(self.device_inflight)
+
+    def _downstream_closure(self, roots: list[int]) -> frozenset[int]:
+        """All nodes reachable from ``roots`` (inclusive) following output
+        edges. Closed under successors, so every consumer of a deferred
+        node's output is itself deferred — the device leg never feeds data
+        back into the host leg of the same tick."""
+        succs: dict[int, list[int]] = {n.id: [] for n in self.graph.nodes}
+        for node in self.graph.nodes:
+            for up in node.inputs:
+                succs[up.id].append(node.id)
+        seen = set(roots)
+        frontier = list(roots)
+        while frontier:
+            nid = frontier.pop()
+            for s in succs[nid]:
+                if s not in seen:
+                    seen.add(s)
+                    frontier.append(s)
+        return frozenset(seen)
+
+    def resolve_barrier(self) -> None:
+        """Wait for every in-flight device leg to resolve (no-op when
+        pipelining is off). Must run before anything externalizes engine
+        state: persistence commits, end-of-stream flushes, output reads."""
+        if self._bridge is not None:
+            self._bridge.barrier()
+
+    def bridge_stats(self) -> dict | None:
+        """Device-bridge instrumentation (None when pipelining is off)."""
+        if self._bridge is not None:
+            return self._bridge.stats()
+        return None
+
+    def take_device_error(self) -> BaseException | None:
+        """A device-leg failure that no submit/barrier observed yet (e.g.
+        the run was stopped externally and teardown drained the bridge
+        without raising). Callers re-raise it after cleanup so pipelined
+        mode never turns an operator/callback exception into a clean
+        exit."""
+        if self._bridge is not None:
+            return self._bridge.error()
+        return None
 
     def close(self) -> None:
-        """Release the worker thread pool (idempotent)."""
+        """Release the worker thread pool and drain the bridge (idempotent).
+        The bridge object survives closure so post-run instrumentation
+        (bench, /metrics snapshots) can still read its counters."""
+        if self._bridge is not None:
+            self._bridge.close()
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -281,6 +363,8 @@ class Scheduler:
         within the same tick.
         """
         if self.n_workers == 1:
+            if self._bridge is not None:
+                return self._run_time_pipelined(time, flush)
             outputs: dict[int, Delta] = {}
             for node in self._topo:
                 in_deltas = [outputs.get(up.id, _EMPTY) for up in node.inputs]
@@ -291,6 +375,43 @@ class Scheduler:
                 self.on_step(time)
             return outputs
         return self._run_time_sharded(time, flush)
+
+    def _run_time_pipelined(self, time: int, flush: bool):
+        """One tick, split into a host leg (stepped now, on this thread)
+        and a device leg (the deferred closure, submitted to the bridge).
+
+        The leg closure captures this tick's ``outputs`` dict; host-leg
+        deltas are complete before submission and the deferred closure is
+        closed under successors, so the two threads never share a node.
+        Steps observe ticks in order because the bridge worker is a single
+        FIFO. ``flush=True`` (end of stream) is a hard barrier: everything
+        must have retired before the caller tears down or reads results.
+        """
+        outputs: dict[int, Delta] = {}
+        deferred: list[Node] = []
+        for node in self._topo:
+            if node.id in self._deferred_ids:
+                deferred.append(node)
+                continue
+            in_deltas = [outputs.get(up.id, _EMPTY) for up in node.inputs]
+            delta = self._step_op(node, node.op, time, in_deltas, flush)
+            outputs[node.id] = delta
+            self._count(node.id, delta)
+
+        def leg() -> None:
+            for node in deferred:
+                in_deltas = [outputs.get(up.id, _EMPTY)
+                             for up in node.inputs]
+                delta = self._step_op(node, node.op, time, in_deltas, flush)
+                outputs[node.id] = delta
+                self._count(node.id, delta)
+
+        self._bridge.submit(time, leg)
+        if self.on_step is not None:
+            self.on_step(time)
+        if flush:
+            self._bridge.barrier()
+        return _PipelinedOutputs(self._bridge, outputs)
 
     def _step_op(self, node: Node, op: Operator, time: int,
                  in_deltas: list[Delta], flush: bool) -> Delta:
@@ -426,8 +547,10 @@ class Scheduler:
                                     else:
                                         if gw is None:
                                             gw = self._route_value(v)
-                                            if len(cache) < (1 << 20):
-                                                cache[v] = gw
+                                            if len(cache) >= \
+                                                    self._route_cache_max:
+                                                cache.clear()
+                                            cache[v] = gw
                                 if lo <= gw < hi:
                                     routed[gw - lo].append(e)
                                 else:
@@ -552,6 +675,33 @@ def _wm_max(a, b):
         return a
 
 
+class _PipelinedOutputs:
+    """Lazy per-tick output view under pipelined execution: deferred-node
+    deltas materialize on the bridge worker, so any read is a hard resolve
+    barrier first. The streaming/batch drivers never read these (pure
+    overlap); direct callers (tests, notebooks) get the synchronous-mode
+    answer, just later."""
+
+    __slots__ = ("_bridge", "_outputs")
+
+    def __init__(self, bridge, outputs: dict[int, Delta]):
+        self._bridge = bridge
+        self._outputs = outputs
+
+    def get(self, node_id: int, default: Delta = None) -> Delta:
+        self._bridge.barrier()
+        return self._outputs.get(
+            node_id, _EMPTY if default is None else default)
+
+    def __getitem__(self, node_id: int) -> Delta:
+        self._bridge.barrier()
+        return self._outputs[node_id]
+
+    def __contains__(self, node_id: int) -> bool:
+        self._bridge.barrier()
+        return node_id in self._outputs
+
+
 class _MergedOutputs:
     """Lazy node-output view over per-worker deltas: merging every node's
     partitions each tick would be pure overhead (the streaming/batch drivers
@@ -628,7 +778,11 @@ class IterateOperator(Operator):
         # run sharded across that process's workers (joins/groupbys in the
         # loop body exchange by key like any other pipeline) — the
         # owning scheduler passes its worker count down via inner_workers
-        sched = Scheduler(sub, n_workers=getattr(self, "inner_workers", 1))
+        # fixpoint rounds read every node's outputs immediately — a
+        # pipelined inner scheduler would barrier per round, so keep the
+        # sub-graph synchronous (device_inflight=1)
+        sched = Scheduler(sub, n_workers=getattr(self, "inner_workers", 1),
+                          device_inflight=1)
         var_states = [Arrangement() for _ in range(self.n_iterated)]
         out_states = [Arrangement() for _ in range(self.n_iterated)]
         result_states = [Arrangement() for _ in range(self.n_results)]

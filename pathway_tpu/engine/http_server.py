@@ -43,11 +43,16 @@ class MonitoringHttpServer:
                 "latency_ms": round(st.get("latency_ms", 0.0), 3),
                 "total_ms": round(st.get("total_ms", 0.0), 3),
             })
-        return {
+        payload = {
             "process_id": int(os.environ.get("PATHWAY_PROCESS_ID", "0")),
             "sources": len(self.runtime.sessions),
             "operators": operators,
         }
+        bridge = sched.bridge_stats() if hasattr(sched, "bridge_stats") \
+            else None
+        if bridge is not None:
+            payload["device_bridge"] = bridge
+        return payload
 
     def healthz_payload(self) -> tuple[bool, dict]:
         """(healthy, body) for ``/healthz``: 200 while every supervised
@@ -116,6 +121,35 @@ class MonitoringHttpServer:
                 failed = 1 if s["state"] == "failed" else 0
                 lines.append(
                     f"pathway_tpu_connector_failed{labels} {failed}")
+        sched = self.runtime.scheduler
+        bridge = sched.bridge_stats() if hasattr(sched, "bridge_stats") \
+            else None
+        if bridge is not None:
+            # pipelined-execution instrumentation (engine/device_bridge.py):
+            # in-flight depth + dispatch-queue wait make the host/device
+            # overlap visible instead of inferred
+            lines.append("# TYPE pathway_tpu_device_inflight_depth gauge")
+            lines.append(
+                f"pathway_tpu_device_inflight_depth {bridge['depth']}")
+            lines.append("# TYPE pathway_tpu_device_inflight_window gauge")
+            lines.append(f"pathway_tpu_device_inflight_window "
+                         f"{bridge['max_inflight']}")
+            lines.append("# TYPE pathway_tpu_device_legs_dispatched counter")
+            lines.append(f"pathway_tpu_device_legs_dispatched "
+                         f"{bridge['legs_dispatched']}")
+            lines.append("# TYPE pathway_tpu_device_legs_resolved counter")
+            lines.append(f"pathway_tpu_device_legs_resolved "
+                         f"{bridge['legs_resolved']}")
+            lines.append("# TYPE pathway_tpu_device_legs_overlapped counter")
+            lines.append(f"pathway_tpu_device_legs_overlapped "
+                         f"{bridge['legs_overlapped']}")
+            lines.append(
+                "# TYPE pathway_tpu_device_queue_wait_ms_total counter")
+            lines.append(f"pathway_tpu_device_queue_wait_ms_total "
+                         f"{bridge['queue_wait_ms']}")
+            lines.append("# TYPE pathway_tpu_device_exec_ms_total counter")
+            lines.append(
+                f"pathway_tpu_device_exec_ms_total {bridge['exec_ms']}")
         try:
             import resource
 

@@ -38,6 +38,13 @@ class ExternalIndexOperator(Operator):
     # per-worker steps must stay sequential
     parallel_safe = False
 
+    @property
+    def device_bound(self) -> bool:
+        """Pipeline this operator through the device bridge when the index
+        itself is device-resident (HBM slab KNN variants); host-side
+        indexes (HNSW, BM25) keep the synchronous path."""
+        return bool(getattr(self.index, "device_bound", False))
+
     def exchange_specs(self):
         # Reference semantics (operators/external_index.rs:97): the DATA
         # stream is broadcast so every worker can answer queries, and

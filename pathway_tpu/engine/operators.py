@@ -61,6 +61,12 @@ class Operator:
     # False for ops whose replicas share mutable state (e.g. one device
     # slab): their per-worker steps must not run on the thread pool
     parallel_safe = True
+    # True for ops whose step dispatches accelerator work (device-resident
+    # index add/search, traceable batch UDFs): with n_workers == 1 and
+    # PATHWAY_DEVICE_INFLIGHT >= 2 the scheduler defers this op AND its
+    # downstream closure to the device bridge so the next tick's host work
+    # overlaps the dispatch (engine/device_bridge.py)
+    device_bound = False
     # Consulted only for EXCHANGED inputs (the sharded merge points in
     # graph.py; spec-None inputs always pass through unmerged): False for
     # ops whose step() is exact on unconsolidated input — purely additive

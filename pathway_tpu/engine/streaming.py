@@ -231,11 +231,18 @@ class StreamingRuntime:
                     # stamp after the step too: a long (healthy) batch
                     # counts as progress the moment it completes, so only
                     # a single step exceeding the deadline can ever be
-                    # reported as a stall
+                    # reported as a stall. Under pipelined execution
+                    # run_time returns with device legs still in flight —
+                    # that IS progress (backpressure, not the watchdog,
+                    # bounds a slow device).
                     self.last_tick_at = _time.monotonic()
                     self.monitor.update(self.scheduler, self.runner.graph,
                                         time_counter)
                     if self.persistence is not None:
+                        # hard resolve barrier: a checkpoint must never
+                        # cover a tick whose device leg could still fail —
+                        # replay-skip would otherwise drop its outputs
+                        self.scheduler.resolve_barrier()
                         self.persistence.commit(time_counter)
                 time_counter += 1
                 if all_closed and not any_data:
@@ -250,7 +257,8 @@ class StreamingRuntime:
                         if leftovers:
                             self.scheduler.run_time(time_counter)
                             time_counter += 1
-                    # all sources closed: end-of-stream flush tick
+                    # all sources closed: end-of-stream flush tick (a hard
+                    # resolve barrier under pipelined execution)
                     self.scheduler.run_time(time_counter, flush=True)
                     if self.persistence is not None:
                         self.persistence.commit(time_counter)
@@ -277,3 +285,10 @@ class StreamingRuntime:
             # connector's own exception (its reader-thread traceback is
             # attached) from pw.run, after a full clean teardown
             raise fatal
+        # a device leg that failed after the loop's last submit (e.g. the
+        # run was stopped externally) was drained-but-not-raised by
+        # scheduler.close(): surface it now, exactly as synchronous mode
+        # would have raised it out of run_time
+        deferred = self.scheduler.take_device_error()
+        if deferred is not None:
+            raise deferred

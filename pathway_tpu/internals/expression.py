@@ -366,7 +366,7 @@ class ApplyExpression(ColumnExpression):
     def __init__(self, fn: Callable, return_type: Any, *args,
                  propagate_none: bool = False, deterministic: bool = True,
                  max_batch_size: int | None = None,
-                 batch: bool = False, **kwargs):
+                 batch: bool = False, device: bool = False, **kwargs):
         self._fn = fn
         self._return_type = dt.wrap(return_type)
         self._args = tuple(wrap_arg(a) for a in args)
@@ -378,6 +378,10 @@ class ApplyExpression(ColumnExpression):
         # the columnar dispatch path for TPU/vectorized UDFs (SURVEY §7 —
         # replaces the reference's per-row GIL calls, dataflow.rs:1300-1305)
         self._batch = batch
+        # device=True (batch only) → the fn dispatches accelerator work:
+        # the operator hosting this expression joins the scheduler's
+        # pipelined device leg (engine/device_bridge.py)
+        self._device = device and batch
 
     @property
     def _deps(self):

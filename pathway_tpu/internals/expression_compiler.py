@@ -110,6 +110,10 @@ class ExpressionCompiler:
     def __init__(self, ctx: CompileContext):
         self.ctx = ctx
         self.has_non_deterministic = False
+        # set when a compiled expression dispatches accelerator work
+        # (batch UDF with device=True): the hosting operator is marked
+        # device_bound so the scheduler can pipeline it (device bridge)
+        self.has_device = False
 
     # -- public -------------------------------------------------------------
     def compile(self, expr: ex.ColumnExpression) -> Callable[[list, list], Batch]:
@@ -627,6 +631,8 @@ class ExpressionCompiler:
         if not expr._deterministic:
             self.has_non_deterministic = True
         if getattr(expr, "_batch", False):
+            if getattr(expr, "_device", False):
+                self.has_device = True
             return self._compile_batch_apply(expr, fns, kw_fns)
 
         def fn(keys, rows):
@@ -752,4 +758,7 @@ _PENDING = _Pending()
 def compile_map_program(exprs, ctx: CompileContext):
     comp = ExpressionCompiler(ctx)
     program = comp.compile_program(list(exprs))
+    # carried as a function attribute so the lowering can mark the hosting
+    # MapOperator device_bound without changing every call site
+    program.device_bound = comp.has_device
     return program, comp.has_non_deterministic

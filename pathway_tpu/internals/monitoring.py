@@ -84,6 +84,20 @@ class StatsMonitor:
             return
         self._last_render = now
         self._rows = []
+        # pipelined-execution line: in-flight depth, dispatch-queue wait
+        # and overlap ratio straight from the device bridge, so the
+        # host/device overlap is observable, not inferred
+        self._bridge_line = None
+        bridge = scheduler.bridge_stats() \
+            if hasattr(scheduler, "bridge_stats") else None
+        if bridge is not None:
+            self._bridge_line = (
+                f"device bridge: in-flight {bridge['depth']}/"
+                f"{bridge['max_inflight']}  legs {bridge['legs_resolved']}/"
+                f"{bridge['legs_dispatched']}  "
+                f"overlap {bridge['overlap_ratio']:.0%}  "
+                f"queue-wait {bridge['queue_wait_ms']:.0f}ms  "
+                f"exec {bridge['exec_ms']:.0f}ms")
         for node in graph.nodes:
             st = scheduler.stats.get(node.id)
             if not st:
@@ -114,6 +128,9 @@ class StatsMonitor:
             table.add_row(name, str(ins), str(rets), f"{lat:.2f}",
                           f"{tot:.0f}")
         parts = [table]
+        if getattr(self, "_bridge_line", None):
+            parts.append(Panel(self._bridge_line, title="pipelining",
+                               height=None))
         sup_lines = self._supervisor_lines()
         if sup_lines:
             parts.append(Panel("\n".join(sup_lines), title="connectors",
@@ -155,6 +172,8 @@ class StatsMonitor:
             for name, ins, rets, lat, tot in self._rows:
                 print(f"[monitor] {name}: +{ins} -{rets} {lat:.2f}ms",
                       file=sys.stderr)
+            if getattr(self, "_bridge_line", None):
+                print(f"[monitor] {self._bridge_line}", file=sys.stderr)
             for line in self._supervisor_lines():
                 print(f"[monitor] {line}", file=sys.stderr)
 

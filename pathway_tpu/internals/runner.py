@@ -61,6 +61,18 @@ def _referenced_tables(exprs, base: Table) -> list[Table]:
     return order
 
 
+def _map_op_for(program, nondet: bool):
+    """Map operator for a compiled program; device-dispatching programs
+    (batch UDFs with device=True, e.g. the JAX encoder embedder) mark the
+    operator device_bound so the scheduler pipelines it through the device
+    bridge."""
+    op = eng.DeterministicMapOperator(program) if nondet \
+        else eng.MapOperator(program)
+    if getattr(program, "device_bound", False):
+        op.device_bound = True
+    return op
+
+
 class GraphRunner:
     def __init__(self):
         self.graph = EngineGraph()
@@ -202,8 +214,8 @@ class GraphRunner:
         exprs = plan.params["exprs"]
         node, ctx = self._row_space(base, exprs)
         program, nondet = compile_map_program(exprs, ctx)
-        op = eng.DeterministicMapOperator(program) if nondet else eng.MapOperator(program)
-        return self.graph.add_node(op, [node], f"map:{table._name}")
+        return self.graph.add_node(_map_op_for(program, nondet), [node],
+                                   f"map:{table._name}")
 
     def _lower_filter(self, table: Table, plan: Plan) -> Node:
         base = plan.params["base"]
@@ -370,8 +382,8 @@ class GraphRunner:
         post_ctx = CompileContext()
         post_ctx.add_table(proxy, 0)
         post_program, nondet = compile_map_program(rewritten, post_ctx)
-        op = eng.DeterministicMapOperator(post_program) if nondet else eng.MapOperator(post_program)
-        return self.graph.add_node(op, [gnode], f"reduce:{table._name}")
+        return self.graph.add_node(_map_op_for(post_program, nondet),
+                                   [gnode], f"reduce:{table._name}")
 
     # -- joins --------------------------------------------------------------
     def _lower_join_select(self, table: Table, plan: Plan) -> Node:
@@ -501,8 +513,8 @@ class GraphRunner:
             [lnode, rnode], f"join:{mode}")
 
         program, nondet = compile_map_program(exprs, ctx)
-        op = eng.DeterministicMapOperator(program) if nondet else eng.MapOperator(program)
-        return self.graph.add_node(op, [jnode], f"join_select:{table._name}")
+        return self.graph.add_node(_map_op_for(program, nondet), [jnode],
+                                   f"join_select:{table._name}")
 
     # -- set ops ------------------------------------------------------------
     def _project_to_names(self, t: Table, names: list[str]) -> Node:

@@ -284,7 +284,8 @@ class UDF:
     def __init__(self, *, return_type: Any = None, deterministic: bool = False,
                  propagate_none: bool = False, executor: Executor | None = None,
                  cache_strategy: CacheStrategy | None = None,
-                 max_batch_size: int | None = None, batch: bool = False):
+                 max_batch_size: int | None = None, batch: bool = False,
+                 device: bool = False):
         self.return_type = return_type
         self.deterministic = deterministic
         self.propagate_none = propagate_none
@@ -294,6 +295,10 @@ class UDF:
         # batch=True → __wrapped__ receives whole columns (lists) and
         # returns a list (columnar TPU/vectorized dispatch; sync only)
         self.batch = batch
+        # device=True → the batch dispatch is accelerator work (jax/XLA):
+        # the scheduler may overlap it with the next tick's host work via
+        # the device bridge (PATHWAY_DEVICE_INFLIGHT)
+        self.device = device
         self._prepared: Callable | None = None
 
     # subclasses override
@@ -373,6 +378,7 @@ class UDF:
             deterministic=self.deterministic,
             max_batch_size=self.max_batch_size,
             batch=self.batch,
+            device=self.device,
             **kwargs,
         )
 
@@ -392,7 +398,8 @@ def udf(fun: Callable | None = None, /, *, return_type: Any = None,
         deterministic: bool = False, propagate_none: bool = False,
         executor: Executor | None = None,
         cache_strategy: CacheStrategy | None = None,
-        max_batch_size: int | None = None, batch: bool = False):
+        max_batch_size: int | None = None, batch: bool = False,
+        device: bool = False):
     """Decorator turning a Python function into a column UDF."""
 
     def wrapper(f):
@@ -400,7 +407,7 @@ def udf(fun: Callable | None = None, /, *, return_type: Any = None,
             f, return_type=return_type, deterministic=deterministic,
             propagate_none=propagate_none, executor=executor,
             cache_strategy=cache_strategy, max_batch_size=max_batch_size,
-            batch=batch,
+            batch=batch, device=device,
         )
 
     if fun is not None:

@@ -285,6 +285,10 @@ class BruteForceKnnIndex:
     realloc, brute_force_knn_integration.rs).
     """
 
+    # adds/searches dispatch XLA work: eligible for the scheduler's
+    # pipelined device leg (engine/device_bridge.py)
+    device_bound = True
+
     def __init__(self, dimensions: int, *, reserved_space: int = 0,
                  metric: KnnMetric | str = KnnMetric.L2SQ,
                  dtype: str = "float32", device=None):
@@ -808,6 +812,8 @@ class DeviceEmbeddingKnnIndex:
     ``embedder`` must expose ``encode_batch_device(texts) -> (B, dim)``
     jax array (JaxEncoderEmbedder does).
     """
+
+    device_bound = True
 
     def __init__(self, embedder, inner: BruteForceKnnIndex):
         self.embedder = embedder

@@ -69,6 +69,8 @@ class ShardedKnnIndex:
     avoids hash skew in the slab).
     """
 
+    device_bound = True  # pipeline through the device bridge (graph.py)
+
     def __init__(self, dimensions: int, *, mesh=None,
                  reserved_space: int = 0,
                  metric: KnnMetric | str = KnnMetric.L2SQ,

@@ -1,0 +1,177 @@
+"""Warmup + persistent XLA compilation cache.
+
+The flagship encoder runs under jit with sequence-length bucketing: ~18
+distinct (batch, width) shapes (``JaxEncoderEmbedder.bucket_widths``). By
+default XLA compiles each shape the first time a serving tick dispatches it
+— a ~0.75 s stall per shape *inside* the measured/served window (bench.py
+round-5 finding: two in-window compiles cost 1.48 s of a 2.76 s window).
+
+Two fixes, composable:
+
+- ``enable_compilation_cache()`` points jax's persistent compilation cache
+  at a per-machine directory (``PATHWAY_COMPILATION_CACHE`` or
+  ``~/.cache/pathway_tpu/xla_cache``): every shape compiles once per
+  MACHINE instead of once per process. ``maybe_enable_compilation_cache``
+  is the opt-in hook wired into the embedders: it activates only when the
+  env var is set.
+- ``pw.warmup(embedder, index=...)`` eagerly walks the bucket shapes
+  (encoder forward, and the fused encode+scatter / search kernels when an
+  index is given) so all compilation happens before the first real tick —
+  from the persistent cache when warm, from scratch otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import time as _time
+from typing import Any
+
+_CACHE_WIRED = False
+
+
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Point jax's persistent compilation cache at ``path`` (default:
+    ``PATHWAY_COMPILATION_CACHE`` or ``~/.cache/pathway_tpu/xla_cache``).
+    Returns the directory in use, or None when the running jax has no
+    persistent-cache support (older versions — warmup still works, it just
+    compiles once per process)."""
+    global _CACHE_WIRED
+    import jax
+
+    if path is None:
+        path = os.environ.get("PATHWAY_COMPILATION_CACHE") or os.path.join(
+            os.path.expanduser("~"), ".cache", "pathway_tpu", "xla_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(path))
+    except Exception:
+        return None
+    # cache every entry: the default thresholds skip sub-second compiles,
+    # but 18 x 0.7 s is exactly the stall this exists to delete
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:
+            pass
+    _CACHE_WIRED = True
+    return path
+
+
+def maybe_enable_compilation_cache() -> str | None:
+    """Activate the persistent cache iff ``PATHWAY_COMPILATION_CACHE`` is
+    set (idempotent; called from embedder constructors)."""
+    if _CACHE_WIRED:
+        return None
+    if not os.environ.get("PATHWAY_COMPILATION_CACHE"):
+        return None
+    return enable_compilation_cache()
+
+
+def warmup(embedder: Any = None, *, index: Any = None,
+           batch_size: int | None = None, ks: tuple[int, ...] = (),
+           cache: bool = True) -> dict:
+    """Pre-compile the serving-path kernels so no XLA compile lands inside
+    a live tick.
+
+    ``embedder``: a :class:`JaxEncoderEmbedder`-shaped object (exposes
+    ``bucket_widths()`` / ``_encode_packed`` / ``params``); every bucket
+    width is compiled at ``batch_size`` (default: the embedder's
+    ``max_batch_size``, else 32). Only the WIDTH dimension is bucketed —
+    the batch dimension is whatever the engine dispatches, so the
+    no-compile-in-tick guarantee requires pinning it: construct the
+    embedder with ``max_batch_size=batch_size`` (as bench.py does) so
+    every full dispatch is exactly the warmed shape. Unpinned batch
+    sizes still compile on first sight of each new row count.
+
+    ``index``: optionally a device KNN index. A fused
+    :class:`DeviceEmbeddingKnnIndex` warms the encode+scatter dispatch at
+    every width through scratch slots (removed and flushed afterwards);
+    any non-empty index additionally warms its search kernel for each
+    fan-out in ``ks``.
+
+    ``cache=True`` wires the persistent compilation cache first, so warmed
+    executables persist across processes on this machine.
+
+    Returns ``{"cache_dir", "compiled", "seconds"}`` where ``compiled``
+    lists the (kind, shape) pairs that were walked.
+    """
+    t0 = _time.perf_counter()
+    out: dict = {"cache_dir": None, "compiled": []}
+    if cache:
+        out["cache_dir"] = enable_compilation_cache()
+    if embedder is None and index is None:
+        out["seconds"] = round(_time.perf_counter() - t0, 3)
+        return out
+
+    import jax
+    import numpy as np
+
+    if embedder is None and index is not None:
+        embedder = getattr(index, "embedder", None)
+
+    widths: list[int] = []
+    if embedder is not None and hasattr(embedder, "bucket_widths"):
+        widths = embedder.bucket_widths()
+    B = (batch_size or getattr(embedder, "max_batch_size", None) or 32)
+
+    def packed_operands(w: int):
+        dtype = np.int16 if getattr(embedder, "_pack_ids", False) \
+            else np.int32
+        ids = np.zeros((B, w), dtype)
+        lens = np.full((B,), max(1, w - 2), np.int32)
+        return ids, lens
+
+    fused = getattr(index, "_fused", None)
+    inner = getattr(index, "inner", index)
+    if embedder is not None and widths:
+        fused_used = False
+        for w in widths:
+            ids, lens = packed_operands(w)
+            if fused is not None:
+                # warm the REAL serving dispatch (encode+scatter is one
+                # donated jit, distinct from the plain encoder) through
+                # scratch slots, then retract them
+                from pathway_tpu.internals.keys import Pointer
+
+                scratch = [Pointer((1 << 62) + i) for i in range(B)]
+                try:
+                    fused(scratch, embedder.params, ids, lens)
+                except ValueError as e:
+                    if "cannot grow" not in str(e):
+                        raise
+                    # slab too full for scratch slots: live ingest will
+                    # also take the growable two-dispatch fallback
+                    # (DeviceEmbeddingKnnIndex.add_batch), so warm the
+                    # plain encoder — the dispatch that path uses
+                    fused = None
+                    jax.block_until_ready(
+                        embedder._encode_packed(embedder.params, ids, lens))
+                    out["compiled"].append(("encode", (B, w)))
+                    continue
+                fused_used = True
+                for k in scratch:
+                    inner.remove(k)
+                out["compiled"].append(("fused_ingest", (B, w)))
+            else:
+                jax.block_until_ready(
+                    embedder._encode_packed(embedder.params, ids, lens))
+                out["compiled"].append(("encode", (B, w)))
+        if fused_used:
+            # push the scratch removals now (even if a later width fell
+            # back): the first live ingest must not compile the plain
+            # scatter in-window flushing them
+            inner.flush_device()
+    if index is not None and ks:
+        search_index = inner if hasattr(inner, "_get_search_fn") else None
+        if search_index is not None and len(search_index) > 0:
+            dim = search_index.dim
+            from pathway_tpu.internals.keys import Pointer
+
+            for k in ks:
+                search_index.search(
+                    [(Pointer((1 << 62)), np.zeros(dim, np.float32), k,
+                      None)])
+                out["compiled"].append(("search", (k,)))
+    out["seconds"] = round(_time.perf_counter() - t0, 3)
+    return out

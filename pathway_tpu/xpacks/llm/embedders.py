@@ -50,8 +50,15 @@ class JaxEncoderEmbedder(BaseEmbedder):
                  call_kwargs: dict = {}, **kwargs):
         kwargs.setdefault("batch", True)
         kwargs.setdefault("deterministic", True)
+        kwargs.setdefault("device", True)  # pipeline via the device bridge
         super().__init__(**kwargs)
         import jax
+
+        from pathway_tpu.warmup import maybe_enable_compilation_cache
+
+        # opt-in persistent XLA cache (PATHWAY_COMPILATION_CACHE): the ~18
+        # bucket shapes compile once per machine, not once per process
+        maybe_enable_compilation_cache()
 
         from pathway_tpu.models.encoder import EncoderConfig, encode, \
             init_params
@@ -93,6 +100,24 @@ class JaxEncoderEmbedder(BaseEmbedder):
             b = -(-n // 32) * 32
         return min(b, self.max_len)
 
+    def bucket_widths(self) -> list[int]:
+        """Every padded width ``_bucket`` can produce for this ``max_len``
+        (~18 shapes at 512) — the exact compile set ``pw.warmup`` walks so
+        a warmed process (or a persistent-cache hit) never compiles the
+        encoder inside a serving tick."""
+        widths: list[int] = []
+        w = 16
+        while w <= min(64, self.max_len):
+            widths.append(w)
+            w += 16
+        w = 96
+        while w < self.max_len:
+            widths.append(w)
+            w += 32
+        if self.max_len not in widths:
+            widths.append(self.max_len)
+        return widths
+
     def pack_tokens(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
         """Tokenize + bucket-pad, returning ``(ids, lens)`` ready for the
         packed device producer — int16 ids when the vocab fits."""
@@ -130,8 +155,12 @@ class JaxEncoderEmbedder(BaseEmbedder):
         return np.asarray(self.encode_batch_device(texts))
 
     def __wrapped__(self, texts: list[str], **kwargs) -> list[np.ndarray]:
-        emb = self.embed_batch(list(texts))
-        return [emb[i] for i in range(emb.shape[0])]
+        # ONE device→host transfer for the whole batch, then zero-copy row
+        # views into it (ndarray iteration yields views, never copies) —
+        # per-row np.array(...) slicing would re-allocate B×hidden floats
+        # per tick on the hot path. The fused on-device ingest
+        # (ops/knn.py) bypasses this entirely: embeddings stay in HBM.
+        return list(self.embed_batch(list(texts)))
 
     def get_embedding_dimension(self, **kwargs) -> int:
         return int(self.config.hidden)
@@ -233,6 +262,7 @@ class ClipEmbedder(BaseEmbedder):
                  seed: int = 0, **kwargs):
         kwargs.setdefault("batch", True)
         kwargs.setdefault("deterministic", True)
+        kwargs.setdefault("device", True)  # pipeline via the device bridge
         super().__init__(**kwargs)
         import jax
 
@@ -283,8 +313,8 @@ class ClipEmbedder(BaseEmbedder):
         return np.asarray(self._encode_image(self.params, px))
 
     def __wrapped__(self, texts: list[str], **kwargs) -> list[np.ndarray]:
-        emb = self.embed_text_batch(list(texts))
-        return [emb[i] for i in range(emb.shape[0])]
+        # zero-copy row views of the single batch transfer
+        return list(self.embed_text_batch(list(texts)))
 
     def image(self):
         """A UDF embedding image bytes/arrays into the shared space."""
@@ -292,11 +322,12 @@ class ClipEmbedder(BaseEmbedder):
 
         class _ImageUDF(BaseEmbedder):
             def __init__(self):
-                super().__init__(batch=True, deterministic=True)
+                super().__init__(batch=True, deterministic=True,
+                                 device=True)
 
             def __wrapped__(self, images: list, **kwargs):
-                emb = outer.embed_image_batch(list(images))
-                return [emb[i] for i in range(emb.shape[0])]
+                # zero-copy row views of the single batch transfer
+                return list(outer.embed_image_batch(list(images)))
 
             def get_embedding_dimension(self, **kwargs) -> int:
                 return int(outer.config.embed_dim)

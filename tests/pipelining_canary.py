@@ -1,0 +1,156 @@
+"""Pipelining smoke gate: the async device path must actually run.
+
+Drives ``examples/streaming_etl.py``'s real graph (``build()``) over a
+small order feed with ``PATHWAY_DEVICE_INFLIGHT=2`` and asserts, from the
+live ``/metrics`` endpoint and the scheduler's bridge counters, that
+
+1. the device bridge resolved > 0 legs (the traceable ``demand_score``
+   UDF and its downstream window/sink rode the async leg — a silent fall
+   back to synchronous execution fails the gate), and
+2. the CSV output is complete and identical to a ``PATHWAY_DEVICE_INFLIGHT=1``
+   (synchronous) run — overlap must never change results.
+
+Exits 0 iff both hold. Run: ``python tests/pipelining_canary.py``
+(same pattern as watchdog_canary.py: the gate is only trusted because a
+seeded property is proven end to end).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import urllib.request
+
+
+def _write_feed(root: pathlib.Path) -> tuple[str, str]:
+    orders = root / "orders"
+    orders.mkdir()
+    rows = [{"item": f"i{i % 4}", "qty": 1 + i % 3,
+             "price": 2.5 * (1 + i % 5), "ts": 60 * i} for i in range(24)]
+    (orders / "orders.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n")
+    cats = root / "categories.csv"
+    cats.write_text("item,category\n" + "\n".join(
+        f"i{i},cat{i % 2}" for i in range(4)) + "\n")
+    return str(orders), str(cats)
+
+
+def _run(inflight: int, with_http: bool) -> tuple[list, dict | None, str]:
+    os.environ["PATHWAY_DEVICE_INFLIGHT"] = str(inflight)
+    import pathway_tpu as pw
+    from examples.streaming_etl import build
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    with tempfile.TemporaryDirectory() as td:
+        root = pathlib.Path(td)
+        orders_dir, cats_csv = _write_feed(root)
+        out_csv = str(root / "out.csv")
+        build(orders_dir, cats_csv, out_csv)
+        # the order feed tails its directory (mode="streaming", never
+        # closes): run on a background thread, observe the live runtime,
+        # then stop it once the bridge and the sink have visibly worked
+        import threading
+
+        metrics_txt = ""
+
+        def _run_pipeline():
+            pw.run(with_http_server=with_http)
+
+        t = threading.Thread(target=_run_pipeline, daemon=True)
+        t.start()
+        import time
+
+        deadline = time.monotonic() + 30.0
+        from pathway_tpu.engine import streaming as _streaming
+
+        rt = None
+        while time.monotonic() < deadline and rt is None:
+            live = list(_streaming._ACTIVE_RUNTIMES)
+            rt = live[0] if live else None
+            time.sleep(0.05)
+        assert rt is not None, "runtime did not start"
+        # wait until the windowed rows visibly flowed AND the sink went
+        # quiescent (same size across two polls — the finite feed is fully
+        # ingested in one directory scan, so quiescence means complete)
+        last_size = -1
+        while time.monotonic() < deadline:
+            stats = rt.scheduler.bridge_stats()
+            legs_ok = stats is None or stats["legs_resolved"] > 0
+            size = os.path.getsize(out_csv) if os.path.exists(out_csv) \
+                else 0
+            if legs_ok and size > 0 and size == last_size:
+                break
+            last_size = size
+            time.sleep(0.25)
+        if with_http and rt.http_server is not None:
+            url = f"http://127.0.0.1:{rt.http_server.port}/metrics"
+            metrics_txt = urllib.request.urlopen(url, timeout=5).read() \
+                .decode()
+        rt.scheduler.resolve_barrier()
+        stats = rt.scheduler.bridge_stats()
+        _streaming.stop_all()
+        t.join(15.0)
+        rows = _consolidate_csv(out_csv)
+        G.clear()
+        return rows, stats, metrics_txt
+
+
+def _consolidate_csv(path: str) -> list:
+    """Final state from a CSV event log (trailing time/diff columns):
+    tick boundaries differ run to run, so the comparable artifact is the
+    net row multiset, not the raw event sequence."""
+    if not os.path.exists(path):
+        return []
+    acc: dict[tuple, int] = {}
+    with open(path) as f:
+        reader = csv.reader(f)
+        header = next(reader, None)
+        if header is None:
+            return []
+        t_pos, d_pos = header.index("time"), header.index("diff")
+        for r in reader:
+            key = tuple(v for i, v in enumerate(r) if i not in (t_pos, d_pos))
+            acc[key] = acc.get(key, 0) + int(r[d_pos])
+    return sorted(k for k, n in acc.items() for _ in range(n) if n > 0)
+
+
+def main() -> int:
+    pipelined_rows, stats, metrics_txt = _run(2, with_http=True)
+    if not stats or stats["legs_resolved"] <= 0:
+        print(f"FAIL: device bridge never resolved a leg: {stats}",
+              file=sys.stderr)
+        return 1
+    if "pathway_tpu_device_legs_resolved" not in metrics_txt:
+        print("FAIL: /metrics does not export device-bridge counters",
+              file=sys.stderr)
+        return 1
+    for line in metrics_txt.splitlines():
+        if line.startswith("pathway_tpu_device_legs_resolved"):
+            if float(line.split()[-1]) <= 0:
+                print(f"FAIL: /metrics reports zero resolved legs: {line}",
+                      file=sys.stderr)
+                return 1
+    sync_rows, sync_stats, _ = _run(1, with_http=False)
+    if sync_stats is not None:
+        print(f"FAIL: PATHWAY_DEVICE_INFLIGHT=1 still built a bridge: "
+              f"{sync_stats}", file=sys.stderr)
+        return 1
+    if not pipelined_rows or pipelined_rows != sync_rows:
+        print(f"FAIL: pipelined CSV != synchronous CSV "
+              f"({len(pipelined_rows)} vs {len(sync_rows)} rows)",
+              file=sys.stderr)
+        return 1
+    print(f"OK: bridge resolved {stats['legs_resolved']} legs "
+          f"(overlap {stats['overlap_ratio']:.0%}), outputs identical to "
+          f"synchronous run ({len(pipelined_rows)} CSV rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    sys.exit(main())

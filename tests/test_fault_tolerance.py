@@ -458,3 +458,84 @@ def test_collect_session_sleep_honors_stop():
     assert cs.sleep(30.0) is False  # returns immediately, signalling exit
     assert time.monotonic() - t0 < 1.0
     assert cs.stop_requested
+
+
+# ---------------------------------------------------------------------------
+# pipelined execution (PATHWAY_DEVICE_INFLIGHT >= 2) under injected faults
+# ---------------------------------------------------------------------------
+
+def _run_counts_with_device_leg(subject, *, inflight, monkeypatch,
+                                backend=None, policy=None, **run_kwargs):
+    """_run_counts with a traceable device UDF in the pipeline, so the
+    groupby/subscribe chain rides the scheduler's deferred device leg."""
+    import numpy as np
+
+    monkeypatch.setenv("PATHWAY_DEVICE_INFLIGHT", str(inflight))
+    G.clear()
+
+    @pw.udf(batch=True, device=True, deterministic=True, return_type=int)
+    def dev_len(ws):
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(np.asarray([len(w) for w in ws], np.int32))
+        return [int(v) for v in np.asarray(arr)]
+
+    t = pw.io.python.read(
+        subject, schema=pw.schema_from_types(word=str),
+        autocommit_duration_ms=10, persistent_id="pipelined-words",
+        connector_policy=policy)
+    t = t.select(word=t.word, wl=dev_len(t.word))
+    counts = t.groupby(t.word).reduce(word=t.word, c=pw.reducers.count())
+    state: dict[str, int] = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            state[row["word"]] = row["c"]
+        elif state.get(row["word"]) == row["c"]:
+            del state[row["word"]]
+
+    pw.io.subscribe(counts, on_change)
+    cfg = None
+    if backend is not None:
+        cfg = pw.persistence.Config.simple_config(backend)
+    pw.run(persistence_config=cfg, **run_kwargs)
+    return state
+
+
+@pytest.mark.parametrize("inflight", [1, 2])
+def test_pipelined_crash_restart_exactly_once_byte_identical(
+        inflight, monkeypatch):
+    """The PR 3 exactly-once contract is unchanged by pipelining: crash →
+    backoff restart → replay produces the identical serialized state at
+    every in-flight window (persistence commits barrier on device legs)."""
+    baseline = _run_counts_with_device_leg(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0),
+        inflight=inflight, monkeypatch=monkeypatch)
+    assert baseline == {"a": 3, "b": 2, "c": 1}
+    backend = pw.persistence.Backend.mock()
+    subject = flaky_subject(_rows(WORDS), fail_after=3, fail_attempts=2)
+    state = _run_counts_with_device_leg(
+        subject, inflight=inflight, monkeypatch=monkeypatch,
+        backend=backend, policy=_fast_policy())
+    assert type(subject).attempts == 3
+    assert json.dumps(sorted(state.items())).encode() \
+        == json.dumps(sorted(baseline.items())).encode()
+    replay = _run_counts_with_device_leg(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0),
+        inflight=inflight, monkeypatch=monkeypatch, backend=backend)
+    assert replay == baseline
+
+
+def test_pipelined_watchdog_restart_with_device_leg(monkeypatch):
+    """Watchdog abandon+restart while the pipeline routinely has a device
+    leg in flight: the stall verdict comes from reader liveness, never
+    from bridge occupancy, and recovery stays exactly-once."""
+    subject = hanging_subject(_rows(WORDS), hang_attempts=1)
+    state = _run_counts_with_device_leg(
+        subject, inflight=2, monkeypatch=monkeypatch,
+        policy=_fast_policy(max_retries=2),
+        watchdog=pw.WatchdogConfig(reader_stall_timeout_s=0.25,
+                                   tick_deadline_s=None,
+                                   poll_interval_s=0.05))
+    assert state == {"a": 3, "b": 2, "c": 1}
+    assert type(subject).attempts == 2
