@@ -74,6 +74,95 @@ _LEG_FNS = {
 }
 
 
+# -- flight beacon -----------------------------------------------------------
+# r05 lost a whole run to "backend probe hung past 240s" naming no operator,
+# no leg, no last-dispatched kernel. The child process now mirrors its
+# device-phase state (current stage, bridge depth, in-flight leg's operator
+# + seconds-since-dispatch, via engine/flight_recorder.py) into a sidecar
+# file every few seconds; the parent's hang/SIGTERM emit paths read it, so
+# the surviving JSON line names the culprit.
+
+_FLIGHT_STAGE: dict = {"stage": None, "started_at": None}
+
+
+def _flight_file() -> str | None:
+    return os.environ.get("_BENCH_FLIGHT_FILE") or None
+
+
+def _set_stage(stage: str) -> None:
+    _FLIGHT_STAGE["stage"] = stage
+    _FLIGHT_STAGE["started_at"] = time.time()
+    _write_flight_snapshot()
+
+
+def _write_flight_snapshot() -> None:
+    path = _flight_file()
+    if not path:
+        return
+    try:
+        from pathway_tpu.engine.device_bridge import live_bridge_snapshot
+        from pathway_tpu.engine.flight_recorder import live_inflight
+
+        started = _FLIGHT_STAGE["started_at"]
+        snap = {
+            "stage": _FLIGHT_STAGE["stage"],
+            "stage_age_s": (round(time.time() - started, 1)
+                            if started else None),
+            "bridge": live_bridge_snapshot(),
+            "inflight_op": live_inflight(),
+            "updated_at": time.time(),
+        }
+        with open(path + ".tmp", "w") as f:
+            json.dump(snap, f)
+        os.replace(path + ".tmp", path)
+    except Exception:  # noqa: BLE001 — the beacon must never kill a leg
+        pass
+
+
+def _start_flight_beacon(interval_s: float = 2.0) -> None:
+    if not _flight_file():
+        return
+    import threading
+
+    def run() -> None:
+        while True:
+            time.sleep(interval_s)
+            _write_flight_snapshot()
+
+    threading.Thread(target=run, daemon=True,
+                     name="bench-flight-beacon").start()
+
+
+def _flight_note() -> str | None:
+    """One-line device-phase attribution from the sidecar file (None when
+    no child ever wrote one)."""
+    path = _flight_file()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except Exception:  # noqa: BLE001 — a torn write must not mask the error
+        return None
+    parts = [f"stage={snap.get('stage')}"]
+    if snap.get("stage_age_s") is not None:
+        parts.append(f"{snap['stage_age_s']:.0f}s in stage")
+    br = snap.get("bridge")
+    if br:
+        parts.append(f"bridge depth {br['depth']}/{br['max_inflight']}")
+        leg = br.get("inflight")
+        if leg:
+            parts.append(f"leg tick {leg['tick']} dispatched "
+                         f"{leg['since_s']:.1f}s ago")
+    op = snap.get("inflight_op")
+    if op and op.get("operator"):
+        parts.append(f"in-flight op {op['operator']!r} [{op['leg']}] "
+                     f"{op['since_s']:.1f}s since dispatch")
+    age = time.time() - snap.get("updated_at", time.time())
+    parts.append(f"(snapshot {age:.0f}s old)")
+    return "; ".join(parts)
+
+
 def _run_device_legs_child() -> None:
     """Child-process entry: backend init + the legs named in
     ``_BENCH_DEVICE_LEGS``. Prints a JSON snapshot line after EVERY leg
@@ -82,7 +171,13 @@ def _run_device_legs_child() -> None:
     legs = [leg for leg in
             os.environ.get("_BENCH_DEVICE_LEGS", "").split(",")
             if leg and leg not in SKIP]
+    # flight recorder on (unless explicitly off): the framework leg's
+    # scheduler then exposes its in-flight operator to the beacon, so a
+    # hang names the stuck operator instead of just "device phase"
+    os.environ.setdefault("PATHWAY_FLIGHT_RECORDER", "1")
+    _start_flight_beacon()
     result: dict = {}
+    _set_stage("backend-init")
     try:
         import jax
 
@@ -95,10 +190,12 @@ def _run_device_legs_child() -> None:
         return
     print(json.dumps(result), flush=True)
     for leg in legs:
+        _set_stage(leg)
         try:
             result.update(_LEG_FNS[leg]())
         except Exception as e:  # noqa: BLE001
             result[f"{leg}_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+        _set_stage(f"{leg}:done")
         print(json.dumps(result), flush=True)
 
 
@@ -164,15 +261,18 @@ def _run_leg_group(legs: list[str], timeout_s: float) -> dict:
                 timeout=try_budget)
         except subprocess.TimeoutExpired as e:
             # salvage the last snapshot line — completed legs survive a
-            # hang in a later leg
+            # hang in a later leg; the flight note names what was in
+            # flight when the axe fell
+            note = _flight_note()
+            suffix = f"; {note}" if note else ""
             salvaged = _last_json_line(e.stdout)
             if salvaged is not None:
                 salvaged["device_hang_error"] = (
                     f"legs {legs} exceeded {timeout_s:.0f}s; "
-                    "kept legs completed before the hang")
+                    f"kept legs completed before the hang{suffix}")
                 return salvaged
             last_err = (f"legs {legs} exceeded {timeout_s:.0f}s "
-                        "(backend hang?)")
+                        f"(backend hang?){suffix}")
             continue
         out = _last_json_line(proc.stdout)
         if out is not None:
@@ -253,6 +353,16 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             errors["etl_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
+    # sidecar path for the device-phase flight beacon, inherited by the
+    # child processes; every emit below reads it, so the last surviving
+    # JSON line always carries whatever attribution the child reported
+    if not ({"embed", "framework", "knn"} <= SKIP) \
+            and "_BENCH_FLIGHT_FILE" not in os.environ:
+        import tempfile
+
+        os.environ["_BENCH_FLIGHT_FILE"] = os.path.join(
+            tempfile.gettempdir(), f"bench_flight_{os.getpid()}.json")
+
     def emit(extra_error: str | None = None) -> None:
         # value/vs_baseline are null — not a real-looking 0.0 — when the
         # embed leg never produced a measurement
@@ -260,6 +370,12 @@ def main() -> None:
         err = dict(errors)
         if extra_error:
             err["bench_error"] = extra_error
+        note = _flight_note()
+        if note:
+            # device-phase attribution (stage, bridge depth, in-flight
+            # leg's operator + seconds-since-dispatch) from the child's
+            # flight beacon — see _flight_note
+            err["device_phase"] = note
         print(json.dumps({
             "metric": "RAG docs/sec/chip (embed+index); p50 KNN @10M",
             "value": None if docs_per_sec is None else round(docs_per_sec, 1),

@@ -40,8 +40,27 @@ from __future__ import annotations
 import os
 import threading
 import time as _time
+import weakref
 from collections import deque
 from typing import Callable
+
+# live bridges (weak: a bridge dies with its scheduler). Out-of-band
+# observers — bench.py's flight beacon, post-mortem dumps — read depth and
+# the in-flight leg without a reference threaded through every layer.
+_LIVE: "weakref.WeakSet[DeviceBridge]" = weakref.WeakSet()
+
+
+def live_bridge_snapshot() -> dict | None:
+    """Stats + in-flight leg of any live bridge (None when no bridge
+    exists). With several bridges, prefers one with a leg in flight."""
+    best = None
+    for b in list(_LIVE):
+        snap = b.stats()
+        snap["inflight"] = b.inflight()
+        if snap["inflight"] is not None:
+            return snap
+        best = best or snap
+    return best
 
 
 def device_inflight_from_env() -> int:
@@ -56,9 +75,15 @@ def device_inflight_from_env() -> int:
 class DeviceBridge:
     """FIFO dispatch queue for per-tick device legs (see module doc)."""
 
-    def __init__(self, max_inflight: int = 2, name: str = "device-bridge"):
+    def __init__(self, max_inflight: int = 2, name: str = "device-bridge",
+                 recorder=None):
         self.max_inflight = max(1, int(max_inflight))
         self.name = name
+        # flight recorder (engine/flight_recorder.py): leg-level spans
+        # (queue-wait vs execute) and the in-flight marker for post-mortems
+        self.recorder = recorder
+        self._current: tuple | None = None  # (tick, started_monotonic)
+        _LIVE.add(self)
         self._cv = threading.Condition()
         self._queue: deque = deque()  # (tick, fn, submitted_at)
         self._running = False
@@ -134,6 +159,17 @@ class DeviceBridge:
         if thread is not None:
             thread.join(join_timeout)
 
+    def inflight(self) -> dict | None:
+        """The leg currently executing: tick + seconds since it started
+        (None when idle). The operator-level detail lives on the attached
+        flight recorder; this survives even with recording off, so bench's
+        hang paths can always report seconds-since-dispatch."""
+        cur = self._current
+        if cur is None:
+            return None
+        return {"tick": cur[0],
+                "since_s": round(_time.monotonic() - cur[1], 3)}
+
     def error(self) -> BaseException | None:
         """The stored leg failure, if any (without raising). Lets teardown
         paths that must not raise mid-cleanup (Scheduler.close → drain)
@@ -173,21 +209,43 @@ class DeviceBridge:
                     return
                 tick, fn, submitted_at = self._queue.popleft()
                 self._running = True
+                self._current = (tick, _time.monotonic())
                 # a host thread already blocked on us? then this leg is
                 # (at least partially) serialized with host work
                 waited_at_start = self._waiters > 0
+            rec = self.recorder
+            recording = rec is not None and rec.enabled
+            if recording:
+                rec.mark_leg(tick)
             started = _time.perf_counter()
             try:
                 fn()
             except BaseException as e:  # noqa: BLE001 — must cross threads
+                if recording:
+                    # poison carries the flight-recorder tail: the host
+                    # thread re-raises this exact object, so the next
+                    # "device leg failed" report names operator + frame
+                    from pathway_tpu.engine.flight_recorder import \
+                        attach_note
+
+                    tail = rec.dump_tail()
+                    if tail:
+                        attach_note(
+                            e, f"device leg poisoned at tick {tick}; "
+                               f"flight recorder tail:\n{tail}")
                 with self._cv:
                     self._error = e
                     self._running = False
+                    self._current = None
                     # later ticks must not execute on top of a failed one
                     self._queue.clear()
                     self._cv.notify_all()
                 continue  # keep serving barrier wake-ups until close
             finished = _time.perf_counter()
+            if recording:
+                rec.record_leg(tick, (started - submitted_at) * 1e3,
+                               (finished - started) * 1e3)
+                rec.clear_leg()
             with self._cv:
                 self.queue_wait_ms += (started - submitted_at) * 1e3
                 self.exec_ms += (finished - started) * 1e3
@@ -195,4 +253,5 @@ class DeviceBridge:
                 if not waited_at_start and self._waiters == 0:
                     self.legs_overlapped += 1
                 self._running = False
+                self._current = None
                 self._cv.notify_all()

@@ -1,0 +1,449 @@
+"""Flight recorder: ring-buffered per-operator tick tracing.
+
+The reference engine exports per-operator latency gauges and OTLP spans
+(src/engine/telemetry.rs:196-366); this module is the port's in-process
+counterpart, sized for post-mortems rather than dashboards: a bounded ring
+of structured span events — tick, operator id + class + user frame
+(internals/trace.py), host vs. device leg, queue-wait vs. execute time,
+rows in/out — written by the Scheduler (engine/graph.py) and the device
+bridge (engine/device_bridge.py).
+
+Consumers:
+
+- ``PATHWAY_TRACE_PATH`` / ``pw.run(trace_path=)`` — Chrome trace-event
+  JSON (opens directly in Perfetto), host and device legs on separate
+  tracks, operator spans carrying user-frame attribution;
+- ``/metrics`` — per-operator latency histograms + row counters;
+  ``/trace`` — the last-N-ticks buffer as JSON (engine/http_server.py);
+- post-mortem dumps — watchdog fire, device-bridge poison and bench's
+  device-phase hang each embed :meth:`FlightRecorder.dump_tail`, so a
+  "tunnel unhealthy" run names its stuck operator instead of nothing;
+- a configured OTel SDK — recorded spans flow through the run's
+  ``Telemetry`` provider (internals/telemetry.py) with real timestamps.
+
+Cost model: **disabled is the default and costs one predictable branch per
+operator step, no allocation** (the Scheduler holds ``recorder=None`` or an
+``enabled=False`` recorder; both short-circuit before any tuple is built).
+Enabled, each step appends one tuple to a deque and bumps a fixed-bucket
+histogram under a lock — the lock is uncontended except when a device leg
+retires concurrently with host work.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+import weakref
+
+# Prometheus-style latency buckets (ms). +Inf is implicit as the last
+# cumulative bucket. Chosen to straddle both sub-ms host operators and
+# multi-second device dispatches through a dev tunnel.
+LATENCY_BUCKETS_MS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 500.0, 1000.0, 2500.0, 10_000.0,
+)
+
+_DEFAULT_BUFFER_EVENTS = 4096
+_DEFAULT_TAIL_TICKS = 8
+
+# live enabled recorders (weak: a recorder dies with its scheduler/run).
+# Lets out-of-band observers — bench.py's flight beacon — find the run's
+# in-flight operator without plumbing a reference through every layer.
+_LIVE: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+
+
+def live_inflight() -> dict | None:
+    """The in-flight operator summary of any live enabled recorder
+    (None when nothing is recording or nothing is in flight)."""
+    for rec in list(_LIVE):
+        if rec.enabled:
+            info = rec.inflight_summary()
+            if info is not None:
+                return info
+    return None
+
+
+def attach_note(e: BaseException, note: str) -> None:
+    """PEP 678 note with the pre-3.11 emulation (same storage contract as
+    internals/trace.py add_trace_note, shared here so exceptions raised on
+    the bridge worker can carry the recorder tail across threads)."""
+    if note in getattr(e, "__notes__", ()):
+        return
+    if hasattr(e, "add_note"):
+        e.add_note(note)
+    else:
+        notes = getattr(e, "__notes__", None)
+        if notes is None:
+            notes = []
+            e.__notes__ = notes
+        notes.append(note)
+
+
+class _OpStats:
+    """Per-operator aggregate: fixed-bucket latency histogram + row
+    counters + identity (name, operator class, user frame) captured once."""
+
+    __slots__ = ("name", "op_class", "frame", "bucket_counts", "sum_ms",
+                 "count", "rows_in", "rows_out")
+
+    def __init__(self, name: str, op_class: str, frame: str | None):
+        self.name = name
+        self.op_class = op_class
+        self.frame = frame
+        self.bucket_counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)
+        self.sum_ms = 0.0
+        self.count = 0
+        self.rows_in = 0
+        self.rows_out = 0
+
+    def observe(self, ms: float, rows_in: int, rows_out: int) -> None:
+        i = 0
+        for b in LATENCY_BUCKETS_MS:
+            if ms <= b:
+                break
+            i += 1
+        self.bucket_counts[i] += 1
+        self.sum_ms += ms
+        self.count += 1
+        self.rows_in += rows_in
+        self.rows_out += rows_out
+
+
+class FlightRecorder:
+    """Ring-buffered span recorder for one scheduler (see module doc)."""
+
+    def __init__(self, trace_path: str | None = None,
+                 buffer_events: int | None = None):
+        self.enabled = False
+        self.trace_path = trace_path
+        if buffer_events is None:
+            from pathway_tpu.internals.config import _env_int
+
+            buffer_events = max(256, _env_int("PATHWAY_TRACE_BUFFER_EVENTS",
+                                              _DEFAULT_BUFFER_EVENTS))
+        self._lock = threading.Lock()
+        # (tick, op_id, leg, t0_perf, dur_ms, rows_in, rows_out)
+        self._events: collections.deque = collections.deque(
+            maxlen=buffer_events)
+        self._ops: dict[int, _OpStats] = {}
+        # device-leg level events: (tick, queue_wait_ms, exec_ms)
+        self._legs: collections.deque = collections.deque(maxlen=512)
+        # in-flight markers, ONE SLOT PER STEPPING THREAD: host thread(s),
+        # sharded pool workers and the bridge worker each own the slot
+        # keyed by their thread id, so a device op hung for minutes keeps
+        # its marker while other threads churn theirs (the whole point of
+        # stall attribution). Dict item set/del is atomic under the GIL.
+        self._inflight_op: dict = {}
+        # thread id -> (tick, leg, node, started_monotonic)
+        self._inflight_leg = None  # (tick, dispatched_monotonic)
+        # trace time base: perf_counter for durations, wall ns for OTel
+        self._epoch = time.perf_counter()
+        self._wall_ns_offset = time.time_ns() - int(self._epoch * 1e9)
+        self._otel = None
+        self._jax_annotation = None  # cached class / False after probe
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_env(cls, trace_path: str | None = None,
+                 auto_on: bool = False) -> "FlightRecorder | None":
+        """The run-level recorder, or None when recording is off.
+
+        Enabled when a trace path is given (argument or
+        ``PATHWAY_TRACE_PATH``), when ``PATHWAY_FLIGHT_RECORDER`` is
+        truthy, or when the caller's surface makes the data observable
+        (``auto_on``: http server / live dashboard).
+        ``PATHWAY_FLIGHT_RECORDER=0`` force-disables everything."""
+        flag = os.environ.get("PATHWAY_FLIGHT_RECORDER", "").strip().lower()
+        if flag in ("0", "false", "off", "no"):
+            return None
+        tp = trace_path or os.environ.get("PATHWAY_TRACE_PATH") or None
+        forced = flag in ("1", "true", "on", "yes")
+        if tp is None and not forced and not auto_on:
+            return None
+        rec = cls(trace_path=tp)
+        rec.enabled = True
+        _LIVE.add(rec)
+        return rec
+
+    def set_telemetry(self, telemetry) -> None:
+        """Route recorded spans through the run's OTel provider — only
+        when a real SDK pipeline is wired (API-only mode would pay span
+        construction for a no-op exporter)."""
+        if telemetry is not None \
+                and getattr(telemetry, "_provider", None) is not None:
+            self._otel = telemetry
+
+    # -- hot-path write side ----------------------------------------------
+    def mark_op(self, tick: int, node, leg: str) -> None:
+        self._inflight_op[threading.get_ident()] = (
+            tick, leg, node, time.monotonic())
+
+    def clear_op(self) -> None:
+        self._inflight_op.pop(threading.get_ident(), None)
+
+    def record(self, tick: int, node, leg: str, t0: float, dur_ms: float,
+               rows_in: int, rows_out: int) -> None:
+        with self._lock:
+            st = self._ops.get(node.id)
+            if st is None:
+                trace = getattr(node, "trace", None)
+                st = self._ops[node.id] = _OpStats(
+                    node.name or type(node.op).__name__,
+                    type(node.op).__name__,
+                    str(trace) if trace is not None else None)
+            st.observe(dur_ms, rows_in, rows_out)
+            self._events.append(
+                (tick, node.id, leg, t0, dur_ms, rows_in, rows_out))
+        if self._otel is not None:
+            self._emit_otel_span(st, tick, leg, t0, dur_ms, rows_in,
+                                 rows_out)
+
+    def mark_leg(self, tick: int) -> None:
+        self._inflight_leg = (tick, time.monotonic())
+
+    def clear_leg(self) -> None:
+        self._inflight_leg = None
+
+    def record_leg(self, tick: int, queue_wait_ms: float,
+                   exec_ms: float) -> None:
+        with self._lock:
+            self._legs.append((tick, queue_wait_ms, exec_ms))
+
+    def device_annotation(self, tick: int):
+        """``jax.profiler.TraceAnnotation`` for one device leg, so XLA
+        profiles line up with framework spans; nullcontext when jax is
+        unavailable. The class lookup is probed once."""
+        if self._jax_annotation is None:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._jax_annotation = TraceAnnotation
+            except Exception:
+                self._jax_annotation = False
+        if self._jax_annotation is False:
+            return contextlib.nullcontext()
+        return self._jax_annotation(f"pathway.device_leg.t{tick}")
+
+    def _emit_otel_span(self, st: _OpStats, tick: int, leg: str, t0: float,
+                        dur_ms: float, rows_in: int, rows_out: int) -> None:
+        try:
+            start_ns = int(t0 * 1e9) + self._wall_ns_offset
+            span = self._otel.tracer.start_span(
+                f"pathway.operator.{st.name}", start_time=start_ns)
+            span.set_attribute("pathway.tick", tick)
+            span.set_attribute("pathway.leg", leg)
+            span.set_attribute("pathway.operator_class", st.op_class)
+            span.set_attribute("pathway.rows_in", rows_in)
+            span.set_attribute("pathway.rows_out", rows_out)
+            if st.frame:
+                span.set_attribute("pathway.user_frame", st.frame)
+            span.end(end_time=start_ns + int(dur_ms * 1e6))
+        except Exception:  # noqa: BLE001 — telemetry must never kill a step
+            self._otel = None
+
+    # -- read side ---------------------------------------------------------
+    def op_stats(self) -> list[dict]:
+        """Histogram snapshot per operator (for /metrics): cumulative
+        bucket counts, sum/count, row totals."""
+        with self._lock:
+            items = [(op_id, st.name, st.op_class, st.frame,
+                      list(st.bucket_counts), st.sum_ms, st.count,
+                      st.rows_in, st.rows_out)
+                     for op_id, st in self._ops.items()]
+        out = []
+        for (op_id, name, op_class, frame, counts, sum_ms, count,
+             rows_in, rows_out) in items:
+            cum = []
+            acc = 0
+            for le, c in zip(LATENCY_BUCKETS_MS, counts):
+                acc += c
+                cum.append((le, acc))
+            cum.append((float("inf"), acc + counts[-1]))
+            out.append({
+                "id": op_id, "name": name, "op_class": op_class,
+                "frame": frame, "buckets": cum, "sum_ms": sum_ms,
+                "count": count, "rows_in": rows_in, "rows_out": rows_out,
+            })
+        return out
+
+    def tail_events(self, n_ticks: int | None = None) -> list[tuple]:
+        """The buffered events of the last ``n_ticks`` distinct ticks
+        (all buffered events when None), oldest first."""
+        with self._lock:
+            evs = list(self._events)
+        if n_ticks is None or not evs:
+            return evs
+        keep: set = set()
+        for ev in reversed(evs):  # ticks appear in decreasing order
+            if ev[0] not in keep:
+                if len(keep) >= n_ticks:
+                    break
+                keep.add(ev[0])
+        return [ev for ev in evs if ev[0] in keep]
+
+    def _op_meta(self, op_id: int) -> tuple[str, str | None]:
+        with self._lock:
+            st = self._ops.get(op_id)
+        if st is None:
+            return (f"op{op_id}", None)
+        return (st.name, st.frame)
+
+    def inflight_summary(self) -> dict | None:
+        """The operator currently stepping (plus its leg/frame) — the
+        post-mortem answer to "what was the engine doing when it hung"."""
+        slots = list(self._inflight_op.values())
+        now = time.monotonic()
+        if slots:
+            # several threads mid-step: name the one stuck longest
+            tick, leg, node, started = min(slots, key=lambda s: s[3])
+            trace = getattr(node, "trace", None)
+            return {
+                "tick": tick,
+                "leg": leg,
+                "operator": node.name or type(node.op).__name__,
+                "op_class": type(node.op).__name__,
+                "user_frame": str(trace) if trace is not None else None,
+                "since_s": round(now - started, 3),
+            }
+        leg = self._inflight_leg
+        if leg is not None:
+            return {"tick": leg[0], "leg": "device", "operator": None,
+                    "op_class": None, "user_frame": None,
+                    "since_s": round(now - leg[1], 3)}
+        return None
+
+    def dump_tail(self, n_ticks: int = _DEFAULT_TAIL_TICKS,
+                  max_lines: int = 60) -> str:
+        """Human-readable post-mortem block: the last-N-ticks span tail
+        plus the currently in-flight leg with its operator and user frame.
+        Empty string when nothing was recorded."""
+        evs = self.tail_events(n_ticks)
+        lines = []
+        for tick, op_id, leg, _t0, dur_ms, rows_in, rows_out in \
+                evs[-max_lines:]:
+            name, _ = self._op_meta(op_id)
+            lines.append(f"  tick {tick} [{leg}] {name}: {dur_ms:.2f}ms "
+                         f"rows {rows_in}->{rows_out}")
+        info = self.inflight_summary()
+        if info is not None:
+            who = info["operator"] or "device leg"
+            lines.append(
+                f"  IN FLIGHT: tick {info['tick']} [{info['leg']}] {who} "
+                f"({info['since_s']:.1f}s since dispatch)")
+            if info.get("user_frame"):
+                for fl in info["user_frame"].splitlines():
+                    lines.append(f"  {fl}")
+        return "\n".join(lines)
+
+    def trace_payload(self, n_ticks: int | None = None) -> dict:
+        """JSON-friendly snapshot for the ``/trace`` endpoint."""
+        events = []
+        for tick, op_id, leg, t0, dur_ms, rows_in, rows_out in \
+                self.tail_events(n_ticks):
+            name, frame = self._op_meta(op_id)
+            events.append({
+                "tick": tick, "operator": name, "id": op_id, "leg": leg,
+                "ts_ms": round((t0 - self._epoch) * 1e3, 3),
+                "dur_ms": round(dur_ms, 3),
+                "rows_in": rows_in, "rows_out": rows_out,
+                "user_frame": frame,
+            })
+        with self._lock:
+            legs = [{"tick": t, "queue_wait_ms": round(q, 3),
+                     "exec_ms": round(e, 3)} for t, q, e in self._legs]
+        return {"enabled": self.enabled, "events": events,
+                "device_legs": legs, "inflight": self.inflight_summary()}
+
+    def dominator(self) -> dict | None:
+        """The operator that dominated the last complete tick (critical
+        path attribution for /status and the dashboard)."""
+        evs = self.tail_events(1)
+        if not evs:
+            return None
+        tick = evs[-1][0]
+        best = None
+        total = 0.0
+        for ev in evs:
+            total += ev[4]
+            if best is None or ev[4] > best[4]:
+                best = ev
+        name, frame = self._op_meta(best[1])
+        return {"tick": tick, "operator": name, "leg": best[2],
+                "ms": round(best[4], 3),
+                "share": round(best[4] / total, 3) if total > 0 else 0.0,
+                "user_frame": frame}
+
+    # -- Chrome trace-event export ----------------------------------------
+    def chrome_trace_events(self) -> list[dict]:
+        """Trace-event list: host and device legs as separate tracks
+        (tid 0/1 with thread_name metadata), per-(tick, leg) wrapper spans
+        containing operator spans — all B/E pairs, properly nested, so the
+        file opens directly in Perfetto."""
+        pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        tids = {"host": 0, "device": 1}
+        out = [
+            {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+             "args": {"name": f"{leg} leg"}}
+            for leg, tid in tids.items()
+        ]
+        evs = self.tail_events(None)
+        # group by (tick, leg) preserving order; events within a leg are
+        # sequential (one thread per leg), so wrapper = [min start, max end]
+        groups: dict[tuple, list] = {}
+        order: list[tuple] = []
+        for ev in evs:
+            k = (ev[0], ev[2])
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(ev)
+        leg_meta = {}
+        with self._lock:
+            for tick, q, e in self._legs:
+                leg_meta[tick] = (q, e)
+        for tick, leg in order:
+            g = groups[(tick, leg)]
+            tid = tids.get(leg, 2)
+            start_us = (g[0][3] - self._epoch) * 1e6
+            end_us = max((ev[3] - self._epoch + ev[4] / 1e3) * 1e6
+                         for ev in g)
+            wrap_args = {"tick": tick, "leg": leg}
+            if leg == "device" and tick in leg_meta:
+                wrap_args["queue_wait_ms"] = round(leg_meta[tick][0], 3)
+                wrap_args["exec_ms"] = round(leg_meta[tick][1], 3)
+            out.append({"ph": "B", "pid": pid, "tid": tid,
+                        "ts": start_us, "cat": leg,
+                        "name": f"tick {tick}", "args": wrap_args})
+            for _tick, op_id, _leg, t0, dur_ms, rows_in, rows_out in g:
+                name, frame = self._op_meta(op_id)
+                ts = (t0 - self._epoch) * 1e6
+                args = {"tick": tick, "operator": name,
+                        "rows_in": rows_in, "rows_out": rows_out}
+                if frame:
+                    args["user_frame"] = frame
+                out.append({"ph": "B", "pid": pid, "tid": tid, "ts": ts,
+                            "cat": leg, "name": name, "args": args})
+                out.append({"ph": "E", "pid": pid, "tid": tid,
+                            "ts": ts + dur_ms * 1e3, "cat": leg,
+                            "name": name})
+            out.append({"ph": "E", "pid": pid, "tid": tid, "ts": end_us,
+                        "cat": leg, "name": f"tick {tick}"})
+        return out
+
+    def write_chrome_trace(self, path: str | None = None) -> str | None:
+        """Serialize the buffer to Chrome trace JSON at ``path`` (defaults
+        to the configured trace_path); returns the path written or None."""
+        path = path or self.trace_path
+        if not path:
+            return None
+        payload = {"traceEvents": self.chrome_trace_events(),
+                   "displayTimeUnit": "ms"}
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return path

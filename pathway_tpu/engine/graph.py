@@ -132,9 +132,14 @@ class Scheduler:
 
     def __init__(self, graph: EngineGraph, n_workers: int = 1,
                  parallel_threads: bool | None = None, cluster=None,
-                 device_inflight: int | None = None):
+                 device_inflight: int | None = None, recorder=None):
         self.graph = graph
         self.cluster = cluster
+        # flight recorder (engine/flight_recorder.py): None or disabled is
+        # the hot-path default — one branch per operator step, no
+        # allocation; runtimes pass an enabled recorder when tracing /
+        # monitoring surfaces want span data
+        self.recorder = recorder
         if cluster is not None:
             # SPMD multi-process: n_workers is per-process; the global
             # worker space is P x T, owned in contiguous blocks
@@ -228,13 +233,17 @@ class Scheduler:
         self.device_inflight = max(1, int(device_inflight))
         self._bridge = None
         self._deferred_ids: frozenset[int] = frozenset()
+        device_nodes = [n.id for n in graph.nodes
+                        if getattr(n.op, "device_bound", False)]
         if (self.device_inflight >= 2 and self.n_workers == 1
-                and cluster is None):
-            device_nodes = [n.id for n in graph.nodes
-                            if getattr(n.op, "device_bound", False)]
-            if device_nodes:
-                self._deferred_ids = self._downstream_closure(device_nodes)
-                self._bridge = DeviceBridge(self.device_inflight)
+                and cluster is None and device_nodes):
+            self._deferred_ids = self._downstream_closure(device_nodes)
+            self._bridge = DeviceBridge(self.device_inflight,
+                                        recorder=self.recorder)
+        # trace labeling: deferred-closure nodes are the device leg when
+        # pipelining; synchronous mode still labels the device-bound
+        # operators themselves so traces distinguish legs in both modes
+        self._trace_device_ids = self._deferred_ids or frozenset(device_nodes)
 
     def _downstream_closure(self, roots: list[int]) -> frozenset[int]:
         """All nodes reachable from ``roots`` (inclusive) following output
@@ -399,12 +408,23 @@ class Scheduler:
             self._count(node.id, delta)
 
         def leg() -> None:
-            for node in deferred:
-                in_deltas = [outputs.get(up.id, _EMPTY)
-                             for up in node.inputs]
-                delta = self._step_op(node, node.op, time, in_deltas, flush)
-                outputs[node.id] = delta
-                self._count(node.id, delta)
+            def _body() -> None:
+                for node in deferred:
+                    in_deltas = [outputs.get(up.id, _EMPTY)
+                                 for up in node.inputs]
+                    delta = self._step_op(node, node.op, time, in_deltas,
+                                          flush)
+                    outputs[node.id] = delta
+                    self._count(node.id, delta)
+
+            rec = self.recorder
+            if rec is not None and rec.enabled:
+                # jax.profiler.TraceAnnotation: XLA profiles show the same
+                # tick boundaries as the framework's flight-recorder spans
+                with rec.device_annotation(time):
+                    _body()
+            else:
+                _body()
 
         self._bridge.submit(time, leg)
         if self.on_step is not None:
@@ -419,6 +439,16 @@ class Scheduler:
 
         from pathway_tpu.internals.error import set_active_step_log
 
+        # flight recorder: the disabled path is this one branch — no
+        # allocation, no call (the overhead guard in tests/trace_canary.py
+        # holds it under 2% per tick)
+        rec = self.recorder
+        recording = rec is not None and rec.enabled
+        if recording:
+            leg = "device" if node.id in self._trace_device_ids else "host"
+            # inflight marker set BEFORE the step: a hung operator is
+            # exactly the one the post-mortem must name
+            rec.mark_op(time, node, leg)
         t0 = _time.perf_counter()
         set_active_step_log(node.error_log)
         try:
@@ -450,6 +480,15 @@ class Scheduler:
         with self._stats_lock:
             st["latency_ms"] = ms
             st["total_ms"] += ms
+        if recording:
+            rows_in = 0
+            for d in in_deltas:
+                rows_in += len(d.entries)
+            rec.record(time, node, leg, t0, ms, rows_in, len(delta.entries))
+            # cleared on success only: an operator that raised (or is
+            # still raising through the bridge) stays named in the
+            # in-flight slot for the post-mortem dump
+            rec.clear_op()
         return delta
 
     def _count(self, node_id: int, delta: Delta) -> None:
@@ -688,10 +727,11 @@ class _PipelinedOutputs:
         self._bridge = bridge
         self._outputs = outputs
 
-    def get(self, node_id: int, default: Delta = None) -> Delta:
+    def get(self, node_id: int, default: Delta | None = None) -> Delta | None:
+        # default passes through verbatim (dict.get contract): a caller's
+        # None-check must behave identically in pipelined and sync modes
         self._bridge.barrier()
-        return self._outputs.get(
-            node_id, _EMPTY if default is None else default)
+        return self._outputs.get(node_id, default)
 
     def __getitem__(self, node_id: int) -> Delta:
         self._bridge.barrier()

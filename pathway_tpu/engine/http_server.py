@@ -48,11 +48,36 @@ class MonitoringHttpServer:
             "sources": len(self.runtime.sessions),
             "operators": operators,
         }
+        # critical-path attribution: which operator dominated the last
+        # tick. latency_ms is each operator's LAST step latency, so the
+        # max over operators is exactly the last tick's dominator; the
+        # flight recorder (when on) adds leg + user-frame detail.
+        if operators:
+            dom = max(operators, key=lambda o: o["latency_ms"])
+            payload["last_tick_dominator"] = {
+                "operator": dom["name"], "ms": dom["latency_ms"]}
+            rec = getattr(sched, "recorder", None)
+            if rec is not None and rec.enabled:
+                detail = rec.dominator()
+                if detail is not None:
+                    payload["last_tick_dominator"] = detail
         bridge = sched.bridge_stats() if hasattr(sched, "bridge_stats") \
             else None
         if bridge is not None:
+            bridge = dict(bridge)
+            bridge["inflight"] = sched._bridge.inflight() \
+                if getattr(sched, "_bridge", None) is not None else None
             payload["device_bridge"] = bridge
         return payload
+
+    def trace_payload(self) -> dict:
+        """``/trace``: the flight recorder's last-N-ticks span buffer
+        (empty shell with enabled=false when nothing is recording)."""
+        rec = getattr(self.runtime.scheduler, "recorder", None)
+        if rec is None:
+            return {"enabled": False, "events": [], "device_legs": [],
+                    "inflight": None}
+        return rec.trace_payload()
 
     def healthz_payload(self) -> tuple[bool, dict]:
         """(healthy, body) for ``/healthz``: 200 while every supervised
@@ -107,6 +132,38 @@ class MonitoringHttpServer:
                 f"pathway_tpu_operator_latency_ms{labels} {op['latency_ms']}")
             lines.append(
                 f"pathway_tpu_operator_total_ms{labels} {op['total_ms']}")
+        rec = getattr(self.runtime.scheduler, "recorder", None)
+        if rec is not None and rec.enabled:
+            ops = rec.op_stats()
+            if ops:
+                # per-operator step-latency histograms + row counters from
+                # the flight recorder (engine/flight_recorder.py) — the
+                # stage-level visibility the reference exports as OTLP
+                # latency gauges (telemetry.rs:312-366)
+                lines.append("# TYPE pathway_tpu_operator_step_duration_ms"
+                             " histogram")
+                lines.append("# TYPE pathway_tpu_operator_rows_in counter")
+                lines.append("# TYPE pathway_tpu_operator_rows_out counter")
+                for st in ops:
+                    base = f'operator="{esc(st["name"])}",id="{st["id"]}"'
+                    for le, c in st["buckets"]:
+                        le_s = "+Inf" if le == float("inf") \
+                            else format(le, "g")
+                        lines.append(
+                            "pathway_tpu_operator_step_duration_ms_bucket"
+                            f'{{{base},le="{le_s}"}} {c}')
+                    lines.append(
+                        "pathway_tpu_operator_step_duration_ms_sum"
+                        f"{{{base}}} {round(st['sum_ms'], 6)}")
+                    lines.append(
+                        "pathway_tpu_operator_step_duration_ms_count"
+                        f"{{{base}}} {st['count']}")
+                    lines.append(
+                        f"pathway_tpu_operator_rows_in{{{base}}} "
+                        f"{st['rows_in']}")
+                    lines.append(
+                        f"pathway_tpu_operator_rows_out{{{base}}} "
+                        f"{st['rows_out']}")
         sup = getattr(self.runtime, "supervisor", None)
         if sup is not None and sup.entries:
             # connector supervision counters (engine/supervisor.py):
@@ -179,6 +236,9 @@ class MonitoringHttpServer:
                     body = json.dumps(payload).encode()
                     ctype = "application/json"
                     code = 200 if healthy else 503
+                elif self.path.rstrip("/") == "/trace":
+                    body = json.dumps(server.trace_payload()).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -42,7 +42,8 @@ class StreamingRuntime:
     def __init__(self, runner, *, monitoring_level=None, with_http_server=False,
                  persistence_config=None, terminate_on_error=True,
                  default_commit_ms: int = 100, n_workers: int | None = None,
-                 cluster=None, connector_policy=None, watchdog=None):
+                 cluster=None, connector_policy=None, watchdog=None,
+                 trace_path: str | None = None):
         from pathway_tpu.engine.supervisor import ConnectorSupervisor
         from pathway_tpu.io._datasource import Session
 
@@ -52,18 +53,27 @@ class StreamingRuntime:
             n_workers = get_pathway_config().threads
         self.runner = runner
         self.cluster = cluster
-        self.scheduler = Scheduler(runner.graph, n_workers=n_workers,
-                                   cluster=cluster)
-        self.sessions = []
         self.default_commit_ms = default_commit_ms
         self._stop = threading.Event()
         self.monitor = StatsMonitor(monitoring_level or MonitoringLevel.NONE)
+        # flight recorder (engine/flight_recorder.py): on when a trace
+        # path is configured or the data is observable (http server /
+        # live dashboard); otherwise None — one dead branch per op step
+        from pathway_tpu.engine.flight_recorder import FlightRecorder
+
+        self.recorder = FlightRecorder.from_env(
+            trace_path=trace_path,
+            auto_on=with_http_server or self.monitor.enabled())
+        self.scheduler = Scheduler(runner.graph, n_workers=n_workers,
+                                   cluster=cluster, recorder=self.recorder)
+        self.sessions = []
         # supervision: reader threads are owned by the supervisor, which
         # restarts crashed readers per policy and escalates per
         # terminate_on_error (engine/supervisor.py)
         self.supervisor = ConnectorSupervisor(
             terminate_on_error=terminate_on_error,
             default_policy=connector_policy)
+        self.supervisor.recorder = self.recorder
         self.monitor.set_supervisor(self.supervisor)
         self.watchdog_config = watchdog
         self.watchdog = None
@@ -273,6 +283,17 @@ class StreamingRuntime:
                 session.stopping.set()
             self.join_readers()
             _ACTIVE_RUNTIMES.discard(self)
+            if self.recorder is not None:
+                # written in the finally so a crashed run still leaves its
+                # trace on disk (the post-mortem artifact)
+                try:
+                    self.recorder.write_chrome_trace()
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "failed to write trace to %s",
+                        self.recorder.trace_path, exc_info=True)
             self.monitor.close()
             self.scheduler.close()
             if self.persistence is not None:

@@ -191,6 +191,8 @@ class _SupervisedSource:
         self.name = name
         self.state = DETACHED
         self.restarts = 0
+        self.last_restart_at: float | None = None  # monotonic, stamped on
+        #                                            every restart attempt
         self.forwarded = 0  # entries delivered past the proxy, all attempts
         self.stall_count = 0
         self.stalled = False
@@ -224,6 +226,18 @@ class ConnectorSupervisor:
         self.fatal_error: BaseException | None = None
         self.commit_stalled = False  # set/cleared by the watchdog
         self._stopping = False
+        # flight recorder (engine/flight_recorder.py), set by the runtime:
+        # stall escalations embed its tail so a ConnectorStalledError
+        # names what the engine was executing, not just the silent source
+        self.recorder = None
+
+    def _stall_error(self, msg: str) -> "ConnectorStalledError":
+        rec = self.recorder
+        if rec is not None and rec.enabled:
+            tail = rec.dump_tail()
+            if tail:
+                msg += f"\nflight recorder tail:\n{tail}"
+        return ConnectorStalledError(msg)
 
     # -- registration ------------------------------------------------------
     def add_source(self, node, datasource, session, live_session,
@@ -252,6 +266,8 @@ class ConnectorSupervisor:
         entry.attempt_started_at = now
         entry.last_activity = now
         entry.saw_activity = False
+        if entry.restarts:  # a restart, not the initial attach
+            entry.last_restart_at = now
         # state flips last: the watchdog only inspects RUNNING entries, so
         # ordering (timestamps first) keeps it from reading a fresh attempt
         # against the previous attempt's last_activity
@@ -309,7 +325,7 @@ class ConnectorSupervisor:
         if entry.stall_flagged:
             entry.stall_flagged = False
             self._abandon(entry)
-            self._on_failure(entry, ConnectorStalledError(
+            self._on_failure(entry, self._stall_error(
                 f"source {entry.name!r} stopped producing while claiming "
                 f"liveness (no push/heartbeat for "
                 f"{now - entry.last_activity:.1f}s)"), now)
@@ -319,7 +335,7 @@ class ConnectorSupervisor:
                 and now - entry.attempt_started_at
                 > entry.policy.connect_timeout):
             self._abandon(entry)
-            self._on_failure(entry, ConnectorStalledError(
+            self._on_failure(entry, self._stall_error(
                 f"source {entry.name!r} produced nothing within its "
                 f"connect_timeout ({entry.policy.connect_timeout}s)"), now)
 
@@ -393,16 +409,23 @@ class ConnectorSupervisor:
 
     # -- observability (StatsMonitor / http_server) ------------------------
     def summary(self) -> list[dict]:
+        now = time.monotonic()
         out = []
         for e in self.entries:
             out.append({
                 "source": e.name,
                 "state": e.state,
                 "restarts": e.restarts,
+                "last_restart_age_s": (round(now - e.last_restart_at, 1)
+                                       if e.last_restart_at is not None
+                                       else None),
                 "forwarded": e.forwarded,
                 "stalled": e.stalled,
                 "stall_count": e.stall_count,
+                # first line only: stall errors carry a multi-line flight
+                # recorder tail that belongs in logs, not a status row
                 "error": (f"{type(e.last_error).__name__}: {e.last_error}"
+                          .splitlines()[0]
                           if e.last_error is not None else None),
             })
         return out
@@ -437,6 +460,16 @@ class Watchdog:
         self._thread: threading.Thread | None = None
         self._tick_logged = False
 
+    def _postmortem(self) -> str:
+        """The flight-recorder tail (last ticks + in-flight leg with its
+        operator and user frame), or '' when nothing is recording — the
+        attribution block every watchdog fire appends to its log line."""
+        rec = getattr(self.runtime.scheduler, "recorder", None)
+        if rec is None or not rec.enabled:
+            return ""
+        tail = rec.dump_tail()
+        return f"\nflight recorder tail:\n{tail}" if tail else ""
+
     def start(self) -> None:
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="pathway-tpu-watchdog")
@@ -469,7 +502,8 @@ class Watchdog:
                 logger.error(
                     "watchdog: commit loop has not ticked for %.1fs "
                     "(deadline %.1fs) — the scheduler step or a cluster "
-                    "exchange is stuck", now - last, deadline)
+                    "exchange is stuck%s", now - last, deadline,
+                    self._postmortem())
         elif self.supervisor.commit_stalled:
             self.supervisor.commit_stalled = False
             self._tick_logged = False
@@ -492,6 +526,7 @@ class Watchdog:
                     and now - entry.last_activity > timeout:
                 logger.error(
                     "watchdog: source %r claims liveness but produced no "
-                    "push/heartbeat for %.1fs (stall timeout %.1fs)",
-                    entry.name, now - entry.last_activity, timeout)
+                    "push/heartbeat for %.1fs (stall timeout %.1fs)%s",
+                    entry.name, now - entry.last_activity, timeout,
+                    self._postmortem())
                 entry.stall_flagged = True

@@ -26,12 +26,22 @@ class MonitoringLevel(enum.Enum):
     ALL = enum.auto()
 
 
+def _log_buffer_lines(default: int = 8) -> int:
+    """Log-panel depth, overridable with PATHWAY_LOG_BUFFER_LINES (a
+    post-mortem dump in the log pane needs more than 8 lines)."""
+    from pathway_tpu.internals.config import _env_int
+
+    return max(1, _env_int("PATHWAY_LOG_BUFFER_LINES", default))
+
+
 class _LogBuffer(logging.Handler):
     """Captures recent log records for the dashboard's log panel
     (reference keeps a rich log pane under the stats table)."""
 
-    def __init__(self, maxlen: int = 8):
+    def __init__(self, maxlen: int | None = None):
         super().__init__()
+        if maxlen is None:
+            maxlen = _log_buffer_lines()
         self.records: collections.deque[str] = collections.deque(
             maxlen=maxlen)
 
@@ -128,6 +138,10 @@ class StatsMonitor:
             table.add_row(name, str(ins), str(rets), f"{lat:.2f}",
                           f"{tot:.0f}")
         parts = [table]
+        slow = self._slowest_lines()
+        if slow:
+            parts.append(Panel("\n".join(slow), title="top slowest (last tick)",
+                               height=None))
         if getattr(self, "_bridge_line", None):
             parts.append(Panel(self._bridge_line, title="pipelining",
                                height=None))
@@ -140,6 +154,19 @@ class StatsMonitor:
                                height=None))
         return parts[0] if len(parts) == 1 else Group(*parts)
 
+    def _slowest_lines(self, top_n: int = 5) -> list[str]:
+        """Critical-path panel: the operators that dominated the last
+        tick, worst first — the per-tick answer to "where does the time
+        go" (stats latency_ms is each operator's last step latency)."""
+        ranked = sorted(self._rows, key=lambda r: r[3], reverse=True)
+        total = sum(r[3] for r in self._rows) or 1.0
+        lines = []
+        for name, _ins, _rets, lat, _tot in ranked[:top_n]:
+            if lat <= 0.0:
+                break
+            lines.append(f"{name}: {lat:.2f}ms ({lat / total:.0%} of tick)")
+        return lines
+
     def _supervisor_lines(self) -> list[str]:
         if self.supervisor is None:
             return []
@@ -147,6 +174,8 @@ class StatsMonitor:
         for s in self.supervisor.summary():
             line = (f"{s['source']}: {s['state']}  rows={s['forwarded']}  "
                     f"restarts={s['restarts']}")
+            if s["restarts"] and s.get("last_restart_age_s") is not None:
+                line += f" (last {s['last_restart_age_s']:.0f}s ago)"
             if s["stalled"]:
                 line += "  STALLED"
             if s["error"]:

@@ -13,13 +13,19 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
         default_logging: bool = True, persistence_config=None,
         runtime_typechecking: bool | None = None, terminate_on_error: bool = True,
         telemetry_config=None, static_check: str | None = None,
-        connector_policy=None, watchdog=None,
+        connector_policy=None, watchdog=None, trace_path: str | None = None,
         **kwargs) -> Any:
     """Build the engine graph from all registered outputs and run it.
 
     Static-only graphs run in batch mode to completion; graphs with streaming
     sources enter the realtime microbatch loop (pathway_tpu/engine/streaming.py)
     until all sources finish or the process is stopped.
+
+    ``trace_path`` (or ``PATHWAY_TRACE_PATH``) turns on the flight
+    recorder (engine/flight_recorder.py) and writes the run's span buffer
+    as Chrome trace-event JSON — host and device legs on separate tracks,
+    per-operator spans with user-frame attribution — loadable directly in
+    Perfetto (README "Observability").
 
     ``connector_policy`` is the default :class:`pw.ConnectorPolicy`
     (retry/backoff/escalation) applied to streaming sources that did not
@@ -82,12 +88,21 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
                     persistence_config=persistence_config,
                     terminate_on_error=terminate_on_error,
                     connector_policy=connector_policy, watchdog=watchdog,
-                    cluster=cluster)
+                    cluster=cluster, trace_path=trace_path)
                 telemetry.register_scheduler_gauges(rt.scheduler,
                                                     runner.graph)
+                if rt.recorder is not None:
+                    # recorded spans also flow through the OTel provider
+                    # when a real SDK pipeline is configured
+                    rt.recorder.set_telemetry(telemetry)
                 rt.run()
             else:
-                runner.run_batch(cluster=cluster)
+                from pathway_tpu.engine.flight_recorder import FlightRecorder
+
+                recorder = FlightRecorder.from_env(trace_path=trace_path)
+                if recorder is not None:
+                    recorder.set_telemetry(telemetry)
+                runner.run_batch(cluster=cluster, recorder=recorder)
     finally:
         telemetry.shutdown()
     return runner

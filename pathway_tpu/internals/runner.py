@@ -99,28 +99,47 @@ class GraphRunner:
         node = self.lower(table)
         self.graph.add_node(eng.OutputOperator(callback), [node], "subscribe")
 
-    def run_batch(self, n_workers: int | None = None, cluster=None) -> None:
+    def run_batch(self, n_workers: int | None = None, cluster=None,
+                  recorder=None) -> None:
         """Run all static feeds to completion (batch mode: one pass over the
         totally-ordered times present in the inputs + a flush tick). Under
         a cluster, static feeds are deterministic SPMD replicas: every
-        process holds the same feed and keeps its worker block's shard."""
+        process holds the same feed and keeps its worker block's shard.
+
+        ``recorder`` threads a flight recorder through the scheduler
+        (engine/flight_recorder.py); when omitted, the env wiring
+        (``PATHWAY_TRACE_PATH`` / ``PATHWAY_FLIGHT_RECORDER``) decides —
+        the default is None, costing one dead branch per operator step."""
         if n_workers is None:
             from pathway_tpu.internals.config import get_pathway_config
 
             n_workers = get_pathway_config().threads
-        sched = Scheduler(self.graph, n_workers=n_workers, cluster=cluster)
+        if recorder is None:
+            from pathway_tpu.engine.flight_recorder import FlightRecorder
+
+            recorder = FlightRecorder.from_env()
+        sched = Scheduler(self.graph, n_workers=n_workers, cluster=cluster,
+                          recorder=recorder)
         by_time, feed_times = self.static_feeds_by_time()
         times = {0} | feed_times
-        for t in sorted(times):
-            for node, groups in by_time:
-                batch = groups.get(t)
-                if batch:
-                    sched.push_source(node, Delta(batch))
-            sched.run_time(t)
-        # end-of-stream flush tick: temporal buffers release held rows
-        sched.run_time(max(times) + 1, flush=True)
-        sched.close()  # batch run complete: release worker pool threads
-        self._scheduler = sched
+        try:
+            for t in sorted(times):
+                for node, groups in by_time:
+                    batch = groups.get(t)
+                    if batch:
+                        sched.push_source(node, Delta(batch))
+                sched.run_time(t)
+            # end-of-stream flush tick: temporal buffers release held rows
+            sched.run_time(max(times) + 1, flush=True)
+        finally:
+            sched.close()  # batch run complete: release worker pool threads
+            self._scheduler = sched
+            if recorder is not None:
+                # trace survives a failing run — it is the post-mortem
+                try:
+                    recorder.write_chrome_trace()
+                except Exception:
+                    pass
 
     def static_feeds_by_time(self):
         """Feeds are stored pre-grouped by logical time (see _lower_static).

@@ -526,6 +526,101 @@ def test_pipelined_crash_restart_exactly_once_byte_identical(
     assert replay == baseline
 
 
+def test_supervisor_summary_reports_last_restart_age():
+    """The connector panel shows WHEN a source last restarted, not just
+    how many times (a restart storm and one old restart read the same in
+    a bare count)."""
+    G.clear()
+    schema = pw.schema_from_types(word=str)
+    t = pw.io.python.read(
+        flaky_subject(_rows(WORDS), fail_after=3, fail_attempts=1),
+        schema=schema, autocommit_duration_ms=10, persistent_id="aged",
+        connector_policy=_fast_policy())
+    pw.io.subscribe(t, lambda *a, **k: None)
+    rt = _build_streaming_runtime()
+    rt.run()
+    s = rt.supervisor.summary()[0]
+    assert s["restarts"] == 1
+    assert s["last_restart_age_s"] is not None
+    assert 0.0 <= s["last_restart_age_s"] < 60.0
+    # a source that never restarted reports None, not 0
+    G.clear()
+    t2 = pw.io.python.read(
+        flaky_subject(_rows(["x"]), fail_after=0, fail_attempts=0),
+        schema=schema, autocommit_duration_ms=10, persistent_id="calm")
+    pw.io.subscribe(t2, lambda *a, **k: None)
+    rt2 = _build_streaming_runtime()
+    rt2.run()
+    assert rt2.supervisor.summary()[0]["last_restart_age_s"] is None
+
+
+def test_stalled_error_carries_flight_recorder_tail(monkeypatch):
+    """With the recorder on, a watchdog escalation's ConnectorStalledError
+    — and its ErrorLog entry — embed the flight-recorder tail, so the
+    failure names what the engine was executing, not just the source."""
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER", "1")
+    n_before = len(pw.global_error_log().connector_failures())
+    subject = hanging_subject(_rows(["a"]))
+    with pytest.raises(pw.ConnectorStalledError) as exc_info:
+        _run_counts(
+            subject, policy=pw.ConnectorPolicy(max_retries=0),
+            terminate_on_error=True,
+            watchdog=pw.WatchdogConfig(reader_stall_timeout_s=0.3,
+                                       tick_deadline_s=None,
+                                       poll_interval_s=0.05))
+    msg = str(exc_info.value)
+    assert "claiming liveness" in msg
+    assert "flight recorder tail" in msg
+    assert "tick" in msg  # actual span lines, not just the header
+    failures = pw.global_error_log().connector_failures()[n_before:]
+    assert any("flight recorder tail" in f["message"] for f in failures)
+
+
+def test_stalled_error_plain_when_recorder_off(monkeypatch):
+    monkeypatch.delenv("PATHWAY_FLIGHT_RECORDER", raising=False)
+    monkeypatch.delenv("PATHWAY_TRACE_PATH", raising=False)
+    subject = hanging_subject(_rows(["a"]))
+    with pytest.raises(pw.ConnectorStalledError) as exc_info:
+        _run_counts(
+            subject, policy=pw.ConnectorPolicy(max_retries=0),
+            terminate_on_error=True,
+            watchdog=pw.WatchdogConfig(reader_stall_timeout_s=0.3,
+                                       tick_deadline_s=None,
+                                       poll_interval_s=0.05))
+    assert "flight recorder tail" not in str(exc_info.value)
+
+
+def test_device_bridge_poison_note_carries_tail(monkeypatch):
+    """A device-leg failure re-raised on the host thread carries the
+    flight-recorder tail as a PEP 678 note: the poisoned tick, its
+    operators, and the failing leg are named in the traceback."""
+    monkeypatch.setenv("PATHWAY_DEVICE_INFLIGHT", "2")
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER", "1")
+    G.clear()
+
+    @pw.udf(batch=True, device=True, deterministic=True, return_type=int)
+    def dev_len(ws):
+        return [len(w) for w in ws]
+
+    class _OneRow(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(word="x")
+
+    t = pw.io.python.read(_OneRow(), schema=pw.schema_from_types(word=str),
+                          autocommit_duration_ms=10)
+    t = t.select(word=t.word, wl=dev_len(t.word))
+
+    def exploding_sink(*a, **k):
+        raise RuntimeError("sink exploded on the device leg")
+
+    pw.io.subscribe(t, exploding_sink)
+    with pytest.raises(RuntimeError, match="sink exploded") as exc_info:
+        pw.run()
+    notes = "\n".join(getattr(exc_info.value, "__notes__", []))
+    assert "device leg poisoned at tick" in notes
+    assert "flight recorder tail" in notes
+
+
 def test_pipelined_watchdog_restart_with_device_leg(monkeypatch):
     """Watchdog abandon+restart while the pipeline routinely has a device
     leg in flight: the stall verdict comes from reader liveness, never

@@ -1,9 +1,14 @@
 """HTTP monitoring endpoint + error-trace attribution
-(reference: src/engine/http_server.rs, internals/trace.py)."""
+(reference: src/engine/http_server.rs, internals/trace.py), plus the
+exposition-format contract of every /metrics family: label escaping,
+histogram bucket monotonicity + _sum/_count consistency, and a regex lint
+over every emitted line."""
 
 from __future__ import annotations
 
 import json
+import math
+import re
 import urllib.request
 
 import pytest
@@ -25,12 +30,14 @@ class _FakeNode:
         self.id = id
         self.name = name
         self.op = object()
+        self.trace = None
 
 
 class _FakeRuntime:
     def __init__(self):
         class Sched:
             stats = {0: {"insertions": 7, "retractions": 2}}
+            recorder = None
 
         class Graph:
             nodes = [_FakeNode(0, "source:test")]
@@ -41,6 +48,26 @@ class _FakeRuntime:
         self.scheduler = Sched()
         self.runner = Runner()
         self.sessions = [1, 2]
+
+
+_AWKWARD = 'source:"we\\ird"\nname'  # quote, backslash, newline
+
+_STEP_SAMPLES_MS = (0.05, 0.3, 2.0, 7.0, 180.0, 3000.0, 50_000.0)
+
+
+def _recording_runtime():
+    """A fake runtime whose scheduler carries a flight recorder with one
+    awkwardly-named operator and a known latency sample set."""
+    from pathway_tpu.engine.flight_recorder import FlightRecorder
+
+    rt = _FakeRuntime()
+    rec = FlightRecorder()
+    rec.enabled = True
+    node = _FakeNode(0, _AWKWARD)
+    for i, ms in enumerate(_STEP_SAMPLES_MS):
+        rec.record(i, node, "host", float(i), ms, 10, 9)
+    rt.scheduler.recorder = rec
+    return rt
 
 
 def test_http_status_and_metrics():
@@ -56,6 +83,150 @@ def test_http_status_and_metrics():
         assert metrics.rstrip().endswith("# EOF")
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# /metrics exposition format: escaping, histogram invariants, family lint
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<family>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*",?)+)\})?'
+    r' (?P<value>-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\+Inf|NaN))$')
+
+
+def _metrics_lines(rt) -> list[str]:
+    server = MonitoringHttpServer(rt, port=0)
+    return server.metrics_payload().splitlines()
+
+
+def _parse_samples(lines):
+    """[(family, {label: value}, float)] for every sample line; asserts
+    every non-comment line parses (the regex lint)."""
+    out = []
+    for line in lines:
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            for lm in re.finditer(r'([a-zA-Z_][a-zA-Z0-9_]*)='
+                                  r'"((?:[^"\\\n]|\\.)*)"', raw):
+                labels[lm.group(1)] = lm.group(2)
+        out.append((m.group("family"), labels, float(m.group("value"))))
+    return out
+
+
+def test_metrics_regex_lint_every_family_typed():
+    """Every emitted sample parses, and every family is announced with a
+    # TYPE line (histogram samples resolve to their base family)."""
+    lines = _metrics_lines(_recording_runtime())
+    assert lines[-1] == "# EOF"
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    assert typed, "no TYPE declarations emitted"
+    for family, _labels, _v in _parse_samples(lines):
+        base = re.sub(r"_(bucket|sum|count)$", "", family)
+        assert family in typed or base in typed, \
+            f"sample family {family!r} has no # TYPE declaration"
+
+
+def test_metrics_label_escaping_round_trips():
+    """Quote / backslash / newline in an operator name must be escaped per
+    the exposition format and decode back to the original name."""
+    lines = _metrics_lines(_recording_runtime())
+    ops = set()
+    for family, labels, _v in _parse_samples(lines):
+        if family.startswith("pathway_tpu_operator_step_duration_ms"):
+            raw = labels["operator"]
+            assert "\n" not in raw
+            ops.add(raw.replace(r"\\", "\x00").replace(r"\"", '"')
+                    .replace(r"\n", "\n").replace("\x00", "\\"))
+    assert _AWKWARD in ops
+
+
+def test_histogram_monotonic_and_sum_count_consistent():
+    lines = _metrics_lines(_recording_runtime())
+    buckets = []   # (le, cumulative_count) in emission order
+    sum_ms = count = None
+    for family, labels, v in _parse_samples(lines):
+        if family == "pathway_tpu_operator_step_duration_ms_bucket":
+            le = math.inf if labels["le"] == "+Inf" else float(labels["le"])
+            buckets.append((le, v))
+        elif family == "pathway_tpu_operator_step_duration_ms_sum":
+            sum_ms = v
+        elif family == "pathway_tpu_operator_step_duration_ms_count":
+            count = v
+    assert buckets and sum_ms is not None and count is not None
+    # le values strictly increasing, ending at +Inf
+    les = [b[0] for b in buckets]
+    assert les == sorted(les) and len(set(les)) == len(les)
+    assert les[-1] == math.inf
+    # cumulative counts monotonically non-decreasing; +Inf == _count
+    counts = [b[1] for b in buckets]
+    assert counts == sorted(counts)
+    assert counts[-1] == count == len(_STEP_SAMPLES_MS)
+    assert sum_ms == pytest.approx(sum(_STEP_SAMPLES_MS), rel=1e-6)
+    # spot-check one interior bucket: samples <= 2.5ms
+    by_le = dict(buckets)
+    assert by_le[2.5] == sum(1 for ms in _STEP_SAMPLES_MS if ms <= 2.5)
+
+
+def test_metrics_row_counters_and_gauges_still_linted():
+    """The pre-existing families (operator gauges, process memory) pass
+    the same lint and the recorder's row counters total correctly."""
+    samples = _parse_samples(_metrics_lines(_recording_runtime()))
+    rows_in = [v for f, _l, v in samples
+               if f == "pathway_tpu_operator_rows_in"]
+    rows_out = [v for f, _l, v in samples
+                if f == "pathway_tpu_operator_rows_out"]
+    assert rows_in == [10 * len(_STEP_SAMPLES_MS)]
+    assert rows_out == [9 * len(_STEP_SAMPLES_MS)]
+
+
+def test_trace_endpoint_serves_span_buffer():
+    rt = _recording_runtime()
+    server = MonitoringHttpServer(rt, port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        payload = json.loads(urllib.request.urlopen(base + "/trace").read())
+        assert payload["enabled"] is True
+        assert len(payload["events"]) == len(_STEP_SAMPLES_MS)
+        ev = payload["events"][-1]
+        assert ev["operator"] == _AWKWARD
+        assert ev["leg"] == "host"
+        assert ev["rows_in"] == 10 and ev["rows_out"] == 9
+        # /status names the operator that dominated the last tick
+        status = json.loads(
+            urllib.request.urlopen(base + "/status").read())
+        assert status["last_tick_dominator"]["operator"] == _AWKWARD
+    finally:
+        server.stop()
+
+
+def test_trace_endpoint_without_recorder_reports_disabled():
+    server = MonitoringHttpServer(_FakeRuntime(), port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        payload = json.loads(urllib.request.urlopen(base + "/trace").read())
+        assert payload == {"enabled": False, "events": [],
+                           "device_legs": [], "inflight": None}
+    finally:
+        server.stop()
+
+
+def test_log_buffer_lines_env(monkeypatch):
+    from pathway_tpu.internals.monitoring import _LogBuffer
+
+    monkeypatch.setenv("PATHWAY_LOG_BUFFER_LINES", "3")
+    assert _LogBuffer().records.maxlen == 3
+    monkeypatch.setenv("PATHWAY_LOG_BUFFER_LINES", "bogus")
+    assert _LogBuffer().records.maxlen == 8  # fallback, never a crash
+    monkeypatch.delenv("PATHWAY_LOG_BUFFER_LINES")
+    assert _LogBuffer().records.maxlen == 8
 
 
 def test_engine_error_carries_user_trace():
