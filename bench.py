@@ -30,6 +30,8 @@ KNN_DIM = 384
 # tunnel (larger batches pay proportionally more upload per dispatch)
 BATCH = int(os.environ.get("BENCH_BATCH", 2048))
 SKIP = set(os.environ.get("BENCH_SKIP", "").split(","))
+# every leg that runs in the killable device-phase subprocesses
+_DEVICE_LEG_NAMES = {"embed", "framework", "knn", "serving"}
 SEQ = 128
 WORDS_PER_DOC = 90
 
@@ -71,7 +73,32 @@ _LEG_FNS = {
     "embed": lambda: bench_embed(),
     "framework": lambda: bench_embed_framework(),
     "knn": lambda: bench_knn(),
+    "serving": lambda: bench_serving(),
 }
+
+# serving-path SLO leg (bench_serving): slab size / dim / query count
+SERVING_N = int(os.environ.get("BENCH_SERVING_N", 100_000))
+SERVING_DIM = int(os.environ.get("BENCH_SERVING_DIM", KNN_DIM))
+SERVING_QUERIES = int(os.environ.get("BENCH_SERVING_QUERIES", 48))
+SERVING_WARMUP = int(os.environ.get("BENCH_SERVING_WARMUP", 8))
+
+# evidence rule (ROADMAP): the parent checkpoints every successful
+# device-leg snapshot into BENCH_LASTGOOD.json the moment the child
+# prints it, so a later hang / SIGKILL cannot erase captured numbers
+_LASTGOOD_STATE: dict = {}
+
+
+def _write_lastgood(snapshot: dict) -> None:
+    path = os.environ.get("BENCH_LASTGOOD_PATH", "BENCH_LASTGOOD.json")
+    try:
+        from pathway_tpu.engine.flight_recorder import atomic_write_json
+
+        _LASTGOOD_STATE.update(
+            {k: v for k, v in snapshot.items() if not k.endswith("error")})
+        atomic_write_json(path, {"updated_at": time.time(),
+                                 "result": dict(_LASTGOOD_STATE)})
+    except Exception:  # noqa: BLE001 — evidence must never kill a leg
+        pass
 
 
 # -- flight beacon -----------------------------------------------------------
@@ -240,11 +267,18 @@ def _run_leg_group(legs: list[str], timeout_s: float) -> dict:
     PJRT client setup, where neither SIGALRM nor Python-level retry can
     reach it — round 3's artifact died both ways. A subprocess with a
     hard timeout turns every failure mode into a JSON ``error`` field,
-    and separate groups (embed vs knn) mean a hang in one cannot void
-    the other's measurement.
+    and separate groups (embed vs knn vs serving) mean a hang in one
+    cannot void the other's measurement.
+
+    Child stdout is consumed INCREMENTALLY: the per-leg JSON snapshot
+    lines are parsed as they arrive and each successful one is
+    checkpointed to ``BENCH_LASTGOOD.json`` immediately (evidence rule —
+    a wedged tunnel, or the outer driver's SIGKILL, can no longer erase
+    a round's captured numbers).
     """
     import subprocess
     import sys
+    import threading
 
     last_err = "device legs never ran"
     group_deadline = time.monotonic() + timeout_s  # total across tries
@@ -254,35 +288,67 @@ def _run_leg_group(legs: list[str], timeout_s: float) -> dict:
             break
         env = dict(os.environ, _BENCH_DEVICE_CHILD="1",
                    _BENCH_DEVICE_LEGS=",".join(legs))
+        proc = subprocess.Popen(
+            [sys.executable, "-u", os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        state: dict = {"last": None}
+        stderr_tail: list[str] = []
+
+        def _read_stdout(stdout=proc.stdout, state=state):
+            for ln in stdout:
+                s = ln.strip()
+                if not s.startswith("{"):
+                    continue
+                try:
+                    d = json.loads(s)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(d, dict):
+                    state["last"] = d
+                    if "error" not in d:
+                        _write_lastgood(d)
+
+        def _read_stderr(stderr=proc.stderr, tail=stderr_tail):
+            for ln in stderr:
+                tail.append(ln.rstrip())
+                del tail[:-8]
+
+        t_out = threading.Thread(target=_read_stdout, daemon=True)
+        t_err = threading.Thread(target=_read_stderr, daemon=True)
+        t_out.start()
+        t_err.start()
+        timed_out = False
         try:
-            proc = subprocess.run(
-                [sys.executable, "-u", os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True,
-                timeout=try_budget)
-        except subprocess.TimeoutExpired as e:
-            # salvage the last snapshot line — completed legs survive a
-            # hang in a later leg; the flight note names what was in
-            # flight when the axe fell
+            proc.wait(timeout=try_budget)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            proc.kill()
+            proc.wait()
+        t_out.join(5.0)
+        t_err.join(5.0)
+        out = state["last"]
+        if timed_out:
+            # completed legs survive a hang in a later leg (their
+            # snapshots were already parsed AND written to lastgood);
+            # the flight note names what was in flight at the kill
             note = _flight_note()
             suffix = f"; {note}" if note else ""
-            salvaged = _last_json_line(e.stdout)
-            if salvaged is not None:
-                salvaged["device_hang_error"] = (
+            if out is not None:
+                out["device_hang_error"] = (
                     f"legs {legs} exceeded {timeout_s:.0f}s; "
                     f"kept legs completed before the hang{suffix}")
-                return salvaged
+                return out
             last_err = (f"legs {legs} exceeded {timeout_s:.0f}s "
                         f"(backend hang?){suffix}")
             continue
-        out = _last_json_line(proc.stdout)
         if out is not None:
             if "error" not in out:
                 return out
             last_err = out["error"]
             continue
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
         last_err = (f"device-leg subprocess rc={proc.returncode}: "
-                    + " | ".join(tail[-3:]))[:400]
+                    + " | ".join(stderr_tail[-3:]))[:400]
     return {"error": last_err}
 
 
@@ -295,7 +361,8 @@ def _run_device_legs() -> dict:
         return {"error": probe_err}
     groups = [g for g in
               ([leg for leg in ("embed", "framework") if leg not in SKIP],
-               [leg for leg in ("knn",) if leg not in SKIP]) if g]
+               [leg for leg in ("knn",) if leg not in SKIP],
+               [leg for leg in ("serving",) if leg not in SKIP]) if g]
     result: dict = {}
     for group in groups:
         remaining = deadline - time.monotonic()
@@ -311,24 +378,6 @@ def _run_device_legs() -> dict:
             else:
                 result[k] = v
     return result
-
-
-def _last_json_line(stdout) -> dict | None:
-    """Last parseable JSON-dict line of a (possibly bytes, possibly None)
-    captured stdout."""
-    if stdout is None:
-        return None
-    if isinstance(stdout, bytes):
-        stdout = stdout.decode("utf-8", errors="replace")
-    for ln in reversed(stdout.splitlines()):
-        if ln.strip().startswith("{"):
-            try:
-                out = json.loads(ln)
-                if isinstance(out, dict):
-                    return out
-            except json.JSONDecodeError:
-                continue
-    return None
 
 
 def main() -> None:
@@ -356,7 +405,7 @@ def main() -> None:
     # sidecar path for the device-phase flight beacon, inherited by the
     # child processes; every emit below reads it, so the last surviving
     # JSON line always carries whatever attribution the child reported
-    if not ({"embed", "framework", "knn"} <= SKIP) \
+    if not (_DEVICE_LEG_NAMES <= SKIP) \
             and "_BENCH_FLIGHT_FILE" not in os.environ:
         import tempfile
 
@@ -393,7 +442,7 @@ def main() -> None:
     # timeout that SIGKILLs after SIGTERM must still find a JSON line
     # (round-5 rehearsal lost a whole run's output exactly this way)
     emit("device legs still pending" if not (
-        {"embed", "framework", "knn"} <= SKIP) else None)
+        _DEVICE_LEG_NAMES <= SKIP) else None)
 
     import signal
 
@@ -406,7 +455,7 @@ def main() -> None:
     except (ValueError, OSError):
         pass  # non-main thread / exotic platform: snapshot above suffices
 
-    if not ({"embed", "framework", "knn"} <= SKIP):
+    if not (_DEVICE_LEG_NAMES <= SKIP):
         dev = _run_device_legs()
         for k, v in dev.items():
             (errors if k.endswith("error") else result)[k] = v
@@ -754,6 +803,181 @@ def _make_framework_embedder(cls):
                                  vocab_size=config.vocab_size),
             max_len=SEQ),
         max_len=SEQ, max_batch_size=BATCH)
+
+
+def bench_serving() -> dict:
+    """Serving-path SLO leg: the BASELINE ``knn_p50_e2e_ms`` measured as
+    a *serving* latency for the first time.
+
+    Queries enter through a real ``rest_connector`` (HTTP POST), ride
+    the commit tick into ``query_as_of_now`` against a KNN index that is
+    ingesting vectors CONCURRENTLY, and resolve back through the
+    response writer. The request tracker (engine/request_tracker.py)
+    stamps every hand-off, so the reported e2e quantiles come with the
+    full per-stage decomposition (ingress wait / queue / host leg /
+    device leg / response write) — the input signal for the PR-7
+    latency-aware admission scheduler.
+    """
+    import threading
+    import urllib.request
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine import streaming as _streaming
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.io.http import PathwayWebserver, rest_connector
+    from pathway_tpu.io.python import ConnectorSubject
+    from pathway_tpu.stdlib.indexing import (
+        default_brute_force_knn_document_index,
+    )
+
+    os.environ.setdefault("PATHWAY_FLIGHT_RECORDER", "1")  # tracker on
+    G.clear()
+    dim, n_vecs = SERVING_DIM, SERVING_N
+    loaded = threading.Event()
+
+    class IngestSubject(ConnectorSubject):
+        """Bulk-load the slab, then keep trickling inserts so every
+        timed query is answered under live ingest. Owns its generator —
+        numpy Generators are not thread-safe, and this runs on the
+        reader thread concurrently with the query thread's draws."""
+
+        def run(self):
+            rng = np.random.default_rng(1)
+            chunk = 4096
+            pushed = 0
+            while pushed < n_vecs:
+                m = min(chunk, n_vecs - pushed)
+                for v in rng.random((m, dim), np.float32) * 2.0 - 1.0:
+                    self.next(v=v)
+                pushed += m
+                if not self._session.sleep(0.002):
+                    return
+            loaded.set()
+            while not self._session.stop_requested:
+                for v in rng.random((64, dim), np.float32) * 2.0 - 1.0:
+                    self.next(v=v)
+                if not self._session.sleep(0.02):
+                    return
+
+    data = pw.io.python.read(
+        IngestSubject(), schema=sch.schema_from_types(v=np.ndarray),
+        autocommit_duration_ms=10, name="serving_ingest")
+    index = default_brute_force_knn_document_index(
+        data.v, data, dimensions=dim, reserved_space=n_vecs + (64 << 10),
+        dtype="bfloat16")
+
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+    qschema = sch.schema_from_types(vec=dt.ANY, k=int)
+    queries, writer = rest_connector(
+        webserver=ws, route="/query", schema=qschema, methods=("POST",),
+        delete_completed_queries=True, autocommit_duration_ms=5)
+    qv = queries.select(
+        qv=pw.apply(lambda v: np.asarray(v, dtype=np.float32),
+                    queries.vec),
+        k=queries.k)
+    res = index.query_as_of_now(qv.qv, number_of_matches=qv.k)
+    writer(res.select(
+        n_matches=pw.apply(len, res._pw_index_reply_id)))
+
+    errors: list[BaseException] = []
+
+    def _run():
+        try:
+            pw.run()
+        except Exception as e:  # noqa: BLE001 — reported in the leg JSON
+            errors.append(e)
+
+    th = threading.Thread(target=_run, daemon=True, name="bench-serving")
+    th.start()
+    try:
+        deadline = time.monotonic() + 600.0
+        rt = None
+        while time.monotonic() < deadline and rt is None:
+            live = list(_streaming._ACTIVE_RUNTIMES)
+            if live and ws._started.is_set() and ws.port:
+                rt = live[0]
+            if errors:
+                raise errors[0]
+            time.sleep(0.05)
+        assert rt is not None, "serving runtime never started"
+        if not loaded.wait(timeout=max(60.0, deadline - time.monotonic())):
+            raise TimeoutError(
+                f"serving slab never finished loading ({n_vecs} vecs)")
+
+        url = f"http://127.0.0.1:{ws.port}/query"
+
+        def ask(vec) -> float:
+            body = json.dumps({"vec": [float(x) for x in vec],
+                               "k": 10}).encode()
+            req = urllib.request.Request(
+                url, data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                resp.read()
+            return (time.perf_counter() - t0) * 1e3
+
+        qvecs = np.random.default_rng(2).random(
+            (SERVING_WARMUP + SERVING_QUERIES, dim),
+            np.float32) * 2.0 - 1.0
+        tracker = rt.recorder.requests
+        for i in range(SERVING_WARMUP):  # compile + slab upload
+            ask(qvecs[i])
+        n_warm = tracker.count  # completions before the timed window
+        client_ms = [ask(qvecs[SERVING_WARMUP + i])
+                     for i in range(SERVING_QUERIES)]
+        # count-based slice: the completed ring is bounded, so indexing
+        # from its front would misalign once warmup spans are evicted —
+        # take exactly the timed window's completions off the tail
+        n_timed = tracker.count - n_warm
+        spans = tracker.trace_spans()[-n_timed:] if n_timed else []
+        assert spans, "no timed request spans completed"
+        if len(spans) < n_timed:
+            print(f"serving: completed-span ring kept {len(spans)} of "
+                  f"{n_timed} timed spans (raise "
+                  "PATHWAY_REQUEST_TRACE_SPANS for larger windows)",
+                  flush=True)
+        ingested = sum(
+            st.get("insertions", 0)
+            for nid, st in rt.scheduler.stats.items()
+            if rt.runner.graph.nodes[nid].name == "serving_ingest")
+    finally:
+        _streaming.stop_all()
+        th.join(15.0)
+        G.clear()
+    if errors:
+        raise errors[0]
+
+    e2e = np.array([r["e2e_ms"] for r in spans])
+    # SLO accounting over the TIMED window only — the run-wide tracker
+    # also counted the warmup queries (XLA compile, slab upload), which
+    # would misstate the serving result in the headline fields
+    over_budget = int(np.sum(e2e > tracker.slo_ms))
+    out = {
+        # exact quantiles over the timed window (warmup excluded)
+        "knn_p50_e2e_ms": round(float(np.percentile(e2e, 50)), 2),
+        "knn_p95_e2e_ms": round(float(np.percentile(e2e, 95)), 2),
+        "knn_p99_e2e_ms": round(float(np.percentile(e2e, 99)), 2),
+        "serving_client_p50_ms": round(float(np.percentile(client_ms, 50)),
+                                       2),
+        "serving_n_queries": len(spans),
+        "serving_n_vectors": n_vecs,
+        "serving_ingested_rows": int(ingested),
+        "serving_dim": dim,
+        "serving_slo_ms": tracker.slo_ms,
+        "serving_slo_burn_rate": round(
+            (over_budget / len(e2e)) / tracker.error_budget, 3),
+        "serving_over_budget": over_budget,
+    }
+    from pathway_tpu.engine.request_tracker import STAGES
+
+    for stage in STAGES:
+        vals = np.array([r["stages"][stage] for r in spans])
+        out[f"serving_stage_{stage}_p50_ms"] = round(
+            float(np.percentile(vals, 50)), 3)
+    return out
 
 
 def bench_etl(n_rows: int = 100_000) -> dict:

@@ -24,6 +24,10 @@ Consumers:
 Cost model: **disabled is the default and costs one predictable branch per
 operator step, no allocation** (the Scheduler holds ``recorder=None`` or an
 ``enabled=False`` recorder; both short-circuit before any tuple is built).
+Enabled, idle steps (zero rows either way, sub-millisecond) are not
+recorded at all: the ring buffer holds the last N *active* ticks, so a
+quiescent streaming server cannot flush out the spans of the ticks that
+actually served requests.
 Enabled, each step appends one tuple to a deque and bumps a fixed-bucket
 histogram under a lock — the lock is uncontended except when a device leg
 retires concurrently with host work.
@@ -49,6 +53,27 @@ LATENCY_BUCKETS_MS = (
 
 _DEFAULT_BUFFER_EVENTS = 4096
 _DEFAULT_TAIL_TICKS = 8
+
+
+def atomic_write_json(path: str, payload) -> str:
+    """Serialize ``payload`` to ``path`` atomically: write to a unique
+    sibling tmp file, fsync, then rename. A crash mid-write can never
+    leave a truncated, unloadable file at ``path`` (and never clobbers a
+    previous good one); the tmp is removed on failure."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
 
 # live enabled recorders (weak: a recorder dies with its scheduler/run).
 # Lets out-of-band observers — bench.py's flight beacon — find the run's
@@ -145,6 +170,10 @@ class FlightRecorder:
         self._wall_ns_offset = time.time_ns() - int(self._epoch * 1e9)
         self._otel = None
         self._jax_annotation = None  # cached class / False after probe
+        # request-scoped serving spans (engine/request_tracker.py): set on
+        # enabled recorders by from_env; None keeps every per-request hook
+        # a dead branch
+        self.requests = None
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -166,6 +195,9 @@ class FlightRecorder:
             return None
         rec = cls(trace_path=tp)
         rec.enabled = True
+        from pathway_tpu.engine.request_tracker import RequestTracker
+
+        rec.requests = RequestTracker()
         _LIVE.add(rec)
         return rec
 
@@ -355,8 +387,19 @@ class FlightRecorder:
         with self._lock:
             legs = [{"tick": t, "queue_wait_ms": round(q, 3),
                      "exec_ms": round(e, 3)} for t, q, e in self._legs]
-        return {"enabled": self.enabled, "events": events,
-                "device_legs": legs, "inflight": self.inflight_summary()}
+        out = {"enabled": self.enabled, "events": events,
+               "device_legs": legs, "inflight": self.inflight_summary()}
+        if self.requests is not None:
+            out["requests"] = {
+                "summary": self.requests.summary(),
+                "completed": [
+                    {k: r[k] for k in ("request_id", "route", "tick",
+                                       "e2e_ms", "stages",
+                                       "dominant_stage", "over_budget")}
+                    for r in self.requests.trace_spans()[-32:]
+                ],
+            }
+        return out
 
     def dominator(self) -> dict | None:
         """The operator that dominated the last complete tick (critical
@@ -405,12 +448,17 @@ class FlightRecorder:
         with self._lock:
             for tick, q, e in self._legs:
                 leg_meta[tick] = (q, e)
+        # per-(tick, leg) wrapper start: flow arrows from request spans
+        # bind to these (the query <-> operator <-> device-leg causality
+        # link in the three-track Perfetto view)
+        wrapper_start_us: dict[tuple, float] = {}
         for tick, leg in order:
             g = groups[(tick, leg)]
             tid = tids.get(leg, 2)
             start_us = (g[0][3] - self._epoch) * 1e6
             end_us = max((ev[3] - self._epoch + ev[4] / 1e3) * 1e6
                          for ev in g)
+            wrapper_start_us[(tick, leg)] = start_us
             wrap_args = {"tick": tick, "leg": leg}
             if leg == "device" and tick in leg_meta:
                 wrap_args["queue_wait_ms"] = round(leg_meta[tick][0], 3)
@@ -432,6 +480,69 @@ class FlightRecorder:
                             "name": name})
             out.append({"ph": "E", "pid": pid, "tid": tid, "ts": end_us,
                         "cat": leg, "name": f"tick {tick}"})
+        out.extend(self._request_trace_events(pid, wrapper_start_us))
+        return out
+
+    def _request_trace_events(self, pid: int,
+                              wrapper_start_us: dict) -> list[dict]:
+        """Third track: completed request spans as async (b/e) events —
+        async because concurrent requests legitimately overlap, which
+        B/E nesting cannot represent — with per-stage child spans and a
+        flow arrow (s -> t -> f) from each request's tick-start into the
+        tick's host and device wrappers, so clicking a query walks to the
+        operator spans that served it."""
+        tracker = self.requests
+        spans = tracker.trace_spans() if tracker is not None else []
+        if not spans:
+            return []
+        out = [{"ph": "M", "pid": pid, "tid": 2, "name": "thread_name",
+                "args": {"name": "requests"}}]
+        from pathway_tpu.engine.request_tracker import STAGES
+
+        for i, r in enumerate(spans):
+            stamps_us = [(t - self._epoch) * 1e6 for t in r["stamps"]]
+            rid = r["request_id"]
+            fid = f"req-{rid}"
+            name = f"req {rid}"
+            args = {"request_id": rid, "route": r["route"],
+                    "tick": r["tick"], "e2e_ms": r["e2e_ms"],
+                    "dominant_stage": r["dominant_stage"],
+                    **{f"{k}_ms": v for k, v in r["stages"].items()}}
+            out.append({"ph": "b", "cat": "request", "id": fid, "pid": pid,
+                        "tid": 2, "ts": stamps_us[0], "name": name,
+                        "args": args})
+            for j, stage in enumerate(STAGES):
+                if stamps_us[j + 1] - stamps_us[j] <= 0.0:
+                    continue
+                out.append({"ph": "b", "cat": "request", "id": fid,
+                            "pid": pid, "tid": 2, "ts": stamps_us[j],
+                            "name": stage})
+                out.append({"ph": "e", "cat": "request", "id": fid,
+                            "pid": pid, "tid": 2,
+                            "ts": stamps_us[j + 1], "name": stage})
+            out.append({"ph": "e", "cat": "request", "id": fid, "pid": pid,
+                        "tid": 2, "ts": stamps_us[-1], "name": name})
+            tick = r["tick"]
+            if tick is None:
+                continue
+            host_us = wrapper_start_us.get((tick, "host"))
+            dev_us = wrapper_start_us.get((tick, "device"))
+            targets = [(0, host_us), (1, dev_us)]
+            targets = [(tid, ts) for tid, ts in targets if ts is not None]
+            if not targets:
+                continue
+            # flow: s inside the request span at tick pickup, then one
+            # step/finish per leg wrapper the request crossed
+            out.append({"ph": "s", "cat": "request", "id": fid,
+                        "pid": pid, "tid": 2, "ts": stamps_us[2],
+                        "name": "request"})
+            for k, (tid, ts) in enumerate(targets):
+                ph = "f" if k == len(targets) - 1 else "t"
+                ev = {"ph": ph, "cat": "request", "id": fid, "pid": pid,
+                      "tid": tid, "ts": ts + 0.01, "name": "request"}
+                if ph == "f":
+                    ev["bp"] = "e"
+                out.append(ev)
         return out
 
     def write_chrome_trace(self, path: str | None = None) -> str | None:
@@ -442,8 +553,6 @@ class FlightRecorder:
             return None
         payload = {"traceEvents": self.chrome_trace_events(),
                    "displayTimeUnit": "ms"}
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, path)
-        return path
+        # atomic (unique tmp + fsync + rename): a crash mid-write must not
+        # leave a truncated trace, nor clobber the previous good one
+        return atomic_write_json(path, payload)

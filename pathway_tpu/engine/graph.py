@@ -375,15 +375,35 @@ class Scheduler:
             if self._bridge is not None:
                 return self._run_time_pipelined(time, flush)
             outputs: dict[int, Delta] = {}
+            # request-tracking host-done stamp (engine/request_tracker.py):
+            # in synchronous mode the "host leg" ends when the first
+            # device-bound operator steps (no device nodes: after the
+            # loop). Armed only while requests are actually in flight.
+            requests = self._tracked_requests()
+            host_pending = requests is not None
             for node in self._topo:
+                if host_pending and node.id in self._trace_device_ids:
+                    requests.host_done(time)
+                    host_pending = False
                 in_deltas = [outputs.get(up.id, _EMPTY) for up in node.inputs]
                 delta = self._step_op(node, node.op, time, in_deltas, flush)
                 outputs[node.id] = delta
                 self._count(node.id, delta)
+            if host_pending:
+                requests.host_done(time)
             if self.on_step is not None:
                 self.on_step(time)
             return outputs
         return self._run_time_sharded(time, flush)
+
+    def _tracked_requests(self):
+        """The run's request tracker iff recording is on AND a request is
+        mid-flight — one branch per tick otherwise."""
+        rec = self.recorder
+        if rec is not None and rec.enabled and rec.requests is not None \
+                and rec.requests.active():
+            return rec.requests
+        return None
 
     def _run_time_pipelined(self, time: int, flush: bool):
         """One tick, split into a host leg (stepped now, on this thread)
@@ -406,6 +426,11 @@ class Scheduler:
             delta = self._step_op(node, node.op, time, in_deltas, flush)
             outputs[node.id] = delta
             self._count(node.id, delta)
+        requests = self._tracked_requests()
+        if requests is not None:
+            # host leg complete; the device leg (bridge worker) resolves
+            # the request downstream — the stamp that opens its stage
+            requests.host_done(time)
 
         def leg() -> None:
             def _body() -> None:
@@ -484,7 +509,15 @@ class Scheduler:
             rows_in = 0
             for d in in_deltas:
                 rows_in += len(d.entries)
-            rec.record(time, node, leg, t0, ms, rows_in, len(delta.entries))
+            # idle steps (no rows either way, sub-ms) are NOT recorded:
+            # a quiescent streaming server ticks ~50x/s and every tick
+            # steps every operator, so idle spans would flush the ring
+            # (4096 events ~= 4 s of idle) and evict the spans of the
+            # ticks that actually served requests — exactly the ones
+            # post-mortems and the Perfetto request flows need
+            if rows_in or delta.entries or ms >= 1.0:
+                rec.record(time, node, leg, t0, ms, rows_in,
+                           len(delta.entries))
             # cleared on success only: an operator that raised (or is
             # still raising through the bridge) stays named in the
             # in-flight slot for the post-mortem dump
@@ -651,6 +684,11 @@ class Scheduler:
             outputs[node.id] = outs
             for d in outs:
                 self._count(node.id, d)
+        requests = self._tracked_requests()
+        if requests is not None:
+            # sharded execution is bulk-synchronous: the whole tick is
+            # one host leg (device stage reads as 0 — honestly)
+            requests.host_done(time)
         if self.on_step is not None:
             self.on_step(time)
         return _MergedOutputs(outputs)

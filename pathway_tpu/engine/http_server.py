@@ -68,7 +68,21 @@ class MonitoringHttpServer:
             bridge["inflight"] = sched._bridge.inflight() \
                 if getattr(sched, "_bridge", None) is not None else None
             payload["device_bridge"] = bridge
+        tracker = self._request_tracker()
+        if tracker is not None:
+            # serving-path SLO snapshot (engine/request_tracker.py):
+            # request counts, e2e quantiles, per-stage p50s, burn rate —
+            # and the tail of over-budget requests with their dominant
+            # stage (README "Serving SLO")
+            payload["serving"] = tracker.summary()
+            payload["slow_queries"] = tracker.slow_queries()
         return payload
+
+    def _request_tracker(self):
+        rec = getattr(self.runtime.scheduler, "recorder", None)
+        if rec is not None and rec.enabled:
+            return rec.requests
+        return None
 
     def trace_payload(self) -> dict:
         """``/trace``: the flight recorder's last-N-ticks span buffer
@@ -164,6 +178,70 @@ class MonitoringHttpServer:
                     lines.append(
                         f"pathway_tpu_operator_rows_out{{{base}}} "
                         f"{st['rows_out']}")
+        tracker = self._request_tracker()
+        if tracker is not None and tracker.count:
+            # serving-path SLO families (engine/request_tracker.py):
+            # streaming e2e quantiles as a Prometheus summary, per-stage
+            # p50/sum/count, and the burn-rate gauge the PR-7 scheduler
+            # will consume
+            qs = tracker.quantiles_ms()
+            lines.append(
+                "# TYPE pathway_tpu_query_e2e_latency_ms summary")
+            if qs is not None:
+                for q, v in qs.items():
+                    lines.append(
+                        "pathway_tpu_query_e2e_latency_ms"
+                        f'{{quantile="{format(q, "g")}"}} {round(v, 6)}')
+            lines.append("pathway_tpu_query_e2e_latency_ms_sum "
+                         f"{round(tracker.sum_ms, 6)}")
+            lines.append("pathway_tpu_query_e2e_latency_ms_count "
+                         f"{tracker.count}")
+            lines.append("# TYPE pathway_tpu_query_stage_ms summary")
+            for stage, agg in tracker.stage_summary().items():
+                if agg["p50_ms"] is not None:
+                    lines.append(
+                        "pathway_tpu_query_stage_ms"
+                        f'{{stage="{esc(stage)}",quantile="0.5"}} '
+                        f"{round(agg['p50_ms'], 6)}")
+                lines.append(
+                    f'pathway_tpu_query_stage_ms_sum{{stage="{esc(stage)}"}}'
+                    f" {agg['sum_ms']}")
+                lines.append(
+                    "pathway_tpu_query_stage_ms_count"
+                    f'{{stage="{esc(stage)}"}} {tracker.count}')
+            lines.append("# TYPE pathway_tpu_query_slo_violations counter")
+            lines.append(
+                f"pathway_tpu_query_slo_violations {tracker.violations}")
+            lines.append("# TYPE pathway_tpu_slo_target_ms gauge")
+            lines.append(f"pathway_tpu_slo_target_ms {tracker.slo_ms}")
+            lines.append("# TYPE pathway_tpu_slo_burn_rate gauge")
+            lines.append(
+                f"pathway_tpu_slo_burn_rate {round(tracker.burn_rate(), 6)}")
+        cluster = getattr(self.runtime, "cluster", None)
+        if cluster is not None and getattr(cluster, "stats", None):
+            # exchange-plane cost per row (engine/multiproc.py): the
+            # surface that makes an encdec regression visible per-run
+            cst = cluster.stats
+            lines.append(
+                "# TYPE pathway_tpu_exchange_encode_us_per_row gauge")
+            lines.append(f"pathway_tpu_exchange_encode_us_per_row "
+                         f"{round(cluster.encode_us_per_row(), 6)}")
+            lines.append(
+                "# TYPE pathway_tpu_exchange_decode_us_per_row gauge")
+            lines.append(f"pathway_tpu_exchange_decode_us_per_row "
+                         f"{round(cluster.decode_us_per_row(), 6)}")
+            lines.append("# TYPE pathway_tpu_exchange_rows_out counter")
+            lines.append(
+                f"pathway_tpu_exchange_rows_out {cst['rows_out']}")
+            lines.append("# TYPE pathway_tpu_exchange_rows_in counter")
+            lines.append(f"pathway_tpu_exchange_rows_in {cst['rows_in']}")
+            lines.append("# TYPE pathway_tpu_exchange_bytes_out counter")
+            lines.append(
+                f"pathway_tpu_exchange_bytes_out {cst['bytes_out']}")
+            lines.append("# TYPE pathway_tpu_exchange_bytes_in counter")
+            lines.append(f"pathway_tpu_exchange_bytes_in {cst['bytes_in']}")
+            lines.append("# TYPE pathway_tpu_exchange_rounds counter")
+            lines.append(f"pathway_tpu_exchange_rounds {cst['rounds']}")
         sup = getattr(self.runtime, "supervisor", None)
         if sup is not None and sup.entries:
             # connector supervision counters (engine/supervisor.py):

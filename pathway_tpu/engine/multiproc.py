@@ -59,6 +59,19 @@ def _pack_payload(obj):
     return obj
 
 
+def _payload_rows(obj) -> int:
+    """Entry count of a (packed or unpacked) exchange payload — the
+    denominator for the per-row encode/decode gauges. Entry lists (and
+    packed _ENTS tuples) count their rows; scalars count zero."""
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == _ENTS:
+        return len(obj[2])
+    if isinstance(obj, list):
+        return len(obj)
+    if isinstance(obj, dict):
+        return sum(_payload_rows(v) for v in obj.values())
+    return 0
+
+
 def _unpack_payload(obj):
     if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == _ENTS:
         _tag, kb, rows, diffs = obj
@@ -91,9 +104,23 @@ class Cluster:
         self.peers: dict[int, Connection] = {}
         self._listener: Listener | None = None
         self._seq = 0
-        # exchange-plane telemetry (bytes/messages/barriers) for perf work
+        # exchange-plane telemetry (bytes/messages/barriers + enc/dec cost
+        # per row) for perf work; exported on /metrics as
+        # pathway_tpu_exchange_* so the encdec regression the r5 driver
+        # caught (1.453 -> 6.495 us/row) is visible per-run
         self.stats = {"bytes_out": 0, "bytes_in": 0, "messages": 0,
-                      "rounds": 0}
+                      "rounds": 0, "encode_s": 0.0, "decode_s": 0.0,
+                      "rows_out": 0, "rows_in": 0}
+
+    def encode_us_per_row(self) -> float:
+        st = self.stats
+        return st["encode_s"] * 1e6 / st["rows_out"] if st["rows_out"] \
+            else 0.0
+
+    def decode_us_per_row(self) -> float:
+        st = self.stats
+        return st["decode_s"] * 1e6 / st["rows_in"] if st["rows_in"] \
+            else 0.0
 
     # -- wiring --------------------------------------------------------------
     def connect(self, timeout_s: float = 30.0) -> None:
@@ -178,9 +205,12 @@ class Cluster:
         def send_all():
             try:
                 for peer, conn in self.peers.items():
+                    t0 = time.perf_counter()
+                    packed = _pack_payload(msgs.get(peer))
                     blob = pickle.dumps(
-                        (tag, _pack_payload(msgs.get(peer))),
-                        protocol=pickle.HIGHEST_PROTOCOL)
+                        (tag, packed), protocol=pickle.HIGHEST_PROTOCOL)
+                    st["encode_s"] += time.perf_counter() - t0
+                    st["rows_out"] += _payload_rows(packed)
                     st["bytes_out"] += len(blob)
                     st["messages"] += 1
                     conn.send_bytes(blob)
@@ -215,12 +245,16 @@ class Cluster:
                         "PATHWAY_CLUSTER_RECV_TIMEOUT.")
             blob = conn.recv_bytes()
             st["bytes_in"] += len(blob)
+            t0 = time.perf_counter()
             rtag, payload = pickle.loads(blob)
             if rtag != tag:
                 raise RuntimeError(
                     f"cluster protocol skew: process {self.process_id} "
                     f"expected {tag!r} from {peer}, got {rtag!r}")
-            out[peer] = _unpack_payload(payload)
+            unpacked = _unpack_payload(payload)
+            st["decode_s"] += time.perf_counter() - t0
+            st["rows_in"] += _payload_rows(unpacked)
+            out[peer] = unpacked
         sender.join()
         if err:
             raise err[0]

@@ -95,6 +95,17 @@ class StreamingRuntime:
             session = Session()
             self.sessions.append((node, session, datasource))
 
+        # request-scoped serving tracing (engine/request_tracker.py):
+        # sources that declare a request_tracker slot (rest_connector)
+        # get the run's tracker, so each query's ingress/queue/host/
+        # device/response stages are stamped end to end
+        self._request_tracker = (
+            self.recorder.requests if self.recorder is not None else None)
+        if self._request_tracker is not None:
+            for _node, _session, ds in self.sessions:
+                if hasattr(ds, "request_tracker"):
+                    ds.request_tracker = self._request_tracker
+
     def stop(self) -> None:
         self._stop.set()
         self.supervisor.request_stop()
@@ -108,7 +119,7 @@ class StreamingRuntime:
         for t in self.supervisor.all_threads():
             t.join(max(0.0, deadline - _time.monotonic()))
 
-    def _drain_and_forward(self):
+    def _drain_and_forward(self, tick: int):
         """Drain local sessions; under a cluster split each source's rows
         by owning process (single reader on process 0 forwards shards —
         reference: 'single reader forwards for non-partitioned sources').
@@ -116,11 +127,17 @@ class StreamingRuntime:
         peer -> {source index -> entries}."""
         any_data = False
         all_closed = True
+        tracker = self._request_tracker
         pushes: dict[int, dict[int, list]] = {}
         for i, (node, session, datasource) in enumerate(self.sessions):
             entries = session.drain()
             if entries:
                 any_data = True
+                if tracker is not None and \
+                        getattr(datasource, "request_tracker", None) \
+                        is tracker:
+                    # tick-pickup stamp: ends each request's queue stage
+                    tracker.picked_up(entries, tick)
                 delta = Delta(entries)
                 if self.cluster is not None:
                     for peer, ents in self.scheduler.partition_remote(
@@ -228,7 +245,8 @@ class StreamingRuntime:
                         session.stopping.set()
                         session.close(reason="error",
                                       error=self.supervisor.fatal_error)
-                any_data, all_closed, pushes = self._drain_and_forward()
+                any_data, all_closed, pushes = self._drain_and_forward(
+                    time_counter)
                 any_data, all_closed = self._tick_sync(
                     time_counter, any_data, all_closed, pushes)
                 # under a cluster an idle tick would still pay one TCP
@@ -260,7 +278,8 @@ class StreamingRuntime:
                     # and closing — loop until truly empty, then final tick
                     leftovers = True
                     while leftovers:
-                        any_data, _closed, pushes = self._drain_and_forward()
+                        any_data, _closed, pushes = self._drain_and_forward(
+                            time_counter)
                         any_data, _closed = self._tick_sync(
                             time_counter, any_data, True, pushes)
                         leftovers = any_data

@@ -94,6 +94,10 @@ class StatsMonitor:
             return
         self._last_render = now
         self._rows = []
+        # serving panel: per-request SLO snapshot from the run's request
+        # tracker (engine/request_tracker.py) — query quantiles, burn
+        # rate and the most recent over-budget request's dominant stage
+        self._serving_lines = self._serving_panel(scheduler)
         # pipelined-execution line: in-flight depth, dispatch-queue wait
         # and overlap ratio straight from the device bridge, so the
         # host/device overlap is observable, not inferred
@@ -145,6 +149,9 @@ class StatsMonitor:
         if getattr(self, "_bridge_line", None):
             parts.append(Panel(self._bridge_line, title="pipelining",
                                height=None))
+        if getattr(self, "_serving_lines", None):
+            parts.append(Panel("\n".join(self._serving_lines),
+                               title="serving", height=None))
         sup_lines = self._supervisor_lines()
         if sup_lines:
             parts.append(Panel("\n".join(sup_lines), title="connectors",
@@ -153,6 +160,34 @@ class StatsMonitor:
             parts.append(Panel("\n".join(self._log.records), title="log",
                                height=None))
         return parts[0] if len(parts) == 1 else Group(*parts)
+
+    def _serving_panel(self, scheduler) -> list[str]:
+        rec = getattr(scheduler, "recorder", None)
+        tracker = rec.requests if rec is not None and rec.enabled else None
+        if tracker is None or not tracker.count:
+            return []
+        s = tracker.summary()
+        lines = []
+        e2e = s.get("e2e_ms")
+        if e2e:
+            lines.append(
+                f"queries {s['requests']}  p50 {e2e['p50']:.1f}ms  "
+                f"p95 {e2e['p95']:.1f}ms  p99 {e2e['p99']:.1f}ms  "
+                f"SLO {s['slo_ms']:.0f}ms  burn {s['burn_rate']:.2f}x  "
+                f"over-budget {s['violations']}")
+        stages = s.get("stages")
+        if stages:
+            lines.append("stage p50: " + "  ".join(
+                f"{name} {v:.1f}ms" for name, v in stages.items()
+                if v is not None))
+        slow = tracker.slow_queries()
+        if slow:
+            last = slow[-1]
+            lines.append(
+                f"slow: {last['request_id']} {last['e2e_ms']:.1f}ms "
+                f"dominant {last['dominant_stage']} "
+                f"({last['stages'][last['dominant_stage']]:.1f}ms)")
+        return lines
 
     def _slowest_lines(self, top_n: int = 5) -> list[str]:
         """Critical-path panel: the operators that dominated the last
@@ -203,6 +238,8 @@ class StatsMonitor:
                       file=sys.stderr)
             if getattr(self, "_bridge_line", None):
                 print(f"[monitor] {self._bridge_line}", file=sys.stderr)
+            for line in getattr(self, "_serving_lines", None) or ():
+                print(f"[monitor] {line}", file=sys.stderr)
             for line in self._supervisor_lines():
                 print(f"[monitor] {line}", file=sys.stderr)
 

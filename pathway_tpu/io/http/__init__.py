@@ -10,8 +10,11 @@ row with the same key. This is the serving front door of the RAG stack
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json as _json
+import os as _os
 import threading
+import time as _time
 from typing import Any
 
 from pathway_tpu.internals import dtype as dt
@@ -23,6 +26,54 @@ from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._datasource import (DataSource, Session,
                                          apply_connector_policy)
+
+
+# -- request-id assignment (serving-path SLO tracing) -------------------------
+# Every request entering the webserver gets an id at ingress, echoed back in
+# the X-Pathway-Request-Id response header and propagated (out of band — never
+# inside engine rows) through the request tracker
+# (engine/request_tracker.py, README "Serving SLO").
+
+_rid_counter = itertools.count(1)
+_rid_prefix: str | None = None
+
+
+def _next_request_id() -> str:
+    global _rid_prefix
+    if _rid_prefix is None:
+        _rid_prefix = _os.urandom(3).hex()
+    return f"{_rid_prefix}-{next(_rid_counter):06d}"
+
+
+class RequestContext:
+    """Ingress metadata handed to route handlers that accept a second
+    positional argument: the assigned request id and the arrival stamp
+    (perf_counter) taken before any parsing."""
+
+    __slots__ = ("request_id", "ingress_t")
+
+    def __init__(self, request_id: str, ingress_t: float):
+        self.request_id = request_id
+        self.ingress_t = ingress_t
+
+
+def _accepts_ctx(handler) -> bool:
+    """Does the handler take (payload, ctx)? Probed once at register time
+    so plain single-argument handlers keep working unchanged."""
+    import inspect
+
+    try:
+        sig = inspect.signature(handler)
+    except (TypeError, ValueError):
+        return False
+    positional = 0
+    for p in sig.parameters.values():
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD):
+            positional += 1
+        elif p.kind == inspect.Parameter.VAR_POSITIONAL:
+            return True
+    return positional >= 2
 
 
 class PathwayWebserver:
@@ -37,6 +88,8 @@ class PathwayWebserver:
         # (method, route) -> "custom" | "raw"; keyed per method so two
         # connectors sharing a route cannot clobber each other's format
         self._formats: dict[tuple[str, str], str] = {}
+        # (method, route) -> handler takes (payload, RequestContext)
+        self._wants_ctx: dict[tuple[str, str], bool] = {}
         self._openapi: dict = {"openapi": "3.0.3",
                                "info": {"title": "pathway-tpu", "version": "1"},
                                "paths": {}}
@@ -59,9 +112,11 @@ class PathwayWebserver:
                     f"route {key[0]} {route} is already registered with "
                     f"input format {self._formats[key]!r}; refusing to "
                     f"re-register it as {format!r}")
+        wants_ctx = _accepts_ctx(handler)
         for key in keys:
             self._routes[key] = handler
             self._formats[key] = format
+            self._wants_ctx[key] = wants_ctx
         if schema is not None:
             props = {
                 c.name: {"type": _openapi_type(c.dtype)}
@@ -95,7 +150,11 @@ class PathwayWebserver:
             return resp
 
         async def _dispatch_inner(request):
-            handler = self._routes.get((request.method, request.path))
+            # ingress stamp BEFORE any parsing: the request id is born
+            # here and the ingress_wait stage starts here
+            t_ingress = _time.perf_counter()
+            route_key = (request.method, request.path)
+            handler = self._routes.get(route_key)
             if handler is None:
                 if request.path == "/_schema" and self.with_schema_endpoint:
                     # reference serves yaml by default with ?format=json
@@ -118,9 +177,10 @@ class PathwayWebserver:
                     return web.Response(status=200, text=text,
                                         content_type="text/x-yaml")
                 return web.Response(status=404, text="no such route")
+            rid = _next_request_id()
+            rid_header = {"X-Pathway-Request-Id": rid}
             try:
-                fmt = self._formats.get((request.method, request.path),
-                                        "custom")
+                fmt = self._formats.get(route_key, "custom")
                 if fmt == "raw":
                     # raw format: the whole body IS the query value, for
                     # every method — a bodyless GET yields {'query': ''}
@@ -139,14 +199,20 @@ class PathwayWebserver:
                         payload.setdefault(param, value)
                 else:
                     payload = dict(request.query)
-                result = await handler(payload)
+                if self._wants_ctx.get(route_key):
+                    result = await handler(
+                        payload, RequestContext(rid, t_ingress))
+                else:
+                    result = await handler(payload)
                 if isinstance(result, (dict, list)):
-                    return web.json_response(result)
-                return web.Response(text=str(result))
+                    return web.json_response(result, headers=rid_header)
+                return web.Response(text=str(result), headers=rid_header)
             except _BadRequest as e:
-                return web.Response(status=400, text=str(e))
+                return web.Response(status=400, text=str(e),
+                                    headers=rid_header)
             except Exception as e:
-                return web.Response(status=500, text=repr(e))
+                return web.Response(status=500, text=repr(e),
+                                    headers=rid_header)
 
         async def main():
             app = web.Application()
@@ -155,6 +221,12 @@ class PathwayWebserver:
             await runner.setup()
             site = web.TCPSite(runner, self.host, self.port)
             await site.start()
+            if self.port == 0:
+                # ephemeral port requested: publish the bound one so
+                # clients (tests, bench) can find the endpoint
+                socks = getattr(site._server, "sockets", None)
+                if socks:
+                    self.port = socks[0].getsockname()[1]
             self._started.set()
             while True:
                 await asyncio.sleep(3600)
@@ -192,6 +264,10 @@ def _openapi_type(d) -> str:
 
 class RestSource(DataSource):
     name = "rest"
+    # request-scoped tracing (engine/request_tracker.py): the streaming
+    # runtime wires the run's tracker here when the flight recorder is on;
+    # None keeps every stamp a dead branch
+    request_tracker = None
 
     def __init__(self, webserver: PathwayWebserver, route: str,
                  methods: tuple[str, ...], schema,
@@ -214,7 +290,7 @@ class RestSource(DataSource):
     def run(self, session: Session) -> None:
         self._session = session
 
-        async def handler(payload: dict):
+        async def handler(payload: dict, ctx=None):
             for col in self.schema.columns().values():
                 if col.name not in payload:
                     if col.has_default_value:
@@ -226,29 +302,52 @@ class RestSource(DataSource):
                 err = self.request_validator(payload)
                 if err:
                     raise _BadRequest(str(err))
-            with self._lock:
-                self._seq += 1
-                seq = self._seq
-            key, row = self.row_to_engine(payload, seq)
-            key = hash_values("rest", self._uid, seq)
-            loop = asyncio.get_event_loop()
-            event = asyncio.Event()
-            slot: list = [None]
-            self.pending[key] = (loop, event, slot)
-            session.push(key, row, 1)
-            await event.wait()
-            if self.delete_completed_queries:
-                session.push(key, row, -1)
-            return slot[0]
+            # request-scoped span: the webserver-assigned id + ingress
+            # stamp start it; the commit loop / scheduler / resolve add
+            # their stamps; finish() in the finally aggregates (or drops
+            # an unresolved span — client disconnect, handler error)
+            tracker = self.request_tracker
+            span = None
+            if tracker is not None and ctx is not None:
+                span = tracker.start(ctx.request_id, self.route,
+                                     ctx.ingress_t)
+            try:
+                with self._lock:
+                    self._seq += 1
+                    seq = self._seq
+                key, row = self.row_to_engine(payload, seq)
+                key = hash_values("rest", self._uid, seq)
+                loop = asyncio.get_event_loop()
+                event = asyncio.Event()
+                slot: list = [None]
+                self.pending[key] = (loop, event, slot)
+                if span is not None:
+                    # registered BEFORE push: the commit loop may drain
+                    # (and stamp tick pickup on) the row immediately
+                    tracker.enqueued(span, key)
+                session.push(key, row, 1)
+                await event.wait()
+                if self.delete_completed_queries:
+                    session.push(key, row, -1)
+                return slot[0]
+            finally:
+                if span is not None:
+                    tracker.finish(span)
 
         self.webserver.register(self.route, self.methods, handler,
                                 self.schema, format=self.format)
         self.webserver.start()
-        # stay alive until runtime stops us (sources close when run() returns)
-        stop = threading.Event()
-        stop.wait()
+        # stay alive until the runtime requests stop (sources close when
+        # run() returns; waiting on the session's stop event — not a
+        # private never-set one — lets teardown actually join this thread)
+        session.stopping.wait()
 
     def resolve(self, key: Pointer, value: Any) -> None:
+        tracker = self.request_tracker
+        if tracker is not None:
+            # stamped before waking the handler so the response_write
+            # stage starts at resolution, not at event delivery
+            tracker.resolved(key)
         entry = self.pending.pop(key, None)
         if entry is None:
             return

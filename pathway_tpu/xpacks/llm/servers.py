@@ -4,6 +4,14 @@ Reference: xpacks/llm/servers.py (BaseRestServer.serve:22, QARestServer:81,
 QASummaryRestServer:134). Each route → (schema, handler): rest_connector
 turns requests into a query table, the handler builds the result table,
 response_writer resolves the awaiting HTTP request.
+
+Serving SLO observability rides along for free (README "Serving SLO"):
+every request gets an id at webserver ingress, echoed back in the
+``X-Pathway-Request-Id`` response header, and — when the flight recorder
+is on (``with_http_server=True`` auto-enables it) — a per-stage latency
+decomposition on ``/metrics`` (``pathway_tpu_query_e2e_latency_ms``
+quantiles + SLO burn rate), ``/status.slow_queries`` and the Perfetto
+trace's request track. Tune the target with ``PATHWAY_SLO_E2E_MS``.
 """
 
 from __future__ import annotations

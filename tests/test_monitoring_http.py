@@ -185,6 +185,46 @@ def test_metrics_row_counters_and_gauges_still_linted():
     assert rows_out == [9 * len(_STEP_SAMPLES_MS)]
 
 
+def test_exchange_plane_metrics_exposed_per_row():
+    """A runtime with a cluster exports pathway_tpu_exchange_* including
+    the per-row encode/decode gauges (the r5 encdec-regression surface),
+    all passing the same exposition lint."""
+    from pathway_tpu.engine.multiproc import Cluster
+
+    rt = _FakeRuntime()
+    cl = Cluster(2, 0, 41000)
+    cl.stats.update({"encode_s": 0.010, "decode_s": 0.004,
+                     "rows_out": 2000, "rows_in": 1000,
+                     "bytes_out": 64000, "bytes_in": 32000,
+                     "messages": 4, "rounds": 2})
+    rt.cluster = cl
+    samples = _parse_samples(_metrics_lines(rt))
+    by_family = {f: v for f, _l, v in samples}
+    assert by_family["pathway_tpu_exchange_encode_us_per_row"] == \
+        pytest.approx(5.0)
+    assert by_family["pathway_tpu_exchange_decode_us_per_row"] == \
+        pytest.approx(4.0)
+    assert by_family["pathway_tpu_exchange_rows_out"] == 2000
+    assert by_family["pathway_tpu_exchange_bytes_in"] == 32000
+    assert by_family["pathway_tpu_exchange_rounds"] == 2
+
+
+def test_exchange_payload_row_counting():
+    """_payload_rows counts entries through packed and raw payload shapes
+    (scalars and liveness flags count zero)."""
+    from pathway_tpu.engine.multiproc import (_pack_payload, _payload_rows,
+                                              _unpack_payload)
+    from pathway_tpu.internals.keys import hash_values
+
+    ents = [(hash_values("r", i), (f"w{i}", i), 1) for i in range(7)]
+    payload = {"rows": {0: {3: ents}}, "wm": None, "bcast": {1: ents[:2]},
+               "any": True, "closed": False}
+    packed = _pack_payload(payload)
+    assert _payload_rows(packed) == 9
+    assert _payload_rows(_unpack_payload(packed)) == 9
+    assert _payload_rows({"any": True, "wm": 3}) == 0
+
+
 def test_trace_endpoint_serves_span_buffer():
     rt = _recording_runtime()
     server = MonitoringHttpServer(rt, port=0)
