@@ -402,6 +402,14 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             errors["etl_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
+    if "paging" not in SKIP:
+        # paged-store leg (CPU-runnable): ingest stall across online
+        # growth paged-vs-slab + ragged warmup compile count
+        try:
+            result.update(bench_paging())
+        except Exception as e:  # noqa: BLE001
+            errors["paging_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
     # sidecar path for the device-phase flight beacon, inherited by the
     # child processes; every emit below reads it, so the last surviving
     # JSON line always carries whatever attribution the child reported
@@ -572,7 +580,7 @@ def bench_embed() -> dict:
     # drain the async dispatch queue before the final stamp: sustained
     # throughput must include all queued device work, not just dispatches.
     # Materialize (not block_until_ready — a relay can report that ~0 ms):
-    np.asarray(index._dev_valid[:1])
+    index.drain()
     now = time.perf_counter()
     batch_times[-1] += now - last_t
     sustained = batch_times[1:]  # drop the warmup-straddling first batch
@@ -746,9 +754,8 @@ def bench_embed_framework(n_docs: int | None = None) -> dict:
     # the raw leg): the last ticks' fused ingests may still be queued
     for node in runner.graph.nodes:
         idx = getattr(node.op, "index", None)
-        if isinstance(idx, DeviceEmbeddingKnnIndex) and \
-                idx.inner._dev_valid is not None:
-            np.asarray(idx.inner._dev_valid[:1])  # materialize: relay-proof
+        if isinstance(idx, DeviceEmbeddingKnnIndex):
+            idx.inner.drain()  # materialize: relay-proof
     dt = time.perf_counter() - t0
     bridge = runner._scheduler.bridge_stats()
     G.clear()
@@ -1123,6 +1130,94 @@ def bench_etl(n_rows: int = 100_000) -> dict:
         out["etl_rows_per_s_per_core"] = round(rN / fit_workers, 0)
     else:
         out["etl_rows_per_s_per_core"] = round(r1, 0)
+    return out
+
+
+def bench_paging() -> dict:
+    """Paged-store leg (CPU-runnable, also meaningful on device): the two
+    acceptance numbers of the paged HBM vector store.
+
+    1. **Ingest stall during online growth**: identical chunked ingest
+       into the paged store and the contiguous slab, growth forced
+       mid-stream, each chunk flushed+drained so its wall time includes
+       its device work. The slab pays a stop-the-world full re-upload on
+       the first flush after every growth; the paged store only
+       establishes a fresh extent — ``paging_grow_stall_ms_paged`` vs
+       ``_slab`` is that difference, measured.
+    2. **Warmup compile count under ragged batching**: the encoder's
+       width-bucket zoo (~18 shapes) vs the ragged sequence-count buckets
+       ``pw.warmup`` actually compiles (≤ 6).
+    """
+    import pathway_tpu as pw
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.ops.knn import (BruteForceKnnIndex,
+                                     DeviceEmbeddingKnnIndex, KnnMetric)
+    from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
+
+    dim = int(os.environ.get("BENCH_PAGING_DIM", 256))
+    chunk = int(os.environ.get("BENCH_PAGING_CHUNK", 4096))
+    total = int(os.environ.get("BENCH_PAGING_ROWS", 16 * 4096))
+    rng = np.random.default_rng(0)
+    vecs = (rng.random((total, dim), np.float32) * 2.0 - 1.0)
+
+    def run_mode(paged: bool) -> dict:
+        index = BruteForceKnnIndex(dim, reserved_space=2 * chunk,
+                                   metric=KnnMetric.COS, paged=paged)
+        chunk_ms: list[float] = []
+        grow_chunks: list[float] = []
+        for base in range(0, total, chunk):
+            m = min(chunk, total - base)
+            keys = [Pointer(base + i) for i in range(m)]
+            cap_before = index.capacity
+            t0 = time.perf_counter()
+            index.add_batch(keys, vecs[base:base + m])
+            index.flush_device()
+            index.drain()
+            ms = (time.perf_counter() - t0) * 1e3
+            chunk_ms.append(ms)
+            if index.capacity > cap_before:
+                grow_chunks.append(ms)
+        res = index.search([(Pointer(10**9), vecs[7], 5, None)])
+        out = {
+            "ingest_p50_ms": round(float(np.percentile(chunk_ms, 50)), 2),
+            "ingest_p99_ms": round(float(np.percentile(chunk_ms, 99)), 2),
+            "grow_stall_ms": round(max(grow_chunks), 2) if grow_chunks
+            else None,
+            "grow_events": len(grow_chunks),
+            # rows written to device / rows ingested: the slab re-ships
+            # every occupied slot after each growth (stop-the-world
+            # re-upload); the paged store writes each row ONCE. This is
+            # the environment-independent form of the growth stall (on
+            # CPU, wall-ms mostly measures XLA compile churn instead)
+            "upload_amplification": round(
+                index.upload_rows_total / total, 3),
+        }
+        return out, res
+
+    paged, res_p = run_mode(True)
+    slab, res_s = run_mode(False)
+    out = {"paging_rows": total, "paging_dim": dim,
+           "paging_chunk": chunk,
+           "paging_identical_topk": res_p == res_s}
+    for k, v in paged.items():
+        out[f"paging_{k}_paged"] = v
+    for k, v in slab.items():
+        out[f"paging_{k}_slab"] = v
+
+    # warmup compile count: ragged buckets vs the width-bucket zoo (tiny
+    # encoder shape — the COUNT is the metric, the model size is not)
+    cfg = EncoderConfig.tiny(max_len=512)
+    emb = JaxEncoderEmbedder(config=cfg, ragged=True, max_len=512)
+    idx = DeviceEmbeddingKnnIndex(
+        emb, BruteForceKnnIndex(cfg.hidden, metric=KnnMetric.COS,
+                                paged=True))
+    t0 = time.perf_counter()
+    warm = pw.warmup(emb, index=idx, cache=False)
+    out["paging_warmup_compiles_ragged"] = len(warm["compiled"])
+    out["paging_warmup_seconds_ragged"] = round(
+        time.perf_counter() - t0, 2)
+    out["paging_warmup_bucket_shapes"] = len(emb.bucket_widths())
     return out
 
 

@@ -21,6 +21,17 @@ def monitoring_port() -> int:
     return base + pid
 
 
+def _paged_stats() -> dict | None:
+    """Aggregate paged-store occupancy (engine/paged_store.py), or None
+    when no paged pool is live in this process."""
+    try:
+        from pathway_tpu.engine.paged_store import live_paged_stats
+
+        return live_paged_stats()
+    except Exception:
+        return None
+
+
 class MonitoringHttpServer:
     def __init__(self, runtime, port: int | None = None):
         self.runtime = runtime
@@ -76,6 +87,11 @@ class MonitoringHttpServer:
             # stage (README "Serving SLO")
             payload["serving"] = tracker.summary()
             payload["slow_queries"] = tracker.slow_queries()
+        paged = _paged_stats()
+        if paged is not None:
+            # paged vector store (engine/paged_store.py): page table
+            # occupancy, extent count, growth events, per-tenant pages
+            payload["paged_store"] = paged
         return payload
 
     def _request_tracker(self):
@@ -285,6 +301,35 @@ class MonitoringHttpServer:
             lines.append("# TYPE pathway_tpu_device_exec_ms_total counter")
             lines.append(
                 f"pathway_tpu_device_exec_ms_total {bridge['exec_ms']}")
+        paged = _paged_stats()
+        if paged is not None:
+            # paged vector store occupancy (engine/paged_store.py): pool
+            # totals + the free-list level that proves delete/ingest churn
+            # reuses pages instead of growing HBM
+            lines.append("# TYPE pathway_tpu_paged_page_rows gauge")
+            lines.append(f"pathway_tpu_paged_page_rows {paged['page_rows']}")
+            lines.append("# TYPE pathway_tpu_paged_pages_total gauge")
+            lines.append(
+                f"pathway_tpu_paged_pages_total {paged['pages_total']}")
+            lines.append("# TYPE pathway_tpu_paged_pages_free gauge")
+            lines.append(
+                f"pathway_tpu_paged_pages_free {paged['pages_free']}")
+            lines.append("# TYPE pathway_tpu_paged_live_rows gauge")
+            lines.append(f"pathway_tpu_paged_live_rows {paged['live_rows']}")
+            lines.append("# TYPE pathway_tpu_paged_occupancy_ratio gauge")
+            lines.append(f"pathway_tpu_paged_occupancy_ratio "
+                         f"{round(paged['occupancy'], 6)}")
+            lines.append("# TYPE pathway_tpu_paged_extents gauge")
+            lines.append(f"pathway_tpu_paged_extents {paged['extents']}")
+            lines.append("# TYPE pathway_tpu_paged_grow_events counter")
+            lines.append(
+                f"pathway_tpu_paged_grow_events {paged['grow_events']}")
+            if paged["tenants"]:
+                lines.append("# TYPE pathway_tpu_paged_tenant_pages gauge")
+                for tenant, n in sorted(paged["tenants"].items()):
+                    lines.append(
+                        f'pathway_tpu_paged_tenant_pages'
+                        f'{{tenant="{esc(tenant)}"}} {n}')
         try:
             import resource
 

@@ -159,6 +159,15 @@ class ExternalIndexOperator(Operator):
                 flush_adds()  # preserve add/remove ordering within the batch
                 self.index.remove(key)
         flush_adds()
+        if data_changed and self._is_primary and \
+                hasattr(self.index, "flush_device"):
+            # push this tick's page uploads to the device NOW (async
+            # dispatch, inside the scheduler's device leg since this
+            # operator is device_bound): an ingest-only tick no longer
+            # parks its rows in the dirty set for the NEXT query's tick to
+            # upload synchronously — the paged store's upload cost rides
+            # the pipeline instead of the first query's latency
+            self.index.flush_device()
         out = Delta()
         # 2. answer query insertions (batched), retract answers on removal.
         # Per-key NET view of the batch: an update can arrive as +1-then--1

@@ -98,6 +98,10 @@ class StatsMonitor:
         # tracker (engine/request_tracker.py) — query quantiles, burn
         # rate and the most recent over-budget request's dominant stage
         self._serving_lines = self._serving_panel(scheduler)
+        # paged vector store line: page occupancy, free-list level and
+        # growth events (engine/paged_store.py) — page churn and online
+        # growth are visible without scraping /metrics
+        self._paged_line = self._paged_panel()
         # pipelined-execution line: in-flight depth, dispatch-queue wait
         # and overlap ratio straight from the device bridge, so the
         # host/device overlap is observable, not inferred
@@ -149,6 +153,9 @@ class StatsMonitor:
         if getattr(self, "_bridge_line", None):
             parts.append(Panel(self._bridge_line, title="pipelining",
                                height=None))
+        if getattr(self, "_paged_line", None):
+            parts.append(Panel(self._paged_line, title="paged store",
+                               height=None))
         if getattr(self, "_serving_lines", None):
             parts.append(Panel("\n".join(self._serving_lines),
                                title="serving", height=None))
@@ -188,6 +195,24 @@ class StatsMonitor:
                 f"dominant {last['dominant_stage']} "
                 f"({last['stages'][last['dominant_stage']]:.1f}ms)")
         return lines
+
+    def _paged_panel(self) -> str | None:
+        try:
+            from pathway_tpu.engine.paged_store import live_paged_stats
+
+            st = live_paged_stats()
+        except Exception:
+            return None
+        if st is None:
+            return None
+        line = (f"pages {st['pages_total'] - st['pages_free']}/"
+                f"{st['pages_total']} x {st['page_rows']} rows  "
+                f"occupancy {st['occupancy']:.0%}  "
+                f"extents {st['extents']}  grows {st['grow_events']}")
+        if st["tenants"]:
+            line += "  tenants " + " ".join(
+                f"{t}:{n}p" for t, n in sorted(st["tenants"].items()))
+        return line
 
     def _slowest_lines(self, top_n: int = 5) -> list[str]:
         """Critical-path panel: the operators that dominated the last
@@ -238,6 +263,8 @@ class StatsMonitor:
                       file=sys.stderr)
             if getattr(self, "_bridge_line", None):
                 print(f"[monitor] {self._bridge_line}", file=sys.stderr)
+            if getattr(self, "_paged_line", None):
+                print(f"[monitor] {self._paged_line}", file=sys.stderr)
             for line in getattr(self, "_serving_lines", None) or ():
                 print(f"[monitor] {line}", file=sys.stderr)
             for line in self._supervisor_lines():

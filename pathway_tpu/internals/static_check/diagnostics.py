@@ -83,6 +83,9 @@ CODES: dict[str, tuple[Severity, str]] = {
     "PWT110": (Severity.INFO,
                "jit-traceable UDF dispatched row-by-row on the host "
                "(auto-jit / batch=True candidate)"),
+    "PWT111": (Severity.WARNING,
+               "paged store reservation/tenant quota not page-aligned, or "
+               "tenant quotas sum past device HBM"),
 }
 
 

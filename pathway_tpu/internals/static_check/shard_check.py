@@ -593,11 +593,23 @@ class ShardChecker:
 
             reserved = getattr(factory, "reserved_space", None)
             if isinstance(reserved, int) and reserved > 0:
+                # layout-accurate message: the paged store (default)
+                # page-aligns each shard's slab, so the predicted cost
+                # must use the same page_rows the runtime will
+                pr = None
+                from pathway_tpu.engine.paged_store import (
+                    page_rows, paged_store_enabled)
+
+                if paged_store_enabled():
+                    try:
+                        pr = page_rows()
+                    except ValueError:
+                        pr = None  # reported separately as PWT111
                 for d in check_sharded_dim(
                         reserved, slab_data,
                         what=f"KNN slab reservation (reserved_space="
                              f"{reserved} over {slab_data} shards)"):
-                    cap = slab_cap_per_shard(slab_data, reserved)
+                    cap = slab_cap_per_shard(slab_data, reserved, pr)
                     self.a._report(
                         d.code,
                         d.message + f"; the slab allocates {cap} rows/shard "
@@ -615,10 +627,15 @@ class ShardChecker:
                 self.a._report(d.code, d.message, node, severity=d.severity)
 
         # PWT108: fused donated ingest with no reserved capacity
+        # (contiguous slab only — the paged store grows the fused path by
+        # allocating pages, so no fallback cliff exists there)
         fused = (getattr(factory, "fuse", False) and device_embedder
                  and getattr(factory, "mesh", None) is None)
         reserved = getattr(factory, "reserved_space", None)
-        if fused and isinstance(reserved, int) and reserved <= 0:
+        from pathway_tpu.engine.paged_store import paged_store_enabled
+
+        if fused and isinstance(reserved, int) and reserved <= 0 \
+                and not paged_store_enabled():
             from pathway_tpu.ops.knn import planned_capacity
 
             cap = planned_capacity(reserved or 0)
@@ -630,7 +647,84 @@ class ShardChecker:
                 f"back to the slow two-dispatch path — fix: reserve the "
                 f"expected corpus size up front",
                 node)
+        self._check_paged_layout(node, factory, reserved, slab_data)
         return device_embedder
+
+    def _check_paged_layout(self, node, factory, reserved,
+                            slab_data) -> None:
+        """PWT111: paged-store reservations and tenant quotas. Alignment
+        findings are warnings (the allocator rounds UP, silently
+        over-reserving); quotas summing past device HBM are errors."""
+        from pathway_tpu.engine.paged_store import (page_rows,
+                                                    paged_store_enabled)
+        from pathway_tpu.internals.static_check.diagnostics import Severity
+
+        if not paged_store_enabled():
+            return
+        try:
+            pr = page_rows()
+        except ValueError as e:
+            self.a._report("PWT111", f"invalid paged-store config: {e}",
+                           node, severity=Severity.ERROR)
+            return
+        if isinstance(reserved, int) and reserved > 0 and reserved % pr:
+            rounded = -(-reserved // pr) * pr
+            self.a._report(
+                "PWT111",
+                f"reserved_space={reserved} is not page-aligned "
+                f"(PATHWAY_PAGE_ROWS={pr}): the paged store rounds the "
+                f"reservation up to {rounded} rows "
+                f"({rounded // pr} pages), silently over-reserving "
+                f"{rounded - reserved} rows of HBM — fix: reserve whole "
+                f"pages",
+                node)
+        quotas = getattr(factory, "tenant_quotas", None)
+        if not isinstance(quotas, dict) or not quotas:
+            return
+        total_pages = 0
+        for tenant, rows in quotas.items():
+            if not isinstance(rows, int) or rows <= 0:
+                self.a._report(
+                    "PWT111",
+                    f"tenant {tenant!r} quota {rows!r} is not a positive "
+                    f"row count",
+                    node, severity=Severity.ERROR)
+                continue
+            pages = -(-rows // pr)
+            total_pages += pages
+            if rows % pr:
+                self.a._report(
+                    "PWT111",
+                    f"tenant {tenant!r} quota of {rows} rows is not "
+                    f"page-aligned (PATHWAY_PAGE_ROWS={pr}): the allocator "
+                    f"grants whole pages, so the quota silently becomes "
+                    f"{pages * pr} rows ({pages} pages) — fix: quota in "
+                    f"multiples of {pr}",
+                    node)
+        dim = getattr(factory, "dimensions", None)
+        if not isinstance(dim, int) or dim <= 0:
+            return
+        dtype = getattr(factory, "dtype", "float32")
+        bytes_per_val = {"int8": 1, "bfloat16": 2}.get(dtype, 4)
+        # int8 carries f32 scale+vsq side columns per row
+        row_bytes = dim * bytes_per_val + (8 if dtype == "int8" else 0)
+        hbm_bytes = int(float(os.environ.get(
+            "PATHWAY_DEVICE_HBM_GB", "16")) * (1 << 30))
+        n_dev = max(1, slab_data or 1)
+        need = total_pages * pr * row_bytes
+        if need > hbm_bytes * n_dev:
+            self.a._report(
+                "PWT111",
+                f"tenant quotas sum to {total_pages} pages "
+                f"({total_pages * pr} rows x {row_bytes} B/row = "
+                f"{need / (1 << 30):.1f} GiB as {dtype}) but the device "
+                f"has {hbm_bytes * n_dev / (1 << 30):.0f} GiB HBM "
+                f"({n_dev} dev x PATHWAY_DEVICE_HBM_GB"
+                f"={os.environ.get('PATHWAY_DEVICE_HBM_GB', '16')}) — "
+                f"admitting every tenant at "
+                f"quota OOMs the slab — fix: lower quotas or shard the "
+                f"store over more chips",
+                node, severity=Severity.ERROR)
 
     def _explicit_mesh_spec(self, factory) -> MeshSpec | None:
         """The factory's mesh when explicitly pinned (not None/'auto')."""

@@ -296,22 +296,15 @@ def _moe_block(x, p, config: EncoderConfig):
                        config.layer_norm_eps, out_dtype=cd)
 
 
-def encode(params: dict, token_ids, attention_mask, *,
-           config: EncoderConfig,
-           attn_fn: Callable | None = None,
-           token_type_ids=None):
-    """Forward pass → pooled, (optionally) L2-normalized embeddings.
-
-    token_ids, attention_mask: (B, S) int32 / bool. ``attn_fn`` overrides the
-    attention op (signature (q, k, v, mask) with (B,S,H,D) inputs) — pass a
-    ring/Ulysses wrapper for sequence-parallel long-context encoding.
-    """
-    if attn_fn is None:
-        attn_fn = _dense_attention
+def _forward(params: dict, token_ids, mask, *, config: EncoderConfig,
+             attn_fn: Callable, position_ids=None, token_type_ids=None):
+    """Embedding + transformer stack → (B, S, H) final hidden states.
+    ``position_ids=None`` keeps the standard 0..S-1 positions; the ragged
+    path passes per-token positions so each packed document restarts at 0
+    (byte-compatible with encoding it as its own row)."""
     emb = params["embeddings"]
     B, S = token_ids.shape
     cd = config.compute_dtype
-    mask = attention_mask.astype(bool)
     # Large batches: gather from a bf16 view of the table — the (V, H)
     # random-access read is the pass's most HBM-expensive op, and the one-off
     # f32→bf16 convert (~V*H*6 bytes) amortizes when the gather touches a
@@ -322,7 +315,10 @@ def encode(params: dict, token_ids, attention_mask, *,
         x = emb["token"].astype(cd)[token_ids]
     else:
         x = emb["token"][token_ids].astype(cd)
-    x = x + emb["position"][:S][None].astype(cd)
+    if position_ids is None:
+        x = x + emb["position"][:S][None].astype(cd)
+    else:
+        x = x + emb["position"][position_ids].astype(cd)
     if token_type_ids is None:
         x = x + emb["token_type"][0][None, None].astype(cd)
     else:
@@ -336,17 +332,89 @@ def encode(params: dict, token_ids, attention_mask, *,
             x = _moe_block(x, layer["moe"], config)
         else:
             x = _mlp_block(x, layer["mlp"], config)
+    return x
 
+
+def _normalized(pooled, config: EncoderConfig):
+    if config.normalize:
+        pooled = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+    return pooled
+
+
+def encode(params: dict, token_ids, attention_mask, *,
+           config: EncoderConfig,
+           attn_fn: Callable | None = None,
+           token_type_ids=None):
+    """Forward pass → pooled, (optionally) L2-normalized embeddings.
+
+    token_ids, attention_mask: (B, S) int32 / bool. ``attn_fn`` overrides the
+    attention op (signature (q, k, v, mask) with (B,S,H,D) inputs) — pass a
+    ring/Ulysses wrapper for sequence-parallel long-context encoding.
+    """
+    if attn_fn is None:
+        attn_fn = _dense_attention
+    mask = attention_mask.astype(bool)
+    x = _forward(params, token_ids, mask, config=config, attn_fn=attn_fn,
+                 token_type_ids=token_type_ids)
     if config.pooling == "cls":
         pooled = x[:, 0].astype(jnp.float32)
     else:  # mean over valid tokens
         xf = x.astype(jnp.float32)
         m = mask.astype(jnp.float32)[..., None]
         pooled = jnp.sum(xf * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
-    if config.normalize:
-        pooled = pooled / jnp.maximum(
-            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
-    return pooled
+    return _normalized(pooled, config)
+
+
+def _segment_attention(q, k, v, seg):
+    """_dense_attention with a block-diagonal (same-segment) mask: token q
+    attends token k iff they belong to the same packed document. Same
+    softmax numerics as _dense_attention — only the bias mask differs."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    same = (seg[:, :, None] == seg[:, None, :]) & (seg >= 0)[:, None, :]
+    bias = jnp.where(same[:, None, :, :], 0.0, -1e9).astype(scores.dtype)
+    scores = scores + bias
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp((scores - m).astype(jnp.float32)).astype(scores.dtype)
+    probs = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def encode_ragged(params: dict, token_ids, doc_map, position_ids,
+                  doc_seq, doc_off, *, config: EncoderConfig):
+    """Ragged-packed forward: variable-length documents packed back-to-back
+    into fixed-width sequences (Ragged Paged Attention's batching applied
+    to the encoder) → (n_docs, H) pooled embeddings.
+
+    token_ids (B, W) int32: packed tokens, many docs per row;
+    doc_map (B, W) int32: output row per token (-1 = padding) — doubles as
+    the attention segment id, so docs sharing a sequence never attend each
+    other; position_ids (B, W): positions restarting at 0 per doc;
+    doc_seq/doc_off (N,): each output doc's (sequence, first-token offset),
+    CLS pooling gathers there. Compilation depends only on (B, W, N) — the
+    per-width bucket zoo collapses to a handful of sequence-count buckets.
+    """
+    mask = doc_map >= 0
+
+    def attn(q, k, v, _mask):
+        return _segment_attention(q, k, v, doc_map)
+
+    x = _forward(params, token_ids, mask, config=config, attn_fn=attn,
+                 position_ids=position_ids)
+    n_docs = doc_seq.shape[0]
+    if config.pooling == "cls":
+        pooled = x[doc_seq, doc_off].astype(jnp.float32)
+    else:  # per-document mean over the packed tokens
+        B, W = token_ids.shape
+        flat = x.reshape(B * W, -1).astype(jnp.float32)
+        seg = jnp.where(mask, doc_map, n_docs).reshape(B * W)
+        sums = jax.ops.segment_sum(flat, seg, num_segments=n_docs + 1)
+        cnt = jax.ops.segment_sum(
+            mask.astype(jnp.float32).reshape(B * W), seg,
+            num_segments=n_docs + 1)
+        pooled = sums[:n_docs] / jnp.maximum(cnt[:n_docs, None], 1.0)
+    return _normalized(pooled, config)
 
 
 @functools.partial(jax.jit, static_argnames=("config",))

@@ -137,15 +137,12 @@ class HnswIndex:
             self._filters.pop(key, None)
 
     def _passes_filter(self, key: Pointer, filt) -> bool:
-        data = self._filters.get(key)
-        if callable(filt):
-            try:
-                return bool(filt(data))
-            except Exception:
-                return False
-        from pathway_tpu.internals.jmespath_lite import evaluate_filter
+        # same dispatch predicate as the device slab/paged indexes
+        # (ops/knn.py passes_filter): fail-closed callables, jmespath-lite
+        # strings — search semantics cannot drift between engines
+        from pathway_tpu.ops.knn import passes_filter
 
-        return evaluate_filter(filt, data)
+        return passes_filter(self._filters, key, filt)
 
     def search(self, queries: list[tuple]) -> list[tuple]:
         """[(qkey, vector, limit, filter)] -> per query ((key, dist), ...)

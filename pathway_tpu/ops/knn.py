@@ -28,6 +28,17 @@ Two scale features target the 10M-vector p50 budget (BASELINE.md):
 - Above ``_CHUNK_ROWS`` slots the kernel switches to a ``lax.scan`` over
   slab chunks with a per-chunk top-k and a final merge, bounding the
   (B, N) score buffer at (B, chunk) regardless of slab size.
+
+Device storage is PAGED by default (engine/paged_store.py, Ragged Paged
+Attention's memory design): HBM is allocated in page-aligned extents that
+are never moved once created, a host page table maps slots to (page,
+offset), growth appends an extent instead of discarding + re-uploading the
+slab, frees return pages to a free list, and the fused donated ingest can
+grow (it allocates pages in one extent, or a fresh extent). Search runs
+the SAME kernels per extent and merges per-extent top-k — byte-identical
+results vs the contiguous slab, which stays available behind
+``PATHWAY_PAGED_STORE=0`` (and is the reference the paged tests pin
+against).
 """
 
 from __future__ import annotations
@@ -55,6 +66,21 @@ _CHUNK_ROWS = 1 << 19
 
 def _round_up(n: int, mult: int) -> int:
     return ((n + mult - 1) // mult) * mult
+
+
+def passes_filter(filter_data: dict, key: Pointer, filt: Any) -> bool:
+    """The ONE metadata-filter predicate every index variant dispatches
+    through (brute-force, paged, sharded, HNSW): callable filters are
+    fail-closed, string filters go through the jmespath-lite engine."""
+    data = filter_data.get(key)
+    if callable(filt):
+        try:
+            return bool(filt(data))
+        except Exception:
+            return False
+    from pathway_tpu.internals.jmespath_lite import evaluate_filter
+
+    return evaluate_filter(filt, data)
 
 
 def planned_capacity(reserved_space: int) -> int:
@@ -276,6 +302,38 @@ def _shared_scatter_fn():
     return scatter
 
 
+def _fused_step_fns(producer: Callable, dtype: str):
+    """The donated producer+scatter step of a fused ingest — shared by the
+    slab and paged stores (shape-polymorphic: the paged variant passes one
+    extent's arrays instead of the whole slab). ``mode="drop"`` makes the
+    out-of-range sentinel slots of ragged padding rows a guaranteed no-op;
+    in-range scatters are unaffected."""
+    import jax
+    import jax.numpy as jnp
+
+    if dtype == "int8":
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def step_i8(slab, scales, vsq, valid, slots, *args):
+            q, scale, vn = _quantize_i8(producer(*args))
+            return (slab.at[slots].set(q, mode="drop"),
+                    scales.at[slots].set(scale, mode="drop"),
+                    vsq.at[slots].set(vn, mode="drop"),
+                    valid.at[slots].set(True, mode="drop"))
+
+        return step_i8
+
+    slab_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(slab, valid, slots, *args):
+        out = producer(*args)
+        slab = slab.at[slots].set(out.astype(slab_dtype), mode="drop")
+        valid = valid.at[slots].set(True, mode="drop")
+        return slab, valid
+
+    return step
+
+
 class BruteForceKnnIndex:
     """Incremental exact KNN over a device-resident vector slab.
 
@@ -289,30 +347,68 @@ class BruteForceKnnIndex:
     # pipelined device leg (engine/device_bridge.py)
     device_bound = True
 
+    def __new__(cls, *args, **kwargs):
+        # paged device storage is the default; PATHWAY_PAGED_STORE=0 (or
+        # paged=False) selects this legacy contiguous-slab class itself
+        if cls is BruteForceKnnIndex:
+            from pathway_tpu.engine.paged_store import paged_store_enabled
+
+            if paged_store_enabled(kwargs.get("paged")):
+                cls = PagedKnnIndex
+        return object.__new__(cls)
+
     def __init__(self, dimensions: int, *, reserved_space: int = 0,
                  metric: KnnMetric | str = KnnMetric.L2SQ,
-                 dtype: str = "float32", device=None):
+                 dtype: str = "float32", device=None,
+                 paged: bool | None = None, page_rows: int | None = None,
+                 tenant: Any = None,
+                 tenant_quotas: dict[Any, int] | None = None):
         if isinstance(metric, str):
             metric = KnnMetric(metric)
         self.dim = int(dimensions)
         self.metric = metric
-        self.capacity = planned_capacity(reserved_space)
         self.dtype = dtype
         self._np_dtype = _np_dtype(dtype)
         self._is_int8 = dtype == "int8"
         self._lock = threading.RLock()
 
+        self._key_to_slot: dict[Pointer, int] = {}
+        self._slot_to_key: dict[int, Pointer] = {}
+        self._filter_data: dict[Pointer, Any] = {}
+        self._dirty: set[int] = set()    # host → device pending
+        self._stale: set[int] = set()    # device → host pending (add_batch_device)
+        # rows written to device storage (scatters + dense uploads).
+        # upload_rows_total / rows ingested is the re-upload amplification
+        # the paged store exists to delete: the slab re-ships every
+        # occupied slot after a growth, pages never re-ship
+        self.upload_rows_total = 0
+        self._init_storage(reserved_space, device, page_rows=page_rows,
+                           tenant=tenant, tenant_quotas=tenant_quotas)
+
+    # ------------------------------------------------------------------
+    # storage hooks — the paged subclass swaps slot allocation + device
+    # layout here; everything else (key maps, mirror semantics, search
+    # ranking, filters) is shared
+    # ------------------------------------------------------------------
+    def _init_storage(self, reserved_space: int, device, *,
+                      page_rows: int | None = None, tenant: Any = None,
+                      tenant_quotas: dict[Any, int] | None = None) -> None:
+        if tenant_quotas:
+            # quota accounting lives in the page allocator — the
+            # contiguous slab has none. Loud, not silent: a quota the
+            # runtime will not enforce is a security config bug
+            import logging
+
+            logging.getLogger("pathway_tpu.paged_store").warning(
+                "tenant_quotas are only enforced by the paged store — "
+                "the contiguous slab (PATHWAY_PAGED_STORE=0 / "
+                "paged=False) ignores them")
+        self.capacity = planned_capacity(reserved_space)
         # host mirror
         self._host_vectors = np.zeros((self.capacity, self.dim),
                                       dtype=self._np_dtype)
         self._host_valid = np.zeros((self.capacity,), dtype=bool)
-        self._key_to_slot: dict[Pointer, int] = {}
-        self._slot_to_key: dict[int, Pointer] = {}
-        self._filter_data: dict[Pointer, Any] = {}
         self._free: list[int] = list(range(self.capacity - 1, -1, -1))
-        self._dirty: set[int] = set()    # host → device pending
-        self._stale: set[int] = set()    # device → host pending (add_batch_device)
-
         # device state (lazy); _dev_scales/_dev_vsq only for int8
         # (per-row quantization scale + INT-domain squared norm, f32)
         self._dev_vectors = None
@@ -321,6 +417,17 @@ class BruteForceKnnIndex:
         self._dev_vsq = None
         self._device = device
 
+    def _ensure_free(self, n: int) -> None:
+        """Guarantee ``n`` subsequent ``_take_slot`` calls succeed."""
+        while len(self._free) < n:
+            self._grow()
+
+    def _take_slot(self) -> int:
+        return self._free.pop()
+
+    def _release_slot(self, slot: int) -> None:
+        self._free.append(slot)
+
     # ------------------------------------------------------------------
     # maintenance (called from the external-index operator on data diffs)
     # ------------------------------------------------------------------
@@ -328,9 +435,8 @@ class BruteForceKnnIndex:
         """Slot for ``key``, allocating (and growing) if new. Lock held."""
         slot = self._key_to_slot.get(key)
         if slot is None:
-            if not self._free:
-                self._grow()
-            slot = self._free.pop()
+            self._ensure_free(1)
+            slot = self._take_slot()
             self._key_to_slot[key] = slot
             self._slot_to_key[slot] = key
         return slot
@@ -380,16 +486,15 @@ class BruteForceKnnIndex:
         self.set_filter_data(keys, filter_data)
         with self._lock:
             n_new = len({k for k in keys if k not in self._key_to_slot})
-            while len(self._free) < n_new:
-                self._grow()
+            self._ensure_free(n_new)
             slots = np.empty(len(keys), dtype=np.int64)
             k2s = self._key_to_slot  # bulk ingest: locals beat attr lookups
             s2k = self._slot_to_key
-            free = self._free
+            take = self._take_slot
             for i, key in enumerate(keys):
                 slot = k2s.get(key)
                 if slot is None:
-                    slot = free.pop()
+                    slot = take()
                     k2s[key] = slot
                     s2k[slot] = key
                 slots[i] = slot
@@ -419,14 +524,14 @@ class BruteForceKnnIndex:
         self.set_filter_data(keys, filter_data)
         with self._lock:
             n_new = len({k for k in keys if k not in self._key_to_slot})
-            while len(self._free) < n_new:
-                self._grow()
+            self._ensure_free(n_new)
             slots = np.empty(len(keys), dtype=np.int32)
-            k2s, s2k, free = self._key_to_slot, self._slot_to_key, self._free
+            k2s, s2k = self._key_to_slot, self._slot_to_key
+            take = self._take_slot
             for i, key in enumerate(keys):
                 slot = k2s.get(key)
                 if slot is None:
-                    slot = free.pop()
+                    slot = take()
                     k2s[key] = slot
                     s2k[slot] = key
                 slots[i] = slot
@@ -448,67 +553,77 @@ class BruteForceKnnIndex:
         the embedding tensor never leaves the chip.
 
         ``producer(*args) -> (n, dim) array``. Returns
-        ``ingest(keys, *args)``. Capacity must not grow mid-stream —
+        ``ingest(keys, *args, n_rows=None)``; ``n_rows`` is the producer's
+        output row count when it exceeds ``len(keys)`` (ragged-packed
+        batches pad their doc dimension) — padding rows scatter to an
+        out-of-range sentinel slot and are dropped.
+
+        On the contiguous slab, capacity must not grow mid-stream —
         reserve up front (ValueError otherwise, donation pins the shape).
+        The paged store (default) grows instead: new keys allocate pages
+        in one extent, or a fresh extent.
         """
-        import functools
+        step = _fused_step_fns(producer, self.dtype)
 
-        import jax
-        import jax.numpy as jnp
-
-        slab_dtype = (jnp.bfloat16 if self.dtype == "bfloat16"
-                      else jnp.float32)
-
-        if self._is_int8:
-            @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-            def step_i8(slab, scales, vsq, valid, slots, *args):
-                q, scale, vn = _quantize_i8(producer(*args))
-                return (slab.at[slots].set(q),
-                        scales.at[slots].set(scale),
-                        vsq.at[slots].set(vn),
-                        valid.at[slots].set(True))
-        else:
-            @functools.partial(jax.jit, donate_argnums=(0, 1))
-            def step(slab, valid, slots, *args):
-                out = producer(*args)
-                slab = slab.at[slots].set(out.astype(slab_dtype))
-                valid = valid.at[slots].set(True)
-                return slab, valid
-
-        def ingest(keys: list[Pointer], *args) -> None:
+        def ingest(keys: list[Pointer], *args,
+                   n_rows: int | None = None) -> None:
             with self._lock:
-                n_new = len({k for k in keys
-                             if k not in self._key_to_slot})
-                if len(self._free) < n_new:
-                    raise ValueError(
-                        "fused ingest cannot grow the slab (donated shape "
-                        "is pinned) — reserve capacity up front")
-                self._flush_to_device()
-                slots = np.empty(len(keys), dtype=np.int32)
-                k2s, s2k, free = (self._key_to_slot, self._slot_to_key,
-                                  self._free)
-                for i, key in enumerate(keys):
-                    slot = k2s.get(key)
-                    if slot is None:
-                        slot = free.pop()
-                        k2s[key] = slot
-                        s2k[slot] = key
-                    slots[i] = slot
-                if self._is_int8:
-                    (self._dev_vectors, self._dev_scales, self._dev_vsq,
-                     self._dev_valid) = step_i8(
-                        self._dev_vectors, self._dev_scales, self._dev_vsq,
-                        self._dev_valid, jnp.asarray(slots), *args)
-                else:
-                    self._dev_vectors, self._dev_valid = step(
-                        self._dev_vectors, self._dev_valid,
-                        jnp.asarray(slots), *args)
-                self._host_valid[slots] = True
-                slot_list = slots.tolist()
-                self._stale.update(slot_list)
-                self._dirty.difference_update(slot_list)
+                self._fused_ingest(step, keys, args, n_rows)
 
         return ingest
+
+    def _fused_take_slots(self, keys: list[Pointer],
+                          take: Callable | None = None) -> np.ndarray:
+        """Slot per key (existing or freshly taken). Lock held; capacity
+        for the new keys has already been ensured — ``take`` must not
+        fail. The paged subclass passes a region-pinned ``take``."""
+        slots = np.empty(len(keys), dtype=np.int32)
+        k2s, s2k = self._key_to_slot, self._slot_to_key
+        take = take or self._take_slot
+        for i, key in enumerate(keys):
+            slot = k2s.get(key)
+            if slot is None:
+                slot = take()
+                k2s[key] = slot
+                s2k[slot] = key
+            slots[i] = slot
+        return slots
+
+    @staticmethod
+    def _pad_slots(slots: np.ndarray, n_rows: int | None, sentinel: int):
+        import jax.numpy as jnp
+
+        if n_rows is not None and n_rows > len(slots):
+            # ragged batches pad the producer's doc dimension: sentinel
+            # (out-of-range) slots + the steps' mode="drop" scatters
+            # discard the padding rows
+            slots = np.concatenate([
+                slots,
+                np.full(n_rows - len(slots), sentinel, np.int32)])
+        return jnp.asarray(slots)
+
+    def _fused_ingest(self, step, keys: list[Pointer], args,
+                      n_rows: int | None) -> None:
+        n_new = len({k for k in keys if k not in self._key_to_slot})
+        if len(self._free) < n_new:
+            raise ValueError(
+                "fused ingest cannot grow the slab (donated shape "
+                "is pinned) — reserve capacity up front")
+        self._flush_to_device()
+        slots = self._fused_take_slots(keys)
+        dev_slots = self._pad_slots(slots, n_rows, self.capacity)
+        if self._is_int8:
+            (self._dev_vectors, self._dev_scales, self._dev_vsq,
+             self._dev_valid) = step(
+                self._dev_vectors, self._dev_scales, self._dev_vsq,
+                self._dev_valid, dev_slots, *args)
+        else:
+            self._dev_vectors, self._dev_valid = step(
+                self._dev_vectors, self._dev_valid, dev_slots, *args)
+        self._host_valid[slots] = True
+        slot_list = slots.tolist()
+        self._stale.update(slot_list)
+        self._dirty.difference_update(slot_list)
 
     def _sync_mirror(self) -> None:
         """Pull device-authoritative rows back into the host mirror (lock
@@ -535,7 +650,7 @@ class BruteForceKnnIndex:
             del self._slot_to_key[slot]
             self._filter_data.pop(key, None)
             self._host_valid[slot] = False
-            self._free.append(slot)
+            self._release_slot(slot)
             self._dirty.add(slot)
             self._stale.discard(slot)
 
@@ -570,6 +685,7 @@ class BruteForceKnnIndex:
     # ------------------------------------------------------------------
     def _scatter(self, idxs, vals, valid_vals):
         """Slab-donating scatter through the shared jitted kernel."""
+        self.upload_rows_total += int(idxs.shape[0])
         if self._is_int8:
             (self._dev_vectors, self._dev_scales, self._dev_vsq,
              self._dev_valid) = _shared_scatter_i8_fn()(
@@ -606,6 +722,7 @@ class BruteForceKnnIndex:
             else:
                 self._dev_vectors = jnp.asarray(self._host_vectors)
                 self._dev_valid = jnp.asarray(self._host_valid)
+                self.upload_rows_total += self.capacity
                 self._dirty.clear()
                 return
         if self._dirty:
@@ -623,6 +740,15 @@ class BruteForceKnnIndex:
         with self._lock:
             self._flush_to_device()
 
+    def drain(self) -> None:
+        """Materialize the device state (one element per buffer): blocks
+        until every dispatched scatter/ingest resolved. Relay-proof (an
+        async relay reports block_until_ready as ~0 ms) — benches stamp
+        sustained throughput after this."""
+        with self._lock:
+            if self._dev_valid is not None:
+                np.asarray(self._dev_valid[:1])
+
     def _get_search_fn(self, k: int):
         """Jitted search(queries, vectors, extras, valid) — pair with
         ``_search_extras()`` at the call site."""
@@ -637,6 +763,19 @@ class BruteForceKnnIndex:
         if self._is_int8:
             return (self._dev_scales, self._dev_vsq)
         return ()
+
+    def _fetch_cap(self) -> int:
+        """Upper bound on per-search candidate fetch (the chunked kernel's
+        per-chunk top-k bounds it at the chunk size)."""
+        return min(self.capacity, _CHUNK_ROWS)
+
+    def _device_topk(self, qmat, fetch_k: int):
+        """(scores, global slot ids) as host arrays, exactly ``fetch_k``
+        columns, best first. Lock held, device state flushed."""
+        search_fn = self._get_search_fn(fetch_k)
+        ts, ti = search_fn(qmat, self._dev_vectors, self._search_extras(),
+                           self._dev_valid)
+        return np.asarray(ts), np.asarray(ti)
 
     def search(self, queries: list[tuple]) -> list[tuple]:
         """Batched search: [(qkey, vector, limit, filter)] →
@@ -656,7 +795,7 @@ class BruteForceKnnIndex:
             # k; the chunked kernel's per-chunk top-k bounds fetch at the
             # chunk size
             has_filter = any(q[3] is not None for q in queries)
-            fetch_cap = min(self.capacity, _CHUNK_ROWS)
+            fetch_cap = self._fetch_cap()
             fetch_k = min(fetch_cap,
                           max_k * 4 if has_filter else max_k)
             fetch_k = max(fetch_k, 1)
@@ -665,12 +804,7 @@ class BruteForceKnnIndex:
                           for q in queries]))
 
             while True:
-                search_fn = self._get_search_fn(fetch_k)
-                top_scores_d, top_idx_d = search_fn(qmat, self._dev_vectors,
-                                                    self._search_extras(),
-                                                    self._dev_valid)
-                top_scores = np.asarray(top_scores_d)
-                top_idx = np.asarray(top_idx_d)
+                top_scores, top_idx = self._device_topk(qmat, fetch_k)
 
                 out = []
                 exhausted = True
@@ -764,37 +898,353 @@ class BruteForceKnnIndex:
             if not self._key_to_slot:
                 raise ValueError("empty index")
             self._flush_to_device()
-            search_fn = self._get_search_fn(k)
+            run, operands = self._probe_searcher(k)
             rng = np.random.default_rng(seed)
             qpool = jnp.asarray(rng.random(
                 (reps, batch_size, self.dim), dtype=np.float32) * 2.0 - 1.0)
-            vectors, valid = self._dev_vectors, self._dev_valid
-            extras = self._search_extras()
 
             @jax.jit
-            def probe(qpool, vectors, extras, valid):
+            def probe(qpool, operands):
                 def body(i, acc):
-                    ts, ti = search_fn(qpool[i], vectors, extras, valid)
+                    ts, ti = run(qpool[i], operands)
                     return acc + jnp.sum(ts) + jnp.sum(ti).astype(jnp.float32)
 
                 return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
 
-            float(probe(qpool, vectors, extras, valid))  # compile + warm
+            float(probe(qpool, operands))  # compile + warm
             t0 = _time.perf_counter()
-            float(probe(qpool, vectors, extras, valid))
+            float(probe(qpool, operands))
             total = _time.perf_counter() - t0
             return total / reps * 1e3
 
-    def _passes_filter(self, key: Pointer, filt: Any) -> bool:
-        data = self._filter_data.get(key)
-        if callable(filt):
-            try:
-                return bool(filt(data))
-            except Exception:
-                return False
-        from pathway_tpu.internals.jmespath_lite import evaluate_filter
+    def _probe_searcher(self, k: int):
+        """``(run, operands)`` with ``run(qbatch, operands) -> (ts, ti)``
+        jit-traceable — the device side of one search, parameterized so
+        latency_probe measures the REAL storage layout (slab or paged)."""
+        search_fn = self._get_search_fn(k)
+        operands = (self._dev_vectors, self._search_extras(),
+                    self._dev_valid)
 
-        return evaluate_filter(filt, data)
+        def run(q, operands):
+            vectors, extras, valid = operands
+            return search_fn(q, vectors, extras, valid)
+
+        return run, operands
+
+    def _passes_filter(self, key: Pointer, filt: Any) -> bool:
+        return passes_filter(self._filter_data, key, filt)
+
+
+class PagedKnnIndex(BruteForceKnnIndex):
+    """BruteForceKnnIndex over the paged device store (the default —
+    ``BruteForceKnnIndex(...)`` constructs this class unless
+    ``PATHWAY_PAGED_STORE=0`` / ``paged=False``).
+
+    Device memory is a :class:`~pathway_tpu.engine.paged_store.DevicePagePool`
+    of page-aligned extents; the host page table (PageAllocator) maps
+    slots to (page, offset). What changes vs the slab:
+
+    - **growth is online**: a new extent is appended (established as zeros
+      on device); existing extents are never discarded, re-uploaded or
+      re-quantized, and the dirty set is untouched — no stop-the-world
+      re-upload stall, and device-authoritative rows need no mirror
+      round-trip before growing;
+    - **fused donated ingest can grow**: new keys allocate pages inside
+      one extent (or a fresh extent when none fits the batch) and the
+      donated step scatters into that extent only;
+    - **frees return pages** to the allocator's free list for reuse —
+      ingest/delete churn keeps occupancy bounded;
+    - **search is per-extent + merge**: each established extent runs the
+      SAME shared kernel the slab uses; per-extent top-k candidates merge
+      on the host by (score desc, slot asc) — byte-identical results to
+      the slab path (single extent: literally the same kernel call);
+    - ``tenant`` / ``tenant_quotas`` tag this index's pages in the
+      allocator and cap them (PageQuotaExceeded past the cap) — the
+      accounting unit for many small indexes on one device.
+
+    The host mirror stays one contiguous array indexed by global slot
+    (mirror growth is a host-RAM memcpy; only DEVICE copies are the stall
+    this class deletes).
+    """
+
+    def _init_storage(self, reserved_space: int, device, *,
+                      page_rows: int | None = None, tenant: Any = None,
+                      tenant_quotas: dict[Any, int] | None = None) -> None:
+        from pathway_tpu.engine.paged_store import DevicePagePool
+
+        self._pool = DevicePagePool(
+            self.dim, reserved_space=reserved_space,
+            rows_per_page=page_rows, tenant_quotas=tenant_quotas,
+            lock=self._lock)
+        self._tenant = tenant
+        self._host_vectors = np.zeros((self._pool.capacity, self.dim),
+                                      dtype=self._np_dtype)
+        self._host_valid = np.zeros((self._pool.capacity,), dtype=bool)
+        self._free = None  # slot accounting lives in the page allocator
+        self._device = device
+
+    @property
+    def capacity(self) -> int:
+        return self._pool.capacity
+
+    def page_stats(self) -> dict:
+        with self._lock:
+            return self._pool.stats()
+
+    # -- slot allocation through the page table -------------------------
+    def _ensure_free(self, n: int) -> None:
+        self._pool.ensure_free(n, self._tenant)
+        self._extend_mirror()
+
+    def _take_slot(self) -> int:
+        return self._pool.allocator.take_slot(self._tenant)
+
+    def _release_slot(self, slot: int) -> None:
+        self._pool.allocator.release_slot(slot)
+
+    def _grow(self) -> None:
+        self._pool.grow()
+        self._extend_mirror()
+
+    def _extend_mirror(self) -> None:
+        """Track pool capacity in the host mirror. Host-side only — the
+        device extents are untouched (no re-upload, dirty set unchanged,
+        device-authoritative rows stay put: no _sync_mirror needed)."""
+        cap = self._pool.capacity
+        old = self._host_vectors.shape[0]
+        if cap <= old:
+            return
+        new_vec = np.zeros((cap, self.dim), dtype=self._np_dtype)
+        new_vec[:old] = self._host_vectors
+        self._host_vectors = new_vec
+        new_valid = np.zeros((cap,), dtype=bool)
+        new_valid[:old] = self._host_valid
+        self._host_valid = new_valid
+
+    # -- device state per extent ----------------------------------------
+    def _establish_extent(self, ext) -> None:
+        """Zero device arrays for one extent (on-device allocation, no
+        host transfer) — rows arrive by scatter only, so establishment is
+        one-time and extents are never re-created."""
+        if ext.established:
+            return
+        import jax.numpy as jnp
+
+        if self._is_int8:
+            ext.vectors = jnp.zeros((ext.rows, self.dim), dtype=jnp.int8)
+            ext.scales = jnp.zeros((ext.rows,), jnp.float32)
+            ext.vsq = jnp.zeros((ext.rows,), jnp.float32)
+        else:
+            slab_dtype = (jnp.bfloat16 if self.dtype == "bfloat16"
+                          else jnp.float32)
+            ext.vectors = jnp.zeros((ext.rows, self.dim), dtype=slab_dtype)
+        ext.valid = jnp.zeros((ext.rows,), dtype=bool)
+
+    def _scatter(self, idxs, vals, valid_vals):
+        import jax.numpy as jnp
+
+        idxs_np = np.asarray(idxs)
+        self.upload_rows_total += len(idxs_np)
+        groups = list(self._pool.split_by_extent(idxs_np))
+        for ext, local, pos in groups:
+            self._establish_extent(ext)
+            if len(groups) == 1:
+                vsub, valsub = vals, valid_vals
+            else:
+                vsub, valsub = vals[pos], valid_vals[pos]
+            if self._is_int8:
+                (ext.vectors, ext.scales, ext.vsq,
+                 ext.valid) = _shared_scatter_i8_fn()(
+                    ext.vectors, ext.scales, ext.vsq, ext.valid,
+                    jnp.asarray(local, dtype=jnp.int32), vsub, valsub)
+            else:
+                ext.vectors, ext.valid = _shared_scatter_fn()(
+                    ext.vectors, ext.valid,
+                    jnp.asarray(local, dtype=jnp.int32), vsub, valsub)
+
+    def _flush_to_device(self):
+        import jax.numpy as jnp
+
+        if not self._dirty:
+            return
+        idxs = np.fromiter(self._dirty, dtype=np.int64)
+        self._dirty.clear()
+        scatter_rows: list[np.ndarray] = []
+        for ext, local, pos in self._pool.split_by_extent(idxs):
+            if not ext.established and not self._is_int8 \
+                    and len(pos) * 2 >= ext.rows:
+                # bulk load of a fresh extent: one dense upload of its
+                # mirror range (the slab's dense shortcut, per extent) —
+                # rows outside the dirty set are zeros with valid False
+                ext.vectors = jnp.asarray(
+                    self._host_vectors[ext.base:ext.base + ext.rows])
+                ext.valid = jnp.asarray(
+                    self._host_valid[ext.base:ext.base + ext.rows])
+                self.upload_rows_total += ext.rows
+            else:
+                scatter_rows.append(idxs[pos])
+        if scatter_rows:
+            rows = np.concatenate(scatter_rows)
+            self._scatter(rows, jnp.asarray(self._host_vectors[rows]),
+                          jnp.asarray(self._host_valid[rows]))
+
+    def _sync_mirror(self) -> None:
+        if not self._stale:
+            return
+        idxs = np.fromiter(self._stale, dtype=np.int64)
+        self._stale.clear()
+        for ext, local, pos in self._pool.split_by_extent(idxs):
+            if not ext.established:
+                continue
+            rows_global = idxs[pos]
+            local = local.astype(np.int32)
+            if self._is_int8:
+                rows = np.asarray(ext.vectors[local], dtype=np.float32)
+                scales = np.asarray(ext.scales[local], dtype=np.float32)
+                self._host_vectors[rows_global] = rows * scales[:, None]
+            else:
+                self._host_vectors[rows_global] = np.asarray(
+                    ext.vectors[local]).astype(self._np_dtype)
+
+    # -- search over the page table --------------------------------------
+    def _extent_extras(self, ext) -> tuple:
+        if self._is_int8:
+            return (ext.scales, ext.vsq)
+        return ()
+
+    def _extent_fetch_cap(self, ext) -> int:
+        return min(ext.rows, _CHUNK_ROWS)
+
+    def _device_topk(self, qmat, fetch_k: int):
+        parts = []
+        for ext in self._pool.extents:
+            if not ext.established:
+                continue  # never written → no valid rows to score
+            k_e = min(fetch_k, self._extent_fetch_cap(ext))
+            fn = self._get_search_fn(k_e)
+            ts, ti = fn(qmat, ext.vectors, self._extent_extras(ext),
+                        ext.valid)
+            parts.append((np.asarray(ts), np.asarray(ti) + ext.base))
+        if not parts:
+            B = int(qmat.shape[0])
+            return (np.full((B, fetch_k), -np.inf, np.float32),
+                    np.zeros((B, fetch_k), np.int64))
+        if len(parts) == 1 and parts[0][0].shape[1] == fetch_k:
+            return parts[0]
+        # merge per-extent candidates: stable argsort on descending score
+        # reproduces top_k's tie order (candidates are laid out in global
+        # slot order: extents by base, top_k ties by ascending local slot)
+        cand_s = np.concatenate([p[0] for p in parts], axis=1)
+        cand_i = np.concatenate([p[1] for p in parts], axis=1)
+        order = np.argsort(-cand_s, axis=1, kind="stable")[:, :fetch_k]
+        top_s = np.take_along_axis(cand_s, order, axis=1)
+        top_i = np.take_along_axis(cand_i, order, axis=1)
+        if top_s.shape[1] < fetch_k:
+            # capacity counts not-yet-established extents, so the
+            # established candidates can undershoot an escalated fetch_k —
+            # pad to the contract width (-inf rows read as exhausted)
+            pad = fetch_k - top_s.shape[1]
+            top_s = np.pad(top_s, ((0, 0), (0, pad)),
+                           constant_values=-np.inf)
+            top_i = np.pad(top_i, ((0, 0), (0, pad)))
+        return top_s, top_i
+
+    def drain(self) -> None:
+        with self._lock:
+            for ext in self._pool.extents:
+                if ext.established:
+                    np.asarray(ext.valid[:1])
+
+    def _probe_searcher(self, k: int):
+        import jax.numpy as jnp
+
+        exts = [e for e in self._pool.extents if e.established]
+        fns = [self._get_search_fn(min(k, self._extent_fetch_cap(e)))
+               for e in exts]
+        bases = [e.base for e in exts]
+        operands = tuple((e.vectors, self._extent_extras(e), e.valid)
+                        for e in exts)
+
+        def run(q, operands):
+            ts_all, ti_all = [], []
+            for fn, base, (vectors, extras, valid) in zip(
+                    fns, bases, operands):
+                ts, ti = fn(q, vectors, extras, valid)
+                ts_all.append(ts)
+                ti_all.append(ti + base)
+            if len(ts_all) == 1:
+                return ts_all[0], ti_all[0]
+            import jax
+
+            cand_s = jnp.concatenate(ts_all, axis=1)
+            cand_i = jnp.concatenate(ti_all, axis=1)
+            ms, pos = jax.lax.top_k(cand_s, min(k, cand_s.shape[1]))
+            return ms, jnp.take_along_axis(cand_i, pos, axis=1)
+
+        return run, operands
+
+    # -- fused ingest: grow by allocating pages/extents -------------------
+    def _fused_ingest(self, step, keys: list[Pointer], args,
+                      n_rows: int | None) -> None:
+        from pathway_tpu.engine.paged_store import PageQuotaExceeded
+
+        alloc = self._pool.allocator
+        new_keys = [k for k in keys if k not in self._key_to_slot]
+        n_new = len(set(new_keys))
+        ext_ids = {self._pool.extent_index_of(self._key_to_slot[k])
+                   for k in keys if k in self._key_to_slot}
+        if len(ext_ids) > 1:
+            # one donated step scatters into ONE extent; a batch updating
+            # rows already spread across extents takes the two-dispatch
+            # fallback (DeviceEmbeddingKnnIndex catches this ValueError)
+            raise ValueError(
+                "fused ingest cannot update rows spanning multiple "
+                "extents in one donated step")
+        capped = alloc.quota_capped_slots(self._tenant)
+        if capped is not None and capped < n_new:
+            raise PageQuotaExceeded(
+                f"tenant {self._tenant!r} needs {n_new} slots but its "
+                f"page quota caps it at {capped} more")
+        if ext_ids:
+            eidx = next(iter(ext_ids))
+        else:
+            eidx = max(range(len(self._pool.extents)),
+                       key=lambda e: alloc.free_slots_available(
+                           self._tenant, regions=[e]))
+            if alloc.free_slots_available(
+                    self._tenant, regions=[eidx]) < n_new:
+                # ONLINE GROWTH under donation: a fresh extent sized for
+                # the batch — the previously donated extents are untouched
+                self._pool.grow(min_rows=n_new)
+                self._extend_mirror()
+                eidx = len(self._pool.extents) - 1
+        if alloc.free_slots_available(self._tenant, regions=[eidx]) < n_new:
+            # the one extent cannot hold the batch (updated rows pin it,
+            # or the tenant's quota caps it below the batch even after a
+            # grow): take the two-dispatch fallback, which allocates
+            # across extents — checked BEFORE any slot is assigned, so a
+            # failed fused attempt never leaks phantom key mappings
+            raise ValueError(
+                "fused ingest cannot place this batch in one extent")
+        self._flush_to_device()
+        ext = self._pool.extents[eidx]
+        self._establish_extent(ext)
+        slots = self._fused_take_slots(
+            keys, take=lambda: alloc.take_slot(self._tenant,
+                                               regions=[eidx]))
+        local = slots - ext.base
+        dev_slots = self._pad_slots(local, n_rows, ext.rows)
+        if self._is_int8:
+            (ext.vectors, ext.scales, ext.vsq, ext.valid) = step(
+                ext.vectors, ext.scales, ext.vsq, ext.valid,
+                dev_slots, *args)
+        else:
+            ext.vectors, ext.valid = step(
+                ext.vectors, ext.valid, dev_slots, *args)
+        self._host_valid[slots] = True
+        slot_list = slots.tolist()
+        self._stale.update(slot_list)
+        self._dirty.difference_update(slot_list)
 
 
 class DeviceEmbeddingKnnIndex:
@@ -824,7 +1274,11 @@ class DeviceEmbeddingKnnIndex:
         # serializing host and device work — measured 0.42 s/tick vs
         # ~0.04 s fused on the round-5 bench host
         self._fused = None
-        if hasattr(embedder, "pack_tokens") and \
+        self._ragged = bool(getattr(embedder, "ragged", False))
+        if self._ragged and hasattr(embedder, "ragged_device_producer"):
+            self._fused = inner.make_fused_ingest(
+                embedder.ragged_device_producer)
+        elif hasattr(embedder, "pack_tokens") and \
                 hasattr(embedder, "device_producer"):
             self._fused = inner.make_fused_ingest(embedder.device_producer)
 
@@ -833,13 +1287,25 @@ class DeviceEmbeddingKnnIndex:
         texts = [str(t) for t in texts]
         if self._fused is not None:
             try:
-                ids, lens = self.embedder.pack_tokens(texts)
-                self._fused(keys, self.embedder.params, ids, lens)
+                if self._ragged:
+                    # ragged-packed fused ingest: one donated dispatch per
+                    # fixed-shape chunk; padded doc rows scatter-drop
+                    d0 = 0
+                    for args, n_docs, n_pad in \
+                            self.embedder.pack_ragged(texts):
+                        self._fused(keys[d0:d0 + n_docs],
+                                    self.embedder.params, *args,
+                                    n_rows=n_pad)
+                        d0 += n_docs
+                else:
+                    ids, lens = self.embedder.pack_tokens(texts)
+                    self._fused(keys, self.embedder.params, ids, lens)
                 self.inner.set_filter_data(keys, filter_data)
                 return
             except ValueError:
-                # slab full — the donated shape cannot grow; fall through
-                # to the growable two-dispatch path
+                # slab full / batch spans extents — fall through to the
+                # growable two-dispatch path (re-adds every key, so a
+                # partially-fused ragged batch stays consistent)
                 pass
         vecs = self.embedder.encode_batch_device(texts)
         self.inner.add_batch_device(keys, vecs, filter_data)
@@ -850,6 +1316,14 @@ class DeviceEmbeddingKnnIndex:
 
     def remove(self, key: Pointer) -> None:
         self.inner.remove(key)
+
+    def flush_device(self) -> None:
+        # forwarded so the external-index operator's ingest-only-tick
+        # flush (engine/index_ops.py) reaches the wrapped store
+        self.inner.flush_device()
+
+    def drain(self) -> None:
+        self.inner.drain()
 
     def __len__(self) -> int:
         return len(self.inner)

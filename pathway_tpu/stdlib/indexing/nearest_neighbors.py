@@ -46,6 +46,11 @@ class BruteForceKnnFactory:
     # embedders (set by DataIndex when a query-embedder override is in
     # play — the fused text path could not honor it)
     fuse: bool = True
+    # paged store only: this index's page-allocator tenant tag + per-tenant
+    # row quotas (rounded UP to whole pages; PWT111 flags non-page-aligned
+    # quotas and quota sums past device HBM)
+    tenant: Any = None
+    tenant_quotas: dict | None = None
 
     def build(self):
         dim = self.dimensions
@@ -63,10 +68,13 @@ class BruteForceKnnFactory:
 
             return ShardedKnnIndex(dim, mesh=mesh,
                                    reserved_space=self.reserved_space,
-                                   metric=self.metric, dtype=self.dtype)
+                                   metric=self.metric, dtype=self.dtype,
+                                   tenant=self.tenant,
+                                   tenant_quotas=self.tenant_quotas)
         inner = BruteForceKnnIndex(
             dim, reserved_space=self.reserved_space, metric=self.metric,
-            dtype=self.dtype)
+            dtype=self.dtype, tenant=self.tenant,
+            tenant_quotas=self.tenant_quotas)
         # device-capable embedder: the engine index takes raw text and
         # embeds on-chip; embeddings never round-trip the host. The gate
         # must mirror BruteForceKnn.embeds_internally exactly — that
@@ -94,7 +102,8 @@ class BruteForceKnn(InnerIndex):
                  metadata_column: ex.ColumnExpression | None = None, *,
                  dimensions: int | None = None, reserved_space: int = 1024,
                  metric: KnnMetric = KnnMetric.L2SQ, embedder: Any = None,
-                 mesh: Any = None, dtype: str = "float32"):
+                 mesh: Any = None, dtype: str = "float32",
+                 tenant: Any = None, tenant_quotas: dict | None = None):
         super().__init__(data_column, metadata_column)
         self.dimensions = dimensions
         self.reserved_space = reserved_space
@@ -102,12 +111,15 @@ class BruteForceKnn(InnerIndex):
         self.embedder = embedder
         self.mesh = mesh
         self.dtype = dtype
+        self.tenant = tenant
+        self.tenant_quotas = tenant_quotas
 
     def factory(self) -> BruteForceKnnFactory:
         return BruteForceKnnFactory(
             dimensions=self.dimensions, reserved_space=self.reserved_space,
             metric=self.metric, embedder=self.embedder, mesh=self.mesh,
-            dtype=self.dtype)
+            dtype=self.dtype, tenant=self.tenant,
+            tenant_quotas=self.tenant_quotas)
 
     @property
     def query_embedder(self):

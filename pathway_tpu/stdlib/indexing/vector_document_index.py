@@ -30,16 +30,19 @@ def default_brute_force_knn_document_index(
         embedder: Any = None, dimensions: int | None = None,
         reserved_space: int = 1024, metric: KnnMetric = KnnMetric.COS,
         metadata_column: ex.ColumnExpression | None = None,
-        mesh: Any = None, dtype: str = "float32") -> DataIndex:
+        mesh: Any = None, dtype: str = "float32",
+        tenant: Any = None,
+        tenant_quotas: dict | None = None) -> DataIndex:
     """``mesh='auto'`` shards the slab over the device mesh's data axis
     (ICI top-k merge) when more than one device is visible; ``dtype=
     'bfloat16'`` halves slab bytes and scan time on one chip, and
     ``dtype='int8'`` halves them again (quantized on device, host mirror
-    exact f32)."""
+    exact f32). ``tenant``/``tenant_quotas`` tag and cap the index's pages
+    in the paged store's allocator (engine/paged_store.py)."""
     inner = BruteForceKnn(
         data_column, metadata_column, dimensions=dimensions,
         reserved_space=reserved_space, metric=metric, embedder=embedder,
-        mesh=mesh, dtype=dtype)
+        mesh=mesh, dtype=dtype, tenant=tenant, tenant_quotas=tenant_quotas)
     return DataIndex(data_table, inner)
 
 

@@ -18,6 +18,11 @@ Two fixes, composable:
   (encoder forward, and the fused encode+scatter / search kernels when an
   index is given) so all compilation happens before the first real tick —
   from the persistent cache when warm, from scratch otherwise.
+
+Under ragged batching (``PATHWAY_RAGGED_ENCODER=1`` /
+``JaxEncoderEmbedder(ragged=True)``) the compile set is the embedder's
+sequence-count buckets (``ragged_buckets()``, ≤ 6 shapes at one fixed
+width) instead of the ~18 width buckets — warmup walks those.
 """
 
 from __future__ import annotations
@@ -124,7 +129,33 @@ def warmup(embedder: Any = None, *, index: Any = None,
 
     fused = getattr(index, "_fused", None)
     inner = getattr(index, "inner", index)
-    if embedder is not None and widths:
+    if embedder is not None and getattr(embedder, "ragged", False):
+        # ragged batching: the compile set is the sequence-count buckets
+        # (≤ 6 shapes at one fixed width) instead of the ~18 width zoo
+        from pathway_tpu.internals.keys import Pointer
+
+        W = getattr(embedder, "max_len", 0)
+        for n_seqs in embedder.ragged_buckets():
+            ops, n_docs = embedder.ragged_warmup_operands(n_seqs)
+            if fused is not None:
+                scratch = [Pointer((1 << 62) + i) for i in range(n_docs)]
+                try:
+                    fused(scratch, embedder.params, *ops, n_rows=n_docs)
+                except ValueError:
+                    jax.block_until_ready(embedder._encode_ragged(
+                        embedder.params, *ops))
+                    out["compiled"].append(("ragged_encode", (n_seqs, W)))
+                    continue
+                for k in scratch:
+                    inner.remove(k)
+                out["compiled"].append(("ragged_fused_ingest", (n_seqs, W)))
+            else:
+                jax.block_until_ready(embedder._encode_ragged(
+                    embedder.params, *ops))
+                out["compiled"].append(("ragged_encode", (n_seqs, W)))
+        if fused is not None:
+            inner.flush_device()
+    elif embedder is not None and widths:
         fused_used = False
         for w in widths:
             ids, lens = packed_operands(w)

@@ -47,11 +47,14 @@ class JaxEncoderEmbedder(BaseEmbedder):
     def __init__(self, *, model: str | None = None, config=None,
                  params=None, tokenizer=None,
                  seed: int = 0, max_len: int = 512,
+                 ragged: bool | None = None,
                  call_kwargs: dict = {}, **kwargs):
         kwargs.setdefault("batch", True)
         kwargs.setdefault("deterministic", True)
         kwargs.setdefault("device", True)  # pipeline via the device bridge
         super().__init__(**kwargs)
+        import os
+
         import jax
 
         from pathway_tpu.warmup import maybe_enable_compilation_cache
@@ -87,6 +90,23 @@ class JaxEncoderEmbedder(BaseEmbedder):
         # (device_producer) serves both this jit and the fused ingest.
         self._encode_packed = jax.jit(self.device_producer)
         self._pack_ids = self.config.vocab_size <= 32767
+        # ragged batching (PATHWAY_RAGGED_ENCODER=1 or ragged=True):
+        # variable-length docs pack back-to-back into fixed-width
+        # sequences with a doc-map vector instead of per-width padding —
+        # the ~18 width-bucket compiles collapse to the handful of
+        # sequence-count buckets in ragged_buckets()
+        if ragged is None:
+            ragged = os.environ.get(
+                "PATHWAY_RAGGED_ENCODER", "0").lower() in (
+                "1", "true", "on", "yes")
+        self.ragged = bool(ragged)
+        from pathway_tpu.internals.config import _env_int
+
+        self._ragged_max_seqs = max(1, _env_int("PATHWAY_RAGGED_MAX_SEQS", 8))
+        # docs-per-sequence cap bounds the padded doc dimension of a chunk
+        # (W//16: a doc is never shorter than CLS+token+SEP anyway)
+        self._ragged_doc_cap = max(1, self.max_len // 16)
+        self._encode_ragged = jax.jit(self.ragged_device_producer)
 
     def _bucket(self, n: int) -> int:
         """Pad target for a batch whose longest row has ``n`` tokens.
@@ -143,11 +163,113 @@ class JaxEncoderEmbedder(BaseEmbedder):
         mask = jnp.arange(ids32.shape[1])[None, :] < lens[:, None]
         return encode(params, ids32, mask, config=self.config)
 
+    def ragged_device_producer(self, params, ids, doc_map, pos_ids,
+                               doc_seq, doc_off):
+        """Pure (traceable) forward over a ragged-packed chunk
+        (models/encoder.py encode_ragged) — the fused-ingest producer of
+        the ragged path, returning (n_docs_padded, hidden)."""
+        from pathway_tpu.models.encoder import encode_ragged
+
+        return encode_ragged(params, ids, doc_map, pos_ids, doc_seq,
+                             doc_off, config=self.config)
+
+    def ragged_buckets(self) -> list[int]:
+        """Sequence-count buckets the ragged path can dispatch: powers of
+        two up to the per-chunk cap (full chunks all share ONE shape).
+        This is the ENTIRE ragged compile set — len ≤ 6 vs ~18 width
+        buckets — and the set ``pw.warmup`` walks when ragged is on."""
+        out, b = [], 1
+        while b < self._ragged_max_seqs:
+            out.append(b)
+            b *= 2
+        out.append(self._ragged_max_seqs)
+        return out
+
+    def pack_ragged(self, texts: list[str]) -> list[tuple]:
+        """Greedy first-fit packing of tokenized docs into fixed-width
+        sequences, chunked at ``_ragged_max_seqs`` sequences per dispatch.
+
+        Returns ``[(args, n_docs, n_docs_padded), ...]`` per chunk, docs
+        in input order, where ``args = (ids, doc_map, pos_ids, doc_seq,
+        doc_off)`` feed ragged_device_producer and ``n_docs_padded`` is
+        its static output row count (pad rows carry doc_map -1 and are
+        dropped by the caller / the fused scatter)."""
+        ids, mask = self.tokenizer.batch(
+            [t or "." for t in texts], max_len=self.max_len)
+        lens = mask.sum(axis=1).astype(np.int64)
+        W, cap = self.max_len, self._ragged_doc_cap
+        # assign each doc a (sequence, offset) first-fit in order
+        seq_of = np.empty(len(texts), np.int64)
+        off_of = np.empty(len(texts), np.int64)
+        seq, fill, docs_in_seq = 0, 0, 0
+        for d, n in enumerate(lens):
+            n = int(n)
+            if fill + n > W or docs_in_seq >= cap:
+                seq, fill, docs_in_seq = seq + 1, 0, 0
+            seq_of[d], off_of[d] = seq, fill
+            fill += n
+            docs_in_seq += 1
+        n_seqs_total = seq + 1
+        chunks: list[tuple] = []
+        max_seqs = self._ragged_max_seqs
+        buckets = self.ragged_buckets()
+        d0 = 0
+        for s0 in range(0, n_seqs_total, max_seqs):
+            s1 = min(s0 + max_seqs, n_seqs_total)
+            n_seqs = next(b for b in buckets if b >= s1 - s0)
+            d1 = d0
+            while d1 < len(texts) and seq_of[d1] < s1:
+                d1 += 1
+            n_docs = d1 - d0
+            n_pad = n_seqs * cap
+            c_ids = np.zeros((n_seqs, W), np.int32)
+            c_map = np.full((n_seqs, W), -1, np.int32)
+            c_pos = np.zeros((n_seqs, W), np.int32)
+            c_dseq = np.zeros((n_pad,), np.int32)
+            c_doff = np.zeros((n_pad,), np.int32)
+            for j, d in enumerate(range(d0, d1)):
+                n = int(lens[d])
+                s, o = int(seq_of[d]) - s0, int(off_of[d])
+                c_ids[s, o:o + n] = ids[d, :n]
+                c_map[s, o:o + n] = j
+                c_pos[s, o:o + n] = np.arange(n)
+                c_dseq[j], c_doff[j] = s, o
+            chunks.append(((c_ids, c_map, c_pos, c_dseq, c_doff),
+                           n_docs, n_pad))
+            d0 = d1
+        return chunks
+
+    def ragged_warmup_operands(self, n_seqs: int) -> tuple[tuple, int]:
+        """Synthetic ragged chunk at bucket ``n_seqs`` with every padded
+        doc slot real — warmup compiles the exact (n_seqs, W) dispatch
+        shape without caring about content."""
+        W, cap = self.max_len, self._ragged_doc_cap
+        tok = W // cap
+        n_docs = n_seqs * cap
+        ids = np.zeros((n_seqs, W), np.int32)
+        doc_map = np.repeat(np.arange(n_docs, dtype=np.int32),
+                            tok).reshape(n_seqs, cap * tok)
+        if cap * tok < W:
+            doc_map = np.pad(doc_map, ((0, 0), (0, W - cap * tok)),
+                             constant_values=-1)
+        pos = np.tile(np.arange(tok, dtype=np.int32), cap)[None, :]
+        pos = np.pad(np.repeat(pos, n_seqs, 0),
+                     ((0, 0), (0, W - cap * tok)))
+        dseq = np.repeat(np.arange(n_seqs, dtype=np.int32), cap)
+        doff = np.tile(np.arange(cap, dtype=np.int32) * tok, n_seqs)
+        return (ids, doc_map, pos, dseq, doff), n_docs
+
     def encode_batch_device(self, texts: list[str]):
         """Tokenize + encoder forward, returning the (B, hidden) embedding
         still ON DEVICE (a jax array, dispatch left asynchronous). The
         fused index path (ops/knn.py DeviceEmbeddingKnnIndex) scatters it
         straight into the HBM slab — embeddings never visit the host."""
+        if self.ragged:
+            import jax.numpy as jnp
+
+            outs = [self._encode_ragged(self.params, *args)[:n_docs]
+                    for args, n_docs, _n_pad in self.pack_ragged(texts)]
+            return outs[0] if len(outs) == 1 else jnp.concatenate(outs, 0)
         ids, lens = self.pack_tokens(texts)
         return self._encode_packed(self.params, ids, lens)
 

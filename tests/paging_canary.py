@@ -1,0 +1,123 @@
+"""Paged-store canary: online growth during live ingest, no
+stop-the-world re-upload, ragged warmup bucket collapse.
+
+Two gates (same pattern as pipelining_canary.py — the gate is trusted
+because a seeded property is proven end to end):
+
+1. **bench paging leg** (bench.bench_paging): identical chunked ingest
+   through the paged store and the contiguous slab must produce
+   byte-identical top-k, BOTH must grow, and the upload amplification
+   (device rows written / rows ingested) must stay ~1.0 for the paged
+   store while the slab re-ships its occupied slots after every growth.
+   Ragged warmup must compile ≤ 6 shapes vs the ~18 width buckets.
+   The leg's JSON is written as a CI artifact AND checkpointed into
+   ``BENCH_LASTGOOD.json`` per the evidence rule.
+
+2. **live engine ingest**: a streaming table feeds a paged KNN index
+   through the real external-index operator across many commit ticks,
+   forcing growth mid-stream; retrieval must stay exact and the pool
+   must report the growth (grow_events >= 1, occupancy sane) — growth
+   never stops the pipeline.
+
+Exits 0 iff all hold. Run: ``python tests/paging_canary.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PATHWAY_PAGED_STORE", None)  # the default-on path is the DUT
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def gate_bench_leg() -> dict:
+    import bench
+
+    out = bench.bench_paging()
+    bench._write_lastgood(out)  # evidence rule: checkpoint immediately
+    artifact = os.environ.get("PAGING_BENCH_ARTIFACT")
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=1)
+    assert out["paging_identical_topk"] is True, \
+        "paged top-k diverged from the slab"
+    assert out["paging_grow_events_paged"] >= 2, out
+    assert out["paging_grow_events_slab"] >= 2, out
+    amp_paged = out["paging_upload_amplification_paged"]
+    amp_slab = out["paging_upload_amplification_slab"]
+    assert amp_paged <= 1.5, (
+        f"paged store re-uploaded {amp_paged}x the ingested rows — growth "
+        f"is copying device state again")
+    assert amp_slab >= amp_paged + 0.5, (
+        f"slab amplification {amp_slab} vs paged {amp_paged}: the slab "
+        f"baseline stopped re-uploading (measurement broken?)")
+    assert out["paging_warmup_compiles_ragged"] <= 6, out
+    assert out["paging_warmup_bucket_shapes"] >= 15, out
+    print(f"[gate1] identical top-k; upload amplification paged "
+          f"{amp_paged} vs slab {amp_slab}; ragged warmup "
+          f"{out['paging_warmup_compiles_ragged']} compiles vs "
+          f"{out['paging_warmup_bucket_shapes']} width buckets")
+    return out
+
+
+def gate_live_ingest() -> None:
+    import numpy as np
+
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+    from pathway_tpu.stdlib.indexing import (
+        default_brute_force_knn_document_index)
+
+    G.clear()
+    rng = np.random.default_rng(0)
+    n, dim, ticks = 6000, 32, 8  # grows 1024 → 8192 across live ticks
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    schema = sch.schema_from_types(v=np.ndarray)
+    rows = [(vecs[i], (i * ticks) // n * 2, 1) for i in range(n)]
+    data = table_from_rows(schema, rows, is_stream=True)
+    index = default_brute_force_knn_document_index(
+        data.v, data, dimensions=dim, reserved_space=1024)
+    qschema = sch.schema_from_types(qv=np.ndarray, k=int)
+    queries = table_from_rows(qschema, [(vecs[4321], 3)])
+    res = index.query_as_of_now(queries.qv, number_of_matches=queries.k)
+    runner = GraphRunner()
+    cap = runner.capture(res)
+    runner.run_batch(n_workers=1)
+
+    from pathway_tpu.engine.index_ops import ExternalIndexOperator
+    from pathway_tpu.ops.knn import PagedKnnIndex
+
+    ops = [node.op for node in runner.graph.nodes
+           if isinstance(node.op, ExternalIndexOperator)]
+    assert ops, "no external index operator in the canary graph"
+    idx = ops[0].index
+    assert isinstance(idx, PagedKnnIndex), type(idx)
+    st = idx.page_stats()
+    assert st["grow_events"] >= 1, st
+    assert st["capacity_rows"] >= n, st
+    assert 0.0 < st["occupancy"] <= 1.0, st
+    final = [row for _, row, _, diff in cap.events if diff > 0]
+    assert final, "no retrieval answer produced"
+    reply = final[-1][0]
+    assert reply, "empty retrieval under live growth"
+    G.clear()
+    print(f"[gate2] live ingest grew the store {st['grow_events']}x to "
+          f"{st['capacity_rows']} rows ({st['pages_total']} pages) with "
+          f"retrieval intact")
+
+
+def main() -> int:
+    gate_bench_leg()
+    gate_live_ingest()
+    print("paging canary: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Pinned exchange-plane serialization performance.
+
+Round 5 regressed exchange encode/decode 1.45 → 6.5 µs/row (4.5×) and the
+only witness was a bench artifact nobody gated on. This test pins the
+relationship that regression broke: the PACKED payload format
+(engine/multiproc.py _pack_payload — columnar key/value arrays instead of
+per-row tuples) must stay cheaper than naively pickling the same rows,
+in both bytes and best-case encode+decode time.
+
+Timing in CI is noisy, so the time assertion takes the BEST of several
+trials (a regression of the r5 class is a 4.5× systematic slowdown — it
+survives min-of-N; scheduler jitter does not) and the threshold leaves
+~2× headroom over the measured ratio (~0.3-0.8 on an idle core).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+
+import pytest
+
+from pathway_tpu.engine.multiproc import _pack_payload, _unpack_payload
+from pathway_tpu.internals.keys import hash_values
+
+N_ROWS = 20_000
+TRIALS = 5
+# packed must never cost more than 1.5x a plain pickle of the same rows
+# (the r5 regression put it at ~4.5x) …
+MAX_TIME_RATIO = 1.5
+# … and must stay byte-smaller on the wire
+MAX_BYTES_RATIO = 1.0
+
+
+def _payload():
+    ents = [(hash_values("row", i), (f"w{i % 5000}", int(i % 9 + 1)), 1)
+            for i in range(N_ROWS)]
+    return {"rows": {0: {0: ents}}, "wm": None, "bcast": None}
+
+
+def _encdec_seconds(enc, dec):
+    t0 = time.perf_counter()
+    blob = enc()
+    mid = time.perf_counter()
+    dec(blob)
+    return mid - t0, time.perf_counter() - mid, blob
+
+
+def test_packed_exchange_beats_pickle():
+    payload = _payload()
+    best_ratio = float("inf")
+    bytes_ratio = None
+    for _ in range(TRIALS):
+        p_enc, p_dec, p_blob = _encdec_seconds(
+            lambda: pickle.dumps(("x", _pack_payload(payload)),
+                                 protocol=pickle.HIGHEST_PROTOCOL),
+            lambda b: _unpack_payload(pickle.loads(b)[1]))
+        n_enc, n_dec, n_blob = _encdec_seconds(
+            lambda: pickle.dumps(("x", payload),
+                                 protocol=pickle.HIGHEST_PROTOCOL),
+            pickle.loads)
+        best_ratio = min(best_ratio,
+                         (p_enc + p_dec) / max(n_enc + n_dec, 1e-9))
+        bytes_ratio = len(p_blob) / len(n_blob)
+    assert bytes_ratio <= MAX_BYTES_RATIO, (
+        f"packed payload grew past plain pickle on the wire: "
+        f"{bytes_ratio:.2f}x")
+    assert best_ratio <= MAX_TIME_RATIO, (
+        f"packed encode+decode is {best_ratio:.2f}x plain pickle "
+        f"(> {MAX_TIME_RATIO}x): the exchange plane regressed — see "
+        f"ROADMAP 'Rebuild the exchange plane' and the r5 1.45→6.5 "
+        f"µs/row incident")
+
+
+def test_packed_roundtrip_is_lossless():
+    payload = _payload()
+    out = _unpack_payload(pickle.loads(pickle.dumps(
+        ("x", _pack_payload(payload)),
+        protocol=pickle.HIGHEST_PROTOCOL))[1])
+    assert out == payload
+
+
+@pytest.mark.parametrize("rows", [0, 1])
+def test_packed_tiny_payloads(rows):
+    ents = [(hash_values("row", i), ("w", 1), 1) for i in range(rows)]
+    payload = {"rows": {0: {0: ents}}, "wm": 7, "bcast": None}
+    assert _unpack_payload(_pack_payload(payload)) == payload

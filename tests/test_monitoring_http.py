@@ -225,6 +225,31 @@ def test_exchange_payload_row_counting():
     assert _payload_rows({"any": True, "wm": 3}) == 0
 
 
+def test_paged_store_metrics_exposed():
+    """A live paged pool surfaces the page-occupancy families (and they
+    pass the exposition lint) plus the /status paged_store section."""
+    import numpy as np
+
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    idx = BruteForceKnnIndex(8, paged=True, tenant="acme")
+    idx.add_batch([Pointer(i) for i in range(10)],
+                  np.zeros((10, 8), np.float32))
+    lines = _metrics_lines(_FakeRuntime())
+    samples = {f: (labels, v) for f, labels, v in _parse_samples(lines)}
+    assert samples["pathway_tpu_paged_pages_total"][1] >= 1
+    assert "pathway_tpu_paged_occupancy_ratio" in samples
+    assert samples["pathway_tpu_paged_grow_events"][1] >= 0
+    tenant_rows = [(labels, v) for f, labels, v in _parse_samples(lines)
+                   if f == "pathway_tpu_paged_tenant_pages"]
+    assert any(labels.get("tenant") == "acme" for labels, _ in tenant_rows)
+    server = MonitoringHttpServer(_FakeRuntime(), port=0)
+    st = server.status_payload()
+    assert st["paged_store"]["pages_total"] >= 1
+    del idx  # release the pool so later exposition tests see a clean set
+
+
 def test_trace_endpoint_serves_span_buffer():
     rt = _recording_runtime()
     server = MonitoringHttpServer(rt, port=0)

@@ -1,0 +1,496 @@
+"""Paged HBM vector store (engine/paged_store.py + ops/knn.py PagedKnnIndex
++ parallel/sharded_knn.py PagedShardedKnnIndex) and ragged encoder batching.
+
+The load-bearing contract: the paged store is BYTE-IDENTICAL to the
+contiguous slab (PATHWAY_PAGED_STORE=0) across ingest/delete/grow/search
+churn — same keys, same distances, bit for bit — while growth allocates
+pages instead of re-uploading, fused donated ingest grows instead of
+raising, and freed pages are reused (occupancy bounded).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine.paged_store import (DevicePagePool, PageAllocator,
+                                            PageQuotaExceeded,
+                                            live_paged_stats, page_rows,
+                                            paged_store_enabled)
+from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric, PagedKnnIndex
+
+
+def _mk(n=None, **kw):
+    # paged pinned explicitly: this suite must test the paged path even
+    # on the CI matrix leg that flips the default to the slab
+    kw.setdefault("metric", KnnMetric.L2SQ)
+    kw.setdefault("paged", True)
+    return BruteForceKnnIndex(8, **kw)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+def test_page_rows_validation(monkeypatch):
+    assert page_rows(1024) == 1024
+    for bad in (100, 96, 1 << 20, 0):
+        with pytest.raises(ValueError):
+            page_rows(bad)
+    monkeypatch.setenv("PATHWAY_PAGE_ROWS", "4096")
+    assert page_rows() == 4096
+    monkeypatch.setenv("PATHWAY_PAGE_ROWS", "100")
+    with pytest.raises(ValueError):
+        page_rows()
+
+
+def test_paged_store_env_gate(monkeypatch):
+    monkeypatch.delenv("PATHWAY_PAGED_STORE", raising=False)
+    assert paged_store_enabled()          # default ON
+    assert not paged_store_enabled(False)  # explicit arg wins
+    monkeypatch.setenv("PATHWAY_PAGED_STORE", "0")
+    assert not paged_store_enabled()
+    assert paged_store_enabled(True)
+
+
+def test_allocator_alloc_free_reuse():
+    a = PageAllocator(128)
+    a.add_region(0, 0, 4)  # 4 pages, 512 slots
+    slots = [a.take_slot() for _ in range(300)]
+    assert len(set(slots)) == 300
+    assert a.live_rows == 300
+    st = a.stats()
+    assert st["pages_total"] == 4 and st["pages_free"] == 1
+    # free an entire page's worth: the drained page returns to the pool
+    for s in slots:
+        a.release_slot(s)
+    st = a.stats()
+    assert st["pages_free"] == 4 and st["live_rows"] == 0
+    # reuse: no growth needed for a fresh fill
+    again = [a.take_slot() for _ in range(512)]
+    assert len(set(again)) == 512
+    with pytest.raises(RuntimeError):
+        a.take_slot()  # exhausted without ensure_free/grow
+
+
+def test_allocator_partial_free_reopens_page():
+    a = PageAllocator(128)
+    a.add_region(0, 0, 1)
+    slots = [a.take_slot() for _ in range(128)]  # page full
+    a.release_slot(slots[7])
+    assert a.take_slot() == slots[7]  # the freed slot is allocatable again
+
+
+def test_allocator_tenant_quotas_and_regions():
+    a = PageAllocator(128, tenant_quotas={"acme": 2})
+    a.add_region(0, 0, 2)
+    a.add_region(1, 256, 2)
+    acme = [a.take_slot("acme") for _ in range(256)]  # exactly 2 pages
+    assert a.tenant_pages["acme"] == 2
+    with pytest.raises(PageQuotaExceeded):
+        a.take_slot("acme")
+    assert a.quota_capped_slots("acme") == 0
+    # another tenant still allocates; regions restrict placement
+    s = a.take_slot("globex", regions=[1])
+    assert 256 <= s < 512
+    # freeing acme's pages returns quota headroom
+    for s in acme:
+        a.release_slot(s)
+    assert a.quota_remaining_pages("acme") == 2
+    assert a.take_slot("acme") in set(acme) | set(range(512))
+
+
+def test_pool_grow_appends_extent_without_touching_old():
+    pool = DevicePagePool(8, reserved_space=1024, rows_per_page=1024)
+    assert pool.capacity == 1024 and len(pool.extents) == 1
+    first = pool.extents[0]
+    pool.ensure_free(1500)
+    assert pool.capacity >= 2048 and pool.extents[0] is first
+    assert pool.grow_events >= 1
+    # slot→extent mapping and page-aligned bases
+    assert pool.extent_index_of(0) == 0
+    assert pool.extent_index_of(1024) == 1
+
+
+# ---------------------------------------------------------------------------
+# paged index vs slab: byte-identical across churn
+# ---------------------------------------------------------------------------
+
+def test_default_is_paged_and_opt_out_works(monkeypatch):
+    monkeypatch.delenv("PATHWAY_PAGED_STORE", raising=False)
+    idx = BruteForceKnnIndex(8)
+    assert isinstance(idx, PagedKnnIndex)
+    slab = BruteForceKnnIndex(8, paged=False)
+    assert type(slab) is BruteForceKnnIndex
+    monkeypatch.setenv("PATHWAY_PAGED_STORE", "0")
+    assert type(BruteForceKnnIndex(8)) is BruteForceKnnIndex
+
+
+@pytest.mark.parametrize("metric", [KnnMetric.L2SQ, KnnMetric.COS])
+def test_churn_byte_identical_vs_slab(metric):
+    """The acceptance-pinned property: interleaved ingest/delete/grow/
+    search — paged top-k == slab top-k, keys AND distances, byte for
+    byte."""
+    rng = np.random.default_rng(11)
+    paged = BruteForceKnnIndex(16, metric=metric, paged=True)
+    slab = BruteForceKnnIndex(16, metric=metric, paged=False)
+    assert isinstance(paged, PagedKnnIndex)
+    live: list[int] = []
+    next_key = 0
+
+    def step(op):
+        nonlocal next_key
+        if op == "ingest":
+            n = int(rng.integers(50, 400))
+            keys = [Pointer(next_key + i) for i in range(n)]
+            vecs = rng.normal(size=(n, 16)).astype(np.float32)
+            paged.add_batch(keys, vecs)
+            slab.add_batch(keys, vecs)
+            live.extend(range(next_key, next_key + n))
+            next_key += n
+        elif op == "delete" and live:
+            kill = rng.choice(len(live),
+                              size=min(len(live), 120), replace=False)
+            for i in sorted(kill, reverse=True):
+                k = live.pop(int(i))
+                paged.remove(Pointer(k))
+                slab.remove(Pointer(k))
+        elif op == "update" and live:
+            k = int(live[int(rng.integers(len(live)))])
+            v = rng.normal(size=(1, 16)).astype(np.float32)
+            paged.add_batch([Pointer(k)], v)
+            slab.add_batch([Pointer(k)], v)
+
+    ops = rng.choice(["ingest", "delete", "update", "search"], size=30)
+    for op in ops:
+        step(op)
+        if op == "search" or op == ops[-1]:
+            qs = [(Pointer(10**9 + i),
+                   rng.normal(size=16).astype(np.float32),
+                   int(rng.integers(1, 12)), None) for i in range(4)]
+            assert paged.search(qs) == slab.search(qs)
+    assert paged.capacity > 1024, "churn never grew the store"
+    assert len(paged) == len(slab) == len(live)
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+def test_churn_low_precision_paged_matches_slab(dtype):
+    rng = np.random.default_rng(3)
+    paged = BruteForceKnnIndex(16, metric=KnnMetric.COS, dtype=dtype,
+                               paged=True)
+    slab = BruteForceKnnIndex(16, metric=KnnMetric.COS, dtype=dtype,
+                              paged=False)
+    keys = [Pointer(i) for i in range(1500)]  # grows past 1024
+    vecs = rng.normal(size=(1500, 16)).astype(np.float32)
+    paged.add_batch(keys, vecs)
+    slab.add_batch(keys, vecs)
+    for i in range(0, 600):
+        paged.remove(Pointer(i))
+        slab.remove(Pointer(i))
+    qs = [(Pointer(10**9 + i), vecs[700 + 13 * i], 10, None)
+          for i in range(4)]
+    rp, rs = paged.search(qs), slab.search(qs)
+    for a, b in zip(rp, rs):
+        assert [k for k, _ in a] == [k for k, _ in b]
+        np.testing.assert_allclose([d for _, d in a], [d for _, d in b],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_filtered_search_and_exhaustive_fallback_paged(monkeypatch):
+    import pathway_tpu.ops.knn as knn_mod
+
+    monkeypatch.setattr(knn_mod, "_CHUNK_ROWS", 128)
+    idx = _mk()
+    rng = np.random.default_rng(4)
+    n = 1400  # spans two extents after growth
+    vecs = rng.normal(size=(n, 8)).astype(np.float32)
+    q = vecs[0] + 100.0
+    dists = np.sum((vecs - q) ** 2, axis=1)
+    allowed = set(np.argsort(dists)[-3:].tolist())
+    idx.add_batch([Pointer(i) for i in range(n)], vecs,
+                  filter_data=[{"ok": i in allowed} for i in range(n)])
+    res = idx.search([(Pointer(10**9), q, 3,
+                       lambda d: bool(d and d["ok"]))])[0]
+    assert {int(k) for k, _ in res} == allowed
+
+
+# ---------------------------------------------------------------------------
+# fused donated ingest: paged grows, slab still errors (regression)
+# ---------------------------------------------------------------------------
+
+def test_fused_ingest_grows_by_allocating_extent():
+    import jax.numpy as jnp
+
+    idx = _mk(metric=KnnMetric.COS, dtype="bfloat16")
+    ingest = idx.make_fused_ingest(lambda x: x)
+    rng = np.random.default_rng(5)
+    vals = None
+    for base in range(0, 3000, 500):
+        vals = jnp.asarray(rng.normal(size=(500, 8)).astype(np.float32))
+        ingest([Pointer(base + i) for i in range(500)], vals)
+    assert idx.capacity >= 3000
+    assert idx.page_stats()["grow_events"] >= 1
+    res = idx.search([(Pointer(10**9), np.asarray(vals[17]), 1, None)])
+    assert res[0][0][0] == Pointer(2500 + 17)
+
+
+def test_fused_ingest_slab_still_errors_clearly():
+    import jax.numpy as jnp
+
+    slab = _mk(paged=False)
+    ingest = slab.make_fused_ingest(lambda x: x)
+    with pytest.raises(ValueError, match="cannot grow the slab"):
+        ingest([Pointer(i) for i in range(2000)],
+               jnp.zeros((2000, 8), jnp.float32))
+
+
+def test_fused_ingest_quota_exceeded_is_not_swallowed():
+    import jax.numpy as jnp
+
+    idx = _mk(tenant="acme", tenant_quotas={"acme": 1024})
+    ingest = idx.make_fused_ingest(lambda x: x)
+    ingest([Pointer(i) for i in range(1024)], jnp.zeros((1024, 8)))
+    with pytest.raises(PageQuotaExceeded):
+        ingest([Pointer(5000)], jnp.zeros((1, 8)))
+
+
+# ---------------------------------------------------------------------------
+# page reuse: occupancy bounded under churn
+# ---------------------------------------------------------------------------
+
+def test_freed_pages_are_reused_occupancy_bounded():
+    idx = _mk()
+    rng = np.random.default_rng(6)
+    key = 0
+    for _round in range(8):
+        keys = [Pointer(key + i) for i in range(1000)]
+        idx.add_batch(keys, rng.normal(size=(1000, 8)).astype(np.float32))
+        idx.search([(Pointer(10**9), np.zeros(8, np.float32), 3, None)])
+        for k in keys:
+            idx.remove(k)
+        key += 1000
+    st = idx.page_stats()
+    # 8000 rows churned through a store that never needs more than ~2
+    # extents: freed pages were reused, not leaked
+    assert st["pages_total"] <= 4, st
+    assert st["grow_events"] <= 2, st
+    assert st["live_rows"] == 0
+
+
+def test_tenant_quota_enforced_on_add_batch():
+    idx = _mk(tenant="acme", tenant_quotas={"acme": 2048})
+    rng = np.random.default_rng(7)
+    idx.add_batch([Pointer(i) for i in range(2048)],
+                  rng.normal(size=(2048, 8)).astype(np.float32))
+    with pytest.raises(PageQuotaExceeded):
+        idx.add_batch([Pointer(9000)],
+                      rng.normal(size=(1, 8)).astype(np.float32))
+    # freeing rows frees pages back under quota
+    for i in range(2048):
+        idx.remove(Pointer(i))
+    idx.add_batch([Pointer(9000)],
+                  rng.normal(size=(1, 8)).astype(np.float32))
+    assert len(idx) == 1
+
+
+# ---------------------------------------------------------------------------
+# stats surfaces
+# ---------------------------------------------------------------------------
+
+def test_live_paged_stats_aggregates():
+    idx = _mk(tenant="acme")
+    idx.add_batch([Pointer(i) for i in range(10)],
+                  np.zeros((10, 8), np.float32))
+    st = live_paged_stats()
+    assert st is not None
+    assert st["pages_total"] >= 1
+    assert st["page_rows"] == idx.page_stats()["page_rows"]
+    assert "acme" in st["tenants"]
+
+
+def test_add_batch_device_and_mirror_sync_across_extents():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(8)
+    vecs = rng.normal(size=(1500, 8)).astype(np.float32)
+    host = _mk()
+    dev = _mk()
+    keys = [Pointer(i) for i in range(1500)]
+    host.add_batch(keys, vecs)
+    dev.add_batch_device(keys, jnp.asarray(vecs))
+    q = [(Pointer(900 + i), vecs[i * 7], 5, None) for i in range(4)]
+    assert host.search(q) == dev.search(q)
+    # exact host-side read path syncs the stale mirror per extent
+    got = dev._exhaustive_filtered_search(vecs[1400], 1, lambda d: True)
+    assert got[0][0] == Pointer(1400)
+
+
+def test_latency_probe_multi_extent():
+    idx = _mk()
+    rng = np.random.default_rng(9)
+    idx.add_batch([Pointer(i) for i in range(1500)],
+                  rng.normal(size=(1500, 8)).astype(np.float32))
+    idx.search([(Pointer(10**9), np.zeros(8, np.float32), 3, None)])
+    assert len(idx._pool.extents) >= 2
+    ms = idx.latency_probe(batch_size=1, k=5, reps=4)
+    assert ms > 0.0
+
+
+# ---------------------------------------------------------------------------
+# sharded paged store
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh4():
+    import os
+
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    from pathway_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    return make_mesh(MeshConfig(data=4, model=1))
+
+
+def test_sharded_paged_grow_without_remap(mesh4):
+    from pathway_tpu.parallel.sharded_knn import (PagedShardedKnnIndex,
+                                                  ShardedKnnIndex)
+
+    idx = ShardedKnnIndex(8, mesh=mesh4, reserved_space=8, page_rows=128,
+                          paged=True)
+    assert isinstance(idx, PagedShardedKnnIndex)
+    assert idx.cap_per_shard == 128  # page-aligned minimum
+    rng = np.random.default_rng(10)
+    n = idx.total_capacity + 200
+    vecs = rng.normal(size=(n, 8)).astype(np.float32)
+    keys = [Pointer(i) for i in range(n)]
+    idx.add_batch(keys, vecs)
+    slot_snapshot = dict(idx._key_to_slot)
+    idx.add_batch([Pointer(n)],
+                  rng.normal(size=(1, 8)).astype(np.float32))
+    # online growth: NO slot was remapped (the slab path remaps them all)
+    assert all(idx._key_to_slot[k] == s for k, s in slot_snapshot.items())
+    for probe in (0, n // 2, n - 1):
+        res = idx.search([(Pointer(10**6), vecs[probe], 1, None)])
+        assert res[0] and res[0][0][0] == Pointer(probe)
+    assert idx.page_stats()["grow_events"] >= 1
+
+
+def test_sharded_paged_tenant_quota_enforced(mesh4):
+    from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex
+
+    idx = ShardedKnnIndex(8, mesh=mesh4, reserved_space=8, page_rows=128,
+                          paged=True, tenant="acme",
+                          tenant_quotas={"acme": 512})  # 4 pages
+    rng = np.random.default_rng(13)
+    idx.add_batch([Pointer(i) for i in range(512)],
+                  rng.normal(size=(512, 8)).astype(np.float32))
+    with pytest.raises(PageQuotaExceeded):
+        idx.add_batch([Pointer(9000)],
+                      rng.normal(size=(1, 8)).astype(np.float32))
+    for i in range(512):
+        idx.remove(Pointer(i))
+    idx.add_batch([Pointer(9000)],
+                  rng.normal(size=(1, 8)).astype(np.float32))
+    assert len(idx) == 1
+
+
+def test_sharded_paged_matches_contiguous(mesh4):
+    from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex
+
+    rng = np.random.default_rng(12)
+    vecs = rng.normal(size=(700, 8)).astype(np.float32)
+    keys = [Pointer(i) for i in range(700)]
+    paged = ShardedKnnIndex(8, mesh=mesh4, reserved_space=8, page_rows=128,
+                            paged=True)
+    flat = ShardedKnnIndex(8, mesh=mesh4, reserved_space=700, paged=False)
+    paged.add_batch(keys, vecs)
+    flat.add_batch(keys, vecs)
+    for i in range(0, 700, 2):
+        paged.remove(Pointer(i))
+        flat.remove(Pointer(i))
+    qs = [(Pointer(10**6 + i), vecs[101 + 2 * i], 6, None)
+          for i in range(3)]
+    rp, rf = paged.search(qs), flat.search(qs)
+    for a, b in zip(rp, rf):
+        assert [k for k, _ in a] == [k for k, _ in b]
+        np.testing.assert_allclose([d for _, d in a], [d for _, d in b],
+                                   rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ragged encoder batching
+# ---------------------------------------------------------------------------
+
+def _tiny_embedders(**kw):
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
+
+    cfg = EncoderConfig.tiny(compute_dtype=jnp.float32, **kw)
+    return (JaxEncoderEmbedder(config=cfg, ragged=True, max_len=64),
+            JaxEncoderEmbedder(config=cfg, ragged=False, max_len=64))
+
+
+TEXTS = ["hello world foo", "a",
+         "some much longer text with many more words than the others "
+         "to span packing widths", "mid size text here ok"] * 9
+
+
+@pytest.mark.parametrize("pooling", ["cls", "mean"])
+def test_ragged_encode_matches_per_row(pooling):
+    ragged, plain = _tiny_embedders(pooling=pooling)
+    er = np.asarray(ragged.encode_batch_device(TEXTS))
+    ep = np.asarray(plain.encode_batch_device(TEXTS))
+    assert er.shape == ep.shape
+    cos = np.sum(er * ep, axis=1)
+    assert cos.min() > 0.9999, cos.min()
+
+
+def test_ragged_packing_shapes_and_order():
+    ragged, _ = _tiny_embedders()
+    chunks = ragged.pack_ragged(TEXTS)
+    n_docs = sum(c[1] for c in chunks)
+    assert n_docs == len(TEXTS)
+    for (ids, doc_map, pos, dseq, doff), n_real, n_pad in chunks:
+        assert ids.shape == doc_map.shape == pos.shape
+        assert ids.shape[0] in ragged.ragged_buckets()
+        assert dseq.shape == doff.shape == (n_pad,)
+        # docs numbered 0..n_real-1 in input order; padding rows -1
+        assert set(np.unique(doc_map)) <= set(range(-1, n_real))
+
+
+def test_ragged_fused_ingest_end_to_end():
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.ops.knn import DeviceEmbeddingKnnIndex
+    from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
+
+    cfg = EncoderConfig.tiny()
+    emb = JaxEncoderEmbedder(config=cfg, ragged=True, max_len=64)
+    inner = BruteForceKnnIndex(cfg.hidden, metric=KnnMetric.COS,
+                               dtype="bfloat16", paged=True)
+    idx = DeviceEmbeddingKnnIndex(emb, inner)
+    texts = [f"document number {i} with content {i * 7}" for i in range(150)]
+    idx.add_batch([Pointer(i) for i in range(150)], texts)
+    assert len(idx) == 150
+    res = idx.search([(Pointer(10**9), texts[42], 1, None)])
+    assert res[0][0][0] == Pointer(42)
+
+
+def test_ragged_warmup_compile_count_under_six():
+    import pathway_tpu as pw
+    from pathway_tpu.models.encoder import EncoderConfig
+    from pathway_tpu.ops.knn import DeviceEmbeddingKnnIndex
+    from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
+
+    cfg = EncoderConfig.tiny(max_len=512)
+    emb = JaxEncoderEmbedder(config=cfg, ragged=True, max_len=512)
+    idx = DeviceEmbeddingKnnIndex(
+        emb, BruteForceKnnIndex(cfg.hidden, metric=KnnMetric.COS,
+                                paged=True))
+    out = pw.warmup(emb, index=idx, cache=False)
+    assert 0 < len(out["compiled"]) <= 6, out["compiled"]
+    assert len(idx) == 0  # warmup scratch rows retracted
+    # the width-bucket zoo this replaces is ~18 compiles
+    assert len(emb.bucket_widths()) >= 15

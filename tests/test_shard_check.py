@@ -52,7 +52,8 @@ def _bind(table):
 
 
 def _knn_pipeline(tmp_path, *, mesh="auto", reserved_space=1024,
-                  embedder=None, dimensions=16, dtype="float32"):
+                  embedder=None, dimensions=16, dtype="float32",
+                  tenant_quotas=None):
     """Streaming docs -> sharded KNN index -> bound query results."""
     from pathway_tpu.stdlib.indexing import (
         default_brute_force_knn_document_index)
@@ -62,7 +63,8 @@ def _knn_pipeline(tmp_path, *, mesh="auto", reserved_space=1024,
         lambda d: np.zeros(16, dtype=np.float32), np.ndarray, docs.doc))
     index = default_brute_force_knn_document_index(
         data.vec, data, dimensions=dimensions, reserved_space=reserved_space,
-        mesh=mesh, embedder=embedder, dtype=dtype)
+        mesh=mesh, embedder=embedder, dtype=dtype,
+        tenant_quotas=tenant_quotas)
     hits = index.query_as_of_now(data.vec, number_of_matches=1)
     _bind(hits)
     return hits
@@ -349,7 +351,10 @@ class _DeviceEmbedder:
         return 16
 
 
-def test_pwt108_fused_ingest_without_reservation(tmp_path):
+def test_pwt108_fused_ingest_without_reservation(tmp_path, monkeypatch):
+    # the fused-path cliff only exists on the contiguous slab — the paged
+    # store grows the fused path by allocating pages
+    monkeypatch.setenv("PATHWAY_PAGED_STORE", "0")
     _knn_pipeline(tmp_path, mesh=None, embedder=_DeviceEmbedder(),
                   reserved_space=0)
     diags = pw.static_check()
@@ -359,7 +364,8 @@ def test_pwt108_fused_ingest_without_reservation(tmp_path):
     assert "1024" in pwt108[0].message  # names the pinned minimum capacity
 
 
-def test_pwt108_negative_reserved_or_unfused(tmp_path):
+def test_pwt108_negative_reserved_or_unfused(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_PAGED_STORE", "0")
     _knn_pipeline(tmp_path, mesh=None, embedder=_DeviceEmbedder(),
                   reserved_space=4096)
     assert "PWT108" not in codes(pw.static_check())
@@ -367,6 +373,66 @@ def test_pwt108_negative_reserved_or_unfused(tmp_path):
     # a plain UDF embedder has no fused device path to lose
     _knn_pipeline(tmp_path, mesh=None, reserved_space=0)
     assert "PWT108" not in codes(pw.static_check())
+
+
+def test_pwt108_suppressed_under_paged_store(tmp_path, monkeypatch):
+    # default (paged) storage: fused ingest grows by allocating a page,
+    # so the unreserved-slab cliff PWT108 warns about does not exist
+    monkeypatch.delenv("PATHWAY_PAGED_STORE", raising=False)
+    _knn_pipeline(tmp_path, mesh=None, embedder=_DeviceEmbedder(),
+                  reserved_space=0)
+    assert "PWT108" not in codes(pw.static_check())
+
+
+# ---------------------------------------------------------------------------
+# PWT111 — paged-store reservation / tenant quota layout
+# ---------------------------------------------------------------------------
+
+def test_pwt111_unaligned_reservation(tmp_path, monkeypatch):
+    monkeypatch.delenv("PATHWAY_PAGED_STORE", raising=False)
+    monkeypatch.delenv("PATHWAY_PAGE_ROWS", raising=False)
+    _knn_pipeline(tmp_path, mesh=None, reserved_space=1500)
+    diags = pw.static_check()
+    pwt = [d for d in diags if d.code == "PWT111"]
+    assert len(pwt) == 1
+    assert pwt[0].severity is Severity.WARNING
+    assert "1500" in pwt[0].message and "2048" in pwt[0].message
+
+
+def test_pwt111_unaligned_tenant_quota(tmp_path, monkeypatch):
+    monkeypatch.delenv("PATHWAY_PAGED_STORE", raising=False)
+    _knn_pipeline(tmp_path, mesh=None, reserved_space=1024,
+                  tenant_quotas={"acme": 1500, "globex": 2048})
+    diags = pw.static_check()
+    pwt = [d for d in diags if d.code == "PWT111"]
+    assert len(pwt) == 1  # only acme's quota is unaligned
+    assert "acme" in pwt[0].message and "2048" in pwt[0].message
+
+
+def test_pwt111_quotas_past_device_hbm(tmp_path, monkeypatch):
+    monkeypatch.delenv("PATHWAY_PAGED_STORE", raising=False)
+    monkeypatch.setenv("PATHWAY_DEVICE_HBM_GB", "1")
+    # 16 B/row f32 rows: 2^27 rows/tenant x 4 tenants = 8 GiB >> 1 GiB
+    quotas = {f"t{i}": (1 << 27) for i in range(4)}
+    _knn_pipeline(tmp_path, mesh=None, reserved_space=1024,
+                  tenant_quotas=quotas)
+    diags = pw.static_check()
+    over = [d for d in diags if d.code == "PWT111" and d.is_error]
+    assert len(over) == 1
+    assert "HBM" in over[0].message
+
+
+def test_pwt111_negative_cases(tmp_path, monkeypatch):
+    # page-aligned reservation + aligned, HBM-fitting quotas: clean
+    monkeypatch.delenv("PATHWAY_PAGED_STORE", raising=False)
+    _knn_pipeline(tmp_path, mesh=None, reserved_space=2048,
+                  tenant_quotas={"acme": 4096})
+    assert "PWT111" not in codes(pw.static_check())
+    G.clear()
+    # slab mode: the paged layout rules do not apply
+    monkeypatch.setenv("PATHWAY_PAGED_STORE", "0")
+    _knn_pipeline(tmp_path, mesh=None, reserved_space=1500)
+    assert "PWT111" not in codes(pw.static_check())
 
 
 # ---------------------------------------------------------------------------
