@@ -410,6 +410,15 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             errors["paging_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
+    if "durability" not in SKIP:
+        # watermark-durability leg (CPU-runnable): bridge overlap with
+        # persistence ON at inflight 1 vs 4 + checkpoint cadence — the
+        # evidence that durability no longer prices pipelining at depth 1
+        try:
+            result.update(bench_durability())
+        except Exception as e:  # noqa: BLE001
+            errors["durability_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
     # sidecar path for the device-phase flight beacon, inherited by the
     # child processes; every emit below reads it, so the last surviving
     # JSON line always carries whatever attribution the child reported
@@ -1275,6 +1284,100 @@ def _bench_knn_int8(n, gen, chunk, queries, bf16_top) -> dict:
         "knn_int8_batch64_ms": round(b64, 2),
         "knn_int8_overlap10_vs_bf16": round(overlap, 3),
     }
+
+
+def bench_durability() -> dict:
+    """Checkpoint cadence vs pipeline depth (resolved-prefix commit
+    watermark, engine/device_bridge.py + engine/persistence.py).
+
+    Runs one paced streaming graph — python connector → device-leg batch
+    UDF (a fixed per-leg device stand-in delay on CPU; the mechanics
+    under test are the bridge/commit interactions, not kernel speed) →
+    groupby — three ways: inflight=4 with persistence ON, inflight=4
+    with persistence OFF, inflight=1 with persistence ON. Reports the
+    bridge overlap ratio of each plus ticks-per-commit and watermark lag,
+    so the acceptance bar "persistence-on overlap within 10% of
+    persistence-off at inflight=4" is a captured number, not a claim.
+    """
+    import tempfile
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine.streaming import StreamingRuntime
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+
+    n_rows = int(os.environ.get("BENCH_DURABILITY_ROWS", 40))
+    leg_ms = float(os.environ.get("BENCH_DURABILITY_LEG_MS", 20.0))
+
+    def run_once(inflight: int, persist_dir: str | None) -> dict:
+        os.environ["PATHWAY_DEVICE_INFLIGHT"] = str(inflight)
+        G.clear()
+
+        @pw.udf(batch=True, device=True, deterministic=True,
+                return_type=int)
+        def dev_score(qty: list) -> list:
+            time.sleep(leg_ms / 1e3)
+            return [int(q) * 2 for q in qty]
+
+        class _Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                for i in range(n_rows):
+                    time.sleep(0.004)
+                    self.next(item=f"i{i % 5}", qty=1 + i % 3)
+
+        t = pw.io.python.read(
+            _Feed(), schema=pw.schema_from_types(item=str, qty=int),
+            autocommit_duration_ms=10, persistent_id="bench-durability")
+        t = t.select(item=t.item, score=dev_score(t.qty))
+        agg = t.groupby(t.item).reduce(item=t.item,
+                                       s=pw.reducers.sum(t.score))
+        pw.io.subscribe(agg, lambda *a, **k: None)
+        cfg = None
+        if persist_dir is not None:
+            cfg = pw.persistence.Config.simple_config(
+                pw.persistence.Backend.filesystem(persist_dir))
+        runner = GraphRunner()
+        for binder in G.output_binders:
+            binder(runner)
+        rt = StreamingRuntime(runner, persistence_config=cfg)
+        t0 = time.perf_counter()
+        rt.run()
+        wall_s = time.perf_counter() - t0
+        bridge = rt.scheduler.bridge_stats() or {}
+        pstats = rt.persistence.stats() if rt.persistence else {}
+        G.clear()
+        return {"wall_s": wall_s, "bridge": bridge, "pstats": pstats}
+
+    out: dict = {}
+    prior_inflight = os.environ.get("PATHWAY_DEVICE_INFLIGHT")
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            p4 = run_once(4, os.path.join(td, "p4"))
+            nop4 = run_once(4, None)
+            p1 = run_once(1, os.path.join(td, "p1"))
+    finally:
+        # later legs (and the device-phase child env) must see the
+        # caller's pipelining depth, not this leg's last override
+        if prior_inflight is None:
+            os.environ.pop("PATHWAY_DEVICE_INFLIGHT", None)
+        else:
+            os.environ["PATHWAY_DEVICE_INFLIGHT"] = prior_inflight
+    out["durability_overlap_inflight4_persist"] = round(
+        p4["bridge"].get("overlap_ratio", 0.0), 3)
+    out["durability_overlap_inflight4_nopersist"] = round(
+        nop4["bridge"].get("overlap_ratio", 0.0), 3)
+    out["durability_bridge_max_depth_persist"] = \
+        p4["bridge"].get("max_depth", 0)
+    for tag, leg in (("inflight4", p4), ("inflight1", p1)):
+        ps = leg["pstats"]
+        commits = max(1, ps.get("commits_with_data", 0))
+        out[f"durability_commits_{tag}"] = ps.get("commits_with_data", 0)
+        out[f"durability_ticks_per_commit_{tag}"] = round(
+            ps.get("watermark", 0) / commits, 2)
+        out[f"durability_wall_s_{tag}"] = round(leg["wall_s"], 3)
+    out["durability_watermark_lag_ticks"] = p4["pstats"].get(
+        "lag_ticks", 0)
+    return out
 
 
 def bench_knn() -> dict:

@@ -59,8 +59,11 @@ def build(orders_dir: str, categories_csv: str, out_csv: str,
             initial_delay_ms=500, backoff_factor=2.0, max_delay_ms=15_000,
             jitter=True),
         connect_timeout=60.0)
+    # the stable persistent_id makes the feed resumable under
+    # pw.persistence (crash/restart replays the committed watermark and
+    # the reader seeks past it — tests/durability_canary.py)
     orders = pw.io.fs.read(orders_dir, format="json", schema=Order,
-                           mode="streaming",
+                           mode="streaming", persistent_id="orders",
                            connector_policy=orders_policy)
     cats = pw.io.fs.read(categories_csv, format="csv",
                          schema=Category, mode="static")

@@ -24,7 +24,14 @@ Guarantees:
   window is full, so a slow device cannot be out-run by the host.
 - **Hard barrier**: ``barrier()`` returns only when every submitted leg has
   resolved. Callers place it before anything that externalizes state —
-  persistence checkpoints, end-of-stream flush, reading a tick's outputs.
+  end-of-stream flush and reading a tick's outputs.
+- **Resolved-prefix watermark**: because legs retire strictly in tick
+  order, the tick of the last resolved leg is the longest *resolved
+  prefix* of submitted work. ``resolved_watermark()`` exposes it as a
+  monotone counter; a failed leg freezes it (the failed tick never
+  enters the prefix). Persistence commits *up to the watermark* instead
+  of draining the bridge (engine/streaming.py), so checkpoints trail the
+  pipeline without collapsing it to depth 1.
 - **Error propagation**: a leg that raises poisons the bridge; the pending
   queue is dropped (later ticks must not run on top of a failed one) and
   the *original* exception re-raises on the host thread at the next
@@ -43,6 +50,8 @@ import time as _time
 import weakref
 from collections import deque
 from typing import Callable
+
+from pathway_tpu.testing import faults
 
 # live bridges (weak: a bridge dies with its scheduler). Out-of-band
 # observers — bench.py's flight beacon, post-mortem dumps — read depth and
@@ -83,6 +92,15 @@ class DeviceBridge:
         # (queue-wait vs execute) and the in-flight marker for post-mortems
         self.recorder = recorder
         self._current: tuple | None = None  # (tick, started_monotonic)
+        # longest resolved prefix of submitted legs: the tick of the last
+        # leg that retired cleanly (FIFO worker => strictly tick-ordered
+        # resolution). 0 = nothing resolved yet; frozen on leg failure.
+        self._watermark = 0
+        # observer fired (outside the lock, on the worker thread) after
+        # every watermark advance — the streaming runtime stamps commit
+        # loop progress here so a slow-but-advancing device never reads
+        # as a commit stall
+        self.on_advance: Callable[[int], None] | None = None
         _LIVE.add(self)
         self._cv = threading.Condition()
         self._queue: deque = deque()  # (tick, fn, submitted_at)
@@ -170,6 +188,14 @@ class DeviceBridge:
         return {"tick": cur[0],
                 "since_s": round(_time.monotonic() - cur[1], 3)}
 
+    def resolved_watermark(self) -> int:
+        """Tick of the longest fully-resolved prefix of submitted legs
+        (monotone; 0 before anything resolved). Every leg with tick <=
+        the watermark has retired cleanly — the durability frontier the
+        persistence commit loop trails."""
+        with self._cv:
+            return self._watermark
+
     def error(self) -> BaseException | None:
         """The stored leg failure, if any (without raising). Lets teardown
         paths that must not raise mid-cleanup (Scheduler.close → drain)
@@ -183,6 +209,7 @@ class DeviceBridge:
             return {
                 "max_inflight": self.max_inflight,
                 "depth": len(self._queue) + (1 if self._running else 0),
+                "resolved_watermark": self._watermark,
                 "legs_dispatched": self.legs_dispatched,
                 "legs_resolved": resolved,
                 "legs_overlapped": self.legs_overlapped,
@@ -219,7 +246,15 @@ class DeviceBridge:
                 rec.mark_leg(tick)
             started = _time.perf_counter()
             try:
+                # fault points at the new watermark boundaries
+                # (testing/faults.py): ``exec`` injects a device-leg
+                # failure; ``resolved`` injects a crash between the leg's
+                # work retiring and the watermark advancing — work done
+                # but the durability frontier frozen, the edge the
+                # crash-sweep suite must cover
+                faults.hit("bridge.leg.exec", tick=tick)
                 fn()
+                faults.hit("bridge.leg.resolved", tick=tick)
             except BaseException as e:  # noqa: BLE001 — must cross threads
                 if recording:
                     # poison carries the flight-recorder tail: the host
@@ -252,6 +287,15 @@ class DeviceBridge:
                 self.legs_resolved += 1
                 if not waited_at_start and self._waiters == 0:
                     self.legs_overlapped += 1
+                # legs resolve strictly in tick order, so this leg's tick
+                # IS the longest resolved prefix
+                self._watermark = tick
                 self._running = False
                 self._current = None
                 self._cv.notify_all()
+            on_advance = self.on_advance
+            if on_advance is not None:
+                try:
+                    on_advance(tick)
+                except Exception:  # observer must never poison the bridge
+                    pass

@@ -266,10 +266,38 @@ class Scheduler:
 
     def resolve_barrier(self) -> None:
         """Wait for every in-flight device leg to resolve (no-op when
-        pipelining is off). Must run before anything externalizes engine
-        state: persistence commits, end-of-stream flushes, output reads."""
+        pipelining is off). Must run before anything that reads engine
+        state synchronously: end-of-stream flushes and output reads.
+        Persistence commits do NOT barrier — they trail the resolved
+        prefix via :meth:`commit_watermark` instead."""
         if self._bridge is not None:
             self._bridge.barrier()
+
+    def commit_watermark(self, completed_tick: int) -> int:
+        """The durability frontier for a persistence commit issued after
+        ``completed_tick`` returned from :meth:`run_time`: with pipelining
+        on, the bridge's resolved-prefix watermark (every leg <= it has
+        retired — a checkpoint may cover exactly that prefix while later
+        legs are still in flight); synchronously, the tick itself (it is
+        fully processed the moment run_time returns)."""
+        if self._bridge is not None:
+            return min(self._bridge.resolved_watermark(), completed_tick)
+        return completed_tick
+
+    def set_watermark_listener(self, cb) -> None:
+        """Observe every watermark advance (bridge-worker thread). No-op
+        without a bridge — synchronous ticks already stamp progress
+        inline."""
+        if self._bridge is not None:
+            self._bridge.on_advance = cb
+
+    def bridge_inflight(self) -> dict | None:
+        """The oldest unresolved device leg (tick + seconds since
+        dispatch), None when idle or pipelining is off. Survives
+        recording-off — stall post-mortems always get a name."""
+        if self._bridge is not None:
+            return self._bridge.inflight()
+        return None
 
     def bridge_stats(self) -> dict | None:
         """Device-bridge instrumentation (None when pipelining is off)."""

@@ -92,6 +92,12 @@ class MonitoringHttpServer:
             # paged vector store (engine/paged_store.py): page table
             # occupancy, extent count, growth events, per-tenant pages
             payload["paged_store"] = paged
+        persistence = getattr(self.runtime, "persistence", None)
+        if persistence is not None:
+            # commit-watermark durability (engine/persistence.py): how
+            # far checkpoints trail the pipeline — a growing lag is
+            # visible here before it ever becomes a stall
+            payload["persistence"] = persistence.stats()
         return payload
 
     def _request_tracker(self):
@@ -136,6 +142,8 @@ class MonitoringHttpServer:
             "failed_sources": failed,
             "stalled_sources": stalled,
             "commit_loop_stalled": commit_stalled,
+            "engine_failed": bool(sup is not None
+                                  and getattr(sup, "engine_failed", False)),
             "connector_retries": retries,
         }
 
@@ -301,6 +309,46 @@ class MonitoringHttpServer:
             lines.append("# TYPE pathway_tpu_device_exec_ms_total counter")
             lines.append(
                 f"pathway_tpu_device_exec_ms_total {bridge['exec_ms']}")
+        persistence = getattr(self.runtime, "persistence", None)
+        if persistence is not None:
+            # commit-watermark durability (engine/persistence.py): lag
+            # between the pipeline head and the durability frontier, the
+            # bridge depth each commit trailed behind, per-commit durable
+            # write latency, and transient-write retries — the surfaces
+            # that make "checkpoints independent of in-flight depth"
+            # checkable instead of asserted
+            pst = persistence.stats()
+            lines.append(
+                "# TYPE pathway_tpu_commit_watermark_lag_ticks gauge")
+            lines.append(f"pathway_tpu_commit_watermark_lag_ticks "
+                         f"{pst['lag_ticks']}")
+            lines.append("# TYPE pathway_tpu_commit_watermark gauge")
+            lines.append(
+                f"pathway_tpu_commit_watermark {pst['watermark']}")
+            lines.append(
+                "# TYPE pathway_tpu_device_inflight_at_commit gauge")
+            lines.append(f"pathway_tpu_device_inflight_at_commit "
+                         f"{pst['inflight_at_commit']}")
+            lines.append("# TYPE pathway_tpu_persistence_commits counter")
+            lines.append(
+                f"pathway_tpu_persistence_commits {pst['commits']}")
+            lines.append(
+                "# TYPE pathway_tpu_persistence_entries_committed counter")
+            lines.append(f"pathway_tpu_persistence_entries_committed "
+                         f"{pst['entries_committed']}")
+            lines.append(
+                "# TYPE pathway_tpu_persistence_write_retries counter")
+            lines.append(f"pathway_tpu_persistence_write_retries "
+                         f"{pst['write_retries']}")
+            lines.append("# TYPE pathway_tpu_commit_wait_ms histogram")
+            for le, c in persistence.commit_wait.cumulative():
+                le_s = "+Inf" if le == float("inf") else format(le, "g")
+                lines.append(
+                    f'pathway_tpu_commit_wait_ms_bucket{{le="{le_s}"}} {c}')
+            lines.append(f"pathway_tpu_commit_wait_ms_sum "
+                         f"{round(persistence.commit_wait.sum_ms, 6)}")
+            lines.append(f"pathway_tpu_commit_wait_ms_count "
+                         f"{persistence.commit_wait.count}")
         paged = _paged_stats()
         if paged is not None:
             # paged vector store occupancy (engine/paged_store.py): pool

@@ -31,13 +31,18 @@ reference's mock metadata backend).
 from __future__ import annotations
 
 import io
+import logging
 import os
 import pickle
 import struct
+import threading
+import time as _time
 import zlib
-from typing import Any
+from typing import Any, Callable
 
 from pathway_tpu.testing import faults
+
+logger = logging.getLogger(__name__)
 
 _HDR = struct.Struct("<QI")  # payload length, CRC32(payload)
 _MAGIC = b"PWSNAP01"  # format marker; bump the digit on layout changes
@@ -82,6 +87,100 @@ class _RestrictedUnpickler(pickle.Unpickler):
 
 def _safe_loads(payload: bytes):
     return _RestrictedUnpickler(io.BytesIO(payload)).load()
+
+
+# ---------------------------------------------------------------------------
+# transient-write retries (shared by the file and object-store logs)
+# ---------------------------------------------------------------------------
+
+# process-wide retry counter, exported on /metrics as
+# ``pathway_tpu_persistence_write_retries`` (Prometheus counters are
+# process-scoped by convention — several drivers in one process share it)
+_retry_lock = threading.Lock()
+_write_retries_total = 0
+
+
+def write_retries_total() -> int:
+    with _retry_lock:
+        return _write_retries_total
+
+
+def _retrying_write(body: Callable[[], None], what: str) -> None:
+    """Run one durable write (append+fsync, object PUT), retrying
+    transient failures with the shared exponential backoff + full jitter
+    schedule (internals/retries.py). ``body`` must be safe to re-run from
+    scratch: the file log truncates its torn tail before every attempt
+    and object PUTs are atomic whole-object writes. Exhausting
+    ``PATHWAY_PERSISTENCE_WRITE_RETRIES`` (default 3; 0 disables
+    retries) re-raises the last error — the streaming commit loop then
+    escalates it per ``terminate_on_error``."""
+    from pathway_tpu.internals.config import _env_int
+
+    global _write_retries_total
+    budget = max(0, _env_int("PATHWAY_PERSISTENCE_WRITE_RETRIES", 3))
+    strategy = None
+    attempt = 0
+    while True:
+        try:
+            body()
+            return
+        except Exception as e:
+            if attempt >= budget:
+                raise
+            if strategy is None:
+                from pathway_tpu.internals.retries import \
+                    ExponentialBackoffRetryStrategy
+
+                strategy = ExponentialBackoffRetryStrategy(
+                    initial_delay_ms=max(1, _env_int(
+                        "PATHWAY_PERSISTENCE_RETRY_INITIAL_MS", 50)),
+                    backoff_factor=2.0,
+                    max_delay_ms=max(1, _env_int(
+                        "PATHWAY_PERSISTENCE_RETRY_MAX_MS", 2000)),
+                    jitter=True)
+            delay = strategy.delay_for_attempt(attempt)
+            with _retry_lock:
+                _write_retries_total += 1
+            logger.warning(
+                "transient persistence write failure (%s): %s: %s — "
+                "retry %d/%d in %.3fs", what, type(e).__name__, e,
+                attempt + 1, budget, delay)
+            _time.sleep(delay)
+            attempt += 1
+
+
+class _WaitHistogram:
+    """Fixed-bucket commit-wait histogram, Prometheus-exposed as
+    ``pathway_tpu_commit_wait_ms`` — how long each durable commit (append
+    + fsync/PUT incl. retries) held the loop."""
+
+    BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                  1000.0)
+
+    def __init__(self):
+        self.counts = [0] * (len(self.BUCKETS_MS) + 1)
+        self.sum_ms = 0.0
+        self.count = 0
+
+    def observe(self, ms: float) -> None:
+        i = 0
+        for b in self.BUCKETS_MS:
+            if ms <= b:
+                break
+            i += 1
+        self.counts[i] += 1
+        self.sum_ms += ms
+        self.count += 1
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """[(le, cumulative count)], +Inf last (exposition format)."""
+        out: list[tuple[float, int]] = []
+        cum = 0
+        for b, c in zip(self.BUCKETS_MS, self.counts):
+            cum += c
+            out.append((b, cum))
+        out.append((float("inf"), cum + self.counts[-1]))
+        return out
 
 
 class SnapshotLog:
@@ -147,15 +246,29 @@ class SnapshotLog:
             if valid == 0:
                 self._f.write(_MAGIC)
         payload = pickle.dumps((time, entries), protocol=pickle.HIGHEST_PROTOCOL)
-        faults.hit("persistence.append", path=self.path, time=time)
-        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
-        # fault point between header and payload: an armed action aborts
-        # here leaving exactly the torn-tail record _scan must drop
-        faults.hit("persistence.append.torn", path=self.path, time=time)
-        self._f.write(payload)
-        self._f.flush()
-        faults.hit("persistence.fsync", path=self.path, time=time)
-        os.fsync(self._f.fileno())
+        start = self._f.tell()
+
+        def _write() -> None:
+            # re-entry after a failed attempt: truncate whatever the torn
+            # attempt left (a header without its payload) before
+            # rewriting, or every later record would sit behind
+            # unreadable bytes. First attempt: size == start, a no-op.
+            # The file is opened in append mode, so writes land at the
+            # (possibly truncated-back) end regardless of seek position.
+            self._f.truncate(start)
+            self._f.seek(start)
+            faults.hit("persistence.append", path=self.path, time=time)
+            self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+            # fault point between header and payload: an armed action
+            # aborts here leaving exactly the torn-tail record _scan
+            # must drop
+            faults.hit("persistence.append.torn", path=self.path, time=time)
+            self._f.write(payload)
+            self._f.flush()
+            faults.hit("persistence.fsync", path=self.path, time=time)
+            os.fsync(self._f.fileno())
+
+        _retrying_write(_write, f"append to {self.path}")
 
     def close(self) -> None:
         if self._f is not None:
@@ -230,7 +343,15 @@ class S3SnapshotLog:
         payload = pickle.dumps((time, entries),
                                protocol=pickle.HIGHEST_PROTOCOL)
         body = _MAGIC + _HDR.pack(len(payload), zlib.crc32(payload)) + payload
-        self.client.put_object(f"{self.prefix}/{self._seq:016d}", body)
+        key = f"{self.prefix}/{self._seq:016d}"
+
+        def _put() -> None:
+            faults.hit("persistence.s3.put", key=key, time=time)
+            self.client.put_object(key, body)
+
+        # whole-object PUTs are atomic, so a retry simply overwrites the
+        # failed attempt's slot; _seq advances only after success
+        _retrying_write(_put, f"PUT {key}")
         self._seq += 1
 
     def close(self) -> None:
@@ -260,12 +381,25 @@ class _RecordingSession:
     non-seekable sources it additionally drops the first ``skip`` live
     entries — those were replayed from the snapshot log (the reference's
     offset-continuation, expressed as replay+skip). Duck-types
-    io._datasource.Session (push/drain/close/closed)."""
+    io._datasource.Session (push/drain/close/closed).
+
+    **Durability seals**: the streaming loop stamps ``seal(tick)``
+    immediately before draining the inner session for tick ``tick``, so
+    every entry under a seal was drained — and therefore fully processed
+    — by that tick. The commit loop then takes exactly the prefix sealed
+    at ticks <= the bridge's resolved watermark: an entry becomes durable
+    only once its tick provably retired, at any in-flight depth."""
 
     def __init__(self, inner, skip: int):
         self._inner = inner
         self._skip = skip
         self.pending: list = []  # (key, row, diff, offset)
+        # (tick, cumulative pending length at seal time), tick-ascending.
+        # The mutex serializes reader-thread pushes against the commit
+        # loop's seal/take (a push between the take's slice and rebind
+        # would otherwise be dropped from durability forever).
+        self._seals: list[tuple[int, int]] = []
+        self._mutex = threading.Lock()
         self.closed = inner.closed
         self.stopping = inner.stopping
 
@@ -280,8 +414,40 @@ class _RecordingSession:
         if self._skip > 0:
             self._skip -= 1
             return
-        self.pending.append((key, row, diff, offset))
+        with self._mutex:
+            self.pending.append((key, row, diff, offset))
         self._inner.push(key, row, diff)
+
+    def seal(self, tick: int) -> None:
+        """Mark everything pushed so far as belonging to ``tick``'s drain
+        (called right before the drain, so sealed ⊆ processed-by-tick)."""
+        with self._mutex:
+            n = len(self.pending)
+            if self._seals and self._seals[-1][1] == n:
+                # idle tick: the existing seal already covers these
+                # entries at an OLDER tick — keep it (re-stamping to the
+                # newer tick would shrink what a frozen watermark may
+                # commit); the list only grows when entries do
+                return
+            self._seals.append((tick, n))
+
+    def take_sealed(self, watermark: int) -> list:
+        """Remove and return every pending entry under a seal with tick
+        <= ``watermark`` — the longest durable-eligible prefix."""
+        with self._mutex:
+            n = 0
+            cut = 0
+            for i, (tick, count) in enumerate(self._seals):
+                if tick > watermark:
+                    break
+                n = count
+                cut = i + 1
+            if cut:
+                self._seals = [(t, c - n) for t, c in self._seals[cut:]]
+            if n == 0:
+                return []
+            entries, self.pending = self.pending[:n], self.pending[n:]
+            return entries
 
     def drain(self) -> list:
         return self._inner.drain()
@@ -336,6 +502,14 @@ class PersistenceDriver:
         self._restore_time: int | None = None
         self._record_cache: dict[str, list] = {}  # sid → records (read once)
         self._attached_ids: set[str] = set()
+        # -- commit instrumentation (read via stats(); /metrics + /status) --
+        self.commits = 0                 # commit() calls
+        self.commits_with_data = 0       # commits that appended >= 1 record
+        self.entries_committed = 0
+        self.last_commit_watermark = 0   # durability frontier (monotone)
+        self.last_commit_tick = 0        # loop tick at the last commit
+        self.last_inflight_at_commit = 0  # bridge depth when committing
+        self.commit_wait = _WaitHistogram()
 
     # -- identity ----------------------------------------------------------
     def _source_id(self, datasource) -> str:
@@ -448,14 +622,67 @@ class PersistenceDriver:
         self._sessions.append((sid, log, rec))
         return rec
 
-    def commit(self, time: int) -> None:
-        """Durably record everything pushed since the previous commit.
-        Called by the runtime after the scheduler finished time ``time``, so
-        a log record's presence implies its time was fully processed."""
+    def seal(self, tick: int) -> None:
+        """Stamp a durability seal on every recorded source (streaming
+        loop, right before the tick's drain)."""
+        for _sid, _log, rec in self._sessions:
+            rec.seal(tick)
+
+    def commit(self, time: int, watermark: int | None = None,
+               inflight: int = 0) -> None:
+        """Durably record entries whose processing is provably complete.
+
+        ``watermark=None`` — synchronous callers and the end-of-stream
+        flush: everything pushed so far is sealed at ``time`` and
+        committed (the caller holds hard-barrier semantics: ``time`` is
+        fully processed when this runs).
+
+        With a watermark — the pipelined streaming loop: only entries
+        sealed at ticks <= ``watermark`` (the device bridge's resolved
+        prefix) are appended, in a record carrying the *watermark* tick.
+        Either way the log invariant is the same: a record's presence
+        implies its time was fully processed — now held exactly, at any
+        in-flight depth, instead of by draining the bridge first.
+        Transient backend write failures retry inside the log's append
+        (``_retrying_write``)."""
+        t0 = _time.perf_counter()
+        if watermark is None:
+            watermark = time
+            self.seal(time)
+        # fault point between reading the watermark and the durable
+        # append: a crash here loses nothing (the sealed entries are
+        # re-emitted by the reader on restart, never skipped)
+        faults.hit("persistence.commit", time=time, watermark=watermark)
+        wrote = False
         for sid, log, rec in self._sessions:
-            if rec.pending:
-                entries, rec.pending = rec.pending, []
-                log.append(time, entries)
+            entries = rec.take_sealed(watermark)
+            if entries:
+                log.append(watermark, entries)
+                self.entries_committed += len(entries)
+                wrote = True
+        self.commits += 1
+        self.last_commit_tick = max(self.last_commit_tick, time)
+        self.last_commit_watermark = max(self.last_commit_watermark,
+                                         watermark)
+        self.last_inflight_at_commit = inflight
+        if wrote:
+            self.commits_with_data += 1
+            self.commit_wait.observe((_time.perf_counter() - t0) * 1e3)
+
+    def stats(self) -> dict:
+        """Commit-watermark snapshot for /status and the dashboard."""
+        return {
+            "commits": self.commits,
+            "commits_with_data": self.commits_with_data,
+            "entries_committed": self.entries_committed,
+            "watermark": self.last_commit_watermark,
+            "lag_ticks": max(0, self.last_commit_tick
+                             - self.last_commit_watermark),
+            "inflight_at_commit": self.last_inflight_at_commit,
+            "write_retries": write_retries_total(),
+            "commit_wait_ms_sum": round(self.commit_wait.sum_ms, 3),
+            "commit_wait_count": self.commit_wait.count,
+        }
 
     def close(self) -> None:
         for _sid, log, _rec in self._sessions:

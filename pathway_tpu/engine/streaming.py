@@ -54,7 +54,16 @@ class StreamingRuntime:
         self.runner = runner
         self.cluster = cluster
         self.default_commit_ms = default_commit_ms
+        self.terminate_on_error = terminate_on_error
         self._stop = threading.Event()
+        # last tick run_time RETURNED for (pipelined: its device leg may
+        # still be in flight — the bridge watermark, not this counter, is
+        # the durability frontier)
+        self._last_completed_tick = 0
+        # an engine failure swallowed by the degrade path
+        # (terminate_on_error=False): kept so teardown neither re-raises
+        # it nor mistakes it for an unobserved device error
+        self._degraded_engine_error = None
         self.monitor = StatsMonitor(monitoring_level or MonitoringLevel.NONE)
         # flight recorder (engine/flight_recorder.py): on when a trace
         # path is configured or the data is observable (http server /
@@ -66,6 +75,11 @@ class StreamingRuntime:
             auto_on=with_http_server or self.monitor.enabled())
         self.scheduler = Scheduler(runner.graph, n_workers=n_workers,
                                    cluster=cluster, recorder=self.recorder)
+        # watchdog progress on every resolved device leg: the commit loop
+        # may legitimately block in submit() behind a full in-flight
+        # window — a slow-but-ADVANCING watermark is progress, not a
+        # stall; only a frozen one may breach the tick deadline
+        self.scheduler.set_watermark_listener(self._on_watermark_advance)
         self.sessions = []
         # supervision: reader threads are owned by the supervisor, which
         # restarts crashed readers per policy and escalates per
@@ -85,6 +99,8 @@ class StreamingRuntime:
             from pathway_tpu.engine.persistence import PersistenceDriver
 
             self.persistence = PersistenceDriver(persistence_config)
+            # dashboard durability panel: watermark lag is visible live
+            self.monitor.persistence = self.persistence
         self.http_server = None
         if with_http_server:
             from pathway_tpu.engine.http_server import MonitoringHttpServer
@@ -118,6 +134,57 @@ class StreamingRuntime:
         deadline = _time.monotonic() + timeout
         for t in self.supervisor.all_threads():
             t.join(max(0.0, deadline - _time.monotonic()))
+
+    def _on_watermark_advance(self, tick: int) -> None:
+        # bridge-worker thread; a bare float store is atomic under the GIL
+        self.last_tick_at = _time.monotonic()
+
+    def _handle_engine_failure(self, error: BaseException) -> bool:
+        """A failure escaped the commit loop: a poisoned device leg, a
+        persistence append whose write retries were exhausted, or an
+        operator error. Escalate through the supervisor's existing
+        terminate-vs-degrade contract — teardown's final watermark
+        commit makes the last fully-resolved prefix durable on both
+        branches, so nothing unprocessed can be covered by the log
+        either way. Returns True iff the failure is absorbed as a degrade
+        (``terminate_on_error=False``): recorded in the global ErrorLog
+        (kind="engine"), flagged on the supervisor, run ends cleanly.
+        Interrupts and shutdown requests always re-raise."""
+        if isinstance(error, (KeyboardInterrupt, SystemExit,
+                              GeneratorExit)):
+            return False
+        if self.terminate_on_error:
+            return False
+        import logging
+
+        from pathway_tpu.internals.error import global_error_log
+
+        kind = ("device leg"
+                if self.scheduler.take_device_error() is error
+                else "engine")
+        global_error_log().log(
+            f"{kind} failed under terminate_on_error=False; stopping "
+            f"ingestion after the last committed watermark: "
+            f"{type(error).__name__}: {error}",
+            operator="engine", kind="engine")
+        logging.getLogger(__name__).error(
+            "%s failed; degrading to a clean stop "
+            "(terminate_on_error=False). Restart resumes from the last "
+            "committed watermark.", kind, exc_info=error)
+        self.supervisor.engine_failed = True
+        self._degraded_engine_error = error
+        return True
+
+    def _commit_watermark_tick(self, tick: int) -> None:
+        """One trailing checkpoint: commit the longest resolved prefix of
+        device legs (<= ``tick``) WITHOUT draining the bridge — the
+        pipeline keeps running ahead at full ``PATHWAY_DEVICE_INFLIGHT``
+        depth while durability follows the watermark."""
+        wm = self.scheduler.commit_watermark(tick)
+        bridge = self.scheduler.bridge_stats()
+        self.persistence.commit(
+            tick, watermark=wm,
+            inflight=bridge["depth"] if bridge is not None else 0)
 
     def _drain_and_forward(self, tick: int):
         """Drain local sessions; under a cluster split each source's rows
@@ -245,6 +312,13 @@ class StreamingRuntime:
                         session.stopping.set()
                         session.close(reason="error",
                                       error=self.supervisor.fatal_error)
+                if self.persistence is not None:
+                    # durability seal BEFORE the drain: everything under
+                    # the seal is drained — hence processed — by this
+                    # tick, so "sealed at t" ⊆ "complete once the tick-t
+                    # leg resolves" holds exactly (entries pushed after
+                    # the seal wait for the next tick's seal)
+                    self.persistence.seal(time_counter)
                 any_data, all_closed, pushes = self._drain_and_forward(
                     time_counter)
                 any_data, all_closed = self._tick_sync(
@@ -262,16 +336,20 @@ class StreamingRuntime:
                     # reported as a stall. Under pipelined execution
                     # run_time returns with device legs still in flight —
                     # that IS progress (backpressure, not the watchdog,
-                    # bounds a slow device).
+                    # bounds a slow device; every resolved leg also
+                    # stamps progress via the watermark listener).
                     self.last_tick_at = _time.monotonic()
+                    self._last_completed_tick = time_counter
                     self.monitor.update(self.scheduler, self.runner.graph,
                                         time_counter)
                     if self.persistence is not None:
-                        # hard resolve barrier: a checkpoint must never
-                        # cover a tick whose device leg could still fail —
-                        # replay-skip would otherwise drop its outputs
-                        self.scheduler.resolve_barrier()
-                        self.persistence.commit(time_counter)
+                        # resolved-prefix commit watermark: checkpoint
+                        # the longest prefix of ticks whose device legs
+                        # have retired instead of draining the bridge —
+                        # a record can still never cover a tick that
+                        # could fail, but checkpoint cadence no longer
+                        # prices pipelining at effective depth 1
+                        self._commit_watermark_tick(time_counter)
                 time_counter += 1
                 if all_closed and not any_data:
                     # re-drain: a source may have pushed between its drain()
@@ -289,9 +367,21 @@ class StreamingRuntime:
                     # all sources closed: end-of-stream flush tick (a hard
                     # resolve barrier under pipelined execution)
                     self.scheduler.run_time(time_counter, flush=True)
+                    self._last_completed_tick = time_counter
                     if self.persistence is not None:
+                        # end-of-stream keeps its hard barrier (the flush
+                        # tick above) — this full commit seals and
+                        # persists everything, watermark == final tick
                         self.persistence.commit(time_counter)
                     break
+        except BaseException as e:  # noqa: BLE001 — escalation decides
+            # poisoned device leg / exhausted persistence retries /
+            # operator failure: the finally below first commits the last
+            # fully-resolved prefix, then either degrade
+            # (terminate_on_error=False: absorbed, recorded) or terminate
+            # (re-raise to pw.run's caller after a clean teardown)
+            if not self._handle_engine_failure(e):
+                raise
         finally:
             # teardown: stop reader threads FIRST so nothing pushes into a
             # closed pipeline, then join them (a reader that ignores the
@@ -316,6 +406,20 @@ class StreamingRuntime:
             self.monitor.close()
             self.scheduler.close()
             if self.persistence is not None:
+                # final resolved-prefix commit: scheduler.close() drained
+                # the bridge, so the watermark now covers every leg that
+                # retired (a poisoned bridge froze it at the last clean
+                # tick) — stop/crash paths keep exactly the resolved
+                # prefix durable, never a tick that could still fail
+                try:
+                    self._commit_watermark_tick(self._last_completed_tick)
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "final watermark commit failed during teardown; "
+                        "the previous commit's prefix stays durable",
+                        exc_info=True)
                 self.persistence.close()
             if self.http_server is not None:
                 self.http_server.stop()
@@ -328,7 +432,11 @@ class StreamingRuntime:
         # a device leg that failed after the loop's last submit (e.g. the
         # run was stopped externally) was drained-but-not-raised by
         # scheduler.close(): surface it now, exactly as synchronous mode
-        # would have raised it out of run_time
+        # would have raised it out of run_time — unless the degrade path
+        # already absorbed and recorded this exact failure
         deferred = self.scheduler.take_device_error()
-        if deferred is not None:
-            raise deferred
+        if deferred is not None \
+                and deferred is not self._degraded_engine_error:
+            if self.terminate_on_error or not self._handle_engine_failure(
+                    deferred):
+                raise deferred

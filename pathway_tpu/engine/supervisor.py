@@ -225,6 +225,11 @@ class ConnectorSupervisor:
         self.entries: list[_SupervisedSource] = []
         self.fatal_error: BaseException | None = None
         self.commit_stalled = False  # set/cleared by the watchdog
+        # engine-side failure absorbed by the degrade path
+        # (terminate_on_error=False): a poisoned device leg or exhausted
+        # persistence write retries — serving stopped cleanly but the run
+        # must read as degraded, never healthy
+        self.engine_failed = False
         self._stopping = False
         # flight recorder (engine/flight_recorder.py), set by the runtime:
         # stall escalations embed its tail so a ConnectorStalledError
@@ -432,9 +437,10 @@ class ConnectorSupervisor:
 
     def healthy(self) -> bool:
         """The single definition of not-degraded, consumed by /healthz:
-        no escalated fatal, no stalled commit loop, no failed or stalled
-        source."""
+        no escalated fatal, no stalled commit loop, no absorbed engine
+        failure, no failed or stalled source."""
         return (self.fatal_error is None and not self.commit_stalled
+                and not self.engine_failed
                 and not any(e.state == FAILED or e.stalled
                             for e in self.entries))
 
@@ -459,6 +465,10 @@ class Watchdog:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._tick_logged = False
+        # distinct commit-stall breaches over the watchdog's lifetime
+        # (tests assert a legitimately-waiting commit loop never breaches;
+        # commit_stalled alone clears itself on recovery)
+        self.commit_stall_events = 0
 
     def _postmortem(self) -> str:
         """The flight-recorder tail (last ticks + in-flight leg with its
@@ -499,10 +509,24 @@ class Watchdog:
             self.supervisor.commit_stalled = True
             if not self._tick_logged:
                 self._tick_logged = True
+                self.commit_stall_events += 1
+                # the oldest unresolved device leg is the prime suspect:
+                # the commit loop stamps progress on every watermark
+                # advance, so a breach means the frontier itself froze.
+                # bridge_inflight() survives recording-off; the flight
+                # recorder tail (when on) adds the operator + user frame.
+                leg = ""
+                sched = getattr(self.runtime, "scheduler", None)
+                inflight = sched.bridge_inflight() \
+                    if hasattr(sched, "bridge_inflight") else None
+                if inflight is not None:
+                    leg = (f"; oldest unresolved device leg: tick "
+                           f"{inflight['tick']}, in flight for "
+                           f"{inflight['since_s']}s")
                 logger.error(
                     "watchdog: commit loop has not ticked for %.1fs "
                     "(deadline %.1fs) — the scheduler step or a cluster "
-                    "exchange is stuck%s", now - last, deadline,
+                    "exchange is stuck%s%s", now - last, deadline, leg,
                     self._postmortem())
         elif self.supervisor.commit_stalled:
             self.supervisor.commit_stalled = False

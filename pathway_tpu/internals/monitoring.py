@@ -65,6 +65,10 @@ class StatsMonitor:
         self._live = None
         self._rows: list[tuple] = []
         self._t0 = time.monotonic()
+        # persistence driver (engine/persistence.py), set by the runtime:
+        # the durability panel shows the commit watermark trailing the
+        # pipeline before the lag ever becomes a stall
+        self.persistence = None
         # connector supervision state (engine/supervisor.py) rendered as a
         # second panel: per-source lifecycle, restart counts, last error
         self.supervisor = None
@@ -102,6 +106,19 @@ class StatsMonitor:
         # growth events (engine/paged_store.py) — page churn and online
         # growth are visible without scraping /metrics
         self._paged_line = self._paged_panel()
+        # durability line: commit watermark, its lag behind the pipeline
+        # head, and the bridge depth the last commit trailed — a frozen
+        # watermark is visible here before the watchdog fires
+        self._persistence_line = None
+        if self.persistence is not None:
+            pst = self.persistence.stats()
+            self._persistence_line = (
+                f"commit watermark t={pst['watermark']}  "
+                f"lag {pst['lag_ticks']} tick(s)  "
+                f"commits {pst['commits_with_data']}/{pst['commits']}  "
+                f"inflight@commit {pst['inflight_at_commit']}  "
+                f"wait {pst['commit_wait_ms_sum']:.0f}ms  "
+                f"write-retries {pst['write_retries']}")
         # pipelined-execution line: in-flight depth, dispatch-queue wait
         # and overlap ratio straight from the device bridge, so the
         # host/device overlap is observable, not inferred
@@ -152,6 +169,9 @@ class StatsMonitor:
                                height=None))
         if getattr(self, "_bridge_line", None):
             parts.append(Panel(self._bridge_line, title="pipelining",
+                               height=None))
+        if getattr(self, "_persistence_line", None):
+            parts.append(Panel(self._persistence_line, title="durability",
                                height=None))
         if getattr(self, "_paged_line", None):
             parts.append(Panel(self._paged_line, title="paged store",
@@ -263,6 +283,8 @@ class StatsMonitor:
                       file=sys.stderr)
             if getattr(self, "_bridge_line", None):
                 print(f"[monitor] {self._bridge_line}", file=sys.stderr)
+            if getattr(self, "_persistence_line", None):
+                print(f"[monitor] {self._persistence_line}", file=sys.stderr)
             if getattr(self, "_paged_line", None):
                 print(f"[monitor] {self._paged_line}", file=sys.stderr)
             for line in getattr(self, "_serving_lines", None) or ():

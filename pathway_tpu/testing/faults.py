@@ -12,6 +12,19 @@ named failpoints like the reference engine's test-only error hooks):
    failure at an exact, reproducible moment: an fsync that dies
    mid-commit, a torn append, a peer that delays a tick exchange.
 
+   Watermark-durability boundaries (PR 8) each have a point, so the
+   crash/restart sweep can land on every edge of the resolved-prefix
+   commit protocol: ``bridge.leg.exec`` (the device leg itself fails,
+   with N ticks committed and M legs in flight), ``bridge.leg.resolved``
+   (crash between the leg's work retiring and the watermark advancing —
+   work done, durability frontier frozen), ``persistence.commit``
+   (crash between reading the watermark and the durable append),
+   ``persistence.append`` / ``persistence.append.torn`` /
+   ``persistence.fsync`` (inside the append; transient failures here are
+   retried with backoff — arm more failures than
+   ``PATHWAY_PERSISTENCE_WRITE_RETRIES`` to exhaust the budget), and
+   ``persistence.s3.put`` (the object-store upload).
+
 2. **Faulty sources** — ``ConnectorSubject`` doubles with scripted crash
    schedules (:func:`flaky_subject` raises after the Nth entry on the
    first K attempts; :func:`hanging_subject` stops producing while

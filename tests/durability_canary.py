@@ -1,0 +1,222 @@
+"""Durability gate: watermark commits must survive a seeded crash storm.
+
+Drives ``examples/streaming_etl.py``'s real graph under persistence with
+``PATHWAY_DEVICE_INFLIGHT=4`` through a seeded crash/restart loop: each
+round trickles more order files in, arms a RANDOM watermark-boundary
+fault point (``bridge.leg.exec`` / ``bridge.leg.resolved`` /
+``persistence.commit`` / ``persistence.append.torn`` /
+``persistence.fsync``) at a random hit index, and lets the run crash (or
+go quiescent when the point never fires). After the storm, a clean run
+over the same persistence root must produce a consolidated CSV
+**identical** to a synchronous (``PATHWAY_DEVICE_INFLIGHT=1``,
+no-persistence) reference over the full input — exactly-once at every
+seeded crash point.
+
+The final run must also prove the tentpole property: with persistence ON
+the bridge reaches depth > 1 (the old barrier-before-commit pinned it at
+effective depth 1) and trailing watermark commits happened mid-stream.
+
+Exits 0 iff both hold. Run: ``python tests/durability_canary.py``
+(``DURABILITY_SEED`` reruns a specific storm).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import pathlib
+import random
+import sys
+import tempfile
+import threading
+import time
+
+N_ROUNDS = 3
+FILES_PER_ROUND = 3
+ROWS_PER_FILE = 4
+POINTS = ("bridge.leg.exec", "bridge.leg.resolved", "persistence.commit",
+          "persistence.append.torn", "persistence.fsync")
+
+
+def _write_round(orders: pathlib.Path, rnd: int) -> None:
+    for f in range(FILES_PER_ROUND):
+        base = rnd * FILES_PER_ROUND + f
+        rows = [{"item": f"i{(base + i) % 4}", "qty": 1 + (base + i) % 3,
+                 "price": 2.5 * (1 + (base + i) % 5),
+                 "ts": 60 * (base * ROWS_PER_FILE + i)}
+                for i in range(ROWS_PER_FILE)]
+        (orders / f"{base:03d}.jsonl").write_text(
+            "\n".join(json.dumps(r) for r in rows) + "\n")
+
+
+def _write_cats(root: pathlib.Path) -> str:
+    cats = root / "categories.csv"
+    cats.write_text("item,category\n" + "\n".join(
+        f"i{i},cat{i % 2}" for i in range(4)) + "\n")
+    return str(cats)
+
+
+def _consolidate_csv(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    acc: dict[tuple, int] = {}
+    with open(path) as f:
+        reader = csv.reader(f)
+        header = next(reader, None)
+        if header is None:
+            return []
+        t_pos, d_pos = header.index("time"), header.index("diff")
+        for r in reader:
+            key = tuple(v for i, v in enumerate(r)
+                        if i not in (t_pos, d_pos))
+            acc[key] = acc.get(key, 0) + int(r[d_pos])
+    return sorted(k for k, n in acc.items() for _ in range(n) if n > 0)
+
+
+def _run(orders_dir: str, cats_csv: str, out_csv: str, *, inflight: int,
+         pdir: str | None, max_s: float = 25.0):
+    """One run attempt: build the real graph, run on a thread, wait for a
+    crash or sink quiescence, stop. Returns (error, bridge_stats,
+    persistence_stats)."""
+    os.environ["PATHWAY_DEVICE_INFLIGHT"] = str(inflight)
+    import pathway_tpu as pw
+    from examples.streaming_etl import build
+    from pathway_tpu.engine import streaming as _streaming
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    build(orders_dir, cats_csv, out_csv)
+    cfg = None
+    if pdir is not None:
+        cfg = pw.persistence.Config.simple_config(
+            pw.persistence.Backend.filesystem(pdir))
+    err: list[BaseException] = []
+
+    def _target():
+        try:
+            pw.run(persistence_config=cfg, terminate_on_error=True)
+        except BaseException as e:  # noqa: BLE001 — the injected crash
+            err.append(e)
+
+    t = threading.Thread(target=_target, daemon=True)
+    t.start()
+    deadline = time.monotonic() + max_s
+    rt = None
+    while time.monotonic() < deadline and rt is None and t.is_alive():
+        live = list(_streaming._ACTIVE_RUNTIMES)
+        rt = live[0] if live else None
+        time.sleep(0.05)
+    last_size = -1
+    while time.monotonic() < deadline and t.is_alive():
+        size = os.path.getsize(out_csv) if os.path.exists(out_csv) else 0
+        if size > 0 and size == last_size:
+            break  # sink quiescent: the finite feed is fully ingested
+        last_size = size
+        time.sleep(0.3)
+    _streaming.stop_all()
+    t.join(20.0)
+    assert not t.is_alive(), "runtime did not stop"
+    bridge = rt.scheduler.bridge_stats() if rt is not None else None
+    pstats = rt.persistence.stats() \
+        if rt is not None and rt.persistence is not None else None
+    G.clear()
+    return (err[0] if err else None), bridge, pstats
+
+
+def main() -> int:
+    seed = int(os.environ.get("DURABILITY_SEED", "8"))
+    rng = random.Random(seed)
+    from pathway_tpu.testing import faults
+
+    # injected write failures must crash, not be retried away
+    os.environ["PATHWAY_PERSISTENCE_WRITE_RETRIES"] = "0"
+    with tempfile.TemporaryDirectory() as td:
+        root = pathlib.Path(td)
+        orders = root / "orders"
+        orders.mkdir()
+        cats_csv = _write_cats(root)
+        pdir = str(root / "pstate")
+
+        crashes = 0
+        for rnd in range(N_ROUNDS):
+            _write_round(orders, rnd)
+            point = rng.choice(POINTS)
+            k = rng.randint(2, 12)
+            faults.arm_point(point, faults.FailOnHit(k))
+            try:
+                err, _bridge, _p = _run(
+                    str(orders), cats_csv, str(root / f"out_{rnd}.csv"),
+                    inflight=4, pdir=pdir)
+            finally:
+                faults.reset()
+            if err is not None:
+                if not isinstance(err, faults.InjectedFault):
+                    print(f"FAIL: round {rnd} died of an UNINJECTED error: "
+                          f"{type(err).__name__}: {err}", file=sys.stderr)
+                    return 1
+                crashes += 1
+                print(f"round {rnd}: crashed at {point!r} hit {k} "
+                      f"(as injected)")
+            else:
+                print(f"round {rnd}: {point!r} hit {k} never fired "
+                      f"(quiescent run)")
+
+        # final clean recovery run over the full input + durable prefix.
+        # One more round of files lands first, so the recovery run always
+        # has fresh rows to commit (a storm that already made everything
+        # durable would otherwise leave the trailing-commit gate moot).
+        _write_round(orders, N_ROUNDS)
+        final_csv = str(root / "out_final.csv")
+        err, bridge, pstats = _run(str(orders), cats_csv, final_csv,
+                                   inflight=4, pdir=pdir)
+        if err is not None:
+            print(f"FAIL: clean recovery run raised {type(err).__name__}: "
+                  f"{err}", file=sys.stderr)
+            return 1
+        got = _consolidate_csv(final_csv)
+
+        # synchronous no-persistence reference over the same full input
+        err, sync_bridge, _ = _run(str(orders), cats_csv,
+                                   str(root / "out_sync.csv"),
+                                   inflight=1, pdir=None)
+        if err is not None:
+            print(f"FAIL: sync reference raised {type(err).__name__}: "
+                  f"{err}", file=sys.stderr)
+            return 1
+        want = _consolidate_csv(str(root / "out_sync.csv"))
+        if sync_bridge is not None:
+            print(f"FAIL: inflight=1 still built a bridge: {sync_bridge}",
+                  file=sys.stderr)
+            return 1
+        if not want or got != want:
+            print(f"FAIL: recovered CSV != synchronous CSV "
+                  f"({len(got)} vs {len(want)} rows, seed {seed}, "
+                  f"{crashes} crashes)", file=sys.stderr)
+            for row in got[:5]:
+                print(f"  got : {row}", file=sys.stderr)
+            for row in want[:5]:
+                print(f"  want: {row}", file=sys.stderr)
+            return 1
+
+        # tentpole property: persistence no longer collapses the bridge
+        if not bridge or bridge["max_depth"] < 2:
+            print(f"FAIL: bridge never exceeded depth 1 under persistence "
+                  f"(watermark commits are still barriering): {bridge}",
+                  file=sys.stderr)
+            return 1
+        if not pstats or pstats["commits_with_data"] < 1:
+            print(f"FAIL: no trailing watermark commit happened: {pstats}",
+                  file=sys.stderr)
+            return 1
+        print(f"OK: seed {seed}, {crashes}/{N_ROUNDS} rounds crashed; "
+              f"recovered CSV identical to sync run ({len(got)} rows); "
+              f"bridge max depth {bridge['max_depth']} with persistence "
+              f"on; watermark t={pstats['watermark']} over "
+              f"{pstats['commits_with_data']} durable commits")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    sys.exit(main())

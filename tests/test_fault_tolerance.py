@@ -634,3 +634,476 @@ def test_pipelined_watchdog_restart_with_device_leg(monkeypatch):
                                    poll_interval_s=0.05))
     assert state == {"a": 3, "b": 2, "c": 1}
     assert type(subject).attempts == 2
+
+
+# ---------------------------------------------------------------------------
+# watermark durability: resolved-prefix commits (PR 8)
+# ---------------------------------------------------------------------------
+
+def test_bridge_watermark_monotone_and_freezes_on_failure():
+    """The resolved watermark is the tick of the last cleanly-retired leg
+    (FIFO => strictly tick-ordered), and a failed leg freezes it — the
+    failed tick never enters the durable prefix."""
+    from pathway_tpu.engine.device_bridge import DeviceBridge
+
+    bridge = DeviceBridge(max_inflight=4)
+    try:
+        assert bridge.resolved_watermark() == 0
+        bridge.submit(1, lambda: None)
+        bridge.submit(2, lambda: None)
+        bridge.barrier()
+        assert bridge.resolved_watermark() == 2
+
+        def boom():
+            raise RuntimeError("leg failed")
+
+        bridge.submit(3, boom)
+        with pytest.raises(RuntimeError, match="leg failed"):
+            bridge.barrier()
+        assert bridge.resolved_watermark() == 2  # frozen, not advanced
+        assert bridge.stats()["resolved_watermark"] == 2
+    finally:
+        bridge.close()
+
+
+def test_bridge_watermark_advance_fires_listener():
+    """Every advance fires on_advance with the new tick — the hook the
+    runtime stamps watchdog progress through."""
+    from pathway_tpu.engine.device_bridge import DeviceBridge
+
+    bridge = DeviceBridge(max_inflight=4)
+    seen: list[int] = []
+    bridge.on_advance = seen.append
+    try:
+        for t in (1, 2, 3):
+            bridge.submit(t, lambda: None)
+        bridge.barrier()
+        assert seen == [1, 2, 3]
+    finally:
+        bridge.close()
+
+
+def test_watermark_advance_stamps_commit_loop_progress():
+    """The runtime's watermark listener refreshes last_tick_at, so a
+    commit loop blocked behind a full in-flight window reads as
+    progressing while legs keep resolving."""
+    G.clear()
+    t = pw.io.python.read(
+        flaky_subject(_rows(["x"]), fail_after=0, fail_attempts=0),
+        schema=pw.schema_from_types(word=str), autocommit_duration_ms=10,
+        persistent_id="stamp")
+    pw.io.subscribe(t, lambda *a, **k: None)
+    rt = _build_streaming_runtime()
+    stale = rt.last_tick_at - 1000.0
+    rt.last_tick_at = stale
+    rt._on_watermark_advance(7)
+    assert rt.last_tick_at > stale
+    rt.run()  # drain cleanly so the fixture's thread-leak check passes
+
+
+def test_recording_session_seals_partition_pending_prefix():
+    """seal(tick) freezes 'everything pushed so far belongs to this
+    tick's drain'; take_sealed(watermark) removes exactly the prefix
+    under seals <= watermark, leaving later and unsealed entries."""
+    from pathway_tpu.engine.persistence import _RecordingSession
+    from pathway_tpu.io._datasource import Session
+
+    rec = _RecordingSession(Session(), skip=0)
+    rec.push("k1", ("a",), 1)
+    rec.push("k2", ("b",), 1)
+    rec.seal(1)
+    rec.push("k3", ("c",), 1)
+    rec.seal(2)
+    rec.push("k4", ("d",), 1)  # pushed after the last seal
+    assert rec.take_sealed(0) == []
+    assert [e[0] for e in rec.take_sealed(1)] == ["k1", "k2"]
+    assert [e[0] for e in rec.take_sealed(99)] == ["k3"]  # k4 unsealed
+    rec.seal(100)
+    assert [e[0] for e in rec.take_sealed(100)] == ["k4"]
+    assert rec.pending == []
+
+
+def test_commit_records_carry_watermark_tick():
+    """A watermark commit appends exactly the sealed-:math:`\\le`-watermark
+    prefix in a record stamped with the WATERMARK tick, and the stats
+    snapshot reports the lag + bridge depth at commit."""
+    from pathway_tpu.engine.persistence import PersistenceDriver
+    from pathway_tpu.io._datasource import CallbackSource, Session
+
+    backend = pw.persistence.Backend.mock()
+    cfg = pw.persistence.Config.simple_config(backend)
+    driver = PersistenceDriver(cfg)
+    src = CallbackSource(lambda: iter(()), pw.schema_from_types(x=int))
+    src.persistent_id = "wm"
+    rec = driver.attach_source(src, Session())
+    rec.push("k1", (1,), 1)
+    driver.seal(3)
+    rec.push("k2", (2,), 1)
+    driver.seal(4)
+    driver.commit(5, watermark=3, inflight=2)
+    assert backend._mock_store["wm"] == [(3, [("k1", (1,), 1, None)])]
+    st = driver.stats()
+    assert st["watermark"] == 3
+    assert st["lag_ticks"] == 2  # tick 5 committed only up to 3
+    assert st["inflight_at_commit"] == 2
+    assert st["commits"] == 1 and st["commits_with_data"] == 1
+    # restart replays exactly the committed watermark
+    assert PersistenceDriver(cfg).restore_time() == 3
+    # a later commit whose watermark caught up takes the rest
+    driver.commit(6, watermark=6)
+    assert [t for t, _ in backend._mock_store["wm"]] == [3, 6]
+    assert backend._mock_store["wm"][1][1][0][0] == "k2"
+
+
+def _run_counts_slow_device(subject, *, inflight, monkeypatch, backend,
+                            leg_sleep_s=0.05, **run_kwargs):
+    """_run_counts with a device UDF that sleeps per non-empty batch, and
+    the built runtime returned for post-run inspection."""
+    import numpy as np
+
+    monkeypatch.setenv("PATHWAY_DEVICE_INFLIGHT", str(inflight))
+    G.clear()
+
+    @pw.udf(batch=True, device=True, deterministic=True, return_type=int)
+    def dev_len(ws):
+        import jax.numpy as jnp
+
+        time.sleep(leg_sleep_s)
+        arr = jnp.asarray(np.asarray([len(w) for w in ws], np.int32))
+        return [int(v) for v in np.asarray(arr)]
+
+    t = pw.io.python.read(
+        subject, schema=pw.schema_from_types(word=str),
+        autocommit_duration_ms=10, persistent_id="slow-dev")
+    t = t.select(word=t.word, wl=dev_len(t.word))
+    counts = t.groupby(t.word).reduce(word=t.word, c=pw.reducers.count())
+    state: dict[str, int] = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            state[row["word"]] = row["c"]
+        elif state.get(row["word"]) == row["c"]:
+            del state[row["word"]]
+
+    pw.io.subscribe(counts, on_change)
+    rt = _build_streaming_runtime(
+        persistence_config=pw.persistence.Config.simple_config(backend),
+        **run_kwargs)
+    rt.run()
+    return state, rt
+
+
+def test_commit_no_longer_barriers_bridge(monkeypatch):
+    """THE acceptance property of the watermark refactor: with
+    persistence ON, the bridge still reaches depth > 1 (the old
+    barrier-before-commit forced effective depth 1) and trailing commits
+    happen while legs are in flight — checkpoint cadence decoupled from
+    PATHWAY_DEVICE_INFLIGHT."""
+    words = [f"w{i % 3}" for i in range(10)]
+    backend = pw.persistence.Backend.mock()
+    state, rt = _run_counts_slow_device(
+        flaky_subject(_rows(words), fail_after=0, fail_attempts=0,
+                      delay_s=0.01),
+        inflight=4, monkeypatch=monkeypatch, backend=backend)
+    assert state == {"w0": 4, "w1": 3, "w2": 3}
+    stats = rt.scheduler.bridge_stats()
+    assert stats is not None and stats["max_depth"] >= 2, stats
+    pst = rt.persistence.stats()
+    # trailing commits: at least one durable commit happened BEFORE the
+    # end-of-stream flush (which would be the single commit under a
+    # drain-the-bridge design with this pacing)
+    assert pst["commits_with_data"] >= 1
+    assert pst["watermark"] >= 1
+    # and the run is fully durable at the end: a fresh process replays
+    # to the identical state
+    G.clear()
+    replay = _run_counts(flaky_subject(_rows(words), fail_after=0,
+                                       fail_attempts=0), backend=backend,
+                         persistent_id="slow-dev")
+    assert replay == state
+
+
+# every new watermark boundary x in-flight depth; persistence.* points
+# disable write retries so the injected failure actually crashes the run
+_SWEEP_POINTS = ("bridge.leg.exec", "bridge.leg.resolved",
+                 "persistence.commit", "persistence.append.torn",
+                 "persistence.fsync")
+
+
+@pytest.mark.parametrize("inflight", [1, 2, 4])
+@pytest.mark.parametrize("point", _SWEEP_POINTS)
+def test_crash_sweep_byte_identical_exactly_once(point, inflight,
+                                                 monkeypatch, tmp_path):
+    """Crash-at-every-fault-point sweep: a run killed at any watermark
+    boundary, at any in-flight depth, must recover on rerun to output
+    byte-identical to the synchronous no-fault run. (At inflight=1 the
+    bridge.* points never arm — the run completes; the assertion still
+    pins sync equivalence.) Filesystem backend: the persistence.* points
+    live inside the real file log's append."""
+    monkeypatch.setenv("PATHWAY_PERSISTENCE_WRITE_RETRIES", "0")
+    baseline = _run_counts_with_device_leg(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0),
+        inflight=1, monkeypatch=monkeypatch)
+    assert baseline == {"a": 3, "b": 2, "c": 1}
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    # seeded crash position (process-stable, unlike hash()): vary the hit
+    # index per case so the sweep lands on different committed-prefix
+    # lengths
+    k = 1 + (len(point) + inflight) % 3
+    with faults.arm(point, faults.FailOnHit(k)):
+        try:
+            _run_counts_with_device_leg(
+                flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0,
+                              delay_s=0.02),
+                inflight=inflight, monkeypatch=monkeypatch,
+                backend=backend, terminate_on_error=True)
+        except InjectedFault:
+            pass  # the crash: frozen watermark, torn tail, or lost fsync
+    faults.reset()
+    state = _run_counts_with_device_leg(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0),
+        inflight=inflight, monkeypatch=monkeypatch, backend=backend)
+    assert json.dumps(sorted(state.items())).encode() \
+        == json.dumps(sorted(baseline.items())).encode()
+
+
+def test_double_crash_replay_at_watermark_boundary(monkeypatch):
+    """Crash-of-a-recovery at the watermark boundary: two consecutive
+    device-leg crashes (each freezing a different watermark), then a
+    clean run — replay+skip must hold across both committed prefixes."""
+    baseline = _run_counts_with_device_leg(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0),
+        inflight=1, monkeypatch=monkeypatch)
+    backend = pw.persistence.Backend.mock()
+    for k in (2, 3):  # second crash strictly later in the leg sequence
+        with faults.arm("bridge.leg.exec", faults.FailOnHit(k)):
+            try:
+                _run_counts_with_device_leg(
+                    flaky_subject(_rows(WORDS), fail_after=0,
+                                  fail_attempts=0, delay_s=0.02),
+                    inflight=4, monkeypatch=monkeypatch, backend=backend,
+                    terminate_on_error=True)
+            except InjectedFault:
+                pass
+        faults.reset()
+    state = _run_counts_with_device_leg(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0),
+        inflight=4, monkeypatch=monkeypatch, backend=backend)
+    assert json.dumps(sorted(state.items())).encode() \
+        == json.dumps(sorted(baseline.items())).encode()
+
+
+def test_restart_after_poisoned_bridge_resumes_from_watermark(monkeypatch):
+    """A poisoned bridge freezes the watermark; the teardown path still
+    commits the resolved prefix, and the restart replays it (restore
+    time == frozen watermark) instead of starting from zero."""
+    backend = pw.persistence.Backend.mock()
+
+    class _PoisonAfterFirstCommit:
+        """Fail the first device leg dispatched after a durable record
+        exists — deterministic 'N committed + M in flight' shape without
+        racing tick pacing."""
+
+        def __call__(self, point, ctx):
+            if backend._mock_store.get("pipelined-words"):
+                raise InjectedFault(f"poison at {point!r} after commit")
+
+    with faults.arm("bridge.leg.exec", _PoisonAfterFirstCommit()):
+        with pytest.raises(InjectedFault):
+            _run_counts_with_device_leg(
+                flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0,
+                              delay_s=0.03),
+                inflight=4, monkeypatch=monkeypatch, backend=backend,
+                terminate_on_error=True)
+    faults.reset()
+    # the resolved prefix was committed before escalation
+    committed = backend._mock_store.get("pipelined-words", [])
+    assert committed, "poisoned run committed no resolved prefix"
+    from pathway_tpu.engine.persistence import PersistenceDriver
+
+    frozen = PersistenceDriver(
+        pw.persistence.Config.simple_config(backend)).restore_time()
+    assert frozen >= 1
+    assert all(t <= frozen for t, _ in committed)
+    state = _run_counts_with_device_leg(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0),
+        inflight=4, monkeypatch=monkeypatch, backend=backend)
+    assert state == {"a": 3, "b": 2, "c": 1}
+
+
+def test_device_leg_failure_degrades_when_not_terminating(monkeypatch):
+    """terminate_on_error=False on a device-leg failure: the run absorbs
+    the poison after committing the resolved prefix — recorded in the
+    ErrorLog (kind='engine'), flagged on the supervisor (healthz reads
+    degraded), never laundered into a clean healthy shutdown."""
+    import numpy as np  # noqa: F401 — device UDF path
+
+    monkeypatch.setenv("PATHWAY_DEVICE_INFLIGHT", "2")
+    G.clear()
+
+    @pw.udf(batch=True, device=True, deterministic=True, return_type=int)
+    def dev_len(ws):
+        return [len(w) for w in ws]
+
+    t = pw.io.python.read(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0,
+                      delay_s=0.02),
+        schema=pw.schema_from_types(word=str), autocommit_duration_ms=10,
+        persistent_id="degrade")
+    t = t.select(word=t.word, wl=dev_len(t.word))
+    pw.io.subscribe(t, lambda *a, **k: None)
+    backend = pw.persistence.Backend.mock()
+    rt = _build_streaming_runtime(
+        terminate_on_error=False,
+        persistence_config=pw.persistence.Config.simple_config(backend))
+    n_before = len([e for e in pw.global_error_log().entries
+                    if e["kind"] == "engine"])
+    with faults.arm("bridge.leg.exec", faults.FailOnHit(2)):
+        rt.run()  # absorbed: no raise
+    faults.reset()
+    assert rt.supervisor.engine_failed
+    assert not rt.supervisor.healthy()
+    from pathway_tpu.engine.http_server import MonitoringHttpServer
+
+    healthy, body = MonitoringHttpServer(rt, port=0).healthz_payload()
+    assert not healthy and body["engine_failed"]
+    engine_entries = [e for e in pw.global_error_log().entries
+                      if e["kind"] == "engine"][n_before:]
+    assert any("device leg" in e["message"] for e in engine_entries)
+
+
+def test_transient_fsync_failure_retried_run_completes(tmp_path,
+                                                       monkeypatch):
+    """A transient fsync failure is retried with backoff instead of
+    killing the run; the output and the durable log are intact."""
+    monkeypatch.setenv("PATHWAY_PERSISTENCE_RETRY_INITIAL_MS", "1")
+    from pathway_tpu.engine.persistence import write_retries_total
+
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    before = write_retries_total()
+    with faults.arm("persistence.fsync", faults.FailNTimes(2)):
+        state = _run_counts(flaky_subject(_rows(WORDS), fail_after=0,
+                                          fail_attempts=0),
+                            backend=backend)
+    faults.reset()
+    assert state == {"a": 3, "b": 2, "c": 1}
+    assert write_retries_total() - before >= 2
+    replay = _run_counts(flaky_subject(_rows(WORDS), fail_after=0,
+                                       fail_attempts=0), backend=backend)
+    assert replay == state
+
+
+def test_transient_torn_append_retried_repairs_tail(tmp_path, monkeypatch):
+    """A torn append (header written, payload lost) that is retried must
+    truncate the torn bytes first — the log stays fully readable and the
+    restart replays exactly-once."""
+    monkeypatch.setenv("PATHWAY_PERSISTENCE_RETRY_INITIAL_MS", "1")
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    with faults.arm("persistence.append.torn", faults.FailNTimes(1)):
+        state = _run_counts(flaky_subject(_rows(WORDS), fail_after=0,
+                                          fail_attempts=0),
+                            backend=backend)
+    faults.reset()
+    assert state == {"a": 3, "b": 2, "c": 1}
+    from pathway_tpu.engine.persistence import SnapshotLog
+
+    path = str(tmp_path / "pstate" / "streams" / "words.snap")
+    records = SnapshotLog(path).read_all()
+    assert sum(len(e) for _t, e in records) == len(WORDS)
+    replay = _run_counts(flaky_subject(_rows(WORDS), fail_after=0,
+                                       fail_attempts=0), backend=backend)
+    assert replay == state
+
+
+def test_persistence_retry_exhaustion_escalates(monkeypatch, tmp_path):
+    """Write retries exhausted escalate per terminate_on_error=True: the
+    backend's own exception reaches pw.run's caller."""
+    monkeypatch.setenv("PATHWAY_PERSISTENCE_WRITE_RETRIES", "1")
+    monkeypatch.setenv("PATHWAY_PERSISTENCE_RETRY_INITIAL_MS", "1")
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    with faults.arm("persistence.fsync", faults.FailNTimes(50)):
+        with pytest.raises(InjectedFault):
+            _run_counts(flaky_subject(_rows(WORDS), fail_after=0,
+                                      fail_attempts=0, delay_s=0.02),
+                        backend=backend, terminate_on_error=True)
+    faults.reset()
+
+
+def test_persistence_retry_exhaustion_degrades_when_not_terminating(
+        monkeypatch, tmp_path):
+    """...and per terminate_on_error=False: absorbed, recorded in the
+    ErrorLog, run ends cleanly."""
+    monkeypatch.setenv("PATHWAY_PERSISTENCE_WRITE_RETRIES", "0")
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    n_before = len([e for e in pw.global_error_log().entries
+                    if e["kind"] == "engine"])
+    with faults.arm("persistence.fsync", faults.FailNTimes(50)):
+        _run_counts(flaky_subject(_rows(WORDS), fail_after=0,
+                                  fail_attempts=0, delay_s=0.02),
+                    backend=backend, terminate_on_error=False)
+    faults.reset()
+    engine_entries = [e for e in pw.global_error_log().entries
+                      if e["kind"] == "engine"][n_before:]
+    assert engine_entries, "exhausted retries left no ErrorLog entry"
+
+
+def test_commit_stall_postmortem_names_oldest_leg(caplog):
+    """A genuine commit-loop breach names the oldest unresolved device
+    leg (tick + seconds in flight) — bridge_inflight() survives
+    recording-off, so the attribution never depends on the recorder."""
+    import logging
+    from types import SimpleNamespace
+
+    from pathway_tpu.engine.device_bridge import DeviceBridge
+    from pathway_tpu.engine.supervisor import (ConnectorSupervisor,
+                                               Watchdog, WatchdogConfig)
+
+    bridge = DeviceBridge(max_inflight=2)
+    release = threading.Event()
+    bridge.submit(7, release.wait)
+    try:
+        deadline = time.monotonic() + 5
+        while bridge.inflight() is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert bridge.inflight() is not None
+
+        class _Sched:
+            recorder = None
+
+            @staticmethod
+            def bridge_inflight():
+                return bridge.inflight()
+
+        runtime = SimpleNamespace(scheduler=_Sched(),
+                                  last_tick_at=time.monotonic() - 100.0)
+        sup = ConnectorSupervisor()
+        wd = Watchdog(runtime, sup, WatchdogConfig(tick_deadline_s=1.0))
+        with caplog.at_level(logging.ERROR,
+                             logger="pathway_tpu.engine.supervisor"):
+            wd._check_commit_loop(time.monotonic())
+        assert sup.commit_stalled
+        assert wd.commit_stall_events == 1
+        assert "oldest unresolved device leg: tick 7" in caplog.text
+    finally:
+        release.set()
+        bridge.close()
+
+
+def test_slow_but_advancing_watermark_never_trips_watchdog(monkeypatch):
+    """A commit loop waiting on a full in-flight window of slow-but-
+    advancing device legs (including the end-of-stream barrier over the
+    queued backlog) stays under the tick deadline because every resolved
+    leg stamps progress — zero commit-stall breaches."""
+    words = [f"w{i % 3}" for i in range(10)]
+    backend = pw.persistence.Backend.mock()
+    state, rt = _run_counts_slow_device(
+        flaky_subject(_rows(words), fail_after=0, fail_attempts=0,
+                      delay_s=0.015),
+        inflight=4, monkeypatch=monkeypatch, backend=backend,
+        leg_sleep_s=0.15,
+        watchdog=pw.WatchdogConfig(tick_deadline_s=1.0,
+                                   reader_stall_timeout_s=None,
+                                   poll_interval_s=0.05))
+    assert state == {"w0": 4, "w1": 3, "w2": 3}
+    assert rt.watchdog.commit_stall_events == 0
+    assert not rt.supervisor.commit_stalled

@@ -309,3 +309,52 @@ def test_engine_error_carries_user_trace():
     assert "in operator" in notes
     assert "test_monitoring_http.py" in notes
     assert "flatten" in notes
+
+
+def test_persistence_watermark_metrics_exposed():
+    """Commit-watermark durability families (PR 8): lag gauge, inflight
+    at commit, commit counters, write retries, and the commit-wait
+    histogram — all lint-clean with monotone cumulative buckets."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.http_server import MonitoringHttpServer
+    from pathway_tpu.engine.persistence import PersistenceDriver
+    from pathway_tpu.io._datasource import CallbackSource, Session
+
+    rt = _FakeRuntime()
+    backend = pw.persistence.Backend.mock()
+    driver = PersistenceDriver(pw.persistence.Config.simple_config(backend))
+    src = CallbackSource(lambda: iter(()), pw.schema_from_types(x=int))
+    src.persistent_id = "m"
+    rec = driver.attach_source(src, Session())
+    rec.push("k", (1,), 1)
+    driver.seal(4)
+    driver.commit(6, watermark=4, inflight=3)
+    rt.persistence = driver
+
+    lines = _metrics_lines(rt)
+    samples = {f: v for f, _l, v in _parse_samples(lines)}
+    assert samples["pathway_tpu_commit_watermark"] == 4
+    assert samples["pathway_tpu_commit_watermark_lag_ticks"] == 2
+    assert samples["pathway_tpu_device_inflight_at_commit"] == 3
+    assert samples["pathway_tpu_persistence_commits"] == 1
+    assert samples["pathway_tpu_persistence_entries_committed"] == 1
+    assert "pathway_tpu_persistence_write_retries" in samples
+    assert samples["pathway_tpu_commit_wait_ms_count"] == 1
+    # histogram: cumulative bucket counts are monotone and end at count
+    buckets = [(l, v) for f, l, v in _parse_samples(lines)
+               if f == "pathway_tpu_commit_wait_ms_bucket"]
+    values = [v for _l, v in buckets]
+    assert values == sorted(values)
+    assert buckets[-1][0]["le"] == "+Inf"
+    assert values[-1] == samples["pathway_tpu_commit_wait_ms_count"]
+    # every family is TYPE-declared (same lint as the rest of the suite)
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    for fam in ("pathway_tpu_commit_watermark_lag_ticks",
+                "pathway_tpu_commit_wait_ms",
+                "pathway_tpu_device_inflight_at_commit",
+                "pathway_tpu_persistence_write_retries"):
+        assert fam in typed
+    # /status carries the same snapshot
+    status = MonitoringHttpServer(rt, port=0).status_payload()
+    assert status["persistence"]["watermark"] == 4
+    assert status["persistence"]["lag_ticks"] == 2
