@@ -145,8 +145,13 @@ replay = _apply(_spawn_opts, replay)
               help="emit machine-readable diagnostics (code, severity, "
                    "file, line, message) on stdout for CI annotation; "
                    "exit-code semantics unchanged")
+@click.option("--concurrency", "concurrency", is_flag=True,
+              help="run the PWT2xx concurrency lint instead: an AST pass "
+                   "over the given source files/directories (thread "
+                   "inventory, lock inventory, lock-order graph) — "
+                   "nothing is imported or executed")
 @click.argument("paths", nargs=-1, required=True)
-def check(paths, strict, require_pipeline, tpu_mesh, as_json):
+def check(paths, strict, require_pipeline, tpu_mesh, as_json, concurrency):
     """Statically analyze pipeline scripts without running them.
 
     Imports each script (or every ``*.py`` under a directory) with
@@ -157,12 +162,26 @@ def check(paths, strict, require_pipeline, tpu_mesh, as_json):
     "no pipeline collected"; an error under ``--require-pipeline``) — add
     an ``if __name__ == "__pathway_check__":`` branch building the graph
     with placeholder inputs to have it checked. Exits nonzero on any
-    error-severity diagnostic."""
+    error-severity diagnostic.
+
+    With ``--concurrency`` the paths are treated as SOURCE trees instead:
+    the PWT2xx concurrency lint (thread inventory, lock inventory,
+    lock-order graph — internals/static_check/concurrency_check.py) runs
+    over them without importing anything; ``--json`` adds the inventories
+    to the payload."""
     import json as _json
     import pathlib
 
     from pathway_tpu.internals.static_check import (Severity,
                                                     parse_mesh_spec)
+
+    if concurrency:
+        if tpu_mesh is not None or require_pipeline:
+            raise click.UsageError(
+                "--concurrency analyzes source files; it does not "
+                "compose with --tpu-mesh/--require-pipeline")
+        _check_concurrency_cli(paths, strict=strict, as_json=as_json)
+        return
 
     mesh = None
     if tpu_mesh is not None:
@@ -217,6 +236,45 @@ def check(paths, strict, require_pipeline, tpu_mesh, as_json):
         click.echo(_json.dumps(json_out, indent=2))
     if n_errors:
         click.echo(f"static check failed: {n_errors} blocking "
+                   f"diagnostic(s)", err=True)
+        sys.exit(1)
+
+
+def _check_concurrency_cli(paths, *, strict: bool, as_json: bool) -> None:
+    """``check --concurrency``: the PWT2xx source-level lint. Exit-code
+    semantics mirror the pipeline check — nonzero on any error-severity
+    diagnostic (warnings too under ``--strict``). ``--json`` emits the
+    diagnostics plus the thread/lock inventory for CI artifacts."""
+    import json as _json
+
+    from pathway_tpu.internals.static_check import (Severity,
+                                                    check_concurrency,
+                                                    concurrency_inventory)
+    from pathway_tpu.internals.static_check.concurrency_check import \
+        build_corpus
+
+    try:
+        corpus = build_corpus(paths)  # one parse serves check + inventory
+        diagnostics = check_concurrency(paths, corpus=corpus)
+    except ValueError as e:
+        raise click.UsageError(str(e))
+    bad = [d for d in diagnostics
+           if d.severity is Severity.ERROR
+           or (strict and d.severity is Severity.WARNING)]
+    if as_json:
+        payload = {
+            "diagnostics": [d.to_dict() for d in diagnostics],
+            "inventory": concurrency_inventory(paths, corpus=corpus),
+        }
+        click.echo(_json.dumps(payload, indent=2))
+    else:
+        for d in diagnostics:
+            click.echo(str(d))
+    status = "FAIL" if bad else "ok"
+    click.echo(f"[{status}] concurrency check over {', '.join(paths)} — "
+               f"{len(diagnostics)} diagnostic(s)", err=True)
+    if bad:
+        click.echo(f"concurrency check failed: {len(bad)} blocking "
                    f"diagnostic(s)", err=True)
         sys.exit(1)
 

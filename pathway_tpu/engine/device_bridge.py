@@ -102,7 +102,9 @@ class DeviceBridge:
         # as a commit stall
         self.on_advance: Callable[[int], None] | None = None
         _LIVE.add(self)
-        self._cv = threading.Condition()
+        from pathway_tpu.engine.locking import create_condition
+
+        self._cv = create_condition("DeviceBridge._cv")
         self._queue: deque = deque()  # (tick, fn, submitted_at)
         self._running = False
         self._error: BaseException | None = None
@@ -130,14 +132,19 @@ class DeviceBridge:
         Raises the stored leg exception, if any — the host thread is the
         one that must observe device failures.
         """
+        from pathway_tpu.engine.locking import assert_unlocked
+
+        # submit blocks behind a full in-flight window: entering with an
+        # engine lock held would stall every contender on a slow device
+        assert_unlocked("DeviceBridge.submit")
         with self._cv:
             self._raise_if_error()
             if self._closed:
                 raise RuntimeError("device bridge is closed")
             if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._work, daemon=True, name=self.name)
-                self._thread.start()
+                from pathway_tpu.engine.threads import spawn
+
+                self._thread = spawn(self._work, name=self.name)
             while (len(self._queue) + (1 if self._running else 0)
                    >= self.max_inflight):
                 self._waiters += 1
@@ -157,6 +164,9 @@ class DeviceBridge:
         """Block until every submitted leg has resolved; re-raise a leg
         failure. This is the hard consistency point before commits,
         flushes and output reads."""
+        from pathway_tpu.engine.locking import assert_unlocked
+
+        assert_unlocked("DeviceBridge.barrier")
         with self._cv:
             while (self._queue or self._running) and self._error is None:
                 self._waiters += 1

@@ -150,7 +150,9 @@ class FlightRecorder:
 
             buffer_events = max(256, _env_int("PATHWAY_TRACE_BUFFER_EVENTS",
                                               _DEFAULT_BUFFER_EVENTS))
-        self._lock = threading.Lock()
+        from pathway_tpu.engine.locking import create_lock
+
+        self._lock = create_lock("FlightRecorder._lock")
         # (tick, op_id, leg, t0_perf, dur_ms, rows_in, rows_out)
         self._events: collections.deque = collections.deque(
             maxlen=buffer_events)

@@ -64,14 +64,14 @@ class CapturedStream:
     """
 
     def __init__(self):
-        import threading
+        from pathway_tpu.engine.locking import create_lock
 
         self._chunks: list[tuple[int, list]] = []
         self._events: list[tuple] = []  # flattened (key, row, time, diff)
         # guards the chunk buffer: pool-thread replicas share this capture,
         # and an unsynchronized detach could orphan a concurrent append
         # (one lock operation per TICK, not per row — off the hot path)
-        self._lock = threading.Lock()
+        self._lock = create_lock("CapturedStream._lock")
 
     @property
     def events(self) -> list[tuple]:
@@ -168,9 +168,9 @@ class Scheduler:
             from concurrent.futures import ThreadPoolExecutor
 
             self._pool = ThreadPoolExecutor(max_workers=self._local_n)
-        import threading
+        from pathway_tpu.engine.locking import create_lock
 
-        self._stats_lock = threading.Lock()
+        self._stats_lock = create_lock("Scheduler._stats_lock")
         # value -> worker memo per exchanged edge; bounded so
         # high-cardinality instance columns (user ids, session keys) do not
         # leak over a long streaming run: at the cap the edge's memo is

@@ -120,14 +120,21 @@ class MonitoringHttpServer:
         source is live and the commit loop ticks; 503 with a body naming
         failed/stalled sources and retry counts once degraded (contract in
         README "Fault tolerance")."""
+        from pathway_tpu.engine.threads import crashed_threads
+
         sup = getattr(self.runtime, "supervisor", None)
         failed: list[dict] = []
         stalled: list[str] = []
         retries: dict[str, int] = {}
         commit_stalled = False
-        healthy = True
+        crashes = crashed_threads()
+        # with a supervisor, its predicate owns the health definition
+        # (it already folds in crashed threads scoped to its run);
+        # without one (standalone monitoring), a crashed engine thread
+        # must still flip the status — body and code may never disagree
+        healthy = not crashes
         if sup is not None:
-            healthy = sup.healthy()  # the supervisor owns the predicate
+            healthy = sup.healthy()
             commit_stalled = sup.commit_stalled
             for s in sup.summary():
                 retries[s["source"]] = s["restarts"]
@@ -144,6 +151,9 @@ class MonitoringHttpServer:
             "commit_loop_stalled": commit_stalled,
             "engine_failed": bool(sup is not None
                                   and getattr(sup, "engine_failed", False)),
+            # engine threads dead of an uncaught exception (excepthook in
+            # engine/threads.py) — non-empty degrades the run
+            "crashed_threads": crashes,
             "connector_retries": retries,
         }
 
@@ -425,9 +435,9 @@ class MonitoringHttpServer:
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="pathway-tpu-http")
-        self._thread.start()
+        from pathway_tpu.engine.threads import spawn
+
+        self._thread = spawn(self._httpd.serve_forever, name="http")
 
     def stop(self) -> None:
         if self._httpd is not None:

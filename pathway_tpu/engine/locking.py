@@ -1,0 +1,381 @@
+"""Engine lock factories + the runtime lock-order sanitizer.
+
+The reference engine gets thread-safety from Rust ownership; this Python
+reproduction runs ~10 long-lived threads (device-bridge worker, supervisor
+reader threads, watchdog, HTTP monitoring server, multiproc sender/acceptor)
+sharing engine state behind ``threading`` primitives. Two layers keep that
+honest:
+
+1. **Static** — the PWT2xx concurrency checker
+   (internals/static_check/concurrency_check.py) builds a lock inventory and
+   a lock-order graph from the source and flags inversions, unguarded
+   cross-thread writes, and locks held across blocking calls before they
+   become flaky CI failures.
+2. **Dynamic** — this module. Every engine lock is created through
+   :func:`create_lock` / :func:`create_rlock` / :func:`create_condition`
+   (never bare ``threading.Lock()``; the checker flags raw constructions).
+   By default the factories return the plain ``threading`` primitive — zero
+   overhead. With ``PATHWAY_LOCK_SANITIZER=1`` they return sanitized
+   wrappers that record per-thread held-sets, maintain the global lock
+   acquisition-order graph, and **assert it stays acyclic**: the first
+   acquisition that would create a cycle (the schedule that can deadlock,
+   even if this interleaving did not) raises :class:`LockOrderViolation`
+   with both acquisition stacks. ``PATHWAY_LOCK_SANITIZER=report`` logs and
+   records instead of raising (:func:`violations` returns the findings).
+
+Known-blocking regions — fsync, cluster socket sends, device-bridge
+submit/barrier waits — are marked with :func:`blocking_call`; entering one
+while holding any sanitized lock reports a held-across-blocking violation
+(PWT203's runtime counterpart). ``Condition.wait`` releases its own lock
+but blocks while keeping every *other* held lock — the sanitized condition
+treats the wait as an implicit blocking region for those.
+
+Lock *names* establish identity in the order graph, so name them by
+owner: ``"FlightRecorder._lock"``, ``"DeviceBridge._cv"``. Per-instance
+locks of one class share a name deliberately — the order discipline is a
+class-level contract, so ``A._x`` nested inside ``B._y`` on one instance
+pair and the reverse on another is still detected as a cycle. The known
+blind spot of name-level identity: nesting the SAME name (instance 1's
+``A._x`` inside instance 2's ``A._x``) records no edge — an instance-
+order discipline (e.g. acquire in ``id()`` order) is the caller's
+responsibility there, and the engine avoids the pattern entirely (no
+code path acquires two instances of one class).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import traceback
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "LockOrderViolation", "HeldAcrossBlockingViolation", "assert_unlocked",
+    "blocking_call", "create_condition", "create_lock", "create_rlock",
+    "held_locks", "sanitizer_enabled", "violations",
+]
+
+
+def sanitizer_enabled() -> bool:
+    """Truthy ``PATHWAY_LOCK_SANITIZER`` arms the sanitized factories.
+    Checked at lock CREATION time: a run toggles the sanitizer by env, not
+    per lock, and the disabled path stays a plain ``threading`` primitive
+    with zero wrapper overhead."""
+    return os.environ.get("PATHWAY_LOCK_SANITIZER", "").strip().lower() in (
+        "1", "true", "on", "yes", "report", "warn")
+
+
+def _raise_on_violation() -> bool:
+    return os.environ.get("PATHWAY_LOCK_SANITIZER", "").strip().lower() \
+        not in ("report", "warn")
+
+
+class LockOrderViolation(RuntimeError):
+    """Acquiring this lock here creates a cycle in the global lock
+    acquisition-order graph — some interleaving of the involved threads
+    deadlocks, even if this run did not."""
+
+
+class HeldAcrossBlockingViolation(RuntimeError):
+    """A known-blocking call (fsync, socket send, bridge submit, condition
+    wait) was entered while holding an engine lock: every other thread
+    needing that lock now waits out the blocking call too."""
+
+
+class _SanitizerState:
+    """Process-wide sanitizer bookkeeping. One instance per process; tests
+    swap in a fresh one via :func:`_reset_for_tests` so the order graph of
+    one test cannot poison the next."""
+
+    def __init__(self):
+        # guards the order graph + violation list (a plain lock: the
+        # sanitizer must not sanitize itself)
+        self.mutex = threading.Lock()
+        # (held_name, acquired_name) -> short stack of first establishment
+        self.edges: dict[tuple[str, str], str] = {}
+        self.violation_log: list[dict] = []
+        self.tls = threading.local()
+
+    def held_stack(self) -> list:
+        stack = getattr(self.tls, "stack", None)
+        if stack is None:
+            stack = self.tls.stack = []
+        return stack
+
+
+_STATE = _SanitizerState()
+
+
+def _reset_for_tests() -> None:
+    """Fresh order graph + violation list (unit tests only)."""
+    global _STATE
+    _STATE = _SanitizerState()
+
+
+def _short_stack(skip: int = 3, limit: int = 6) -> str:
+    return "".join(traceback.format_stack()[-(limit + skip):-skip]) or ""
+
+
+def _has_path(edges: dict, src: str, dst: str) -> bool:
+    """Reachability src -> dst in the order graph (iterative DFS)."""
+    stack = [src]
+    seen = set()
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(b for (a, b) in edges if a == n)
+    return False
+
+
+def _record_violation(kind: str, message: str,
+                      exc_type: type[RuntimeError]) -> None:
+    with _STATE.mutex:
+        _STATE.violation_log.append(
+            {"kind": kind, "message": message, "stack": _short_stack()})
+    if _raise_on_violation():
+        raise exc_type(message)
+    logger.error("lock sanitizer: %s", message)
+
+
+def violations() -> list[dict]:
+    """Violations recorded so far (raise mode records before raising, so
+    post-mortems and tests can read the full list either way)."""
+    with _STATE.mutex:
+        return list(_STATE.violation_log)
+
+
+def held_locks() -> list[str]:
+    """Names of sanitized locks the CALLING thread holds, outermost
+    first (empty when the sanitizer is off)."""
+    return [w.name for w in _STATE.held_stack()]
+
+
+class _SanitizedBase:
+    """Held-set + order-graph bookkeeping shared by lock and condition
+    wrappers. Reentrant holds (RLock, Condition re-entry) push one stack
+    entry per acquisition but add no order edges past the first."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    # -- bookkeeping -------------------------------------------------------
+    def _on_acquired(self) -> str | None:
+        """Record the acquisition; returns an inversion message (without
+        raising — the caller must first put the inner lock back) when this
+        acquisition would close a cycle in the order graph."""
+        stack = _STATE.held_stack()
+        if any(w is self for w in stack):
+            stack.append(self)  # reentrant: no new edges
+            return None
+        holders = [w for w in stack if w.name != self.name]
+        msg = None
+        with _STATE.mutex:
+            for held in holders:
+                edge = (held.name, self.name)
+                if edge in _STATE.edges:
+                    continue
+                if msg is None and _has_path(_STATE.edges, self.name,
+                                             held.name):
+                    prior = _STATE.edges.get((self.name, held.name))
+                    where = (f"\norder {self.name} -> {held.name} "
+                             f"established at:\n{prior}" if prior else "")
+                    msg = (
+                        f"lock-order inversion: acquiring {self.name!r} "
+                        f"while holding {held.name!r}, but the established "
+                        f"global order already requires {self.name!r} "
+                        f"before {held.name!r} — this schedule can "
+                        f"deadlock.{where}")
+                # record the edge either way, so every further acquisition
+                # through an inverted site reports once, not per call
+                _STATE.edges[edge] = _short_stack()
+        stack.append(self)
+        return msg
+
+    def _on_released(self) -> None:
+        stack = _STATE.held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                return
+
+    def _check_blocking(self, what: str) -> None:
+        held = [w.name for w in _STATE.held_stack() if w is not self]
+        if held:
+            _record_violation(
+                "held-across-blocking",
+                f"blocking call {what!r} entered while holding engine "
+                f"lock(s) {held}: every thread contending on them now "
+                f"waits out the blocking call (PWT203)",
+                HeldAcrossBlockingViolation)
+
+    def _fail_acquire(self, msg: str, release) -> None:
+        # in raise mode the caller never enters its critical section, so
+        # the physical lock must be put back BEFORE raising — otherwise
+        # the violation wedges every other thread on this lock
+        if _raise_on_violation():
+            self._on_released()
+            release()
+        _record_violation("lock-order", msg, LockOrderViolation)
+
+
+class _SanitizedLock(_SanitizedBase):
+    def __init__(self, name: str, inner=None):
+        super().__init__(name)
+        self._inner = inner if inner is not None else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            msg = self._on_acquired()
+            if msg is not None:
+                self._fail_acquire(msg, self._inner.release)
+        return got
+
+    def release(self) -> None:
+        self._on_released()
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<SanitizedLock {self.name!r} {self._inner!r}>"
+
+
+class _SanitizedRLock(_SanitizedLock):
+    def __init__(self, name: str):
+        super().__init__(name, threading.RLock())
+
+    def locked(self) -> bool:  # RLock has no locked() before 3.12
+        if self._inner.acquire(blocking=False):
+            self._inner.release()
+            return False
+        return True
+
+
+class _SanitizedCondition(_SanitizedBase):
+    """Condition wrapper: the underlying ``threading.Condition`` owns a
+    plain inner lock (wait/notify need the real acquire-release protocol);
+    this wrapper maintains the held-set and order-graph around it, and
+    treats ``wait`` as a blocking region for every OTHER held lock."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._cond = threading.Condition()
+
+    def acquire(self, *args) -> bool:
+        got = self._cond.acquire(*args)
+        if got:
+            msg = self._on_acquired()
+            if msg is not None:
+                self._fail_acquire(msg, self._cond.release)
+        return got
+
+    def release(self) -> None:
+        self._on_released()
+        self._cond.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout: float | None = None) -> bool:
+        # wait releases this condition's lock but blocks while every other
+        # held lock stays held — exactly the held-across-blocking hazard
+        self._check_blocking(f"{self.name}.wait")
+        self._on_released()
+        try:
+            # pwt-ok: PWT205 — delegation; the predicate loop is the
+            # caller's obligation (and ITS wait is what PWT205 checks)
+            return self._cond.wait(timeout)
+        finally:
+            self._on_acquired()
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        self._check_blocking(f"{self.name}.wait_for")
+        self._on_released()
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            self._on_acquired()
+
+    def notify(self, n: int = 1) -> None:
+        # pwt-ok: PWT208 — delegation; the caller's `with cond:` holds
+        # the underlying lock when this runs
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        # pwt-ok: PWT208 — delegation (see notify)
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<SanitizedCondition {self.name!r}>"
+
+
+# ---------------------------------------------------------------------------
+# factories — the only way engine code creates locks
+# ---------------------------------------------------------------------------
+
+def create_lock(name: str):
+    """A mutex for engine state. Plain ``threading.Lock`` normally; the
+    sanitized wrapper under ``PATHWAY_LOCK_SANITIZER``."""
+    if sanitizer_enabled():
+        return _SanitizedLock(name)
+    return threading.Lock()
+
+
+def create_rlock(name: str):
+    if sanitizer_enabled():
+        return _SanitizedRLock(name)
+    return threading.RLock()
+
+
+def create_condition(name: str):
+    if sanitizer_enabled():
+        return _SanitizedCondition(name)
+    return threading.Condition()
+
+
+def assert_unlocked(what: str) -> None:
+    """The held-across-blocking check alone: under the sanitizer, report
+    a violation if the calling thread holds any engine lock on the brink
+    of the known-blocking call ``what``. Free when the sanitizer is off
+    (one env-flag branch)."""
+    if sanitizer_enabled():
+        held = held_locks()
+        if held:
+            _record_violation(
+                "held-across-blocking",
+                f"blocking call {what!r} entered while holding engine "
+                f"lock(s) {held}: every thread contending on them now "
+                f"waits out the blocking call (PWT203)",
+                HeldAcrossBlockingViolation)
+
+
+@contextlib.contextmanager
+def blocking_call(what: str):
+    """Mark a known-blocking region (fsync, socket send/recv, bridge
+    submit wait, jax dispatch). Under the sanitizer, entering with any
+    engine lock held reports a held-across-blocking violation naming the
+    locks; otherwise free (one truthiness branch)."""
+    assert_unlocked(what)
+    yield

@@ -19,14 +19,19 @@ mapping).
 
 from __future__ import annotations
 
+import errno
 import logging
 import os
 import pickle
-import threading
+import selectors
+import socket
 import time
-from multiprocessing.connection import Client, Connection, Listener
+from multiprocessing.connection import (Connection, Listener,
+                                        answer_challenge, deliver_challenge)
 from typing import Any
 
+from pathway_tpu.engine.locking import assert_unlocked
+from pathway_tpu.engine.threads import spawn
 from pathway_tpu.internals.keys import Pointer
 from pathway_tpu.testing import faults
 
@@ -138,22 +143,10 @@ class Cluster:
 
         acceptor = None
         if me < self.n_processes - 1:
-            acceptor = threading.Thread(target=accept_loop, daemon=True)
-            acceptor.start()
+            acceptor = spawn(accept_loop, name="cluster-acceptor")
         # dial every lower-numbered process (it is listening)
         for peer in range(me):
-            deadline = time.monotonic() + timeout_s
-            while True:
-                try:
-                    conn = Client((host, self.first_port + peer),
-                                  authkey=self.authkey)
-                    break
-                except (ConnectionError, OSError):
-                    if time.monotonic() > deadline:
-                        raise TimeoutError(
-                            f"process {me}: cannot reach peer {peer} at "
-                            f"{host}:{self.first_port + peer}")
-                    time.sleep(0.05)
+            conn = self._dial_peer(host, self.first_port + peer, timeout_s)
             conn.send(me)
             self.peers[peer] = conn
         if acceptor is not None:
@@ -163,6 +156,66 @@ class Cluster:
                     f"process {me}: peers did not all connect within "
                     f"{timeout_s}s (expected {self.n_processes - 1 - me})")
             self.peers.update(accepted)
+
+    def _dial_peer(self, host: str, port: int,
+                   timeout_s: float) -> Connection:
+        """Dial one lower-numbered peer with a selector wait instead of a
+        fixed ``time.sleep(0.05)`` retry poll (the PWT206 exemplar fix): a
+        non-blocking connect is awaited on the default selector, so an
+        in-progress handshake resolves the instant the peer's listener
+        accepts instead of up to one poll interval later. A refused
+        connect (the peer's listener is not up yet) resolves immediately
+        on loopback, so retries are paced by a bounded selector wait —
+        still interruptible by the deadline, never an unconditional
+        sleep."""
+        deadline = time.monotonic() + timeout_s
+        sel = selectors.DefaultSelector()
+        last_err: Exception | None = None
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"process {self.process_id}: cannot reach peer at "
+                        f"{host}:{port} within {timeout_s}s"
+                        + (f" (last error: {last_err})" if last_err else ""))
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.setblocking(False)
+                rc = s.connect_ex((host, port))
+                if rc in (0, errno.EISCONN):
+                    err = 0
+                elif rc in (errno.EINPROGRESS, errno.EWOULDBLOCK,
+                            errno.EAGAIN, errno.EALREADY):
+                    sel.register(s, selectors.EVENT_WRITE)
+                    try:
+                        ready = sel.select(timeout=remaining)
+                    finally:
+                        sel.unregister(s)
+                    err = (s.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+                           if ready else errno.ETIMEDOUT)
+                else:
+                    err = rc
+                if err == 0:
+                    s.setblocking(True)
+                    conn = Connection(s.detach())
+                    try:
+                        # multiprocessing.connection.Client's handshake,
+                        # on the socket the selector already connected
+                        answer_challenge(conn, self.authkey)
+                        deliver_challenge(conn, self.authkey)
+                        return conn
+                    except (OSError, EOFError) as e:
+                        conn.close()
+                        last_err = e
+                else:
+                    s.close()
+                    last_err = OSError(err, os.strerror(err))
+                # pace the retry: an empty-selector timed wait (kernel
+                # sleep bounded by the deadline, not a blind time.sleep)
+                sel.select(timeout=min(
+                    0.05, max(0.0, deadline - time.monotonic())))
+        finally:
+            sel.close()
 
     def close(self) -> None:
         # teardown failures are logged (debug, with the peer id), never
@@ -217,8 +270,7 @@ class Cluster:
             except BaseException as e:  # surfaced after the joins
                 err.append(e)
 
-        sender = threading.Thread(target=send_all, daemon=True)
-        sender.start()
+        sender = spawn(send_all, name="cluster-sender")
         # bounded recv: a hung peer (or accidentally non-SPMD user code
         # whose exchange schedule diverged) must surface as a diagnostic,
         # not an eternal deadlock — only a cleanly-dead peer raises EOFError
@@ -226,6 +278,9 @@ class Cluster:
         timeout_s = float(os.environ.get(
             "PATHWAY_CLUSTER_RECV_TIMEOUT", 300.0))
         out: dict[int, Any] = {}
+        # socket recv is a known-blocking region: the sanitizer asserts
+        # the commit loop entered the exchange holding no engine lock
+        assert_unlocked("cluster.exchange.recv")
         for peer, conn in self.peers.items():
             # poll in slices so a LOCAL send failure (unpicklable row,
             # malformed payload) surfaces as itself immediately — in SPMD

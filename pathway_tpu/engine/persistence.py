@@ -35,10 +35,11 @@ import logging
 import os
 import pickle
 import struct
-import threading
 import time as _time
 import zlib
 from typing import Any, Callable
+
+from pathway_tpu.engine.locking import blocking_call, create_lock
 
 from pathway_tpu.testing import faults
 
@@ -96,7 +97,7 @@ def _safe_loads(payload: bytes):
 # process-wide retry counter, exported on /metrics as
 # ``pathway_tpu_persistence_write_retries`` (Prometheus counters are
 # process-scoped by convention — several drivers in one process share it)
-_retry_lock = threading.Lock()
+_retry_lock = create_lock("persistence._retry_lock")
 _write_retries_total = 0
 
 
@@ -266,7 +267,10 @@ class SnapshotLog:
             self._f.write(payload)
             self._f.flush()
             faults.hit("persistence.fsync", path=self.path, time=time)
-            os.fsync(self._f.fileno())
+            # fsync is a known-blocking call: the sanitizer asserts no
+            # engine lock is held while the durability write stalls
+            with blocking_call("persistence.fsync"):
+                os.fsync(self._f.fileno())
 
         _retrying_write(_write, f"append to {self.path}")
 
@@ -399,7 +403,7 @@ class _RecordingSession:
         # loop's seal/take (a push between the take's slice and rebind
         # would otherwise be dropped from durability forever).
         self._seals: list[tuple[int, int]] = []
-        self._mutex = threading.Lock()
+        self._mutex = create_lock("RecordingSession._mutex")
         self.closed = inner.closed
         self.stopping = inner.stopping
 

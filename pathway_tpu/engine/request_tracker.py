@@ -39,7 +39,6 @@ tracing on or off.
 from __future__ import annotations
 
 import collections
-import threading
 import time
 
 # stage names, in hand-off order (see module doc)
@@ -184,7 +183,9 @@ class RequestTracker:
         budget = error_budget if error_budget is not None else _env_float(
             "PATHWAY_SLO_ERROR_BUDGET", _DEFAULT_ERROR_BUDGET)
         self.error_budget = max(1e-6, budget)
-        self._lock = threading.Lock()
+        from pathway_tpu.engine.locking import create_lock
+
+        self._lock = create_lock("RequestTracker._lock")
         self._by_key: dict = {}
         self._by_tick: dict[int, list[RequestSpan]] = {}
         self.completed: collections.deque = collections.deque(

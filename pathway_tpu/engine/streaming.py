@@ -45,7 +45,12 @@ class StreamingRuntime:
                  cluster=None, connector_policy=None, watchdog=None,
                  trace_path: str | None = None):
         from pathway_tpu.engine.supervisor import ConnectorSupervisor
+        from pathway_tpu.engine.threads import install_excepthook
         from pathway_tpu.io._datasource import Session
+
+        # uncaught exceptions in ANY engine thread land in the ErrorLog
+        # and flip /healthz instead of dying silently on stderr
+        install_excepthook()
 
         if n_workers is None:
             from pathway_tpu.internals.config import get_pathway_config
@@ -290,8 +295,10 @@ class StreamingRuntime:
         self.watchdog = Watchdog(self, self.supervisor, self.watchdog_config)
         self.watchdog.start()
         try:
-            while not self._stop.is_set():
-                _time.sleep(commit_s)
+            # Event wait, not time.sleep: a stop request wakes the loop
+            # immediately instead of out-waiting the commit interval
+            # (the PWT206 sleep-polling pattern this checker family bans)
+            while not self._stop.wait(commit_s):
                 self.last_tick_at = _time.monotonic()
                 # supervision tick: observe crashed/stalled readers, fire
                 # scheduled backoff restarts, escalate exhausted retries

@@ -132,7 +132,9 @@ class _SupervisedSession:
         # while a push is in flight past the detached check, or the zombie
         # row lands after the restart snapshotted its skip count
         # (double-delivery). Uncontended on the hot path.
-        self._lock = threading.Lock()
+        from pathway_tpu.engine.locking import create_lock
+
+        self._lock = create_lock("_SupervisedSession._lock")
         self.closed = threading.Event()
         self.closed_reason: str | None = None
         self.error: BaseException | None = None
@@ -196,7 +198,10 @@ class _SupervisedSource:
         self.forwarded = 0  # entries delivered past the proxy, all attempts
         self.stall_count = 0
         self.stalled = False
-        self.stall_flagged = False  # set by the watchdog, consumed by poll()
+        # set by the watchdog THREAD, consumed by poll() on the commit
+        # loop: an Event, not a bare bool — set/clear/is_set make the
+        # cross-thread hand-off explicit (PWT202's fix shape)
+        self.stall_flag = threading.Event()
         self.last_error: BaseException | None = None
         self.attempt: _SupervisedSession | None = None
         self.attempt_started_at: float | None = None
@@ -235,6 +240,11 @@ class ConnectorSupervisor:
         # stall escalations embed its tail so a ConnectorStalledError
         # names what the engine was executing, not just the silent source
         self.recorder = None
+        # crash accounting starts at THIS run: a thread that died in a
+        # previous run of a long-lived process must not degrade this one
+        from pathway_tpu.engine.threads import crash_epoch
+
+        self._crash_epoch = crash_epoch()
 
     def _stall_error(self, msg: str) -> "ConnectorStalledError":
         rec = self.recorder
@@ -266,7 +276,7 @@ class ConnectorSupervisor:
         proxy = _SupervisedSession(entry, entry.live_session, skip)
         entry.attempt = proxy
         entry.stalled = False
-        entry.stall_flagged = False
+        entry.stall_flag.clear()
         now = time.monotonic()
         entry.attempt_started_at = now
         entry.last_activity = now
@@ -327,8 +337,8 @@ class ConnectorSupervisor:
                 entry.state = DONE
                 entry.session.close(reason="eos")
             return
-        if entry.stall_flagged:
-            entry.stall_flagged = False
+        if entry.stall_flag.is_set():
+            entry.stall_flag.clear()
             self._abandon(entry)
             self._on_failure(entry, self._stall_error(
                 f"source {entry.name!r} stopped producing while claiming "
@@ -438,9 +448,14 @@ class ConnectorSupervisor:
     def healthy(self) -> bool:
         """The single definition of not-degraded, consumed by /healthz:
         no escalated fatal, no stalled commit loop, no absorbed engine
-        failure, no failed or stalled source."""
+        failure, no failed or stalled source, and no engine thread dead of
+        an uncaught exception (engine/threads.py excepthook — a run whose
+        watchdog or bridge worker silently died must not read healthy)."""
+        from pathway_tpu.engine.threads import crashed_threads
+
         return (self.fatal_error is None and not self.commit_stalled
                 and not self.engine_failed
+                and not crashed_threads(self._crash_epoch)
                 and not any(e.state == FAILED or e.stalled
                             for e in self.entries))
 
@@ -481,9 +496,9 @@ class Watchdog:
         return f"\nflight recorder tail:\n{tail}" if tail else ""
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name="pathway-tpu-watchdog")
-        self._thread.start()
+        from pathway_tpu.engine.threads import spawn
+
+        self._thread = spawn(self._run, name="watchdog")
 
     def stop(self) -> None:
         self._stop.set()
@@ -538,7 +553,7 @@ class Watchdog:
         if timeout is None:
             return
         for entry in self.supervisor.entries:
-            if entry.state != RUNNING or entry.stall_flagged:
+            if entry.state != RUNNING or entry.stall_flag.is_set():
                 continue
             attempt = entry.attempt
             if attempt is None or attempt.closed.is_set() \
@@ -553,4 +568,4 @@ class Watchdog:
                     "push/heartbeat for %.1fs (stall timeout %.1fs)%s",
                     entry.name, now - entry.last_activity, timeout,
                     self._postmortem())
-                entry.stall_flagged = True
+                entry.stall_flag.set()

@@ -1,0 +1,144 @@
+"""Engine thread factory + the package-wide ``threading.excepthook``.
+
+Every long-lived engine thread (device-bridge worker, supervisor reader
+threads, watchdog, HTTP monitoring server, multiproc acceptor/sender) is
+created through :func:`spawn` instead of bare ``threading.Thread`` — the
+PWT207 concurrency check flags raw constructions in ``engine/``/``io/``.
+The factory buys three things the bare constructor does not:
+
+1. **No silent deaths** — before this module existed, an uncaught
+   exception in a daemon thread printed to stderr and vanished: the run
+   kept reporting healthy while (say) its watchdog was gone. The factory
+   installs a process-wide ``threading.excepthook`` (chained in front of
+   the previous hook, so stderr tracebacks still appear) that records the
+   failure in the global ErrorLog (kind="thread") and in
+   :func:`crashed_threads` — which ``ConnectorSupervisor.healthy()``
+   consults, so ``/healthz`` flips to 503.
+2. **A live inventory** — :func:`live_threads` lists every factory-made
+   thread still alive (name, daemon flag, age), the runtime counterpart of
+   the static checker's thread inventory; ``/status`` debugging and the
+   thread-leak test fixture read it.
+3. **Uniform naming** — every engine thread is ``pathway-tpu-<role>``, so
+   a ``py-spy``/``faulthandler`` dump of a wedged process reads as a
+   thread inventory table.
+
+Connector reader crashes are NOT routed through the excepthook: the
+supervisor's restart/escalation protocol (engine/supervisor.py) owns
+those, and its session wrapper catches reader exceptions before they
+reach thread teardown.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+__all__ = ["crashed_threads", "install_excepthook", "live_threads",
+           "spawn"]
+
+# factory-made threads still referenced somewhere (weak: a finished thread
+# whose handle was dropped must not leak inventory entries forever)
+_THREADS: "weakref.WeakSet[threading.Thread]" = weakref.WeakSet()
+_started_at: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+# uncaught-exception records: {"thread": name, "error": "Type: msg"}.
+# Appends are list.append (atomic); readers copy.
+_CRASHES: list[dict] = []
+
+_PREV_HOOK = None
+_INSTALLED = False
+_install_lock = threading.Lock()
+
+
+def _pathway_excepthook(args) -> None:
+    """Record an uncaught thread exception in the ErrorLog + crash list,
+    then chain to the previous hook (default: stderr traceback)."""
+    if args.exc_type is SystemExit:
+        if _PREV_HOOK is not None:
+            _PREV_HOOK(args)
+        return
+    name = args.thread.name if args.thread is not None else "<unknown>"
+    err = f"{args.exc_type.__name__}: {args.exc_value}"
+    _CRASHES.append({"thread": name, "error": err})
+    try:
+        from pathway_tpu.internals.error import global_error_log
+
+        global_error_log().log(
+            f"uncaught exception in thread {name!r}: {err}",
+            operator=f"thread:{name}", kind="thread")
+    except Exception:
+        pass  # the hook must never raise — that kills the report too
+    if _PREV_HOOK is not None:
+        _PREV_HOOK(args)
+
+
+def install_excepthook() -> None:
+    """Idempotently install the engine excepthook (chained). Called on
+    first :func:`spawn`; safe to call eagerly (StreamingRuntime does, so
+    even non-factory threads get crash accounting)."""
+    global _PREV_HOOK, _INSTALLED
+    with _install_lock:
+        if _INSTALLED:
+            return
+        _PREV_HOOK = threading.excepthook
+        threading.excepthook = _pathway_excepthook
+        _INSTALLED = True
+
+
+def spawn(target, *, name: str, daemon: bool = True, args: tuple = (),
+          kwargs: dict | None = None, start: bool = True) -> threading.Thread:
+    """Create (and by default start) an engine thread.
+
+    ``name`` is the role suffix: the thread is named
+    ``pathway-tpu-<name>`` unless already prefixed. The thread is
+    registered in the live inventory and covered by the excepthook.
+    """
+    install_excepthook()
+    if not name.startswith("pathway-tpu"):
+        name = f"pathway-tpu-{name}"
+    # pwt-ok: PWT207 — the factory's own construction site
+    t = threading.Thread(target=target, args=args, kwargs=kwargs or {},
+                         daemon=daemon, name=name)
+    _THREADS.add(t)
+    _started_at[t] = time.monotonic()
+    if start:
+        t.start()
+    return t
+
+
+def live_threads() -> list[dict]:
+    """The factory-made threads currently alive: name, daemon flag, age
+    since spawn — the runtime thread inventory."""
+    now = time.monotonic()
+    out = []
+    for t in list(_THREADS):
+        if not t.is_alive():
+            continue
+        out.append({
+            "name": t.name,
+            "daemon": t.daemon,
+            "age_s": round(now - _started_at.get(t, now), 1),
+        })
+    return sorted(out, key=lambda d: d["name"])
+
+
+def crashed_threads(since: int = 0) -> list[dict]:
+    """Uncaught-exception records since process start (or since the
+    epoch ``since``, see :func:`crash_epoch`). Non-empty means some
+    engine thread died silently from the runtime's point of view —
+    ``ConnectorSupervisor.healthy()`` treats crashes since its own
+    creation as degraded, so ``/healthz`` serves 503."""
+    return list(_CRASHES[since:])
+
+
+def crash_epoch() -> int:
+    """Marker for "crashes from now on": pass to
+    :func:`crashed_threads` so a long-lived process (test suite,
+    embedder) starting a NEW run is not permanently degraded by a
+    thread that died in a previous one."""
+    return len(_CRASHES)
+
+
+def _reset_crashes_for_tests() -> None:
+    del _CRASHES[:]

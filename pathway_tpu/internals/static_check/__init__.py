@@ -9,6 +9,12 @@ error/warning/info) *before* the engine ever steps. The same analyzer backs
 ``python -m pathway_tpu check`` CLI (``--tpu-mesh 4x2`` analyzes against a
 hypothetical topology, ``--json`` emits machine-readable diagnostics).
 
+The third family, ``PWT201``–``PWT208`` (concurrency_check.py), analyzes
+*source files* rather than the plan DAG — the engine's own threads and
+locks: :func:`check_concurrency` is the API door, ``check --concurrency``
+the CLI door, and the runtime lock-order sanitizer
+(``PATHWAY_LOCK_SANITIZER=1``, engine/locking.py) the execution door.
+
 >>> import pathway_tpu as pw
 >>> t = pw.debug.table_from_markdown('''
 ... a | b
@@ -26,6 +32,10 @@ PWT001 error ...: operator '+' is not defined between int and str
 from __future__ import annotations
 
 from pathway_tpu.internals.static_check.analyzer import Analyzer, analyze
+from pathway_tpu.internals.static_check.concurrency_check import (
+    check_concurrency,
+    concurrency_inventory,
+)
 from pathway_tpu.internals.static_check.diagnostics import (
     CODES,
     Diagnostic,
@@ -42,7 +52,8 @@ from pathway_tpu.internals.static_check.shard_check import (
 
 __all__ = [
     "Analyzer", "CODES", "Diagnostic", "MeshSpec", "Severity",
-    "StaticCheckError", "UdfClassification", "analyze", "classify_udf",
+    "StaticCheckError", "UdfClassification", "analyze",
+    "check_concurrency", "classify_udf", "concurrency_inventory",
     "parse_mesh_spec", "render", "static_check",
 ]
 

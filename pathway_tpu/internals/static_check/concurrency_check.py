@@ -1,0 +1,1025 @@
+"""Static concurrency analysis — the PWT2xx diagnostic family.
+
+PWT0xx validates the logical plan and PWT1xx the sharding layer; this pass
+turns the Analyzer machinery on the layer where the reference engine gets
+safety for free from Rust ownership and this Python reproduction does not:
+the ~10 long-lived threads (device-bridge worker, supervisor reader
+threads, watchdog, HTTP monitoring server, multiproc acceptor/sender)
+sharing engine state. Unlike its siblings it analyzes **source files**, not
+the plan DAG — an AST pass over ``pathway_tpu/engine/`` (and ``io/``,
+``parallel/``) that builds:
+
+- a **thread inventory** — every ``threading.Thread`` / factory ``spawn``
+  target and the methods it reaches through ``self`` calls;
+- a **lock inventory** — every lock/rlock/condition/event attribute or
+  module global, resolved to a stable identity (``Class.attr`` /
+  ``module.NAME``);
+- a **lock-order graph** — a directed edge A→B for every ``with B:``
+  nested (lexically or one ``self``-call deep) inside ``with A:``.
+
+and flags:
+
+====== ======================================================== =========
+code   finding                                                  severity
+====== ======================================================== =========
+PWT201 lock-order inversion (cycle in the order graph)          error
+PWT202 attribute written from ≥2 thread roots, no common guard  error
+PWT203 lock held across a known-blocking call                   warning
+PWT204 daemon thread whose handle is dropped (no stop/join)     warning
+PWT205 ``Condition.wait`` outside a predicate re-check loop     error
+PWT206 sleep-polling loop where an Event exists                 warning
+PWT207 bare ``threading.Thread`` instead of the engine factory  warning
+PWT208 ``Condition.notify`` outside the condition's ``with``    error
+====== ======================================================== =========
+
+The runtime twin is the lock-order sanitizer (engine/locking.py,
+``PATHWAY_LOCK_SANITIZER=1``): what this pass proves about the source, the
+sanitizer asserts about the execution.
+
+**Waivers.** A finding on a line whose source carries ``pwt-ok: PWTxxx``
+(or a bare ``pwt-ok``) is suppressed — for the handful of deliberate
+lock-free patterns (single GIL-atomic stores, the thread factory's own
+``threading.Thread`` call). CI treats the waiver comment as the audit
+trail; "fixed, not suppressed" is the norm for everything else.
+
+Everything here is metadata-only: the analyzed modules are parsed, never
+imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import pathlib
+from dataclasses import dataclass, field
+
+from pathway_tpu.internals.static_check.diagnostics import Diagnostic
+from pathway_tpu.internals.trace import Trace
+
+# attribute/global kinds the inventory tracks
+_THREADING_KINDS = {"Lock": "lock", "RLock": "rlock",
+                    "Condition": "condition", "Event": "event"}
+_FACTORY_KINDS = {"create_lock": "lock", "create_rlock": "rlock",
+                  "create_condition": "condition"}
+_LOCKISH = ("lock", "rlock", "condition")
+
+# method names that block the calling thread indefinitely (or for device
+# time) — holding an engine lock across one stalls every contender.
+# ``submit``/``barrier`` are bridge-shaped and only match receivers whose
+# source text mentions "bridge" (ThreadPoolExecutor.submit is not
+# blocking); bare names match any receiver.
+_BLOCKING_ATTRS = {"fsync", "sendall", "send_bytes", "recv_bytes",
+                   "exchange", "block_until_ready", "device_put"}
+_BLOCKING_BRIDGE_ATTRS = {"submit", "barrier"}
+_SLEEP_NAMES = {"sleep"}
+
+
+def _waived(source_lines: list[str], lineno: int, code: str) -> bool:
+    """True when the flagged line — or the contiguous comment block
+    directly above it — carries a ``pwt-ok`` waiver for ``code`` (a bare
+    ``pwt-ok`` with no code waives every check on the line)."""
+    def _matches(text: str) -> bool:
+        return "pwt-ok" in text and (
+            code in text or "PWT" not in text)
+
+    if 1 <= lineno <= len(source_lines) and _matches(
+            source_lines[lineno - 1]):
+        return True
+    ln = lineno - 1
+    while 1 <= ln <= len(source_lines) and \
+            source_lines[ln - 1].lstrip().startswith("#"):
+        if _matches(source_lines[ln - 1]):
+            return True
+        ln -= 1
+    return False
+
+
+# ---------------------------------------------------------------------------
+# inventory model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LockDef:
+    """One lock/rlock/condition/event in the inventory."""
+
+    lock_id: str      # "Class.attr" or "module.NAME"
+    kind: str         # lock | rlock | condition | event
+    file: str
+    line: int
+
+
+@dataclass
+class ThreadDef:
+    """One thread creation site."""
+
+    target: str | None      # resolved "Class.method" / "module.func" / None
+    file: str
+    line: int
+    via_factory: bool       # engine/threads.py spawn
+    daemon: bool
+    handle_kept: bool       # stored/returned/appended/joined
+
+
+@dataclass
+class _Write:
+    attr: str
+    line: int
+    guards: frozenset
+    method: str
+
+
+@dataclass
+class _FuncInfo:
+    name: str
+    qualname: str
+    cls: str | None
+    file: str
+    # (held_lock_id, acquired_lock_id, line)
+    order_edges: list = field(default_factory=list)
+    # lock ids this function acquires directly (any nesting)
+    acquires: set = field(default_factory=set)
+    # (held_lock_id, call_description, line)
+    blocking_under_lock: list = field(default_factory=list)
+    # (cond_id, line, inside_while)
+    cond_waits: list = field(default_factory=list)
+    # (cond_id, line, inside_with_same_cond)
+    notifies: list = field(default_factory=list)
+    writes: list = field(default_factory=list)          # [_Write]
+    # (callee_method_name, frozenset(held), line) — self.<m>() calls
+    self_calls: list = field(default_factory=list)
+    # (line, event_id_or_None) sleep calls inside polling while-loops
+    poll_sleeps: list = field(default_factory=list)
+    spawns: list = field(default_factory=list)          # [ThreadDef]
+    raw_threads: list = field(default_factory=list)     # [line]
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    file: str
+    attr_kinds: dict = field(default_factory=dict)   # attr -> kind
+    methods: dict = field(default_factory=dict)      # name -> _FuncInfo
+    # attr -> method names in which it is the spawn target
+    thread_targets: set = field(default_factory=set)
+
+
+@dataclass
+class _ModuleInfo:
+    path: str
+    stem: str
+    source_lines: list
+    classes: dict = field(default_factory=dict)      # name -> _ClassInfo
+    functions: dict = field(default_factory=dict)    # name -> _FuncInfo
+    global_kinds: dict = field(default_factory=dict)  # NAME -> kind
+    # (line, "threading.Lock") raw primitive constructions anywhere in the
+    # module (module level included) — PWT207's lock-factory aspect
+    raw_locks: list = field(default_factory=list)
+    # a module that DEFINES the factories is the provider, not a consumer
+    is_factory_provider: bool = False
+
+
+# ---------------------------------------------------------------------------
+# pass 1: attribute/global kind collection (whole corpus, so `other._mutex`
+# can resolve by unique definer)
+# ---------------------------------------------------------------------------
+
+def _call_kind(call: ast.expr) -> str | None:
+    """Kind of primitive a call expression constructs, if any:
+    ``threading.Lock()``, ``Condition()``, ``create_lock("...")`` …"""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    else:
+        return None
+    return _THREADING_KINDS.get(name) or _FACTORY_KINDS.get(name)
+
+
+class _KindCollector(ast.NodeVisitor):
+    def __init__(self, mod: _ModuleInfo):
+        self.mod = mod
+        self._cls: _ClassInfo | None = None
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev = self._cls
+        self._cls = self.mod.classes.setdefault(
+            node.name, _ClassInfo(node.name, self.mod.path))
+        self.generic_visit(node)
+        self._cls = prev
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        kind = _call_kind(node.value)
+        if kind is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self" and self._cls is not None:
+                    self._cls.attr_kinds[t.attr] = kind
+                elif isinstance(t, ast.Name) and self._cls is None:
+                    self.mod.global_kinds[t.id] = kind
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-function analysis
+# ---------------------------------------------------------------------------
+
+class _Corpus:
+    """All analyzed modules + the cross-module attr-kind index."""
+
+    def __init__(self, modules: list[_ModuleInfo],
+                 parse_failures: list[tuple[str, str]] | None = None):
+        self.modules = modules
+        # (path, error) for files that could not be read/parsed — the
+        # checker reports these as PWT000: a silently-skipped file would
+        # hollow out a "directory is clean" gate
+        self.parse_failures = parse_failures or []
+        # attr name -> [(class_name, kind)] across the whole corpus
+        self.attr_index: dict[str, list[tuple[str, str]]] = {}
+        for m in modules:
+            for c in m.classes.values():
+                for attr, kind in c.attr_kinds.items():
+                    self.attr_index.setdefault(attr, []).append(
+                        (c.name, kind))
+
+    def resolve(self, expr: ast.expr, mod: _ModuleInfo,
+                cls: _ClassInfo | None,
+                kinds: tuple = _LOCKISH) -> tuple[str, str] | None:
+        """Resolve an expression to (lock_id, kind) when it names an
+        inventoried primitive of one of ``kinds``; None otherwise.
+        ``self.x`` prefers the enclosing class; any other ``<obj>.x``
+        resolves only when exactly one class in the corpus defines ``x``
+        with a matching kind (ambiguity drops the fact rather than
+        inventing one)."""
+        if isinstance(expr, ast.Name):
+            kind = mod.global_kinds.get(expr.id)
+            if kind in kinds:
+                return (f"{mod.stem}.{expr.id}", kind)
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and cls is not None:
+            kind = cls.attr_kinds.get(attr)
+            if kind in kinds:
+                return (f"{cls.name}.{attr}", kind)
+        candidates = [(c, k) for c, k in self.attr_index.get(attr, ())
+                      if k in kinds]
+        if len(candidates) == 1:
+            c, k = candidates[0]
+            return (f"{c}.{attr}", k)
+        return None
+
+
+def _is_spawn_call(call: ast.Call) -> tuple[bool, bool] | None:
+    """(is_thread_creation, via_factory) or None."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "Thread":
+        return (True, False)
+    if isinstance(fn, ast.Name) and fn.id == "Thread":
+        return (True, False)
+    if isinstance(fn, ast.Name) and fn.id == "spawn":
+        return (True, True)
+    if isinstance(fn, ast.Attribute) and fn.attr == "spawn":
+        return (True, True)
+    return None
+
+
+def _target_name(call: ast.Call) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    if call.args:
+        return call.args[0]
+    return None
+
+
+def _resolve_target(expr: ast.expr | None,
+                    cls: _ClassInfo | None,
+                    mod: _ModuleInfo) -> str | None:
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+            and cls is not None:
+        return f"{cls.name}.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        return f"{mod.stem}.{expr.id}"
+    return None
+
+
+def _expr_text(expr: ast.expr) -> str:
+    try:
+        return ast.unparse(expr)
+    except Exception:
+        return "<expr>"
+
+
+class _FuncAnalyzer(ast.NodeVisitor):
+    """Walks ONE function body tracking the lexical with-lock stack and
+    while-loop ancestry. Nested functions are analyzed as part of their
+    enclosing function (a closure spawned as a thread target shares the
+    method's guards)."""
+
+    def __init__(self, corpus: _Corpus, mod: _ModuleInfo,
+                 cls: _ClassInfo | None, info: _FuncInfo):
+        self.corpus = corpus
+        self.mod = mod
+        self.cls = cls
+        self.info = info
+        self.with_stack: list[str] = []     # lock ids, outermost first
+        self.while_depth = 0
+        self.while_tests: list[ast.expr] = []
+
+    # -- with / locks ------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        entered: list[str] = []
+        for item in node.items:
+            res = self.corpus.resolve(item.context_expr, self.mod, self.cls)
+            if res is not None:
+                lock_id, _kind = res
+                self.info.acquires.add(lock_id)
+                for held in self.with_stack:
+                    if held != lock_id:
+                        self.info.order_edges.append(
+                            (held, lock_id, node.lineno))
+                self.with_stack.append(lock_id)
+                entered.append(lock_id)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in entered:
+            self.with_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- loops -------------------------------------------------------------
+    def visit_While(self, node: ast.While) -> None:
+        self.while_depth += 1
+        self.while_tests.append(node.test)
+        self.generic_visit(node)
+        self.while_tests.pop()
+        self.while_depth -= 1
+
+    # -- calls -------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_spawn(node)
+        self._check_blocking(node)
+        self._check_wait_notify(node)
+        self._check_sleep(node)
+        self._check_self_call(node)
+        self.generic_visit(node)
+
+    def _check_spawn(self, node: ast.Call) -> None:
+        spawn = _is_spawn_call(node)
+        if spawn is None:
+            return
+        _is_thread, via_factory = spawn
+        if not via_factory:
+            self.info.raw_threads.append(node.lineno)
+        target = _resolve_target(_target_name(node), self.cls, self.mod)
+        daemon = True
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        self.info.spawns.append(ThreadDef(
+            target=target, file=self.mod.path, line=node.lineno,
+            via_factory=via_factory, daemon=daemon, handle_kept=False))
+        if target is not None and self.cls is not None and \
+                target.startswith(self.cls.name + "."):
+            self.cls.thread_targets.add(target.split(".", 1)[1])
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        if not self.with_stack:
+            return
+        fn = node.func
+        desc = None
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _BLOCKING_ATTRS:
+                desc = _expr_text(fn)
+            elif fn.attr in _BLOCKING_BRIDGE_ATTRS and \
+                    "bridge" in _expr_text(fn.value).lower():
+                desc = _expr_text(fn)
+            elif fn.attr in _SLEEP_NAMES and not isinstance(
+                    fn.value, ast.Constant):
+                # time.sleep / _time.sleep / session.sleep — all block
+                desc = _expr_text(fn)
+        elif isinstance(fn, ast.Name) and fn.id in (
+                _BLOCKING_ATTRS | _SLEEP_NAMES):
+            desc = fn.id
+        if desc is not None:
+            self.info.blocking_under_lock.append(
+                (self.with_stack[-1], desc, node.lineno))
+
+    def _check_wait_notify(self, node: ast.Call) -> None:
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        if fn.attr in ("wait", "wait_for"):
+            res = self.corpus.resolve(fn.value, self.mod, self.cls,
+                                      kinds=("condition",))
+            if res is not None:
+                cond_id, _ = res
+                ok = fn.attr == "wait_for" or self.while_depth > 0
+                self.info.cond_waits.append((cond_id, node.lineno, ok))
+                # waiting on a condition while holding OTHER locks blocks
+                # those locks too (the condition only releases its own)
+                others = [h for h in self.with_stack if h != cond_id]
+                if others:
+                    self.info.blocking_under_lock.append(
+                        (others[-1], f"{_expr_text(fn)} (wait releases "
+                                     f"only its own lock)", node.lineno))
+        elif fn.attr in ("notify", "notify_all"):
+            res = self.corpus.resolve(fn.value, self.mod, self.cls,
+                                      kinds=("condition",))
+            if res is not None:
+                cond_id, _ = res
+                self.info.notifies.append(
+                    (cond_id, node.lineno, cond_id in self.with_stack))
+
+    def _check_sleep(self, node: ast.Call) -> None:
+        fn = node.func
+        is_sleep = (isinstance(fn, ast.Attribute)
+                    and fn.attr == "sleep"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in ("time", "_time")) or (
+                        isinstance(fn, ast.Name) and fn.id == "sleep")
+        if not is_sleep or self.while_depth == 0:
+            return
+        # an Event is "available" when the loop condition polls one
+        # (`while not self._stop.is_set()`) or the enclosing class owns
+        # one — either way Event.wait(timeout) replaces the sleep
+        event_id = None
+        for test in self.while_tests:
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr == "is_set":
+                    res = self.corpus.resolve(
+                        sub.func.value, self.mod, self.cls,
+                        kinds=("event",))
+                    event_id = res[0] if res else _expr_text(sub.func.value)
+        if event_id is None and self.cls is not None:
+            for attr, kind in self.cls.attr_kinds.items():
+                if kind == "event":
+                    event_id = f"{self.cls.name}.{attr}"
+                    break
+        if event_id is not None:
+            self.info.poll_sleeps.append((node.lineno, event_id))
+
+    def _check_self_call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "self":
+            self.info.self_calls.append(
+                (fn.attr, frozenset(self.with_stack), node.lineno))
+
+    # -- writes ------------------------------------------------------------
+    def _record_write(self, target: ast.expr, lineno: int) -> None:
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            self.info.writes.append(_Write(
+                target.attr, lineno, frozenset(self.with_stack),
+                self.info.name))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_write(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    # handle spawn results: `t = threading.Thread(...)` / `self._t = ...`
+    # handled post-hoc in _mark_kept_handles (needs whole-function view)
+
+
+def _mark_kept_handles(fn_node: ast.AST, info: _FuncInfo) -> None:
+    """Decide handle_kept for each spawn in this function: kept when the
+    thread object is stored on self, returned, appended into a container,
+    or joined by a local name. Anything else is a dropped daemon handle
+    (PWT204)."""
+    # local name -> spawn indices (matched by the spawn call's line)
+    local_spawns: dict[str, list[int]] = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_spawn_call(node.value) is None:
+                continue
+            idx = next((i for i, sp in enumerate(info.spawns)
+                        if sp.line == node.value.lineno), None)
+            if idx is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    info.spawns[idx].handle_kept = True
+                elif isinstance(t, ast.Name):
+                    local_spawns.setdefault(t.id, []).append(idx)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Call) and \
+                    _is_spawn_call(node.value) is not None:
+                idx = next((i for i, sp in enumerate(info.spawns)
+                            if sp.line == node.value.lineno), None)
+                if idx is not None:
+                    info.spawns[idx].handle_kept = True
+            elif isinstance(node.value, ast.Name):
+                for idx in local_spawns.get(node.value.id, ()):
+                    info.spawns[idx].handle_kept = True
+    # second sweep: joins / appends of local names
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            fn = node.func
+            if fn.attr in ("join", "append", "add"):
+                names = [a.id for a in node.args
+                         if isinstance(a, ast.Name)]
+                if isinstance(fn.value, ast.Name):
+                    names.append(fn.value.id)
+                for name in names:
+                    for idx in local_spawns.get(name, ()):
+                        info.spawns[idx].handle_kept = True
+
+
+# ---------------------------------------------------------------------------
+# corpus construction
+# ---------------------------------------------------------------------------
+
+def _collect_files(paths) -> list[pathlib.Path]:
+    files: list[pathlib.Path] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            files.extend(
+                f for f in sorted(path.rglob("*.py"))
+                if "__pycache__" not in f.parts
+                and not any(part.startswith(".") for part in f.parts))
+        elif path.suffix == ".py":
+            files.append(path)
+        else:
+            raise ValueError(f"not a python file or directory: {p}")
+    return files
+
+
+def build_corpus(paths) -> _Corpus:
+    modules: list[_ModuleInfo] = []
+    parse_failures: list[tuple[str, str]] = []
+    for f in _collect_files(paths):
+        try:
+            source = f.read_text()
+            tree = ast.parse(source, filename=str(f))
+        except (OSError, SyntaxError) as e:
+            parse_failures.append((str(f), f"{type(e).__name__}: {e}"))
+            continue
+        # __init__.py modules take their package's name, so two
+        # connectors' module-global locks cannot collide on the id
+        # prefix "__init__" (a collision would invent cross-package
+        # order edges — and spurious PWT201 inversions)
+        stem = f.parent.name if f.stem == "__init__" else f.stem
+        mod = _ModuleInfo(path=str(f), stem=stem,
+                          source_lines=source.splitlines())
+        _KindCollector(mod).visit(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in ("create_lock", "create_rlock",
+                                      "create_condition", "spawn"):
+                mod.is_factory_provider = True
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("Lock", "RLock", "Condition") \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "threading":
+                mod.raw_locks.append(
+                    (node.lineno, f"threading.{node.func.attr}"))
+        mod._tree = tree  # type: ignore[attr-defined]
+        modules.append(mod)
+    corpus = _Corpus(modules, parse_failures)
+    # pass 2 needs the cross-module attr-kind index, so it runs after
+    # every module's pass 1 completed
+    for mod in corpus.modules:
+        _analyze_module(corpus, mod)
+    return corpus
+
+
+def _analyze_module(corpus: _Corpus, mod: _ModuleInfo) -> None:
+    tree = mod._tree  # type: ignore[attr-defined]
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls = mod.classes.setdefault(
+                node.name, _ClassInfo(node.name, mod.path))
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = _FuncInfo(sub.name,
+                                     f"{cls.name}.{sub.name}",
+                                     cls.name, mod.path)
+                    _FuncAnalyzer(corpus, mod, cls, info).visit(sub)
+                    _mark_kept_handles(sub, info)
+                    cls.methods[sub.name] = info
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = _FuncInfo(node.name, f"{mod.stem}.{node.name}", None,
+                             mod.path)
+            _FuncAnalyzer(corpus, mod, None, info).visit(node)
+            _mark_kept_handles(node, info)
+            mod.functions[node.name] = info
+
+
+# ---------------------------------------------------------------------------
+# inventories (consumed by tests, README generation and --json consumers)
+# ---------------------------------------------------------------------------
+
+def lock_inventory(corpus: _Corpus) -> list[LockDef]:
+    out: list[LockDef] = []
+    for mod in corpus.modules:
+        for name, kind in mod.global_kinds.items():
+            out.append(LockDef(f"{mod.stem}.{name}", kind, mod.path, 0))
+        for cls in mod.classes.values():
+            for attr, kind in cls.attr_kinds.items():
+                out.append(LockDef(f"{cls.name}.{attr}", kind, mod.path, 0))
+    return out
+
+
+def thread_inventory(corpus: _Corpus) -> list[ThreadDef]:
+    out: list[ThreadDef] = []
+    for mod in corpus.modules:
+        funcs = list(mod.functions.values()) + [
+            m for c in mod.classes.values() for m in c.methods.values()]
+        for fn in funcs:
+            out.extend(fn.spawns)
+    return out
+
+
+def lock_order_edges(corpus: _Corpus) -> list[tuple[str, str, str, int]]:
+    """(held, acquired, file, line) for every order edge: lexical nesting
+    plus one level of ``self``-method call propagation (``with a:
+    self.m()`` where ``m`` acquires ``b`` yields a→b)."""
+    edges: list[tuple[str, str, str, int]] = []
+    for mod in corpus.modules:
+        all_funcs = list(mod.functions.values()) + [
+            m for c in mod.classes.values() for m in c.methods.values()]
+        for fn in all_funcs:
+            for held, acq, line in fn.order_edges:
+                edges.append((held, acq, fn.file, line))
+        for cls in mod.classes.values():
+            closure = _class_acquire_closure(cls)
+            for fn in cls.methods.values():
+                for callee, held, line in fn.self_calls:
+                    if not held:
+                        continue
+                    for acq in closure.get(callee, ()):
+                        for h in held:
+                            if h != acq:
+                                edges.append((h, acq, fn.file, line))
+    return edges
+
+
+def _class_acquire_closure(cls: _ClassInfo) -> dict[str, set]:
+    """method -> lock ids it may acquire, transitively through self
+    calls (fixpoint over the class's own call graph)."""
+    acq = {name: set(fn.acquires) for name, fn in cls.methods.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in cls.methods.items():
+            for callee, _held, _line in fn.self_calls:
+                extra = acq.get(callee, set()) - acq[name]
+                if extra:
+                    acq[name] |= extra
+                    changed = True
+    return acq
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+def _diag(code: str, message: str, mod_path: str, line: int,
+          function: str, source_lines: list[str]) -> Diagnostic:
+    src = source_lines[line - 1].strip() if 0 < line <= len(source_lines) \
+        else ""
+    return Diagnostic(code=code, message=message,
+                      trace=Trace(mod_path, line, function, src))
+
+
+class ConcurrencyChecker:
+    """Runs every PWT2xx check over a parsed corpus."""
+
+    def __init__(self, corpus: _Corpus):
+        self.corpus = corpus
+        self.diagnostics: list[Diagnostic] = []
+        self._sources = {m.path: m.source_lines for m in corpus.modules}
+
+    def _report(self, code: str, message: str, file: str, line: int,
+                function: str = "") -> None:
+        lines = self._sources.get(file, [])
+        if _waived(lines, line, code):
+            return
+        self.diagnostics.append(
+            _diag(code, message, file, line, function, lines))
+
+    def run(self) -> list[Diagnostic]:
+        for path, err in self.corpus.parse_failures:
+            # unparseable source cannot be certified clean — an error,
+            # so the directory gate fails instead of quietly shrinking
+            self.diagnostics.append(Diagnostic(
+                code="PWT000",
+                message=f"cannot analyze {path}: {err}"))
+        self.check_lock_order()       # PWT201
+        self.check_unguarded_writes()  # PWT202
+        self.check_held_across_blocking()  # PWT203
+        self.check_dropped_daemons()  # PWT204
+        self.check_cond_waits()       # PWT205
+        self.check_sleep_polling()    # PWT206
+        self.check_raw_threads()      # PWT207
+        self.check_notify_outside()   # PWT208
+        return self.diagnostics
+
+    # -- PWT201 ------------------------------------------------------------
+    def check_lock_order(self) -> None:
+        edges = lock_order_edges(self.corpus)
+        adj: dict[str, set] = {}
+        where: dict[tuple[str, str], tuple[str, int]] = {}
+        for held, acq, file, line in edges:
+            adj.setdefault(held, set()).add(acq)
+            where.setdefault((held, acq), (file, line))
+        reported: set[frozenset] = set()
+        for (a, b), (file, line) in sorted(where.items(),
+                                           key=lambda kv: kv[1]):
+            if frozenset((a, b)) in reported:
+                continue
+            if self._reaches(adj, b, a):
+                reported.add(frozenset((a, b)))
+                rev = where.get((b, a))
+                rev_s = f" (reverse order at {rev[0]}:{rev[1]})" \
+                    if rev else ""
+                self._report(
+                    "PWT201",
+                    f"lock-order inversion: {a!r} is acquired before "
+                    f"{b!r} here, but the graph also orders {b!r} before "
+                    f"{a!r}{rev_s} — two threads taking the two paths "
+                    f"concurrently deadlock",
+                    file, line)
+
+    @staticmethod
+    def _reaches(adj: dict, src: str, dst: str) -> bool:
+        """Reachability src → dst in the order graph. The length-2 case
+        (a direct reverse edge) is a cycle like any other."""
+        stack = [src]
+        seen = set()
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj.get(n, ()))
+        return False
+
+    # -- PWT202 ------------------------------------------------------------
+    def check_unguarded_writes(self) -> None:
+        for mod in self.corpus.modules:
+            for cls in mod.classes.values():
+                if not cls.thread_targets:
+                    continue
+                self._check_class_writes(mod, cls)
+
+    def _check_class_writes(self, mod: _ModuleInfo,
+                            cls: _ClassInfo) -> None:
+        reach = _reachable_methods(cls)
+        roots: dict[str, set] = {}
+        for target in cls.thread_targets:
+            roots[f"thread:{target}"] = reach.get(target, {target})
+        thread_methods = set().union(*roots.values()) if roots else set()
+        # the implicit main root: every method not reachable from a
+        # thread target (constructor excluded: it runs before threads)
+        main_methods = {name for name in cls.methods
+                        if name not in thread_methods
+                        and name != "__init__"}
+        roots["main"] = main_methods
+        guaranteed = _guaranteed_held(cls, roots)
+        # attr -> root -> list[(write, guards)]
+        per_attr: dict[str, dict[str, list]] = {}
+        for root, methods in roots.items():
+            for m in methods:
+                fn = cls.methods.get(m)
+                if fn is None:
+                    continue
+                for w in fn.writes:
+                    if cls.attr_kinds.get(w.attr) in (
+                            "lock", "rlock", "condition", "event"):
+                        continue
+                    guards = w.guards | guaranteed.get((root, m),
+                                                      frozenset())
+                    per_attr.setdefault(w.attr, {}).setdefault(
+                        root, []).append((w, guards))
+        for attr, by_root in per_attr.items():
+            if len(by_root) < 2:
+                continue
+            # at least one genuine thread root must write it
+            if not any(r.startswith("thread:") for r in by_root):
+                continue
+            common = None
+            for _root, writes in by_root.items():
+                for _w, guards in writes:
+                    common = guards if common is None else common & guards
+            if common:
+                continue
+            w = next(iter(by_root.values()))[0][0]
+            rootnames = sorted(by_root)
+            self._report(
+                "PWT202",
+                f"attribute {cls.name}.{attr} is written from "
+                f"{len(by_root)} thread roots ({', '.join(rootnames)}) "
+                f"with no common lock guard — interleaved writes race "
+                f"(guard them with one lock, or make the hand-off an "
+                f"Event)",
+                cls.file, w.line, w.method)
+
+    # -- PWT203 ------------------------------------------------------------
+    def check_held_across_blocking(self) -> None:
+        for mod in self.corpus.modules:
+            for fn in _all_funcs(mod):
+                for lock_id, desc, line in fn.blocking_under_lock:
+                    self._report(
+                        "PWT203",
+                        f"{fn.qualname} holds {lock_id!r} across blocking "
+                        f"call {desc}() — every thread contending on the "
+                        f"lock waits out the call (move it outside the "
+                        f"critical section)",
+                        fn.file, line, fn.name)
+
+    # -- PWT204 ------------------------------------------------------------
+    def check_dropped_daemons(self) -> None:
+        for mod in self.corpus.modules:
+            for fn in _all_funcs(mod):
+                for sp in fn.spawns:
+                    if sp.daemon and not sp.handle_kept:
+                        tgt = sp.target or "<unresolved target>"
+                        self._report(
+                            "PWT204",
+                            f"daemon thread (target {tgt}) spawned in "
+                            f"{fn.qualname} with its handle dropped: no "
+                            f"stop/join path exists, so shutdown cannot "
+                            f"wait it out and it dies mid-work at "
+                            f"interpreter exit",
+                            fn.file, sp.line, fn.name)
+
+    # -- PWT205 ------------------------------------------------------------
+    def check_cond_waits(self) -> None:
+        for mod in self.corpus.modules:
+            for fn in _all_funcs(mod):
+                for cond_id, line, ok in fn.cond_waits:
+                    if ok:
+                        continue
+                    self._report(
+                        "PWT205",
+                        f"{fn.qualname} calls {cond_id}.wait() outside a "
+                        f"predicate re-check loop: spurious wake-ups and "
+                        f"missed notifies break the invariant (use "
+                        f"`while not pred: cv.wait()` or cv.wait_for)",
+                        fn.file, line, fn.name)
+
+    # -- PWT206 ------------------------------------------------------------
+    def check_sleep_polling(self) -> None:
+        for mod in self.corpus.modules:
+            for fn in _all_funcs(mod):
+                for line, event_id in fn.poll_sleeps:
+                    self._report(
+                        "PWT206",
+                        f"{fn.qualname} sleep-polls in a loop while an "
+                        f"Event ({event_id}) exists: "
+                        f"`{event_id.split('.')[-1]}.wait(timeout)` wakes "
+                        f"immediately on the state change instead of up "
+                        f"to one poll interval late",
+                        fn.file, line, fn.name)
+
+    # -- PWT207 ------------------------------------------------------------
+    def check_raw_threads(self) -> None:
+        for mod in self.corpus.modules:
+            for fn in _all_funcs(mod):
+                for line in fn.raw_threads:
+                    self._report(
+                        "PWT207",
+                        f"{fn.qualname} constructs threading.Thread "
+                        f"directly: use the engine thread factory "
+                        f"(pathway_tpu.engine.threads.spawn) so the "
+                        f"thread gets excepthook coverage, inventory "
+                        f"registration and uniform naming",
+                        fn.file, line, fn.name)
+            if mod.is_factory_provider:
+                continue  # the factory module constructs the primitives
+            for line, what in mod.raw_locks:
+                self._report(
+                    "PWT207",
+                    f"{mod.stem} constructs {what} directly: use the "
+                    f"engine lock factory (pathway_tpu.engine.locking "
+                    f"create_lock/create_rlock/create_condition) so the "
+                    f"lock is named, inventoried, and sanitizable under "
+                    f"PATHWAY_LOCK_SANITIZER",
+                    mod.path, line)
+
+    # -- PWT208 ------------------------------------------------------------
+    def check_notify_outside(self) -> None:
+        for mod in self.corpus.modules:
+            for fn in _all_funcs(mod):
+                for cond_id, line, inside in fn.notifies:
+                    if inside:
+                        continue
+                    self._report(
+                        "PWT208",
+                        f"{fn.qualname} notifies {cond_id} without "
+                        f"holding it: threading.Condition.notify raises "
+                        f"RuntimeError('cannot notify on un-acquired "
+                        f"lock') at runtime — wrap it in `with "
+                        f"{cond_id.split('.')[-1]}:`",
+                        fn.file, line, fn.name)
+
+
+def _all_funcs(mod: _ModuleInfo):
+    yield from mod.functions.values()
+    for cls in mod.classes.values():
+        yield from cls.methods.values()
+
+
+def _reachable_methods(cls: _ClassInfo) -> dict[str, set]:
+    """method -> set of class methods reachable from it via self calls
+    (inclusive)."""
+    out: dict[str, set] = {}
+    for start in cls.methods:
+        seen = {start}
+        stack = [start]
+        while stack:
+            m = stack.pop()
+            fn = cls.methods.get(m)
+            if fn is None:
+                continue
+            for callee, _h, _l in fn.self_calls:
+                if callee in cls.methods and callee not in seen:
+                    seen.add(callee)
+                    stack.append(callee)
+        out[start] = seen
+    return out
+
+
+def _guaranteed_held(cls: _ClassInfo,
+                     roots: dict[str, set]) -> dict[tuple, frozenset]:
+    """(root, method) -> lock ids guaranteed held whenever ``method`` runs
+    under ``root``: the intersection over all call paths from the root of
+    the locks held at each call site. Root entry points hold nothing."""
+    out: dict[tuple, frozenset] = {}
+    for root, methods in roots.items():
+        if root.startswith("thread:"):
+            entries = {root.split(":", 1)[1]}
+        else:
+            entries = set(methods)
+        held: dict[str, frozenset | None] = {m: None for m in methods}
+        for e in entries:
+            held[e] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for m in methods:
+                fn = cls.methods.get(m)
+                if fn is None or held.get(m) is None:
+                    continue
+                base = held[m]
+                for callee, at_call, _line in fn.self_calls:
+                    if callee not in held:
+                        continue
+                    eff = frozenset(at_call) | base
+                    cur = held[callee]
+                    new = eff if cur is None else cur & eff
+                    if new != cur:
+                        held[callee] = new
+                        changed = True
+        for m in methods:
+            out[(root, m)] = held.get(m) or frozenset()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# front door
+# ---------------------------------------------------------------------------
+
+def check_concurrency(paths, *, corpus: _Corpus | None = None
+                      ) -> list[Diagnostic]:
+    """Run the PWT2xx family over ``paths`` (files or directories of
+    Python source). Returns diagnostics; nothing is imported or
+    executed. Pass a prebuilt ``corpus`` (from :func:`build_corpus`) to
+    share the parse with :func:`concurrency_inventory`."""
+    return ConcurrencyChecker(corpus or build_corpus(paths)).run()
+
+
+def concurrency_inventory(paths, *, corpus: _Corpus | None = None) -> dict:
+    """The thread/lock inventories and lock-order graph as plain data —
+    the machine-readable twin of README's "Concurrency model" tables."""
+    corpus = corpus or build_corpus(paths)
+    return {
+        "threads": [vars(t).copy() for t in thread_inventory(corpus)],
+        "locks": [vars(lk) for lk in lock_inventory(corpus)],
+        "order_edges": sorted({(a, b) for a, b, _f, _l
+                               in lock_order_edges(corpus)}),
+    }

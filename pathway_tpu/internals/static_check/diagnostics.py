@@ -86,6 +86,37 @@ CODES: dict[str, tuple[Severity, str]] = {
     "PWT111": (Severity.WARNING,
                "paged store reservation/tenant quota not page-aligned, or "
                "tenant quotas sum past device HBM"),
+    # -- PWT2xx: concurrency (static_check/concurrency_check.py) -----------
+    # Source-level AST analysis over the multi-threaded engine itself
+    # (engine/, io/, parallel/), not the plan DAG: thread inventory, lock
+    # inventory, lock-order graph. Runtime twin: PATHWAY_LOCK_SANITIZER
+    # (engine/locking.py).
+    "PWT201": (Severity.ERROR,
+               "lock-order inversion: a cycle in the global lock "
+               "acquisition-order graph (some interleaving deadlocks)"),
+    "PWT202": (Severity.ERROR,
+               "attribute written from two or more thread roots with no "
+               "common lock guard"),
+    "PWT203": (Severity.WARNING,
+               "lock held across a known-blocking call (fsync, socket "
+               "send/recv, bridge submit, device dispatch)"),
+    "PWT204": (Severity.WARNING,
+               "daemon thread spawned with no stop/join path (its handle "
+               "is dropped; nothing can ever wait it out)"),
+    "PWT205": (Severity.ERROR,
+               "Condition.wait outside a predicate re-check loop (misses "
+               "spurious wake-ups and missed-notify races)"),
+    "PWT206": (Severity.WARNING,
+               "sleep-polling loop where an Event exists (use Event.wait: "
+               "immediate wake-up, no poll latency)"),
+    "PWT207": (Severity.WARNING,
+               "thread or lock primitive constructed bare instead of "
+               "through the engine factories (threads.py spawn / "
+               "locking.py create_*: excepthook, inventory and sanitizer "
+               "coverage)"),
+    "PWT208": (Severity.ERROR,
+               "Condition.notify/notify_all outside the condition's "
+               "`with` block (raises RuntimeError at runtime)"),
 }
 
 

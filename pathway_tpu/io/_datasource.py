@@ -105,10 +105,12 @@ class DataSource:
             else:
                 session.close(reason="eos")
 
-        t = threading.Thread(target=runner, daemon=True,
-                             name=f"pathway-tpu-src-{self.name}-{self._uid}")
-        t.start()
-        return t
+        from pathway_tpu.engine.threads import spawn
+
+        # factory-spawned (engine/threads.py): inventory + excepthook
+        # coverage; the wrapper above still owns reader-crash semantics
+        # (the supervisor restarts, the excepthook only observes)
+        return spawn(runner, name=f"src-{self.name}-{self._uid}")
 
     def run(self, session: Session) -> None:
         raise NotImplementedError

@@ -239,9 +239,9 @@ class PathwayWebserver:
             except Exception:
                 self._started.set()
 
-        self._thread = threading.Thread(target=run_loop, daemon=True,
-                                        name="pathway-tpu-webserver")
-        self._thread.start()
+        from pathway_tpu.engine.threads import spawn
+
+        self._thread = spawn(run_loop, name="webserver")
         self._started.wait(timeout=10)
 
 
@@ -285,7 +285,9 @@ class RestSource(DataSource):
                                           asyncio.Event, list]] = {}
         self._session: Session | None = None
         self._seq = 0
-        self._lock = threading.Lock()
+        from pathway_tpu.engine.locking import create_lock
+
+        self._lock = create_lock("RestSource._lock")
 
     def run(self, session: Session) -> None:
         self._session = session

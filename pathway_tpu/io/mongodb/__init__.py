@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import socket
 import struct
-import threading
 from urllib.parse import urlparse
 
 from pathway_tpu.internals.parse_graph import G
@@ -76,7 +75,9 @@ def write(table: Table, *, connection_string: str, database: str,
 
     def binder(runner):
         state = {"conn": None}
-        lock = threading.Lock()
+        from pathway_tpu.engine.locking import create_lock
+
+        lock = create_lock("mongodb.write.binder")
 
         def conn() -> _MongoConn:
             if state["conn"] is None:

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import json as _json
 import socket
-import threading
 from urllib.parse import urlparse
 
 from pathway_tpu.internals import dtype as dt
@@ -220,7 +219,9 @@ def write(table: Table, uri: str, topic: str, *, format: str = "json",
 
     def binder(runner):
         state = {"conn": None}
-        lock = threading.Lock()
+        from pathway_tpu.engine.locking import create_lock
+
+        lock = create_lock("nats.write.binder")
 
         def conn() -> _NatsConn:
             if state["conn"] is None:

@@ -46,7 +46,6 @@ from __future__ import annotations
 import enum
 import functools
 import math
-import threading
 from typing import Any, Callable
 
 import numpy as np
@@ -370,7 +369,12 @@ class BruteForceKnnIndex:
         self.dtype = dtype
         self._np_dtype = _np_dtype(dtype)
         self._is_int8 = dtype == "int8"
-        self._lock = threading.RLock()
+        # engine lock factory: sanitizable under PATHWAY_LOCK_SANITIZER —
+        # this is the lock /metrics threads take for paged-store stats
+        # (the PR-7 stats() race class)
+        from pathway_tpu.engine.locking import create_rlock
+
+        self._lock = create_rlock("BruteForceKnnIndex._lock")
 
         self._key_to_slot: dict[Pointer, int] = {}
         self._slot_to_key: dict[int, Pointer] = {}

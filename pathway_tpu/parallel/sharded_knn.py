@@ -15,7 +15,6 @@ config 5 (multi-worker KNN over a stream).
 from __future__ import annotations
 
 import math
-import threading
 from typing import Any, Callable
 
 import numpy as np
@@ -116,7 +115,9 @@ class ShardedKnnIndex:
         self.dtype = dtype
         self._mesh = mesh if mesh is not None else get_mesh()
         self.n_shards = int(self._mesh.shape[DATA_AXIS])
-        self._lock = threading.RLock()
+        from pathway_tpu.engine.locking import create_rlock
+
+        self._lock = create_rlock("ShardedKnnIndex._lock")
         self._key_to_slot: dict[Pointer, int] = {}
         self._slot_to_key: dict[int, Pointer] = {}
         self._filter_data: dict[Pointer, Any] = {}

@@ -1,0 +1,31 @@
+"""Deliberately misordered locks — the CI canary proving the PWT2xx gate
+bites.
+
+``python -m pathway_tpu check --concurrency
+tests/concurrency_negative_example.py`` must exit nonzero: ``ingest``
+acquires ``_ingest_lock`` then ``_query_lock`` while ``query`` acquires
+them in the opposite order — a lock-order inversion (PWT201). An ingest
+thread and a query thread taking the two paths concurrently deadlock.
+The module is never imported by the suite (the checker parses, it does
+not execute).
+"""
+
+import threading
+
+
+class MisorderedServingTier:
+    def __init__(self):
+        self._ingest_lock = threading.Lock()
+        self._query_lock = threading.Lock()
+        self.rows = []
+        self.results = []
+
+    def ingest(self, batch):
+        with self._ingest_lock:
+            with self._query_lock:
+                self.rows.extend(batch)
+
+    def query(self, q):
+        with self._query_lock:
+            with self._ingest_lock:
+                self.results.append((q, len(self.rows)))

@@ -1,0 +1,689 @@
+"""Concurrency analyzer (static_check/concurrency_check.py): one
+true-positive and one true-negative per PWT201–PWT208 code, the waiver
+mechanism, the thread/lock inventories, the engine-dogfood gate, and the
+CLI ``--concurrency`` front door (mirrors tests/test_shard_check.py)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+
+from pathway_tpu.internals.static_check import (check_concurrency,
+                                                concurrency_inventory)
+
+
+def run_check(tmp_path, source: str):
+    f = tmp_path / "mod_under_test.py"
+    f.write_text(textwrap.dedent(source))
+    return check_concurrency([str(f)])
+
+
+def codes(diags):
+    return sorted(d.code for d in diags)
+
+
+def only(diags, code):
+    return [d for d in diags if d.code == code]
+
+
+# ---------------------------------------------------------------------------
+# PWT201 — lock-order inversion
+# ---------------------------------------------------------------------------
+
+_INVERSION = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def ingest(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def query(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_pwt201_inversion_is_error(tmp_path):
+    diags = only(run_check(tmp_path, _INVERSION), "PWT201")
+    assert len(diags) == 1  # one report per inverted pair, not per edge
+    assert diags[0].is_error
+    assert "deadlock" in diags[0].message
+
+
+def test_pwt201_negative_consistent_order(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ingest(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def query(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    assert only(diags, "PWT201") == []
+
+
+def test_pwt201_inversion_through_method_call(tmp_path):
+    # `with a: self.helper()` where helper takes b, vs `with b: ... a` —
+    # one self-call level of propagation must close the cycle
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def helper(self):
+                with self._b:
+                    pass
+
+            def ingest(self):
+                with self._a:
+                    self.helper()
+
+            def query(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert len(only(diags, "PWT201")) == 1
+
+
+# ---------------------------------------------------------------------------
+# PWT202 — unguarded cross-thread writes
+# ---------------------------------------------------------------------------
+
+_RACY = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.counter = 0
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+        def bump(self):
+            self.counter += 1
+
+        def _run(self):
+            while True:
+                self.counter += 1
+"""
+
+
+def test_pwt202_unguarded_cross_root_write(tmp_path):
+    diags = only(run_check(tmp_path, _RACY), "PWT202")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "Worker.counter" in diags[0].message
+
+
+def test_pwt202_negative_common_guard(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.counter = 0
+                self._lock = threading.Lock()
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def bump(self):
+                with self._lock:
+                    self.counter += 1
+
+            def _run(self):
+                while True:
+                    with self._lock:
+                        self.counter += 1
+    """)
+    assert only(diags, "PWT202") == []
+
+
+def test_pwt202_negative_guard_via_calling_method(tmp_path):
+    # the write sits in a helper that every root calls under the lock —
+    # guaranteed-held propagation must count it as guarded
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.counter = 0
+                self._lock = threading.Lock()
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _bump_locked(self):
+                self.counter += 1
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _run(self):
+                while True:
+                    with self._lock:
+                        self._bump_locked()
+    """)
+    assert only(diags, "PWT202") == []
+
+
+def test_pwt202_negative_init_writes_do_not_count(tmp_path):
+    # __init__ runs before any thread exists
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.counter = 0
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+
+            def _run(self):
+                while True:
+                    self.counter += 1
+    """)
+    assert only(diags, "PWT202") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT203 — lock held across blocking call
+# ---------------------------------------------------------------------------
+
+_HELD_FSYNC = """
+    import os
+    import threading
+
+    class Log:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._f = None
+
+        def append(self, blob):
+            with self._lock:
+                os.fsync(self._f.fileno())
+"""
+
+
+def test_pwt203_fsync_under_lock(tmp_path):
+    diags = only(run_check(tmp_path, _HELD_FSYNC), "PWT203")
+    assert len(diags) == 1
+    assert "os.fsync" in diags[0].message
+
+
+def test_pwt203_negative_fsync_outside_lock(tmp_path):
+    diags = run_check(tmp_path, """
+        import os
+        import threading
+
+        class Log:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._f = None
+
+            def append(self, blob):
+                with self._lock:
+                    pending = blob
+                os.fsync(self._f.fileno())
+    """)
+    assert only(diags, "PWT203") == []
+
+
+def test_pwt203_bridge_submit_under_lock(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Loop:
+            def __init__(self, bridge):
+                self._state_lock = threading.Lock()
+                self._bridge = bridge
+
+            def tick(self, t, leg):
+                with self._state_lock:
+                    self._bridge.submit(t, leg)
+    """)
+    assert len(only(diags, "PWT203")) == 1
+
+
+def test_pwt203_negative_pool_submit_is_not_blocking(tmp_path):
+    # ThreadPoolExecutor.submit returns immediately — only bridge-shaped
+    # receivers count
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Loop:
+            def __init__(self, pool):
+                self._state_lock = threading.Lock()
+                self._pool = pool
+
+            def tick(self, fn):
+                with self._state_lock:
+                    self._pool.submit(fn)
+    """)
+    assert only(diags, "PWT203") == []
+
+
+def test_pwt203_wait_with_second_lock_held(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        class TwoLocks:
+            def __init__(self):
+                self._state = threading.Lock()
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def consume(self):
+                with self._state:
+                    with self._cv:
+                        while not self.ready:
+                            self._cv.wait()
+    """)
+    assert len(only(diags, "PWT203")) == 1
+    assert "releases" in only(diags, "PWT203")[0].message
+
+
+# ---------------------------------------------------------------------------
+# PWT204 — dropped daemon handle
+# ---------------------------------------------------------------------------
+
+def test_pwt204_dropped_daemon_handle(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        def fire_and_forget(fn):
+            threading.Thread(target=fn, daemon=True).start()
+    """)
+    assert len(only(diags, "PWT204")) == 1
+
+
+def test_pwt204_negative_kept_handles(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Owner:
+            def __init__(self):
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                pass
+
+        def start_joined(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            t.join()
+
+        def start_returned(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+    """)
+    assert only(diags, "PWT204") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT205 — Condition.wait without a predicate loop
+# ---------------------------------------------------------------------------
+
+def test_pwt205_wait_without_loop(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def take(self):
+                with self._cv:
+                    self._cv.wait()
+    """)
+    hits = only(diags, "PWT205")
+    assert len(hits) == 1
+    assert hits[0].is_error
+
+
+def test_pwt205_negative_loop_and_wait_for(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.items = []
+
+            def take(self):
+                with self._cv:
+                    while not self.items:
+                        self._cv.wait()
+                    return self.items.pop()
+
+            def take2(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self.items)
+                    return self.items.pop()
+    """)
+    assert only(diags, "PWT205") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT206 — sleep-polling where an Event exists
+# ---------------------------------------------------------------------------
+
+def test_pwt206_sleep_poll_with_event(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+        import time
+
+        class Loop:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def run(self):
+                while not self._stop.is_set():
+                    time.sleep(0.05)
+    """)
+    assert len(only(diags, "PWT206")) == 1
+    assert "_stop" in only(diags, "PWT206")[0].message
+
+
+def test_pwt206_negative_event_wait_and_no_event(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+        import time
+
+        class Loop:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def run(self):
+                while not self._stop.wait(0.05):
+                    pass
+
+        def module_level_retry():
+            while True:
+                time.sleep(0.05)
+    """)
+    # the Event.wait loop is the fix; the module-level retry loop has no
+    # Event in scope to wait on
+    assert only(diags, "PWT206") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT207 — bare threading.Thread
+# ---------------------------------------------------------------------------
+
+def test_pwt207_raw_thread(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        def go(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+    """)
+    assert len(only(diags, "PWT207")) == 1
+
+
+def test_pwt207_negative_factory_spawn(tmp_path):
+    diags = run_check(tmp_path, """
+        from pathway_tpu.engine.threads import spawn
+
+        def go(fn):
+            return spawn(fn, name="worker")
+    """)
+    assert only(diags, "PWT207") == []
+
+
+def test_pwt207_raw_lock_construction(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        _LOCK = threading.Lock()
+    """)
+    hits = only(diags, "PWT207")
+    assert len(hits) == 1
+    assert "threading.Lock" in hits[0].message
+
+
+def test_pwt207_negative_lock_factory_and_provider_module(tmp_path):
+    # factory calls are fine, and a module DEFINING create_lock is the
+    # provider — its own threading.Lock() constructions are exempt
+    diags = run_check(tmp_path, """
+        import threading
+
+        def create_lock(name):
+            return threading.Lock()
+    """)
+    assert only(diags, "PWT207") == []
+
+
+def test_init_py_modules_get_package_qualified_ids(tmp_path):
+    # two packages' __init__.py each define a module-global lock nested
+    # in opposite orders relative to a shared class lock: distinct ids
+    # (per package) mean no spurious cross-package inversion
+    shared = """
+        import threading
+
+        _LOCK = threading.Lock()  # pwt-ok: PWT207
+
+        class C_{pkg}:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def go(self):
+                with {outer}:
+                    with {inner}:
+                        pass
+    """
+    for pkg, outer, inner in (("alpha", "_LOCK", "self._mu"),
+                              ("beta", "self._mu", "_LOCK")):
+        d = tmp_path / pkg
+        d.mkdir()
+        (d / "__init__.py").write_text(textwrap.dedent(
+            shared.format(pkg=pkg, outer=outer, inner=inner)))
+    diags = check_concurrency([str(tmp_path)])
+    assert only(diags, "PWT201") == []
+    inv = concurrency_inventory([str(tmp_path)])
+    ids = {lk["lock_id"] for lk in inv["locks"]}
+    assert "alpha._LOCK" in ids and "beta._LOCK" in ids
+
+
+# ---------------------------------------------------------------------------
+# PWT208 — notify outside the condition's with
+# ---------------------------------------------------------------------------
+
+def test_pwt208_notify_outside_with(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def put(self, item):
+                self._cv.notify_all()
+    """)
+    assert len(only(diags, "PWT208")) == 1
+    assert only(diags, "PWT208")[0].is_error
+
+
+def test_pwt208_negative_notify_inside_with(tmp_path):
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def put(self, item):
+                with self._cv:
+                    self._cv.notify_all()
+    """)
+    assert only(diags, "PWT208") == []
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+
+def test_waiver_suppresses_named_code(tmp_path):
+    diags = run_check(tmp_path, """
+        import os
+        import threading
+
+        class Log:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._f = None
+
+            def append(self, blob):
+                with self._lock:
+                    # pwt-ok: PWT203 — single-writer log, contention-free
+                    os.fsync(self._f.fileno())
+    """)
+    assert only(diags, "PWT203") == []
+
+
+def test_waiver_for_other_code_does_not_suppress(tmp_path):
+    diags = run_check(tmp_path, """
+        import os
+        import threading
+
+        class Log:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._f = None
+
+            def append(self, blob):
+                with self._lock:
+                    # pwt-ok: PWT204
+                    os.fsync(self._f.fileno())
+    """)
+    assert len(only(diags, "PWT203")) == 1
+
+
+def test_syntax_error_is_pwt000_not_silently_skipped(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def uh(:\n")
+    diags = check_concurrency([str(f)])
+    assert codes(diags) == ["PWT000"]
+    assert diags[0].is_error
+
+
+# ---------------------------------------------------------------------------
+# inventories
+# ---------------------------------------------------------------------------
+
+def test_inventories(tmp_path):
+    f = tmp_path / "inv.py"
+    f.write_text(textwrap.dedent("""
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+                self._stop = threading.Event()
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                with self._lock:
+                    with self._cv:
+                        pass
+    """))
+    inv = concurrency_inventory([str(f)])
+    lock_ids = {lk["lock_id"]: lk["kind"] for lk in inv["locks"]}
+    assert lock_ids["Engine._lock"] == "lock"
+    assert lock_ids["Engine._cv"] == "condition"
+    assert lock_ids["Engine._stop"] == "event"
+    [t] = inv["threads"]
+    assert t["target"] == "Engine._run"
+    assert t["handle_kept"] is True
+    assert ("Engine._lock", "Engine._cv") in [
+        tuple(e) for e in inv["order_edges"]]
+
+
+# ---------------------------------------------------------------------------
+# dogfood: the engine itself must be clean (the CI gate's contract)
+# ---------------------------------------------------------------------------
+
+def test_engine_source_is_concurrency_clean():
+    assert check_concurrency(["pathway_tpu/engine"]) == []
+
+
+def test_io_and_parallel_sources_are_concurrency_clean():
+    assert check_concurrency(["pathway_tpu/io", "pathway_tpu/parallel"]) \
+        == []
+
+
+def test_seeded_negative_example_trips_the_gate():
+    diags = check_concurrency(["tests/concurrency_negative_example.py"])
+    assert any(d.code == "PWT201" and d.is_error for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# CLI front door
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", "check", *args],
+        capture_output=True, text=True, env=None)
+
+
+def test_cli_concurrency_clean_and_json():
+    proc = _run_cli("--concurrency", "--json", "pathway_tpu/engine")
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["diagnostics"] == []
+    targets = {t["target"] for t in payload["inventory"]["threads"]}
+    assert "DeviceBridge._work" in targets
+    assert "Watchdog._run" in targets
+
+
+def test_cli_concurrency_seeded_inversion_fails():
+    proc = _run_cli("--concurrency",
+                    "tests/concurrency_negative_example.py")
+    assert proc.returncode == 1
+    assert "PWT201" in proc.stdout

@@ -1,0 +1,289 @@
+"""Runtime lock-order sanitizer (engine/locking.py): factory behavior with
+the sanitizer off and on, inversion detection (raise + report modes),
+held-across-blocking reporting, condition-wait bookkeeping, and the
+excepthook/thread-factory wiring (engine/threads.py)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from pathway_tpu.engine import locking
+from pathway_tpu.engine.locking import (HeldAcrossBlockingViolation,
+                                        LockOrderViolation, blocking_call,
+                                        create_condition, create_lock,
+                                        create_rlock, held_locks,
+                                        violations)
+
+
+@pytest.fixture
+def sanitizer(monkeypatch):
+    monkeypatch.setenv("PATHWAY_LOCK_SANITIZER", "1")
+    locking._reset_for_tests()
+    yield
+    locking._reset_for_tests()
+
+
+@pytest.fixture
+def sanitizer_report(monkeypatch):
+    monkeypatch.setenv("PATHWAY_LOCK_SANITIZER", "report")
+    locking._reset_for_tests()
+    yield
+    locking._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# factories
+# ---------------------------------------------------------------------------
+
+def test_factories_return_plain_primitives_when_off(monkeypatch):
+    monkeypatch.delenv("PATHWAY_LOCK_SANITIZER", raising=False)
+    assert isinstance(create_lock("X.a"), type(threading.Lock()))
+    assert isinstance(create_rlock("X.b"), type(threading.RLock()))
+    assert isinstance(create_condition("X.c"), threading.Condition)
+
+
+def test_sanitized_lock_basics(sanitizer):
+    lock = create_lock("T.basics")
+    assert not lock.locked()
+    with lock:
+        assert lock.locked()
+        assert held_locks() == ["T.basics"]
+    assert not lock.locked()
+    assert held_locks() == []
+
+
+def test_sanitized_rlock_is_reentrant(sanitizer):
+    lock = create_rlock("T.rlock")
+    with lock:
+        with lock:
+            assert held_locks() == ["T.rlock", "T.rlock"]
+    assert held_locks() == []
+    assert violations() == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order inversion
+# ---------------------------------------------------------------------------
+
+def test_inversion_raises_and_names_both_locks(sanitizer):
+    a = create_lock("T.a")
+    b = create_lock("T.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderViolation, match="T.a.*T.b|T.b.*T.a"):
+            a.acquire()
+    # the violation is recorded AND the physical lock was put back —
+    # a raise must not wedge every other thread on the lock forever
+    assert [v["kind"] for v in violations()] == ["lock-order"]
+    assert a.acquire(blocking=False)
+    a.release()
+
+
+def test_inversion_detected_across_threads(sanitizer):
+    # thread 1 establishes a→b; the MAIN thread then takes b→a: the graph
+    # is global, so the cycle is caught even though no single thread ever
+    # held both orders
+    a = create_lock("T.x")
+    b = create_lock("T.y")
+
+    def establish():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=establish)
+    t.start()
+    t.join()
+    with b:
+        with pytest.raises(LockOrderViolation):
+            with a:
+                pass
+
+
+def test_consistent_order_never_fires(sanitizer):
+    a = create_lock("T.c1")
+    b = create_lock("T.c2")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert violations() == []
+
+
+def test_report_mode_records_without_raising(sanitizer_report):
+    a = create_lock("T.r1")
+    b = create_lock("T.r2")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # inversion: logged, not raised
+            pass
+    assert [v["kind"] for v in violations()] == ["lock-order"]
+
+
+def test_same_name_locks_share_identity(sanitizer):
+    # two instances of one class share the lock name on purpose: no
+    # self-edge, no false inversion from instance pairs
+    a1 = create_lock("Inst._lock")
+    a2 = create_lock("Inst._lock")
+    with a1:
+        with a2:
+            pass
+    with a2:
+        with a1:
+            pass
+    assert violations() == []
+
+
+# ---------------------------------------------------------------------------
+# held-across-blocking
+# ---------------------------------------------------------------------------
+
+def test_blocking_call_with_lock_held_raises(sanitizer):
+    lock = create_lock("T.held")
+    with lock:
+        with pytest.raises(HeldAcrossBlockingViolation, match="fsync"):
+            with blocking_call("persistence.fsync"):
+                pass
+    assert [v["kind"] for v in violations()] == ["held-across-blocking"]
+
+
+def test_blocking_call_without_lock_is_free(sanitizer):
+    with blocking_call("persistence.fsync"):
+        pass
+    assert violations() == []
+
+
+def test_blocking_call_noop_when_sanitizer_off(monkeypatch):
+    monkeypatch.delenv("PATHWAY_LOCK_SANITIZER", raising=False)
+    with blocking_call("anything"):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# sanitized conditions
+# ---------------------------------------------------------------------------
+
+def test_condition_wait_releases_only_its_own_lock(sanitizer):
+    cv = create_condition("T.cv")
+    # waiting while holding ONLY the condition is the normal protocol
+    with cv:
+        cv.wait(timeout=0.01)
+    assert violations() == []
+    assert held_locks() == []
+
+
+def test_condition_wait_with_second_lock_is_a_violation(sanitizer):
+    lock = create_lock("T.other")
+    cv = create_condition("T.cv2")
+    with lock:
+        with cv:
+            with pytest.raises(HeldAcrossBlockingViolation,
+                               match="T.other"):
+                cv.wait(timeout=0.01)
+    assert held_locks() == []
+
+
+def test_condition_notify_roundtrip(sanitizer):
+    cv = create_condition("T.cv3")
+    state = {"ready": False}
+    got = []
+
+    def consumer():
+        with cv:
+            while not state["ready"]:
+                cv.wait(timeout=5.0)
+            got.append(True)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    with cv:
+        state["ready"] = True
+        cv.notify_all()
+    t.join(timeout=5.0)
+    assert got == [True]
+    assert violations() == []
+
+
+# ---------------------------------------------------------------------------
+# engine integration: the real lock points run sanitized
+# ---------------------------------------------------------------------------
+
+def test_device_bridge_runs_sanitized(sanitizer):
+    from pathway_tpu.engine.device_bridge import DeviceBridge
+
+    bridge = DeviceBridge(max_inflight=2, name="sanitized-bridge")
+    seen = []
+    for t in range(1, 6):
+        bridge.submit(t, lambda t=t: seen.append(t))
+    bridge.barrier()
+    bridge.close()
+    assert seen == [1, 2, 3, 4, 5]
+    assert bridge.resolved_watermark() == 5
+    assert violations() == []
+
+
+# ---------------------------------------------------------------------------
+# thread factory + excepthook (engine/threads.py)
+# ---------------------------------------------------------------------------
+
+def test_spawn_names_and_inventories_threads():
+    import time
+
+    from pathway_tpu.engine import threads
+
+    release = threading.Event()
+    t = threads.spawn(release.wait, name="unit-test-worker")
+    try:
+        assert t.name == "pathway-tpu-unit-test-worker"
+        assert t.daemon
+        deadline = time.monotonic() + 2.0
+        names = []
+        while time.monotonic() < deadline:
+            names = [e["name"] for e in threads.live_threads()]
+            if "pathway-tpu-unit-test-worker" in names:
+                break
+        assert "pathway-tpu-unit-test-worker" in names
+    finally:
+        release.set()
+        t.join(timeout=5.0)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_uncaught_thread_exception_lands_in_errorlog_and_healthz():
+    # the chained previous hook still fires (pytest's own warning proves
+    # the chain is intact); the assertion is about OUR side effects
+    from pathway_tpu.engine import threads
+    from pathway_tpu.engine.supervisor import ConnectorSupervisor
+    from pathway_tpu.internals import error as error_mod
+
+    threads._reset_crashes_for_tests()
+    before = len(error_mod._global_log.entries)
+    # the supervisor exists BEFORE its thread dies (the run's ordering);
+    # crash accounting is epoch-scoped to the supervisor's creation
+    sup = ConnectorSupervisor()
+
+    def boom():
+        raise RuntimeError("seeded thread crash")
+
+    t = threads.spawn(boom, name="crasher")
+    t.join(timeout=5.0)
+    crashes = threads.crashed_threads()
+    try:
+        assert any("seeded thread crash" in c["error"] for c in crashes)
+        new = error_mod._global_log.entries[before:]
+        assert any(e["kind"] == "thread"
+                   and "seeded thread crash" in e["message"] for e in new)
+        # the supervisor's health predicate (hence /healthz) degrades
+        assert not sup.healthy()
+        # ...but a NEW run in the same process starts healthy: old
+        # crashes must not poison it forever
+        assert ConnectorSupervisor().healthy()
+    finally:
+        threads._reset_crashes_for_tests()
