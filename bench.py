@@ -419,6 +419,16 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             errors["durability_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
+    if "recovery" not in SKIP:
+        # bounded-recovery leg (CPU-runnable): restart wall-clock at
+        # 1k/10k/100k-row histories, WAL-only (linear) vs snapshot+suffix
+        # (~flat) — the evidence that compaction bounds restart by data
+        # size, not stream age
+        try:
+            result.update(bench_recovery())
+        except Exception as e:  # noqa: BLE001
+            errors["recovery_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
     # sidecar path for the device-phase flight beacon, inherited by the
     # child processes; every emit below reads it, so the last surviving
     # JSON line always carries whatever attribution the child reported
@@ -1377,6 +1387,101 @@ def bench_durability() -> dict:
         out[f"durability_wall_s_{tag}"] = round(leg["wall_s"], 3)
     out["durability_watermark_lag_ticks"] = p4["pstats"].get(
         "lag_ticks", 0)
+    return out
+
+
+def bench_recovery() -> dict:
+    """Bounded-time crash recovery (PR 10): restart wall-clock vs history
+    size, WAL-only vs snapshot+suffix (engine/persistence.py operator-state
+    snapshots + compaction).
+
+    For each history size H: synthesize a WAL of H rows directly through
+    the durable log API (the on-disk format a real run writes), then
+    measure a restart three ways — (1) full-WAL replay, (2) one more
+    replay with snapshots ON (its teardown writes the generation and
+    compacts), (3) the snapshot-restored restart. WAL-only restart grows
+    linearly with H; the snapshot restart must stay ~flat: the acceptance
+    bar is restart(100k) <= 2x restart(1k) with snapshots on, reported as
+    ``recovery_snapshot_ratio_maxmin``.
+    """
+    import tempfile
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine.persistence import PersistenceDriver
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.internals.parse_graph import G
+
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_RECOVERY_ROWS", "1000,10000,100000").split(",")]
+    chunk = 500  # rows per WAL record (one commit's worth)
+
+    class _Closed(pw.io.python.ConnectorSubject):
+        def run(self):
+            return  # nothing live: the restart is pure recovery
+
+    def run_restart(pdir: str) -> float:
+        G.clear()
+        t = pw.io.python.read(
+            _Closed(), schema=pw.schema_from_types(word=str),
+            autocommit_duration_ms=10, persistent_id="bench-recovery")
+        counts = t.groupby(t.word).reduce(word=t.word,
+                                          c=pw.reducers.count())
+        pw.io.subscribe(counts, lambda *a, **k: None)
+        cfg = pw.persistence.Config.simple_config(
+            pw.persistence.Backend.filesystem(pdir))
+        t0 = time.perf_counter()
+        pw.run(persistence_config=cfg)
+        wall = time.perf_counter() - t0
+        G.clear()
+        return wall
+
+    out: dict = {}
+    prior = {k: os.environ.get(k) for k in
+             ("PATHWAY_SNAPSHOT_EVERY_TICKS", "PATHWAY_DEVICE_INFLIGHT")}
+    os.environ["PATHWAY_DEVICE_INFLIGHT"] = "1"
+    snap_restarts: dict[int, float] = {}
+    try:
+        for n in sizes:
+            with tempfile.TemporaryDirectory() as td:
+                pdir = os.path.join(td, "p")
+                driver = PersistenceDriver(
+                    pw.persistence.Config.simple_config(
+                        pw.persistence.Backend.filesystem(pdir)))
+                log = driver._log_for("bench-recovery")
+                # fixed 1000-word vocabulary at every history size: the
+                # aggregation STATE stays constant while the input log
+                # grows — exactly the regime where an input-WAL restart
+                # is O(stream age) and a state snapshot is O(state)
+                tick = 0
+                for base in range(0, n, chunk):
+                    tick += 1
+                    log.append(tick, [
+                        (Pointer(i), (f"w{i % 1000}",), 1, None)
+                        for i in range(base, min(base + chunk, n))])
+                log.close()
+                os.environ.pop("PATHWAY_SNAPSHOT_EVERY_TICKS", None)
+                # min of two: first-run import/compile noise must not
+                # masquerade as replay cost (both restarts are pure
+                # recovery over the identical root)
+                wal_s = min(run_restart(pdir), run_restart(pdir))
+                # snapshot-prep replay: teardown writes the generation
+                # covering the whole history and compacts the WAL
+                os.environ["PATHWAY_SNAPSHOT_EVERY_TICKS"] = "1000000000"
+                run_restart(pdir)
+                snap_s = min(run_restart(pdir), run_restart(pdir))
+                out[f"recovery_walonly_restart_s_{n}"] = round(wal_s, 3)
+                out[f"recovery_snapshot_restart_s_{n}"] = round(snap_s, 3)
+                snap_restarts[n] = snap_s
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if snap_restarts:
+        lo, hi = min(sizes), max(sizes)
+        out["recovery_snapshot_ratio_maxmin"] = round(
+            snap_restarts[hi] / max(snap_restarts[lo], 1e-9), 3)
     return out
 
 

@@ -198,6 +198,31 @@ class DeviceBridge:
         return {"tick": cur[0],
                 "since_s": round(_time.monotonic() - cur[1], 3)}
 
+    def wait_watermark(self, tick: int) -> int:
+        """Block until the resolved watermark reaches ``tick``; re-raise a
+        leg failure. Unlike :meth:`barrier` this does NOT wait for the
+        queue to drain — it waits only for the durability frontier, and
+        returns the (possibly short) frontier when the bridge goes idle
+        or closed without reaching ``tick`` (callers treat < tick as
+        'no consistent cut available — skip'). The snapshot pass is the
+        caller: at cadence ticks the host thread has just submitted leg
+        ``tick`` and submits nothing more until this returns, so reaching
+        the watermark means every operator sits exactly at ``tick``."""
+        from pathway_tpu.engine.locking import assert_unlocked
+
+        assert_unlocked("DeviceBridge.wait_watermark")
+        with self._cv:
+            while self._watermark < tick and self._error is None:
+                if not self._queue and not self._running:
+                    break  # idle/closed: nothing left to advance it
+                self._waiters += 1
+                try:
+                    self._cv.wait()
+                finally:
+                    self._waiters -= 1
+            self._raise_if_error()
+            return self._watermark
+
     def resolved_watermark(self) -> int:
         """Tick of the longest fully-resolved prefix of submitted legs
         (monotone; 0 before anything resolved). Every leg with tick <=

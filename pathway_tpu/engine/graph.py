@@ -273,6 +273,87 @@ class Scheduler:
         if self._bridge is not None:
             self._bridge.barrier()
 
+    def wait_watermark(self, tick: int) -> int:
+        """Block until the resolved-prefix watermark reaches ``tick``
+        (synchronous mode: already there). Unlike :meth:`resolve_barrier`
+        this waits ONLY on the watermark — it never drains legs beyond
+        ``tick`` and it returns early (with the frozen watermark) when the
+        bridge goes idle without reaching it. The snapshot pass uses it
+        to obtain a consistent operator-state cut at exactly ``tick``."""
+        if self._bridge is not None:
+            return self._bridge.wait_watermark(tick)
+        return tick
+
+    # -- operator-state snapshots (engine/persistence.py) -----------------
+    def graph_fingerprint(self) -> list:
+        """Stable identity of the plan this scheduler runs: a snapshot
+        taken by one process image must not restore into a different
+        graph. Node ids are construction-ordered and operator CLASSES are
+        program-determined; node *names* are not used — they embed
+        process-global counters (table_0 vs table_1) that differ between
+        otherwise identical runs."""
+        return [(n.id, type(n.op).__name__,
+                 tuple(up.id for up in n.inputs))
+                for n in self.graph.nodes]
+
+    def snapshot_operator_states(self) -> dict:
+        """Per-node, per-replica plain-data state capture (None entries
+        for stateless replicas are dropped node-wise). Caller guarantees
+        the pipeline is quiescent at the snapshot tick (wait_watermark).
+        Raises ``SnapshotUnsupported`` when any operator cannot
+        capture."""
+        states: dict[int, list] = {}
+        for node in self.graph.nodes:
+            per = [op.snapshot_state() for op in self._replicas[node.id]]
+            if any(st is not None for st in per):
+                states[node.id] = per
+        return states
+
+    def restore_operator_states(self, states: dict) -> None:
+        """Load a snapshot's per-node states into the freshly-built
+        replicas. Mismatched node ids / replica counts mean the program
+        changed between runs — raise loudly (the WAL prefix the snapshot
+        covers is compacted away; silently dropping state would produce
+        wrong answers, not a slow restart)."""
+        for nid, per in states.items():
+            reps = self._replicas.get(int(nid))
+            if reps is None:
+                raise ValueError(
+                    f"snapshot carries state for node {nid} which this "
+                    "run's graph does not have — the pipeline changed "
+                    "between runs; clear the persistence root to start "
+                    "fresh")
+            if len(per) != len(reps):
+                raise ValueError(
+                    f"snapshot for node {nid} has {len(per)} replica "
+                    f"states but this run built {len(reps)} replicas "
+                    "(n_workers changed between runs)")
+            for op, st in zip(reps, per):
+                if st is not None:
+                    op.restore_state(st)
+
+    def emit_restored_outputs(self, tick: int) -> None:
+        """Re-emit every restored OutputOperator's consolidated state to
+        its sink at ``tick`` — what full replay of the compacted prefix
+        would have re-emitted by reprocessing it."""
+        from pathway_tpu.engine.operators import OutputOperator
+
+        for node in self.graph.nodes:
+            for op in self._replicas[node.id]:
+                if isinstance(op, OutputOperator):
+                    op.emit_restored(tick)
+
+    def enable_output_tracking(self) -> None:
+        """Turn on consolidated emitted-state tracking on every output
+        operator (required before any data flows in a snapshotting
+        run)."""
+        from pathway_tpu.engine.operators import OutputOperator
+
+        for node in self.graph.nodes:
+            for op in self._replicas[node.id]:
+                if isinstance(op, OutputOperator):
+                    op.track_emitted = True
+
     def commit_watermark(self, completed_tick: int) -> int:
         """The durability frontier for a persistence commit issued after
         ``completed_tick`` returned from :meth:`run_time`: with pipelining
@@ -864,6 +945,24 @@ class IterateOperator(Operator):
         self.input_states = [Arrangement() for _ in range(self.arity)]
         self.emitted: list[Arrangement] = []
         self.n_results: int | None = None
+
+    def snapshot_state(self):
+        # the fixpoint re-runs per outer timestamp over the FULL input
+        # state, so inputs + what was already emitted are the whole state
+        return {"inputs": [st.rows for st in self.input_states],
+                "emitted": [st.rows for st in self.emitted],
+                "n_results": self.n_results}
+
+    def restore_state(self, state) -> None:
+        for st, rows in zip(self.input_states, state["inputs"]):
+            st.rows = dict(rows)
+        self.n_results = state["n_results"]
+        if self.n_results is not None:
+            self.emitted = []
+            for rows in state["emitted"]:
+                arr = Arrangement()
+                arr.rows = dict(rows)
+                self.emitted.append(arr)
 
     def step(self, time, in_deltas):
         if not any(in_deltas):

@@ -350,6 +350,28 @@ class MonitoringHttpServer:
                 "# TYPE pathway_tpu_persistence_write_retries counter")
             lines.append(f"pathway_tpu_persistence_write_retries "
                          f"{pst['write_retries']}")
+            # snapshot tier (bounded-time recovery): age names a wedged
+            # snapshot loop, wal_replayable_entries is the restart cost
+            # compaction bounds, compactions prove truncation happens
+            lines.append("# TYPE pathway_tpu_snapshot_age_ticks gauge")
+            lines.append(f"pathway_tpu_snapshot_age_ticks "
+                         f"{pst['snapshot_age_ticks']}")
+            lines.append("# TYPE pathway_tpu_snapshot_bytes gauge")
+            lines.append(
+                f"pathway_tpu_snapshot_bytes {pst['snapshot_bytes']}")
+            lines.append("# TYPE pathway_tpu_snapshot_generation gauge")
+            lines.append(f"pathway_tpu_snapshot_generation "
+                         f"{pst['snapshot_generation']}")
+            lines.append("# TYPE pathway_tpu_snapshots_total counter")
+            lines.append(
+                f"pathway_tpu_snapshots_total {pst['snapshots_total']}")
+            lines.append("# TYPE pathway_tpu_compactions_total counter")
+            lines.append(
+                f"pathway_tpu_compactions_total {pst['compactions_total']}")
+            lines.append(
+                "# TYPE pathway_tpu_wal_replayable_entries gauge")
+            lines.append(f"pathway_tpu_wal_replayable_entries "
+                         f"{pst['wal_replayable_entries']}")
             lines.append("# TYPE pathway_tpu_commit_wait_ms histogram")
             for le, c in persistence.commit_wait.cumulative():
                 le_s = "+Inf" if le == float("inf") else format(le, "g")

@@ -16,7 +16,8 @@ from __future__ import annotations
 from typing import Any, Protocol
 
 from pathway_tpu.engine.delta import Delta
-from pathway_tpu.engine.operators import Exchange, Operator
+from pathway_tpu.engine.operators import (Exchange, Operator,
+                                          SnapshotUnsupported)
 from pathway_tpu.internals.keys import Pointer
 
 
@@ -100,6 +101,30 @@ class ExternalIndexOperator(Operator):
                 "cross-topology transfer; build the index with mesh='auto' "
                 "or the active mesh",
                 dict(slab_mesh.shape), dict(active.shape))
+
+    def snapshot_state(self):
+        """Answers + standing queries, plus (primary replica only) the
+        index's own capture — for the device-resident KNN slab that is
+        the HOST page-table view and the live vectors, so a restore
+        re-uploads extents without re-running the embedder
+        (ops/knn.py ``snapshot_state``)."""
+        st: dict = {"answers": self.answers,
+                    "live_queries": self.live_queries}
+        if self._is_primary:
+            if not hasattr(self.index, "snapshot_state"):
+                raise SnapshotUnsupported(
+                    f"external index {type(self.index).__name__} has no "
+                    "snapshot_state/restore_state hooks — operator-state "
+                    "snapshots are disabled for this run (recovery falls "
+                    "back to full-WAL replay)")
+            st["index"] = self.index.snapshot_state()
+        return st
+
+    def restore_state(self, state) -> None:
+        self.answers = dict(state["answers"])
+        self.live_queries = dict(state["live_queries"])
+        if self._is_primary and "index" in state:
+            self.index.restore_state(state["index"])
 
     def replicate(self, n: int):
         import copy

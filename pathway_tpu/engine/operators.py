@@ -56,6 +56,14 @@ class Exchange:
     BROADCAST = "broadcast"
 
 
+class SnapshotUnsupported(RuntimeError):
+    """Raised by ``snapshot_state`` when an operator holds state it cannot
+    capture as plain data (e.g. an external index without capture hooks).
+    The streaming runtime disables snapshotting for the run — recovery
+    falls back to full-WAL replay — instead of writing a checkpoint that
+    silently misses state."""
+
+
 class Operator:
     arity = 1
     # False for ops whose replicas share mutable state (e.g. one device
@@ -105,6 +113,26 @@ class Operator:
         Only called once, at the final flush tick."""
         return Delta()
 
+    # -- operator-state checkpoints (engine/persistence.py snapshots) ------
+    def snapshot_state(self):
+        """Plain-data capture of this operator's accumulated state, or
+        ``None`` for stateless operators (the default). The returned value
+        must decode under the persistence layer's restricted unpickler:
+        containers, scalars, ndarrays, Pointers — never classes or
+        callables. Called by the Scheduler at a snapshot tick, with every
+        device leg <= that tick resolved (state is a consistent cut).
+        Raise :class:`SnapshotUnsupported` for state that cannot be
+        captured — the runtime then disables snapshots loudly."""
+        return None
+
+    def restore_state(self, state) -> None:
+        """Inverse of :meth:`snapshot_state`, called on a freshly-built
+        operator before any data flows."""
+        raise SnapshotUnsupported(
+            f"{type(self).__name__} recorded no snapshot hook but a "
+            "snapshot carries state for it — the graph changed between "
+            "runs, or the snapshot is foreign")
+
 
 class SourceOperator(Operator):
     """Fed externally by an input session; just passes its delta through."""
@@ -150,6 +178,19 @@ class MapOperator(Operator):
         ])
 
 
+def _stable_row_fp(row: tuple) -> int:
+    """Cross-process-stable row digest (hash_values: fixed blake2b salt)
+    for cache keys that must survive a snapshot restore into a NEW
+    interpreter — hash()-based row_fingerprint varies with the process
+    hash seed for string cells. Costlier than hash() per novel row
+    (hash_values memoizes repeats), but this keys only
+    DeterministicMapOperator, which exists to cache NON-deterministic
+    user fns — a path already dominated by the fn call itself; re-keying
+    at restore (the cheaper pattern used for multiset reducers) is
+    impossible here because the cache does not retain input rows."""
+    return int(hash_values(*row))
+
+
 class DeterministicMapOperator(MapOperator):
     """Map that caches outputs per key so retractions replay identical values
     even for non-deterministic fns (reference:
@@ -159,6 +200,15 @@ class DeterministicMapOperator(MapOperator):
         super().__init__(fn)
         self.cache: dict[tuple[Pointer, int], tuple] = {}
 
+    def snapshot_state(self):
+        # the cache IS semantics: retractions after restore must replay
+        # the exact values the non-deterministic fn produced pre-crash.
+        # Keys use the stable fingerprint, so they survive as-is.
+        return {"cache": self.cache}
+
+    def restore_state(self, state) -> None:
+        self.cache = dict(state["cache"])
+
     def step(self, time, in_deltas):
         delta = in_deltas[0]
         if not delta:
@@ -166,7 +216,7 @@ class DeterministicMapOperator(MapOperator):
         out = Delta()
         to_eval = []
         for key, row, diff in delta.entries:
-            ck = (key, row_fingerprint(row))
+            ck = (key, _stable_row_fp(row))
             if diff < 0 and ck in self.cache:
                 out.append(key, self.cache.pop(ck), diff)
             else:
@@ -252,6 +302,13 @@ class BinaryKeyOperator(Operator):
     def exchange_specs(self):
         return [Exchange.BY_KEY, Exchange.BY_KEY]
 
+    def snapshot_state(self):
+        return {"left": self.left.rows, "right": self.right.rows}
+
+    def restore_state(self, state) -> None:
+        self.left.rows = dict(state["left"])
+        self.right.rows = dict(state["right"])
+
     def step(self, time, in_deltas):
         dl, dr = in_deltas
         if not dl and not dr:
@@ -292,6 +349,13 @@ class NAryConcatOperator(Operator):
 
     def exchange_specs(self):
         return [Exchange.BY_KEY] * self.arity
+
+    def snapshot_state(self):
+        return {"states": [st.rows for st in self.states]}
+
+    def restore_state(self, state) -> None:
+        for st, rows in zip(self.states, state["states"]):
+            st.rows = dict(rows)
 
     def step(self, time, in_deltas):
         if not any(in_deltas):
@@ -519,6 +583,29 @@ class GroupByOperator(Operator):
         # exchanges by group key, dataflow.rs:2904)
         return [lambda key, row: self.group_fn(key, row)[0]]
 
+    def snapshot_state(self):
+        return {
+            "groups": {gkey: [st.state_dict() for st in states]
+                       for gkey, states in self.group_states.items()},
+            "vals": self.group_vals,
+            "counts": self.group_counts,
+            "out": self.out.rows,
+            "seq": self.seq,
+        }
+
+    def restore_state(self, state) -> None:
+        self.group_states = {}
+        for gkey, dicts in state["groups"].items():
+            states = [make_reducer_state(name, **kw)
+                      for name, _, kw in self.reducer_specs]
+            for st, d in zip(states, dicts):
+                st.load_state(d)
+            self.group_states[gkey] = states
+        self.group_vals = dict(state["vals"])
+        self.group_counts = dict(state["counts"])
+        self.out.rows = dict(state["out"])
+        self.seq = state["seq"]
+
     def step(self, time, in_deltas):
         delta = in_deltas[0]
         if not delta:
@@ -718,6 +805,44 @@ class ColumnarGroupByOperator(Operator):
             return [lambda key, row: canonical_shard_value(row[p])]
         ps = self.gval_pos
         return [lambda key, row: tuple(row[p] for p in ps)]
+
+    def snapshot_state(self):
+        n = len(self._gvals)
+        return {
+            "gvals": self._gvals,
+            "gkeys": self._gkeys,
+            "last": self._last,
+            "cnt": self._cnt[:n].copy(),
+            "sums": [s[:n].copy() for s in self._sums],
+            "big": self._big,
+            "mm": self._mm,
+        }
+
+    def restore_state(self, state) -> None:
+        self._gvals = [tuple(g) for g in state["gvals"]]
+        self._gkeys = list(state["gkeys"])
+        self._last = list(state["last"])
+        n = len(self._gvals)
+        self._cnt = np.asarray(state["cnt"], np.int64).copy()
+        self._sums = [np.asarray(s, np.int64).copy() for s in state["sums"]]
+        self._big = dict(state["big"])
+        for i in self._mm:
+            self._mm[i] = {c: dict(g)
+                           for c, g in state["mm"].get(i, {}).items()}
+        # the interning tables hold CLASS objects (typed keys) — never
+        # serialized; rebuilt from the group values exactly as _codes
+        # constructs them
+        self._intern = {}
+        self._by_gkey = {}
+        for code in range(n):
+            gvals = self._gvals[code]
+            self._by_gkey[self._gkeys[code]] = code
+            if len(self.gval_pos) == 1:
+                v = gvals[0]
+                tk = (v.__class__, v)
+            else:
+                tk = (tuple(v.__class__ for v in gvals), gvals)
+            self._intern[tk] = code
 
     def _add_group(self, tkey, gvals: tuple) -> int:
         # alias via the hashed key: distinct typed representations of
@@ -1000,6 +1125,14 @@ class JoinOperator(Operator):
         # one worker (reference: join exchanges, dataflow.rs:2276)
         return [lambda k, r: self.lkey_fn(k, r),
                 lambda k, r: self.rkey_fn(k, r)]
+
+    def snapshot_state(self):
+        # _mix_cache is a pure memo (rebuilds on demand) — never captured
+        return {"left": self.left, "right": self.right}
+
+    def restore_state(self, state) -> None:
+        self.left = {jk: dict(g) for jk, g in state["left"].items()}
+        self.right = {jk: dict(g) for jk, g in state["right"].items()}
 
     def _default_out_key(self, lkey, rkey, jk):
         ck = (lkey, rkey)
@@ -1339,6 +1472,13 @@ class DeduplicateOperator(Operator):
         self.acceptor = acceptor
         self.state: dict[Any, tuple[Pointer, tuple]] = {}
 
+    def snapshot_state(self):
+        return {"state": self.state}
+
+    def restore_state(self, state) -> None:
+        self.state = {inst: (k, tuple(r))
+                      for inst, (k, r) in state["state"].items()}
+
     def exchange_specs(self):
         # per-instance acceptance is order-sensitive: a single worker must
         # own each instance (reference: deduplicate exchanges by instance)
@@ -1380,22 +1520,70 @@ class DeduplicateOperator(Operator):
 
 
 class OutputOperator(Operator):
-    """Terminal capture: invokes callback(time, delta); passes delta through."""
+    """Terminal capture: invokes callback(time, delta); passes delta through.
+
+    Under operator-state snapshots (engine/persistence.py) it additionally
+    tracks the CONSOLIDATED emitted state — key -> (row, net count) — so a
+    restart restored from a snapshot can re-emit the covered prefix's
+    visible state to fresh sinks, exactly as a full-WAL replay would have
+    re-emitted it by reprocessing the prefix. Tracking is off (zero cost)
+    unless the runtime enables it for a snapshotting run.
+    """
 
     def __init__(self, callback: Callable[[int, Delta], None]):
         self.callback = callback
+        self.track_emitted = False
+        self.emitted: dict[Pointer, list] = {}  # key -> [row, net count]
 
     def replicate(self, n):
         # all workers funnel into the same sink: share the callback object
         # (a deepcopy of a bound method would clone its receiver and the
         # replica outputs would silently vanish into the copy)
-        return [self] + [OutputOperator(self.callback) for _ in range(n - 1)]
+        reps = [self]
+        for _ in range(n - 1):
+            r = OutputOperator(self.callback)
+            r.track_emitted = self.track_emitted
+            reps.append(r)
+        return reps
 
     def step(self, time, in_deltas):
         delta = in_deltas[0]
         if delta:
+            if self.track_emitted:
+                self._track(delta)
             self.callback(time, delta)
         return delta
+
+    def _track(self, delta: Delta) -> None:
+        emitted = self.emitted
+        for key, row, diff in delta.entries:
+            cur = emitted.get(key)
+            c = (cur[1] if cur is not None else 0) + diff
+            if c <= 0:
+                emitted.pop(key, None)
+            elif diff > 0 or cur is None:
+                emitted[key] = [row, c]
+            else:
+                cur[1] = c
+
+    def snapshot_state(self):
+        if not self.track_emitted:
+            return None
+        return {"emitted": {k: (tuple(r), c)
+                            for k, (r, c) in self.emitted.items()}}
+
+    def restore_state(self, state) -> None:
+        self.track_emitted = True
+        self.emitted = {k: [tuple(r), c]
+                        for k, (r, c) in state["emitted"].items()}
+
+    def emit_restored(self, time: int) -> None:
+        """Push the restored consolidated state to the sink as one initial
+        delta — the snapshot-mode stand-in for the output rows a full
+        replay of the covered prefix would have re-emitted."""
+        if self.emitted:
+            self.callback(time, Delta(
+                [(k, r, c) for k, (r, c) in self.emitted.items()]))
 
     def notify_time_end(self, time):
         pass
@@ -1409,6 +1597,12 @@ class StatefulArrangeOperator(Operator):
 
     def exchange_specs(self):
         return [Exchange.BY_KEY]
+
+    def snapshot_state(self):
+        return {"rows": self.state.rows}
+
+    def restore_state(self, state) -> None:
+        self.state.rows = dict(state["rows"])
 
     def step(self, time, in_deltas):
         self.state.update(in_deltas[0])
@@ -1433,6 +1627,14 @@ class SortOperator(Operator):
         # prev/next neighbours are computed within an instance: one worker
         # must own each instance (reference: operators/prev_next.rs)
         return [lambda k, r: self.instance_fn(k, r)]
+
+    def snapshot_state(self):
+        return {"instances": self.instances, "out": self.out.rows}
+
+    def restore_state(self, state) -> None:
+        self.instances = {inst: dict(g)
+                          for inst, g in state["instances"].items()}
+        self.out.rows = dict(state["out"])
 
     def step(self, time, in_deltas):
         delta = in_deltas[0]
@@ -1502,6 +1704,21 @@ class GradualBroadcastOperator(Operator):
         self.triplet: tuple | None = None
         self._threshold: int | None = None  # threshold of last emission
         self.emitted_apx: dict[Pointer, Any] = {}
+
+    def snapshot_state(self):
+        # emitted_apx may hold the _MISSING sentinel only transiently
+        # (pop side) — live values are plain data
+        return {"rows": self.rows, "triplet": self.triplet,
+                "threshold": self._threshold,
+                "emitted_apx": self.emitted_apx}
+
+    def restore_state(self, state) -> None:
+        self.rows = dict(state["rows"])
+        self.triplet = state["triplet"]
+        self._threshold = state["threshold"]
+        self.emitted_apx = dict(state["emitted_apx"])
+        self._sorted_keys = sorted(int(k) for k in self.rows)
+        self._by_int = {int(k): k for k in self.rows}
 
     def exchange_specs(self):
         # rows shard by key; the triplet stream is broadcast so every

@@ -47,6 +47,7 @@ logger = logging.getLogger(__name__)
 
 _HDR = struct.Struct("<QI")  # payload length, CRC32(payload)
 _MAGIC = b"PWSNAP01"  # format marker; bump the digit on layout changes
+_STATE_MAGIC = b"PWOPSNAP1"  # operator-state snapshot blob marker
 
 # Decode whitelist: data classes that legitimately appear inside logged
 # (time, [(key, row, diff, offset), ...]) records — engine Values
@@ -88,6 +89,54 @@ class _RestrictedUnpickler(pickle.Unpickler):
 
 def _safe_loads(payload: bytes):
     return _RestrictedUnpickler(io.BytesIO(payload)).load()
+
+
+# ---------------------------------------------------------------------------
+# snapshot/compaction knobs (cadence knobs live in engine/streaming.py)
+# ---------------------------------------------------------------------------
+
+def _keep_generations() -> int:
+    """Snapshot generations retained (>= 1). The WAL is truncated only to
+    the OLDEST retained generation's tick, so a corrupt newest snapshot
+    can always fall back one generation and still find its suffix."""
+    from pathway_tpu.internals.config import _env_int
+
+    return max(1, _env_int("PATHWAY_SNAPSHOT_KEEP_GENERATIONS", 2))
+
+
+def _compact_enabled() -> bool:
+    """PATHWAY_SNAPSHOT_COMPACT=0 writes snapshots without truncating the
+    WAL (the recovery-equivalence property tests compare snapshot+suffix
+    replay against full-WAL replay over the same root)."""
+    return os.environ.get("PATHWAY_SNAPSHOT_COMPACT", "1").lower() not in (
+        "0", "false", "off", "no")
+
+
+def _restore_enabled() -> bool:
+    """PATHWAY_SNAPSHOT_RESTORE=0 ignores existing snapshots on startup
+    (full-WAL replay — only sound while compaction is disabled or no
+    snapshot was ever written)."""
+    return os.environ.get("PATHWAY_SNAPSHOT_RESTORE", "1").lower() not in (
+        "0", "false", "off", "no")
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp + fsync + rename, like flight_recorder.atomic_write_json but
+    for a binary blob: a crash mid-write never leaves a truncated file at
+    ``path`` and never clobbers a previous good one."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 # ---------------------------------------------------------------------------
@@ -218,15 +267,43 @@ class SnapshotLog:
             length, crc = _HDR.unpack_from(data, pos)
             end = pos + _HDR.size + length
             if end > len(data):
+                # incomplete record: a torn tail (crash mid-append) — or
+                # a corrupted LENGTH header mid-log, which is
+                # indistinguishable byte-wise; say how much is dropped
+                # either way (the next append truncates it)
+                logger.warning(
+                    "%s: incomplete record at byte %d (%d trailing "
+                    "byte(s) dropped: torn tail, or a corrupt length "
+                    "header hiding later records)", self.path, pos,
+                    len(data) - pos)
                 break
             payload = data[pos + _HDR.size:end]
-            if zlib.crc32(payload) != crc:
-                break  # torn/corrupt tail — recover the prefix before it
-            try:
-                rec = _safe_loads(payload)
-            except pickle.UnpicklingError:
-                raise  # forbidden global = tampering, not a torn tail
-            except Exception:
+            bad = zlib.crc32(payload) != crc
+            if not bad:
+                try:
+                    rec = _safe_loads(payload)
+                except pickle.UnpicklingError:
+                    raise  # forbidden global = tampering, not a torn tail
+                except Exception:
+                    bad = True
+            if bad:
+                # a CRC/decode failure on the LAST framed record is the
+                # ordinary torn tail; one with more bytes behind it is
+                # mid-log corruption (bit rot, partial overwrite) — the
+                # per-record CRC catches it BEFORE the unpickler sees
+                # garbage, and recovery truncates at the first bad
+                # record, loudly, dropping whatever followed
+                if end < len(data):
+                    logger.error(
+                        "%s: corrupt record at byte %d (mid-log, %d bytes "
+                        "follow) — truncating the log at the first bad "
+                        "record; %d later byte(s) of history are "
+                        "unrecoverable and will be re-ingested live",
+                        self.path, pos, len(data) - end, len(data) - pos)
+                else:
+                    logger.warning(
+                        "%s: torn tail record at byte %d dropped (crash "
+                        "mid-append)", self.path, pos)
                 break
             records.append(rec)
             pos = end
@@ -235,7 +312,7 @@ class SnapshotLog:
     def read_all(self) -> list[tuple[int, list]]:
         return self._scan()[0]
 
-    def append(self, time: int, entries: list) -> None:
+    def append(self, time: int, entries: list) -> int:
         if self._f is None:
             # truncate any torn tail record before appending, or every later
             # record would sit behind unreadable bytes forever
@@ -247,6 +324,14 @@ class SnapshotLog:
             if valid == 0:
                 self._f.write(_MAGIC)
         payload = pickle.dumps((time, entries), protocol=pickle.HIGHEST_PROTOCOL)
+        crc = zlib.crc32(payload)
+        if faults.armed("persistence.append.corrupt"):
+            # test hook: flip payload bytes AFTER the CRC was computed —
+            # the written record is a mid-log corruption _scan must catch
+            mutable = bytearray(payload)
+            faults.hit("persistence.append.corrupt", path=self.path,
+                       time=time, payload=mutable)
+            payload = bytes(mutable)
         start = self._f.tell()
 
         def _write() -> None:
@@ -259,7 +344,7 @@ class SnapshotLog:
             self._f.truncate(start)
             self._f.seek(start)
             faults.hit("persistence.append", path=self.path, time=time)
-            self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+            self._f.write(_HDR.pack(len(payload), crc))
             # fault point between header and payload: an armed action
             # aborts here leaving exactly the torn-tail record _scan
             # must drop
@@ -273,6 +358,51 @@ class SnapshotLog:
                 os.fsync(self._f.fileno())
 
         _retrying_write(_write, f"append to {self.path}")
+        return _HDR.size + len(payload)
+
+    def truncate_to(self, tick: int) -> int:
+        """WAL compaction: atomically rewrite the log keeping only records
+        with time > ``tick`` (the suffix a durable snapshot does not
+        cover). Returns the number of ENTRIES dropped. Record times are
+        monotone (commit watermarks), so the kept records are a
+        contiguous byte suffix — copied verbatim, never re-pickled; only
+        the dropped prefix (plus the first kept record) is decoded. The
+        previous file is replaced only after the rewrite is fsynced, so a
+        crash mid-compaction leaves either the old or the new log —
+        never a partial one."""
+        self.close()  # the append handle's position is about to be wrong
+        if not os.path.exists(self.path):
+            return 0
+        with open(self.path, "rb") as f:
+            data = f.read()
+        if not data.startswith(_MAGIC):
+            return 0  # alien/torn-magic file: _scan's rules own this case
+        pos = len(_MAGIC)
+        dropped = 0
+        cut = None  # byte offset of the first KEPT record
+        while pos + _HDR.size <= len(data):
+            length, crc = _HDR.unpack_from(data, pos)
+            end = pos + _HDR.size + length
+            if end > len(data):
+                break
+            payload = data[pos + _HDR.size:end]
+            if zlib.crc32(payload) != crc:
+                break
+            try:
+                t, entries = _safe_loads(payload)
+            except Exception:
+                break
+            if t > tick:
+                cut = pos
+                break
+            dropped += len(entries)
+            pos = end
+        if dropped == 0:
+            return 0
+        body = _MAGIC + (data[cut:] if cut is not None else b"")
+        with blocking_call("persistence.compact"):
+            _atomic_write_bytes(self.path, body)
+        return dropped
 
     def close(self) -> None:
         if self._f is not None:
@@ -294,6 +424,7 @@ class S3SnapshotLog:
         self.prefix = "/".join(
             p for p in (root_prefix.strip("/"), "streams", source_id) if p)
         self._seq: int | None = None
+        self._purged = False
 
     def read_all(self) -> list[tuple[int, list]]:
         """Contiguous durable prefix, stopping at the first gap or corrupt
@@ -303,23 +434,36 @@ class S3SnapshotLog:
         the reader re-emits)."""
         records: list = []
         expect = 0
+        objs = []
         for obj in sorted(self.client.list_objects(self.prefix + "/"),
                           key=lambda o: o["key"]):
             try:
                 seq = int(obj["key"].rsplit("/", 1)[-1])
             except ValueError:
                 continue  # foreign object under the prefix
+            objs.append((seq, obj["key"]))
+        for i, (seq, key) in enumerate(objs):
             if seq != expect:
                 break  # gap: a later commit without its predecessor
-            data = self.client.get_object(obj["key"])
-            if not data.startswith(_MAGIC) \
-                    or len(data) < len(_MAGIC) + _HDR.size:
+            data = self.client.get_object(key)
+            bad = (not data.startswith(_MAGIC)
+                   or len(data) < len(_MAGIC) + _HDR.size)
+            if not bad:
+                length, crc = _HDR.unpack_from(data, len(_MAGIC))
+                payload = data[len(_MAGIC) + _HDR.size:
+                               len(_MAGIC) + _HDR.size + length]
+                bad = len(payload) != length or zlib.crc32(payload) != crc
+            if bad:
+                # per-record CRC: a corrupt object with SUCCESSORS is
+                # mid-sequence corruption, not an interrupted tail upload
+                # — recovery still stops at the first bad record, loudly
+                if i + 1 < len(objs):
+                    logger.error(
+                        "%s: corrupt snapshot object %s mid-sequence "
+                        "(%d later object(s)) — truncating recovery at "
+                        "the first bad record", self.prefix, key,
+                        len(objs) - i - 1)
                 break
-            length, crc = _HDR.unpack_from(data, len(_MAGIC))
-            payload = data[len(_MAGIC) + _HDR.size:
-                           len(_MAGIC) + _HDR.size + length]
-            if len(payload) != length or zlib.crc32(payload) != crc:
-                break  # interrupted upload: prefix ends here
             records.append(_safe_loads(payload))
             expect += 1
         self._seq = expect  # next append overwrites a torn slot
@@ -341,12 +485,36 @@ class S3SnapshotLog:
             seq += 1
         return seq
 
-    def append(self, time: int, entries: list) -> None:
+    def _purge_stale_successors(self) -> None:
+        """Delete objects at/past the next append slot before the first
+        write of this session. After a mid-sequence corruption (or gap)
+        truncated recovery, objects BEYOND the break are leftovers of the
+        abandoned timeline — appending in front of them and crashing
+        would let a later read_all splice those CRC-valid strays back
+        into the replayed history."""
+        for obj in list(self.client.list_objects(self.prefix + "/")):
+            try:
+                seq = int(obj["key"].rsplit("/", 1)[-1])
+            except ValueError:
+                continue
+            if seq >= self._seq:
+                self.client.delete_object(obj["key"])
+
+    def append(self, time: int, entries: list) -> int:
         if self._seq is None:
             self._seq = self._next_seq()
+        if not self._purged:
+            self._purged = True
+            self._purge_stale_successors()
         payload = pickle.dumps((time, entries),
                                protocol=pickle.HIGHEST_PROTOCOL)
-        body = _MAGIC + _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        crc = zlib.crc32(payload)
+        if faults.armed("persistence.append.corrupt"):
+            mutable = bytearray(payload)
+            faults.hit("persistence.append.corrupt", key=self.prefix,
+                       time=time, payload=mutable)
+            payload = bytes(mutable)
+        body = _MAGIC + _HDR.pack(len(payload), crc) + payload
         key = f"{self.prefix}/{self._seq:016d}"
 
         def _put() -> None:
@@ -357,6 +525,7 @@ class S3SnapshotLog:
         # failed attempt's slot; _seq advances only after success
         _retrying_write(_put, f"PUT {key}")
         self._seq += 1
+        return len(body)
 
     def close(self) -> None:
         pass
@@ -364,7 +533,9 @@ class S3SnapshotLog:
 
 class MockLog:
     """In-memory log living on the Backend object, surviving re-runs that
-    reuse the same ``pw.persistence.Backend.mock()`` instance."""
+    reuse the same ``pw.persistence.Backend.mock()`` instance. Grows the
+    same truncate API as the file log so unit tests exercise snapshot
+    compaction without a filesystem."""
 
     def __init__(self, store: dict, source_id: str):
         self._records = store.setdefault(source_id, [])
@@ -372,8 +543,21 @@ class MockLog:
     def read_all(self) -> list[tuple[int, list]]:
         return list(self._records)
 
-    def append(self, time: int, entries: list) -> None:
+    def append(self, time: int, entries: list) -> int:
         self._records.append((time, entries))
+        # byte-threshold accounting parity with the durable logs
+        return len(pickle.dumps((time, entries),
+                                protocol=pickle.HIGHEST_PROTOCOL))
+
+    def truncate_to(self, tick: int) -> int:
+        """Drop records covered by a durable snapshot (time <= tick);
+        returns entries dropped. In-place slice assignment so every
+        holder of the store's list sees the compaction."""
+        dropped = sum(len(e) for t, e in self._records if t <= tick)
+        if dropped:
+            self._records[:] = [(t, e) for t, e in self._records
+                                if t > tick]
+        return dropped
 
     def close(self) -> None:
         pass
@@ -419,21 +603,44 @@ class _RecordingSession:
             self._skip -= 1
             return
         with self._mutex:
+            # the inner push stays INSIDE the mutex: seal_drain drains
+            # the inner session and seals pending atomically under it, so
+            # an entry must never be recordable (pending) without being
+            # drainable (inner) — a push split across the mutex boundary
+            # could be sealed at tick t yet processed at t+1, and a
+            # snapshot at t would cover it without containing it
             self.pending.append((key, row, diff, offset))
-        self._inner.push(key, row, diff)
+            self._inner.push(key, row, diff)
 
     def seal(self, tick: int) -> None:
         """Mark everything pushed so far as belonging to ``tick``'s drain
         (called right before the drain, so sealed ⊆ processed-by-tick)."""
         with self._mutex:
-            n = len(self.pending)
-            if self._seals and self._seals[-1][1] == n:
-                # idle tick: the existing seal already covers these
-                # entries at an OLDER tick — keep it (re-stamping to the
-                # newer tick would shrink what a frozen watermark may
-                # commit); the list only grows when entries do
-                return
-            self._seals.append((tick, n))
+            self._seal_locked(tick)
+
+    def _seal_locked(self, tick: int) -> None:
+        n = len(self.pending)
+        if self._seals and self._seals[-1][1] == n:
+            # idle tick: the existing seal already covers these
+            # entries at an OLDER tick — keep it (re-stamping to the
+            # newer tick would shrink what a frozen watermark may
+            # commit); the list only grows when entries do
+            return
+        self._seals.append((tick, n))
+
+    def seal_drain(self, tick: int) -> list:
+        """Atomically drain the inner session AND seal at ``tick`` under
+        the push mutex, so *sealed at <= tick* equals *drained at <= tick*
+        EXACTLY. The streaming loop uses this instead of seal-then-drain:
+        an entry arriving between a separate seal and the drain would be
+        processed at ``tick`` but sealed at ``tick+1`` — harmless for
+        WAL-only replay, but fatal for operator-state snapshots (the
+        snapshot cut at ``tick`` would already contain it while the WAL
+        suffix past ``tick`` replays it again — a double count)."""
+        with self._mutex:
+            entries = self._inner.drain()
+            self._seal_locked(tick)
+            return entries
 
     def take_sealed(self, watermark: int) -> list:
         """Remove and return every pending entry under a seal with tick
@@ -514,6 +721,40 @@ class PersistenceDriver:
         self.last_commit_tick = 0        # loop tick at the last commit
         self.last_inflight_at_commit = 0  # bridge depth when committing
         self.commit_wait = _WaitHistogram()
+        # -- operator-state snapshots + WAL compaction ---------------------
+        # (filesystem + mock backends; object stores keep WAL-only
+        # recovery until they grow an atomic-manifest story)
+        self.snapshots_supported = self.kind in ("filesystem", "mock")
+        self._snap_dir = (os.path.join(self.root, "snapshots")
+                          if self.kind == "filesystem" else None)
+        self._loaded_snapshot: dict | None = None
+        self._snapshot_probed = False
+        self._snapshot_warned = False
+        # generation validity cache: gens this driver wrote or whose
+        # state blob already passed its checksum (re-verified at most
+        # once per generation) vs gens known corrupt — retention must
+        # never let a corrupt generation occupy a keep slot (it would
+        # prune the valid fallback and truncate the WAL to a tick only
+        # the corrupt generation covers)
+        self._validated_gens: set[int] = set()
+        self._corrupt_gens: set[int] = set()
+        self.last_snapshot_tick = 0
+        self.snapshot_generation = 0     # 0 = none yet; generations are 1-based
+        self.snapshot_bytes = 0
+        self.snapshots_total = 0         # written by THIS driver
+        self.compactions_total = 0
+        self.wal_replayable_entries = 0  # entries a restart would replay
+        self.wal_bytes_since_snapshot = 0
+        # durable entries NOT covered by the newest snapshot (freshly
+        # committed ones plus a restart's replayed suffix): the
+        # no-empty-churn guard — a snapshot is only worth writing while
+        # this is non-zero
+        self.wal_entries_uncovered = 0
+        # per-source compact resume frontier, maintained on every commit:
+        # entry/insert counts, per-file positions (fs offsets) and the
+        # partition antichain — what the manifest stores so seek-capable
+        # sources can continue past a COMPACTED prefix
+        self._frontiers: dict[str, dict] = {}
 
     # -- identity ----------------------------------------------------------
     def _source_id(self, datasource) -> str:
@@ -539,6 +780,267 @@ class PersistenceDriver:
         return SnapshotLog(os.path.join(self.root, "streams",
                                         source_id + ".snap"))
 
+    # -- per-source resume frontier (manifest payload) ---------------------
+    def _frontier(self, sid: str) -> dict:
+        fr = self._frontiers.get(sid)
+        if fr is None:
+            fr = self._frontiers[sid] = {
+                "entries": 0,   # durable entries, any diff (skip counter)
+                "inserts": 0,   # durable insertions (fs key-seq counter)
+                "files": {},    # fkey -> [mtime, rows, saw_last]
+                "parts": {},    # partition -> max offset (antichain)
+            }
+        return fr
+
+    @staticmethod
+    def _frontier_fold(fr: dict, entries: list) -> None:
+        """Fold durable entries' offset labels into the compact frontier —
+        the summary the snapshot manifest stores so seek-capable sources
+        can continue past a prefix whose WAL records were compacted."""
+        files, parts = fr["files"], fr["parts"]
+        for entry in entries:
+            fr["entries"] += 1
+            if entry[2] > 0:
+                fr["inserts"] += 1
+            offset = entry[3] if len(entry) > 3 else None
+            if not isinstance(offset, tuple):
+                continue
+            if len(offset) == 3 and offset[0] == "part":
+                _kind, p, o = offset
+                cur = parts.get(p)
+                if cur is None or o > cur:
+                    parts[p] = o
+            elif len(offset) == 5:
+                kind, fkey, mtime, idx, is_last = offset
+                fkey = str(fkey)
+                if kind == "retract":
+                    # the file changed and its old rows were retracted:
+                    # forget the stale position (new rows re-populate)
+                    files.pop(fkey, None)
+                    continue
+                st = files.get(fkey)
+                if st is None or st[0] != mtime:
+                    st = files[fkey] = [mtime, 0, False]
+                st[1] = max(st[1], idx + 1)
+                st[2] = bool(st[2] or is_last)
+
+    # -- operator-state snapshots ------------------------------------------
+    def _list_generations(self) -> list[dict]:
+        """Manifest dicts of every on-disk generation, newest first. A
+        manifest that fails to parse is skipped (and logged): the
+        generation's state file without its manifest is an orphan from a
+        crash mid-write, never a valid snapshot."""
+        metas: list[dict] = []
+        if self.kind == "mock":
+            metas = list(getattr(self._backend, "_mock_snapshots", []))
+        elif self._snap_dir and os.path.isdir(self._snap_dir):
+            import json
+
+            for fname in os.listdir(self._snap_dir):
+                if not fname.endswith(".json"):
+                    continue
+                path = os.path.join(self._snap_dir, fname)
+                try:
+                    with open(path) as f:
+                        meta = json.load(f)
+                    meta["_manifest_path"] = path
+                    metas.append(meta)
+                except Exception as e:
+                    logger.error(
+                        "unreadable snapshot manifest %s (%s: %s) — "
+                        "skipping that generation", path,
+                        type(e).__name__, e)
+        return sorted(metas, key=lambda m: m.get("generation", 0),
+                      reverse=True)
+
+    def _read_state_blob(self, meta: dict) -> bytes:
+        if self.kind == "mock":
+            data = meta["state"]
+        else:
+            with open(os.path.join(self._snap_dir,
+                                   meta["state_file"]), "rb") as f:
+                data = f.read()
+        if not data.startswith(_STATE_MAGIC):
+            raise ValueError("state file missing magic header")
+        blob = data[len(_STATE_MAGIC):]
+        if len(blob) != int(meta["state_bytes"]) \
+                or zlib.crc32(blob) != int(meta["state_crc32"]):
+            raise ValueError("state checksum mismatch (corrupt snapshot)")
+        return blob
+
+    def load_snapshot(self) -> dict | None:
+        """Newest VALID snapshot generation (checksum-verified, decoded by
+        the restricted unpickler), or None. A corrupt newest generation
+        falls back one generation, loudly — the WAL keeps the suffix back
+        to the oldest RETAINED generation, so the fallback replays more
+        but recovers byte-identically."""
+        if self._snapshot_probed:
+            return self._loaded_snapshot
+        self._snapshot_probed = True
+        if not self.snapshots_supported or not _restore_enabled():
+            return None
+        for meta in self._list_generations():
+            gen = int(meta.get("generation", 0))
+            try:
+                blob = self._read_state_blob(meta)
+                payload = _safe_loads(blob)
+            except Exception as e:
+                logger.error(
+                    "snapshot generation %d unreadable (%s: %s) — "
+                    "falling back one generation", gen,
+                    type(e).__name__, e)
+                self._corrupt_gens.add(gen)
+                continue
+            self._validated_gens.add(gen)
+            tick = int(meta["snapshot_tick"])
+            self._loaded_snapshot = {
+                "generation": gen, "tick": tick, "payload": payload,
+                "sources": meta.get("sources") or {}}
+            self.last_snapshot_tick = tick
+            self.snapshot_generation = gen
+            self.snapshot_bytes = len(blob)
+            logger.info(
+                "restored operator-state snapshot generation %d "
+                "(tick %d, %d bytes) — replaying only the WAL suffix",
+                gen, tick, len(blob))
+            return self._loaded_snapshot
+        return None
+
+    def write_snapshot(self, tick: int, payload_obj) -> bool:
+        """Durably record an operator-state snapshot at ``tick`` (all
+        entries sealed <= tick are already committed by the caller), then
+        compact: truncate each source's WAL to the suffix past the oldest
+        RETAINED generation's tick and prune old generations. Write
+        order — state file, then manifest (each atomic: tmp + fsync +
+        rename), then truncation — makes every crash point safe: before
+        the manifest, the generation does not exist; after it, covered
+        WAL records are ignored on replay whether or not the truncation
+        ran."""
+        if not self.snapshots_supported:
+            if not self._snapshot_warned:
+                self._snapshot_warned = True
+                logger.warning(
+                    "operator-state snapshots are not supported on the "
+                    "%r persistence backend — recovery stays full-WAL "
+                    "replay (restart cost grows with history)", self.kind)
+            return False
+        if tick <= self.last_snapshot_tick:
+            return False  # watermark did not advance: no empty churn
+        blob = pickle.dumps(payload_obj, protocol=pickle.HIGHEST_PROTOCOL)
+        # write-time proof the restricted unpickler accepts this snapshot:
+        # a checkpoint that cannot load must never truncate the WAL
+        _safe_loads(blob)
+        existing = self._list_generations()
+        gen = (int(existing[0].get("generation", 0)) + 1) if existing \
+            else self.snapshot_generation + 1
+        sources = {
+            sid: {"covered": fr["entries"], "inserts": fr["inserts"],
+                  "files": fr["files"],
+                  "parts": [[p, o] for p, o in fr["parts"].items()]}
+            for sid, fr in self._frontiers.items()}
+        faults.hit("persistence.snapshot.write", tick=tick, generation=gen)
+        meta = {"format": "pwsnapmeta1", "generation": gen,
+                "snapshot_tick": tick, "state_bytes": len(blob),
+                "state_crc32": zlib.crc32(blob), "sources": sources,
+                "wrote_at": _time.time()}
+        if self.kind == "mock":
+            meta["state"] = _STATE_MAGIC + blob
+            snaps = getattr(self._backend, "_mock_snapshots", None)
+            if snaps is None:
+                snaps = self._backend._mock_snapshots = []
+            snaps.append(meta)
+        else:
+            os.makedirs(self._snap_dir, exist_ok=True)
+            state_file = f"{gen:08d}.state"
+            meta["state_file"] = state_file
+            with blocking_call("persistence.snapshot.write"):
+                _atomic_write_bytes(
+                    os.path.join(self._snap_dir, state_file),
+                    _STATE_MAGIC + blob)
+                from pathway_tpu.engine.flight_recorder import \
+                    atomic_write_json
+
+                atomic_write_json(
+                    os.path.join(self._snap_dir, f"{gen:08d}.json"), meta)
+        self.snapshot_generation = gen
+        self.last_snapshot_tick = tick
+        self.snapshots_total += 1
+        self.snapshot_bytes = len(blob)
+        self.wal_bytes_since_snapshot = 0
+        self.wal_entries_uncovered = 0
+        # every durable entry now sits in a record <= tick: a normal-path
+        # restart replays nothing (records physically retained for the
+        # generation-fallback window are filtered by the snapshot tick)
+        self.wal_replayable_entries = 0
+        self._validated_gens.add(gen)
+        self._compact()
+        return True
+
+    def _gen_valid(self, meta: dict) -> bool:
+        """Checksum-verify a generation at most once (this driver's own
+        writes and load-time passes are pre-validated)."""
+        gen = int(meta.get("generation", 0))
+        if gen in self._validated_gens:
+            return True
+        if gen in self._corrupt_gens:
+            return False
+        try:
+            self._read_state_blob(meta)
+        except Exception as e:
+            logger.error(
+                "snapshot generation %d is corrupt (%s: %s) — excluded "
+                "from retention (it must not shadow a valid fallback)",
+                gen, type(e).__name__, e)
+            self._corrupt_gens.add(gen)
+            return False
+        self._validated_gens.add(gen)
+        return True
+
+    def _compact(self) -> None:
+        """Truncate WAL prefixes covered by the oldest retained VALID
+        generation and prune everything else — corrupt generations never
+        occupy a retention slot (keeping one would prune the real
+        fallback and truncate the WAL to a tick only the corrupt
+        generation covers). Runs strictly after the new generation is
+        durable; a crash at any point here only costs replay time, never
+        data."""
+        gens = self._list_generations()
+        valid = [m for m in gens if self._gen_valid(m)]
+        kept = valid[:_keep_generations()]
+        kept_ids = {id(m) for m in kept}
+        if _compact_enabled() and kept:
+            truncate_tick = int(kept[-1]["snapshot_tick"])
+            faults.hit("persistence.compact.truncate", tick=truncate_tick)
+            dropped_entries = 0
+            for _sid, log, _rec in self._sessions:
+                if hasattr(log, "truncate_to"):
+                    dropped_entries += log.truncate_to(truncate_tick)
+            if dropped_entries:
+                self.compactions_total += 1
+        for meta in gens:
+            if id(meta) not in kept_ids:
+                self._delete_generation(meta)
+
+    def _delete_generation(self, meta: dict) -> None:
+        if self.kind == "mock":
+            try:
+                self._backend._mock_snapshots.remove(meta)
+            except ValueError:
+                pass
+            return
+        # manifest first: a state file without a manifest is an inert
+        # orphan, while a manifest without its state would be a loud
+        # (checksum-failing) fallback on every restart
+        for path in (meta.get("_manifest_path"),
+                     os.path.join(self._snap_dir,
+                                  meta.get("state_file", ""))
+                     if meta.get("state_file") else None):
+            if path:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
     # -- runtime API (called by StreamingRuntime) --------------------------
     def _records(self, sid: str) -> list:
         """Read (and cache) a source's log records — restore_time and
@@ -551,7 +1053,8 @@ class PersistenceDriver:
         """Last committed logical time across all logged sources (0 = fresh)."""
         if self._restore_time is not None:
             return self._restore_time
-        last = 0
+        snap = self.load_snapshot()
+        last = snap["tick"] if snap is not None else 0
         if self.kind == "mock":
             sids = list(self._backend._mock_store.keys())
         elif self._s3 is not None:
@@ -592,27 +1095,68 @@ class PersistenceDriver:
                 "connector a unique persistent_id.")
         self._attached_ids.add(sid)
         log = self._log_for(sid)
+        snap = self.load_snapshot()
+        snap_tick = snap["tick"] if snap is not None else 0
+        src_meta = (snap["sources"].get(sid)
+                    if snap is not None else None) or {}
+        covered = int(src_meta.get("covered", 0))
+        records = self._records(sid)
+        if snap_tick:
+            # records <= the snapshot tick are covered by restored
+            # operator state. A crash between snapshot-durable and
+            # WAL-truncate leaves them in the log — they are ignored
+            # here, never replayed on top of the state that already
+            # includes them.
+            records = [(t, e) for t, e in records if t > snap_tick]
         replayed: list = []
-        for _t, entries in self._records(sid):
+        for _t, entries in records:
             for entry in entries:
                 key, row, diff = entry[0], entry[1], entry[2]
                 offset = entry[3] if len(entry) > 3 else None
                 session.push(key, row, diff)
                 replayed.append((key, row, diff, offset))
+        self.wal_replayable_entries += len(replayed)
+        self.wal_entries_uncovered += len(replayed)
+        # resume frontier: continue from the manifest's compact summary,
+        # then fold the replayed WAL suffix on top
+        fr = self._frontier(sid)
+        if src_meta:
+            fr["entries"] = covered
+            fr["inserts"] = int(src_meta.get("inserts", 0))
+            fr["files"] = {k: list(v)
+                           for k, v in (src_meta.get("files") or {}).items()}
+            fr["parts"] = {p: o for p, o in (src_meta.get("parts") or [])}
+        self._frontier_fold(fr, replayed)
         from pathway_tpu.engine.offsets import OffsetAntichain
 
-        antichain = OffsetAntichain.from_entries(
-            off for _k, _r, _d, off in replayed)
+        antichain = OffsetAntichain(fr["parts"]) if fr["parts"] else None
         if antichain and hasattr(datasource, "seek_offsets"):
             # partitioned source: continue each partition past its durable
             # frontier (reference OffsetAntichain, persistence/frontier.rs)
             datasource.seek_offsets(antichain)
             skip = 0
-        elif hasattr(datasource, "seek"):
+        elif covered and hasattr(datasource, "seek_snapshot"):
+            # the prefix was compacted away: hand the source the MANIFEST
+            # frontier (per-file positions, insert count) plus the raw
+            # WAL suffix — it positions its reader without the entries
+            datasource.seek_snapshot(
+                {"files": fr["files"], "inserts": fr["inserts"]}, replayed)
+            skip = 0
+        elif hasattr(datasource, "seek") and not covered:
             datasource.seek(replayed)
             skip = 0
         else:
-            if replayed:
+            if covered and hasattr(datasource, "seek"):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "source %r defines seek() but not seek_snapshot(); "
+                    "its replay prefix was compacted by an operator-state "
+                    "snapshot, so resume falls back to the prefix-skip "
+                    "protocol (the reader is assumed to re-emit the "
+                    "identical first %d entries).", sid,
+                    covered + len(replayed))
+            elif replayed or covered:
                 import logging
 
                 logging.getLogger(__name__).warning(
@@ -620,8 +1164,9 @@ class PersistenceDriver:
                     "reader is assumed to re-emit the identical first %d "
                     "entries on restart. Sources that re-read *current* "
                     "state (databases, compacted topics) need a seek() "
-                    "implementation for exact resume.", sid, len(replayed))
-            skip = len(replayed)
+                    "implementation for exact resume.", sid,
+                    covered + len(replayed))
+            skip = covered + len(replayed)
         rec = _RecordingSession(session, skip=skip)
         self._sessions.append((sid, log, rec))
         return rec
@@ -661,8 +1206,12 @@ class PersistenceDriver:
         for sid, log, rec in self._sessions:
             entries = rec.take_sealed(watermark)
             if entries:
-                log.append(watermark, entries)
+                nbytes = log.append(watermark, entries) or 0
                 self.entries_committed += len(entries)
+                self.wal_replayable_entries += len(entries)
+                self.wal_entries_uncovered += len(entries)
+                self.wal_bytes_since_snapshot += nbytes
+                self._frontier_fold(self._frontier(sid), entries)
                 wrote = True
         self.commits += 1
         self.last_commit_tick = max(self.last_commit_tick, time)
@@ -686,6 +1235,15 @@ class PersistenceDriver:
             "write_retries": write_retries_total(),
             "commit_wait_ms_sum": round(self.commit_wait.sum_ms, 3),
             "commit_wait_count": self.commit_wait.count,
+            # -- snapshot / compaction tier --------------------------------
+            "snapshot_tick": self.last_snapshot_tick,
+            "snapshot_generation": self.snapshot_generation,
+            "snapshots_total": self.snapshots_total,
+            "snapshot_bytes": self.snapshot_bytes,
+            "snapshot_age_ticks": max(0, self.last_commit_tick
+                                      - self.last_snapshot_tick),
+            "compactions_total": self.compactions_total,
+            "wal_replayable_entries": self.wal_replayable_entries,
         }
 
     def close(self) -> None:

@@ -22,6 +22,11 @@ from pathway_tpu.engine.delta import row_fingerprint
 
 
 class ReducerState:
+    # slots that hold user callables (never serialized: a snapshot must
+    # stay data-only for the restricted unpickler; fresh construction
+    # re-binds them from the reducer spec)
+    _CALLABLE_SLOTS = ("fn", "emit_fn")
+
     def add(self, args: tuple, diff: int) -> None:
         raise NotImplementedError
 
@@ -30,6 +35,25 @@ class ReducerState:
 
     def is_empty(self) -> bool:
         raise NotImplementedError
+
+    def state_dict(self) -> dict:
+        """Plain-data snapshot of this state (engine/persistence.py
+        operator-state checkpoints): every ``__slots__`` value except the
+        user callables. Values are plain containers/scalars/ndarrays, so
+        the restricted unpickler accepts them on restore."""
+        out: dict[str, Any] = {}
+        for cls in type(self).__mro__:
+            for slot in getattr(cls, "__slots__", ()):
+                if slot in self._CALLABLE_SLOTS:
+                    continue
+                out[slot] = getattr(self, slot)
+        return out
+
+    def load_state(self, state: dict) -> None:
+        """Restore a ``state_dict`` into a freshly-constructed state (the
+        factory re-supplied any callables)."""
+        for k, v in state.items():
+            setattr(self, k, v)
 
 
 class _CountState(ReducerState):
@@ -127,6 +151,20 @@ class _MultisetState(ReducerState):
 
     def is_empty(self):
         return self.n == 0
+
+    def load_state(self, state):
+        super().load_state(state)
+        # fingerprints are hash()-based and string hashes vary with the
+        # process hash seed: a snapshot restored in a NEW process must
+        # re-key its multiset with THIS process's fingerprints, or later
+        # retractions would never find their entries
+        counts, values = self.counts, self.values
+        self.counts = {}
+        self.values = {}
+        for fp, args in values.items():
+            nfp = row_fingerprint(args)
+            self.counts[nfp] = counts[fp]
+            self.values[nfp] = args
 
     def iter_args(self):
         for fp, c in self.counts.items():
@@ -272,6 +310,18 @@ class _EarliestState(ReducerState):
 
     def is_empty(self):
         return self.n <= 0 or not self.stamps
+
+    def load_state(self, state):
+        super().load_state(state)
+        # same cross-process re-keying as _MultisetState: add() computes
+        # fp over the value tuple, so recompute from the stored value
+        stamps, values = self.stamps, self.values
+        self.stamps = {}
+        self.values = {}
+        for fp, v in values.items():
+            nfp = row_fingerprint((v,))  # add() keys by the 1-value tuple
+            self.stamps[nfp] = stamps[fp]
+            self.values[nfp] = v
 
 
 class _LatestState(_EarliestState):

@@ -18,6 +18,7 @@ import weakref
 from pathway_tpu.engine.delta import Delta
 from pathway_tpu.engine.graph import Scheduler
 from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
+from pathway_tpu.testing import faults
 
 # live runtimes (weak: a runtime dies with its last strong ref). Lets
 # embedding code — and the test harness — stop pw.run() loops started on
@@ -100,12 +101,46 @@ class StreamingRuntime:
         # tick progress against this
         self.last_tick_at = _time.monotonic()
         self.persistence = None
+        # operator-state snapshot cadence (0 = disabled): env knobs win
+        # over the Config fields; single-process only (a cluster's state
+        # is split across processes — no consistent single-file cut yet)
+        self._snapshot_every_ticks = 0
+        self._snapshot_every_bytes = 0
         if persistence_config is not None and persistence_config.backend is not None:
             from pathway_tpu.engine.persistence import PersistenceDriver
 
             self.persistence = PersistenceDriver(persistence_config)
             # dashboard durability panel: watermark lag is visible live
             self.monitor.persistence = self.persistence
+            if cluster is None:
+                from pathway_tpu.internals.config import _env_int
+
+                self._snapshot_every_ticks = max(0, _env_int(
+                    "PATHWAY_SNAPSHOT_EVERY_TICKS",
+                    int(getattr(persistence_config, "snapshot_every_ticks",
+                                0) or 0)))
+                self._snapshot_every_bytes = max(0, _env_int(
+                    "PATHWAY_SNAPSHOT_EVERY_BYTES",
+                    int(getattr(persistence_config, "snapshot_every_bytes",
+                                0) or 0)))
+                if self._snapshots_enabled() \
+                        and not self.persistence.snapshots_supported:
+                    # never run the (expensive) state-capture pass just
+                    # to have write_snapshot discard it every cadence
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "snapshot cadence configured but the %r "
+                        "persistence backend cannot store snapshots — "
+                        "recovery stays full-WAL replay",
+                        self.persistence.kind)
+                    self._snapshot_every_ticks = 0
+                    self._snapshot_every_bytes = 0
+            if self._snapshots_enabled():
+                # consolidated emitted-state tracking must be on BEFORE
+                # any data flows, so a later snapshot can re-emit the
+                # covered prefix's visible state to fresh sinks
+                self.scheduler.enable_output_tracking()
         self.http_server = None
         if with_http_server:
             from pathway_tpu.engine.http_server import MonitoringHttpServer
@@ -115,6 +150,10 @@ class StreamingRuntime:
         for node, datasource in runner._stream_subjects:
             session = Session()
             self.sessions.append((node, session, datasource))
+        # source index -> persistence recording proxy: the commit loop
+        # drains THROUGH the proxy (seal_drain) so seals align exactly
+        # with drains — the alignment operator-state snapshots require
+        self._drain_proxies: dict[int, object] = {}
 
         # request-scoped serving tracing (engine/request_tracker.py):
         # sources that declare a request_tracker slot (rest_connector)
@@ -191,6 +230,99 @@ class StreamingRuntime:
             tick, watermark=wm,
             inflight=bridge["depth"] if bridge is not None else 0)
 
+    def _snapshots_enabled(self) -> bool:
+        return bool(self._snapshot_every_ticks
+                    or self._snapshot_every_bytes)
+
+    def _snapshot_due(self, tick: int) -> bool:
+        if not self._snapshots_enabled() or self.persistence is None:
+            return False
+        p = self.persistence
+        if p.wal_entries_uncovered == 0:
+            # nothing durable beyond the last generation: operator state
+            # is unchanged — an idle stream must not churn generations
+            return False
+        if self._snapshot_every_ticks and \
+                tick - p.last_snapshot_tick >= self._snapshot_every_ticks:
+            return True
+        return bool(self._snapshot_every_bytes
+                    and p.wal_bytes_since_snapshot
+                    >= self._snapshot_every_bytes)
+
+    def _snapshot_pass(self, tick: int) -> None:
+        """Operator-state checkpoint at ``tick``: wait for the bridge
+        WATERMARK to reach the tick (never a full barrier — with the host
+        thread parked here no later leg exists, so reaching the watermark
+        IS a consistent cut at exactly ``tick``), commit everything
+        sealed <= tick so the WAL covers the cut, capture operator state,
+        write the snapshot generation and compact the WAL. Any failure
+        (unsupported operator, unpicklable state) disables snapshots for
+        the rest of the run, loudly — recovery falls back to full-WAL
+        replay, never to a checkpoint with missing state."""
+        from pathway_tpu.engine.operators import SnapshotUnsupported
+
+        wm = self.scheduler.wait_watermark(tick)  # re-raises leg failures
+        if wm < tick:
+            return  # frozen/idle bridge: no consistent cut available
+        bridge = self.scheduler.bridge_stats()
+        self.persistence.commit(
+            tick, watermark=tick,
+            inflight=bridge["depth"] if bridge is not None else 0)
+        if self.persistence.wal_entries_uncovered == 0:
+            # the watermark moved but no durable entry lies beyond the
+            # last generation (clean shutdown of an idle stream, teardown
+            # after a quiescent tail): skip — no empty-generation churn.
+            # A pure-replay restart DOES snapshot here: its replayed
+            # suffix counts as uncovered, and covering it bounds the
+            # NEXT restart.
+            return
+        try:
+            payload = {
+                "graph": self.scheduler.graph_fingerprint(),
+                "n_workers": self.scheduler.n_workers,
+                "nodes": self.scheduler.snapshot_operator_states(),
+            }
+            self.persistence.write_snapshot(tick, payload)
+        except SnapshotUnsupported as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "operator-state snapshots disabled for this run: %s", e)
+            self._snapshot_every_ticks = 0
+            self._snapshot_every_bytes = 0
+        except faults.InjectedFault:
+            # test-injected crash at a snapshot/compaction fault point:
+            # die like any other armed point (the crash sweep simulates
+            # process death here, not a degradable write failure)
+            raise
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "operator-state snapshot at tick %d failed; snapshots "
+                "disabled for this run (recovery falls back to full-WAL "
+                "replay)", tick, exc_info=True)
+            self._snapshot_every_ticks = 0
+            self._snapshot_every_bytes = 0
+
+    def _restore_snapshot(self) -> int:
+        """Load the newest valid snapshot (if any), restore operator
+        states and re-emit the covered prefix's consolidated output state
+        to the sinks. Returns the snapshot tick (0 = none)."""
+        snap = self.persistence.load_snapshot()
+        if snap is None:
+            return 0
+        payload = snap["payload"]
+        if payload.get("graph") != self.scheduler.graph_fingerprint():
+            raise ValueError(
+                "persistence root carries an operator-state snapshot for "
+                "a DIFFERENT pipeline (graph fingerprint mismatch) — the "
+                "program changed between runs; clear the persistence "
+                "root to start fresh")
+        self.scheduler.restore_operator_states(payload["nodes"])
+        self.scheduler.emit_restored_outputs(snap["tick"])
+        return snap["tick"]
+
     def _drain_and_forward(self, tick: int):
         """Drain local sessions; under a cluster split each source's rows
         by owning process (single reader on process 0 forwards shards —
@@ -202,7 +334,12 @@ class StreamingRuntime:
         tracker = self._request_tracker
         pushes: dict[int, dict[int, list]] = {}
         for i, (node, session, datasource) in enumerate(self.sessions):
-            entries = session.drain()
+            rec = self._drain_proxies.get(i)
+            # the recording proxy drains + seals atomically: sealed <= t
+            # IS drained <= t, the consistency-cut alignment snapshots
+            # need (a separate seal would leak gap entries into t+1)
+            entries = session.drain() if rec is None \
+                else rec.seal_drain(tick)
             if entries:
                 any_data = True
                 if tracker is not None and \
@@ -242,19 +379,38 @@ class StreamingRuntime:
     def run(self) -> None:
         _ACTIVE_RUNTIMES.add(self)
         time_counter = 1
-        if self.persistence is not None:
-            time_counter = self.persistence.restore_time() + 1
+        restored_tick = 0
         replay_only = (
             self.persistence is not None
             and not getattr(self.persistence.config, "continue_after_replay",
                             True))
         reader_here = self.cluster is None or self.cluster.process_id == 0
-        for node, session, datasource in self.sessions:
+        if self.persistence is not None:
+            time_counter = self.persistence.restore_time() + 1
+            if self.cluster is None:
+                # bounded-time recovery: load the newest valid snapshot,
+                # restore operator state at its tick and re-emit the
+                # covered prefix's consolidated outputs — the WAL suffix
+                # (replayed below via attach_source) is all that re-runs
+                restored_tick = self._restore_snapshot()
+            elif self.persistence.load_snapshot() is not None:
+                # a snapshot-compacted root cannot restore under a
+                # cluster (state is per-process; attach_source would
+                # silently skip the covered records): fail loudly rather
+                # than drop the covered prefix
+                raise ValueError(
+                    "persistence root carries an operator-state snapshot "
+                    "but this run is clustered (PATHWAY_PROCESSES > 1) — "
+                    "snapshot restore is single-process only. Re-run "
+                    "single-process, or set PATHWAY_SNAPSHOT_RESTORE=0 "
+                    "(sound only if the WAL was never compacted).")
+        for i, (node, session, datasource) in enumerate(self.sessions):
             live_session = session
             if self.persistence is not None and reader_here:
                 # replay the durable prefix into `session`, then hand the
                 # reader a recording proxy that skips the replayed count
                 live_session = self.persistence.attach_source(datasource, session)
+                self._drain_proxies[i] = live_session
             if replay_only or not reader_here:
                 # pure replay (CLI `replay` without --continue) or a
                 # non-reading cluster process: no live reader threads —
@@ -273,7 +429,13 @@ class StreamingRuntime:
         # single collapsed batch would net out add/retract pairs that
         # legitimately exist at different times (update streams). Static
         # feeds are SPMD-identical, so no cluster forwarding is needed.
+        # Restored-snapshot runs SKIP them: the restored operator state
+        # already includes the static rows (re-pushing would double-count
+        # them; same assumption as replay — static inputs are unchanged
+        # between runs).
         static_by_time, static_times = self.runner.static_feeds_by_time()
+        if restored_tick:
+            static_times = []
         for t in sorted(static_times):
             any_batch = False
             for node, groups in static_by_time:
@@ -294,6 +456,13 @@ class StreamingRuntime:
 
         self.watchdog = Watchdog(self, self.supervisor, self.watchdog_config)
         self.watchdog.start()
+        # teardown may write a FINAL operator-state snapshot, but only
+        # after a clean loop exit: a loop dying mid-commit may have
+        # consumed sealed entries (take_sealed) whose append never became
+        # durable — a snapshot covering that state would mark them
+        # processed while the restart's reader re-emits them (double
+        # count). The flag flips only when the while-loop exits normally.
+        loop_clean = False
         try:
             # Event wait, not time.sleep: a stop request wakes the loop
             # immediately instead of out-waiting the commit interval
@@ -319,13 +488,13 @@ class StreamingRuntime:
                         session.stopping.set()
                         session.close(reason="error",
                                       error=self.supervisor.fatal_error)
-                if self.persistence is not None:
-                    # durability seal BEFORE the drain: everything under
-                    # the seal is drained — hence processed — by this
-                    # tick, so "sealed at t" ⊆ "complete once the tick-t
-                    # leg resolves" holds exactly (entries pushed after
-                    # the seal wait for the next tick's seal)
-                    self.persistence.seal(time_counter)
+                # durability seals ride the drain itself: _drain_and_forward
+                # drains each persisted source through its recording proxy's
+                # seal_drain(tick), so "sealed at t" == "drained at t" ==
+                # "complete once the tick-t leg resolves" holds EXACTLY —
+                # required by operator-state snapshots (a seal taken before
+                # the drain would let gap entries be processed at t but
+                # recorded at t+1, double-counting them after a restore)
                 any_data, all_closed, pushes = self._drain_and_forward(
                     time_counter)
                 any_data, all_closed = self._tick_sync(
@@ -357,6 +526,11 @@ class StreamingRuntime:
                         # could fail, but checkpoint cadence no longer
                         # prices pipelining at effective depth 1
                         self._commit_watermark_tick(time_counter)
+                        if self._snapshot_due(time_counter):
+                            # bounded-time recovery: operator-state
+                            # snapshot anchored to the watermark + WAL
+                            # compaction (engine/persistence.py)
+                            self._snapshot_pass(time_counter)
                 time_counter += 1
                 if all_closed and not any_data:
                     # re-drain: a source may have pushed between its drain()
@@ -381,6 +555,7 @@ class StreamingRuntime:
                         # persists everything, watermark == final tick
                         self.persistence.commit(time_counter)
                     break
+            loop_clean = True
         except BaseException as e:  # noqa: BLE001 — escalation decides
             # poisoned device leg / exhausted persistence retries /
             # operator failure: the finally below first commits the last
@@ -427,6 +602,24 @@ class StreamingRuntime:
                         "final watermark commit failed during teardown; "
                         "the previous commit's prefix stays durable",
                         exc_info=True)
+                # final snapshot on CLEAN shutdown only, and only if the
+                # watermark advanced since the last one (write_snapshot's
+                # guard — no empty-generation churn). A poisoned bridge /
+                # degraded run keeps operator state inconsistent with the
+                # frozen watermark, so those paths stay WAL-only.
+                if self._snapshots_enabled() and loop_clean \
+                        and self.supervisor.fatal_error is None \
+                        and self._degraded_engine_error is None \
+                        and self.scheduler.take_device_error() is None:
+                    try:
+                        self._snapshot_pass(self._last_completed_tick)
+                    except Exception:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "final snapshot failed during teardown; the "
+                            "WAL alone stays authoritative",
+                            exc_info=True)
                 self.persistence.close()
             if self.http_server is not None:
                 self.http_server.stop()

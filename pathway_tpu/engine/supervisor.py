@@ -484,6 +484,11 @@ class Watchdog:
         # (tests assert a legitimately-waiting commit loop never breaches;
         # commit_stalled alone clears itself on recovery)
         self.commit_stall_events = 0
+        # snapshot-age breaches: a wedged snapshot loop is NOT a wedged
+        # commit loop (commits keep trailing the watermark while the
+        # checkpoint tier silently stops bounding recovery time)
+        self.snapshot_stall_events = 0
+        self._snapshot_logged = False
 
     def _postmortem(self) -> str:
         """The flight-recorder tail (last ticks + in-flight leg with its
@@ -512,6 +517,7 @@ class Watchdog:
             now = time.monotonic()
             self._check_commit_loop(now)
             self._check_readers(now)
+            self._check_snapshot_age()
 
     def _check_commit_loop(self, now: float) -> None:
         deadline = self.config.tick_deadline_s
@@ -547,6 +553,47 @@ class Watchdog:
             self.supervisor.commit_stalled = False
             self._tick_logged = False
             logger.warning("watchdog: commit loop progressing again")
+
+    def _check_snapshot_age(self) -> None:
+        """Warn when the operator-state snapshot tier stops keeping pace:
+        age beyond 3x the configured tick cadence means restarts are
+        quietly drifting back toward O(history) replay even though the
+        commit loop itself is healthy."""
+        tick_cadence = getattr(self.runtime, "_snapshot_every_ticks", 0)
+        byte_cadence = getattr(self.runtime, "_snapshot_every_bytes", 0)
+        persistence = getattr(self.runtime, "persistence", None)
+        if (not tick_cadence and not byte_cadence) or persistence is None:
+            return
+        if persistence.wal_entries_uncovered == 0:
+            # idle stream: no durable entry lies beyond the last
+            # generation, so there is nothing a snapshot SHOULD have
+            # covered — age grows harmlessly (ticks are free)
+            if self._snapshot_logged:
+                self._snapshot_logged = False
+                logger.info("watchdog: snapshot cadence recovered")
+            return
+        if tick_cadence:
+            lag = (persistence.last_commit_tick
+                   - persistence.last_snapshot_tick)
+            breach = lag > 3 * tick_cadence
+            unit, cadence = "ticks", tick_cadence
+        else:
+            lag = persistence.wal_bytes_since_snapshot
+            breach = lag > 3 * byte_cadence
+            unit, cadence = "bytes", byte_cadence
+        if breach:
+            if not self._snapshot_logged:
+                self._snapshot_logged = True
+                self.snapshot_stall_events += 1
+                logger.warning(
+                    "watchdog: operator-state snapshot age is %d %s "
+                    "(cadence %d, threshold %d) — the snapshot pass is "
+                    "wedged or disabled while commits keep flowing; "
+                    "restart time is growing with history again",
+                    lag, unit, cadence, 3 * cadence)
+        elif self._snapshot_logged:
+            self._snapshot_logged = False
+            logger.info("watchdog: snapshot cadence recovered")
 
     def _check_readers(self, now: float) -> None:
         timeout = self.config.reader_stall_timeout_s

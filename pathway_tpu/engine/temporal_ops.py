@@ -29,6 +29,12 @@ class ForgetImmediatelyOperator(Operator):
     def __init__(self):
         self.queued = Delta()
 
+    def snapshot_state(self):
+        return {"queued": self.queued.entries}
+
+    def restore_state(self, state) -> None:
+        self.queued = Delta(list(state["queued"]))
+
     def step(self, time, in_deltas):
         delta = in_deltas[0]
         out = Delta(self.queued.entries + delta.entries).consolidate()
@@ -97,6 +103,16 @@ class _WatermarkOp(Operator):
         if v is not None and _gt(v, self.watermark):
             self.watermark = v
 
+    def snapshot_state(self):
+        # NEG_INF serializes as a plain -inf float; restore re-pins the
+        # module sentinel so the identity checks in _gt/_le keep holding
+        wm = self.watermark
+        return {"wm": None if wm is NEG_INF else wm}
+
+    def restore_state(self, state) -> None:
+        wm = state["wm"]
+        self.watermark = NEG_INF if wm is None else wm
+
 
 def _gt(a, b):
     if b is NEG_INF:
@@ -123,6 +139,19 @@ class BufferOperator(_WatermarkOp):
     def __init__(self, threshold_fn, time_fn):
         super().__init__(threshold_fn, time_fn)
         self.held: dict = {}  # fingerprint -> (key, row, count)
+
+    def snapshot_state(self):
+        st = super().snapshot_state()
+        st["held"] = self.held
+        return st
+
+    def restore_state(self, state) -> None:
+        super().restore_state(state)
+        # held is keyed (key, row_fingerprint(row)) and hash()-based
+        # fingerprints vary with the process hash seed — re-key from the
+        # stored rows so post-restore retractions find their entries
+        self.held = {(k, row_fingerprint(r)): (k, r, c)
+                     for k, r, c in state["held"].values()}
 
     def step(self, time, in_deltas):
         delta = in_deltas[0]
@@ -175,6 +204,17 @@ class ForgetOperator(_WatermarkOp):
         super().__init__(threshold_fn, time_fn)
         self.live: dict = {}
         self.mark = mark
+
+    def snapshot_state(self):
+        st = super().snapshot_state()
+        st["live"] = self.live
+        return st
+
+    def restore_state(self, state) -> None:
+        super().restore_state(state)
+        # same cross-process re-keying as BufferOperator.held
+        self.live = {(k, row_fingerprint(r)): (k, r, c)
+                     for k, r, c in state["live"].values()}
 
     def step(self, time, in_deltas):
         delta = in_deltas[0]

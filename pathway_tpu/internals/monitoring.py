@@ -119,6 +119,14 @@ class StatsMonitor:
                 f"inflight@commit {pst['inflight_at_commit']}  "
                 f"wait {pst['commit_wait_ms_sum']:.0f}ms  "
                 f"write-retries {pst['write_retries']}")
+            if pst.get("snapshot_generation"):
+                # snapshot tier: generation + age make a wedged snapshot
+                # loop visible next to the (healthy) commit watermark
+                self._persistence_line += (
+                    f"  snap gen {pst['snapshot_generation']} "
+                    f"t={pst['snapshot_tick']} "
+                    f"age {pst['snapshot_age_ticks']}  "
+                    f"wal {pst['wal_replayable_entries']} entr.")
         # pipelined-execution line: in-flight depth, dispatch-queue wait
         # and overlap ratio straight from the device bridge, so the
         # host/device overlap is observable, not inferred

@@ -143,6 +143,43 @@ class FsSource(DataSource):
                 # logged rows are a prefix of the file at this mtime
                 self._resume_skip[fkey] = (st["mtime"], len(st["rows"]))
 
+    def seek_snapshot(self, state: dict, replayed: list) -> None:
+        """Persistence continuation past a COMPACTED prefix
+        (engine/persistence.py operator-state snapshots): the covered
+        entries' (key, row) data is gone from the WAL, so per-file
+        positions come from the manifest's compact frontier —
+        ``state["files"]`` maps file -> [mtime, prefix_rows, saw_last] —
+        and only the WAL *suffix* still arrives as raw entries.
+
+        Limitation vs full :meth:`seek`: rows of snapshot-covered files
+        cannot be retracted if such a file mutates after the restart
+        (their data was compacted away) — covered files are assumed
+        immutable, which is the same append-only assumption compaction
+        itself rests on (README "Fault tolerance").
+        """
+        self._resume_seq = int(state.get("inserts", 0))
+        self._resume_seen = {}
+        self._resume_emitted = {}
+        self._resume_skip = {}
+        suffix_rows: dict[str, list] = {}
+        for key, row, diff, offset in replayed:
+            if diff > 0 and offset and len(offset) == 5 \
+                    and offset[0] == "row":
+                suffix_rows.setdefault(str(offset[1]), []).append((key, row))
+        for fkey, st in (state.get("files") or {}).items():
+            mtime, nrows, saw_last = st[0], int(st[1]), bool(st[2])
+            if saw_last:
+                self._resume_seen[fkey] = mtime
+            else:
+                # durable rows are a prefix of the file at this mtime:
+                # continue past them (the frontier already folded any
+                # suffix entries, so nrows includes both tiers)
+                self._resume_skip[fkey] = (mtime, nrows)
+            # best-effort retraction data: suffix rows only (prefix rows
+            # were compacted — see the limitation above)
+            if fkey in suffix_rows:
+                self._resume_emitted[fkey] = suffix_rows[fkey]
+
     def run(self, session: Session) -> None:
         seen: dict[str, float] = dict(getattr(self, "_resume_seen", {}))
         emitted: dict[str, list] = dict(getattr(self, "_resume_emitted", {}))

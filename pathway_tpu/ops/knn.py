@@ -432,6 +432,52 @@ class BruteForceKnnIndex:
     def _release_slot(self, slot: int) -> None:
         self._free.append(slot)
 
+    def reserve_rows(self, n: int) -> None:
+        """Pre-size storage for ``n`` upcoming adds (used by the snapshot
+        restore path so a bulk re-establish does one sizing step instead
+        of a doubling cascade). Lock taken here — call before add_batch."""
+        with self._lock:
+            self._ensure_free(n)
+
+    # ------------------------------------------------------------------
+    # operator-state snapshots (engine/persistence.py): capture the host
+    # view — key map, synced mirror rows, filter payloads — so a restart
+    # rebuilds the device extents by re-upload, never by re-embedding
+    # ------------------------------------------------------------------
+    def snapshot_state(self) -> dict:
+        with self._lock:
+            # device-authoritative rows (fused/device adds) land in the
+            # mirror first: the mirror is exact float32 for every dtype
+            # (int8 quantization happens device-side at scatter)
+            self._sync_mirror()
+            keys = list(self._key_to_slot)
+            if keys:
+                slots = np.fromiter((self._key_to_slot[k] for k in keys),
+                                    np.int64, len(keys))
+                vectors = self._host_vectors[slots].copy()
+            else:
+                vectors = np.zeros((0, self.dim), dtype=self._np_dtype)
+            return {"dim": self.dim, "dtype": self.dtype, "keys": keys,
+                    "vectors": vectors,
+                    "filter_data": dict(self._filter_data)}
+
+    def restore_state(self, state: dict) -> None:
+        if int(state["dim"]) != self.dim or state["dtype"] != self.dtype:
+            raise ValueError(
+                f"snapshot carries a ({state['dim']}, {state['dtype']}) "
+                f"index but this run built ({self.dim}, {self.dtype}) — "
+                "the pipeline changed between runs")
+        keys = list(state["keys"])
+        if not keys:
+            return
+        self.reserve_rows(len(keys))
+        self.add_batch(keys, np.asarray(state["vectors"],
+                                        dtype=self._np_dtype))
+        fd = state["filter_data"]
+        if fd:
+            fks = list(fd)
+            self.set_filter_data(fks, [fd[k] for k in fks])
+
     # ------------------------------------------------------------------
     # maintenance (called from the external-index operator on data diffs)
     # ------------------------------------------------------------------
@@ -999,6 +1045,13 @@ class PagedKnnIndex(BruteForceKnnIndex):
     def _ensure_free(self, n: int) -> None:
         self._pool.ensure_free(n, self._tenant)
         self._extend_mirror()
+
+    def reserve_rows(self, n: int) -> None:
+        # single right-sized extent (paged_store.reserve_rows) instead of
+        # the doubling cascade — restore re-uploads into fewer extents
+        with self._lock:
+            self._pool.reserve_rows(n, self._tenant)
+            self._extend_mirror()
 
     def _take_slot(self) -> int:
         return self._pool.allocator.take_slot(self._tenant)

@@ -56,6 +56,13 @@ class Config:
     snapshot_interval_ms: int = 0
     persistence_mode: PersistenceMode = PersistenceMode.PERSISTING
     continue_after_replay: bool = True
+    # operator-state snapshot cadence (engine/persistence.py): every N
+    # commit ticks, and/or whenever the WAL grew by >= N bytes since the
+    # last snapshot. 0/None disables (WAL-only recovery: restart cost
+    # grows with stream age). Env overrides: PATHWAY_SNAPSHOT_EVERY_TICKS
+    # / PATHWAY_SNAPSHOT_EVERY_BYTES.
+    snapshot_every_ticks: int | None = None
+    snapshot_every_bytes: int | None = None
 
     @classmethod
     def simple_config(cls, backend: Backend, **kwargs) -> "Config":

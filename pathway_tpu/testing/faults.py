@@ -25,6 +25,18 @@ named failpoints like the reference engine's test-only error hooks):
    ``PATHWAY_PERSISTENCE_WRITE_RETRIES`` to exhaust the budget), and
    ``persistence.s3.put`` (the object-store upload).
 
+   Snapshot/compaction boundaries (PR 10) extend the sweep to the
+   operator-state checkpoint protocol: ``persistence.snapshot.write``
+   (crash before the snapshot state file becomes durable — the previous
+   generation plus the full WAL must recover), ``persistence.compact.
+   truncate`` (crash between the new snapshot generation going durable
+   and the WAL prefix truncation — covered records still in the WAL must
+   be ignored, not replayed twice), and ``persistence.append.corrupt``
+   (arm with :class:`CorruptPayload` to bit-flip a record's payload
+   after its CRC was computed — a mid-log corruption the next ``_scan``
+   must detect and truncate at, loudly, instead of feeding garbage to
+   the unpickler).
+
 2. **Faulty sources** — ``ConnectorSubject`` doubles with scripted crash
    schedules (:func:`flaky_subject` raises after the Nth entry on the
    first K attempts; :func:`hanging_subject` stops producing while
@@ -62,6 +74,13 @@ def hit(point: str, **ctx) -> None:
     action = _registry.get(point)
     if action is not None:
         action(point, ctx)
+
+
+def armed(point: str) -> bool:
+    """Whether ``point`` currently has an action — lets hot paths skip
+    preparing fault context (e.g. the mutable payload copy
+    ``persistence.append.corrupt`` needs) when nothing is armed."""
+    return point in _registry
 
 
 def arm_point(point: str, action: Callable) -> None:
@@ -118,6 +137,28 @@ class FailOnHit:
         self.hits += 1
         if self.hits == self.k:
             raise self.exc(f"injected fault at {point!r} (hit {self.hits})")
+
+
+class CorruptPayload:
+    """Flip one byte of the mutable ``payload`` bytearray passed in the
+    fault context, on the ``k``-th hit (1-based). Used with
+    ``persistence.append.corrupt``: the CRC was computed on the clean
+    payload, so the written record is a mid-log corruption the next scan
+    must detect."""
+
+    def __init__(self, k: int = 1, byte_index: int = 0):
+        self.k = k
+        self.byte_index = byte_index
+        self.hits = 0
+        self.corrupted = 0
+
+    def __call__(self, point: str, ctx: dict) -> None:
+        self.hits += 1
+        payload = ctx.get("payload")
+        if self.hits == self.k and payload:
+            i = self.byte_index % len(payload)
+            payload[i] ^= 0xFF
+            self.corrupted += 1
 
 
 class Delay:

@@ -1,0 +1,222 @@
+"""Recovery gate: snapshot+suffix restarts must be byte-identical AND
+bounded (engine/persistence.py operator-state snapshots + WAL compaction).
+
+Drives ``examples/streaming_etl.py``'s real graph under persistence with
+``PATHWAY_SNAPSHOT_EVERY_TICKS`` set and ``PATHWAY_DEVICE_INFLIGHT=4``
+through a seeded kill/restart loop: each round trickles more order files
+in, arms a RANDOM fault point — the PR-8 watermark boundaries PLUS the
+PR-10 snapshot/compaction boundaries (``persistence.snapshot.write``,
+``persistence.compact.truncate``, ``persistence.append.corrupt``) — and
+lets the run crash (or go quiescent when the point never fires).
+
+After the storm, a clean run over the same persistence root must:
+
+1. produce a consolidated CSV **identical** to a synchronous
+   (``PATHWAY_DEVICE_INFLIGHT=1``, no persistence) reference over the
+   full input — exactly-once through snapshots, compaction, corruption
+   and fallback;
+2. have restored from an operator-state snapshot (generation >= 1);
+3. show ``wal_replayable_entries`` MUCH smaller than the total ingested
+   history — the compaction bound that makes restart time O(data), not
+   O(stream age).
+
+Exits 0 iff all hold. Run: ``python tests/recovery_canary.py``
+(``RECOVERY_SEED`` reruns a specific storm).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import pathlib
+import random
+import sys
+import tempfile
+import threading
+import time
+
+N_ROUNDS = 3
+FILES_PER_ROUND = 3
+ROWS_PER_FILE = 4
+POINTS = ("bridge.leg.exec", "bridge.leg.resolved", "persistence.commit",
+          "persistence.fsync", "persistence.snapshot.write",
+          "persistence.compact.truncate")
+
+
+def _write_round(orders: pathlib.Path, rnd: int) -> None:
+    for f in range(FILES_PER_ROUND):
+        base = rnd * FILES_PER_ROUND + f
+        rows = [{"item": f"i{(base + i) % 4}", "qty": 1 + (base + i) % 3,
+                 "price": 2.5 * (1 + (base + i) % 5),
+                 "ts": 60 * (base * ROWS_PER_FILE + i)}
+                for i in range(ROWS_PER_FILE)]
+        (orders / f"{base:03d}.jsonl").write_text(
+            "\n".join(json.dumps(r) for r in rows) + "\n")
+
+
+def _write_cats(root: pathlib.Path) -> str:
+    cats = root / "categories.csv"
+    cats.write_text("item,category\n" + "\n".join(
+        f"i{i},cat{i % 2}" for i in range(4)) + "\n")
+    return str(cats)
+
+
+def _consolidate_csv(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    acc: dict[tuple, int] = {}
+    with open(path) as f:
+        reader = csv.reader(f)
+        header = next(reader, None)
+        if header is None:
+            return []
+        t_pos, d_pos = header.index("time"), header.index("diff")
+        for r in reader:
+            key = tuple(v for i, v in enumerate(r)
+                        if i not in (t_pos, d_pos))
+            acc[key] = acc.get(key, 0) + int(r[d_pos])
+    return sorted(k for k, n in acc.items() for _ in range(n) if n > 0)
+
+
+def _run(orders_dir: str, cats_csv: str, out_csv: str, *, inflight: int,
+         pdir: str | None, max_s: float = 25.0):
+    """One run attempt: build the real graph, run on a thread, wait for a
+    crash or sink quiescence, stop. Returns (error, persistence_stats)."""
+    os.environ["PATHWAY_DEVICE_INFLIGHT"] = str(inflight)
+    import pathway_tpu as pw
+    from examples.streaming_etl import build
+    from pathway_tpu.engine import streaming as _streaming
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    build(orders_dir, cats_csv, out_csv)
+    cfg = None
+    if pdir is not None:
+        cfg = pw.persistence.Config.simple_config(
+            pw.persistence.Backend.filesystem(pdir))
+    err: list[BaseException] = []
+
+    def _target():
+        try:
+            pw.run(persistence_config=cfg, terminate_on_error=True)
+        except BaseException as e:  # noqa: BLE001 — the injected crash
+            err.append(e)
+
+    t = threading.Thread(target=_target, daemon=True)
+    t.start()
+    deadline = time.monotonic() + max_s
+    rt = None
+    while time.monotonic() < deadline and rt is None and t.is_alive():
+        live = list(_streaming._ACTIVE_RUNTIMES)
+        rt = live[0] if live else None
+        time.sleep(0.05)
+    last_size = -1
+    while time.monotonic() < deadline and t.is_alive():
+        size = os.path.getsize(out_csv) if os.path.exists(out_csv) else 0
+        if size > 0 and size == last_size:
+            break  # sink quiescent: the finite feed is fully ingested
+        last_size = size
+        time.sleep(0.3)
+    _streaming.stop_all()
+    t.join(20.0)
+    assert not t.is_alive(), "runtime did not stop"
+    pstats = rt.persistence.stats() \
+        if rt is not None and rt.persistence is not None else None
+    G.clear()
+    return (err[0] if err else None), pstats
+
+
+def main() -> int:
+    seed = int(os.environ.get("RECOVERY_SEED", "5"))
+    rng = random.Random(seed)
+    from pathway_tpu.testing import faults
+
+    # injected write failures must crash, not be retried away; snapshot
+    # cadence keeps several generations landing inside a short storm
+    os.environ["PATHWAY_PERSISTENCE_WRITE_RETRIES"] = "0"
+    os.environ["PATHWAY_SNAPSHOT_EVERY_TICKS"] = "3"
+    with tempfile.TemporaryDirectory() as td:
+        root = pathlib.Path(td)
+        orders = root / "orders"
+        orders.mkdir()
+        cats_csv = _write_cats(root)
+        pdir = str(root / "pstate")
+
+        crashes = 0
+        for rnd in range(N_ROUNDS):
+            _write_round(orders, rnd)
+            point = rng.choice(POINTS)
+            k = rng.randint(1, 8)
+            faults.arm_point(point, faults.FailOnHit(k))
+            try:
+                err, _p = _run(
+                    str(orders), cats_csv, str(root / f"out_{rnd}.csv"),
+                    inflight=4, pdir=pdir)
+            finally:
+                faults.reset()
+            if err is not None:
+                if not isinstance(err, faults.InjectedFault):
+                    print(f"FAIL: round {rnd} died of an UNINJECTED error: "
+                          f"{type(err).__name__}: {err}", file=sys.stderr)
+                    return 1
+                crashes += 1
+                print(f"round {rnd}: crashed at {point!r} hit {k} "
+                      f"(as injected)")
+            else:
+                print(f"round {rnd}: {point!r} hit {k} never fired "
+                      f"(quiescent run)")
+
+        # one more round of files so the recovery run commits fresh rows
+        _write_round(orders, N_ROUNDS)
+        final_csv = str(root / "out_final.csv")
+        err, pstats = _run(str(orders), cats_csv, final_csv,
+                           inflight=4, pdir=pdir)
+        if err is not None:
+            print(f"FAIL: clean recovery run raised {type(err).__name__}: "
+                  f"{err}", file=sys.stderr)
+            return 1
+        got = _consolidate_csv(final_csv)
+
+        # synchronous no-persistence reference over the same full input
+        err, _ = _run(str(orders), cats_csv, str(root / "out_sync.csv"),
+                      inflight=1, pdir=None)
+        if err is not None:
+            print(f"FAIL: sync reference raised {type(err).__name__}: "
+                  f"{err}", file=sys.stderr)
+            return 1
+        want = _consolidate_csv(str(root / "out_sync.csv"))
+        if not want or got != want:
+            print(f"FAIL: recovered CSV != synchronous CSV "
+                  f"({len(got)} vs {len(want)} rows, seed {seed}, "
+                  f"{crashes} crashes)", file=sys.stderr)
+            for row in got[:5]:
+                print(f"  got : {row}", file=sys.stderr)
+            for row in want[:5]:
+                print(f"  want: {row}", file=sys.stderr)
+            return 1
+
+        # tentpole properties: a snapshot generation exists, and the WAL
+        # the NEXT restart would replay is much smaller than history
+        total_rows = (N_ROUNDS + 1) * FILES_PER_ROUND * ROWS_PER_FILE
+        if not pstats or pstats["snapshot_generation"] < 1:
+            print(f"FAIL: no operator-state snapshot was ever written: "
+                  f"{pstats}", file=sys.stderr)
+            return 1
+        if pstats["wal_replayable_entries"] > total_rows // 2:
+            print(f"FAIL: WAL not compacted — "
+                  f"{pstats['wal_replayable_entries']} replayable entries "
+                  f"vs {total_rows} total history", file=sys.stderr)
+            return 1
+        print(f"OK: seed {seed}, {crashes}/{N_ROUNDS} rounds crashed; "
+              f"recovered CSV identical to sync run ({len(got)} rows); "
+              f"snapshot generation {pstats['snapshot_generation']} at "
+              f"t={pstats['snapshot_tick']}; WAL replayable entries "
+              f"{pstats['wal_replayable_entries']} of {total_rows} "
+              f"ingested ({pstats['compactions_total']} compactions)")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    sys.exit(main())

@@ -358,3 +358,48 @@ def test_persistence_watermark_metrics_exposed():
     status = MonitoringHttpServer(rt, port=0).status_payload()
     assert status["persistence"]["watermark"] == 4
     assert status["persistence"]["lag_ticks"] == 2
+
+
+def test_snapshot_tier_metrics_exposed():
+    """Snapshot/compaction families (PR 10): age, bytes, generation,
+    totals, compactions and the replayable-entry gauge — plus the
+    /status.persistence naming of last snapshot tick + generation."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.http_server import MonitoringHttpServer
+    from pathway_tpu.engine.persistence import PersistenceDriver
+    from pathway_tpu.io._datasource import CallbackSource, Session
+
+    rt = _FakeRuntime()
+    backend = pw.persistence.Backend.mock()
+    driver = PersistenceDriver(pw.persistence.Config.simple_config(backend))
+    src = CallbackSource(lambda: iter(()), pw.schema_from_types(x=int))
+    src.persistent_id = "m"
+    rec = driver.attach_source(src, Session())
+    rec.push("k", (1,), 1)
+    driver.seal(2)
+    driver.commit(2, watermark=2)
+    assert driver.write_snapshot(2, {"nodes": {}}) is True
+    rec.push("k2", (2,), 1)
+    driver.seal(5)
+    driver.commit(5, watermark=5)
+    rt.persistence = driver
+
+    lines = _metrics_lines(rt)
+    samples = {f: v for f, _l, v in _parse_samples(lines)}
+    assert samples["pathway_tpu_snapshot_age_ticks"] == 3  # tick 5 vs 2
+    assert samples["pathway_tpu_snapshot_generation"] == 1
+    assert samples["pathway_tpu_snapshots_total"] == 1
+    assert samples["pathway_tpu_snapshot_bytes"] > 0
+    assert samples["pathway_tpu_compactions_total"] == 1
+    # compaction dropped the covered entry; one suffix entry remains
+    assert samples["pathway_tpu_wal_replayable_entries"] == 1
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    for fam in ("pathway_tpu_snapshot_age_ticks",
+                "pathway_tpu_snapshot_bytes",
+                "pathway_tpu_wal_replayable_entries",
+                "pathway_tpu_compactions_total"):
+        assert fam in typed
+    status = MonitoringHttpServer(rt, port=0).status_payload()
+    assert status["persistence"]["snapshot_tick"] == 2
+    assert status["persistence"]["snapshot_generation"] == 1
+    assert status["persistence"]["wal_replayable_entries"] == 1
