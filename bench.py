@@ -402,6 +402,16 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             errors["etl_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
+    if "scaleout" not in SKIP:
+        # exchange-plane scale-out leg (CPU-runnable): 4-process SPMD
+        # cluster vs 1 process over both transports (shm slab ring / raw
+        # tcp), etl_scaleout_efficiency under the cores-vs-workers
+        # honesty rule, byte-identity, per-transport encdec cost
+        try:
+            result.update(bench_scaleout())
+        except Exception as e:  # noqa: BLE001
+            errors["scaleout_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
     if "paging" not in SKIP:
         # paged-store leg (CPU-runnable): ingest stall across online
         # growth paged-vs-slab + ragged warmup compile count
@@ -1017,14 +1027,14 @@ def bench_etl(n_rows: int = 100_000) -> dict:
     keys, and native (C, Python-C-API) passes for the join bilinear update
     and the groupby gather/emit loops (native/fastjoin.cpp,
     native/fastgroup.cpp). True multi-process execution
-    (engine/multiproc.py, TCP exchange, PATHWAY_PROCESSES xT) is
-    implemented and correctness-tested (tests/test_sharded.py,
-    tests/test_cli.py), but wall-clock scaling is unobservable in this
-    environment: the container exposes ONE CPU core (etl_n_cores below),
-    so P processes timeshare it and pickle exchange adds ~20-25% on
-    trivial rows. On multi-core hosts the UDF-heavy path parallelizes
-    (stateless maps ship zero bytes cross-process; only group/join
-    exchanges pay pickling).
+    (engine/multiproc.py — columnar wire frames over tcp or same-host
+    shared memory, PATHWAY_PROCESSES xT) is correctness-tested
+    (tests/test_sharded.py, tests/test_cli.py) and has its own
+    ``scaleout`` leg (bench_scaleout) measuring etl_scaleout_efficiency
+    under the cores-vs-workers honesty rule; this leg's in-process
+    n_workers figures measure sharded scheduling on one interpreter,
+    where wall-clock scaling is unobservable on a 1-core container
+    (etl_n_cores below).
     """
     import pathway_tpu as pw
     from pathway_tpu.debug import table_from_rows
@@ -1039,29 +1049,45 @@ def bench_etl(n_rows: int = 100_000) -> dict:
 
     def bench_exchange() -> dict:
         """Serialization microbench of the multiprocess exchange plane
-        (engine/multiproc.py): bytes/row and enc+dec cost of the packed
-        payload format actually sent between cluster processes."""
-        import pickle as _p
+        (engine/wire.py): bytes/row and enc+dec cost of the columnar wire
+        format actually sent between cluster processes.
 
-        from pathway_tpu.engine.multiproc import (_pack_payload,
-                                                  _unpack_payload)
+        Methodology note — the r04→r05 "regression" (1.453 → 6.495
+        µs/row) was this microbench timing ONE encode+decode: decode
+        allocates tens of thousands of objects, so whenever a
+        generational GC pass (gen-2 scans the whole live heap, huge
+        after the earlier bench legs) landed inside the single timed
+        window the number exploded. Best-of-5 is immune to that class;
+        the single-trial figure is still reported for contrast, and
+        tests/test_exchange_perf.py pins the best-of-5 ≤ 3.0 absolute."""
+        from pathway_tpu.engine import wire
         from pathway_tpu.internals.keys import hash_values
 
         n = min(20_000, n_rows)
         ents = [(hash_values("row", i), (f"w{words[i]}", int(qtys[i])), 1)
                 for i in range(n)]
         payload = {"rows": {0: {0: ents}}, "wm": None, "bcast": None}
-        t0 = time.perf_counter()
-        blob = _p.dumps(("x", _pack_payload(payload)),
-                        protocol=_p.HIGHEST_PROTOCOL)
-        enc_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        _unpack_payload(_p.loads(blob)[1])
-        dec_s = time.perf_counter() - t0
+        trials = []
+        blob = b""
+        for _ in range(5):
+            t0 = time.perf_counter()
+            chunks, _total, _rows = wire.encode_frame(("x", 1, 0), payload)
+            blob = b"".join(chunks)
+            mid = time.perf_counter()
+            wire.decode_frame(blob)
+            trials.append((mid - t0, time.perf_counter() - mid))
+        best = min(trials, key=sum)
+        sums_us = [(e + d) / n * 1e6 for e, d in trials]
         return {
             "exchange_bytes_per_row": round(len(blob) / n, 1),
-            "exchange_encdec_us_per_row": round(
-                (enc_s + dec_s) / n * 1e6, 3),
+            "exchange_encode_us_per_row": round(best[0] / n * 1e6, 3),
+            "exchange_decode_us_per_row": round(best[1] / n * 1e6, 3),
+            "exchange_encdec_us_per_row": round(min(sums_us), 3),
+            # the old (r05) methodology and the spread, kept so the
+            # artifact itself shows why single-trial numbers were noise
+            "exchange_encdec_us_per_row_single_trial": round(
+                sums_us[0], 3),
+            "exchange_encdec_us_per_row_worst": round(max(sums_us), 3),
         }
 
     def run_once(n_workers: int) -> tuple[float, int]:
@@ -1087,14 +1113,14 @@ def bench_etl(n_rows: int = 100_000) -> dict:
             counts.word, counts.n, counts.total, lex.cat)
         runner = GraphRunner()
         runner.capture(joined)
-        exchanged = sum(
-            1 for node in runner.graph.nodes
-            if any(s is not None for s in node.op.exchange_specs()))
         t0 = time.perf_counter()
         runner.run_batch(n_workers=n_workers)
         dt = time.perf_counter() - t0
+        # coalesced BSP rounds a cluster would pay per tick (the batched
+        # exchange groups per-node barriers by topological level)
+        rounds = runner._scheduler.exchange_rounds_per_tick()
         G.clear()
-        return n_rows / dt, exchanged
+        return n_rows / dt, rounds
 
     def run_windowed() -> float:
         """Tumbling-window aggregation throughput (temporal hot path:
@@ -1125,7 +1151,7 @@ def bench_etl(n_rows: int = 100_000) -> dict:
         return n_rows / dt
 
     cores = os.cpu_count() or 1
-    r1, exchanged_nodes = run_once(1)
+    r1, exchange_rounds = run_once(1)
     r8, _ = run_once(8)
     # honest scaling presentation: an 8-worker figure on fewer than 8
     # cores measures timesharing, not scaling — label it so (round-4
@@ -1139,8 +1165,9 @@ def bench_etl(n_rows: int = 100_000) -> dict:
         "etl_n_rows": n_rows,
         "etl_ticks": n_ticks,
         "etl_n_cores": cores,
-        # cluster barrier count per tick = exchanged nodes (BSP rounds)
-        "etl_exchange_rounds_per_tick": exchanged_nodes,
+        # cluster barrier count per tick AFTER coalescing (BSP rounds;
+        # was = exchanged nodes before the batched exchange landed)
+        "etl_exchange_rounds_per_tick": exchange_rounds,
         **bench_exchange(),
     }
     if fit_workers > 1:
@@ -1149,6 +1176,185 @@ def bench_etl(n_rows: int = 100_000) -> dict:
         out["etl_rows_per_s_per_core"] = round(rN / fit_workers, 0)
     else:
         out["etl_rows_per_s_per_core"] = round(r1, 0)
+    return out
+
+
+_SCALEOUT_PROGRAM = """
+import json, os, sys, time
+import numpy as np
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_rows
+from pathway_tpu.engine.multiproc import get_cluster
+from pathway_tpu.internals.runner import GraphRunner
+
+n_rows = int(os.environ["BENCH_SCALEOUT_ROWS"])
+n_ticks = int(os.environ["BENCH_SCALEOUT_TICKS"])
+vocab = 5000
+rng = np.random.default_rng(0)
+words = rng.integers(0, vocab, size=n_rows)
+qtys = rng.integers(1, 10, size=n_rows)
+ticks = np.sort(rng.integers(0, n_ticks, size=n_rows))
+
+class S(pw.Schema):
+    word: str
+    qty: int
+
+class L(pw.Schema):
+    word: str
+    cat: str
+
+events = table_from_rows(
+    S, [(f"w{words[i]}", int(qtys[i]), int(ticks[i]) * 2, 1)
+        for i in range(n_rows)], is_stream=True)
+lex = table_from_rows(
+    L, [(f"w{i}", f"cat{i % 7}") for i in range(vocab)])
+counts = events.groupby(events.word).reduce(
+    events.word, n=pw.reducers.count(),
+    total=pw.reducers.sum(events.qty))
+joined = counts.join(lex, counts.word == lex.word).select(
+    counts.word, counts.n, counts.total, lex.cat)
+runner = GraphRunner()
+cap = runner.capture(joined)
+cl = get_cluster()
+t0 = time.perf_counter()
+runner.run_batch(cluster=cl)
+dt = time.perf_counter() - t0
+events_out = sorted((int(k), repr(r), t, d)
+                    for k, r, t, d in cap.consolidated_events())
+doc = {
+    "dt_s": dt,
+    "events": events_out,
+    "rounds_per_tick": runner._scheduler.exchange_rounds_per_tick(),
+    "stats": cl.stats if cl is not None else None,
+    "by_transport": cl.stats_by_transport if cl is not None else None,
+    "transports": cl.transport_counts() if cl is not None else {},
+}
+with open(sys.argv[1], "w") as f:
+    json.dump(doc, f)
+"""
+
+
+def bench_scaleout() -> dict:
+    """Honest multi-worker scale-out leg: the WordCount+join ETL pipeline
+    run as ONE process and as FOUR OS processes (SPMD cluster,
+    engine/multiproc.py) over both transports, reporting
+
+    * ``etl_scaleout_efficiency`` = (4-process rate / 1-process rate) /
+      min(4, cores) — the cores-vs-workers honesty rule from bench_etl: on
+      fewer than 4 cores the 4-process figure measures timesharing, so
+      the denominator only credits cores that exist and
+      ``scaleout_oversubscribed`` flags the run (CI gates ≥ 0.7 only on
+      ≥ 4-core runners — tests/scaleout_canary.py);
+    * byte-identity: the union of the 4 shards' consolidated outputs must
+      equal the 1-process events exactly, per transport;
+    * per-transport exchange cost from the live cluster counters (the
+      same numbers /metrics exports as pathway_tpu_exchange_*{transport=}).
+    """
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    n_rows = int(os.environ.get("BENCH_SCALEOUT_ROWS", 100_000))
+    n_ticks = int(os.environ.get("BENCH_SCALEOUT_TICKS", 20))
+    first_port = int(os.environ.get("BENCH_SCALEOUT_PORT", 19600))
+    workers = 4
+    cores = os.cpu_count() or 1
+
+    tmp = tempfile.mkdtemp(prefix="bench_scaleout_")
+    prog = os.path.join(tmp, "scaleout_prog.py")
+    with open(prog, "w") as f:
+        f.write(_SCALEOUT_PROGRAM)
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                    BENCH_SCALEOUT_ROWS=str(n_rows),
+                    BENCH_SCALEOUT_TICKS=str(n_ticks))
+    base_env.setdefault("PYTHONPATH", os.path.dirname(
+        os.path.abspath(__file__)))
+
+    def run_procs(n: int, port: int, transport: str) -> list[dict]:
+        handles = []
+        for pid in range(n):
+            env = dict(base_env, PATHWAY_PROCESSES=str(n),
+                       PATHWAY_PROCESS_ID=str(pid), PATHWAY_THREADS="1",
+                       PATHWAY_FIRST_PORT=str(port),
+                       PATHWAY_RUN_ID=f"scaleout-{transport}",
+                       PATHWAY_EXCHANGE_TRANSPORT=transport)
+            out_path = os.path.join(tmp, f"out_{transport}_{n}_{pid}")
+            handles.append((out_path, subprocess.Popen(
+                [_sys.executable, prog, out_path], env=env,
+                stderr=subprocess.PIPE, text=True)))
+        docs = []
+        try:
+            for out_path, h in handles:
+                _, err = h.communicate(timeout=600)
+                if h.returncode != 0:
+                    raise RuntimeError(
+                        f"scaleout child failed (rc={h.returncode}): "
+                        f"{err[-500:]}")
+                with open(out_path) as f:
+                    docs.append(json.load(f))
+        except BaseException:
+            # one child failing/timing out must not orphan its siblings:
+            # bench's main() absorbs this error and runs more legs, and a
+            # leaked 4-process cluster spins in exchange retries (recv
+            # timeout 300 s), distorting every later timing in the artifact
+            # and squatting on the ports for the next transport's run.
+            for _, h in handles:
+                if h.poll() is None:
+                    h.kill()
+            for _, h in handles:
+                try:
+                    h.communicate(timeout=10)
+                except Exception:
+                    pass
+            raise
+        return docs
+
+    [single] = run_procs(1, first_port, "tcp")
+    rate_1p = n_rows / single["dt_s"]
+    out: dict = {
+        "scaleout_rows": n_rows,
+        "scaleout_ticks": n_ticks,
+        "scaleout_workers": workers,
+        "scaleout_n_cores": cores,
+        "scaleout_oversubscribed": cores < workers,
+        "scaleout_rows_per_s_1p": round(rate_1p, 0),
+        "scaleout_rounds_per_tick": single["rounds_per_tick"],
+    }
+    expect = sorted(map(tuple, single["events"]))
+    best_rate, best_transport = 0.0, None
+    for transport in ("shm", "tcp"):
+        docs = run_procs(workers, first_port + 20
+                         + (0 if transport == "shm" else 20), transport)
+        # collective run: the slowest process bounds the wall-clock
+        rate = n_rows / max(d["dt_s"] for d in docs)
+        merged = sorted(tuple(e) for d in docs for e in d["events"])
+        identical = merged == expect
+        used = {t for d in docs for t in d["transports"]}
+        st = docs[0]["stats"]
+        t_st = docs[0]["by_transport"][transport]
+        enc_us = (t_st["encode_s"] * 1e6 / t_st["rows_out"]
+                  if t_st["rows_out"] else 0.0)
+        dec_us = (t_st["decode_s"] * 1e6 / t_st["rows_in"]
+                  if t_st["rows_in"] else 0.0)
+        out.update({
+            f"scaleout_rows_per_s_4p_{transport}": round(rate, 0),
+            f"scaleout_identical_{transport}": identical,
+            f"scaleout_transport_used_{transport}": sorted(used),
+            f"scaleout_exchange_encode_us_per_row_{transport}": round(
+                enc_us, 3),
+            f"scaleout_exchange_decode_us_per_row_{transport}": round(
+                dec_us, 3),
+            f"scaleout_exchange_rounds_{transport}": st["rounds"],
+        })
+        if transport == "shm":
+            out["scaleout_shm_slab_bytes"] = (st["shm_bytes_out"]
+                                              + st["shm_bytes_in"])
+        if identical and rate > best_rate:
+            best_rate, best_transport = rate, transport
+    if best_transport is not None:
+        out["etl_scaleout_efficiency"] = round(
+            (best_rate / rate_1p) / min(workers, cores), 3)
+        out["scaleout_best_transport"] = best_transport
     return out
 
 

@@ -656,143 +656,197 @@ class Scheduler:
         cl = self.cluster
         per_proc = L  # contiguous worker blocks of equal size per process
         outputs: dict[int, list[Delta]] = {}  # node.id -> per-LOCAL deltas
-        for node in self._topo:
-            reps = self._replicas[node.id]
-            if self._gather[node.id]:
-                outs = self._step_gather(node, reps, time, flush, outputs, L)
+        # Coalesced exchange: nodes whose routing is computed wait here
+        # (unstepped) so their cross-process rows share ONE frame per peer
+        # — the per-node ("x", time, node.id) barrier round collapses to
+        # one round per *level* of the topological order. A node whose
+        # input is still pending forces a flush first (its send rows need
+        # that input stepped), so batch boundaries follow the dependency
+        # structure and are SPMD-deterministic; the batch ordinal in the
+        # tag catches any skew.
+        pending: list[dict] = []
+        pending_ids: set[int] = set()
+        batch_no = 0
+
+        def finish_step(ctx) -> None:
+            node, reps = ctx["node"], ctx["reps"]
+            per_worker = ctx["per_worker"]
+            if ctx["wm_node"] and ctx["wm_local"] is not None:
+                reps[0]._advance_watermark_value(ctx["wm_local"])
+            if self._pool is not None and reps[0].parallel_safe:
+                outs = list(self._pool.map(
+                    lambda w: self._step_op(node, reps[w], time,
+                                            per_worker[w], flush),
+                    range(L)))
             else:
-                op0 = reps[0] if reps else node.op
-                specs = op0.exchange_specs()
-                consolidate = op0.consolidate_inputs
-                per_worker: list[list[Delta]] = [
-                    [_EMPTY] * len(node.inputs) for _ in range(L)]
-                # remote shares: peer -> {input j -> {global worker -> entries}}
-                send: dict[int, dict] = {}
-                exchanged = False
-                bcast: dict[int, list] = {}  # input j -> entries for peers
-                for j, up in enumerate(node.inputs):
-                    parts = outputs.get(up.id) or [_EMPTY] * L
-                    spec = specs[j]
-                    if spec is None:
-                        for w in range(L):
-                            per_worker[w][j] = parts[w]
-                        continue
-                    exchanged = True
-                    if spec == Exchange.BROADCAST:
-                        # every local worker sees the complete delta; under
-                        # a cluster the local share also goes to all peers
-                        ents: list = []
-                        for p in parts:
-                            ents.extend(p.entries)
-                        if cl is not None and ents:
-                            bcast[j] = ents
-                        if ents:
-                            merged = Delta(list(ents))
-                            if consolidate:
-                                merged = merged.consolidate()
-                            for w in range(L):
-                                per_worker[w][j] = merged
-                        continue
-                    routed = [[] for _ in range(L)]
-                    if spec == Exchange.BY_KEY:
-                        for p in parts:
-                            for e in p.entries:  # inline: keys are ints
-                                gw = int(e[0]) % n
-                                if lo <= gw < hi:
-                                    routed[gw - lo].append(e)
-                                else:
-                                    send.setdefault(gw // per_proc, {}) \
-                                        .setdefault(j, {}) \
-                                        .setdefault(gw, []).append(e)
-                    else:
-                        # non-int route values (instance columns etc.)
-                        # repeat heavily tick after tick: memoize value ->
-                        # worker per edge. Ints (already-uniform Pointers)
-                        # route directly — % is cheaper than the cache
-                        # probe — and tuples are per-row null sentinels
-                        # that would never hit.
-                        cache = self._route_cache.setdefault(
-                            (node.id, j), {})
-                        for p in parts:
-                            for e in p.entries:
-                                v = spec(e[0], e[1])
-                                if isinstance(v, int):
-                                    gw = int(v) % n
-                                elif isinstance(v, tuple):
-                                    gw = self._route_value(v)
-                                else:
-                                    try:
-                                        gw = cache.get(v)
-                                    except TypeError:  # unhashable
-                                        gw = self._route_value(v)
-                                    else:
-                                        if gw is None:
-                                            gw = self._route_value(v)
-                                            if len(cache) >= \
-                                                    self._route_cache_max:
-                                                cache.clear()
-                                            cache[v] = gw
-                                if lo <= gw < hi:
-                                    routed[gw - lo].append(e)
-                                else:
-                                    send.setdefault(gw // per_proc, {}) \
-                                        .setdefault(j, {}) \
-                                        .setdefault(gw, []).append(e)
-                    self._merge_routed(per_worker, routed, j, consolidate)
-                # temporal operators share one watermark across workers
-                # (global, like a timely frontier): advance it from every
-                # process's pre-routing input before any replica releases
-                # rows on it — the candidate scalar rides the exchange
-                wm_local = None
-                wm_node = reps and hasattr(reps[0], "_advance_watermark")
-                if wm_node:
-                    for j, up in enumerate(node.inputs):
-                        for p in outputs.get(up.id) or ():
-                            wm_local = _wm_max(
-                                wm_local, reps[0]._watermark_candidate(p))
-                if cl is not None and (exchanged or wm_node):
-                    msgs = {p: {"rows": send.get(p), "wm": wm_local,
-                                "bcast": bcast or None}
-                            for p in cl.peers}
-                    recv = cl.exchange(("x", time, node.id), msgs)
-                    for payload in recv.values():
-                        if payload is None:
-                            continue
-                        rows = payload.get("rows")
-                        if rows:
-                            for j, by_worker in rows.items():
-                                routed = [[] for _ in range(L)]
-                                for gw, ents in by_worker.items():
-                                    routed[gw - lo].extend(ents)
-                                self._merge_routed(per_worker, routed, j,
-                                                   consolidate)
-                        peer_bcast = payload.get("bcast")
-                        if peer_bcast:
-                            for j, ents in peer_bcast.items():
-                                for w in range(L):
-                                    cur = per_worker[w][j]
-                                    base = cur.entries if cur is not _EMPTY \
-                                        else []
-                                    merged = Delta(base + ents)
-                                    per_worker[w][j] = merged.consolidate() \
-                                        if consolidate else merged
-                        wm_local = _wm_max(wm_local, payload.get("wm"))
-                if wm_node and wm_local is not None:
-                    reps[0]._advance_watermark_value(wm_local)
-                if self._pool is not None and reps[0].parallel_safe:
-                    outs = list(self._pool.map(
-                        lambda w: self._step_op(node, reps[w], time,
-                                                per_worker[w], flush),
-                        range(L)))
-                else:
-                    outs = [
-                        self._step_op(node, reps[w], time, per_worker[w],
-                                      flush)
-                        for w in range(L)
-                    ]
+                outs = [
+                    self._step_op(node, reps[w], time, per_worker[w],
+                                  flush)
+                    for w in range(L)
+                ]
             outputs[node.id] = outs
             for d in outs:
                 self._count(node.id, d)
+
+        def flush_exchange() -> None:
+            nonlocal batch_no
+            if not pending:
+                return
+            msgs = {
+                p: {ctx["node"].id: {"rows": ctx["send"].get(p),
+                                     "wm": ctx["wm_local"],
+                                     "bcast": ctx["bcast"] or None}
+                    for ctx in pending}
+                for p in cl.peers
+            }
+            recv = cl.exchange(("x", time, batch_no), msgs)
+            batch_no += 1
+            for ctx in pending:
+                node = ctx["node"]
+                per_worker = ctx["per_worker"]
+                consolidate = ctx["consolidate"]
+                wm_local = ctx["wm_local"]
+                for by_node in recv.values():
+                    payload = by_node.get(node.id) if by_node else None
+                    if payload is None:
+                        continue
+                    rows = payload.get("rows")
+                    if rows:
+                        for j, by_worker in rows.items():
+                            routed = [[] for _ in range(L)]
+                            for gw, ents in by_worker.items():
+                                routed[gw - lo].extend(ents)
+                            self._merge_routed(per_worker, routed, j,
+                                               consolidate)
+                    peer_bcast = payload.get("bcast")
+                    if peer_bcast:
+                        for j, ents in peer_bcast.items():
+                            for w in range(L):
+                                cur = per_worker[w][j]
+                                base = cur.entries \
+                                    if cur is not _EMPTY else []
+                                merged = Delta(base + ents)
+                                per_worker[w][j] = merged.consolidate() \
+                                    if consolidate else merged
+                    wm_local = _wm_max(wm_local, payload.get("wm"))
+                ctx["wm_local"] = wm_local
+                finish_step(ctx)
+            pending.clear()
+            pending_ids.clear()
+
+        for node in self._topo:
+            reps = self._replicas[node.id]
+            if self._gather[node.id]:
+                # gather reads its inputs' outputs AND runs its own
+                # ("g", ...) round — resolve any pending batch first
+                flush_exchange()
+                outs = self._step_gather(node, reps, time, flush, outputs,
+                                         L)
+                outputs[node.id] = outs
+                for d in outs:
+                    self._count(node.id, d)
+                continue
+            if pending_ids and any(up.id in pending_ids
+                                   for up in node.inputs):
+                flush_exchange()
+            op0 = reps[0] if reps else node.op
+            specs = op0.exchange_specs()
+            consolidate = op0.consolidate_inputs
+            per_worker: list[list[Delta]] = [
+                [_EMPTY] * len(node.inputs) for _ in range(L)]
+            # remote shares: peer -> {input j -> {global worker -> entries}}
+            send: dict[int, dict] = {}
+            exchanged = False
+            bcast: dict[int, list] = {}  # input j -> entries for peers
+            for j, up in enumerate(node.inputs):
+                parts = outputs.get(up.id) or [_EMPTY] * L
+                spec = specs[j]
+                if spec is None:
+                    for w in range(L):
+                        per_worker[w][j] = parts[w]
+                    continue
+                exchanged = True
+                if spec == Exchange.BROADCAST:
+                    # every local worker sees the complete delta; under
+                    # a cluster the local share also goes to all peers
+                    ents: list = []
+                    for p in parts:
+                        ents.extend(p.entries)
+                    if cl is not None and ents:
+                        bcast[j] = ents
+                    if ents:
+                        merged = Delta(list(ents))
+                        if consolidate:
+                            merged = merged.consolidate()
+                        for w in range(L):
+                            per_worker[w][j] = merged
+                    continue
+                routed = [[] for _ in range(L)]
+                if spec == Exchange.BY_KEY:
+                    for p in parts:
+                        for e in p.entries:  # inline: keys are ints
+                            gw = int(e[0]) % n
+                            if lo <= gw < hi:
+                                routed[gw - lo].append(e)
+                            else:
+                                send.setdefault(gw // per_proc, {}) \
+                                    .setdefault(j, {}) \
+                                    .setdefault(gw, []).append(e)
+                else:
+                    # non-int route values (instance columns etc.)
+                    # repeat heavily tick after tick: memoize value ->
+                    # worker per edge. Ints (already-uniform Pointers)
+                    # route directly — % is cheaper than the cache
+                    # probe — and tuples are per-row null sentinels
+                    # that would never hit.
+                    cache = self._route_cache.setdefault(
+                        (node.id, j), {})
+                    for p in parts:
+                        for e in p.entries:
+                            v = spec(e[0], e[1])
+                            if isinstance(v, int):
+                                gw = int(v) % n
+                            elif isinstance(v, tuple):
+                                gw = self._route_value(v)
+                            else:
+                                try:
+                                    gw = cache.get(v)
+                                except TypeError:  # unhashable
+                                    gw = self._route_value(v)
+                                else:
+                                    if gw is None:
+                                        gw = self._route_value(v)
+                                        if len(cache) >= \
+                                                self._route_cache_max:
+                                            cache.clear()
+                                        cache[v] = gw
+                            if lo <= gw < hi:
+                                routed[gw - lo].append(e)
+                            else:
+                                send.setdefault(gw // per_proc, {}) \
+                                    .setdefault(j, {}) \
+                                    .setdefault(gw, []).append(e)
+                self._merge_routed(per_worker, routed, j, consolidate)
+            # temporal operators share one watermark across workers
+            # (global, like a timely frontier): advance it from every
+            # process's pre-routing input before any replica releases
+            # rows on it — the candidate scalar rides the exchange
+            wm_local = None
+            wm_node = bool(reps) and hasattr(reps[0], "_advance_watermark")
+            if wm_node:
+                for j, up in enumerate(node.inputs):
+                    for p in outputs.get(up.id) or ():
+                        wm_local = _wm_max(
+                            wm_local, reps[0]._watermark_candidate(p))
+            ctx = {"node": node, "reps": reps, "per_worker": per_worker,
+                   "send": send, "bcast": bcast, "wm_local": wm_local,
+                   "wm_node": wm_node, "consolidate": consolidate}
+            if cl is not None and (exchanged or wm_node):
+                pending.append(ctx)
+                pending_ids.add(node.id)
+            else:
+                finish_step(ctx)
+        flush_exchange()
         requests = self._tracked_requests()
         if requests is not None:
             # sharded execution is bulk-synchronous: the whole tick is
@@ -801,6 +855,31 @@ class Scheduler:
         if self.on_step is not None:
             self.on_step(time)
         return _MergedOutputs(outputs)
+
+    def exchange_rounds_per_tick(self) -> int:
+        """Cluster BSP rounds one tick costs after exchange coalescing
+        (static estimate from the graph, assuming a cluster is attached):
+        exchanged/watermark nodes share one round per topological level;
+        a gather node flushes the open batch and pays its own round."""
+        rounds = 0
+        pending: set[int] = set()
+        for node in self._topo:
+            if self._gather[node.id]:
+                if pending:
+                    rounds += 1
+                    pending = set()
+                rounds += 1
+                continue
+            reps = self._replicas[node.id]
+            op0 = reps[0] if reps else node.op
+            exchanged = any(s is not None for s in op0.exchange_specs())
+            wm_node = bool(reps) and hasattr(reps[0], "_advance_watermark")
+            if pending and any(up.id in pending for up in node.inputs):
+                rounds += 1
+                pending = set()
+            if exchanged or wm_node:
+                pending.add(node.id)
+        return rounds + (1 if pending else 0)
 
     @staticmethod
     def _merge_routed(per_worker, routed, j, consolidate: bool = True) -> None:

@@ -253,27 +253,40 @@ class MonitoringHttpServer:
                 f"pathway_tpu_slo_burn_rate {round(tracker.burn_rate(), 6)}")
         cluster = getattr(self.runtime, "cluster", None)
         if cluster is not None and getattr(cluster, "stats", None):
-            # exchange-plane cost per row (engine/multiproc.py): the
-            # surface that makes an encdec regression visible per-run
+            # exchange-plane cost per row (engine/multiproc.py), split by
+            # transport (tcp sockets vs same-host shared-memory rings):
+            # the surface that makes an encdec regression visible per-run
+            # AND shows which link kind carried the rows
             cst = cluster.stats
+            by_t = getattr(cluster, "stats_by_transport", None) or {}
             lines.append(
                 "# TYPE pathway_tpu_exchange_encode_us_per_row gauge")
-            lines.append(f"pathway_tpu_exchange_encode_us_per_row "
-                         f"{round(cluster.encode_us_per_row(), 6)}")
+            for t in sorted(by_t):
+                lines.append(
+                    f'pathway_tpu_exchange_encode_us_per_row'
+                    f'{{transport="{esc(t)}"}} '
+                    f"{round(cluster.encode_us_per_row(t), 6)}")
             lines.append(
                 "# TYPE pathway_tpu_exchange_decode_us_per_row gauge")
-            lines.append(f"pathway_tpu_exchange_decode_us_per_row "
-                         f"{round(cluster.decode_us_per_row(), 6)}")
-            lines.append("# TYPE pathway_tpu_exchange_rows_out counter")
-            lines.append(
-                f"pathway_tpu_exchange_rows_out {cst['rows_out']}")
-            lines.append("# TYPE pathway_tpu_exchange_rows_in counter")
-            lines.append(f"pathway_tpu_exchange_rows_in {cst['rows_in']}")
-            lines.append("# TYPE pathway_tpu_exchange_bytes_out counter")
-            lines.append(
-                f"pathway_tpu_exchange_bytes_out {cst['bytes_out']}")
-            lines.append("# TYPE pathway_tpu_exchange_bytes_in counter")
-            lines.append(f"pathway_tpu_exchange_bytes_in {cst['bytes_in']}")
+            for t in sorted(by_t):
+                lines.append(
+                    f'pathway_tpu_exchange_decode_us_per_row'
+                    f'{{transport="{esc(t)}"}} '
+                    f"{round(cluster.decode_us_per_row(t), 6)}")
+            for fam in ("rows_out", "rows_in", "bytes_out", "bytes_in",
+                        "messages"):
+                lines.append(f"# TYPE pathway_tpu_exchange_{fam} counter")
+                for t in sorted(by_t):
+                    lines.append(
+                        f'pathway_tpu_exchange_{fam}'
+                        f'{{transport="{esc(t)}"}} {by_t[t][fam]}')
+            # slab traffic that bypassed the sockets entirely (bytes_out
+            # above counts doorbells only for shm links) and the global
+            # barrier count, which spans transports
+            lines.append("# TYPE pathway_tpu_exchange_shm_bytes counter")
+            shm_total = (cst.get("shm_bytes_out", 0)
+                         + cst.get("shm_bytes_in", 0))
+            lines.append(f"pathway_tpu_exchange_shm_bytes {shm_total}")
             lines.append("# TYPE pathway_tpu_exchange_rounds counter")
             lines.append(f"pathway_tpu_exchange_rounds {cst['rounds']}")
         sup = getattr(self.runtime, "supervisor", None)

@@ -9,9 +9,23 @@ with the same node ids; global logical workers ``[0, P*T)`` are owned in
 contiguous blocks of T per process, and rows cross processes only at
 operator exchange boundaries.
 
-Transport is ``multiprocessing.connection`` over loopback/LAN TCP — the
-host-side control+exchange plane (the reference's timely ``communication``
-crate). Device-side data parallelism rides the jax mesh/ICI instead
+Two transports carry the frames (engine/wire.py's self-describing columnar
+format — length-prefixed byte slabs, the shape timely's ``communication``
+crate hands to its sockets, with no pickle round-trip on the row path):
+
+* **tcp** — raw loopback/LAN sockets, ``sendall`` out, ``recv_into`` into a
+  reusable per-peer buffer (no per-frame allocation on either side).
+* **shm** — for same-host peers (selected automatically, or forced via
+  ``PATHWAY_EXCHANGE_TRANSPORT``): a ``multiprocessing.shared_memory`` slab
+  ring per direction (``PATHWAY_SHM_RING_BYTES``, 4 slots). The writer
+  copies the frame chunks straight into a free slot (no join, no socket
+  copy) and rings a 13-byte doorbell on the paired socket — the portable
+  stand-in for an eventfd, which unrelated processes cannot share without
+  SCM_RIGHTS plumbing; the reader decodes *in place* from the slot's
+  memoryview, then releases the slot. Frames larger than a slot fall back
+  to the TCP path for that frame.
+
+Device-side data parallelism rides the jax mesh/ICI instead
 (parallel/mesh.py); this plane moves host rows and progress barriers, which
 are control flow, not tensor math (SURVEY §5 distributed-communication
 mapping).
@@ -20,74 +34,339 @@ mapping).
 from __future__ import annotations
 
 import errno
+import hmac as hmac_mod
 import logging
 import os
-import pickle
 import selectors
 import socket
+import struct
 import time
-from multiprocessing.connection import (Connection, Listener,
-                                        answer_challenge, deliver_challenge)
 from typing import Any
 
+from pathway_tpu.engine import wire
 from pathway_tpu.engine.locking import assert_unlocked
 from pathway_tpu.engine.threads import spawn
-from pathway_tpu.internals.keys import Pointer
 from pathway_tpu.testing import faults
 
 logger = logging.getLogger(__name__)
 
-_ENTS = "__pw_ents__"
+_u32 = struct.Struct("<I")
+_u64 = struct.Struct("<Q")
+_DOORBELL = struct.Struct("<cIQ")  # b"S" | slot | length
+_INLINE_HDR = struct.Struct("<cQ")  # b"F" | length
+_SHM_ACK = b"A"  # dialer -> listener: rings attached and token verified
+
+TRANSPORTS = ("tcp", "shm")
 
 
-def _pack_payload(obj):
-    """Compact the dominant exchange payload shape — lists of
-    (Pointer, row, diff) entries — before pickling: Pointers serialize as
-    one 16-byte blob per list instead of a per-instance class reconstruct
-    (measured: ~3.6x faster dumps, ~25% fewer bytes per row)."""
-    if isinstance(obj, list) and obj:
-        e = obj[0]
-        if (type(e) is tuple and len(e) == 3 and isinstance(e[0], int)
-                and not isinstance(e[0], bool)):
+class ClusterConnectError(ConnectionError):
+    """Cluster wiring failed inside its deadline — a peer never dialed,
+    died mid-handshake, or presented a bad authkey. Named so a wedged
+    ``connect()`` surfaces as a diagnosis instead of a hang."""
+
+
+def _stat_block() -> dict:
+    return {"bytes_out": 0, "bytes_in": 0, "messages": 0, "rounds": 0,
+            "encode_s": 0.0, "decode_s": 0.0, "rows_out": 0, "rows_in": 0}
+
+
+def _send_exact(sock: socket.socket, data) -> None:
+    sock.sendall(data)
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` from the socket. Raises EOFError on a clean peer
+    close — the signal the peer-death path keys on."""
+    got = 0
+    need = len(view)
+    while got < need:
+        n = sock.recv_into(view[got:])
+        if n == 0:
+            raise EOFError("cluster peer closed connection")
+        got += n
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return buf
+
+
+def _send_hello(sock: socket.socket, obj: dict) -> None:
+    import pickle
+
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    _send_exact(sock, _u32.pack(len(blob)) + blob)
+
+
+def _recv_hello(sock: socket.socket) -> dict:
+    import pickle
+
+    (n,) = _u32.unpack(bytes(_recv_exact(sock, 4)))
+    if n > 1 << 20:
+        raise ClusterConnectError(
+            f"absurd hello length {n} — not a pathway-tpu peer?")
+    return pickle.loads(bytes(_recv_exact(sock, n)))
+
+
+def shm_ring_bytes() -> int:
+    try:
+        return max(1 << 16,
+                   int(os.environ.get("PATHWAY_SHM_RING_BYTES",
+                                      str(8 << 20))))
+    except ValueError:
+        return 8 << 20
+
+
+def _wire_compat() -> tuple:
+    """Native buffer layout this process would put on the wire: byte order
+    plus the array itemsizes the columnar codec's bulk buffers use
+    (engine/wire.py packs diff/int/float/length arrays native-endian)."""
+    import sys
+    from array import array
+
+    return (sys.byteorder, array("i").itemsize, array("I").itemsize,
+            array("q").itemsize, array("d").itemsize)
+
+
+def _wire_compat_error(theirs, peer_id: int) -> str | None:
+    """None when compatible; otherwise the named refusal. Hellos from
+    peers predating the field (None) are treated as compatible — the
+    frame magic/version still guards gross protocol skew."""
+    if theirs is None or tuple(theirs) == _wire_compat():
+        return None
+    return (f"peer {peer_id} has an incompatible native wire layout "
+            f"{tuple(theirs)} vs {_wire_compat()} (byte order / array "
+            "itemsizes): columnar wire format v1 ships native-endian bulk "
+            "buffers and refuses cross-endian clusters rather than "
+            "decoding corrupt rows")
+
+
+def transport_mode() -> str:
+    """``PATHWAY_EXCHANGE_TRANSPORT``: auto (default — shm for same-host
+    peers, tcp across hosts), shm (same-host required; warns and keeps tcp
+    if the peer is remote), or tcp (force sockets everywhere)."""
+    mode = os.environ.get("PATHWAY_EXCHANGE_TRANSPORT", "auto").lower()
+    if mode not in ("auto", "shm", "tcp"):
+        logger.warning("unknown PATHWAY_EXCHANGE_TRANSPORT=%r; using auto",
+                       mode)
+        return "auto"
+    return mode
+
+
+def _shm_headroom() -> int | None:
+    """Free bytes on /dev/shm, or None when undeterminable (non-Linux).
+    SharedMemory's create ftruncate()s tmpfs sparsely, so an over-capacity
+    ring is created "successfully" and the first slot write past the
+    limit kills the process with SIGBUS — the only safe check is up
+    front. Docker's default /dev/shm is 64 MiB; a 4-process cluster at
+    the 8 MiB ring default needs ~96 MiB."""
+    try:
+        st = os.statvfs("/dev/shm")
+    except (AttributeError, OSError):  # pragma: no cover - non-Linux
+        return None
+    return st.f_bavail * st.f_frsize
+
+
+class _ShmRing:
+    """One direction of a same-host exchange link: a shared-memory slab
+    split into ``nslots`` equal slots, each guarded by a 1-byte state flag
+    (0 = free, 1 = full). The writer claims slot ``seq % nslots``, copies
+    the frame chunks in, flips the flag, and rings the doorbell on the
+    paired socket; the reader decodes in place and flips the flag back.
+    Single-producer/single-consumer by construction (one direction of one
+    peer pair), so the byte-sized flags are the whole protocol — the
+    socket doorbell provides the cross-process ordering barrier."""
+
+    _HDR = struct.Struct("<4sIQ")  # magic | nslots | slot_bytes
+
+    def __init__(self, name: str | None = None, *, nslots: int = 4,
+                 slot_bytes: int | None = None):
+        from multiprocessing import resource_tracker, shared_memory
+
+        if name is None:
+            if slot_bytes is None:
+                slot_bytes = max(4096, shm_ring_bytes() // nslots)
+            size = self._HDR.size + nslots + nslots * slot_bytes
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            self.created = True
+            self._HDR.pack_into(self._shm.buf, 0, b"PWSH", nslots,
+                                slot_bytes)
+            self.nslots = nslots
+            self.slot_bytes = slot_bytes
+        else:
+            # CPython 3.10 registers ATTACHERS with the resource tracker
+            # too (bpo-38119), so both sides would unlink at exit
+            # (double-unlink noise, and an early unlink if the attacher
+            # exits first). Undo the registration AFTER the attach — a
+            # global register monkeypatch would race unrelated
+            # SharedMemory creates on other threads (their segments would
+            # silently lose tracker coverage for the whole patch window).
+            self._shm = shared_memory.SharedMemory(name=name)
             try:
-                # the genexpr also validates shape: a non-3-tuple or
-                # negative/oversized key raises and the list ships raw
-                keys = b"".join(int(k).to_bytes(16, "little")
-                                for k, _r, _d in obj)
-            except (TypeError, ValueError, OverflowError):
-                return obj
-            return (_ENTS, keys, [r for _k, r, _d in obj],
-                    [d for _k, _r, d in obj])
-        return obj
-    if isinstance(obj, dict):
-        return {k: _pack_payload(v) for k, v in obj.items()}
-    return obj
+                resource_tracker.unregister(self._shm._name,
+                                            "shared_memory")
+            except Exception:  # pragma: no cover - tracker quirks
+                pass
+            self.created = False
+            magic, self.nslots, self.slot_bytes = self._HDR.unpack_from(
+                self._shm.buf, 0)
+            if magic != b"PWSH":
+                self._shm.close()  # mapped but unusable — do not leak it
+                raise ClusterConnectError(
+                    f"shared-memory ring {name} has bad magic")
+        self.name = self._shm.name
+        self._state_off = self._HDR.size
+        self._data_off = self._HDR.size + self.nslots
+        self._seq = 0
+
+    def _slot_view(self, slot: int) -> memoryview:
+        off = self._data_off + slot * self.slot_bytes
+        return self._shm.buf[off:off + self.slot_bytes]
+
+    def write(self, chunks: list, total: int,
+              deadline: float) -> int | None:
+        """Copy ``chunks`` into the next slot; returns the slot index, or
+        None when the frame exceeds the slot size (caller sends inline
+        over TCP instead). Blocks until the slot is free — a reader that
+        never drains surfaces as a TimeoutError, not silent corruption."""
+        if total > self.slot_bytes:
+            return None
+        slot = self._seq % self.nslots
+        buf = self._shm.buf
+        state_at = self._state_off + slot
+        pause = 20e-6
+        while buf[state_at]:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shm ring slot {slot} not released within deadline "
+                    "(peer hung mid-exchange?)")
+            time.sleep(pause)
+            pause = min(pause * 2, 0.002)
+        view = self._slot_view(slot)
+        pos = 0
+        for c in chunks:
+            ln = len(c)
+            view[pos:pos + ln] = c
+            pos += ln
+        buf[state_at] = 1
+        self._seq += 1
+        return slot
+
+    def read_view(self, slot: int, length: int) -> memoryview:
+        return self._slot_view(slot)[:length]
+
+    # attach-verification token: the listener writes random bytes into
+    # slot 0's data region (the slot flag stays free, so the first real
+    # frame simply overwrites them) and ships them in the hello reply;
+    # the dialer proves the mapping is genuinely the SAME memory by
+    # reading them back. Hostname equality alone lies for cloned
+    # VMs/containers with a default hostname.
+    def poke_token(self, token: bytes) -> None:
+        view = self._slot_view(0)
+        view[:len(token)] = token
+
+    def peek_token(self, n: int) -> bytes:
+        return bytes(self._slot_view(0)[:n])
+
+    def release(self, slot: int) -> None:
+        self._shm.buf[self._state_off + slot] = 0
+
+    def close(self) -> None:
+        try:
+            self._shm.close()
+        except Exception as e:  # pragma: no cover - teardown best-effort
+            # typically BufferError: a raised frame's traceback still
+            # pins a slot view, so the mmap cannot unmap yet — it dies
+            # with the process either way
+            logger.debug("shm ring %s close failed: %s", self.name, e)
+        finally:
+            # unlink regardless: it only removes the NAME, and skipping
+            # it (the old close-then-unlink chain) leaked the segment on
+            # /dev/shm forever whenever close() raised
+            if self.created:
+                try:
+                    self._shm.unlink()
+                except FileNotFoundError:
+                    pass
+                except Exception as e:  # pragma: no cover - teardown
+                    logger.debug("shm ring %s unlink failed: %s",
+                                 self.name, e)
 
 
-def _payload_rows(obj) -> int:
-    """Entry count of a (packed or unpacked) exchange payload — the
-    denominator for the per-row encode/decode gauges. Entry lists (and
-    packed _ENTS tuples) count their rows; scalars count zero."""
-    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == _ENTS:
-        return len(obj[2])
-    if isinstance(obj, list):
-        return len(obj)
-    if isinstance(obj, dict):
-        return sum(_payload_rows(v) for v in obj.values())
-    return 0
+class _Peer:
+    """One duplex cluster link: the TCP socket (frames, doorbells, and the
+    handshake) plus optional shared-memory rings for bulk payloads."""
+
+    def __init__(self, sock: socket.socket, transport: str = "tcp",
+                 tx_ring: _ShmRing | None = None,
+                 rx_ring: _ShmRing | None = None):
+        self.sock = sock
+        self.transport = transport
+        self.tx_ring = tx_ring
+        self.rx_ring = rx_ring
+        self._rbuf = bytearray(1 << 16)  # reusable inline-frame buffer
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(sock, selectors.EVENT_READ)
+
+    def send_frame(self, chunks: list, total: int,
+                   deadline: float) -> int:
+        """Ship one frame; returns bytes put on the wire (shm doorbells
+        count their 13 control bytes, not the slab traffic — ``bytes_out``
+        measures socket pressure; slab bytes ride ``shm_bytes``)."""
+        if self.tx_ring is not None:
+            slot = self.tx_ring.write(chunks, total, deadline)
+            if slot is not None:
+                self.sock.sendall(_DOORBELL.pack(b"S", slot, total))
+                return _DOORBELL.size
+        hdr = _INLINE_HDR.pack(b"F", total)
+        self.sock.sendall(b"".join([hdr, *chunks]))
+        return _INLINE_HDR.size + total
+
+    def wait_readable(self, timeout: float) -> bool:
+        return bool(self._sel.select(timeout))
+
+    def recv_frame(self):
+        """Read one frame. Returns ``(view, release, wire_bytes)`` —
+        ``view`` is valid until ``release()`` is called (shm slot, or the
+        reusable inline buffer)."""
+        hdr = bytes(_recv_exact(self.sock, 1))
+        if hdr == b"S":
+            rest = _recv_exact(self.sock, _DOORBELL.size - 1)
+            slot, length = struct.unpack("<IQ", bytes(rest))
+            ring = self.rx_ring
+            if ring is None:
+                raise RuntimeError(
+                    "shm doorbell received but no ring attached "
+                    "(transport negotiation skew)")
+            view = ring.read_view(slot, length)
+            return view, lambda: ring.release(slot), _DOORBELL.size
+        if hdr == b"F":
+            (length,) = _u64.unpack(
+                bytes(_recv_exact(self.sock, _INLINE_HDR.size - 1)))
+            if length > len(self._rbuf):
+                self._rbuf = bytearray(max(length, 2 * len(self._rbuf)))
+            view = memoryview(self._rbuf)[:length]
+            _recv_exact_into(self.sock, view)
+            return view, _noop, _INLINE_HDR.size + length
+        raise RuntimeError(
+            f"cluster protocol skew: unknown frame type {hdr!r}")
+
+    def close(self) -> None:
+        try:
+            self._sel.close()
+        except Exception:  # pragma: no cover
+            pass
+        try:
+            self.sock.close()
+        finally:
+            for ring in (self.tx_ring, self.rx_ring):
+                if ring is not None:
+                    ring.close()
 
 
-def _unpack_payload(obj):
-    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == _ENTS:
-        _tag, kb, rows, diffs = obj
-        return [
-            (Pointer(int.from_bytes(kb[i * 16:(i + 1) * 16], "little")),
-             rows[i], diffs[i])
-            for i in range(len(rows))
-        ]
-    if isinstance(obj, dict):
-        return {k: _unpack_payload(v) for k, v in obj.items()}
-    return obj
+def _noop() -> None:
+    return None
 
 
 class Cluster:
@@ -106,59 +385,325 @@ class Cluster:
         self.process_id = int(process_id)
         self.first_port = int(first_port)
         self.authkey = f"pathway-tpu/{run_id or 'cluster'}".encode()
-        self.peers: dict[int, Connection] = {}
-        self._listener: Listener | None = None
-        self._seq = 0
+        self.peers: dict[int, _Peer] = {}
+        self._listener: socket.socket | None = None
         # exchange-plane telemetry (bytes/messages/barriers + enc/dec cost
         # per row) for perf work; exported on /metrics as
-        # pathway_tpu_exchange_* so the encdec regression the r5 driver
-        # caught (1.453 -> 6.495 us/row) is visible per-run
-        self.stats = {"bytes_out": 0, "bytes_in": 0, "messages": 0,
-                      "rounds": 0, "encode_s": 0.0, "decode_s": 0.0,
-                      "rows_out": 0, "rows_in": 0}
+        # pathway_tpu_exchange_*{transport=...} so the encdec regression
+        # the r5 driver caught (1.453 -> 6.495 us/row) is visible per-run
+        # AND per-transport. `stats` keeps the cross-transport totals;
+        # `stats_by_transport` splits them by link kind. shm slab traffic
+        # is accounted as shm_bytes_out/_in (bytes_out/in measure socket
+        # bytes); the two directions are SEPARATE keys because the sender
+        # thread and the receiving thread update them concurrently — a
+        # shared key's `+=` would lose increments (the PWT202 class).
+        self.stats = _stat_block()
+        self.stats["shm_bytes_out"] = 0
+        self.stats["shm_bytes_in"] = 0
+        self.stats_by_transport = {t: _stat_block() for t in TRANSPORTS}
 
-    def encode_us_per_row(self) -> float:
-        st = self.stats
+    def shm_bytes(self) -> int:
+        """Total slab traffic that bypassed the sockets (both directions;
+        single-reader sum of the two thread-owned counters)."""
+        return self.stats["shm_bytes_out"] + self.stats["shm_bytes_in"]
+
+    def encode_us_per_row(self, transport: str | None = None) -> float:
+        st = self.stats if transport is None \
+            else self.stats_by_transport[transport]
         return st["encode_s"] * 1e6 / st["rows_out"] if st["rows_out"] \
             else 0.0
 
-    def decode_us_per_row(self) -> float:
-        st = self.stats
+    def decode_us_per_row(self, transport: str | None = None) -> float:
+        st = self.stats if transport is None \
+            else self.stats_by_transport[transport]
         return st["decode_s"] * 1e6 / st["rows_in"] if st["rows_in"] \
             else 0.0
+
+    def transport_counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for p in self.peers.values():
+            out[p.transport] = out.get(p.transport, 0) + 1
+        return out
 
     # -- wiring --------------------------------------------------------------
     def connect(self, timeout_s: float = 30.0) -> None:
         me = self.process_id
         host = os.environ.get("PATHWAY_CLUSTER_HOST", "127.0.0.1")
-        self._listener = Listener((host, self.first_port + me),
-                                  authkey=self.authkey)
-        accepted: dict[int, Connection] = {}
-
-        def accept_loop():
-            while len(accepted) < self.n_processes - 1 - me:
-                conn = self._listener.accept()
-                peer = conn.recv()
-                accepted[peer] = conn
-
+        deadline = time.monotonic() + timeout_s
+        expect = self.n_processes - 1 - me
+        accepted: dict[int, _Peer] = {}
+        accept_err: list[BaseException] = []
         acceptor = None
-        if me < self.n_processes - 1:
-            acceptor = spawn(accept_loop, name="cluster-acceptor")
-        # dial every lower-numbered process (it is listening)
-        for peer in range(me):
-            conn = self._dial_peer(host, self.first_port + peer, timeout_s)
-            conn.send(me)
-            self.peers[peer] = conn
-        if acceptor is not None:
-            acceptor.join(timeout=timeout_s)
-            if acceptor.is_alive():
-                raise TimeoutError(
-                    f"process {me}: peers did not all connect within "
-                    f"{timeout_s}s (expected {self.n_processes - 1 - me})")
-            self.peers.update(accepted)
+        if expect > 0:
+            lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lsock.bind((host, self.first_port + me))
+            lsock.listen(self.n_processes)
+            self._listener = lsock
 
-    def _dial_peer(self, host: str, port: int,
-                   timeout_s: float) -> Connection:
+            def accept_loop():
+                # every blocking step is bounded by the shared deadline: a
+                # dialer that dies mid-handshake (or a port-scanning
+                # stranger) costs one logged failure, never a wedged
+                # connect() (the old Listener.accept()/conn.recv() pair
+                # blocked forever on exactly that)
+                try:
+                    while len(accepted) < expect:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise ClusterConnectError(
+                                f"process {me}: only {len(accepted)} of "
+                                f"{expect} peers connected within "
+                                f"{timeout_s}s (missing processes "
+                                f"{sorted(set(range(me + 1, self.n_processes)) - set(accepted))})")
+                        lsock.settimeout(min(0.25, remaining))
+                        try:
+                            s, _addr = lsock.accept()
+                        except socket.timeout:
+                            continue
+                        try:
+                            peer_id, peer = self._handshake_listener(
+                                s, deadline)
+                        except (OSError, EOFError, ClusterConnectError,
+                                socket.timeout) as e:
+                            logger.warning(
+                                "process %d: dialer handshake failed "
+                                "midway (%s); still waiting for %d peers",
+                                me, e, expect - len(accepted))
+                            s.close()
+                            continue
+                        accepted[peer_id] = peer
+                except BaseException as e:
+                    accept_err.append(e)
+
+            acceptor = spawn(accept_loop, name="cluster-acceptor")
+        try:
+            # dial every lower-numbered process (it is listening)
+            for peer in range(me):
+                self.peers[peer] = self._dial_peer(
+                    host, self.first_port + peer, deadline, timeout_s)
+            if acceptor is not None:
+                acceptor.join(
+                    timeout=max(0.0, deadline - time.monotonic()) + 1.0)
+                if accept_err:
+                    raise accept_err[0]
+                if acceptor.is_alive() or len(accepted) < expect:
+                    raise ClusterConnectError(
+                        f"process {me}: peers did not all connect within "
+                        f"{timeout_s}s (expected {expect}, got "
+                        f"{len(accepted)})")
+                self.peers.update(accepted)
+        except BaseException:
+            # failed bring-up must not leak the links already made — in
+            # particular accepted peers' shm rings (8 MiB a side), which
+            # close() could never reach (they were not in self.peers yet).
+            # Stop the acceptor first (closing the listener breaks it out
+            # of accept()) so it stops adding to `accepted` under us.
+            if self._listener is not None:
+                try:
+                    self._listener.close()
+                except Exception:  # pragma: no cover - teardown
+                    pass
+                self._listener = None
+            if acceptor is not None:
+                acceptor.join(timeout=6.0)
+            for p in list(accepted.values()):
+                try:
+                    p.close()
+                except Exception:  # pragma: no cover - teardown
+                    pass
+            self.close()
+            raise
+
+    # -- handshake -----------------------------------------------------------
+    def _auth(self, sock: socket.socket, deadline: float) -> None:
+        """Mutual HMAC-SHA256 challenge over the raw socket (replaces the
+        multiprocessing.connection challenge, which needed its Connection
+        framing). Both sides write first, then read — no deadlock. The
+        per-operation timeout is capped below the connect deadline so one
+        silent dialer (port scanner, peer dying mid-handshake) cannot
+        monopolize the accept loop while a genuine peer waits."""
+        sock.settimeout(min(5.0, max(0.1, deadline - time.monotonic())))
+        my_nonce = os.urandom(16)
+        _send_exact(sock, my_nonce)
+        peer_nonce = bytes(_recv_exact(sock, 16))
+        _send_exact(sock,
+                    hmac_mod.new(self.authkey, peer_nonce, "sha256").digest())
+        theirs = bytes(_recv_exact(sock, 32))
+        mine = hmac_mod.new(self.authkey, my_nonce, "sha256").digest()
+        if not hmac_mod.compare_digest(theirs, mine):
+            raise ClusterConnectError(
+                "cluster authentication failed (PATHWAY_RUN_ID mismatch "
+                "between processes?)")
+
+    def _shm_wanted(self) -> bool:
+        if transport_mode() == "tcp":
+            return False
+        try:
+            from multiprocessing import shared_memory  # noqa: F401
+        except ImportError:  # pragma: no cover - stdlib everywhere we run
+            return False
+        return True
+
+    def _handshake_listener(self, sock: socket.socket,
+                            deadline: float) -> tuple[int, _Peer]:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._auth(sock, deadline)
+        hello = _recv_hello(sock)
+        peer_id = int(hello["proc"])
+        same_host = hello.get("host") == socket.gethostname()
+        use_shm = (self._shm_wanted() and hello.get("shm", False)
+                   and same_host)
+        if transport_mode() == "shm" and not same_host:
+            logger.warning(
+                "PATHWAY_EXCHANGE_TRANSPORT=shm but peer %d is on another "
+                "host (%r); keeping tcp for that link", peer_id,
+                hello.get("host"))
+        compat_err = _wire_compat_error(hello.get("wire"), peer_id)
+        reply: dict[str, Any] = {"proc": self.process_id,
+                                 "host": socket.gethostname(),
+                                 "wire": _wire_compat(), "shm": None}
+        tx = rx = None
+        try:
+            if use_shm and compat_err is None:
+                # the listener (lower process id) creates both rings; the
+                # dialer attaches by name. Auto-generated names cannot
+                # collide across concurrent runs.
+                tx, rx = self._create_rings(peer_id)
+            if tx is not None:
+                token = os.urandom(16)
+                tx.poke_token(token)
+                reply["shm"] = {"l2d": tx.name, "d2l": rx.name,
+                                "token": token.hex()}
+            # the reply ships even on incompatibility so the dialer's own
+            # compat check fails fast with the same named diagnosis
+            _send_hello(sock, reply)
+            if compat_err is not None:
+                raise ClusterConnectError(compat_err)
+            if tx is not None:
+                # wait for the dialer to confirm it attached the rings and
+                # verified the token. Without this barrier nothing orders
+                # the dialer's peek_token() before this side's first
+                # exchange frame lands in slot 0 (a descheduled dialer
+                # would read frame bytes and refuse with the cloned-
+                # hostname diagnosis on a healthy cluster), and a dialer
+                # that refused the rings would leave this listener wedging
+                # its first exchange for the full recv timeout. Bounded:
+                # the _auth() socket timeout is still armed here.
+                if bytes(_recv_exact(sock, 1)) != _SHM_ACK:
+                    raise ClusterConnectError(
+                        f"peer {peer_id}: bad shared-memory attach ack "
+                        "(cluster protocol skew)")
+        except BaseException:
+            # a dialer dying between ring creation and hello delivery must
+            # not leak two mapped-and-linked segments per attempt
+            for ring in (tx, rx):
+                if ring is not None:
+                    ring.close()
+            raise
+        sock.settimeout(None)
+        return peer_id, _Peer(sock, "shm" if tx is not None else "tcp",
+                              tx, rx)
+
+    def _create_rings(self, peer_id: int) \
+            -> tuple[_ShmRing | None, _ShmRing | None]:
+        """Create the ring pair for one accepted dialer, degrading the
+        link to tcp (mode auto) or refusing by name (mode shm) when
+        /dev/shm cannot hold them. The statvfs precheck matters more than
+        the OSError path: tmpfs ftruncate is sparse, so an over-capacity
+        create "succeeds" and the first slot write past the limit would
+        SIGBUS the process instead of raising anything catchable."""
+        slot_bytes = max(4096, shm_ring_bytes() // 4)  # _ShmRing defaults
+        need = 2 * (_ShmRing._HDR.size + 4 + 4 * slot_bytes)
+        head = _shm_headroom()
+        err: str | None = None
+        if head is not None and head < need:
+            err = (f"/dev/shm has {head} bytes free but the exchange "
+                   f"ring pair needs {need}")
+        tx = rx = None
+        if err is None:
+            try:
+                tx = _ShmRing()   # listener -> dialer
+                rx = _ShmRing()   # dialer -> listener
+            except OSError as e:
+                if tx is not None:
+                    tx.close()
+                tx = rx = None
+                err = f"cannot create shared-memory ring: {e}"
+        if err is not None:
+            if transport_mode() == "shm":
+                raise ClusterConnectError(
+                    f"{err} — shrink PATHWAY_SHM_RING_BYTES or set "
+                    "PATHWAY_EXCHANGE_TRANSPORT=tcp")
+            logger.warning("%s; keeping tcp for peer %d", err, peer_id)
+        return tx, rx
+
+    def _handshake_dialer(self, sock: socket.socket,
+                          deadline: float) -> _Peer:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._auth(sock, deadline)
+        _send_hello(sock, {"proc": self.process_id,
+                           "host": socket.gethostname(),
+                           "wire": _wire_compat(),
+                           "shm": self._shm_wanted()})
+        reply = _recv_hello(sock)
+        compat_err = _wire_compat_error(reply.get("wire"),
+                                        int(reply.get("proc", -1)))
+        if compat_err is not None:
+            raise ClusterConnectError(compat_err)
+        shm = reply.get("shm")
+        tx = rx = None
+        if shm is not None:
+            tx, rx = self._attach_rings(shm)
+            try:
+                _send_exact(sock, _SHM_ACK)  # token verified — listener
+                # may now let its first exchange frame overwrite slot 0
+            except BaseException:
+                # listener died between its hello and our ack: the dial
+                # loop retries, and each retry would leak another mapped
+                # (untracked) ring pair
+                tx.close()
+                rx.close()
+                raise
+        sock.settimeout(None)
+        return _Peer(sock, "shm" if shm is not None else "tcp", tx, rx)
+
+    def _attach_rings(self, shm: dict) -> tuple[_ShmRing, _ShmRing]:
+        """Attach the listener-created ring pair and PROVE the mapping is
+        the same memory via the hello token. Hostname equality lies for
+        cloned VMs / default-hostname containers: without this check an
+        attach failure would be retried as transient until the connect
+        deadline, and a name that happens to exist locally would wedge
+        the first exchange for the full recv timeout. Both cases are
+        definitive — refuse by name (remedy: force tcp)."""
+        remedy = ("peers share a hostname but not memory (cloned "
+                  "VM/container hostnames?) — set "
+                  "PATHWAY_EXCHANGE_TRANSPORT=tcp")
+        try:
+            rx = _ShmRing(name=shm["l2d"])
+        except OSError as e:
+            raise ClusterConnectError(
+                f"cannot attach peer's shared-memory ring: {e}; "
+                f"{remedy}") from e
+        try:
+            expected = bytes.fromhex(shm.get("token", ""))
+            if expected and rx.peek_token(len(expected)) != expected:
+                raise ClusterConnectError(
+                    f"shared-memory ring attached but its contents do "
+                    f"not match the handshake token; {remedy}")
+            try:
+                tx = _ShmRing(name=shm["d2l"])
+            except OSError as e:
+                raise ClusterConnectError(
+                    f"cannot attach peer's shared-memory ring: {e}; "
+                    f"{remedy}") from e
+        except BaseException:
+            rx.close()
+            raise
+        return tx, rx
+
+    def _dial_peer(self, host: str, port: int, deadline: float,
+                   timeout_s: float) -> _Peer:
         """Dial one lower-numbered peer with a selector wait instead of a
         fixed ``time.sleep(0.05)`` retry poll (the PWT206 exemplar fix): a
         non-blocking connect is awaited on the default selector, so an
@@ -168,14 +713,13 @@ class Cluster:
         on loopback, so retries are paced by a bounded selector wait —
         still interruptible by the deadline, never an unconditional
         sleep."""
-        deadline = time.monotonic() + timeout_s
         sel = selectors.DefaultSelector()
         last_err: Exception | None = None
         try:
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise TimeoutError(
+                    raise ClusterConnectError(
                         f"process {self.process_id}: cannot reach peer at "
                         f"{host}:{port} within {timeout_s}s"
                         + (f" (last error: {last_err})" if last_err else ""))
@@ -197,15 +741,16 @@ class Cluster:
                     err = rc
                 if err == 0:
                     s.setblocking(True)
-                    conn = Connection(s.detach())
                     try:
-                        # multiprocessing.connection.Client's handshake,
-                        # on the socket the selector already connected
-                        answer_challenge(conn, self.authkey)
-                        deliver_challenge(conn, self.authkey)
-                        return conn
-                    except (OSError, EOFError) as e:
-                        conn.close()
+                        return self._handshake_dialer(s, deadline)
+                    except ClusterConnectError:
+                        # definitive protocol refusal (authkey mismatch,
+                        # cross-endian peer): retrying cannot succeed and
+                        # would bury the diagnosis in a timeout message
+                        s.close()
+                        raise
+                    except (OSError, EOFError, socket.timeout) as e:
+                        s.close()
                         last_err = e
                 else:
                     s.close()
@@ -254,19 +799,30 @@ class Cluster:
         err: list[BaseException] = []
         st = self.stats
         st["rounds"] += 1
+        timeout_s = float(os.environ.get(
+            "PATHWAY_CLUSTER_RECV_TIMEOUT", 300.0))
+        send_deadline = time.monotonic() + timeout_s
 
         def send_all():
             try:
                 for peer, conn in self.peers.items():
+                    ts = self.stats_by_transport[conn.transport]
                     t0 = time.perf_counter()
-                    packed = _pack_payload(msgs.get(peer))
-                    blob = pickle.dumps(
-                        (tag, packed), protocol=pickle.HIGHEST_PROTOCOL)
-                    st["encode_s"] += time.perf_counter() - t0
-                    st["rows_out"] += _payload_rows(packed)
-                    st["bytes_out"] += len(blob)
+                    chunks, total, n_rows = wire.encode_frame(
+                        tag, msgs.get(peer))
+                    enc = time.perf_counter() - t0
+                    wire_bytes = conn.send_frame(chunks, total,
+                                                 send_deadline)
+                    st["encode_s"] += enc
+                    ts["encode_s"] += enc
+                    st["rows_out"] += n_rows
+                    ts["rows_out"] += n_rows
+                    st["bytes_out"] += wire_bytes
+                    ts["bytes_out"] += wire_bytes
+                    if wire_bytes < total:
+                        st["shm_bytes_out"] += total
                     st["messages"] += 1
-                    conn.send_bytes(blob)
+                    ts["messages"] += 1
             except BaseException as e:  # surfaced after the joins
                 err.append(e)
 
@@ -275,8 +831,6 @@ class Cluster:
         # whose exchange schedule diverged) must surface as a diagnostic,
         # not an eternal deadlock — only a cleanly-dead peer raises EOFError
         # on its own
-        timeout_s = float(os.environ.get(
-            "PATHWAY_CLUSTER_RECV_TIMEOUT", 300.0))
         out: dict[int, Any] = {}
         # socket recv is a known-blocking region: the sanitizer asserts
         # the commit loop entered the exchange holding no engine lock
@@ -287,7 +841,7 @@ class Cluster:
             # every process fails identically, so waiting out the full
             # timeout would mislabel it a hung peer
             deadline = time.monotonic() + timeout_s
-            while not conn.poll(0.2):
+            while not conn.wait_readable(0.2):
                 if err:
                     raise err[0]
                 if time.monotonic() > deadline:
@@ -298,23 +852,31 @@ class Cluster:
                         "diverged — graph construction must be "
                         "deterministic across processes). Tune with "
                         "PATHWAY_CLUSTER_RECV_TIMEOUT.")
-            blob = conn.recv_bytes()
-            st["bytes_in"] += len(blob)
+            ts = self.stats_by_transport[conn.transport]
+            view, release, wire_bytes = conn.recv_frame()
             t0 = time.perf_counter()
-            rtag, payload = pickle.loads(blob)
+            try:
+                rtag, payload, n_rows = wire.decode_frame(view)
+            finally:
+                release()
+            dec = time.perf_counter() - t0
+            st["bytes_in"] += wire_bytes
+            ts["bytes_in"] += wire_bytes
+            if wire_bytes < len(view):
+                st["shm_bytes_in"] += len(view)
             if rtag != tag:
                 raise RuntimeError(
                     f"cluster protocol skew: process {self.process_id} "
                     f"expected {tag!r} from {peer}, got {rtag!r}")
-            unpacked = _unpack_payload(payload)
-            st["decode_s"] += time.perf_counter() - t0
-            st["rows_in"] += _payload_rows(unpacked)
-            out[peer] = unpacked
+            st["decode_s"] += dec
+            ts["decode_s"] += dec
+            st["rows_in"] += n_rows
+            ts["rows_in"] += n_rows
+            out[peer] = payload
         sender.join()
         if err:
             raise err[0]
         return out
-
 
 
 _CLUSTER: Cluster | None = None
@@ -332,9 +894,20 @@ def get_cluster() -> Cluster | None:
     cfg = get_pathway_config()
     if cfg.processes <= 1:
         return None
-    _CLUSTER = Cluster(cfg.processes, cfg.process_id, cfg.first_port,
-                       os.environ.get("PATHWAY_RUN_ID", ""))
-    _CLUSTER.connect()
+    # publish the global only AFTER connect() succeeds: a failed connect
+    # close()s the half-built cluster, and a published dead cluster would
+    # make every later get_cluster() return it — exchange() sees no peers
+    # and silently computes only the local shard instead of erroring
+    cluster = Cluster(cfg.processes, cfg.process_id, cfg.first_port,
+                      os.environ.get("PATHWAY_RUN_ID", ""))
+    cluster.connect()
+    import atexit
+
+    # clean shm teardown even when the program never calls reset_cluster:
+    # the creator unlinks its rings instead of leaning on the resource
+    # tracker's exit sweep (which logs leak warnings)
+    atexit.register(reset_cluster)
+    _CLUSTER = cluster
     return _CLUSTER
 
 

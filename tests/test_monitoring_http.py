@@ -186,43 +186,62 @@ def test_metrics_row_counters_and_gauges_still_linted():
 
 
 def test_exchange_plane_metrics_exposed_per_row():
-    """A runtime with a cluster exports pathway_tpu_exchange_* including
-    the per-row encode/decode gauges (the r5 encdec-regression surface),
-    all passing the same exposition lint."""
+    """A runtime with a cluster exports pathway_tpu_exchange_* with
+    per-transport (tcp/shm) labels, including the per-row encode/decode
+    gauges (the r5 encdec-regression surface), all passing the same
+    exposition lint."""
     from pathway_tpu.engine.multiproc import Cluster
 
     rt = _FakeRuntime()
     cl = Cluster(2, 0, 41000)
-    cl.stats.update({"encode_s": 0.010, "decode_s": 0.004,
-                     "rows_out": 2000, "rows_in": 1000,
-                     "bytes_out": 64000, "bytes_in": 32000,
-                     "messages": 4, "rounds": 2})
+    cl.stats.update({"rounds": 2, "shm_bytes_out": 90000,
+                     "shm_bytes_in": 38000})
+    cl.stats_by_transport["tcp"].update(
+        {"encode_s": 0.010, "decode_s": 0.004,
+         "rows_out": 2000, "rows_in": 1000,
+         "bytes_out": 64000, "bytes_in": 32000, "messages": 4})
+    cl.stats_by_transport["shm"].update(
+        {"encode_s": 0.001, "decode_s": 0.002,
+         "rows_out": 500, "rows_in": 1000,
+         "bytes_out": 52, "bytes_in": 52, "messages": 4})
     rt.cluster = cl
     samples = _parse_samples(_metrics_lines(rt))
-    by_family = {f: v for f, _l, v in samples}
-    assert by_family["pathway_tpu_exchange_encode_us_per_row"] == \
+    by_series = {(f, labels.get("transport")): v
+                 for f, labels, v in samples}
+    assert by_series["pathway_tpu_exchange_encode_us_per_row", "tcp"] == \
         pytest.approx(5.0)
-    assert by_family["pathway_tpu_exchange_decode_us_per_row"] == \
+    assert by_series["pathway_tpu_exchange_decode_us_per_row", "tcp"] == \
         pytest.approx(4.0)
-    assert by_family["pathway_tpu_exchange_rows_out"] == 2000
-    assert by_family["pathway_tpu_exchange_bytes_in"] == 32000
-    assert by_family["pathway_tpu_exchange_rounds"] == 2
+    assert by_series["pathway_tpu_exchange_decode_us_per_row", "shm"] == \
+        pytest.approx(2.0)
+    assert by_series["pathway_tpu_exchange_rows_out", "tcp"] == 2000
+    assert by_series["pathway_tpu_exchange_rows_out", "shm"] == 500
+    assert by_series["pathway_tpu_exchange_bytes_in", "tcp"] == 32000
+    assert by_series["pathway_tpu_exchange_shm_bytes", None] == 128000
+    assert by_series["pathway_tpu_exchange_rounds", None] == 2
 
 
 def test_exchange_payload_row_counting():
-    """_payload_rows counts entries through packed and raw payload shapes
-    (scalars and liveness flags count zero)."""
-    from pathway_tpu.engine.multiproc import (_pack_payload, _payload_rows,
-                                              _unpack_payload)
+    """payload_rows (and the codec's own row accounting) count genuine
+    entry lists only: wm/bcast side-channels, scalars, liveness flags and
+    plain lists are excluded — encode_us_per_row divides by rows moved,
+    nothing else (the old _payload_rows counted any list it saw)."""
+    from pathway_tpu.engine import wire
     from pathway_tpu.internals.keys import hash_values
 
     ents = [(hash_values("r", i), (f"w{i}", i), 1) for i in range(7)]
     payload = {"rows": {0: {3: ents}}, "wm": None, "bcast": {1: ents[:2]},
                "any": True, "closed": False}
-    packed = _pack_payload(payload)
-    assert _payload_rows(packed) == 9
-    assert _payload_rows(_unpack_payload(packed)) == 9
-    assert _payload_rows({"any": True, "wm": 3}) == 0
+    assert wire.payload_rows(payload) == 7
+    chunks, _total, n_enc = wire.encode_frame(("x", 1, 0), payload)
+    _tag, decoded, n_dec = wire.decode_frame(b"".join(chunks))
+    assert n_enc == n_dec == 7
+    assert decoded == payload
+    assert wire.payload_rows({"any": True, "wm": 3}) == 0
+    # a plain (non-entry) list is payload structure, not rows
+    assert wire.payload_rows({"xs": [1, 2, 3]}) == 0
+    # watermark side-channels never count, even when list-shaped
+    assert wire.payload_rows({"wm": ents, "bcast": {0: ents}}) == 0
 
 
 def test_paged_store_metrics_exposed():

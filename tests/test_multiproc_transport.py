@@ -1,0 +1,353 @@
+"""Transport-layer units for the cluster exchange plane
+(engine/multiproc.py): bounded connect/handshake (the accept-loop hang
+fix), the shared-memory slab ring, and the socket framing path."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.engine import wire
+from pathway_tpu.engine.multiproc import (Cluster, ClusterConnectError,
+                                          _Peer, _ShmRing)
+
+
+def test_connect_times_out_with_named_error_when_peer_never_dials():
+    """Process 0 of 2 listens; nobody dials. The old accept loop joined a
+    thread stuck in Listener.accept() and raised a generic TimeoutError
+    only if the join noticed; a missing peer must now surface as
+    ClusterConnectError within the deadline."""
+    cl = Cluster(2, 0, 19810, run_id="hangfix")
+    t0 = time.monotonic()
+    with pytest.raises(ClusterConnectError):
+        cl.connect(timeout_s=1.0)
+    assert time.monotonic() - t0 < 5.0
+    cl.close()
+
+
+def test_connect_survives_dialer_dying_mid_handshake():
+    """A dialer that connects and then goes silent (dies mid-handshake)
+    used to wedge the accept loop forever inside conn.recv(); now the
+    handshake recv is deadline-bounded, the bad dialer is logged and
+    dropped, and connect() still fails *named* (no real peer ever
+    arrived) instead of hanging."""
+    cl = Cluster(2, 0, 19815, run_id="midhs")
+
+    def half_dial():
+        # connect, send one junk byte instead of the HMAC handshake, then
+        # hold the socket open silently (the mid-handshake death)
+        time.sleep(0.2)
+        s = socket.create_connection(("127.0.0.1", 19815), timeout=2)
+        s.sendall(b"z")
+        time.sleep(3.0)
+        s.close()
+
+    t = threading.Thread(target=half_dial, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(ClusterConnectError):
+        cl.connect(timeout_s=2.0)
+    assert time.monotonic() - t0 < 8.0
+    cl.close()
+
+
+def test_cross_endian_peer_is_refused_by_name():
+    """The codec's bulk buffers are native-endian; a peer advertising a
+    different native layout must be refused with the named diagnosis (not
+    silently decoded byte-swapped)."""
+    from pathway_tpu.engine.multiproc import _wire_compat, _wire_compat_error
+
+    assert _wire_compat_error(_wire_compat(), 1) is None
+    assert _wire_compat_error(None, 1) is None  # pre-field peers pass
+    err = _wire_compat_error(("big", 4, 4, 8, 8), 1)
+    assert err is not None and "incompatible native wire layout" in err
+
+
+def test_connect_rejects_wrong_authkey():
+    """Mismatched PATHWAY_RUN_ID (authkey) must fail the handshake on
+    both sides, not connect two unrelated runs together."""
+    results: dict = {}
+
+    def listener():
+        cl = Cluster(2, 0, 19820, run_id="run-A")
+        try:
+            cl.connect(timeout_s=2.5)
+            results["listener"] = "connected"
+        except ClusterConnectError as e:
+            results["listener"] = e
+        finally:
+            cl.close()
+
+    def dialer():
+        cl = Cluster(2, 1, 19820, run_id="run-B")
+        try:
+            cl.connect(timeout_s=2.5)
+            results["dialer"] = "connected"
+        except ClusterConnectError as e:
+            results["dialer"] = e
+        finally:
+            cl.close()
+
+    th = [threading.Thread(target=listener, daemon=True),
+          threading.Thread(target=dialer, daemon=True)]
+    for t in th:
+        t.start()
+    for t in th:
+        t.join(timeout=10)
+        assert not t.is_alive(), "connect() wedged on authkey mismatch"
+    assert isinstance(results["listener"], ClusterConnectError)
+    assert isinstance(results["dialer"], ClusterConnectError)
+
+
+# -- shm ring ----------------------------------------------------------------
+
+def test_shm_ring_roundtrip_and_slot_reuse():
+    ring = _ShmRing(nslots=2, slot_bytes=256)
+    try:
+        peer = _ShmRing(name=ring.name)  # attach
+        deadline = time.monotonic() + 2
+        for i in range(7):  # > 2 cycles through both slots
+            blob = bytes([i]) * (50 + i)
+            slot = ring.write([blob[:10], blob[10:]], len(blob), deadline)
+            assert slot == i % 2
+            view = peer.read_view(slot, len(blob))
+            assert bytes(view) == blob
+            view.release()  # a live slot view would block the mmap close
+            peer.release(slot)
+        peer.close()
+    finally:
+        ring.close()
+
+
+def test_attach_rings_verifies_shared_memory_via_token():
+    """Hostname equality lies on cloned VMs: the dialer must prove the
+    attached ring is the SAME memory via the handshake token, refusing by
+    name (not retrying into a timeout) on mismatch or missing segment."""
+    import os as _os
+
+    cl = Cluster(2, 1, 19890, run_id="tok")
+    l2d = _ShmRing(nslots=2, slot_bytes=128)
+    d2l = _ShmRing(nslots=2, slot_bytes=128)
+    try:
+        token = _os.urandom(16)
+        l2d.poke_token(token)
+        tx, rx = cl._attach_rings({"l2d": l2d.name, "d2l": d2l.name,
+                                   "token": token.hex()})
+        assert rx.peek_token(16) == token
+        tx.close()
+        rx.close()
+        with pytest.raises(ClusterConnectError, match="token"):
+            cl._attach_rings({"l2d": l2d.name, "d2l": d2l.name,
+                              "token": _os.urandom(16).hex()})
+        with pytest.raises(ClusterConnectError, match="cannot attach"):
+            cl._attach_rings({"l2d": "psm_does_not_exist_pw",
+                              "d2l": d2l.name, "token": token.hex()})
+    finally:
+        l2d.close()
+        d2l.close()
+
+
+def test_listener_requires_shm_attach_ack(monkeypatch):
+    """The shm handshake ends with a dialer->listener ack sent only after
+    the rings are attached and the token verified. A dialer that dies (or
+    refuses the rings) after receiving the ring names must fail the
+    listener's handshake by name — before the ack barrier the listener's
+    connect() returned a live peer whose first exchange could overwrite
+    the slot-0 token under the dialer's feet (spurious cloned-hostname
+    refusal) or wedge for the full recv timeout against a dialer that
+    bailed."""
+    from pathway_tpu.engine.multiproc import (_recv_hello, _send_hello,
+                                              _wire_compat)
+
+    monkeypatch.setenv("PATHWAY_EXCHANGE_TRANSPORT", "shm")
+    port = 19895
+    listener = Cluster(2, 0, port, run_id="ackbar")
+    saw: dict = {}
+
+    def dialer_no_ack():
+        time.sleep(0.2)
+        s = socket.create_connection(("127.0.0.1", port), timeout=2)
+        try:
+            dial = Cluster(2, 1, port, run_id="ackbar")
+            dial._auth(s, time.monotonic() + 2)
+            _send_hello(s, {"proc": 1, "host": socket.gethostname(),
+                            "wire": _wire_compat(), "shm": True})
+            saw["reply"] = _recv_hello(s)
+        finally:
+            s.close()  # dies without sending the attach ack
+
+    t = threading.Thread(target=dialer_no_ack, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(ClusterConnectError):
+        listener.connect(timeout_s=2.0)
+    assert time.monotonic() - t0 < 8.0
+    listener.close()
+    t.join(timeout=5)
+    # the handshake really reached the shm stage before the dialer bailed
+    assert saw["reply"].get("shm") is not None
+
+
+def test_get_cluster_not_published_on_failed_connect(monkeypatch):
+    """A connect() failure must leave the module global unset: a published
+    dead (close()d, peerless) cluster would make every later get_cluster()
+    return it, and exchange() with no peers silently computes only the
+    local shard — divergent results instead of a named error."""
+    import pathway_tpu.engine.multiproc as mp
+
+    mp.reset_cluster()
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    monkeypatch.setenv("PATHWAY_FIRST_PORT", "19893")
+
+    def boom(self, timeout_s=30.0):
+        raise ClusterConnectError("boom")
+
+    monkeypatch.setattr(mp.Cluster, "connect", boom)
+    with pytest.raises(ClusterConnectError):
+        mp.get_cluster()
+    assert mp._CLUSTER is None
+
+
+def test_shm_ring_close_unlinks_despite_exported_views():
+    """A propagating traceback can pin a slot view past close(); the
+    creator must still unlink the segment NAME (the mapping dies with the
+    process either way, but the swallowed BufferError used to leak the
+    /dev/shm file forever)."""
+    from multiprocessing import shared_memory
+
+    ring = _ShmRing(nslots=2, slot_bytes=128)
+    view = ring._slot_view(0)  # simulates a view held by a raised frame
+    ring.close()
+    with pytest.raises(FileNotFoundError):
+        shared_memory.SharedMemory(name=ring.name)
+    view.release()
+
+
+def test_shm_capacity_guard_degrades_auto_to_tcp(monkeypatch):
+    """tmpfs ftruncate is sparse, so an over-capacity ring 'creates'
+    fine and SIGBUSes on the first slot write (Docker's default /dev/shm
+    is 64 MiB). A too-small /dev/shm must degrade the link to tcp in
+    auto mode and refuse BY NAME under forced shm — never bring up a
+    ring that cannot hold its own slots."""
+    import pathway_tpu.engine.multiproc as mp
+
+    monkeypatch.setattr(mp, "_shm_headroom", lambda: 1024)
+    monkeypatch.setenv("PATHWAY_EXCHANGE_TRANSPORT", "shm")
+    cl = Cluster(2, 0, 19897, run_id="cap")
+    with pytest.raises(ClusterConnectError, match="/dev/shm"):
+        cl._create_rings(1)
+    cl.close()
+
+    # auto mode: full 2-process connect completes over sockets instead
+    monkeypatch.setenv("PATHWAY_EXCHANGE_TRANSPORT", "auto")
+    results: dict = {}
+
+    def side(pid):
+        c = Cluster(2, pid, 19898, run_id="cap2")
+        try:
+            c.connect(timeout_s=5.0)
+            results[pid] = c.transport_counts()
+        finally:
+            c.close()
+
+    th = [threading.Thread(target=side, args=(p,), daemon=True)
+          for p in (0, 1)]
+    for t in th:
+        t.start()
+    for t in th:
+        t.join(timeout=15)
+        assert not t.is_alive()
+    assert results[0] == {"tcp": 1}
+    assert results[1] == {"tcp": 1}
+
+
+def test_shm_ring_oversized_frame_returns_none():
+    ring = _ShmRing(nslots=2, slot_bytes=64)
+    try:
+        assert ring.write([b"x" * 100], 100, time.monotonic() + 1) is None
+    finally:
+        ring.close()
+
+
+def test_shm_ring_full_slot_times_out_loudly():
+    ring = _ShmRing(nslots=1, slot_bytes=64)
+    try:
+        assert ring.write([b"a"], 1, time.monotonic() + 1) == 0
+        # never released: the next write of the same slot must time out
+        # with a diagnosis, not overwrite unread data
+        with pytest.raises(TimeoutError, match="not released"):
+            ring.write([b"b"], 1, time.monotonic() + 0.3)
+    finally:
+        ring.close()
+
+
+# -- socket framing ----------------------------------------------------------
+
+def _peer_pair() -> tuple[_Peer, _Peer]:
+    a, b = socket.socketpair()
+    return _Peer(a), _Peer(b)
+
+
+def test_inline_frame_roundtrip_reuses_recv_buffer():
+    pa, pb = _peer_pair()
+    try:
+        payload = {"rows": {0: {0: [(7, ("x", 1), 1)]}}, "wm": None,
+                   "bcast": None}
+        chunks, total, _ = wire.encode_frame(("x", 1, 0), payload)
+        pa.send_frame(chunks, total, time.monotonic() + 2)
+        assert pb.wait_readable(2.0)
+        view, release, _bytes = pb.recv_frame()
+        tag, out, _ = wire.decode_frame(view)
+        release()
+        assert tag == ("x", 1, 0)
+        buf_before = id(pb._rbuf)
+        # a second, equal-sized frame must reuse the same buffer
+        pa.send_frame(chunks, total, time.monotonic() + 2)
+        view, release, _bytes = pb.recv_frame()
+        wire.decode_frame(view)
+        release()
+        assert id(pb._rbuf) == buf_before
+    finally:
+        pa.close()
+        pb.close()
+
+
+def test_shm_frame_rides_ring_with_socket_doorbell():
+    tx = _ShmRing(nslots=2, slot_bytes=4096)
+    rx_attached = _ShmRing(name=tx.name)
+    a, b = socket.socketpair()
+    pa = _Peer(a, "shm", tx_ring=tx)
+    pb = _Peer(b, "shm", rx_ring=rx_attached)
+    try:
+        chunks, total, _ = wire.encode_frame("t", {"rows": None, "any": True})
+        sock_bytes = pa.send_frame(chunks, total, time.monotonic() + 2)
+        assert sock_bytes == 13  # the doorbell, not the frame
+        view, release, _b = pb.recv_frame()
+        tag, out, _ = wire.decode_frame(view)
+        release()
+        assert tag == "t" and out == {"rows": None, "any": True}
+        # oversized frame falls back to the inline socket path
+        big = [b"y" * 8192]
+        sock_bytes = pa.send_frame(big, 8192, time.monotonic() + 2)
+        assert sock_bytes > 8192
+        view, release, _b = pb.recv_frame()
+        assert bytes(view) == big[0]
+        release()
+    finally:
+        pa.close()
+        pb.close()
+
+
+def test_peer_death_surfaces_as_eoferror():
+    pa, pb = _peer_pair()
+    pa.close()
+    try:
+        assert pb.wait_readable(2.0)
+        with pytest.raises(EOFError):
+            pb.recv_frame()
+    finally:
+        pb.close()

@@ -274,19 +274,33 @@ joined = totals.join(info, totals.item == info.item).select(
 
 runner = GraphRunner()
 caps = [runner.capture(t) for t in (totals, joined)]
-runner.run_batch(cluster=get_cluster())
+cl = get_cluster()
+runner.run_batch(cluster=cl)
 out = [sorted((int(k), repr(r), t, d)
               for k, r, t, d in c.consolidated_events()) for c in caps]
+# run_batch executes one tick per distinct feed time (incl. 0) plus the
+# end-of-stream flush tick — recorded so the test can pin the scheduler's
+# STATIC round estimate against the rounds the cluster actually counted
+_, feed_times = runner.static_feeds_by_time()
+doc = {"caps": out,
+       "transports": cl.transport_counts() if cl is not None else {},
+       "stats": cl.stats if cl is not None else {},
+       "ticks": len({0} | feed_times) + 1,
+       "rounds_est": runner._scheduler.exchange_rounds_per_tick()}
 with open(sys.argv[1], "w") as f:
-    json.dump(out, f)
+    json.dump(doc, f)
 """
 
 
-def test_multi_process_batch_matches_single(tmp_path):
+@pytest.mark.parametrize("transport,first_port",
+                         [("tcp", 19310), ("shm", 19340)])
+def test_multi_process_batch_matches_single(tmp_path, transport, first_port):
     """True multi-process execution (engine/multiproc.py): 2 OS processes
-    exchange over TCP; the union of their captured shards must equal the
-    single-process result, and the shards must be disjoint (state really
-    partitioned across processes)."""
+    exchange over the requested transport (raw TCP sockets, or the
+    shared-memory slab ring with its socket doorbell); the union of their
+    captured shards must equal the single-process result, the shards must
+    be disjoint (state really partitioned across processes), and the
+    forced transport must actually have carried the frames."""
     import json
     import subprocess
     import sys as _sys
@@ -294,15 +308,16 @@ def test_multi_process_batch_matches_single(tmp_path):
     prog = tmp_path / "mp_prog.py"
     prog.write_text(_MP_PROGRAM)
     base_env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo",
-                    PATHWAY_RUN_ID="mp-test")
+                    PATHWAY_RUN_ID=f"mp-test-{transport}",
+                    PATHWAY_EXCHANGE_TRANSPORT=transport)
 
-    def run_procs(n: int, first_port: int) -> list[list]:
+    def run_procs(n: int, port: int) -> list[dict]:
         handles = []
         for pid in range(n):
             env = dict(base_env, PATHWAY_PROCESSES=str(n),
                        PATHWAY_PROCESS_ID=str(pid),
                        PATHWAY_THREADS="2",
-                       PATHWAY_FIRST_PORT=str(first_port))
+                       PATHWAY_FIRST_PORT=str(port))
             handles.append(subprocess.Popen(
                 [_sys.executable, str(prog), str(tmp_path / f"out_{n}_{pid}")],
                 env=env, stderr=subprocess.PIPE, text=True))
@@ -315,14 +330,28 @@ def test_multi_process_batch_matches_single(tmp_path):
                 (tmp_path / f"out_{n}_{pid}").read_text()))
         return outs
 
-    [single] = run_procs(1, 19310)
-    shards = run_procs(2, 19320)
-    for cap_i in range(len(single)):
-        merged = sorted(tuple(e) for s in shards for e in s[cap_i])
-        expect = sorted(tuple(e) for e in single[cap_i])
+    [single] = run_procs(1, first_port)
+    shards = run_procs(2, first_port + 10)
+    for doc in shards:
+        assert doc["transports"] == {transport: 1}
+        assert doc["stats"]["rows_out"] > 0
+        # the static estimate (exchange_rounds_per_tick) re-states the
+        # step loop's batching rules; this pins it to the rounds the
+        # cluster ACTUALLY paid so the two copies cannot silently drift
+        assert doc["rounds_est"] > 0
+        assert doc["stats"]["rounds"] == doc["rounds_est"] * doc["ticks"]
+        if transport == "shm":
+            # the slab carried the payloads; sockets carried doorbells
+            slab = (doc["stats"]["shm_bytes_out"]
+                    + doc["stats"]["shm_bytes_in"])
+            assert slab > doc["stats"]["bytes_out"]
+    for cap_i in range(len(single["caps"])):
+        merged = sorted(tuple(e) for s in shards
+                        for e in s["caps"][cap_i])
+        expect = sorted(tuple(e) for e in single["caps"][cap_i])
         assert merged == expect
-        keys0 = {e[0] for e in shards[0][cap_i]}
-        keys1 = {e[0] for e in shards[1][cap_i]}
+        keys0 = {e[0] for e in shards[0]["caps"][cap_i]}
+        keys1 = {e[0] for e in shards[1]["caps"][cap_i]}
         assert not (keys0 & keys1)
         assert keys0 and keys1
 
@@ -483,23 +512,29 @@ def test_cluster_peer_death_detected(tmp_path):
             or "BrokenPipe" in err0 or "closed" in err0), err0[-500:]
 
 
-def test_exchange_payload_pack_roundtrip():
-    """The packed exchange wire format must be lossless, including nested
-    rows/bcast shapes and Pointer-keyed entries (engine/multiproc.py)."""
-    from pathway_tpu.engine.multiproc import _pack_payload, _unpack_payload
+def test_exchange_payload_wire_roundtrip():
+    """The columnar exchange wire format must be lossless, including
+    nested rows/bcast shapes and Pointer-keyed entries (engine/wire.py),
+    and the frame must take the columnar kind for entry payloads."""
+    from pathway_tpu.engine import wire
     from pathway_tpu.internals.keys import Pointer, hash_values
 
     ents = [(hash_values("a", i), (f"w{i}", i, None), 1 - 2 * (i % 2))
             for i in range(50)]
     payload = {"rows": {1: {3: ents}}, "wm": 7,
                "bcast": {0: ents[:3]}, "any": True}
-    packed = _pack_payload(payload)
-    assert packed["rows"][1][3][0] == "__pw_ents__"
-    out = _unpack_payload(packed)
+    chunks, total, n_rows = wire.encode_frame(("x", 2, 0), payload)
+    blob = b"".join(chunks)
+    assert total == len(blob)
+    assert blob[3] == wire.KIND_COLUMNAR
+    assert n_rows == 50  # bcast and wm side-channels excluded
+    tag, out, _ = wire.decode_frame(blob)
+    assert tag == ("x", 2, 0)
     assert out == payload
     assert all(isinstance(e[0], Pointer) for e in out["rows"][1][3])
     # non-entry lists and scalars pass through untouched
-    assert _unpack_payload(_pack_payload({"xs": [1, 2], "s": "x"})) == \
+    chunks2, _t, _n = wire.encode_frame("s", {"xs": [1, 2], "s": "x"})
+    assert wire.decode_frame(b"".join(chunks2))[1] == \
         {"xs": [1, 2], "s": "x"}
 
 
