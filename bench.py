@@ -439,6 +439,16 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             errors["recovery_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
+    if "replica" not in SKIP:
+        # replica-fleet leg (CPU-runnable): hydration time-to-ready vs
+        # history size (WAL-only vs snapshot), end-to-end p50/p95 through
+        # the router at 1 vs 2 replicas, staleness lag exported on
+        # /metrics, and the kill-under-load failover count
+        try:
+            result.update(bench_replica())
+        except Exception as e:  # noqa: BLE001
+            errors["replica_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
     # sidecar path for the device-phase flight beacon, inherited by the
     # child processes; every emit below reads it, so the last surviving
     # JSON line always carries whatever attribution the child reported
@@ -1688,6 +1698,519 @@ def bench_recovery() -> dict:
         lo, hi = min(sizes), max(sizes)
         out["recovery_snapshot_ratio_maxmin"] = round(
             snap_restarts[hi] / max(snap_restarts[lo], 1e-9), 3)
+    return out
+
+
+_REPLICA_PROGRAM = """
+# One member of the replica-fleet bench/canary (bench_replica): the
+# SAME KNN-serving program run as the PRIMARY (ingests the seeded vector
+# feed under persistence, then trickles so staleness stays a live
+# number) or as a READ REPLICA (PATHWAY_REPLICA_OF, hydrates + tails;
+# registers with the router through PATHWAY_ROUTER_CONTROL). A fixed
+# per-query sleep in the post-KNN UDF stands in for per-query device
+# cost (rerank/fetch): the router's load spreading is only measurable
+# if a query COSTS something, and a sleep costs wall-clock without
+# needing a core — so the 1-vs-2-replica p95 drop is honest even on a
+# 1-core runner.
+import json, os, sys, threading, time
+import numpy as np
+import pathway_tpu as pw
+from pathway_tpu.engine import streaming as _streaming
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.io.http import PathwayWebserver, rest_connector
+from pathway_tpu.stdlib.indexing import (
+    default_brute_force_knn_document_index)
+
+DIM = 16
+ROLE = os.environ["REPLICA_BENCH_ROLE"]
+ROOT = os.environ["REPLICA_BENCH_ROOT"]
+N = int(os.environ.get("REPLICA_BENCH_VECS", "256"))
+COST_MS = float(os.environ.get("REPLICA_BENCH_QUERY_COST_MS", "4"))
+READY = os.environ.get("REPLICA_BENCH_READY_FILE")
+
+
+class Subject(pw.io.python.ConnectorSubject):
+    def run(self):
+        rng = np.random.default_rng(11)
+        for i in range(N):
+            self.next(v=rng.random(DIM, np.float32) * 2 - 1)
+            if i % 32 == 31 and not self._session.sleep(0.05):
+                return
+        while True:  # trickle: keep the WAL (and staleness) live
+            if not self._session.sleep(0.5):
+                return
+            self.next(v=rng.random(DIM, np.float32) * 2 - 1)
+
+
+ws = PathwayWebserver(host="127.0.0.1", port=0)
+data = pw.io.python.read(
+    Subject(), schema=sch.schema_from_types(v=np.ndarray),
+    autocommit_duration_ms=25, name="vecs", persistent_id="vecs")
+index = default_brute_force_knn_document_index(
+    data.v, data, dimensions=DIM, reserved_space=4096)
+qschema = sch.schema_from_types(vec=dt.ANY, k=int)
+queries, writer = rest_connector(
+    webserver=ws, route="/q", schema=qschema, methods=("POST",),
+    delete_completed_queries=True, autocommit_duration_ms=10)
+qv = queries.select(
+    qv=pw.apply(lambda v: np.asarray(v, dtype=np.float32), queries.vec),
+    k=queries.k)
+res = index.query_as_of_now(qv.qv, number_of_matches=qv.k)
+
+
+def _ids(ids):
+    time.sleep(COST_MS / 1e3)  # the per-query device-cost stand-in
+    return [str(i) for i in ids]
+
+
+writer(res.select(
+    ids=pw.apply(_ids, res._pw_index_reply_id),
+    scores=pw.apply(lambda ds: [float(d) for d in ds],
+                    res._pw_index_reply_score)))
+
+
+def _announce():
+    while not ws._started.is_set():
+        time.sleep(0.02)
+    def write(doc):
+        if not READY:
+            return
+        with open(READY + ".tmp", "w") as f:
+            json.dump(doc, f)
+        os.replace(READY + ".tmp", READY)
+    write({"port": ws.port, "pid": os.getpid(), "seeded": False})
+    if ROLE == "primary":
+        while True:  # flip `seeded` once the initial N vectors are durable
+            rts = list(_streaming._ACTIVE_RUNTIMES)
+            if rts and rts[0].persistence is not None \\
+                    and rts[0].persistence.entries_committed >= N:
+                write({"port": ws.port, "pid": os.getpid(),
+                       "seeded": True})
+                return
+            time.sleep(0.05)
+
+
+threading.Thread(target=_announce, daemon=True).start()
+
+if ROLE == "primary":
+    pw.run(persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(ROOT)))
+else:
+    pw.run(replica_of=ROOT)
+"""
+
+
+class _ReplicaFleet:
+    """Multi-process replica-fleet harness shared by bench_replica and
+    tests/replica_canary.py: an in-process QueryRouter fronting a primary
+    + N read replicas, each a real OS process running _REPLICA_PROGRAM.
+    The parent generates closed-loop query load against the router's
+    front port and measures end-to-end latency — the numbers a client of
+    the fleet would see."""
+
+    def __init__(self, tmp: str, *, vecs: int = 256,
+                 query_cost_ms: float = 25.0):
+        import sys as _sys
+
+        self.tmp = tmp
+        self.root = os.path.join(tmp, "primary-root")
+        self.prog = os.path.join(tmp, "replica_prog.py")
+        with open(self.prog, "w") as f:
+            f.write(_REPLICA_PROGRAM)
+        self._py = _sys.executable
+        self.base_env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PATHWAY_RUN_ID="replica-bench",
+            REPLICA_BENCH_ROOT=self.root,
+            REPLICA_BENCH_VECS=str(vecs),
+            REPLICA_BENCH_QUERY_COST_MS=str(query_cost_ms))
+        self.base_env.setdefault("PYTHONPATH", os.path.dirname(
+            os.path.abspath(__file__)))
+        # children must not inherit replica/monitoring config from the
+        # parent's environment
+        for k in ("PATHWAY_REPLICA_OF", "PATHWAY_ROUTER_CONTROL",
+                  "PATHWAY_REPLICA_ID", "PATHWAY_SNAPSHOT_EVERY_TICKS",
+                  "PATHWAY_MONITORING_HTTP_PORT", "PATHWAY_PROCESSES"):
+            self.base_env.pop(k, None)
+        self.vecs = vecs
+        self.router = None
+        self.procs: dict[str, object] = {}  # name -> Popen
+
+    # -- lifecycle ---------------------------------------------------------
+    def start_router(self):
+        from pathway_tpu.engine.router import QueryRouter
+
+        prior = os.environ.get("PATHWAY_RUN_ID")
+        os.environ["PATHWAY_RUN_ID"] = "replica-bench"  # shared authkey
+        try:
+            self.router = QueryRouter(port=0, control_port=0)
+            self.router.start()
+        finally:
+            if prior is None:
+                os.environ.pop("PATHWAY_RUN_ID", None)
+            else:
+                os.environ["PATHWAY_RUN_ID"] = prior
+        return self.router
+
+    def _spawn(self, name: str, env: dict):
+        import subprocess
+
+        err = open(os.path.join(self.tmp, f"{name}.stderr"), "w")
+        h = subprocess.Popen([self._py, self.prog], env=env,
+                             stderr=err, stdout=subprocess.DEVNULL)
+        h._err_file = err  # noqa: SLF001 — closed in stop()
+        self.procs[name] = h
+        return h
+
+    def _check_alive(self, name: str) -> None:
+        h = self.procs[name]
+        if h.poll() is not None:
+            with open(os.path.join(self.tmp, f"{name}.stderr")) as f:
+                tail = f.read()[-800:]
+            raise RuntimeError(
+                f"fleet member {name} died (rc={h.returncode}): {tail}")
+
+    def start_primary(self, *, snapshot_ticks: int = 4,
+                      timeout_s: float = 120.0):
+        ready = os.path.join(self.tmp, "primary.ready")
+        env = dict(self.base_env, REPLICA_BENCH_ROLE="primary",
+                   REPLICA_BENCH_READY_FILE=ready,
+                   PATHWAY_SNAPSHOT_EVERY_TICKS=str(snapshot_ticks))
+        self._spawn("primary", env)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self._check_alive("primary")
+            if os.path.exists(ready):
+                with open(ready) as f:
+                    doc = json.load(f)
+                if doc.get("seeded"):
+                    return doc
+            time.sleep(0.1)
+        raise TimeoutError("primary never finished seeding its WAL")
+
+    def start_replica(self, rid: str, *, max_staleness: int = 4,
+                      timeout_s: float = 120.0):
+        env = dict(self.base_env, REPLICA_BENCH_ROLE="replica",
+                   PATHWAY_REPLICA_OF=self.root, PATHWAY_REPLICA_ID=rid,
+                   PATHWAY_ROUTER_CONTROL=(
+                       f"127.0.0.1:{self.router.control_port}"))
+        self._spawn(rid, env)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self._check_alive(rid)
+            for ep in self.router.endpoints():
+                if ep.replica_id == rid and ep.port \
+                        and ep.applied_tick > 0 \
+                        and ep.staleness_ticks <= max_staleness:
+                    self._warm(ep)
+                    return ep
+            time.sleep(0.05)
+        raise TimeoutError(f"replica {rid} never caught up / registered")
+
+    def _warm(self, ep, n: int = 3) -> None:
+        """Warm a fresh replica DIRECTLY (bypassing the router) before it
+        takes fleet traffic: its first queries pay the one-off KNN
+        compile, and a measurement window that includes them measures
+        warmup, not serving."""
+        import http.client
+
+        body = json.dumps({"vec": [0.1] * 16, "k": 3}).encode()
+        for _ in range(n):
+            conn = http.client.HTTPConnection(ep.host, ep.port,
+                                              timeout=60)
+            try:
+                conn.request("POST", "/q", body=body,
+                             headers={"Content-Type": "application/json"})
+                conn.getresponse().read()
+            finally:
+                conn.close()
+
+    def kill_replica(self, rid: str) -> None:
+        self.procs[rid].kill()  # SIGKILL: death, not a graceful drain
+
+    def wait_deregistered(self, rid: str, timeout_s: float = 30.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(e.replica_id != rid for e in self.router.endpoints()):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"router never dropped dead replica {rid}")
+
+    # -- load --------------------------------------------------------------
+    def run_load(self, seconds: float, *, clients: int = 8,
+                 warmup_s: float = 1.0,
+                 kill_at_s: float | None = None,
+                 kill_rid: str | None = None) -> dict:
+        """Closed-loop load from ``clients`` threads against the router
+        front door for ``seconds``; optionally SIGKILL ``kill_rid`` at
+        ``kill_at_s`` into the window. Returns latency quantiles over
+        the post-warmup samples and the FULL-window failure count (a
+        lost query is a lost query, warm or not)."""
+        import http.client
+        import threading as _threading
+
+        body = json.dumps({"vec": [0.1] * 16, "k": 3}).encode()
+        samples: list[tuple[float, float, bool]] = []
+        lock = _threading.Lock()
+        stop_at = time.monotonic() + seconds
+
+        def client():
+            while time.monotonic() < stop_at:
+                t0 = time.monotonic()
+                ok = False
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", self.router.port, timeout=30)
+                    try:
+                        conn.request(
+                            "POST", "/q", body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        resp.read()
+                        ok = resp.status == 200
+                    finally:
+                        conn.close()
+                except OSError:
+                    ok = False
+                with lock:
+                    samples.append(
+                        (t0, (time.monotonic() - t0) * 1e3, ok))
+
+        threads = [_threading.Thread(target=client, daemon=True)
+                   for _ in range(clients)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        if kill_at_s is not None and kill_rid is not None:
+            time.sleep(kill_at_s)
+            self.kill_replica(kill_rid)
+        for t in threads:
+            t.join(timeout=seconds + 60)
+        lost = sum(1 for _t, _ms, ok in samples if not ok)
+        lat = sorted(ms for t0, ms, ok in samples
+                     if ok and t0 >= t_start + warmup_s)
+        out = {"queries": len(samples), "lost": lost}
+        if lat:
+            out["p50_ms"] = round(float(np.percentile(lat, 50)), 3)
+            out["p95_ms"] = round(float(np.percentile(lat, 95)), 3)
+        return out
+
+    def stop(self) -> None:
+        for name, h in self.procs.items():
+            if h.poll() is None:
+                h.kill()
+        for name, h in self.procs.items():
+            try:
+                h.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
+            err = getattr(h, "_err_file", None)
+            if err is not None:
+                err.close()
+        if self.router is not None:
+            self.router.stop()
+
+
+def _bench_replica_ready_sweep() -> dict:
+    """Hydration wall-clock vs history size: for each history H,
+    synthesize a WAL of H rows, then measure replica time-to-ready (start
+    -> applied tick == primary watermark) twice — WAL-only (tail replay,
+    O(stream age)) and snapshot-hydrated (PR-10 restore + empty suffix,
+    O(state)). The snapshot path must stay ~flat across histories."""
+    import tempfile
+    import threading as _threading
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine import streaming as _streaming
+    from pathway_tpu.engine.persistence import PersistenceDriver
+    from pathway_tpu.internals.keys import Pointer
+    from pathway_tpu.internals.parse_graph import G
+
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_REPLICA_ROWS", "1000,10000,50000").split(",")]
+    chunk = 500
+
+    class _Closed(pw.io.python.ConnectorSubject):
+        def run(self):
+            return
+
+    def build():
+        G.clear()
+        t = pw.io.python.read(
+            _Closed(), schema=pw.schema_from_types(word=str),
+            autocommit_duration_ms=10, persistent_id="bench-replica")
+        counts = t.groupby(t.word).reduce(word=t.word,
+                                          c=pw.reducers.count())
+        pw.io.subscribe(counts, lambda *a, **k: None)
+
+    def replica_ready_s(pdir: str, target_tick: int) -> tuple[float, dict]:
+        build()
+        errs: list[BaseException] = []
+
+        def _r():
+            try:
+                pw.run(replica_of=pdir)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        t0 = time.perf_counter()
+        th = _threading.Thread(target=_r, daemon=True)
+        th.start()
+        ready = None
+        deadline = time.monotonic() + 300
+        stats = {}
+        while time.monotonic() < deadline:
+            if errs:
+                raise RuntimeError(f"replica run failed: {errs[0]!r}")
+            for rt in list(_streaming._ACTIVE_RUNTIMES):
+                if rt.replica is not None \
+                        and rt.replica.applied_tick >= target_tick:
+                    ready = time.perf_counter() - t0
+                    stats = rt.replica.stats()
+            if ready is not None:
+                break
+            time.sleep(0.02)
+        _streaming.stop_all()
+        th.join(timeout=60)
+        G.clear()
+        if ready is None:
+            raise TimeoutError(
+                f"replica never reached tick {target_tick} over {pdir}")
+        return ready, stats
+
+    out: dict = {}
+    prior = os.environ.get("PATHWAY_SNAPSHOT_EVERY_TICKS")
+    snap_ready: dict[int, float] = {}
+    try:
+        for n in sizes:
+            with tempfile.TemporaryDirectory() as td:
+                pdir = os.path.join(td, "p")
+                driver = PersistenceDriver(
+                    pw.persistence.Config.simple_config(
+                        pw.persistence.Backend.filesystem(pdir)))
+                log = driver._log_for("bench-replica")
+                tick = 0
+                for base in range(0, n, chunk):
+                    tick += 1
+                    log.append(tick, [
+                        (Pointer(i), (f"w{i % 1000}",), 1, None)
+                        for i in range(base, min(base + chunk, n))])
+                log.close()
+                os.environ.pop("PATHWAY_SNAPSHOT_EVERY_TICKS", None)
+                # min of two: first-run import/compile noise must not
+                # masquerade as tail-replay cost (same rule as
+                # bench_recovery's restarts)
+                wal_s = min(replica_ready_s(pdir, tick)[0],
+                            replica_ready_s(pdir, tick)[0])
+                # snapshot prep: one primary restart with snapshots ON —
+                # its teardown writes the generation and compacts, so the
+                # next replica hydrates O(state) with an empty suffix
+                os.environ["PATHWAY_SNAPSHOT_EVERY_TICKS"] = "1000000000"
+                build()
+                pw.run(persistence_config=pw.persistence.Config
+                       .simple_config(
+                           pw.persistence.Backend.filesystem(pdir)))
+                G.clear()
+                snap_s, st = min(replica_ready_s(pdir, tick),
+                                 replica_ready_s(pdir, tick),
+                                 key=lambda r: r[0])
+                out[f"replica_ready_walonly_s_{n}"] = round(wal_s, 3)
+                out[f"replica_ready_snapshot_s_{n}"] = round(snap_s, 3)
+                out[f"replica_hydrate_s_{n}"] = (
+                    None if st.get("hydrate_wall_s") is None
+                    else round(st["hydrate_wall_s"], 3))
+                snap_ready[n] = snap_s
+    finally:
+        if prior is None:
+            os.environ.pop("PATHWAY_SNAPSHOT_EVERY_TICKS", None)
+        else:
+            os.environ["PATHWAY_SNAPSHOT_EVERY_TICKS"] = prior
+    if snap_ready:
+        lo, hi = min(sizes), max(sizes)
+        out["replica_snapshot_ready_ratio_maxmin"] = round(
+            snap_ready[hi] / max(snap_ready[lo], 1e-9), 3)
+    return out
+
+
+def bench_replica() -> dict:
+    """Elastic replica fleet (engine/replica.py + engine/router.py):
+
+    * hydration time-to-ready vs history size, WAL-only (linear) vs
+      snapshot-hydrated (~flat) — _bench_replica_ready_sweep;
+    * a LIVE fleet: primary + read replicas as separate OS processes
+      behind the in-process router — end-to-end p50/p95 through the
+      router front door with 1 vs 2 replicas (the elasticity evidence),
+      per-replica request spread, exported staleness lag (scraped from
+      the router's real /metrics HTTP surface), and a SIGKILL of one
+      replica under live load (zero lost queries = the failover
+      evidence). tests/replica_canary.py gates all of it in CI.
+    """
+    import tempfile
+    import urllib.request
+
+    out = _bench_replica_ready_sweep()
+    # 10s windows: the elasticity gate compares phase p95s, and with
+    # ~20 qps of closed-loop traffic a 6s window leaves ~100 post-warmup
+    # samples — p95 is then set by ~5 queue-alignment outliers and the
+    # 1-vs-2-replica comparison flakes. 10s windows + 2s warmup keep the
+    # estimate inside the phases' true separation (~2x).
+    load_s = float(os.environ.get("BENCH_REPLICA_LOAD_S", 10.0))
+    clients = int(os.environ.get("BENCH_REPLICA_CLIENTS", 8))
+    tmp = tempfile.mkdtemp(prefix="bench_replica_")
+    fleet = _ReplicaFleet(tmp)
+    try:
+        fleet.start_router()
+        fleet.start_primary()
+        fleet.start_replica("r1")
+        one = fleet.run_load(load_s, clients=clients, warmup_s=2.0)
+        fleet.start_replica("r2")
+        r1_before = {e.replica_id: e.requests
+                     for e in fleet.router.endpoints()}.get("r1", 0)
+        two = fleet.run_load(load_s, clients=clients, warmup_s=2.0)
+        eps = {e.replica_id: e for e in fleet.router.endpoints()}
+        out.update({
+            "replica_fleet_clients": clients,
+            "replica_query_cost_ms": float(
+                fleet.base_env["REPLICA_BENCH_QUERY_COST_MS"]),
+            "replica_p50_ms_1": one.get("p50_ms"),
+            "replica_p95_ms_1": one.get("p95_ms"),
+            "replica_p50_ms_2": two.get("p50_ms"),
+            "replica_p95_ms_2": two.get("p95_ms"),
+            # phase-2 spread: requests each replica served while BOTH
+            # were up (r1's phase-1 traffic subtracted out)
+            "replica_requests_r1": eps["r1"].requests - r1_before,
+            "replica_requests_r2": eps["r2"].requests,
+            "replica_max_staleness_ticks": max(
+                e.staleness_ticks for e in eps.values()),
+        })
+        if one.get("p95_ms") and two.get("p95_ms"):
+            out["replica_p95_ratio_2v1"] = round(
+                two["p95_ms"] / one["p95_ms"], 3)
+        # the exported surface itself: per-replica staleness must be on
+        # the router's real /metrics endpoint (acceptance criterion)
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{fleet.router.port}/metrics",
+            timeout=10).read().decode()
+        out["replica_staleness_exported"] = (
+            'pathway_tpu_replica_staleness_ticks{replica="r1"}' in metrics
+            and 'pathway_tpu_replica_staleness_ticks{replica="r2"}'
+            in metrics)
+        # failover: SIGKILL r1 mid-window; the router must fail its
+        # in-flight queries over to r2 — zero lost end to end
+        kill = fleet.run_load(load_s, clients=clients,
+                              kill_at_s=load_s / 3, kill_rid="r1")
+        fleet.wait_deregistered("r1")
+        out.update({
+            "replica_kill_queries": kill["queries"],
+            "replica_lost_queries": kill["lost"],
+            "replica_failovers": fleet.router.failovers_total,
+            "replica_p95_ms_after_kill": kill.get("p95_ms"),
+            "replica_fleet_after_kill": sorted(
+                e.replica_id for e in fleet.router.endpoints()),
+        })
+    finally:
+        fleet.stop()
     return out
 
 

@@ -56,9 +56,21 @@ class MonitoringHttpServer:
             })
         payload = {
             "process_id": int(os.environ.get("PATHWAY_PROCESS_ID", "0")),
+            # serving role in the replica fleet (engine/replica.py /
+            # engine/router.py): "primary" (owns writes + the WAL) or
+            # "replica" (snapshot-hydrated, tails the WAL read-only);
+            # the router process reports "router" from its own endpoint
+            "role": getattr(self.runtime, "role", "primary"),
             "sources": len(self.runtime.sessions),
             "operators": operators,
         }
+        replica = getattr(self.runtime, "replica", None)
+        if replica is not None:
+            # hydration + staleness snapshot: how far this replica's
+            # applied tick trails the primary's durable watermark
+            payload["replica"] = replica.stats()
+            payload["applied_tick"] = replica.applied_tick
+            payload["staleness_ticks"] = replica.staleness_ticks()
         # critical-path attribution: which operator dominated the last
         # tick. latency_ms is each operator's LAST step latency, so the
         # max over operators is exactly the last tick's dominator; the
@@ -144,8 +156,19 @@ class MonitoringHttpServer:
                                    "restarts": s["restarts"]})
                 if s["stalled"]:
                     stalled.append(s["source"])
+        replica = getattr(self.runtime, "replica", None)
         return healthy, {
             "status": "healthy" if healthy else "degraded",
+            "role": getattr(self.runtime, "role", "primary"),
+            "applied_tick": (replica.applied_tick if replica is not None
+                             else (self.runtime.persistence
+                                   .last_commit_watermark
+                                   if getattr(self.runtime, "persistence",
+                                              None) is not None else
+                                   getattr(self.runtime,
+                                           "_last_completed_tick", 0))),
+            "staleness_ticks": (replica.staleness_ticks()
+                                if replica is not None else 0),
             "failed_sources": failed,
             "stalled_sources": stalled,
             "commit_loop_stalled": commit_stalled,
@@ -423,6 +446,38 @@ class MonitoringHttpServer:
                     lines.append(
                         f'pathway_tpu_paged_tenant_pages'
                         f'{{tenant="{esc(tenant)}"}} {n}')
+        replica = getattr(self.runtime, "replica", None)
+        if replica is not None:
+            # replica-fleet freshness (engine/replica.py): watermark lag
+            # behind the primary, the applied frontier, and hydration
+            # cost — the same families the router exports fleet-wide,
+            # labeled with this replica's id
+            rst = replica.stats()
+            rlab = f'{{replica="{esc(rst["replica_id"])}"}}'
+            lines.append(
+                "# TYPE pathway_tpu_replica_staleness_ticks gauge")
+            lines.append(f"pathway_tpu_replica_staleness_ticks{rlab} "
+                         f"{rst['staleness_ticks']}")
+            lines.append("# TYPE pathway_tpu_replica_applied_tick gauge")
+            lines.append(f"pathway_tpu_replica_applied_tick{rlab} "
+                         f"{rst['applied_tick']}")
+            lines.append(
+                "# TYPE pathway_tpu_replica_primary_watermark gauge")
+            lines.append(f"pathway_tpu_replica_primary_watermark{rlab} "
+                         f"{rst['primary_watermark']}")
+            lines.append("# TYPE pathway_tpu_replica_generation gauge")
+            lines.append(f"pathway_tpu_replica_generation{rlab} "
+                         f"{rst['generation']}")
+            lines.append(
+                "# TYPE pathway_tpu_replica_entries_applied counter")
+            lines.append(f"pathway_tpu_replica_entries_applied{rlab} "
+                         f"{rst['entries_applied']}")
+            if rst["hydrate_wall_s"] is not None:
+                lines.append(
+                    "# TYPE pathway_tpu_replica_hydrate_seconds gauge")
+                lines.append(
+                    f"pathway_tpu_replica_hydrate_seconds{rlab} "
+                    f"{rst['hydrate_wall_s']}")
         try:
             import resource
 

@@ -109,6 +109,68 @@ def _recv_hello(sock: socket.socket) -> dict:
     return pickle.loads(bytes(_recv_exact(sock, n)))
 
 
+def hmac_handshake(sock: socket.socket, authkey: bytes,
+                   deadline: float) -> None:
+    """Mutual HMAC-SHA256 challenge over a raw socket (replaces the
+    multiprocessing.connection challenge, which needed its Connection
+    framing). Both sides write first, then read — no deadlock. The
+    per-operation timeout is capped below the caller's deadline so one
+    silent dialer (port scanner, peer dying mid-handshake) cannot
+    monopolize an accept loop while a genuine peer waits. Shared by the
+    cluster exchange plane and the replica-fleet control channel
+    (engine/replica.py / engine/router.py)."""
+    sock.settimeout(min(5.0, max(0.1, deadline - time.monotonic())))
+    my_nonce = os.urandom(16)
+    _send_exact(sock, my_nonce)
+    peer_nonce = bytes(_recv_exact(sock, 16))
+    _send_exact(sock,
+                hmac_mod.new(authkey, peer_nonce, "sha256").digest())
+    theirs = bytes(_recv_exact(sock, 32))
+    mine = hmac_mod.new(authkey, my_nonce, "sha256").digest()
+    if not hmac_mod.compare_digest(theirs, mine):
+        raise ClusterConnectError(
+            "cluster authentication failed (PATHWAY_RUN_ID mismatch "
+            "between processes?)")
+
+
+# -- control-channel framing (replica fleet) ----------------------------------
+# The router<->replica control plane ships (tag, payload) messages as
+# length-prefixed engine/wire.py frames over an HMAC-authenticated socket —
+# the PR-11 wire format and handshake, minus the shm rings (control traffic
+# is tiny; heartbeats and scale commands, not row batches).
+
+_CTRL_MAX_FRAME = 16 << 20  # a control message has no business being bigger
+
+
+def send_control_frame(sock: socket.socket, tag: Any, payload: Any) -> int:
+    """One framed control message: u32 total | wire frame. Returns bytes
+    put on the wire."""
+    chunks, total, _rows = wire.encode_frame(tag, payload)
+    _send_exact(sock, b"".join([_u32.pack(total), *chunks]))
+    return _u32.size + total
+
+
+def recv_control_frame(sock: socket.socket) -> tuple[Any, Any]:
+    """Read one framed control message; (tag, payload). Raises EOFError
+    on clean peer close — the replica-death signal the router keys on."""
+    (total,) = _u32.unpack(bytes(_recv_exact(sock, 4)))
+    if total > _CTRL_MAX_FRAME:
+        raise ClusterConnectError(
+            f"absurd control frame length {total} — not a pathway-tpu "
+            "control peer?")
+    buf = _recv_exact(sock, total)
+    tag, payload, _rows = wire.decode_frame(memoryview(buf))
+    return tag, payload
+
+
+def control_authkey(run_id: str | None = None) -> bytes:
+    """The fleet-wide HMAC key: every process of one deployment derives
+    it from PATHWAY_RUN_ID (same derivation as the cluster's)."""
+    rid = run_id if run_id is not None else os.environ.get(
+        "PATHWAY_RUN_ID", "")
+    return f"pathway-tpu/{rid or 'cluster'}".encode()
+
+
 def shm_ring_bytes() -> int:
     try:
         return max(1 << 16,
@@ -384,7 +446,7 @@ class Cluster:
         self.n_processes = int(n_processes)
         self.process_id = int(process_id)
         self.first_port = int(first_port)
-        self.authkey = f"pathway-tpu/{run_id or 'cluster'}".encode()
+        self.authkey = control_authkey(run_id)
         self.peers: dict[int, _Peer] = {}
         self._listener: socket.socket | None = None
         # exchange-plane telemetry (bytes/messages/barriers + enc/dec cost
@@ -517,24 +579,7 @@ class Cluster:
 
     # -- handshake -----------------------------------------------------------
     def _auth(self, sock: socket.socket, deadline: float) -> None:
-        """Mutual HMAC-SHA256 challenge over the raw socket (replaces the
-        multiprocessing.connection challenge, which needed its Connection
-        framing). Both sides write first, then read — no deadlock. The
-        per-operation timeout is capped below the connect deadline so one
-        silent dialer (port scanner, peer dying mid-handshake) cannot
-        monopolize the accept loop while a genuine peer waits."""
-        sock.settimeout(min(5.0, max(0.1, deadline - time.monotonic())))
-        my_nonce = os.urandom(16)
-        _send_exact(sock, my_nonce)
-        peer_nonce = bytes(_recv_exact(sock, 16))
-        _send_exact(sock,
-                    hmac_mod.new(self.authkey, peer_nonce, "sha256").digest())
-        theirs = bytes(_recv_exact(sock, 32))
-        mine = hmac_mod.new(self.authkey, my_nonce, "sha256").digest()
-        if not hmac_mod.compare_digest(theirs, mine):
-            raise ClusterConnectError(
-                "cluster authentication failed (PATHWAY_RUN_ID mismatch "
-                "between processes?)")
+        hmac_handshake(sock, self.authkey, deadline)
 
     def _shm_wanted(self) -> bool:
         if transport_mode() == "tcp":

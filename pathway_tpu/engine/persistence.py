@@ -78,6 +78,14 @@ _SAFE_GLOBALS = {
 }
 
 
+class ReadOnlyPersistenceError(RuntimeError):
+    """A mutation (append/commit/truncate/compact/snapshot write) was
+    attempted through a driver opened with ``read_only=True``. Raised by
+    name so a replica that would otherwise corrupt its primary's WAL or
+    snapshot generations dies loudly instead (engine/replica.py opens the
+    primary's root exactly this way)."""
+
+
 class _RestrictedUnpickler(pickle.Unpickler):
     def find_class(self, module, name):
         if (module, name) in _SAFE_GLOBALS:
@@ -563,6 +571,65 @@ class MockLog:
         pass
 
 
+def scan_log_bytes(data: bytes,
+                   expect_magic: bool) -> tuple[list[tuple[int, list]], int]:
+    """Parse intact ``(time, entries)`` records from a (possibly partial)
+    snapshot-log byte buffer. ``expect_magic`` is True when ``data``
+    begins at byte 0 of the file (the magic header is consumed first).
+    Returns ``(records, consumed)`` — ``consumed`` counts bytes of
+    ``data`` consumed, magic included. Unlike :meth:`SnapshotLog._scan`,
+    an incomplete or checksum-failing tail record is left UNconsumed
+    rather than dropped: a live primary may still be mid-append, and the
+    tailer (engine/replica.py) simply retries from the same offset on
+    its next poll."""
+    records: list = []
+    pos = 0
+    if expect_magic:
+        if not data.startswith(_MAGIC):
+            return records, 0  # header not fully written yet
+        pos = len(_MAGIC)
+    while pos + _HDR.size <= len(data):
+        length, crc = _HDR.unpack_from(data, pos)
+        end = pos + _HDR.size + length
+        if end > len(data):
+            break  # incomplete: the primary is mid-append — retry later
+        payload = data[pos + _HDR.size:end]
+        if zlib.crc32(payload) != crc:
+            break  # not yet flushed fully (or corrupt): retry later
+        try:
+            records.append(_safe_loads(payload))
+        except Exception:
+            break
+        pos = end
+    return records, pos
+
+
+class _ReadOnlyLog:
+    """Log proxy handed out by a ``read_only=True`` driver: every read
+    passes through; every mutation raises :class:`ReadOnlyPersistenceError`
+    by name (defense in depth behind the driver-level guards)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.path = getattr(inner, "path", None)
+
+    def read_all(self):
+        return self._inner.read_all()
+
+    def append(self, time, entries):
+        raise ReadOnlyPersistenceError(
+            "append() on a read-only persistence root — a replica must "
+            "never write to its primary's WAL")
+
+    def truncate_to(self, tick):
+        raise ReadOnlyPersistenceError(
+            "truncate_to() on a read-only persistence root — a replica "
+            "must never compact its primary's WAL")
+
+    def close(self):
+        self._inner.close()
+
+
 class _RecordingSession:
     """Session proxy for a restarted source: buffers live entries (with
     their source offsets) for durable append at the next commit. For
@@ -667,15 +734,41 @@ class _RecordingSession:
         self._inner.close()
 
 
+def source_id(datasource) -> str:
+    """Stable durable identity of a source (shared by the driver and the
+    replica tailer — both sides of the WAL must agree on it)."""
+    pid = getattr(datasource, "persistent_id", None)
+    if pid:
+        return str(pid)
+    # `_uid` is a process-wide construction counter: stable only if the
+    # program builds the same sources in the same order every run.
+    logger.warning(
+        "source %r has no persistent_id; falling back to construction "
+        "order (%s-%s) — adding/reordering sources between runs will "
+        "mismatch snapshot logs. Pass persistent_id= to the connector.",
+        datasource.name, datasource.name, datasource._uid)
+    return f"{datasource.name}-{datasource._uid}"
+
+
 class PersistenceDriver:
     """Engine side of ``pw.persistence.Config`` (python half at
     pathway_tpu/persistence/__init__.py; reference equivalent
     persistence/__init__.py:12,89 + src/persistence/tracker.rs)."""
 
-    def __init__(self, config):
+    # class-level default so partially-constructed drivers (tests build
+    # them via __new__) still read as writable
+    read_only = False
+
+    def __init__(self, config, read_only: bool = False):
         self.config = config
         backend = config.backend
         self.kind = backend.kind
+        # read-only open mode (engine/replica.py): every mutation —
+        # commit/append, WAL truncation, snapshot write, generation
+        # pruning — raises ReadOnlyPersistenceError by name, so a replica
+        # can never damage the primary's durability state. Reads
+        # (restore_time / load_snapshot / _records) are untouched.
+        self.read_only = bool(read_only)
         self._s3 = None
         if self.kind == "s3":
             # native SigV4 client (io/s3/_client.py): snapshots become
@@ -701,7 +794,9 @@ class PersistenceDriver:
             self._s3, self.root = client_from_backend(backend)
         elif self.kind == "filesystem":
             self.root = backend.path
-            os.makedirs(os.path.join(self.root, "streams"), exist_ok=True)
+            if not self.read_only:
+                os.makedirs(os.path.join(self.root, "streams"),
+                            exist_ok=True)
         elif self.kind == "mock":
             if not hasattr(backend, "_mock_store"):
                 backend._mock_store = {}
@@ -758,27 +853,54 @@ class PersistenceDriver:
 
     # -- identity ----------------------------------------------------------
     def _source_id(self, datasource) -> str:
-        pid = getattr(datasource, "persistent_id", None)
-        if pid:
-            return str(pid)
-        # `_uid` is a process-wide construction counter: stable only if the
-        # program builds the same sources in the same order every run.
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "source %r has no persistent_id; falling back to construction "
-            "order (%s-%s) — adding/reordering sources between runs will "
-            "mismatch snapshot logs. Pass persistent_id= to the connector.",
-            datasource.name, datasource.name, datasource._uid)
-        return f"{datasource.name}-{datasource._uid}"
+        return source_id(datasource)
 
     def _log_for(self, source_id: str):
         if self.kind == "mock":
-            return MockLog(self._backend._mock_store, source_id)
+            log = MockLog(self._backend._mock_store, source_id)
+        elif self._s3 is not None:
+            log = S3SnapshotLog(self._s3, self.root, source_id)
+        else:
+            log = SnapshotLog(os.path.join(self.root, "streams",
+                                           source_id + ".snap"))
+        return _ReadOnlyLog(log) if self.read_only else log
+
+    def stream_path(self, source_id: str) -> str | None:
+        """Filesystem path of a source's WAL (None on non-file backends)
+        — the byte-level tail surface engine/replica.py polls."""
+        if self.kind != "filesystem":
+            return None
+        return os.path.join(self.root, "streams", source_id + ".snap")
+
+    def oldest_snapshot_tick(self) -> int | None:
+        """Tick of the OLDEST retained snapshot generation (None when no
+        generation exists). Compaction truncates every WAL to the suffix
+        past exactly this tick, so it is the floor of what the log still
+        contains — a replica whose applied tick is below it after a
+        compaction rescan has provably missed records
+        (engine/replica.py)."""
+        metas = self._list_generations()
+        if not metas:
+            return None
+        return min(int(m.get("tick", 0)) for m in metas)
+
+    def list_source_ids(self) -> list[str]:
+        """Every source id with a durable log under this root (the
+        replica's tail set: a source whose id appears here is hydrated
+        and tailed from the primary's WAL instead of read live)."""
+        if self.kind == "mock":
+            return sorted(self._backend._mock_store.keys())
         if self._s3 is not None:
-            return S3SnapshotLog(self._s3, self.root, source_id)
-        return SnapshotLog(os.path.join(self.root, "streams",
-                                        source_id + ".snap"))
+            prefix = "/".join(p for p in (self.root.strip("/"), "streams")
+                              if p) + "/"
+            return sorted({
+                obj["key"][len(prefix):].split("/", 1)[0]
+                for obj in self._s3.list_objects(prefix)})
+        streams = os.path.join(self.root, "streams")
+        if not os.path.isdir(streams):
+            return []
+        return sorted(f[:-5] for f in os.listdir(streams)
+                      if f.endswith(".snap"))
 
     # -- per-source resume frontier (manifest payload) ---------------------
     def _frontier(self, sid: str) -> dict:
@@ -916,6 +1038,10 @@ class PersistenceDriver:
         the manifest, the generation does not exist; after it, covered
         WAL records are ignored on replay whether or not the truncation
         ran."""
+        if self.read_only:
+            raise ReadOnlyPersistenceError(
+                "write_snapshot() on a read-only persistence root — a "
+                "replica must never write snapshot generations")
         if not self.snapshots_supported:
             if not self._snapshot_warned:
                 self._snapshot_warned = True
@@ -1004,6 +1130,11 @@ class PersistenceDriver:
         generation covers). Runs strictly after the new generation is
         durable; a crash at any point here only costs replay time, never
         data."""
+        if self.read_only:
+            raise ReadOnlyPersistenceError(
+                "_compact() on a read-only persistence root — a replica "
+                "must never truncate the primary's WAL or prune its "
+                "snapshot generations")
         gens = self._list_generations()
         valid = [m for m in gens if self._gen_valid(m)]
         kept = valid[:_keep_generations()]
@@ -1055,19 +1186,7 @@ class PersistenceDriver:
             return self._restore_time
         snap = self.load_snapshot()
         last = snap["tick"] if snap is not None else 0
-        if self.kind == "mock":
-            sids = list(self._backend._mock_store.keys())
-        elif self._s3 is not None:
-            prefix = "/".join(p for p in (self.root.strip("/"), "streams")
-                              if p) + "/"
-            sids = sorted({
-                obj["key"][len(prefix):].split("/", 1)[0]
-                for obj in self._s3.list_objects(prefix)})
-        else:
-            streams = os.path.join(self.root, "streams")
-            sids = [f[:-5] for f in os.listdir(streams)
-                    if f.endswith(".snap")] if os.path.isdir(streams) else []
-        for sid in sids:
+        for sid in self.list_source_ids():
             for t, _ in self._records(sid):
                 last = max(last, t)
         self._restore_time = last
@@ -1087,6 +1206,11 @@ class PersistenceDriver:
         - otherwise the source is assumed to re-emit the identical entry
           sequence on restart, and the first N live pushes are dropped.
         """
+        if self.read_only:
+            raise ReadOnlyPersistenceError(
+                "attach_source() on a read-only persistence root — a "
+                "replica hydrates through engine/replica.py (tail-only), "
+                "never through the recording/commit path")
         sid = self._source_id(datasource)
         if sid in self._attached_ids:
             raise ValueError(
@@ -1194,6 +1318,10 @@ class PersistenceDriver:
         in-flight depth, instead of by draining the bridge first.
         Transient backend write failures retry inside the log's append
         (``_retrying_write``)."""
+        if self.read_only:
+            raise ReadOnlyPersistenceError(
+                "commit() on a read-only persistence root — a replica "
+                "must never append to the primary's WAL")
         t0 = _time.perf_counter()
         if watermark is None:
             watermark = time

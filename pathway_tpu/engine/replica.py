@@ -1,0 +1,556 @@
+"""Snapshot-hydrated read replicas (the replica half of the elastic
+replica fleet; the router half lives in engine/router.py).
+
+A replica is a fresh serving process running the SAME program as the
+primary, pointed at the primary's persistence root with
+``pw.run(replica_of=<root>)``. It never ingests live data and never
+writes durability state; instead it
+
+1. **hydrates** — loads the newest valid operator-state snapshot
+   generation (PR-10's restore path: KNN state is re-uploaded to the
+   device, never re-embedded; a corrupt newest generation falls back one
+   generation, loudly), then
+2. **tails** the primary's durability log: each source's WAL is polled
+   read-only for records past the replica's applied tick, and every
+   COMPLETE primary commit tick is applied locally (a poll round's ready
+   ticks coalesce into one scheduler tick — incremental operators are
+   additive over deltas, so the coalesced apply lands byte-identically
+   on the newest ready tick's state) — the replica's state at
+   ``applied_tick`` is byte-identical to the primary's state at the
+   same watermark tick, and
+3. **serves** — its own ``rest_connector`` routes run live (a
+   :class:`~pathway_tpu.io.http.RestSource` sets ``replica_serve_live``)
+   so ``query_as_of_now`` answers queries at the replica's applied tick;
+   writes stay on the primary.
+
+The primary's root is opened through
+``PersistenceDriver(config, read_only=True)``: any append, truncation,
+compaction or snapshot write raises
+:class:`~pathway_tpu.engine.persistence.ReadOnlyPersistenceError` by
+name — a replica structurally cannot damage the primary's WAL or
+snapshot generations.
+
+**Tick-boundary rule.** The primary appends one record per source per
+commit, all carrying the same watermark tick; a tailer polling mid-commit
+could observe source A's record at tick *t* before source B's lands. The
+tailer therefore holds the NEWEST observed tick back until a later tick
+appears (a completeness proof: the primary's single commit loop finishes
+every append of commit *t* before starting *t+1*) or several consecutive
+polls read no new bytes (sustained silence: the commit that produced *t*
+finished), and only complete ticks are applied — the replica never
+serves a state the primary never had at a tick boundary.
+
+Control traffic to the router (registration, heartbeats carrying
+applied tick / staleness / serving quantiles, scale-in stop commands)
+rides the PR-11 framed transport: HMAC-SHA256 mutual handshake keyed on
+``PATHWAY_RUN_ID`` + length-prefixed ``engine/wire.py`` frames
+(:func:`~pathway_tpu.engine.multiproc.send_control_frame`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import time as _time
+
+from pathway_tpu.engine.delta import Delta
+from pathway_tpu.engine.locking import create_lock
+from pathway_tpu.engine.persistence import (PersistenceDriver,
+                                            ReadOnlyPersistenceError,
+                                            scan_log_bytes, source_id)
+from pathway_tpu.engine.threads import spawn
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ReplicaHydrationError", "ReadOnlyPersistenceError", "ReplicaTailer",
+    "ControlClient", "replica_id_from_env",
+]
+
+
+class ReplicaHydrationError(RuntimeError):
+    """The replica could not reach a query-ready state from the primary's
+    persistence root (unsupported backend, graph mismatch, ...)."""
+
+
+def replica_id_from_env() -> str:
+    return os.environ.get("PATHWAY_REPLICA_ID") or f"replica-{os.getpid()}"
+
+
+def _poll_interval_s() -> float:
+    from pathway_tpu.internals.config import _env_int
+
+    return max(1, _env_int("PATHWAY_REPLICA_POLL_MS", 50)) / 1000.0
+
+
+class _FsLogTail:
+    """Incremental read-only tail over one source's filesystem WAL.
+
+    Tracks (inode, byte offset) so each poll reads ONLY appended bytes.
+    A torn/in-flight tail record is left unconsumed (the next poll
+    retries once the primary's fsync lands). A compaction (the primary
+    atomically replaces the file, changing the inode) or a post-crash
+    torn-tail truncation (size below our offset) triggers a rescan from
+    byte 0, deduplicated by the per-log strictly-increasing record
+    ticks."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._ino: int | None = None
+        self._offset = 0
+        self.last_tick = 0  # max record tick ever returned (dedup key)
+        # set when an inode change forced a rescan: the primary replaced
+        # the file (compaction) — pump() must verify no tick this tail
+        # still needed was truncated away (see ReplicaTailer.pump)
+        self.rescanned = False
+
+    def poll(self) -> tuple[list[tuple[int, list]], int]:
+        """(new records with tick > last_tick, bytes CONSUMED). The
+        progress figure counts parsed bytes, not bytes read: a torn tail
+        record re-read on every poll makes no progress, and reporting it
+        as activity would reset the quiet-poll counter forever — holding
+        the newest complete tick back for as long as the crashed
+        primary's torn record sits there."""
+        try:
+            st = os.stat(self.path)
+        except FileNotFoundError:
+            return [], 0
+        if self._ino is not None and (st.st_ino != self._ino
+                                      or st.st_size < self._offset):
+            # compacted (atomic replace, new inode) or torn-tail
+            # truncated in place (size shrank): rescan from byte 0
+            if st.st_ino != self._ino:
+                self.rescanned = True
+            self._ino, self._offset = None, 0
+        if st.st_size <= self._offset:
+            return [], 0
+        with open(self.path, "rb") as f:
+            if self._offset:
+                f.seek(self._offset)
+            data = f.read()
+        if self._ino is None:
+            self._ino = st.st_ino
+        records, consumed = scan_log_bytes(data,
+                                           expect_magic=self._offset == 0)
+        self._offset += consumed
+        fresh = [(t, e) for t, e in records if t > self.last_tick]
+        if fresh:
+            self.last_tick = max(t for t, _e in fresh)
+        return fresh, consumed
+
+
+class _MockLogTail:
+    """Tail over a MockLog record list (in-process tests): the list is
+    shared with the writing driver, so new appends simply appear; an
+    in-place truncate_to shrinks it, handled by the tick dedup."""
+
+    def __init__(self, store: dict, sid: str):
+        self._records = store.setdefault(sid, [])
+        self.last_tick = 0
+
+    def poll(self) -> tuple[list[tuple[int, list]], int]:
+        fresh = [(t, e) for t, e in list(self._records)
+                 if t > self.last_tick]
+        if fresh:
+            self.last_tick = max(t for t, _e in fresh)
+        return fresh, sum(len(e) for _t, e in fresh)
+
+
+class ReplicaTailer:
+    """Hydration + WAL tailing for one replica runtime (see module doc).
+
+    Lifecycle (driven by StreamingRuntime in replica mode):
+    ``bind(runtime)`` classifies sources into tailed (sid has a WAL in
+    the root, not a serving source) vs live; ``hydrate(scheduler)``
+    restores the newest valid snapshot generation; ``pump(runtime, tc)``
+    is called every commit-loop iteration and applies each complete new
+    primary tick as one scheduler tick."""
+
+    def __init__(self, backend, replica_id: str | None = None):
+        from pathway_tpu import persistence as _p
+
+        if isinstance(backend, str):
+            backend = _p.Backend.filesystem(backend)
+        if backend.kind not in ("filesystem", "mock"):
+            raise ReplicaHydrationError(
+                f"replica hydration requires a filesystem (or mock) "
+                f"persistence root, not {backend.kind!r}")
+        self.replica_id = replica_id or replica_id_from_env()
+        self.driver = PersistenceDriver(_p.Config(backend=backend),
+                                        read_only=True)
+        self._lock = create_lock("ReplicaTailer._lock")
+        self._quiet_polls = 0  # consecutive polls that read no bytes
+        self._tails: dict[str, object] = {}     # sid -> log tail
+        self._nodes: dict[str, object] = {}     # sid -> source Node
+        self._tailed_idx: set[int] = set()      # session indices tailed
+        # ticks observed but not yet applied: tick -> {sid: entries}
+        self._pending: dict[int, dict[str, list]] = {}
+        # -- fleet-visible state (stats(), heartbeats, /metrics) -----------
+        self.applied_tick = 0        # primary watermark fully applied
+        self.primary_watermark = 0   # newest durable tick observed
+        self.generation = 0          # snapshot generation hydrated from
+        self.hydrate_wall_s: float | None = None
+        self.catchup_wall_s: float | None = None  # start -> first caught-up
+        self.records_applied = 0
+        self.entries_applied = 0
+        self._started_at = _time.monotonic()
+
+    # -- wiring -------------------------------------------------------------
+    def bind(self, sessions) -> None:
+        """Classify the runtime's sources: a source whose durable id has
+        a WAL under the primary root is TAILED (its reader thread never
+        starts; rows arrive from the log); serving sources
+        (``replica_serve_live``) and sources unknown to the root run
+        live."""
+        root_sids = set(self.driver.list_source_ids())
+        for i, (node, _session, ds) in enumerate(sessions):
+            if getattr(ds, "replica_serve_live", False):
+                continue
+            sid = source_id(ds)
+            if sid not in root_sids:
+                logger.warning(
+                    "replica source %r has no WAL under the primary root "
+                    "— it will read LIVE on this replica (replicas "
+                    "normally tail every persisted feed)", sid)
+                continue
+            self._nodes[sid] = node
+            self._tailed_idx.add(i)
+            if self.driver.kind == "mock":
+                self._tails[sid] = _MockLogTail(
+                    self.driver._backend._mock_store, sid)
+            else:
+                self._tails[sid] = _FsLogTail(
+                    self.driver.stream_path(sid))
+        if not self._tails:
+            logger.warning(
+                "replica %s: no tailed sources (primary root empty or "
+                "ids mismatched?) — serving whatever local state exists",
+                self.replica_id)
+
+    def is_tailed(self, session_index: int) -> bool:
+        return session_index in self._tailed_idx
+
+    # -- hydration ----------------------------------------------------------
+    def hydrate(self, scheduler) -> int:
+        """Restore the newest valid snapshot generation into the fresh
+        scheduler (operator state incl. the KNN index via re-upload +
+        consolidated sink re-emission) and position the tailer past the
+        covered prefix. Returns the snapshot tick (0 = no snapshot; the
+        whole WAL replays through the first pumps instead)."""
+        t0 = _time.perf_counter()
+        snap = self.driver.load_snapshot()
+        if snap is None:
+            self.hydrate_wall_s = _time.perf_counter() - t0
+            return 0
+        payload = snap["payload"]
+        if payload.get("graph") != scheduler.graph_fingerprint():
+            raise ReplicaHydrationError(
+                "the primary's operator-state snapshot was taken by a "
+                "DIFFERENT pipeline (graph fingerprint mismatch) — a "
+                "replica must run the identical program as its primary")
+        scheduler.restore_operator_states(payload["nodes"])
+        scheduler.emit_restored_outputs(snap["tick"])
+        tick = int(snap["tick"])
+        self.applied_tick = tick
+        self.primary_watermark = max(self.primary_watermark, tick)
+        self.generation = int(snap["generation"])
+        for tail in self._tails.values():
+            tail.last_tick = max(tail.last_tick, tick)
+        self.hydrate_wall_s = _time.perf_counter() - t0
+        logger.info(
+            "replica %s hydrated from snapshot generation %d (tick %d) "
+            "in %.3fs — tailing the WAL suffix", self.replica_id,
+            self.generation, tick, self.hydrate_wall_s)
+        return tick
+
+    # -- tailing ------------------------------------------------------------
+    def pump(self, runtime, time_counter: int) -> int:
+        """One tail round: poll every source's WAL, merge new records
+        into the pending buffer, apply every COMPLETE primary tick (see
+        module doc for the newest-tick hold-back rule). Returns the
+        advanced local tick counter.
+
+        All ready ticks of one round are COALESCED into a single local
+        scheduler tick: the incremental operators are additive over
+        ``(key, row, diff)`` deltas, so applying Δt1+…+Δtk in one step
+        lands byte-identically on the state at tick tk — a state the
+        primary had — while paying ONE tick of engine overhead instead
+        of k. A replica whose loop was busy serving a slow query batch
+        therefore catches up on its backlog in one tick rather than
+        stalling new queries behind k sequential applies (bounded tail
+        latency AND bounded staleness under load)."""
+        new_bytes = 0
+        rescan_floor: int | None = None  # min seen-tick of rescanned tails
+        with self._lock:
+            for sid, tail in self._tails.items():
+                seen_before = tail.last_tick
+                records, nbytes = tail.poll()
+                new_bytes += nbytes
+                if getattr(tail, "rescanned", False):
+                    # what this tail had read BEFORE the replacement is
+                    # what bounds the loss — the rescan poll itself
+                    # already advanced last_tick through the new file
+                    tail.rescanned = False
+                    rescan_floor = (seen_before if rescan_floor is None
+                                    else min(rescan_floor, seen_before))
+                for t, entries in records:
+                    self._pending.setdefault(t, {})[sid] = entries
+            if self._pending:
+                self.primary_watermark = max(self.primary_watermark,
+                                             max(self._pending))
+            newest = max(self._pending) if self._pending else 0
+            # newest-tick hold-back: apply tick t once a LATER tick is
+            # durable (the single commit loop finishes every append of
+            # commit t before starting t+1 — a later tick anywhere is a
+            # completeness PROOF) or after several consecutive quiet
+            # polls (the per-commit appends land back-to-back, so a
+            # sustained silence means the commit that produced t
+            # finished; multiple polls guard against one source's fsync
+            # or write-retry straddling a single poll interval — a
+            # primary stalled longer than that mid-commit is the
+            # residual window only a commit-complete WAL marker would
+            # close)
+            self._quiet_polls = self._quiet_polls + 1 if new_bytes == 0 \
+                else 0
+            quiet = self._quiet_polls >= 3
+            ready = sorted(t for t in self._pending
+                           if t < newest or quiet)
+            batches = [(t, self._pending.pop(t)) for t in ready]
+        if rescan_floor is not None:
+            # a compaction replaced a WAL under us: everything at or
+            # below the OLDEST retained generation's tick is gone from
+            # the log. If a rescanned tail had not yet READ that far
+            # (its dedup last_tick is below the truncation floor), the
+            # dropped records are unrecoverable from the tail — refuse
+            # to silently serve a gapped state; dying loudly lets the
+            # operator (or autoscaler spawn_cb) restart the replica,
+            # which re-hydrates from the newest generation and is
+            # whole again.
+            floor = self.driver.oldest_snapshot_tick()
+            if floor is not None and rescan_floor < floor:
+                raise ReplicaHydrationError(
+                    f"the primary compacted its WAL past this replica's "
+                    f"tail position (seen tick {rescan_floor} < oldest "
+                    f"retained generation tick {floor}) — the replica "
+                    f"lagged more than the snapshot retention window; "
+                    f"restart it to re-hydrate from the newest "
+                    f"generation")
+        if not batches:
+            return time_counter
+        # coalesce: per-source concatenation in tick order = the summed
+        # delta of every ready tick
+        merged: dict[str, list] = {}
+        for t, by_sid in batches:
+            for sid, entries in by_sid.items():
+                merged.setdefault(sid, []).extend(entries)
+                self.records_applied += 1
+                self.entries_applied += len(entries)
+        scheduler = runtime.scheduler
+        for sid in sorted(merged):
+            scheduler.push_source(
+                self._nodes[sid],
+                Delta([(k, r, d) for k, r, d, *_o in merged[sid]]))
+        scheduler.run_time(time_counter)
+        runtime._last_completed_tick = time_counter
+        runtime.last_tick_at = _time.monotonic()
+        time_counter += 1
+        self.applied_tick = batches[-1][0]
+        if self.catchup_wall_s is None \
+                and self.applied_tick >= self.primary_watermark:
+            self.catchup_wall_s = _time.monotonic() - self._started_at
+        return time_counter
+
+    # -- fleet surface -------------------------------------------------------
+    def staleness_ticks(self) -> int:
+        return max(0, self.primary_watermark - self.applied_tick)
+
+    def stats(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "applied_tick": self.applied_tick,
+            "primary_watermark": self.primary_watermark,
+            "staleness_ticks": self.staleness_ticks(),
+            "generation": self.generation,
+            "hydrate_wall_s": (None if self.hydrate_wall_s is None
+                               else round(self.hydrate_wall_s, 6)),
+            "catchup_wall_s": (None if self.catchup_wall_s is None
+                               else round(self.catchup_wall_s, 6)),
+            "records_applied": self.records_applied,
+            "entries_applied": self.entries_applied,
+            "tailed_sources": sorted(self._tails),
+        }
+
+    def close(self) -> None:
+        self.driver.close()
+
+
+class ControlClient:
+    """The replica (or read-serving primary) side of the fleet control
+    channel: dials the router's control listener, authenticates with the
+    shared HMAC handshake, announces ``(role, replica id, HTTP serving
+    endpoint)`` and then heartbeats applied tick / staleness / serving
+    quantiles every ``PATHWAY_REPLICA_HEARTBEAT_MS``. A ``("stop", ...)``
+    frame from the router (scale-in) stops the runtime gracefully.
+    Reconnects with backoff if the router restarts; never takes the
+    serving path down with it."""
+
+    def __init__(self, runtime, address: tuple[str, int],
+                 role: str = "replica", replica_id: str | None = None):
+        from pathway_tpu.internals.config import _env_int
+
+        self.runtime = runtime
+        self.address = address
+        self.role = role
+        self.replica_id = replica_id or replica_id_from_env()
+        self.heartbeat_s = max(
+            10, _env_int("PATHWAY_REPLICA_HEARTBEAT_MS", 250)) / 1000.0
+        self._thread = None
+        self._sock: socket.socket | None = None
+
+    # the serving endpoint to announce: the first live webserver of the
+    # runtime's rest sources (queries go THERE; the monitoring port is in
+    # the heartbeat for dashboards)
+    def _serving_endpoint(self) -> tuple[str, int] | None:
+        for _node, _session, ds in self.runtime.sessions:
+            ws = getattr(ds, "webserver", None)
+            if ws is not None and ws._started.is_set() and ws.port:
+                host = ws.host
+                if host in ("0.0.0.0", "::"):
+                    host = "127.0.0.1"
+                return host, int(ws.port)
+        return None
+
+    def _heartbeat_payload(self) -> dict:
+        rt = self.runtime
+        hb = {"replica": self.replica_id, "role": self.role,
+              "at": _time.time()}
+        # re-announce the serving endpoint: if the webserver was not yet
+        # bound at hello time, the router learns the address from the
+        # first heartbeat that carries it instead of never routing here
+        endpoint = self._serving_endpoint()
+        if endpoint is not None:
+            hb["host"], hb["port"] = endpoint
+        tailer = getattr(rt, "replica", None)
+        if tailer is not None:
+            hb.update(tailer.stats())
+        else:
+            p = getattr(rt, "persistence", None)
+            if p is not None:
+                hb["applied_tick"] = p.last_commit_watermark
+                hb["primary_watermark"] = p.last_commit_watermark
+                hb["generation"] = p.snapshot_generation
+            hb["staleness_ticks"] = 0
+        tracker = getattr(rt.recorder, "requests", None) \
+            if rt.recorder is not None else None
+        if tracker is not None:
+            qs = tracker.quantiles_ms()
+            if qs is not None:
+                hb["p50_ms"] = round(qs[0.5], 3)
+                hb["p95_ms"] = round(qs[0.95], 3)
+            hb["requests"] = tracker.count
+        mon = getattr(rt, "http_server", None)
+        if mon is not None:
+            hb["monitoring_port"] = mon.port
+        return hb
+
+    def start(self) -> None:
+        self._thread = spawn(self._run, name=f"ctrl-{self.replica_id}")
+
+    def _connect_once(self) -> socket.socket:
+        from pathway_tpu.engine.multiproc import (control_authkey,
+                                                  hmac_handshake,
+                                                  send_control_frame)
+
+        sock = socket.create_connection(self.address, timeout=5.0)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hmac_handshake(sock, control_authkey(),
+                           _time.monotonic() + 5.0)
+            # wait (bounded) for the serving endpoint: the webserver
+            # starts on the reader thread, typically within milliseconds
+            deadline = _time.monotonic() + 10.0
+            endpoint = self._serving_endpoint()
+            while endpoint is None and _time.monotonic() < deadline:
+                if self.runtime._stop.wait(0.02):
+                    break
+                endpoint = self._serving_endpoint()
+            hello = {"replica": self.replica_id, "role": self.role}
+            if endpoint is not None:
+                hello["host"], hello["port"] = endpoint
+            send_control_frame(sock, "hello", hello)
+            return sock
+        except BaseException:
+            sock.close()
+            raise
+
+    def _run(self) -> None:
+        from pathway_tpu.engine.multiproc import (recv_control_frame,
+                                                  send_control_frame)
+
+        backoff = 0.2
+        while not self.runtime._stop.is_set():
+            try:
+                sock = self._connect_once()
+            except Exception as e:  # noqa: BLE001 — reconnect with backoff
+                logger.debug("control dial to %s failed: %s; retrying",
+                             self.address, e)
+                if self.runtime._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 5.0)
+                continue
+            backoff = 0.2
+            self._sock = sock
+            try:
+                while not self.runtime._stop.is_set():
+                    send_control_frame(sock, "hb",
+                                       self._heartbeat_payload())
+                    # between heartbeats, watch for router commands
+                    sock.settimeout(self.heartbeat_s)
+                    try:
+                        tag, payload = recv_control_frame(sock)
+                    except socket.timeout:
+                        continue
+                    if tag == "stop":
+                        logger.info(
+                            "replica %s: router requested stop (%s) — "
+                            "shutting down gracefully", self.replica_id,
+                            (payload or {}).get("reason", "scale-in"))
+                        self.runtime.stop()
+                        return
+            except (OSError, EOFError) as e:
+                logger.debug("control link to router lost (%s); "
+                             "redialing", e)
+            finally:
+                self._sock = None
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        # the thread observes runtime._stop; closing the socket unblocks
+        # a recv in flight
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def control_address_from_env() -> tuple[str, int] | None:
+    """``PATHWAY_ROUTER_CONTROL=host:port`` — where this process's
+    control client should register (None = no router)."""
+    raw = os.environ.get("PATHWAY_ROUTER_CONTROL", "").strip()
+    if not raw:
+        return None
+    host, _sep, port = raw.rpartition(":")
+    try:
+        return (host or "127.0.0.1", int(port))
+    except ValueError:
+        logger.warning("unparseable PATHWAY_ROUTER_CONTROL=%r ignored",
+                       raw)
+        return None

@@ -1,0 +1,714 @@
+"""Latency-aware query router for the elastic replica fleet (the front
+tier; the replica half lives in engine/replica.py).
+
+One router process fronts N serving processes (read replicas hydrated
+from the primary's snapshot + WAL suffix, plus optionally the primary
+itself). Replicas dial the router's **control listener** — the PR-11
+framed transport: mutual HMAC-SHA256 handshake keyed on
+``PATHWAY_RUN_ID``, then length-prefixed ``engine/wire.py`` frames — and
+heartbeat their applied tick, staleness and serving quantiles; the
+router detects replica death by control-socket EOF *and* by forward
+failure.
+
+Queries enter the router's **front HTTP server** and are proxied to one
+replica chosen by
+
+* **staleness bound** — replicas whose watermark lag exceeds
+  ``PATHWAY_ROUTER_MAX_STALENESS_TICKS`` are bypassed while a fresher
+  one exists (availability wins over the bound when none qualifies), then
+* **observed latency** — the router keeps per-replica P² p50/p95
+  streaming estimators (the PR-6 ``request_tracker`` machinery) over the
+  latencies it measures itself, and picks the endpoint with the lowest
+  expected cost ``p50 × (1 + inflight)`` (latency-aware least-work). An
+  endpoint nobody routed to for ``PATHWAY_ROUTER_REEXPLORE_S`` scores 0
+  and is re-explored: a latency estimate seeded during a cold start
+  (first queries pay compile/hydration) must not starve it forever.
+
+**Failover**: the router holds each query body until a response arrives;
+a connection-level failure marks the endpoint dead and replays the query
+on the next-best replica — in-flight queries survive replica death
+(idempotent reads; writes stay on the primary).
+
+**Elastic scaling**: the router's SLO burn rate (violation ratio over a
+sliding window / error budget, same contract as the PR-6 tracker, same
+``PATHWAY_SLO_E2E_MS`` / ``PATHWAY_SLO_ERROR_BUDGET`` knobs) drives an
+autoscaler: sustained burn > high-water spawns a replica via the
+operator-supplied callback; burn < low-water retires the worst one with
+a graceful ``("stop", ...)`` control frame (the replica drains and
+exits; the router stops routing to it first).
+
+The router's own monitoring contract matches the engine's
+(``/healthz`` / ``/status`` / ``/metrics`` with ``role: "router"``,
+served locally on the front port; every other path is proxied).
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import json
+import logging
+import os
+import socket
+import threading
+import time as _time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from pathway_tpu.engine.locking import create_lock
+from pathway_tpu.engine.multiproc import (control_authkey, hmac_handshake,
+                                          recv_control_frame,
+                                          send_control_frame)
+from pathway_tpu.engine.request_tracker import P2Quantile
+from pathway_tpu.engine.threads import spawn
+
+logger = logging.getLogger(__name__)
+
+_LOCAL_PATHS = ("/healthz", "/status", "/metrics", "/_router")
+
+
+def _env_int(name: str, default: int) -> int:
+    from pathway_tpu.internals.config import _env_int as ei
+
+    return ei(name, default)
+
+
+def _env_float(name: str, default: float) -> float:
+    from pathway_tpu.internals.config import _env_float as ef
+
+    return ef(name, default)
+
+
+class ReplicaEndpoint:
+    """One registered serving process, as the router sees it: identity +
+    serving address from the hello, freshness from heartbeats, latency
+    from the router's own measurements."""
+
+    def __init__(self, replica_id: str, role: str, host: str | None,
+                 port: int | None, sock: socket.socket):
+        self.replica_id = replica_id
+        self.role = role  # "replica" | "primary"
+        self.host = host
+        self.port = port
+        self.sock = sock  # control socket (stop commands ride it back)
+        self.alive = True
+        self.retiring = False
+        self.applied_tick = 0
+        self.primary_watermark = 0
+        self.staleness_ticks = 0
+        self.generation = 0
+        self.monitoring_port: int | None = None
+        self.last_heartbeat = _time.monotonic()
+        self.requests = 0
+        self.failures = 0
+        self.inflight = 0
+        self.last_routed_at = _time.monotonic()
+        self.p50 = P2Quantile(0.5)
+        self.p95 = P2Quantile(0.95)
+        # replica-side serving quantiles from the heartbeat (/status only
+        # — routing uses the router-observed estimators above)
+        self.reported_p50_ms: float | None = None
+        self.reported_p95_ms: float | None = None
+
+    def observe(self, ms: float) -> None:
+        self.p50.observe(ms)
+        self.p95.observe(ms)
+
+    def expected_cost_ms(self, prior_ms: float = 0.0) -> float:
+        """Latency-aware least-work score: the observed p50 scaled by
+        queued work. An unmeasured endpoint is costed at ``prior_ms``
+        (the fleet's median p50, supplied by ``choose()``): still the
+        cheapest choice at equal queue depth — it gets explored and
+        thereby measured — but the inflight multiplier keeps a burst of
+        concurrent queries from ALL herding onto a just-spawned cold
+        replica whose first responses are seconds of compile away."""
+        p50 = self.p50.value()
+        if p50 is None:
+            p50 = prior_ms
+        return p50 * (1.0 + self.inflight) if p50 \
+            else float(self.inflight)
+
+    def apply_heartbeat(self, hb: dict) -> None:
+        self.last_heartbeat = _time.monotonic()
+        # a heartbeat is proof of life: a transient forward failure
+        # (timeout, connect refusal) marks alive=False, and the next
+        # heartbeat restores the endpoint to rotation — a genuinely dead
+        # process cannot heartbeat, and its control EOF removes it
+        self.alive = True
+        # late serving endpoint: a replica whose webserver was not up at
+        # hello time announces it via heartbeat once it binds
+        if (not self.host or not self.port) and hb.get("host") \
+                and hb.get("port"):
+            self.host, self.port = hb["host"], int(hb["port"])
+        self.applied_tick = int(hb.get("applied_tick", self.applied_tick))
+        self.primary_watermark = int(hb.get("primary_watermark",
+                                            self.primary_watermark))
+        self.staleness_ticks = int(hb.get("staleness_ticks",
+                                          self.staleness_ticks))
+        self.generation = int(hb.get("generation", self.generation))
+        if hb.get("monitoring_port"):
+            self.monitoring_port = int(hb["monitoring_port"])
+        if hb.get("p50_ms") is not None:
+            self.reported_p50_ms = float(hb["p50_ms"])
+        if hb.get("p95_ms") is not None:
+            self.reported_p95_ms = float(hb["p95_ms"])
+
+    def summary(self) -> dict:
+        return {
+            "replica": self.replica_id,
+            "role": self.role,
+            "endpoint": (f"{self.host}:{self.port}"
+                         if self.host and self.port else None),
+            "alive": self.alive,
+            "retiring": self.retiring,
+            "applied_tick": self.applied_tick,
+            "staleness_ticks": self.staleness_ticks,
+            "generation": self.generation,
+            "requests": self.requests,
+            "failures": self.failures,
+            "inflight": self.inflight,
+            "p50_ms": (None if self.p50.value() is None
+                       else round(self.p50.value(), 3)),
+            "p95_ms": (None if self.p95.value() is None
+                       else round(self.p95.value(), 3)),
+            "reported_p50_ms": self.reported_p50_ms,
+            "reported_p95_ms": self.reported_p95_ms,
+        }
+
+
+class NoReplicaAvailable(ConnectionError):
+    """Every registered endpoint is dead or was already tried."""
+
+
+class QueryRouter:
+    """See module doc. ``start()`` brings up the control listener and the
+    front HTTP server; both bind ephemeral ports when given 0."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 control_port: int = 0,
+                 max_staleness_ticks: int | None = None,
+                 slo_ms: float | None = None,
+                 error_budget: float | None = None):
+        self.host = host
+        self.port = port
+        self.control_port = control_port
+        self.max_staleness_ticks = (
+            max_staleness_ticks if max_staleness_ticks is not None
+            else _env_int("PATHWAY_ROUTER_MAX_STALENESS_TICKS", 1024))
+        self.slo_ms = slo_ms if slo_ms is not None else _env_float(
+            "PATHWAY_SLO_E2E_MS", 20.0)
+        self.error_budget = max(1e-6, error_budget if error_budget
+                                is not None
+                                else _env_float("PATHWAY_SLO_ERROR_BUDGET",
+                                                0.01))
+        self.forward_timeout_s = _env_float(
+            "PATHWAY_ROUTER_FORWARD_TIMEOUT_S", 30.0)
+        # an endpoint nobody routed to for this long is re-explored (cost
+        # 0): a latency estimate seeded during its cold start — first
+        # queries pay compile/hydration — must not starve it forever
+        self.reexplore_s = _env_float("PATHWAY_ROUTER_REEXPLORE_S", 5.0)
+        self._lock = create_lock("QueryRouter._lock")
+        self._endpoints: dict[str, ReplicaEndpoint] = {}
+        self._stop = threading.Event()
+        self._ctrl_sock: socket.socket | None = None
+        self._httpd: ThreadingHTTPServer | None = None
+        self._threads: list = []
+        # -- fleet-wide serving aggregates ---------------------------------
+        self._window: collections.deque = collections.deque(
+            maxlen=max(16, _env_int("PATHWAY_SLO_WINDOW", 256)))
+        self._e2e_p50 = P2Quantile(0.5)
+        self._e2e_p95 = P2Quantile(0.95)
+        self.requests_total = 0
+        self.failovers_total = 0
+        self.unroutable_total = 0  # 503s: no live replica could answer
+        self.violations = 0
+        # -- autoscaler ----------------------------------------------------
+        self._spawn_cb = None
+        self._retire_cb = None
+        self.min_replicas = 1
+        self.max_replicas = 8
+        self.scale_high = 1.0
+        self.scale_low = 0.05
+        self.scale_cooldown_s = 10.0
+        self._last_scale_at = 0.0
+        self.scale_out_events = 0
+        self.scale_in_events = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        ctrl = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ctrl.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ctrl.bind((self.host, self.control_port))
+        ctrl.listen(16)
+        self.control_port = ctrl.getsockname()[1]
+        self._ctrl_sock = ctrl
+        self._track_thread(spawn(self._accept_loop,
+                                 name="router-control"))
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _handle(self, method: str) -> None:
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if method == "GET" and path in _LOCAL_PATHS:
+                    router._serve_local(self, path)
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                router._serve_proxy(self, method, body)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_PATCH(self):
+                self._handle("PATCH")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._track_thread(spawn(self._httpd.serve_forever,
+                                 name="router-front"))
+        logger.info("query router up: front %s:%d, control %s:%d",
+                    self.host, self.port, self.host, self.control_port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._ctrl_sock is not None:
+            try:
+                self._ctrl_sock.close()
+            except OSError:
+                pass
+            self._ctrl_sock = None
+        with self._lock:
+            eps = list(self._endpoints.values())
+        for ep in eps:
+            try:
+                ep.sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            threads, self._threads = self._threads, []
+        for t in threads:
+            t.join(timeout=3.0)
+
+    def _track_thread(self, t) -> None:
+        """Register a router thread for join-at-stop, pruning finished
+        ones so endpoint churn (autoscaler cycles, re-registrations)
+        does not grow the list without bound. Lock-guarded against
+        stop()'s snapshot-and-clear."""
+        with self._lock:
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    # -- control plane -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        authkey = control_authkey()
+        self._ctrl_sock.settimeout(0.25)
+        while not self._stop.is_set():
+            try:
+                sock, _addr = self._ctrl_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed: shutting down
+            try:
+                hmac_handshake(sock, authkey, _time.monotonic() + 5.0)
+                tag, hello = recv_control_frame(sock)
+                if tag != "hello":
+                    raise ConnectionError(
+                        f"control protocol skew: expected hello, "
+                        f"got {tag!r}")
+            except Exception as e:  # noqa: BLE001 — strangers knock
+                logger.warning("control handshake failed: %s", e)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            sock.settimeout(None)
+            ep = ReplicaEndpoint(
+                str(hello.get("replica") or f"anon-{id(sock):x}"),
+                str(hello.get("role") or "replica"),
+                hello.get("host"), hello.get("port"), sock)
+            with self._lock:
+                old = self._endpoints.get(ep.replica_id)
+                self._endpoints[ep.replica_id] = ep
+            if old is not None:
+                try:
+                    old.sock.close()
+                except OSError:
+                    pass
+            logger.info("replica registered: %s (%s) at %s:%s",
+                        ep.replica_id, ep.role, ep.host, ep.port)
+            self._track_thread(spawn(
+                lambda e=ep: self._endpoint_loop(e),
+                name=f"router-hb-{ep.replica_id}"))
+
+    def _endpoint_loop(self, ep: ReplicaEndpoint) -> None:
+        """Per-endpoint heartbeat reader; EOF/socket error = death."""
+        try:
+            while not self._stop.is_set():
+                tag, payload = recv_control_frame(ep.sock)
+                if tag == "hb" and isinstance(payload, dict):
+                    ep.apply_heartbeat(payload)
+        except (OSError, EOFError, ConnectionError):
+            pass
+        finally:
+            ep.alive = False
+            with self._lock:
+                if self._endpoints.get(ep.replica_id) is ep:
+                    del self._endpoints[ep.replica_id]
+            try:
+                ep.sock.close()
+            except OSError:
+                pass
+            if not self._stop.is_set():
+                logger.warning(
+                    "replica %s left the fleet (control link closed) — "
+                    "routing around it", ep.replica_id)
+
+    def request_stop_replica(self, ep: ReplicaEndpoint,
+                             reason: str = "scale-in") -> bool:
+        """Graceful retire: stop routing to the endpoint, then ask it to
+        shut down over its control socket."""
+        ep.retiring = True
+        try:
+            send_control_frame(ep.sock, "stop", {"reason": reason})
+            return True
+        except OSError as e:
+            logger.warning("stop command to %s failed: %s",
+                           ep.replica_id, e)
+            ep.alive = False
+            return False
+
+    # -- routing -------------------------------------------------------------
+    def endpoints(self) -> list[ReplicaEndpoint]:
+        with self._lock:
+            return list(self._endpoints.values())
+
+    def live_replicas(self) -> list[ReplicaEndpoint]:
+        return [e for e in self.endpoints()
+                if e.alive and not e.retiring and e.role == "replica"]
+
+    def choose(self, exclude: set | None = None) -> ReplicaEndpoint:
+        """Routing policy (module doc): replicas first (the primary — if
+        it registered as a read-serving endpoint — is the last resort),
+        within-staleness-bound first, lowest expected latency wins."""
+        exclude = exclude or set()
+        live = [e for e in self.endpoints()
+                if e.alive and not e.retiring
+                and e.replica_id not in exclude
+                and e.host and e.port]
+        if not live:
+            raise NoReplicaAvailable(
+                "no live replica endpoint (fleet empty, all dead, or "
+                "all already tried)")
+        replicas = [e for e in live if e.role == "replica"] or live
+        fresh = [e for e in replicas
+                 if e.staleness_ticks <= self.max_staleness_ticks]
+        if not fresh:
+            # availability over the bound: serve from the least-stale
+            # endpoint rather than 503 a fleet that is merely lagging
+            fresh = sorted(replicas, key=lambda e: e.staleness_ticks)[:1]
+        now = _time.monotonic()
+        measured = sorted(p for p in (e.p50.value() for e in fresh)
+                          if p is not None)
+        prior = measured[len(measured) // 2] if measured else 0.0
+
+        def cost(e: ReplicaEndpoint) -> float:
+            if now - e.last_routed_at > self.reexplore_s:
+                return 0.0  # long-unmeasured: re-explore (see __init__)
+            return e.expected_cost_ms(prior)
+
+        chosen = min(fresh, key=cost)
+        # stamp at CHOICE time so concurrent clients do not all pile onto
+        # one re-explored endpoint before its first response lands
+        chosen.last_routed_at = now
+        return chosen
+
+    def forward(self, method: str, path: str, body: bytes,
+                content_type: str = "application/json"
+                ) -> tuple[int, bytes, str, int, str]:
+        """Proxy one query, failing over across replicas until one
+        answers. Returns (status, body, serving replica id, failovers,
+        response content type). The query body is held here until a
+        response arrives — replica death mid-flight costs a retry,
+        never the query."""
+        t0 = _time.perf_counter()
+        tried: set[str] = set()
+        failovers = 0
+        last_err: Exception | None = None
+        while True:
+            try:
+                ep = self.choose(exclude=tried)
+            except NoReplicaAvailable:
+                self.unroutable_total += 1
+                detail = (f" (last error: {last_err})" if last_err else "")
+                return (503,
+                        f"no replica available{detail}".encode(),
+                        "", failovers, "text/plain")
+            tried.add(ep.replica_id)
+            ep.inflight += 1
+            t_attempt = _time.perf_counter()
+            try:
+                conn = http.client.HTTPConnection(
+                    ep.host, ep.port, timeout=self.forward_timeout_s)
+                try:
+                    conn.request(method, path, body=body or None,
+                                 headers={"Content-Type": content_type})
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    status = resp.status
+                    resp_ctype = resp.getheader(
+                        "Content-Type", "application/json")
+                finally:
+                    conn.close()
+            # HTTPException covers the replica dying MID-response
+            # (IncompleteRead/BadStatusLine are not OSErrors) — exactly
+            # the SIGKILL-under-load case; both classes fail over
+            except (OSError, http.client.HTTPException) as e:
+                # connection-level failure: the replica is gone (or
+                # unreachable) — fail over with the SAME body
+                ep.failures += 1
+                ep.alive = False
+                last_err = e
+                failovers += 1
+                self.failovers_total += 1
+                logger.warning(
+                    "forward to %s failed (%s: %s) — failing over",
+                    ep.replica_id, type(e).__name__, e)
+                continue
+            finally:
+                ep.inflight = max(0, ep.inflight - 1)
+            # per-replica estimators see THIS attempt's latency only —
+            # time burned timing out on a corpse must not poison the
+            # rescuing replica's p50/p95 (and thereby choose())
+            ep.requests += 1
+            ep.observe((_time.perf_counter() - t_attempt) * 1e3)
+            ms = (_time.perf_counter() - t0) * 1e3
+            with self._lock:
+                self.requests_total += 1
+                self._window.append(ms)
+                self._e2e_p50.observe(ms)
+                self._e2e_p95.observe(ms)
+                if ms > self.slo_ms:
+                    self.violations += 1
+            return status, data, ep.replica_id, failovers, resp_ctype
+
+    # -- SLO / scaling -------------------------------------------------------
+    def burn_rate(self) -> float:
+        """Observed violation ratio over the sliding window / allowed
+        error budget — the PR-6 burn-rate contract, measured at the
+        fleet's front door."""
+        with self._lock:
+            if not self._window:
+                return 0.0
+            viol = sum(1 for v in self._window if v > self.slo_ms)
+            return (viol / len(self._window)) / self.error_budget
+
+    def quantiles_ms(self) -> dict | None:
+        p50, p95 = self._e2e_p50.value(), self._e2e_p95.value()
+        if p50 is None:
+            return None
+        return {"p50": round(p50, 3), "p95": round(max(p50, p95), 3)}
+
+    def configure_autoscaler(self, spawn_cb=None, retire_cb=None, *,
+                             min_replicas: int = 1, max_replicas: int = 8,
+                             high: float = 1.0, low: float = 0.05,
+                             cooldown_s: float = 10.0,
+                             interval_s: float = 1.0) -> None:
+        """Arm burn-rate-driven elasticity. ``spawn_cb()`` must start one
+        new replica process (it registers itself over the control
+        channel); ``retire_cb(replica_id)`` is notified after a graceful
+        stop command went out. Evaluation runs on a router thread every
+        ``interval_s``."""
+        self._spawn_cb = spawn_cb
+        self._retire_cb = retire_cb
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.scale_high = high
+        self.scale_low = low
+        self.scale_cooldown_s = cooldown_s
+        self._track_thread(spawn(
+            lambda: self._autoscale_loop(interval_s),
+            name="router-autoscaler"))
+
+    def _autoscale_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.maybe_scale()
+            except Exception:  # noqa: BLE001 — scaling must not die
+                logger.warning("autoscaler evaluation failed",
+                               exc_info=True)
+
+    def maybe_scale(self) -> str | None:
+        """One scaling decision ('out', 'in' or None) based on the
+        current burn rate; cooldown-guarded so one burst cannot thrash
+        the fleet."""
+        now = _time.monotonic()
+        if now - self._last_scale_at < self.scale_cooldown_s:
+            return None
+        live = self.live_replicas()
+        burn = self.burn_rate()
+        if burn > self.scale_high and self._spawn_cb is not None \
+                and len(live) < self.max_replicas:
+            logger.info(
+                "burn rate %.2f > %.2f with %d replica(s) — scaling OUT",
+                burn, self.scale_high, len(live))
+            self._last_scale_at = now
+            self.scale_out_events += 1
+            self._spawn_cb()
+            return "out"
+        if burn < self.scale_low and len(live) > self.min_replicas:
+            # retire the endpoint contributing least: worst observed p95
+            victim = max(live, key=lambda e: e.p95.value() or 0.0)
+            logger.info(
+                "burn rate %.2f < %.2f with %d replica(s) — scaling IN "
+                "(retiring %s)", burn, self.scale_low, len(live),
+                victim.replica_id)
+            self._last_scale_at = now
+            self.scale_in_events += 1
+            self.request_stop_replica(victim)
+            if self._retire_cb is not None:
+                self._retire_cb(victim.replica_id)
+            return "in"
+        return None
+
+    # -- monitoring surface --------------------------------------------------
+    def status_payload(self) -> dict:
+        qs = self.quantiles_ms()
+        return {
+            "role": "router",
+            "front": f"{self.host}:{self.port}",
+            "control": f"{self.host}:{self.control_port}",
+            "replicas": [e.summary() for e in self.endpoints()],
+            "requests": self.requests_total,
+            "failovers": self.failovers_total,
+            "unroutable": self.unroutable_total,
+            "violations": self.violations,
+            "slo_ms": self.slo_ms,
+            "error_budget": self.error_budget,
+            "burn_rate": round(self.burn_rate(), 3),
+            "max_staleness_ticks": self.max_staleness_ticks,
+            "e2e_ms": qs,
+            "scale_out_events": self.scale_out_events,
+            "scale_in_events": self.scale_in_events,
+        }
+
+    def healthz_payload(self) -> tuple[bool, dict]:
+        live = [e for e in self.endpoints() if e.alive]
+        healthy = bool(live)
+        return healthy, {
+            "status": "healthy" if healthy else "degraded",
+            "role": "router",
+            "replicas_live": len(live),
+            "replicas": sorted(e.replica_id for e in live),
+            "burn_rate": round(self.burn_rate(), 3),
+        }
+
+    def metrics_payload(self) -> str:
+        def esc(v: str) -> str:
+            return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+                "\n", r"\n")
+
+        eps = self.endpoints()
+        lines = [
+            "# TYPE pathway_tpu_router_replicas gauge",
+            f"pathway_tpu_router_replicas "
+            f"{sum(1 for e in eps if e.alive)}",
+            "# TYPE pathway_tpu_router_requests_total counter",
+            f"pathway_tpu_router_requests_total {self.requests_total}",
+            "# TYPE pathway_tpu_router_failovers counter",
+            f"pathway_tpu_router_failovers {self.failovers_total}",
+            "# TYPE pathway_tpu_router_unroutable counter",
+            f"pathway_tpu_router_unroutable {self.unroutable_total}",
+            "# TYPE pathway_tpu_router_scale_out_events counter",
+            f"pathway_tpu_router_scale_out_events {self.scale_out_events}",
+            "# TYPE pathway_tpu_router_scale_in_events counter",
+            f"pathway_tpu_router_scale_in_events {self.scale_in_events}",
+            "# TYPE pathway_tpu_slo_target_ms gauge",
+            f"pathway_tpu_slo_target_ms {self.slo_ms}",
+            "# TYPE pathway_tpu_slo_burn_rate gauge",
+            f"pathway_tpu_slo_burn_rate {round(self.burn_rate(), 6)}",
+        ]
+        if eps:
+            lines.append("# TYPE pathway_tpu_router_requests counter")
+            lines.append("# TYPE pathway_tpu_router_failures counter")
+            lines.append("# TYPE pathway_tpu_router_replica_p50_ms gauge")
+            lines.append("# TYPE pathway_tpu_router_replica_p95_ms gauge")
+            lines.append(
+                "# TYPE pathway_tpu_replica_staleness_ticks gauge")
+            lines.append("# TYPE pathway_tpu_replica_applied_tick gauge")
+            for e in sorted(eps, key=lambda e: e.replica_id):
+                lab = f'{{replica="{esc(e.replica_id)}"}}'
+                lines.append(
+                    f"pathway_tpu_router_requests{lab} {e.requests}")
+                lines.append(
+                    f"pathway_tpu_router_failures{lab} {e.failures}")
+                p50, p95 = e.p50.value(), e.p95.value()
+                if p50 is not None:
+                    lines.append(
+                        "pathway_tpu_router_replica_p50_ms"
+                        f"{lab} {round(p50, 6)}")
+                    lines.append(
+                        "pathway_tpu_router_replica_p95_ms"
+                        f"{lab} {round(max(p50, p95), 6)}")
+                lines.append(
+                    f"pathway_tpu_replica_staleness_ticks{lab} "
+                    f"{e.staleness_ticks}")
+                lines.append(
+                    f"pathway_tpu_replica_applied_tick{lab} "
+                    f"{e.applied_tick}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    # -- front HTTP plumbing -------------------------------------------------
+    def _serve_local(self, handler, path: str) -> None:
+        if path == "/healthz":
+            healthy, payload = self.healthz_payload()
+            body = json.dumps(payload).encode()
+            code, ctype = (200 if healthy else 503), "application/json"
+        elif path == "/metrics":
+            body = self.metrics_payload().encode()
+            code, ctype = 200, "text/plain; version=0.0.4"
+        else:  # /status, /_router
+            body = json.dumps(self.status_payload()).encode()
+            code, ctype = 200, "application/json"
+        handler.send_response(code)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _serve_proxy(self, handler, method: str, body: bytes) -> None:
+        status, data, replica_id, failovers, ctype = self.forward(
+            method, handler.path, body,
+            content_type=handler.headers.get("Content-Type",
+                                             "application/json"))
+        try:
+            handler.send_response(status)
+            handler.send_header("Content-Type", ctype)
+            handler.send_header("Content-Length", str(len(data)))
+            if replica_id:
+                handler.send_header("X-Pathway-Replica", replica_id)
+            if failovers:
+                handler.send_header("X-Pathway-Failovers", str(failovers))
+            handler.end_headers()
+            handler.wfile.write(data)
+        except OSError:
+            pass  # client went away; the query itself was served

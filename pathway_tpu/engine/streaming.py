@@ -44,10 +44,28 @@ class StreamingRuntime:
                  persistence_config=None, terminate_on_error=True,
                  default_commit_ms: int = 100, n_workers: int | None = None,
                  cluster=None, connector_policy=None, watchdog=None,
-                 trace_path: str | None = None):
+                 trace_path: str | None = None, replica=None):
         from pathway_tpu.engine.supervisor import ConnectorSupervisor
         from pathway_tpu.engine.threads import install_excepthook
         from pathway_tpu.io._datasource import Session
+
+        # read-replica mode (engine/replica.py): hydrate from the
+        # primary's snapshot + WAL suffix through a READ-ONLY driver and
+        # tail the durability log instead of reading persisted feeds
+        # live; serving sources (rest routes) still run. Mutually
+        # exclusive with owning the persistence root or clustering.
+        self.replica = replica
+        if replica is not None:
+            if persistence_config is not None:
+                raise ValueError(
+                    "a replica cannot own a persistence root: it tails "
+                    "the PRIMARY's root read-only (drop "
+                    "persistence_config, or drop replica_of)")
+            if cluster is not None:
+                raise ValueError(
+                    "replica mode is single-process (scale out by adding "
+                    "replicas behind the router, not cluster workers)")
+        self.role = "replica" if replica is not None else "primary"
 
         # uncaught exceptions in ANY engine thread land in the ErrorLog
         # and flip /healthz instead of dying silently on stderr
@@ -150,6 +168,25 @@ class StreamingRuntime:
         for node, datasource in runner._stream_subjects:
             session = Session()
             self.sessions.append((node, session, datasource))
+        if self.replica is not None:
+            # classify sources: WAL-backed feeds are tailed (no reader
+            # thread), serving sources run live
+            self.replica.bind(self.sessions)
+        # fleet control channel (engine/replica.py): when a router's
+        # control address is configured, this process — replica OR a
+        # read-serving primary — registers and heartbeats its applied
+        # tick / staleness / serving quantiles over the framed HMAC
+        # transport
+        from pathway_tpu.engine.replica import (ControlClient,
+                                                control_address_from_env)
+
+        self._control_client = None
+        ctrl_addr = control_address_from_env()
+        if ctrl_addr is not None and cluster is None:
+            self._control_client = ControlClient(
+                self, ctrl_addr, role=self.role,
+                replica_id=(self.replica.replica_id
+                            if self.replica is not None else None))
         # source index -> persistence recording proxy: the commit loop
         # drains THROUGH the proxy (seal_drain) so seals align exactly
         # with drains — the alignment operator-state snapshots require
@@ -404,8 +441,22 @@ class StreamingRuntime:
                     "snapshot restore is single-process only. Re-run "
                     "single-process, or set PATHWAY_SNAPSHOT_RESTORE=0 "
                     "(sound only if the WAL was never compacted).")
+        if self.replica is not None:
+            # hydrate: newest valid snapshot generation -> operator state
+            # (KNN re-upload, consolidated sink re-emission); the WAL
+            # suffix replays through the first pump rounds below
+            restored_tick = self.replica.hydrate(self.scheduler)
+            # local ticks start past every tick the primary's root
+            # already covers: one monotone clock across restore + tailing
+            time_counter = max(restored_tick,
+                               self.replica.driver.restore_time()) + 1
         for i, (node, session, datasource) in enumerate(self.sessions):
             live_session = session
+            if self.replica is not None and self.replica.is_tailed(i):
+                # tailed feed: rows arrive from the primary's WAL — the
+                # reader thread must never start (it would double-ingest,
+                # and the replica may not even reach the raw inputs)
+                continue
             if self.persistence is not None and reader_here:
                 # replay the durable prefix into `session`, then hand the
                 # reader a recording proxy that skips the replayed count
@@ -451,6 +502,14 @@ class StreamingRuntime:
             [s[2].autocommit_duration_ms or self.default_commit_ms
              for s in self.sessions] + [self.default_commit_ms]
         ) / 1000.0
+        if self.replica is not None:
+            # the loop cadence is also the WAL poll cadence — staleness
+            # is bounded by max(commit interval, PATHWAY_REPLICA_POLL_MS)
+            from pathway_tpu.engine.replica import _poll_interval_s
+
+            commit_s = min(commit_s, _poll_interval_s())
+        if self._control_client is not None:
+            self._control_client.start()
 
         from pathway_tpu.engine.supervisor import Watchdog
 
@@ -495,6 +554,12 @@ class StreamingRuntime:
                 # required by operator-state snapshots (a seal taken before
                 # the drain would let gap entries be processed at t but
                 # recorded at t+1, double-counting them after a restore)
+                if self.replica is not None:
+                    # tail the primary's WAL: every complete new primary
+                    # commit tick is applied, coalesced per round into
+                    # one local scheduler tick (engine/replica.py pump —
+                    # advances applied_tick)
+                    time_counter = self.replica.pump(self, time_counter)
                 any_data, all_closed, pushes = self._drain_and_forward(
                     time_counter)
                 any_data, all_closed = self._tick_sync(
@@ -568,6 +633,9 @@ class StreamingRuntime:
             # teardown: stop reader threads FIRST so nothing pushes into a
             # closed pipeline, then join them (a reader that ignores the
             # stop event is a bug the thread-leak test fixture catches)
+            self._stop.set()  # natural loop exits must also stop helpers
+            if self._control_client is not None:
+                self._control_client.stop()
             self.watchdog.stop()
             self.supervisor.request_stop()
             for _node, session, _ds in self.sessions:
@@ -621,6 +689,8 @@ class StreamingRuntime:
                             "WAL alone stays authoritative",
                             exc_info=True)
                 self.persistence.close()
+            if self.replica is not None:
+                self.replica.close()
             if self.http_server is not None:
                 self.http_server.stop()
         fatal = self.supervisor.fatal_error

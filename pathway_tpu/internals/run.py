@@ -14,7 +14,7 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
         runtime_typechecking: bool | None = None, terminate_on_error: bool = True,
         telemetry_config=None, static_check: str | None = None,
         connector_policy=None, watchdog=None, trace_path: str | None = None,
-        **kwargs) -> Any:
+        replica_of: str | None = None, **kwargs) -> Any:
     """Build the engine graph from all registered outputs and run it.
 
     Static-only graphs run in batch mode to completion; graphs with streaming
@@ -45,11 +45,30 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
     classifications the analyzer records on apply expressions
     (``_shard_class``) are the hook for auto-jitting traceable UDFs here
     later.
+
+    ``replica_of`` (or ``PATHWAY_REPLICA_OF``) runs this program as a
+    snapshot-hydrated READ REPLICA of the primary whose persistence root
+    it names (engine/replica.py): operator state restores from the newest
+    valid snapshot generation, persisted feeds are tailed from the
+    primary's WAL through a read-only driver, rest routes serve
+    ``query_as_of_now`` at the replica's applied tick, and — when
+    ``PATHWAY_ROUTER_CONTROL`` names a router (engine/router.py) — the
+    process registers and heartbeats staleness/latency over the framed
+    HMAC control channel (README "Replica fleet").
     """
+    import os as _os
+
     from pathway_tpu.internals.config import get_pathway_config
 
-    if persistence_config is None:
+    if replica_of is None:
+        replica_of = _os.environ.get("PATHWAY_REPLICA_OF") or None
+    if persistence_config is None and replica_of is None:
         persistence_config = _persistence_config_from_env()
+    replica = None
+    if replica_of is not None:
+        from pathway_tpu.engine.replica import ReplicaTailer
+
+        replica = ReplicaTailer(replica_of)
     _run_static_check(static_check, persistence_config, terminate_on_error,
                       connector_policy)
 
@@ -88,7 +107,8 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
                     persistence_config=persistence_config,
                     terminate_on_error=terminate_on_error,
                     connector_policy=connector_policy, watchdog=watchdog,
-                    cluster=cluster, trace_path=trace_path)
+                    cluster=cluster, trace_path=trace_path,
+                    replica=replica)
                 telemetry.register_scheduler_gauges(rt.scheduler,
                                                     runner.graph)
                 if rt.recorder is not None:
@@ -97,6 +117,11 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
                     rt.recorder.set_telemetry(telemetry)
                 rt.run()
             else:
+                if replica is not None:
+                    raise ValueError(
+                        "replica_of= requires a streaming pipeline (a "
+                        "batch graph has no WAL to tail and nothing to "
+                        "serve)")
                 from pathway_tpu.engine.flight_recorder import FlightRecorder
 
                 recorder = FlightRecorder.from_env(trace_path=trace_path)

@@ -499,10 +499,41 @@ class _FuncAnalyzer(ast.NodeVisitor):
     # handled post-hoc in _mark_kept_handles (needs whole-function view)
 
 
-def _mark_kept_handles(fn_node: ast.AST, info: _FuncInfo) -> None:
+def _callee_keeps_param(class_node: ast.ClassDef | None,
+                        method_name: str, arg_index: int) -> bool:
+    """One-level resolution for ``self.m(spawn(...))``: does method ``m``
+    of the same class keep its ``arg_index``-th parameter (append/add
+    into a container, join, store on self, or return it)? Mirrors the
+    direct keep rules so a tracking helper counts as keeping."""
+    if class_node is None:
+        return False
+    for sub in class_node.body:
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))                 or sub.name != method_name:
+            continue
+        params = [a.arg for a in sub.args.args]
+        pidx = arg_index + 1  # skip self
+        if pidx >= len(params):
+            return False
+        pname = params[pidx]
+        for node in ast.walk(sub):
+            if isinstance(node, ast.Call)                     and isinstance(node.func, ast.Attribute)                     and node.func.attr in ("join", "append", "add")                     and any(isinstance(a, ast.Name) and a.id == pname
+                            for a in node.args):
+                return True
+            if isinstance(node, ast.Assign)                     and isinstance(node.value, ast.Name)                     and node.value.id == pname                     and any(isinstance(t, ast.Attribute)
+                            for t in node.targets):
+                return True
+            if isinstance(node, ast.Return)                     and isinstance(node.value, ast.Name)                     and node.value.id == pname:
+                return True
+        return False
+    return False
+
+
+def _mark_kept_handles(fn_node: ast.AST, info: _FuncInfo,
+                       class_node: ast.ClassDef | None = None) -> None:
     """Decide handle_kept for each spawn in this function: kept when the
     thread object is stored on self, returned, appended into a container,
-    or joined by a local name. Anything else is a dropped daemon handle
+    joined by a local name, or handed to a same-class method that
+    verifiably keeps it. Anything else is a dropped daemon handle
     (PWT204)."""
     # local name -> spawn indices (matched by the spawn call's line)
     local_spawns: dict[str, list[int]] = {}
@@ -542,6 +573,30 @@ def _mark_kept_handles(fn_node: ast.AST, info: _FuncInfo) -> None:
                 for name in names:
                     for idx in local_spawns.get(name, ()):
                         info.spawns[idx].handle_kept = True
+                # direct form: container.append(spawn(...)) — the handle
+                # lands in the container without ever touching a name
+                for a in node.args:
+                    if isinstance(a, ast.Call) \
+                            and _is_spawn_call(a) is not None:
+                        idx = next(
+                            (i for i, sp in enumerate(info.spawns)
+                             if sp.line == a.lineno), None)
+                        if idx is not None:
+                            info.spawns[idx].handle_kept = True
+            elif isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                # tracking-helper form: self.m(spawn(...)) keeps the
+                # handle IFF m of this class verifiably keeps its
+                # parameter (one-level resolution, same keep rules)
+                for ai, a in enumerate(node.args):
+                    if isinstance(a, ast.Call) \
+                            and _is_spawn_call(a) is not None \
+                            and _callee_keeps_param(class_node, fn.attr,
+                                                    ai):
+                        idx = next(
+                            (i for i, sp in enumerate(info.spawns)
+                             if sp.line == a.lineno), None)
+                        if idx is not None:
+                            info.spawns[idx].handle_kept = True
 
 
 # ---------------------------------------------------------------------------
@@ -616,7 +671,7 @@ def _analyze_module(corpus: _Corpus, mod: _ModuleInfo) -> None:
                                      f"{cls.name}.{sub.name}",
                                      cls.name, mod.path)
                     _FuncAnalyzer(corpus, mod, cls, info).visit(sub)
-                    _mark_kept_handles(sub, info)
+                    _mark_kept_handles(sub, info, class_node=node)
                     cls.methods[sub.name] = info
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             info = _FuncInfo(node.name, f"{mod.stem}.{node.name}", None,

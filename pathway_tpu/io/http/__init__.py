@@ -268,6 +268,11 @@ class RestSource(DataSource):
     # runtime wires the run's tracker here when the flight recorder is on;
     # None keeps every stamp a dead branch
     request_tracker = None
+    # replica mode (engine/replica.py): serving sources run LIVE on a
+    # read replica — queries are per-process ephemeral ingress, never
+    # tailed from the primary's WAL (the primary's own recorded query
+    # stream is skipped; resolve() already ignores unknown keys)
+    replica_serve_live = True
 
     def __init__(self, webserver: PathwayWebserver, route: str,
                  methods: tuple[str, ...], schema,

@@ -1,0 +1,117 @@
+"""Replica-fleet canary: snapshot-hydrated read replicas behind the
+latency-aware router must survive replica death and get faster when the
+fleet grows — proven on a REAL multi-process fleet, not mocks.
+
+Drives ``bench.bench_replica()`` (engine/replica.py + engine/router.py):
+a primary and read replicas run as separate OS processes (each a full
+``pw.run`` — the replicas with ``replica_of=`` hydrating from the
+primary's snapshot generation + WAL suffix and registering over the
+framed HMAC control channel), fronted by the in-process QueryRouter,
+under closed-loop query load from client threads. Gates:
+
+1. **failover** — SIGKILL one replica mid-window under live load: ZERO
+   lost queries end to end (the router holds each body and replays it on
+   the next-best replica), >= 1 failover actually observed (the gate saw
+   a real death, not a quiet window), and the router dropped the corpse
+   from the fleet;
+2. **elasticity** — adding a second replica drops the front-door p95
+   (ratio gated <= REPLICA_P95_RATIO, default 0.9; the per-query cost is
+   a sleep — wall-clock, not cores — so the drop is honest on 1-core
+   runners) and the load genuinely spreads (both replicas served);
+3. **staleness exposition** — per-replica
+   ``pathway_tpu_replica_staleness_ticks{replica=}`` scraped from the
+   router's real /metrics HTTP surface during the run;
+4. **bounded hydration** — replica time-to-ready from snapshot+suffix
+   stays ~flat across history sizes (<= REPLICA_READY_RATIO, default
+   2.0, largest vs smallest — the WAL-only contrast is reported, not
+   gated: it is the linear baseline).
+
+The leg's JSON is written as a CI artifact AND checkpointed into
+``BENCH_LASTGOOD.json`` per the evidence rule.
+
+Exits 0 iff all hold. Run: ``python tests/replica_canary.py``.
+Knobs: BENCH_REPLICA_ROWS, BENCH_REPLICA_LOAD_S, BENCH_REPLICA_CLIENTS,
+REPLICA_P95_RATIO, REPLICA_READY_RATIO, REPLICA_BENCH_ARTIFACT (JSON
+path), BENCH_LASTGOOD_PATH.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+P95_RATIO = float(os.environ.get("REPLICA_P95_RATIO", 0.9))
+READY_RATIO = float(os.environ.get("REPLICA_READY_RATIO", 2.0))
+
+
+def main() -> int:
+    import bench
+
+    out = bench.bench_replica()
+    bench._write_lastgood(out)  # evidence rule: checkpoint immediately
+    artifact = os.environ.get("REPLICA_BENCH_ARTIFACT")
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=1)
+
+    # gate 1: failover — a SIGKILLed replica under live load costs
+    # retries, never queries
+    assert out["replica_kill_queries"] > 0, out
+    assert out["replica_lost_queries"] == 0, (
+        f"{out['replica_lost_queries']} of {out['replica_kill_queries']} "
+        "queries lost across the replica kill — failover leaked load")
+    assert out["replica_failovers"] >= 1, (
+        "no failover observed: the kill window never exercised the "
+        "replay path, the zero-lost gate proved nothing")
+    assert out["replica_fleet_after_kill"] == ["r2"], (
+        f"router still routes to the corpse: "
+        f"{out['replica_fleet_after_kill']}")
+    print(f"[gate1] {out['replica_kill_queries']} queries across the "
+          f"SIGKILL, 0 lost, {out['replica_failovers']} failover(s), "
+          f"fleet converged to {out['replica_fleet_after_kill']}")
+
+    # gate 2: elasticity — the second replica must demonstrably drop p95
+    # and actually take traffic
+    ratio = out.get("replica_p95_ratio_2v1")
+    assert ratio is not None, f"no p95 measured in a load phase: {out}"
+    assert ratio <= P95_RATIO, (
+        f"p95 with 2 replicas is {ratio}x the 1-replica p95 "
+        f"({out['replica_p95_ms_1']} -> {out['replica_p95_ms_2']} ms): "
+        f"adding a replica did not demonstrably help (gate {P95_RATIO})")
+    assert out["replica_requests_r1"] > 0 \
+        and out["replica_requests_r2"] > 0, (
+        f"load did not spread: r1={out['replica_requests_r1']} "
+        f"r2={out['replica_requests_r2']} in the 2-replica window")
+    print(f"[gate2] p95 {out['replica_p95_ms_1']} -> "
+          f"{out['replica_p95_ms_2']} ms ({ratio}x <= {P95_RATIO}) "
+          f"with spread r1={out['replica_requests_r1']} "
+          f"r2={out['replica_requests_r2']}")
+
+    # gate 3: per-replica staleness exported on the router's real
+    # /metrics surface (scraped over HTTP during the run)
+    assert out["replica_staleness_exported"] is True, (
+        "pathway_tpu_replica_staleness_ticks{replica=} missing from the "
+        "router's /metrics")
+    print(f"[gate3] staleness exported per replica (max lag observed: "
+          f"{out['replica_max_staleness_ticks']} ticks)")
+
+    # gate 4: snapshot hydration bounded — time-to-ready ~flat vs
+    # history size (the WAL-only numbers are the linear contrast)
+    ready_ratio = out["replica_snapshot_ready_ratio_maxmin"]
+    assert ready_ratio <= READY_RATIO, (
+        f"snapshot-hydrated replica ready time not flat: {ready_ratio}x "
+        f"largest-vs-smallest history (gate {READY_RATIO})")
+    print(f"[gate4] snapshot-hydrated ready time ratio {ready_ratio} "
+          f"<= {READY_RATIO} across history sizes")
+
+    print("replica canary: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -353,8 +353,49 @@ def test_pwt204_negative_kept_handles(tmp_path):
             t = threading.Thread(target=fn, daemon=True)
             t.start()
             return t
+
+        class Fleet:
+            # the router idiom: the handle lands in the container
+            # directly, never touching a local name
+            def __init__(self):
+                self._threads = []
+
+            def start(self, fn):
+                self._threads.append(threading.Thread(target=fn,
+                                                      daemon=True))
+
+        class Tracked:
+            # the tracking-helper idiom: self.m(spawn(...)) where m
+            # verifiably appends its parameter
+            def __init__(self):
+                self._threads = []
+
+            def _track(self, t):
+                self._threads = [x for x in self._threads
+                                 if x.is_alive()]
+                self._threads.append(t)
+
+            def start(self, fn):
+                self._track(threading.Thread(target=fn, daemon=True))
     """)
     assert only(diags, "PWT204") == []
+
+
+def test_pwt204_helper_that_drops_is_still_flagged(tmp_path):
+    # handing the handle to a same-class method is only keeping it if
+    # that method actually stores it — a sink that ignores its argument
+    # must not launder the drop
+    diags = run_check(tmp_path, """
+        import threading
+
+        class Dropper:
+            def _log(self, t):
+                print(t.name)
+
+            def start(self, fn):
+                self._log(threading.Thread(target=fn, daemon=True))
+    """)
+    assert len(only(diags, "PWT204")) == 1
 
 
 # ---------------------------------------------------------------------------

@@ -422,3 +422,118 @@ def test_snapshot_tier_metrics_exposed():
     assert status["persistence"]["snapshot_tick"] == 2
     assert status["persistence"]["snapshot_generation"] == 1
     assert status["persistence"]["wal_replayable_entries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# replica fleet exposition (PR 12): role fields + staleness families on the
+# replica's own endpoint, and the router's /metrics — all through the same
+# regex lint + TYPE-declaration contract as every other family
+# ---------------------------------------------------------------------------
+
+class _FakeTailer:
+    """Duck-types engine/replica.ReplicaTailer's monitoring surface, with
+    an awkward replica id to exercise label escaping."""
+
+    replica_id = 'rep"lica\\one'
+    applied_tick = 41
+    primary_watermark = 44
+    generation = 3
+
+    def staleness_ticks(self):
+        return 3
+
+    def stats(self):
+        return {
+            "replica_id": self.replica_id,
+            "applied_tick": self.applied_tick,
+            "primary_watermark": self.primary_watermark,
+            "staleness_ticks": self.staleness_ticks(),
+            "generation": self.generation,
+            "hydrate_wall_s": 0.125,
+            "catchup_wall_s": 0.5,
+            "records_applied": 7,
+            "entries_applied": 70,
+            "tailed_sources": ["vecs"],
+        }
+
+
+def test_replica_families_exposition_and_status_role():
+    rt = _FakeRuntime()
+    rt.role = "replica"
+    rt.replica = _FakeTailer()
+    lines = _metrics_lines(rt)
+    by_family = {}
+    for f, labels, v in _parse_samples(lines):
+        by_family.setdefault(f, []).append((labels, v))
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    for fam, want in (("pathway_tpu_replica_staleness_ticks", 3),
+                      ("pathway_tpu_replica_applied_tick", 41),
+                      ("pathway_tpu_replica_primary_watermark", 44),
+                      ("pathway_tpu_replica_generation", 3),
+                      ("pathway_tpu_replica_entries_applied", 70)):
+        assert fam in typed, fam
+        (labels, v), = by_family[fam]
+        # the escaped label round-trips back to the raw replica id
+        raw = labels["replica"].replace(r"\\", "\\").replace(r"\"", '"')
+        assert raw == _FakeTailer.replica_id
+        assert v == want, (fam, v)
+    server = MonitoringHttpServer(rt, port=0)
+    status = server.status_payload()
+    assert status["role"] == "replica"
+    assert status["applied_tick"] == 41
+    assert status["staleness_ticks"] == 3
+    assert status["replica"]["generation"] == 3
+    healthy, hz = server.healthz_payload()
+    assert hz["role"] == "replica"
+    assert hz["applied_tick"] == 41 and hz["staleness_ticks"] == 3
+
+
+def test_primary_role_default_on_status_and_healthz():
+    server = MonitoringHttpServer(_FakeRuntime(), port=0)
+    assert server.status_payload()["role"] == "primary"
+    _healthy, hz = server.healthz_payload()
+    assert hz["role"] == "primary" and hz["staleness_ticks"] == 0
+
+
+def test_router_metrics_through_exposition_lint():
+    """The router's /metrics body obeys the same exposition contract:
+    every sample parses, every family is TYPE-declared, per-replica
+    labels escape correctly."""
+    import socket as _socket
+
+    from pathway_tpu.engine.router import QueryRouter, ReplicaEndpoint
+
+    router = QueryRouter(slo_ms=10.0)
+    a, _b = _socket.socketpair()
+    ep = ReplicaEndpoint('we"ird\\replica', "replica", "127.0.0.1", 1, a)
+    ep.staleness_ticks = 5
+    ep.applied_tick = 12
+    for ms in (1.0, 2.0, 3.0, 40.0, 5.0, 6.0):
+        ep.observe(ms)
+    ep.requests = 6
+    router._endpoints[ep.replica_id] = ep
+    for ms in (5.0, 50.0):
+        router._window.append(ms)
+    lines = router.metrics_payload().splitlines()
+    assert lines[-1] == "# EOF"
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    seen = {}
+    for f, labels, v in _parse_samples(lines):
+        assert f in typed, f"router family {f!r} has no # TYPE line"
+        seen.setdefault(f, []).append((labels, v))
+    for fam in ("pathway_tpu_router_replicas",
+                "pathway_tpu_router_requests_total",
+                "pathway_tpu_router_failovers",
+                "pathway_tpu_router_requests",
+                "pathway_tpu_router_replica_p50_ms",
+                "pathway_tpu_router_replica_p95_ms",
+                "pathway_tpu_replica_staleness_ticks",
+                "pathway_tpu_slo_burn_rate"):
+        assert fam in seen, fam
+    (labels, v), = seen["pathway_tpu_replica_staleness_ticks"]
+    raw = labels["replica"].replace(r"\\", "\\").replace(r"\"", '"')
+    assert raw == ep.replica_id and v == 5
+    # p50 <= p95 (the exposed pair is ordered like the tracker's)
+    p50 = seen["pathway_tpu_router_replica_p50_ms"][0][1]
+    p95 = seen["pathway_tpu_router_replica_p95_ms"][0][1]
+    assert p50 <= p95

@@ -1,0 +1,789 @@
+"""Elastic replica fleet: snapshot-hydrated read replicas + the
+latency-aware router (engine/replica.py, engine/router.py).
+
+Covers the PR's pinned contracts:
+
+* read-only persistence open mode — a replica can never append to,
+  truncate, compact or snapshot the primary's root; violations raise
+  ``ReadOnlyPersistenceError`` BY NAME;
+* incremental WAL tailing — torn tails are retried (never dropped),
+  compaction rescans deduplicate by record tick;
+* hydration equivalence — a replica hydrated at generation G + WAL
+  suffix answers ``query_as_of_now`` byte-identically to the primary at
+  the same applied tick, swept across snapshot boundaries (no snapshot /
+  snapshot-covers-all / snapshot + suffix) and the
+  corrupt-newest-generation fallback;
+* live tailing — a replica trailing a RUNNING primary converges to
+  staleness 0 and exports role/applied_tick/staleness on /status,
+  /healthz and /metrics;
+* router policy — staleness bound + latency-aware least-work choice,
+  replica-before-primary preference, deterministic failover (dead
+  endpoint chosen first, query survives), burn-rate-driven scale out/in
+  over the control channel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import streaming as _streaming
+from pathway_tpu.engine.multiproc import (control_authkey, hmac_handshake,
+                                          recv_control_frame,
+                                          send_control_frame)
+from pathway_tpu.engine.persistence import (PersistenceDriver,
+                                            ReadOnlyPersistenceError,
+                                            SnapshotLog, scan_log_bytes)
+from pathway_tpu.engine.replica import _FsLogTail
+from pathway_tpu.engine.router import (NoReplicaAvailable, QueryRouter,
+                                       ReplicaEndpoint)
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.http import PathwayWebserver, rest_connector
+from pathway_tpu.io.python import ConnectorSubject
+from pathway_tpu.stdlib.indexing import default_brute_force_knn_document_index
+
+DIM = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+    _streaming.stop_all()
+
+
+def _fs_config(root):
+    return pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(str(root)))
+
+
+# ---------------------------------------------------------------------------
+# read-only open mode (test-pinned satellite)
+# ---------------------------------------------------------------------------
+
+def test_readonly_driver_raises_by_name(tmp_path):
+    # a primary writes some history first
+    rw = PersistenceDriver(_fs_config(tmp_path))
+    log = rw._log_for("src")
+    log.append(1, [("k", ("row",), 1, None)])
+    log.close()
+
+    ro = PersistenceDriver(_fs_config(tmp_path), read_only=True)
+    assert ro.read_only
+    # reads pass through
+    assert ro.restore_time() == 1
+    assert ro.list_source_ids() == ["src"]
+    assert ro._records("src")[0][0] == 1
+    # every mutation raises BY NAME
+    with pytest.raises(ReadOnlyPersistenceError):
+        ro.commit(2)
+    with pytest.raises(ReadOnlyPersistenceError):
+        ro.write_snapshot(2, {"nodes": {}})
+    with pytest.raises(ReadOnlyPersistenceError):
+        ro._compact()
+
+    class _FakeSource:
+        persistent_id = "src"
+        name = "fake"
+        _uid = 0
+
+    with pytest.raises(ReadOnlyPersistenceError):
+        ro.attach_source(_FakeSource(), object())
+    # and the log proxy itself refuses (defense in depth)
+    rolog = ro._log_for("src")
+    with pytest.raises(ReadOnlyPersistenceError):
+        rolog.append(3, [])
+    with pytest.raises(ReadOnlyPersistenceError):
+        rolog.truncate_to(1)
+    assert rolog.read_all()[0][0] == 1
+
+
+def test_readonly_driver_does_not_create_dirs(tmp_path):
+    root = tmp_path / "never_written"
+    ro = PersistenceDriver(_fs_config(root), read_only=True)
+    assert ro.list_source_ids() == []
+    assert ro.restore_time() == 0
+    assert not root.exists(), "read-only open must not touch the disk"
+
+
+# ---------------------------------------------------------------------------
+# WAL tailing primitives
+# ---------------------------------------------------------------------------
+
+def test_scan_log_bytes_leaves_torn_tail_unconsumed(tmp_path):
+    path = str(tmp_path / "s.snap")
+    log = SnapshotLog(path)
+    log.append(1, [("a", ("r",), 1, None)])
+    log.append(2, [("b", ("r",), 1, None)])
+    log.close()
+    data = open(path, "rb").read()
+    # whole image parses
+    recs, consumed = scan_log_bytes(data, expect_magic=True)
+    assert [t for t, _ in recs] == [1, 2] and consumed == len(data)
+    # truncated mid-record: the second record is left unconsumed
+    recs, consumed = scan_log_bytes(data[:-3], expect_magic=True)
+    assert [t for t, _ in recs] == [1]
+    assert consumed < len(data) - 3
+    # the unconsumed suffix completes once the remaining bytes land
+    recs2, c2 = scan_log_bytes(data[consumed:], expect_magic=False)
+    assert [t for t, _ in recs2] == [2] and consumed + c2 == len(data)
+
+
+def test_fs_tail_torn_record_reports_no_progress(tmp_path):
+    """A torn tail record re-read on every poll must report 0 bytes of
+    progress — otherwise the quiet-poll release in pump() never fires
+    and a crashed primary's final complete tick is withheld forever."""
+    path = str(tmp_path / "s.snap")
+    log = SnapshotLog(path)
+    log.append(1, [("a", ("r",), 1, None)])
+    log.append(2, [("b", ("r",), 1, None)])
+    log.close()
+    whole = open(path, "rb").read()
+    tail = _FsLogTail(path)
+    recs, consumed = tail.poll()
+    assert [t for t, _ in recs] == [1, 2] and consumed == len(whole)
+    # primary crashes mid-append: a torn third record sits at the tail
+    with open(path, "ab") as f:
+        f.write(b"\x99" * 7)
+    for _ in range(3):  # every poll: no records, NO progress
+        assert tail.poll() == ([], 0)
+    # the record completing later resumes normal progress
+    os.truncate(path, len(whole))
+    log2 = SnapshotLog(path)
+    log2.append(3, [("c", ("r",), 1, None)])
+    log2.close()
+    recs, consumed = tail.poll()
+    assert [t for t, _ in recs] == [3] and consumed > 0
+
+
+def test_pump_raises_when_compaction_outruns_tail(tmp_path):
+    """If the primary compacts its WAL past a lagging replica's tail
+    position, the dropped records are unrecoverable — pump must die
+    loudly (restart re-hydrates from the newest generation) instead of
+    silently serving a gapped state."""
+    from pathway_tpu.engine.replica import ReplicaHydrationError, \
+        ReplicaTailer
+
+    root = tmp_path / "root"
+    (root / "streams").mkdir(parents=True)
+    path = str(root / "streams" / "s.snap")
+    log = SnapshotLog(path)
+    for t in range(1, 5):
+        log.append(t, [(f"k{t}", ("r",), 1, None)])
+    tailer = ReplicaTailer(str(root), replica_id="gap-test")
+    tail = _FsLogTail(path)
+    tailer._tails = {"s": tail}
+    recs, _ = tail.poll()
+    assert tail.last_tick == 4
+
+    class _Rt:  # pump touches the scheduler only when batches apply
+        scheduler = None
+
+    tailer._pending.clear()  # seen-but-unapplied is not lost
+    # compaction drops ticks <= 4 while the tail is CAUGHT UP: fine
+    log.truncate_to(4)
+    log.append(5, [("k5", ("r",), 1, None)])
+    tailer.driver.oldest_snapshot_tick = lambda: 4
+    # the rescan is noticed, the gap check passes (last_tick 4 >= floor
+    # 4), and the newest tick 5 is held back — no raise, no apply
+    assert tailer.pump(_Rt(), 100) == 100
+    assert tail.last_tick == 5
+    # now the tail LAGS: a fresh tail that never saw ticks 1..5 meets a
+    # log whose floor is 5 — the gap is real, the tailer must refuse
+    log.truncate_to(5)
+    log.append(6, [("k6", ("r",), 1, None)])
+    log.close()
+    lagging = _FsLogTail(path)
+    lagging.poll()
+    lagging._ino = -1  # next poll sees a "changed" inode -> rescan
+    lagging.last_tick = 2  # saw only ticks <= 2 before the compaction
+    tailer._tails = {"s": lagging}
+    tailer._pending.clear()
+    tailer.driver.oldest_snapshot_tick = lambda: 5
+    with pytest.raises(ReplicaHydrationError, match="compacted"):
+        tailer.pump(_Rt(), 101)
+
+
+def test_fs_tail_incremental_and_dedup(tmp_path):
+    path = str(tmp_path / "s.snap")
+    log = SnapshotLog(path)
+    tail = _FsLogTail(path)
+    assert tail.poll() == ([], 0)  # no file yet
+    log.append(1, [("a", ("r",), 1, None)])
+    recs, nbytes = tail.poll()
+    assert [t for t, _ in recs] == [1] and nbytes > 0
+    assert tail.poll() == ([], 0)  # nothing new
+    log.append(2, [("b", ("r",), 1, None)])
+    log.append(3, [("c", ("r",), 1, None)])
+    recs, _ = tail.poll()
+    assert [t for t, _ in recs] == [2, 3]
+    # compaction: atomic rewrite dropping records <= 2 (new inode) —
+    # the rescan must not re-deliver tick 3
+    log.truncate_to(2)
+    assert tail.poll() == ([], 0) or tail.poll()[0] == []
+    log.append(4, [("d", ("r",), 1, None)])
+    recs, _ = tail.poll()
+    assert [t for t, _ in recs] == [4]
+    log.close()
+
+
+# ---------------------------------------------------------------------------
+# hydration equivalence (query_as_of_now byte-identity)
+# ---------------------------------------------------------------------------
+
+def _build_knn_app(n_vecs, ws, *, trickle=False):
+    """The shared primary/replica program: seeded vector feed -> KNN
+    index -> rest route answering query_as_of_now with (ids, scores)."""
+
+    class Subject(ConnectorSubject):
+        def run(self):
+            rng = np.random.default_rng(7)
+            for i in range(n_vecs):
+                v = rng.random(DIM, np.float32) * 2 - 1
+                self.next(v=v)
+                if i % 16 == 15 or trickle:
+                    if not self._session.sleep(0.05 if not trickle
+                                               else 0.02):
+                        return
+
+    data = pw.io.python.read(
+        Subject(), schema=sch.schema_from_types(v=np.ndarray),
+        autocommit_duration_ms=20, name="vecs", persistent_id="vecs")
+    index = default_brute_force_knn_document_index(
+        data.v, data, dimensions=DIM, reserved_space=512)
+    qschema = sch.schema_from_types(vec=dt.ANY, k=int)
+    queries, writer = rest_connector(
+        webserver=ws, route="/q", schema=qschema, methods=("POST",),
+        delete_completed_queries=True, autocommit_duration_ms=10)
+    qv = queries.select(
+        qv=pw.apply(lambda v: np.asarray(v, dtype=np.float32),
+                    queries.vec),
+        k=queries.k)
+    res = index.query_as_of_now(qv.qv, number_of_matches=qv.k)
+    writer(res.select(
+        ids=pw.apply(lambda ids: [str(i) for i in ids],
+                     res._pw_index_reply_id),
+        scores=pw.apply(lambda ds: [float(d) for d in ds],
+                        res._pw_index_reply_score)))
+
+
+def _run_bg(**kw):
+    errs: list[BaseException] = []
+
+    def _r():
+        try:
+            pw.run(**kw)
+        except Exception as e:  # noqa: BLE001 — surfaced by the test
+            errs.append(e)
+
+    th = threading.Thread(target=_r, daemon=True)
+    th.start()
+    return th, errs
+
+
+def _wait_runtime(ws, errs, *, replica=None, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if errs:
+            raise AssertionError(f"pipeline failed: {errs[0]!r}")
+        for rt in list(_streaming._ACTIVE_RUNTIMES):
+            if replica is not None and (rt.replica is not None) != replica:
+                continue
+            if ws._started.is_set() and ws.port:
+                return rt
+        time.sleep(0.05)
+    raise TimeoutError("runtime never started")
+
+
+def _ask(port, vec, k=5):
+    body = json.dumps({"vec": [float(x) for x in vec], "k": k}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/q", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.read().decode()
+
+
+def _run_primary(root, n_vecs, qvecs, monkeypatch, *,
+                 snapshot_ticks=0, expect_new=None) -> list[str]:
+    """Run the app as primary over ``root``, wait until all vectors are
+    durable, capture the reference answers, stop cleanly.
+    ``expect_new`` is the number of entries this run commits itself (a
+    restart replays the durable prefix, which does not re-commit)."""
+    G.clear()
+    if snapshot_ticks:
+        monkeypatch.setenv("PATHWAY_SNAPSHOT_EVERY_TICKS",
+                           str(snapshot_ticks))
+    else:
+        monkeypatch.delenv("PATHWAY_SNAPSHOT_EVERY_TICKS", raising=False)
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+    _build_knn_app(n_vecs, ws)
+    th, errs = _run_bg(persistence_config=_fs_config(root))
+    rt = _wait_runtime(ws, errs, replica=False)
+    want = n_vecs if expect_new is None else expect_new
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline \
+            and rt.persistence.entries_committed < want:
+        time.sleep(0.05)
+    assert rt.persistence.entries_committed >= want, \
+        rt.persistence.stats()
+    answers = [_ask(ws.port, q) for q in qvecs]
+    _streaming.stop_all()
+    th.join(timeout=30)
+    assert not th.is_alive() and not errs, errs
+    return answers
+
+
+def _run_replica_and_answer(root, n_vecs, qvecs, expect_entries=None):
+    """Start the same program as a replica of ``root``, wait for
+    catch-up, answer the query set, return (answers, tailer stats)."""
+    G.clear()
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+    _build_knn_app(n_vecs, ws)
+    th, errs = _run_bg(replica_of=str(root))
+    rt = _wait_runtime(ws, errs, replica=True)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = rt.replica.stats()
+        if st["applied_tick"] == st["primary_watermark"] and (
+                expect_entries is None
+                or st["entries_applied"] >= expect_entries):
+            break
+        time.sleep(0.05)
+    stats = rt.replica.stats()
+    answers = [_ask(ws.port, q) for q in qvecs]
+    _streaming.stop_all()
+    th.join(timeout=30)
+    assert not th.is_alive() and not errs, errs
+    return answers, stats
+
+
+_QVECS = np.random.default_rng(3).random((4, DIM), np.float32) * 2 - 1
+
+
+def test_hydration_equivalence_wal_only(tmp_path, monkeypatch):
+    """No snapshot generation at all: the replica replays the whole WAL
+    through the tail path and answers byte-identically."""
+    primary = _run_primary(tmp_path, 48, _QVECS, monkeypatch,
+                           snapshot_ticks=0)
+    replica, st = _run_replica_and_answer(tmp_path, 48, _QVECS,
+                                          expect_entries=48)
+    assert replica == primary
+    assert st["generation"] == 0 and st["entries_applied"] >= 48
+
+
+def test_hydration_equivalence_snapshot_covers_all(tmp_path, monkeypatch):
+    """Teardown snapshot covers the full history: hydration is pure
+    state restore (KNN re-upload), zero WAL entries replayed."""
+    primary = _run_primary(tmp_path, 48, _QVECS, monkeypatch,
+                           snapshot_ticks=4)
+    replica, st = _run_replica_and_answer(tmp_path, 48, _QVECS)
+    assert replica == primary
+    assert st["generation"] >= 1
+    assert st["entries_applied"] == 0  # the snapshot covered everything
+
+
+def test_hydration_equivalence_snapshot_plus_suffix(tmp_path, monkeypatch):
+    """Generation G + a genuine WAL suffix: phase 2 extends the history
+    with snapshots disabled, so the replica must restore G and tail the
+    suffix past it."""
+    _run_primary(tmp_path, 32, _QVECS, monkeypatch, snapshot_ticks=4)
+    primary = _run_primary(tmp_path, 56, _QVECS, monkeypatch,
+                           snapshot_ticks=0,  # +24 vecs, WAL-only
+                           expect_new=24)
+    replica, st = _run_replica_and_answer(tmp_path, 56, _QVECS,
+                                          expect_entries=1)
+    assert replica == primary
+    assert st["generation"] >= 1, "must hydrate from the snapshot"
+    assert st["entries_applied"] >= 24, "must tail the WAL suffix"
+
+
+def test_hydration_equivalence_corrupt_newest_generation(
+        tmp_path, monkeypatch):
+    """A corrupt newest generation falls back one generation and replays
+    a longer suffix — answers stay byte-identical (the WAL retains the
+    suffix back to the OLDEST kept generation). The newest generation is
+    corrupted BEFORE a WAL-only extension run, so the replica must both
+    fall back and tail genuine data records past the fallback."""
+    _run_primary(tmp_path, 48, _QVECS, monkeypatch, snapshot_ticks=3)
+    snapdir = tmp_path / "snapshots"
+    states = sorted(snapdir.glob("*.state"))
+    assert len(states) >= 2, "need >= 2 generations for the fallback"
+    blob = bytearray(states[-1].read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    states[-1].write_bytes(bytes(blob))
+    # extension run: the primary itself falls back (loudly), then grows
+    # the history WAL-only — no fresh generation shadows the corruption
+    primary = _run_primary(tmp_path, 56, _QVECS, monkeypatch,
+                           snapshot_ticks=0, expect_new=8)
+    replica, st = _run_replica_and_answer(tmp_path, 56, _QVECS,
+                                          expect_entries=8)
+    assert replica == primary
+    newest = int(states[-1].stem)
+    assert 1 <= st["generation"] < newest, \
+        f"expected fallback below generation {newest}, got {st}"
+    assert st["entries_applied"] >= 8, "fallback must replay the suffix"
+
+
+def test_replica_live_tail_staleness_and_surfaces(tmp_path, monkeypatch):
+    """A replica trailing a RUNNING primary: applied tick advances while
+    the primary ingests, converges to staleness 0, and the role /
+    applied_tick / staleness fields + the staleness metric family are
+    live on the replica's own monitoring endpoint."""
+    monkeypatch.setenv("PATHWAY_SNAPSHOT_EVERY_TICKS", "8")
+    monkeypatch.setenv("PATHWAY_MONITORING_HTTP_PORT", "0")
+    n = 120
+    G.clear()
+    ws_p = PathwayWebserver(host="127.0.0.1", port=0)
+    _build_knn_app(n, ws_p, trickle=True)
+    th_p, errs_p = _run_bg(persistence_config=_fs_config(tmp_path))
+    rt_p = _wait_runtime(ws_p, errs_p, replica=False)
+    # let some history accumulate, then hydrate a replica mid-stream
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline \
+            and rt_p.persistence.entries_committed < n // 4:
+        time.sleep(0.05)
+    monkeypatch.delenv("PATHWAY_SNAPSHOT_EVERY_TICKS", raising=False)
+    G.clear()
+    ws_r = PathwayWebserver(host="127.0.0.1", port=0)
+    _build_knn_app(n, ws_r)
+    th_r, errs_r = _run_bg(replica_of=str(tmp_path),
+                           with_http_server=True)
+    rt_r = _wait_runtime(ws_r, errs_r, replica=True)
+    mid_applied = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if errs_p or errs_r:
+            raise AssertionError((errs_p, errs_r))
+        st = rt_r.replica.stats()
+        if mid_applied is None and st["entries_applied"] > 0:
+            mid_applied = st["applied_tick"]
+        if rt_p.persistence.entries_committed >= n \
+                and st["entries_applied"] + 0 >= 0 \
+                and st["applied_tick"] == st["primary_watermark"] \
+                and st["primary_watermark"] > 0:
+            break
+        time.sleep(0.05)
+    st = rt_r.replica.stats()
+    assert st["staleness_ticks"] == 0, st
+    assert st["applied_tick"] > (mid_applied or 0), \
+        "applied tick must advance while tailing the live primary"
+    # monitoring surfaces (satellite: role/applied_tick/staleness) —
+    # checked while QUIESCENT (feed complete, replica caught up):
+    # querying the primary first would append fresh commit ticks to the
+    # WAL and race the exact-equality assertions below
+    base = f"http://127.0.0.1:{rt_r.http_server.port}"
+    status = json.loads(urllib.request.urlopen(
+        base + "/status", timeout=10).read())
+    assert status["role"] == "replica"
+    assert status["applied_tick"] == st["applied_tick"]
+    assert status["staleness_ticks"] == 0
+    assert status["replica"]["tailed_sources"] == ["vecs"]
+    hz = json.loads(urllib.request.urlopen(
+        base + "/healthz", timeout=10).read())
+    assert hz["role"] == "replica" and "staleness_ticks" in hz
+    metrics = urllib.request.urlopen(
+        base + "/metrics", timeout=10).read().decode()
+    rid = st["replica_id"]
+    assert (f'pathway_tpu_replica_staleness_ticks{{replica="{rid}"}} 0'
+            in metrics)
+    assert f'pathway_tpu_replica_applied_tick{{replica="{rid}"}}' \
+        in metrics
+    # the two serving tiers agree on the same index state (queries to
+    # the primary tick its commit clock, but never mutate the vectors)
+    primary_answers = [_ask(ws_p.port, q) for q in _QVECS]
+    replica_answers = [_ask(ws_r.port, q) for q in _QVECS]
+    assert replica_answers == primary_answers
+    _streaming.stop_all()
+    th_p.join(timeout=30)
+    th_r.join(timeout=30)
+    assert not errs_p and not errs_r, (errs_p, errs_r)
+
+
+# ---------------------------------------------------------------------------
+# router policy units
+# ---------------------------------------------------------------------------
+
+def _fake_endpoint(router, rid, *, role="replica", staleness=0,
+                   p50=None, inflight=0, host="127.0.0.1", port=1):
+    a, b = socket.socketpair()
+    ep = ReplicaEndpoint(rid, role, host, port, a)
+    ep.staleness_ticks = staleness
+    ep.inflight = inflight
+    if p50 is not None:
+        for _ in range(8):
+            ep.observe(p50)
+    router._endpoints[rid] = ep
+    return ep, b
+
+
+def test_router_choose_latency_and_staleness():
+    router = QueryRouter(max_staleness_ticks=10)
+    fast, _ = _fake_endpoint(router, "fast", p50=2.0)
+    _slow, _ = _fake_endpoint(router, "slow", p50=50.0)
+    assert router.choose().replica_id == "fast"
+    # the fast one goes stale past the bound: the fresh one wins even
+    # though it is slower
+    fast.staleness_ticks = 99
+    assert router.choose().replica_id == "slow"
+    # ALL stale: availability wins — least-stale is served, never a 503
+    router._endpoints["slow"].staleness_ticks = 200
+    assert router.choose().replica_id == "fast"
+    # inflight load shifts the latency-aware choice
+    fast.staleness_ticks = 0
+    router._endpoints["slow"].staleness_ticks = 0
+    fast.inflight = 100
+    assert router.choose().replica_id == "slow"
+
+
+def test_router_reexplores_idle_endpoint():
+    """An endpoint whose latency estimate was seeded during cold start
+    (huge p50) but that nobody routed to for reexplore_s scores 0 and is
+    probed again — the estimate must not starve it forever."""
+    router = QueryRouter()
+    router.reexplore_s = 5.0
+    _fast, _ = _fake_endpoint(router, "fast", p50=2.0)
+    slow, _ = _fake_endpoint(router, "slow", p50=5000.0)
+    assert router.choose().replica_id == "fast"
+    # the slow one has been idle past the window: re-explored
+    slow.last_routed_at = time.monotonic() - 10.0
+    assert router.choose().replica_id == "slow"
+    # choice stamped: the very next pick goes back to the fast one, not
+    # a second blind probe of the re-explored endpoint
+    assert router.choose().replica_id == "fast"
+
+
+def test_router_choose_prefers_replicas_over_primary():
+    router = QueryRouter()
+    _p, _ = _fake_endpoint(router, "primary-1", role="primary", p50=1.0)
+    _r, _ = _fake_endpoint(router, "replica-1", p50=30.0)
+    assert router.choose().replica_id == "replica-1"
+    # the replica dies: the read-serving primary is the last resort
+    router._endpoints["replica-1"].alive = False
+    assert router.choose().replica_id == "primary-1"
+    router._endpoints["primary-1"].alive = False
+    with pytest.raises(NoReplicaAvailable):
+        router.choose()
+
+
+def test_router_burn_rate_scaling_decisions():
+    router = QueryRouter(slo_ms=10.0, error_budget=0.01)
+    spawned = []
+    retired = []
+    router._spawn_cb = lambda: spawned.append(1)
+    router._retire_cb = retired.append
+    router.scale_cooldown_s = 0.0
+    router.min_replicas = 1
+    router.max_replicas = 4
+    _a, _ = _fake_endpoint(router, "a", p50=5.0)
+    _b, peer_b = _fake_endpoint(router, "b", p50=80.0)
+    # burning hot: every request violates the 10 ms SLO
+    for _ in range(64):
+        router._window.append(50.0)
+    assert router.burn_rate() > 1.0
+    assert router.maybe_scale() == "out"
+    assert spawned == [1]
+    # cold: scale in retires the worst-p95 replica with a stop frame
+    router._window.clear()
+    for _ in range(64):
+        router._window.append(1.0)
+    assert router.maybe_scale() == "in"
+    assert retired == ["b"]
+    tag, payload = recv_control_frame(peer_b)
+    assert tag == "stop" and payload["reason"] == "scale-in"
+    assert router._endpoints["b"].retiring
+    # a retiring endpoint is never chosen
+    assert router.choose().replica_id == "a"
+
+
+def test_router_scale_cooldown_blocks_thrash():
+    router = QueryRouter(slo_ms=10.0)
+    router._spawn_cb = lambda: None
+    router.scale_cooldown_s = 3600.0
+    _a, _ = _fake_endpoint(router, "a")
+    for _ in range(64):
+        router._window.append(50.0)
+    assert router.maybe_scale() == "out" or router.maybe_scale() is None
+    assert router.maybe_scale() is None  # cooldown holds
+
+
+# ---------------------------------------------------------------------------
+# router end to end: control protocol + proxy + failover
+# ---------------------------------------------------------------------------
+
+class _FakeReplicaHTTP:
+    """A minimal serving stand-in answering every POST with its name."""
+
+    def __init__(self, name: str):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                body = json.dumps({"served_by": outer.name}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.name = name
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        self._t = threading.Thread(target=self.httpd.serve_forever,
+                                   daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _register_replica(router, rid, port, *, role="replica",
+                      staleness=0) -> socket.socket:
+    """Speak the real control protocol: HMAC handshake, hello, one
+    heartbeat."""
+    sock = socket.create_connection(("127.0.0.1", router.control_port),
+                                    timeout=5)
+    hmac_handshake(sock, control_authkey(), time.monotonic() + 5)
+    sock.settimeout(None)
+    send_control_frame(sock, "hello", {"replica": rid, "role": role,
+                                       "host": "127.0.0.1", "port": port})
+    send_control_frame(sock, "hb", {"replica": rid, "applied_tick": 7,
+                                    "primary_watermark": 7,
+                                    "staleness_ticks": staleness,
+                                    "generation": 1})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        eps = {e.replica_id: e for e in router.endpoints()}
+        if rid in eps and eps[rid].applied_tick == 7:
+            return sock
+        time.sleep(0.02)
+    raise TimeoutError(f"router never registered {rid}")
+
+
+def test_router_end_to_end_proxy_failover_and_metrics():
+    router = QueryRouter()
+    router.start()
+    serving = _FakeReplicaHTTP("alive-replica")
+    try:
+        # a dead endpoint registers first (cold -> chosen first): the
+        # forward fails over and the query is NOT lost
+        dead_sock = _register_replica(router, "dead-replica", 1)
+        live_sock = _register_replica(router, "alive-replica",
+                                      serving.port)
+        body = json.dumps({"q": 1}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/q", data=body,
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["served_by"] == "alive-replica"
+            assert resp.headers["X-Pathway-Replica"] == "alive-replica"
+            assert int(resp.headers["X-Pathway-Failovers"]) >= 1
+        assert router.failovers_total >= 1
+        assert router.requests_total == 1
+        # every further query lands on the live replica; zero lost
+        for _ in range(5):
+            with urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{router.port}/q", data=body,
+                        method="POST"), timeout=30) as resp:
+                assert resp.status == 200
+        assert router.unroutable_total == 0
+        # control-socket EOF removes the endpoint from the fleet
+        dead_sock.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+                e.replica_id == "dead-replica"
+                for e in router.endpoints()):
+            time.sleep(0.02)
+        assert all(e.replica_id != "dead-replica"
+                   for e in router.endpoints())
+        # local monitoring contract: role=router + per-replica families
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/healthz", timeout=10).read())
+        assert hz["role"] == "router" and hz["replicas_live"] >= 1
+        status = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/status", timeout=10).read())
+        assert status["role"] == "router"
+        assert any(r["replica"] == "alive-replica"
+                   for r in status["replicas"])
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/metrics",
+            timeout=10).read().decode()
+        assert ('pathway_tpu_router_requests{replica="alive-replica"}'
+                in metrics)
+        assert ('pathway_tpu_replica_staleness_ticks'
+                '{replica="alive-replica"} 0' in metrics)
+        assert 'pathway_tpu_router_replica_p50_ms{replica=' in metrics
+        live_sock.close()
+    finally:
+        serving.stop()
+        router.stop()
+
+
+def test_router_503_when_fleet_empty():
+    router = QueryRouter()
+    router.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/q", data=b"{}",
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert router.unroutable_total == 1
+        hz_req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/healthz")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(hz_req, timeout=10)
+        assert ei.value.code == 503  # empty fleet = degraded router
+    finally:
+        router.stop()
+
+
+def test_control_frame_roundtrip_rejects_bad_authkey():
+    """The control listener refuses a peer with the wrong PATHWAY_RUN_ID
+    authkey (the HMAC handshake fails) and stays up for genuine peers."""
+    router = QueryRouter()
+    router.start()
+    serving = _FakeReplicaHTTP("ok")
+    try:
+        sock = socket.create_connection(
+            ("127.0.0.1", router.control_port), timeout=5)
+        try:
+            hmac_handshake(sock, b"wrong-key", time.monotonic() + 3)
+            # the listener may close before or after our check — either
+            # way no endpoint must appear
+        except Exception:
+            pass
+        finally:
+            sock.close()
+        time.sleep(0.2)
+        assert router.endpoints() == []
+        # a genuine peer still registers afterwards
+        ok = _register_replica(router, "ok", serving.port)
+        assert [e.replica_id for e in router.endpoints()] == ["ok"]
+        ok.close()
+    finally:
+        serving.stop()
+        router.stop()
