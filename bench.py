@@ -93,6 +93,17 @@ def _write_lastgood(snapshot: dict) -> None:
     try:
         from pathway_tpu.engine.flight_recorder import atomic_write_json
 
+        if not _LASTGOOD_STATE and os.path.exists(path):
+            # seed from the on-disk checkpoint so a single-leg run (the
+            # CI jobs call one bench_* fn directly) REFINES the evidence
+            # file instead of erasing every other leg's captured numbers
+            try:
+                with open(path) as f:
+                    prior = json.load(f).get("result")
+                if isinstance(prior, dict):
+                    _LASTGOOD_STATE.update(prior)
+            except Exception:  # noqa: BLE001 — a torn file must not block
+                pass
         _LASTGOOD_STATE.update(
             {k: v for k, v in snapshot.items() if not k.endswith("error")})
         atomic_write_json(path, {"updated_at": time.time(),
@@ -222,6 +233,15 @@ def _run_device_legs_child() -> None:
             result.update(_LEG_FNS[leg]())
         except Exception as e:  # noqa: BLE001
             result[f"{leg}_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+        if "framework_docs_per_s" in result and "docs_per_s" in result:
+            # VERDICT #5's headline on the REAL device legs: framework-
+            # path throughput over the raw-kernel leg's, SAME run —
+            # target >= 0.85. Suffixed _device: the gated CPU autojit
+            # leg owns the bare `framework_vs_raw_ratio` key, and a full
+            # bench run must not let one leg clobber the other's number
+            # in result/BENCH_LASTGOOD.json
+            result["framework_vs_raw_ratio_device"] = round(
+                result["framework_docs_per_s"] / result["docs_per_s"], 3)
         _set_stage(f"{leg}:done")
         print(json.dumps(result), flush=True)
 
@@ -401,6 +421,17 @@ def main() -> None:
             result.update(bench_etl())
         except Exception as e:  # noqa: BLE001
             errors["etl_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
+    if "autojit" not in SKIP:
+        # auto-jit leg (CPU-runnable): framework-vs-raw on the doc-scoring
+        # pipeline, auto-jit on/off in the same artifact + the per-stage
+        # flight-recorder breakdown (where the Table-path tax went)
+        try:
+            result.update(bench_autojit())
+            _write_lastgood({k: v for k, v in result.items()
+                             if k.startswith(("autojit_", "framework_vs_"))})
+        except Exception as e:  # noqa: BLE001
+            errors["autojit_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
     if "scaleout" not in SKIP:
         # exchange-plane scale-out leg (CPU-runnable): 4-process SPMD
@@ -821,6 +852,19 @@ def bench_embed_framework(n_docs: int | None = None) -> dict:
         out["framework_bridge_overlap_ratio"] = round(
             bridge["overlap_ratio"], 3)
         out["framework_bridge_queue_wait_ms"] = bridge["queue_wait_ms"]
+    try:
+        # auto-jit tier counters for THIS run (internals/autojit.py):
+        # fused programs, XLA bucket compiles, demotions, dispatch mix
+        from pathway_tpu.internals.autojit import autojit_stats
+
+        ajs = autojit_stats()
+        out["framework_autojit_enabled"] = ajs["enabled"]
+        out["framework_autojit_programs"] = ajs["programs"]
+        out["framework_autojit_compiles"] = ajs["compiles"]
+        out["framework_autojit_demotions"] = ajs["demotions"]
+        out["framework_autojit_bucket_count"] = ajs["bucket_count"]
+    except Exception:  # noqa: BLE001
+        pass
     return out
 
 
@@ -1242,6 +1286,270 @@ doc = {
 with open(sys.argv[1], "w") as f:
     json.dump(doc, f)
 """
+
+
+# -- auto-jit leg (CPU-runnable) --------------------------------------------
+# Per-doc "embed" payload for the framework-vs-raw comparison: a jitted
+# id-embedding + 2-layer MLP + L2 norm, calibrated into the flagship
+# raw-kernel budget's band (BASELINE 15k docs/s/chip ~ 66 us/doc; these
+# dims measure ~57 us/doc on this container's CPU) so the ratio gates the
+# SAME regime VERDICT #5's 10.1k-vs-15.0k numbers come from. A near-zero
+# payload would gate pure dispatch overhead (a regime the real pipeline
+# never runs in); an oversized one would hide any framework tax — the
+# per-stage breakdown below keeps the tax itself visible either way.
+AUTOJIT_DOCS = int(os.environ.get("BENCH_AUTOJIT_DOCS", 16 * 2048))
+AUTOJIT_TICK = 2048
+_AUTOJIT_VOCAB, _AUTOJIT_EMB, _AUTOJIT_H1, _AUTOJIT_H2 = \
+    4096, 768, 1536, 1280
+
+
+def _autojit_payload():
+    """(embed_fn(ids int32[n]) -> float64[n], params) — the jitted raw
+    kernel both sides of the comparison dispatch per tick."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(12)
+    params = tuple(
+        np.asarray(rng.standard_normal(s), np.float32) / np.sqrt(s[0])
+        for s in ((_AUTOJIT_VOCAB, _AUTOJIT_EMB),
+                  (_AUTOJIT_EMB, _AUTOJIT_H1), (_AUTOJIT_H1, _AUTOJIT_H2)))
+
+    @jax.jit
+    def fwd(ids, emb, w1, w2):
+        h = jnp.tanh(emb[ids] @ w1)
+        o = h @ w2
+        return jnp.sqrt((o * o).sum(axis=1))
+
+    def embed(ids: np.ndarray) -> np.ndarray:
+        return np.asarray(fwd(jnp.asarray(ids), *params), np.float64)
+
+    return embed
+
+
+def bench_autojit(n_docs: int | None = None) -> dict:
+    """Framework-vs-raw on CPU: the SAME doc-scoring pipeline measured as
+    (a) raw kernels + a thin hand-written loop, (b) the Table path with
+    auto-jit ON, (c) the Table path with auto-jit OFF (today's behavior).
+
+    The pipeline carries every workload class the auto-jit tier targets:
+    a chain of traceable/vmappable scalar UDFs (fused into one dispatch;
+    interpreted per-row when OFF), a host-only UDF (split out and stepped
+    on the host thread while the device leg is in flight, WindVE-style),
+    and a batch device UDF payload (the jitted embed kernel) riding the
+    pipelined bridge. The raw comparator dispatches the IDENTICAL jitted
+    kernel and vectorized numpy score math per tick, with the host-only
+    formatting as a plain Python loop — i.e. what a user would hand-write
+    without the framework, including the row<->column conversions both
+    sides must do.
+
+    ``framework_vs_raw_ratio`` (VERDICT #5, target >= 0.85) is the ON
+    ratio; ``framework_vs_raw_ratio_nojit`` reproduces today's gap in the
+    same artifact. Per-stage flight-recorder breakdowns for both modes
+    ship inline (`autojit_stage_breakdown`) and as a standalone artifact
+    when ``BENCH_AUTOJIT_TRACE_ARTIFACT`` names a path — the "where the
+    Table-path tax went" evidence the ROADMAP asks for. Best-of-3 per
+    mode: single-trial numbers on shared CI runners catch GC pauses and
+    neighbor load (the r05 encdec lesson).
+    """
+    import pathway_tpu as pw
+    from pathway_tpu.debug import table_from_rows
+    from pathway_tpu.engine.flight_recorder import FlightRecorder
+    from pathway_tpu.internals import autojit
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import GraphRunner
+
+    if n_docs is None:
+        n_docs = AUTOJIT_DOCS
+    n_docs -= n_docs % AUTOJIT_TICK
+    n_ticks = n_docs // AUTOJIT_TICK
+    embed_kernel = _autojit_payload()
+
+    # the scoring chain: six sync scalar UDFs spanning every class the
+    # tier compiles (jit-traceable int/conditional float -> XLA group;
+    # compounding-float / math.sqrt / integer-division bodies -> numpy
+    # group) — interpreted per row per UDF when auto-jit is off, exactly
+    # the per-doc host tax the real framework leg pays around its
+    # embedder (parse/split/metadata UDFs)
+    import math
+
+    @pw.udf
+    def boost(x: int) -> int:
+        return x * 3 + 7
+
+    @pw.udf
+    def gate(y: float) -> float:
+        return y if y < 0.75 else 0.75
+
+    @pw.udf
+    def mix(x: int, y: float) -> float:
+        return x * 0.0001 + y * 0.5
+
+    @pw.udf
+    def norm(y: float) -> float:
+        return math.sqrt(y) + 1.0
+
+    @pw.udf
+    def damp(y: float) -> float:
+        return y * 0.5 + 0.25
+
+    @pw.udf
+    def step(x: int) -> int:
+        return (x % 7) + (x // 3)
+
+    @pw.udf(deterministic=True)
+    def tag(x: int) -> str:
+        return f"doc-{x % 97}"
+
+    @pw.udf(batch=True, device=True, deterministic=True, return_type=float)
+    def embed(xs):
+        ids = np.asarray(xs, np.int64) % _AUTOJIT_VOCAB
+        return embed_kernel(ids.astype(np.int32)).tolist()
+
+    rng = np.random.default_rng(3)
+    xs = rng.integers(0, 1_000_000, size=n_docs)
+    ys = rng.random(size=n_docs)
+    rows = [(int(x), float(y), i // AUTOJIT_TICK, 1)
+            for i, (x, y) in enumerate(zip(xs, ys))]
+    schema = sch.schema_from_types(x=int, y=float)
+
+    def run_framework() -> tuple[float, list, dict, dict]:
+        G.clear()
+        autojit.reset_stats()
+        t = table_from_rows(schema, rows, is_stream=True)
+        t1 = t.select(sb=boost(t.x), sg=gate(t.y), sm=mix(t.x, t.y),
+                      sn=norm(t.y), sd=damp(t.y), st=step(t.x),
+                      tg=tag(t.x))
+        t2 = t1.select(emb=embed(t1.sb), tg=t1.tg, sg=t1.sg, sm=t1.sm,
+                       sn=t1.sn, sd=t1.sd, st=t1.st)
+        runner = GraphRunner()
+        cap = runner.capture(t2)
+        # first-tick compiles belong in warmup, not the timed window:
+        # walk the fused programs' bucket ladders (satellite contract —
+        # pw.warmup after building the runner) and prime the embed kernel
+        # at the tick shape
+        warm = pw.warmup(cache=False)
+        embed_kernel(np.zeros(AUTOJIT_TICK, np.int32))
+        rec = FlightRecorder()
+        rec.enabled = True
+        t0 = time.perf_counter()
+        runner.run_batch(n_workers=1, recorder=rec)
+        dt = time.perf_counter() - t0
+        bridge = runner._scheduler.bridge_stats()
+        stages = [
+            {"op": s["name"], "op_class": s["op_class"],
+             "ms": round(s["sum_ms"], 1), "steps": s["count"],
+             "rows_in": s["rows_in"]}
+            for s in sorted(rec.op_stats(), key=lambda s: -s["sum_ms"])]
+        out_rows = [r for _, r, _, d in cap.events if d > 0]
+        G.clear()
+        meta = {
+            "bridge": bridge,
+            "warmup_autojit_compiles": sum(
+                1 for kind, _ in warm["compiled"] if kind == "autojit"),
+            "stats": autojit.autojit_stats(),
+        }
+        return dt, out_rows, meta, {"stages": stages}
+
+    def run_raw() -> tuple[float, list]:
+        t0 = time.perf_counter()
+        out = []
+        for tk in range(n_ticks):
+            lo = tk * AUTOJIT_TICK
+            chunk = rows[lo:lo + AUTOJIT_TICK]
+            xa = np.fromiter((r[0] for r in chunk), np.int64, len(chunk))
+            ya = np.fromiter((r[1] for r in chunk), np.float64, len(chunk))
+            sb = xa * 3 + 7
+            sg = np.minimum(ya, 0.75)
+            sm = xa * 0.0001 + ya * 0.5
+            sn = np.sqrt(ya) + 1.0
+            sd = ya * 0.5 + 0.25
+            st = (xa % 7) + (xa // 3)
+            tg = [f"doc-{int(v) % 97}" for v in xa.tolist()]
+            emb = embed_kernel((sb % _AUTOJIT_VOCAB).astype(np.int32))
+            out.extend(zip(emb.tolist(), tg, sg.tolist(), sm.tolist(),
+                           sn.tolist(), sd.tolist(), st.tolist()))
+        dt = time.perf_counter() - t0
+        return dt, out
+
+    prev = os.environ.get("PATHWAY_AUTO_JIT")
+    try:
+        # wake the jit once outside every timed window
+        embed_kernel(np.zeros(AUTOJIT_TICK, np.int32))
+        # INTERLEAVED best-of-3 (the r05 lesson, round 2): the three modes
+        # run round-robin so a neighbor-load / GC episode on a shared
+        # runner lands on all of them, not on whichever phase it straddles
+        # — phase-sequential trials measured ratio swings of ±0.3 on this
+        # container with an unchanged binary
+        raw_best = on_best = off_best = None
+        for _ in range(3):
+            trial = run_raw()
+            if raw_best is None or trial[0] < raw_best[0]:
+                raw_best = trial
+            os.environ["PATHWAY_AUTO_JIT"] = "1"
+            trial = run_framework()
+            if on_best is None or trial[0] < on_best[0]:
+                on_best = trial
+            os.environ["PATHWAY_AUTO_JIT"] = "0"
+            trial = run_framework()
+            if off_best is None or trial[0] < off_best[0]:
+                off_best = trial
+            if prev is None:
+                os.environ.pop("PATHWAY_AUTO_JIT", None)
+            else:
+                os.environ["PATHWAY_AUTO_JIT"] = prev
+        raw_dt, raw_out = raw_best
+        on_dt, on_rows, on_meta, on_stages = on_best
+        off_dt, off_rows, off_meta, off_stages = off_best
+    finally:
+        if prev is None:
+            os.environ.pop("PATHWAY_AUTO_JIT", None)
+        else:
+            os.environ["PATHWAY_AUTO_JIT"] = prev
+
+    # byte-identity across all three paths is part of the leg's contract:
+    # a fast-but-wrong fused tier must fail the bench, not ship a number
+    # (sorted: the source's consolidation pass may reorder within a tick)
+    assert sorted(on_rows) == sorted(off_rows), \
+        "auto-jit changed the framework output"
+    assert sorted(on_rows) == sorted(raw_out), \
+        "framework output diverged from the raw comparator"
+
+    on_stats = on_meta["stats"]
+    out = {
+        "autojit_n_docs": n_docs,
+        "autojit_raw_docs_per_s": round(n_docs / raw_dt, 1),
+        "autojit_framework_docs_per_s": round(n_docs / on_dt, 1),
+        "autojit_framework_docs_per_s_nojit": round(n_docs / off_dt, 1),
+        "framework_vs_raw_ratio": round(raw_dt / on_dt, 3),
+        "framework_vs_raw_ratio_nojit": round(raw_dt / off_dt, 3),
+        "autojit_programs": on_stats["programs"],
+        "autojit_compiles": on_stats["compiles"],
+        "autojit_demotions": on_stats["demotions"],
+        "autojit_bucket_count": on_stats["bucket_count"],
+        "autojit_device_dispatches": on_stats["device_dispatches"],
+        "autojit_vector_dispatches": on_stats["vector_dispatches"],
+        "autojit_fallback_batches": on_stats["fallback_batches"],
+        "autojit_warmup_compiles": on_meta["warmup_autojit_compiles"],
+        "autojit_bridge_overlap_ratio": round(
+            on_meta["bridge"]["overlap_ratio"], 3)
+        if on_meta["bridge"] else None,
+        "autojit_stage_breakdown": {
+            "on": on_stages["stages"][:8], "off": off_stages["stages"][:8]},
+    }
+    trace_path = os.environ.get("BENCH_AUTOJIT_TRACE_ARTIFACT")
+    if trace_path:
+        from pathway_tpu.engine.flight_recorder import atomic_write_json
+
+        atomic_write_json(trace_path, {
+            "leg": "autojit", "n_docs": n_docs,
+            "summary": {k: v for k, v in out.items()
+                        if k != "autojit_stage_breakdown"},
+            "per_stage_ms": {"on": on_stages["stages"],
+                             "off": off_stages["stages"]},
+        })
+    return out
 
 
 def bench_scaleout() -> dict:

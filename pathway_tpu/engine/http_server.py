@@ -99,6 +99,15 @@ class MonitoringHttpServer:
             # stage (README "Serving SLO")
             payload["serving"] = tracker.summary()
             payload["slow_queries"] = tracker.slow_queries()
+        try:
+            # auto-jit tier state (internals/autojit.py): enabled flag,
+            # fused-program count, backend mix (xla/numpy/interp after
+            # demotions), compile/dispatch/demotion counters
+            from pathway_tpu.internals.autojit import autojit_stats
+
+            payload["autojit"] = autojit_stats()
+        except Exception:
+            pass
         paged = _paged_stats()
         if paged is not None:
             # paged vector store (engine/paged_store.py): page table
@@ -355,6 +364,39 @@ class MonitoringHttpServer:
             lines.append("# TYPE pathway_tpu_device_exec_ms_total counter")
             lines.append(
                 f"pathway_tpu_device_exec_ms_total {bridge['exec_ms']}")
+        try:
+            from pathway_tpu.internals.autojit import autojit_stats
+
+            ajs = autojit_stats()
+        except Exception:
+            ajs = None
+        if ajs is not None:
+            # auto-jit tier (internals/autojit.py): fused traceable-UDF
+            # programs, XLA bucket compiles, loud-once demotions and the
+            # per-backend dispatch counters — the evidence surface for
+            # "the Table-path tax went into fused dispatches"
+            lines.append("# TYPE pathway_tpu_autojit_enabled gauge")
+            lines.append("pathway_tpu_autojit_enabled "
+                         f"{1 if ajs['enabled'] else 0}")
+            lines.append("# TYPE pathway_tpu_autojit_programs gauge")
+            lines.append(f"pathway_tpu_autojit_programs {ajs['programs']}")
+            lines.append("# TYPE pathway_tpu_autojit_compiles counter")
+            lines.append(f"pathway_tpu_autojit_compiles {ajs['compiles']}")
+            lines.append("# TYPE pathway_tpu_autojit_demotions counter")
+            lines.append(
+                f"pathway_tpu_autojit_demotions {ajs['demotions']}")
+            lines.append(
+                "# TYPE pathway_tpu_autojit_device_dispatches counter")
+            lines.append(f"pathway_tpu_autojit_device_dispatches "
+                         f"{ajs['device_dispatches']}")
+            lines.append(
+                "# TYPE pathway_tpu_autojit_vector_dispatches counter")
+            lines.append(f"pathway_tpu_autojit_vector_dispatches "
+                         f"{ajs['vector_dispatches']}")
+            lines.append(
+                "# TYPE pathway_tpu_autojit_fallback_batches counter")
+            lines.append(f"pathway_tpu_autojit_fallback_batches "
+                         f"{ajs['fallback_batches']}")
         persistence = getattr(self.runtime, "persistence", None)
         if persistence is not None:
             # commit-watermark durability (engine/persistence.py): lag

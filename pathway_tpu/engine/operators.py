@@ -178,6 +178,49 @@ class MapOperator(Operator):
         ])
 
 
+class ZipAlignedOperator(Operator):
+    """Stateless zip of two 1:1 projections of the SAME upstream delta.
+
+    Built by the lowering's auto-jit host/device map split
+    (internals/runner.py): both inputs are MapOperators over one input
+    node, so each tick they emit the same keys with the same diffs in the
+    same order — the recombination needs no arrangements, just a
+    positional merge per the column spec ((side, pos), ...) with side 0 =
+    left row, 1 = right row. Alignment is asserted, not assumed: a key or
+    diff mismatch means an engine invariant broke, and wrong-but-plausible
+    output would be strictly worse than a crash."""
+
+    arity = 2
+
+    def __init__(self, spec: tuple):
+        self.spec = tuple(spec)
+        # the merge runs per row on the hot path: compile it once to a
+        # C-level tuple build instead of interpreting the spec per cell
+        cells = ", ".join(f"{'l' if side == 0 else 'r'}[{pos}]"
+                          for side, pos in self.spec)
+        self._combine = eval(  # noqa: S307 — generated from the int spec
+            f"lambda l, r: ({cells}{',' if self.spec else ''})")
+
+    def step(self, time, in_deltas):
+        dl, dr = in_deltas
+        if not dl and not dr:
+            return Delta()
+        if len(dl.entries) != len(dr.entries):
+            raise RuntimeError(
+                "auto-jit map split lost alignment: "
+                f"{len(dl.entries)} host rows vs {len(dr.entries)} device "
+                "rows in one tick")
+        combine = self._combine
+        out = []
+        for (lk, lrow, ld), (rk, rrow, rd) in zip(dl.entries, dr.entries):
+            if lk != rk or ld != rd:
+                raise RuntimeError(
+                    "auto-jit map split lost alignment: "
+                    f"({lk!r}, {ld}) vs ({rk!r}, {rd})")
+            out.append((lk, combine(lrow, rrow), ld))
+        return Delta(out)
+
+
 def _stable_row_fp(row: tuple) -> int:
     """Cross-process-stable row digest (hash_values: fixed blake2b salt)
     for cache keys that must survive a snapshot restore into a NEW

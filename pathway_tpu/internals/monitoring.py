@@ -141,6 +141,24 @@ class StatsMonitor:
                 f"overlap {bridge['overlap_ratio']:.0%}  "
                 f"queue-wait {bridge['queue_wait_ms']:.0f}ms  "
                 f"exec {bridge['exec_ms']:.0f}ms")
+        # fused-program dispatches (internals/autojit.py): the pipelining
+        # panel shows whether the auto-jit tier is carrying batches and
+        # on which backend — a demotion is visible here live
+        try:
+            from pathway_tpu.internals.autojit import autojit_stats
+
+            ajs = autojit_stats()
+        except Exception:
+            ajs = None
+        if ajs is not None and ajs["programs"]:
+            line = (
+                f"auto-jit: {ajs['programs']} fused program(s)  "
+                f"xla {ajs['device_dispatches']} / "
+                f"vector {ajs['vector_dispatches']} dispatches  "
+                f"compiles {ajs['compiles']}  "
+                f"demotions {ajs['demotions']}")
+            self._bridge_line = (f"{self._bridge_line}\n{line}"
+                                 if self._bridge_line else line)
         for node in graph.nodes:
             st = scheduler.stats.get(node.id)
             if not st:

@@ -41,10 +41,12 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
     :class:`StaticCheckError` on error-severity findings, ``"off"`` (the
     default, also settable via ``PATHWAY_STATIC_CHECK``) skips analysis.
     ``PATHWAY_STATIC_CHECK_MESH`` (e.g. ``"4x2"``) arms the mesh-dependent
-    sharding checks (PWT1xx) against that topology; the UDF-traceability
-    classifications the analyzer records on apply expressions
-    (``_shard_class``) are the hook for auto-jitting traceable UDFs here
-    later.
+    sharding checks (PWT1xx) against that topology. The UDF-traceability
+    classifications recorded on apply expressions (``_shard_class``) feed
+    the auto-jit tier (internals/autojit.py): traceable/vmappable sync
+    UDF chains compile into fused vectorized dispatches at graph lowering,
+    byte-identical to the interpreted path, on by default and disabled
+    with ``PATHWAY_AUTO_JIT=0`` (README "Auto-jit").
 
     ``replica_of`` (or ``PATHWAY_REPLICA_OF``) runs this program as a
     snapshot-hydrated READ REPLICA of the primary whose persistence root

@@ -232,9 +232,69 @@ class GraphRunner:
         base = plan.params["base"]
         exprs = plan.params["exprs"]
         node, ctx = self._row_space(base, exprs)
+        split_node = self._lower_map_split(table, exprs, node, ctx)
+        if split_node is not None:
+            return split_node
         program, nondet = compile_map_program(exprs, ctx)
         return self.graph.add_node(_map_op_for(program, nondet), [node],
                                    f"map:{table._name}")
+
+    def _lower_map_split(self, table: Table, exprs, node, ctx) -> Node | None:
+        """WindVE-style host/device split (internals/autojit.py): a select
+        that carries BOTH auto-jit-fusable UDF chains and host-only UDFs
+        lowers into two map operators over the same input — the fused part
+        marked device_bound so it rides the pipelined bridge leg, the
+        host-only part stepped on the host thread *while* a previous
+        tick's device leg is still in flight — recombined by a stateless
+        aligned zip. One operator (today's behavior) would serialize the
+        host-only UDF time before the device dispatch every tick."""
+        try:
+            from pathway_tpu.internals.autojit import split_map_exprs
+
+            split = split_map_exprs(exprs)
+        except Exception:
+            split = None
+        if split is None:
+            return None
+        dev_idx, host_idx = split
+        dev_program, dev_nd = compile_map_program(
+            [exprs[i] for i in dev_idx], ctx)
+        host_program, host_nd = compile_map_program(
+            [exprs[i] for i in host_idx], ctx)
+
+        def bail():
+            # the full-program compile below builds its own FusedProgram
+            # for these exprs — back the split's out of the registry and
+            # counter, or /metrics reports phantom programs
+            from pathway_tpu.internals.autojit import discard_programs
+
+            discard_programs(dev_program.autojit)
+            discard_programs(host_program.autojit)
+            return None
+
+        if dev_program.autojit is None or dev_nd or host_nd:
+            # fusion did not engage after all, or a side needs the
+            # caching DeterministicMapOperator (which reorders entries —
+            # the aligned zip requires order preservation): single node
+            return bail()
+        if getattr(host_program, "device_bound", False):
+            # the "host" side carries a device=True batch UDF: both maps
+            # would ride the device leg, making the split pure overhead
+            return bail()
+        spec = [None] * len(exprs)
+        for j, i in enumerate(host_idx):
+            spec[i] = (0, j)
+        for j, i in enumerate(dev_idx):
+            spec[i] = (1, j)
+        host_node = self.graph.add_node(
+            eng.MapOperator(host_program), [node],
+            f"map_host:{table._name}")
+        dev_node = self.graph.add_node(
+            _map_op_for(dev_program, dev_nd), [node],
+            f"map_dev:{table._name}")
+        return self.graph.add_node(
+            eng.ZipAlignedOperator(tuple(spec)), [host_node, dev_node],
+            f"map:{table._name}")
 
     def _lower_filter(self, table: Table, plan: Plan) -> Node:
         base = plan.params["base"]

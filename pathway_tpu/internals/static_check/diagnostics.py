@@ -81,8 +81,9 @@ CODES: dict[str, tuple[Severity, str]] = {
     "PWT109": (Severity.WARNING,
                "host-only UDF on a streaming hot path"),
     "PWT110": (Severity.INFO,
-               "jit-traceable UDF dispatched row-by-row on the host "
-               "(auto-jit / batch=True candidate)"),
+               "jit-traceable UDF dispatched row-by-row: auto-jitted at "
+               "runtime when PATHWAY_AUTO_JIT=1, else a batch=True "
+               "candidate"),
     "PWT111": (Severity.WARNING,
                "paged store reservation/tenant quota not page-aligned, or "
                "tenant quotas sum past device HBM"),

@@ -25,10 +25,12 @@ Three check layers, mirroring the runtime stack:
 3. **UDF traceability** — an AST (bytecode fallback) classifier tags every
    sync ``pw.udf`` as jit-traceable / vmappable / host-only. Host-only UDFs
    on a streaming hot path flag PWT109; traceable ones dispatched row-by-row
-   flag PWT110 (auto-jit / ``batch=True`` candidates). The classification is
-   recorded on the expression (``expr._shard_class``) and in
-   ``Analyzer.udf_classifications`` so ``run.py`` can later auto-jit the
-   traceable class.
+   flag PWT110. The classification is recorded on the expression
+   (``expr._shard_class``) and in ``Analyzer.udf_classifications``; the
+   auto-jit tier (internals/autojit.py) consumes it at compile time to fuse
+   the traceable/vmappable classes into vectorized device dispatches, so
+   with auto-jit enabled PWT110 is informational ("will be auto-jitted")
+   rather than a manual-rewrite prompt.
 
 Everything here is metadata-only: no device is touched, jax is never
 imported — a hypothetical topology can be analyzed on a laptop that owns
@@ -797,6 +799,10 @@ class ShardChecker:
             # batch=True already amortizes dispatch to one call per engine
             # batch — exactly the fix PWT109/PWT110 would suggest
             return
+        from pathway_tpu.internals.autojit import autojit_enabled
+        from pathway_tpu.internals.autojit import \
+            body_fusable as _autojit_body_fusable
+
         if cls.sync_points and cls.kind != "host":
             self.a._report(
                 "PWT105",
@@ -810,18 +816,45 @@ class ShardChecker:
             detail = "; ".join(cls.reasons[:3]) or "unclassifiable"
             sync = (f" (also: {'; '.join(cls.sync_points)})"
                     if cls.sync_points else "")
+            overlap = (
+                " (with auto-jit on, host-only work in a select that also "
+                "carries traceable UDFs is split out and overlapped with "
+                "the device leg instead of serializing before it)"
+                if autojit_enabled() else "")
             self.a._report(
                 "PWT109",
                 f"host-only UDF {fn_name!r} sits on a streaming hot path: "
                 f"{detail}{sync} — each batch round-trips device→host→"
                 f"device — fix: rewrite with jnp/np primitives, or batch "
-                f"the work (pw.udf(batch=True)) to amortize the dispatch",
+                f"the work (pw.udf(batch=True)) to amortize the dispatch"
+                f"{overlap}",
+                node, expr=expr)
+        elif autojit_enabled() and _autojit_body_fusable(expr._fn):
+            # informational: the runtime is expected to fuse this UDF
+            # automatically (internals/autojit.py) — suggesting a manual
+            # batch=True rewrite would send the user to do the compiler's
+            # job. The body passed the tier's static hazard screen; the
+            # compiler still applies dtype/int-overflow gates, hence
+            # "expected", never "guaranteed".
+            self.a._report(
+                "PWT110",
+                f"UDF {fn_name!r} is {cls.kind} and is expected to be "
+                f"auto-jitted into a fused vectorized dispatch at runtime "
+                f"(PATHWAY_AUTO_JIT=1; byte-identical to the interpreted "
+                f"path, demotes loudly if untraceable on real data) — no "
+                f"change needed; pw.udf(batch=True) remains the manual "
+                f"override, PATHWAY_AUTO_JIT=0 the escape hatch",
                 node, expr=expr)
         else:
+            # auto-jit off, or the body carries a hazard the fused tier
+            # refuses (truthiness, inexact math.*, pow) — the manual
+            # batch=True rewrite is the actionable advice
             self.a._report(
                 "PWT110",
                 f"UDF {fn_name!r} is {cls.kind} but dispatched row-by-row "
                 f"on the host — eligible for vectorized TPU dispatch — "
-                f"fix: pw.udf(batch=True) (columns in, column out) or let "
-                f"a future run.py auto-jit it",
+                f"fix: pw.udf(batch=True) (columns in, column out)"
+                + ("" if autojit_enabled() else
+                   ", or re-enable auto-jit (PATHWAY_AUTO_JIT=1) to fuse "
+                   "it automatically"),
                 node, expr=expr)

@@ -313,7 +313,9 @@ class UDF:
         if self.return_type is not None:
             return self.return_type
         try:
-            hints = inspect.get_type_hints(fn)
+            import typing
+
+            hints = typing.get_type_hints(fn)
         except Exception:
             return dt.ANY
         ret = hints.get("return")

@@ -75,7 +75,7 @@ def maybe_enable_compilation_cache() -> str | None:
 
 def warmup(embedder: Any = None, *, index: Any = None,
            batch_size: int | None = None, ks: tuple[int, ...] = (),
-           cache: bool = True) -> dict:
+           cache: bool = True, autojit_max_bucket: int | None = None) -> dict:
     """Pre-compile the serving-path kernels so no XLA compile lands inside
     a live tick.
 
@@ -98,13 +98,26 @@ def warmup(embedder: Any = None, *, index: Any = None,
     ``cache=True`` wires the persistent compilation cache first, so warmed
     executables persist across processes on this machine.
 
+    Auto-jit (internals/autojit.py): every fused UDF program registered by
+    the expression compiler has its power-of-two batch-bucket ladder
+    walked (8 up to ``autojit_max_bucket``, default
+    ``PATHWAY_AUTO_JIT_WARM_MAX`` or 2048) so the XLA bucket compiles
+    happen here instead of inside the first serving ticks. Programs only
+    register at graph lowering, so call this AFTER building the runner
+    (bench.py's framework leg is the canonical ordering). No-op with
+    ``PATHWAY_AUTO_JIT=0``.
+
     Returns ``{"cache_dir", "compiled", "seconds"}`` where ``compiled``
-    lists the (kind, shape) pairs that were walked.
+    lists the (kind, shape) pairs that were walked — auto-jit entries as
+    ``("autojit", (program_label, bucket))``.
     """
     t0 = _time.perf_counter()
     out: dict = {"cache_dir": None, "compiled": []}
     if cache:
         out["cache_dir"] = enable_compilation_cache()
+    from pathway_tpu.internals.autojit import warm_registered
+
+    out["compiled"].extend(warm_registered(autojit_max_bucket))
     if embedder is None and index is None:
         out["seconds"] = round(_time.perf_counter() - t0, 3)
         return out

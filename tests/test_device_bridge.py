@@ -334,15 +334,25 @@ def test_watchdog_tick_with_device_leg_in_flight(monkeypatch):
     assert state == {float(i): float(i) * 2.0 for i in range(12)}
 
 
-def test_crash_replay_exactly_once_with_device_leg(monkeypatch):
+@pytest.mark.parametrize("autojit", ["0", "1"])
+def test_crash_replay_exactly_once_with_device_leg(monkeypatch, autojit):
     """The fault-tolerance contract with a device leg in the pipeline:
     a crash mid-stream, a backoff restart and a fresh-process replay all
     produce the baseline's exact state (persistence checkpoints sit
-    behind the resolve barrier)."""
+    behind the resolve barrier). Parametrized over PATHWAY_AUTO_JIT: with
+    the tier ON the traceable scoring UDF fuses and its map joins the
+    device leg (internals/autojit.py), so the crash points also cover an
+    auto-jitted dispatch in flight."""
+    from pathway_tpu.internals import autojit as autojit_mod
     from pathway_tpu.internals.retries import FixedDelayRetryStrategy
     from pathway_tpu.testing.faults import flaky_subject
 
     monkeypatch.setenv("PATHWAY_DEVICE_INFLIGHT", "2")
+    monkeypatch.setenv("PATHWAY_AUTO_JIT", autojit)
+    # ticks are tiny here: drop the dispatch floor so the fused program
+    # actually executes under the crash points
+    monkeypatch.setattr(autojit_mod, "MIN_ROWS", 1)
+    autojit_mod.reset_stats()
     words = ["a", "b", "a", "c", "b", "a"]
 
     @pw.udf(batch=True, device=True, deterministic=True, return_type=int)
@@ -352,6 +362,10 @@ def test_crash_replay_exactly_once_with_device_leg(monkeypatch):
         arr = jnp.asarray(np.asarray([len(w) for w in ws], np.int32))
         return [int(v) for v in np.asarray(arr + 1)]
 
+    @pw.udf
+    def score(wl: int) -> int:
+        return wl * 5 + 1
+
     def run_counts(subject, backend=None, policy=None):
         G.clear()
         t = pw.io.python.read(
@@ -359,6 +373,7 @@ def test_crash_replay_exactly_once_with_device_leg(monkeypatch):
             autocommit_duration_ms=10, persistent_id="devwords",
             connector_policy=policy)
         t = t.select(word=t.word, wl=dev_len(t.word))
+        t = t.select(word=t.word, wl=score(t.wl))
         counts = t.groupby(t.word).reduce(
             word=t.word, c=pw.reducers.count(), wl=pw.reducers.max(t.wl))
         state = {}
@@ -378,7 +393,7 @@ def test_crash_replay_exactly_once_with_device_leg(monkeypatch):
 
     rows = [{"word": w} for w in words]
     baseline = run_counts(flaky_subject(rows, fail_after=0, fail_attempts=0))
-    assert baseline == {"a": (3, 2), "b": (2, 2), "c": (1, 2)}
+    assert baseline == {"a": (3, 11), "b": (2, 11), "c": (1, 11)}
 
     backend = pw.persistence.Backend.mock()
     policy = pw.ConnectorPolicy(
@@ -390,6 +405,13 @@ def test_crash_replay_exactly_once_with_device_leg(monkeypatch):
     replay = run_counts(flaky_subject(rows, fail_after=0, fail_attempts=0),
                         backend=backend)
     assert replay == baseline
+    if autojit == "1":
+        # non-vacuous: the fused program really dispatched under the
+        # crash/restart/replay sequence
+        stats = autojit_mod.autojit_stats()
+        assert stats["programs"] >= 1
+        assert (stats["device_dispatches"] + stats["vector_dispatches"]) > 0
+        assert stats["demotions"] == 0
 
 
 # ---------------------------------------------------------------------------

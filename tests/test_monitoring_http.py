@@ -537,3 +537,46 @@ def test_router_metrics_through_exposition_lint():
     p50 = seen["pathway_tpu_router_replica_p50_ms"][0][1]
     p95 = seen["pathway_tpu_router_replica_p95_ms"][0][1]
     assert p50 <= p95
+
+
+# ---------------------------------------------------------------------------
+# auto-jit tier exposition (internals/autojit.py): counter families under
+# the same regex lint + TYPE-declaration contract, /status tier state
+# ---------------------------------------------------------------------------
+
+def test_autojit_families_exposed_and_status_tier_state(monkeypatch):
+    from pathway_tpu.internals import autojit
+
+    monkeypatch.setenv("PATHWAY_AUTO_JIT", "1")
+    autojit.reset_stats()
+    autojit._bump("programs")
+    autojit._bump("compiles", 3)
+    autojit._bump("demotions")
+    autojit._bump("device_dispatches", 7)
+    try:
+        lines = _metrics_lines(_FakeRuntime())
+        typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+        seen = {f: v for f, _labels, v in _parse_samples(lines)}
+        for fam, want in (("pathway_tpu_autojit_enabled", 1),
+                          ("pathway_tpu_autojit_programs", 1),
+                          ("pathway_tpu_autojit_compiles", 3),
+                          ("pathway_tpu_autojit_demotions", 1),
+                          ("pathway_tpu_autojit_device_dispatches", 7),
+                          ("pathway_tpu_autojit_vector_dispatches", 0),
+                          ("pathway_tpu_autojit_fallback_batches", 0)):
+            assert fam in typed, fam
+            assert seen[fam] == want, (fam, seen[fam])
+        # /status names the tier state (enabled flag + backend mix)
+        status = MonitoringHttpServer(_FakeRuntime(), port=0).status_payload()
+        assert status["autojit"]["enabled"] is True
+        assert status["autojit"]["programs"] == 1
+        assert "live_programs" in status["autojit"]
+        # the escape hatch is visible on both surfaces
+        monkeypatch.setenv("PATHWAY_AUTO_JIT", "0")
+        lines = _metrics_lines(_FakeRuntime())
+        seen = {f: v for f, _labels, v in _parse_samples(lines)}
+        assert seen["pathway_tpu_autojit_enabled"] == 0
+        status = MonitoringHttpServer(_FakeRuntime(), port=0).status_payload()
+        assert status["autojit"]["enabled"] is False
+    finally:
+        autojit.reset_stats()

@@ -6,6 +6,7 @@ classifier, the iterate integration, and the CLI's ``--tpu-mesh`` /
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -496,6 +497,53 @@ def test_pwt110_negative_batch_udf_or_static_source(tmp_path):
     """)
     assert "PWT110" not in codes(pw.static_check(
         s.select(b=pw.apply(lambda x: x * 2, s.a))))
+
+
+def test_pwt110_wording_tracks_autojit_state(tmp_path, monkeypatch):
+    """With auto-jit on (the default) PWT110 is informational — the
+    runtime fuses the UDF, so the message must NOT send the user off to a
+    manual batch=True rewrite; with PATHWAY_AUTO_JIT=0 the manual rewrite
+    is the suggestion again."""
+    monkeypatch.setenv("PATHWAY_AUTO_JIT", "1")
+    t = _streaming_table(tmp_path)
+    _bind(t.select(b=pw.apply(lambda x: x * 2 + 1, t.a)))
+    d, = [d for d in pw.static_check() if d.code == "PWT110"]
+    assert "auto-jitted" in d.message
+    assert "no change needed" in d.message
+    G.clear()
+    monkeypatch.setenv("PATHWAY_AUTO_JIT", "0")
+    t = _streaming_table(tmp_path)
+    _bind(t.select(b=pw.apply(lambda x: x * 2 + 1, t.a)))
+    d, = [d for d in pw.static_check() if d.code == "PWT110"]
+    assert "auto-jitted" not in d.message
+    assert "fix: pw.udf(batch=True)" in d.message
+    G.clear()
+    # a body the fused tier will refuse (math.exp has no IEEE-exact
+    # vector counterpart) must keep the actionable manual advice even
+    # with auto-jit on — "will be auto-jitted" would be an overclaim
+    monkeypatch.setenv("PATHWAY_AUTO_JIT", "1")
+    t = _streaming_table(tmp_path)
+    _bind(t.select(b=pw.apply(lambda y: math.exp(y), t.a)))
+    d, = [d for d in pw.static_check() if d.code == "PWT110"]
+    assert "auto-jitted" not in d.message
+    assert "fix: pw.udf(batch=True)" in d.message
+
+
+def test_pwt109_wording_gains_overlap_caveat(tmp_path, monkeypatch):
+    """Host-only-on-hot-path keeps its warning either way, but with
+    auto-jit on it names the WindVE-style host/device overlap the split
+    lowering provides."""
+    monkeypatch.setenv("PATHWAY_AUTO_JIT", "1")
+    t = _streaming_table(tmp_path)
+    _bind(t.select(b=pw.apply(_hosty, t.a)))
+    d, = [d for d in pw.static_check() if d.code == "PWT109"]
+    assert "overlapped with the device leg" in d.message
+    G.clear()
+    monkeypatch.setenv("PATHWAY_AUTO_JIT", "0")
+    t = _streaming_table(tmp_path)
+    _bind(t.select(b=pw.apply(_hosty, t.a)))
+    d, = [d for d in pw.static_check() if d.code == "PWT109"]
+    assert "overlapped" not in d.message
 
 
 # ---------------------------------------------------------------------------
