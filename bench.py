@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -110,6 +111,69 @@ def _write_lastgood(snapshot: dict) -> None:
                                  "result": dict(_LASTGOOD_STATE)})
     except Exception:  # noqa: BLE001 — evidence must never kill a leg
         pass
+
+
+# -- perf-trajectory watch ----------------------------------------------------
+# BENCH_LASTGOOD.json is a last-good SNAPSHOT; the trajectory lives in
+# BENCH_HISTORY.jsonl (one row per leg metric per run: leg, metric, value,
+# git sha, timestamp — engine/fleet_observability.py). Every leg appends
+# its rows, and `bench.py --check-regression` compares each series'
+# newest point against the trailing median of its prior points with
+# per-metric tolerance bands — a CI-checkable time series instead of an
+# empty trajectory (ROADMAP evidence rule).
+
+def _append_bench_history(leg: str, metrics: dict) -> None:
+    try:
+        from pathway_tpu.engine.fleet_observability import \
+            append_bench_history
+
+        append_bench_history(leg, metrics)
+    except Exception:  # noqa: BLE001 — evidence must never kill a leg
+        pass
+
+
+def check_regression_main(argv: list[str]) -> int:
+    """``bench.py --check-regression``: gate the newest BENCH_HISTORY
+    point of every watched series against its trailing median. Exit 0
+    when the trajectory holds (or is too young to judge), 1 naming each
+    regression otherwise. Knobs: ``--history PATH``
+    (BENCH_HISTORY_PATH), ``--window N``, ``--min-prior N``,
+    ``--tolerance F`` (BENCH_REGRESSION_TOLERANCE, default 0.35)."""
+    from pathway_tpu.engine.fleet_observability import (
+        bench_history_rows, check_regressions, history_path)
+
+    opts = {"--history": None, "--window": "8", "--min-prior": "3",
+            "--tolerance": None}
+    i = 0
+    while i < len(argv):
+        if argv[i] in opts and i + 1 < len(argv):
+            opts[argv[i]] = argv[i + 1]
+            i += 2
+        else:
+            i += 1
+    path = history_path(opts["--history"])
+    rows = bench_history_rows(path)
+    if not rows:
+        print(json.dumps({"check": "regression", "history": path,
+                          "rows": 0, "regressions": [],
+                          "note": "no trajectory yet"}), flush=True)
+        return 0
+    regs = check_regressions(
+        path, window=int(opts["--window"]),
+        min_prior=int(opts["--min-prior"]),
+        tolerance=(float(opts["--tolerance"])
+                   if opts["--tolerance"] is not None else None))
+    series = {(r.get("leg"), r["metric"]) for r in rows}
+    print(json.dumps({"check": "regression", "history": path,
+                      "rows": len(rows), "series": len(series),
+                      "regressions": regs}), flush=True)
+    for r in regs:
+        direction = ">" if r["direction"] == "lower" else "<"
+        print(f"REGRESSION {r['leg']}/{r['metric']}: {r['value']} "
+              f"{direction} trailing median {r['median']} beyond the "
+              f"{r['tolerance']:.0%} band (ratio {r['ratio']}, "
+              f"{r['n_prior']} prior points)", file=sys.stderr)
+    return 1 if regs else 0
 
 
 # -- flight beacon -----------------------------------------------------------
@@ -397,10 +461,18 @@ def _run_device_legs() -> dict:
                 result[f"{'_'.join(group)}_{k}"] = v
             else:
                 result[k] = v
+        # trajectory rows for the device phase too: whatever the group
+        # captured before any hang joins the time series (error keys are
+        # non-numeric and filtered by the appender)
+        _append_bench_history("_".join(group), out)
     return result
 
 
 def main() -> None:
+    if "--check-regression" in sys.argv:
+        # perf-trajectory watch: judge BENCH_HISTORY.jsonl instead of
+        # running any leg (engine/fleet_observability.py)
+        sys.exit(check_regression_main(sys.argv[1:]))
     if os.environ.get("_BENCH_DEVICE_CHILD"):
         _run_device_legs_child()
         return
@@ -418,7 +490,9 @@ def main() -> None:
     # take give a flaky device tunnel time to recover before the probe
     if "etl" not in SKIP:
         try:
-            result.update(bench_etl())
+            leg_out = bench_etl()
+            result.update(leg_out)
+            _append_bench_history("etl", leg_out)
         except Exception as e:  # noqa: BLE001
             errors["etl_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
@@ -427,7 +501,9 @@ def main() -> None:
         # pipeline, auto-jit on/off in the same artifact + the per-stage
         # flight-recorder breakdown (where the Table-path tax went)
         try:
-            result.update(bench_autojit())
+            leg_out = bench_autojit()
+            result.update(leg_out)
+            _append_bench_history("autojit", leg_out)
             _write_lastgood({k: v for k, v in result.items()
                              if k.startswith(("autojit_", "framework_vs_"))})
         except Exception as e:  # noqa: BLE001
@@ -439,7 +515,9 @@ def main() -> None:
         # tcp), etl_scaleout_efficiency under the cores-vs-workers
         # honesty rule, byte-identity, per-transport encdec cost
         try:
-            result.update(bench_scaleout())
+            leg_out = bench_scaleout()
+            result.update(leg_out)
+            _append_bench_history("scaleout", leg_out)
         except Exception as e:  # noqa: BLE001
             errors["scaleout_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
@@ -447,7 +525,9 @@ def main() -> None:
         # paged-store leg (CPU-runnable): ingest stall across online
         # growth paged-vs-slab + ragged warmup compile count
         try:
-            result.update(bench_paging())
+            leg_out = bench_paging()
+            result.update(leg_out)
+            _append_bench_history("paging", leg_out)
         except Exception as e:  # noqa: BLE001
             errors["paging_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
@@ -456,7 +536,9 @@ def main() -> None:
         # persistence ON at inflight 1 vs 4 + checkpoint cadence — the
         # evidence that durability no longer prices pipelining at depth 1
         try:
-            result.update(bench_durability())
+            leg_out = bench_durability()
+            result.update(leg_out)
+            _append_bench_history("durability", leg_out)
         except Exception as e:  # noqa: BLE001
             errors["durability_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
@@ -466,7 +548,9 @@ def main() -> None:
         # (~flat) — the evidence that compaction bounds restart by data
         # size, not stream age
         try:
-            result.update(bench_recovery())
+            leg_out = bench_recovery()
+            result.update(leg_out)
+            _append_bench_history("recovery", leg_out)
         except Exception as e:  # noqa: BLE001
             errors["recovery_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
@@ -476,7 +560,9 @@ def main() -> None:
         # the router at 1 vs 2 replicas, staleness lag exported on
         # /metrics, and the kill-under-load failover count
         try:
-            result.update(bench_replica())
+            leg_out = bench_replica()
+            result.update(leg_out)
+            _append_bench_history("replica", leg_out)
         except Exception as e:  # noqa: BLE001
             errors["replica_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
@@ -2036,6 +2122,11 @@ ROOT = os.environ["REPLICA_BENCH_ROOT"]
 N = int(os.environ.get("REPLICA_BENCH_VECS", "256"))
 COST_MS = float(os.environ.get("REPLICA_BENCH_QUERY_COST_MS", "4"))
 READY = os.environ.get("REPLICA_BENCH_READY_FILE")
+# fleet-observability mode (tests/fleet_trace_canary.py): each process
+# runs its monitoring HTTP server (ephemeral port, announced over the
+# control-channel heartbeat) so the router can scrape /metrics and
+# /trace?format=chrome for the /fleet/* surfaces
+HTTP = os.environ.get("REPLICA_BENCH_HTTP") == "1"
 
 
 class Subject(pw.io.python.ConnectorSubject):
@@ -2103,9 +2194,10 @@ threading.Thread(target=_announce, daemon=True).start()
 
 if ROLE == "primary":
     pw.run(persistence_config=pw.persistence.Config(
-        backend=pw.persistence.Backend.filesystem(ROOT)))
+        backend=pw.persistence.Backend.filesystem(ROOT)),
+        with_http_server=HTTP)
 else:
-    pw.run(replica_of=ROOT)
+    pw.run(replica_of=ROOT, with_http_server=HTTP)
 """
 
 
@@ -2118,10 +2210,17 @@ class _ReplicaFleet:
     the fleet would see."""
 
     def __init__(self, tmp: str, *, vecs: int = 256,
-                 query_cost_ms: float = 25.0):
+                 query_cost_ms: float = 25.0,
+                 observability: bool = False):
         import sys as _sys
 
         self.tmp = tmp
+        # fleet-observability mode (tests/fleet_trace_canary.py): every
+        # member runs its monitoring HTTP server on an ephemeral port
+        # with the flight recorder on, and the PRIMARY also registers
+        # with the router (read-serving last resort) so /fleet/* covers
+        # the whole fleet
+        self.observability = observability
         self.root = os.path.join(tmp, "primary-root")
         self.prog = os.path.join(tmp, "replica_prog.py")
         with open(self.prog, "w") as f:
@@ -2141,6 +2240,11 @@ class _ReplicaFleet:
                   "PATHWAY_REPLICA_ID", "PATHWAY_SNAPSHOT_EVERY_TICKS",
                   "PATHWAY_MONITORING_HTTP_PORT", "PATHWAY_PROCESSES"):
             self.base_env.pop(k, None)
+        if observability:
+            self.base_env.update(
+                REPLICA_BENCH_HTTP="1",
+                PATHWAY_MONITORING_HTTP_PORT="0",  # ephemeral, in the hb
+                PATHWAY_FLIGHT_RECORDER="1")
         self.vecs = vecs
         self.router = None
         self.procs: dict[str, object] = {}  # name -> Popen
@@ -2185,6 +2289,12 @@ class _ReplicaFleet:
         env = dict(self.base_env, REPLICA_BENCH_ROLE="primary",
                    REPLICA_BENCH_READY_FILE=ready,
                    PATHWAY_SNAPSHOT_EVERY_TICKS=str(snapshot_ticks))
+        if self.observability and self.router is not None:
+            # the primary registers too (role "primary", routed only as
+            # a last resort) so /fleet/metrics//fleet/trace cover it
+            env.update(PATHWAY_REPLICA_ID="primary",
+                       PATHWAY_ROUTER_CONTROL=(
+                           f"127.0.0.1:{self.router.control_port}"))
         self._spawn("primary", env)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:

@@ -11,6 +11,7 @@ env vars consumed by ``pw.run`` (internals/run.py)."""
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -363,6 +364,75 @@ def _collect_and_check(script, mesh=None):
             if _is_local_helper(name):
                 del sys.modules[name]
         G.clear()
+
+
+@cli.command("trace-merge")
+@click.option("--out", "out_path", type=str, default=None,
+              help="where to write the merged trace "
+                   "(default: <dir>/fleet_trace.json)")
+@click.argument("paths", nargs=-1, required=True)
+def trace_merge(paths, out_path):
+    """Merge per-process Chrome trace files into ONE clock-aligned
+    fleet timeline (engine/fleet_observability.py).
+
+    PATHS are trace JSON files — or directories scanned for ``*.json``
+    files that look like Chrome traces (a ``traceEvents`` list). Each
+    process's ``pathway_meta`` block (written by the flight recorder:
+    pid, role, process label, monotonic↔wall clock anchor) places its
+    events on the shared wall-clock timeline; request ids that appear in
+    several processes get cross-process flow arrows, so a failover
+    renders as an arrow from the router into the rescuing replica's
+    track. The merged file opens directly in Perfetto."""
+    import pathlib
+
+    from pathway_tpu.engine.fleet_observability import merge_traces
+    from pathway_tpu.engine.flight_recorder import atomic_write_json
+
+    files: list[pathlib.Path] = []
+    first_dir: pathlib.Path | None = None
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            if first_dir is None:
+                first_dir = path
+            files.extend(sorted(path.glob("*.json")))
+        elif path.is_file():
+            files.append(path)
+        else:
+            raise click.UsageError(f"no such file or directory: {p}")
+    if out_path is None:
+        out_path = str((first_dir or pathlib.Path("."))
+                       / "fleet_trace.json")
+    payloads = []
+    for f in files:
+        if os.path.abspath(str(f)) == os.path.abspath(out_path):
+            continue  # re-running over a dir must not merge its own output
+        try:
+            with open(f) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(data, dict) and isinstance(
+                data.get("traceEvents"), list):
+            payloads.append(data)
+        else:
+            click.echo(f"[skip] {f} — not a Chrome trace payload",
+                       err=True)
+    if not payloads:
+        raise click.UsageError(
+            "no Chrome trace payloads found under the given paths "
+            "(run with PATHWAY_TRACE_PATH set on each process, or point "
+            "at the router's /fleet/trace output)")
+    merged = merge_traces(payloads)
+    atomic_write_json(out_path, merged)
+    fleet = merged["pathway_fleet"]
+    click.echo(
+        f"merged {len(payloads)} process trace(s) -> {out_path}: "
+        f"{len(merged['traceEvents'])} events, "
+        f"{len(fleet['cross_process_request_ids'])} request id(s) "
+        f"spanning processes "
+        f"({', '.join(p['role'] + ':' + p['process'] for p in fleet['processes'])})",
+        err=True)
 
 
 @cli.command()

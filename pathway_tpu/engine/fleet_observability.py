@@ -1,0 +1,661 @@
+"""Fleet-wide observability plane: cross-process request tracing,
+aggregated metrics, and the perf-trajectory regression watch.
+
+PRs 11–13 turned one process into a fleet (SPMD workers,
+snapshot-hydrated replicas, a latency-aware router), but every
+observability surface so far was strictly per-process: a query that
+entered the router and failed over across two replicas produced three
+disjoint traces under three unrelated request ids, and there was no
+single scrape point for the fleet. This module is the glue that makes
+the fleet observable AS a fleet:
+
+**Request-id propagation.** One id names a query end to end. The
+webserver (io/http) adopts an inbound ``X-Pathway-Request-Id`` instead
+of minting a fresh one; the router forwards the id (plus an
+``X-Pathway-Hop`` counter) on every proxied attempt *including failover
+replays*, and echoes it on every response *including 503s*. The
+router's own per-request record carries the :data:`ROUTER_STAGES`
+(``route``/``forward``/``failover``) — the fleet-side prefix of the
+PR-6 per-process stage decomposition.
+
+**Clock-aligned trace merge.** Each process's flight recorder stamps
+its Chrome-trace payload with ``pathway_meta`` — os pid, role
+(primary/replica/router), process label, and a monotonic↔wall clock
+anchor (``epoch_wall_us``: the wall-clock microsecond that perf-counter
+zero of the trace timeline maps to). The same anchor rides the PR-12
+control-channel heartbeats, so the router can align endpoints it never
+scraped a file from. :func:`merge_traces` shifts every process's events
+onto ONE wall-clock timeline, renames process tracks, and draws
+cross-process flow arrows between the router's request span and the
+serving process's request span that share a request id — a failover
+renders as an arrow from the router into the RESCUING replica's track.
+Consumers: ``python -m pathway_tpu trace-merge <dir>`` (offline, over
+written trace files) and the router's ``/fleet/trace`` (live, over each
+endpoint's ``/trace?format=chrome``).
+
+**Metrics aggregation.** :func:`merge_metrics` takes each process's
+Prometheus exposition text and emits ONE fleet document: every family
+declared with exactly one ``# TYPE`` line (N processes shipping the
+same family must not redeclare it), every sample re-labeled with
+``process=``/``role=``, and — where merging is mathematically sound —
+an extra ``process="_fleet"`` aggregate: counters sum, histograms sum
+bucket-wise (cumulative buckets stay monotone under addition). Gauges
+and quantile summaries pass through per-process only: averaging P²
+quantiles is not a quantile of the union, so no fake fleet p50 is
+invented. Served by the router as ``/fleet/metrics``.
+
+**Perf-trajectory watch.** Every bench leg appends rows to
+``BENCH_HISTORY.jsonl`` (one JSON object per line: leg, metric, value,
+git sha, timestamp) and ``bench.py --check-regression`` compares each
+series' newest point against the trailing median of its prior points
+with per-metric tolerance bands — the ROADMAP's evidence rule gets a
+*trajectory*, not just a last-good snapshot.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import re
+import statistics
+import time
+
+logger = logging.getLogger(__name__)
+
+# the cross-process propagation headers (README "Observability > Fleet")
+REQUEST_ID_HEADER = "X-Pathway-Request-Id"
+HOP_HEADER = "X-Pathway-Hop"
+
+# router-side request stages — the fleet prefix of the per-process
+# STAGES, defined next to them in engine/request_tracker.py: `route`
+# (endpoint choice), `forward` (the first proxy attempt), `failover`
+# (each replay on the next-best replica after a connection failure)
+from pathway_tpu.engine.request_tracker import ROUTER_STAGES  # noqa: E402
+
+_HISTORY_DEFAULT = "BENCH_HISTORY.jsonl"
+
+
+def clock_anchor() -> dict:
+    """A monotonic↔wall mapping taken NOW: ``wall - perf`` is the
+    wall-clock second that perf-counter zero maps to in this process.
+    Shipped in heartbeats so the router can align an endpoint's
+    monotonic trace timestamps without scraping its trace payload."""
+    return {"perf": time.perf_counter(), "wall": time.time()}
+
+
+def anchor_epoch_wall_us(anchor: dict, epoch_perf: float) -> float:
+    """Wall-clock microseconds of a perf-counter ``epoch_perf`` under
+    ``anchor`` (a :func:`clock_anchor` dict)."""
+    return (anchor["wall"] - anchor["perf"] + epoch_perf) * 1e6
+
+
+# ---------------------------------------------------------------------------
+# router-side request spans
+# ---------------------------------------------------------------------------
+
+class RouterSpan:
+    """One query's router-side record: the request id it carried (or was
+    assigned), per-stage perf_counter stamps, and the per-attempt
+    forward/failover outcomes. The router mutates it inline during
+    ``forward()``; ``RouterRequestLog.finish`` freezes it into the
+    bounded completed ring."""
+
+    __slots__ = ("rid", "path", "t0", "t_routed", "attempts", "status",
+                 "replica", "t_done")
+
+    def __init__(self, rid: str, path: str, t0: float):
+        self.rid = rid
+        self.path = path
+        self.t0 = t0
+        self.t_routed: float | None = None
+        # (stage, replica_id, t_start, t_end, ok) — stage is "forward"
+        # for the first attempt, "failover" for each replay
+        self.attempts: list[tuple] = []
+        self.status: int | None = None
+        self.replica: str | None = None
+        self.t_done: float | None = None
+
+    def note_routed(self) -> None:
+        if self.t_routed is None:
+            self.t_routed = time.perf_counter()
+
+    def note_attempt(self, replica_id: str, t_start: float,
+                     ok: bool) -> None:
+        stage = "forward" if not self.attempts else "failover"
+        self.attempts.append(
+            (stage, replica_id, t_start, time.perf_counter(), ok))
+
+    def failovers(self) -> int:
+        return sum(1 for a in self.attempts if a[0] == "failover")
+
+
+class RouterRequestLog:
+    """Bounded ring of completed :class:`RouterSpan` records + streaming
+    per-stage aggregates, and the Chrome-trace export that puts the
+    router's view of each query on its own track (merged against the
+    serving processes' request tracks by :func:`merge_traces`)."""
+
+    def __init__(self, maxlen: int = 512):
+        from pathway_tpu.engine.locking import create_lock
+        from pathway_tpu.engine.request_tracker import P2Quantile
+
+        self._lock = create_lock("RouterRequestLog._lock")
+        self.completed: collections.deque = collections.deque(
+            maxlen=max(8, maxlen))
+        self._stage_p50 = {s: P2Quantile(0.5) for s in ROUTER_STAGES}
+        self._stage_sum = {s: 0.0 for s in ROUTER_STAGES}
+        self.epoch = time.perf_counter()
+        self.epoch_wall_us = anchor_epoch_wall_us(clock_anchor(),
+                                                  self.epoch)
+
+    def start(self, rid: str, path: str) -> RouterSpan:
+        return RouterSpan(rid, path, time.perf_counter())
+
+    def finish(self, span: RouterSpan, status: int,
+               replica: str | None) -> None:
+        span.status = status
+        span.replica = replica
+        span.t_done = time.perf_counter()
+        route_ms = ((span.t_routed or span.t0) - span.t0) * 1e3
+        fwd_ms = sum((t1 - t0) * 1e3
+                     for s, _r, t0, t1, _ok in span.attempts
+                     if s == "forward")
+        fo_ms = sum((t1 - t0) * 1e3
+                    for s, _r, t0, t1, _ok in span.attempts
+                    if s == "failover")
+        with self._lock:
+            for stage, ms in (("route", route_ms), ("forward", fwd_ms),
+                              ("failover", fo_ms)):
+                self._stage_sum[stage] += ms
+                self._stage_p50[stage].observe(ms)
+            self.completed.append(span)
+
+    def stage_summary(self) -> dict:
+        with self._lock:
+            return {s: {"p50_ms": self._stage_p50[s].value(),
+                        "sum_ms": round(self._stage_sum[s], 3)}
+                    for s in ROUTER_STAGES}
+
+    def chrome_trace_events(self) -> list[dict]:
+        """The router's request track: one async (b/e) span per query
+        named by its request id, with per-attempt child spans carrying
+        the stage (forward/failover), replica and outcome. ``ts`` is
+        relative to :attr:`epoch` — aligned fleet-wide via
+        ``pathway_meta.epoch_wall_us``."""
+        pid = os.getpid()
+        with self._lock:
+            spans = list(self.completed)
+        if not spans:
+            return []
+        out = [{"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
+                "args": {"name": "router requests"}}]
+        for span in spans:
+            us = lambda t: (t - self.epoch) * 1e6  # noqa: E731
+            fid = f"req-{span.rid}"
+            name = f"req {span.rid}"
+            args = {"request_id": span.rid, "path": span.path,
+                    "status": span.status, "replica": span.replica,
+                    "failovers": span.failovers()}
+            t_end = span.t_done if span.t_done is not None else span.t0
+            out.append({"ph": "b", "cat": "router_request", "id": fid,
+                        "pid": pid, "tid": 0, "ts": us(span.t0),
+                        "name": name, "args": args})
+            for stage, replica, t0, t1, ok in span.attempts:
+                out.append({"ph": "b", "cat": "router_request", "id": fid,
+                            "pid": pid, "tid": 0, "ts": us(t0),
+                            "name": f"{stage} {replica}",
+                            "args": {"stage": stage, "replica": replica,
+                                     "ok": ok}})
+                out.append({"ph": "e", "cat": "router_request", "id": fid,
+                            "pid": pid, "tid": 0, "ts": us(t1),
+                            "name": f"{stage} {replica}"})
+            out.append({"ph": "e", "cat": "router_request", "id": fid,
+                        "pid": pid, "tid": 0, "ts": us(t_end),
+                        "name": name})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# fleet trace merge
+# ---------------------------------------------------------------------------
+
+def merge_traces(payloads) -> dict:
+    """Merge per-process Chrome-trace payloads into ONE clock-aligned
+    timeline (module doc). Each payload is the dict written by
+    ``FlightRecorder.write_chrome_trace`` / served by
+    ``/trace?format=chrome`` — ``traceEvents`` plus a ``pathway_meta``
+    block ``{pid, process, role, epoch_wall_us}``. Payloads without
+    meta merge too (offset 0, anonymous process): a merged-but-
+    misaligned trace beats no trace.
+
+    Events keep their per-process relative order (B/E nesting is
+    per-(pid, tid) and addition preserves order); every process is
+    re-stamped with a unique merged pid and named via ``process_name``
+    metadata; cross-process flow arrows (``s``/``t``/``f``) bind the
+    router's request span to the serving process's request span that
+    shares its request id."""
+    payloads = [p for p in payloads
+                if isinstance(p, dict) and isinstance(
+                    p.get("traceEvents"), list)]
+    if not payloads:
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "pathway_fleet": {"processes": [],
+                                  "cross_process_request_ids": []}}
+    metas = []
+    for i, p in enumerate(payloads):
+        m = p.get("pathway_meta") or {}
+        metas.append({
+            "pid": int(m.get("pid", 0) or 0),
+            "process": str(m.get("process") or f"proc{i}"),
+            "role": str(m.get("role") or "unknown"),
+            "epoch_wall_us": float(m.get("epoch_wall_us", 0.0) or 0.0),
+        })
+    # common origin: the earliest process epoch, so merged timestamps
+    # start near zero instead of at "microseconds since 1970"
+    anchored = [m["epoch_wall_us"] for m in metas if m["epoch_wall_us"]]
+    origin_us = min(anchored) if anchored else 0.0
+
+    events: list[dict] = []
+    # request spans per merged pid: rid -> (begin ts, tid)
+    serving_spans: dict[int, dict[str, tuple[float, int]]] = {}
+    router_spans: dict[int, dict[str, dict]] = {}
+    for mpid, (payload, meta) in enumerate(zip(payloads, metas)):
+        shift_us = (meta["epoch_wall_us"] - origin_us) \
+            if meta["epoch_wall_us"] else 0.0
+        events.append({"ph": "M", "pid": mpid, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": f"{meta['role']}:"
+                                        f"{meta['process']}"}})
+        events.append({"ph": "M", "pid": mpid, "tid": 0,
+                       "name": "process_sort_index",
+                       "args": {"sort_index":
+                                0 if meta["role"] == "router" else
+                                1 if meta["role"] == "primary" else 2}})
+        for ev in payload["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = mpid
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            events.append(ev)
+            rid = (ev.get("args") or {}).get("request_id")
+            if rid and ev.get("ph") == "b":
+                if ev.get("cat") == "router_request":
+                    router_spans.setdefault(mpid, {}).setdefault(
+                        rid, {"ts": ev["ts"], "tid": ev.get("tid", 0)})
+                elif ev.get("cat") == "request":
+                    serving_spans.setdefault(mpid, {}).setdefault(
+                        rid, (ev["ts"], ev.get("tid", 2)))
+    # cross-process flows: router request span -> every serving
+    # process's span with the same id (normally exactly one — the
+    # process that actually answered; after a failover that is the
+    # RESCUING replica, so the arrow lands where the query did)
+    cross_rids: set[str] = set()
+    for rpid, by_rid in router_spans.items():
+        for rid, src in by_rid.items():
+            targets = [(spid, pos) for spid, spans in
+                       serving_spans.items() for r, pos in spans.items()
+                       if r == rid]
+            if not targets:
+                continue
+            cross_rids.add(rid)
+            fid = f"xreq-{rid}"
+            events.append({"ph": "s", "cat": "fleet", "id": fid,
+                           "pid": rpid, "tid": src["tid"],
+                           "ts": src["ts"], "name": "request"})
+            for k, (spid, (ts, tid)) in enumerate(sorted(targets)):
+                ph = "f" if k == len(targets) - 1 else "t"
+                ev = {"ph": ph, "cat": "fleet", "id": fid, "pid": spid,
+                      "tid": tid, "ts": ts + 0.01, "name": "request"}
+                if ph == "f":
+                    ev["bp"] = "e"
+                events.append(ev)
+    # serving-only cross-process ids (e.g. primary handed off to a
+    # replica without the router in the capture set) still count as
+    # spanning processes
+    by_rid_pids: dict[str, set[int]] = {}
+    for pid, spans in serving_spans.items():
+        for rid in spans:
+            by_rid_pids.setdefault(rid, set()).add(pid)
+    for pid, spans in router_spans.items():
+        for rid in spans:
+            by_rid_pids.setdefault(rid, set()).add(pid)
+    cross_rids.update(r for r, pids in by_rid_pids.items()
+                      if len(pids) > 1)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "pathway_fleet": {
+            "processes": [{"pid": i, "process": m["process"],
+                           "role": m["role"],
+                           "epoch_wall_us": m["epoch_wall_us"]}
+                          for i, m in enumerate(metas)],
+            "cross_process_request_ids": sorted(cross_rids),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics merge
+# ---------------------------------------------------------------------------
+
+_TYPE_RE = re.compile(r"^# TYPE\s+(\S+)\s+(\S+)\s*$")
+_SAMPLE_RE = re.compile(
+    r'^(?P<family>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})?'
+    r'\s+(?P<value>\S+)\s*$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+# the fleet-aggregate pseudo-process label: counter/histogram sums
+# across processes land under process="_fleet" (underscore-prefixed so
+# it can never collide with a real replica id, which the router derives
+# from PATHWAY_REPLICA_ID / pids)
+FLEET_PROCESS = "_fleet"
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus exposition label-value escaping (the PR-5 contract)."""
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+def _parse_exposition(text: str):
+    """Yield ("type", family, kind) and ("sample", family, labels_raw,
+    value_str) items in document order; non-conforming lines are
+    skipped (the per-process endpoints are already lint-gated)."""
+    for line in text.splitlines():
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            if m:
+                yield ("type", m.group(1), m.group(2))
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m:
+            yield ("sample", m.group("family"),
+                   m.group("labels") or "", m.group("value"))
+
+
+def _base_family(family: str) -> str:
+    return re.sub(r"_(bucket|sum|count)$", "", family)
+
+
+def _float(v: str) -> float | None:
+    if v == "+Inf":
+        return float("inf")
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+def merge_metrics(scrapes) -> str:
+    """Merge per-process exposition documents into one fleet document.
+
+    ``scrapes`` is an iterable of ``(meta, text)`` where ``meta`` is
+    ``{"process": str, "role": str}`` and ``text`` one process's
+    ``/metrics`` body. Contract (module doc + the exposition tests):
+
+    * one ``# TYPE`` line per family, however many processes ship it
+      (conflicting kinds keep the first and log — never redeclare);
+    * every sample re-labeled ``process=``/``role=`` (label values
+      escaped per the exposition format);
+    * counters and histograms additionally aggregated under
+      ``process="_fleet"``, summed per remaining label set (histogram
+      cumulative buckets stay monotone under addition; the ``+Inf``
+      bucket equals the summed ``_count``);
+    * gauges and summaries pass through per-process only (averaging
+      quantiles across processes is not a quantile of anything).
+    """
+    family_kind: dict[str, str] = {}
+    family_order: list[str] = []
+    # base family -> list of (sub_family, merged_labels_raw, value_str)
+    samples: dict[str, list[tuple[str, str, str]]] = {}
+    # (base family, sub family, non-process labels frozen) -> float sum,
+    # for the _fleet aggregates
+    sums: dict[tuple, float] = {}
+    sum_order: list[tuple] = []
+
+    for meta, text in scrapes:
+        process = str(meta.get("process", "?"))
+        role = str(meta.get("role", "unknown"))
+        extra = (f'process="{escape_label_value(process)}",'
+                 f'role="{escape_label_value(role)}"')
+        for item in _parse_exposition(text):
+            if item[0] == "type":
+                _kind_tag, family, kind = item
+                prior = family_kind.get(family)
+                if prior is None:
+                    family_kind[family] = kind
+                    family_order.append(family)
+                elif prior != kind:
+                    logger.warning(
+                        "fleet metrics: family %s arrives as %s from "
+                        "%s but was first declared %s — keeping the "
+                        "first declaration", family, kind, process,
+                        prior)
+                continue
+            _tag, sub_family, labels_raw, value = item
+            # group under the declared family: an exact declaration wins
+            # (a counter literally NAMED foo_count must not be filed
+            # under a phantom "foo"); only undeclared _bucket/_sum/
+            # _count sub-samples resolve to their histogram/summary base
+            base = sub_family if sub_family in family_kind \
+                else _base_family(sub_family)
+            merged = extra + ("," + labels_raw if labels_raw else "")
+            samples.setdefault(base, []).append(
+                (sub_family, merged, value))
+            kind = family_kind.get(base)
+            if kind in ("counter", "histogram"):
+                v = _float(value)
+                if v is not None:
+                    key = (base, sub_family, labels_raw)
+                    if key not in sums:
+                        sum_order.append(key)
+                        sums[key] = 0.0
+                    sums[key] += v
+
+    lines: list[str] = []
+    fleet_extra = (f'process="{FLEET_PROCESS}",role="fleet"')
+    agg_by_base: dict[str, list[tuple[str, str, float]]] = {}
+    for base, sub_family, labels_raw in sum_order:
+        agg_by_base.setdefault(base, []).append(
+            (sub_family, labels_raw,
+             sums[(base, sub_family, labels_raw)]))
+    for family in family_order:
+        if family not in samples and family not in agg_by_base:
+            continue
+        lines.append(f"# TYPE {family} {family_kind[family]}")
+        for sub_family, labels_raw, value in samples.get(family, ()):
+            lines.append(f"{sub_family}{{{labels_raw}}} {value}")
+        for sub_family, labels_raw, total in agg_by_base.get(family, ()):
+            merged = fleet_extra + ("," + labels_raw if labels_raw
+                                    else "")
+            out_v = format(total, "g") if total != int(total) \
+                else str(int(total))
+            lines.append(f"{sub_family}{{{merged}}} {out_v}")
+    # families that arrived without a TYPE line still pass through,
+    # per-process labeled, so nothing a process exported is dropped
+    untyped = [f for f in samples if f not in family_kind]
+    for family in untyped:
+        for sub_family, labels_raw, value in samples[family]:
+            lines.append(f"{sub_family}{{{labels_raw}}} {value}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# perf-trajectory watch (BENCH_HISTORY.jsonl)
+# ---------------------------------------------------------------------------
+
+def history_path(path: str | None = None) -> str:
+    return path or os.environ.get("BENCH_HISTORY_PATH", _HISTORY_DEFAULT)
+
+
+def git_sha() -> str | None:
+    sha = os.environ.get("GITHUB_SHA")
+    if sha:
+        return sha[:12]
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=5)
+        if out.returncode == 0:
+            return out.stdout.strip() or None
+    except Exception:  # noqa: BLE001 — evidence, never a crash
+        pass
+    return None
+
+
+def append_bench_history(leg: str, metrics: dict,
+                         path: str | None = None,
+                         sha: str | None = None,
+                         at: float | None = None) -> int:
+    """Append one row per numeric metric of one bench leg to the
+    trajectory file (JSONL: ``{"leg","metric","value","sha","at"}``).
+    Non-numeric values (and bools, and error strings) are skipped;
+    returns the number of rows written. Append-only with line-granular
+    records: a torn tail line is skipped by the reader, never fatal."""
+    path = history_path(path)
+    if sha is None:
+        sha = git_sha()
+    if at is None:
+        at = time.time()
+    rows = []
+    for metric, value in sorted(metrics.items()):
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        rows.append(json.dumps({"leg": leg, "metric": metric,
+                                "value": float(value), "sha": sha,
+                                "at": at}))
+    if not rows:
+        return 0
+    parent = os.path.dirname(os.path.abspath(path))
+    if parent and not os.path.isdir(parent):
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "a") as f:
+        f.write("\n".join(rows) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return len(rows)
+
+
+def bench_history_rows(path: str | None = None) -> list[dict]:
+    """All parseable trajectory rows, file order (= time order). A torn
+    or foreign line is skipped, not fatal — the file is append-only
+    evidence, and one bad write must not hide the rest."""
+    path = history_path(path)
+    rows: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(row, dict) and "metric" in row \
+                        and isinstance(row.get("value"), (int, float)):
+                    rows.append(row)
+    except FileNotFoundError:
+        pass
+    return rows
+
+
+# direction heuristics: which way is "worse" for a metric, by name.
+# Higher-better markers win over the time-suffix check so
+# "docs_per_s" / "rows_per_s" land on the right side of their own
+# trailing "_s". Metrics matching neither are unwatched (reported, not
+# gated) — an unknown metric must not produce a coin-flip gate.
+_HIGHER_MARKERS = ("per_s", "per_sec", "docs_per", "rows_per",
+                   "throughput", "efficiency", "vs_target", "vs_raw",
+                   "overlap_ratio", "qps")
+_LOWER_MARKERS = ("latency", "staleness", "lost", "amplification",
+                  "stall", "lag", "compiles", "failures", "hang",
+                  "skew")
+
+
+def metric_direction(name: str) -> str | None:
+    """'higher' (bigger is better), 'lower' (smaller is better), or
+    None (unwatched)."""
+    low = name.lower()
+    if any(m in low for m in _HIGHER_MARKERS):
+        return "higher"
+    if any(m in low for m in _LOWER_MARKERS) \
+            or low.endswith(("_ms", "_us", "_s")) \
+            or re.search(r"_(ms|us|s)_\d+$", low):
+        return "lower"
+    return None
+
+
+def check_regressions(path: str | None = None, *, window: int = 8,
+                      min_prior: int = 3, tolerance: float | None = None,
+                      tolerances: dict | None = None,
+                      directions: dict | None = None) -> list[dict]:
+    """Compare each (leg, metric) series' NEWEST point against the
+    trailing median of up to ``window`` prior points. A series with
+    fewer than ``min_prior`` prior points is young and passes (one CI
+    run cannot regress against itself). Tolerance bands are relative:
+    the default (``tolerance`` or ``BENCH_REGRESSION_TOLERANCE``,
+    0.35 = 35%) can be overridden per metric via ``tolerances``
+    (longest-prefix match on the metric name). Returns one record per
+    flagged regression, worst first."""
+    if tolerance is None:
+        try:
+            tolerance = float(os.environ.get(
+                "BENCH_REGRESSION_TOLERANCE", 0.35))
+        except ValueError:
+            tolerance = 0.35
+    series: dict[tuple[str, str], list[dict]] = {}
+    for row in bench_history_rows(path):
+        series.setdefault((str(row.get("leg", "?")), row["metric"]),
+                          []).append(row)
+    out: list[dict] = []
+    for (leg, metric), rows in sorted(series.items()):
+        direction = (directions or {}).get(metric) \
+            or metric_direction(metric)
+        if direction is None or len(rows) < min_prior + 1:
+            continue
+        newest = rows[-1]
+        prior = [r["value"] for r in rows[max(0, len(rows) - 1 - window):
+                                          len(rows) - 1]]
+        med = statistics.median(prior)
+        tol = tolerance
+        if tolerances:
+            best = -1
+            for prefix, t in tolerances.items():
+                if metric.startswith(prefix) and len(prefix) > best:
+                    best, tol = len(prefix), t
+        if med == 0:
+            # a series pinned at zero (lost queries, demotions): any
+            # nonzero newest point in the bad direction is a regression
+            bad = newest["value"] > 0 if direction == "lower" \
+                else newest["value"] < 0
+            ratio = float("inf") if bad else 1.0
+        else:
+            ratio = newest["value"] / med
+            bad = ratio > 1.0 + tol if direction == "lower" \
+                else ratio < 1.0 - tol
+        if bad:
+            out.append({
+                "leg": leg, "metric": metric,
+                "value": newest["value"], "median": med,
+                "ratio": (None if ratio == float("inf")
+                          else round(ratio, 4)),
+                "direction": direction, "tolerance": tol,
+                "n_prior": len(prior), "sha": newest.get("sha"),
+            })
+    def severity(r):
+        if r["ratio"] is None:
+            return float("inf")
+        return r["ratio"] if r["direction"] == "lower" \
+            else 1.0 / max(r["ratio"], 1e-9)
+    out.sort(key=severity, reverse=True)
+    return out

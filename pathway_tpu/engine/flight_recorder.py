@@ -57,9 +57,15 @@ _DEFAULT_TAIL_TICKS = 8
 
 def atomic_write_json(path: str, payload) -> str:
     """Serialize ``payload`` to ``path`` atomically: write to a unique
-    sibling tmp file, fsync, then rename. A crash mid-write can never
-    leave a truncated, unloadable file at ``path`` (and never clobbers a
-    previous good one); the tmp is removed on failure."""
+    sibling tmp file, fsync, then rename, then fsync the CONTAINING
+    directory. A crash mid-write can never leave a truncated, unloadable
+    file at ``path`` (and never clobbers a previous good one); the tmp is
+    removed on failure. The directory fsync is load-bearing for the
+    evidence files (BENCH_LASTGOOD.json / BENCH_HISTORY.jsonl): on ext4
+    the rename itself lives in the directory's metadata, so a crash
+    right after ``os.replace`` could otherwise roll the directory back
+    to the OLD entry and lose the checkpoint the data fsync already made
+    durable."""
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "w") as f:
@@ -73,7 +79,30 @@ def atomic_write_json(path: str, payload) -> str:
         except OSError:
             pass
         raise
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
     return path
+
+
+def fsync_dir(dirpath: str) -> None:
+    """fsync a directory so a just-renamed entry survives a crash
+    (see :func:`atomic_write_json`). Platforms whose directories cannot
+    be opened or fsynced degrade silently — the rename still happened;
+    only its crash durability is best-effort there. Fault point
+    ``fs.atomic_write.dirsync`` simulates the crash landing between the
+    rename and this sync."""
+    from pathway_tpu.testing import faults
+
+    faults.hit("fs.atomic_write.dirsync", dir=dirpath)
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 # live enabled recorders (weak: a recorder dies with its scheduler/run).
 # Lets out-of-band observers — bench.py's flight beacon — find the run's
@@ -176,6 +205,12 @@ class FlightRecorder:
         # enabled recorders by from_env; None keeps every per-request hook
         # a dead branch
         self.requests = None
+        # fleet identity (engine/fleet_observability.py): stamped by the
+        # streaming runtime so the written trace names its process and the
+        # trace merger can place it on the right fleet track
+        self.role = "primary"
+        self.process = (os.environ.get("PATHWAY_REPLICA_ID")
+                        or f"pid{os.getpid()}")
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -431,10 +466,14 @@ class FlightRecorder:
         pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
         tids = {"host": 0, "device": 1}
         out = [
+            {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+             "args": {"name": f"{self.role}:{self.process}"}},
+        ]
+        out.extend(
             {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
              "args": {"name": f"{leg} leg"}}
             for leg, tid in tids.items()
-        ]
+        )
         evs = self.tail_events(None)
         # group by (tick, leg) preserving order; events within a leg are
         # sequential (one thread per leg), so wrapper = [min start, max end]
@@ -547,14 +586,39 @@ class FlightRecorder:
                 out.append(ev)
         return out
 
+    def chrome_trace_payload(self) -> dict:
+        """The full Chrome-trace payload incl. the ``pathway_meta`` fleet
+        block (os pid, role, process label, and the monotonic↔wall clock
+        anchor) that lets ``fleet_observability.merge_traces`` place this
+        process's events on the shared wall-clock timeline. Served live by
+        ``/trace?format=chrome`` and written by
+        :meth:`write_chrome_trace`."""
+        # wall-clock microsecond that this trace's ts==0 (the recorder
+        # epoch) maps to: events are (t - epoch) * 1e6, and
+        # epoch_wall_ns = epoch * 1e9 + _wall_ns_offset by construction
+        epoch_wall_us = (self._epoch * 1e9 + self._wall_ns_offset) / 1e3
+        return {
+            "traceEvents": self.chrome_trace_events(),
+            "displayTimeUnit": "ms",
+            "pathway_meta": {
+                "pid": os.getpid(),
+                "process": self.process,
+                "role": self.role,
+                "epoch_wall_us": epoch_wall_us,
+                # the perf_counter value ts==0 maps to: lets a consumer
+                # holding only a heartbeat clock anchor (wall - perf)
+                # recompute epoch_wall_us independently
+                "epoch_perf": self._epoch,
+            },
+        }
+
     def write_chrome_trace(self, path: str | None = None) -> str | None:
         """Serialize the buffer to Chrome trace JSON at ``path`` (defaults
         to the configured trace_path); returns the path written or None."""
         path = path or self.trace_path
         if not path:
             return None
-        payload = {"traceEvents": self.chrome_trace_events(),
-                   "displayTimeUnit": "ms"}
-        # atomic (unique tmp + fsync + rename): a crash mid-write must not
-        # leave a truncated trace, nor clobber the previous good one
-        return atomic_write_json(path, payload)
+        # atomic (unique tmp + fsync + rename + dir fsync): a crash
+        # mid-write must not leave a truncated trace, nor clobber the
+        # previous good one
+        return atomic_write_json(path, self.chrome_trace_payload())

@@ -136,6 +136,26 @@ class MonitoringHttpServer:
                     "inflight": None}
         return rec.trace_payload()
 
+    def chrome_trace_payload(self) -> dict:
+        """``/trace?format=chrome``: the same buffer as Chrome trace-event
+        JSON with the ``pathway_meta`` fleet block — what the router's
+        ``/fleet/trace`` and ``python -m pathway_tpu trace-merge`` consume
+        (engine/fleet_observability.py). Without a recorder the shell
+        still carries this process's identity so a merge over a partially
+        instrumented fleet stays well-formed."""
+        import os as _os
+
+        rec = getattr(self.runtime.scheduler, "recorder", None)
+        if rec is None:
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "pathway_meta": {
+                        "pid": _os.getpid(),
+                        "process": _os.environ.get("PATHWAY_REPLICA_ID")
+                        or f"pid{_os.getpid()}",
+                        "role": getattr(self.runtime, "role", "primary"),
+                        "epoch_wall_us": 0.0}}
+        return rec.chrome_trace_payload()
+
     def healthz_payload(self) -> tuple[bool, dict]:
         """(healthy, body) for ``/healthz``: 200 while every supervised
         source is live and the commit loop ticks; 503 with a body naming
@@ -198,10 +218,10 @@ class MonitoringHttpServer:
             "# TYPE pathway_tpu_operator_latency_ms gauge",
             "# TYPE pathway_tpu_operator_total_ms counter",
         ]
-        def esc(v: str) -> str:
-            # Prometheus exposition format label escaping
-            return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
-                "\n", r"\n")
+        # the one exposition-escaping contract, shared with the router
+        # and the fleet merger (engine/fleet_observability.py)
+        from pathway_tpu.engine.fleet_observability import \
+            escape_label_value as esc
 
         payload = self.status_payload()
         for op in payload["operators"]:
@@ -538,19 +558,27 @@ class MonitoringHttpServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
                 code = 200
-                if self.path.rstrip("/") in ("", "/status"):
+                path, _sep, query = self.path.partition("?")
+                path = path.rstrip("/")
+                if path in ("", "/status"):
                     body = json.dumps(server.status_payload()).encode()
                     ctype = "application/json"
-                elif self.path.rstrip("/") == "/metrics":
+                elif path == "/metrics":
                     body = server.metrics_payload().encode()
                     ctype = "text/plain; version=0.0.4"
-                elif self.path.rstrip("/") == "/healthz":
+                elif path == "/healthz":
                     healthy, payload = server.healthz_payload()
                     body = json.dumps(payload).encode()
                     ctype = "application/json"
                     code = 200 if healthy else 503
-                elif self.path.rstrip("/") == "/trace":
-                    body = json.dumps(server.trace_payload()).encode()
+                elif path == "/trace":
+                    # ?format=chrome: the fleet-mergeable Chrome trace
+                    # payload (engine/fleet_observability.py)
+                    if "format=chrome" in query:
+                        payload = server.chrome_trace_payload()
+                    else:
+                        payload = server.trace_payload()
+                    body = json.dumps(payload).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

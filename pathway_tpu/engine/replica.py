@@ -449,9 +449,19 @@ class ControlClient:
                 hb["p50_ms"] = round(qs[0.5], 3)
                 hb["p95_ms"] = round(qs[0.95], 3)
             hb["requests"] = tracker.count
+            # replica-side SLO burn: /fleet/status shows every process's
+            # burn rate next to the router's front-door one
+            hb["burn_rate"] = round(tracker.burn_rate(), 4)
         mon = getattr(rt, "http_server", None)
         if mon is not None:
             hb["monitoring_port"] = mon.port
+        # monotonic<->wall clock anchor (engine/fleet_observability.py):
+        # rides every heartbeat so the router can clock-align this
+        # process's monotonic trace timestamps in /fleet/trace even when
+        # the scraped payload lacks its own wall anchor
+        from pathway_tpu.engine.fleet_observability import clock_anchor
+
+        hb["clock"] = clock_anchor()
         return hb
 
     def start(self) -> None:

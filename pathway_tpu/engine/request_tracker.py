@@ -44,6 +44,15 @@ import time
 # stage names, in hand-off order (see module doc)
 STAGES = ("ingress_wait", "queue", "host", "device", "response_write")
 
+# router-side stages a request crosses BEFORE the five above begin on the
+# serving process (the fleet prefix of the decomposition): `route` is the
+# endpoint choice, `forward` the first proxy attempt, `failover` every
+# replay on the next-best replica. Recorded per query by the router's
+# RouterRequestLog (engine/fleet_observability.py) under the SAME request
+# id the serving process adopts, so the merged fleet trace shows one
+# query's router + process stages end to end.
+ROUTER_STAGES = ("route", "forward", "failover")
+
 _DEFAULT_SLO_E2E_MS = 20.0       # BASELINE.md serving target
 _DEFAULT_ERROR_BUDGET = 0.01     # 1% of requests may exceed the SLO
 _DEFAULT_WINDOW = 256            # burn-rate sliding window (requests)

@@ -39,13 +39,24 @@ exits; the router stops routing to it first).
 
 The router's own monitoring contract matches the engine's
 (``/healthz`` / ``/status`` / ``/metrics`` with ``role: "router"``,
-served locally on the front port; every other path is proxied).
+served locally on the front port; every other path is proxied), and the
+router is additionally the fleet's single observability scrape point
+(engine/fleet_observability.py): ``/fleet/metrics`` (every endpoint's
+families merged and re-labeled ``{process=,role=}``), ``/fleet/status``
+(roles, applied ticks, staleness, burn rates in one JSON) and
+``/fleet/trace`` (one clock-aligned Perfetto timeline with cross-process
+flow arrows — a failover renders as an arrow from the router into the
+rescuing replica's track). Request ids propagate end to end: the router
+adopts/mints ``X-Pathway-Request-Id``, forwards it (plus an
+``X-Pathway-Hop`` counter) on every attempt incl. failover replays, and
+echoes it on every response incl. 503s.
 """
 
 from __future__ import annotations
 
 import collections
 import http.client
+import itertools
 import json
 import logging
 import os
@@ -54,6 +65,13 @@ import threading
 import time as _time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from pathway_tpu.engine.fleet_observability import (HOP_HEADER,
+                                                    REQUEST_ID_HEADER,
+                                                    RouterRequestLog,
+                                                    anchor_epoch_wall_us,
+                                                    escape_label_value,
+                                                    merge_metrics,
+                                                    merge_traces)
 from pathway_tpu.engine.locking import create_lock
 from pathway_tpu.engine.multiproc import (control_authkey, hmac_handshake,
                                           recv_control_frame,
@@ -63,7 +81,20 @@ from pathway_tpu.engine.threads import spawn
 
 logger = logging.getLogger(__name__)
 
-_LOCAL_PATHS = ("/healthz", "/status", "/metrics", "/_router")
+# locally-served paths; everything else proxies to a replica. /fleet/*
+# is the single scrape point for the whole fleet
+# (engine/fleet_observability.py): merged metrics, one-JSON fleet
+# status, and the clock-aligned merged Perfetto trace.
+_LOCAL_PATHS = ("/healthz", "/status", "/metrics", "/_router",
+                "/fleet/metrics", "/fleet/status", "/fleet/trace")
+
+_router_rid_counter = itertools.count(1)
+
+
+def _mint_router_rid() -> str:
+    """A request id minted at the ROUTER for queries that arrived
+    without one — the id every downstream hop then adopts."""
+    return f"rtr-{os.getpid():x}-{next(_router_rid_counter):06d}"
 
 
 def _env_int(name: str, default: int) -> int:
@@ -108,6 +139,13 @@ class ReplicaEndpoint:
         # — routing uses the router-observed estimators above)
         self.reported_p50_ms: float | None = None
         self.reported_p95_ms: float | None = None
+        # replica-side SLO burn rate (heartbeat) — /fleet/status in one
+        # JSON next to the router's own front-door burn rate
+        self.burn_rate: float | None = None
+        # monotonic<->wall clock anchor (heartbeat): lets /fleet/trace
+        # align this endpoint's monotonic trace timestamps even when its
+        # scraped payload predates the fleet meta block
+        self.clock: dict | None = None
 
     def observe(self, ms: float) -> None:
         self.p50.observe(ms)
@@ -151,6 +189,23 @@ class ReplicaEndpoint:
             self.reported_p50_ms = float(hb["p50_ms"])
         if hb.get("p95_ms") is not None:
             self.reported_p95_ms = float(hb["p95_ms"])
+        if hb.get("burn_rate") is not None:
+            self.burn_rate = float(hb["burn_rate"])
+        if isinstance(hb.get("clock"), dict):
+            self.clock = hb["clock"]
+
+    def p50_skew_ms(self) -> float | None:
+        """Router-observed p50 minus the replica's self-reported serving
+        p50 — the network + proxy overhead in the healthy case. A skew
+        that grows past that floor names a clock-drifted or overloaded
+        replica BEFORE it breaches SLO: the replica still thinks it is
+        fast (its own timeline is compressed or its accept queue is
+        eating the wait), while every router-side measurement already
+        pays the real latency."""
+        p50 = self.p50.value()
+        if p50 is None or self.reported_p50_ms is None:
+            return None
+        return p50 - self.reported_p50_ms
 
     def summary(self) -> dict:
         return {
@@ -172,6 +227,9 @@ class ReplicaEndpoint:
                        else round(self.p95.value(), 3)),
             "reported_p50_ms": self.reported_p50_ms,
             "reported_p95_ms": self.reported_p95_ms,
+            "p50_skew_ms": (None if (skew := self.p50_skew_ms()) is None
+                            else round(skew, 3)),
+            "burn_rate": self.burn_rate,
         }
 
 
@@ -217,6 +275,11 @@ class QueryRouter:
             maxlen=max(16, _env_int("PATHWAY_SLO_WINDOW", 256)))
         self._e2e_p50 = P2Quantile(0.5)
         self._e2e_p95 = P2Quantile(0.95)
+        # router-side per-request spans (route/forward/failover stages,
+        # engine/fleet_observability.py): the router's track in the
+        # merged fleet trace, keyed by the SAME request id the serving
+        # process adopts
+        self.request_log = RouterRequestLog()
         self.requests_total = 0
         self.failovers_total = 0
         self.unroutable_total = 0  # 503s: no live replica could answer
@@ -442,26 +505,42 @@ class QueryRouter:
         return chosen
 
     def forward(self, method: str, path: str, body: bytes,
-                content_type: str = "application/json"
-                ) -> tuple[int, bytes, str, int, str]:
+                content_type: str = "application/json",
+                rid: str | None = None, hop: int = 0
+                ) -> tuple[int, bytes, str, int, str, str]:
         """Proxy one query, failing over across replicas until one
         answers. Returns (status, body, serving replica id, failovers,
-        response content type). The query body is held here until a
-        response arrives — replica death mid-flight costs a retry,
-        never the query."""
+        response content type, request id). The query body is held here
+        until a response arrives — replica death mid-flight costs a
+        retry, never the query.
+
+        Propagation contract (engine/fleet_observability.py): the
+        request id — inbound ``X-Pathway-Request-Id`` or minted here —
+        is forwarded with an incremented ``X-Pathway-Hop`` on EVERY
+        attempt, including failover replays, so the rescuing replica
+        adopts the same id the first attempt carried; the caller echoes
+        it on every response, including 503s."""
+        if rid is None:
+            rid = _mint_router_rid()
+        span = self.request_log.start(rid, path)
         t0 = _time.perf_counter()
         tried: set[str] = set()
         failovers = 0
         last_err: Exception | None = None
+        headers = {"Content-Type": content_type,
+                   REQUEST_ID_HEADER: rid,
+                   HOP_HEADER: str(hop + 1)}
         while True:
             try:
                 ep = self.choose(exclude=tried)
             except NoReplicaAvailable:
                 self.unroutable_total += 1
+                self.request_log.finish(span, 503, None)
                 detail = (f" (last error: {last_err})" if last_err else "")
                 return (503,
                         f"no replica available{detail}".encode(),
-                        "", failovers, "text/plain")
+                        "", failovers, "text/plain", rid)
+            span.note_routed()
             tried.add(ep.replica_id)
             ep.inflight += 1
             t_attempt = _time.perf_counter()
@@ -470,7 +549,7 @@ class QueryRouter:
                     ep.host, ep.port, timeout=self.forward_timeout_s)
                 try:
                     conn.request(method, path, body=body or None,
-                                 headers={"Content-Type": content_type})
+                                 headers=headers)
                     resp = conn.getresponse()
                     data = resp.read()
                     status = resp.status
@@ -483,12 +562,14 @@ class QueryRouter:
             # the SIGKILL-under-load case; both classes fail over
             except (OSError, http.client.HTTPException) as e:
                 # connection-level failure: the replica is gone (or
-                # unreachable) — fail over with the SAME body
+                # unreachable) — fail over with the SAME body (and the
+                # SAME request id: the replay is the same query)
                 ep.failures += 1
                 ep.alive = False
                 last_err = e
                 failovers += 1
                 self.failovers_total += 1
+                span.note_attempt(ep.replica_id, t_attempt, ok=False)
                 logger.warning(
                     "forward to %s failed (%s: %s) — failing over",
                     ep.replica_id, type(e).__name__, e)
@@ -500,6 +581,7 @@ class QueryRouter:
             # rescuing replica's p50/p95 (and thereby choose())
             ep.requests += 1
             ep.observe((_time.perf_counter() - t_attempt) * 1e3)
+            span.note_attempt(ep.replica_id, t_attempt, ok=True)
             ms = (_time.perf_counter() - t0) * 1e3
             with self._lock:
                 self.requests_total += 1
@@ -508,7 +590,8 @@ class QueryRouter:
                 self._e2e_p95.observe(ms)
                 if ms > self.slo_ms:
                     self.violations += 1
-            return status, data, ep.replica_id, failovers, resp_ctype
+            self.request_log.finish(span, status, ep.replica_id)
+            return status, data, ep.replica_id, failovers, resp_ctype, rid
 
     # -- SLO / scaling -------------------------------------------------------
     def burn_rate(self) -> float:
@@ -622,10 +705,7 @@ class QueryRouter:
         }
 
     def metrics_payload(self) -> str:
-        def esc(v: str) -> str:
-            return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
-                "\n", r"\n")
-
+        esc = escape_label_value  # the one exposition-escaping contract
         eps = self.endpoints()
         lines = [
             "# TYPE pathway_tpu_router_replicas gauge",
@@ -652,6 +732,8 @@ class QueryRouter:
             lines.append("# TYPE pathway_tpu_router_replica_p50_ms gauge")
             lines.append("# TYPE pathway_tpu_router_replica_p95_ms gauge")
             lines.append(
+                "# TYPE pathway_tpu_router_replica_p50_skew_ms gauge")
+            lines.append(
                 "# TYPE pathway_tpu_replica_staleness_ticks gauge")
             lines.append("# TYPE pathway_tpu_replica_applied_tick gauge")
             for e in sorted(eps, key=lambda e: e.replica_id):
@@ -668,6 +750,15 @@ class QueryRouter:
                     lines.append(
                         "pathway_tpu_router_replica_p95_ms"
                         f"{lab} {round(max(p50, p95), 6)}")
+                skew = e.p50_skew_ms()
+                if skew is not None:
+                    # router-observed minus self-reported serving p50:
+                    # a clock-drifted or overloaded replica shows here
+                    # before it breaches SLO (heartbeats already carry
+                    # the replica's own quantiles)
+                    lines.append(
+                        "pathway_tpu_router_replica_p50_skew_ms"
+                        f"{lab} {round(skew, 6)}")
                 lines.append(
                     f"pathway_tpu_replica_staleness_ticks{lab} "
                     f"{e.staleness_ticks}")
@@ -676,6 +767,131 @@ class QueryRouter:
                     f"{e.applied_tick}")
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
+
+    # -- fleet surfaces (engine/fleet_observability.py) ----------------------
+    def _scrape(self, url: str, timeout: float = 2.5) -> str:
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode()
+
+    def _scrapable_endpoints(self) -> list[ReplicaEndpoint]:
+        return [e for e in self.endpoints()
+                if e.alive and e.monitoring_port]
+
+    def _scrape_fleet(self, path: str, timeout: float
+                      ) -> list[tuple[ReplicaEndpoint, str]]:
+        """Scrape ``path`` from every alive endpoint's monitoring port
+        CONCURRENTLY — N endpoints cost one timeout of wall time, not N
+        (a hung-but-alive endpoint must not serialize the whole fleet
+        scrape behind its timeout); failures degrade to that endpoint's
+        rows only. Results keep endpoint order."""
+        import concurrent.futures
+
+        eps = self._scrapable_endpoints()
+        if not eps:
+            return []
+
+        def one(ep: ReplicaEndpoint) -> str | None:
+            host = ep.host or "127.0.0.1"
+            try:
+                return self._scrape(
+                    f"http://{host}:{ep.monitoring_port}{path}",
+                    timeout=timeout)
+            except Exception as e:  # noqa: BLE001 — a dead endpoint is
+                # routing's problem; the scrape degrades per-process
+                logger.warning("fleet scrape of %s%s failed: %s",
+                               ep.replica_id, path, e)
+                return None
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(eps)),
+                thread_name_prefix="pathway-tpu-fleet-scrape") as pool:
+            bodies = list(pool.map(one, eps))
+        return [(ep, body) for ep, body in zip(eps, bodies)
+                if body is not None]
+
+    def fleet_metrics_payload(self) -> str:
+        """``/fleet/metrics``: one scrape point for the whole fleet —
+        the router's own families plus every registered endpoint's
+        ``/metrics`` body, merged under the exposition contract
+        (one TYPE line per family, every sample re-labeled
+        ``process=``/``role=``, counters/histograms summed under
+        ``process="_fleet"``; fleet_observability.merge_metrics)."""
+        scrapes = [({"process": "router", "role": "router"},
+                    self.metrics_payload())]
+        for ep, text in self._scrape_fleet("/metrics", timeout=2.5):
+            scrapes.append(({"process": ep.replica_id, "role": ep.role},
+                            text))
+        return merge_metrics(scrapes)
+
+    def fleet_status_payload(self) -> dict:
+        """``/fleet/status``: roles, applied ticks, staleness and burn
+        rates of the whole fleet in one JSON — built from the control-
+        channel heartbeats (no scrape round trip), plus the router's own
+        front-door aggregates and per-request stage summary."""
+        fleet = [e.summary() for e in self.endpoints()]
+        qs = self.quantiles_ms()
+        return {
+            "role": "router",
+            "front": f"{self.host}:{self.port}",
+            "requests": self.requests_total,
+            "failovers": self.failovers_total,
+            "unroutable": self.unroutable_total,
+            "slo_ms": self.slo_ms,
+            "burn_rate": round(self.burn_rate(), 3),
+            "e2e_ms": qs,
+            "request_stages": self.request_log.stage_summary(),
+            "fleet": fleet,
+        }
+
+    def chrome_trace_payload(self) -> dict:
+        """The router's own mergeable trace payload: the request track
+        (route/forward/failover spans per query) plus the fleet meta
+        block, same shape every serving process exposes at
+        ``/trace?format=chrome``."""
+        return {
+            "traceEvents": self.request_log.chrome_trace_events(),
+            "displayTimeUnit": "ms",
+            "pathway_meta": {
+                "pid": os.getpid(),
+                "process": "router",
+                "role": "router",
+                "epoch_wall_us": self.request_log.epoch_wall_us,
+            },
+        }
+
+    def fleet_trace_payload(self) -> dict:
+        """``/fleet/trace``: ONE clock-aligned Perfetto timeline for the
+        fleet — the router's request track merged with every registered
+        endpoint's ``/trace?format=chrome`` payload; a failover renders
+        as a flow arrow from the router into the rescuing replica's
+        track (fleet_observability.merge_traces)."""
+        payloads = [self.chrome_trace_payload()]
+        for ep, body in self._scrape_fleet("/trace?format=chrome",
+                                           timeout=5.0):
+            try:
+                payload = json.loads(body)
+            except ValueError as e:
+                logger.warning("fleet trace payload of %s unparseable: "
+                               "%s", ep.replica_id, e)
+                continue
+            meta = payload.get("pathway_meta")
+            clock = ep.clock
+            if isinstance(meta, dict) and not meta.get("epoch_wall_us") \
+                    and isinstance(clock, dict) \
+                    and {"wall", "perf"} <= set(clock):
+                # endpoint shipped no wall anchor in the payload: fall
+                # back to the control-channel heartbeat anchor — its
+                # (wall - perf) offset plus the payload's perf epoch
+                # pins the same wall-clock origin the recorder would
+                # have stamped
+                try:
+                    meta["epoch_wall_us"] = anchor_epoch_wall_us(
+                        clock, float(meta.get("epoch_perf", 0.0) or 0.0))
+                except (TypeError, ValueError):
+                    pass  # version-skewed junk anchor: merge unaligned
+            payloads.append(payload)
+        return merge_traces(payloads)
 
     # -- front HTTP plumbing -------------------------------------------------
     def _serve_local(self, handler, path: str) -> None:
@@ -686,6 +902,15 @@ class QueryRouter:
         elif path == "/metrics":
             body = self.metrics_payload().encode()
             code, ctype = 200, "text/plain; version=0.0.4"
+        elif path == "/fleet/metrics":
+            body = self.fleet_metrics_payload().encode()
+            code, ctype = 200, "text/plain; version=0.0.4"
+        elif path == "/fleet/status":
+            body = json.dumps(self.fleet_status_payload()).encode()
+            code, ctype = 200, "application/json"
+        elif path == "/fleet/trace":
+            body = json.dumps(self.fleet_trace_payload()).encode()
+            code, ctype = 200, "application/json"
         else:  # /status, /_router
             body = json.dumps(self.status_payload()).encode()
             code, ctype = 200, "application/json"
@@ -696,14 +921,23 @@ class QueryRouter:
         handler.wfile.write(body)
 
     def _serve_proxy(self, handler, method: str, body: bytes) -> None:
-        status, data, replica_id, failovers, ctype = self.forward(
+        try:
+            hop = int(handler.headers.get(HOP_HEADER) or 0)
+        except ValueError:
+            hop = 0
+        status, data, replica_id, failovers, ctype, rid = self.forward(
             method, handler.path, body,
             content_type=handler.headers.get("Content-Type",
-                                             "application/json"))
+                                             "application/json"),
+            rid=handler.headers.get(REQUEST_ID_HEADER) or None, hop=hop)
         try:
             handler.send_response(status)
             handler.send_header("Content-Type", ctype)
             handler.send_header("Content-Length", str(len(data)))
+            # the id rides EVERY response — healthy proxies, failover
+            # replays AND 503s: an unrouted query must still be
+            # greppable fleet-wide by the id its client holds
+            handler.send_header(REQUEST_ID_HEADER, rid)
             if replica_id:
                 handler.send_header("X-Pathway-Replica", replica_id)
             if failovers:

@@ -97,6 +97,18 @@ class StreamingRuntime:
         self.recorder = FlightRecorder.from_env(
             trace_path=trace_path,
             auto_on=with_http_server or self.monitor.enabled())
+        if self.recorder is not None:
+            # fleet identity on the trace (engine/fleet_observability.py):
+            # the merged Perfetto timeline names each process's track by
+            # role + process label, and replicas share the id the router
+            # knows them by
+            import os as _os
+
+            self.recorder.role = self.role
+            self.recorder.process = (
+                replica.replica_id if replica is not None
+                else _os.environ.get("PATHWAY_REPLICA_ID")
+                or f"primary-{_os.getpid()}")
         self.scheduler = Scheduler(runner.graph, n_workers=n_workers,
                                    cluster=cluster, recorder=self.recorder)
         # watchdog progress on every resolved device leg: the commit loop

@@ -29,10 +29,13 @@ from pathway_tpu.io._datasource import (DataSource, Session,
 
 
 # -- request-id assignment (serving-path SLO tracing) -------------------------
-# Every request entering the webserver gets an id at ingress, echoed back in
-# the X-Pathway-Request-Id response header and propagated (out of band — never
+# Every request entering the webserver gets an id at ingress — ADOPTED from an
+# inbound X-Pathway-Request-Id header when the router (or a calling service)
+# already named the query, minted fresh otherwise — echoed back in the
+# X-Pathway-Request-Id response header and propagated (out of band — never
 # inside engine rows) through the request tracker
-# (engine/request_tracker.py, README "Serving SLO").
+# (engine/request_tracker.py, README "Serving SLO"; fleet propagation contract
+# in engine/fleet_observability.py).
 
 _rid_counter = itertools.count(1)
 _rid_prefix: str | None = None
@@ -43,6 +46,28 @@ def _next_request_id() -> str:
     if _rid_prefix is None:
         _rid_prefix = _os.urandom(3).hex()
     return f"{_rid_prefix}-{next(_rid_counter):06d}"
+
+
+_RID_MAX_LEN = 128
+_RID_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_.:")
+
+
+def _adopt_request_id(inbound: str | None) -> str:
+    """Adopt the inbound ``X-Pathway-Request-Id`` (the fleet propagation
+    contract: the router — or a calling service — already named this
+    query, and one id must span every process it crosses) or mint a
+    fresh one. Inbound ids are sanitized, not trusted: an id with
+    characters outside the safe set, or past the length cap, would leak
+    header junk into traces and metric labels — such requests get a
+    local id instead (the response still carries the id actually
+    used)."""
+    if inbound:
+        rid = inbound.strip()
+        if rid and len(rid) <= _RID_MAX_LEN \
+                and all(c in _RID_OK for c in rid):
+            return rid
+    return _next_request_id()
 
 
 class RequestContext:
@@ -177,7 +202,8 @@ class PathwayWebserver:
                     return web.Response(status=200, text=text,
                                         content_type="text/x-yaml")
                 return web.Response(status=404, text="no such route")
-            rid = _next_request_id()
+            rid = _adopt_request_id(
+                request.headers.get("X-Pathway-Request-Id"))
             rid_header = {"X-Pathway-Request-Id": rid}
             try:
                 fmt = self._formats.get(route_key, "custom")

@@ -1,0 +1,297 @@
+"""Fleet observability canary: the /fleet/* plane proven on a REAL
+multi-process fleet (PR 14, engine/fleet_observability.py).
+
+Builds the same fleet shape as tests/replica_canary.py — an in-process
+QueryRouter fronting a primary + two read replicas, each a full
+``pw.run`` OS process — but in observability mode: every member runs
+its monitoring HTTP server on an ephemeral port (announced over the
+control-channel heartbeat) with the flight recorder on, and the primary
+registers with the router too. Under closed-loop load with one SIGKILL
+failover, the gates are:
+
+1. **fleet metrics** — the router's ``/fleet/metrics`` serves every
+   registered process's families, re-labeled ``{process=,role=}``, with
+   exactly one ``# TYPE`` line per family and a ``process="_fleet"``
+   counter aggregate; ``/fleet/status`` carries roles, applied ticks,
+   staleness and burn rates in one JSON.
+2. **failover under load** — ≥ 1 failover observed, ZERO lost queries
+   (the PR-12 guarantee, re-proven with tracing on).
+3. **merged trace** — ``/fleet/trace`` is ONE clock-aligned Perfetto
+   timeline: ≥ 2 processes carry events, at least one request id spans
+   ≥ 2 processes, every (pid, tid) track validates under the PR-5 B/E
+   nesting checker, and a failed-over request's flow arrow lands on a
+   DIFFERENT process than the router (the rescuing replica's track).
+4. **perf trajectory** — the canary's own measurements append to
+   ``BENCH_HISTORY.jsonl``; ``bench.py --check-regression`` passes on
+   the real trajectory and FLAGS a seeded synthetic regression.
+
+Artifacts: the merged trace JSON (``FLEET_TRACE_ARTIFACT``) and the
+history file (``BENCH_HISTORY_PATH``). Exits 0 iff all gates hold.
+Run: ``python tests/fleet_trace_canary.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<family>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})?'
+    r'\s+(?P<value>\S+)\s*$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _samples(text: str):
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        out.append((m.group("family"), labels, m.group("value")))
+    return out
+
+
+def _get(url: str, timeout: float = 20.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _check_nesting(events) -> None:
+    """PR-5 checker, keyed per (pid, tid) — the merged file must stay
+    Perfetto-valid after N processes' B/E spans interleave."""
+    stacks: dict = {}
+    for ev in events:
+        key = (ev.get("pid"), ev.get("tid"))
+        if ev["ph"] == "B":
+            stacks.setdefault(key, []).append(ev["name"])
+        elif ev["ph"] == "E":
+            stack = stacks.setdefault(key, [])
+            assert stack, f"E without B on {key}: {ev['name']}"
+            top = stack.pop()
+            assert top == ev["name"], \
+                f"mis-nested on {key}: E {ev['name']!r} closes {top!r}"
+    for key, stack in stacks.items():
+        assert not stack, f"unclosed spans on {key}: {stack}"
+
+
+def _wait_fleet(router, names: set[str], timeout_s: float = 60.0) -> None:
+    """Wait until every named process is registered WITH a monitoring
+    port (the heartbeat announces it)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        eps = {e.replica_id: e for e in router.endpoints()}
+        if names <= set(eps) and all(eps[n].monitoring_port
+                                     for n in names):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"fleet never fully announced monitoring ports: "
+        f"{ {e.replica_id: e.monitoring_port for e in router.endpoints()} }")
+
+
+def main() -> int:
+    import bench
+
+    hist = os.environ.setdefault(
+        "BENCH_HISTORY_PATH",
+        os.path.join(tempfile.gettempdir(),
+                     f"fleet_canary_hist_{os.getpid()}.jsonl"))
+    tmp = tempfile.mkdtemp(prefix="fleet_canary_")
+    fleet = bench._ReplicaFleet(tmp, observability=True)
+    try:
+        router = fleet.start_router()
+        fleet.start_primary()
+        fleet.start_replica("r1")
+        fleet.start_replica("r2")
+        _wait_fleet(router, {"primary", "r1", "r2"})
+
+        # ---- gate 1: /fleet/metrics + /fleet/status (full fleet) ------
+        base = f"http://127.0.0.1:{router.port}"
+        merged = _get(base + "/fleet/metrics").decode()
+        lines = merged.splitlines()
+        assert lines[-1] == "# EOF", "merged doc missing the EOF marker"
+        fams = [l.split()[2] for l in lines if l.startswith("# TYPE")]
+        assert len(fams) == len(set(fams)), (
+            f"duplicate # TYPE declarations in the merged doc: "
+            f"{[f for f in fams if fams.count(f) > 1][:4]}")
+        samples = _samples(merged)
+        procs = {labels.get("process") for _f, labels, _v in samples}
+        assert {"router", "primary", "r1", "r2"} <= procs, (
+            f"/fleet/metrics missing per-process families: {procs}")
+        for name in ("primary", "r1", "r2"):
+            assert any(f == "pathway_tpu_insertions"
+                       and labels.get("process") == name
+                       for f, labels, _v in samples), (
+                f"no per-process engine family for {name}")
+        assert "_fleet" in procs, "no process=\"_fleet\" aggregate"
+        status = json.loads(_get(base + "/fleet/status"))
+        assert status["role"] == "router" and "burn_rate" in status
+        by_name = {m["replica"]: m for m in status["fleet"]}
+        assert {"primary", "r1", "r2"} <= set(by_name)
+        assert by_name["primary"]["role"] == "primary"
+        for n in ("r1", "r2"):
+            assert by_name[n]["role"] == "replica"
+            assert by_name[n]["applied_tick"] > 0
+            assert by_name[n]["staleness_ticks"] >= 0
+        print(f"[gate1] /fleet/metrics serves {len(procs)} processes "
+              f"({sorted(p for p in procs if p)}), one TYPE per family, "
+              f"_fleet aggregates present; /fleet/status has "
+              f"roles/ticks/staleness/burn in one JSON")
+
+        # ---- real perf trajectory: several measured points ------------
+        # four short steady-state windows (same fleet, same load shape)
+        # each append a real fleet_p50_ms row, so the gate-4 regression
+        # check evaluates a genuinely multi-point series instead of
+        # passing vacuously on a too-young one (a fresh CI checkout has
+        # no committed history — BENCH_HISTORY.jsonl is machine-local
+        # evidence like BENCH_LASTGOOD.json)
+        from pathway_tpu.engine.fleet_observability import \
+            append_bench_history
+
+        window_s = float(os.environ.get("FLEET_CANARY_WINDOW_S", 2.0))
+        window_p50s = []
+        for _ in range(4):
+            win = fleet.run_load(window_s, clients=6, warmup_s=0.5)
+            if win.get("p50_ms"):
+                window_p50s.append(win["p50_ms"])
+                append_bench_history(
+                    "fleet_canary", {"fleet_p50_ms": win["p50_ms"]},
+                    path=hist)
+        assert len(window_p50s) >= 4, (
+            f"steady-state windows produced too few p50s: {window_p50s}")
+
+        # ---- gate 2: SIGKILL failover under load ----------------------
+        load_s = float(os.environ.get("FLEET_CANARY_LOAD_S", 6.0))
+        kill = fleet.run_load(load_s, clients=6, warmup_s=1.0,
+                              kill_at_s=load_s / 3, kill_rid="r1")
+        fleet.wait_deregistered("r1")
+        assert kill["queries"] > 0, kill
+        assert kill["lost"] == 0, (
+            f"{kill['lost']} of {kill['queries']} queries lost across "
+            "the SIGKILL — failover leaked load")
+        assert router.failovers_total >= 1, (
+            "no failover observed: the kill window never exercised the "
+            "replay path")
+        print(f"[gate2] {kill['queries']} queries across the SIGKILL, "
+              f"0 lost, {router.failovers_total} failover(s)")
+
+        # ---- gate 3: one clock-aligned merged trace -------------------
+        trace = json.loads(_get(base + "/fleet/trace", timeout=30.0))
+        events = trace["traceEvents"]
+        fleet_meta = trace["pathway_fleet"]
+        roles = {p["role"] for p in fleet_meta["processes"]}
+        assert "router" in roles and {"replica", "primary"} & roles, roles
+        pids_with_events = {e["pid"] for e in events
+                            if e["ph"] in ("B", "E", "b", "e")}
+        assert len(pids_with_events) >= 2, (
+            f"merged trace carries events from "
+            f"{len(pids_with_events)} process(es) only")
+        cross = fleet_meta["cross_process_request_ids"]
+        assert cross, "no request id spans >= 2 processes in the trace"
+        # verify one id end to end straight from the events, not the
+        # summary: the same request_id on a router_request span AND a
+        # serving-process request span, different pids
+        rid = cross[0]
+        span_pids = {e["pid"] for e in events
+                     if e["ph"] == "b"
+                     and (e.get("args") or {}).get("request_id") == rid}
+        assert len(span_pids) >= 2, (rid, span_pids)
+        _check_nesting(events)
+        # failover arrow: a router span that failed over must flow into
+        # a DIFFERENT process — the rescuing replica's track
+        failed_over = {e["args"]["request_id"] for e in events
+                       if e.get("cat") == "router_request"
+                       and e["ph"] == "b"
+                       and e.get("args", {}).get("failovers", 0) >= 1
+                       and e["args"].get("request_id")}
+        flows = {}
+        for e in events:
+            if e.get("cat") == "fleet" and e["ph"] in ("s", "t", "f"):
+                flows.setdefault(e["id"], {}).setdefault(
+                    e["ph"], set()).add(e["pid"])
+        arrows = 0
+        for rid in failed_over & {i[len("xreq-"):] for i in flows}:
+            flow = flows[f"xreq-{rid}"]
+            src = flow.get("s", set())
+            dst = flow.get("f", set()) | flow.get("t", set())
+            if src and dst and not (src & dst):
+                arrows += 1
+        assert arrows >= 1, (
+            f"no failover flow arrow lands on another process "
+            f"(failed-over ids: {len(failed_over)}, flows: {len(flows)})")
+        print(f"[gate3] merged trace: {len(events)} events across "
+              f"{len(pids_with_events)} processes, {len(cross)} request "
+              f"id(s) span processes, nesting valid, {arrows} failover "
+              f"arrow(s) into the rescuing replica")
+        artifact = os.environ.get("FLEET_TRACE_ARTIFACT")
+        if artifact:
+            from pathway_tpu.engine.flight_recorder import \
+                atomic_write_json
+
+            atomic_write_json(artifact, trace)
+
+        # ---- gate 4: perf-trajectory watch ----------------------------
+        # the post-failover load's numbers join the trajectory too (the
+        # kill-window p95 is load-shape-specific, so it rides under its
+        # own metric names, not the steady-state series)
+        append_bench_history("fleet_canary", {
+            "fleet_kill_queries": kill["queries"],
+            "fleet_lost_queries": kill["lost"],
+            "fleet_failovers": router.failovers_total,
+        }, path=hist)
+        env = dict(os.environ, BENCH_HISTORY_PATH=hist)
+        bench_py = str(pathlib.Path(__file__).resolve().parent.parent
+                       / "bench.py")
+        clean = subprocess.run(
+            [sys.executable, bench_py, "--check-regression"],
+            capture_output=True, text=True, env=env, timeout=120)
+        # NON-vacuous: fleet_p50_ms carries >= 4 real points (> the
+        # min-prior floor), so the newest steady-state window was
+        # genuinely judged against the trailing median of its siblings
+        # — assert the series is old enough to be judged AND passed
+        from pathway_tpu.engine.fleet_observability import \
+            bench_history_rows
+
+        p50_rows = [r for r in bench_history_rows(hist)
+                    if r["metric"] == "fleet_p50_ms"]
+        assert len(p50_rows) >= 4, p50_rows
+        assert clean.returncode == 0, (
+            f"real trajectory flagged as a regression:\n{clean.stdout}"
+            f"\n{clean.stderr}")
+        # seed a synthetic regression: healthy history, then a 60% drop
+        for v in (100.0, 101.0, 99.0, 100.5):
+            append_bench_history("canary", {"synthetic_docs_per_s": v},
+                                 path=hist)
+        append_bench_history("canary", {"synthetic_docs_per_s": 40.0},
+                             path=hist)
+        flagged = subprocess.run(
+            [sys.executable, bench_py, "--check-regression"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert flagged.returncode == 1, (
+            f"seeded synthetic regression NOT flagged:\n{flagged.stdout}")
+        assert "synthetic_docs_per_s" in flagged.stderr, flagged.stderr
+        print(f"[gate4] --check-regression: real trajectory clean, "
+              f"seeded synthetic regression flagged "
+              f"({flagged.stderr.strip().splitlines()[-1]})")
+    finally:
+        fleet.stop()
+
+    print("fleet trace canary: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -167,8 +167,17 @@ def test_batch_trace_file_is_valid_and_nested(monkeypatch, tmp_path):
     pw.debug.compute_and_print(out)
     data = json.loads(path.read_text())
     events = data["traceEvents"]
-    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
-    assert names == {"host leg", "device leg"}
+    thread_names = {e["args"]["name"] for e in events
+                    if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert thread_names == {"host leg", "device leg"}
+    # fleet identity (PR 14): the process track is named role:process and
+    # the payload carries the mergeable clock-anchor meta block
+    proc_names = {e["args"]["name"] for e in events
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+    assert len(proc_names) == 1 and next(iter(proc_names)).count(":") >= 1
+    meta = data["pathway_meta"]
+    assert meta["role"] and meta["process"]
+    assert meta["epoch_wall_us"] > 0
     _check_nesting(events)
     b_ops = [e for e in events if e["ph"] == "B"
              and not e["name"].startswith("tick ")]

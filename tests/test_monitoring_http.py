@@ -540,6 +540,218 @@ def test_router_metrics_through_exposition_lint():
 
 
 # ---------------------------------------------------------------------------
+# fleet metrics aggregation (engine/fleet_observability.py, PR 14): the
+# /fleet/metrics merge must keep the SAME exposition contract the
+# per-process endpoints are gated on — one TYPE line per family however
+# many processes ship it, every sample re-labeled {process=,role=} with
+# exposition-format escaping, and histogram aggregates that stay monotone
+# ---------------------------------------------------------------------------
+
+_ADVERSARIAL_PROCESS = 'pro"cess\\one\nx'
+_ADVERSARIAL_REPLICA = 'rep"lica\\two'
+
+
+def _fleet_doc(process: str, counters: dict[str, float],
+               hist: tuple[tuple[float, int], ...] | None = None,
+               role: str = "replica") -> tuple[dict, str]:
+    lines = []
+    for fam, v in counters.items():
+        lines.append(f"# TYPE {fam} counter")
+        lines.append(f"{fam} {v}")
+    lines.append("# TYPE pathway_tpu_q_ms summary")
+    lines.append('pathway_tpu_q_ms{quantile="0.5"} 4.0')
+    lines.append("# TYPE pathway_tpu_up gauge")
+    lines.append(
+        f'pathway_tpu_up{{replica="{_ADVERSARIAL_REPLICA.replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"}} 1')
+    if hist is not None:
+        lines.append("# TYPE pathway_tpu_wait_ms histogram")
+        total = 0
+        for le, c in hist:
+            total = c
+            le_s = "+Inf" if le == float("inf") else format(le, "g")
+            lines.append(
+                f'pathway_tpu_wait_ms_bucket{{le="{le_s}"}} {c}')
+        lines.append(f"pathway_tpu_wait_ms_sum {float(total)}")
+        lines.append(f"pathway_tpu_wait_ms_count {total}")
+    lines.append("# EOF")
+    return ({"process": process, "role": role}, "\n".join(lines) + "\n")
+
+
+def test_fleet_metrics_label_escaping_round_trips():
+    """Adversarial process AND replica ids survive the merge: the
+    injected process label escapes per the exposition format and decodes
+    back to the raw id, and pre-existing labels are untouched."""
+    from pathway_tpu.engine.fleet_observability import merge_metrics
+
+    merged = merge_metrics([
+        _fleet_doc(_ADVERSARIAL_PROCESS, {"pathway_tpu_reqs": 3}),
+        _fleet_doc("plain", {"pathway_tpu_reqs": 4}),
+    ])
+    samples = _parse_samples(merged.splitlines())
+    procs = set()
+    for f, labels, _v in samples:
+        if f == "pathway_tpu_reqs" and "process" in labels:
+            procs.add(labels["process"].replace(r"\\", "\x00")
+                      .replace(r"\"", '"').replace(r"\n", "\n")
+                      .replace("\x00", "\\"))
+    assert _ADVERSARIAL_PROCESS in procs and "plain" in procs
+    replicas = {labels["replica"].replace(r"\\", "\x00")
+                .replace(r"\"", '"').replace("\x00", "\\")
+                for f, labels, _v in samples
+                if f == "pathway_tpu_up" and "replica" in labels}
+    assert replicas == {_ADVERSARIAL_REPLICA}
+
+
+def test_fleet_metrics_type_declared_once_per_family():
+    """N processes shipping the same family must yield exactly ONE
+    # TYPE declaration (Prometheus rejects redeclaration), with every
+    per-process sample under it and every line lint-clean."""
+    from pathway_tpu.engine.fleet_observability import merge_metrics
+
+    docs = [_fleet_doc(f"p{i}", {"pathway_tpu_reqs": i})
+            for i in range(4)]
+    merged = merge_metrics(docs)
+    lines = merged.splitlines()
+    assert lines[-1] == "# EOF"
+    type_lines = [l for l in lines if l.startswith("# TYPE")]
+    families = [l.split()[2] for l in type_lines]
+    assert len(families) == len(set(families)), families
+    assert families.count("pathway_tpu_reqs") == 1
+    samples = _parse_samples(lines)  # regex lint over every line
+    reqs = [(labels.get("process"), v) for f, labels, v in samples
+            if f == "pathway_tpu_reqs"]
+    # 4 per-process samples + the _fleet sum
+    assert len(reqs) == 5
+    assert ("_fleet", 0 + 1 + 2 + 3) in reqs
+    # every sample family is TYPE-declared (PR-5 contract)
+    typed = set(families)
+    for f, _labels, _v in samples:
+        base = re.sub(r"_(bucket|sum|count)$", "", f)
+        assert f in typed or base in typed, f
+
+
+def test_fleet_metrics_histogram_merge_monotone():
+    """Histogram families merge by summing cumulative buckets — the
+    merged _fleet series must stay monotone with +Inf == _count, and the
+    per-process pass-throughs keep their own invariants."""
+    import math
+
+    from pathway_tpu.engine.fleet_observability import merge_metrics
+
+    h1 = ((1.0, 2), (5.0, 4), (float("inf"), 7))
+    h2 = ((1.0, 1), (5.0, 5), (float("inf"), 6))
+    merged = merge_metrics([
+        _fleet_doc("p1", {}, hist=h1),
+        _fleet_doc("p2", {}, hist=h2),
+    ])
+    samples = _parse_samples(merged.splitlines())
+    fleet_buckets = []
+    fleet_count = None
+    for f, labels, v in samples:
+        if labels.get("process") != "_fleet":
+            continue
+        if f == "pathway_tpu_wait_ms_bucket":
+            le = math.inf if labels["le"] == "+Inf" else float(labels["le"])
+            fleet_buckets.append((le, v))
+        elif f == "pathway_tpu_wait_ms_count":
+            fleet_count = v
+    assert fleet_buckets, "no merged _fleet histogram emitted"
+    fleet_buckets.sort(key=lambda b: b[0])
+    counts = [c for _le, c in fleet_buckets]
+    assert counts == sorted(counts), "merged buckets lost monotonicity"
+    assert fleet_buckets[-1][0] == math.inf
+    assert fleet_buckets[-1][1] == fleet_count == 7 + 6
+    assert counts == [2 + 1, 4 + 5, 7 + 6]
+    # summaries (quantiles) are pass-through only: no fake fleet p50
+    assert not any(f == "pathway_tpu_q_ms"
+                   and labels.get("process") == "_fleet"
+                   for f, labels, _v in samples)
+    # gauges pass through per-process only as well
+    assert not any(f == "pathway_tpu_up"
+                   and labels.get("process") == "_fleet"
+                   for f, labels, _v in samples)
+
+
+def test_fleet_metrics_family_named_like_histogram_suffix():
+    """A counter literally NAMED *_count (or *_sum/_bucket) must keep
+    its own TYPE line and _fleet aggregate — the histogram sub-sample
+    resolution only applies to UNDECLARED suffixed samples."""
+    from pathway_tpu.engine.fleet_observability import merge_metrics
+
+    doc = ("# TYPE pathway_tpu_foo_count counter\n"
+           "pathway_tpu_foo_count 5\n# EOF\n")
+    merged = merge_metrics([({"process": "p1", "role": "replica"}, doc),
+                            ({"process": "p2", "role": "replica"}, doc)])
+    lines = merged.splitlines()
+    assert lines.count("# TYPE pathway_tpu_foo_count counter") == 1
+    samples = _parse_samples(lines)
+    vals = {labels.get("process"): v for f, labels, v in samples
+            if f == "pathway_tpu_foo_count"}
+    assert vals == {"p1": 5, "p2": 5, "_fleet": 10}
+
+
+def test_trace_endpoint_chrome_format_carries_fleet_meta():
+    """/trace?format=chrome serves the mergeable payload: traceEvents +
+    pathway_meta (pid, role, process, clock anchor)."""
+    rt = _recording_runtime()
+    server = MonitoringHttpServer(rt, port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        payload = json.loads(urllib.request.urlopen(
+            base + "/trace?format=chrome").read())
+        assert isinstance(payload["traceEvents"], list)
+        meta = payload["pathway_meta"]
+        assert meta["pid"] > 0 and meta["role"] and meta["process"]
+        assert meta["epoch_wall_us"] > 0
+        # the plain /trace contract is unchanged
+        plain = json.loads(urllib.request.urlopen(
+            base + "/trace").read())
+        assert plain["enabled"] is True and "events" in plain
+    finally:
+        server.stop()
+
+
+def test_router_fleet_metrics_endpoint_merges_live_scrape():
+    """The router's /fleet/metrics scrapes a REAL monitoring endpoint
+    (announced via heartbeat monitoring_port) and serves the merged
+    document with the router's own families alongside."""
+    import socket as _socket
+
+    from pathway_tpu.engine.router import QueryRouter, ReplicaEndpoint
+
+    server = MonitoringHttpServer(_recording_runtime(), port=0)
+    server.start()
+    router = QueryRouter(port=0, control_port=0)
+    router.start()
+    try:
+        a, _b = _socket.socketpair()
+        ep = ReplicaEndpoint("r1", "replica", "127.0.0.1", 1, a)
+        ep.monitoring_port = server.port
+        router._endpoints["r1"] = ep
+        merged = urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/fleet/metrics",
+            timeout=10).read().decode()
+        lines = merged.splitlines()
+        assert lines[-1] == "# EOF"
+        samples = _parse_samples(lines)
+        procs = {labels.get("process") for _f, labels, _v in samples}
+        assert {"router", "r1"} <= procs
+        # a per-process family from the scraped endpoint rode through,
+        # re-labeled
+        assert any(f == "pathway_tpu_insertions"
+                   and labels.get("process") == "r1"
+                   and labels.get("role") == "replica"
+                   for f, labels, _v in samples)
+        # one TYPE line per family in the merged doc
+        fams = [l.split()[2] for l in lines if l.startswith("# TYPE")]
+        assert len(fams) == len(set(fams))
+    finally:
+        router.stop()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
 # auto-jit tier exposition (internals/autojit.py): counter families under
 # the same regex lint + TYPE-declaration contract, /status tier state
 # ---------------------------------------------------------------------------
