@@ -83,6 +83,20 @@ SERVING_DIM = int(os.environ.get("BENCH_SERVING_DIM", KNN_DIM))
 SERVING_QUERIES = int(os.environ.get("BENCH_SERVING_QUERIES", 48))
 SERVING_WARMUP = int(os.environ.get("BENCH_SERVING_WARMUP", 8))
 
+# QoS leg (bench_qos): same workload QoS-off vs QoS-on — the before/after
+# artifact for "the controller actively trades ingest throughput for
+# query latency" (engine/qos.py; ROADMAP "close the SLO control loop")
+QOS_N = int(os.environ.get("BENCH_QOS_N", 20_000))
+QOS_DIM = int(os.environ.get("BENCH_QOS_DIM", 64))
+QOS_QUERIES = int(os.environ.get("BENCH_QOS_QUERIES", 32))
+QOS_WARMUP = int(os.environ.get("BENCH_QOS_WARMUP", 6))
+QOS_INGEST_CHUNK = int(os.environ.get("BENCH_QOS_INGEST_CHUNK", 1024))
+QOS_INGEST_PERIOD_S = float(os.environ.get("BENCH_QOS_INGEST_PERIOD_S",
+                                           0.05))
+QOS_BURST = int(os.environ.get("BENCH_QOS_BURST", 32))
+QOS_K = int(os.environ.get("BENCH_QOS_K", 10))
+QOS_COMMIT_MS = int(os.environ.get("BENCH_QOS_COMMIT_MS", 5))
+
 # evidence rule (ROADMAP): the parent checkpoints every successful
 # device-leg snapshot into BENCH_LASTGOOD.json the moment the child
 # prints it, so a later hang / SIGKILL cannot erase captured numbers
@@ -132,13 +146,30 @@ def _append_bench_history(leg: str, metrics: dict) -> None:
         pass
 
 
+# per-metric direction overrides for series the name heuristics cannot
+# judge (engine/fleet_observability.metric_direction). The qos leg's
+# series need them: "qos_shed_total" carries no marker at all (fewer
+# sheds is better), and the ingest-rate pair is deliberately split —
+# the OFF series is a plain throughput number (higher is better; a drop
+# means the workload itself regressed) while the ON series is the
+# CONTROLLER'S trade and moves with load, so it stays unwatched
+# (reported, never gated) rather than coin-flipped.
+_BENCH_DIRECTIONS = {
+    "qos_shed_total": "lower",
+    "qos_off_ingest_rate_rps": "higher",
+    "qos_p50_speedup": "higher",
+}
+
+
 def check_regression_main(argv: list[str]) -> int:
     """``bench.py --check-regression``: gate the newest BENCH_HISTORY
     point of every watched series against its trailing median. Exit 0
     when the trajectory holds (or is too young to judge), 1 naming each
     regression otherwise. Knobs: ``--history PATH``
     (BENCH_HISTORY_PATH), ``--window N``, ``--min-prior N``,
-    ``--tolerance F`` (BENCH_REGRESSION_TOLERANCE, default 0.35)."""
+    ``--tolerance F`` (BENCH_REGRESSION_TOLERANCE, default 0.35).
+    Direction overrides for heuristic-blind series live in
+    ``_BENCH_DIRECTIONS``."""
     from pathway_tpu.engine.fleet_observability import (
         bench_history_rows, check_regressions, history_path)
 
@@ -162,7 +193,8 @@ def check_regression_main(argv: list[str]) -> int:
         path, window=int(opts["--window"]),
         min_prior=int(opts["--min-prior"]),
         tolerance=(float(opts["--tolerance"])
-                   if opts["--tolerance"] is not None else None))
+                   if opts["--tolerance"] is not None else None),
+        directions=_BENCH_DIRECTIONS)
     series = {(r.get("leg"), r["metric"]) for r in rows}
     print(json.dumps({"check": "regression", "history": path,
                       "rows": len(rows), "series": len(series),
@@ -565,6 +597,21 @@ def main() -> None:
             _append_bench_history("replica", leg_out)
         except Exception as e:  # noqa: BLE001
             errors["replica_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
+    if "qos" not in SKIP:
+        # QoS leg (CPU-runnable): the same heavy-ingest serving workload
+        # QoS-off vs QoS-on — the before/after artifact for "the
+        # controller trades ingest throughput for query latency"
+        # (engine/qos.py), plus visible-shedding / deferral / coalescing
+        # counters from the induced overload phase
+        try:
+            leg_out = bench_qos()
+            result.update(leg_out)
+            _append_bench_history("qos", leg_out)
+            _write_lastgood({k: v for k, v in leg_out.items()
+                             if k.startswith("qos_")})
+        except Exception as e:  # noqa: BLE001
+            errors["qos_error"] = f"{type(e).__name__}: {str(e)[:300]}"
 
     # sidecar path for the device-phase flight beacon, inherited by the
     # child processes; every emit below reads it, so the last surviving
@@ -1153,6 +1200,230 @@ def bench_serving() -> dict:
         vals = np.array([r["stages"][stage] for r in spans])
         out[f"serving_stage_{stage}_p50_ms"] = round(
             float(np.percentile(vals, 50)), 3)
+    return out
+
+
+def _qos_serving_phase(qos_on: bool) -> dict:
+    """One phase of the QoS before/after: a KNN index under HEAVY live
+    ingest (large chunks per commit tick, so the device leg is dominated
+    by maintenance work) serving closed-loop rest queries. Returns the
+    phase's query quantiles, the ingest rate observed DURING the timed
+    query window, and — QoS on — the controller's counters."""
+    import concurrent.futures
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine import streaming as _streaming
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.io.http import PathwayWebserver, rest_connector
+    from pathway_tpu.io.python import ConnectorSubject
+    from pathway_tpu.stdlib.indexing import (
+        default_brute_force_knn_document_index,
+    )
+
+    os.environ["PATHWAY_FLIGHT_RECORDER"] = "1"
+    os.environ.setdefault("PATHWAY_SLO_E2E_MS", "20")
+    if qos_on:
+        os.environ["PATHWAY_QOS"] = "1"
+        # a small admission queue so the induced overload burst below
+        # actually sheds (visible-shedding evidence, never silent)
+        os.environ.setdefault("PATHWAY_QOS_ADMISSION_QUEUE", "8")
+    else:
+        os.environ["PATHWAY_QOS"] = "0"
+    G.clear()
+    dim, n_vecs, chunk = QOS_DIM, QOS_N, QOS_INGEST_CHUNK
+    loaded = threading.Event()
+
+    class HeavyIngest(ConnectorSubject):
+        """Bulk-load the slab, then keep pushing LARGE chunks at a
+        heavy-but-sustainable rate — big enough that an unbudgeted tick
+        spends tens of ms on maintenance (queries blow the SLO), small
+        enough that the engine can keep up (an overload beyond machine
+        capacity grows the backlog without bound and measures nothing
+        but the backlog)."""
+
+        def run(self):
+            rng = np.random.default_rng(7)
+            pushed = 0
+            while pushed < n_vecs:
+                m = min(chunk, n_vecs - pushed)
+                for v in rng.random((m, dim), np.float32) * 2.0 - 1.0:
+                    self.next(v=v)
+                pushed += m
+                if not self._session.sleep(0.002):
+                    return
+            loaded.set()
+            while not self._session.stop_requested:
+                for v in rng.random((chunk, dim), np.float32) * 2.0 - 1.0:
+                    self.next(v=v)
+                if not self._session.sleep(QOS_INGEST_PERIOD_S):
+                    return
+
+    data = pw.io.python.read(
+        HeavyIngest(), schema=sch.schema_from_types(v=np.ndarray),
+        autocommit_duration_ms=QOS_COMMIT_MS, name="qos_ingest")
+    index = default_brute_force_knn_document_index(
+        data.v, data, dimensions=dim, reserved_space=n_vecs + (256 << 10))
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+    qschema = sch.schema_from_types(vec=dt.ANY, k=int)
+    queries, writer = rest_connector(
+        webserver=ws, route="/query", schema=qschema, methods=("POST",),
+        delete_completed_queries=True,
+        autocommit_duration_ms=QOS_COMMIT_MS)
+    qv = queries.select(
+        qv=pw.apply(lambda v: np.asarray(v, dtype=np.float32),
+                    queries.vec),
+        k=queries.k)
+    res = index.query_as_of_now(qv.qv, number_of_matches=qv.k)
+    writer(res.select(
+        n_matches=pw.apply(len, res._pw_index_reply_id)))
+
+    errors: list[BaseException] = []
+
+    def _run():
+        try:
+            pw.run()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    th = threading.Thread(target=_run, daemon=True,
+                          name=f"bench-qos-{'on' if qos_on else 'off'}")
+    th.start()
+    out: dict = {}
+    try:
+        deadline = time.monotonic() + 300.0
+        rt = None
+        while time.monotonic() < deadline and rt is None:
+            live = list(_streaming._ACTIVE_RUNTIMES)
+            if live and ws._started.is_set() and ws.port:
+                rt = live[0]
+            if errors:
+                raise errors[0]
+            time.sleep(0.05)
+        assert rt is not None, "qos runtime never started"
+        assert (rt.qos is not None) == qos_on
+        if not loaded.wait(timeout=max(60.0,
+                                       deadline - time.monotonic())):
+            raise TimeoutError(f"qos slab never loaded ({n_vecs} vecs)")
+        url = f"http://127.0.0.1:{ws.port}/query"
+
+        def ask(vec, timeout=120.0, retries=8):
+            body = json.dumps({"vec": [float(x) for x in vec],
+                               "k": QOS_K}).encode()
+            req = urllib.request.Request(
+                url, data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            for _attempt in range(retries + 1):
+                try:
+                    with urllib.request.urlopen(req,
+                                                timeout=timeout) as resp:
+                        resp.read()
+                    return
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    if e.code != 503 or _attempt == retries:
+                        raise
+                    # the shed contract: back off per Retry-After (capped
+                    # — a closed-loop bench client is exactly who the
+                    # hint is for)
+                    try:
+                        after = float(e.headers.get("Retry-After") or 1)
+                    except ValueError:
+                        after = 1.0
+                    time.sleep(min(after, 1.0))
+
+        def ingested_rows() -> int:
+            return sum(
+                st.get("insertions", 0)
+                for nid, st in rt.scheduler.stats.items()
+                if rt.runner.graph.nodes[nid].name == "qos_ingest")
+
+        qvecs = np.random.default_rng(11).random(
+            (QOS_WARMUP + QOS_QUERIES, dim), np.float32) * 2.0 - 1.0
+        tracker = rt.recorder.requests
+        for i in range(QOS_WARMUP):  # compile + slab upload
+            ask(qvecs[i])
+        # -- timed closed-loop window (sequential, under live ingest) ----
+        n_warm = tracker.count
+        rows0 = ingested_rows()
+        t0 = time.perf_counter()
+        for i in range(QOS_QUERIES):
+            ask(qvecs[QOS_WARMUP + i])
+        window_s = time.perf_counter() - t0
+        rows1 = ingested_rows()
+        n_timed = tracker.count - n_warm
+        spans = tracker.trace_spans()[-n_timed:] if n_timed else []
+        assert spans, "no timed qos request spans completed"
+        e2e = np.array([r["e2e_ms"] for r in spans])
+        tag = "on" if qos_on else "off"
+        out[f"qos_{tag}_knn_p50_e2e_ms"] = round(
+            float(np.percentile(e2e, 50)), 2)
+        out[f"qos_{tag}_knn_p95_e2e_ms"] = round(
+            float(np.percentile(e2e, 95)), 2)
+        out[f"qos_{tag}_ingest_rate_rps"] = round(
+            (rows1 - rows0) / max(window_s, 1e-9), 1)
+        out[f"qos_{tag}_n_queries"] = len(spans)
+        # -- induced overload: a concurrent burst past the queue cap -----
+        def burst_one(i):
+            """(got_503, retry_after_present) — summed on the main
+            thread so concurrent increments cannot race."""
+            try:
+                ask(qvecs[i % len(qvecs)], timeout=60.0)
+                return (0, False)
+            except urllib.error.HTTPError as e:
+                e.read()
+                if e.code == 503:
+                    return (1, bool(e.headers.get("Retry-After")))
+                return (0, False)
+
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=QOS_BURST) as pool:
+            burst = list(pool.map(burst_one, range(QOS_BURST)))
+        shed_503 = sum(b for b, _ra in burst)
+        retry_after_seen = any(ra for _b, ra in burst)
+        out[f"qos_{tag}_burst_503s"] = shed_503
+        if qos_on:
+            q = rt.qos.summary()
+            out["qos_shed_total"] = q["shed_total"]
+            out["qos_ingest_deferrals"] = q["ingest_deferrals"]
+            out["qos_deferred_rows_total"] = q["deferred_rows_total"]
+            out["qos_coalesced_dispatches"] = q["coalesced_dispatches"]
+            out["qos_coalesced_queries"] = q["coalesced_queries"]
+            out["qos_query_budget_ms"] = q["query_budget_ms"]
+            assert retry_after_seen or shed_503 == 0, \
+                "503 without Retry-After violates the shed contract"
+    finally:
+        _streaming.stop_all()
+        th.join(15.0)
+        G.clear()
+        os.environ.pop("PATHWAY_QOS", None)
+    if errors:
+        raise errors[0]
+    return out
+
+
+def bench_qos() -> dict:
+    """QoS before/after leg: the SAME heavy-ingest serving workload with
+    the controller off, then on. The artifact shows the trade the
+    ROADMAP item demands: QoS-on lowers query p50 (budgeted device time,
+    admission control, coalescing) at the cost of measurably deferred
+    ingest; QoS-off runs ingest at full rate while query latency blows
+    out. Plus the shed evidence: the induced overload burst sheds
+    visibly (503 + Retry-After + shed_total), never silently."""
+    out = _qos_serving_phase(qos_on=False)
+    out.update(_qos_serving_phase(qos_on=True))
+    if out.get("qos_off_knn_p50_e2e_ms"):
+        out["qos_p50_speedup"] = round(
+            out["qos_off_knn_p50_e2e_ms"]
+            / max(out["qos_on_knn_p50_e2e_ms"], 1e-9), 3)
+    if out.get("qos_off_ingest_rate_rps"):
+        out["qos_ingest_trade_ratio"] = round(
+            out["qos_on_ingest_rate_rps"]
+            / max(out["qos_off_ingest_rate_rps"], 1e-9), 3)
     return out
 
 

@@ -111,6 +111,7 @@ from pathway_tpu.engine.supervisor import (  # noqa: E402
     ConnectorStalledError,
     WatchdogConfig,
 )
+from pathway_tpu.engine.qos import QosConfig, QueryShedError  # noqa: E402
 from pathway_tpu.internals.config import set_license_key  # noqa: E402
 from pathway_tpu.warmup import enable_compilation_cache, warmup  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402

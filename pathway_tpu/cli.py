@@ -350,7 +350,14 @@ def _collect_and_check(script, mesh=None):
                 code="PWT000",
                 message=f"script failed during collection: {e!r}")], True
         collected = bool(G.tables() or G.outputs)
-        diagnostics = analyze(graph=G, mesh=mesh)
+        from pathway_tpu.engine.qos import qos_enabled_from_env
+
+        # PWT013 arming from the CLI: the script's run-time qos= argument
+        # is unknowable here, but an explicit PATHWAY_QOS decision in the
+        # environment (1 = enabled, 0 = the documented waiver) must be
+        # honored the same way pw.run honors it
+        diagnostics = analyze(graph=G, mesh=mesh,
+                              qos_enabled=qos_enabled_from_env())
         return diagnostics, collected
     finally:
         for (mod, name, _), fn in zip(patched, saved):

@@ -99,6 +99,12 @@ class MonitoringHttpServer:
             # stage (README "Serving SLO")
             payload["serving"] = tracker.summary()
             payload["slow_queries"] = tracker.slow_queries()
+        qos = getattr(self.runtime, "qos", None)
+        if qos is not None:
+            # QoS control plane (engine/qos.py): budget partition,
+            # admission queue, shed/deferral/coalescing counters —
+            # the closed loop's own state next to the measurements
+            payload["qos"] = qos.summary()
         try:
             # auto-jit tier state (internals/autojit.py): enabled flag,
             # fused-program count, backend mix (xla/numpy/interp after
@@ -303,6 +309,38 @@ class MonitoringHttpServer:
             lines.append("# TYPE pathway_tpu_slo_burn_rate gauge")
             lines.append(
                 f"pathway_tpu_slo_burn_rate {round(tracker.burn_rate(), 6)}")
+        qos = getattr(self.runtime, "qos", None)
+        if qos is not None:
+            # QoS control plane (engine/qos.py): the budget the
+            # controller currently reserves for query work, the
+            # admission queue level, and the shed / deferral /
+            # coalescing counters — every shed query is accounted here
+            # (and got its 503 + Retry-After), nothing sheds silently
+            qsum = qos.summary()
+            lines.append("# TYPE pathway_tpu_qos_query_budget_ms gauge")
+            lines.append(f"pathway_tpu_qos_query_budget_ms "
+                         f"{qsum['query_budget_ms']}")
+            lines.append(
+                "# TYPE pathway_tpu_qos_admission_queue_depth gauge")
+            lines.append(f"pathway_tpu_qos_admission_queue_depth "
+                         f"{qsum['admission_queue_depth']}")
+            lines.append("# TYPE pathway_tpu_qos_shed_total counter")
+            lines.append(
+                f"pathway_tpu_qos_shed_total {qsum['shed_total']}")
+            lines.append("# TYPE pathway_tpu_qos_ingest_deferrals counter")
+            lines.append(f"pathway_tpu_qos_ingest_deferrals "
+                         f"{qsum['ingest_deferrals']}")
+            lines.append(
+                "# TYPE pathway_tpu_qos_coalesced_queries counter")
+            lines.append(f"pathway_tpu_qos_coalesced_queries "
+                         f"{qsum['coalesced_queries']}")
+            lines.append(
+                "# TYPE pathway_tpu_qos_coalesced_dispatches counter")
+            lines.append(f"pathway_tpu_qos_coalesced_dispatches "
+                         f"{qsum['coalesced_dispatches']}")
+            lines.append("# TYPE pathway_tpu_qos_shedding gauge")
+            lines.append(f"pathway_tpu_qos_shedding "
+                         f"{1 if qsum['shedding'] else 0}")
         cluster = getattr(self.runtime, "cluster", None)
         if cluster is not None and getattr(cluster, "stats", None):
             # exchange-plane cost per row (engine/multiproc.py), split by

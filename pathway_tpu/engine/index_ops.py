@@ -251,6 +251,17 @@ class ExternalIndexOperator(Operator):
             batch = [(k, v, l, f) for k, (v, l, f)
                      in self.live_queries.items()]
         if batch:
+            if not self.revise and len(batch) > 1:
+                # cross-request coalescing accounting (engine/qos.py):
+                # these as-of-now queries — typically several concurrent
+                # HTTP requests that landed in the same commit tick —
+                # ride ONE kernel dispatch below (the index stacks the
+                # batch into a single device search; per-request top-k
+                # merges on the way out). One module-global probe when
+                # QoS is off.
+                from pathway_tpu.engine.qos import note_coalesced_dispatch
+
+                note_coalesced_dispatch(len(batch))
             replies = self.index.search(batch)
             for (key, _, _, _), reply in zip(batch, replies):
                 reply = tuple(reply)

@@ -654,6 +654,13 @@ class _RecordingSession:
         # loop's seal/take (a push between the take's slice and rebind
         # would otherwise be dropped from durability forever).
         self._seals: list[tuple[int, int]] = []
+        # entries drained (processed) but not yet taken by a commit:
+        # under QoS ingest budgeting (engine/qos.py) a tick's drain may
+        # be PARTIAL, so seals must cover exactly the drained prefix —
+        # pushed-but-undrained entries stay past the newest seal and get
+        # sealed by the later tick that actually drains them (sealed ⊆
+        # processed is preserved at any clip point)
+        self._drained = 0
         self._mutex = create_lock("RecordingSession._mutex")
         self.closed = inner.closed
         self.stopping = inner.stopping
@@ -681,12 +688,17 @@ class _RecordingSession:
 
     def seal(self, tick: int) -> None:
         """Mark everything pushed so far as belonging to ``tick``'s drain
-        (called right before the drain, so sealed ⊆ processed-by-tick)."""
+        (called right before the drain, so sealed ⊆ processed-by-tick).
+        The full-commit path only (end-of-stream, sync callers): the
+        streaming loop's drains all go through :meth:`seal_drain`, and at
+        end of stream the re-drain loop has emptied the inner session, so
+        sealing the whole pending list never covers an unprocessed
+        entry."""
         with self._mutex:
-            self._seal_locked(tick)
+            self._drained = len(self.pending)
+            self._seal_locked(tick, self._drained)
 
-    def _seal_locked(self, tick: int) -> None:
-        n = len(self.pending)
+    def _seal_locked(self, tick: int, n: int) -> None:
         if self._seals and self._seals[-1][1] == n:
             # idle tick: the existing seal already covers these
             # entries at an OLDER tick — keep it (re-stamping to the
@@ -695,7 +707,7 @@ class _RecordingSession:
             return
         self._seals.append((tick, n))
 
-    def seal_drain(self, tick: int) -> list:
+    def seal_drain(self, tick: int, limit: int | None = None) -> list:
         """Atomically drain the inner session AND seal at ``tick`` under
         the push mutex, so *sealed at <= tick* equals *drained at <= tick*
         EXACTLY. The streaming loop uses this instead of seal-then-drain:
@@ -703,10 +715,19 @@ class _RecordingSession:
         processed at ``tick`` but sealed at ``tick+1`` — harmless for
         WAL-only replay, but fatal for operator-state snapshots (the
         snapshot cut at ``tick`` would already contain it while the WAL
-        suffix past ``tick`` replays it again — a double count)."""
+        suffix past ``tick`` replays it again — a double count).
+
+        ``limit`` clips the drain (QoS ingest budgeting): the seal then
+        covers exactly the drained prefix — pending rows beyond it belong
+        to no seal until a later tick drains them, so a deferred row can
+        never be covered by a checkpoint before the engine processed it.
+        Push order and drain order coincide (both append under the push
+        path), so the drained prefix of the inner queue IS the prefix of
+        ``pending``."""
         with self._mutex:
-            entries = self._inner.drain()
-            self._seal_locked(tick)
+            entries = self._inner.drain(limit)
+            self._drained += len(entries)
+            self._seal_locked(tick, self._drained)
             return entries
 
     def take_sealed(self, watermark: int) -> list:
@@ -725,10 +746,11 @@ class _RecordingSession:
             if n == 0:
                 return []
             entries, self.pending = self.pending[:n], self.pending[n:]
+            self._drained -= n
             return entries
 
-    def drain(self) -> list:
-        return self._inner.drain()
+    def drain(self, limit: int | None = None) -> list:
+        return self._inner.drain(limit)
 
     def close(self) -> None:
         self._inner.close()

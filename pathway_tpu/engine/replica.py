@@ -452,6 +452,12 @@ class ControlClient:
             # replica-side SLO burn: /fleet/status shows every process's
             # burn rate next to the router's front-door one
             hb["burn_rate"] = round(tracker.burn_rate(), 4)
+        qos = getattr(rt, "qos", None)
+        if qos is not None:
+            # QoS state rides the heartbeat (engine/qos.py): the router
+            # steers load away from a shedding endpoint BEFORE its p95
+            # degrades, and /fleet/status shows per-endpoint QoS
+            hb["qos"] = qos.heartbeat_state()
         mon = getattr(rt, "http_server", None)
         if mon is not None:
             hb["monitoring_port"] = mon.port

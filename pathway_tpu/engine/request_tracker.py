@@ -7,21 +7,24 @@ span is stamped at five fixed hand-off points as the request crosses the
 serving path:
 
     ingress         arrival at the webserver dispatch (t_ingress)
+    admission       entering the QoS admission gate (t_admission)
     enqueued        row pushed into the connector session (t_enqueued)
     tick start      the commit loop drained the row (t_tick_start)
     host-leg done   the scheduler finished the tick's host leg (t_host_done)
     resolved        response_writer resolved the request key (t_resolved)
     responded       the HTTP handler returned the value (t_responded)
 
-Consecutive stamps define the five stages reported everywhere
-(:data:`STAGES`): ``ingress_wait`` (parse/validate), ``queue`` (waiting
-for the commit tick), ``host`` (host-leg compute), ``device`` (device-leg
-dispatch through resolution — in synchronous mode the host leg subsumes
-it), ``response_write`` (event wake + serialization). Stamps are
-normalized to a monotone sequence (a missing or out-of-order stamp snaps
-to its predecessor), so the stage decomposition **telescopes**: the five
-stages sum to the wall-clock e2e total by construction, which is the
-contract tests/test_request_tracing.py pins.
+Consecutive stamps define the six stages reported everywhere
+(:data:`STAGES`): ``ingress_wait`` (parse/validate), ``admission_wait``
+(time queued at the QoS admission gate, engine/qos.py — ~0 with QoS
+off), ``queue`` (waiting for the commit tick), ``host`` (host-leg
+compute), ``device`` (device-leg dispatch through resolution — in
+synchronous mode the host leg subsumes it), ``response_write`` (event
+wake + serialization). Stamps are normalized to a monotone sequence (a
+missing or out-of-order stamp snaps to its predecessor), so the stage
+decomposition **telescopes**: the six stages sum to the wall-clock e2e
+total by construction, which is the contract
+tests/test_request_tracing.py pins.
 
 Aggregation is streaming and bounded: P² quantile estimators
 (Jain & Chlamtac 1985) for e2e p50/p95/p99 and per-stage p50, a sliding
@@ -42,7 +45,8 @@ import collections
 import time
 
 # stage names, in hand-off order (see module doc)
-STAGES = ("ingress_wait", "queue", "host", "device", "response_write")
+STAGES = ("ingress_wait", "admission_wait", "queue", "host", "device",
+          "response_write")
 
 # router-side stages a request crosses BEFORE the five above begin on the
 # serving process (the fleet prefix of the decomposition): `route` is the
@@ -142,8 +146,9 @@ class RequestSpan:
     every stamp is a single attribute store, ordered by the pipeline's
     own hand-off sequence."""
 
-    __slots__ = ("rid", "route", "key", "tick", "t_ingress", "t_enqueued",
-                 "t_tick_start", "t_host_done", "t_resolved", "t_responded")
+    __slots__ = ("rid", "route", "key", "tick", "t_ingress", "t_admission",
+                 "t_enqueued", "t_tick_start", "t_host_done", "t_resolved",
+                 "t_responded")
 
     def __init__(self, rid: str, route: str, t_ingress: float):
         self.rid = rid
@@ -151,6 +156,7 @@ class RequestSpan:
         self.key = None
         self.tick: int | None = None
         self.t_ingress = t_ingress
+        self.t_admission: float | None = None
         self.t_enqueued: float | None = None
         self.t_tick_start: float | None = None
         self.t_host_done: float | None = None
@@ -158,12 +164,13 @@ class RequestSpan:
         self.t_responded: float | None = None
 
     def normalized_stamps(self) -> list[float]:
-        """The six stamps as a monotone sequence: a missing or
+        """The seven stamps as a monotone sequence: a missing or
         out-of-order stamp snaps to its predecessor, so consecutive
         differences are non-negative and telescope exactly to
         ``t_responded - t_ingress``."""
-        raw = (self.t_ingress, self.t_enqueued, self.t_tick_start,
-               self.t_host_done, self.t_resolved, self.t_responded)
+        raw = (self.t_ingress, self.t_admission, self.t_enqueued,
+               self.t_tick_start, self.t_host_done, self.t_resolved,
+               self.t_responded)
         out = [raw[0]]
         cur = raw[0]
         for t in raw[1:]:
@@ -216,6 +223,15 @@ class RequestTracker:
     # -- write side (stamping, in hand-off order) --------------------------
     def start(self, rid: str, route: str, t_ingress: float) -> RequestSpan:
         return RequestSpan(rid, route, t_ingress)
+
+    def admission(self, span: RequestSpan) -> None:
+        """The handler is about to enter the QoS admission gate
+        (engine/qos.py): everything before this stamp is parse/validate
+        (``ingress_wait``); the gap to the enqueue stamp is
+        ``admission_wait`` — time the query spent queued (or deliberated
+        over) at admission. With QoS off the gate is a no-op and this is
+        stamped immediately before the enqueue, so the stage reads ~0."""
+        span.t_admission = time.perf_counter()
 
     def enqueued(self, span: RequestSpan, key) -> None:
         """Row built and about to be pushed; registers the engine key so
@@ -337,6 +353,25 @@ class RequestTracker:
             return None
         vals.sort()
         return dict(zip(sorted(self._e2e_q), vals))
+
+    def window_size(self) -> int:
+        """Completed requests currently in the burn-rate window. The QoS
+        admission gate (engine/qos.py) refuses to make burn-based shed
+        decisions on a near-empty window: one compile-time outlier must
+        not read as '100x the error budget' and wedge the gate shut."""
+        with self._lock:
+            return len(self._window)
+
+    def window_p50_ms(self) -> float | None:
+        """Median e2e over the RECENT window (not the run-wide P²
+        estimator, which warmup-compile outliers drag for hundreds of
+        observations). The QoS gate's latency prediction uses this: an
+        admission decision is about the system as it is NOW."""
+        with self._lock:
+            if not self._window:
+                return None
+            xs = sorted(self._window)
+            return xs[len(xs) // 2]
 
     def burn_rate(self) -> float:
         """Observed violation ratio over the sliding window, divided by

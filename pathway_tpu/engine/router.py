@@ -146,6 +146,9 @@ class ReplicaEndpoint:
         # align this endpoint's monotonic trace timestamps even when its
         # scraped payload predates the fleet meta block
         self.clock: dict | None = None
+        # QoS state from the heartbeat (engine/qos.py): budget, queue
+        # depth and the shedding flag — the router's steer-away signal
+        self.qos: dict | None = None
 
     def observe(self, ms: float) -> None:
         self.p50.observe(ms)
@@ -193,6 +196,14 @@ class ReplicaEndpoint:
             self.burn_rate = float(hb["burn_rate"])
         if isinstance(hb.get("clock"), dict):
             self.clock = hb["clock"]
+        if isinstance(hb.get("qos"), dict):
+            self.qos = hb["qos"]
+
+    def is_shedding(self) -> bool:
+        """The endpoint's own QoS controller reported active shedding in
+        its latest heartbeat — route around it while anyone else can
+        serve (availability still wins when everyone sheds)."""
+        return bool(self.qos and self.qos.get("shedding"))
 
     def p50_skew_ms(self) -> float | None:
         """Router-observed p50 minus the replica's self-reported serving
@@ -230,6 +241,7 @@ class ReplicaEndpoint:
             "p50_skew_ms": (None if (skew := self.p50_skew_ms()) is None
                             else round(skew, 3)),
             "burn_rate": self.burn_rate,
+            "qos": self.qos,
         }
 
 
@@ -482,6 +494,14 @@ class QueryRouter:
                 "no live replica endpoint (fleet empty, all dead, or "
                 "all already tried)")
         replicas = [e for e in live if e.role == "replica"] or live
+        # QoS steer-away (engine/qos.py): an endpoint whose heartbeat
+        # reports active shedding is bypassed while a non-shedding one
+        # exists — the router reacts to the endpoint's OWN admission
+        # state before its p95 (a lagging estimator) ever degrades.
+        # Availability wins when the whole fleet sheds.
+        not_shedding = [e for e in replicas if not e.is_shedding()]
+        if not_shedding:
+            replicas = not_shedding
         fresh = [e for e in replicas
                  if e.staleness_ticks <= self.max_staleness_ticks]
         if not fresh:
@@ -507,12 +527,18 @@ class QueryRouter:
     def forward(self, method: str, path: str, body: bytes,
                 content_type: str = "application/json",
                 rid: str | None = None, hop: int = 0
-                ) -> tuple[int, bytes, str, int, str, str]:
+                ) -> tuple[int, bytes, str, int, str, str, str | None]:
         """Proxy one query, failing over across replicas until one
         answers. Returns (status, body, serving replica id, failovers,
-        response content type, request id). The query body is held here
-        until a response arrives — replica death mid-flight costs a
-        retry, never the query.
+        response content type, request id, retry-after). The query body
+        is held here until a response arrives — replica death mid-flight
+        costs a retry, never the query.
+
+        Every 503 leaving the router carries ``Retry-After`` (the
+        unified shed contract, engine/qos.py): an unroutable/fleet-dead
+        503 supplies its own hint, and a backend's shed 503 has its
+        upstream ``Retry-After`` propagated instead of dropped with the
+        rest of the upstream headers.
 
         Propagation contract (engine/fleet_observability.py): the
         request id — inbound ``X-Pathway-Request-Id`` or minted here —
@@ -539,7 +565,7 @@ class QueryRouter:
                 detail = (f" (last error: {last_err})" if last_err else "")
                 return (503,
                         f"no replica available{detail}".encode(),
-                        "", failovers, "text/plain", rid)
+                        "", failovers, "text/plain", rid, "1")
             span.note_routed()
             tried.add(ep.replica_id)
             ep.inflight += 1
@@ -555,6 +581,7 @@ class QueryRouter:
                     status = resp.status
                     resp_ctype = resp.getheader(
                         "Content-Type", "application/json")
+                    retry_after = resp.getheader("Retry-After")
                 finally:
                     conn.close()
             # HTTPException covers the replica dying MID-response
@@ -591,7 +618,10 @@ class QueryRouter:
                 if ms > self.slo_ms:
                     self.violations += 1
             self.request_log.finish(span, status, ep.replica_id)
-            return status, data, ep.replica_id, failovers, resp_ctype, rid
+            if status == 503 and not retry_after:
+                retry_after = "1"  # every 503 carries the hint
+            return (status, data, ep.replica_id, failovers, resp_ctype,
+                    rid, retry_after if status == 503 else None)
 
     # -- SLO / scaling -------------------------------------------------------
     def burn_rate(self) -> float:
@@ -925,7 +955,8 @@ class QueryRouter:
             hop = int(handler.headers.get(HOP_HEADER) or 0)
         except ValueError:
             hop = 0
-        status, data, replica_id, failovers, ctype, rid = self.forward(
+        (status, data, replica_id, failovers, ctype, rid,
+         retry_after) = self.forward(
             method, handler.path, body,
             content_type=handler.headers.get("Content-Type",
                                              "application/json"),
@@ -938,6 +969,11 @@ class QueryRouter:
             # replays AND 503s: an unrouted query must still be
             # greppable fleet-wide by the id its client holds
             handler.send_header(REQUEST_ID_HEADER, rid)
+            if retry_after is not None:
+                # unified 503 contract: shed (propagated from the
+                # backend's QoS gate), unroutable and fleet-dead 503s
+                # all tell the client when to come back
+                handler.send_header("Retry-After", retry_after)
             if replica_id:
                 handler.send_header("X-Pathway-Replica", replica_id)
             if failovers:

@@ -44,7 +44,7 @@ class StreamingRuntime:
                  persistence_config=None, terminate_on_error=True,
                  default_commit_ms: int = 100, n_workers: int | None = None,
                  cluster=None, connector_policy=None, watchdog=None,
-                 trace_path: str | None = None, replica=None):
+                 trace_path: str | None = None, replica=None, qos=None):
         from pathway_tpu.engine.supervisor import ConnectorSupervisor
         from pathway_tpu.engine.threads import install_excepthook
         from pathway_tpu.io._datasource import Session
@@ -89,14 +89,28 @@ class StreamingRuntime:
         # it nor mistakes it for an unobserved device error
         self._degraded_engine_error = None
         self.monitor = StatsMonitor(monitoring_level or MonitoringLevel.NONE)
+        # QoS control plane (engine/qos.py): resolved FIRST because an
+        # armed controller needs the measurement plane — QoS implies the
+        # flight recorder (and with it the request tracker)
+        from pathway_tpu.engine.qos import resolve_qos
+
+        self._qos_config = resolve_qos(qos)
+        if self._qos_config is not None and cluster is not None:
+            raise ValueError(
+                "QoS is single-process (the controller partitions ONE "
+                "device's time; scale out with replicas behind the "
+                "router, each running its own controller)")
+        self.qos = None
         # flight recorder (engine/flight_recorder.py): on when a trace
         # path is configured or the data is observable (http server /
-        # live dashboard); otherwise None — one dead branch per op step
+        # live dashboard), or when QoS needs the request tracker;
+        # otherwise None — one dead branch per op step
         from pathway_tpu.engine.flight_recorder import FlightRecorder
 
         self.recorder = FlightRecorder.from_env(
             trace_path=trace_path,
-            auto_on=with_http_server or self.monitor.enabled())
+            auto_on=(with_http_server or self.monitor.enabled()
+                     or self._qos_config is not None))
         if self.recorder is not None:
             # fleet identity on the trace (engine/fleet_observability.py):
             # the merged Perfetto timeline names each process's track by
@@ -203,6 +217,12 @@ class StreamingRuntime:
         # drains THROUGH the proxy (seal_drain) so seals align exactly
         # with drains — the alignment operator-state snapshots require
         self._drain_proxies: dict[int, object] = {}
+        # (ingest_rows, query_rows, deferred) of the latest drain — the
+        # QoS feedback loop's per-tick input
+        self._last_drain: tuple[int, int, bool] = (0, 0, False)
+        # cumulative bridge exec_ms at the last QoS tick (delta = this
+        # tick's resolved device time, the cost-model signal)
+        self._qos_exec_ms_seen = 0.0
 
         # request-scoped serving tracing (engine/request_tracker.py):
         # sources that declare a request_tracker slot (rest_connector)
@@ -214,6 +234,32 @@ class StreamingRuntime:
             for _node, _session, ds in self.sessions:
                 if hasattr(ds, "request_tracker"):
                     ds.request_tracker = self._request_tracker
+        # QoS controller (engine/qos.py): turns the tracker's burn rate /
+        # stage p50s into per-tick ingest budgets, admission decisions
+        # and coalescing accounting. Wired into every serving source's
+        # admission gate; the commit loop consults it per tick.
+        if self._qos_config is not None:
+            if self._request_tracker is None:
+                # PATHWAY_FLIGHT_RECORDER=0 force-disabled the
+                # measurement plane the controller feeds on: refuse the
+                # contradictory config loudly rather than run a control
+                # loop with no inputs
+                raise ValueError(
+                    "QoS is enabled but PATHWAY_FLIGHT_RECORDER=0 "
+                    "force-disabled the flight recorder — the controller "
+                    "needs the request tracker's burn rate; drop one of "
+                    "the two flags")
+            from pathway_tpu.engine.qos import (QosController,
+                                                install_controller)
+
+            self.qos = QosController(self._qos_config,
+                                     self._request_tracker)
+            self.supervisor.backpressure_factor = \
+                self._qos_config.backpressure_factor
+            for _node, _session, ds in self.sessions:
+                if hasattr(ds, "qos"):
+                    ds.qos = self.qos
+            install_controller(self.qos)
 
     def stop(self) -> None:
         self._stop.set()
@@ -278,6 +324,26 @@ class StreamingRuntime:
         self.persistence.commit(
             tick, watermark=wm,
             inflight=bridge["depth"] if bridge is not None else 0)
+
+    def _qos_tick_feedback(self, tick_ms: float) -> None:
+        """Close the loop for one tick: feed the controller what the
+        tick actually did (rows drained, host wall time, the device
+        time that retired on the bridge since the last tick) and
+        propagate deferral backpressure to the connector readers."""
+        ingest_rows, query_rows, deferred = self._last_drain
+        device_ms = None
+        bridge = self.scheduler.bridge_stats()
+        if bridge is not None:
+            # cumulative resolved-leg exec time: the per-tick delta lags
+            # the submitting tick by the in-flight depth, which is fine
+            # for an EWMA cost model
+            seen = bridge["exec_ms"]
+            device_ms = max(0.0, seen - self._qos_exec_ms_seen)
+            self._qos_exec_ms_seen = seen
+        self.qos.on_tick(ingest_rows=ingest_rows, deferred=deferred,
+                         tick_ms=tick_ms, device_ms=device_ms,
+                         queries_in_tick=query_rows)
+        self.supervisor.apply_backpressure(self.qos.backpressure_active)
 
     def _snapshots_enabled(self) -> bool:
         return bool(self._snapshot_every_ticks
@@ -372,25 +438,64 @@ class StreamingRuntime:
         self.scheduler.emit_restored_outputs(snap["tick"])
         return snap["tick"]
 
-    def _drain_and_forward(self, tick: int):
+    def _drain_and_forward(self, tick: int, budgeted: bool = True):
         """Drain local sessions; under a cluster split each source's rows
         by owning process (single reader on process 0 forwards shards —
         reference: 'single reader forwards for non-partitioned sources').
         Returns (any_data, all_closed, pushes) where pushes maps
-        peer -> {source index -> entries}."""
+        peer -> {source index -> entries}.
+
+        With QoS armed (and ``budgeted``), ingest sources drain at most
+        the controller's per-tick row budget (engine/qos.py): clipped
+        rows stay *in their session* and ride later ticks through this
+        same path, so seals keep covering exactly what each tick drained
+        — deferral moves timestamps, never durability or content.
+        Serving sources (request-tracking) are never clipped; the
+        end-of-stream re-drain passes ``budgeted=False`` (latency has no
+        meaning once every source closed — finish at full throughput)."""
         any_data = False
         all_closed = True
         tracker = self._request_tracker
         pushes: dict[int, dict[int, list]] = {}
-        for i, (node, session, datasource) in enumerate(self.sessions):
+        qos = self.qos
+        budget = (qos.ingest_row_budget()
+                  if qos is not None and budgeted else None)
+        ingest_rows = 0
+        query_rows = 0
+        deferred = False
+        n = len(self.sessions)
+        # rotate the drain order of INGEST sources by tick so a tight
+        # budget cannot starve whichever source happens to sit last
+        order = list(range(n))
+        if budget is not None and n > 1:
+            r = tick % n
+            order = order[r:] + order[:r]
+        for i in order:
+            node, session, datasource = self.sessions[i]
+            serving = hasattr(datasource, "request_tracker")
+            limit = None
+            if budget is not None and not serving:
+                limit = budget - ingest_rows
+                if limit < 0:
+                    limit = 0
             rec = self._drain_proxies.get(i)
             # the recording proxy drains + seals atomically: sealed <= t
             # IS drained <= t, the consistency-cut alignment snapshots
             # need (a separate seal would leak gap entries into t+1)
-            entries = session.drain() if rec is None \
-                else rec.seal_drain(tick)
+            entries = session.drain(limit) if rec is None \
+                else rec.seal_drain(tick, limit)
+            if limit is not None and session.backlog() > 0 \
+                    and len(entries) >= limit:
+                # the budget clipped this source: the remainder rides a
+                # later tick (never dropped — visible in the counters)
+                deferred = True
+                qos.note_deferral(session.backlog())
             if entries:
                 any_data = True
+                if serving:
+                    query_rows += len(entries)
+                else:
+                    ingest_rows += len(entries)
                 if tracker is not None and \
                         getattr(datasource, "request_tracker", None) \
                         is tracker:
@@ -404,6 +509,7 @@ class StreamingRuntime:
                 self.scheduler.push_source(node, delta)
             if not session.closed.is_set():
                 all_closed = False
+        self._last_drain = (ingest_rows, query_rows, deferred)
         return any_data, all_closed, pushes
 
     def _tick_sync(self, tick, any_data, all_closed, pushes):
@@ -520,6 +626,10 @@ class StreamingRuntime:
             from pathway_tpu.engine.replica import _poll_interval_s
 
             commit_s = min(commit_s, _poll_interval_s())
+        if self.qos is not None:
+            # the tick interval IS the device-time budget denominator:
+            # a fixed PATHWAY_QOS_QUERY_BUDGET partitions this many ms
+            self.qos.tick_interval_ms = max(1.0, commit_s * 1e3)
         if self._control_client is not None:
             self._control_client.start()
 
@@ -582,6 +692,8 @@ class StreamingRuntime:
                 # SPMD-consistent (single-process keeps ticking — empty
                 # ticks are near-free and drive as-of-now retractions)
                 if self.cluster is None or any_data:
+                    t_tick0 = (_time.perf_counter()
+                               if self.qos is not None else 0.0)
                     self.scheduler.run_time(time_counter)
                     # stamp after the step too: a long (healthy) batch
                     # counts as progress the moment it completes, so only
@@ -593,6 +705,9 @@ class StreamingRuntime:
                     # stamps progress via the watermark listener).
                     self.last_tick_at = _time.monotonic()
                     self._last_completed_tick = time_counter
+                    if self.qos is not None:
+                        self._qos_tick_feedback(
+                            (_time.perf_counter() - t_tick0) * 1e3)
                     self.monitor.update(self.scheduler, self.runner.graph,
                                         time_counter)
                     if self.persistence is not None:
@@ -614,8 +729,10 @@ class StreamingRuntime:
                     # and closing — loop until truly empty, then final tick
                     leftovers = True
                     while leftovers:
+                        # unbudgeted: every source closed — deferred
+                        # ingest drains to completion at full throughput
                         any_data, _closed, pushes = self._drain_and_forward(
-                            time_counter)
+                            time_counter, budgeted=False)
                         any_data, _closed = self._tick_sync(
                             time_counter, any_data, True, pushes)
                         leftovers = any_data
@@ -646,6 +763,14 @@ class StreamingRuntime:
             # closed pipeline, then join them (a reader that ignores the
             # stop event is a bug the thread-leak test fixture catches)
             self._stop.set()  # natural loop exits must also stop helpers
+            if self.qos is not None:
+                # release the module-global hook: a later QoS-off run in
+                # this process must not credit a dead run's controller
+                from pathway_tpu.engine.qos import (current_controller,
+                                                    install_controller)
+
+                if current_controller() is self.qos:
+                    install_controller(None)
             if self._control_client is not None:
                 self._control_client.stop()
             self.watchdog.stop()

@@ -152,6 +152,12 @@ class _SupervisedSession:
         # watchdog (and falsify the connect-timeout baseline) forever
         if not self.detached:
             self._entry.touch()
+            if self._entry.backpressure.is_set():
+                # QoS deferral backpressure: stretch the producer's poll
+                # interval while the controller is protecting query
+                # latency (engine/qos.py; stop still wakes immediately)
+                seconds = seconds \
+                    * self._entry.supervisor.backpressure_factor
         return not self.stopping.wait(seconds)
 
     def push(self, key, row, diff: int = 1, offset=None) -> None:
@@ -212,6 +218,12 @@ class _SupervisedSource:
         self.saw_activity = False
         self.next_restart_at: float | None = None
         self.threads: list[threading.Thread] = []
+        # QoS backpressure (engine/qos.py): raised by the supervisor while
+        # the controller is deferring this source's ingest — the reader's
+        # sleep() stretches so the producer slows at its own cadence. An
+        # Event (not a bare bool) for the same PWT202 reason as stall_flag:
+        # the commit loop sets it, the reader thread reads it.
+        self.backpressure = threading.Event()
 
     def touch(self) -> None:
         self.last_activity = time.monotonic()
@@ -236,6 +248,9 @@ class ConnectorSupervisor:
         # must read as degraded, never healthy
         self.engine_failed = False
         self._stopping = False
+        # QoS backpressure stretch applied to reader sleeps while the
+        # flag is up (engine/qos.py; set by the runtime from QosConfig)
+        self.backpressure_factor = 4.0
         # flight recorder (engine/flight_recorder.py), set by the runtime:
         # stall escalations embed its tail so a ConnectorStalledError
         # names what the engine was executing, not just the silent source
@@ -266,6 +281,20 @@ class ConnectorSupervisor:
                                   live_session, policy, str(name))
         self.entries.append(entry)
         return entry
+
+    def apply_backpressure(self, active: bool) -> None:
+        """Raise/clear QoS deferral backpressure on every INGEST source
+        (serving sources — those carrying a request tracker slot — are
+        the traffic the controller protects, never throttled here).
+        Called by the commit loop each tick (engine/qos.py); readers
+        observe it at their next sleep()."""
+        for entry in self.entries:
+            if hasattr(entry.datasource, "request_tracker"):
+                continue
+            if active:
+                entry.backpressure.set()
+            else:
+                entry.backpressure.clear()
 
     def start_all(self) -> None:
         for entry in self.entries:

@@ -102,6 +102,10 @@ class StatsMonitor:
         # tracker (engine/request_tracker.py) — query quantiles, burn
         # rate and the most recent over-budget request's dominant stage
         self._serving_lines = self._serving_panel(scheduler)
+        # QoS panel: the control loop's side of the serving story —
+        # budget partition, admission queue, shed/deferral/coalescing
+        # (engine/qos.py)
+        self._qos_line = self._qos_panel()
         # paged vector store line: page occupancy, free-list level and
         # growth events (engine/paged_store.py) — page churn and online
         # growth are visible without scraping /metrics
@@ -205,6 +209,8 @@ class StatsMonitor:
         if getattr(self, "_serving_lines", None):
             parts.append(Panel("\n".join(self._serving_lines),
                                title="serving", height=None))
+        if getattr(self, "_qos_line", None):
+            parts.append(Panel(self._qos_line, title="qos", height=None))
         sup_lines = self._supervisor_lines()
         if sup_lines:
             parts.append(Panel("\n".join(sup_lines), title="connectors",
@@ -241,6 +247,29 @@ class StatsMonitor:
                 f"dominant {last['dominant_stage']} "
                 f"({last['stages'][last['dominant_stage']]:.1f}ms)")
         return lines
+
+    def _qos_panel(self) -> str | None:
+        try:
+            from pathway_tpu.engine.qos import current_controller
+
+            ctl = current_controller()
+        except Exception:
+            return None
+        if ctl is None:
+            return None
+        s = ctl.summary()
+        line = (f"{s['mode']}: query budget {s['query_budget_ms']:.1f}ms  "
+                f"ingest {s['ingest_rows_per_tick']} rows/tick  "
+                f"queue {s['admission_queue_depth']}/"
+                f"{s['admission_queue_cap']}  shed {s['shed_total']}  "
+                f"deferrals {s['ingest_deferrals']}  "
+                f"coalesced {s['coalesced_queries']}q/"
+                f"{s['coalesced_dispatches']}d")
+        if s["shedding"]:
+            line += "  SHEDDING"
+        if s["backpressure_active"]:
+            line += "  backpressure"
+        return line
 
     def _paged_panel(self) -> str | None:
         try:
@@ -315,6 +344,8 @@ class StatsMonitor:
                 print(f"[monitor] {self._paged_line}", file=sys.stderr)
             for line in getattr(self, "_serving_lines", None) or ():
                 print(f"[monitor] {line}", file=sys.stderr)
+            if getattr(self, "_qos_line", None):
+                print(f"[monitor] {self._qos_line}", file=sys.stderr)
             for line in self._supervisor_lines():
                 print(f"[monitor] {line}", file=sys.stderr)
 

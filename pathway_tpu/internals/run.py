@@ -14,7 +14,7 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
         runtime_typechecking: bool | None = None, terminate_on_error: bool = True,
         telemetry_config=None, static_check: str | None = None,
         connector_policy=None, watchdog=None, trace_path: str | None = None,
-        replica_of: str | None = None, **kwargs) -> Any:
+        replica_of: str | None = None, qos=None, **kwargs) -> Any:
     """Build the engine graph from all registered outputs and run it.
 
     Static-only graphs run in batch mode to completion; graphs with streaming
@@ -48,6 +48,16 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
     byte-identical to the interpreted path, on by default and disabled
     with ``PATHWAY_AUTO_JIT=0`` (README "Auto-jit").
 
+    ``qos`` (or ``PATHWAY_QOS=1``) arms the QoS control plane
+    (engine/qos.py): per-tick device-time budgeting between query and
+    ingest work steered by the SLO burn rate, bounded query admission
+    with deadline-aware shedding (503 + ``Retry-After``), and
+    cross-request coalescing accounting. ``True`` / a
+    :class:`pw.QosConfig` enable it, ``False`` disables explicitly
+    (the PWT013 waiver), ``None`` defers to the environment. QoS
+    implies the flight recorder (the controller feeds on the request
+    tracker; README "QoS & admission control").
+
     ``replica_of`` (or ``PATHWAY_REPLICA_OF``) runs this program as a
     snapshot-hydrated READ REPLICA of the primary whose persistence root
     it names (engine/replica.py): operator state restores from the newest
@@ -72,7 +82,7 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
 
         replica = ReplicaTailer(replica_of)
     _run_static_check(static_check, persistence_config, terminate_on_error,
-                      connector_policy)
+                      connector_policy, qos=qos)
 
     cfg = get_pathway_config()
     cluster = None
@@ -110,7 +120,7 @@ def run(*, debug: bool = False, monitoring_level=None, with_http_server: bool = 
                     terminate_on_error=terminate_on_error,
                     connector_policy=connector_policy, watchdog=watchdog,
                     cluster=cluster, trace_path=trace_path,
-                    replica=replica)
+                    replica=replica, qos=qos)
                 telemetry.register_scheduler_gauges(rt.scheduler,
                                                     runner.graph)
                 if rt.recorder is not None:
@@ -141,7 +151,7 @@ def run_all(**kwargs):
 
 def _run_static_check(mode: str | None, persistence_config,
                       terminate_on_error: bool | None = None,
-                      connector_policy=None) -> None:
+                      connector_policy=None, qos=None) -> None:
     """Opt-in pre-execution analysis gate for pw.run."""
     import os
 
@@ -157,11 +167,20 @@ def _run_static_check(mode: str | None, persistence_config,
     from pathway_tpu.internals.static_check import (Severity, StaticCheckError,
                                                     analyze)
 
+    # PWT013 arming (the run knows its own QoS decision — the analyzer's
+    # tri-state: True/False are decisions, None defers to the env)
+    qos_enabled: bool | None
+    if qos is None:
+        from pathway_tpu.engine.qos import qos_enabled_from_env
+
+        qos_enabled = qos_enabled_from_env()
+    else:
+        qos_enabled = bool(qos)
     diagnostics = analyze(
         graph=G, persisted=persistence_config is not None,
         mesh=os.environ.get("PATHWAY_STATIC_CHECK_MESH") or None,
         terminate_on_error=terminate_on_error,
-        connector_policy=connector_policy)
+        connector_policy=connector_policy, qos_enabled=qos_enabled)
     if not diagnostics:
         return
     log = logging.getLogger("pathway_tpu.static_check")

@@ -61,7 +61,8 @@ __all__ = [
 def static_check(*tables, persistence: bool | None = None,
                  graph=None, mesh=None,
                  terminate_on_error: bool | None = None,
-                 connector_policy=None) -> list[Diagnostic]:
+                 connector_policy=None,
+                 qos: bool | None = None) -> list[Diagnostic]:
     """Statically validate the pipeline and return its diagnostics.
 
     With explicit ``tables``, those tables count as intended outputs (their
@@ -89,6 +90,10 @@ def static_check(*tables, persistence: bool | None = None,
         persistence = _persistence_config_from_env() is not None
     if mesh is None:
         mesh = os.environ.get("PATHWAY_STATIC_CHECK_MESH") or None
+    if qos is None:
+        from pathway_tpu.engine.qos import qos_enabled_from_env
+
+        qos = qos_enabled_from_env()
     return analyze(tables, graph=graph, persisted=bool(persistence),
                    mesh=mesh, terminate_on_error=terminate_on_error,
-                   connector_policy=connector_policy)
+                   connector_policy=connector_policy, qos_enabled=qos)

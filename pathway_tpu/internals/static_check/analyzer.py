@@ -51,7 +51,7 @@ class _Node:
 class Analyzer:
     def __init__(self, *, graph=None, persisted: bool = False, mesh=None,
                  terminate_on_error: bool | None = None,
-                 connector_policy=None):
+                 connector_policy=None, qos_enabled: bool | None = None):
         if graph is None:
             from pathway_tpu.internals.parse_graph import G as graph
         from pathway_tpu.internals.static_check.shard_check import \
@@ -65,6 +65,12 @@ class Analyzer:
         # run-wide default ConnectorPolicy applied to sources without one
         self.terminate_on_error = terminate_on_error
         self.connector_policy = connector_policy
+        # the run's QoS decision for PWT013 (engine/qos.py), tri-state
+        # like terminate_on_error: True/False are explicit decisions
+        # (False is the documented waiver — a deliberate opt-out), None
+        # means nobody decided (QoS defaults OFF → the "measuring
+        # without acting" square when an SLO target is configured)
+        self.qos_enabled = qos_enabled
         # topology under analysis for the PWT1xx sharding family; None
         # skips the mesh-dependent checks (UDF/placement checks still run).
         # A malformed spec (e.g. a typo'd PATHWAY_STATIC_CHECK_MESH) must
@@ -426,6 +432,7 @@ class Analyzer:
                 node)
 
     def _check_streaming_sources(self, roots, reachable) -> None:
+        qos_reported = False
         for node in list(self._nodes.values()):
             if node.table._plan.kind != "input":
                 continue
@@ -435,6 +442,8 @@ class Analyzer:
                 # the dead-dataflow check (PWT004) already reports it
                 continue
             self._check_failure_policy(node, source)
+            if not qos_reported:
+                qos_reported = self._check_qos_slo(node, source)
             if not roots:
                 self._report(
                     "PWT005",
@@ -469,6 +478,36 @@ class Analyzer:
             f"neither restart nor stop the run — the source is silently "
             f"dropped (give it retries, or let the failure terminate)",
             node)
+
+    def _check_qos_slo(self, node: _Node, source) -> bool:
+        """PWT013: a serving-latency SLO target is configured but the
+        pipeline would run with QoS disabled — the measurement plane
+        (PR 6) is armed while nothing acts on it (engine/qos.py).
+        Arming mirrors PWT012's rules: the check fires only on the one
+        square where nobody decided — ``qos_enabled is None`` means QoS
+        defaults OFF; an explicit False (``pw.run(qos=False)`` /
+        ``PATHWAY_QOS=0``) is the documented waiver, True is the fix.
+        Scoped to pipelines that actually serve (a source carrying a
+        request-tracker slot, i.e. a rest route): a pure ETL graph
+        measures nothing per-request, so there is no loop to close.
+        Returns True when reported (one finding per pipeline)."""
+        if self.qos_enabled is not None:
+            return False
+        if not hasattr(source, "request_tracker"):
+            return False
+        import os
+
+        if not (os.environ.get("PATHWAY_SLO_E2E_MS") or "").strip():
+            return False
+        self._report(
+            "PWT013",
+            f"serving source {node.table._name!r} runs under a configured "
+            f"SLO target (PATHWAY_SLO_E2E_MS) with QoS disabled: latency "
+            f"is measured but nothing acts on it — enable the control "
+            f"loop (pw.run(qos=True) / PATHWAY_QOS=1) or waive "
+            f"explicitly (qos=False / PATHWAY_QOS=0)",
+            node)
+        return True
 
     def _check_sinks(self) -> None:
         for binding in self.graph.outputs:
@@ -567,14 +606,17 @@ def _format_incompatibility(format: str | None, col_t: dt.DType) -> str | None:
 
 def analyze(tables: Iterable = (), *, graph=None, persisted: bool = False,
             mesh=None, terminate_on_error: bool | None = None,
-            connector_policy=None) -> list[Diagnostic]:
+            connector_policy=None,
+            qos_enabled: bool | None = None) -> list[Diagnostic]:
     """Run every static check; see :class:`Analyzer`. ``mesh`` arms the
     mesh-dependent sharding checks against a real or hypothetical
     topology (``"4x2"``, a MeshSpec/MeshConfig, or a jax Mesh);
     ``terminate_on_error`` (the run's escalation mode, when known) arms
     the connector failure-policy check (PWT012), with
     ``connector_policy`` as the run-wide default for sources that set
-    none of their own."""
+    none of their own; ``qos_enabled`` (the run's QoS decision,
+    tri-state) arms the measuring-without-acting check (PWT013)."""
     return Analyzer(graph=graph, persisted=persisted, mesh=mesh,
                     terminate_on_error=terminate_on_error,
-                    connector_policy=connector_policy).run(tables)
+                    connector_policy=connector_policy,
+                    qos_enabled=qos_enabled).run(tables)

@@ -55,6 +55,10 @@ CODES: dict[str, tuple[Severity, str]] = {
                "streaming source with max_retries=0 under "
                "terminate_on_error=False: a crash silently drops the "
                "source"),
+    "PWT013": (Severity.WARNING,
+               "SLO target configured (PATHWAY_SLO_E2E_MS) but the "
+               "pipeline serves with QoS disabled: latency is measured "
+               "but nothing acts on it"),
     # -- PWT1xx: sharding / placement (static_check/shard_check.py) --------
     "PWT101": (Severity.ERROR,
                "mesh axis sizes do not fit the device count"),

@@ -39,6 +39,12 @@ class Session:
         # (reference: connector threads exit when the main loop drops the
         # channel, src/connectors/mod.rs)
         self.stopping = threading.Event()
+        # QoS backpressure (engine/qos.py): while the controller is
+        # deferring ingest to protect query latency, the supervisor
+        # raises this flag and sleep() stretches the reader's poll
+        # interval — producers slow down instead of growing the backlog
+        self.backpressure = threading.Event()
+        self.backpressure_factor = 4.0
 
     @property
     def stop_requested(self) -> bool:
@@ -46,7 +52,11 @@ class Session:
 
     def sleep(self, seconds: float) -> bool:
         """Pause between polls, waking immediately on a stop request.
-        Returns True to keep running, False when the source must exit."""
+        Returns True to keep running, False when the source must exit.
+        While QoS backpressure is up the pause stretches, throttling the
+        producer at its own cadence."""
+        if self.backpressure.is_set():
+            seconds = seconds * self.backpressure_factor
         return not self.stopping.wait(seconds)
 
     def push(self, key: Pointer, row: tuple, diff: int = 1,
@@ -56,13 +66,22 @@ class Session:
         # (engine/persistence.py) and ignored on the plain live path.
         self._q.put((key, row, diff))
 
-    def drain(self) -> list[tuple]:
+    def drain(self, limit: int | None = None) -> list[tuple]:
+        """Pop buffered entries (all of them, or at most ``limit`` when
+        the QoS controller budgets this tick's ingest — the remainder
+        stays queued and rides later ticks)."""
         out = []
-        while True:
+        while limit is None or len(out) < limit:
             try:
                 out.append(self._q.get_nowait())
             except queue.Empty:
                 return out
+        return out
+
+    def backlog(self) -> int:
+        """Approximate queued-entry count (producer threads may race it;
+        used only for deferral accounting and observability)."""
+        return self._q.qsize()
 
     def close(self, reason: str = "eos",
               error: BaseException | None = None) -> None:

@@ -24,6 +24,7 @@ from pathway_tpu.internals.keys import Pointer, hash_values
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Plan, Table
 from pathway_tpu.internals.universe import Universe
+from pathway_tpu.engine.qos import QueryShedError
 from pathway_tpu.io._datasource import (DataSource, Session,
                                          apply_connector_policy)
 
@@ -236,6 +237,16 @@ class PathwayWebserver:
             except _BadRequest as e:
                 return web.Response(status=400, text=str(e),
                                     headers=rid_header)
+            except QueryShedError as e:
+                # QoS admission shed (engine/qos.py): a fast 503 with the
+                # request id AND Retry-After — the unified 503 contract
+                # (the router's unroutable/fleet-dead 503s carry the same
+                # pair). Shedding is visible, never silent: the
+                # controller already counted this query in shed_total.
+                return web.Response(
+                    status=503, text=f"query shed: {e.reason}",
+                    headers={**rid_header,
+                             "Retry-After": str(e.retry_after_s)})
             except Exception as e:
                 return web.Response(status=500, text=repr(e),
                                     headers=rid_header)
@@ -290,6 +301,11 @@ def _openapi_type(d) -> str:
 
 class RestSource(DataSource):
     name = "rest"
+    # QoS admission control (engine/qos.py): the streaming runtime wires
+    # the run's controller here when QoS is armed; None keeps the gate a
+    # dead branch. Admission runs BEFORE session.push — a shed query
+    # never enters the engine.
+    qos = None
     # request-scoped tracing (engine/request_tracker.py): the streaming
     # runtime wires the run's tracker here when the flight recorder is on;
     # None keeps every stamp a dead branch
@@ -344,7 +360,30 @@ class RestSource(DataSource):
             if tracker is not None and ctx is not None:
                 span = tracker.start(ctx.request_id, self.route,
                                      ctx.ingress_t)
+            qos = self.qos
+            admitted = False
             try:
+                if span is not None:
+                    # opens the admission_wait stage: everything from
+                    # here to the enqueue stamp is time spent at the
+                    # QoS gate (~0 with QoS off)
+                    tracker.admission(span)
+                if qos is not None:
+                    # bounded grace for a full queue (absorbs a
+                    # micro-burst without blocking the event loop);
+                    # admit() makes the final counted decision and
+                    # raises QueryShedError on shed — mapped to a fast
+                    # 503 + Retry-After by the dispatcher above
+                    grace_s = qos.config.admission_grace_ms / 1e3
+                    if grace_s > 0:
+                        t_gate = _time.perf_counter()
+                        while not qos.admission_has_capacity() \
+                                and _time.perf_counter() - t_gate \
+                                < grace_s:
+                            await asyncio.sleep(0.002)
+                    qos.admit(ctx.ingress_t if ctx is not None
+                              else _time.perf_counter())
+                    admitted = True
                 with self._lock:
                     self._seq += 1
                     seq = self._seq
@@ -364,6 +403,8 @@ class RestSource(DataSource):
                     session.push(key, row, -1)
                 return slot[0]
             finally:
+                if admitted:
+                    qos.finish_query()
                 if span is not None:
                     tracker.finish(span)
 

@@ -31,7 +31,8 @@ import threading
 import time
 import urllib.request
 
-STAGE_FIELDS = ("ingress_wait", "queue", "host", "device", "response_write")
+STAGE_FIELDS = ("ingress_wait", "admission_wait", "queue", "host",
+                "device", "response_write")
 
 
 def gate_streaming_etl() -> str | None:
@@ -191,7 +192,13 @@ def gate_bench_serving() -> str | None:
         for field in required:
             if field not in last:
                 return f"bench JSON missing {field}: {sorted(last)}"
-            if not last[field] > 0:
+            # admission_wait is legitimately ~0 when QoS is off (the
+            # stamp sits flush against the enqueue); every other stage
+            # must have genuinely elapsed
+            if field == "serving_stage_admission_wait_p50_ms":
+                if last[field] < 0:
+                    return f"bench JSON field {field} negative: {last[field]}"
+            elif not last[field] > 0:
                 return f"bench JSON field {field} not positive: {last[field]}"
         if not lastgood.exists():
             return "BENCH_LASTGOOD.json was not written"

@@ -792,3 +792,117 @@ def test_autojit_families_exposed_and_status_tier_state(monkeypatch):
         assert status["autojit"]["enabled"] is False
     finally:
         autojit.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# unified 503 contract (engine/qos.py): every 503 — webserver shed,
+# router unroutable / fleet-dead, proxied shed — echoes
+# X-Pathway-Request-Id AND carries Retry-After
+# ---------------------------------------------------------------------------
+
+def _drain_http_error(ei):
+    err = ei.value
+    err.read()
+    return err
+
+
+def test_router_unroutable_503_carries_id_and_retry_after():
+    import urllib.error
+
+    from pathway_tpu.engine.router import QueryRouter
+
+    router = QueryRouter()
+    router.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/q", data=b"{}",
+            method="POST",
+            headers={"X-Pathway-Request-Id": "client-rid-42"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        err = _drain_http_error(ei)
+        assert err.code == 503
+        # the id the client holds rides the 503 back (fleet grep-ability)
+        assert err.headers["X-Pathway-Request-Id"] == "client-rid-42"
+        assert int(err.headers["Retry-After"]) >= 1
+        assert router.unroutable_total == 1
+    finally:
+        router.stop()
+
+
+def test_router_propagates_upstream_retry_after_on_shed_503():
+    """A backend's QoS gate shed the query: the router's proxy must keep
+    the upstream Retry-After (previously only body+content-type crossed
+    the proxy) and still echo the request id."""
+    import socket
+    import threading
+    import urllib.error
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from pathway_tpu.engine.router import QueryRouter, ReplicaEndpoint
+
+    class _SheddingBackend(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            body = b"query shed: admission queue full"
+            self.send_response(503)
+            self.send_header("Retry-After", "7")
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    backend = ThreadingHTTPServer(("127.0.0.1", 0), _SheddingBackend)
+    bthread = threading.Thread(target=backend.serve_forever, daemon=True)
+    bthread.start()
+    router = QueryRouter()
+    router.start()
+    try:
+        a, b = socket.socketpair()
+        ep = ReplicaEndpoint("shedder", "replica", "127.0.0.1",
+                             backend.server_address[1], a)
+        router._endpoints["shedder"] = ep
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/q", data=b"{}",
+            method="POST",
+            headers={"X-Pathway-Request-Id": "rid-shed-1"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        err = _drain_http_error(ei)
+        assert err.code == 503
+        assert err.headers["Retry-After"] == "7"       # propagated
+        assert err.headers["X-Pathway-Request-Id"] == "rid-shed-1"
+        b.close()
+    finally:
+        router.stop()
+        backend.shutdown()
+        backend.server_close()
+
+
+def test_webserver_shed_503_carries_id_and_retry_after():
+    """The webserver's own shed path (QueryShedError out of a handler)
+    emits the same 503 pair — id echo + Retry-After."""
+    import urllib.error
+
+    from pathway_tpu.engine.qos import QueryShedError
+    from pathway_tpu.io.http import PathwayWebserver
+
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+
+    async def shedding_handler(payload):
+        raise QueryShedError("admission queue full (test)", 3)
+
+    ws.register("/shed", ("POST",), shedding_handler, None)
+    ws.start()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{ws.port}/shed", data=b"{}", method="POST",
+        headers={"X-Pathway-Request-Id": "rid-web-9"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    err = _drain_http_error(ei)
+    assert err.code == 503
+    assert err.headers["X-Pathway-Request-Id"] == "rid-web-9"
+    assert err.headers["Retry-After"] == "3"

@@ -1,0 +1,715 @@
+"""QoS control plane (engine/qos.py): budgeting, admission, coalescing.
+
+Pins the subsystem's contracts:
+
+- **byte-identity** — with QoS on (and the ingest partition actively
+  clipping drains), the consolidated outputs for all admitted traffic
+  are identical to QoS-off: deferral moves rows to later ticks, never
+  drops, duplicates or alters them;
+- **visible shedding** — every shed query is counted in ``shed_total``
+  AND answered with a 503 carrying ``Retry-After`` + the request id
+  (the unified 503 contract the router shares);
+- **seal alignment under partial drains** — the recording session's
+  seals cover exactly the drained prefix at any clip point, so a
+  checkpoint can never cover a deferred-but-unprocessed row;
+- **coalescing accounting** — concurrent as-of-now queries sharing one
+  kernel dispatch are counted, revise-mode re-answers are not;
+- **PWT013** — SLO configured + QoS disabled warns (measuring without
+  acting), with the explicit-opt-out waiver and both TN squares.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.qos import (QosConfig, QosController,
+                                    QueryShedError, current_controller,
+                                    install_controller, resolve_qos)
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    G.clear()
+    install_controller(None)
+    yield
+    G.clear()
+    install_controller(None)
+
+
+class _FakeTracker:
+    """Minimal RequestTracker stand-in: the controller reads slo_ms,
+    burn_rate(), window_size() and quantiles_ms()."""
+
+    def __init__(self, slo_ms=20.0, burn=0.0, p50=None, window=256):
+        self.slo_ms = slo_ms
+        self.burn = burn
+        self.p50 = p50
+        self.window = window
+
+    def burn_rate(self):
+        return self.burn
+
+    def window_size(self):
+        return self.window
+
+    def quantiles_ms(self):
+        if self.p50 is None:
+            return None
+        return {0.5: self.p50, 0.95: self.p50 * 2, 0.99: self.p50 * 3}
+
+
+def _controller(*, burn=0.0, p50=None, slo=20.0, window=256, **cfg_kwargs):
+    cfg = QosConfig(**cfg_kwargs)
+    return QosController(cfg, _FakeTracker(slo_ms=slo, burn=burn,
+                                           p50=p50, window=window)), cfg
+
+
+# ---------------------------------------------------------------------------
+# config + admission control
+# ---------------------------------------------------------------------------
+
+def test_config_env_knobs(monkeypatch):
+    monkeypatch.setenv("PATHWAY_QOS_QUERY_BUDGET", "12.5")
+    monkeypatch.setenv("PATHWAY_QOS_ADMISSION_QUEUE", "7")
+    monkeypatch.setenv("PATHWAY_QOS_MIN_INGEST_ROWS", "3")
+    cfg = QosConfig.from_env()
+    assert cfg.query_budget_ms == 12.5
+    assert cfg.admission_queue == 7
+    assert cfg.min_ingest_rows == 3
+    monkeypatch.setenv("PATHWAY_QOS_QUERY_BUDGET", "adaptive")
+    assert QosConfig.from_env().query_budget_ms is None
+
+
+def test_resolve_qos_tristate(monkeypatch):
+    monkeypatch.delenv("PATHWAY_QOS", raising=False)
+    assert resolve_qos(None) is None          # default: off
+    assert resolve_qos(False) is None         # explicit opt-out
+    assert isinstance(resolve_qos(True), QosConfig)
+    cfg = QosConfig()
+    assert resolve_qos(cfg) is cfg
+    monkeypatch.setenv("PATHWAY_QOS", "1")
+    assert isinstance(resolve_qos(None), QosConfig)
+    monkeypatch.setenv("PATHWAY_QOS", "0")
+    assert resolve_qos(None) is None
+    with pytest.raises(TypeError):
+        resolve_qos("yes")
+
+
+def test_admission_queue_full_sheds_and_frees():
+    ctl, _ = _controller(admission_queue=1)
+    ctl.admit(time.perf_counter())           # fills the single slot
+    with pytest.raises(QueryShedError) as ei:
+        ctl.admit(time.perf_counter())
+    assert ei.value.retry_after_s >= 1
+    assert ctl.shed_total == 1
+    assert ctl.admitted_total == 1
+    ctl.finish_query()                        # slot freed
+    ctl.admit(time.perf_counter())
+    assert ctl.admitted_total == 2
+    assert ctl.shed_total == 1                # no silent extra counting
+
+
+def test_admission_deadline_shed_under_burn():
+    # burning budget + predicted latency past the deadline (default:
+    # 5x the SLO target — client patience, not the latency target)
+    # -> fast 503
+    ctl, _ = _controller(burn=5.0, p50=600.0, slo=20.0)
+    with pytest.raises(QueryShedError):
+        ctl.admit(time.perf_counter())
+    assert ctl.shed_total == 1
+    # same prediction but healthy burn -> admitted (the queue, not the
+    # gate, absorbs it)
+    ctl2, _ = _controller(burn=0.1, p50=600.0, slo=20.0)
+    ctl2.admit(time.perf_counter())
+    assert ctl2.shed_total == 0
+    # burning but predicted well under the deadline -> admitted (a
+    # degraded-but-fast system serves; only hopeless queries shed)
+    ctl3, _ = _controller(burn=5.0, p50=30.0, slo=20.0)
+    ctl3.admit(time.perf_counter())
+    assert ctl3.shed_total == 0
+    # burn without statistical footing never sheds: one compile-time
+    # outlier in a tiny window must not wedge the gate shut
+    ctl4, _ = _controller(burn=100.0, p50=600.0, slo=20.0, window=1)
+    ctl4.admit(time.perf_counter())
+    assert ctl4.shed_total == 0
+
+
+def test_shedding_flag_tracks_burn_and_queue():
+    ctl, cfg = _controller(burn=5.0, p50=100.0)
+    assert not ctl.is_shedding()              # not serving yet
+    ctl._serving_active_until = time.monotonic() + 60
+    assert ctl.is_shedding()                  # burn past threshold
+    ctl2, cfg2 = _controller(admission_queue=1)
+    ctl2.admit(time.perf_counter())
+    assert ctl2.is_shedding()                 # queue at cap
+
+
+# ---------------------------------------------------------------------------
+# device-time budgeting
+# ---------------------------------------------------------------------------
+
+def test_ingest_bounded_by_ceiling_without_serving():
+    # outside a serving phase the partition sits at its ceiling — never
+    # unlimited: with QoS armed, max_ingest_rows bounds any single
+    # tick's ingest batch (a bulk-push between ticks must not hand the
+    # next tick a monster drain)
+    ctl, cfg = _controller()
+    assert ctl.ingest_row_budget() == cfg.max_ingest_rows
+
+
+def test_aimd_feedback_halves_and_regrows():
+    ctl, cfg = _controller(burn=5.0, p50=100.0, slo=20.0,
+                           min_ingest_rows=8, max_ingest_rows=1024)
+    ctl._serving_active_until = time.monotonic() + 60
+    start = ctl.ingest_row_budget()
+    assert start == 1024
+    ctl.on_tick(ingest_rows=100, deferred=False, tick_ms=10.0)
+    assert ctl.ingest_row_budget() == 512     # multiplicative decrease
+    for _ in range(12):
+        ctl.on_tick(ingest_rows=100, deferred=False, tick_ms=10.0)
+    assert ctl.ingest_row_budget() == cfg.min_ingest_rows  # floor holds
+    ctl.tracker.burn = 0.0                    # pressure gone
+    ctl.tracker.p50 = 1.0
+    for _ in range(40):
+        ctl.on_tick(ingest_rows=100, deferred=False, tick_ms=10.0)
+    assert ctl.ingest_row_budget() == cfg.max_ingest_rows  # regrown
+
+
+def test_fixed_budget_translates_ms_to_rows():
+    ctl, _ = _controller(query_budget_ms=60.0, min_ingest_rows=1,
+                         max_ingest_rows=10_000)
+    ctl.tick_interval_ms = 100.0
+    ctl._serving_active_until = time.monotonic() + 60
+    # learn the cost: ingest-only ticks at 0.1 ms/row
+    for _ in range(20):
+        ctl.on_tick(ingest_rows=100, deferred=False, tick_ms=10.0,
+                    device_ms=10.0, queries_in_tick=0)
+    # 100 ms tick - 60 ms query budget = 40 ms ingest at ~0.1 ms/row
+    assert ctl.ingest_row_budget() == pytest.approx(400, rel=0.25)
+    assert ctl.query_budget_ms() == 60.0
+
+
+def test_budget_relaxes_gradually_when_serving_stops():
+    ctl, cfg = _controller(burn=5.0, p50=100.0, min_ingest_rows=8,
+                           max_ingest_rows=1024)
+    ctl._serving_active_until = time.monotonic() + 0.05
+    for _ in range(10):                       # drive to the floor
+        ctl.on_tick(ingest_rows=10, deferred=True, tick_ms=5.0)
+    assert ctl.ingest_row_budget() == cfg.min_ingest_rows
+    time.sleep(0.06)                          # serving window expires
+    # relaxation is GRADUAL: the deferred backlog drains over bounded
+    # ticks (x4/tick), never one monster tick — and even fully relaxed
+    # the allowance tops out at the ceiling, never unlimited
+    ctl.on_tick(ingest_rows=10, deferred=False, tick_ms=5.0)
+    first = ctl.ingest_row_budget()
+    assert first < 1024
+    for _ in range(5):
+        ctl.on_tick(ingest_rows=10, deferred=False, tick_ms=5.0)
+    assert ctl.ingest_row_budget() == cfg.max_ingest_rows
+    assert not ctl.backpressure_active
+
+
+# ---------------------------------------------------------------------------
+# partial drains: Session + recording-session seal alignment
+# ---------------------------------------------------------------------------
+
+def test_session_partial_drain_keeps_backlog():
+    from pathway_tpu.io._datasource import Session
+
+    s = Session()
+    for i in range(10):
+        s.push(i, (i,), 1)
+    first = s.drain(4)
+    assert [k for k, _r, _d in first] == [0, 1, 2, 3]
+    assert s.backlog() == 6
+    assert len(s.drain(None)) == 6
+    assert s.backlog() == 0
+    assert s.drain(0) == []
+
+
+def test_recording_session_seals_cover_exactly_the_drained_prefix():
+    from pathway_tpu.engine.persistence import _RecordingSession
+    from pathway_tpu.io._datasource import Session
+
+    inner = Session()
+    rec = _RecordingSession(inner, skip=0)
+    for i in range(10):
+        rec.push(i, (i,), 1, offset=i)
+    # tick 1 drains only 4 rows: the seal must cover exactly those 4
+    drained = rec.seal_drain(1, limit=4)
+    assert len(drained) == 4
+    taken = rec.take_sealed(1)
+    assert [e[0] for e in taken] == [0, 1, 2, 3]
+    # the 6 deferred rows were NOT durable-eligible at tick 1
+    assert rec.take_sealed(1) == []
+    # tick 2 drains the rest (plus 2 new pushes mid-flight)
+    rec.push(10, (10,), 1, offset=10)
+    rec.push(11, (11,), 1, offset=11)
+    drained2 = rec.seal_drain(2)
+    assert len(drained2) == 8
+    taken2 = rec.take_sealed(2)
+    assert [e[0] for e in taken2] == [4, 5, 6, 7, 8, 9, 10, 11]
+    assert rec.pending == []
+
+
+def test_recording_session_partial_then_watermark_lag():
+    """A frozen watermark must hold back ONLY undrained/later seals —
+    the partial-drain bookkeeping keeps earlier ticks takeable."""
+    from pathway_tpu.engine.persistence import _RecordingSession
+    from pathway_tpu.io._datasource import Session
+
+    inner = Session()
+    rec = _RecordingSession(inner, skip=0)
+    for i in range(6):
+        rec.push(i, (i,), 1, offset=i)
+    rec.seal_drain(1, limit=2)
+    rec.seal_drain(2, limit=2)
+    rec.seal_drain(3)
+    # watermark at 2: ticks 1+2 durable-eligible, tick 3's rows held
+    taken = rec.take_sealed(2)
+    assert [e[0] for e in taken] == [0, 1, 2, 3]
+    assert [e[0] for e in rec.take_sealed(3)] == [4, 5]
+
+
+# ---------------------------------------------------------------------------
+# coalescing accounting
+# ---------------------------------------------------------------------------
+
+class _FakeIndex:
+    def __init__(self):
+        self.search_calls = 0
+
+    def add(self, key, vec, filt):
+        pass
+
+    def remove(self, key):
+        pass
+
+    def search(self, queries):
+        self.search_calls += 1
+        return [((key, 0.0),) for key, _v, _l, _f in queries]
+
+
+def test_coalesced_queries_counted_once_per_dispatch():
+    from pathway_tpu.engine.delta import Delta
+    from pathway_tpu.engine.index_ops import ExternalIndexOperator
+
+    ctl, _ = _controller()
+    install_controller(ctl)
+    idx = _FakeIndex()
+    op = ExternalIndexOperator(idx, data_vec_pos=0, data_filter_pos=None,
+                               query_vec_pos=0, query_limit_pos=None,
+                               query_filter_pos=None)
+    queries = Delta([(i, ([0.0],), 1) for i in range(3)])
+    op.step(1, [Delta(), queries])
+    assert idx.search_calls == 1              # ONE kernel dispatch
+    assert ctl.coalesced_dispatches == 1
+    assert ctl.coalesced_queries == 3
+    # a single query is not "coalesced"
+    op.step(2, [Delta(), Delta([(9, ([0.0],), 1)])])
+    assert ctl.coalesced_dispatches == 1
+
+
+def test_revise_mode_reanswers_not_counted():
+    from pathway_tpu.engine.delta import Delta
+    from pathway_tpu.engine.index_ops import ExternalIndexOperator
+
+    ctl, _ = _controller()
+    install_controller(ctl)
+    op = ExternalIndexOperator(_FakeIndex(), data_vec_pos=0,
+                               data_filter_pos=None, query_vec_pos=0,
+                               query_limit_pos=None, query_filter_pos=None,
+                               revise=True)
+    queries = Delta([(i, ([0.0],), 1) for i in range(3)])
+    op.step(1, [Delta(), queries])
+    assert ctl.coalesced_dispatches == 0      # standing-query re-answers
+
+
+def test_hook_is_noop_without_controller():
+    from pathway_tpu.engine.qos import note_coalesced_dispatch
+
+    note_coalesced_dispatch(5)                # must not raise
+    assert current_controller() is None
+
+
+# ---------------------------------------------------------------------------
+# end to end: byte-identity + deferral + visible shedding
+# ---------------------------------------------------------------------------
+
+def _run_counts(monkeypatch, words, *, qos_env: bool) -> tuple[dict, dict]:
+    """Stream word rows, return (final counts, qos counters)."""
+    from pathway_tpu.testing.faults import flaky_subject
+
+    G.clear()
+    if qos_env:
+        monkeypatch.setenv("PATHWAY_QOS", "1")
+        # force the partition (no live HTTP queries in this test) and
+        # clamp it tight so a 300-row burst MUST defer across ticks
+        monkeypatch.setenv("PATHWAY_QOS_ALWAYS_BUDGET", "1")
+        monkeypatch.setenv("PATHWAY_QOS_MIN_INGEST_ROWS", "16")
+        monkeypatch.setenv("PATHWAY_QOS_MAX_INGEST_ROWS", "16")
+    else:
+        monkeypatch.delenv("PATHWAY_QOS", raising=False)
+        monkeypatch.delenv("PATHWAY_QOS_ALWAYS_BUDGET", raising=False)
+    t = pw.io.python.read(
+        flaky_subject([{"word": w} for w in words], fail_after=0,
+                      fail_attempts=0),
+        schema=pw.schema_from_types(word=str), autocommit_duration_ms=5)
+    counts = t.groupby(t.word).reduce(word=t.word, c=pw.reducers.count())
+    state: dict[str, int] = {}
+    captured: list = []
+
+    def on_change(key, row, time, is_addition):
+        if not captured:
+            ctl = current_controller()
+            if ctl is not None:
+                captured.append(ctl)
+        if is_addition:
+            state[row["word"]] = row["c"]
+        elif state.get(row["word"]) == row["c"]:
+            del state[row["word"]]
+
+    pw.io.subscribe(counts, on_change)
+    pw.run()
+    qstats = captured[0].summary() if captured else {}
+    return state, qstats
+
+
+def test_e2e_identity_with_forced_deferral(monkeypatch):
+    """The acceptance invariant: consolidated outputs of admitted
+    traffic are identical QoS-on vs QoS-off, while the controller
+    demonstrably deferred ingest (rows rode later ticks)."""
+    words = [f"w{i % 37}" for i in range(300)]
+    base, _ = _run_counts(monkeypatch, words, qos_env=False)
+    qos, qstats = _run_counts(monkeypatch, words, qos_env=True)
+    assert qos == base                        # nothing dropped or altered
+    assert sum(base.values()) == 300
+    assert qstats["ingest_deferrals"] >= 1    # the clip actually happened
+    assert qstats["deferred_rows_total"] >= 1
+    assert qstats["shed_total"] == 0          # ingest defers, never sheds
+
+
+def test_e2e_shed_is_visible_503_with_retry_after(monkeypatch):
+    """A shed query = 503 + Retry-After + X-Pathway-Request-Id AND a
+    shed_total increment — never a silent drop."""
+    from pathway_tpu.engine import streaming as _streaming
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.io.http import PathwayWebserver, rest_connector
+
+    monkeypatch.setenv("PATHWAY_QOS", "1")
+    monkeypatch.setenv("PATHWAY_FLIGHT_RECORDER", "1")
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+    schema = sch.schema_from_types(query=str)
+    table, writer = rest_connector(
+        webserver=ws, route="/q", schema=schema, methods=("POST",),
+        delete_completed_queries=True, autocommit_duration_ms=10)
+    writer(table.select(result=pw.apply(str.upper, table.query)))
+
+    errors: list = []
+
+    def _run():
+        try:
+            pw.run()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    th = threading.Thread(target=_run, daemon=True)
+    th.start()
+    deadline = time.monotonic() + 20.0
+    rt = None
+    while time.monotonic() < deadline:
+        live = list(_streaming._ACTIVE_RUNTIMES)
+        if live and ws._started.is_set() and ws.port \
+                and getattr(live[0], "qos", None) is not None:
+            rt = live[0]
+            break
+        time.sleep(0.02)
+    try:
+        assert rt is not None and not errors, f"no runtime: {errors}"
+
+        def ask(q):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ws.port}/q",
+                data=json.dumps({"query": q}).encode(), method="POST",
+                headers={"Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=15)
+
+        with ask("ok") as resp:               # healthy baseline
+            assert resp.status == 200
+
+        # force the gate shut: queue pinned at its cap
+        rt.qos._queue_depth = rt.qos.config.admission_queue
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            ask("shed-me")
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert ei.value.headers["X-Pathway-Request-Id"]
+        assert rt.qos.shed_total == 1
+        rt.qos._queue_depth = 0               # gate open again
+        with ask("ok2") as resp:
+            assert resp.status == 200
+            assert resp.read() == b"OK2"
+        assert rt.qos.admitted_total == 2
+    finally:
+        _streaming.stop_all()
+        th.join(10.0)
+        G.clear()
+    assert not errors, f"pipeline failed: {errors}"
+
+
+def test_admission_wait_stage_telescopes():
+    """The new stage slots into the decomposition without breaking the
+    sum-to-e2e contract (satellite: tracker admission_wait)."""
+    from pathway_tpu.engine.request_tracker import (STAGES, RequestSpan,
+                                                    RequestTracker)
+
+    assert "admission_wait" in STAGES
+    tr = RequestTracker(slo_ms=1000.0)
+    span = tr.start("rid-1", "/q", t_ingress=100.0)
+    span.t_admission = 100.010   # 10 ms parse/validate
+    span.t_enqueued = 100.060    # 50 ms queued at the admission gate
+    span.t_tick_start = 100.070
+    span.t_host_done = 100.080
+    span.t_resolved = 100.090
+    span.t_responded = 100.100
+    stages = span.stages_ms()
+    assert stages["ingress_wait"] == pytest.approx(10.0)
+    assert stages["admission_wait"] == pytest.approx(50.0)
+    assert sum(stages.values()) == pytest.approx(
+        (span.t_responded - span.t_ingress) * 1e3)
+    # QoS off: no admission stamp -> the stage reads 0, still telescopes
+    span2 = tr.start("rid-2", "/q", t_ingress=5.0)
+    span2.t_enqueued = 5.020
+    span2.t_resolved = 5.030
+    span2.t_responded = 5.040
+    s2 = span2.stages_ms()
+    assert s2["admission_wait"] == pytest.approx(20.0)  # snaps into gap
+    assert sum(s2.values()) == pytest.approx(40.0)
+
+
+# ---------------------------------------------------------------------------
+# backpressure propagation
+# ---------------------------------------------------------------------------
+
+def test_supervisor_backpressure_spares_serving_sources():
+    from pathway_tpu.engine.supervisor import ConnectorSupervisor
+    from pathway_tpu.io._datasource import DataSource, Session
+
+    class _Ingest(DataSource):
+        name = "ingest"
+
+    class _Serving(DataSource):
+        name = "serving"
+        request_tracker = None  # the serving marker slot
+
+    sup = ConnectorSupervisor()
+    schema = pw.schema_from_types(x=int)
+    e1 = sup.add_source(None, _Ingest(schema), Session(), Session())
+    e2 = sup.add_source(None, _Serving(schema), Session(), Session())
+    sup.apply_backpressure(True)
+    assert e1.backpressure.is_set()
+    assert not e2.backpressure.is_set()       # never throttle queries
+    sup.apply_backpressure(False)
+    assert not e1.backpressure.is_set()
+
+
+def test_session_sleep_stretches_under_backpressure():
+    from pathway_tpu.io._datasource import Session
+
+    s = Session()
+    s.backpressure_factor = 5.0
+    t0 = time.perf_counter()
+    assert s.sleep(0.01)
+    fast = time.perf_counter() - t0
+    s.backpressure.set()
+    t0 = time.perf_counter()
+    assert s.sleep(0.01)
+    slow = time.perf_counter() - t0
+    assert slow >= 0.045 > fast
+
+
+# ---------------------------------------------------------------------------
+# PWT013: measuring without acting
+# ---------------------------------------------------------------------------
+
+def _serving_graph():
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.io.http import PathwayWebserver, rest_connector
+
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+    table, writer = rest_connector(
+        webserver=ws, route="/q",
+        schema=sch.schema_from_types(query=str), methods=("POST",))
+    writer(table.select(result=pw.apply(str.upper, table.query)))
+
+
+def _codes(**kwargs):
+    return {d.code for d in pw.static_check(**kwargs)}
+
+
+def test_pwt013_tp_slo_set_qos_unset(monkeypatch):
+    monkeypatch.setenv("PATHWAY_SLO_E2E_MS", "20")
+    monkeypatch.delenv("PATHWAY_QOS", raising=False)
+    _serving_graph()
+    assert "PWT013" in _codes()
+
+
+def test_pwt013_tn_qos_enabled(monkeypatch):
+    monkeypatch.setenv("PATHWAY_SLO_E2E_MS", "20")
+    monkeypatch.setenv("PATHWAY_QOS", "1")
+    _serving_graph()
+    assert "PWT013" not in _codes()
+
+
+def test_pwt013_waiver_explicit_opt_out(monkeypatch):
+    # PATHWAY_QOS=0 is a DECISION (the documented waiver): no warning
+    monkeypatch.setenv("PATHWAY_SLO_E2E_MS", "20")
+    monkeypatch.setenv("PATHWAY_QOS", "0")
+    _serving_graph()
+    assert "PWT013" not in _codes()
+    # the API argument waives the same way
+    monkeypatch.delenv("PATHWAY_QOS", raising=False)
+    assert "PWT013" not in _codes(qos=False)
+
+
+def test_pwt013_tn_no_slo_or_no_serving(monkeypatch):
+    monkeypatch.delenv("PATHWAY_SLO_E2E_MS", raising=False)
+    monkeypatch.delenv("PATHWAY_QOS", raising=False)
+    _serving_graph()
+    assert "PWT013" not in _codes()           # nothing measured: no loop
+    G.clear()
+    monkeypatch.setenv("PATHWAY_SLO_E2E_MS", "20")
+    from pathway_tpu.testing.faults import flaky_subject
+
+    t = pw.io.python.read(
+        flaky_subject([{"word": "a"}], fail_after=0, fail_attempts=0),
+        schema=pw.schema_from_types(word=str))
+    pw.io.subscribe(t, lambda *a, **k: None)
+    assert "PWT013" not in _codes()           # pure ETL: nothing serves
+
+
+# ---------------------------------------------------------------------------
+# exposition: pathway_tpu_qos_* families
+# ---------------------------------------------------------------------------
+
+def test_qos_metrics_families_and_status(monkeypatch):
+    from pathway_tpu.engine.http_server import MonitoringHttpServer
+    from tests.test_monitoring_http import _parse_samples
+
+    ctl, _ = _controller()
+    ctl.shed_total = 3
+    ctl.ingest_deferrals = 7
+    ctl.coalesced_queries = 12
+    ctl.coalesced_dispatches = 4
+
+    class _RT:
+        qos = ctl
+        sessions: list = []
+
+        class scheduler:
+            recorder = None
+            stats: dict = {}
+
+        class runner:
+            class graph:
+                nodes: list = []
+
+    server = MonitoringHttpServer(_RT(), port=0)
+    lines = server.metrics_payload().splitlines()
+    samples = _parse_samples(lines)           # regex lint over every line
+    vals = {f: v for f, _l, v in samples}
+    assert vals["pathway_tpu_qos_shed_total"] == 3.0
+    assert vals["pathway_tpu_qos_ingest_deferrals"] == 7.0
+    assert vals["pathway_tpu_qos_coalesced_queries"] == 12.0
+    assert vals["pathway_tpu_qos_admission_queue_depth"] == 0.0
+    assert "pathway_tpu_qos_query_budget_ms" in vals
+    typed = {ln.split()[2] for ln in lines if ln.startswith("# TYPE")}
+    for fam in ("pathway_tpu_qos_query_budget_ms",
+                "pathway_tpu_qos_ingest_deferrals",
+                "pathway_tpu_qos_shed_total",
+                "pathway_tpu_qos_coalesced_queries",
+                "pathway_tpu_qos_admission_queue_depth"):
+        assert fam in typed, f"{fam} has no # TYPE line"
+    status = server.status_payload()
+    assert status["qos"]["shed_total"] == 3
+    assert status["qos"]["enabled"] is True
+    assert status["qos"]["mode"] == "adaptive"
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: heartbeat QoS state steers the router
+# ---------------------------------------------------------------------------
+
+def test_router_steers_away_from_shedding_endpoint():
+    import socket
+
+    from pathway_tpu.engine.router import QueryRouter, ReplicaEndpoint
+
+    router = QueryRouter()
+    socks = []
+
+    def _ep(rid, p50, shedding):
+        a, b = socket.socketpair()
+        socks.append((a, b))
+        ep = ReplicaEndpoint(rid, "replica", "127.0.0.1", 1, a)
+        for _ in range(8):
+            ep.observe(p50)
+        ep.apply_heartbeat({"qos": {"shedding": shedding,
+                                    "shed_total": 5 if shedding else 0}})
+        router._endpoints[rid] = ep
+        return ep
+
+    try:
+        fast_shedding = _ep("fast-shedding", 1.0, True)
+        slow_healthy = _ep("slow-healthy", 50.0, False)
+        # the fast endpoint is actively shedding: the router must steer
+        # to the slower healthy one BEFORE p95 ever degrades
+        assert router.choose().replica_id == "slow-healthy"
+        # availability wins when the WHOLE fleet sheds
+        slow_healthy.apply_heartbeat({"qos": {"shedding": True}})
+        assert router.choose().replica_id in ("fast-shedding",
+                                              "slow-healthy")
+        # recovery: the heartbeat clears the flag, endpoint rejoins
+        fast_shedding.apply_heartbeat({"qos": {"shedding": False}})
+        assert router.choose().replica_id == "fast-shedding"
+        # /fleet/status shows per-endpoint QoS state
+        fleet = router.fleet_status_payload()["fleet"]
+        by_id = {e["replica"]: e for e in fleet}
+        assert by_id["slow-healthy"]["qos"]["shedding"] is True
+        assert by_id["fast-shedding"]["qos"]["shedding"] is False
+    finally:
+        for a, b in socks:
+            a.close()
+            b.close()
+
+
+def test_heartbeat_payload_carries_qos_state():
+    from pathway_tpu.engine.replica import ControlClient
+
+    ctl, _ = _controller()
+    ctl.shed_total = 2
+
+    class _RT:
+        qos = ctl
+        sessions: list = []
+        recorder = None
+        persistence = None
+        replica = None
+        http_server = None
+
+    client = ControlClient.__new__(ControlClient)
+    client.runtime = _RT()
+    client.replica_id = "r1"
+    client.role = "replica"
+    hb = client._heartbeat_payload()
+    assert hb["qos"]["shed_total"] == 2
+    assert hb["qos"]["shedding"] is False
+    assert "query_budget_ms" in hb["qos"]
