@@ -158,6 +158,19 @@ _BENCH_DIRECTIONS = {
     "qos_shed_total": "lower",
     "qos_off_ingest_rate_rps": "higher",
     "qos_p50_speedup": "higher",
+    # recovery leg: the bounded-restart contract is "smaller is better"
+    # across the board. The ratio carries no unit marker at all (a bare
+    # max/min quotient — growth means snapshot restart is no longer flat
+    # in history size), and the restart series are pinned explicitly so
+    # the suffix heuristic's `_s_<n>` match is a backstop, not the only
+    # thing watching the recovery trajectory.
+    "recovery_snapshot_ratio_maxmin": "lower",
+    "recovery_walonly_restart_s_1000": "lower",
+    "recovery_walonly_restart_s_10000": "lower",
+    "recovery_walonly_restart_s_100000": "lower",
+    "recovery_snapshot_restart_s_1000": "lower",
+    "recovery_snapshot_restart_s_10000": "lower",
+    "recovery_snapshot_restart_s_100000": "lower",
 }
 
 

@@ -151,8 +151,28 @@ replay = _apply(_spawn_opts, replay)
                    "over the given source files/directories (thread "
                    "inventory, lock inventory, lock-order graph) — "
                    "nothing is imported or executed")
+@click.option("--durability", "durability", is_flag=True,
+              help="run the PWT3xx durability lint instead: an AST pass "
+                   "over the given source files/directories (snapshot "
+                   "capture/restore contracts, atomic persistence writes, "
+                   "restricted unpickling) — nothing is imported or "
+                   "executed")
+@click.option("--all", "all_families", is_flag=True,
+              help="run every check family in one pass: script analysis "
+                   "(PWT0xx expression + PWT1xx shard) over .py file "
+                   "arguments, source lints (PWT2xx concurrency + PWT3xx "
+                   "durability) over directory arguments; --json emits a "
+                   "versioned per-family payload and the exit code is a "
+                   "bitmask (expression=1, shard=2, concurrency=4, "
+                   "durability=8)")
+@click.option("--list-waivers", "list_waivers", is_flag=True,
+              help="report every inline 'pwt-ok' waiver under the given "
+                   "source trees (code, file:line, justification) instead "
+                   "of linting; --json emits a machine-readable list for "
+                   "CI audit artifacts")
 @click.argument("paths", nargs=-1, required=True)
-def check(paths, strict, require_pipeline, tpu_mesh, as_json, concurrency):
+def check(paths, strict, require_pipeline, tpu_mesh, as_json, concurrency,
+          durability, all_families, list_waivers):
     """Statically analyze pipeline scripts without running them.
 
     Imports each script (or every ``*.py`` under a directory) with
@@ -165,23 +185,43 @@ def check(paths, strict, require_pipeline, tpu_mesh, as_json, concurrency):
     with placeholder inputs to have it checked. Exits nonzero on any
     error-severity diagnostic.
 
-    With ``--concurrency`` the paths are treated as SOURCE trees instead:
-    the PWT2xx concurrency lint (thread inventory, lock inventory,
-    lock-order graph — internals/static_check/concurrency_check.py) runs
-    over them without importing anything; ``--json`` adds the inventories
-    to the payload."""
+    With ``--concurrency`` or ``--durability`` the paths are treated as
+    SOURCE trees instead: the PWT2xx concurrency lint (thread inventory,
+    lock inventory, lock-order graph) or the PWT3xx durability lint
+    (snapshot coverage, capture/restore symmetry, atomic persistence) —
+    both internals/static_check/ AST passes — run over them without
+    importing anything; ``--json`` adds the inventories to the payload.
+
+    ``--all`` runs every family in one invocation; ``--list-waivers``
+    audits inline ``pwt-ok`` suppressions instead of linting."""
     import json as _json
     import pathlib
 
     from pathway_tpu.internals.static_check import (Severity,
                                                     parse_mesh_spec)
 
+    modes = [name for flag, name in (
+        (concurrency, "--concurrency"), (durability, "--durability"),
+        (all_families, "--all"), (list_waivers, "--list-waivers"),
+    ) if flag]
+    if len(modes) > 1:
+        raise click.UsageError(
+            f"{' and '.join(modes)} are mutually exclusive")
+    if modes and (tpu_mesh is not None or require_pipeline):
+        raise click.UsageError(
+            f"{modes[0]} does not compose with "
+            "--tpu-mesh/--require-pipeline")
     if concurrency:
-        if tpu_mesh is not None or require_pipeline:
-            raise click.UsageError(
-                "--concurrency analyzes source files; it does not "
-                "compose with --tpu-mesh/--require-pipeline")
         _check_concurrency_cli(paths, strict=strict, as_json=as_json)
+        return
+    if durability:
+        _check_durability_cli(paths, strict=strict, as_json=as_json)
+        return
+    if list_waivers:
+        _list_waivers_cli(paths, as_json=as_json)
+        return
+    if all_families:
+        _check_all_cli(paths, strict=strict, as_json=as_json)
         return
 
     mesh = None
@@ -278,6 +318,131 @@ def _check_concurrency_cli(paths, *, strict: bool, as_json: bool) -> None:
         click.echo(f"concurrency check failed: {len(bad)} blocking "
                    f"diagnostic(s)", err=True)
         sys.exit(1)
+
+
+def _check_durability_cli(paths, *, strict: bool, as_json: bool) -> None:
+    """``check --durability``: the PWT3xx source-level lint. Same
+    exit-code semantics as ``--concurrency``; ``--json`` adds the
+    stateful-operator/fault-point inventory for CI artifacts."""
+    import json as _json
+
+    from pathway_tpu.internals.static_check import (Severity,
+                                                    check_durability,
+                                                    durability_inventory)
+    from pathway_tpu.internals.static_check.durability_check import \
+        build_corpus
+
+    try:
+        corpus = build_corpus(paths)  # one parse serves check + inventory
+        diagnostics = check_durability(paths, corpus=corpus)
+    except ValueError as e:
+        raise click.UsageError(str(e))
+    bad = [d for d in diagnostics
+           if d.severity is Severity.ERROR
+           or (strict and d.severity is Severity.WARNING)]
+    if as_json:
+        payload = {
+            "diagnostics": [d.to_dict() for d in diagnostics],
+            "inventory": durability_inventory(paths, corpus=corpus),
+        }
+        click.echo(_json.dumps(payload, indent=2))
+    else:
+        for d in diagnostics:
+            click.echo(str(d))
+    status = "FAIL" if bad else "ok"
+    click.echo(f"[{status}] durability check over {', '.join(paths)} — "
+               f"{len(diagnostics)} diagnostic(s)", err=True)
+    if bad:
+        click.echo(f"durability check failed: {len(bad)} blocking "
+                   f"diagnostic(s)", err=True)
+        sys.exit(1)
+
+
+def _list_waivers_cli(paths, *, as_json: bool) -> None:
+    """``check --list-waivers``: audit inline ``pwt-ok`` suppressions.
+    Always exits 0 — waivers are sanctioned, the point is visibility
+    (the CI durability-lint job archives the JSON as an audit artifact)."""
+    import json as _json
+
+    from pathway_tpu.internals.static_check import (render_waivers,
+                                                    scan_waivers)
+
+    try:
+        waivers = scan_waivers(paths)
+    except ValueError as e:
+        raise click.UsageError(str(e))
+    if as_json:
+        click.echo(_json.dumps(waivers, indent=2))
+    elif waivers:
+        click.echo(render_waivers(waivers))
+    click.echo(f"[ok] {_plural(len(waivers), 'waiver', 'waivers')} under "
+               f"{', '.join(paths)}", err=True)
+
+
+# ``check --all`` exit code is a bitmask so CI can tell which family
+# regressed from the code alone (and --json mirrors it as "exit_code")
+_FAMILY_BITS = {"expression": 1, "shard": 2, "concurrency": 4,
+                "durability": 8}
+
+
+def _check_all_cli(paths, *, strict: bool, as_json: bool) -> None:
+    """``check --all``: every family in one invocation. ``.py`` file
+    arguments get the script analysis (PWT0xx expression / PWT1xx shard,
+    split per diagnostic code); directory arguments get the source lints
+    (PWT2xx concurrency, PWT3xx durability). The JSON payload is
+    versioned (``schema_version``) so CI consumers can evolve with it."""
+    import json as _json
+    import pathlib
+
+    from pathway_tpu.internals.static_check import (Severity,
+                                                    check_concurrency,
+                                                    check_durability)
+
+    scripts = [p for p in paths if pathlib.Path(p).suffix == ".py"]
+    trees = [p for p in paths if p not in scripts]
+    for p in trees:
+        if not pathlib.Path(p).is_dir():
+            raise click.UsageError(
+                f"not a python script or directory: {p}")
+
+    families: dict[str, list] = {
+        "expression": [], "shard": [], "concurrency": [], "durability": []}
+    for script in scripts:
+        diagnostics, _collected = _collect_and_check(
+            pathlib.Path(script), mesh=None)
+        for d in diagnostics:
+            fam = "shard" if d.code.startswith("PWT1") else "expression"
+            families[fam].append(d)
+    if trees:
+        try:
+            families["concurrency"] = check_concurrency(trees)
+            families["durability"] = check_durability(trees)
+        except ValueError as e:
+            raise click.UsageError(str(e))
+
+    exit_code = 0
+    for fam, diagnostics in families.items():
+        bad = [d for d in diagnostics
+               if d.severity is Severity.ERROR
+               or (strict and d.severity is Severity.WARNING)]
+        if bad:
+            exit_code |= _FAMILY_BITS[fam]
+        if not as_json:
+            for d in diagnostics:
+                click.echo(str(d))
+        click.echo(f"[{'FAIL' if bad else 'ok'}] {fam} — "
+                   f"{len(diagnostics)} diagnostic(s)", err=True)
+    if as_json:
+        click.echo(_json.dumps({
+            "schema_version": 1,
+            "families": {fam: [d.to_dict() for d in diagnostics]
+                         for fam, diagnostics in families.items()},
+            "exit_code": exit_code,
+        }, indent=2))
+    if exit_code:
+        click.echo(f"static check failed (family bitmask {exit_code})",
+                   err=True)
+        sys.exit(exit_code)
 
 
 def _collect_and_check(script, mesh=None):

@@ -538,6 +538,11 @@ def append_bench_history(leg: str, metrics: dict,
     parent = os.path.dirname(os.path.abspath(path))
     if parent and not os.path.isdir(parent):
         os.makedirs(parent, exist_ok=True)
+    from pathway_tpu.testing import faults
+
+    # crash edge inside the append: a torn tail line is the reader's
+    # skip-don't-die contract, and this point lets a test land there
+    faults.hit("observability.history.append", path=str(path))
     with open(path, "a") as f:
         f.write("\n".join(rows) + "\n")
         f.flush()

@@ -66,12 +66,17 @@ def atomic_write_json(path: str, payload) -> str:
     right after ``os.replace`` could otherwise roll the directory back
     to the OLD entry and lose the checkpoint the data fsync already made
     durable."""
+    from pathway_tpu.testing import faults
+
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "w") as f:
             json.dump(payload, f)
             f.flush()
             os.fsync(f.fileno())
+        # crash edge between the data fsync and the rename — the
+        # durable tmp must never shadow the previous good ``path``
+        faults.hit("fs.atomic_write.replace", path=str(path))
         os.replace(tmp, path)
     except BaseException:
         try:

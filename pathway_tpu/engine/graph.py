@@ -211,6 +211,16 @@ class Scheduler:
                 # identity across processes is irrelevant (state disjoint)
                 self._replicas[node.id] = node.op.replicate(
                     self._local_n)
+        # snapshot-coverage sanitizer (engine/snapshot_sanitizer.py):
+        # under PATHWAY_SNAPSHOT_SANITIZER=1 every replica whose class
+        # overrides snapshot_state gets a mutation tracer; the snapshot
+        # path below then diffs mutated attrs against the capture set
+        from pathway_tpu.engine import snapshot_sanitizer as _snapsan
+
+        if _snapsan.sanitizer_enabled():
+            for reps in self._replicas.values():
+                for op in reps:
+                    _snapsan.track_operator(op)
         self.stats: dict[int, dict] = {
             n.id: {"insertions": 0, "retractions": 0,
                    "latency_ms": 0.0, "total_ms": 0.0}
@@ -302,9 +312,12 @@ class Scheduler:
         the pipeline is quiescent at the snapshot tick (wait_watermark).
         Raises ``SnapshotUnsupported`` when any operator cannot
         capture."""
+        from pathway_tpu.engine import snapshot_sanitizer as _snapsan
+
         states: dict[int, list] = {}
         for node in self.graph.nodes:
-            per = [op.snapshot_state() for op in self._replicas[node.id]]
+            per = [_snapsan.checked_snapshot(op)
+                   for op in self._replicas[node.id]]
             if any(st is not None for st in per):
                 states[node.id] = per
         return states

@@ -106,6 +106,9 @@ def _recv_hello(sock: socket.socket) -> dict:
     if n > 1 << 20:
         raise ClusterConnectError(
             f"absurd hello length {n} — not a pathway-tpu peer?")
+    # pwt-ok: PWT306 — cluster hello from a peer this process is about
+    # to HMAC-authenticate (engine/wire.py handshake); length-capped
+    # metadata dict, not a snapshot restore path
     return pickle.loads(bytes(_recv_exact(sock, n)))
 
 

@@ -787,6 +787,12 @@ class ColumnarGroupByOperator(Operator):
     _INT_GUARD = 1 << 62  # |sum| beyond this migrates to exact python ints
     consolidate_inputs = False  # purely additive array state
 
+    # derived interning tables (typed-key and hashed-key -> dense code):
+    # deliberately outside the snapshot — restore_state rebuilds them
+    # from _gvals/_gkeys exactly as _codes constructs them, so the
+    # coverage sanitizer must not demand their capture
+    _snapshot_sanitizer_exempt = ("_intern", "_by_gkey")
+
     def __init__(self, gval_pos: list, reducer_cols: list):
         # gval_pos: row positions of the group-value columns
         # reducer_cols: [("count", None) | ("sum"|"avg"|"min"|"max", pos)]
@@ -1125,6 +1131,10 @@ class JoinOperator(Operator):
     """
 
     arity = 2
+    # pure memo (lk, rk) -> mixed output pointer: every entry recomputes
+    # to the same value via mix_pointers, so the coverage sanitizer must
+    # not demand its capture (snapshot_state deliberately skips it)
+    _snapshot_sanitizer_exempt = ("_mix_cache",)
 
     def __init__(self, mode: str, lkey_fn, rkey_fn,
                  out_fn: Callable[[Pointer | None, tuple | None, Pointer | None, tuple | None], tuple],

@@ -138,6 +138,10 @@ def _atomic_write_bytes(path: str, data: bytes) -> None:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
+        # crash edge between the data fsync and the rename: the tmp is
+        # durable but invisible — recovery must fall back to the
+        # previous good file at ``path``
+        faults.hit("persistence.atomic.replace", path=str(path))
         os.replace(tmp, path)
     except BaseException:
         try:

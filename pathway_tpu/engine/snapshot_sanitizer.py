@@ -1,0 +1,292 @@
+"""Runtime snapshot-coverage sanitizer — PWT3xx's execution twin.
+
+The static durability checker
+(internals/static_check/durability_check.py) proves about the *source*
+that every stateful operator captures what it mutates; this module
+asserts it about the *execution*, the same split as PWT2xx vs the lock
+sanitizer (engine/locking.py). By default it is completely inert — the
+scheduler's snapshot path calls plain ``snapshot_state()``. With
+``PATHWAY_SNAPSHOT_SANITIZER=1`` every operator whose class overrides
+``snapshot_state`` is *tracked*:
+
+1. **Mutation tracing.** The operator's class is swapped for a generated
+   subclass (same ``__name__``/``__qualname__`` — graph fingerprints
+   must not change) whose ``__setattr__`` records which attrs were
+   rebound since the last snapshot and where (file:line of the writer).
+   In-place container mutation never passes through ``__setattr__``, so
+   the tracker also fingerprints every attr value at each snapshot and
+   diffs against the previous capture — a changed fingerprint is a
+   mutation even when no rebind was seen.
+2. **Coverage diff.** During each ``snapshot_state()`` call the tracked
+   instance records every attr the capture *reads* (via
+   ``__getattribute__``). A mutated attr the capture never read is an
+   uncovered mutation: the snapshot claims to cover the WAL prefix while
+   silently dropping state — :class:`SnapshotCoverageViolation` names
+   the operator, the attr, and the mutation site. Deliberately transient
+   attrs (per-tick scratch rebuilt on restore) opt out via a class-level
+   ``_snapshot_sanitizer_exempt = ("attr", ...)`` tuple.
+3. **Shadow round-trip.** On each snapshot the captured state is pushed
+   through the restricted unpickler (the same whitelist the write-time
+   proof uses) and restored into a deep-copied shadow instance; the
+   shadow's re-capture must fingerprint identically. A lossy
+   ``restore_state`` (dropped key, un-re-keyed dict) surfaces at
+   snapshot time in the writer process instead of as wrong answers in a
+   replica hydrated weeks later.
+
+``PATHWAY_SNAPSHOT_SANITIZER=report`` (or ``warn``) logs and records
+instead of raising; :func:`violations` returns the findings either way.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import logging
+import os
+import pickle
+import sys
+
+from pathway_tpu.engine.locking import create_lock
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "SnapshotCoverageViolation", "checked_snapshot", "sanitizer_enabled",
+    "track_operator", "violations",
+]
+
+
+def sanitizer_enabled() -> bool:
+    """Truthy ``PATHWAY_SNAPSHOT_SANITIZER`` arms tracking. Checked at
+    scheduler construction: a run toggles the sanitizer by env, and the
+    disabled path keeps plain classes with zero wrapper overhead."""
+    return os.environ.get("PATHWAY_SNAPSHOT_SANITIZER", "") \
+        .strip().lower() in ("1", "true", "on", "yes", "report", "warn")
+
+
+def _raise_on_violation() -> bool:
+    return os.environ.get("PATHWAY_SNAPSHOT_SANITIZER", "") \
+        .strip().lower() not in ("report", "warn")
+
+
+class SnapshotCoverageViolation(RuntimeError):
+    """An operator's snapshot does not faithfully cover its mutated
+    state: an attr changed since the last snapshot that
+    ``snapshot_state`` never read, or the captured state failed the
+    restore round-trip. Restoring this snapshot would silently diverge
+    from the writer."""
+
+
+class _Tracked:
+    """Per-operator tracking record (strong op ref pins the id)."""
+
+    __slots__ = ("op", "fps", "write_sites", "reading", "covered")
+
+    def __init__(self, op):
+        self.op = op
+        self.fps = _attr_fingerprints(op)
+        self.write_sites: dict[str, str] = {}
+        self.reading = False
+        self.covered: set[str] = set()
+
+
+class _SanitizerState:
+    """Process-wide bookkeeping; tests swap in a fresh one via
+    :func:`_reset_for_tests`."""
+
+    def __init__(self):
+        self.mutex = create_lock("snapshot_sanitizer.state")
+        self.violation_log: list[dict] = []
+        self.tracked: dict[int, _Tracked] = {}
+
+
+_STATE = _SanitizerState()
+
+
+def _reset_for_tests() -> None:
+    """Fresh tracking table + violation list (unit tests only)."""
+    global _STATE
+    _STATE = _SanitizerState()
+
+
+def violations() -> list[dict]:
+    """Violations recorded so far (raise mode records before raising, so
+    post-mortems and tests can read the full list either way)."""
+    with _STATE.mutex:
+        return list(_STATE.violation_log)
+
+
+def _record_violation(message: str) -> None:
+    with _STATE.mutex:
+        _STATE.violation_log.append({"message": message})
+    if _raise_on_violation():
+        raise SnapshotCoverageViolation(message)
+    logger.error("snapshot sanitizer: %s", message)
+
+
+def _fingerprint(value) -> bytes | None:
+    """Content digest of an attr value; None when unpicklable (sessions,
+    callables, device handles — rebinds of those are still caught by the
+    ``__setattr__`` tracer)."""
+    try:
+        return hashlib.blake2b(
+            pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL),
+            digest_size=16).digest()
+    except Exception:
+        return None
+
+
+def _attr_fingerprints(op) -> dict[str, bytes]:
+    out = {}
+    for name, value in vars(op).items():
+        fp = _fingerprint(value)
+        if fp is not None:
+            out[name] = fp
+    return out
+
+
+_TRACED: dict[type, type] = {}
+
+
+def _traced_class(cls: type) -> type:
+    traced = _TRACED.get(cls)
+    if traced is not None:
+        return traced
+
+    def __setattr__(self, name, value):
+        rec = _STATE.tracked.get(id(self))
+        if rec is not None and not rec.reading:
+            f = sys._getframe(1)
+            rec.write_sites[name] = \
+                f"{f.f_code.co_filename}:{f.f_lineno}"
+        super(traced, self).__setattr__(name, value)
+
+    def __getattribute__(self, name):
+        rec = _STATE.tracked.get(id(self))
+        if rec is not None and rec.reading \
+                and not name.startswith("__"):
+            rec.covered.add(name)
+        return super(traced, self).__getattribute__(name)
+
+    # graph_fingerprint() keys node identity on type(op).__name__ — the
+    # traced class must be indistinguishable there
+    traced = type(cls.__name__, (cls,), {
+        "__setattr__": __setattr__,
+        "__getattribute__": __getattribute__,
+        "__qualname__": cls.__qualname__,
+        "__module__": cls.__module__,
+    })
+    _TRACED[cls] = traced
+    return traced
+
+
+def track_operator(op):
+    """Arm mutation/coverage tracking on ``op`` if its class overrides
+    ``snapshot_state`` (stateless operators — base default returning
+    None — stay untouched; the static PWT301 covers operators that
+    *should* override but don't). Returns ``op``."""
+    from pathway_tpu.engine.operators import Operator
+
+    cls = type(op)
+    if getattr(cls, "snapshot_state", None) is Operator.snapshot_state:
+        return op
+    if cls in _TRACED.values():  # already a traced class (re-track)
+        with _STATE.mutex:
+            _STATE.tracked.setdefault(id(op), _Tracked(op))
+        return op
+    op.__class__ = _traced_class(cls)
+    with _STATE.mutex:
+        _STATE.tracked[id(op)] = _Tracked(op)
+    return op
+
+
+def checked_snapshot(op):
+    """``op.snapshot_state()`` with coverage + round-trip checking for
+    tracked operators; the plain call for everything else. The
+    scheduler's snapshot path routes through here whenever the sanitizer
+    is enabled."""
+    rec = _STATE.tracked.get(id(op))
+    if rec is None or rec.op is not op:
+        return op.snapshot_state()
+    rec.covered = set()
+    rec.reading = True
+    try:
+        state = op.snapshot_state()
+    finally:
+        rec.reading = False
+    cur = _attr_fingerprints(op)
+    exempt = set(getattr(type(op), "_snapshot_sanitizer_exempt", ()))
+    changed = {a for a, fp in cur.items() if rec.fps.get(a) != fp}
+    for a in rec.write_sites:
+        if a in cur and a in rec.fps and cur[a] == rec.fps[a]:
+            continue  # rebound to an equal value
+        changed.add(a)
+    if state is not None:
+        name = type(op).__name__
+        for attr in sorted(changed - rec.covered - exempt):
+            site = rec.write_sites.get(attr, "in-place mutation")
+            _record_violation(
+                f"operator {name}: state attr {attr!r} mutated since "
+                f"the last snapshot (at {site}) but snapshot_state "
+                f"never read it — a restore from this snapshot "
+                f"silently loses the mutation (capture it, or list it "
+                f"in {name}._snapshot_sanitizer_exempt if it is "
+                f"per-tick scratch)")
+        _round_trip_check(op, state)
+    rec.fps = cur
+    rec.write_sites = {}
+    return state
+
+
+def _round_trip_check(op, state) -> None:
+    """Push ``state`` through the restricted unpickler and a shadow
+    restore; the shadow's re-capture must fingerprint identically.
+    Within one process the volatile keys PWT303 worries about
+    (hash()/row_fingerprint) recompute to the same values, so a faithful
+    restore is byte-stable here even when it would not be cross-process
+    — what this catches is *lossy* capture/restore logic. One blind
+    spot: a restore that leaves an attr entirely untouched is invisible,
+    because the shadow is a deepcopy of the live instance and already
+    holds the value — the static PWT302 key-asymmetry check covers that
+    case from the source side."""
+    from pathway_tpu.engine.operators import SnapshotUnsupported
+    from pathway_tpu.engine.persistence import _safe_loads
+
+    name = type(op).__name__
+    try:
+        blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception as e:
+        _record_violation(
+            f"operator {name}: snapshot state is not picklable "
+            f"({type(e).__name__}: {e}) — the persistence driver would "
+            f"reject this snapshot at write time")
+        return
+    try:
+        state2 = _safe_loads(blob)
+    except Exception as e:
+        _record_violation(
+            f"operator {name}: snapshot state does not survive the "
+            f"restricted unpickler ({e}) — restore would reject it; "
+            f"extend persistence._SAFE_GLOBALS or capture plain data")
+        return
+    try:
+        shadow = copy.deepcopy(op)
+    except Exception:
+        return  # shared-handle operators (copy.copy replicas): skip
+    try:
+        shadow.restore_state(state2)
+        recapture = shadow.snapshot_state()
+    except SnapshotUnsupported:
+        return
+    except Exception as e:
+        _record_violation(
+            f"operator {name}: restore_state raised on its own "
+            f"snapshot ({type(e).__name__}: {e}) — recovery from this "
+            f"snapshot is impossible")
+        return
+    if _fingerprint(recapture) != _fingerprint(state):
+        _record_violation(
+            f"operator {name}: snapshot -> restore -> snapshot is not "
+            f"a fixed point — restore_state loses or rewrites captured "
+            f"state (check key symmetry and volatile-key re-keying; "
+            f"PWT302/PWT303 are the static twins of this finding)")

@@ -375,6 +375,8 @@ class StreamingRuntime:
         the rest of the run, loudly — recovery falls back to full-WAL
         replay, never to a checkpoint with missing state."""
         from pathway_tpu.engine.operators import SnapshotUnsupported
+        from pathway_tpu.engine.snapshot_sanitizer import \
+            SnapshotCoverageViolation
 
         wm = self.scheduler.wait_watermark(tick)  # re-raises leg failures
         if wm < tick:
@@ -409,6 +411,11 @@ class StreamingRuntime:
             # test-injected crash at a snapshot/compaction fault point:
             # die like any other armed point (the crash sweep simulates
             # process death here, not a degradable write failure)
+            raise
+        except SnapshotCoverageViolation:
+            # the sanitizer found a snapshot that would restore wrong —
+            # degrading to WAL replay would hide exactly the bug the
+            # sanitizer exists to surface; fail the run loudly
             raise
         except Exception:
             import logging
@@ -481,7 +488,10 @@ class StreamingRuntime:
             rec = self._drain_proxies.get(i)
             # the recording proxy drains + seals atomically: sealed <= t
             # IS drained <= t, the consistency-cut alignment snapshots
-            # need (a separate seal would leak gap entries into t+1)
+            # need (a separate seal would leak gap entries into t+1).
+            # pwt-ok: PWT307 — the plain drain() arm only runs when
+            # rec is None, i.e. the source is NOT persisted: there is
+            # no WAL to seal against, so nothing can be lost on crash
             entries = session.drain(limit) if rec is None \
                 else rec.seal_drain(tick, limit)
             if limit is not None and session.backlog() > 0 \

@@ -386,6 +386,10 @@ def _dec_column(cur: _Cursor, n: int) -> list:
         return _dec_keys(cur, n)
     if ct == _C_PKL:
         blob_len = cur.u32()
+        # pwt-ok: PWT306 — intra-fleet exchange frames from peers the
+        # same supervisor spawned (HMAC-authenticated transport), not a
+        # snapshot restore path; cell payloads carry arbitrary UDF types
+        # a name whitelist cannot enumerate
         return pickle.loads(bytes(cur.take(blob_len)))
     if ct == _C_OPT_I64:
         mask = bytes(cur.take(n))
@@ -416,6 +420,8 @@ def _dec_entries(cur: _Cursor, ctr: list, count: bool) -> list:
         rows = list(zip(*cols)) if cols else [()] * n
     else:
         blob_len = cur.u32()
+        # pwt-ok: PWT306 — trusted intra-fleet wire protocol (see
+        # _dec_column); not a restore path
         rows = pickle.loads(bytes(cur.take(blob_len)))
     if count:
         ctr[0] += n
@@ -438,6 +444,8 @@ def _dec_node(cur: _Cursor, ctr: list, count: bool):
         return _dec_entries(cur, ctr, count)
     if nt == _N_PICKLE:
         blob_len = cur.u32()
+        # pwt-ok: PWT306 — trusted intra-fleet wire protocol (see
+        # _dec_column); not a restore path
         return pickle.loads(bytes(cur.take(blob_len)))
     if nt == _N_INT:
         return _i64.unpack(cur.take(8))[0]
@@ -464,11 +472,15 @@ def decode_frame(buf) -> tuple[Any, Any, int]:
     if version != VERSION:
         raise ValueError(f"unsupported exchange wire version {version}")
     if kind == KIND_PICKLE:
+        # pwt-ok: PWT306 — trusted intra-fleet wire protocol (see
+        # _dec_column); not a restore path
         tag, payload = pickle.loads(view[4:])
         return tag, payload, payload_rows(payload)
     cur = _Cursor(view)
     cur.pos = 4
     tag_len = cur.u32()
+    # pwt-ok: PWT306 — trusted intra-fleet wire protocol (see
+    # _dec_column); not a restore path
     tag = pickle.loads(bytes(cur.take(tag_len)))
     ctr = [0]
     payload = _dec_node(cur, ctr, True)

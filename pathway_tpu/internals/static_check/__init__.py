@@ -15,6 +15,17 @@ locks: :func:`check_concurrency` is the API door, ``check --concurrency``
 the CLI door, and the runtime lock-order sanitizer
 (``PATHWAY_LOCK_SANITIZER=1``, engine/locking.py) the execution door.
 
+The fourth family, ``PWT301``–``PWT308`` (durability_check.py), walks the
+same source-file road over the persistence plane: snapshot coverage,
+capture/restore symmetry, atomic-write and fault-point discipline,
+restore-path safety. :func:`check_durability` is the API door,
+``check --durability`` the CLI door, and the snapshot-coverage sanitizer
+(``PATHWAY_SNAPSHOT_SANITIZER=1``, engine/snapshot_sanitizer.py) the
+execution door. ``check --all`` runs all four families in one invocation
+with a versioned JSON document and per-family exit bits, and
+``check --list-waivers`` (:func:`scan_waivers`) audits every inline
+``pwt-ok`` exemption.
+
 >>> import pathway_tpu as pw
 >>> t = pw.debug.table_from_markdown('''
 ... a | b
@@ -43,18 +54,27 @@ from pathway_tpu.internals.static_check.diagnostics import (
     StaticCheckError,
     render,
 )
+from pathway_tpu.internals.static_check.durability_check import (
+    check_durability,
+    durability_inventory,
+)
 from pathway_tpu.internals.static_check.shard_check import (
     MeshSpec,
     UdfClassification,
     classify_udf,
     parse_mesh_spec,
 )
+from pathway_tpu.internals.static_check.waivers import (
+    render_waivers,
+    scan_waivers,
+)
 
 __all__ = [
     "Analyzer", "CODES", "Diagnostic", "MeshSpec", "Severity",
     "StaticCheckError", "UdfClassification", "analyze",
-    "check_concurrency", "classify_udf", "concurrency_inventory",
-    "parse_mesh_spec", "render", "static_check",
+    "check_concurrency", "check_durability", "classify_udf",
+    "concurrency_inventory", "durability_inventory", "parse_mesh_spec",
+    "render", "render_waivers", "scan_waivers", "static_check",
 ]
 
 

@@ -122,6 +122,42 @@ CODES: dict[str, tuple[Severity, str]] = {
     "PWT208": (Severity.ERROR,
                "Condition.notify/notify_all outside the condition's "
                "`with` block (raises RuntimeError at runtime)"),
+    # -- PWT3xx: durability / crash-recovery (static_check/
+    # durability_check.py). Source-level AST analysis over the
+    # persistence plane (engine/, io/): snapshot coverage, atomic-write
+    # discipline, fault-point coverage, restore-path safety. Runtime
+    # twin: PATHWAY_SNAPSHOT_SANITIZER (engine/snapshot_sanitizer.py).
+    "PWT301": (Severity.WARNING,
+               "stateful operator mutates state on step/drain paths but "
+               "defines no snapshot_state/restore_state pair (silent "
+               "degradation to full-WAL replay on recovery)"),
+    "PWT302": (Severity.ERROR,
+               "capture/restore asymmetry: a snapshot state key captured "
+               "but never restored, or restored but never captured"),
+    "PWT303": (Severity.ERROR,
+               "hash()/id()/fingerprint-keyed container in snapshotted "
+               "state restored without a stable re-key (keys from the "
+               "writer process are meaningless in the restorer)"),
+    "PWT304": (Severity.ERROR,
+               "write to a persistence-root-derived path bypassing the "
+               "atomic tmp+fsync+rename discipline (a crash mid-write "
+               "leaves a torn file where a checkpoint should be)"),
+    "PWT305": (Severity.WARNING,
+               "blocking persistence I/O (fsync/truncate/put) with no "
+               "named fault point in the enclosing function — the crash "
+               "edge is not injectable by testing/faults.py"),
+    "PWT306": (Severity.ERROR,
+               "unrestricted pickle.load/loads/Unpickler on a restore "
+               "path (use persistence._safe_loads: arbitrary-code "
+               "execution from a corrupt or hostile snapshot)"),
+    "PWT307": (Severity.ERROR,
+               "Session.drain outside the atomic seal_drain helper on a "
+               "persisted streaming path (drained rows can be lost "
+               "between drain and seal on crash)"),
+    "PWT308": (Severity.WARNING,
+               "nondeterminism source (time.time, random, os.urandom, "
+               "uuid4) feeds snapshotted state — restored replicas "
+               "diverge from the writer"),
 }
 
 

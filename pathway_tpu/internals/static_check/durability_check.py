@@ -1,0 +1,867 @@
+"""Static durability analysis — the PWT3xx diagnostic family.
+
+PWT2xx fenced the engine's concurrency contract; this pass fences its
+*crash-recovery* contract — the persistence plane (``engine/``, ``io/``)
+where a silent bug costs data instead of a deadlock. PR 10's review
+passes hand-found exactly the patterns below (hash()-keyed snapshot
+state, non-atomic checkpoint writes, seal/drain atomicity gaps); each is
+mechanical enough for an AST pass to catch at authoring time. Like
+PWT2xx it analyzes **source files**, never importing them, and builds a
+small corpus: every class, its methods, its ``__init__``-assigned
+mutable state attributes, and its capture/restore method pair
+(``snapshot_state``/``restore_state`` for operators,
+``state_dict``/``load_state`` for reducer states).
+
+====== ======================================================== =========
+code   finding                                                  severity
+====== ======================================================== =========
+PWT301 stateful operator with no snapshot/restore pair          warning
+PWT302 capture/restore key asymmetry                            error
+PWT303 hash()/id()-keyed snapshot state with no re-key          error
+PWT304 persistence-path write outside tmp+fsync+rename          error
+PWT305 blocking persistence I/O with no named fault point       warning
+PWT306 unrestricted pickle.load/Unpickler on a restore path     error
+PWT307 ``Session.drain`` outside the ``seal_drain`` helper      error
+PWT308 nondeterminism source feeding snapshotted state          warning
+====== ======================================================== =========
+
+The runtime twin is the snapshot-coverage sanitizer
+(engine/snapshot_sanitizer.py, ``PATHWAY_SNAPSHOT_SANITIZER=1``): what
+this pass proves about the source — every mutated state attr is captured
+— the sanitizer asserts about the execution, attr by attr, snapshot by
+snapshot, with a shadow restore round-trip on top.
+
+**Waivers.** Same contract as PWT2xx: a finding on a line whose source
+(or the contiguous comment block above it) carries ``pwt-ok: PWT3xx``
+is suppressed, and the comment doubles as the audit trail
+(``check --list-waivers`` enumerates them). "Fixed, not suppressed" is
+the norm; waivers are for the handful of deliberate exceptions (the
+trusted intra-fleet wire protocol's pickle, the non-persisted session's
+plain ``drain``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from pathway_tpu.internals.static_check.concurrency_check import (
+    _collect_files, _waived)
+from pathway_tpu.internals.static_check.diagnostics import Diagnostic
+from pathway_tpu.internals.trace import Trace
+
+# capture/restore method-name pairs the contract recognizes: operators
+# use snapshot_state/restore_state, reducer states state_dict/load_state
+_PAIRS = (("snapshot_state", "restore_state"), ("state_dict", "load_state"))
+_CAPTURE_NAMES = {cap for cap, _ in _PAIRS}
+_RESTORE_NAMES = {res for _, res in _PAIRS}
+
+# key-producing calls whose values are process-local: Python hash() is
+# salted per process, id() is an address, row_fingerprint is hash-based
+# (engine/delta.py). _stable_row_fp (content digest) is deliberately NOT
+# here — stable keys need no re-key.
+_VOLATILE_KEY_FNS = {"hash", "id", "row_fingerprint"}
+
+# a write-mode open() whose path expression mentions one of these is a
+# persistence-plane write and must go through tmp+fsync+rename
+_PERSIST_PATH_TOKENS = ("root", "snapshot", "wal", "checkpoint",
+                        "generation", "persist", "manifest")
+
+# in-place container mutators (PWT301's "mutated in step/drain paths")
+_MUTATOR_ATTRS = {"append", "add", "pop", "update", "setdefault", "extend",
+                  "discard", "clear", "popitem", "insert", "remove"}
+
+# nondeterminism sources (PWT308): module-attribute call forms
+_NONDET_CALLS = {("time", "time"), ("time", "time_ns"), ("os", "urandom"),
+                 ("uuid", "uuid4"), ("uuid", "uuid1")}
+_NONDET_MODULES = {"random"}  # any random.* call
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    """``"X"`` for a ``self.X`` expression, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_volatile_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and (
+        (isinstance(node.func, ast.Name)
+         and node.func.id in _VOLATILE_KEY_FNS)
+        or (isinstance(node.func, ast.Attribute)
+            and node.func.attr in _VOLATILE_KEY_FNS))
+
+
+def _contains_volatile_call(node: ast.AST) -> bool:
+    return any(_is_volatile_call(n) for n in ast.walk(node))
+
+
+def _is_nondet_call(node: ast.AST) -> bool:
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)):
+        return False
+    mod, attr = node.func.value.id, node.func.attr
+    return (mod, attr) in _NONDET_CALLS or mod in _NONDET_MODULES
+
+
+def _walk_unit(fn_node: ast.AST):
+    """Walk a function subtree including nested functions but excluding
+    nested class bodies (those are analysis units of their own)."""
+    stack = [fn_node]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            stack.append(child)
+
+
+# ---------------------------------------------------------------------------
+# corpus model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _ClassInfo:
+    name: str
+    path: str
+    lineno: int
+    bases: list[str]
+    node: ast.ClassDef
+    #: direct method name -> def node
+    methods: dict[str, ast.FunctionDef] = field(default_factory=dict)
+    #: attr assigned a container literal/ctor in __init__ -> lineno
+    mutable_attrs: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class _ModuleInfo:
+    path: str
+    stem: str
+    source_lines: list[str]
+    tree: ast.Module
+    classes: dict[str, _ClassInfo] = field(default_factory=dict)
+    functions: dict[str, ast.FunctionDef] = field(default_factory=dict)
+
+
+class _Corpus:
+    def __init__(self, modules: list[_ModuleInfo],
+                 parse_failures: list[tuple[str, str]]):
+        self.modules = modules
+        self.parse_failures = parse_failures
+        #: class name -> _ClassInfo (last definition wins; good enough
+        #: for base-chain resolution inside one source tree)
+        self.class_index: dict[str, _ClassInfo] = {}
+        for mod in modules:
+            for cls in mod.classes.values():
+                self.class_index[cls.name] = cls
+
+
+_CONTAINER_CTORS = {"dict", "list", "set", "defaultdict", "OrderedDict",
+                    "Counter", "deque"}
+
+
+def _is_container_literal(value: ast.expr) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.id if isinstance(fn, ast.Name) else \
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        return name in _CONTAINER_CTORS
+    return False
+
+
+def build_corpus(paths) -> _Corpus:
+    modules: list[_ModuleInfo] = []
+    parse_failures: list[tuple[str, str]] = []
+    for f in _collect_files(paths):
+        try:
+            source = f.read_text()
+            tree = ast.parse(source, filename=str(f))
+        except (OSError, SyntaxError) as e:
+            parse_failures.append((str(f), f"{type(e).__name__}: {e}"))
+            continue
+        stem = f.parent.name if f.stem == "__init__" else f.stem
+        mod = _ModuleInfo(path=str(f), stem=stem,
+                          source_lines=source.splitlines(), tree=tree)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls = _ClassInfo(
+                    name=node.name, path=mod.path, lineno=node.lineno,
+                    bases=[ast.unparse(b) for b in node.bases], node=node)
+                for sub in node.body:
+                    if isinstance(sub,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        cls.methods[sub.name] = sub
+                init = cls.methods.get("__init__")
+                if init is not None:
+                    for stmt in ast.walk(init):
+                        if isinstance(stmt, ast.Assign) \
+                                and len(stmt.targets) == 1 \
+                                and _self_attr(stmt.targets[0]) \
+                                and _is_container_literal(stmt.value):
+                            cls.mutable_attrs.setdefault(
+                                _self_attr(stmt.targets[0]), stmt.lineno)
+                mod.classes[node.name] = cls
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[node.name] = node
+        modules.append(mod)
+    return _Corpus(modules, parse_failures)
+
+
+def _units(mod: _ModuleInfo):
+    """Yield (class_info | None, function_node) analysis units."""
+    for cls in mod.classes.values():
+        for fn in cls.methods.values():
+            yield cls, fn
+    for fn in mod.functions.values():
+        yield None, fn
+
+
+# ---------------------------------------------------------------------------
+# contract resolution helpers
+# ---------------------------------------------------------------------------
+
+def _defines_pair_locally(cls: _ClassInfo) -> bool:
+    return any(cap in cls.methods and res in cls.methods
+               for cap, res in _PAIRS)
+
+
+def _inherits_real_pair(cls: _ClassInfo, corpus: _Corpus) -> bool:
+    """True when a corpus ancestor other than the root ``Operator``
+    (whose defaults are the trivial None/raise pair) defines the
+    capture/restore pair — e.g. ColumnarGroupByOperator inheriting
+    GroupByOperator's, or a reducer inheriting ReducerState's."""
+    seen = set()
+    queue = list(cls.bases)
+    while queue:
+        base = queue.pop()
+        if base in seen:
+            continue
+        seen.add(base)
+        anc = corpus.class_index.get(base)
+        if anc is None or anc.name == "Operator":
+            continue
+        if _defines_pair_locally(anc):
+            return True
+        queue.extend(anc.bases)
+    return False
+
+
+def _is_operator_like(cls: _ClassInfo, corpus: _Corpus) -> bool:
+    """The class participates in the operator snapshot protocol: its own
+    name (or a transitively resolved base's) ends with "Operator"."""
+    seen = set()
+    queue = [cls.name, *cls.bases]
+    while queue:
+        name = queue.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        if name.endswith("Operator"):
+            return True
+        anc = corpus.class_index.get(name)
+        if anc is not None:
+            queue.extend(anc.bases)
+    return False
+
+
+def _local_capture(cls: _ClassInfo) -> ast.FunctionDef | None:
+    for cap in _CAPTURE_NAMES:
+        if cap in cls.methods:
+            return cls.methods[cap]
+    return None
+
+
+def _local_restore(cls: _ClassInfo) -> ast.FunctionDef | None:
+    for res in _RESTORE_NAMES:
+        if res in cls.methods:
+            return cls.methods[res]
+    return None
+
+
+def _mutations(cls: _ClassInfo, fn: ast.FunctionDef) -> dict[str, int]:
+    """State-attr in-place mutations in ``fn``: attr -> first lineno.
+    Counts subscript stores/deletes, augassigns and container-mutator
+    method calls against attrs initialized as containers in __init__."""
+    out: dict[str, int] = {}
+
+    def _hit(attr: str | None, lineno: int) -> None:
+        if attr in cls.mutable_attrs and attr not in out:
+            out[attr] = lineno
+
+    for node in _walk_unit(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    _hit(_self_attr(tgt.value), node.lineno)
+        elif isinstance(node, ast.AugAssign):
+            tgt = node.target
+            if isinstance(tgt, ast.Subscript):
+                _hit(_self_attr(tgt.value), node.lineno)
+            else:
+                _hit(_self_attr(tgt), node.lineno)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    _hit(_self_attr(tgt.value), node.lineno)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATOR_ATTRS:
+            _hit(_self_attr(node.func.value), node.lineno)
+    return out
+
+
+def _capture_reads(capture: ast.FunctionDef) -> set[str]:
+    """Attrs ``self.X`` referenced anywhere in the capture method."""
+    return {a for n in _walk_unit(capture)
+            if (a := _self_attr(n)) is not None}
+
+
+# ---------------------------------------------------------------------------
+# capture/restore key extraction (PWT302)
+# ---------------------------------------------------------------------------
+
+def _capture_keys(capture: ast.FunctionDef) -> tuple[set[str], bool]:
+    """(literal state keys the capture emits, capture_is_open).
+
+    Keys come from dict literals in ``return`` statements plus
+    ``local["k"] = ...`` stores into a returned local. Dynamic keys
+    (non-constant subscripts, ``**`` unpacks, non-dict returns) mark the
+    capture *open*: we cannot claim a restored key was never captured.
+    """
+    keys: set[str] = set()
+    open_capture = False
+    returned_names: set[str] = set()
+    for node in _walk_unit(capture):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        keys.add(k.value)
+                    else:  # **unpack (None) or computed key
+                        open_capture = True
+            elif isinstance(node.value, ast.Name):
+                returned_names.add(node.value.id)
+            elif isinstance(node.value, ast.Constant) \
+                    and node.value.value is None:
+                pass  # `return None` branch (stateless fast path)
+            else:
+                open_capture = True
+    for node in _walk_unit(capture):
+        # normalize `st: dict = {...}` to the plain-assign shape
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            node = ast.Assign(targets=[node.target], value=node.value)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id in returned_names \
+                and isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+                else:
+                    open_capture = True
+        elif isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in returned_names
+                        for t in node.targets):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in returned_names:
+                    if isinstance(t.slice, ast.Constant) \
+                            and isinstance(t.slice.value, str):
+                        keys.add(t.slice.value)
+                    else:
+                        open_capture = True
+    return keys, open_capture
+
+
+def _restore_keys(restore: ast.FunctionDef) -> tuple[set[str], bool]:
+    """(literal state keys the restore reads, restore_is_open)."""
+    args = restore.args.args
+    # first arg after self is the state parameter
+    param = args[1].arg if len(args) > 1 else None
+    if param is None:
+        return set(), True
+    keys: set[str] = set()
+    open_restore = False
+    for node in _walk_unit(restore):
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == param:
+            if isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str):
+                keys.add(node.slice.value)
+            else:
+                open_restore = True
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == param:
+            if node.func.attr == "get" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                keys.add(node.args[0].value)
+            elif node.func.attr in ("items", "keys", "values", "get",
+                                    "pop"):
+                open_restore = True
+        elif isinstance(node, ast.Compare) \
+                and isinstance(node.left, ast.Constant) \
+                and isinstance(node.left.value, str) \
+                and any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in node.ops) \
+                and any(isinstance(c, ast.Name) and c.id == param
+                        for c in node.comparators):
+            keys.add(node.left.value)  # `"k" in state` guard
+        elif isinstance(node, ast.For) \
+                and isinstance(node.iter, ast.Name) \
+                and node.iter.id == param:
+            open_restore = True
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "update" \
+                and any(isinstance(a, ast.Name) and a.id == param
+                        for a in node.args):
+            open_restore = True
+    return keys, open_restore
+
+
+# ---------------------------------------------------------------------------
+# diagnostics plumbing
+# ---------------------------------------------------------------------------
+
+def _diag(code: str, message: str, mod_path: str, line: int,
+          function: str, source_lines: list[str]) -> Diagnostic:
+    src = source_lines[line - 1].strip() if 0 < line <= len(source_lines) \
+        else ""
+    return Diagnostic(code=code, message=message,
+                      trace=Trace(mod_path, line, function, src))
+
+
+class DurabilityChecker:
+    """Runs every PWT3xx check over a parsed corpus."""
+
+    def __init__(self, corpus: _Corpus):
+        self.corpus = corpus
+        self.diagnostics: list[Diagnostic] = []
+        self._sources = {m.path: m.source_lines for m in corpus.modules}
+
+    def _report(self, code: str, message: str, file: str, line: int,
+                function: str = "") -> None:
+        lines = self._sources.get(file, [])
+        if _waived(lines, line, code):
+            return
+        self.diagnostics.append(
+            _diag(code, message, file, line, function, lines))
+
+    def run(self) -> list[Diagnostic]:
+        for path, err in self.corpus.parse_failures:
+            self.diagnostics.append(Diagnostic(
+                code="PWT000",
+                message=f"cannot analyze {path}: {err}"))
+        self.check_missing_pair()        # PWT301
+        self.check_key_asymmetry()       # PWT302
+        self.check_volatile_keys()       # PWT303
+        self.check_non_atomic_writes()   # PWT304
+        self.check_fault_point_coverage()  # PWT305
+        self.check_unrestricted_pickle()   # PWT306
+        self.check_unsealed_drain()        # PWT307
+        self.check_nondeterminism()        # PWT308
+        return self.diagnostics
+
+    # -- PWT301 ------------------------------------------------------------
+    def check_missing_pair(self) -> None:
+        for mod in self.corpus.modules:
+            for cls in mod.classes.values():
+                if not _is_operator_like(cls, self.corpus):
+                    continue
+                if cls.name == "Operator":  # the protocol provider
+                    continue
+                if not cls.mutable_attrs:
+                    continue
+                if _defines_pair_locally(cls) \
+                        or _inherits_real_pair(cls, self.corpus):
+                    continue
+                mutated: dict[str, int] = {}
+                for name, fn in cls.methods.items():
+                    if name == "__init__" or name in _CAPTURE_NAMES \
+                            or name in _RESTORE_NAMES:
+                        continue
+                    for attr, line in _mutations(cls, fn).items():
+                        mutated.setdefault(attr, line)
+                if not mutated:
+                    continue
+                attrs = ", ".join(sorted(mutated))
+                self._report(
+                    "PWT301",
+                    f"stateful operator {cls.name!r} mutates state "
+                    f"attr(s) {attrs} on step/drain paths but defines no "
+                    f"snapshot_state/restore_state pair: recovery "
+                    f"silently degrades to full-WAL replay",
+                    cls.path, cls.lineno, cls.name)
+
+    # -- PWT302 ------------------------------------------------------------
+    def check_key_asymmetry(self) -> None:
+        for mod in self.corpus.modules:
+            for cls in mod.classes.values():
+                capture = _local_capture(cls)
+                restore = _local_restore(cls)
+                if capture is None or restore is None:
+                    continue
+                captured, cap_open = _capture_keys(capture)
+                restored, res_open = _restore_keys(restore)
+                if not res_open:
+                    for key in sorted(captured - restored):
+                        self._report(
+                            "PWT302",
+                            f"{cls.name}.{capture.name} captures state "
+                            f"key {key!r} that {restore.name} never "
+                            f"reads: the attr is lost on recovery",
+                            cls.path, capture.lineno,
+                            f"{cls.name}.{capture.name}")
+                if not cap_open:
+                    for key in sorted(restored - captured):
+                        self._report(
+                            "PWT302",
+                            f"{cls.name}.{restore.name} reads state key "
+                            f"{key!r} that {capture.name} never emits: "
+                            f"restore raises KeyError (or installs a "
+                            f"stale default) on every recovery",
+                            cls.path, restore.lineno,
+                            f"{cls.name}.{restore.name}")
+
+    # -- PWT303 ------------------------------------------------------------
+    def _volatile_keyed_attrs(self, cls: _ClassInfo) -> dict[str, int]:
+        """Attrs stored into under a hash()/id()/row_fingerprint-derived
+        key anywhere in the class: attr -> first store lineno."""
+        out: dict[str, int] = {}
+        for fn in cls.methods.values():
+            local_volatile: set[str] = set()
+            for node in _walk_unit(fn):
+                if isinstance(node, ast.Assign) \
+                        and _contains_volatile_call(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            local_volatile.add(tgt.id)
+
+            def _key_is_volatile(key: ast.expr) -> bool:
+                if _contains_volatile_call(key):
+                    return True
+                return any(isinstance(n, ast.Name)
+                           and n.id in local_volatile
+                           for n in ast.walk(key))
+
+            for node in _walk_unit(fn):
+                attr, key = None, None
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript):
+                            attr = _self_attr(tgt.value)
+                            key = tgt.slice
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("setdefault", "add") \
+                        and node.args:
+                    attr = _self_attr(node.func.value)
+                    key = node.args[0]
+                if attr is not None and key is not None \
+                        and _key_is_volatile(key) and attr not in out:
+                    out[attr] = node.lineno
+        return out
+
+    @staticmethod
+    def _rekeyed_in_restore(restore: ast.FunctionDef, attr: str) -> bool:
+        """True when the restore body rebuilds ``self.attr`` under fresh
+        fingerprints: a comprehension assigned to it containing a
+        volatile-key call, or a loop that both calls one and stores into
+        the attr."""
+        for node in _walk_unit(restore):
+            if isinstance(node, ast.Assign) \
+                    and any(_self_attr(t) == attr for t in node.targets) \
+                    and isinstance(node.value,
+                                   (ast.DictComp, ast.SetComp,
+                                    ast.ListComp, ast.GeneratorExp)) \
+                    and _contains_volatile_call(node.value):
+                return True
+            if isinstance(node, (ast.For, ast.While)) \
+                    and _contains_volatile_call(node):
+                for sub in ast.walk(node):
+                    stored = None
+                    if isinstance(sub, ast.Assign):
+                        for tgt in sub.targets:
+                            if isinstance(tgt, ast.Subscript):
+                                stored = _self_attr(tgt.value)
+                    elif isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr in ("add", "setdefault"):
+                        stored = _self_attr(sub.func.value)
+                    if stored == attr:
+                        return True
+        return False
+
+    def check_volatile_keys(self) -> None:
+        for mod in self.corpus.modules:
+            for cls in mod.classes.values():
+                restore = _local_restore(cls)
+                if restore is None:
+                    continue
+                volatile = self._volatile_keyed_attrs(cls)
+                if not volatile:
+                    continue
+                capture = _local_capture(cls)
+                if capture is not None:
+                    captured_attrs = _capture_reads(capture)
+                else:
+                    # capture inherited (e.g. ReducerState.state_dict's
+                    # generic __slots__ walk): every attr is captured
+                    captured_attrs = set(volatile)
+                restored_attrs = {a for n in _walk_unit(restore)
+                                  if (a := _self_attr(n)) is not None}
+                for attr, line in sorted(volatile.items()):
+                    if attr not in captured_attrs \
+                            or attr not in restored_attrs:
+                        continue
+                    if self._rekeyed_in_restore(restore, attr):
+                        continue
+                    self._report(
+                        "PWT303",
+                        f"{cls.name}.{attr} is keyed by hash()/id()/"
+                        f"row_fingerprint values (process-local) and "
+                        f"snapshotted, but {restore.name} reinstalls it "
+                        f"without a stable re-key: every lookup misses "
+                        f"after recovery",
+                        cls.path, restore.lineno,
+                        f"{cls.name}.{restore.name}")
+
+    # -- PWT304 ------------------------------------------------------------
+    def check_non_atomic_writes(self) -> None:
+        blessed = {"atomic_write_json", "_atomic_write_bytes", "fsync_dir"}
+        for mod in self.corpus.modules:
+            for cls, fn in _units(mod):
+                if fn.name in blessed:
+                    continue
+                has_replace = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("replace", "rename")
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "os"
+                    for n in _walk_unit(fn))
+                if has_replace:
+                    continue  # the function implements the discipline
+                owner = f"{cls.name}.{fn.name}" if cls else fn.name
+                for node in _walk_unit(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    path_expr = None
+                    if isinstance(node.func, ast.Name) \
+                            and node.func.id == "open" and node.args:
+                        mode = None
+                        if len(node.args) > 1 and isinstance(
+                                node.args[1], ast.Constant):
+                            mode = node.args[1].value
+                        for kw in node.keywords:
+                            if kw.arg == "mode" and isinstance(
+                                    kw.value, ast.Constant):
+                                mode = kw.value.value
+                        if not (isinstance(mode, str)
+                                and mode.startswith("w")):
+                            continue
+                        path_expr = node.args[0]
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in ("write_text",
+                                                   "write_bytes"):
+                        path_expr = node.func.value
+                    if path_expr is None:
+                        continue
+                    text = ast.unparse(path_expr).lower()
+                    if not any(tok in text for tok in
+                               _PERSIST_PATH_TOKENS):
+                        continue
+                    self._report(
+                        "PWT304",
+                        f"{owner} writes a persistence-root-derived "
+                        f"path ({ast.unparse(path_expr)}) without the "
+                        f"tmp+fsync+rename discipline: a crash mid-"
+                        f"write leaves a torn file where a checkpoint "
+                        f"should be (use _atomic_write_bytes / "
+                        f"atomic_write_json)",
+                        mod.path, node.lineno, owner)
+
+    # -- PWT305 ------------------------------------------------------------
+    def check_fault_point_coverage(self) -> None:
+        for mod in self.corpus.modules:
+            for cls, fn in _units(mod):
+                has_fault_point = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("hit", "armed")
+                    and "faults" in ast.unparse(n.func.value)
+                    for n in _walk_unit(fn))
+                if has_fault_point:
+                    continue
+                owner = f"{cls.name}.{fn.name}" if cls else fn.name
+                for node in _walk_unit(fn):
+                    if not isinstance(node, ast.Call) \
+                            or not isinstance(node.func, ast.Attribute):
+                        continue
+                    what = None
+                    recv = ast.unparse(node.func.value).lower()
+                    if node.func.attr == "fsync" and recv == "os":
+                        what = "os.fsync"
+                    elif node.func.attr == "truncate":
+                        what = f"{recv}.truncate"
+                    elif node.func.attr in ("put", "put_object") \
+                            and any(t in recv for t in
+                                    ("s3", "client", "bucket")):
+                        what = f"{recv}.{node.func.attr}"
+                    if what is None:
+                        continue
+                    self._report(
+                        "PWT305",
+                        f"{owner} performs blocking persistence I/O "
+                        f"({what}) with no named fault point in the "
+                        f"enclosing function: this crash edge is not "
+                        f"injectable by testing/faults.py (add "
+                        f"faults.hit(\"...\") beside it)",
+                        mod.path, node.lineno, owner)
+
+    # -- PWT306 ------------------------------------------------------------
+    def check_unrestricted_pickle(self) -> None:
+        for mod in self.corpus.modules:
+            for cls, fn in _units(mod):
+                owner = f"{cls.name}.{fn.name}" if cls else fn.name
+                for node in _walk_unit(fn):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute) \
+                            and isinstance(node.func.value, ast.Name) \
+                            and node.func.value.id == "pickle" \
+                            and node.func.attr in ("load", "loads",
+                                                   "Unpickler"):
+                        self._report(
+                            "PWT306",
+                            f"{owner} calls pickle.{node.func.attr} "
+                            f"directly: a corrupt or hostile payload "
+                            f"executes arbitrary code on restore (use "
+                            f"persistence._safe_loads, which whitelists "
+                            f"snapshot types by name)",
+                            mod.path, node.lineno, owner)
+
+    # -- PWT307 ------------------------------------------------------------
+    def check_unsealed_drain(self) -> None:
+        for mod in self.corpus.modules:
+            for cls, fn in _units(mod):
+                if fn.name == "seal_drain":
+                    continue  # the atomic helper itself
+                if cls is not None and "seal_drain" in cls.methods:
+                    continue  # the provider class's internal delegation
+                owner = f"{cls.name}.{fn.name}" if cls else fn.name
+                for node in _walk_unit(fn):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "drain":
+                        recv = ast.unparse(node.func.value).lower()
+                        if "session" not in recv and recv != "sess":
+                            continue
+                        self._report(
+                            "PWT307",
+                            f"{owner} drains a session outside the "
+                            f"atomic seal_drain helper: rows drained "
+                            f"here are lost if the process dies before "
+                            f"the seal (call rec.seal_drain(tick, "
+                            f"limit) on persisted paths)",
+                            mod.path, node.lineno, owner)
+
+    # -- PWT308 ------------------------------------------------------------
+    def check_nondeterminism(self) -> None:
+        for mod in self.corpus.modules:
+            for cls in mod.classes.values():
+                capture = _local_capture(cls)
+                if capture is None:
+                    continue
+                captured_attrs = _capture_reads(capture)
+                for name, fn in cls.methods.items():
+                    if name in _CAPTURE_NAMES:
+                        continue
+                    for node in _walk_unit(fn):
+                        attr, value = None, None
+                        if isinstance(node, ast.Assign) \
+                                and len(node.targets) == 1:
+                            tgt = node.targets[0]
+                            if isinstance(tgt, ast.Subscript):
+                                attr = _self_attr(tgt.value)
+                            else:
+                                attr = _self_attr(tgt)
+                            value = node.value
+                        elif isinstance(node, ast.AugAssign):
+                            attr = _self_attr(node.target)
+                            value = node.value
+                        if attr is None or value is None \
+                                or attr not in captured_attrs:
+                            continue
+                        if any(_is_nondet_call(n)
+                               for n in ast.walk(value)):
+                            self._report(
+                                "PWT308",
+                                f"{cls.name}.{attr} is snapshotted but "
+                                f"fed from a nondeterminism source in "
+                                f"{name} ({ast.unparse(value)}): "
+                                f"restored replicas diverge from the "
+                                f"writer",
+                                mod.path, node.lineno,
+                                f"{cls.name}.{name}")
+
+
+# ---------------------------------------------------------------------------
+# front doors
+# ---------------------------------------------------------------------------
+
+def check_durability(paths, *, corpus: _Corpus | None = None
+                     ) -> list[Diagnostic]:
+    """Run the PWT3xx family over ``paths`` (files or directories of
+    Python source). Returns diagnostics; nothing is imported or
+    executed. Pass a prebuilt ``corpus`` (from :func:`build_corpus`) to
+    share the parse with :func:`durability_inventory`."""
+    return DurabilityChecker(corpus or build_corpus(paths)).run()
+
+
+def durability_inventory(paths, *, corpus: _Corpus | None = None) -> dict:
+    """The snapshot-protocol and fault-point inventories as plain data —
+    which classes participate in the operator snapshot protocol, with
+    what state attrs, and which named crash edges testing/faults.py can
+    inject."""
+    corpus = corpus or build_corpus(paths)
+    operators = []
+    for mod in corpus.modules:
+        for cls in mod.classes.values():
+            if not _is_operator_like(cls, corpus) \
+                    or cls.name == "Operator":
+                continue
+            operators.append({
+                "class": cls.name,
+                "file": cls.path,
+                "state_attrs": sorted(cls.mutable_attrs),
+                "has_snapshot_pair": _defines_pair_locally(cls)
+                or _inherits_real_pair(cls, corpus),
+            })
+    fault_points: set[str] = set()
+    for mod in corpus.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "hit" \
+                    and "faults" in ast.unparse(node.func.value) \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                fault_points.add(node.args[0].value)
+    return {
+        "operators": sorted(operators, key=lambda o: o["class"]),
+        "fault_points": sorted(fault_points),
+    }

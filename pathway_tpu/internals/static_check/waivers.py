@@ -1,0 +1,91 @@
+"""Inline ``pwt-ok`` waiver audit — the reviewable face of suppression.
+
+Every static-check family (PWT0xx–PWT3xx) honors an inline waiver: a
+``# pwt-ok: PWTnnn — justification`` comment on the flagged line or in
+the contiguous comment block above it. Waivers are deliberate,
+audit-trailed exemptions — which only works if someone can actually see
+them. :func:`scan_waivers` enumerates every waiver in a source tree as
+``(codes, file, line, justification)`` records; ``python -m pathway_tpu
+check --list-waivers`` renders them in text or JSON, and CI uploads the
+JSON as an artifact so exemptions stay reviewable instead of invisible.
+
+A waiver with no code (bare ``pwt-ok``) suppresses every check on its
+line; it is reported with ``codes == ["*"]`` so blanket waivers stand
+out in review.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+
+from pathway_tpu.internals.static_check.concurrency_check import \
+    _collect_files
+
+_CODE_RE = re.compile(r"PWT\d{3}")
+
+
+def _comment_lines(text: str) -> dict[int, str] | None:
+    """lineno -> comment text for every real COMMENT token, or None when
+    the file does not tokenize (such files never reach the checkers
+    either). Tokenizing — rather than substring-scanning raw lines —
+    keeps ``pwt-ok`` mentions inside docstrings and help strings (the
+    CLI documents the waiver contract in its own ``--help`` text) out of
+    the audit."""
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        return {t.start[0]: t.string
+                for t in tokens if t.type == tokenize.COMMENT}
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return None
+
+
+def scan_waivers(paths) -> list[dict]:
+    """Every inline ``pwt-ok`` waiver under ``paths`` as a list of
+    ``{"codes", "file", "line", "comment"}`` dicts, ordered by file and
+    line. ``comment`` is the waiver's justification text (everything
+    after ``pwt-ok`` on the line, codes stripped) — empty means an
+    unjustified waiver, which review should treat as a smell. Only real
+    ``#`` comments count: a ``pwt-ok`` mentioned in a docstring or
+    string literal is documentation, not a waiver."""
+    out: list[dict] = []
+    for f in _collect_files(paths):
+        try:
+            text = f.read_text()
+        except OSError:
+            continue
+        lines = text.splitlines()
+        comments = _comment_lines(text)
+        if comments is None:
+            continue
+        for lineno in sorted(comments):
+            line = comments[lineno]
+            idx = line.find("pwt-ok")
+            if idx < 0:
+                continue
+            rest = line[idx + len("pwt-ok"):]
+            codes = _CODE_RE.findall(rest) or ["*"]
+            head = _CODE_RE.sub("", rest).strip()
+            parts = [head.lstrip(":,—–- ").rstrip()]
+            # multi-line justifications continue in the comment block
+            # below the marker line (same contiguous block _waived scans)
+            for n in range(lineno + 1, len(lines) + 1):
+                cont = comments.get(n)
+                if cont is None or lines[n - 1].strip() != cont.strip():
+                    break  # code line, or a trailing comment on one
+                parts.append(cont.lstrip("#").strip())
+            comment = " ".join(p for p in parts if p)
+            out.append({"codes": codes, "file": str(f), "line": lineno,
+                        "comment": comment})
+    return out
+
+
+def render_waivers(waivers: list[dict]) -> str:
+    """One line per waiver: ``CODE[,CODE] file:line — justification``."""
+    rows = []
+    for w in waivers:
+        just = w["comment"] or "(no justification)"
+        rows.append(f"{','.join(w['codes'])} {w['file']}:{w['line']} "
+                    f"— {just}")
+    return "\n".join(rows)

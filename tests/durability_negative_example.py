@@ -1,0 +1,35 @@
+"""Deliberately non-durable code — the CI canary proving the PWT3xx gate
+bites.
+
+``python -m pathway_tpu check --durability
+tests/durability_negative_example.py`` must exit nonzero:
+
+- ``RollingCountOperator`` mutates ``self.counts`` on the step path but
+  defines no ``snapshot_state``/``restore_state`` pair — on recovery its
+  state silently degrades to full-WAL replay (PWT301, warning);
+- ``save_manifest`` writes a persistence-root-derived path with a plain
+  write-mode ``open``, no tmp+fsync+rename — a crash mid-write leaves a
+  torn manifest where a checkpoint should be (PWT304, error; this is
+  what makes the exit code nonzero without ``--strict``).
+
+The module is never imported by the suite (the checker parses, it does
+not execute).
+"""
+
+import json
+
+
+class RollingCountOperator:
+    """Stateful operator with no capture/restore pair (PWT301)."""
+
+    def __init__(self):
+        self.counts = {}
+
+    def step(self, key):
+        self.counts[key] = self.counts.get(key, 0) + 1
+
+
+def save_manifest(root, manifest):
+    """Torn-write hazard on the persistence root (PWT304)."""
+    with open(root / "manifest.json", "w") as f:
+        f.write(json.dumps(manifest))

@@ -1,0 +1,601 @@
+"""Durability analyzer (static_check/durability_check.py): one
+true-positive and one true-negative per PWT301–PWT308 code, the waiver
+mechanism and its ``--list-waivers`` audit, the operator/fault-point
+inventory, the engine+io dogfood gate, and the CLI front doors
+(``--durability``, ``--all``, ``--list-waivers``) — mirrors
+tests/test_concurrency_check.py for the PWT2xx family."""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+import textwrap
+
+from pathway_tpu.internals.static_check import (check_durability,
+                                                durability_inventory,
+                                                scan_waivers)
+
+
+def run_check(tmp_path, source: str):
+    f = tmp_path / "mod_under_test.py"
+    f.write_text(textwrap.dedent(source))
+    return check_durability([str(f)])
+
+
+def codes(diags):
+    return sorted(d.code for d in diags)
+
+
+def only(diags, code):
+    return [d for d in diags if d.code == code]
+
+
+# ---------------------------------------------------------------------------
+# PWT301 — stateful operator with no snapshot/restore pair
+# ---------------------------------------------------------------------------
+
+_UNCOVERED_OPERATOR = """
+    class RollingCountOperator:
+        def __init__(self):
+            self.counts = {}
+
+        def step(self, key):
+            self.counts[key] = self.counts.get(key, 0) + 1
+"""
+
+
+def test_pwt301_missing_pair_is_warning(tmp_path):
+    diags = only(run_check(tmp_path, _UNCOVERED_OPERATOR), "PWT301")
+    assert len(diags) == 1
+    assert not diags[0].is_error  # degraded recovery, not wrong answers
+    assert "counts" in diags[0].message
+    assert "full-WAL replay" in diags[0].message
+
+
+def test_pwt301_negative_local_pair(tmp_path):
+    diags = run_check(tmp_path, """
+        class RollingCountOperator:
+            def __init__(self):
+                self.counts = {}
+
+            def step(self, key):
+                self.counts[key] = self.counts.get(key, 0) + 1
+
+            def snapshot_state(self):
+                return {"counts": self.counts}
+
+            def restore_state(self, state):
+                self.counts = dict(state["counts"])
+    """)
+    assert only(diags, "PWT301") == []
+
+
+def test_pwt301_negative_inherited_pair(tmp_path):
+    diags = run_check(tmp_path, """
+        class BaseWindowOperator:
+            def snapshot_state(self):
+                return {"buf": self.buf}
+
+            def restore_state(self, state):
+                self.buf = dict(state["buf"])
+
+        class TumblingWindowOperator(BaseWindowOperator):
+            def __init__(self):
+                self.buf = {}
+
+            def step(self, k, row):
+                self.buf[k] = row
+    """)
+    assert only(diags, "PWT301") == []
+
+
+def test_pwt301_negative_non_operator_class(tmp_path):
+    # a plain cache class is outside the operator snapshot protocol
+    diags = run_check(tmp_path, """
+        class MetricsBag:
+            def __init__(self):
+                self.vals = {}
+
+            def bump(self, k):
+                self.vals[k] = self.vals.get(k, 0) + 1
+    """)
+    assert only(diags, "PWT301") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT302 — capture/restore key asymmetry
+# ---------------------------------------------------------------------------
+
+def test_pwt302_captured_key_never_restored(tmp_path):
+    diags = only(run_check(tmp_path, """
+        class BufferOperator:
+            def __init__(self):
+                self.held = {}
+                self.seen = set()
+
+            def snapshot_state(self):
+                return {"held": self.held, "seen": self.seen}
+
+            def restore_state(self, state):
+                self.held = dict(state["held"])
+    """), "PWT302")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "'seen'" in diags[0].message
+    assert "lost on recovery" in diags[0].message
+
+
+def test_pwt302_restored_key_never_captured(tmp_path):
+    diags = only(run_check(tmp_path, """
+        class BufferOperator:
+            def __init__(self):
+                self.held = {}
+
+            def snapshot_state(self):
+                return {"held": self.held}
+
+            def restore_state(self, state):
+                self.held = dict(state["held"])
+                self.wm = state["watermark"]
+    """), "PWT302")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "'watermark'" in diags[0].message
+
+
+def test_pwt302_negative_symmetric_keys(tmp_path):
+    diags = run_check(tmp_path, """
+        class BufferOperator:
+            def __init__(self):
+                self.held = {}
+                self.seen = set()
+
+            def snapshot_state(self):
+                st: dict = {"held": self.held}
+                st["seen"] = sorted(self.seen)
+                return st
+
+            def restore_state(self, state):
+                self.held = dict(state["held"])
+                if "seen" in state:
+                    self.seen = set(state["seen"])
+    """)
+    assert only(diags, "PWT302") == []
+
+
+def test_pwt302_negative_dynamic_restore_is_open(tmp_path):
+    # a restore that iterates the whole state dict may read any key:
+    # the "captured but never restored" direction cannot be claimed
+    diags = run_check(tmp_path, """
+        class BufferOperator:
+            def __init__(self):
+                self.held = {}
+                self.seen = set()
+
+            def snapshot_state(self):
+                return {"held": self.held, "seen": self.seen}
+
+            def restore_state(self, state):
+                for key, value in state.items():
+                    setattr(self, key, value)
+    """)
+    assert only(diags, "PWT302") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT303 — volatile-keyed snapshot state with no re-key on restore
+# ---------------------------------------------------------------------------
+
+_VOLATILE_KEYED = """
+    class DedupOperator:
+        def __init__(self):
+            self.held = {}
+
+        def step(self, key, row):
+            fp = row_fingerprint(row)
+            self.held[(key, fp)] = row
+
+        def snapshot_state(self):
+            return {"held": self.held}
+
+        def restore_state(self, state):
+            self.held = dict(state["held"])
+"""
+
+
+def test_pwt303_volatile_keys_without_rekey(tmp_path):
+    diags = only(run_check(tmp_path, _VOLATILE_KEYED), "PWT303")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "held" in diags[0].message
+    assert "re-key" in diags[0].message
+
+
+def test_pwt303_negative_rekeyed_on_restore(tmp_path):
+    diags = run_check(tmp_path, """
+        class DedupOperator:
+            def __init__(self):
+                self.held = {}
+
+            def step(self, key, row):
+                fp = row_fingerprint(row)
+                self.held[(key, fp)] = row
+
+            def snapshot_state(self):
+                return {"held": self.held}
+
+            def restore_state(self, state):
+                self.held = {(k, row_fingerprint(r)): r
+                             for (k, _), r in state["held"].items()}
+    """)
+    assert only(diags, "PWT303") == []
+
+
+def test_pwt303_negative_stable_keys(tmp_path):
+    # _stable_row_fp is a content digest — stable keys need no re-key
+    diags = run_check(tmp_path, """
+        class DedupOperator:
+            def __init__(self):
+                self.held = {}
+
+            def step(self, key, row):
+                fp = _stable_row_fp(row)
+                self.held[(key, fp)] = row
+
+            def snapshot_state(self):
+                return {"held": self.held}
+
+            def restore_state(self, state):
+                self.held = dict(state["held"])
+    """)
+    assert only(diags, "PWT303") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT304 — persistence-path write outside tmp+fsync+rename
+# ---------------------------------------------------------------------------
+
+def test_pwt304_torn_write_on_persistence_path(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import json
+
+        def save_manifest(root, manifest):
+            with open(root / "manifest.json", "w") as f:
+                f.write(json.dumps(manifest))
+    """), "PWT304")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "tmp+fsync+rename" in diags[0].message
+
+
+def test_pwt304_write_text_on_snapshot_path(tmp_path):
+    diags = only(run_check(tmp_path, """
+        def write_gen(snapshot_dir, payload):
+            (snapshot_dir / "gen-7.json").write_text(payload)
+    """), "PWT304")
+    assert len(diags) == 1
+
+
+def test_pwt304_negative_atomic_discipline(tmp_path):
+    # the enclosing function implements tmp+fsync+rename itself
+    diags = run_check(tmp_path, """
+        import os
+
+        def save_manifest(root, payload):
+            tmp = root / "manifest.json.tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, root / "manifest.json")
+    """)
+    assert only(diags, "PWT304") == []
+
+
+def test_pwt304_negative_non_persistence_path(tmp_path):
+    diags = run_check(tmp_path, """
+        def dump_debug(out_dir, payload):
+            with open(out_dir / "debug.csv", "w") as f:
+                f.write(payload)
+    """)
+    assert only(diags, "PWT304") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT305 — blocking persistence I/O with no named fault point
+# ---------------------------------------------------------------------------
+
+def test_pwt305_fsync_without_fault_point(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import os
+
+        def flush_log(f):
+            f.flush()
+            os.fsync(f.fileno())
+    """), "PWT305")
+    assert len(diags) == 1
+    assert not diags[0].is_error
+    assert "fault point" in diags[0].message
+
+
+def test_pwt305_negative_named_fault_point(tmp_path):
+    diags = run_check(tmp_path, """
+        import os
+
+        from pathway_tpu.testing import faults
+
+        def flush_log(f):
+            f.flush()
+            faults.hit("wal.fsync")
+            os.fsync(f.fileno())
+    """)
+    assert only(diags, "PWT305") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT306 — unrestricted pickle on a restore path
+# ---------------------------------------------------------------------------
+
+def test_pwt306_raw_pickle_loads(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import pickle
+
+        def load_snapshot(blob):
+            return pickle.loads(blob)
+    """), "PWT306")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "_safe_loads" in diags[0].message
+
+
+def test_pwt306_negative_safe_loads(tmp_path):
+    diags = run_check(tmp_path, """
+        from pathway_tpu.engine.persistence import _safe_loads
+
+        def load_snapshot(blob):
+            return _safe_loads(blob)
+    """)
+    assert only(diags, "PWT306") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT307 — Session.drain outside seal_drain
+# ---------------------------------------------------------------------------
+
+def test_pwt307_unsealed_drain(tmp_path):
+    diags = only(run_check(tmp_path, """
+        def pump(session, limit):
+            return session.drain(limit)
+    """), "PWT307")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "seal_drain" in diags[0].message
+
+
+def test_pwt307_negative_seal_drain_provider(tmp_path):
+    # the atomic helper itself, and the provider class's delegation
+    diags = run_check(tmp_path, """
+        class Recorder:
+            def seal_drain(self, tick, limit):
+                rows = self.session.drain(limit)
+                self._seal(tick, rows)
+                return rows
+
+            def _flush(self, tick, limit):
+                return self.session.drain(limit)
+    """)
+    assert only(diags, "PWT307") == []
+
+
+def test_pwt307_negative_non_session_receiver(tmp_path):
+    diags = run_check(tmp_path, """
+        def pump(queue, limit):
+            return queue.drain(limit)
+    """)
+    assert only(diags, "PWT307") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT308 — nondeterminism feeding snapshotted state
+# ---------------------------------------------------------------------------
+
+def test_pwt308_wallclock_into_snapshotted_attr(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import time
+
+        class StampOperator:
+            def __init__(self):
+                self.latest = {}
+
+            def step(self, key):
+                self.latest[key] = time.time()
+
+            def snapshot_state(self):
+                return {"latest": self.latest}
+
+            def restore_state(self, state):
+                self.latest = dict(state["latest"])
+    """), "PWT308")
+    assert len(diags) == 1
+    assert not diags[0].is_error
+    assert "diverge" in diags[0].message
+
+
+def test_pwt308_negative_uncaptured_scratch(tmp_path):
+    # wall-clock into an attr the snapshot never captures is fine
+    diags = run_check(tmp_path, """
+        import time
+
+        class StampOperator:
+            def __init__(self):
+                self.latest = {}
+                self._last_poll = 0.0
+
+            def step(self, key):
+                self._last_poll = time.time()
+                self.latest[key] = key
+
+            def snapshot_state(self):
+                return {"latest": self.latest}
+
+            def restore_state(self, state):
+                self.latest = dict(state["latest"])
+    """)
+    assert only(diags, "PWT308") == []
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+
+def test_waiver_suppresses_named_code(tmp_path):
+    diags = run_check(tmp_path, """
+        import pickle
+
+        def load_frame(blob):
+            # pwt-ok: PWT306 — trusted intra-process test fixture
+            return pickle.loads(blob)
+    """)
+    assert only(diags, "PWT306") == []
+
+
+def test_waiver_for_other_code_does_not_suppress(tmp_path):
+    diags = run_check(tmp_path, """
+        import pickle
+
+        def load_frame(blob):
+            # pwt-ok: PWT305 — wrong family
+            return pickle.loads(blob)
+    """)
+    assert len(only(diags, "PWT306")) == 1
+
+
+def test_scan_waivers_reports_codes_and_justification(tmp_path):
+    f = tmp_path / "mod_under_test.py"
+    f.write_text(textwrap.dedent("""
+        def load_frame(blob):
+            # pwt-ok: PWT306 — trusted fixture,
+            # never fed external bytes
+            return pickle.loads(blob)
+
+        def anything(x):
+            return x  # pwt-ok
+    """))
+    waivers = scan_waivers([str(f)])
+    assert [w["codes"] for w in waivers] == [["PWT306"], ["*"]]
+    assert waivers[0]["comment"] == \
+        "trusted fixture, never fed external bytes"
+    assert waivers[0]["line"] == 3
+
+
+def test_scan_waivers_ignores_strings_and_docstrings(tmp_path):
+    f = tmp_path / "mod_under_test.py"
+    f.write_text(textwrap.dedent('''
+        """Docs: suppress a finding with ``# pwt-ok: PWT306 — reason``."""
+
+        HELP = "list every pwt-ok waiver under the given paths"
+
+        def real(blob):
+            # pwt-ok: PWT306 — the only genuine marker in this module
+            return pickle.loads(blob)
+    '''))
+    waivers = scan_waivers([str(f)])
+    assert [w["line"] for w in waivers] == [7]
+    assert waivers[0]["codes"] == ["PWT306"]
+
+
+# ---------------------------------------------------------------------------
+# inventory
+# ---------------------------------------------------------------------------
+
+def test_inventory_operators_and_fault_points(tmp_path):
+    inv = durability_inventory(["pathway_tpu/engine"])
+    by_class = {o["class"]: o for o in inv["operators"]}
+    assert by_class["JoinOperator"]["has_snapshot_pair"]
+    assert "persistence.atomic.replace" in inv["fault_points"]
+    assert "fs.atomic_write.replace" in inv["fault_points"]
+    assert "observability.history.append" in inv["fault_points"]
+
+
+# ---------------------------------------------------------------------------
+# dogfood gates — the persistence plane itself must pass its own lint
+# ---------------------------------------------------------------------------
+
+def test_engine_source_is_durability_clean():
+    assert check_durability(["pathway_tpu/engine"]) == []
+
+
+def test_io_source_is_durability_clean():
+    assert check_durability(["pathway_tpu/io"]) == []
+
+
+def test_seeded_negative_example_trips_the_gate():
+    diags = check_durability(["tests/durability_negative_example.py"])
+    assert any(d.code == "PWT301" for d in diags)
+    assert any(d.code == "PWT304" and d.is_error for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# CLI front doors
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", "check", *args],
+        capture_output=True, text=True, env=None)
+
+
+def test_cli_durability_clean_and_json():
+    proc = _run_cli("--durability", "--json", "pathway_tpu/engine")
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["diagnostics"] == []
+    assert "persistence.atomic.replace" in \
+        payload["inventory"]["fault_points"]
+
+
+def test_cli_durability_seeded_negative_fails():
+    proc = _run_cli("--durability",
+                    "tests/durability_negative_example.py")
+    assert proc.returncode == 1
+    assert "PWT304" in proc.stdout
+
+
+def test_cli_all_clean_tree_and_schema(tmp_path):
+    proc = _run_cli("--all", "--json", "pathway_tpu/engine")
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["schema_version"] == 1
+    assert set(payload["families"]) == \
+        {"expression", "shard", "concurrency", "durability"}
+    assert payload["exit_code"] == 0
+
+
+def test_cli_all_exit_code_is_family_bitmask(tmp_path):
+    tree = tmp_path / "src"
+    tree.mkdir()
+    shutil.copy("tests/durability_negative_example.py",
+                tree / "negative.py")
+    proc = _run_cli("--all", "--json", str(tree))
+    assert proc.returncode == 8, proc.stderr  # durability bit only
+    payload = json.loads(proc.stdout)
+    assert payload["exit_code"] == 8
+    fam_codes = [d["code"] for d in payload["families"]["durability"]]
+    assert "PWT304" in fam_codes
+
+
+def test_cli_list_waivers_json_audit():
+    proc = _run_cli("--list-waivers", "--json", "pathway_tpu/engine")
+    assert proc.returncode == 0, proc.stderr
+    waivers = json.loads(proc.stdout)
+    wire = [w for w in waivers if w["file"].endswith("wire.py")]
+    assert wire and all(w["codes"] == ["PWT306"] for w in wire)
+    assert all(w["comment"] for w in wire)  # every waiver justified
+
+
+def test_cli_modes_are_mutually_exclusive():
+    proc = _run_cli("--concurrency", "--durability", "pathway_tpu/engine")
+    assert proc.returncode != 0
+    assert "mutually exclusive" in proc.stderr

@@ -1,0 +1,169 @@
+"""Restricted unpickler (persistence._safe_loads) vs everything the
+snapshot writer actually emits: every reducer state_dict (all 18
+REDUCER_FACTORIES), operator snapshot payloads (arrange rows keyed by
+Pointer, dedup emitted maps, temporal watermark/stamp state), paged-store
+page-table views, and the wire-format value types (numpy arrays, pandas
+timestamps). The flip side: a payload referencing any global OUTSIDE the
+whitelist is rejected by name, never constructed."""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine.persistence import _safe_loads
+from pathway_tpu.engine.reducers import (REDUCER_FACTORIES,
+                                         make_reducer_state)
+from pathway_tpu.internals.keys import Pointer
+
+
+def _round_trip(value):
+    return _safe_loads(pickle.dumps(value,
+                                    protocol=pickle.HIGHEST_PROTOCOL))
+
+
+# ---------------------------------------------------------------------------
+# reducer state_dicts — every factory the engine registers
+# ---------------------------------------------------------------------------
+
+# representative add() feeds per reducer family (args, diff)
+_FEEDS = {
+    "count": [((), 1), ((), 1)],
+    "sum": [((3,), 1), ((4,), 1)],
+    "int_sum": [((3,), 1), ((4,), 1)],
+    "float_sum": [((1.5,), 1), ((2.25,), 1)],
+    "array_sum": [((np.array([1.0, 2.0]),), 1),
+                  ((np.array([0.5, 0.5]),), 1)],
+    "avg": [((3,), 1), ((5,), 1)],
+    "min": [((3,), 1), ((7,), 1)],
+    "max": [((3,), 1), ((7,), 1)],
+    "argmin": [((3, "x"), 1), ((7, "y"), 1)],
+    "argmax": [((3, "x"), 1), ((7, "y"), 1)],
+    "unique": [(("u",), 1), (("u",), 1)],
+    "any": [(("z",), 1)],
+    "sorted_tuple": [((3,), 1), ((1,), 1)],
+    "tuple": [((3, 0), 1), ((1, 1), 1)],
+    "ndarray": [((1.0, 0), 1), ((2.0, 1), 1)],
+    "earliest": [(("a", 1), 1), (("b", 2), 1)],
+    "latest": [(("a", 1), 1), (("b", 2), 1)],
+    "stateful": [(("r",), 1), (("s",), 1)],
+}
+
+# callables are re-supplied at construction, never serialized
+_CTOR_KWARGS = {
+    "stateful": {"fn": lambda st, rows: (st or 0) + len(rows)},
+}
+
+
+def _emit_equal(name, a, b) -> bool:
+    if name in ("array_sum", "ndarray"):
+        return np.array_equal(a, b)
+    return a == b
+
+
+@pytest.mark.parametrize("name", sorted(REDUCER_FACTORIES))
+def test_every_reducer_state_dict_survives_safe_loads(name):
+    assert name in _FEEDS, f"no feed defined for reducer {name!r}"
+    kwargs = _CTOR_KWARGS.get(name, {})
+    st = make_reducer_state(name, **kwargs)
+    for args, diff in _FEEDS[name]:
+        st.add(args, diff)
+    state = _round_trip(st.state_dict())  # the exact persisted payload
+    fresh = make_reducer_state(name, **kwargs)
+    fresh.load_state(state)
+    assert _emit_equal(name, fresh.emit(), st.emit())
+
+
+def test_feed_table_covers_all_factories():
+    # a reducer added without a feed here would silently skip coverage
+    assert set(_FEEDS) == set(REDUCER_FACTORIES)
+
+
+def test_multiset_rekey_survives_retraction_after_load():
+    # load_state re-keys hash()-fingerprinted entries (the runtime twin
+    # of PWT303): a post-restore retraction must find its entry
+    st = make_reducer_state("min")
+    st.add(("a",), 1)
+    st.add(("b",), 1)
+    fresh = make_reducer_state("min")
+    fresh.load_state(_round_trip(st.state_dict()))
+    fresh.add(("a",), -1)
+    assert fresh.emit() == "b"
+
+
+# ---------------------------------------------------------------------------
+# operator snapshot payload shapes
+# ---------------------------------------------------------------------------
+
+def test_arrange_rows_with_pointer_keys_load():
+    # StatefulArrangeOperator.snapshot_state: {"rows": {Pointer: tuple}}
+    rows = {Pointer(7): ("a", 1), Pointer(9): ("b", 2)}
+    assert _round_trip({"rows": rows}) == {"rows": rows}
+
+
+def test_dedup_emitted_map_loads():
+    # DeduplicateOperator.snapshot_state: {"emitted": {key: (row, c)}}
+    payload = {"emitted": {Pointer(3): (("x", 1.5), 2)}}
+    assert _round_trip(payload) == payload
+
+
+def test_temporal_watermark_state_loads():
+    # temporal/earliest-latest style state: watermark ticks plus
+    # per-value stamp lists (plain ints/lists under fingerprint keys)
+    payload = {"wm": 12,
+               "stamps": {-123456789: [1, 4, 6]},
+               "values": {-123456789: "a"}}
+    assert _round_trip(payload) == payload
+
+
+def test_paged_store_page_table_view_loads():
+    # host-side page-table shape: logical slot -> (page, offset), plus
+    # the side columns a paged snapshot would carry (codes, scales)
+    payload = {
+        "page_rows": 128,
+        "slots": {i: (i // 128, i % 128) for i in range(0, 512, 64)},
+        "codes": np.arange(8, dtype=np.int8),
+        "scales": np.ones(8, dtype=np.float32),
+    }
+    out = _round_trip(payload)
+    assert out["slots"] == payload["slots"]
+    assert np.array_equal(out["codes"], payload["codes"])
+    assert np.array_equal(out["scales"], payload["scales"])
+
+
+def test_pandas_timestamp_values_load():
+    import pandas as pd
+
+    payload = {"t": pd.Timestamp("2026-08-06T12:00:00"),
+               "dt": pd.Timedelta(seconds=90)}
+    assert _round_trip(payload) == payload
+
+
+# ---------------------------------------------------------------------------
+# rejection — novel globals are refused by name
+# ---------------------------------------------------------------------------
+
+class _NotWhitelisted:
+    pass
+
+
+def test_novel_global_is_rejected_by_name():
+    blob = pickle.dumps({"x": _NotWhitelisted()},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    with pytest.raises(pickle.UnpicklingError) as e:
+        _safe_loads(blob)
+    assert "_NotWhitelisted" in str(e.value)
+    assert "forbidden" in str(e.value)
+
+
+def test_os_system_reduce_payload_is_rejected():
+    class _Evil:
+        def __reduce__(self):
+            import os
+            return (os.system, ("true",))
+
+    blob = pickle.dumps(_Evil(), protocol=pickle.HIGHEST_PROTOCOL)
+    with pytest.raises(pickle.UnpicklingError):
+        _safe_loads(blob)

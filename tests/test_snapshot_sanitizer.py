@@ -1,0 +1,289 @@
+"""Runtime snapshot-coverage sanitizer (engine/snapshot_sanitizer.py):
+unit coverage for mutation tracing, coverage diffing, the exempt tuple,
+report mode and the shadow restore round-trip — then end-to-end on a
+real streaming graph: a seeded uncovered-attr mutation is caught at the
+first snapshot, and a fully sanitized recovery run stays violation-free
+with restored output byte-identical to the unsanitized baseline."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import snapshot_sanitizer as snapsan
+from pathway_tpu.engine.operators import Operator
+from pathway_tpu.engine.snapshot_sanitizer import (
+    SnapshotCoverageViolation, checked_snapshot, track_operator, violations)
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import faults
+from pathway_tpu.testing.faults import flaky_subject
+
+WORDS = ["a", "b", "a", "c", "b", "a"]
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    G.clear()
+    faults.reset()
+    snapsan._reset_for_tests()
+    yield
+    G.clear()
+    faults.reset()
+    snapsan._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# toy operators
+# ---------------------------------------------------------------------------
+
+class LeakyOperator(Operator):
+    """Mutates ``scratch`` that ``snapshot_state`` never captures."""
+
+    def __init__(self):
+        self.counts: dict = {}
+        self.scratch: dict = {}
+
+    def snapshot_state(self):
+        return {"counts": dict(self.counts)}
+
+    def restore_state(self, state) -> None:
+        self.counts = dict(state["counts"])
+
+
+class LossyOperator(Operator):
+    """Captures two keys; restore resets one — not a fixed point.
+
+    (A restore that leaves ``b`` *untouched* is invisible to the shadow
+    round-trip — the shadow starts from the live instance — which is why
+    the static PWT302 key-asymmetry check exists alongside this.)"""
+
+    def __init__(self):
+        self.a: dict = {}
+        self.b: dict = {}
+
+    def snapshot_state(self):
+        return {"a": dict(self.a), "b": dict(self.b)}
+
+    def restore_state(self, state) -> None:
+        self.a = dict(state["a"])
+        self.b = {}  # captured "b" discarded
+
+
+class StatelessOperator(Operator):
+    """No snapshot_state override — outside the snapshot protocol."""
+
+
+# ---------------------------------------------------------------------------
+# unit: tracking + coverage diff
+# ---------------------------------------------------------------------------
+
+def test_uncovered_inplace_mutation_raises():
+    op = track_operator(LeakyOperator())
+    op.counts["a"] = 1   # covered: snapshot_state reads self.counts
+    op.scratch["x"] = 1  # in-place, never captured
+    with pytest.raises(SnapshotCoverageViolation) as e:
+        checked_snapshot(op)
+    assert "'scratch'" in str(e.value)
+    assert "LeakyOperator" in str(e.value)
+    assert "_snapshot_sanitizer_exempt" in str(e.value)
+
+
+def test_uncovered_rebind_names_the_write_site():
+    op = track_operator(LeakyOperator())
+    op.scratch = {"x": 1}  # rebind goes through the __setattr__ tracer
+    with pytest.raises(SnapshotCoverageViolation) as e:
+        checked_snapshot(op)
+    assert "test_snapshot_sanitizer.py" in str(e.value)
+
+
+def test_covered_mutation_is_clean_and_round_trips():
+    op = track_operator(LeakyOperator())
+    op.counts["a"] = 1
+    assert checked_snapshot(op) == {"counts": {"a": 1}}
+    assert violations() == []
+    # baselines reset at each snapshot: a fresh covered mutation is
+    # clean again, an old one does not re-fire
+    op.counts["b"] = 2
+    assert checked_snapshot(op) == {"counts": {"a": 1, "b": 2}}
+    assert violations() == []
+
+
+def test_exempt_tuple_suppresses_scratch_attr():
+    class ExemptOperator(LeakyOperator):
+        _snapshot_sanitizer_exempt = ("scratch",)
+
+    op = track_operator(ExemptOperator())
+    op.scratch["x"] = 1
+    checked_snapshot(op)
+    assert violations() == []
+
+
+def test_stateless_operator_is_not_tracked():
+    op = StatelessOperator()
+    assert track_operator(op) is op
+    assert type(op) is StatelessOperator  # class swap skipped
+
+
+def test_traced_class_is_indistinguishable():
+    # graph_fingerprint() keys node identity on type(op).__name__
+    op = track_operator(LeakyOperator())
+    assert type(op).__name__ == "LeakyOperator"
+    assert type(op).__qualname__ == LeakyOperator.__qualname__
+    assert isinstance(op, LeakyOperator)
+
+
+def test_untracked_operator_passes_through():
+    op = LeakyOperator()  # never tracked
+    op.scratch["x"] = 1
+    assert checked_snapshot(op) == {"counts": {}}
+    assert violations() == []
+
+
+def test_report_mode_records_without_raising(monkeypatch):
+    monkeypatch.setenv("PATHWAY_SNAPSHOT_SANITIZER", "report")
+    op = track_operator(LeakyOperator())
+    op.scratch["x"] = 1
+    assert checked_snapshot(op) == {"counts": {}}
+    assert len(violations()) == 1
+    assert "'scratch'" in violations()[0]["message"]
+
+
+def test_reset_for_tests_clears_log():
+    op = track_operator(LeakyOperator())
+    op.scratch["x"] = 1
+    with pytest.raises(SnapshotCoverageViolation):
+        checked_snapshot(op)
+    assert violations()
+    snapsan._reset_for_tests()
+    assert violations() == []
+
+
+# ---------------------------------------------------------------------------
+# unit: shadow round-trip
+# ---------------------------------------------------------------------------
+
+def test_lossy_restore_is_not_a_fixed_point():
+    op = track_operator(LossyOperator())
+    op.a["k"] = 1
+    op.b["k"] = 2
+    with pytest.raises(SnapshotCoverageViolation) as e:
+        checked_snapshot(op)
+    assert "not a fixed point" in str(e.value)
+    assert "PWT302" in str(e.value)  # points at the static twin
+
+
+def test_unpicklable_state_is_a_violation():
+    class CallableStateOperator(LeakyOperator):
+        def snapshot_state(self):
+            return {"counts": dict(self.counts), "fn": lambda: None}
+
+    op = track_operator(CallableStateOperator())
+    with pytest.raises(SnapshotCoverageViolation) as e:
+        checked_snapshot(op)
+    assert "not picklable" in str(e.value)
+
+
+class _Opaque:  # picklable (module-level) but not in _SAFE_GLOBALS
+    pass
+
+
+def test_non_whitelisted_state_type_is_a_violation():
+    class OpaqueStateOperator(LeakyOperator):
+        def snapshot_state(self):
+            return {"counts": dict(self.counts), "blob": _Opaque()}
+
+    op = track_operator(OpaqueStateOperator())
+    with pytest.raises(SnapshotCoverageViolation) as e:
+        checked_snapshot(op)
+    assert "restricted unpickler" in str(e.value)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end on a real streaming graph
+# ---------------------------------------------------------------------------
+
+def _rows(words):
+    return [{"word": w} for w in words]
+
+
+def _run_wordcount(subject, *, backend=None):
+    G.clear()
+    t = pw.io.python.read(
+        subject, schema=pw.schema_from_types(word=str),
+        autocommit_duration_ms=10, persistent_id="sanitizer-words")
+    counts = t.groupby(t.word).reduce(word=t.word, c=pw.reducers.count())
+    state: dict[str, int] = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            state[row["word"]] = row["c"]
+        elif state.get(row["word"]) == row["c"]:
+            del state[row["word"]]
+
+    pw.io.subscribe(counts, on_change)
+    cfg = None
+    if backend is not None:
+        cfg = pw.persistence.Config.simple_config(backend)
+    pw.run(persistence_config=cfg)
+    return state
+
+
+def _as_bytes(state: dict) -> bytes:
+    return json.dumps(sorted(state.items())).encode()
+
+
+def test_e2e_seeded_uncovered_mutation_is_caught(monkeypatch, tmp_path):
+    """A groupby operator leaking per-step state into an attr its
+    snapshot never captures dies at the first snapshot pass, not as
+    silently wrong answers after a future recovery."""
+    from pathway_tpu.engine.operators import (ColumnarGroupByOperator,
+                                              GroupByOperator)
+
+    for cls in (ColumnarGroupByOperator, GroupByOperator):
+        orig_init = cls.__init__
+        orig_step = cls.step
+
+        def patched_init(self, *a, __orig=orig_init, **k):
+            __orig(self, *a, **k)
+            self._leak = {}
+
+        def patched_step(self, time, in_deltas, __orig=orig_step):
+            self._leak[time] = time  # uncovered in-place mutation
+            return __orig(self, time, in_deltas)
+
+        monkeypatch.setattr(cls, "__init__", patched_init)
+        monkeypatch.setattr(cls, "step", patched_step)
+
+    monkeypatch.setenv("PATHWAY_SNAPSHOT_SANITIZER", "1")
+    monkeypatch.setenv("PATHWAY_SNAPSHOT_EVERY_TICKS", "2")
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+    with pytest.raises(SnapshotCoverageViolation) as e:
+        _run_wordcount(flaky_subject(_rows(WORDS), fail_after=0,
+                                     fail_attempts=0, delay_s=0.02),
+                       backend=backend)
+    assert "'_leak'" in str(e.value)
+
+
+def test_e2e_sanitized_recovery_is_clean_and_byte_identical(monkeypatch,
+                                                            tmp_path):
+    """The acceptance run: full recovery cycle under the live sanitizer
+    — zero violations, restored output byte-identical to the
+    unsanitized baseline."""
+    baseline = _run_wordcount(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0))
+    assert baseline == {"a": 3, "b": 2, "c": 1}
+
+    monkeypatch.setenv("PATHWAY_SNAPSHOT_SANITIZER", "1")
+    monkeypatch.setenv("PATHWAY_SNAPSHOT_EVERY_TICKS", "2")
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+    first = _run_wordcount(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0,
+                      delay_s=0.02), backend=backend)
+    assert _as_bytes(first) == _as_bytes(baseline)
+    restored = _run_wordcount(
+        flaky_subject(_rows(WORDS), fail_after=0, fail_attempts=0),
+        backend=backend)
+    assert _as_bytes(restored) == _as_bytes(baseline)
+    assert violations() == []
