@@ -77,6 +77,73 @@ _LEG_FNS = {
     "serving": lambda: bench_serving(),
 }
 
+
+class _DeviceEventCounter:
+    """Per-leg XLA compile + implicit host→device transfer counts.
+
+    Compiles come from the device sanitizer's monitoring listener
+    (engine/device_sanitizer.install_compile_counter — a plain counter,
+    no env gate). Transfers ride JAX's transfer guard in ``log`` mode,
+    whose per-transfer lines come out of C++ (guard_lib.cc) on fd 2 —
+    invisible to Python-level stderr hooks — so the guard window
+    captures fd 2 into a temp file, counts the marker lines, and replays
+    the bytes to the real stderr so nothing is swallowed. The counts
+    join BENCH_HISTORY.jsonl as ``{leg}_compile_count`` /
+    ``{leg}_transfer_count`` with lower-is-better pins in
+    ``_BENCH_DIRECTIONS``: a recompile zoo or a new per-tick upload then
+    fails ``--check-regression`` numerically even with the sanitizer
+    off."""
+
+    def __init__(self):
+        from pathway_tpu.engine.device_sanitizer import \
+            install_compile_counter
+
+        self._compiles = install_compile_counter()
+
+    def count(self, leg: str, fn):
+        """Run ``fn()`` and return (its result, the events dict)."""
+        import tempfile
+
+        import jax
+
+        c0 = self._compiles()
+        tmp = tempfile.TemporaryFile()
+        saved = os.dup(2)
+        guarded = True
+        try:
+            # restore whatever mode was active (the device sanitizer may
+            # hold "disallow" in steady state — don't weaken it for good)
+            prev = jax.config.jax_transfer_guard_host_to_device or "allow"
+            jax.config.update("jax_transfer_guard_host_to_device", "log")
+        except Exception:  # noqa: BLE001 — older jax: compiles only
+            guarded = False
+        os.dup2(tmp.fileno(), 2)
+        try:
+            out = fn()
+        finally:
+            os.dup2(saved, 2)
+            os.close(saved)
+            if guarded:
+                try:
+                    jax.config.update(
+                        "jax_transfer_guard_host_to_device", prev)
+                except Exception:  # noqa: BLE001
+                    pass
+            tmp.seek(0)
+            data = tmp.read()
+            tmp.close()
+            if data:
+                try:
+                    os.write(2, data)  # replay: keep stderr observable
+                except OSError:
+                    pass
+        events = {f"{leg}_compile_count": self._compiles() - c0}
+        if guarded:
+            events[f"{leg}_transfer_count"] = sum(
+                b"host-to-device transfer" in line
+                for line in data.splitlines())
+        return out, events
+
 # serving-path SLO leg (bench_serving): slab size / dim / query count
 SERVING_N = int(os.environ.get("BENCH_SERVING_N", 100_000))
 SERVING_DIM = int(os.environ.get("BENCH_SERVING_DIM", KNN_DIM))
@@ -171,6 +238,19 @@ _BENCH_DIRECTIONS = {
     "recovery_snapshot_restart_s_1000": "lower",
     "recovery_snapshot_restart_s_10000": "lower",
     "recovery_snapshot_restart_s_100000": "lower",
+    # device-discipline columns (_DeviceEventCounter): bare counts carry
+    # no unit marker the name heuristic could judge, and both are
+    # strictly lower-is-better — a rising compile count is a recompile
+    # zoo and a rising transfer count a new per-tick host→device upload,
+    # caught numerically here even when PATHWAY_DEVICE_SANITIZER is off
+    "embed_compile_count": "lower",
+    "embed_transfer_count": "lower",
+    "framework_compile_count": "lower",
+    "framework_transfer_count": "lower",
+    "knn_compile_count": "lower",
+    "knn_transfer_count": "lower",
+    "serving_compile_count": "lower",
+    "serving_transfer_count": "lower",
 }
 
 
@@ -336,10 +416,19 @@ def _run_device_legs_child() -> None:
                       f"{str(e)[:300]}"}), flush=True)
         return
     print(json.dumps(result), flush=True)
+    try:
+        counter = _DeviceEventCounter()
+    except Exception:  # noqa: BLE001 — counting must never kill a leg
+        counter = None
     for leg in legs:
         _set_stage(leg)
         try:
-            result.update(_LEG_FNS[leg]())
+            if counter is not None:
+                leg_out, events = counter.count(leg, _LEG_FNS[leg])
+                result.update(leg_out)
+                result.update(events)
+            else:
+                result.update(_LEG_FNS[leg]())
         except Exception as e:  # noqa: BLE001
             result[f"{leg}_error"] = f"{type(e).__name__}: {str(e)[:300]}"
         if "framework_docs_per_s" in result and "docs_per_s" in result:

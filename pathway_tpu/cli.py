@@ -157,14 +157,20 @@ replay = _apply(_spawn_opts, replay)
                    "capture/restore contracts, atomic persistence writes, "
                    "restricted unpickling) — nothing is imported or "
                    "executed")
+@click.option("--perf", "perf", is_flag=True,
+              help="run the PWT4xx device-discipline lint instead: an AST "
+                   "pass over the given source files/directories "
+                   "(recompile zoos, hidden host-device syncs, per-row "
+                   "dispatch, donation/residency discipline, warmup "
+                   "registry coverage) — nothing is imported or executed")
 @click.option("--all", "all_families", is_flag=True,
               help="run every check family in one pass: script analysis "
                    "(PWT0xx expression + PWT1xx shard) over .py file "
                    "arguments, source lints (PWT2xx concurrency + PWT3xx "
-                   "durability) over directory arguments; --json emits a "
-                   "versioned per-family payload and the exit code is a "
-                   "bitmask (expression=1, shard=2, concurrency=4, "
-                   "durability=8)")
+                   "durability + PWT4xx perf) over directory arguments; "
+                   "--json emits a versioned per-family payload and the "
+                   "exit code is a bitmask (expression=1, shard=2, "
+                   "concurrency=4, durability=8, perf=16)")
 @click.option("--list-waivers", "list_waivers", is_flag=True,
               help="report every inline 'pwt-ok' waiver under the given "
                    "source trees (code, file:line, justification) instead "
@@ -172,7 +178,7 @@ replay = _apply(_spawn_opts, replay)
                    "CI audit artifacts")
 @click.argument("paths", nargs=-1, required=True)
 def check(paths, strict, require_pipeline, tpu_mesh, as_json, concurrency,
-          durability, all_families, list_waivers):
+          durability, perf, all_families, list_waivers):
     """Statically analyze pipeline scripts without running them.
 
     Imports each script (or every ``*.py`` under a directory) with
@@ -185,12 +191,14 @@ def check(paths, strict, require_pipeline, tpu_mesh, as_json, concurrency,
     with placeholder inputs to have it checked. Exits nonzero on any
     error-severity diagnostic.
 
-    With ``--concurrency`` or ``--durability`` the paths are treated as
-    SOURCE trees instead: the PWT2xx concurrency lint (thread inventory,
-    lock inventory, lock-order graph) or the PWT3xx durability lint
-    (snapshot coverage, capture/restore symmetry, atomic persistence) —
-    both internals/static_check/ AST passes — run over them without
-    importing anything; ``--json`` adds the inventories to the payload.
+    With ``--concurrency``, ``--durability`` or ``--perf`` the paths are
+    treated as SOURCE trees instead: the PWT2xx concurrency lint (thread
+    inventory, lock inventory, lock-order graph), the PWT3xx durability
+    lint (snapshot coverage, capture/restore symmetry, atomic
+    persistence) or the PWT4xx device-discipline lint (recompile zoos,
+    hidden host-device syncs, donation/residency discipline) — all
+    internals/static_check/ AST passes — run over them without importing
+    anything; ``--json`` adds the inventories to the payload.
 
     ``--all`` runs every family in one invocation; ``--list-waivers``
     audits inline ``pwt-ok`` suppressions instead of linting."""
@@ -202,7 +210,8 @@ def check(paths, strict, require_pipeline, tpu_mesh, as_json, concurrency,
 
     modes = [name for flag, name in (
         (concurrency, "--concurrency"), (durability, "--durability"),
-        (all_families, "--all"), (list_waivers, "--list-waivers"),
+        (perf, "--perf"), (all_families, "--all"),
+        (list_waivers, "--list-waivers"),
     ) if flag]
     if len(modes) > 1:
         raise click.UsageError(
@@ -216,6 +225,9 @@ def check(paths, strict, require_pipeline, tpu_mesh, as_json, concurrency,
         return
     if durability:
         _check_durability_cli(paths, strict=strict, as_json=as_json)
+        return
+    if perf:
+        _check_perf_cli(paths, strict=strict, as_json=as_json)
         return
     if list_waivers:
         _list_waivers_cli(paths, as_json=as_json)
@@ -358,6 +370,43 @@ def _check_durability_cli(paths, *, strict: bool, as_json: bool) -> None:
         sys.exit(1)
 
 
+def _check_perf_cli(paths, *, strict: bool, as_json: bool) -> None:
+    """``check --perf``: the PWT4xx device-discipline lint. Same
+    exit-code semantics as ``--concurrency``; ``--json`` adds the jit /
+    hot-unit / warmup-registry inventory for CI artifacts."""
+    import json as _json
+
+    from pathway_tpu.internals.static_check import (Severity, check_perf,
+                                                    perf_inventory)
+    from pathway_tpu.internals.static_check.durability_check import \
+        build_corpus
+
+    try:
+        corpus = build_corpus(paths)  # one parse serves check + inventory
+        diagnostics = check_perf(paths, corpus=corpus)
+    except ValueError as e:
+        raise click.UsageError(str(e))
+    bad = [d for d in diagnostics
+           if d.severity is Severity.ERROR
+           or (strict and d.severity is Severity.WARNING)]
+    if as_json:
+        payload = {
+            "diagnostics": [d.to_dict() for d in diagnostics],
+            "inventory": perf_inventory(paths, corpus=corpus),
+        }
+        click.echo(_json.dumps(payload, indent=2))
+    else:
+        for d in diagnostics:
+            click.echo(str(d))
+    status = "FAIL" if bad else "ok"
+    click.echo(f"[{status}] perf check over {', '.join(paths)} — "
+               f"{len(diagnostics)} diagnostic(s)", err=True)
+    if bad:
+        click.echo(f"perf check failed: {len(bad)} blocking "
+                   f"diagnostic(s)", err=True)
+        sys.exit(1)
+
+
 def _list_waivers_cli(paths, *, as_json: bool) -> None:
     """``check --list-waivers``: audit inline ``pwt-ok`` suppressions.
     Always exits 0 — waivers are sanctioned, the point is visibility
@@ -382,21 +431,42 @@ def _list_waivers_cli(paths, *, as_json: bool) -> None:
 # ``check --all`` exit code is a bitmask so CI can tell which family
 # regressed from the code alone (and --json mirrors it as "exit_code")
 _FAMILY_BITS = {"expression": 1, "shard": 2, "concurrency": 4,
-                "durability": 8}
+                "durability": 8, "perf": 16}
+
+
+def _defer_pwt105(shard_diags: list, trees) -> list:
+    """PWT105 defers to PWT402 when both families run in one invocation:
+    drop PWT105 findings whose UDF *definition* (the related trace
+    shard_check attaches) lives under a tree the PWT4xx pass scanned —
+    the wider device-path lint already covers that source, and keeping
+    both would double-report every sync site."""
+    import pathlib
+
+    roots = [pathlib.Path(t).resolve() for t in trees]
+
+    def _covered(d) -> bool:
+        if d.code != "PWT105" or not d.related:
+            return False
+        f = pathlib.Path(d.related[0].file_name).resolve()
+        return any(root == f or root in f.parents for root in roots)
+
+    return [d for d in shard_diags if not _covered(d)]
 
 
 def _check_all_cli(paths, *, strict: bool, as_json: bool) -> None:
     """``check --all``: every family in one invocation. ``.py`` file
     arguments get the script analysis (PWT0xx expression / PWT1xx shard,
     split per diagnostic code); directory arguments get the source lints
-    (PWT2xx concurrency, PWT3xx durability). The JSON payload is
-    versioned (``schema_version``) so CI consumers can evolve with it."""
+    (PWT2xx concurrency, PWT3xx durability, PWT4xx perf). The JSON
+    payload is versioned (``schema_version``) so CI consumers can evolve
+    with it."""
     import json as _json
     import pathlib
 
     from pathway_tpu.internals.static_check import (Severity,
                                                     check_concurrency,
-                                                    check_durability)
+                                                    check_durability,
+                                                    check_perf)
 
     scripts = [p for p in paths if pathlib.Path(p).suffix == ".py"]
     trees = [p for p in paths if p not in scripts]
@@ -406,7 +476,8 @@ def _check_all_cli(paths, *, strict: bool, as_json: bool) -> None:
                 f"not a python script or directory: {p}")
 
     families: dict[str, list] = {
-        "expression": [], "shard": [], "concurrency": [], "durability": []}
+        "expression": [], "shard": [], "concurrency": [],
+        "durability": [], "perf": []}
     for script in scripts:
         diagnostics, _collected = _collect_and_check(
             pathlib.Path(script), mesh=None)
@@ -417,8 +488,10 @@ def _check_all_cli(paths, *, strict: bool, as_json: bool) -> None:
         try:
             families["concurrency"] = check_concurrency(trees)
             families["durability"] = check_durability(trees)
+            families["perf"] = check_perf(trees)
         except ValueError as e:
             raise click.UsageError(str(e))
+        families["shard"] = _defer_pwt105(families["shard"], trees)
 
     exit_code = 0
     for fam, diagnostics in families.items():
@@ -434,7 +507,9 @@ def _check_all_cli(paths, *, strict: bool, as_json: bool) -> None:
                    f"{len(diagnostics)} diagnostic(s)", err=True)
     if as_json:
         click.echo(_json.dumps({
-            "schema_version": 1,
+            # v2: adds the "perf" family (PWT4xx, exit bit 16) and the
+            # PWT105→PWT402 deference over shared trees
+            "schema_version": 2,
             "families": {fam: [d.to_dict() for d in diagnostics]
                          for fam, diagnostics in families.items()},
             "exit_code": exit_code,

@@ -21,8 +21,16 @@ capture/restore symmetry, atomic-write and fault-point discipline,
 restore-path safety. :func:`check_durability` is the API door,
 ``check --durability`` the CLI door, and the snapshot-coverage sanitizer
 (``PATHWAY_SNAPSHOT_SANITIZER=1``, engine/snapshot_sanitizer.py) the
-execution door. ``check --all`` runs all four families in one invocation
-with a versioned JSON document and per-family exit bits, and
+execution door.
+
+The fifth family, ``PWT401``–``PWT408`` (perf_check.py), guards the
+serving hot path's device discipline: recompile zoos, hidden host-device
+syncs (superseding PWT105's narrower list), per-row dispatch, residency,
+donation and warmup-registry coverage. :func:`check_perf` is the API
+door, ``check --perf`` the CLI door, and the steady-state device
+sanitizer (``PATHWAY_DEVICE_SANITIZER=1``, engine/device_sanitizer.py)
+the execution door. ``check --all`` runs all five families in one
+invocation with a versioned JSON document and per-family exit bits, and
 ``check --list-waivers`` (:func:`scan_waivers`) audits every inline
 ``pwt-ok`` exemption.
 
@@ -58,6 +66,10 @@ from pathway_tpu.internals.static_check.durability_check import (
     check_durability,
     durability_inventory,
 )
+from pathway_tpu.internals.static_check.perf_check import (
+    check_perf,
+    perf_inventory,
+)
 from pathway_tpu.internals.static_check.shard_check import (
     MeshSpec,
     UdfClassification,
@@ -72,9 +84,10 @@ from pathway_tpu.internals.static_check.waivers import (
 __all__ = [
     "Analyzer", "CODES", "Diagnostic", "MeshSpec", "Severity",
     "StaticCheckError", "UdfClassification", "analyze",
-    "check_concurrency", "check_durability", "classify_udf",
-    "concurrency_inventory", "durability_inventory", "parse_mesh_spec",
-    "render", "render_waivers", "scan_waivers", "static_check",
+    "check_concurrency", "check_durability", "check_perf",
+    "classify_udf", "concurrency_inventory", "durability_inventory",
+    "parse_mesh_spec", "perf_inventory", "render", "render_waivers",
+    "scan_waivers", "static_check",
 ]
 
 

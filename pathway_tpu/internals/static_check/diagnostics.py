@@ -158,6 +158,43 @@ CODES: dict[str, tuple[Severity, str]] = {
                "nondeterminism source (time.time, random, os.urandom, "
                "uuid4) feeds snapshotted state — restored replicas "
                "diverge from the writer"),
+    # -- PWT4xx: device-path perf discipline (static_check/
+    # perf_check.py). Source-level AST analysis over the serving hot
+    # path (engine/, ops/, models/, parallel/): recompile zoos, hidden
+    # host-device syncs, per-row dispatch, residency and donation
+    # discipline. Runtime twin: PATHWAY_DEVICE_SANITIZER
+    # (engine/device_sanitizer.py).
+    "PWT401": (Severity.ERROR,
+               "jitted callable dispatched with an unbucketed data-"
+               "dependent shape (every distinct length compiles a fresh "
+               "executable — a recompile zoo on the serving path)"),
+    "PWT402": (Severity.ERROR,
+               "host-device sync point (.item()/.tolist()/int()/float()/"
+               "np.asarray/bare block_until_ready) on a per-batch path "
+               "outside instrumentation code"),
+    "PWT403": (Severity.WARNING,
+               "per-row device dispatch inside a Python loop where a "
+               "batched/vmapped kernel exists in the same module"),
+    "PWT404": (Severity.WARNING,
+               "implicit host→device transfer per tick: numpy operand "
+               "fed to a jitted callable with no device residency or "
+               "device_put upstream"),
+    "PWT405": (Severity.ERROR,
+               "float64/weak-type promotion reaching kernel code (TPUs "
+               "emulate f64 at ~1/10 throughput; one stray dtype "
+               "contaminates every downstream op)"),
+    "PWT406": (Severity.ERROR,
+               "donated buffer read after donation (XLA may have reused "
+               "the memory: garbage values or a crash, backend-"
+               "dependent)"),
+    "PWT407": (Severity.WARNING,
+               "jitted serving entry point absent from pw.warmup's "
+               "bucket registry (the cold compile lands on the first "
+               "real query instead of warmup)"),
+    "PWT408": (Severity.WARNING,
+               "blocking host I/O (file/socket/log flush) inside a "
+               "device-leg function (stalls the dispatch pipeline for "
+               "host I/O time)"),
 }
 
 

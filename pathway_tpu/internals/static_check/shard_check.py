@@ -47,6 +47,7 @@ from dataclasses import dataclass
 
 from pathway_tpu.internals import expression as ex
 from pathway_tpu.internals.static_check.diagnostics import Diagnostic
+from pathway_tpu.internals.trace import Trace
 
 # axis names mirror parallel/mesh.py (not imported: that module pulls jax
 # at mesh-construction time; the checker must stay importable without it)
@@ -345,6 +346,14 @@ class _UdfVisitor(ast.NodeVisitor):
         if isinstance(func, ast.Name):
             name = func.id
             if name in _VMAP_BUILTINS:
+                if name in ("int", "float", "bool") and node.args \
+                        and not isinstance(node.args[0], ast.Constant):
+                    # the cast's implicit .item() blocks until the device
+                    # flushes — the sync form PWT105's original list
+                    # missed (PWT402 widened the contract; this keeps
+                    # classify_udf's view consistent with it)
+                    self._sync(f"{name}() cast on a device value blocks "
+                               "on an implicit .item()")
                 self._bump("vmappable",
                            f"scalar builtin {name}() (vmap-able)")
             elif name in _HOST_BUILTINS:
@@ -464,6 +473,21 @@ def classify_udf(fn) -> UdfClassification:
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _udf_def_trace(fn) -> Trace | None:
+    """Where the UDF is *defined* (vs. where it is applied, which the
+    diagnostic's main trace carries). PWT105 attaches this as a related
+    trace so ``check --all`` can tell whether the definition lives in a
+    tree the PWT4xx device-path lint already scanned — and defer to
+    PWT402 there instead of double-reporting the same sync."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        code = getattr(getattr(fn, "func", None), "__code__", None)
+    if code is None:
+        return None
+    return Trace(code.co_filename, code.co_firstlineno,
+                 getattr(fn, "__name__", "<udf>"), "")
 
 
 def _is_framework_fn(fn) -> bool:
@@ -811,7 +835,8 @@ class ShardChecker:
                 f"every engine batch stalls the dispatch queue — fix: keep "
                 f"values on device (jnp ops) or move the conversion off "
                 f"the hot path",
-                node, expr=expr)
+                node, expr=expr,
+                related=(t,) if (t := _udf_def_trace(expr._fn)) else ())
         elif cls.kind == "host":
             detail = "; ".join(cls.reasons[:3]) or "unclassifiable"
             sync = (f" (also: {'; '.join(cls.sync_points)})"

@@ -685,10 +685,15 @@ class BruteForceKnnIndex:
         idxs = np.fromiter(self._stale, dtype=np.int32)
         self._stale.clear()
         if self._is_int8:
+            # pwt-ok: PWT402 — deliberate consolidation read at a mirror
+            # boundary (pre-grow realloc / host exact reads), amortized
+            # over the whole stale set, not a per-batch sync
             rows = np.asarray(self._dev_vectors[idxs], dtype=np.float32)
+            # pwt-ok: PWT402 — same consolidation read (int8 scales leg)
             scales = np.asarray(self._dev_scales[idxs], dtype=np.float32)
             self._host_vectors[idxs] = rows * scales[:, None]
             return
+        # pwt-ok: PWT402 — same consolidation read (float slab path)
         self._host_vectors[idxs] = np.asarray(
             self._dev_vectors[idxs]).astype(self._np_dtype)
 
@@ -797,6 +802,9 @@ class BruteForceKnnIndex:
         sustained throughput after this."""
         with self._lock:
             if self._dev_valid is not None:
+                # pwt-ok: PWT402 — deliberate materialization barrier:
+                # drain() exists to block until dispatched device work
+                # resolves (benches stamp throughput after it)
                 np.asarray(self._dev_valid[:1])
 
     def _get_search_fn(self, k: int):

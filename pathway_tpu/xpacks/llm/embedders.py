@@ -264,14 +264,21 @@ class JaxEncoderEmbedder(BaseEmbedder):
         still ON DEVICE (a jax array, dispatch left asynchronous). The
         fused index path (ops/knn.py DeviceEmbeddingKnnIndex) scatters it
         straight into the HBM slab — embeddings never visit the host."""
-        if self.ragged:
-            import jax.numpy as jnp
+        import jax.numpy as jnp
 
-            outs = [self._encode_ragged(self.params, *args)[:n_docs]
+        # residency is established EXPLICITLY (jnp.asarray) rather than by
+        # letting the jit dispatch transfer its numpy operands implicitly:
+        # same bytes over PCIe either way, but the explicit form stays
+        # legal under the device sanitizer's steady-state transfer guard
+        # (engine/device_sanitizer.py) and under PWT404's discipline
+        if self.ragged:
+            outs = [self._encode_ragged(
+                self.params, *(jnp.asarray(a) for a in args))[:n_docs]
                     for args, n_docs, _n_pad in self.pack_ragged(texts)]
             return outs[0] if len(outs) == 1 else jnp.concatenate(outs, 0)
         ids, lens = self.pack_tokens(texts)
-        return self._encode_packed(self.params, ids, lens)
+        return self._encode_packed(self.params, jnp.asarray(ids),
+                                   jnp.asarray(lens))
 
     def embed_batch(self, texts: list[str]) -> np.ndarray:
         return np.asarray(self.encode_batch_device(texts))

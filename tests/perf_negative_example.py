@@ -1,0 +1,80 @@
+"""Deliberately device-undisciplined module — CI's inverted lint gate.
+
+Never imported: tests/test_perf_check.py and the ``device-lint`` CI job
+run ``check --perf`` over this file and require it to FAIL, proving the
+PWT4xx analyzer still catches the seeded anti-patterns:
+
+- PWT401: ``score_batch`` dispatches a jitted kernel with a raw
+  ``len(rows)`` leading dim — a fresh XLA compile per batch length.
+- PWT402: ``search`` casts/materializes device values per batch
+  (``float()``, ``.tolist()``) — a device→host stall every query.
+- PWT403: ``drain`` dispatches the kernel per row in a Python loop
+  while a batched kernel exists in this module.
+- PWT404: ``ingest`` feeds a numpy operand to a jitted kernel with no
+  device residency — an implicit host→device transfer every tick.
+- PWT405: ``make_score_table`` lets float64 reach kernel code.
+- PWT406: ``apply_update`` reads a buffer after donating it.
+- PWT407: ``search_jit`` is a jitted serving entry point absent from
+  pw.warmup's bucket registry (checked with an explicit empty registry).
+- PWT408: ``drain_tick`` does blocking host I/O on the device leg.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def fused(buf, upd):
+    return buf + upd
+
+
+def kernel_batch(xs):
+    return kernel(jnp.stack(xs))
+
+
+def search(q):                              # PWT402 (x2)
+    dev = jnp.asarray(q)
+    r = kernel(dev)
+    return float(r.sum()), r.tolist()
+
+
+search_jit = jax.jit(search)                # PWT407
+
+
+def score_batch(rows):                      # PWT401
+    out = np.empty((len(rows), 4), np.float32)
+    return kernel(out)
+
+
+def drain(rows):                            # PWT403
+    out = []
+    for r in rows:
+        out.append(kernel(r))
+    return out
+
+
+def ingest(rows):                           # PWT404
+    padded = np.zeros((32, 4), np.float32)
+    return kernel(padded)
+
+
+def make_score_table(n):                    # PWT405
+    return jnp.zeros((n, 4), dtype=np.float64)
+
+
+def apply_update(buf, upd):                 # PWT406
+    out = fused(buf, upd)
+    return buf.sum()
+
+
+def drain_tick(x):                          # PWT408
+    print("tick", x)
+    return kernel(x)

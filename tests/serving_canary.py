@@ -1,5 +1,5 @@
 """Serving-latency canary: the request-scoped SLO path, proven end to end
-(same pattern as pipelining_canary.py / trace_canary.py). Two gates:
+(same pattern as pipelining_canary.py / trace_canary.py). Three gates:
 
 1. **streaming_etl + rest_connector** — mount a scoring route (the
    example's own ``demand_score`` device UDF) next to
@@ -9,7 +9,12 @@
    total, with the new metric families live on ``/metrics`` and the
    serving snapshot on ``/status``.
 
-2. **bench serving leg** — run ``bench.py`` with only the ``serving``
+2. **sanitized serving** — warm a paged text index under
+   ``PATHWAY_DEVICE_SANITIZER=1`` (engine/device_sanitizer.py), then
+   serve queries in steady state and gate ZERO post-warmup compiles and
+   zero implicit host→device transfers (any violation raises).
+
+3. **bench serving leg** — run ``bench.py`` with only the ``serving``
    leg enabled (CPU-sized slab) and assert ``knn_p50_e2e_ms`` and every
    ``serving_stage_*_p50_ms`` field is present and positive in the bench
    JSON, and that ``BENCH_LASTGOOD.json`` captured the same numbers
@@ -162,6 +167,63 @@ def gate_streaming_etl() -> str | None:
             G.clear()
 
 
+def gate_sanitized_serving() -> str | None:
+    """Device-discipline gate (PWT4xx's runtime twin): the warmed text
+    serving path — packed encode + paged multi-extent search — completes
+    under ``PATHWAY_DEVICE_SANITIZER=1`` with ZERO post-warmup compiles
+    and zero implicit host→device transfers. Any violation raises, so
+    this gate fails loudly the day a dispatch shape drifts off the
+    warmed ladder."""
+    os.environ["PATHWAY_DEVICE_SANITIZER"] = "1"
+    try:
+        import jax
+
+        import pathway_tpu as pw
+        from pathway_tpu.engine import device_sanitizer as ds
+        from pathway_tpu.internals.keys import Pointer
+        from pathway_tpu.models.encoder import EncoderConfig, init_params
+        from pathway_tpu.ops.knn import (BruteForceKnnIndex,
+                                         DeviceEmbeddingKnnIndex,
+                                         KnnMetric)
+        from pathway_tpu.xpacks.llm.embedders import JaxEncoderEmbedder
+
+        cfg = EncoderConfig.tiny(max_len=64)
+        emb = JaxEncoderEmbedder(
+            config=cfg, params=init_params(jax.random.PRNGKey(0), cfg),
+            max_len=64, max_batch_size=1)
+        idx = DeviceEmbeddingKnnIndex(
+            emb, BruteForceKnnIndex(cfg.hidden, metric=KnnMetric.COS,
+                                    paged=True, page_rows=128))
+        # population is pre-steady-state work: compiles here are warmup
+        texts = [f"document number {i} with content {i * 7}"
+                 for i in range(300)]  # 3 extents at page_rows=128
+        idx.add_batch([Pointer(i) for i in range(300)], texts)
+        idx.drain()
+        pw.warmup(emb, index=idx, ks=(3,), cache=False)
+        if not ds.in_steady_state():
+            return "pw.warmup did not declare steady state"
+        if ds.warmup_compiles() == 0:
+            return "no compiles landed in the warmup window"
+        # steady-state serving: every query must reuse warmed executables
+        # (a violation raises DeviceDisciplineViolation out of this loop)
+        for i in range(8):
+            res = idx.search(
+                [(Pointer(10 ** 6 + i), texts[17 + i], 3, None)])
+            if Pointer(17 + i) not in [k for k, _ in res[0]]:
+                return f"query {i} returned {res[0]}"
+        if ds.post_warmup_compiles() != 0:
+            return (f"{ds.post_warmup_compiles()} post-warmup compile(s): "
+                    f"{ds.violations()}")
+        if ds.violations():
+            return f"violations recorded: {ds.violations()}"
+        print(f"sanitized serving gate OK: {ds.warmup_compiles()} warmup "
+              "compiles, 0 post-warmup, 8 queries served under the "
+              "transfer guard")
+        return None
+    finally:
+        os.environ.pop("PATHWAY_DEVICE_SANITIZER", None)
+
+
 def gate_bench_serving() -> str | None:
     repo = pathlib.Path(__file__).resolve().parent.parent
     with tempfile.TemporaryDirectory() as td:
@@ -172,6 +234,11 @@ def gate_bench_serving() -> str | None:
             BENCH_SERVING_N="2000", BENCH_SERVING_QUERIES="12",
             BENCH_SERVING_WARMUP="4", BENCH_PROBE_TRIES="1",
             BENCH_LASTGOOD_PATH=str(lastgood))
+        # the bench child re-warms mid-run with engine-driven (unpinned)
+        # batch shapes — its compile/transfer-count COLUMNS watch that
+        # leg; the sanitizer's raise-on-compile contract is gated by
+        # gate_sanitized_serving above, on the pinned-shape path
+        env.pop("PATHWAY_DEVICE_SANITIZER", None)
         proc = subprocess.run(
             [sys.executable, str(repo / "bench.py")], env=env, cwd=repo,
             capture_output=True, text=True, timeout=540)
@@ -215,6 +282,7 @@ def gate_bench_serving() -> str | None:
 
 def main() -> int:
     for name, gate in (("streaming-etl", gate_streaming_etl),
+                       ("sanitized-serving", gate_sanitized_serving),
                        ("bench-serving", gate_bench_serving)):
         err = gate()
         if err:

@@ -567,9 +567,9 @@ def test_cli_all_clean_tree_and_schema(tmp_path):
     proc = _run_cli("--all", "--json", "pathway_tpu/engine")
     assert proc.returncode == 0, proc.stderr
     payload = json.loads(proc.stdout)
-    assert payload["schema_version"] == 1
+    assert payload["schema_version"] == 2
     assert set(payload["families"]) == \
-        {"expression", "shard", "concurrency", "durability"}
+        {"expression", "shard", "concurrency", "durability", "perf"}
     assert payload["exit_code"] == 0
 
 

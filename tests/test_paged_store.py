@@ -490,7 +490,10 @@ def test_ragged_warmup_compile_count_under_six():
         emb, BruteForceKnnIndex(cfg.hidden, metric=KnnMetric.COS,
                                 paged=True))
     out = pw.warmup(emb, index=idx, cache=False)
-    assert 0 < len(out["compiled"]) <= 6, out["compiled"]
+    # leaked gc-pending fused programs from other tests may add autojit
+    # entries — the ragged ladder is what this pin counts
+    ladder = [e for e in out["compiled"] if e[0] != "autojit"]
+    assert 0 < len(ladder) <= 6, out["compiled"]
     assert len(idx) == 0  # warmup scratch rows retracted
     # the width-bucket zoo this replaces is ~18 compiles
     assert len(emb.bucket_widths()) >= 15

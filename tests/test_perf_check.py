@@ -1,0 +1,645 @@
+"""Device-discipline analyzer (static_check/perf_check.py): one
+true-positive and one true-negative per PWT401–PWT408 code, the waiver
+mechanism, the warmup-registry parser, the jit/hot-unit inventory, the
+four-directory dogfood gate, the PWT105→PWT402 deference, and the CLI
+front doors (``--perf``, ``--all`` bit 16) — mirrors
+tests/test_durability_check.py for the PWT3xx family."""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+import textwrap
+
+from pathway_tpu.internals.static_check import (check_perf, perf_inventory,
+                                                scan_waivers)
+from pathway_tpu.internals.static_check.perf_check import \
+    load_warmup_registry
+from pathway_tpu.internals.trace import Trace
+
+
+def run_check(tmp_path, source: str, registry=None):
+    f = tmp_path / "mod_under_test.py"
+    f.write_text(textwrap.dedent(source))
+    return check_perf([str(f)], warmup_registry=registry)
+
+
+def codes(diags):
+    return sorted(d.code for d in diags)
+
+
+def only(diags, code):
+    return [d for d in diags if d.code == code]
+
+
+# ---------------------------------------------------------------------------
+# PWT401 — unbucketed data-dependent jit dispatch
+# ---------------------------------------------------------------------------
+
+def test_pwt401_data_dependent_dispatch_is_error(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def score_batch(rows):
+            out = np.empty((len(rows), 4), np.float32)
+            return kernel(out)
+    """), "PWT401")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "data-dependent shape" in diags[0].message
+    assert "bucket" in diags[0].message
+
+
+def test_pwt401_negative_bucketing_evidence(tmp_path):
+    diags = run_check(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def _round_up_pow2(n):
+            return 1 << (n - 1).bit_length()
+
+        def score_batch(rows):
+            n = _round_up_pow2(len(rows))
+            out = np.empty((n, 4), np.float32)
+            return kernel(out)
+    """)
+    assert only(diags, "PWT401") == []
+
+
+def test_pwt401_negative_cold_function(tmp_path):
+    # shape zoo during construction is warmup's problem, not a tick's
+    diags = run_check(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def rebuild_index(rows):
+            out = np.empty((len(rows), 4), np.float32)
+            return kernel(out)
+    """)
+    assert only(diags, "PWT401") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT402 — host-device sync point on a per-batch path
+# ---------------------------------------------------------------------------
+
+def test_pwt402_tolist_and_cast_on_device_value(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def search(q):
+            dev = jnp.asarray(q)
+            r = kernel(dev)
+            top = float(r.sum())
+            return top, r.tolist()
+    """), "PWT402")
+    assert len(diags) == 2
+    assert all(d.is_error for d in diags)
+    msgs = " ".join(d.message for d in diags)
+    assert ".tolist()" in msgs
+    assert "Python float" in msgs  # the cast form PWT105's old list missed
+
+
+def test_pwt402_block_until_ready(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import jax.numpy as jnp
+
+        def drain_queue(pending):
+            out = jnp.stack(pending)
+            out.block_until_ready()
+            return out
+    """), "PWT402")
+    assert len(diags) == 1
+    assert "host idles" in diags[0].message
+
+
+def test_pwt402_negative_host_only_value(tmp_path):
+    # .tolist() on plain numpy bookkeeping is free — no device round-trip
+    diags = run_check(tmp_path, """
+        import numpy as np
+
+        def search(q):
+            slots = np.nonzero(q)[0]
+            return slots.tolist(), float(slots.sum())
+    """)
+    assert only(diags, "PWT402") == []
+
+
+def test_pwt402_negative_instrumentation_function(tmp_path):
+    diags = run_check(tmp_path, """
+        import jax.numpy as jnp
+
+        def dump_metrics_batch(vals):
+            dev = jnp.asarray(vals)
+            return dev.tolist()
+    """)
+    assert only(diags, "PWT402") == []
+
+
+def test_pwt402_waived_with_justification(tmp_path):
+    diags = run_check(tmp_path, """
+        import jax.numpy as jnp
+
+        def drain_queue(pending):
+            out = jnp.stack(pending)
+            # pwt-ok: PWT402 — deliberate materialization barrier
+            out.block_until_ready()
+            return out
+    """)
+    assert only(diags, "PWT402") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT403 — per-row device dispatch in a loop with a batched kernel around
+# ---------------------------------------------------------------------------
+
+_LOOP_DISPATCH = """
+    import jax
+
+    @jax.jit
+    def kernel(x):
+        return x * 2
+
+    def kernel_batch(xs):
+        return [kernel(x) for x in xs]
+
+    def drain(rows):
+        out = []
+        for r in rows:
+            out.append(kernel(r))
+        return out
+"""
+
+
+def test_pwt403_loop_dispatch_is_warning(tmp_path):
+    diags = only(run_check(tmp_path, _LOOP_DISPATCH), "PWT403")
+    # fires in drain's loop (kernel_batch itself is the batched kernel,
+    # but its comprehension also dispatches per row — both are findings)
+    assert diags
+    assert not diags[0].is_error
+    assert "per row inside a Python loop" in diags[0].message
+
+
+def test_pwt403_negative_no_batched_kernel_in_module(tmp_path):
+    # nothing batched exists yet: flagging the loop would just be noise
+    diags = run_check(tmp_path, """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def drain(rows):
+            return [kernel(r) for r in rows]
+    """)
+    assert only(diags, "PWT403") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT404 — numpy operand fed to jit with no device residency
+# ---------------------------------------------------------------------------
+
+def test_pwt404_host_operand_every_tick(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def ingest(rows):
+            padded = np.zeros((32, 4), np.float32)
+            return kernel(padded)
+    """), "PWT404")
+    assert len(diags) == 1
+    assert not diags[0].is_error
+    assert "implicit host" in diags[0].message
+    assert "device_put" in diags[0].message
+
+
+def test_pwt404_negative_device_put_in_unit(tmp_path):
+    diags = run_check(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def ingest(rows):
+            padded = np.zeros((32, 4), np.float32)
+            dev = jax.device_put(padded)
+            return kernel(dev)
+    """)
+    assert only(diags, "PWT404") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT405 — float64 reaching kernel code
+# ---------------------------------------------------------------------------
+
+def test_pwt405_float64_near_device_code(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def make_table(n):
+            return jnp.zeros((n, 4), dtype=np.float64)
+    """), "PWT405")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "float32" in diags[0].message
+
+
+def test_pwt405_negative_float32(tmp_path):
+    diags = run_check(tmp_path, """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def make_table(n):
+            return jnp.zeros((n, 4), dtype=np.float32)
+    """)
+    assert only(diags, "PWT405") == []
+
+
+def test_pwt405_negative_no_device_code(tmp_path):
+    # the string alone, far from any array constructor, is not a finding
+    diags = run_check(tmp_path, """
+        def describe_dtype():
+            return "float64"
+    """)
+    assert only(diags, "PWT405") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT406 — donated buffer read after donation
+# ---------------------------------------------------------------------------
+
+def test_pwt406_read_after_donation(tmp_path):
+    diags = only(run_check(tmp_path, """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def fused(buf, upd):
+            return buf + upd
+
+        def apply_update(buf, upd):
+            out = fused(buf, upd)
+            return buf.sum()
+    """), "PWT406")
+    assert len(diags) == 1
+    assert diags[0].is_error
+    assert "after donating" in diags[0].message
+
+
+def test_pwt406_negative_result_rebound_over_donated_name(tmp_path):
+    diags = run_check(tmp_path, """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def fused(buf, upd):
+            return buf + upd
+
+        def apply_update(buf, upd):
+            buf = fused(buf, upd)
+            return buf.sum()
+    """)
+    assert only(diags, "PWT406") == []
+
+
+def test_pwt406_negative_no_read_after(tmp_path):
+    diags = run_check(tmp_path, """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def fused(buf, upd):
+            return buf + upd
+
+        def apply_update(buf, upd):
+            return fused(buf, upd)
+    """)
+    assert only(diags, "PWT406") == []
+
+
+# ---------------------------------------------------------------------------
+# PWT407 — jitted serving entry point absent from the warmup registry
+# ---------------------------------------------------------------------------
+
+_JIT_ENTRY = """
+    import jax
+
+    def search(q):
+        return q * 2
+
+    search_jit = jax.jit(search)
+"""
+
+
+def test_pwt407_unregistered_entry_point(tmp_path):
+    diags = only(run_check(tmp_path, _JIT_ENTRY, registry=set()),
+                 "PWT407")
+    assert len(diags) == 1
+    assert not diags[0].is_error
+    assert "search_jit" in diags[0].message
+    assert "WARMED_ENTRY_POINTS" in diags[0].message
+
+
+def test_pwt407_negative_registered_under_either_name(tmp_path):
+    # registering the wrapper or the wrapped fn both count
+    assert only(run_check(tmp_path, _JIT_ENTRY,
+                          registry={"search_jit"}), "PWT407") == []
+    assert only(run_check(tmp_path, _JIT_ENTRY,
+                          registry={"search"}), "PWT407") == []
+
+
+def test_pwt407_negative_non_serving_name(tmp_path):
+    diags = run_check(tmp_path, """
+        import jax
+
+        def helper(q):
+            return q * 2
+
+        helper_jit = jax.jit(helper)
+    """, registry=set())
+    assert only(diags, "PWT407") == []
+
+
+def test_pwt407_silent_without_a_registry(tmp_path):
+    # no warmup.py reachable from tmp_path → autodiscovery returns None
+    # and the check stays silent rather than flagging every jit
+    diags = run_check(tmp_path, _JIT_ENTRY)
+    assert only(diags, "PWT407") == []
+
+
+def test_warmup_registry_autodiscovered_next_to_sources(tmp_path):
+    (tmp_path / "warmup.py").write_text(textwrap.dedent("""
+        WARMED_ENTRY_POINTS = frozenset({"search_jit", "encode_jit"})
+    """))
+    assert load_warmup_registry([str(tmp_path)]) == \
+        {"search_jit", "encode_jit"}
+    # the checker picks it up: the registered entry point passes clean
+    diags = run_check(tmp_path, _JIT_ENTRY)
+    assert only(diags, "PWT407") == []
+
+
+def test_warmup_registry_of_real_package_lists_encoder():
+    assert "encode_jit" in load_warmup_registry(["pathway_tpu/models"])
+
+
+# ---------------------------------------------------------------------------
+# PWT408 — blocking host I/O inside a device-leg function
+# ---------------------------------------------------------------------------
+
+def test_pwt408_print_in_dispatching_function(tmp_path):
+    diags = only(run_check(tmp_path, """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def drain_tick(x):
+            print("tick", x.shape)
+            return kernel(x)
+    """), "PWT408")
+    assert len(diags) == 1
+    assert not diags[0].is_error
+    assert "blocking host I/O" in diags[0].message
+
+
+def test_pwt408_negative_no_device_dispatch(tmp_path):
+    # printing in a host-only function is nobody's business
+    diags = run_check(tmp_path, """
+        def drain_tick(x):
+            print("tick", x)
+            return x
+    """)
+    assert only(diags, "PWT408") == []
+
+
+def test_pwt408_negative_instrumentation_function(tmp_path):
+    diags = run_check(tmp_path, """
+        import jax.numpy as jnp
+
+        def trace_dispatch(x):
+            print("probe", x)
+            return jnp.asarray(x)
+    """)
+    assert only(diags, "PWT408") == []
+
+
+# ---------------------------------------------------------------------------
+# waivers integrate with the shared audit
+# ---------------------------------------------------------------------------
+
+def test_perf_waivers_show_up_in_scan(tmp_path):
+    f = tmp_path / "mod_under_test.py"
+    f.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def drain_queue(pending):
+            out = jnp.stack(pending)
+            # pwt-ok: PWT402 — deliberate barrier, bench stamps after it
+            out.block_until_ready()
+            return out
+    """))
+    waivers = scan_waivers([str(f)])
+    assert [w["codes"] for w in waivers] == [["PWT402"]]
+    assert "deliberate barrier" in waivers[0]["comment"]
+
+
+# ---------------------------------------------------------------------------
+# inventory
+# ---------------------------------------------------------------------------
+
+def test_inventory_jits_hot_units_and_registry(tmp_path):
+    f = tmp_path / "mod_under_test.py"
+    f.write_text(textwrap.dedent("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def fused(buf, upd):
+            return buf + upd
+
+        def ingest(self, rows):
+            return fused(rows, rows)
+
+        def _describe():
+            return "cold"
+    """))
+    (tmp_path / "warmup.py").write_text(
+        "WARMED_ENTRY_POINTS = frozenset({'fused'})\n")
+    inv = perf_inventory([str(f)])
+    by_name = {j["name"]: j for j in inv["jit_entry_points"]}
+    assert by_name["fused"]["donate_argnums"] == [0]
+    assert "mod_under_test:ingest" in inv["hot_units"]
+    assert "mod_under_test:_describe" not in inv["hot_units"]
+    assert inv["warmup_registry"] == ["fused"]
+
+
+def test_inventory_of_real_corpus_sees_encoder_jit():
+    inv = perf_inventory(["pathway_tpu/models"])
+    names = {j["name"] for j in inv["jit_entry_points"]}
+    assert "encode_jit" in names
+    assert "encode_jit" in inv["warmup_registry"]
+
+
+# ---------------------------------------------------------------------------
+# dogfood gates — the four device-leg directories must pass their own lint
+# ---------------------------------------------------------------------------
+
+def test_engine_source_is_perf_clean():
+    assert check_perf(["pathway_tpu/engine"]) == []
+
+
+def test_ops_source_is_perf_clean():
+    assert check_perf(["pathway_tpu/ops"]) == []
+
+
+def test_models_source_is_perf_clean():
+    assert check_perf(["pathway_tpu/models"]) == []
+
+
+def test_parallel_source_is_perf_clean():
+    assert check_perf(["pathway_tpu/parallel"]) == []
+
+
+def test_seeded_negative_example_trips_the_gate():
+    diags = check_perf(["tests/perf_negative_example.py"],
+                       warmup_registry=set())
+    seen = set(codes(diags))
+    assert {"PWT401", "PWT402", "PWT403", "PWT404", "PWT405", "PWT406",
+            "PWT407", "PWT408"} <= seen
+    assert any(d.code == "PWT402" and d.is_error for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# PWT105 → PWT402 deference (satellite: the old sync list folds in)
+# ---------------------------------------------------------------------------
+
+def test_classify_udf_counts_cast_as_sync_point():
+    # the form PWT105's old list missed: int()/float() on a device value
+    from pathway_tpu.internals.static_check.shard_check import classify_udf
+
+    def _casty(x):
+        return float(x) * 2.0
+
+    cls = classify_udf(_casty)
+    assert any("implicit .item()" in s for s in cls.sync_points)
+
+
+def test_classify_udf_constant_cast_is_not_sync():
+    from pathway_tpu.internals.static_check.shard_check import classify_udf
+
+    def _const(x):
+        return x * float(2)
+
+    assert classify_udf(_const).sync_points == ()
+
+
+def _pwt105(related_file):
+    from pathway_tpu.internals.static_check.diagnostics import Diagnostic
+    related = (Trace(related_file, 3, "_udf", ""),) if related_file else ()
+    return Diagnostic(code="PWT105", message="sync point",
+                      related=related)
+
+
+def test_defer_pwt105_drops_findings_covered_by_perf_trees(tmp_path):
+    from pathway_tpu.cli import _defer_pwt105
+
+    inside = str(tmp_path / "udfs.py")
+    outside = "/somewhere/else/udfs.py"
+    kept = _defer_pwt105(
+        [_pwt105(inside), _pwt105(outside), _pwt105(None)],
+        [str(tmp_path)])
+    # only the UDF defined under the scanned tree defers to PWT402
+    assert [d.related[0].file_name if d.related else None for d in kept] \
+        == [outside, None]
+
+
+def test_defer_pwt105_keeps_everything_without_trees(tmp_path):
+    from pathway_tpu.cli import _defer_pwt105
+
+    diags = [_pwt105(str(tmp_path / "udfs.py"))]
+    assert _defer_pwt105(diags, []) == diags
+
+
+# ---------------------------------------------------------------------------
+# CLI front doors
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", "check", *args],
+        capture_output=True, text=True, env=None)
+
+
+def test_cli_perf_clean_and_json():
+    proc = _run_cli("--perf", "--json", "pathway_tpu/engine",
+                    "pathway_tpu/ops", "pathway_tpu/models",
+                    "pathway_tpu/parallel")
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["diagnostics"] == []
+    names = {j["name"] for j in payload["inventory"]["jit_entry_points"]}
+    assert "encode_jit" in names
+    assert "encode_jit" in payload["inventory"]["warmup_registry"]
+
+
+def test_cli_perf_seeded_negative_fails():
+    proc = _run_cli("--perf", "tests/perf_negative_example.py")
+    assert proc.returncode == 1
+    assert "PWT402" in proc.stdout
+
+
+def test_cli_all_exit_code_carries_perf_bit(tmp_path):
+    tree = tmp_path / "src"
+    tree.mkdir()
+    shutil.copy("tests/perf_negative_example.py", tree / "negative.py")
+    proc = _run_cli("--all", "--json", str(tree))
+    assert proc.returncode == 16, proc.stderr  # perf bit only
+    payload = json.loads(proc.stdout)
+    assert payload["exit_code"] == 16
+    fam_codes = [d["code"] for d in payload["families"]["perf"]]
+    assert "PWT402" in fam_codes
+
+
+def test_cli_perf_is_mutually_exclusive_with_other_modes():
+    proc = _run_cli("--perf", "--durability", "pathway_tpu/engine")
+    assert proc.returncode != 0
+    assert "mutually exclusive" in proc.stderr
+
+
+def test_cli_list_waivers_covers_perf_family():
+    proc = _run_cli("--list-waivers", "--json", "pathway_tpu/ops")
+    assert proc.returncode == 0, proc.stderr
+    waivers = json.loads(proc.stdout)
+    knn = [w for w in waivers if w["file"].endswith("knn.py")
+           and "PWT402" in w["codes"]]
+    assert knn  # the audited consolidation-read waivers
+    assert all(w["comment"] for w in knn)
