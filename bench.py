@@ -251,6 +251,13 @@ _BENCH_DIRECTIONS = {
     "knn_transfer_count": "lower",
     "serving_compile_count": "lower",
     "serving_transfer_count": "lower",
+    # failover leg (bench_replica): promotion wall-clock is the
+    # write-unavailability window (smaller is better), and the fenced
+    # zombie's write count is a bare counter — each one is a split-brain
+    # write REFUSED; more of them means the zombie raced longer before
+    # noticing its demotion
+    "replica_failover_promotion_s": "lower",
+    "replica_fenced_writes": "lower",
 }
 
 
@@ -2500,6 +2507,17 @@ READY = os.environ.get("REPLICA_BENCH_READY_FILE")
 # control-channel heartbeat) so the router can scrape /metrics and
 # /trace?format=chrome for the /fleet/* surfaces
 HTTP = os.environ.get("REPLICA_BENCH_HTTP") == "1"
+# write-path mode (tests/failover_canary.py): a durable-ack /w route on
+# every member — the primary serves it, replicas tail its WAL so a
+# promoted replica owns the full write history
+WRITES = os.environ.get("REPLICA_BENCH_WRITES") == "1"
+# crash-mid-promotion mode: die (rc 3) INSIDE the promotion, after the
+# epoch bump but before connector readers start — the router must
+# re-elect a survivor
+if os.environ.get("REPLICA_BENCH_PROMOTE_CRASH") == "1":
+    from pathway_tpu.testing import faults as _faults
+    _faults.arm_point("replica.promote.crash",
+                      lambda _p, _c: os._exit(3))
 
 
 class Subject(pw.io.python.ConnectorSubject):
@@ -2540,6 +2558,21 @@ writer(res.select(
     ids=pw.apply(_ids, res._pw_index_reply_id),
     scores=pw.apply(lambda ds: [float(d) for d in ds],
                     res._pw_index_reply_score)))
+
+if WRITES:
+    # the write path: durable-ack ingestion with an IDEMPOTENT aggregate
+    # (key -> max value), so a client retrying an un-acked POST after
+    # failover cannot corrupt state — the 200 means the row is fsynced
+    # in the primary root's WAL
+    wrows, wack = rest_connector(
+        webserver=ws, route="/w",
+        schema=sch.schema_from_types(wkey=str, wval=int),
+        methods=("POST",), persistent_id="writes",
+        autocommit_duration_ms=10, durable_ack=True)
+    agg = wrows.groupby(wrows.wkey).reduce(
+        wkey=wrows.wkey, wval=pw.reducers.max(wrows.wval))
+    pw.io.subscribe(agg, lambda *a, **k: None)
+    wack(wrows.select(ok=wrows.wval))
 
 
 def _announce():
@@ -2584,7 +2617,7 @@ class _ReplicaFleet:
 
     def __init__(self, tmp: str, *, vecs: int = 256,
                  query_cost_ms: float = 25.0,
-                 observability: bool = False):
+                 observability: bool = False, writes: bool = False):
         import sys as _sys
 
         self.tmp = tmp
@@ -2618,20 +2651,35 @@ class _ReplicaFleet:
                 REPLICA_BENCH_HTTP="1",
                 PATHWAY_MONITORING_HTTP_PORT="0",  # ephemeral, in the hb
                 PATHWAY_FLIGHT_RECORDER="1")
+        if writes:
+            self.base_env["REPLICA_BENCH_WRITES"] = "1"
         self.vecs = vecs
         self.router = None
         self.procs: dict[str, object] = {}  # name -> Popen
 
     # -- lifecycle ---------------------------------------------------------
-    def start_router(self):
+    def start_router(self, *, write_paths=None,
+                     election_timeout_ms: int | None = None):
         from pathway_tpu.engine.router import QueryRouter
 
         prior = os.environ.get("PATHWAY_RUN_ID")
         os.environ["PATHWAY_RUN_ID"] = "replica-bench"  # shared authkey
+        prior_et = os.environ.get("PATHWAY_ROUTER_ELECTION_TIMEOUT_MS")
+        if election_timeout_ms is not None:
+            os.environ["PATHWAY_ROUTER_ELECTION_TIMEOUT_MS"] = str(
+                election_timeout_ms)
         try:
-            self.router = QueryRouter(port=0, control_port=0)
+            self.router = QueryRouter(port=0, control_port=0,
+                                      write_paths=write_paths)
             self.router.start()
         finally:
+            if election_timeout_ms is not None:
+                if prior_et is None:
+                    os.environ.pop("PATHWAY_ROUTER_ELECTION_TIMEOUT_MS",
+                                   None)
+                else:
+                    os.environ["PATHWAY_ROUTER_ELECTION_TIMEOUT_MS"] = \
+                        prior_et
             if prior is None:
                 os.environ.pop("PATHWAY_RUN_ID", None)
             else:
@@ -2657,11 +2705,17 @@ class _ReplicaFleet:
                 f"fleet member {name} died (rc={h.returncode}): {tail}")
 
     def start_primary(self, *, snapshot_ticks: int = 4,
-                      timeout_s: float = 120.0):
+                      timeout_s: float = 120.0, register: bool = False):
         ready = os.path.join(self.tmp, "primary.ready")
         env = dict(self.base_env, REPLICA_BENCH_ROLE="primary",
                    REPLICA_BENCH_READY_FILE=ready,
                    PATHWAY_SNAPSHOT_EVERY_TICKS=str(snapshot_ticks))
+        if register and self.router is not None:
+            # failover mode: the primary joins the control plane so the
+            # router can detect its death and run an election
+            env.update(PATHWAY_REPLICA_ID="primary",
+                       PATHWAY_ROUTER_CONTROL=(
+                           f"127.0.0.1:{self.router.control_port}"))
         if self.observability and self.router is not None:
             # the primary registers too (role "primary", routed only as
             # a last resort) so /fleet/metrics//fleet/trace cover it
@@ -2681,11 +2735,14 @@ class _ReplicaFleet:
         raise TimeoutError("primary never finished seeding its WAL")
 
     def start_replica(self, rid: str, *, max_staleness: int = 4,
-                      timeout_s: float = 120.0):
+                      timeout_s: float = 120.0,
+                      promote_crash: bool = False):
         env = dict(self.base_env, REPLICA_BENCH_ROLE="replica",
                    PATHWAY_REPLICA_OF=self.root, PATHWAY_REPLICA_ID=rid,
                    PATHWAY_ROUTER_CONTROL=(
                        f"127.0.0.1:{self.router.control_port}"))
+        if promote_crash:
+            env["REPLICA_BENCH_PROMOTE_CRASH"] = "1"
         self._spawn(rid, env)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
@@ -2719,6 +2776,36 @@ class _ReplicaFleet:
 
     def kill_replica(self, rid: str) -> None:
         self.procs[rid].kill()  # SIGKILL: death, not a graceful drain
+
+    def sigstop(self, name: str) -> None:
+        """Freeze a member: its sockets stay open but it goes silent —
+        the router's staleness detector (not EOF) must declare it."""
+        import signal
+
+        os.kill(self.procs[name].pid, signal.SIGSTOP)
+
+    def sigcont(self, name: str) -> None:
+        import signal
+
+        os.kill(self.procs[name].pid, signal.SIGCONT)
+
+    def wait_promoted(self, n: int = 1, timeout_s: float = 120.0) -> str:
+        """Wait until the router has completed ``n`` promotions; returns
+        the promoted member's id."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.router.promotions_total >= n \
+                    and self.router._write_primary_id is not None:
+                return self.router._write_primary_id
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"router never completed promotion #{n} "
+            f"(promotions={self.router.promotions_total}, "
+            f"election={self.router._election})")
+
+    def stderr_text(self, name: str) -> str:
+        with open(os.path.join(self.tmp, f"{name}.stderr")) as f:
+            return f.read()
 
     def wait_deregistered(self, rid: str, timeout_s: float = 30.0):
         deadline = time.monotonic() + timeout_s
@@ -3000,6 +3087,49 @@ def bench_replica() -> dict:
             "replica_fleet_after_kill": sorted(
                 e.replica_id for e in fleet.router.endpoints()),
         })
+    finally:
+        fleet.stop()
+    out.update(_bench_replica_failover())
+    return out
+
+
+def _bench_replica_failover() -> dict:
+    """Write-path failover wall-clock (PR 18): a registered primary +
+    one caught-up replica; SIGSTOP the primary (a zombie, not a corpse:
+    its sockets stay open, so only the heartbeat-staleness detector can
+    declare it) and measure death-declaration -> promoted-primary
+    heartbeat on the router's clock. Then SIGCONT the zombie: its next
+    commit must refuse with FencedPrimaryError (counted from its
+    stderr — each one is a split-brain write that did NOT land)."""
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="bench_failover_")
+    fleet = _ReplicaFleet(tmp)
+    out: dict = {}
+    try:
+        fleet.start_router(write_paths=("/w",),
+                           election_timeout_ms=1500)
+        fleet.start_primary(register=True)
+        fleet.start_replica("r1")
+        fleet.sigstop("primary")
+        promoted = fleet.wait_promoted(1)
+        out["replica_failover_promotion_s"] = (
+            None if fleet.router.failover_seconds is None
+            else round(fleet.router.failover_seconds, 3))
+        out["replica_promoted_member"] = promoted
+        # wake the zombie: fencing, not luck, keeps the timeline single
+        fleet.sigcont("primary")
+        deadline = time.monotonic() + 60
+        fenced = 0
+        while time.monotonic() < deadline:
+            # the error MESSAGE appears once per refused write; the bare
+            # class name also shows up in traceback frames (over-counts)
+            fenced = fleet.stderr_text("primary").count(
+                "fenced primary: this writer holds fencing epoch")
+            if fenced and fleet.procs["primary"].poll() is not None:
+                break
+            time.sleep(0.25)
+        out["replica_fenced_writes"] = fenced
     finally:
         fleet.stop()
     return out

@@ -261,6 +261,11 @@ def merge_traces(payloads) -> dict:
     # request spans per merged pid: rid -> (begin ts, tid)
     serving_spans: dict[int, dict[str, tuple[float, int]]] = {}
     router_spans: dict[int, dict[str, dict]] = {}
+    # promotion instants (mpid, ts, epoch) + each process's last event
+    # ts — a failover is drawn as a flow arrow from the dead primary's
+    # last recorded moment to the rescuer's promotion instant
+    promotions: list[tuple[int, float, int]] = []
+    last_ts: dict[int, float] = {}
     for mpid, (payload, meta) in enumerate(zip(payloads, metas)):
         shift_us = (meta["epoch_wall_us"] - origin_us) \
             if meta["epoch_wall_us"] else 0.0
@@ -278,7 +283,13 @@ def merge_traces(payloads) -> dict:
             ev["pid"] = mpid
             if "ts" in ev:
                 ev["ts"] = ev["ts"] + shift_us
+                if ev.get("ph") != "M":
+                    last_ts[mpid] = max(last_ts.get(mpid, 0.0), ev["ts"])
             events.append(ev)
+            if ev.get("cat") == "promotion" and ev.get("ph") == "i":
+                promotions.append(
+                    (mpid, ev["ts"],
+                     int((ev.get("args") or {}).get("epoch", 0))))
             rid = (ev.get("args") or {}).get("request_id")
             if rid and ev.get("ph") == "b":
                 if ev.get("cat") == "router_request":
@@ -311,6 +322,27 @@ def merge_traces(payloads) -> dict:
                 if ph == "f":
                     ev["bp"] = "e"
                 events.append(ev)
+    # promotion handoff arrows: dead primary's last recorded moment ->
+    # the rescuer's "promoted to primary" instant. The rescuer's meta
+    # role already reads "primary" (it was promoted before the trace was
+    # written), so the source is any OTHER primary-role process; with
+    # none in the capture set (SIGKILL skips the trace-writing finally,
+    # so the victim's trace exists only if it was scraped live), the
+    # instant stands alone — still visible, just not bound.
+    for ppid, p_ts, epoch in promotions:
+        candidates = [i for i, m in enumerate(metas)
+                      if i != ppid and m["role"] == "primary"
+                      and i in last_ts]
+        if not candidates:
+            continue
+        src = max(candidates, key=lambda i: last_ts[i])
+        fid = f"promo-{epoch}-{ppid}"
+        events.append({"ph": "s", "cat": "fleet", "id": fid, "pid": src,
+                       "tid": 0, "ts": min(last_ts[src], p_ts),
+                       "name": "promotion"})
+        events.append({"ph": "f", "bp": "e", "cat": "fleet", "id": fid,
+                       "pid": ppid, "tid": 0, "ts": p_ts + 0.01,
+                       "name": "promotion"})
     # serving-only cross-process ids (e.g. primary handed off to a
     # replica without the router in the capture set) still count as
     # spanning processes

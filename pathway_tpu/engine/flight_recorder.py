@@ -216,6 +216,11 @@ class FlightRecorder:
         self.role = "primary"
         self.process = (os.environ.get("PATHWAY_REPLICA_ID")
                         or f"pid{os.getpid()}")
+        # (perf_counter, epoch, complete_tick) of a replica→primary
+        # promotion; drawn as an instant on this track and, in the
+        # merged fleet trace, as the timeline-handoff flow arrow from
+        # the dead primary (engine/fleet_observability.merge_traces)
+        self._promotion: tuple[float, int, int] | None = None
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -286,6 +291,14 @@ class FlightRecorder:
                    exec_ms: float) -> None:
         with self._lock:
             self._legs.append((tick, queue_wait_ms, exec_ms))
+
+    def note_promotion(self, epoch: int, complete_tick: int) -> None:
+        """Stamp the moment this process was promoted to primary
+        (engine/streaming.py failover): the written trace carries it as
+        a process-scoped instant, and the fleet merger draws the
+        timeline handoff from the dead primary's track to it."""
+        self._promotion = (time.perf_counter(), int(epoch),
+                           int(complete_tick))
 
     def device_annotation(self, tick: int):
         """``jax.profiler.TraceAnnotation`` for one device leg, so XLA
@@ -479,6 +492,13 @@ class FlightRecorder:
              "args": {"name": f"{leg} leg"}}
             for leg, tid in tids.items()
         )
+        if self._promotion is not None:
+            t_p, epoch, complete_tick = self._promotion
+            out.append({
+                "ph": "i", "s": "p", "pid": pid, "tid": 0,
+                "ts": (t_p - self._epoch) * 1e6, "cat": "promotion",
+                "name": f"promoted to primary (epoch {epoch})",
+                "args": {"epoch": epoch, "complete_tick": complete_tick}})
         evs = self.tail_events(None)
         # group by (tick, leg) preserving order; events within a leg are
         # sequential (one thread per leg), so wrapper = [min start, max end]

@@ -71,6 +71,14 @@ class MonitoringHttpServer:
             payload["replica"] = replica.stats()
             payload["applied_tick"] = replica.applied_tick
             payload["staleness_ticks"] = replica.staleness_ticks()
+        if getattr(self.runtime, "promotions", 0):
+            # write-path failover: this process started as a replica and
+            # was promoted to primary (role above already says "primary")
+            payload["promotions"] = self.runtime.promotions
+            payload["promotion_tick"] = self.runtime.promotion_tick
+            fp = getattr(self.runtime, "failover_promotion_s", None)
+            if fp is not None:
+                payload["failover_promotion_s"] = round(fp, 6)
         # critical-path attribution: which operator dominated the last
         # tick. latency_ms is each operator's LAST step latency, so the
         # max over operators is exactly the last tick's dominator; the
@@ -508,6 +516,16 @@ class MonitoringHttpServer:
                 "# TYPE pathway_tpu_wal_replayable_entries gauge")
             lines.append(f"pathway_tpu_wal_replayable_entries "
                          f"{pst['wal_replayable_entries']}")
+            # write-path failover (PR 18): the fencing epoch this driver
+            # holds and the writes it REFUSED as a fenced stale primary
+            # — a resumed zombie shows as fenced_writes climbing while
+            # its epoch gauge stays below the fleet's
+            lines.append("# TYPE pathway_tpu_fleet_epoch gauge")
+            lines.append(
+                f"pathway_tpu_fleet_epoch {pst.get('fencing_epoch', 0)}")
+            lines.append("# TYPE pathway_tpu_fenced_writes_total counter")
+            lines.append(f"pathway_tpu_fenced_writes_total "
+                         f"{pst.get('fenced_writes', 0)}")
             lines.append("# TYPE pathway_tpu_commit_wait_ms histogram")
             for le, c in persistence.commit_wait.cumulative():
                 le_s = "+Inf" if le == float("inf") else format(le, "g")
@@ -546,6 +564,17 @@ class MonitoringHttpServer:
                     lines.append(
                         f'pathway_tpu_paged_tenant_pages'
                         f'{{tenant="{esc(tenant)}"}} {n}')
+        promotions = getattr(self.runtime, "promotions", 0)
+        if promotions:
+            # this process was PROMOTED replica→primary (write-path
+            # failover); the wall clock is promote-command → serving
+            lines.append("# TYPE pathway_tpu_promotions_total counter")
+            lines.append(f"pathway_tpu_promotions_total {promotions}")
+            fp = getattr(self.runtime, "failover_promotion_s", None)
+            if fp is not None:
+                lines.append("# TYPE pathway_tpu_failover_seconds gauge")
+                lines.append(
+                    f"pathway_tpu_failover_seconds {round(fp, 6)}")
         replica = getattr(self.runtime, "replica", None)
         if replica is not None:
             # replica-fleet freshness (engine/replica.py): watermark lag

@@ -147,7 +147,18 @@ _CTRL_MAX_FRAME = 16 << 20  # a control message has no business being bigger
 
 def send_control_frame(sock: socket.socket, tag: Any, payload: Any) -> int:
     """One framed control message: u32 total | wire frame. Returns bytes
-    put on the wire."""
+    put on the wire.
+
+    Fault point ``router.control.partition`` (testing/faults.py): while
+    armed, frames silently vanish instead of going on the wire — a
+    network partition of the control plane, not a connection death (the
+    socket stays up; heartbeats stop arriving, promote commands are
+    lost, and the router's staleness detector — not EOF — must notice)."""
+    if faults.armed("router.control.partition"):
+        try:
+            faults.hit("router.control.partition", dir="send", tag=tag)
+        except faults.InjectedFault:
+            return 0  # partitioned: the frame is dropped on the floor
     chunks, total, _rows = wire.encode_frame(tag, payload)
     _send_exact(sock, b"".join([_u32.pack(total), *chunks]))
     return _u32.size + total
@@ -155,15 +166,26 @@ def send_control_frame(sock: socket.socket, tag: Any, payload: Any) -> int:
 
 def recv_control_frame(sock: socket.socket) -> tuple[Any, Any]:
     """Read one framed control message; (tag, payload). Raises EOFError
-    on clean peer close — the replica-death signal the router keys on."""
-    (total,) = _u32.unpack(bytes(_recv_exact(sock, 4)))
-    if total > _CTRL_MAX_FRAME:
-        raise ClusterConnectError(
-            f"absurd control frame length {total} — not a pathway-tpu "
-            "control peer?")
-    buf = _recv_exact(sock, total)
-    tag, payload, _rows = wire.decode_frame(memoryview(buf))
-    return tag, payload
+    on clean peer close — the replica-death signal the router keys on.
+
+    The ``router.control.partition`` fault point drops frames on this
+    side too (both directions partition): a dropped frame is consumed
+    from the socket and discarded, and the read blocks for the next."""
+    while True:
+        (total,) = _u32.unpack(bytes(_recv_exact(sock, 4)))
+        if total > _CTRL_MAX_FRAME:
+            raise ClusterConnectError(
+                f"absurd control frame length {total} — not a pathway-tpu "
+                "control peer?")
+        buf = _recv_exact(sock, total)
+        tag, payload, _rows = wire.decode_frame(memoryview(buf))
+        if faults.armed("router.control.partition"):
+            try:
+                faults.hit("router.control.partition", dir="recv",
+                           tag=tag)
+            except faults.InjectedFault:
+                continue  # partitioned: drop the frame, keep reading
+        return tag, payload
 
 
 def control_authkey(run_id: str | None = None) -> bytes:

@@ -86,6 +86,27 @@ class ReadOnlyPersistenceError(RuntimeError):
     primary's root exactly this way)."""
 
 
+class FencedPrimaryError(RuntimeError):
+    """A writer discovered that the persistence root's fencing epoch
+    moved past its own: a replica was PROMOTED to primary while this
+    process still believed it held the write lease (e.g. a SIGSTOPped
+    primary resumed after failover). Raised by name — naming both
+    epochs — before any byte lands in the WAL or a snapshot manifest,
+    so a zombie primary self-demotes loudly instead of splicing a
+    second timeline into the shared root (README "Write-path
+    failover")."""
+
+    def __init__(self, held_epoch: int, root_epoch: int, what: str):
+        self.held_epoch = held_epoch
+        self.root_epoch = root_epoch
+        super().__init__(
+            f"fenced primary: this writer holds fencing epoch "
+            f"{held_epoch} but the persistence root is at epoch "
+            f"{root_epoch} — a newer primary was promoted; refusing "
+            f"{what} and self-demoting (restart this process as a "
+            f"replica of the new primary)")
+
+
 class _RestrictedUnpickler(pickle.Unpickler):
     def find_class(self, module, name):
         if (module, name) in _SAFE_GLOBALS:
@@ -245,9 +266,20 @@ class _WaitHistogram:
         return out
 
 
+def record_epoch(rec) -> int:
+    """Fencing epoch a log record was written under. Records are
+    ``(time, entries)`` tuples from roots that never saw a promotion
+    (epoch 0 — every pre-failover root stays byte-compatible) or
+    ``(time, entries, epoch)`` once a promotion bumped the root's
+    epoch; unpack by index so both shapes read identically."""
+    return int(rec[2]) if len(rec) > 2 else 0
+
+
 class SnapshotLog:
     """Append-only framed, checksummed, restricted-pickle log of
-    (time, entries) records."""
+    (time, entries[, epoch]) records (``epoch`` — the writer's fencing
+    epoch — is stamped only when nonzero, keeping pre-failover logs
+    byte-identical)."""
 
     def __init__(self, path: str):
         self.path = path
@@ -256,7 +288,12 @@ class SnapshotLog:
 
     def _scan(self) -> tuple[list[tuple[int, list]], int]:
         """(intact records, byte offset of the end of the last intact one).
-        A torn tail record — crash mid-append — is excluded from both."""
+        A torn tail record — crash mid-append — is excluded from both.
+        Within one log, record epochs are non-decreasing (a promotion
+        only ever bumps the root's epoch); a record whose epoch is
+        BELOW its predecessor's is a fenced zombie's write that raced
+        the fencing check — recovery truncates at it, loudly, keeping
+        the single post-promotion timeline."""
         records: list = []
         if not os.path.exists(self.path):
             return records, 0
@@ -275,6 +312,7 @@ class SnapshotLog:
                 f"{self.path}: not a {_MAGIC.decode()} snapshot log — "
                 "refusing to read or overwrite it")
         pos = len(_MAGIC)
+        high_epoch = 0
         while pos + _HDR.size <= len(data):
             length, crc = _HDR.unpack_from(data, pos)
             end = pos + _HDR.size + length
@@ -298,6 +336,18 @@ class SnapshotLog:
                     raise  # forbidden global = tampering, not a torn tail
                 except Exception:
                     bad = True
+            if not bad:
+                epoch = record_epoch(rec)
+                if epoch < high_epoch:
+                    logger.error(
+                        "%s: fenced-zombie write at byte %d — record at "
+                        "tick %s carries fencing epoch %d below the "
+                        "log's established epoch %d (a demoted primary "
+                        "raced the fencing check) — truncating at it to "
+                        "keep the single post-promotion timeline",
+                        self.path, pos, rec[0], epoch, high_epoch)
+                    break
+                high_epoch = epoch
             if bad:
                 # a CRC/decode failure on the LAST framed record is the
                 # ordinary torn tail; one with more bytes behind it is
@@ -324,7 +374,7 @@ class SnapshotLog:
     def read_all(self) -> list[tuple[int, list]]:
         return self._scan()[0]
 
-    def append(self, time: int, entries: list) -> int:
+    def append(self, time: int, entries: list, epoch: int = 0) -> int:
         if self._f is None:
             # truncate any torn tail record before appending, or every later
             # record would sit behind unreadable bytes forever
@@ -335,7 +385,8 @@ class SnapshotLog:
                 self._f.seek(valid)
             if valid == 0:
                 self._f.write(_MAGIC)
-        payload = pickle.dumps((time, entries), protocol=pickle.HIGHEST_PROTOCOL)
+        rec = (time, entries, epoch) if epoch else (time, entries)
+        payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
         crc = zlib.crc32(payload)
         if faults.armed("persistence.append.corrupt"):
             # test hook: flip payload bytes AFTER the CRC was computed —
@@ -401,7 +452,8 @@ class SnapshotLog:
             if zlib.crc32(payload) != crc:
                 break
             try:
-                t, entries = _safe_loads(payload)
+                rec = _safe_loads(payload)
+                t, entries = rec[0], rec[1]
             except Exception:
                 break
             if t > tick:
@@ -414,6 +466,30 @@ class SnapshotLog:
         body = _MAGIC + (data[cut:] if cut is not None else b"")
         with blocking_call("persistence.compact"):
             _atomic_write_bytes(self.path, body)
+        return dropped
+
+    def truncate_after(self, tick: int) -> int:
+        """Promotion-time suffix truncation — the inverse cut of
+        :meth:`truncate_to`: atomically rewrite the log keeping only
+        records with time <= ``tick``. The dead primary's final commit
+        may have landed in SOME logs but not others (it died
+        mid-commit); the promoted replica applied only complete ticks,
+        so every record past its applied tick is an incomplete commit
+        that must not survive into the new timeline. Returns entries
+        dropped."""
+        self.close()
+        records, _valid = self._scan()
+        kept = [r for r in records if r[0] <= tick]
+        if len(kept) == len(records):
+            return 0
+        dropped = sum(len(r[1]) for r in records if r[0] > tick)
+        body = bytearray(_MAGIC)
+        for rec in kept:
+            payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+            body += _HDR.pack(len(payload), zlib.crc32(payload))
+            body += payload
+        with blocking_call("persistence.compact"):
+            _atomic_write_bytes(self.path, bytes(body))
         return dropped
 
     def close(self) -> None:
@@ -512,14 +588,17 @@ class S3SnapshotLog:
             if seq >= self._seq:
                 self.client.delete_object(obj["key"])
 
-    def append(self, time: int, entries: list) -> int:
+    def append(self, time: int, entries: list, epoch: int = 0) -> int:
         if self._seq is None:
             self._seq = self._next_seq()
         if not self._purged:
             self._purged = True
             self._purge_stale_successors()
-        payload = pickle.dumps((time, entries),
-                               protocol=pickle.HIGHEST_PROTOCOL)
+        # epoch accepted for log-API parity; object-store roots do not
+        # support fencing (no atomic read-modify-write manifest), so the
+        # driver keeps epoch 0 there and the record shape is unchanged
+        rec = (time, entries, epoch) if epoch else (time, entries)
+        payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
         crc = zlib.crc32(payload)
         if faults.armed("persistence.append.corrupt"):
             mutable = bytearray(payload)
@@ -555,20 +634,30 @@ class MockLog:
     def read_all(self) -> list[tuple[int, list]]:
         return list(self._records)
 
-    def append(self, time: int, entries: list) -> int:
-        self._records.append((time, entries))
+    def append(self, time: int, entries: list, epoch: int = 0) -> int:
+        rec = (time, entries, epoch) if epoch else (time, entries)
+        self._records.append(rec)
         # byte-threshold accounting parity with the durable logs
-        return len(pickle.dumps((time, entries),
-                                protocol=pickle.HIGHEST_PROTOCOL))
+        return len(pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL))
 
     def truncate_to(self, tick: int) -> int:
         """Drop records covered by a durable snapshot (time <= tick);
         returns entries dropped. In-place slice assignment so every
         holder of the store's list sees the compaction."""
-        dropped = sum(len(e) for t, e in self._records if t <= tick)
+        dropped = sum(len(r[1]) for r in self._records if r[0] <= tick)
         if dropped:
-            self._records[:] = [(t, e) for t, e in self._records
-                                if t > tick]
+            self._records[:] = [r for r in self._records if r[0] > tick]
+        return dropped
+
+    def truncate_after(self, tick: int) -> int:
+        """Promotion-time suffix cut (SnapshotLog.truncate_after): drop
+        records PAST ``tick`` — the dead primary's incomplete final
+        commit; returns entries dropped."""
+        kept = [r for r in self._records if r[0] <= tick]
+        if len(kept) == len(self._records):
+            return 0
+        dropped = sum(len(r[1]) for r in self._records if r[0] > tick)
+        self._records[:] = kept
         return dropped
 
     def close(self) -> None:
@@ -585,9 +674,13 @@ def scan_log_bytes(data: bytes,
     an incomplete or checksum-failing tail record is left UNconsumed
     rather than dropped: a live primary may still be mid-append, and the
     tailer (engine/replica.py) simply retries from the same offset on
-    its next poll."""
+    its next poll. A record whose fencing epoch regresses below its
+    predecessor's (a fenced zombie's write) stops the scan there —
+    permanently unconsumed; recovery truncates it (``SnapshotLog._scan``)
+    and the tailer never applies it."""
     records: list = []
     pos = 0
+    high_epoch = 0
     if expect_magic:
         if not data.startswith(_MAGIC):
             return records, 0  # header not fully written yet
@@ -601,9 +694,14 @@ def scan_log_bytes(data: bytes,
         if zlib.crc32(payload) != crc:
             break  # not yet flushed fully (or corrupt): retry later
         try:
-            records.append(_safe_loads(payload))
+            rec = _safe_loads(payload)
         except Exception:
             break
+        epoch = record_epoch(rec)
+        if epoch < high_epoch:
+            break  # fenced-zombie write: never apply, never consume
+        high_epoch = epoch
+        records.append(rec)
         pos = end
     return records, pos
 
@@ -620,7 +718,7 @@ class _ReadOnlyLog:
     def read_all(self):
         return self._inner.read_all()
 
-    def append(self, time, entries):
+    def append(self, time, entries, epoch=0):
         raise ReadOnlyPersistenceError(
             "append() on a read-only persistence root — a replica must "
             "never write to its primary's WAL")
@@ -629,6 +727,11 @@ class _ReadOnlyLog:
         raise ReadOnlyPersistenceError(
             "truncate_to() on a read-only persistence root — a replica "
             "must never compact its primary's WAL")
+
+    def truncate_after(self, tick):
+        raise ReadOnlyPersistenceError(
+            "truncate_after() on a read-only persistence root — a "
+            "replica must never rewrite the primary's WAL tail")
 
     def close(self):
         self._inner.close()
@@ -781,9 +884,12 @@ class PersistenceDriver:
     pathway_tpu/persistence/__init__.py; reference equivalent
     persistence/__init__.py:12,89 + src/persistence/tracker.rs)."""
 
-    # class-level default so partially-constructed drivers (tests build
-    # them via __new__) still read as writable
+    # class-level defaults so partially-constructed drivers (tests build
+    # them via __new__) still read as writable and unfenced
     read_only = False
+    fencing_supported = False
+    fencing_epoch = 0
+    fenced_writes = 0
 
     def __init__(self, config, read_only: bool = False):
         self.config = config
@@ -876,6 +982,145 @@ class PersistenceDriver:
         # partition antichain — what the manifest stores so seek-capable
         # sources can continue past a COMPACTED prefix
         self._frontiers: dict[str, dict] = {}
+        # -- write-path failover fencing (README "Write-path failover") ----
+        # The root carries a monotone fencing epoch in an fsynced manifest
+        # (<root>/epoch.json, PATHWAY_FLEET_EPOCH_PATH to override; mock
+        # roots keep it on the Backend object). A writable driver ADOPTS
+        # the existing epoch at open; promotion bumps it (claim_epoch);
+        # every commit/snapshot first re-reads the manifest and raises
+        # FencedPrimaryError when the root moved past this writer's epoch
+        # — a zombie ex-primary self-demotes before any byte lands.
+        # Object-store roots have no atomic read-modify-write manifest;
+        # fencing stays off there (epoch 0, checks pass).
+        self.fencing_supported = self.kind in ("filesystem", "mock")
+        self.fenced_writes = 0
+        self.fencing_epoch = self.read_epoch() if self.fencing_supported \
+            else 0
+
+    # -- fencing epoch (write-path failover) -------------------------------
+    def epoch_path(self) -> str | None:
+        """Filesystem path of the fencing-epoch manifest (None on
+        non-file backends)."""
+        if self.kind != "filesystem":
+            return None
+        return os.environ.get("PATHWAY_FLEET_EPOCH_PATH") \
+            or os.path.join(self.root, "epoch.json")
+
+    def read_epoch(self) -> int:
+        """The root's current fencing epoch (0 = no promotion ever).
+        The manifest is written atomically (tmp + fsync + replace), so
+        a crash mid-bump leaves the previous epoch intact — never a
+        torn manifest; an unreadable one is treated as epoch 0, loudly
+        (fencing degrades open, it never bricks the root)."""
+        if self.kind == "mock":
+            return int(getattr(self._backend, "_mock_epoch", 0) or 0)
+        path = self.epoch_path()
+        if path is None:
+            return 0
+        import json
+
+        try:
+            with open(path) as f:
+                meta = json.load(f)
+            return int(meta.get("epoch", 0))
+        except FileNotFoundError:
+            return 0
+        except Exception as e:
+            logger.error(
+                "unreadable fencing-epoch manifest %s (%s: %s) — "
+                "treating the root as epoch 0 (fencing disabled until "
+                "the manifest is rewritten)", path, type(e).__name__, e)
+            return 0
+
+    def claim_epoch(self, holder: str, min_epoch: int = 0) -> int:
+        """Atomically bump the root's fencing epoch past every epoch any
+        writer ever held (and past ``min_epoch``, the router's election
+        hint) and adopt it — the promotion step that fences the dead
+        (or SIGSTOP-zombied) primary out of the write path forever."""
+        if self.read_only:
+            raise ReadOnlyPersistenceError(
+                "claim_epoch() on a read-only persistence root — flip "
+                "the driver writable (promote) before claiming")
+        if not self.fencing_supported:
+            raise ValueError(
+                f"fencing epochs are not supported on the {self.kind!r} "
+                "persistence backend (no atomic manifest)")
+        new = max(self.read_epoch() + 1, int(min_epoch))
+        # fault point: a candidate dying INSIDE the claim must leave the
+        # previous epoch manifest intact (the atomic write never ran)
+        faults.hit("persistence.epoch.claim", holder=str(holder),
+                   epoch=new)
+        if self.kind == "mock":
+            self._backend._mock_epoch = new
+        else:
+            import json
+
+            meta = {"format": "pwepoch1", "epoch": new,
+                    "holder": str(holder), "bumped_at": _time.time()}
+            with blocking_call("persistence.epoch.claim"):
+                _atomic_write_bytes(self.epoch_path(),
+                                    json.dumps(meta).encode())
+        self.fencing_epoch = new
+        logger.warning(
+            "fencing epoch bumped to %d by %r — every writer still "
+            "holding an older epoch is fenced out of this root", new,
+            holder)
+        return new
+
+    def check_fenced(self, what: str) -> None:
+        """Refuse a durable write if the root's epoch moved past this
+        writer's (a newer primary was promoted). Called at the top of
+        every commit() and write_snapshot() — the fencing read happens
+        BEFORE any byte of the write lands."""
+        if not self.fencing_supported or self.read_only:
+            return
+        root_epoch = self.read_epoch()
+        if root_epoch > self.fencing_epoch:
+            self.fenced_writes += 1
+            raise FencedPrimaryError(self.fencing_epoch, root_epoch, what)
+
+    def promote(self, holder: str, complete_tick: int,
+                min_epoch: int = 0) -> tuple[int, int]:
+        """Flip a replica's read-only driver into the fleet's new
+        writable primary: re-read the root fresh (the hydration-time
+        caches are stale by now), bump+adopt the fencing epoch, and
+        drop the dead primary's incomplete final commit — every record
+        past ``complete_tick`` (the last COMPLETE tick the promoting
+        replica applied; a mid-commit death leaves later records in
+        SOME logs only). Returns ``(max_tick_seen, epoch)`` where
+        ``max_tick_seen`` is the highest tick present in any log BEFORE
+        the suffix cut — the new primary's time counter starts past it
+        so a torn tick number is never reused."""
+        if not self.fencing_supported:
+            raise ValueError(
+                f"promotion requires a filesystem (or mock) persistence "
+                f"root, not {self.kind!r}")
+        self.read_only = False
+        if self.kind == "filesystem":
+            os.makedirs(os.path.join(self.root, "streams"), exist_ok=True)
+        # hydration-time caches were taken when this driver opened the
+        # root read-only; the dead primary kept writing since
+        self._record_cache.clear()
+        self._restore_time = None
+        self._snapshot_probed = False
+        self._loaded_snapshot = None
+        max_tick = self.restore_time()  # BEFORE the cut: torn ticks too
+        epoch = self.claim_epoch(holder, min_epoch)
+        dropped = 0
+        for sid in self.list_source_ids():
+            log = self._log_for(sid)
+            if hasattr(log, "truncate_after"):
+                dropped += log.truncate_after(complete_tick)
+            log.close()
+        if dropped:
+            logger.warning(
+                "promotion to epoch %d dropped %d entry(ies) of the dead "
+                "primary's incomplete final commit (records past tick "
+                "%d) — none were acknowledged-complete ticks", epoch,
+                dropped, complete_tick)
+            self._record_cache.clear()
+            self._restore_time = None
+        return max_tick, epoch
 
     # -- identity ----------------------------------------------------------
     def _source_id(self, datasource) -> str:
@@ -1068,6 +1313,7 @@ class PersistenceDriver:
             raise ReadOnlyPersistenceError(
                 "write_snapshot() on a read-only persistence root — a "
                 "replica must never write snapshot generations")
+        self.check_fenced("write_snapshot()")
         if not self.snapshots_supported:
             if not self._snapshot_warned:
                 self._snapshot_warned = True
@@ -1094,6 +1340,7 @@ class PersistenceDriver:
         meta = {"format": "pwsnapmeta1", "generation": gen,
                 "snapshot_tick": tick, "state_bytes": len(blob),
                 "state_crc32": zlib.crc32(blob), "sources": sources,
+                "epoch": self.fencing_epoch,
                 "wrote_at": _time.time()}
         if self.kind == "mock":
             meta["state"] = _STATE_MAGIC + blob
@@ -1213,12 +1460,12 @@ class PersistenceDriver:
         snap = self.load_snapshot()
         last = snap["tick"] if snap is not None else 0
         for sid in self.list_source_ids():
-            for t, _ in self._records(sid):
-                last = max(last, t)
+            for rec in self._records(sid):
+                last = max(last, rec[0])
         self._restore_time = last
         return last
 
-    def attach_source(self, datasource, session):
+    def attach_source(self, datasource, session, replay: bool = True):
         """Replay this source's durable prefix into ``session`` and return
         the recording proxy the live reader thread must push into.
 
@@ -1231,6 +1478,13 @@ class PersistenceDriver:
           dropped. This is exact under reordering and file mutation.
         - otherwise the source is assumed to re-emit the identical entry
           sequence on restart, and the first N live pushes are dropped.
+
+        ``replay=False`` — the promotion path (engine/streaming.py): the
+        promoting replica's scheduler already holds the durable state
+        (it tailed every complete tick), so nothing is pushed; only the
+        resume frontier, the seek protocol and the skip counter are set
+        up exactly as a restart would, so the new primary's readers
+        continue past the durable prefix without double-applying it.
         """
         if self.read_only:
             raise ReadOnlyPersistenceError(
@@ -1257,13 +1511,14 @@ class PersistenceDriver:
             # WAL-truncate leaves them in the log — they are ignored
             # here, never replayed on top of the state that already
             # includes them.
-            records = [(t, e) for t, e in records if t > snap_tick]
+            records = [r for r in records if r[0] > snap_tick]
         replayed: list = []
-        for _t, entries in records:
-            for entry in entries:
+        for rec in records:
+            for entry in rec[1]:
                 key, row, diff = entry[0], entry[1], entry[2]
                 offset = entry[3] if len(entry) > 3 else None
-                session.push(key, row, diff)
+                if replay:
+                    session.push(key, row, diff)
                 replayed.append((key, row, diff, offset))
         self.wal_replayable_entries += len(replayed)
         self.wal_entries_uncovered += len(replayed)
@@ -1348,6 +1603,7 @@ class PersistenceDriver:
             raise ReadOnlyPersistenceError(
                 "commit() on a read-only persistence root — a replica "
                 "must never append to the primary's WAL")
+        self.check_fenced("commit()")
         t0 = _time.perf_counter()
         if watermark is None:
             watermark = time
@@ -1360,7 +1616,8 @@ class PersistenceDriver:
         for sid, log, rec in self._sessions:
             entries = rec.take_sealed(watermark)
             if entries:
-                nbytes = log.append(watermark, entries) or 0
+                nbytes = log.append(watermark, entries,
+                                    self.fencing_epoch) or 0
                 self.entries_committed += len(entries)
                 self.wal_replayable_entries += len(entries)
                 self.wal_entries_uncovered += len(entries)
@@ -1398,6 +1655,9 @@ class PersistenceDriver:
                                       - self.last_snapshot_tick),
             "compactions_total": self.compactions_total,
             "wal_replayable_entries": self.wal_replayable_entries,
+            # -- write-path failover fencing -------------------------------
+            "fencing_epoch": self.fencing_epoch,
+            "fenced_writes": self.fenced_writes,
         }
 
     def close(self) -> None:

@@ -58,7 +58,8 @@ from pathway_tpu.engine.delta import Delta
 from pathway_tpu.engine.locking import create_lock
 from pathway_tpu.engine.persistence import (PersistenceDriver,
                                             ReadOnlyPersistenceError,
-                                            scan_log_bytes, source_id)
+                                            record_epoch, scan_log_bytes,
+                                            source_id)
 from pathway_tpu.engine.threads import spawn
 
 logger = logging.getLogger(__name__)
@@ -134,9 +135,9 @@ class _FsLogTail:
         records, consumed = scan_log_bytes(data,
                                            expect_magic=self._offset == 0)
         self._offset += consumed
-        fresh = [(t, e) for t, e in records if t > self.last_tick]
+        fresh = [r for r in records if r[0] > self.last_tick]
         if fresh:
-            self.last_tick = max(t for t, _e in fresh)
+            self.last_tick = max(r[0] for r in fresh)
         return fresh, consumed
 
 
@@ -150,11 +151,10 @@ class _MockLogTail:
         self.last_tick = 0
 
     def poll(self) -> tuple[list[tuple[int, list]], int]:
-        fresh = [(t, e) for t, e in list(self._records)
-                 if t > self.last_tick]
+        fresh = [r for r in list(self._records) if r[0] > self.last_tick]
         if fresh:
-            self.last_tick = max(t for t, _e in fresh)
-        return fresh, sum(len(e) for _t, e in fresh)
+            self.last_tick = max(r[0] for r in fresh)
+        return fresh, sum(len(r[1]) for r in fresh)
 
 
 class ReplicaTailer:
@@ -190,11 +190,16 @@ class ReplicaTailer:
         self.applied_tick = 0        # primary watermark fully applied
         self.primary_watermark = 0   # newest durable tick observed
         self.generation = 0          # snapshot generation hydrated from
+        self.fleet_epoch = 0         # newest fencing epoch observed
         self.hydrate_wall_s: float | None = None
         self.catchup_wall_s: float | None = None  # start -> first caught-up
         self.records_applied = 0
         self.entries_applied = 0
         self._started_at = _time.monotonic()
+        # set by reanchor() when this replica provably applied state the
+        # post-promotion timeline does not contain; pump() raises it so
+        # the process dies loudly and a restart re-hydrates whole
+        self._poisoned: str | None = None
 
     # -- wiring -------------------------------------------------------------
     def bind(self, sessions) -> None:
@@ -209,11 +214,17 @@ class ReplicaTailer:
                 continue
             sid = source_id(ds)
             if sid not in root_sids:
+                # the primary creates each WAL lazily, on the source's
+                # FIRST append — a quiet feed (e.g. a durable-ack write
+                # route before its first write) has no file yet. Tail
+                # the future path anyway (the tail polls until the file
+                # appears); reading LIVE here would double-ingest the
+                # feed the moment the primary's log shows up, and a
+                # promotion would silently skip re-attaching it.
                 logger.warning(
-                    "replica source %r has no WAL under the primary root "
-                    "— it will read LIVE on this replica (replicas "
-                    "normally tail every persisted feed)", sid)
-                continue
+                    "replica source %r has no WAL under the primary "
+                    "root yet — tailing its path for the log to appear",
+                    sid)
             self._nodes[sid] = node
             self._tailed_idx.add(i)
             if self.driver.kind == "mock":
@@ -280,6 +291,8 @@ class ReplicaTailer:
         therefore catches up on its backlog in one tick rather than
         stalling new queries behind k sequential applies (bounded tail
         latency AND bounded staleness under load)."""
+        if self._poisoned is not None:
+            raise ReplicaHydrationError(self._poisoned)
         new_bytes = 0
         rescan_floor: int | None = None  # min seen-tick of rescanned tails
         with self._lock:
@@ -294,8 +307,10 @@ class ReplicaTailer:
                     tail.rescanned = False
                     rescan_floor = (seen_before if rescan_floor is None
                                     else min(rescan_floor, seen_before))
-                for t, entries in records:
-                    self._pending.setdefault(t, {})[sid] = entries
+                for rec in records:
+                    self._pending.setdefault(rec[0], {})[sid] = rec[1]
+                    self.fleet_epoch = max(self.fleet_epoch,
+                                           record_epoch(rec))
             if self._pending:
                 self.primary_watermark = max(self.primary_watermark,
                                              max(self._pending))
@@ -361,6 +376,42 @@ class ReplicaTailer:
             self.catchup_wall_s = _time.monotonic() - self._started_at
         return time_counter
 
+    # -- failover re-anchor --------------------------------------------------
+    def reanchor(self, epoch: int, tick: int) -> None:
+        """Re-anchor this replica's WAL tail on a new primary's timeline
+        (router broadcast after a promotion). Pending ticks past the
+        promotion tick are the dead primary's incomplete final commit —
+        the new primary truncated them from every log, so they are
+        dropped here too and the tails rescan from byte 0 (tick-deduped,
+        so only the genuinely-new epoch records apply). A replica that
+        already APPLIED a tick past the promotion point served state the
+        new timeline does not contain — it poisons itself and the next
+        pump dies loudly (ReplicaHydrationError); a restart re-hydrates
+        from the shared root and is whole again."""
+        with self._lock:
+            self.fleet_epoch = max(self.fleet_epoch, int(epoch))
+            for t in [t for t in self._pending if t > tick]:
+                del self._pending[t]
+            self.primary_watermark = min(self.primary_watermark, tick)
+            for tail in self._tails.values():
+                # force a full rescan: the new primary's truncate_after
+                # may have shrunk the file below our offset, and its
+                # first append may otherwise race the shrink detection
+                if hasattr(tail, "_offset"):
+                    tail._ino, tail._offset = None, 0
+                tail.last_tick = min(tail.last_tick, tick)
+            if self.applied_tick > tick:
+                self._poisoned = (
+                    f"replica {self.replica_id} applied tick "
+                    f"{self.applied_tick} but the fleet promoted a new "
+                    f"primary at epoch {epoch} whose timeline ends at "
+                    f"tick {tick} — this replica served state the new "
+                    f"timeline does not contain; restart it to "
+                    f"re-hydrate from the shared root")
+            logger.warning(
+                "replica %s re-anchored on fencing epoch %d at tick %d",
+                self.replica_id, epoch, tick)
+
     # -- fleet surface -------------------------------------------------------
     def staleness_ticks(self) -> int:
         return max(0, self.primary_watermark - self.applied_tick)
@@ -379,6 +430,7 @@ class ReplicaTailer:
             "records_applied": self.records_applied,
             "entries_applied": self.entries_applied,
             "tailed_sources": sorted(self._tails),
+            "fleet_epoch": self.fleet_epoch,
         }
 
     def close(self) -> None:
@@ -423,7 +475,12 @@ class ControlClient:
 
     def _heartbeat_payload(self) -> dict:
         rt = self.runtime
-        hb = {"replica": self.replica_id, "role": self.role,
+        # role is read LIVE off the runtime: a promotion flips
+        # runtime.role replica→primary mid-run and the router learns the
+        # transition from the very next heartbeat (its failover clock
+        # stops on the first primary-role heartbeat)
+        hb = {"replica": self.replica_id,
+              "role": getattr(rt, "role", self.role),
               "at": _time.time()}
         # re-announce the serving endpoint: if the webserver was not yet
         # bound at hello time, the router learns the address from the
@@ -440,7 +497,17 @@ class ControlClient:
                 hb["applied_tick"] = p.last_commit_watermark
                 hb["primary_watermark"] = p.last_commit_watermark
                 hb["generation"] = p.snapshot_generation
+                hb["fleet_epoch"] = getattr(p, "fencing_epoch", 0)
             hb["staleness_ticks"] = 0
+        # failover bookkeeping: a just-promoted primary announces the
+        # tick its adopted timeline ends at, so the router can re-anchor
+        # the surviving replicas exactly there (engine/router.py)
+        if getattr(rt, "promotion_tick", None) is not None:
+            hb["promotion_tick"] = rt.promotion_tick
+            hb["promotions"] = rt.promotions
+            if rt.failover_promotion_s is not None:
+                hb["failover_promotion_s"] = round(
+                    rt.failover_promotion_s, 6)
         tracker = getattr(rt.recorder, "requests", None) \
             if rt.recorder is not None else None
         if tracker is not None:
@@ -503,19 +570,33 @@ class ControlClient:
     def _run(self) -> None:
         from pathway_tpu.engine.multiproc import (recv_control_frame,
                                                   send_control_frame)
+        from pathway_tpu.internals.retries import \
+            ExponentialBackoffRetryStrategy
 
-        backoff = 0.2
+        # shared backoff policy (internals/retries.py): full jitter so a
+        # fleet of replicas re-dialing a bounced router does not stampede
+        # it in lockstep; max_retries is effectively unbounded — the loop
+        # itself decides when to stop (runtime._stop), the strategy only
+        # shapes the delays
+        retry = ExponentialBackoffRetryStrategy(
+            max_retries=1_000_000, initial_delay_ms=200,
+            backoff_factor=2.0, max_delay_ms=5_000, jitter=True,
+            seed=hash(self.replica_id) & 0xFFFF)
+        attempt = 0
         while not self.runtime._stop.is_set():
             try:
                 sock = self._connect_once()
             except Exception as e:  # noqa: BLE001 — reconnect with backoff
-                logger.debug("control dial to %s failed: %s; retrying",
-                             self.address, e)
-                if self.runtime._stop.wait(backoff):
+                delay = retry.delay_for_attempt(attempt)  # seconds
+                # clamp: past the max_delay cap the schedule is flat, and
+                # float 2.0**attempt overflows for very long outages
+                attempt = min(attempt + 1, 16)
+                logger.debug("control dial to %s failed: %s; retrying "
+                             "in %.2fs", self.address, e, delay)
+                if self.runtime._stop.wait(delay):
                     return
-                backoff = min(backoff * 2, 5.0)
                 continue
-            backoff = 0.2
+            attempt = 0  # connected: the next outage backs off from scratch
             self._sock = sock
             try:
                 while not self.runtime._stop.is_set():
@@ -534,6 +615,22 @@ class ControlClient:
                             (payload or {}).get("reason", "scale-in"))
                         self.runtime.stop()
                         return
+                    if tag == "promote":
+                        # hand the request to the commit loop (it runs
+                        # promotion synchronously between ticks); keep
+                        # this control loop alive — the router learns the
+                        # outcome from role flips in later heartbeats
+                        logger.warning(
+                            "replica %s: router requested promotion (%s)",
+                            self.replica_id, payload or {})
+                        self.runtime.request_promotion(payload or {})
+                        continue
+                    if tag == "reanchor":
+                        tailer = getattr(self.runtime, "replica", None)
+                        if tailer is not None and payload:
+                            tailer.reanchor(int(payload["epoch"]),
+                                            int(payload["tick"]))
+                        continue
             except (OSError, EOFError) as e:
                 logger.debug("control link to router lost (%s); "
                              "redialing", e)

@@ -128,6 +128,10 @@ class ReplicaEndpoint:
         self.staleness_ticks = 0
         self.generation = 0
         self.monitoring_port: int | None = None
+        # fencing epoch / promotion tick from heartbeats (write-path
+        # failover: the router re-anchors surviving replicas on these)
+        self.fleet_epoch = 0
+        self.promotion_tick: int | None = None
         self.last_heartbeat = _time.monotonic()
         self.requests = 0
         self.failures = 0
@@ -175,6 +179,15 @@ class ReplicaEndpoint:
         # heartbeat restores the endpoint to rotation — a genuinely dead
         # process cannot heartbeat, and its control EOF removes it
         self.alive = True
+        # role is adopted LIVE: a promoted replica's very next heartbeat
+        # says "primary", and that flip is what ends an election
+        # (_endpoint_loop compares before/after and tells the router)
+        if hb.get("role") in ("replica", "primary"):
+            self.role = str(hb["role"])
+        if hb.get("fleet_epoch") is not None:
+            self.fleet_epoch = int(hb["fleet_epoch"])
+        if hb.get("promotion_tick") is not None:
+            self.promotion_tick = int(hb["promotion_tick"])
         # late serving endpoint: a replica whose webserver was not up at
         # hello time announces it via heartbeat once it binds
         if (not self.host or not self.port) and hb.get("host") \
@@ -257,10 +270,30 @@ class QueryRouter:
                  control_port: int = 0,
                  max_staleness_ticks: int | None = None,
                  slo_ms: float | None = None,
-                 error_budget: float | None = None):
+                 error_budget: float | None = None,
+                 write_paths: tuple[str, ...] | list[str] | None = None):
         self.host = host
         self.port = port
         self.control_port = control_port
+        # -- write-path failover (promotion orchestration) ------------------
+        # path prefixes that mutate primary state: they route to the
+        # primary-role endpoint only, 503 (honest Retry-After) during an
+        # election, and NEVER fail over to a replica mid-flight (a write
+        # replay against a non-primary would fork the timeline)
+        if write_paths is None:
+            raw = os.environ.get("PATHWAY_ROUTER_WRITE_PATHS", "")
+            write_paths = tuple(p.strip() for p in raw.split(",")
+                                if p.strip())
+        self.write_paths = tuple(write_paths)
+        self.election_timeout_s = max(0.05, _env_int(
+            "PATHWAY_ROUTER_ELECTION_TIMEOUT_MS", 3000) / 1000.0)
+        self.fleet_epoch = 0           # max fencing epoch seen fleet-wide
+        self.promotions_total = 0      # elections completed
+        self.failover_seconds: float | None = None  # last death→primary-hb
+        # active election: {"started_at", "dead", "target", "epoch"} —
+        # guarded by _lock; None when a primary is serving writes
+        self._election: dict | None = None
+        self._write_primary_id: str | None = None
         self.max_staleness_ticks = (
             max_staleness_ticks if max_staleness_ticks is not None
             else _env_int("PATHWAY_ROUTER_MAX_STALENESS_TICKS", 1024))
@@ -318,6 +351,10 @@ class QueryRouter:
         self._ctrl_sock = ctrl
         self._track_thread(spawn(self._accept_loop,
                                  name="router-control"))
+        # slow-path failure detector (write-path failover): heartbeat
+        # staleness + election re-drive; cheap when no primary is known
+        self._track_thread(spawn(self._election_loop,
+                                 name="router-election"))
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -430,6 +467,9 @@ class QueryRouter:
                     pass
             logger.info("replica registered: %s (%s) at %s:%s",
                         ep.replica_id, ep.role, ep.host, ep.port)
+            if ep.role == "primary":
+                with self._lock:
+                    self._write_primary_id = ep.replica_id
             self._track_thread(spawn(
                 lambda e=ep: self._endpoint_loop(e),
                 name=f"router-hb-{ep.replica_id}"))
@@ -440,7 +480,9 @@ class QueryRouter:
             while not self._stop.is_set():
                 tag, payload = recv_control_frame(ep.sock)
                 if tag == "hb" and isinstance(payload, dict):
+                    was_primary = ep.role == "primary"
                     ep.apply_heartbeat(payload)
+                    self._note_heartbeat(ep, was_primary)
         except (OSError, EOFError, ConnectionError):
             pass
         finally:
@@ -456,6 +498,189 @@ class QueryRouter:
                 logger.warning(
                     "replica %s left the fleet (control link closed) — "
                     "routing around it", ep.replica_id)
+                # the WRITE primary died: writes are down until a
+                # replica promotes — start the election immediately
+                # (control EOF is the fast death signal; the heartbeat
+                # staleness monitor is the slow one for partitions)
+                self._on_primary_death(ep.replica_id)
+
+    def _note_heartbeat(self, ep: ReplicaEndpoint,
+                        was_primary: bool) -> None:
+        """Router-side bookkeeping per heartbeat: track the fleet's max
+        fencing epoch, learn who the write primary is, and complete an
+        election when the promoted candidate's first primary-role
+        heartbeat arrives."""
+        completed = None
+        with self._lock:
+            self.fleet_epoch = max(self.fleet_epoch, ep.fleet_epoch)
+            if ep.role != "primary":
+                return
+            if self._write_primary_id != ep.replica_id:
+                self._write_primary_id = ep.replica_id
+            el = self._election
+            if el is not None:
+                # the failover clock stops HERE: primary death →
+                # first primary-role heartbeat from the rescuer
+                self._election = None
+                self.promotions_total += 1
+                self.failover_seconds = \
+                    _time.monotonic() - el["started_at"]
+                completed = el
+        if completed is not None:
+            logger.warning(
+                "election complete: %s is the new write primary at "
+                "fencing epoch %d (failover %.3fs)", ep.replica_id,
+                ep.fleet_epoch, self.failover_seconds)
+        if not was_primary or completed is not None:
+            # first primary heartbeat (promotion or late role flip):
+            # re-anchor every surviving replica on the new timeline
+            self._broadcast_reanchor(ep)
+
+    def _broadcast_reanchor(self, primary: ReplicaEndpoint) -> None:
+        """Tell every surviving replica to re-anchor its WAL tail on the
+        promoted timeline: epoch + the tick the new timeline ends at
+        (pending ticks past it are the dead primary's torn final commit,
+        truncated from every log by the promotion)."""
+        tick = primary.promotion_tick
+        if tick is None:
+            return  # a born-primary (no promotion): nothing to re-anchor
+        for ep in self.endpoints():
+            if ep.replica_id == primary.replica_id or ep.role != "replica":
+                continue
+            try:
+                send_control_frame(ep.sock, "reanchor",
+                                   {"epoch": primary.fleet_epoch,
+                                    "tick": int(tick)})
+            except OSError as e:
+                logger.warning("reanchor to %s failed: %s",
+                               ep.replica_id, e)
+
+    # -- write-path failover: election ---------------------------------------
+    def _on_primary_death(self, replica_id: str) -> None:
+        """The write primary is gone (control EOF, heartbeat staleness,
+        or a failed write forward): open an election and command the
+        best candidate to promote. Idempotent — a second death signal
+        for the same primary joins the already-running election."""
+        with self._lock:
+            if self._election is not None \
+                    or self._write_primary_id != replica_id:
+                return
+            self._write_primary_id = None
+            self._election = {
+                "started_at": _time.monotonic(),
+                "dead": replica_id,
+                "target": None,
+                # the epoch the candidate must claim AT LEAST: strictly
+                # above everything the fleet has seen, so the dead
+                # primary's stamps can never tie the new timeline's
+                "epoch": self.fleet_epoch + 1,
+            }
+        logger.warning(
+            "write primary %s died — electing a successor (timeout "
+            "%.1fs; writes 503 until a candidate promotes)",
+            replica_id, self.election_timeout_s)
+        self._elect()
+
+    def _elect(self) -> None:
+        """Pick the most-caught-up live replica and send it the promote
+        command. Candidate selection by highest ``applied_tick``: zero
+        acknowledged-write loss needs the candidate that tailed the
+        most of the dead primary's WAL (any survivor CAN recover the
+        full durable prefix by replay, but the freshest one promotes
+        fastest). A send failure marks the candidate dead and moves to
+        the next; with no candidates the election stays open and the
+        monitor retries as replicas (re-)register."""
+        with self._lock:
+            el = self._election
+            if el is None:
+                return
+            epoch = el["epoch"]
+        while True:
+            candidates = [e for e in self.endpoints()
+                          if e.alive and not e.retiring
+                          and e.role == "replica"]
+            if not candidates:
+                logger.warning(
+                    "election open but no live replica candidates — "
+                    "writes stay 503 until one registers")
+                return
+            target = max(candidates, key=lambda e: e.applied_tick)
+            try:
+                send_control_frame(target.sock, "promote",
+                                   {"epoch": epoch,
+                                    "dead": self._election["dead"]
+                                    if self._election else None})
+            except OSError as e:
+                logger.warning("promote command to %s failed: %s — "
+                               "trying the next candidate",
+                               target.replica_id, e)
+                target.alive = False
+                continue
+            with self._lock:
+                if self._election is not None:
+                    self._election["target"] = target.replica_id
+            logger.warning(
+                "promote command sent to %s (applied_tick %d, epoch "
+                ">= %d)", target.replica_id, target.applied_tick, epoch)
+            return
+
+    def _election_loop(self) -> None:
+        """Slow-path failure detector + election babysitter. Control
+        EOF catches a dead process instantly; this loop catches what
+        EOF cannot: a SIGSTOPped/partitioned primary whose socket is
+        open but silent (heartbeat staleness), a candidate that died
+        mid-promotion (``replica.promote.crash`` — its EOF fires
+        _on_primary_death only for primaries, so the election must be
+        re-driven here), and a promote frame lost to a control
+        partition (re-elected after a full election window of
+        silence)."""
+        poll_s = max(0.05, self.election_timeout_s / 4.0)
+        while not self._stop.wait(poll_s):
+            now = _time.monotonic()
+            with self._lock:
+                el = dict(self._election) if self._election else None
+                primary_id = self._write_primary_id
+            try:
+                if el is None:
+                    if primary_id is None:
+                        continue
+                    ep = self._endpoints.get(primary_id)
+                    if ep is not None and now - ep.last_heartbeat \
+                            > self.election_timeout_s:
+                        # open socket, silent process: a zombie
+                        # (SIGSTOP) or a partition — treat as death;
+                        # if it resumes later, epoch fencing refuses
+                        # its writes and re-registration re-admits it
+                        logger.warning(
+                            "write primary %s silent for > %.1fs — "
+                            "declaring it dead", primary_id,
+                            self.election_timeout_s)
+                        self._on_primary_death(primary_id)
+                    continue
+                target = el.get("target")
+                tep = self._endpoints.get(target) if target else None
+                if tep is None or not tep.alive:
+                    # candidate registered dead (or none was chosen):
+                    # crash-mid-promotion lands here — elect the next
+                    # survivor; its promote() claims a HIGHER epoch, so
+                    # the half-promoted corpse can never write
+                    self._elect()
+                elif now - el["started_at"] > 2 * self.election_timeout_s \
+                        and tep.role != "primary":
+                    # promote frame (or every heartbeat since) lost:
+                    # re-send — promotion is idempotent on the replica
+                    logger.warning(
+                        "election stalled %.1fs (target %s never "
+                        "became primary) — re-electing",
+                        now - el["started_at"], target)
+                    with self._lock:
+                        if self._election is not None:
+                            self._election["started_at"] = now
+                            self._election["target"] = None
+                    self._elect()
+            except Exception:  # noqa: BLE001 — the detector must not die
+                logger.warning("election evaluation failed",
+                               exc_info=True)
 
     def request_stop_replica(self, ep: ReplicaEndpoint,
                              reason: str = "scale-in") -> bool:
@@ -546,6 +771,9 @@ class QueryRouter:
         attempt, including failover replays, so the rescuing replica
         adopts the same id the first attempt carried; the caller echoes
         it on every response, including 503s."""
+        if self.is_write_path(path):
+            return self._forward_write(method, path, body, content_type,
+                                       rid, hop)
         if rid is None:
             rid = _mint_router_rid()
         span = self.request_log.start(rid, path)
@@ -622,6 +850,106 @@ class QueryRouter:
                 retry_after = "1"  # every 503 carries the hint
             return (status, data, ep.replica_id, failovers, resp_ctype,
                     rid, retry_after if status == 503 else None)
+
+    def is_write_path(self, path: str) -> bool:
+        p = path.split("?", 1)[0]
+        return any(p.startswith(w) for w in self.write_paths)
+
+    def _election_retry_after(self) -> str:
+        """Honest Retry-After for write 503s: the remaining election
+        window (death already detected, a candidate is promoting) —
+        or one full window when no election is running yet."""
+        import math
+
+        with self._lock:
+            el = self._election
+            remaining = (self.election_timeout_s
+                         - (_time.monotonic() - el["started_at"])
+                         if el is not None else self.election_timeout_s)
+        return str(max(1, math.ceil(remaining)))
+
+    def _forward_write(self, method: str, path: str, body: bytes,
+                       content_type: str, rid: str | None, hop: int
+                       ) -> tuple[int, bytes, str, int, str, str,
+                                  str | None]:
+        """Write-path routing: primary only, no cross-replica failover.
+        During an election the write 503s with the remaining election
+        window as ``Retry-After`` — the client's retry lands after the
+        promoted primary started serving. A connection-level failure
+        marks the primary dead and opens the election itself (the
+        control-plane EOF usually beat us here); the write 503s rather
+        than replays, because the router cannot know whether the dying
+        primary durably logged it (the client's retry is the idempotent
+        path — an acknowledged write is durable, an unacknowledged one
+        is the client's to re-send)."""
+        if rid is None:
+            rid = _mint_router_rid()
+        span = self.request_log.start(rid, path)
+        t0 = _time.perf_counter()
+        with self._lock:
+            electing = self._election is not None
+            primary_id = self._write_primary_id
+        ep = self._endpoints.get(primary_id) if primary_id else None
+        if electing or ep is None or not ep.alive \
+                or not ep.host or not ep.port:
+            self.unroutable_total += 1
+            self.request_log.finish(span, 503, None)
+            why = ("a new primary is being elected" if electing
+                   else "no write primary registered")
+            return (503, f"write unavailable: {why}".encode(), "", 0,
+                    "text/plain", rid, self._election_retry_after())
+        span.note_routed()
+        ep.inflight += 1
+        t_attempt = _time.perf_counter()
+        try:
+            conn = http.client.HTTPConnection(
+                ep.host, ep.port, timeout=self.forward_timeout_s)
+            try:
+                conn.request(method, path, body=body or None,
+                             headers={"Content-Type": content_type,
+                                      REQUEST_ID_HEADER: rid,
+                                      HOP_HEADER: str(hop + 1)})
+                resp = conn.getresponse()
+                data = resp.read()
+                status = resp.status
+                resp_ctype = resp.getheader("Content-Type",
+                                            "application/json")
+                retry_after = resp.getheader("Retry-After")
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as e:
+            ep.failures += 1
+            ep.alive = False
+            logger.warning(
+                "write forward to primary %s failed (%s: %s) — opening "
+                "election; the client must retry",
+                ep.replica_id, type(e).__name__, e)
+            self._on_primary_death(ep.replica_id)
+            self.unroutable_total += 1
+            self.request_log.finish(span, 503, None)
+            return (503,
+                    f"write primary died mid-request ({e}); retry after "
+                    f"failover".encode(),
+                    "", 0, "text/plain", rid,
+                    self._election_retry_after())
+        finally:
+            ep.inflight = max(0, ep.inflight - 1)
+        ep.requests += 1
+        ep.observe((_time.perf_counter() - t_attempt) * 1e3)
+        span.note_attempt(ep.replica_id, t_attempt, ok=True)
+        ms = (_time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self.requests_total += 1
+            self._window.append(ms)
+            self._e2e_p50.observe(ms)
+            self._e2e_p95.observe(ms)
+            if ms > self.slo_ms:
+                self.violations += 1
+        self.request_log.finish(span, status, ep.replica_id)
+        if status == 503 and not retry_after:
+            retry_after = "1"
+        return (status, data, ep.replica_id, 0, resp_ctype, rid,
+                retry_after if status == 503 else None)
 
     # -- SLO / scaling -------------------------------------------------------
     def burn_rate(self) -> float:
@@ -705,6 +1033,7 @@ class QueryRouter:
     # -- monitoring surface --------------------------------------------------
     def status_payload(self) -> dict:
         qs = self.quantiles_ms()
+        el = self._election  # one read: the election thread swaps it
         return {
             "role": "router",
             "front": f"{self.host}:{self.port}",
@@ -721,6 +1050,13 @@ class QueryRouter:
             "e2e_ms": qs,
             "scale_out_events": self.scale_out_events,
             "scale_in_events": self.scale_in_events,
+            "fleet_epoch": self.fleet_epoch,
+            "write_primary": self._write_primary_id,
+            "promotions": self.promotions_total,
+            "failover_seconds": (
+                None if self.failover_seconds is None
+                else round(self.failover_seconds, 6)),
+            "election": dict(el) if el is not None else None,
         }
 
     def healthz_payload(self) -> tuple[bool, dict]:
@@ -755,7 +1091,19 @@ class QueryRouter:
             f"pathway_tpu_slo_target_ms {self.slo_ms}",
             "# TYPE pathway_tpu_slo_burn_rate gauge",
             f"pathway_tpu_slo_burn_rate {round(self.burn_rate(), 6)}",
+            # write-path failover: max fencing epoch seen fleet-wide and
+            # elections completed — a promotion shows as the epoch gauge
+            # stepping and the counter incrementing together
+            "# TYPE pathway_tpu_fleet_epoch gauge",
+            f"pathway_tpu_fleet_epoch {self.fleet_epoch}",
+            "# TYPE pathway_tpu_promotions_total counter",
+            f"pathway_tpu_promotions_total {self.promotions_total}",
         ]
+        if self.failover_seconds is not None:
+            # last primary-death → first-primary-heartbeat wall clock
+            lines.append("# TYPE pathway_tpu_failover_seconds gauge")
+            lines.append(f"pathway_tpu_failover_seconds "
+                         f"{round(self.failover_seconds, 6)}")
         if eps:
             lines.append("# TYPE pathway_tpu_router_requests counter")
             lines.append("# TYPE pathway_tpu_router_failures counter")
@@ -871,6 +1219,13 @@ class QueryRouter:
             "burn_rate": round(self.burn_rate(), 3),
             "e2e_ms": qs,
             "request_stages": self.request_log.stage_summary(),
+            "fleet_epoch": self.fleet_epoch,
+            "write_primary": self._write_primary_id,
+            "promotions": self.promotions_total,
+            "failover_seconds": (
+                None if self.failover_seconds is None
+                else round(self.failover_seconds, 6)),
+            "electing": self._election is not None,
             "fleet": fleet,
         }
 

@@ -194,6 +194,16 @@ class StreamingRuntime:
         for node, datasource in runner._stream_subjects:
             session = Session()
             self.sessions.append((node, session, datasource))
+            if getattr(datasource, "durable_ack", False) \
+                    and self.persistence is None and replica is None:
+                # a durable acknowledgement with no WAL to make it
+                # durable would hold every response forever — refuse the
+                # contradiction loudly instead of hanging clients
+                raise ValueError(
+                    "rest_connector(durable_ack=True) requires a "
+                    "persistence root (the acknowledgement IS the fsync "
+                    "of the request's WAL record) — configure "
+                    "persistence, or drop durable_ack")
         if self.replica is not None:
             # classify sources: WAL-backed feeds are tailed (no reader
             # thread), serving sources run live
@@ -223,6 +233,24 @@ class StreamingRuntime:
         # cumulative bridge exec_ms at the last QoS tick (delta = this
         # tick's resolved device time, the cost-model signal)
         self._qos_exec_ms_seen = 0.0
+        # write-path failover (engine/replica.py ControlClient): a
+        # ("promote", ...) control frame parks its payload here and the
+        # COMMIT LOOP executes the promotion synchronously between ticks
+        # — never the control thread, because promotion rewires the
+        # scheduler's feeding machinery, which only the loop may touch.
+        # Event, not a bare bool: set by the control thread, read by the
+        # loop (PWT201).
+        self._promote_event = threading.Event()
+        self._promote_payload: dict = {}
+        # session indexes the replica tails instead of reading live —
+        # exactly the sources a promotion must start readers for
+        self._tailed_sources: list[int] = []
+        self.promotions = 0  # completed promotions (→ /metrics)
+        self.failover_promotion_s: float | None = None
+        # the tick the promoted timeline ends at — rides every heartbeat
+        # so the router can re-anchor surviving replicas exactly there
+        # (pending ticks PAST it are the dead primary's torn commit)
+        self.promotion_tick: int | None = None
 
         # request-scoped serving tracing (engine/request_tracker.py):
         # sources that declare a request_tracker slot (rest_connector)
@@ -266,6 +294,86 @@ class StreamingRuntime:
         self.supervisor.request_stop()
         for _node, session, _ds in self.sessions:
             session.stopping.set()
+
+    def request_promotion(self, payload: dict | None) -> None:
+        """Control-thread entry: ask the commit loop to promote this
+        replica to primary. Idempotent — a duplicate frame, or one
+        delivered to a process that is already primary, is a no-op when
+        the loop picks it up."""
+        self._promote_payload = dict(payload or {})
+        self._promote_event.set()
+
+    def _execute_promotion(self, time_counter: int) -> int:
+        """Promote this replica to primary (commit-loop thread only).
+
+        The state machine: (1) **finish tailing** — pump until the WAL
+        yields nothing new for more quiet rounds than the tailer's
+        newest-tick hold-back, so every COMPLETE commit tick of the dead
+        primary is applied; (2) **fence** — bump the fencing epoch and
+        truncate the dead primary's incomplete final commit
+        (persistence.promote): from here a resumed zombie primary's next
+        write raises FencedPrimaryError; (3) **rewire** — the read-only
+        driver becomes this runtime's read-write persistence, and
+        connector readers start for every previously-tailed source with
+        the durable prefix marked already-covered
+        (attach_source(replay=False): the scheduler holds that state
+        from tailing); (4) **serve** — the role flips to primary and the
+        next heartbeat tells the router to send writes here. A crash
+        between (2) and (3) — the ``replica.promote.crash`` fault point
+        — leaves a bumped epoch and no primary: the router elects the
+        next candidate, whose own promote() bumps the epoch again
+        (``min_epoch`` keeps the sequence monotone)."""
+        self._promote_event.clear()
+        payload = self._promote_payload
+        if self.replica is None or self.role == "primary":
+            return time_counter  # duplicate/stale frame: no-op
+        import logging
+
+        t0 = _time.monotonic()
+        tailer = self.replica
+        # (1) drain the dead primary's WAL to its last complete tick
+        quiet = 0
+        while quiet < 5:
+            before = tailer.applied_tick
+            time_counter = tailer.pump(self, time_counter)
+            quiet = quiet + 1 if tailer.applied_tick == before else 0
+        complete_tick = tailer.applied_tick
+        # (2) fence: claim the next epoch (>= the router's announced
+        # one), flip the driver read-write, cut the torn tail
+        max_tick, epoch = tailer.driver.promote(
+            tailer.replica_id, complete_tick,
+            min_epoch=int(payload.get("epoch", 0)))
+        faults.hit("replica.promote.crash",
+                   epoch=epoch, complete_tick=complete_tick)
+        # (3) rewire: the tailer's driver IS the new persistence root
+        self.persistence = tailer.driver
+        self.monitor.persistence = self.persistence
+        for i in self._tailed_sources:
+            node, session, datasource = self.sessions[i]
+            proxy = self.persistence.attach_source(
+                datasource, session, replay=False)
+            self._drain_proxies[i] = proxy
+            self.supervisor.add_source(node, datasource, session, proxy)
+        self._tailed_sources = []
+        self.supervisor.start_all()  # only the newly-added entries start
+        # the tailer must never pump again — it would re-apply this
+        # process's OWN commits; its driver lives on as self.persistence
+        # (closed once, by teardown's persistence branch)
+        self.replica = None
+        # (4) serve
+        self.role = "primary"
+        if self.recorder is not None:
+            self.recorder.role = "primary"
+            self.recorder.note_promotion(epoch, complete_tick)
+        time_counter = max(time_counter, max_tick + 1)
+        self.promotions += 1
+        self.promotion_tick = complete_tick
+        self.failover_promotion_s = _time.monotonic() - t0
+        logging.getLogger(__name__).warning(
+            "promoted to primary at fencing epoch %d (complete tick %d, "
+            "max durable tick %d, %.3fs): accepting writes",
+            epoch, complete_tick, max_tick, self.failover_promotion_s)
+        return time_counter
 
     def join_readers(self, timeout: float = 5.0) -> None:
         """Join connector threads after stop(); they observe the session's
@@ -324,6 +432,19 @@ class StreamingRuntime:
         self.persistence.commit(
             tick, watermark=wm,
             inflight=bridge["depth"] if bridge is not None else 0)
+        self._flush_durable_acks(wm)
+
+    def _flush_durable_acks(self, watermark: int) -> None:
+        """Release buffered write acknowledgements for ticks the WAL now
+        covers (io/http rest_connector ``durable_ack=True``): commit()
+        returned, so entries sealed <= ``watermark`` are fsynced — an
+        acknowledgement released here survives SIGKILL (replayed on
+        restart, tailed by every replica). Runs on the commit-loop
+        thread, same as the subscribe callback that buffers."""
+        for _node, _session, ds in self.sessions:
+            release = getattr(ds, "on_commit_watermark", None)
+            if release is not None:
+                release(watermark)
 
     def _qos_tick_feedback(self, tick_ms: float) -> None:
         """Close the loop for one tick: feed the controller what the
@@ -583,7 +704,9 @@ class StreamingRuntime:
             if self.replica is not None and self.replica.is_tailed(i):
                 # tailed feed: rows arrive from the primary's WAL — the
                 # reader thread must never start (it would double-ingest,
-                # and the replica may not even reach the raw inputs)
+                # and the replica may not even reach the raw inputs).
+                # Remembered: a promotion starts exactly these readers.
+                self._tailed_sources.append(i)
                 continue
             if self.persistence is not None and reader_here:
                 # replay the durable prefix into `session`, then hand the
@@ -660,6 +783,10 @@ class StreamingRuntime:
             # (the PWT206 sleep-polling pattern this checker family bans)
             while not self._stop.wait(commit_s):
                 self.last_tick_at = _time.monotonic()
+                if self._promote_event.is_set():
+                    # router-requested failover: runs HERE, synchronously
+                    # between ticks, so it can never race a pump or drain
+                    time_counter = self._execute_promotion(time_counter)
                 # supervision tick: observe crashed/stalled readers, fire
                 # scheduled backoff restarts, escalate exhausted retries
                 if self.supervisor.poll() is not None:
@@ -758,6 +885,7 @@ class StreamingRuntime:
                         # tick above) — this full commit seals and
                         # persists everything, watermark == final tick
                         self.persistence.commit(time_counter)
+                        self._flush_durable_acks(time_counter)
                     break
             loop_clean = True
         except BaseException as e:  # noqa: BLE001 — escalation decides

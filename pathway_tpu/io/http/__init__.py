@@ -320,7 +320,7 @@ class RestSource(DataSource):
                  methods: tuple[str, ...], schema,
                  delete_completed_queries: bool,
                  autocommit_duration_ms=50, request_validator=None,
-                 format: str = "custom"):
+                 format: str = "custom", durable_ack: bool = False):
         super().__init__(schema, autocommit_duration_ms)
         self.webserver = webserver
         self.route = route
@@ -330,6 +330,17 @@ class RestSource(DataSource):
         self.request_validator = request_validator
         self.pending: dict[Pointer, tuple[asyncio.AbstractEventLoop,
                                           asyncio.Event, list]] = {}
+        # durable acknowledgement (write routes): a computed response is
+        # parked here by tick and released only after the commit
+        # watermark — i.e. the fsynced WAL — covers that tick, so an
+        # HTTP 200 means the write survives SIGKILL (replayed on
+        # restart, tailed by every replica). A durable-ack route is
+        # necessarily primary state, so replicas TAIL it instead of
+        # serving it live.
+        self.durable_ack = durable_ack
+        if durable_ack:
+            self.replica_serve_live = False  # instance shadows class
+        self._unacked: dict[int, list] = {}
         self._session: Session | None = None
         self._seq = 0
         from pathway_tpu.engine.locking import create_lock
@@ -429,6 +440,42 @@ class RestSource(DataSource):
         slot[0] = value
         loop.call_soon_threadsafe(event.set)
 
+    # -- durable acknowledgement (engine/streaming.py commit loop) ----------
+    def buffer_ack(self, time: int, key: Pointer, value: Any) -> None:
+        """``durable_ack`` mode: park a computed response until the WAL
+        covers its tick. Rows without a local waiter (a replica applying
+        the primary's tailed write stream computes responses too) are
+        dropped here — nothing to acknowledge, nothing to leak."""
+        if key not in self.pending:
+            return
+        self._unacked.setdefault(int(time), []).append((key, value))
+
+    def on_commit_watermark(self, watermark: int) -> None:
+        """Release every parked response whose tick the fsynced WAL now
+        covers. Called by the commit loop right after a successful
+        ``persistence.commit`` — the same thread that buffers, so the
+        dict needs no lock."""
+        if not self._unacked:
+            return
+        for t in sorted(t for t in self._unacked if t <= watermark):
+            for key, value in self._unacked.pop(t):
+                self.resolve(key, value)
+
+    # -- persistence resume protocol (engine/persistence.attach_source) -----
+    def seek(self, replayed: list) -> None:
+        # push-based source: the durable prefix replays from the WAL
+        # (or the promoted replica already tailed it) and every live
+        # HTTP request is NEW — there is nothing to re-emit, so nothing
+        # to position past. Without this, the prefix-skip fallback
+        # would silently drop the first len(replayed) live requests
+        # after a restart or a promotion.
+        return
+
+    def seek_snapshot(self, state: dict, replayed: list) -> None:
+        # same contract as seek(): the compacted prefix holds requests
+        # whose responses were delivered long ago; live traffic is new
+        return
+
 
 def rest_connector(host: str | None = None, port: int | None = None, *,
                    webserver: PathwayWebserver | None = None,
@@ -439,14 +486,26 @@ def rest_connector(host: str | None = None, port: int | None = None, *,
                    delete_completed_queries: bool = False,
                    request_validator=None,
                    format: str | None = None,
-                   documentation=None) -> tuple[Table, Any]:
+                   documentation=None,
+                   persistent_id: str | None = None,
+                   durable_ack: bool = False) -> tuple[Table, Any]:
     """Returns (query_table, response_writer). ``format="custom"``
     parses the JSON body and merges URL query params, 400-ing on missing
     required fields; ``format="raw"`` takes the whole request body as the
     ``query`` column. With no explicit format, a schemaless endpoint
     infers ``raw`` (a plain-text POST yields ``{'query': body}``) and a
     schema-ful one infers ``custom``
-    (reference: _server.py:50,525-535,733-736)."""
+    (reference: _server.py:50,525-535,733-736).
+
+    ``persistent_id`` records the route's rows in the WAL like any other
+    persisted source — required for write routes whose state must
+    survive restarts and be tailed by replicas. ``durable_ack`` holds
+    each HTTP response until the commit watermark covers the request's
+    tick: a 200 then *means* the write is fsynced in the WAL (replayed
+    on restart, promoted with the fleet — the failover zero-loss
+    guarantee quantifies over exactly these acknowledged writes). It
+    also marks the route as primary state, so replicas tail it instead
+    of serving it live."""
     if format is None:
         format = "raw" if schema is None else "custom"
     if format not in ("custom", "raw"):
@@ -464,7 +523,9 @@ def rest_connector(host: str | None = None, port: int | None = None, *,
                         delete_completed_queries,
                         autocommit_duration_ms=autocommit_duration_ms,
                         request_validator=request_validator,
-                        format=format)
+                        format=format, durable_ack=durable_ack)
+    if persistent_id is not None:
+        source.persistent_id = persistent_id
     table = Table(Plan("input", datasource=source), schema, Universe(),
                   name=f"rest:{route}")
 
@@ -481,7 +542,12 @@ def rest_connector(host: str | None = None, port: int | None = None, *,
                     else:
                         value = dict(zip(names, row))
                     value = _jsonable(value)
-                    source.resolve(key, value)
+                    if source.durable_ack:
+                        # parked until the WAL covers this tick; the
+                        # commit loop releases it (on_commit_watermark)
+                        source.buffer_ack(time, key, value)
+                    else:
+                        source.resolve(key, value)
 
             runner.subscribe(response_table, callback)
 

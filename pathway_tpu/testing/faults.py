@@ -37,6 +37,20 @@ named failpoints like the reference engine's test-only error hooks):
    must detect and truncate at, loudly, instead of feeding garbage to
    the unpickler).
 
+   Write-path failover boundaries (PR 18) cover the promotion window:
+   ``replica.promote.crash`` (engine/streaming.py ``_execute_promotion``
+   — fires after the fencing epoch is bumped but BEFORE connector
+   readers start, i.e. a candidate dying mid-promotion; the router must
+   elect the next survivor, whose own claim bumps the epoch again, and
+   zero acknowledged writes may be lost), ``persistence.epoch.claim``
+   (inside the fsynced epoch-manifest write — a torn manifest must
+   leave the previous epoch readable) and ``router.control.partition``
+   (engine/multiproc.py ``send_control_frame``/``recv_control_frame`` —
+   while armed, control frames are silently dropped in BOTH directions:
+   heartbeats vanish, promote commands are lost, and the router's
+   heartbeat-staleness detector, not socket EOF, has to drive the
+   election).
+
 2. **Faulty sources** — ``ConnectorSubject`` doubles with scripted crash
    schedules (:func:`flaky_subject` raises after the Nth entry on the
    first K attempts; :func:`hanging_subject` stops producing while

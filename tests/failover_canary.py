@@ -1,0 +1,298 @@
+"""Write-path failover canary: automatic replica promotion with epoch
+fencing, proven on a REAL multi-process fleet under closed-loop write
+load — not mocks.
+
+Drives ``bench._ReplicaFleet`` (engine/router.py + engine/replica.py +
+engine/persistence.py) in write mode: every member carries a durable-ack
+``/w`` route (a 200 means the row is fsynced in the primary root's WAL)
+feeding an idempotent key->max aggregate, and the router classifies
+``/w`` as a write path (primary-only, honest 503 + Retry-After during an
+election). Three scenarios, each a hard gate:
+
+1. **SIGKILL the primary under write load** — writer threads POST unique
+   keys through the router front door, retrying until acked; the primary
+   is SIGKILLed mid-stream. The router must elect the most-caught-up
+   replica, the replica must promote (finish tailing, fence, truncate
+   the torn tail, go read-write), and writes must resume. Gates: every
+   ACKED write is present in the surviving root's WAL (zero acked-write
+   loss), the recovered key->value aggregate is BYTE-IDENTICAL to an
+   unkilled oracle run's, >= 1 promotion was observed, and the failover
+   wall-clock is reported.
+2. **SIGSTOP/SIGCONT split-brain** — the primary is frozen (sockets
+   open, heartbeats silent): the staleness detector must declare it and
+   promote the replica. The resumed zombie's next commit must refuse
+   with ``FencedPrimaryError`` NAMING both epochs, and the root must
+   still load as a single timeline.
+3. **crash mid-promotion** — the elected candidate dies INSIDE the
+   promotion (``replica.promote.crash`` fault, rc 3, after the epoch
+   bump). The router must re-elect a survivor, which promotes with zero
+   acked-write loss.
+
+The scenarios' JSON is written as a CI artifact. Exits 0 iff all hold.
+Run: ``python tests/failover_canary.py``.
+Knobs: FAILOVER_WRITERS, FAILOVER_KEYS_PER_WRITER,
+FAILOVER_ELECTION_MS, FAILOVER_BENCH_ARTIFACT (JSON path).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+WRITERS = int(os.environ.get("FAILOVER_WRITERS", 4))
+KEYS_PER_WRITER = int(os.environ.get("FAILOVER_KEYS_PER_WRITER", 30))
+ELECTION_MS = int(os.environ.get("FAILOVER_ELECTION_MS", 1500))
+
+
+def _post(port: int, path: str, doc: dict, timeout: float = 60.0):
+    """One POST; returns (status, retry_after_or_None)."""
+    body = json.dumps(doc).encode()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        return resp.status, resp.getheader("Retry-After")
+    finally:
+        conn.close()
+
+
+def _write_until_acked(port: int, key: str, val: int,
+                       deadline: float) -> None:
+    """The client half of the durability contract: retry the SAME
+    idempotent write until a 200 — the ack, not the request, is the
+    moment the write exists. 503s carry an honest Retry-After (the
+    election window); connection errors are a dying primary."""
+    while time.monotonic() < deadline:
+        try:
+            status, retry_after = _post(port, "/w",
+                                        {"wkey": key, "wval": val})
+        except OSError:
+            time.sleep(0.2)
+            continue
+        if status == 200:
+            return
+        time.sleep(min(float(retry_after or 1), 3.0))
+    raise TimeoutError(f"write {key} never acked")
+
+
+def _scan_write_aggregate(root: str) -> dict[str, int]:
+    """Load the root's 'writes' WAL the way a hydrating replica would
+    (same scanner: torn tails and fenced-zombie epoch regressions are
+    truncated) and fold it into the program's key->max aggregate."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.persistence import PersistenceDriver
+
+    driver = PersistenceDriver(
+        pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(root)),
+        read_only=True)
+    agg: dict[str, int] = {}
+    for rec in driver._log_for("writes").read_all():
+        for entry in rec[1]:
+            row, diff = entry[1], entry[2]
+            if diff > 0:
+                k, v = str(row[0]), int(row[1])
+                agg[k] = max(agg.get(k, v), v)
+    return agg
+
+
+def _fleet(tmp: str):
+    import bench
+
+    return bench._ReplicaFleet(tmp, writes=True)
+
+
+def scenario_sigkill_primary(out: dict) -> None:
+    """SIGKILL under closed-loop write load; gate acked-write durability
+    and aggregate byte-identity across the promotion."""
+    tmp = tempfile.mkdtemp(prefix="failover_canary_")
+    fleet = _fleet(tmp)
+    acked: list[tuple[str, int]] = []
+    lock = threading.Lock()
+    try:
+        fleet.start_router(write_paths=("/w",),
+                           election_timeout_ms=ELECTION_MS)
+        fleet.start_primary(register=True, snapshot_ticks=0)
+        fleet.start_replica("r1")
+        fleet.start_replica("r2")
+
+        deadline = time.monotonic() + 300
+
+        def writer(w: int):
+            for j in range(KEYS_PER_WRITER):
+                key, val = f"c{w}_k{j}", 1000 * w + j
+                _write_until_acked(fleet.router.port, key, val, deadline)
+                with lock:
+                    acked.append((key, val))
+
+        threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+                   for w in range(WRITERS)]
+        for t in threads:
+            t.start()
+        # SIGKILL the primary once the stream is genuinely mid-flight
+        total = WRITERS * KEYS_PER_WRITER
+        while True:
+            with lock:
+                if len(acked) >= total // 4:
+                    break
+            time.sleep(0.02)
+        fleet.procs["primary"].kill()
+        killed_at = len(acked)
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), \
+            "writers wedged — writes never resumed after failover"
+
+        promoted = fleet.wait_promoted(1)
+        assert fleet.router.promotions_total >= 1
+        out["sigkill_promoted"] = promoted
+        out["sigkill_acked_total"] = len(acked)
+        out["sigkill_acked_before_kill"] = killed_at
+        out["sigkill_failover_s"] = (
+            None if fleet.router.failover_seconds is None
+            else round(fleet.router.failover_seconds, 3))
+        assert killed_at < len(acked), \
+            "no write was acked AFTER the kill — failover untested"
+    finally:
+        fleet.stop()
+
+    # durability gates, judged against the root itself (the processes
+    # are gone — only the WAL can testify)
+    recovered = _scan_write_aggregate(fleet.root)
+    lost = [(k, v) for k, v in acked if recovered.get(k) != v]
+    assert not lost, f"ACKED writes missing from the root: {lost[:10]}"
+    # oracle: the same client workload against an unkilled primary —
+    # the recovered aggregate must be byte-identical
+    otmp = tempfile.mkdtemp(prefix="failover_oracle_")
+    ofleet = _fleet(otmp)
+    try:
+        doc = ofleet.start_primary(snapshot_ticks=0)
+        odeadline = time.monotonic() + 300
+        for w in range(WRITERS):
+            for j in range(KEYS_PER_WRITER):
+                _write_until_acked(doc["port"], f"c{w}_k{j}",
+                                   1000 * w + j, odeadline)
+    finally:
+        ofleet.stop()
+    oracle = _scan_write_aggregate(ofleet.root)
+    assert json.dumps(recovered, sort_keys=True) == \
+        json.dumps(oracle, sort_keys=True), (
+            "recovered aggregate diverged from the unkilled oracle: "
+            f"only-recovered={sorted(set(recovered) - set(oracle))[:5]} "
+            f"only-oracle={sorted(set(oracle) - set(recovered))[:5]}")
+    out["sigkill_aggregate_keys"] = len(recovered)
+    print(f"[gate1] {len(acked)} acked writes ({killed_at} pre-kill), "
+          f"0 lost, aggregate byte-identical to oracle "
+          f"({len(recovered)} keys), promoted={out['sigkill_promoted']}, "
+          f"failover {out['sigkill_failover_s']}s")
+
+
+def scenario_split_brain(out: dict) -> None:
+    """SIGSTOP the primary; the staleness detector promotes the replica;
+    the SIGCONTed zombie must self-fence BY NAME and the root must stay
+    a single timeline."""
+    tmp = tempfile.mkdtemp(prefix="failover_zombie_")
+    fleet = _fleet(tmp)
+    try:
+        fleet.start_router(write_paths=("/w",),
+                           election_timeout_ms=ELECTION_MS)
+        fleet.start_primary(register=True, snapshot_ticks=0)
+        fleet.start_replica("r1")
+        deadline = time.monotonic() + 300
+        _write_until_acked(fleet.router.port, "pre_stop", 1, deadline)
+        fleet.sigstop("primary")
+        promoted = fleet.wait_promoted(1)
+        assert promoted == "r1", promoted
+        out["zombie_failover_s"] = (
+            None if fleet.router.failover_seconds is None
+            else round(fleet.router.failover_seconds, 3))
+        # the new primary accepts writes while the zombie is frozen
+        _write_until_acked(fleet.router.port, "post_promote", 2, deadline)
+        # wake the zombie: its next commit must refuse, naming epochs
+        fleet.sigcont("primary")
+        fence_deadline = time.monotonic() + 120
+        stderr = ""
+        while time.monotonic() < fence_deadline:
+            stderr = fleet.stderr_text("primary")
+            if "FencedPrimaryError" in stderr:
+                break
+            time.sleep(0.25)
+        assert "FencedPrimaryError" in stderr, \
+            f"zombie never self-fenced: {stderr[-800:]}"
+        assert "holds fencing epoch 0" in stderr \
+            and "root is at epoch 1" in stderr, (
+                "fencing refusal must NAME both epochs: "
+                f"{stderr[-800:]}")
+    finally:
+        fleet.stop()
+    # single-timeline gate: the root still loads through the standard
+    # scanner, and both acked writes survived the whole episode
+    agg = _scan_write_aggregate(fleet.root)
+    assert agg.get("pre_stop") == 1 and agg.get("post_promote") == 2, agg
+    print(f"[gate2] zombie fenced by name (epoch 0 vs 1), root loads as "
+          f"a single timeline, failover {out['zombie_failover_s']}s")
+
+
+def scenario_crash_mid_promotion(out: dict) -> None:
+    """The elected candidate dies inside the promotion (rc 3, post
+    epoch-bump): the election must stay open and a later-arriving
+    survivor must be elected and complete — zero acked writes lost."""
+    tmp = tempfile.mkdtemp(prefix="failover_crash_")
+    fleet = _fleet(tmp)
+    try:
+        fleet.start_router(write_paths=("/w",),
+                           election_timeout_ms=ELECTION_MS)
+        fleet.start_primary(register=True, snapshot_ticks=0)
+        fleet.start_replica("r1", promote_crash=True)
+        deadline = time.monotonic() + 300
+        _write_until_acked(fleet.router.port, "survives", 7, deadline)
+        fleet.procs["primary"].kill()
+        # r1 is elected, bumps the epoch, then dies INSIDE the promotion
+        crash_deadline = time.monotonic() + 120
+        while time.monotonic() < crash_deadline:
+            if fleet.procs["r1"].poll() is not None:
+                break
+            time.sleep(0.1)
+        assert fleet.procs["r1"].poll() == 3, \
+            f"candidate exit rc={fleet.procs['r1'].poll()}"
+        assert fleet.router.promotions_total == 0
+        # the survivor arrives late, catches up, and is elected
+        fleet.start_replica("r2")
+        promoted = fleet.wait_promoted(1)
+        assert promoted == "r2", promoted
+        _write_until_acked(fleet.router.port, "post_crash", 8, deadline)
+    finally:
+        fleet.stop()
+    agg = _scan_write_aggregate(fleet.root)
+    assert agg.get("survives") == 7 and agg.get("post_crash") == 8, agg
+    out["crash_promoted"] = "r2"
+    print("[gate3] crash-mid-promotion re-elected r2, zero acked writes "
+          "lost across BOTH deaths")
+
+
+def main() -> int:
+    out: dict = {}
+    scenario_sigkill_primary(out)
+    scenario_split_brain(out)
+    scenario_crash_mid_promotion(out)
+    artifact = os.environ.get("FAILOVER_BENCH_ARTIFACT")
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=1)
+    print(f"[failover-canary] all gates held: {json.dumps(out)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,494 @@
+"""Write-path high availability: epoch fencing, replica promotion and
+router election (engine/persistence.py, engine/streaming.py,
+engine/router.py).
+
+Covers the PR's pinned contracts:
+
+* **epoch fencing** — the root carries a monotone fencing epoch in an
+  fsynced manifest; a writer whose epoch the root moved past raises
+  ``FencedPrimaryError`` BY NAME (naming both epochs) before any byte
+  lands; a crash inside the epoch claim leaves the previous manifest
+  readable; WAL records stamp the writer's epoch and recovery truncates
+  at an epoch REGRESSION (a fenced zombie's write that raced the check);
+* **promotion** — ``PersistenceDriver.promote`` drops the dead primary's
+  torn final commit (records past the last complete tick), bumps the
+  epoch at least to the router's election hint, and never reuses a torn
+  tick number; runtime-level promotion is idempotent (a duplicate
+  promote frame is a no-op);
+* **router election** — write paths route to the primary only and 503
+  with an honest ``Retry-After`` during an election; primary death
+  (control EOF or heartbeat staleness) elects the most-caught-up
+  replica; a candidate dying mid-promotion re-elects the next survivor;
+  the first primary-role heartbeat completes the election and re-anchors
+  surviving replicas on the promoted timeline;
+* **control partition** — the ``router.control.partition`` fault point
+  silently drops frames in both directions (the staleness detector, not
+  EOF, must notice);
+* **durable acks** — ``rest_connector(durable_ack=True)`` parks each
+  response until the commit watermark covers its tick, drops waiterless
+  rows (a replica applying the tailed write stream), and refuses to run
+  without a persistence root.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.multiproc import (control_authkey, hmac_handshake,
+                                          recv_control_frame,
+                                          send_control_frame)
+from pathway_tpu.engine.persistence import (FencedPrimaryError,
+                                            PersistenceDriver, SnapshotLog,
+                                            record_epoch)
+from pathway_tpu.engine.router import QueryRouter
+from pathway_tpu.internals import dtype as dt  # noqa: F401 — schema idiom
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.http import PathwayWebserver, RestSource, rest_connector
+from pathway_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    G.clear()
+    faults.reset()
+    yield
+    G.clear()
+    faults.reset()
+    from pathway_tpu.engine import streaming as _streaming
+
+    _streaming.stop_all()
+
+
+def _fs_config(root):
+    return pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(str(root)))
+
+
+def _row(k):
+    return (f"k{k}", ("row",), 1, None)
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing (persistence)
+# ---------------------------------------------------------------------------
+
+def test_stale_writer_fenced_by_name(tmp_path):
+    """The split-brain gate in miniature: writer A holds epoch 0, a
+    promotion claims epoch 1 on the same root, and A's next commit
+    raises FencedPrimaryError naming BOTH epochs — before appending."""
+    a = PersistenceDriver(_fs_config(tmp_path))
+    log = a._log_for("src")
+    log.append(1, [_row(1)])
+    log.close()
+    assert a.fencing_epoch == 0
+
+    b = PersistenceDriver(_fs_config(tmp_path))
+    assert b.claim_epoch("rescuer") == 1
+    with pytest.raises(FencedPrimaryError) as ei:
+        a.commit(2)
+    assert ei.value.held_epoch == 0 and ei.value.root_epoch == 1
+    assert "epoch 0" in str(ei.value) and "epoch 1" in str(ei.value)
+    assert a.fenced_writes == 1
+    with pytest.raises(FencedPrimaryError):
+        a.write_snapshot(2, {"nodes": {}})
+    assert a.fenced_writes == 2
+    # the WAL is untouched by the fenced attempts
+    assert [t for t, _ in a._log_for("src").read_all()] == [1]
+    # the NEW holder commits freely
+    b.commit(2)
+    assert b.fenced_writes == 0
+
+
+def test_epoch_adopted_at_open_and_env_override(tmp_path, monkeypatch):
+    """A writable driver ADOPTS the root's existing epoch at open (a
+    restart of the promoted primary is not a zombie), and
+    PATHWAY_FLEET_EPOCH_PATH relocates the manifest."""
+    d1 = PersistenceDriver(_fs_config(tmp_path))
+    d1.claim_epoch("p1")
+    d1.claim_epoch("p1")
+    d2 = PersistenceDriver(_fs_config(tmp_path))
+    assert d2.fencing_epoch == 2
+    d2.commit(1)  # adopted epoch: not fenced
+    # manifest override: a fresh root reads epoch 0 until the override
+    # path carries one, then every driver on that root sees it
+    alt = tmp_path / "elsewhere" / "fleet-epoch.json"
+    alt.parent.mkdir()
+    monkeypatch.setenv("PATHWAY_FLEET_EPOCH_PATH", str(alt))
+    d3 = PersistenceDriver(_fs_config(tmp_path / "other-root"))
+    assert d3.epoch_path() == str(alt)
+    assert d3.fencing_epoch == 0
+    assert d3.claim_epoch("p3", min_epoch=7) == 7
+    assert alt.exists()
+    assert json.loads(alt.read_text())["holder"] == "p3"
+
+
+def test_epoch_claim_crash_leaves_previous_manifest(tmp_path):
+    """A candidate dying INSIDE claim_epoch (fault points
+    ``persistence.epoch.claim`` and ``persistence.atomic.replace``)
+    leaves the previous epoch manifest intact and readable — a torn
+    claim never bricks or regresses the root."""
+    d = PersistenceDriver(_fs_config(tmp_path))
+    d.claim_epoch("p")
+    assert d.read_epoch() == 1
+    for point in ("persistence.epoch.claim", "persistence.atomic.replace"):
+        with faults.arm(point, faults.FailNTimes(1)):
+            with pytest.raises(faults.InjectedFault):
+                d.claim_epoch("crasher")
+        assert d.read_epoch() == 1, point
+        # the driver did not adopt the unclaimed epoch either
+        assert d.fencing_epoch == 1, point
+        d.commit(1)  # still the holder: not fenced
+    # the next (healthy) claim proceeds from the surviving manifest
+    assert d.claim_epoch("rescuer") == 2
+
+
+def test_wal_stamps_epoch_and_truncates_regression(tmp_path):
+    """Records carry the writer's fencing epoch (only when nonzero —
+    pre-failover logs stay byte-identical) and recovery truncates at an
+    epoch REGRESSION: a fenced zombie's append that raced the check must
+    not splice a second timeline behind the promoted primary's."""
+    path = str(tmp_path / "s.snap")
+    log = SnapshotLog(path)
+    log.append(1, [_row(1)])               # epoch 0: legacy 2-tuple
+    log.append(2, [_row(2)], epoch=3)
+    log.append(3, [_row(3)], epoch=3)
+    log.close()
+    recs = SnapshotLog(path).read_all()
+    assert [record_epoch(r) for r in recs] == [0, 3, 3]
+    # a zombie (epoch 1 < 3) appends after the promoted primary: the
+    # scan truncates at the regression, keeping the single timeline
+    zombie = SnapshotLog(path)
+    zombie.append(4, [_row(4)], epoch=1)
+    zombie.append(5, [_row(5)], epoch=3)   # even later good data is cut
+    zombie.close()
+    recs = SnapshotLog(path).read_all()
+    assert [r[0] for r in recs] == [1, 2, 3]
+
+
+def test_promote_drops_torn_suffix_and_bumps_epoch(tmp_path):
+    """Driver-level promotion: the dead primary's final commit landed in
+    log A but not log B (death mid-commit). Promotion at the last
+    COMPLETE tick truncates the torn suffix from every log, claims at
+    least the router's epoch hint, and returns the pre-cut max tick so
+    the torn tick number is never reused."""
+    p = PersistenceDriver(_fs_config(tmp_path))
+    la, lb = p._log_for("a"), p._log_for("b")
+    for t in (1, 2, 3):
+        la.append(t, [_row(t)])
+        lb.append(t, [_row(t)])
+    la.append(4, [_row(4)])  # the torn tick: present in a, absent in b
+    la.close()
+    lb.close()
+
+    r = PersistenceDriver(_fs_config(tmp_path), read_only=True)
+    max_tick, epoch = r.promote("r1", complete_tick=3, min_epoch=5)
+    assert (max_tick, epoch) == (4, 5)
+    assert not r.read_only and r.fencing_epoch == 5
+    assert [t for t, _ in r._log_for("a").read_all()] == [1, 2, 3]
+    assert [t for t, _ in r._log_for("b").read_all()] == [1, 2, 3]
+    # the fenced ex-primary can no longer write
+    with pytest.raises(FencedPrimaryError):
+        p.commit(5)
+    # the root stays loadable as ONE timeline for the next hydration
+    fresh = PersistenceDriver(_fs_config(tmp_path), read_only=True)
+    assert fresh.restore_time() == 3
+
+
+# ---------------------------------------------------------------------------
+# control-plane partition fault
+# ---------------------------------------------------------------------------
+
+def test_control_partition_drops_frames_both_directions():
+    a, b = socket.socketpair()
+    try:
+        with faults.arm("router.control.partition", faults.FailNTimes(2)):
+            # send direction: the frame is dropped on the floor (0 bytes)
+            assert send_control_frame(a, "hb", {"n": 1}) == 0
+            # recv direction: the frame crosses the wire but the reader
+            # discards it and keeps waiting for the NEXT one
+            faults.reset()
+            send_control_frame(a, "hb", {"n": 2})
+            send_control_frame(a, "hb", {"n": 3})
+            faults.arm_point("router.control.partition",
+                             faults.FailNTimes(1))
+            tag, payload = recv_control_frame(b)
+        assert (tag, payload["n"]) == ("hb", 3)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# router election (socket-level, no real replicas)
+# ---------------------------------------------------------------------------
+
+class _FakeServingHTTP:
+    """Minimal serving stand-in answering every POST with its name."""
+
+    def __init__(self, name: str):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                body = json.dumps({"served_by": outer.name}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.name = name
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _join(router, rid, port, *, role="replica", applied_tick=7,
+          fleet_epoch=0) -> socket.socket:
+    """Speak the real control protocol: handshake, hello, one heartbeat;
+    wait until the router registered the endpoint."""
+    sock = socket.create_connection(("127.0.0.1", router.control_port),
+                                    timeout=5)
+    hmac_handshake(sock, control_authkey(), time.monotonic() + 5)
+    sock.settimeout(None)
+    send_control_frame(sock, "hello", {"replica": rid, "role": role,
+                                       "host": "127.0.0.1", "port": port})
+    send_control_frame(sock, "hb", {"replica": rid, "role": role,
+                                    "applied_tick": applied_tick,
+                                    "primary_watermark": applied_tick,
+                                    "staleness_ticks": 0,
+                                    "fleet_epoch": fleet_epoch})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        eps = {e.replica_id: e for e in router.endpoints()}
+        if rid in eps and eps[rid].applied_tick == applied_tick:
+            return sock
+        time.sleep(0.02)
+    raise TimeoutError(f"router never registered {rid}")
+
+
+def _post(port, path, body=b"{}", timeout=15):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _wait(pred, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"{what} never held")
+
+
+def test_router_election_write_503_promote_and_reanchor(monkeypatch):
+    """The full orchestration arc at socket level: writes route to the
+    primary only; its death elects the most-caught-up replica (promote
+    frame with a strictly-higher epoch); the election window 503s writes
+    with an honest Retry-After; the candidate's first primary-role
+    heartbeat completes the election, re-anchors the OTHER replica on
+    the promotion tick, and restores the write path — all pinned on the
+    router's /metrics and /status surfaces."""
+    monkeypatch.setenv("PATHWAY_ROUTER_ELECTION_TIMEOUT_MS", "60000")
+    router = QueryRouter(write_paths=("/w",))
+    router.start()
+    primary_http = _FakeServingHTTP("p0")
+    rescue_http = _FakeServingHTTP("r2")
+    socks = []
+    try:
+        socks.append(_join(router, "p0", primary_http.port,
+                           role="primary", applied_tick=9))
+        r1_sock = _join(router, "r1", 1, applied_tick=5)
+        r2_sock = _join(router, "r2", rescue_http.port, applied_tick=9)
+        socks += [r1_sock, r2_sock]
+        assert router.is_write_path("/w?x=1")
+        assert not router.is_write_path("/q")
+        # healthy write path: primary serves, reads go to replicas
+        status, body, _h = _post(router.port, "/w")
+        assert (status, body["served_by"]) == (200, "p0")
+        assert router._write_primary_id == "p0"
+
+        # primary dies (control EOF): election opens, the promote frame
+        # goes to the most-caught-up replica (r2, tick 9 > r1's 5) with
+        # an epoch strictly above everything the fleet reported
+        socks[0].close()
+        tag, payload = recv_control_frame(r2_sock)
+        assert tag == "promote"
+        assert payload["epoch"] == 1 and payload["dead"] == "p0"
+        assert router._election is not None
+
+        # the election window: writes 503 with an honest Retry-After,
+        # reads keep flowing over the surviving replicas
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/w")
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert "elect" in ei.value.read().decode()
+        status, body, _h = _post(router.port, "/q")
+        assert status == 200
+
+        # the promoted candidate heartbeats role=primary: election done
+        send_control_frame(r2_sock, "hb", {
+            "replica": "r2", "role": "primary", "applied_tick": 9,
+            "primary_watermark": 9, "fleet_epoch": 1,
+            "promotion_tick": 9})
+        _wait(lambda: router.promotions_total == 1, what="election end")
+        assert router._election is None
+        assert router._write_primary_id == "r2"
+        assert router.fleet_epoch == 1
+        assert router.failover_seconds is not None
+        # the surviving replica is re-anchored on the promoted timeline
+        tag, payload = recv_control_frame(r1_sock)
+        assert (tag, payload) == ("reanchor", {"epoch": 1, "tick": 9})
+        # the write path is back, served by the NEW primary
+        status, body, _h = _post(router.port, "/w")
+        assert (status, body["served_by"]) == (200, "r2")
+
+        # observability pins: the failover metric family trio + status
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/metrics",
+            timeout=10).read().decode()
+        assert "pathway_tpu_fleet_epoch 1" in metrics
+        assert "pathway_tpu_promotions_total 1" in metrics
+        assert "pathway_tpu_failover_seconds" in metrics
+        status_doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/status", timeout=10).read())
+        assert status_doc["write_primary"] == "r2"
+        assert status_doc["promotions"] == 1
+        assert status_doc["election"] is None
+        fleet_doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/fleet/status",
+            timeout=10).read())
+        assert fleet_doc["fleet_epoch"] == 1
+        assert fleet_doc["electing"] is False
+    finally:
+        for s in socks[1:]:
+            s.close()
+        primary_http.stop()
+        rescue_http.stop()
+        router.stop()
+
+
+def test_router_reelects_when_candidate_dies(monkeypatch):
+    """Crash-mid-promotion: the elected candidate dies before its first
+    primary heartbeat — the election babysitter elects the next survivor
+    (same election, same epoch floor)."""
+    monkeypatch.setenv("PATHWAY_ROUTER_ELECTION_TIMEOUT_MS", "300")
+    router = QueryRouter(write_paths=("/w",))
+    router.start()
+    socks = []
+    try:
+        p_sock = _join(router, "p0", 1, role="primary", applied_tick=9)
+        r1_sock = _join(router, "r1", 1, applied_tick=9)
+        r2_sock = _join(router, "r2", 1, applied_tick=3)
+        socks += [r1_sock, r2_sock]
+        p_sock.close()
+        tag, payload = recv_control_frame(r1_sock)
+        assert tag == "promote" and payload["epoch"] == 1
+        # the candidate crashes mid-promotion (control EOF, never
+        # heartbeated as primary): the next survivor gets the frame
+        r1_sock.close()
+        r2_sock.settimeout(10)
+        tag, payload = recv_control_frame(r2_sock)
+        assert tag == "promote" and payload["epoch"] == 1
+        # the frame hits the socket before _elect records the target
+        _wait(lambda: (router._election or {}).get("target") == "r2",
+              what="election target switch to r2")
+    finally:
+        for s in socks[1:]:
+            try:
+                s.close()
+            except OSError:
+                pass
+        router.stop()
+
+
+def test_router_staleness_declares_silent_primary_dead(monkeypatch):
+    """A SIGSTOPped/partitioned primary keeps its socket open but goes
+    silent: the heartbeat-staleness detector (not EOF) must open the
+    election. With no candidates the election stays open and writes 503
+    honestly."""
+    monkeypatch.setenv("PATHWAY_ROUTER_ELECTION_TIMEOUT_MS", "250")
+    router = QueryRouter(write_paths=("/w",))
+    router.start()
+    try:
+        p_sock = _join(router, "p0", 1, role="primary", applied_tick=9)
+        assert router._write_primary_id == "p0"
+        # the zombie goes silent (no heartbeats, socket alive)
+        _wait(lambda: router._election is not None, timeout=15,
+              what="staleness death declaration")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.port, "/w")
+        assert ei.value.code == 503
+        assert "Retry-After" in ei.value.headers
+        p_sock.close()
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# durable acknowledgements (io/http)
+# ---------------------------------------------------------------------------
+
+def test_rest_source_durable_ack_released_by_watermark():
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+    src = RestSource(ws, "/w", ("POST",),
+                     sch.schema_from_types(a=int),
+                     delete_completed_queries=False, durable_ack=True)
+    # a durable-ack route is primary state: replicas must TAIL it
+    assert src.replica_serve_live is False
+    loop = asyncio.new_event_loop()
+    try:
+        key = Pointer(1)
+        event = asyncio.Event()
+        slot: list = [None]
+        src.pending[key] = (loop, event, slot)
+        src.buffer_ack(3, key, {"ok": 1})
+        # waiterless row: a REPLICA applying the primary's tailed write
+        # stream computes responses too — dropped, never leaked
+        src.buffer_ack(3, Pointer(2), {"ok": 2})
+        assert [len(v) for v in src._unacked.values()] == [1]
+        src.on_commit_watermark(2)  # WAL does not cover tick 3 yet
+        assert slot[0] is None and key in src.pending
+        src.on_commit_watermark(3)  # durable: the ack is released
+        assert slot[0] == {"ok": 1}
+        assert key not in src.pending and not src._unacked
+    finally:
+        loop.close()
+
+
+def test_durable_ack_requires_persistence_root():
+    """A 200 from a durable-ack route PROMISES the write is fsynced;
+    without a WAL the promise is a lie — refused at runtime init."""
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+    table, writer = rest_connector(
+        webserver=ws, route="/w",
+        schema=sch.schema_from_types(a=int), methods=("POST",),
+        persistent_id="writes", durable_ack=True)
+    writer(table.select(ok=table.a))
+    with pytest.raises(ValueError, match="durable_ack"):
+        pw.run()

@@ -495,6 +495,56 @@ def test_primary_role_default_on_status_and_healthz():
     assert hz["role"] == "primary" and hz["staleness_ticks"] == 0
 
 
+def test_failover_families_exposition_and_status():
+    """Failover families (PR 18): the fencing epoch gauge + fenced-write
+    counter ride the persistence block; the promotion counter and
+    failover wall-clock appear once this process has promoted — all
+    through the exposition lint, and mirrored on /status."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.http_server import MonitoringHttpServer
+    from pathway_tpu.engine.persistence import (FencedPrimaryError,
+                                                PersistenceDriver)
+
+    backend = pw.persistence.Backend.mock()
+    cfg = pw.persistence.Config.simple_config(backend)
+    promoted = PersistenceDriver(cfg)
+    zombie = PersistenceDriver(cfg)
+    assert promoted.claim_epoch("rescuer", min_epoch=3) == 3
+    with pytest.raises(FencedPrimaryError):
+        zombie.commit(1)
+
+    # the promoted runtime: epoch gauge + promotion counter + wall-clock
+    rt = _FakeRuntime()
+    rt.persistence = promoted
+    rt.role = "primary"
+    rt.promotions = 1
+    rt.promotion_tick = 9
+    rt.failover_promotion_s = 1.25
+    lines = _metrics_lines(rt)
+    samples = {f: v for f, _l, v in _parse_samples(lines)}
+    assert samples["pathway_tpu_fleet_epoch"] == 3
+    assert samples["pathway_tpu_fenced_writes_total"] == 0
+    assert samples["pathway_tpu_promotions_total"] == 1
+    assert samples["pathway_tpu_failover_seconds"] == 1.25
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    for fam in ("pathway_tpu_fleet_epoch", "pathway_tpu_fenced_writes_total",
+                "pathway_tpu_promotions_total",
+                "pathway_tpu_failover_seconds"):
+        assert fam in typed, fam
+    status = MonitoringHttpServer(rt, port=0).status_payload()
+    assert status["promotions"] == 1
+    assert status["promotion_tick"] == 9
+    assert status["failover_promotion_s"] == 1.25
+
+    # the fenced zombie: its counter is the split-brain smoking gun
+    zrt = _FakeRuntime()
+    zrt.persistence = zombie
+    zsamples = {f: v for f, _l, v
+                in _parse_samples(_metrics_lines(zrt))}
+    assert zsamples["pathway_tpu_fenced_writes_total"] == 1
+    assert "pathway_tpu_promotions_total" not in zsamples  # never promoted
+
+
 def test_router_metrics_through_exposition_lint():
     """The router's /metrics body obeys the same exposition contract:
     every sample parses, every family is TYPE-declared, per-replica

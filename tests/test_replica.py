@@ -436,6 +436,57 @@ def test_hydration_equivalence_corrupt_newest_generation(
     assert st["entries_applied"] >= 8, "fallback must replay the suffix"
 
 
+def test_promotion_hydration_equivalence_and_idempotence(
+        tmp_path, monkeypatch):
+    """The failover tentpole, single-process: a replica of a dead
+    primary's root is PROMOTED in place — it finishes tailing, fences
+    the root at the election epoch, flips to role=primary with a
+    writable driver, and answers byte-identically to the primary it
+    replaced. A duplicate promote frame is a no-op (the router may
+    re-send after a control partition)."""
+    primary = _run_primary(tmp_path, 48, _QVECS, monkeypatch,
+                           snapshot_ticks=0)
+    G.clear()
+    ws = PathwayWebserver(host="127.0.0.1", port=0)
+    _build_knn_app(48, ws)
+    th, errs = _run_bg(replica_of=str(tmp_path))
+    rt = _wait_runtime(ws, errs, replica=True)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = rt.replica.stats()
+        if st["applied_tick"] == st["primary_watermark"] \
+                and st["entries_applied"] >= 48:
+            break
+        time.sleep(0.05)
+    assert rt.role == "replica"
+
+    rt.request_promotion({"epoch": 1, "dead": "p0"})
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and rt.role != "primary":
+        assert not errs, errs
+        time.sleep(0.05)
+    assert rt.role == "primary"
+    assert rt.promotions == 1
+    assert rt.promotion_tick is not None
+    assert rt.failover_promotion_s is not None
+    # the promoted runtime owns a WRITABLE driver at the claimed epoch
+    assert rt.persistence is not None and not rt.persistence.read_only
+    assert rt.persistence.fencing_epoch >= 1
+    # byte-identical serving across the promotion
+    promoted = [_ask(ws.port, q) for q in _QVECS]
+    assert promoted == primary
+    # duplicate promote frame: absorbed without a second epoch bump
+    rt.request_promotion({"epoch": 2, "dead": "p0"})
+    time.sleep(0.5)
+    assert not errs, errs
+    assert rt.promotions == 1
+    assert rt.persistence.fencing_epoch == 1
+    assert [_ask(ws.port, q) for q in _QVECS] == primary
+    _streaming.stop_all()
+    th.join(timeout=30)
+    assert not th.is_alive() and not errs, errs
+
+
 def test_replica_live_tail_staleness_and_surfaces(tmp_path, monkeypatch):
     """A replica trailing a RUNNING primary: applied tick advances while
     the primary ingests, converges to staleness 0, and the role /
