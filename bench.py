@@ -164,6 +164,25 @@ QOS_BURST = int(os.environ.get("BENCH_QOS_BURST", 32))
 QOS_K = int(os.environ.get("BENCH_QOS_K", 10))
 QOS_COMMIT_MS = int(os.environ.get("BENCH_QOS_COMMIT_MS", 5))
 
+# Semantic result-cache leg (bench_semantic_cache): the SAME router-
+# fronted serving fleet under a Zipf query stream with live ingest,
+# cache-off vs cache-on (operator cache + router fleet cache). The
+# Zipf head repeats, so the leg measures what the cache is FOR:
+# identical (method, path, body) requests served at the router without
+# touching a replica, and repeated query vectors served from the
+# operator cache without a kernel dispatch.
+SEM_POOL = int(os.environ.get("BENCH_SEM_POOL", 96))
+SEM_ZIPF_S = float(os.environ.get("BENCH_SEM_ZIPF_S", 1.1))
+SEM_SECONDS = float(os.environ.get("BENCH_SEM_SECONDS", 10.0))
+SEM_WARMUP_S = float(os.environ.get("BENCH_SEM_WARMUP_S", 1.5))
+SEM_CLIENTS = int(os.environ.get("BENCH_SEM_CLIENTS", 8))
+SEM_COST_MS = float(os.environ.get("BENCH_SEM_COST_MS", 30.0))
+SEM_VECS = int(os.environ.get("BENCH_SEM_VECS", 512))
+# live-ingest cadence for BOTH phases: slow enough that the watermark
+# holds across a forward (so router fills commit), fast enough that
+# invalidations/tick stays a live number in the snapshot
+SEM_TRICKLE_S = float(os.environ.get("BENCH_SEM_TRICKLE_S", 4.0))
+
 # evidence rule (ROADMAP): the parent checkpoints every successful
 # device-leg snapshot into BENCH_LASTGOOD.json the moment the child
 # prints it, so a later hang / SIGKILL cannot erase captured numbers
@@ -258,6 +277,17 @@ _BENCH_DIRECTIONS = {
     # noticing its demotion
     "replica_failover_promotion_s": "lower",
     "replica_fenced_writes": "lower",
+    # semantic result-cache leg: the speedup and both hit rates are the
+    # headline (higher is better); router invalidations are watermark
+    # moves observed by the cache — a climb means the fleet cache is
+    # churning instead of serving. The `lost` counters are plain counts
+    # with no unit marker: any rise is dropped queries.
+    "semantic_cache_qps_speedup": "higher",
+    "semantic_cache_router_hit_rate": "higher",
+    "semantic_cache_op_hit_ratio": "higher",
+    "semantic_cache_router_invalidations": "lower",
+    "semantic_cache_off_lost": "lower",
+    "semantic_cache_on_lost": "lower",
 }
 
 
@@ -453,24 +483,35 @@ def _run_device_legs_child() -> None:
 
 def _probe_backend() -> str | None:
     """Return None when the device backend answers, else an error string.
-    Spaced retries: a tunnel that's unhealthy at one instant often
-    recovers within minutes — round 4 lost its whole TPU record to a
-    single unhealthy window."""
+
+    Retries are spread across the FULL device deadline window
+    (``DEVICE_DEADLINE_S``), not a fixed try count: a tunnel that's
+    unhealthy at one instant often recovers within minutes — round 4
+    lost its whole TPU record to a single unhealthy window, and a
+    fixed 4-try schedule still gave up after ~4 probe-timeouts while
+    the deadline had most of its budget left. Delays grow 10s → 5min
+    (capped) so a quick flap retries fast but a long outage doesn't
+    burn the window on busy-waiting. ``BENCH_PROBE_TRIES`` survives as
+    an optional hard cap for CI smoke runs."""
     import subprocess
     import sys
 
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 240.0))
-    tries = int(os.environ.get("BENCH_PROBE_TRIES", 4))
-    base = (10.0, 45.0)
-    delays = tuple(base[i] if i < len(base) else 90.0
-                   for i in range(max(0, tries - 1)))
+    max_tries = int(os.environ.get("BENCH_PROBE_TRIES", 0))  # 0: window
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_PROBE_WINDOW", DEVICE_DEADLINE_S))
     probe_err = None
-    for attempt in range(len(delays) + 1):
+    delay = 10.0
+    attempt = 0
+    while True:
+        attempt += 1
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; print(len(jax.devices()))"],
-                capture_output=True, text=True, timeout=probe_timeout)
+                capture_output=True, text=True,
+                timeout=min(probe_timeout,
+                            max(10.0, deadline - time.monotonic())))
             if probe.returncode == 0:
                 return None
             tail = probe.stderr.strip().splitlines()
@@ -479,8 +520,13 @@ def _probe_backend() -> str | None:
         except subprocess.TimeoutExpired:
             probe_err = (f"backend probe hung past {probe_timeout:.0f}s "
                          "(device tunnel unhealthy)")
-        if attempt < len(delays):
-            time.sleep(delays[attempt])
+        if max_tries and attempt >= max_tries:
+            break
+        remaining = deadline - time.monotonic()
+        if remaining <= 10.0:  # not enough window left for another try
+            break
+        time.sleep(min(delay, remaining))
+        delay = min(delay * 2.0, 300.0)
     return probe_err[:400]
 
 
@@ -721,6 +767,21 @@ def main() -> None:
                              if k.startswith("qos_")})
         except Exception as e:  # noqa: BLE001
             errors["qos_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
+    if "semantic_cache" not in SKIP:
+        # semantic result-cache leg (CPU-runnable): the same Zipf query
+        # stream through the router cache-off vs cache-on — served QPS,
+        # p95, hit rates at both layers, invalidations/tick under live
+        # ingest (engine/result_cache.py)
+        try:
+            leg_out = bench_semantic_cache()
+            result.update(leg_out)
+            _append_bench_history("semantic_cache", leg_out)
+            _write_lastgood({k: v for k, v in leg_out.items()
+                             if k.startswith("semantic_cache_")})
+        except Exception as e:  # noqa: BLE001
+            errors["semantic_cache_error"] = \
+                f"{type(e).__name__}: {str(e)[:300]}"
 
     # sidecar path for the device-phase flight beacon, inherited by the
     # child processes; every emit below reads it, so the last surviving
@@ -1533,6 +1594,159 @@ def bench_qos() -> dict:
         out["qos_ingest_trade_ratio"] = round(
             out["qos_on_ingest_rate_rps"]
             / max(out["qos_off_ingest_rate_rps"], 1e-9), 3)
+    return out
+
+
+def _semantic_cache_phase(cache_on: bool) -> dict:
+    """One phase of the semantic-cache before/after: a router-fronted
+    single-member fleet (the _ReplicaFleet harness) under a Zipf query
+    stream with the member's trickle ingest live. Cache-on enables BOTH
+    layers — the operator cache in the serving process
+    (PATHWAY_RESULT_CACHE) and the router's fleet cache on the query
+    route (PATHWAY_ROUTER_CACHE_ROUTES) — because that is the shipped
+    configuration; the router layer serves repeated bodies without
+    touching the member, the operator layer serves repeated vectors
+    without a kernel dispatch."""
+    import http.client
+    import tempfile
+    import threading as _threading
+
+    tag = "on" if cache_on else "off"
+    prior = {k: os.environ.get(k)
+             for k in ("PATHWAY_RESULT_CACHE",
+                       "PATHWAY_ROUTER_CACHE_ROUTES")}
+    os.environ["PATHWAY_RESULT_CACHE"] = "1" if cache_on else "0"
+    if cache_on:
+        os.environ["PATHWAY_ROUTER_CACHE_ROUTES"] = "/q"
+    else:
+        os.environ.pop("PATHWAY_ROUTER_CACHE_ROUTES", None)
+    out: dict = {}
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            fleet = _ReplicaFleet(tmp, vecs=SEM_VECS,
+                                  query_cost_ms=SEM_COST_MS)
+            fleet.base_env["PATHWAY_RESULT_CACHE"] = \
+                os.environ["PATHWAY_RESULT_CACHE"]
+            fleet.base_env["REPLICA_BENCH_TRICKLE_S"] = str(SEM_TRICKLE_S)
+            try:
+                fleet.start_router()
+                fleet.start_primary(register=True)
+                ep = None
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline and ep is None:
+                    eps = [e for e in fleet.router.endpoints() if e.port]
+                    ep = eps[0] if eps else None
+                    time.sleep(0.05)
+                assert ep is not None, "primary never registered"
+                fleet._warm(ep)
+                if cache_on:
+                    # the watermark needs a version-carrying heartbeat
+                    # before the router can serve (or fill) a single hit
+                    deadline = time.monotonic() + 30.0
+                    while time.monotonic() < deadline \
+                            and fleet.router._fleet_watermark() is None:
+                        time.sleep(0.05)
+                    assert fleet.router._fleet_watermark() is not None, \
+                        "index-version watermark never went live"
+                # pre-encoded Zipf pool: identical bodies byte-for-byte,
+                # which is exactly what the router cache keys on
+                rng = np.random.default_rng(23)
+                pool = rng.random((SEM_POOL, 16), np.float32) * 2 - 1
+                bodies = [json.dumps({"vec": [float(x) for x in v],
+                                      "k": 3}).encode() for v in pool]
+                samples: list[tuple[float, float, bool]] = []
+                lock = _threading.Lock()
+                stop_at = time.monotonic() + SEM_WARMUP_S + SEM_SECONDS
+
+                def client(seed: int):
+                    crng = np.random.default_rng(1000 + seed)
+                    while time.monotonic() < stop_at:
+                        body = bodies[min(int(crng.zipf(SEM_ZIPF_S)) - 1,
+                                          SEM_POOL - 1)]
+                        t0 = time.monotonic()
+                        ok = False
+                        try:
+                            conn = http.client.HTTPConnection(
+                                "127.0.0.1", fleet.router.port,
+                                timeout=60)
+                            try:
+                                conn.request(
+                                    "POST", "/q", body=body,
+                                    headers={"Content-Type":
+                                             "application/json"})
+                                resp = conn.getresponse()
+                                resp.read()
+                                ok = resp.status == 200
+                            finally:
+                                conn.close()
+                        except OSError:
+                            ok = False
+                        with lock:
+                            samples.append(
+                                (t0, (time.monotonic() - t0) * 1e3, ok))
+
+                threads = [_threading.Thread(target=client, args=(i,),
+                                             daemon=True)
+                           for i in range(SEM_CLIENTS)]
+                t_start = time.monotonic()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=SEM_WARMUP_S + SEM_SECONDS + 120)
+                cut = t_start + SEM_WARMUP_S
+                timed = [(t0, ms, ok) for t0, ms, ok in samples
+                         if t0 >= cut]
+                lat = sorted(ms for _t0, ms, ok in timed if ok)
+                assert lat, f"semantic-cache {tag} phase served nothing"
+                window_s = max(t0 for t0, _ms, _ok in timed) - cut
+                out[f"semantic_cache_{tag}_served_qps"] = round(
+                    len(lat) / max(window_s, 1e-9), 1)
+                out[f"semantic_cache_{tag}_p95_ms"] = round(
+                    float(np.percentile(lat, 95)), 3)
+                out[f"semantic_cache_{tag}_p50_ms"] = round(
+                    float(np.percentile(lat, 50)), 3)
+                out[f"semantic_cache_{tag}_queries"] = len(lat)
+                out[f"semantic_cache_{tag}_lost"] = sum(
+                    1 for _t0, _ms, ok in samples if not ok)
+                if cache_on:
+                    rc = fleet.router.response_cache.stats()
+                    total = rc["hits"] + rc["misses"]
+                    out["semantic_cache_router_hit_rate"] = round(
+                        rc["hits"] / max(total, 1), 4)
+                    out["semantic_cache_router_invalidations"] = \
+                        rc["invalidations"]
+                    # operator-layer stats ride the last heartbeat
+                    opstats = ep.result_cache or {}
+                    out["semantic_cache_op_hit_ratio"] = \
+                        opstats.get("hit_ratio")
+                    out["semantic_cache_invalidations_per_tick"] = \
+                        opstats.get("invalidations_per_tick")
+            finally:
+                fleet.stop()
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
+def bench_semantic_cache() -> dict:
+    """Semantic result-cache leg (engine/result_cache.py): the same
+    Zipf-distributed query stream against the same router-fronted
+    serving member, cache-off then cache-on. The artifact the ROADMAP
+    item demands: served QPS up at equal-or-better p95 (router hits
+    never touch the member; operator hits never touch the device),
+    with the hit/invalidation economics — hit rates at both layers and
+    the member's invalidations-per-tick under its live trickle ingest
+    — in the same snapshot."""
+    out = _semantic_cache_phase(cache_on=False)
+    out.update(_semantic_cache_phase(cache_on=True))
+    if out.get("semantic_cache_off_served_qps"):
+        out["semantic_cache_qps_speedup"] = round(
+            out["semantic_cache_on_served_qps"]
+            / max(out["semantic_cache_off_served_qps"], 1e-9), 3)
     return out
 
 
@@ -2501,6 +2715,11 @@ ROLE = os.environ["REPLICA_BENCH_ROLE"]
 ROOT = os.environ["REPLICA_BENCH_ROOT"]
 N = int(os.environ.get("REPLICA_BENCH_VECS", "256"))
 COST_MS = float(os.environ.get("REPLICA_BENCH_QUERY_COST_MS", "4"))
+# trickle cadence: how often a fresh vector lands after the seed load.
+# The semantic-cache leg stretches this (ingest stays LIVE, but the
+# index-version watermark holds long enough for router fills to commit
+# — a fill is discarded when the watermark moves mid-forward)
+TRICKLE_S = float(os.environ.get("REPLICA_BENCH_TRICKLE_S", "0.5"))
 READY = os.environ.get("REPLICA_BENCH_READY_FILE")
 # fleet-observability mode (tests/fleet_trace_canary.py): each process
 # runs its monitoring HTTP server (ephemeral port, announced over the
@@ -2528,7 +2747,7 @@ class Subject(pw.io.python.ConnectorSubject):
             if i % 32 == 31 and not self._session.sleep(0.05):
                 return
         while True:  # trickle: keep the WAL (and staleness) live
-            if not self._session.sleep(0.5):
+            if not self._session.sleep(TRICKLE_S):
                 return
             self.next(v=rng.random(DIM, np.float32) * 2 - 1)
 

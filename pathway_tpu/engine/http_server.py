@@ -32,6 +32,17 @@ def _paged_stats() -> dict | None:
         return None
 
 
+def _cache_stats() -> dict | None:
+    """Aggregate semantic-result-cache stats (engine/result_cache.py),
+    or None when no cache is live in this process."""
+    try:
+        from pathway_tpu.engine.result_cache import live_cache_stats
+
+        return live_cache_stats()
+    except Exception:
+        return None
+
+
 class MonitoringHttpServer:
     def __init__(self, runtime, port: int | None = None):
         self.runtime = runtime
@@ -127,6 +138,12 @@ class MonitoringHttpServer:
             # paged vector store (engine/paged_store.py): page table
             # occupancy, extent count, growth events, per-tenant pages
             payload["paged_store"] = paged
+        rc = _cache_stats()
+        if rc is not None:
+            # semantic result cache (engine/result_cache.py): hit/miss/
+            # invalidation counters, entry count, the index-version
+            # watermark riding the heartbeats, invalidations per tick
+            payload["result_cache"] = rc
         persistence = getattr(self.runtime, "persistence", None)
         if persistence is not None:
             # commit-watermark durability (engine/persistence.py): how
@@ -564,6 +581,33 @@ class MonitoringHttpServer:
                     lines.append(
                         f'pathway_tpu_paged_tenant_pages'
                         f'{{tenant="{esc(tenant)}"}} {n}')
+        rc = _cache_stats()
+        if rc is not None:
+            # semantic result cache (engine/result_cache.py): repeated
+            # queries served without a kernel dispatch, invalidated
+            # incrementally from the same deltas that maintain the index
+            lines.append("# TYPE pathway_tpu_cache_hits counter")
+            lines.append(f"pathway_tpu_cache_hits {rc['hits']}")
+            lines.append("# TYPE pathway_tpu_cache_misses counter")
+            lines.append(f"pathway_tpu_cache_misses {rc['misses']}")
+            lines.append("# TYPE pathway_tpu_cache_invalidations counter")
+            lines.append(
+                f"pathway_tpu_cache_invalidations {rc['invalidations']}")
+            lines.append("# TYPE pathway_tpu_cache_entries gauge")
+            lines.append(f"pathway_tpu_cache_entries {rc['entries']}")
+            lines.append("# TYPE pathway_tpu_cache_hit_ratio gauge")
+            lines.append(
+                f"pathway_tpu_cache_hit_ratio {round(rc['hit_ratio'], 6)}")
+            lines.append("# TYPE pathway_tpu_cache_evictions counter")
+            lines.append(f"pathway_tpu_cache_evictions {rc['evictions']}")
+            lines.append("# TYPE pathway_tpu_cache_index_version gauge")
+            lines.append(
+                f"pathway_tpu_cache_index_version {rc['version']}")
+            lines.append(
+                "# TYPE pathway_tpu_cache_invalidations_per_tick gauge")
+            lines.append(
+                f"pathway_tpu_cache_invalidations_per_tick "
+                f"{round(rc['invalidations_per_tick'], 6)}")
         promotions = getattr(self.runtime, "promotions", 0)
         if promotions:
             # this process was PROMOTED replica→primary (write-path

@@ -184,6 +184,14 @@ class ExternalIndexOperator(Operator):
                 flush_adds()  # preserve add/remove ordering within the batch
                 self.index.remove(key)
         flush_adds()
+        cache = (getattr(self.index, "result_cache", None)
+                 if not self.revise else None)
+        if cache is not None and data_changed and self._is_primary:
+            # bump the index-version watermark ONCE per data tick (the
+            # broadcast hands replicas the delta too, but they share this
+            # index object and data_changed is computed pre-clear — the
+            # primary guard keeps the bump single)
+            cache.note_data_tick()
         if data_changed and self._is_primary and \
                 hasattr(self.index, "flush_device"):
             # push this tick's page uploads to the device NOW (async
@@ -251,18 +259,7 @@ class ExternalIndexOperator(Operator):
             batch = [(k, v, l, f) for k, (v, l, f)
                      in self.live_queries.items()]
         if batch:
-            if not self.revise and len(batch) > 1:
-                # cross-request coalescing accounting (engine/qos.py):
-                # these as-of-now queries — typically several concurrent
-                # HTTP requests that landed in the same commit tick —
-                # ride ONE kernel dispatch below (the index stacks the
-                # batch into a single device search; per-request top-k
-                # merges on the way out). One module-global probe when
-                # QoS is off.
-                from pathway_tpu.engine.qos import note_coalesced_dispatch
-
-                note_coalesced_dispatch(len(batch))
-            replies = self.index.search(batch)
+            replies = self._answer_batch(batch, cache)
             for (key, _, _, _), reply in zip(batch, replies):
                 reply = tuple(reply)
                 prev = self.answers.get(key)
@@ -273,3 +270,71 @@ class ExternalIndexOperator(Operator):
                 self.answers[key] = reply
                 out.append(key, (reply,), 1)
         return out
+
+    def _answer_batch(self, batch: list[tuple], cache) -> list[tuple]:
+        """Answer one tick's query batch, through the semantic result
+        cache when the index carries one (as-of-now only — revise mode
+        re-answers standing queries, so its replies are not reusable).
+
+        Cache misses still ride ONE kernel dispatch (the cross-request
+        coalescing PR 15 counts); hits and duplicate misses extend that
+        coalescing from "same tick" to "same answer" — they never reach
+        the device at all. Replies are emitted in the original batch
+        order, so a cache-on run is byte-identical to cache-off."""
+        from pathway_tpu.engine.qos import note_coalesced_dispatch
+
+        if cache is None:
+            if not self.revise and len(batch) > 1:
+                # cross-request coalescing accounting (engine/qos.py):
+                # these as-of-now queries — typically several concurrent
+                # HTTP requests that landed in the same commit tick —
+                # ride ONE kernel dispatch (the index stacks the batch
+                # into a single device search; per-request top-k merges
+                # on the way out). One module-global probe when QoS is
+                # off.
+                note_coalesced_dispatch(len(batch))
+            return self.index.search(batch)
+
+        from pathway_tpu.engine.qos import note_answer_coalesced
+        from pathway_tpu.engine.result_cache import fingerprint
+
+        # filtered queries are never cached (filter payloads can change
+        # without touching the vector store)
+        fps = [None if filt is not None else fingerprint(vec, limit)
+               for _key, vec, limit, filt in batch]
+        replies: list = [None] * len(batch)
+        to_search: list[int] = []
+        fp_first: dict[bytes, int] = {}
+        reused = 0
+        for i, fp in enumerate(fps):
+            if fp is not None:
+                hit = cache.lookup(fp)
+                if hit is not None:
+                    replies[i] = hit
+                    reused += 1
+                    continue
+                if fp in fp_first:
+                    reused += 1  # duplicate miss: share the first's reply
+                    continue
+                fp_first[fp] = i
+            to_search.append(i)
+        if to_search:
+            if len(to_search) > 1:
+                note_coalesced_dispatch(len(to_search))
+            searched = self.index.search([batch[i] for i in to_search])
+            pages = getattr(self.index, "last_search_coverage", None)
+            for i, reply in zip(to_search, searched):
+                reply = tuple(reply)
+                replies[i] = reply
+                fp = fps[i]
+                if fp is not None:
+                    _key, vec, limit, _filt = batch[i]
+                    kth = (reply[-1][1]
+                           if reply and len(reply) >= int(limit) else None)
+                    cache.fill(fp, reply, pages, kth, vec)
+        for i, fp in enumerate(fps):
+            if replies[i] is None:
+                replies[i] = replies[fp_first[fp]]
+        if reused:
+            note_answer_coalesced(reused)
+        return replies

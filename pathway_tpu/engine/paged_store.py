@@ -440,6 +440,24 @@ class DevicePagePool:
             pos = np.flatnonzero(in_ext)
             yield ext, (slots[pos] - ext.base), pos
 
+    def touched_page_ids(self) -> frozenset:
+        """The page-touch set of a search over this pool *right now*: every
+        page of every **established** extent (the per-extent merge scans
+        whole extents under their valid masks; extents never established
+        hold no rows and are skipped). This is what the semantic result
+        cache (engine/result_cache.py) records per entry — an insert into
+        a page outside this set at fill time provably landed in device
+        memory the entry's candidate scan never read. Callers hold the
+        owning index's lock (same contract as every other pool call)."""
+        pr = self.allocator.page_rows
+        pages: set[int] = set()
+        for ext in self.extents:
+            if not ext.established:
+                continue
+            first = ext.base // pr
+            pages.update(range(first, first + ext.rows // pr))
+        return frozenset(pages)
+
     def stats(self) -> dict:
         if self._owner_lock is not None:
             with self._owner_lock:

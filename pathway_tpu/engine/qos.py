@@ -92,6 +92,20 @@ def note_coalesced_dispatch(n_queries: int) -> None:
         ctl.note_search_dispatch(n_queries)
 
 
+def note_answer_coalesced(n_queries: int) -> None:
+    """Hook for the semantic result cache (engine/result_cache.py):
+    ``n_queries`` as-of-now queries were answered this tick WITHOUT a
+    kernel dispatch — cache hits plus in-batch duplicate misses sharing
+    one search. This extends PR 15's cross-request coalescing from "same
+    tick" (one dispatch, many queries) to "same answer" (zero
+    dispatches)."""
+    if _LIVE is None:
+        return
+    ctl = _LIVE()
+    if ctl is not None:
+        ctl.note_answer_reuse(n_queries)
+
+
 class QueryShedError(RuntimeError):
     """A query was refused at admission (queue full, or deadline-aware
     shedding under budget burn). The webserver maps it to a fast ``503``
@@ -230,6 +244,8 @@ class QosController:
         self.deferred_rows_total = 0   # rows left for later ticks, summed
         self.coalesced_dispatches = 0  # kernel dispatches serving >1 query
         self.coalesced_queries = 0     # queries that shared a dispatch
+        self.coalesced_answers = 0     # queries served with NO dispatch
+        #                                (result-cache hits + dup misses)
         self.admitted_total = 0
         self._queue_depth = 0
         self.ticks_budgeted = 0
@@ -409,6 +425,15 @@ class QosController:
             self.coalesced_dispatches += 1
             self.coalesced_queries += n_queries
 
+    def note_answer_reuse(self, n_queries: int) -> None:
+        """Queries served from the semantic result cache (or deduped
+        against an identical in-batch miss) — answered with no device
+        dispatch at all."""
+        if n_queries < 1:
+            return
+        with self._lock:
+            self.coalesced_answers += n_queries
+
     # -- surfaces ----------------------------------------------------------
     def query_budget_ms(self) -> float:
         """The current per-tick device-time reservation for query work,
@@ -439,6 +464,7 @@ class QosController:
             "ingest_deferrals": self.ingest_deferrals,
             "query_budget_ms": round(self.query_budget_ms(), 3),
             "admission_queue_depth": self.queue_depth(),
+            "coalesced_answers": self.coalesced_answers,
         }
 
     def is_shedding(self) -> bool:
@@ -477,6 +503,7 @@ class QosController:
                 "deferred_rows_total": self.deferred_rows_total,
                 "coalesced_dispatches": self.coalesced_dispatches,
                 "coalesced_queries": self.coalesced_queries,
+                "coalesced_answers": self.coalesced_answers,
                 "backpressure_active": self.backpressure_active,
             }
         out["query_budget_ms"] = round(self.query_budget_ms(), 3)

@@ -528,6 +528,21 @@ class ControlClient:
         mon = getattr(rt, "http_server", None)
         if mon is not None:
             hb["monitoring_port"] = mon.port
+        # semantic result cache: the index-version watermark rides the
+        # heartbeat so the router can serve fleet-wide hits without
+        # touching a primary or replica (engine/result_cache.py) — plus
+        # compact cache stats for /fleet/status
+        from pathway_tpu.engine.result_cache import live_cache_stats
+
+        rc = live_cache_stats()
+        if rc is not None:
+            hb["index_version"] = rc["version"]
+            hb["result_cache"] = {
+                "entries": rc["entries"], "hits": rc["hits"],
+                "misses": rc["misses"],
+                "invalidations": rc["invalidations"],
+                "invalidations_per_tick": rc["invalidations_per_tick"],
+                "hit_ratio": round(rc["hit_ratio"], 4)}
         # monotonic<->wall clock anchor (engine/fleet_observability.py):
         # rides every heartbeat so the router can clock-align this
         # process's monotonic trace timestamps in /fleet/trace even when

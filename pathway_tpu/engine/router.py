@@ -153,6 +153,11 @@ class ReplicaEndpoint:
         # QoS state from the heartbeat (engine/qos.py): budget, queue
         # depth and the shedding flag — the router's steer-away signal
         self.qos: dict | None = None
+        # semantic-result-cache watermark + stats from the heartbeat
+        # (engine/result_cache.py): the fleet watermark the router's
+        # response cache keys on; None until the endpoint reports one
+        self.index_version: int | None = None
+        self.result_cache: dict | None = None
 
     def observe(self, ms: float) -> None:
         self.p50.observe(ms)
@@ -211,6 +216,10 @@ class ReplicaEndpoint:
             self.clock = hb["clock"]
         if isinstance(hb.get("qos"), dict):
             self.qos = hb["qos"]
+        if hb.get("index_version") is not None:
+            self.index_version = int(hb["index_version"])
+        if isinstance(hb.get("result_cache"), dict):
+            self.result_cache = hb["result_cache"]
 
     def is_shedding(self) -> bool:
         """The endpoint's own QoS controller reported active shedding in
@@ -255,6 +264,8 @@ class ReplicaEndpoint:
                             else round(skew, 3)),
             "burn_rate": self.burn_rate,
             "qos": self.qos,
+            "index_version": self.index_version,
+            "result_cache": self.result_cache,
         }
 
 
@@ -271,7 +282,8 @@ class QueryRouter:
                  max_staleness_ticks: int | None = None,
                  slo_ms: float | None = None,
                  error_budget: float | None = None,
-                 write_paths: tuple[str, ...] | list[str] | None = None):
+                 write_paths: tuple[str, ...] | list[str] | None = None,
+                 cache_routes: tuple[str, ...] | list[str] | None = None):
         self.host = host
         self.port = port
         self.control_port = control_port
@@ -285,6 +297,22 @@ class QueryRouter:
             write_paths = tuple(p.strip() for p in raw.split(",")
                                 if p.strip())
         self.write_paths = tuple(write_paths)
+        # -- fleet result cache (engine/result_cache.py) --------------------
+        # path prefixes whose responses the router may cache against the
+        # fleet index-version watermark (heartbeat-fed). Opt-in: only
+        # deterministic read routes keyed purely by (method, path, body)
+        # qualify — empty (the default) disables the router cache.
+        if cache_routes is None:
+            raw = os.environ.get("PATHWAY_ROUTER_CACHE_ROUTES", "")
+            cache_routes = tuple(p.strip() for p in raw.split(",")
+                                 if p.strip())
+        self.cache_routes = tuple(cache_routes)
+        if self.cache_routes:
+            from pathway_tpu.engine.result_cache import RouterResultCache
+
+            self.response_cache = RouterResultCache()
+        else:
+            self.response_cache = None
         self.election_timeout_s = max(0.05, _env_int(
             "PATHWAY_ROUTER_ELECTION_TIMEOUT_MS", 3000) / 1000.0)
         self.fleet_epoch = 0           # max fencing epoch seen fleet-wide
@@ -778,6 +806,27 @@ class QueryRouter:
             rid = _mint_router_rid()
         span = self.request_log.start(rid, path)
         t0 = _time.perf_counter()
+        # fleet-wide semantic cache: a hit is served HERE, off the
+        # index-version watermark riding the heartbeats — it never
+        # touches a primary or replica (engine/result_cache.py)
+        cache_key = cache_wm = None
+        if self.response_cache is not None and self.is_cache_path(path):
+            from pathway_tpu.engine.result_cache import RouterResultCache
+
+            cache_wm = self._fleet_watermark()
+            cache_key = RouterResultCache.key(method, path, body)
+            hit = self.response_cache.lookup(cache_key, cache_wm)
+            if hit is not None:
+                status, data, resp_ctype = hit
+                ms = (_time.perf_counter() - t0) * 1e3
+                with self._lock:
+                    self.requests_total += 1
+                    self._window.append(ms)
+                    self._e2e_p50.observe(ms)
+                    self._e2e_p95.observe(ms)
+                self.request_log.finish(span, status, "router-cache")
+                return (status, data, "router-cache", 0, resp_ctype,
+                        rid, None)
         tried: set[str] = set()
         failovers = 0
         last_err: Exception | None = None
@@ -848,12 +897,38 @@ class QueryRouter:
             self.request_log.finish(span, status, ep.replica_id)
             if status == 503 and not retry_after:
                 retry_after = "1"  # every 503 carries the hint
+            if cache_key is not None and status == 200 \
+                    and cache_wm is not None \
+                    and self._fleet_watermark() == cache_wm:
+                # fill only when the watermark held across the forward —
+                # a version bump mid-flight makes the response's vintage
+                # ambiguous, and a miss is cheaper than a wrong serve
+                self.response_cache.fill(cache_key, cache_wm, status,
+                                         data, resp_ctype)
             return (status, data, ep.replica_id, failovers, resp_ctype,
                     rid, retry_after if status == 503 else None)
 
     def is_write_path(self, path: str) -> bool:
         p = path.split("?", 1)[0]
         return any(p.startswith(w) for w in self.write_paths)
+
+    def is_cache_path(self, path: str) -> bool:
+        p = path.split("?", 1)[0]
+        return any(p.startswith(c) for c in self.cache_routes) \
+            and not self.is_write_path(p)
+
+    def _fleet_watermark(self):
+        """Equality token for the fleet's index state: every live
+        endpoint's heartbeat-reported ``index_version``. ``None`` — which
+        disables both serve and fill — when no endpoint is live or any
+        live endpoint has not reported a version (correctness over
+        hits: an unversioned endpoint could be mutating unobserved)."""
+        with self._lock:
+            eps = [(e.replica_id, e.index_version)
+                   for e in self._endpoints.values() if e.alive]
+        if not eps or any(v is None for _, v in eps):
+            return None
+        return frozenset(eps)
 
     def _election_retry_after(self) -> str:
         """Honest Retry-After for write 503s: the remaining election
@@ -1057,6 +1132,12 @@ class QueryRouter:
                 None if self.failover_seconds is None
                 else round(self.failover_seconds, 6)),
             "election": dict(el) if el is not None else None,
+            "result_cache": (
+                None if self.response_cache is None else {
+                    **self.response_cache.stats(),
+                    "routes": list(self.cache_routes),
+                    "watermark_live": self._fleet_watermark() is not None,
+                }),
         }
 
     def healthz_payload(self) -> tuple[bool, dict]:
@@ -1104,6 +1185,24 @@ class QueryRouter:
             lines.append("# TYPE pathway_tpu_failover_seconds gauge")
             lines.append(f"pathway_tpu_failover_seconds "
                          f"{round(self.failover_seconds, 6)}")
+        if self.response_cache is not None:
+            # fleet-level semantic result cache (engine/result_cache.py):
+            # hits served at the router off heartbeat watermarks
+            rc = self.response_cache.stats()
+            lines += [
+                "# TYPE pathway_tpu_router_cache_hits counter",
+                f"pathway_tpu_router_cache_hits {rc['hits']}",
+                "# TYPE pathway_tpu_router_cache_misses counter",
+                f"pathway_tpu_router_cache_misses {rc['misses']}",
+                "# TYPE pathway_tpu_router_cache_invalidations counter",
+                f"pathway_tpu_router_cache_invalidations "
+                f"{rc['invalidations']}",
+                "# TYPE pathway_tpu_router_cache_entries gauge",
+                f"pathway_tpu_router_cache_entries {rc['entries']}",
+                "# TYPE pathway_tpu_router_cache_hit_ratio gauge",
+                f"pathway_tpu_router_cache_hit_ratio "
+                f"{round(rc['hit_ratio'], 6)}",
+            ]
         if eps:
             lines.append("# TYPE pathway_tpu_router_requests counter")
             lines.append("# TYPE pathway_tpu_router_failures counter")
@@ -1114,6 +1213,8 @@ class QueryRouter:
             lines.append(
                 "# TYPE pathway_tpu_replica_staleness_ticks gauge")
             lines.append("# TYPE pathway_tpu_replica_applied_tick gauge")
+            lines.append(
+                "# TYPE pathway_tpu_replica_index_version gauge")
             for e in sorted(eps, key=lambda e: e.replica_id):
                 lab = f'{{replica="{esc(e.replica_id)}"}}'
                 lines.append(
@@ -1143,6 +1244,11 @@ class QueryRouter:
                 lines.append(
                     f"pathway_tpu_replica_applied_tick{lab} "
                     f"{e.applied_tick}")
+                if e.index_version is not None:
+                    # the watermark the router's response cache keys on
+                    lines.append(
+                        f"pathway_tpu_replica_index_version{lab} "
+                        f"{e.index_version}")
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 

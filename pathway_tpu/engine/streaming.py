@@ -845,6 +845,14 @@ class StreamingRuntime:
                     if self.qos is not None:
                         self._qos_tick_feedback(
                             (_time.perf_counter() - t_tick0) * 1e3)
+                    # close every live semantic result cache's
+                    # invalidations/tick window (engine/result_cache.py)
+                    # — the basis of the exported invalidations-per-tick
+                    # rate and the bench leg's staleness accounting
+                    from pathway_tpu.engine.result_cache import \
+                        note_commit_ticks
+
+                    note_commit_ticks()
                     self.monitor.update(self.scheduler, self.runner.graph,
                                         time_counter)
                     if self.persistence is not None:

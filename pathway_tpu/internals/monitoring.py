@@ -110,6 +110,9 @@ class StatsMonitor:
         # growth events (engine/paged_store.py) — page churn and online
         # growth are visible without scraping /metrics
         self._paged_line = self._paged_panel()
+        # semantic result cache line: hit ratio, entry count and the
+        # incremental-invalidation counters (engine/result_cache.py)
+        self._cache_line = self._cache_panel()
         # durability line: commit watermark, its lag behind the pipeline
         # head, and the bridge depth the last commit trailed — a frozen
         # watermark is visible here before the watchdog fires
@@ -206,6 +209,9 @@ class StatsMonitor:
         if getattr(self, "_paged_line", None):
             parts.append(Panel(self._paged_line, title="paged store",
                                height=None))
+        if getattr(self, "_cache_line", None):
+            parts.append(Panel(self._cache_line, title="result cache",
+                               height=None))
         if getattr(self, "_serving_lines", None):
             parts.append(Panel("\n".join(self._serving_lines),
                                title="serving", height=None))
@@ -289,6 +295,21 @@ class StatsMonitor:
                 f"{t}:{n}p" for t, n in sorted(st["tenants"].items()))
         return line
 
+    def _cache_panel(self) -> str | None:
+        try:
+            from pathway_tpu.engine.result_cache import live_cache_stats
+
+            st = live_cache_stats()
+        except Exception:
+            return None
+        if st is None:
+            return None
+        return (f"entries {st['entries']}  "
+                f"hit {st['hit_ratio']:.0%} ({st['hits']}h/{st['misses']}m)"
+                f"  invalidations {st['invalidations']} "
+                f"({st['invalidations_per_tick']:.2f}/tick)  "
+                f"v{st['version']}")
+
     def _slowest_lines(self, top_n: int = 5) -> list[str]:
         """Critical-path panel: the operators that dominated the last
         tick, worst first — the per-tick answer to "where does the time
@@ -342,6 +363,8 @@ class StatsMonitor:
                 print(f"[monitor] {self._persistence_line}", file=sys.stderr)
             if getattr(self, "_paged_line", None):
                 print(f"[monitor] {self._paged_line}", file=sys.stderr)
+            if getattr(self, "_cache_line", None):
+                print(f"[monitor] {self._cache_line}", file=sys.stderr)
             for line in getattr(self, "_serving_lines", None) or ():
                 print(f"[monitor] {line}", file=sys.stderr)
             if getattr(self, "_qos_line", None):

@@ -388,6 +388,16 @@ class BruteForceKnnIndex:
         self.upload_rows_total = 0
         self._init_storage(reserved_space, device, page_rows=page_rows,
                            tenant=tenant, tenant_quotas=tenant_quotas)
+        # semantic result cache (engine/result_cache.py): fed from the
+        # add/remove paths below, filled by the external-index operator.
+        # Page geometry comes from storage, so this follows _init_storage.
+        from pathway_tpu.engine.result_cache import maybe_result_cache
+
+        self.result_cache = maybe_result_cache(self)
+        # page-touch set of the most recent search() — (coverage, fill
+        # metadata) the operator pairs with each reply; None until a
+        # search ran or when the cache is disabled
+        self.last_search_coverage: frozenset | None = None
 
     # ------------------------------------------------------------------
     # storage hooks — the paged subclass swaps slot allocation + device
@@ -504,6 +514,8 @@ class BruteForceKnnIndex:
                 self._filter_data[key] = filter_data
             self._dirty.add(slot)
             self._stale.discard(slot)  # host write wins
+            if self.result_cache is not None:
+                self.result_cache.on_insert(slot, key, vec)
 
     def set_filter_data(self, keys: list[Pointer],
                         filter_data: list[Any] | None) -> None:
@@ -553,6 +565,8 @@ class BruteForceKnnIndex:
             slot_list = slots.tolist()
             self._dirty.update(slot_list)
             self._stale.difference_update(slot_list)  # host write wins
+            if self.result_cache is not None:
+                self.result_cache.on_insert_batch(slots, keys, vecs)
 
     def add_batch_device(self, keys: list[Pointer], vectors,
                          filter_data: list[Any] | None = None) -> None:
@@ -592,6 +606,10 @@ class BruteForceKnnIndex:
             slot_list = slots.tolist()
             self._stale.update(slot_list)
             self._dirty.difference_update(slot_list)  # device write wins
+            if self.result_cache is not None:
+                # vectors are device-resident — no host beat test possible,
+                # and the uncovered-page rule dooms every entry anyway
+                self.result_cache.invalidate_all()
 
     def make_fused_ingest(self, producer: Callable):
         """Fuse a producer (e.g. the encoder forward pass) with the slab
@@ -619,6 +637,9 @@ class BruteForceKnnIndex:
                    n_rows: int | None = None) -> None:
             with self._lock:
                 self._fused_ingest(step, keys, args, n_rows)
+                if self.result_cache is not None:
+                    # donated device scatter: same rule as add_batch_device
+                    self.result_cache.invalidate_all()
 
         return ingest
 
@@ -708,6 +729,8 @@ class BruteForceKnnIndex:
             self._release_slot(slot)
             self._dirty.add(slot)
             self._stale.discard(slot)
+            if self.result_cache is not None:
+                self.result_cache.on_delete(slot, key)
 
     def __len__(self) -> int:
         return len(self._key_to_slot)
@@ -827,6 +850,15 @@ class BruteForceKnnIndex:
         per-chunk top-k bounds it at the chunk size)."""
         return min(self.capacity, _CHUNK_ROWS)
 
+    def _coverage_pages(self) -> frozenset:
+        """Page-touch set of a search (lock held, device flushed): the
+        slab kernel scans the whole slab, so coverage is every page over
+        the slab address space (page ids are ``slot // page_rows`` with
+        the configured page size — synthetic for the slab, but consistent
+        with the add/remove hooks feeding the result cache)."""
+        pr = self.result_cache.page_rows
+        return frozenset(range(-(-self.capacity // pr)))
+
     def _device_topk(self, qmat, fetch_k: int):
         """(scores, global slot ids) as host arrays, exactly ``fetch_k``
         columns, best first. Lock held, device state flushed."""
@@ -844,8 +876,16 @@ class BruteForceKnnIndex:
             return []
         with self._lock:
             if not self._key_to_slot:
+                # empty-index scan touches nothing: an entry filled from
+                # it covers no pages, so ANY later insert invalidates it
+                if self.result_cache is not None:
+                    self.last_search_coverage = frozenset()
                 return [() for _ in queries]
             self._flush_to_device()
+            if self.result_cache is not None:
+                # coverage AFTER the flush — it must describe exactly the
+                # device state the kernel below scans
+                self.last_search_coverage = self._coverage_pages()
             import jax.numpy as jnp
 
             max_k = max(int(q[2] or 3) for q in queries)
@@ -1172,6 +1212,11 @@ class PagedKnnIndex(BruteForceKnnIndex):
                     ext.vectors[local]).astype(self._np_dtype)
 
     # -- search over the page table --------------------------------------
+    def _coverage_pages(self) -> frozenset:
+        # paged search scans established extents only — the pool reports
+        # exactly that set (the ISSUE-19 page-touch contract)
+        return self._pool.touched_page_ids()
+
     def _extent_extras(self, ext) -> tuple:
         if self._is_int8:
             return (ext.scales, ext.vsq)

@@ -351,6 +351,76 @@ class VectorStoreServer:
         return f"VectorStoreServer({self._graph['chunks']!r})"
 
 
+def parse_slides(data: Any) -> list[tuple[str, dict]]:
+    """Default slide-deck parser: one document PER SLIDE (pptx) or per
+    page (pdf), stdlib-only (zipfile + XML / content-stream extraction
+    from ``_doc_extract``). Slide decks carry their structure in pages,
+    so the page is the retrieval unit — no splitter runs downstream."""
+    from pathway_tpu.xpacks.llm._doc_extract import (detect_format,
+                                                     extract_pdf,
+                                                     extract_pptx)
+
+    raw = data if isinstance(data, bytes) else str(data).encode()
+    fmt = detect_format(raw)
+    if fmt == "pptx":
+        pages = extract_pptx(raw)
+    elif fmt == "pdf":
+        pages = extract_pdf(raw)
+    else:  # not a deck: index the whole text as a single one-page doc
+        pages = [raw.decode("utf-8", "replace")]
+    total = len(pages)
+    return [(text, {"page": i + 1, "total_pages": total,
+                    "parser": "slides"})
+            for i, text in enumerate(pages)]
+
+
+class SlidesVectorStoreServer(VectorStoreServer):
+    """Slide-deck flavour of :class:`VectorStoreServer` (reference
+    vector_store.py SlidesVectorStoreServer): each slide/page is an
+    indexed document with page-position metadata, there is no default
+    splitter (the slide IS the chunk), and ``/v1/inputs`` answers with
+    the full per-document metadata dicts — a slide UI needs page counts
+    and previews, not bare paths — minus ``excluded_response_metadata``
+    (bulky payloads like rendered page images)."""
+
+    excluded_response_metadata = ["b64_image", "image_base64"]
+
+    def __init__(self, *docs, embedder, parser: Callable | None = None,
+                 splitter: Callable | None = None, **kwargs):
+        super().__init__(*docs, embedder=embedder,
+                         parser=parser if parser is not None
+                         else parse_slides,
+                         splitter=splitter, **kwargs)
+
+    def inputs_query(self, input_queries) -> "pw.Table":
+        docs = self._graph["docs"]
+        metas = docs.reduce(metas=pw.reducers.tuple(pw.this._metadata))
+        excluded = tuple(self.excluded_response_metadata)
+
+        @pw.udf
+        def format_inputs(metas, metadata_filter, filepath_globpattern) \
+                -> Json:
+            import fnmatch
+
+            out = []
+            for m in metas or ():
+                d = dict(m.value) if isinstance(m, Json) else dict(m or {})
+                if filepath_globpattern and not fnmatch.fnmatch(
+                        str(d.get("path", "")), str(filepath_globpattern)):
+                    continue
+                for k in excluded:
+                    d.pop(k, None)
+                out.append(d)
+            return Json(out)
+
+        return input_queries.join_left(metas, id=input_queries.id).select(
+            result=format_inputs(metas.metas, input_queries.metadata_filter,
+                                 input_queries.filepath_globpattern))
+
+    def __repr__(self) -> str:
+        return f"SlidesVectorStoreServer({self._graph['chunks']!r})"
+
+
 class VectorStoreClient:
     """Blocking HTTP client for VectorStoreServer (reference :627)."""
 

@@ -1,0 +1,232 @@
+"""Semantic result-cache canary (engine/result_cache.py), on the REAL
+``examples/streaming_etl.py`` graph: a vector KNN serving route is
+mounted next to the example's own order/category pipeline, and the same
+deterministic query/churn script runs twice — cache-off then cache-on.
+Gates:
+
+1. **byte-identity** — the cache-on run's response bodies are
+   byte-for-byte identical to the cache-off run's, across a churn step
+   that provably CHANGES answers (so identity is not vacuous: a stale
+   serve would diverge here);
+2. **hit-rate > 0** — the repeated query pool actually hits (the cache
+   is live, not configured-but-inert), and the churn step actually
+   invalidates (the incremental invalidator saw the deltas).
+
+Exits 0 iff both hold. Run: ``python tests/semantic_cache_canary.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+DIM = 8
+N_SEED = 64
+N_CHURN = 16
+POOL = 6
+REPEATS = 3
+K = 3
+
+
+def _serving_run(cache_on: bool) -> tuple[list[bytes], dict | None]:
+    """One full serving run: streaming_etl + KNN route, seeded load →
+    query script → churn → same query script. Returns the raw response
+    bodies in request order plus the operator cache stats (None when
+    the cache is disabled)."""
+    os.environ["PATHWAY_RESULT_CACHE"] = "1" if cache_on else "0"
+    from tests.pipelining_canary import _write_feed
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine import streaming as _streaming
+    from pathway_tpu.engine.result_cache import live_cache_stats
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.io.http import PathwayWebserver, rest_connector
+    from pathway_tpu.io.python import ConnectorSubject
+    from pathway_tpu.stdlib.indexing import (
+        default_brute_force_knn_document_index,
+    )
+
+    G.clear()
+    rng = np.random.default_rng(5)
+    seed_vecs = rng.random((N_SEED, DIM), np.float32) * 2 - 1
+    pool = rng.random((POOL, DIM), np.float32) * 2 - 1
+    # churn vectors sit ON the query pool (plus noise), so post-churn
+    # answers provably change — byte-identity across the churn step is
+    # the no-stale-serve proof, not a trivial replay
+    churn_vecs = (pool[np.arange(N_CHURN) % POOL]
+                  + rng.random((N_CHURN, DIM), np.float32) * 0.01)
+    loaded = threading.Event()
+    churn_go = threading.Event()
+
+    class Vecs(ConnectorSubject):
+        def run(self):
+            for v in seed_vecs:
+                self.next(v=v)
+            loaded.set()
+            while not churn_go.is_set():
+                if not self._session.sleep(0.02):
+                    return
+            for v in churn_vecs:
+                self.next(v=v)
+
+    with tempfile.TemporaryDirectory() as td:
+        root = pathlib.Path(td)
+        orders_dir, cats_csv = _write_feed(root)
+        from examples.streaming_etl import build
+
+        build(orders_dir, cats_csv, str(root / "out.csv"))
+        data = pw.io.python.read(
+            Vecs(), schema=sch.schema_from_types(v=np.ndarray),
+            autocommit_duration_ms=20, name="cache_canary_vecs")
+        index = default_brute_force_knn_document_index(
+            data.v, data, dimensions=DIM, reserved_space=48)  # forces grow
+        ws = PathwayWebserver(host="127.0.0.1", port=0)
+        qschema = sch.schema_from_types(vec=dt.ANY, k=int)
+        queries, writer = rest_connector(
+            webserver=ws, route="/knn", schema=qschema, methods=("POST",),
+            delete_completed_queries=True, autocommit_duration_ms=10)
+        qv = queries.select(
+            qv=pw.apply(lambda v: np.asarray(v, dtype=np.float32),
+                        queries.vec),
+            k=queries.k)
+        res = index.query_as_of_now(qv.qv, number_of_matches=qv.k)
+        writer(res.select(
+            scores=pw.apply(lambda ds: [float(d) for d in ds],
+                            res._pw_index_reply_score)))
+
+        errors: list[BaseException] = []
+
+        def _run():
+            try:
+                pw.run()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        th = threading.Thread(target=_run, daemon=True,
+                              name=f"cache-canary-{cache_on}")
+        th.start()
+        bodies: list[bytes] = []
+        stats = None
+        try:
+            deadline = time.monotonic() + 120.0
+            rt = None
+            while time.monotonic() < deadline and rt is None:
+                live = list(_streaming._ACTIVE_RUNTIMES)
+                if live and ws._started.is_set() and ws.port:
+                    rt = live[0]
+                if errors:
+                    raise errors[0]
+                time.sleep(0.05)
+            assert rt is not None, "runtime never started"
+            assert loaded.wait(60.0), "seed vectors never loaded"
+
+            def rows_ingested() -> int:
+                return sum(
+                    st.get("insertions", 0)
+                    for nid, st in rt.scheduler.stats.items()
+                    if rt.runner.graph.nodes[nid].name
+                    == "cache_canary_vecs")
+
+            def wait_rows(n: int):
+                dl = time.monotonic() + 60.0
+                while time.monotonic() < dl:
+                    if rows_ingested() >= n:
+                        return
+                    time.sleep(0.02)
+                raise TimeoutError(
+                    f"ingest stalled at {rows_ingested()}/{n} rows")
+
+            def ask(vec) -> bytes:
+                body = json.dumps({"vec": [float(x) for x in vec],
+                                   "k": K}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{ws.port}/knn", data=body,
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return resp.read()
+
+            def query_script():
+                # repeats back-to-back AND interleaved: same-tick
+                # duplicate misses and later-tick hits both exercise
+                for r in range(REPEATS):
+                    for i in range(POOL):
+                        bodies.append(ask(pool[i]))
+
+            wait_rows(N_SEED)
+            query_script()
+            churn_go.set()
+            wait_rows(N_SEED + N_CHURN)
+            query_script()
+            stats = live_cache_stats()
+        finally:
+            churn_go.set()
+            _streaming.stop_all()
+            th.join(15.0)
+            G.clear()
+            os.environ.pop("PATHWAY_RESULT_CACHE", None)
+        if errors:
+            raise errors[0]
+    return bodies, stats
+
+
+def main() -> int:
+    off_bodies, off_stats = _serving_run(cache_on=False)
+    if off_stats is not None:
+        print("FAIL: cache-off run still registered a live cache",
+              file=sys.stderr)
+        return 1
+    on_bodies, on_stats = _serving_run(cache_on=True)
+    n = POOL * REPEATS * 2
+    if len(off_bodies) != n or len(on_bodies) != n:
+        print(f"FAIL: expected {n} responses, got off={len(off_bodies)} "
+              f"on={len(on_bodies)}", file=sys.stderr)
+        return 1
+    if on_bodies != off_bodies:
+        diffs = [i for i, (a, b) in enumerate(zip(off_bodies, on_bodies))
+                 if a != b]
+        print(f"FAIL: cache-on diverged from cache-off at requests "
+              f"{diffs[:5]} (of {len(diffs)}): "
+              f"off={off_bodies[diffs[0]][:120]!r} "
+              f"on={on_bodies[diffs[0]][:120]!r}", file=sys.stderr)
+        return 1
+    half = POOL * REPEATS
+    changed = sum(1 for i in range(half)
+                  if off_bodies[i] != off_bodies[half + i])
+    if changed == 0:
+        print("FAIL: churn step changed no answers — the identity gate "
+              "is vacuous", file=sys.stderr)
+        return 1
+    if on_stats is None:
+        print("FAIL: cache-on run registered no live cache",
+              file=sys.stderr)
+        return 1
+    if not on_stats["hits"] > 0:
+        print(f"FAIL: cache never hit: {on_stats}", file=sys.stderr)
+        return 1
+    if not on_stats["invalidations"] > 0:
+        print(f"FAIL: churn never invalidated: {on_stats}",
+              file=sys.stderr)
+        return 1
+    print(f"OK: semantic-cache canary holds — {n} responses "
+          f"byte-identical across churn ({changed}/{half} answers "
+          f"changed), hits={on_stats['hits']} "
+          f"misses={on_stats['misses']} "
+          f"invalidations={on_stats['invalidations']} "
+          f"hit_ratio={on_stats['hit_ratio']:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    sys.exit(main())

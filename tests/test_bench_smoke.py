@@ -43,7 +43,7 @@ def test_bench_emits_json_even_when_backend_is_dead():
     import subprocess
 
     env = dict(os.environ, JAX_PLATFORMS="bogus", BENCH_SKIP="etl",
-               BENCH_PROBE_TIMEOUT="30")
+               BENCH_PROBE_TIMEOUT="30", BENCH_PROBE_WINDOW="20")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run(
         [sys.executable, "-u", "/root/repo/bench.py"],

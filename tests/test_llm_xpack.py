@@ -411,3 +411,85 @@ def test_fused_index_handles_document_update_and_delete():
     assert "systolic arrays multiply matrices" not in texts
     assert texts <= {"ring attention rotates blocks",
                      "pallas kernels tile vmem"} and texts
+
+
+# ---------------------------------------------------------------------------
+# SlidesVectorStoreServer: per-slide indexing + metadata-rich /v1/inputs
+# ---------------------------------------------------------------------------
+
+def test_slides_vector_store_indexes_per_slide():
+    from tests.test_doc_extract import make_pptx
+
+    from pathway_tpu.xpacks.llm.vector_store import SlidesVectorStoreServer
+
+    deck = make_pptx([["systolic arrays multiply matrices"],
+                      ["ring attention rotates blocks"],
+                      ["lazy dog jumps"]])
+    schema = sch.schema_from_types(data=bytes, _metadata=pw.Json)
+    docs = table_from_rows(
+        schema, [(deck, Json({"path": "/deck.pptx", "b64_image": "xxxx"}))])
+    store = SlidesVectorStoreServer(docs, embedder=fake_embedder)
+    chunks = store._graph["chunks"]
+    df = table_to_pandas(chunks.select(text=pw.this.text,
+                                       metadata=pw.this.metadata))
+    assert len(df) == 3                      # one chunk PER SLIDE
+    metas = sorted((m.value for m in df["metadata"]),
+                   key=lambda d: d["page"])
+    assert [m["page"] for m in metas] == [1, 2, 3]
+    assert all(m["total_pages"] == 3 for m in metas)
+    assert all(m["path"] == "/deck.pptx" for m in metas)
+
+    schema_q = sch.schema_from_types(query=str, k=int,
+                                     metadata_filter=type(None),
+                                     filepath_globpattern=type(None))
+    queries = table_from_rows(
+        schema_q, [("ring attention blocks", 1, None, None)])
+    res = store.retrieve_query(queries)
+    rows = _result_rows(res.select(result=pw.this.result))
+    pw.run()
+    (match,) = rows[0]["result"].value
+    assert "ring attention" in match["text"]
+    assert match["metadata"]["page"] == 2
+
+
+def test_slides_vector_store_inputs_returns_metadata_dicts():
+    from tests.test_doc_extract import make_pptx
+
+    from pathway_tpu.xpacks.llm.vector_store import SlidesVectorStoreServer
+
+    schema = sch.schema_from_types(data=bytes, _metadata=pw.Json)
+    docs = table_from_rows(schema, [
+        (make_pptx([["alpha"]]),
+         Json({"path": "/a.pptx", "b64_image": "A" * 64, "owner": "ann"})),
+        (make_pptx([["beta"]]),
+         Json({"path": "/b.pptx", "image_base64": "B" * 64})),
+    ])
+    store = SlidesVectorStoreServer(docs, embedder=fake_embedder)
+    inputs_q = table_from_rows(
+        sch.schema_from_types(metadata_filter=type(None),
+                              filepath_globpattern=type(None)),
+        [(None, None)])
+    rows = _result_rows(store.inputs_query(inputs_q))
+    listing = sorted(rows[0]["result"].value, key=lambda d: d["path"])
+    assert [d["path"] for d in listing] == ["/a.pptx", "/b.pptx"]
+    assert listing[0]["owner"] == "ann"      # full metadata, not paths
+    # bulky image payloads are stripped from the listing
+    assert "b64_image" not in listing[0]
+    assert "image_base64" not in listing[1]
+
+    glob_q = table_from_rows(
+        sch.schema_from_types(metadata_filter=type(None),
+                              filepath_globpattern=str),
+        [(None, "/b*")])
+    rows2 = _result_rows(store.inputs_query(glob_q))
+    assert [d["path"] for d in rows2[0]["result"].value] == ["/b.pptx"]
+
+
+def test_parse_slides_non_deck_fallback():
+    from pathway_tpu.xpacks.llm.vector_store import parse_slides
+
+    out = parse_slides(b"plain notes, not a deck")
+    assert len(out) == 1
+    text, meta = out[0]
+    assert "plain notes" in text
+    assert meta["page"] == 1 and meta["total_pages"] == 1

@@ -956,3 +956,87 @@ def test_webserver_shed_503_carries_id_and_retry_after():
     assert err.code == 503
     assert err.headers["X-Pathway-Request-Id"] == "rid-web-9"
     assert err.headers["Retry-After"] == "3"
+
+
+# ---------------------------------------------------------------------------
+# semantic result cache exposition (engine/result_cache.py)
+# ---------------------------------------------------------------------------
+
+def test_result_cache_metrics_exposed():
+    """A live result cache surfaces the pathway_tpu_cache_* families
+    (passing the exposition lint ridden by _parse_samples) plus the
+    /status result_cache section."""
+    import numpy as np
+
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    idx = BruteForceKnnIndex(4, reserved_space=16)
+    assert idx.result_cache is not None
+    idx.add_batch([i for i in range(4)], np.eye(4, dtype=np.float32))
+    q = np.ones(4, np.float32)
+    idx.search([(0, q, 2, None)])
+    fp = b"\x00" * 16
+    idx.result_cache.fill(fp, ((1, 0.5),), frozenset({0}), 0.5, q)
+    assert idx.result_cache.lookup(fp) is not None      # one hit
+    idx.result_cache.lookup(b"\x01" * 16)               # one miss
+    lines = _metrics_lines(_FakeRuntime())
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    samples = {f: (labels, v) for f, labels, v in _parse_samples(lines)}
+    for fam in ("pathway_tpu_cache_hits", "pathway_tpu_cache_misses",
+                "pathway_tpu_cache_invalidations",
+                "pathway_tpu_cache_entries", "pathway_tpu_cache_hit_ratio",
+                "pathway_tpu_cache_evictions",
+                "pathway_tpu_cache_index_version",
+                "pathway_tpu_cache_invalidations_per_tick"):
+        assert fam in samples, fam
+        assert fam in typed, f"{fam} has no # TYPE line"
+    assert samples["pathway_tpu_cache_hits"][1] >= 1
+    assert samples["pathway_tpu_cache_misses"][1] >= 1
+    assert 0.0 <= samples["pathway_tpu_cache_hit_ratio"][1] <= 1.0
+    server = MonitoringHttpServer(_FakeRuntime(), port=0)
+    st = server.status_payload()
+    assert st["result_cache"]["entries"] >= 1
+    assert st["result_cache"]["hits"] >= 1
+    del idx  # release the live cache so later exposition tests are clean
+
+
+def test_router_cache_metrics_and_status():
+    """The router's fleet-cache families ride its /metrics body under
+    the same exposition contract, and /status carries result_cache with
+    the configured routes + watermark liveness."""
+    import socket as _socket
+
+    from pathway_tpu.engine.router import QueryRouter, ReplicaEndpoint
+    from pathway_tpu.engine.result_cache import RouterResultCache
+
+    router = QueryRouter(cache_routes=("/query",))
+    a, _b = _socket.socketpair()
+    ep = ReplicaEndpoint("r0", "replica", "127.0.0.1", 1, a)
+    ep.index_version = 7
+    router._endpoints[ep.replica_id] = ep
+    wm = router._fleet_watermark()
+    assert wm == frozenset({("r0", 7)})
+    key = RouterResultCache.key("POST", "/query", b"{}")
+    router.response_cache.fill(key, wm, 200, b"ok", "application/json")
+    assert router.response_cache.lookup(key, wm) is not None
+    lines = router.metrics_payload().splitlines()
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    seen = {}
+    for f, labels, v in _parse_samples(lines):
+        assert f in typed, f"router family {f!r} has no # TYPE line"
+        seen.setdefault(f, []).append((labels, v))
+    for fam in ("pathway_tpu_router_cache_hits",
+                "pathway_tpu_router_cache_misses",
+                "pathway_tpu_router_cache_invalidations",
+                "pathway_tpu_router_cache_entries",
+                "pathway_tpu_router_cache_hit_ratio",
+                "pathway_tpu_replica_index_version"):
+        assert fam in seen, fam
+    assert seen["pathway_tpu_router_cache_hits"][0][1] >= 1
+    assert seen["pathway_tpu_router_cache_entries"][0][1] == 1
+    (labels, v), = seen["pathway_tpu_replica_index_version"]
+    assert labels["replica"] == "r0" and v == 7
+    st = router.status_payload()
+    assert st["result_cache"]["routes"] == ["/query"]
+    assert st["result_cache"]["watermark_live"] is True
+    assert st["result_cache"]["entries"] == 1

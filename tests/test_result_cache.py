@@ -1,0 +1,361 @@
+"""Semantic result cache (engine/result_cache.py): keying, incremental
+delta invalidation, and the operator/router integration contracts.
+
+Pins the subsystem's load-bearing guarantees:
+
+- **byte-identity** — a cache-enabled run emits *exactly* the deltas a
+  cache-disabled run emits, across seeded index churn (insert, delete,
+  slab growth) interleaved with a Zipf-repeated query stream;
+- **no stale serve** — a delta landing in a cached entry's touched page
+  set that can beat its k-th score invalidates the entry before the
+  next serve (the staleness window is zero ticks, not a TTL);
+- **incremental survival** — deltas that provably cannot change an
+  answer (outside the beat margin, or uncovered by the entry's page
+  set only when they cannot enter it) leave the entry hot;
+- **router watermark fencing** — the fleet-level response cache serves
+  only under an unchanged (replica, index_version) watermark and drops
+  entries the moment the watermark moves.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine.delta import Delta
+from pathway_tpu.engine.index_ops import ExternalIndexOperator
+from pathway_tpu.engine.result_cache import (ResultCache, RouterResultCache,
+                                             fingerprint, live_cache_stats,
+                                             maybe_result_cache,
+                                             result_cache_enabled)
+from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+
+def _operator(idx, **kw):
+    return ExternalIndexOperator(idx, data_vec_pos=0, data_filter_pos=None,
+                                 query_vec_pos=0, query_limit_pos=1,
+                                 query_filter_pos=None, **kw)
+
+
+def _step(op, t, data=(), queries=()):
+    return op.step(t, [Delta(list(data)), Delta(list(queries))])
+
+
+# ---------------------------------------------------------------------------
+# unit: keying + invalidation rules
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_covers_vector_and_limit():
+    v = np.arange(4, dtype=np.float32)
+    assert fingerprint(v, 3) == fingerprint(v.copy(), 3)
+    assert fingerprint(v, 3) != fingerprint(v, 4)
+    w = v.copy()
+    w[0] += 1e-6
+    assert fingerprint(v, 3) != fingerprint(w, 3)
+
+
+def test_env_knob_disables_cache(monkeypatch):
+    monkeypatch.setenv("PATHWAY_RESULT_CACHE", "0")
+    assert result_cache_enabled() is False
+    idx = BruteForceKnnIndex(4, reserved_space=16)
+    assert idx.result_cache is None
+    monkeypatch.setenv("PATHWAY_RESULT_CACHE", "1")
+    assert maybe_result_cache(BruteForceKnnIndex(4, reserved_space=16)) \
+        is not None
+
+
+def test_far_insert_survives_near_insert_dooms():
+    cache = ResultCache(page_rows=8, metric="l2sq")
+    q = np.zeros(4, np.float32)
+    reply = ((b"a", 1.0), (b"b", 2.0))
+    cache.fill(fingerprint(q, 2), reply, frozenset({0, 1}), 2.0, q)
+    # covered page, but distance 100^2*4 >> kth: entry survives
+    cache.on_insert_batch(np.array([3]), [b"z"],
+                          np.full((1, 4), 100.0, np.float32))
+    assert cache.lookup(fingerprint(q, 2)) == reply
+    # covered page and inside the k-th radius: entry is doomed
+    cache.on_insert_batch(np.array([4]), [b"y"],
+                          np.zeros((1, 4), np.float32))
+    assert cache.lookup(fingerprint(q, 2)) is None
+    assert cache.invalidations == 1
+
+
+def test_uncovered_page_insert_always_invalidates():
+    cache = ResultCache(page_rows=8, metric="l2sq")
+    q = np.zeros(4, np.float32)
+    cache.fill(fingerprint(q, 1), ((b"a", 1.0),), frozenset({0}), 1.0, q)
+    # slot 80 -> page 10, outside the entry's coverage: the scan that
+    # filled the entry never saw that page, so distance is no defence
+    cache.on_insert_batch(np.array([80]), [b"far"],
+                          np.full((1, 4), 50.0, np.float32))
+    assert cache.lookup(fingerprint(q, 1)) is None
+
+
+def test_short_reply_always_beatable():
+    # reply shorter than the limit (kth=None): any covered insert wins
+    cache = ResultCache(page_rows=8, metric="l2sq")
+    q = np.zeros(4, np.float32)
+    cache.fill(fingerprint(q, 5), ((b"a", 1.0),), frozenset({0}), None, q)
+    cache.on_insert_batch(np.array([1]), [b"b"],
+                          np.full((1, 4), 99.0, np.float32))
+    assert cache.lookup(fingerprint(q, 5)) is None
+
+
+def test_reinsert_of_reply_key_invalidates():
+    cache = ResultCache(page_rows=8, metric="l2sq")
+    q = np.zeros(4, np.float32)
+    cache.fill(fingerprint(q, 1), ((b"a", 1.0),), frozenset({0}), 1.0, q)
+    # upsert of a key already present in the reply must doom the entry
+    # even when the new vector is far away (the old row is replaced)
+    cache.on_insert_batch(np.array([2]), [b"a"],
+                          np.full((1, 4), 70.0, np.float32))
+    assert cache.lookup(fingerprint(q, 1)) is None
+
+
+def test_delete_invalidates_by_page_membership():
+    cache = ResultCache(page_rows=8, metric="l2sq")
+    q = np.zeros(4, np.float32)
+    cache.fill(fingerprint(q, 1), ((b"a", 1.0),), frozenset({0, 1}), 1.0, q)
+    cache.on_delete(80, b"other")          # page 10: uncovered, survives
+    assert cache.lookup(fingerprint(q, 1)) is not None
+    cache.on_delete(9, b"other")           # page 1: covered, doomed
+    assert cache.lookup(fingerprint(q, 1)) is None
+
+
+def test_lru_eviction_bounds_entries():
+    cache = ResultCache(page_rows=8, metric="l2sq", max_entries=4)
+    for i in range(10):
+        q = np.full(4, float(i), np.float32)
+        cache.fill(fingerprint(q, 1), ((b"k", 0.0),), frozenset({0}), 0.0, q)
+    assert cache.stats()["entries"] == 4
+    assert cache.evictions == 6
+
+
+def test_cosine_metric_beat_test():
+    cache = ResultCache(page_rows=8, metric="cos")
+    q = np.array([1.0, 0.0, 0.0, 0.0], np.float32)
+    # kth cosine distance 0.5: orthogonal insert (dist 1.0) survives,
+    # parallel insert (dist 0.0) dooms
+    cache.fill(fingerprint(q, 2), ((b"a", 0.1), (b"b", 0.5)),
+               frozenset({0}), 0.5, q)
+    cache.on_insert_batch(np.array([1]), [b"c"],
+                          np.array([[0.0, 1.0, 0.0, 0.0]], np.float32))
+    assert cache.lookup(fingerprint(q, 2)) is not None
+    cache.on_insert_batch(np.array([2]), [b"d"],
+                          np.array([[2.0, 0.0, 0.0, 0.0]], np.float32))
+    assert cache.lookup(fingerprint(q, 2)) is None
+
+
+# ---------------------------------------------------------------------------
+# operator integration: staleness + byte-identity under churn
+# ---------------------------------------------------------------------------
+
+def test_covering_delta_invalidates_before_next_serve():
+    """The ISSUE's staleness pin: a delta landing in a touched page that
+    beats the k-th score must be visible to the very next serve."""
+    idx = BruteForceKnnIndex(4, reserved_space=64)
+    op = _operator(idx)
+    rng = np.random.default_rng(7)
+    base = rng.normal(size=(20, 4)).astype(np.float32) + 10.0
+    _step(op, 0, data=[(i, (base[i],), 1) for i in range(20)])
+    q = np.zeros(4, np.float32)
+    out1 = _step(op, 1, queries=[(100, (q, 2), 1)])
+    out2 = _step(op, 2, queries=[(101, (q, 2), 1)])
+    st = idx.result_cache.stats()
+    assert st["hits"] == 1 and st["entries"] == 1
+    # ingest an exact match for q: beats kth, lands in a touched page
+    _step(op, 3, data=[(999, (q.copy(),), 1)])
+    assert idx.result_cache.stats()["entries"] == 0
+    out3 = _step(op, 4, queries=[(102, (q, 2), 1)])
+    reply = list(out3.entries)[0][1][0]
+    assert reply[0][0] == 999                  # fresh row is served
+    assert list(out1.entries)[0][1] == list(out2.entries)[0][1]
+    assert list(out3.entries)[0][1] != list(out1.entries)[0][1]
+
+
+def test_delete_of_served_row_invalidates_before_next_serve():
+    idx = BruteForceKnnIndex(4, reserved_space=64)
+    op = _operator(idx)
+    vecs = np.eye(4, dtype=np.float32)
+    _step(op, 0, data=[(i, (vecs[i],), 1) for i in range(4)])
+    q = np.array([1.0, 0.0, 0.0, 0.0], np.float32)
+    out1 = _step(op, 1, queries=[(100, (q, 1), 1)])
+    assert list(out1.entries)[0][1][0][0][0] == 0
+    _step(op, 2, data=[(0, (vecs[0],), -1)])   # retract the best row
+    out2 = _step(op, 3, queries=[(101, (q, 1), 1)])
+    assert list(out2.entries)[0][1][0][0][0] != 0
+
+
+def test_duplicate_queries_in_one_tick_share_one_miss():
+    idx = BruteForceKnnIndex(4, reserved_space=64)
+    op = _operator(idx)
+    _step(op, 0, data=[(i, (np.full(4, float(i), np.float32),), 1)
+                       for i in range(8)])
+    q = np.ones(4, np.float32)
+    out = _step(op, 1, queries=[(100, (q, 2), 1), (101, (q, 2), 1),
+                                (102, (q, 2), 1)])
+    rows = {k: row for k, row, _d in out.entries}
+    assert rows[100] == rows[101] == rows[102]
+    assert idx.result_cache.fills == 1         # one search, two reuses
+
+
+def _churn_run(seed, cache_on, monkeypatch):
+    monkeypatch.setenv("PATHWAY_RESULT_CACHE", "1" if cache_on else "0")
+    idx = BruteForceKnnIndex(6, reserved_space=32)   # small: forces growth
+    assert (idx.result_cache is not None) is cache_on
+    op = _operator(idx)
+    rng = np.random.default_rng(seed)
+    qpool = rng.normal(size=(24, 6)).astype(np.float32)
+    live, next_key, next_q = [], 0, 10_000
+    outputs = []
+    for t in range(40):
+        data = []
+        n_ins = int(rng.integers(0, 7))      # growth past 32 reserved rows
+        for _ in range(n_ins):
+            vec = rng.normal(size=6).astype(np.float32)
+            data.append((next_key, (vec,), 1))
+            live.append((next_key, vec))
+            next_key += 1
+        if live and rng.random() < 0.35:
+            j = int(rng.integers(0, len(live)))
+            key, vec = live.pop(j)
+            data.append((key, (vec,), -1))
+        queries = []
+        for _ in range(int(rng.integers(0, 4))):
+            qi = min(int(rng.zipf(1.3)) - 1, len(qpool) - 1)  # hot head
+            queries.append((next_q, (qpool[qi], 3), 1))
+            next_q += 1
+        outputs.append(sorted(_step(op, t, data=data, queries=queries)
+                              .entries))
+    if cache_on:
+        st = idx.result_cache.stats()
+        assert st["hits"] > 0                # the Zipf head actually hit
+        assert st["invalidations"] > 0       # churn actually invalidated
+    return outputs
+
+
+def test_property_cache_on_byte_identical_to_cache_off(monkeypatch):
+    """The acceptance pin: across seeded insert/delete/grow churn with a
+    Zipf query stream, the cache changes *when* work happens, never
+    *what* is emitted."""
+    for seed in (3, 11, 42):
+        on = _churn_run(seed, True, monkeypatch)
+        off = _churn_run(seed, False, monkeypatch)
+        assert on == off
+
+
+def test_data_tick_bumps_version_once(monkeypatch):
+    monkeypatch.setenv("PATHWAY_RESULT_CACHE", "1")
+    idx = BruteForceKnnIndex(4, reserved_space=16)
+    op = _operator(idx)
+    v0 = idx.result_cache.version
+    _step(op, 0, data=[(0, (np.zeros(4, np.float32),), 1)])
+    assert idx.result_cache.version == v0 + 1
+    _step(op, 1, queries=[(100, (np.zeros(4, np.float32), 1), 1)])
+    assert idx.result_cache.version == v0 + 1      # queries do not bump
+    st = live_cache_stats()
+    assert st is not None and st["version"] >= v0 + 1
+
+
+def test_cache_hits_feed_qos_coalescing_counter():
+    from pathway_tpu.engine.qos import (QosConfig, QosController,
+                                        install_controller)
+
+    class _Tracker:
+        slo_ms = 20.0
+
+        def burn_rate(self):
+            return 0.0
+
+        def window_size(self):
+            return 0
+
+        def quantiles_ms(self):
+            return None
+
+    ctl = QosController(QosConfig(), _Tracker())
+    install_controller(ctl)
+    try:
+        idx = BruteForceKnnIndex(4, reserved_space=16)
+        op = _operator(idx)
+        _step(op, 0, data=[(i, (np.full(4, float(i), np.float32),), 1)
+                           for i in range(4)])
+        q = np.ones(4, np.float32)
+        _step(op, 1, queries=[(100, (q, 2), 1)])
+        _step(op, 2, queries=[(101, (q, 2), 1)])
+        assert ctl.coalesced_answers == 1
+        assert ctl.summary()["coalesced_answers"] == 1
+        assert ctl.heartbeat_state()["coalesced_answers"] == 1
+    finally:
+        install_controller(None)
+
+
+def test_filtered_queries_bypass_the_cache():
+    idx = BruteForceKnnIndex(4, reserved_space=16)
+    op = ExternalIndexOperator(idx, data_vec_pos=0, data_filter_pos=1,
+                               query_vec_pos=0, query_limit_pos=1,
+                               query_filter_pos=2)
+    _step(op, 0, data=[(i, (np.full(4, float(i), np.float32), "x"), 1)
+                       for i in range(4)])
+    q = np.zeros(4, np.float32)
+    _step(op, 1, queries=[(100, (q, 2, "x == `x`"), 1)])
+    _step(op, 2, queries=[(101, (q, 2, "x == `x`"), 1)])
+    st = idx.result_cache.stats()
+    assert st["hits"] == 0 and st["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# router fleet cache: watermark fencing
+# ---------------------------------------------------------------------------
+
+def test_router_cache_serves_only_under_held_watermark():
+    rc = RouterResultCache()
+    key = RouterResultCache.key("POST", "/query", b'{"q": 1}')
+    wm1 = frozenset({("r0", 3), ("r1", 3)})
+    assert rc.lookup(key, wm1) is None
+    rc.fill(key, wm1, 200, b"answer", "application/json")
+    assert rc.lookup(key, wm1) == (200, b"answer", "application/json")
+    # watermark moved (one replica advanced): entry is dropped, miss
+    wm2 = frozenset({("r0", 4), ("r1", 3)})
+    assert rc.lookup(key, wm2) is None
+    assert rc.invalidations == 1
+    assert rc.lookup(key, wm2) is None          # really gone
+    # unknown watermark (replica without index_version): no serve, no fill
+    rc.fill(key, None, 200, b"answer", "application/json")
+    assert rc.lookup(key, None) is None
+    assert rc.stats()["entries"] == 0
+
+
+def test_router_cache_key_separates_method_path_body():
+    k = RouterResultCache.key
+    assert k("POST", "/query", b"a") == k("POST", "/query", b"a")
+    assert k("POST", "/query", b"a") != k("GET", "/query", b"a")
+    assert k("POST", "/query", b"a") != k("POST", "/query2", b"a")
+    assert k("POST", "/query", b"a") != k("POST", "/query", b"b")
+
+
+def test_router_cache_lru_eviction(monkeypatch):
+    monkeypatch.setenv("PATHWAY_ROUTER_CACHE_ENTRIES", "3")
+    rc = RouterResultCache()
+    wm = frozenset({("r0", 1)})
+    for i in range(6):
+        rc.fill(RouterResultCache.key("POST", "/query", b"%d" % i),
+                wm, 200, b"x", "application/json")
+    assert rc.stats()["entries"] == 3
+
+
+def test_router_cache_path_and_watermark_plumbing():
+    from pathway_tpu.engine.router import QueryRouter, ReplicaEndpoint
+
+    router = QueryRouter(write_paths=("/ingest",), cache_routes=("/query",))
+    assert router.response_cache is not None
+    assert router.is_cache_path("/query")
+    assert router.is_cache_path("/query/v2")
+    assert not router.is_cache_path("/ingest")
+    assert router._fleet_watermark() is None       # no replicas alive
+    ep = ReplicaEndpoint("r0", "replica", "127.0.0.1", 1, None)
+    ep.index_version = 5
+    router._endpoints["r0"] = ep
+    assert router._fleet_watermark() == frozenset({("r0", 5)})
+    ep.index_version = None                        # version unknown: fenced
+    assert router._fleet_watermark() is None
