@@ -45,8 +45,16 @@ def make_docs(n: int, seed: int = 0) -> list[str]:
 
 
 # Peak bf16 throughput used for the MFU estimate (v5e ≈ 197 TFLOP/s;
-# override with BENCH_PEAK_TFLOPS for other chips)
-PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", 197.0))
+# override with BENCH_PEAK_TFLOPS for other chips). Resolved through the
+# shared machine-parameter table (engine/profiler.py) so the bench and
+# the live roofline gauges always describe the same chip.
+def _peak_tflops() -> float:
+    from pathway_tpu.engine.profiler import machine_params
+
+    return machine_params()["peak_tflops"]
+
+
+PEAK_TFLOPS = _peak_tflops()
 # Wall-clock budget for the device-leg subprocess (embed + 10M-slab knn)
 # per-group wall-clock budget, TOTAL across its retries (healthy runs:
 # embed+framework ≈ 6 min, knn incl. int8 ≈ 15 min — well inside)
@@ -61,13 +69,14 @@ DEVICE_DEADLINE_S = float(os.environ.get("BENCH_DEVICE_DEADLINE", 3000.0))
 
 
 def _encoder_flops_per_token(config, seq: int = SEQ) -> float:
-    """Forward FLOPs/token for the encoder: 2*(non-embedding params) for
-    the matmuls + the attention-score/value term (4*S*h per token per
-    layer, S the PADDED width actually dispatched)."""
-    h, f, L = config.hidden, config.intermediate, config.layers
-    per_layer = 2 * (4 * h * h + 2 * h * f)  # qkv+out proj, ffn up+down
-    attn = L * 4 * seq * h  # scores + weighted values, both 2*S*h
-    return float(L * per_layer + attn)
+    """Forward FLOPs/token for the encoder — resolved through the SHARED
+    cost model (engine/profiler.py): the profiler's MFU gauges and the
+    bench's MFU numbers are the same formula by construction, which
+    tests/test_profiler.py pins (no drift between copies)."""
+    from pathway_tpu.engine.profiler import encoder_flops_per_token
+
+    return encoder_flops_per_token(config.hidden, config.intermediate,
+                                   config.layers, seq)
 
 
 _LEG_FNS = {
@@ -230,6 +239,25 @@ def _append_bench_history(leg: str, metrics: dict) -> None:
         append_bench_history(leg, metrics)
     except Exception:  # noqa: BLE001 — evidence must never kill a leg
         pass
+    _maybe_profile_epoch(leg)
+
+
+# --profile: one cost-model + host-flamegraph snapshot per completed leg
+# (engine/profiler.py profile_epoch), embedded as the "profile" key of
+# the emitted BENCH_*.json line — the input `python -m pathway_tpu
+# profdiff A.json B.json` compares when --check-regression flags a leg
+_PROFILE_EPOCHS: list = []
+
+
+def _maybe_profile_epoch(leg: str) -> None:
+    try:
+        from pathway_tpu.engine.profiler import current_profiler
+
+        prof = current_profiler()
+        if prof is not None and "--profile" in sys.argv:
+            _PROFILE_EPOCHS.append({"leg": leg, **prof.profile_epoch()})
+    except Exception:  # noqa: BLE001 — evidence must never kill a leg
+        pass
 
 
 # per-metric direction overrides for series the name heuristics cannot
@@ -305,11 +333,18 @@ def check_regression_main(argv: list[str]) -> int:
 
     opts = {"--history": None, "--window": "8", "--min-prior": "3",
             "--tolerance": None}
+    profdiff_args: list[str] = []
     i = 0
     while i < len(argv):
         if argv[i] in opts and i + 1 < len(argv):
             opts[argv[i]] = argv[i + 1]
             i += 2
+        elif argv[i] == "--profdiff" and i + 2 < len(argv):
+            # name the dominant frame/kernel delta between a baseline
+            # --profile artifact and the flagged run's (profdiff below
+            # runs only when a regression actually fires)
+            profdiff_args = [argv[i + 1], argv[i + 2]]
+            i += 3
         else:
             i += 1
     path = history_path(opts["--history"])
@@ -335,6 +370,30 @@ def check_regression_main(argv: list[str]) -> int:
               f"{direction} trailing median {r['median']} beyond the "
               f"{r['tolerance']:.0%} band (ratio {r['ratio']}, "
               f"{r['n_prior']} prior points)", file=sys.stderr)
+    if regs and profdiff_args:
+        # a regression fired and two --profile artifacts were offered:
+        # name the dominant frame/kernel delta (engine/profiler.py)
+        try:
+            from pathway_tpu.engine.profiler import diff_profiles
+
+            with open(profdiff_args[0]) as f:
+                a = json.load(f)
+            with open(profdiff_args[1]) as f:
+                b = json.load(f)
+            diff = diff_profiles(a, b)
+            dk, df = diff["dominant_kernel"], diff["dominant_frame"]
+            if dk is not None:
+                print(f"PROFDIFF dominant kernel: {dk['family']} "
+                      f"{dk['device_ms_per_dispatch_a']} -> "
+                      f"{dk['device_ms_per_dispatch_b']} ms/dispatch "
+                      f"({dk['bound_by']}-bound)", file=sys.stderr)
+            if df is not None:
+                print(f"PROFDIFF dominant frame: {df['frame']} "
+                      f"share {df['share_a']} -> {df['share_b']}",
+                      file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — attribution is advisory
+            print(f"PROFDIFF unavailable: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     return 1 if regs else 0
 
 
@@ -670,6 +729,20 @@ def main() -> None:
 
     maybe_enable_compilation_cache()
 
+    if "--profile" in sys.argv:
+        # continuous profiler ON for the whole run: cost-model hooks in
+        # the legs feed the per-family aggregates; one profile epoch is
+        # snapped per completed leg (_maybe_profile_epoch) and embedded
+        # under the "profile" key of the emitted artifact
+        from pathway_tpu.engine.profiler import (Profiler, current_profiler,
+                                                 install_profiler)
+
+        if current_profiler() is None:
+            _prof = Profiler()
+            install_profiler(_prof)
+            _prof.start()
+        os.environ.setdefault("PATHWAY_PROFILER", "1")  # child processes
+
     result: dict = {}
     errors: dict = {}
 
@@ -806,6 +879,11 @@ def main() -> None:
             # leg's operator + seconds-since-dispatch) from the child's
             # flight beacon — see _flight_note
             err["device_phase"] = note
+        extra = {}
+        if _PROFILE_EPOCHS:
+            # --profile: per-leg cost-model + flamegraph epochs, the
+            # `python -m pathway_tpu profdiff` input
+            extra["profile"] = _PROFILE_EPOCHS
         print(json.dumps({
             "metric": "RAG docs/sec/chip (embed+index); p50 KNN @10M",
             "value": None if docs_per_sec is None else round(docs_per_sec, 1),
@@ -813,6 +891,7 @@ def main() -> None:
             "vs_baseline": None if docs_per_sec is None else round(
                 docs_per_sec / BASELINE_DOCS_PER_SEC_PER_CHIP, 3),
             **{k: v for k, v in result.items() if k != "docs_per_s"},
+            **extra,
             **err,
         }), flush=True)
 

@@ -682,6 +682,59 @@ def trace_merge(paths, out_path):
         err=True)
 
 
+@cli.command("profdiff")
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the full structured diff as JSON on stdout")
+@click.argument("baseline", type=str)
+@click.argument("flagged", type=str)
+def profdiff(baseline, flagged, as_json):
+    """Name the dominant frame/kernel delta between two profiled runs.
+
+    BASELINE and FLAGGED are ``bench.py --profile`` artifacts
+    (BENCH_*.json with embedded ``profile`` epochs) or bare profile
+    epochs; the comparison (engine/profiler.py diff_profiles) ranks
+    per-kernel-family device-ms-per-dispatch deltas and per-host-frame
+    sample-share deltas, so a flagged ``--check-regression`` run gets a
+    culprit name instead of just a number."""
+    from pathway_tpu.engine.profiler import diff_profiles
+
+    docs = []
+    for p in (baseline, flagged):
+        try:
+            with open(p) as f:
+                docs.append(json.load(f))
+        except (OSError, ValueError) as e:
+            raise click.UsageError(f"cannot read {p}: {e}")
+    try:
+        diff = diff_profiles(docs[0], docs[1])
+    except ValueError as e:
+        raise click.UsageError(str(e))
+    if as_json:
+        click.echo(json.dumps(diff, indent=2))
+        return
+    dk = diff["dominant_kernel"]
+    if dk is not None:
+        click.echo(
+            f"dominant kernel delta: {dk['family']} "
+            f"{dk['device_ms_per_dispatch_a']} -> "
+            f"{dk['device_ms_per_dispatch_b']} ms/dispatch"
+            + (f" (x{dk['ratio']})" if dk.get("ratio") else "")
+            + (f", {dk['bound_by']}-bound" if dk.get("bound_by") else ""))
+    df = diff["dominant_frame"]
+    if df is not None:
+        click.echo(f"dominant host frame delta: {df['frame']} "
+                   f"sample share {df['share_a']} -> {df['share_b']}")
+    if "mfu_rolling_delta" in diff:
+        click.echo(f"rolling MFU delta: {diff['mfu_rolling_delta']:+}")
+    for row in diff["kernel_deltas"][:6]:
+        click.echo(f"  kernel {row['family']}: "
+                   f"{row['delta_ms_per_dispatch']:+} ms/dispatch",
+                   err=True)
+    for row in diff["frame_deltas"][:6]:
+        click.echo(f"  frame {row['frame']}: {row['delta_share']:+} share",
+                   err=True)
+
+
 @cli.command()
 def spawn_from_env():
     """Run ``spawn`` with arguments taken from PATHWAY_SPAWN_ARGS

@@ -51,6 +51,7 @@ import weakref
 from collections import deque
 from typing import Callable
 
+from pathway_tpu.engine.profiler import current_profiler
 from pathway_tpu.testing import faults
 
 # live bridges (weak: a bridge dies with its scheduler). Out-of-band
@@ -279,6 +280,13 @@ class DeviceBridge:
             recording = rec is not None and rec.enabled
             if recording:
                 rec.mark_leg(tick)
+            # profiler leg context: kernel dispatches recorded while fn()
+            # runs are buffered on this thread and re-timed to the leg's
+            # MEASURED execute span at end_leg — the cost model's device
+            # time comes from here, not from async call-site walls
+            prof = current_profiler()
+            if prof is not None:
+                prof.begin_leg(tick)
             started = _time.perf_counter()
             try:
                 # fault points at the new watermark boundaries
@@ -303,6 +311,8 @@ class DeviceBridge:
                         attach_note(
                             e, f"device leg poisoned at tick {tick}; "
                                f"flight recorder tail:\n{tail}")
+                if prof is not None:
+                    prof.end_leg(None)  # failed leg: no measured time
                 with self._cv:
                     self._error = e
                     self._running = False
@@ -312,6 +322,8 @@ class DeviceBridge:
                     self._cv.notify_all()
                 continue  # keep serving barrier wake-ups until close
             finished = _time.perf_counter()
+            if prof is not None:
+                prof.end_leg((finished - started) * 1e3)
             if recording:
                 rec.record_leg(tick, (started - submitted_at) * 1e3,
                                (finished - started) * 1e3)

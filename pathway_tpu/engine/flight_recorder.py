@@ -126,6 +126,18 @@ def live_inflight() -> dict | None:
     return None
 
 
+def live_inflight_by_thread() -> dict:
+    """{thread ident: (leg, operator name)} for every live enabled
+    recorder's in-flight operators — the profiler's host sampler reads
+    this to tag samples with the operator the sampled thread was
+    stepping (engine/profiler.py). Empty dict when nothing records."""
+    out: dict = {}
+    for rec in list(_LIVE):
+        if rec.enabled:
+            out.update(rec.inflight_by_thread())
+    return out
+
+
 def attach_note(e: BaseException, note: str) -> None:
     """PEP 678 note with the pre-3.11 emulation (same storage contract as
     internals/trace.py add_trace_note, shared here so exceptions raised on
@@ -263,6 +275,21 @@ class FlightRecorder:
 
     def clear_op(self) -> None:
         self._inflight_op.pop(threading.get_ident(), None)
+
+    def inflight_by_thread(self) -> dict:
+        """{thread ident: (leg, operator name)} of operators currently
+        being stepped, keyed by the stepping thread. Read lock-free by
+        the profiler's sampler: _inflight_op is only ever mutated by
+        single-item dict ops, so a racy read sees either the old or the
+        new entry, both of which were true moments ago."""
+        out = {}
+        for ident, slot in list(self._inflight_op.items()):
+            try:
+                tick, leg, node, _t0 = slot
+            except (TypeError, ValueError):
+                continue
+            out[ident] = (leg, node.name or type(node.op).__name__)
+        return out
 
     def record(self, tick: int, node, leg: str, t0: float, dur_ms: float,
                rows_in: int, rows_out: int) -> None:

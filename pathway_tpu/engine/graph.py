@@ -20,6 +20,7 @@ from typing import Callable
 
 from pathway_tpu.engine.delta import Arrangement, Delta, row_fingerprint
 from pathway_tpu.engine.operators import Exchange, Operator, SourceOperator
+from pathway_tpu.engine.profiler import current_profiler
 from pathway_tpu.internals.keys import Pointer, hash_values
 
 
@@ -503,12 +504,31 @@ class Scheduler:
             # loop). Armed only while requests are actually in flight.
             requests = self._tracked_requests()
             host_pending = requests is not None
+            prof = current_profiler()
             for node in self._topo:
                 if host_pending and node.id in self._trace_device_ids:
                     requests.host_done(time)
                     host_pending = False
                 in_deltas = [outputs.get(up.id, _EMPTY) for up in node.inputs]
-                delta = self._step_op(node, node.op, time, in_deltas, flush)
+                if prof is not None and node.id in self._trace_device_ids:
+                    # sync mode has no bridge leg to measure: treat each
+                    # device node's step as its own leg so cost-model
+                    # dispatches inside are re-timed to the step's
+                    # measured wall (engine/profiler.py)
+                    import time as _time
+
+                    prof.begin_leg(time)
+                    t0 = _time.perf_counter()
+                    try:
+                        delta = self._step_op(node, node.op, time,
+                                              in_deltas, flush)
+                    except BaseException:
+                        prof.end_leg(None)
+                        raise
+                    prof.end_leg((_time.perf_counter() - t0) * 1e3)
+                else:
+                    delta = self._step_op(node, node.op, time, in_deltas,
+                                          flush)
                 outputs[node.id] = delta
                 self._count(node.id, delta)
             if host_pending:

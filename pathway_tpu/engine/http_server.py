@@ -43,6 +43,17 @@ def _cache_stats() -> dict | None:
         return None
 
 
+def _profiler_stats() -> dict | None:
+    """Continuous-profiler snapshot (engine/profiler.py), or None when
+    no profiler is installed in this process."""
+    try:
+        from pathway_tpu.engine.profiler import live_profiler_stats
+
+        return live_profiler_stats()
+    except Exception:
+        return None
+
+
 class MonitoringHttpServer:
     def __init__(self, runtime, port: int | None = None):
         self.runtime = runtime
@@ -144,6 +155,13 @@ class MonitoringHttpServer:
             # invalidation counters, entry count, the index-version
             # watermark riding the heartbeats, invalidations per tick
             payload["result_cache"] = rc
+        prof = _profiler_stats()
+        if prof is not None:
+            # continuous profiling plane (engine/profiler.py): host
+            # sampler state + per-kernel-family cost-model aggregates
+            # with the roofline classification (arithmetic intensity vs
+            # machine balance, compute- vs bandwidth-bound)
+            payload["profiler"] = prof
         persistence = getattr(self.runtime, "persistence", None)
         if persistence is not None:
             # commit-watermark durability (engine/persistence.py): how
@@ -334,6 +352,29 @@ class MonitoringHttpServer:
             lines.append("# TYPE pathway_tpu_slo_burn_rate gauge")
             lines.append(
                 f"pathway_tpu_slo_burn_rate {round(tracker.burn_rate(), 6)}")
+            tenants = tracker.tenant_summary()
+            if tenants:
+                # per-tenant serving SLOs (the multi-tenant isolation
+                # surface): e2e quantiles under the SAME summary family
+                # as above, split by the tenant the searched index
+                # belongs to, plus each tenant's own burn rate
+                for tenant, ts in sorted(tenants.items()):
+                    tlab = f'tenant="{esc(tenant)}"'
+                    for q, v in (("0.5", ts["p50_ms"]),
+                                 ("0.95", ts["p95_ms"])):
+                        if v is not None:
+                            lines.append(
+                                "pathway_tpu_query_e2e_latency_ms"
+                                f'{{{tlab},quantile="{q}"}} {v}')
+                    lines.append(
+                        "pathway_tpu_query_e2e_latency_ms_count"
+                        f"{{{tlab}}} {ts['count']}")
+                lines.append(
+                    "# TYPE pathway_tpu_tenant_slo_burn_rate gauge")
+                for tenant, ts in sorted(tenants.items()):
+                    lines.append(
+                        "pathway_tpu_tenant_slo_burn_rate"
+                        f'{{tenant="{esc(tenant)}"}} {ts["burn_rate"]}')
         qos = getattr(self.runtime, "qos", None)
         if qos is not None:
             # QoS control plane (engine/qos.py): the budget the
@@ -447,6 +488,49 @@ class MonitoringHttpServer:
             lines.append("# TYPE pathway_tpu_device_exec_ms_total counter")
             lines.append(
                 f"pathway_tpu_device_exec_ms_total {bridge['exec_ms']}")
+        prof = _profiler_stats()
+        if prof is not None:
+            # continuous profiling plane (engine/profiler.py): rolling
+            # MFU / HBM bandwidth utilization from the shared analytic
+            # cost model (the same math bench.py reports), per-family
+            # device time + arithmetic intensity, and the host sampler's
+            # self-accounting (its <2% overhead contract, measurable)
+            lines.append("# TYPE pathway_tpu_mfu_rolling gauge")
+            lines.append(f"pathway_tpu_mfu_rolling {prof['mfu_rolling']}")
+            lines.append("# TYPE pathway_tpu_hbm_bw_util gauge")
+            lines.append(f"pathway_tpu_hbm_bw_util {prof['hbm_bw_util']}")
+            fams = prof["families"]
+            if fams:
+                lines.append("# TYPE pathway_tpu_kernel_device_ms counter")
+                lines.append("# TYPE pathway_tpu_kernel_dispatches counter")
+                lines.append("# TYPE pathway_tpu_kernel_mfu gauge")
+                lines.append("# TYPE pathway_tpu_kernel_arithmetic_intensity"
+                             " gauge")
+                for fam, st in sorted(fams.items()):
+                    flab = f'{{family="{esc(fam)}"}}'
+                    lines.append(f"pathway_tpu_kernel_device_ms{flab} "
+                                 f"{st['device_ms_total']}")
+                    lines.append(f"pathway_tpu_kernel_dispatches{flab} "
+                                 f"{st['dispatches']}")
+                    lines.append(
+                        f"pathway_tpu_kernel_mfu{flab} {st['mfu']}")
+                    lines.append(
+                        f"pathway_tpu_kernel_arithmetic_intensity{flab} "
+                        f"{st['roofline']['arithmetic_intensity']}")
+            host = prof["host"]
+            lines.append("# TYPE pathway_tpu_profiler_samples counter")
+            lines.append(
+                f"pathway_tpu_profiler_samples {host['samples_total']}")
+            lines.append("# TYPE pathway_tpu_profiler_device_attributed"
+                         "_samples counter")
+            lines.append(f"pathway_tpu_profiler_device_attributed_samples "
+                         f"{host['device_attributed_samples']}")
+            lines.append("# TYPE pathway_tpu_profiler_overhead_ratio gauge")
+            lines.append(f"pathway_tpu_profiler_overhead_ratio "
+                         f"{host['overhead_ratio']}")
+            lines.append("# TYPE pathway_tpu_profiler_distinct_stacks gauge")
+            lines.append(f"pathway_tpu_profiler_distinct_stacks "
+                         f"{host['distinct_stacks']}")
         try:
             from pathway_tpu.internals.autojit import autojit_stats
 
@@ -662,6 +746,65 @@ class MonitoringHttpServer:
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
+    def profile_host_response(self, query: str) -> tuple[int, bytes, str]:
+        """``/profile/host[?seconds=N]``: collapsed-flamegraph text
+        (``role;frame;... count`` per line). With ``seconds``, snapshots
+        the folded-stack counters, sleeps, and serves only the window's
+        delta; capped at 60 s. 503 when no profiler is installed."""
+        from pathway_tpu.engine.profiler import current_profiler
+
+        prof = current_profiler()
+        if prof is None:
+            return (503, json.dumps(
+                {"error": "profiler not running "
+                          "(enable with PATHWAY_PROFILER=1)"}).encode(),
+                "application/json")
+        seconds = 0.0
+        for part in query.split("&"):
+            if part.startswith("seconds="):
+                try:
+                    seconds = min(60.0, max(0.0, float(part[8:])))
+                except ValueError:
+                    pass
+        if seconds > 0.0:
+            import time as _time
+
+            baseline = prof.stack_counts()
+            _time.sleep(seconds)
+            text = prof.collapsed(baseline)
+        else:
+            text = prof.collapsed()
+        return 200, text.encode(), "text/plain; charset=utf-8"
+
+    def profile_device_response(self, start: bool,
+                                query: str) -> tuple[int, dict]:
+        """``/profile/device/start|stop``: drive an on-demand
+        jax.profiler capture into an artifact directory (start accepts
+        ``?dir=...``). 409 when starting twice / stopping idle, 503
+        when no profiler is installed."""
+        from pathway_tpu.engine.profiler import current_profiler
+
+        prof = current_profiler()
+        if prof is None:
+            return 503, {"error": "profiler not running "
+                                  "(enable with PATHWAY_PROFILER=1)"}
+        try:
+            if start:
+                out_dir = None
+                for part in query.split("&"):
+                    if part.startswith("dir="):
+                        from urllib.parse import unquote
+
+                        out_dir = unquote(part[4:])
+                return 200, {"capturing": True,
+                             "dir": prof.start_device_capture(out_dir)}
+            return 200, {"capturing": False,
+                         "dir": prof.stop_device_capture()}
+        except RuntimeError as e:
+            return 409, {"error": str(e)}
+        except Exception as e:  # jax.profiler unavailable / backend error
+            return 503, {"error": f"{type(e).__name__}: {e}"}
+
     # -- server ------------------------------------------------------------
     def start(self) -> None:
         server = self
@@ -689,6 +832,18 @@ class MonitoringHttpServer:
                         payload = server.chrome_trace_payload()
                     else:
                         payload = server.trace_payload()
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                elif path == "/profile/host":
+                    # collapsed-flamegraph text (engine/profiler.py):
+                    # ?seconds=N windows the profile to samples taken
+                    # from now (each request has its own handler thread,
+                    # so the sleep blocks nobody else)
+                    code, body, ctype = server.profile_host_response(query)
+                elif path in ("/profile/device/start",
+                              "/profile/device/stop"):
+                    code, payload = server.profile_device_response(
+                        path.endswith("/start"), query)
                     body = json.dumps(payload).encode()
                     ctype = "application/json"
                 else:

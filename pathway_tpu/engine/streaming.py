@@ -123,6 +123,20 @@ class StreamingRuntime:
                 replica.replica_id if replica is not None
                 else _os.environ.get("PATHWAY_REPLICA_ID")
                 or f"primary-{_os.getpid()}")
+        # continuous profiler (engine/profiler.py): same observability
+        # arming rule as the recorder; installed process-wide so the
+        # kernel cost-model hooks and the bridge's leg context find it
+        # with one global load. Sampling starts at run().
+        from pathway_tpu.engine.profiler import (Profiler, current_profiler,
+                                                 install_profiler)
+
+        self.profiler = Profiler.from_env(
+            auto_on=(with_http_server or self.monitor.enabled()
+                     or self._qos_config is not None))
+        self._installed_profiler = False
+        if self.profiler is not None and current_profiler() is None:
+            install_profiler(self.profiler)
+            self._installed_profiler = True
         self.scheduler = Scheduler(runner.graph, n_workers=n_workers,
                                    cluster=cluster, recorder=self.recorder)
         # watchdog progress on every resolved device leg: the commit loop
@@ -724,6 +738,8 @@ class StreamingRuntime:
         self.supervisor.start_all()
         if self.http_server is not None:
             self.http_server.start()
+        if self.profiler is not None:
+            self.profiler.start()
 
         # feed static tables at startup: dimension data (markdown tables,
         # static csv) joined against live streams must be present from tick
@@ -974,6 +990,17 @@ class StreamingRuntime:
                 self.persistence.close()
             if self.replica is not None:
                 self.replica.close()
+            if self.profiler is not None:
+                # stop the sampler + any in-flight capture; release the
+                # module-global hook only if this run installed it (a
+                # test-installed profiler outlives the run untouched)
+                self.profiler.stop()
+                if self._installed_profiler:
+                    from pathway_tpu.engine.profiler import (
+                        current_profiler, install_profiler)
+
+                    if current_profiler() is self.profiler:
+                        install_profiler(None)
             if self.http_server is not None:
                 self.http_server.stop()
         fatal = self.supervisor.fatal_error

@@ -35,7 +35,22 @@ import time
 import weakref
 
 __all__ = ["crashed_threads", "install_excepthook", "live_threads",
-           "spawn"]
+           "spawn", "thread_role"]
+
+# the uniform engine thread-name prefix (see module doc #3); the profiler's
+# host sampler keys folded stacks by the role suffix
+NAME_PREFIX = "pathway-tpu-"
+
+
+def thread_role(name: str) -> str | None:
+    """Role of an engine thread name: the suffix after the uniform
+    ``pathway-tpu-`` prefix, ``"main"`` for MainThread, None for threads
+    outside the engine inventory (the profiler skips those)."""
+    if name.startswith(NAME_PREFIX):
+        return name[len(NAME_PREFIX):]
+    if name == "MainThread":
+        return "main"
+    return None
 
 # factory-made threads still referenced somewhere (weak: a finished thread
 # whose handle was dropped must not leak inventory entries forever)
@@ -96,7 +111,7 @@ def spawn(target, *, name: str, daemon: bool = True, args: tuple = (),
     """
     install_excepthook()
     if not name.startswith("pathway-tpu"):
-        name = f"pathway-tpu-{name}"
+        name = NAME_PREFIX + name
     # pwt-ok: PWT207 — the factory's own construction site
     t = threading.Thread(target=target, args=args, kwargs=kwargs or {},
                          daemon=daemon, name=name)

@@ -113,6 +113,10 @@ class StatsMonitor:
         # semantic result cache line: hit ratio, entry count and the
         # incremental-invalidation counters (engine/result_cache.py)
         self._cache_line = self._cache_panel()
+        # profiler line: rolling MFU / HBM bandwidth utilisation from the
+        # device cost model plus the host sampler's hottest frame and its
+        # own overhead ratio (engine/profiler.py)
+        self._profiler_line = self._profiler_panel()
         # durability line: commit watermark, its lag behind the pipeline
         # head, and the bridge depth the last commit trailed — a frozen
         # watermark is visible here before the watchdog fires
@@ -212,6 +216,9 @@ class StatsMonitor:
         if getattr(self, "_cache_line", None):
             parts.append(Panel(self._cache_line, title="result cache",
                                height=None))
+        if getattr(self, "_profiler_line", None):
+            parts.append(Panel(self._profiler_line, title="profiler",
+                               height=None))
         if getattr(self, "_serving_lines", None):
             parts.append(Panel("\n".join(self._serving_lines),
                                title="serving", height=None))
@@ -310,6 +317,30 @@ class StatsMonitor:
                 f"({st['invalidations_per_tick']:.2f}/tick)  "
                 f"v{st['version']}")
 
+    def _profiler_panel(self) -> str | None:
+        try:
+            from pathway_tpu.engine.profiler import live_profiler_stats
+
+            st = live_profiler_stats()
+        except Exception:
+            return None
+        if st is None:
+            return None
+        line = (f"MFU {st['mfu_rolling']:.1%}  "
+                f"HBM {st['hbm_bw_util']:.1%}  "
+                f"samples {st['host']['samples_total']} "
+                f"({st['host']['device_attributed_samples']} on-device)  "
+                f"overhead {st['host']['overhead_ratio']:.2%}")
+        top = st["host"].get("top_frame")
+        if top:
+            line += f"\nhot: {top}"
+        fams = st.get("families") or {}
+        bound = [f"{name}:{fam['roofline']['bound_by'][:4]}"
+                 for name, fam in sorted(fams.items()) if fam["dispatches"]]
+        if bound:
+            line += "\nroofline " + "  ".join(bound)
+        return line
+
     def _slowest_lines(self, top_n: int = 5) -> list[str]:
         """Critical-path panel: the operators that dominated the last
         tick, worst first — the per-tick answer to "where does the time
@@ -365,6 +396,8 @@ class StatsMonitor:
                 print(f"[monitor] {self._paged_line}", file=sys.stderr)
             if getattr(self, "_cache_line", None):
                 print(f"[monitor] {self._cache_line}", file=sys.stderr)
+            if getattr(self, "_profiler_line", None):
+                print(f"[monitor] {self._profiler_line}", file=sys.stderr)
             for line in getattr(self, "_serving_lines", None) or ():
                 print(f"[monitor] {line}", file=sys.stderr)
             if getattr(self, "_qos_line", None):

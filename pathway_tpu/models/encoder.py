@@ -420,3 +420,20 @@ def encode_ragged(params: dict, token_ids, doc_map, position_ids,
 @functools.partial(jax.jit, static_argnames=("config",))
 def encode_jit(params, token_ids, attention_mask, *, config: EncoderConfig):
     return encode(params, token_ids, attention_mask, config=config)
+
+
+def encoder_cost(config: EncoderConfig, batch: int, seq: int,
+                 ragged: bool = False) -> tuple[float, float]:
+    """Analytic (flops, bytes_moved) for one forward of ``batch x seq``
+    tokens under ``config`` — the config-aware face of the shared cost
+    model (engine/profiler.py owns the formulas; bench.py and the
+    profiling hooks both resolve through them, so MFU numbers agree
+    everywhere). ``ragged=True`` prices the packed segment-attention
+    variant (encode_ragged), which additionally materializes the score
+    tensor in HBM."""
+    from pathway_tpu.engine.profiler import (encoder_cost as _cost,
+                                             segment_attention_cost)
+
+    fn = segment_attention_cost if ragged else _cost
+    return fn(batch, seq, hidden=config.hidden,
+              intermediate=config.intermediate, layers=config.layers)

@@ -46,10 +46,14 @@ from __future__ import annotations
 import enum
 import functools
 import math
+import time as _time
 from typing import Any, Callable
 
 import numpy as np
 
+from pathway_tpu.engine.profiler import (current_profiler,
+                                         ingest_scatter_cost,
+                                         knn_search_cost)
 from pathway_tpu.internals.keys import Pointer
 
 
@@ -761,9 +765,29 @@ class BruteForceKnnIndex:
     # ------------------------------------------------------------------
     # device sync + search
     # ------------------------------------------------------------------
+    def _slab_itemsize(self) -> int:
+        """Bytes per element of the DEVICE slab (the host mirror may be
+        wider: int8 keeps an exact f32 mirror)."""
+        if self._is_int8:
+            return 1
+        return 2 if self.dtype == "bfloat16" else 4
+
     def _scatter(self, idxs, vals, valid_vals):
         """Slab-donating scatter through the shared jitted kernel."""
-        self.upload_rows_total += int(idxs.shape[0])
+        rows = int(idxs.shape[0])
+        self.upload_rows_total += rows
+        prof = current_profiler()
+        if prof is not None:
+            t0 = _time.perf_counter()
+            self._scatter_dispatch(idxs, vals, valid_vals)
+            flops, nbytes = ingest_scatter_cost(
+                rows, self.dim, itemsize=self._slab_itemsize())
+            prof.record_dispatch("ingest_scatter", flops, nbytes,
+                                 (_time.perf_counter() - t0) * 1e3)
+            return
+        self._scatter_dispatch(idxs, vals, valid_vals)
+
+    def _scatter_dispatch(self, idxs, vals, valid_vals):
         if self._is_int8:
             (self._dev_vectors, self._dev_scales, self._dev_vsq,
              self._dev_valid) = _shared_scatter_i8_fn()(
@@ -863,9 +887,21 @@ class BruteForceKnnIndex:
         """(scores, global slot ids) as host arrays, exactly ``fetch_k``
         columns, best first. Lock held, device state flushed."""
         search_fn = self._get_search_fn(fetch_k)
+        prof = current_profiler()
+        t0 = _time.perf_counter() if prof is not None else 0.0
         ts, ti = search_fn(qmat, self._dev_vectors, self._search_extras(),
                            self._dev_valid)
-        return np.asarray(ts), np.asarray(ti)
+        out = np.asarray(ts), np.asarray(ti)
+        if prof is not None:
+            # np.asarray above materializes the result, so the call-site
+            # wall below is honest device time even outside a bridge leg
+            flops, nbytes = knn_search_cost(
+                int(qmat.shape[0]), self.capacity, self.dim,
+                itemsize=self._slab_itemsize(),
+                extra_row_bytes=8 if self._is_int8 else 0)
+            prof.record_dispatch("knn_search", flops, nbytes,
+                                 (_time.perf_counter() - t0) * 1e3)
+        return out
 
     def search(self, queries: list[tuple]) -> list[tuple]:
         """Batched search: [(qkey, vector, limit, filter)] →
@@ -874,6 +910,15 @@ class BruteForceKnnIndex:
         reported as distance) or cosine distance 1-cos_sim."""
         if not queries:
             return []
+        tenant = getattr(self, "_tenant", None)
+        if tenant is not None:
+            # per-tenant serving metrics: the query keys ARE the engine
+            # keys the request tracker registered at enqueue, so this is
+            # where tenant identity meets the request span
+            from pathway_tpu.engine.request_tracker import live_trackers
+
+            for trk in live_trackers():
+                trk.attribute_tenant((q[0] for q in queries), tenant)
         with self._lock:
             if not self._key_to_slot:
                 # empty-index scan touches nothing: an entry filled from
@@ -1150,6 +1195,8 @@ class PagedKnnIndex(BruteForceKnnIndex):
 
         idxs_np = np.asarray(idxs)
         self.upload_rows_total += len(idxs_np)
+        prof = current_profiler()
+        t0 = _time.perf_counter() if prof is not None else 0.0
         groups = list(self._pool.split_by_extent(idxs_np))
         for ext, local, pos in groups:
             self._establish_extent(ext)
@@ -1166,6 +1213,11 @@ class PagedKnnIndex(BruteForceKnnIndex):
                 ext.vectors, ext.valid = _shared_scatter_fn()(
                     ext.vectors, ext.valid,
                     jnp.asarray(local, dtype=jnp.int32), vsub, valsub)
+        if prof is not None:
+            flops, nbytes = ingest_scatter_cost(
+                len(idxs_np), self.dim, itemsize=self._slab_itemsize())
+            prof.record_dispatch("ingest_scatter", flops, nbytes,
+                                 (_time.perf_counter() - t0) * 1e3)
 
     def _flush_to_device(self):
         import jax.numpy as jnp
@@ -1226,6 +1278,26 @@ class PagedKnnIndex(BruteForceKnnIndex):
         return min(ext.rows, _CHUNK_ROWS)
 
     def _device_topk(self, qmat, fetch_k: int):
+        prof = current_profiler()
+        if prof is None:
+            return self._device_topk_parts(qmat, fetch_k)
+        t0 = _time.perf_counter()
+        out = self._device_topk_parts(qmat, fetch_k)
+        # the per-extent kernels scan exactly the established rows (each
+        # np.asarray in the parts loop materializes, so the wall is
+        # honest device time); cost the scan over those rows, not the
+        # slab capacity
+        rows = sum(e.rows for e in self._pool.extents if e.established)
+        if rows:
+            flops, nbytes = knn_search_cost(
+                int(qmat.shape[0]), rows, self.dim,
+                itemsize=self._slab_itemsize(),
+                extra_row_bytes=8 if self._is_int8 else 0)
+            prof.record_dispatch("knn_search", flops, nbytes,
+                                 (_time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _device_topk_parts(self, qmat, fetch_k: int):
         parts = []
         for ext in self._pool.extents:
             if not ext.established:

@@ -11,6 +11,7 @@ tokenized and encoded in one device call, never per row.
 from __future__ import annotations
 
 import asyncio
+from time import perf_counter as _perf_counter
 from typing import Any
 
 import numpy as np
@@ -271,14 +272,42 @@ class JaxEncoderEmbedder(BaseEmbedder):
         # same bytes over PCIe either way, but the explicit form stays
         # legal under the device sanitizer's steady-state transfer guard
         # (engine/device_sanitizer.py) and under PWT404's discipline
+        from pathway_tpu.engine.profiler import current_profiler
+
+        prof = current_profiler()
+        cfg = self.config
         if self.ragged:
-            outs = [self._encode_ragged(
-                self.params, *(jnp.asarray(a) for a in args))[:n_docs]
-                    for args, n_docs, _n_pad in self.pack_ragged(texts)]
+            outs = []
+            for args, n_docs, _n_pad in self.pack_ragged(texts):
+                t0 = _perf_counter() if prof is not None else 0.0
+                outs.append(self._encode_ragged(
+                    self.params, *(jnp.asarray(a) for a in args))[:n_docs])
+                if prof is not None:
+                    from pathway_tpu.engine.profiler import \
+                        segment_attention_cost
+
+                    b, s = args[0].shape  # packed (n_seqs, W) token ids
+                    flops, nbytes = segment_attention_cost(
+                        int(b), int(s), hidden=cfg.hidden,
+                        intermediate=cfg.intermediate, layers=cfg.layers)
+                    prof.record_dispatch(
+                        "segment_attention", flops, nbytes,
+                        (_perf_counter() - t0) * 1e3)
             return outs[0] if len(outs) == 1 else jnp.concatenate(outs, 0)
         ids, lens = self.pack_tokens(texts)
-        return self._encode_packed(self.params, jnp.asarray(ids),
-                                   jnp.asarray(lens))
+        t0 = _perf_counter() if prof is not None else 0.0
+        out = self._encode_packed(self.params, jnp.asarray(ids),
+                                  jnp.asarray(lens))
+        if prof is not None:
+            from pathway_tpu.engine.profiler import encoder_cost
+
+            b, s = ids.shape
+            flops, nbytes = encoder_cost(
+                int(b), int(s), hidden=cfg.hidden,
+                intermediate=cfg.intermediate, layers=cfg.layers)
+            prof.record_dispatch("encoder_forward", flops, nbytes,
+                                 (_perf_counter() - t0) * 1e3)
+        return out
 
     def embed_batch(self, texts: list[str]) -> np.ndarray:
         return np.asarray(self.encode_batch_device(texts))

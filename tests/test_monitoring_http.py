@@ -1040,3 +1040,234 @@ def test_router_cache_metrics_and_status():
     assert st["result_cache"]["routes"] == ["/query"]
     assert st["result_cache"]["watermark_live"] is True
     assert st["result_cache"]["entries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# continuous profiling plane (engine/profiler.py): exposition + endpoints
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _installed_profiler():
+    """A live profiler with known device dispatches and folded stacks
+    (sampler not started — the endpoints read state, not the thread)."""
+    from pathway_tpu.engine.profiler import (Profiler, install_profiler,
+                                             knn_search_cost)
+
+    prof = Profiler(sample_interval_ms=1e6)
+    f, b = knn_search_cost(4, 1024, 64)
+    prof.record_dispatch("knn_search", f, b, 2.0)
+    prof.record_dispatch("encoder_forward", 1e9, 1e6, 5.0)
+    with prof._lock:
+        prof._stacks[("worker", ("run (graph.py:10)", "step (knn.py:20)"))] = 3
+        prof._stacks[("device-bridge", ("work (bridge.py:5)",
+                                        "[device:knn_q]"))] = 2
+        prof.samples_total = 5
+        prof.device_attributed_samples = 2
+    install_profiler(prof)
+    yield prof
+    install_profiler(None)
+
+
+_PROFILER_FAMILIES = (
+    "pathway_tpu_mfu_rolling", "pathway_tpu_hbm_bw_util",
+    "pathway_tpu_kernel_device_ms", "pathway_tpu_kernel_dispatches",
+    "pathway_tpu_kernel_mfu", "pathway_tpu_kernel_arithmetic_intensity",
+    "pathway_tpu_profiler_samples",
+    "pathway_tpu_profiler_device_attributed_samples",
+    "pathway_tpu_profiler_overhead_ratio",
+    "pathway_tpu_profiler_distinct_stacks",
+)
+
+
+def test_profiler_families_exposition_and_status(_installed_profiler):
+    lines = _metrics_lines(_recording_runtime())
+    samples = _parse_samples(lines)  # regex lint over every line
+    fam = {f for f, _l, _v in samples}
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    for name in _PROFILER_FAMILIES:
+        assert name in fam, f"{name} not exported"
+        assert name in typed, f"{name} has no # TYPE declaration"
+    kernels = {labels["family"]: v for f, labels, v in samples
+               if f == "pathway_tpu_kernel_device_ms"}
+    assert kernels == {"knn_search": 2.0, "encoder_forward": 5.0}
+    counts = {f: v for f, labels, v in samples if not labels}
+    assert counts["pathway_tpu_profiler_samples"] == 5.0
+    assert counts["pathway_tpu_profiler_device_attributed_samples"] == 2.0
+    assert counts["pathway_tpu_mfu_rolling"] > 0.0
+    # /status.profiler: roofline verdict per family
+    server = MonitoringHttpServer(_recording_runtime(), port=0)
+    status = server.status_payload()
+    rooflines = {fam: st["roofline"]["bound_by"]
+                 for fam, st in status["profiler"]["families"].items()}
+    assert rooflines["knn_search"] == "bandwidth"
+    assert status["profiler"]["host"]["samples_total"] == 5
+
+
+def test_metrics_without_profiler_omit_the_families():
+    lines = _metrics_lines(_recording_runtime())
+    fam = {f for f, _l, _v in _parse_samples(lines)}
+    assert not fam & set(_PROFILER_FAMILIES)
+
+
+def _tenant_runtime():
+    """A recording runtime whose tracker completed per-tenant queries:
+    acme fast (inside the 50ms SLO), bigco slow (burning budget)."""
+    import time as _time
+
+    from pathway_tpu.engine.request_tracker import RequestTracker
+
+    rt = _recording_runtime()
+    tr = RequestTracker(slo_ms=50.0)
+    for tenant, ms, n in (("acme", 10.0, 8), ("bigco", 120.0, 8)):
+        for i in range(n):
+            base = _time.perf_counter()
+            span = tr.start(f"{tenant}{i}", "/q", t_ingress=base)
+            span.key = (tenant, i)
+            tr._by_key[span.key] = span
+            span.t_enqueued = base
+            tr.attribute_tenant([span.key], tenant)
+            span.t_resolved = base + ms / 1e3
+            tr.finish(span)
+    rt.scheduler.recorder.requests = tr
+    return rt
+
+
+def test_tenant_serving_families_exposition():
+    rt = _tenant_runtime()
+    lines = _metrics_lines(rt)
+    samples = _parse_samples(lines)  # regex lint over every line
+    # tenant-labeled quantiles ride under the EXISTING summary family —
+    # exactly one TYPE declaration for it
+    type_lines = [l.split()[2] for l in lines if l.startswith("# TYPE")]
+    assert type_lines.count("pathway_tpu_query_e2e_latency_ms") == 1
+    q = {(labels["tenant"], labels["quantile"]): v
+         for f, labels, v in samples
+         if f == "pathway_tpu_query_e2e_latency_ms" and "tenant" in labels}
+    assert set(q) == {("acme", "0.5"), ("acme", "0.95"),
+                      ("bigco", "0.5"), ("bigco", "0.95")}
+    assert q[("acme", "0.5")] <= q[("acme", "0.95")]
+    assert q[("bigco", "0.5")] > q[("acme", "0.95")]
+    counts = {labels["tenant"]: v for f, labels, v in samples
+              if f == "pathway_tpu_query_e2e_latency_ms_count"
+              and "tenant" in labels}
+    assert counts == {"acme": 8.0, "bigco": 8.0}
+    burn = {labels["tenant"]: v for f, labels, v in samples
+            if f == "pathway_tpu_tenant_slo_burn_rate"}
+    assert burn["acme"] == 0.0
+    assert burn["bigco"] > 1.0
+    assert "pathway_tpu_tenant_slo_burn_rate" in type_lines
+
+
+def test_profile_host_endpoint_serves_collapsed_stacks(_installed_profiler):
+    server = MonitoringHttpServer(_recording_runtime(), port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        resp = urllib.request.urlopen(base + "/profile/host")
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+        lines = text.strip().splitlines()
+        line_re = re.compile(r"^[^; ][^;]*(;[^;]+)* \d+$")
+        for ln in lines:
+            assert line_re.match(ln), f"bad collapsed line: {ln!r}"
+        assert "worker;run (graph.py:10);step (knn.py:20) 3" in lines
+        # the in-flight tag survives as the synthetic leaf frame
+        assert any(ln.endswith("[device:knn_q] 2") for ln in lines)
+        # ?seconds=N serves only the window's delta (no new samples
+        # arrive while the sampler is idle -> empty window)
+        resp = urllib.request.urlopen(base + "/profile/host?seconds=0.05")
+        assert resp.read().decode() == ""
+    finally:
+        server.stop()
+
+
+def test_profile_endpoints_503_without_profiler():
+    import urllib.error
+
+    server = MonitoringHttpServer(_recording_runtime(), port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        for path in ("/profile/host", "/profile/device/start",
+                     "/profile/device/stop"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + path)
+            err = _drain_http_error(ei)
+            assert err.code == 503
+    finally:
+        server.stop()
+
+
+def test_profile_device_capture_contract(_installed_profiler, monkeypatch,
+                                         tmp_path):
+    """start -> artifact dir in JSON; double-start 409; stop returns the
+    same dir; idle stop 409. jax.profiler is stubbed: the test pins OUR
+    endpoint contract, not XLA's tracer."""
+    import urllib.error
+
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    server = MonitoringHttpServer(_recording_runtime(), port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        target = str(tmp_path / "cap")
+        from urllib.parse import quote
+
+        out = json.loads(urllib.request.urlopen(
+            base + f"/profile/device/start?dir={quote(target, safe='')}"
+        ).read())
+        assert out == {"capturing": True, "dir": target}
+        import os
+
+        assert os.path.isdir(target)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/profile/device/start")
+        assert _drain_http_error(ei).code == 409  # one capture at a time
+        out = json.loads(urllib.request.urlopen(
+            base + "/profile/device/stop").read())
+        assert out == {"capturing": False, "dir": target}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/profile/device/stop")
+        assert _drain_http_error(ei).code == 409  # nothing running
+        assert _installed_profiler.captures_total == 1
+    finally:
+        server.stop()
+
+
+def test_fleet_merge_relabels_profiler_gauges_per_process():
+    """PR-14 /fleet/metrics: the profiler gauges ride the merged
+    exposition with {process=,role=} labels and ONE TYPE declaration —
+    per-role MFU is readable straight off the fleet scrape."""
+    from pathway_tpu.engine.fleet_observability import merge_metrics
+
+    def doc(process, role, mfu, knn_ms):
+        lines = [
+            "# TYPE pathway_tpu_mfu_rolling gauge",
+            f"pathway_tpu_mfu_rolling {mfu}",
+            "# TYPE pathway_tpu_kernel_device_ms counter",
+            f'pathway_tpu_kernel_device_ms{{family="knn_search"}} {knn_ms}',
+            "# EOF",
+        ]
+        return ({"process": process, "role": role},
+                "\n".join(lines) + "\n")
+
+    merged = merge_metrics([doc("primary-0", "primary", 0.31, 12.0),
+                            doc("replica-1", "replica", 0.07, 48.0)])
+    lines = merged.splitlines()
+    samples = _parse_samples(lines)  # regex lint over every line
+    type_lines = [l.split()[2] for l in lines if l.startswith("# TYPE")]
+    assert type_lines.count("pathway_tpu_mfu_rolling") == 1
+    assert type_lines.count("pathway_tpu_kernel_device_ms") == 1
+    mfu = {(labels.get("process"), labels.get("role")): v
+           for f, labels, v in samples if f == "pathway_tpu_mfu_rolling"
+           if "process" in labels}
+    assert mfu[("primary-0", "primary")] == 0.31
+    assert mfu[("replica-1", "replica")] == 0.07
+    knn = {labels.get("process"): (v, labels.get("family"))
+           for f, labels, v in samples
+           if f == "pathway_tpu_kernel_device_ms" and "process" in labels}
+    assert knn["primary-0"] == (12.0, "knn_search")
+    assert knn["replica-1"] == (48.0, "knn_search")
